@@ -1,0 +1,17 @@
+"""kubeflow_tpu — a TPU-native ML platform.
+
+A ground-up rebuild of the capabilities of the Kubeflow monorepo
+(reference: rbrishabh/kubeflow) designed TPU-first:
+
+- compute path: JAX/XLA, pjit/shard_map over ``jax.sharding.Mesh``, Pallas
+  kernels for hot ops; SPMD replaces the reference's PS/NCCL/MPI wiring.
+- control plane: a single slice-aware ``TpuJob`` operator replaces the
+  TFJob/PyTorchJob/MPIJob operator family; gang placement onto TPU pod
+  slices (``google.com/tpu``) replaces GPU node pools.
+- platform: typed deployment config + manifest engine + ``ctl`` CLI replace
+  kfctl/ksonnet/kustomize; a JAX serving component replaces TF-Serving.
+
+See SURVEY.md at the repo root for the full capability map.
+"""
+
+__version__ = "0.1.0"

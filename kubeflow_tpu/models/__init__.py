@@ -1,0 +1,16 @@
+"""In-framework model zoo: transformer LM (flagship), ResNet, MNIST CNN."""
+
+from kubeflow_tpu.models.transformer import (  # noqa: F401
+    Transformer,
+    TransformerConfig,
+    param_logical_axes,
+    param_partition_specs,
+    tiny_config,
+)
+from kubeflow_tpu.models.resnet import (  # noqa: F401
+    ResNet,
+    ResNetConfig,
+    resnet18_thin,
+    resnet50,
+)
+from kubeflow_tpu.models.mnist import MnistCnn  # noqa: F401

@@ -1,0 +1,27 @@
+"""MNIST CNN — the correctness-smoke workload.
+
+Mirrors the reference's 1-worker tf-cnn MNIST smoke config (BASELINE.md
+config 1; reference harness ``/root/reference/tf-controller-examples/tf-cnn/``).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistCnn(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, images: jnp.ndarray) -> jnp.ndarray:
+        """images: (B, 28, 28, 1) -> logits (B, 10)."""
+        x = nn.Conv(32, (3, 3), name="conv1")(images)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3), name="conv2")(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128, name="fc1")(x))
+        return nn.Dense(self.num_classes, name="fc2")(x)

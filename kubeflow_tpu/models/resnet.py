@@ -1,0 +1,108 @@
+"""ResNet-v1.5 family in JAX/Flax — the benchmark workload.
+
+The reference's headline workload is ``tf_cnn_benchmarks`` ResNet-50 run as a
+TFJob (``/root/reference/kubeflow/examples/prototypes/tf-job-simple-v1.jsonnet:28-38``,
+``/root/reference/tf-controller-examples/tf-cnn/create_job_specs.py:101-120``).
+That code lives outside the reference repo; here the model is in-framework so
+the kubebench-equivalent pipeline (``kubeflow_tpu/bench``) benchmarks a real
+training loop on TPU.
+
+TPU notes: NHWC layout (XLA's native conv layout on TPU), bf16 compute with
+fp32 BN statistics, no data-dependent control flow.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)  # ResNet-50
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int
+    dtype: Any
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = partial(
+            nn.Conv, use_bias=False, dtype=self.dtype, param_dtype=self.param_dtype
+        )
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=jnp.float32,
+            param_dtype=self.param_dtype,
+        )
+        residual = x
+        y = conv(self.filters, (1, 1), name="conv1")(x)
+        y = nn.relu(norm(name="bn1")(y))
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides), name="conv2")(y)
+        y = nn.relu(norm(name="bn2")(y))
+        y = conv(self.filters * 4, (1, 1), name="conv3")(y)
+        y = norm(name="bn3", scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(
+                self.filters * 4, (1, 1), strides=(self.strides, self.strides),
+                name="proj_conv",
+            )(residual)
+            residual = norm(name="proj_bn")(residual)
+        return nn.relu(residual + y.astype(residual.dtype))
+
+
+class ResNet(nn.Module):
+    config: ResNetConfig = ResNetConfig()
+
+    @nn.compact
+    def __call__(self, images: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+        """images: (B, H, W, 3) -> logits (B, num_classes) float32."""
+        c = self.config
+        x = images.astype(c.dtype)
+        x = nn.Conv(
+            c.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+            use_bias=False, dtype=c.dtype, param_dtype=c.param_dtype, name="stem_conv",
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train, momentum=0.9, epsilon=1e-5,
+            dtype=jnp.float32, param_dtype=c.param_dtype, name="stem_bn",
+        )(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, n_blocks in enumerate(c.stage_sizes):
+            for j in range(n_blocks):
+                x = BottleneckBlock(
+                    filters=c.width * 2 ** i,
+                    strides=2 if j == 0 and i > 0 else 1,
+                    dtype=c.dtype,
+                    param_dtype=c.param_dtype,
+                    name=f"stage{i}_block{j}",
+                )(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(
+            c.num_classes, dtype=jnp.float32, param_dtype=c.param_dtype, name="head",
+        )(x.astype(jnp.float32))
+
+
+def resnet50(num_classes: int = 1000, **kw) -> ResNet:
+    return ResNet(ResNetConfig(stage_sizes=(3, 4, 6, 3), num_classes=num_classes, **kw))
+
+
+def resnet18_thin(num_classes: int = 10) -> ResNet:
+    """Small variant for CPU tests."""
+    return ResNet(ResNetConfig(stage_sizes=(1, 1), num_classes=num_classes, width=16,
+                               dtype=jnp.float32))

@@ -1,0 +1,360 @@
+"""Flagship decoder-only transformer LM, written TPU-first.
+
+The reference platform never sees model internals — its workloads are opaque
+container images (``tf_cnn_benchmarks`` via
+``/root/reference/kubeflow/examples/prototypes/tf-job-simple-v1.jsonnet:28-38``).
+The TPU-native framework ships models in-framework so parallelism axes
+(SURVEY.md §2c) are real capabilities: this model exposes logical sharding
+axes for DP/TP/SP/EP and stacks its blocks so pipeline stages can shard the
+leading layer axis.
+
+Design notes (TPU-first):
+- bf16 activations, fp32 params/optimizer; big fused einsums for the MXU.
+- ``nn.scan`` over blocks: one traced block, stacked params — fast compiles
+  and a natural ``stage`` axis for pipeline parallelism.
+- ``nn.remat`` per block trades FLOPs for HBM.
+- MoE uses exact dense top-k dispatch (one-hot combine einsum): static
+  shapes, XLA-friendly; experts shard over the ``expert`` logical axis. A
+  capacity-based all_to_all dispatch is the planned fast path for large E.
+- Sequence-parallel regions: norms/residual activations carry a ``seq``
+  sharding constraint so the tp group shards the sequence dim between the
+  matmul regions (Megatron-SP layout).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from kubeflow_tpu.parallel.mesh import (
+    AxisRules,
+    DEFAULT_RULES,
+    logical_to_mesh_axes,
+    shard_constraint,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 8
+    n_heads: int = 8
+    n_kv_heads: int = 8
+    d_ff: int = 2048
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    n_experts: int = 0            # 0 => dense MLP
+    experts_per_token: int = 2
+    dtype: Any = jnp.bfloat16     # activation/compute dtype
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    scan_layers: bool = True
+    logits_softcap: float = 0.0
+    rules: AxisRules = DEFAULT_RULES  # logical-axis -> mesh-axis sharding rules
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+    def validate(self) -> None:
+        if self.n_heads % max(self.n_kv_heads, 1):
+            raise ValueError("n_heads must be a multiple of n_kv_heads")
+        if self.n_experts and self.experts_per_token > self.n_experts:
+            raise ValueError("experts_per_token > n_experts")
+
+
+def _constrain(x, rules: AxisRules, *names):
+    """Logical sharding constraint; silently a no-op outside a mesh context."""
+    return shard_constraint(x, names, rules)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-6
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        dtype = x.dtype
+        x = x.astype(jnp.float32)
+        scale = self.param(
+            "scale", nn.initializers.ones, (x.shape[-1],), self.param_dtype
+        )
+        var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+        x = x * jax.lax.rsqrt(var + self.eps)
+        return (x * scale).astype(dtype)
+
+
+def rope_tables(seq_len: int, head_dim: int, theta: float) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    freqs = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    pos = jnp.arange(seq_len, dtype=jnp.float32)
+    angles = jnp.outer(pos, freqs)  # (S, Dh/2)
+    return jnp.sin(angles), jnp.cos(angles)
+
+
+def apply_rope(x: jnp.ndarray, sin: jnp.ndarray, cos: jnp.ndarray) -> jnp.ndarray:
+    """x: (B, S, H, Dh); rotate pairs (even, odd) halves interleaved as split."""
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    sin = sin[None, :, None, :].astype(x.dtype)
+    cos = cos[None, :, None, :].astype(x.dtype)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+
+
+class Attention(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, sin, cos):
+        c = self.config
+        B, S, D = x.shape
+        H, KH, Dh = c.n_heads, c.n_kv_heads, c.head_dim
+        init = nn.initializers.normal(stddev=D ** -0.5)
+
+        wq = self.param("q_proj", init, (D, H, Dh), c.param_dtype)
+        wk = self.param("k_proj", init, (D, KH, Dh), c.param_dtype)
+        wv = self.param("v_proj", init, (D, KH, Dh), c.param_dtype)
+        wo = self.param("o_proj", init, (H, Dh, D), c.param_dtype)
+
+        q = jnp.einsum("bsd,dhk->bshk", x, wq.astype(c.dtype))
+        k = jnp.einsum("bsd,dhk->bshk", x, wk.astype(c.dtype))
+        v = jnp.einsum("bsd,dhk->bshk", x, wv.astype(c.dtype))
+        q = _constrain(q, c.rules, "batch", None, "heads", None)
+        q = apply_rope(q, sin, cos)
+        k = apply_rope(k, sin, cos)
+
+        if KH != H:
+            rep = H // KH
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+
+        scale = Dh ** -0.5
+        logits = jnp.einsum("bshk,bthk->bhst", q, k) * scale
+        mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+        logits = jnp.where(mask[None, None], logits, jnp.finfo(logits.dtype).min)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(c.dtype)
+        out = jnp.einsum("bhst,bthk->bshk", probs, v)
+        out = jnp.einsum("bshk,hkd->bsd", out, wo.astype(c.dtype))
+        return _constrain(out, c.rules, "batch", "seq", None)
+
+
+class Mlp(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.config
+        D, F = c.d_model, c.d_ff
+        init = nn.initializers.normal(stddev=D ** -0.5)
+        w_gate = self.param("gate_proj", init, (D, F), c.param_dtype)
+        w_up = self.param("up_proj", init, (D, F), c.param_dtype)
+        w_down = self.param("down_proj", init, (F, D), c.param_dtype)
+        h = jax.nn.silu(x @ w_gate.astype(c.dtype)) * (x @ w_up.astype(c.dtype))
+        h = _constrain(h, c.rules, "batch", None, "mlp")
+        return _constrain(h @ w_down.astype(c.dtype), c.rules, "batch", "seq", None)
+
+
+class MoeMlp(nn.Module):
+    """Exact top-k MoE with dense one-hot dispatch (static shapes)."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.config
+        D, F, E, K = c.d_model, c.d_ff, c.n_experts, c.experts_per_token
+        init = nn.initializers.normal(stddev=D ** -0.5)
+        w_router = self.param("router", init, (D, E), jnp.float32)
+        w_gate = self.param("gate_proj", init, (E, D, F), c.param_dtype)
+        w_up = self.param("up_proj", init, (E, D, F), c.param_dtype)
+        w_down = self.param("down_proj", init, (E, F, D), c.param_dtype)
+
+        gate_logits = x.astype(jnp.float32) @ w_router  # (B, S, E)
+        weights, idx = jax.lax.top_k(gate_logits, K)
+        weights = jax.nn.softmax(weights, axis=-1)      # (B, S, K)
+        # combine[b, s, e] = sum_k weights[b,s,k] * [idx[b,s,k] == e]
+        combine = jnp.sum(
+            jax.nn.one_hot(idx, E, dtype=jnp.float32) * weights[..., None], axis=2
+        )  # (B, S, E)
+        combine = combine.astype(c.dtype)
+
+        # Dense dispatch: every expert sees every token, masked by combine.
+        # Experts shard over the "expert" logical axis (EP); with E experts on
+        # e_p shards each device computes E/e_p of the einsum's leading dim.
+        h = jnp.einsum("bsd,edf->bsef", x, w_gate.astype(c.dtype))
+        u = jnp.einsum("bsd,edf->bsef", x, w_up.astype(c.dtype))
+        h = jax.nn.silu(h) * u
+        # batch keeps the dp axis here (expert weights are dp-sharded, so
+        # XLA gathers expert shards within the dp group); a capacity-based
+        # all_to_all dispatch that truly keeps experts resident is the
+        # planned fast path.
+        h = _constrain(h, c.rules, "batch", None, None, "expert_mlp")
+        y = jnp.einsum("bsef,efd->bsed", h, w_down.astype(c.dtype))
+        y = jnp.einsum("bsed,bse->bsd", y, combine)
+
+        # load-balancing auxiliary loss (Switch-style): mean prob * fraction routed
+        probs = jax.nn.softmax(gate_logits, axis=-1)
+        density = jnp.mean(combine.astype(jnp.float32) > 0, axis=(0, 1))
+        mean_prob = jnp.mean(probs, axis=(0, 1))
+        self.sow("losses", "moe_aux", E * jnp.sum(density * mean_prob))
+        return _constrain(y, c.rules, "batch", "seq", None)
+
+
+class Block(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, aux):
+        sin, cos = aux
+        c = self.config
+        h = RMSNorm(param_dtype=c.param_dtype, name="attn_norm")(x)
+        x = x + Attention(c, name="attn")(h, sin, cos)
+        h = RMSNorm(param_dtype=c.param_dtype, name="mlp_norm")(x)
+        mlp = MoeMlp(c, name="moe") if c.n_experts else Mlp(c, name="mlp")
+        x = x + mlp(h)
+        return x, None
+
+
+class Transformer(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens: jnp.ndarray) -> jnp.ndarray:
+        """tokens: (B, S) int32 -> logits (B, S, V) float32."""
+        c = self.config
+        c.validate()
+        B, S = tokens.shape
+        embed = self.param(
+            "token_embed",
+            nn.initializers.normal(stddev=1.0),
+            (c.vocab_size, c.d_model),
+            c.param_dtype,
+        )
+        x = jnp.take(embed.astype(c.dtype), tokens, axis=0)
+        x = _constrain(x, c.rules, "batch", "seq", None)
+        sin, cos = rope_tables(S, c.head_dim, c.rope_theta)
+
+        block_cls = Block
+        if c.remat:
+            block_cls = nn.remat(Block, prevent_cse=False)
+        if c.scan_layers:
+            x, _ = nn.scan(
+                block_cls,
+                variable_axes={"params": 0, "losses": 0},
+                split_rngs={"params": True},
+                in_axes=nn.broadcast,
+                length=c.n_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(c, name="blocks")(x, (sin, cos))
+        else:
+            for i in range(c.n_layers):
+                x, _ = block_cls(c, name=f"block_{i}")(x, (sin, cos))
+
+        x = RMSNorm(param_dtype=c.param_dtype, name="final_norm")(x)
+        logits = jnp.einsum(
+            "bsd,vd->bsv", x, embed.astype(c.dtype)
+        ).astype(jnp.float32)
+        if c.logits_softcap:
+            logits = c.logits_softcap * jnp.tanh(logits / c.logits_softcap)
+        return _constrain(logits, c.rules, "batch", None, "vocab")
+
+
+# ---------------------------------------------------------------------------
+# Parameter sharding: param-path -> logical axes -> PartitionSpec
+# ---------------------------------------------------------------------------
+
+_PARAM_AXES = {
+    "token_embed": ("vocab", "embed"),
+    "q_proj": ("embed", "heads", "kv"),
+    "k_proj": ("embed", "heads", "kv"),
+    "v_proj": ("embed", "heads", "kv"),
+    "o_proj": ("heads", "kv", "embed"),
+    "gate_proj": ("embed", "mlp"),
+    "up_proj": ("embed", "mlp"),
+    "down_proj": ("mlp", "embed"),
+    "router": ("embed", None),
+    "scale": (None,),
+}
+
+_MOE_PARAM_AXES = {
+    "gate_proj": ("expert", "embed", "expert_mlp"),
+    "up_proj": ("expert", "embed", "expert_mlp"),
+    "down_proj": ("expert", "expert_mlp", "embed"),
+}
+
+
+def _path_names(path) -> Tuple[str, ...]:
+    names = []
+    for p in path:
+        if hasattr(p, "key"):
+            names.append(str(p.key))
+        elif hasattr(p, "name"):
+            names.append(str(p.name))
+    return tuple(names)
+
+
+def leaf_logical_axes(path, leaf) -> Tuple[Optional[str], ...]:
+    """Logical axes for one pytree leaf, by param-name matching.
+
+    Works on raw param trees and on whole optimizer/train states (optax's
+    mu/nu mirror the param tree, so the same trailing names match; unknown
+    leaves and scalars fall back to replicated).
+    """
+    names = _path_names(path)
+    name = names[-1] if names else ""
+    ndim = getattr(leaf, "ndim", 0)  # non-array leaves (e.g. a python-int
+    if ndim == 0:                    # TrainState.step) replicate
+        return ()
+    in_moe = "moe" in names
+    table = _MOE_PARAM_AXES if in_moe and name in _MOE_PARAM_AXES else _PARAM_AXES
+    axes = table.get(name)
+    if axes is None:
+        return (None,) * ndim
+    if "blocks" in names:  # scanned: leading layer axis
+        axes = (None,) + tuple(axes)
+    if len(axes) != ndim:
+        raise ValueError(f"axes {axes} rank != leaf {names} rank {ndim}")
+    return tuple(axes)
+
+
+def param_logical_axes(params) -> Any:
+    """Logical-axis tuples for every param leaf, keyed by path name matching.
+
+    Scanned blocks carry a leading layer axis; it maps to the ``stage``
+    logical axis only under pipeline parallelism, so here it is ``None``
+    (replicated layer stack = no pp) — the pipeline wrapper re-annotates it.
+    """
+    return jax.tree_util.tree_map_with_path(leaf_logical_axes, params)
+
+
+def param_partition_specs(params, rules: AxisRules = DEFAULT_RULES) -> Any:
+    axes = param_logical_axes(params)
+    return jax.tree_util.tree_map(
+        lambda a: logical_to_mesh_axes(a, rules),
+        axes,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def tiny_config(**overrides) -> TransformerConfig:
+    """A config small enough for CPU tests but exercising every code path."""
+    base = dict(
+        vocab_size=256,
+        d_model=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        d_ff=128,
+        max_seq_len=64,
+        dtype=jnp.float32,
+        remat=False,
+    )
+    base.update(overrides)
+    return TransformerConfig(**base)

@@ -1,0 +1,22 @@
+"""Parallelism library: mesh construction, sharding rules, distributed init.
+
+First-class DP/TP/PP/SP/EP where the reference only orchestrated
+process-level data parallelism (SURVEY.md §2c).
+"""
+
+from kubeflow_tpu.parallel.mesh import (  # noqa: F401
+    DEFAULT_RULES,
+    MESH_AXES,
+    MeshConfig,
+    auto_mesh_config,
+    create_mesh,
+    logical_to_mesh_axes,
+    named_sharding,
+    shard_constraint,
+    validate_mesh_for_model,
+)
+from kubeflow_tpu.parallel.distributed import (  # noqa: F401
+    ProcessEnv,
+    from_env,
+    initialize,
+)

@@ -1,0 +1,123 @@
+"""Multi-host bootstrap: the TPU-native replacement for TF_CONFIG / hostfiles.
+
+The reference wires distributed jobs through environment protocols the
+operator injects: ``TF_CONFIG`` JSON for PS jobs (consumed at
+``/root/reference/tf-controller-examples/tf-cnn/launcher.py:68-80``), MPI
+hostfiles + kubectl-delivery for MPIJob
+(``/root/reference/kubeflow/mpi-job/mpi-operator.libsonnet:287-289``), and
+MASTER_ADDR env for DDP. Here a single env contract carries the JAX
+coordinator address; XLA wires collectives over ICI within a slice and DCN
+across slices — no ssh, no hostfile, no driver DaemonSet.
+
+Env contract (injected by the TpuJob operator, see
+``kubeflow_tpu/operators/tpujob.py``):
+
+- ``KFTPU_COORDINATOR_ADDRESS``  host:port of process 0 (headless Service)
+- ``KFTPU_NUM_PROCESSES``        total host processes in the job
+- ``KFTPU_PROCESS_ID``           this process's rank
+- ``KFTPU_JOB_NAME`` / ``KFTPU_NAMESPACE``  identity, for logging/metrics
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import time
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+ENV_COORDINATOR = "KFTPU_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "KFTPU_NUM_PROCESSES"
+ENV_PROCESS_ID = "KFTPU_PROCESS_ID"
+ENV_JOB_NAME = "KFTPU_JOB_NAME"
+ENV_NAMESPACE = "KFTPU_NAMESPACE"
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcessEnv:
+    """Parsed view of the operator-injected distributed environment."""
+
+    coordinator_address: Optional[str]
+    num_processes: int
+    process_id: int
+    job_name: str = ""
+    namespace: str = "default"
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.num_processes > 1
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+
+def from_env(environ=None) -> ProcessEnv:
+    env = os.environ if environ is None else environ
+    return ProcessEnv(
+        coordinator_address=env.get(ENV_COORDINATOR),
+        num_processes=int(env.get(ENV_NUM_PROCESSES, "1")),
+        process_id=int(env.get(ENV_PROCESS_ID, "0")),
+        job_name=env.get(ENV_JOB_NAME, ""),
+        namespace=env.get(ENV_NAMESPACE, "default"),
+    )
+
+
+def initialize(
+    penv: Optional[ProcessEnv] = None,
+    *,
+    timeout_s: float = 300.0,
+    retry_interval_s: float = 5.0,
+) -> ProcessEnv:
+    """Call ``jax.distributed.initialize`` from the env contract, with retries.
+
+    The reference's TF_CONFIG was static — every process could start in any
+    order because PS/gRPC reconnected forever. JAX's coordinator (process 0)
+    must be reachable first, so non-zero ranks retry with backoff until the
+    coordinator's Service resolves (SURVEY.md §7 "hard parts" (c)).
+    Single-process jobs return immediately without touching jax.distributed.
+    """
+    penv = penv or from_env()
+    if not penv.is_distributed:
+        log.info("single-process job; skipping jax.distributed")
+        return penv
+    if not penv.coordinator_address:
+        raise RuntimeError(
+            f"{ENV_NUM_PROCESSES}>1 but {ENV_COORDINATOR} is not set"
+        )
+    import jax
+
+    deadline = time.monotonic() + timeout_s
+    attempt = 0
+    while True:
+        attempt += 1
+        remaining = max(deadline - time.monotonic(), retry_interval_s)
+        try:
+            jax.distributed.initialize(
+                coordinator_address=penv.coordinator_address,
+                num_processes=penv.num_processes,
+                process_id=penv.process_id,
+                initialization_timeout=int(remaining),
+            )
+            log.info(
+                "jax.distributed up: rank %d/%d via %s",
+                penv.process_id, penv.num_processes, penv.coordinator_address,
+            )
+            return penv
+        except Exception as e:  # noqa: BLE001 — grpc raises various types
+            # jax assigns its global distributed client before connect(), so
+            # a failed attempt must be torn down or every retry dies with
+            # "initialize should only be called once".
+            try:
+                jax.distributed.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"could not reach coordinator {penv.coordinator_address} "
+                    f"after {attempt} attempts"
+                ) from e
+            log.warning("coordinator not ready (attempt %d): %s", attempt, e)
+            time.sleep(retry_interval_s)

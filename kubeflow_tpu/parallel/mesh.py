@@ -1,0 +1,217 @@
+"""Device-mesh construction and logical-axis sharding rules.
+
+This is the heart of the parallelism the reference platform *lacks* (SURVEY.md
+§2c): the reference only offers process-level data parallelism (TFJob PS mode,
+MPIJob ring-allreduce, PyTorchJob DDP — see
+``/root/reference/kubeflow/tf-training/tf-job-operator.libsonnet:14-46``,
+``/root/reference/kubeflow/mpi-job/mpi-operator.libsonnet``). Here TP/PP/SP/EP
+are first-class mesh axes, and XLA emits the collectives over ICI.
+
+Physical mesh axes
+------------------
+``("dp", "pp", "tp")`` — data, pipeline-stage, and tensor axes. Two further
+*logical* parallelism forms ride these physical axes, which is the standard
+TPU mapping:
+
+- **sequence/context parallel (sp)** shards activations' sequence dimension
+  over the ``tp`` group (Megatron-style sequence parallelism: the tensor
+  group is already exchanging activations per layer, so the sequence shards
+  ride the same ICI neighbours; ring attention runs over the same axis).
+- **expert parallel (ep)** shards MoE experts over the ``dp`` group
+  (DeepSpeed-MoE-style EP-on-DP: tokens all_to_all within the dp group).
+
+Logical axis names used by models are mapped to mesh axes through a rules
+table so a model is written once and resharded by swapping rules.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+MESH_AXES = ("dp", "pp", "tp")
+
+# logical axis -> mesh axis (or None = replicated). Order matters only for
+# first-match lookup; each logical name appears once.
+AxisRules = Tuple[Tuple[str, Optional[Union[str, Tuple[str, ...]]]], ...]
+
+DEFAULT_RULES: AxisRules = (
+    ("batch", ("dp",)),        # per-example batch dim
+    ("stage", ("pp",)),        # stacked pipeline-stage dim on stage-stacked params
+    ("embed", None),           # d_model dim of activations: replicated in tp group
+    ("seq", ("tp",)),          # sequence-parallel regions (norms/residual)
+    ("heads", ("tp",)),        # attention heads
+    ("kv", None),              # per-head dim
+    ("mlp", ("tp",)),          # ffn hidden
+    ("vocab", ("tp",)),        # embedding/unembedding vocab dim
+    ("expert", ("dp",)),       # MoE experts ride the dp axis (EP-on-DP)
+    ("expert_mlp", ("tp",)),   # within-expert ffn hidden
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Shape of the device mesh. Product must equal the device count."""
+
+    dp: int = 1
+    pp: int = 1
+    tp: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.pp * self.tp
+
+    def axis_sizes(self) -> Tuple[int, int, int]:
+        return (self.dp, self.pp, self.tp)
+
+
+def auto_mesh_config(
+    n_devices: int, *, pp: int = 1, tp: Optional[int] = None
+) -> MeshConfig:
+    """Pick a mesh shape for ``n_devices``.
+
+    Defaults to pure data parallelism with a modest tp dimension when the
+    device count allows: tp = gcd(n/pp, 2) unless given. Callers with real
+    topology knowledge should construct :class:`MeshConfig` directly.
+    """
+    if n_devices % pp:
+        raise ValueError(f"pp={pp} does not divide device count {n_devices}")
+    rem = n_devices // pp
+    if tp is None:
+        tp = 2 if rem % 2 == 0 and rem > 1 else 1
+    if rem % tp:
+        raise ValueError(f"tp={tp} does not divide {rem}")
+    return MeshConfig(dp=rem // tp, pp=pp, tp=tp)
+
+
+def create_mesh(
+    config: Optional[MeshConfig] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ``jax.sharding.Mesh`` with axes ``("dp", "pp", "tp")``.
+
+    On real TPU slices, ``mesh_utils.create_device_mesh`` lays the axes out so
+    the innermost (tp) axis falls on ICI-adjacent chips — tp/sp collectives
+    (the per-layer ones) ride the fastest links, dp allreduce amortises over
+    the step.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    if config is None:
+        config = auto_mesh_config(len(devs))
+    if config.size != len(devs):
+        raise ValueError(
+            f"mesh {config.axis_sizes()} needs {config.size} devices, have {len(devs)}"
+        )
+    if devices is None and devs[0].platform == "tpu":
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_device_mesh(config.axis_sizes(), devices=devs)
+    else:
+        arr = np.asarray(devs).reshape(config.axis_sizes())
+    return Mesh(arr, MESH_AXES)
+
+
+def logical_to_mesh_axes(
+    logical_axes: Sequence[Optional[str]], rules: AxisRules = DEFAULT_RULES
+) -> PartitionSpec:
+    """Map a tuple of logical axis names (None = replicated) to a PartitionSpec."""
+    table = dict(rules)
+    out = []
+    for name in logical_axes:
+        if name is None:
+            out.append(None)
+            continue
+        if name not in table:
+            raise KeyError(f"no sharding rule for logical axis {name!r}")
+        mesh_axes = table[name]
+        if mesh_axes is None:
+            out.append(None)
+        elif isinstance(mesh_axes, str):
+            out.append(mesh_axes)
+        elif len(mesh_axes) == 1:
+            out.append(mesh_axes[0])
+        else:
+            out.append(tuple(mesh_axes))
+    # trim trailing Nones for canonical form
+    while out and out[-1] is None:
+        out.pop()
+    return PartitionSpec(*out)
+
+
+def named_sharding(
+    mesh: Mesh,
+    logical_axes: Sequence[Optional[str]],
+    rules: AxisRules = DEFAULT_RULES,
+) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_mesh_axes(logical_axes, rules))
+
+
+def shard_constraint(x, logical_axes, rules: AxisRules = DEFAULT_RULES):
+    """``with_sharding_constraint`` by logical axis names.
+
+    No-op only when no mesh is current (plain eager/test use); inside a mesh
+    a malformed spec raises rather than silently dropping the constraint.
+    """
+    spec = logical_to_mesh_axes(logical_axes, rules)
+    try:
+        no_mesh = jax.sharding.get_abstract_mesh().empty
+    except AttributeError:
+        no_mesh = False
+    if no_mesh:
+        return x
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+def mesh_context(mesh: Mesh):
+    """Context manager making ``mesh`` current for bare-PartitionSpec
+    sharding constraints; spans the jax 0.8/0.9 use_mesh→set_mesh rename."""
+    if hasattr(jax.sharding, "use_mesh"):
+        return jax.sharding.use_mesh(mesh)
+    if hasattr(jax.sharding, "set_mesh"):
+        return jax.sharding.set_mesh(mesh)
+    return mesh
+
+
+def shape_aware_spec(
+    spec: PartitionSpec, shape: Tuple[int, ...], mesh: Mesh
+) -> PartitionSpec:
+    """Drop sharding on dims the mesh cannot divide evenly.
+
+    Lets one rules table serve models whose small dims (e.g. GQA kv heads)
+    don't divide a large tp axis: those dims replicate instead of erroring.
+    """
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    out = []
+    padded = tuple(spec) + (None,) * (len(shape) - len(tuple(spec)))
+    for dim, axis in zip(shape, padded):
+        if axis is None:
+            out.append(None)
+            continue
+        axes = (axis,) if isinstance(axis, str) else tuple(axis)
+        n = 1
+        for a in axes:
+            n *= sizes.get(a, 1)
+        out.append(axis if dim % n == 0 else None)
+    while out and out[-1] is None:
+        out.pop()
+    return PartitionSpec(*out)
+
+
+def validate_mesh_for_model(
+    config: MeshConfig, *, n_heads: int, d_ff: int, n_experts: int = 0
+) -> None:
+    """Fail fast when a mesh shape cannot shard a model's dimensions."""
+    if n_heads % config.tp:
+        raise ValueError(f"tp={config.tp} must divide n_heads={n_heads}")
+    if d_ff % config.tp:
+        raise ValueError(f"tp={config.tp} must divide d_ff={d_ff}")
+    if n_experts and n_experts % config.dp != 0:
+        raise ValueError(
+            f"dp={config.dp} must divide n_experts={n_experts} "
+            f"(experts shard over the dp axis)"
+        )

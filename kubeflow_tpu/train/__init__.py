@@ -1,0 +1,13 @@
+"""Training loop primitives: sharded state, SPMD train steps, optimizers."""
+
+from kubeflow_tpu.train.trainer import (  # noqa: F401
+    TrainState,
+    create_sharded_state,
+    make_image_train_step,
+    make_lm_train_step,
+    make_optimizer,
+    next_token_loss,
+    softmax_cross_entropy,
+    state_partition_specs,
+    state_shardings,
+)

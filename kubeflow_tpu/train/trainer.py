@@ -1,0 +1,193 @@
+"""Sharded training loop primitives: state init, train steps, optimizers.
+
+In the reference, the training loop lives in opaque workload containers
+(``tf_cnn_benchmarks`` — see SURVEY.md §3.3 "HOT LOOP"): workers pull params
+from parameter servers over gRPC per step. Here the hot loop is a single
+pjit-compiled SPMD step over a device mesh; gradient exchange is an XLA
+AllReduce over ICI, and TP/SP/EP shardings come from the models' logical
+axes (``kubeflow_tpu/parallel/mesh.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Mapping, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax.training import train_state
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from kubeflow_tpu.models.transformer import leaf_logical_axes
+from kubeflow_tpu.parallel.mesh import (
+    AxisRules,
+    DEFAULT_RULES,
+    logical_to_mesh_axes,
+    mesh_context,
+    shape_aware_spec,
+)
+
+
+class TrainState(train_state.TrainState):
+    """TrainState with optional BN statistics (for the ResNet family)."""
+
+    batch_stats: Any = None
+
+
+def state_partition_specs(state: Any, rules: AxisRules = DEFAULT_RULES) -> Any:
+    """PartitionSpec for every leaf of a (possibly abstract) train state."""
+
+    def spec(path, leaf):
+        return logical_to_mesh_axes(leaf_logical_axes(path, leaf), rules)
+
+    return jax.tree_util.tree_map_with_path(spec, state)
+
+
+def state_shardings(state: Any, mesh: Mesh, rules: AxisRules = DEFAULT_RULES) -> Any:
+    def shard(path, leaf):
+        spec = logical_to_mesh_axes(leaf_logical_axes(path, leaf), rules)
+        shape = getattr(leaf, "shape", ())
+        return NamedSharding(mesh, shape_aware_spec(spec, shape, mesh))
+
+    return jax.tree_util.tree_map_with_path(shard, state)
+
+
+def make_optimizer(
+    learning_rate: float = 3e-4,
+    *,
+    warmup_steps: int = 100,
+    decay_steps: int = 10_000,
+    weight_decay: float = 0.1,
+    b1: float = 0.9,
+    b2: float = 0.95,
+    grad_clip: float = 1.0,
+) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=learning_rate,
+        warmup_steps=warmup_steps,
+        decay_steps=max(decay_steps, warmup_steps + 1),
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay),
+    )
+
+
+def create_sharded_state(
+    init_fn: Callable[[jax.Array], TrainState],
+    rng: jax.Array,
+    mesh: Mesh,
+    rules: AxisRules = DEFAULT_RULES,
+) -> Tuple[TrainState, Any]:
+    """Initialize a TrainState directly into its sharded layout.
+
+    ``init_fn`` is traced abstractly to derive per-leaf shardings, then
+    jit-compiled with those as out_shardings so every param lands sharded —
+    no host-side full materialization (matters when params exceed one HBM).
+    """
+    abstract = jax.eval_shape(init_fn, rng)
+    shardings = state_shardings(abstract, mesh, rules)
+    state = jax.jit(init_fn, out_shardings=shardings)(rng)
+    return state, shardings
+
+
+def next_token_loss(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Causal LM loss: predict tokens[:, 1:] from logits[:, :-1]."""
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    tgt = tokens[:, 1:]
+    ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def softmax_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def make_lm_train_step(
+    mesh: Mesh,
+    rules: AxisRules = DEFAULT_RULES,
+    *,
+    moe_aux_weight: float = 0.01,
+    donate: bool = True,
+):
+    """Build the jitted SPMD LM train step: (state, tokens) -> (state, metrics)."""
+    batch_spec = logical_to_mesh_axes(("batch", "seq"), rules)
+
+    def step(state: TrainState, tokens: jnp.ndarray):
+        tokens = jax.lax.with_sharding_constraint(tokens, batch_spec)
+
+        def loss_fn(params):
+            logits, mut = state.apply_fn(
+                {"params": params}, tokens, mutable=["losses"]
+            )
+            loss = next_token_loss(logits, tokens)
+            aux = sum(
+                jnp.sum(v) for v in jax.tree_util.tree_leaves(mut)
+            ) if mut else 0.0
+            return loss + moe_aux_weight * aux, loss
+
+        grads, lm_loss = jax.grad(loss_fn, has_aux=True)(state.params)
+        new_state = state.apply_gradients(grads=grads)
+        metrics = {
+            "loss": lm_loss,
+            "grad_norm": optax.global_norm(grads),
+            "step": new_state.step,
+        }
+        return new_state, metrics
+
+    def run(state, tokens):
+        with mesh_context(mesh):
+            return jitted(state, tokens)
+
+    jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+    return run
+
+
+def make_image_train_step(
+    mesh: Mesh,
+    rules: AxisRules = DEFAULT_RULES,
+    *,
+    donate: bool = True,
+):
+    """Jitted SPMD classifier train step with BN-stat updates (ResNet path)."""
+    batch_spec = logical_to_mesh_axes(("batch", None, None, None), rules)
+    label_spec = logical_to_mesh_axes(("batch",), rules)
+
+    def step(state: TrainState, images: jnp.ndarray, labels: jnp.ndarray):
+        images = jax.lax.with_sharding_constraint(images, batch_spec)
+        labels = jax.lax.with_sharding_constraint(labels, label_spec)
+
+        def loss_fn(params):
+            variables = {"params": params}
+            if state.batch_stats is not None:
+                variables["batch_stats"] = state.batch_stats
+                logits, mut = state.apply_fn(
+                    variables, images, train=True, mutable=["batch_stats"]
+                )
+                new_stats = mut["batch_stats"]
+            else:
+                logits = state.apply_fn(variables, images, train=True)
+                new_stats = None
+            loss = softmax_cross_entropy(logits, labels)
+            acc = jnp.mean(jnp.argmax(logits, -1) == labels)
+            return loss, (new_stats, acc)
+
+        (loss, (new_stats, acc)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        new_state = state.apply_gradients(grads=grads)
+        if new_stats is not None:
+            new_state = new_state.replace(batch_stats=new_stats)
+        return new_state, {"loss": loss, "accuracy": acc, "step": new_state.step}
+
+    jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+
+    def run(state, images, labels):
+        with mesh_context(mesh):
+            return jitted(state, images, labels)
+
+    return run

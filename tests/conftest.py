@@ -1,0 +1,22 @@
+"""Test harness config: force an 8-device virtual CPU mesh.
+
+The reference's answer to "how do you test multi-node without a cluster" is
+real CI clusters (see SURVEY.md §4); we add the tier it lacks: a virtual
+multi-device CPU mesh so every sharding/collective path runs in unit tests.
+
+The session's sitecustomize registers the TPU PJRT plugin and pins
+``jax_platforms`` before conftest runs, so the override must go through
+``jax.config`` rather than the environment.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

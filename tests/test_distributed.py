@@ -1,0 +1,69 @@
+"""Distributed-bootstrap env contract + sharding helper regression tests."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubeflow_tpu.parallel import MeshConfig, ProcessEnv, create_mesh, from_env
+from kubeflow_tpu.parallel.distributed import (
+    ENV_COORDINATOR,
+    ENV_NUM_PROCESSES,
+    ENV_PROCESS_ID,
+    initialize,
+)
+from kubeflow_tpu.parallel.mesh import mesh_context, shard_constraint
+
+
+def test_from_env_defaults():
+    penv = from_env({})
+    assert penv.num_processes == 1 and penv.process_id == 0
+    assert not penv.is_distributed
+    assert penv.is_coordinator
+
+
+def test_from_env_parses_contract():
+    penv = from_env({
+        ENV_COORDINATOR: "tpujob-demo-0.tpujob-demo:8476",
+        ENV_NUM_PROCESSES: "4",
+        ENV_PROCESS_ID: "2",
+    })
+    assert penv.is_distributed and not penv.is_coordinator
+    assert penv.coordinator_address.endswith(":8476")
+
+
+def test_initialize_single_process_noop():
+    penv = initialize(ProcessEnv(None, 1, 0))
+    assert penv.num_processes == 1
+
+
+def test_initialize_distributed_requires_coordinator():
+    with pytest.raises(RuntimeError, match="KFTPU_COORDINATOR_ADDRESS"):
+        initialize(ProcessEnv(None, 2, 1), timeout_s=1)
+
+
+def test_shard_constraint_noop_without_mesh():
+    x = jnp.ones((4, 4))
+    y = shard_constraint(x, ("batch", None))
+    assert (y == x).all()
+
+
+def test_shard_constraint_raises_on_bad_rank_inside_mesh():
+    mesh = create_mesh(MeshConfig(dp=8))
+    x = jnp.ones((8, 4))
+    with mesh_context(mesh):
+        with pytest.raises(ValueError):
+            jax.jit(lambda a: shard_constraint(a, ("batch", None, "mlp")))(x)
+
+
+def test_state_partition_specs_on_concrete_state():
+    from kubeflow_tpu.models import MnistCnn
+    from kubeflow_tpu.train import TrainState, make_optimizer, state_partition_specs
+
+    model = MnistCnn()
+    images = jnp.zeros((2, 28, 28, 1))
+    params = model.init(jax.random.key(0), images)["params"]
+    state = TrainState.create(
+        apply_fn=model.apply, params=params, tx=make_optimizer(1e-3)
+    )
+    specs = state_partition_specs(state)  # concrete state: step is a python int
+    assert jax.tree_util.tree_leaves(specs) is not None

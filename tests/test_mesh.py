@@ -1,0 +1,50 @@
+"""Mesh + sharding-rule unit tests (8 virtual CPU devices)."""
+
+import jax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_tpu.parallel import (
+    MeshConfig,
+    auto_mesh_config,
+    create_mesh,
+    logical_to_mesh_axes,
+    validate_mesh_for_model,
+)
+
+
+def test_device_count():
+    assert jax.device_count() == 8, "conftest must force 8 virtual CPU devices"
+
+
+def test_auto_mesh_config():
+    cfg = auto_mesh_config(8)
+    assert cfg.size == 8
+    cfg = auto_mesh_config(8, pp=2, tp=2)
+    assert (cfg.dp, cfg.pp, cfg.tp) == (2, 2, 2)
+    with pytest.raises(ValueError):
+        auto_mesh_config(8, pp=3)
+
+
+def test_create_mesh_axes():
+    mesh = create_mesh(MeshConfig(dp=2, pp=2, tp=2))
+    assert mesh.axis_names == ("dp", "pp", "tp")
+    assert mesh.devices.shape == (2, 2, 2)
+    with pytest.raises(ValueError):
+        create_mesh(MeshConfig(dp=16))
+
+
+def test_logical_to_mesh_axes():
+    assert logical_to_mesh_axes(("batch", None, "mlp")) == P("dp", None, "tp")
+    assert logical_to_mesh_axes(("embed",)) == P()
+    assert logical_to_mesh_axes(("expert", "embed", "expert_mlp")) == P(
+        "dp", None, "tp"
+    )
+    with pytest.raises(KeyError):
+        logical_to_mesh_axes(("nonsense",))
+
+
+def test_validate_mesh_for_model():
+    validate_mesh_for_model(MeshConfig(dp=2, tp=4), n_heads=8, d_ff=256)
+    with pytest.raises(ValueError):
+        validate_mesh_for_model(MeshConfig(tp=3), n_heads=8, d_ff=256)
