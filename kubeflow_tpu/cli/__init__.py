@@ -1,0 +1,3 @@
+"""Deployment CLI (kfctl parity): ``python -m kubeflow_tpu.cli <cmd>``."""
+
+from kubeflow_tpu.cli.main import main  # noqa: F401

@@ -1,0 +1,197 @@
+"""``ctl`` — the deployment CLI (kfctl parity).
+
+Subcommand surface mirrors kfctl: init/generate/apply/delete/show/version
+(``/root/reference/bootstrap/cmd/kfctl/cmd/{init,generate,apply,delete,
+root}.go``), plus ``components`` to list the registry. An *app directory*
+holds ``app.yaml`` (the DeploymentConfig) and generated ``manifests/``;
+phases mirror the coordinator's ALL/PLATFORM/K8S split
+(``coordinator.go:715-917``) with platform provisioning delegated to the
+platform layer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+from typing import List, Optional
+
+import yaml
+
+import kubeflow_tpu
+from kubeflow_tpu.config import DeploymentConfig, preset
+from kubeflow_tpu.k8s.apply import apply_all, delete_all
+from kubeflow_tpu.k8s.client import HttpKubeClient, KubeClient
+from kubeflow_tpu.k8s.fakefile import FileBackedFakeClient
+from kubeflow_tpu.k8s.objects import Obj
+from kubeflow_tpu.manifests import list_components, render_all
+
+log = logging.getLogger("ctl")
+
+APP_YAML = "app.yaml"
+MANIFEST_DIR = "manifests"
+
+
+def _app_config(app_dir: str) -> DeploymentConfig:
+    path = os.path.join(app_dir, APP_YAML)
+    if not os.path.exists(path):
+        raise SystemExit(f"{path} not found — run `ctl init` first")
+    return DeploymentConfig.load(path)
+
+
+def _client(args) -> KubeClient:
+    if args.server:
+        return HttpKubeClient(base_url=args.server, verify=not args.insecure)
+    state = args.fake_state or os.path.join(args.app_dir, ".cluster.json")
+    return FileBackedFakeClient(state)
+
+
+def _manifest_path(app_dir: str) -> str:
+    return os.path.join(app_dir, MANIFEST_DIR)
+
+
+def cmd_init(args) -> int:
+    app_dir = args.app_dir
+    os.makedirs(app_dir, exist_ok=True)
+    path = os.path.join(app_dir, APP_YAML)
+    if os.path.exists(path) and not args.force:
+        raise SystemExit(f"{path} exists (use --force to overwrite)")
+    name = args.name or os.path.basename(os.path.abspath(app_dir))
+    try:
+        config = preset(args.preset, name)
+    except KeyError as e:
+        raise SystemExit(e.args[0]) from e
+    if args.platform:
+        config.platform = args.platform
+    config.validate()
+    config.save(path)
+    print(f"initialized {path} (preset={args.preset}, platform={config.platform})")
+    return 0
+
+
+def cmd_generate(args) -> int:
+    config = _app_config(args.app_dir)
+    objs = render_all(config)
+    out_dir = _manifest_path(args.app_dir)
+    os.makedirs(out_dir, exist_ok=True)
+    for f in os.listdir(out_dir):
+        if f.endswith(".yaml"):
+            os.remove(os.path.join(out_dir, f))
+    for i, obj in enumerate(objs):
+        md = obj.get("metadata", {})
+        fname = f"{i:03d}_{obj['kind'].lower()}_{md.get('name', 'unnamed')}.yaml"
+        with open(os.path.join(out_dir, fname), "w") as f:
+            yaml.safe_dump(obj, f, sort_keys=False)
+    print(f"generated {len(objs)} manifests in {out_dir}")
+    return 0
+
+
+def _load_manifests(app_dir: str) -> List[Obj]:
+    out_dir = _manifest_path(app_dir)
+    if not os.path.isdir(out_dir):
+        raise SystemExit(f"{out_dir} not found — run `ctl generate` first")
+    objs = []
+    for fname in sorted(os.listdir(out_dir)):
+        if fname.endswith(".yaml"):
+            with open(os.path.join(out_dir, fname)) as f:
+                objs.append(yaml.safe_load(f))
+    return objs
+
+
+def cmd_apply(args) -> int:
+    objs = _load_manifests(args.app_dir)
+    client = _client(args)
+    applied = apply_all(client, objs)
+    print(f"applied {len(applied)} objects")
+    return 0
+
+
+def cmd_delete(args) -> int:
+    objs = _load_manifests(args.app_dir)
+    client = _client(args)
+    delete_all(client, objs)
+    print(f"deleted {len(objs)} objects")
+    return 0
+
+
+def cmd_show(args) -> int:
+    config = _app_config(args.app_dir)
+    docs = render_all(config)
+    print(yaml.safe_dump_all(docs, sort_keys=False), end="")
+    return 0
+
+
+def cmd_components(args) -> int:
+    for comp in list_components():
+        print(f"{comp.name:20s} {comp.description}")
+        if args.verbose:
+            for k, v in sorted(comp.defaults.items()):
+                print(f"  {k} = {v!r}")
+    return 0
+
+
+def cmd_version(args) -> int:
+    print(f"ctl (kubeflow_tpu) {kubeflow_tpu.__version__}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ctl", description="TPU-native ML platform deployment CLI",
+    )
+    p.add_argument("-v", "--verbose", action="store_true")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    def app_cmd(name, fn, help_):
+        sp = sub.add_parser(name, help=help_)
+        sp.add_argument("app_dir", help="application directory")
+        sp.set_defaults(fn=fn)
+        return sp
+
+    sp = app_cmd("init", cmd_init, "scaffold an app dir with app.yaml")
+    sp.add_argument("--preset", default="standard",
+                    help="config preset (minimal|standard|gcp-tpu)")
+    sp.add_argument("--name", default=None, help="deployment name")
+    sp.add_argument("--platform", default=None,
+                    help="override platform (local|gcp-tpu|existing)")
+    sp.add_argument("--force", action="store_true")
+
+    app_cmd("generate", cmd_generate, "render manifests from app.yaml")
+
+    for name, fn, help_ in (
+        ("apply", cmd_apply, "apply generated manifests to the cluster"),
+        ("delete", cmd_delete, "delete applied objects"),
+    ):
+        sp = app_cmd(name, fn, help_)
+        sp.add_argument("--server", default=None,
+                        help="API server URL (default: in-cluster or fake)")
+        sp.add_argument("--insecure", action="store_true",
+                        help="skip TLS verification")
+        sp.add_argument("--fake-state", default=None,
+                        help="file-backed fake cluster state path")
+
+    app_cmd("show", cmd_show, "print rendered manifests")
+
+    sp = sub.add_parser("components", help="list available components")
+    # SUPPRESS keeps the global -v value instead of overwriting it with False
+    sp.add_argument("-v", "--verbose", action="store_true",
+                    default=argparse.SUPPRESS)
+    sp.set_defaults(fn=cmd_components)
+
+    sp = sub.add_parser("version", help="print version")
+    sp.set_defaults(fn=cmd_version)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if getattr(args, "verbose", False) else logging.INFO,
+        format="%(levelname)s %(name)s: %(message)s",
+    )
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

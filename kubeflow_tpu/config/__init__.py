@@ -1,0 +1,8 @@
+"""Typed deployment configuration (KfDef equivalent) + presets."""
+
+from kubeflow_tpu.config.deployment import (  # noqa: F401
+    ComponentSpec,
+    DeploymentConfig,
+    SecretSpec,
+)
+from kubeflow_tpu.config.presets import PRESETS, preset  # noqa: F401

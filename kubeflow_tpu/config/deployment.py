@@ -1,0 +1,157 @@
+"""Typed deployment config — the KfDef equivalent.
+
+The reference's deployment state is the ``KfDef`` CRD-shaped app.yaml:
+Applications[] with kustomize overlays+params, Repos[], Secrets[], Plugins[]
+(``/root/reference/bootstrap/pkg/apis/apps/kfdef/v1alpha1/
+application_types.go:41-155``), with canned presets under
+``/root/reference/bootstrap/config/*.yaml``. Here the same role is played by
+one dataclass: components come from the in-framework registry (no repo
+cache / tarball downloads), params are typed per component, and the YAML
+file at ``<app>/app.yaml`` is the single source of truth for
+generate/apply/delete.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import os
+from typing import Any, Dict, List, Mapping, Optional
+
+import yaml
+
+API_VERSION = "kubeflow-tpu.org/v1alpha1"
+KIND = "TpuPlatform"
+
+PLATFORMS = ("local", "gcp-tpu", "existing")
+
+
+@dataclasses.dataclass
+class ComponentSpec:
+    """One enabled platform component + its parameter overrides."""
+
+    name: str
+    params: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"name": self.name}
+        if self.params:
+            out["params"] = dict(self.params)
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "ComponentSpec":
+        return cls(name=d["name"], params=dict(d.get("params", {}) or {}))
+
+
+@dataclasses.dataclass
+class SecretSpec:
+    """Secret source: literal value or env-var indirection (reference:
+    ``application_types.go`` SecretSource literal/env)."""
+
+    name: str
+    literal: Optional[str] = None
+    env: Optional[str] = None
+
+    def resolve(self) -> str:
+        if self.literal is not None:
+            return self.literal
+        if self.env is not None:
+            val = os.environ.get(self.env)
+            if val is None:
+                raise ValueError(f"secret {self.name}: env {self.env} not set")
+            return val
+        raise ValueError(f"secret {self.name}: no source")
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"name": self.name}
+        if self.literal is not None:
+            out["literal"] = self.literal
+        if self.env is not None:
+            out["env"] = self.env
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "SecretSpec":
+        return cls(name=d["name"], literal=d.get("literal"), env=d.get("env"))
+
+
+@dataclasses.dataclass
+class DeploymentConfig:
+    name: str
+    namespace: str = "kubeflow"
+    platform: str = "local"
+    components: List[ComponentSpec] = dataclasses.field(default_factory=list)
+    secrets: List[SecretSpec] = dataclasses.field(default_factory=list)
+    platform_params: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    version: str = "v1alpha1"
+
+    def validate(self) -> None:
+        if not self.name or not self.name.replace("-", "").isalnum():
+            raise ValueError(f"invalid deployment name {self.name!r}")
+        if self.platform not in PLATFORMS:
+            raise ValueError(
+                f"unknown platform {self.platform!r}; choose from {PLATFORMS}"
+            )
+        seen = set()
+        for comp in self.components:
+            if comp.name in seen:
+                raise ValueError(f"duplicate component {comp.name!r}")
+            seen.add(comp.name)
+
+    def component(self, name: str) -> Optional[ComponentSpec]:
+        for comp in self.components:
+            if comp.name == name:
+                return comp
+        return None
+
+    # -- YAML round-trip ---------------------------------------------------
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "apiVersion": API_VERSION,
+            "kind": KIND,
+            "metadata": {"name": self.name, "namespace": self.namespace},
+            "spec": {
+                "platform": self.platform,
+                "platformParams": dict(self.platform_params),
+                "components": [c.to_dict() for c in self.components],
+                "secrets": [s.to_dict() for s in self.secrets],
+                "version": self.version,
+            },
+        }
+
+    def to_yaml(self) -> str:
+        buf = io.StringIO()
+        yaml.safe_dump(self.to_dict(), buf, sort_keys=False)
+        return buf.getvalue()
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "DeploymentConfig":
+        if d.get("kind") != KIND:
+            raise ValueError(f"not a {KIND} document (kind={d.get('kind')!r})")
+        md = d.get("metadata", {}) or {}
+        spec = d.get("spec", {}) or {}
+        return cls(
+            name=md.get("name", ""),
+            namespace=md.get("namespace", "kubeflow"),
+            platform=spec.get("platform", "local"),
+            components=[ComponentSpec.from_dict(c)
+                        for c in spec.get("components", []) or []],
+            secrets=[SecretSpec.from_dict(s) for s in spec.get("secrets", []) or []],
+            platform_params=dict(spec.get("platformParams", {}) or {}),
+            version=spec.get("version", "v1alpha1"),
+        )
+
+    @classmethod
+    def from_yaml(cls, text: str) -> "DeploymentConfig":
+        return cls.from_dict(yaml.safe_load(text))
+
+    @classmethod
+    def load(cls, path: str) -> "DeploymentConfig":
+        with open(path) as f:
+            return cls.from_yaml(f.read())
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_yaml())

@@ -1,0 +1,62 @@
+"""Canned deployment presets — the ``bootstrap/config/kfctl_*.yaml`` equivalent.
+
+Reference presets enumerate per-platform application lists
+(``/root/reference/bootstrap/config/kfctl_gcp_iap.yaml:18-95`` et al.);
+here a preset is a DeploymentConfig factory keyed by name.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from kubeflow_tpu.config.deployment import ComponentSpec, DeploymentConfig
+
+
+def _minimal(name: str) -> DeploymentConfig:
+    """Just the job operator: train on a slice, nothing else."""
+    return DeploymentConfig(
+        name=name,
+        platform="local",
+        components=[ComponentSpec("tpujob-operator")],
+    )
+
+
+def _standard(name: str) -> DeploymentConfig:
+    """Operator + serving + dashboard on an existing cluster."""
+    return DeploymentConfig(
+        name=name,
+        platform="existing",
+        components=[
+            ComponentSpec("tpujob-operator"),
+            ComponentSpec("serving"),
+            ComponentSpec("dashboard"),
+        ],
+    )
+
+
+def _gcp_tpu(name: str) -> DeploymentConfig:
+    """Full GCP deployment targeting TPU pod slices."""
+    cfg = _standard(name)
+    cfg.platform = "gcp-tpu"
+    cfg.platform_params = {
+        "project": "",
+        "zone": "us-central2-b",
+        "accelerator_type": "v5e-8",
+        "cluster": f"{name}-cluster",
+    }
+    return cfg
+
+
+PRESETS: Dict[str, Callable[[str], DeploymentConfig]] = {
+    "minimal": _minimal,
+    "standard": _standard,
+    "gcp-tpu": _gcp_tpu,
+}
+
+
+def preset(preset_name: str, app_name: str) -> DeploymentConfig:
+    if preset_name not in PRESETS:
+        raise KeyError(
+            f"unknown preset {preset_name!r}; known: {sorted(PRESETS)}"
+        )
+    return PRESETS[preset_name](app_name)

@@ -1,0 +1,11 @@
+"""Kubernetes layer: typed object builders, clients (fake + HTTP), apply engine."""
+
+from kubeflow_tpu.k8s.client import (  # noqa: F401
+    ApiError,
+    FakeKubeClient,
+    HttpKubeClient,
+    KubeClient,
+    WatchEvent,
+    register_plural,
+)
+from kubeflow_tpu.k8s import objects  # noqa: F401

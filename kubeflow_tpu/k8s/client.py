@@ -1,0 +1,395 @@
+"""Kubernetes API clients: an in-memory fake and a stdlib HTTP client.
+
+The reference leans on client-go's dynamic client + RESTMapper for
+server-side apply (``/root/reference/bootstrap/pkg/kfapp/kustomize/
+kustomize.go:378-476``) and on real CI clusters for anything resembling an
+integration test (SURVEY.md §4). This framework inverts that: every control-
+plane component programs against :class:`KubeClient`, and the
+:class:`FakeKubeClient` is a faithful-enough API server (uids,
+resourceVersions, watches, ownerReference cascade delete) that operators run
+in unit tests. :class:`HttpKubeClient` is the in-cluster implementation on
+the same interface — stdlib only, service-account token auth.
+"""
+
+from __future__ import annotations
+
+import abc
+import copy
+import itertools
+import json
+import os
+import queue
+import ssl
+import threading
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Mapping, Optional, Tuple
+
+from kubeflow_tpu.k8s.objects import Obj
+
+API_NOT_FOUND = 404
+API_CONFLICT = 409
+
+
+class ApiError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(f"{code}: {message}")
+        self.code = code
+        self.message = message
+
+
+@dataclass(frozen=True)
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    object: Obj
+
+
+def _meta(obj: Obj) -> Tuple[str, str]:
+    md = obj.get("metadata", {})
+    return md.get("namespace", ""), md["name"]
+
+
+class KubeClient(abc.ABC):
+    """Dynamic-typed CRUD + watch over (apiVersion, kind)."""
+
+    @abc.abstractmethod
+    def create(self, obj: Obj) -> Obj: ...
+
+    @abc.abstractmethod
+    def get(self, api_version: str, kind: str, namespace: str, name: str) -> Obj: ...
+
+    @abc.abstractmethod
+    def list(self, api_version: str, kind: str, namespace: Optional[str] = None,
+             label_selector: Optional[Mapping[str, str]] = None) -> List[Obj]: ...
+
+    @abc.abstractmethod
+    def update(self, obj: Obj) -> Obj: ...
+
+    @abc.abstractmethod
+    def update_status(self, obj: Obj) -> Obj: ...
+
+    @abc.abstractmethod
+    def delete(self, api_version: str, kind: str, namespace: str, name: str) -> None: ...
+
+    @abc.abstractmethod
+    def watch(self, api_version: str, kind: str,
+              namespace: Optional[str] = None) -> "queue.Queue[WatchEvent]": ...
+
+    # -- conveniences shared by implementations --
+
+    def get_or_none(self, api_version: str, kind: str, namespace: str,
+                    name: str) -> Optional[Obj]:
+        try:
+            return self.get(api_version, kind, namespace, name)
+        except ApiError as e:
+            if e.code == API_NOT_FOUND:
+                return None
+            raise
+
+    def apply(self, obj: Obj) -> Obj:
+        """Create-or-update by name (the engine's server-side apply)."""
+        ns, name = _meta(obj)
+        existing = self.get_or_none(obj["apiVersion"], obj["kind"], ns, name)
+        if existing is None:
+            return self.create(obj)
+        merged = copy.deepcopy(obj)
+        md = merged.setdefault("metadata", {})
+        md["resourceVersion"] = existing["metadata"].get("resourceVersion")
+        md["uid"] = existing["metadata"].get("uid")
+        if "status" in existing and "status" not in merged:
+            merged["status"] = existing["status"]
+        return self.update(merged)
+
+
+def _match_labels(obj: Obj, selector: Optional[Mapping[str, str]]) -> bool:
+    if not selector:
+        return True
+    labels = obj.get("metadata", {}).get("labels", {}) or {}
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+class FakeKubeClient(KubeClient):
+    """In-memory API server: the framework's envtest equivalent."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._store: Dict[Tuple[str, str, str, str], Obj] = {}
+        self._uid = itertools.count(1)
+        self._rv = itertools.count(1)
+        self._watchers: List[Tuple[Tuple[str, str], Optional[str],
+                                   "queue.Queue[WatchEvent]"]] = []
+
+    def _key(self, api_version: str, kind: str, ns: str, name: str):
+        return (api_version, kind, ns, name)
+
+    def _notify(self, event_type: str, obj: Obj) -> None:
+        gk = (obj["apiVersion"], obj["kind"])
+        ns = obj.get("metadata", {}).get("namespace", "")
+        for (w_gk, w_ns, q) in list(self._watchers):
+            if w_gk == gk and (w_ns is None or w_ns == ns):
+                q.put(WatchEvent(event_type, copy.deepcopy(obj)))
+
+    def create(self, obj: Obj) -> Obj:
+        with self._lock:
+            ns, name = _meta(obj)
+            key = self._key(obj["apiVersion"], obj["kind"], ns, name)
+            if key in self._store:
+                raise ApiError(API_CONFLICT, f"{key} already exists")
+            stored = copy.deepcopy(obj)
+            md = stored.setdefault("metadata", {})
+            md["uid"] = f"uid-{next(self._uid)}"
+            md["resourceVersion"] = str(next(self._rv))
+            self._store[key] = stored
+            self._notify("ADDED", stored)
+            return copy.deepcopy(stored)
+
+    def get(self, api_version: str, kind: str, namespace: str, name: str) -> Obj:
+        with self._lock:
+            key = self._key(api_version, kind, namespace, name)
+            if key not in self._store:
+                raise ApiError(API_NOT_FOUND, f"{kind} {namespace}/{name} not found")
+            return copy.deepcopy(self._store[key])
+
+    def list(self, api_version: str, kind: str, namespace: Optional[str] = None,
+             label_selector: Optional[Mapping[str, str]] = None) -> List[Obj]:
+        with self._lock:
+            out = []
+            for (av, k, ns, _), obj in self._store.items():
+                if av == api_version and k == kind and (
+                    namespace is None or ns == namespace
+                ) and _match_labels(obj, label_selector):
+                    out.append(copy.deepcopy(obj))
+            return out
+
+    def _update(self, obj: Obj, *, status_only: bool) -> Obj:
+        with self._lock:
+            ns, name = _meta(obj)
+            key = self._key(obj["apiVersion"], obj["kind"], ns, name)
+            if key not in self._store:
+                raise ApiError(API_NOT_FOUND, f"{key} not found")
+            current = self._store[key]
+            stored = copy.deepcopy(obj)
+            md = stored.setdefault("metadata", {})
+            if status_only:
+                # status subresource: only status changes land
+                merged = copy.deepcopy(current)
+                merged["status"] = copy.deepcopy(obj.get("status", {}))
+                stored = merged
+                md = stored["metadata"]
+            md["uid"] = current["metadata"]["uid"]
+            md["resourceVersion"] = str(next(self._rv))
+            self._store[key] = stored
+            self._notify("MODIFIED", stored)
+            return copy.deepcopy(stored)
+
+    def update(self, obj: Obj) -> Obj:
+        return self._update(obj, status_only=False)
+
+    def update_status(self, obj: Obj) -> Obj:
+        return self._update(obj, status_only=True)
+
+    def delete(self, api_version: str, kind: str, namespace: str, name: str) -> None:
+        with self._lock:
+            key = self._key(api_version, kind, namespace, name)
+            if key not in self._store:
+                raise ApiError(API_NOT_FOUND, f"{kind} {namespace}/{name} not found")
+            obj = self._store.pop(key)
+            self._notify("DELETED", obj)
+            self._cascade_delete(obj)
+
+    def _cascade_delete(self, owner: Obj) -> None:
+        owner_uid = owner.get("metadata", {}).get("uid")
+        if not owner_uid:
+            return
+        children = []
+        for key, obj in list(self._store.items()):
+            for ref in obj.get("metadata", {}).get("ownerReferences", []) or []:
+                if ref.get("uid") == owner_uid:
+                    children.append(key)
+                    break
+        for (av, k, ns, name) in children:
+            if (av, k, ns, name) in self._store:
+                self.delete(av, k, ns, name)
+
+    def watch(self, api_version: str, kind: str,
+              namespace: Optional[str] = None) -> "queue.Queue[WatchEvent]":
+        q: "queue.Queue[WatchEvent]" = queue.Queue()
+        with self._lock:
+            # replay current state first so watchers never miss pre-existing objects
+            for obj in self.list(api_version, kind, namespace):
+                q.put(WatchEvent("ADDED", obj))
+            self._watchers.append(((api_version, kind), namespace, q))
+        return q
+
+
+# --------------------------------------------------------------------------
+# In-cluster HTTP client (stdlib only)
+# --------------------------------------------------------------------------
+
+SA_TOKEN_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/token"
+SA_CA_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
+
+# api group resource paths need the plural; a static table covers the kinds
+# the platform touches, CRDs register theirs via `register_plural`.
+_PLURALS: Dict[str, str] = {
+    "Namespace": "namespaces",
+    "Pod": "pods",
+    "Service": "services",
+    "ConfigMap": "configmaps",
+    "Secret": "secrets",
+    "ServiceAccount": "serviceaccounts",
+    "Deployment": "deployments",
+    "StatefulSet": "statefulsets",
+    "DaemonSet": "daemonsets",
+    "Role": "roles",
+    "RoleBinding": "rolebindings",
+    "ClusterRole": "clusterroles",
+    "ClusterRoleBinding": "clusterrolebindings",
+    "CustomResourceDefinition": "customresourcedefinitions",
+    "Event": "events",
+    "ResourceQuota": "resourcequotas",
+    "PersistentVolumeClaim": "persistentvolumeclaims",
+}
+
+_CLUSTER_SCOPED = {
+    "Namespace", "ClusterRole", "ClusterRoleBinding", "CustomResourceDefinition",
+}
+
+
+def register_plural(kind: str, plural: str, cluster_scoped: bool = False) -> None:
+    _PLURALS[kind] = plural
+    if cluster_scoped:
+        _CLUSTER_SCOPED.add(kind)
+
+
+class HttpKubeClient(KubeClient):
+    """Talks to a real API server with stdlib urllib; in-cluster defaults."""
+
+    def __init__(
+        self,
+        base_url: Optional[str] = None,
+        token: Optional[str] = None,
+        ca_path: Optional[str] = None,
+        verify: bool = True,
+    ) -> None:
+        host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        self.base_url = (base_url or f"https://{host}:{port}").rstrip("/")
+        if token is None and os.path.exists(SA_TOKEN_PATH):
+            with open(SA_TOKEN_PATH) as f:
+                token = f.read().strip()
+        self.token = token
+        ca = ca_path or (SA_CA_PATH if os.path.exists(SA_CA_PATH) else None)
+        if not verify:
+            self._ctx = ssl._create_unverified_context()  # noqa: S323 — explicit opt-in
+        else:
+            self._ctx = ssl.create_default_context(cafile=ca)
+
+    def _path(self, api_version: str, kind: str, namespace: str,
+              name: Optional[str] = None, *, subresource: str = "") -> str:
+        plural = _PLURALS.get(kind, kind.lower() + "s")
+        if api_version == "v1":
+            prefix = "/api/v1"
+        else:
+            prefix = f"/apis/{api_version}"
+        if kind in _CLUSTER_SCOPED or not namespace:
+            p = f"{prefix}/{plural}"
+        else:
+            p = f"{prefix}/namespaces/{namespace}/{plural}"
+        if name:
+            p += f"/{name}"
+        if subresource:
+            p += f"/{subresource}"
+        return p
+
+    def _request(self, method: str, path: str, body: Optional[Obj] = None,
+                 query: str = "") -> Any:
+        url = self.base_url + path + (f"?{query}" if query else "")
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(req, context=self._ctx, timeout=60) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            raise ApiError(e.code, e.read().decode(errors="replace")) from e
+
+    def create(self, obj: Obj) -> Obj:
+        ns, _ = _meta(obj)
+        return self._request(
+            "POST", self._path(obj["apiVersion"], obj["kind"], ns), obj
+        )
+
+    def get(self, api_version: str, kind: str, namespace: str, name: str) -> Obj:
+        return self._request("GET", self._path(api_version, kind, namespace, name))
+
+    def list(self, api_version: str, kind: str, namespace: Optional[str] = None,
+             label_selector: Optional[Mapping[str, str]] = None) -> List[Obj]:
+        query = ""
+        if label_selector:
+            sel = ",".join(f"{k}={v}" for k, v in label_selector.items())
+            query = f"labelSelector={urllib.request.quote(sel)}"
+        body = self._request(
+            "GET", self._path(api_version, kind, namespace or ""), query=query
+        )
+        items = body.get("items", [])
+        for item in items:  # list items omit apiVersion/kind; restore them
+            item.setdefault("apiVersion", api_version)
+            item.setdefault("kind", kind)
+        return items
+
+    def update(self, obj: Obj) -> Obj:
+        ns, name = _meta(obj)
+        return self._request(
+            "PUT", self._path(obj["apiVersion"], obj["kind"], ns, name), obj
+        )
+
+    def update_status(self, obj: Obj) -> Obj:
+        ns, name = _meta(obj)
+        return self._request(
+            "PUT",
+            self._path(obj["apiVersion"], obj["kind"], ns, name,
+                       subresource="status"),
+            obj,
+        )
+
+    def delete(self, api_version: str, kind: str, namespace: str, name: str) -> None:
+        self._request("DELETE", self._path(api_version, kind, namespace, name))
+
+    def watch(self, api_version: str, kind: str,
+              namespace: Optional[str] = None) -> "queue.Queue[WatchEvent]":
+        """Stream watch events into a queue from a background thread."""
+        q: "queue.Queue[WatchEvent]" = queue.Queue()
+        path = self._path(api_version, kind, namespace or "")
+
+        def pump() -> None:
+            url = self.base_url + path + "?watch=true"
+            req = urllib.request.Request(url)
+            req.add_header("Accept", "application/json")
+            if self.token:
+                req.add_header("Authorization", f"Bearer {self.token}")
+            while True:
+                try:
+                    # re-list on every (re)connect: events raised while the
+                    # watch was down must not be lost (reconcile is
+                    # idempotent, duplicate ADDEDs are harmless)
+                    for obj in self.list(api_version, kind, namespace):
+                        q.put(WatchEvent("ADDED", obj))
+                    with urllib.request.urlopen(req, context=self._ctx) as resp:
+                        for line in resp:
+                            if not line.strip():
+                                continue
+                            evt = json.loads(line)
+                            q.put(WatchEvent(evt["type"], evt["object"]))
+                except Exception:  # noqa: BLE001 — reconnect forever
+                    import time
+
+                    time.sleep(2)
+
+        threading.Thread(target=pump, daemon=True).start()
+        return q

@@ -1,0 +1,70 @@
+"""File-backed fake cluster: FakeKubeClient persisted to a JSON file.
+
+Lets the CLI's apply/delete/show cycle run end-to-end on a laptop with no
+API server — the local-dev answer to the reference's minikube path
+(``/root/reference/bootstrap/pkg/kfapp/minikube/minikube.go``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from kubeflow_tpu.k8s.client import FakeKubeClient
+from kubeflow_tpu.k8s.objects import Obj
+
+
+class FileBackedFakeClient(FakeKubeClient):
+    def __init__(self, path: str) -> None:
+        super().__init__()
+        self.path = path
+        if os.path.exists(path):
+            with open(path) as f:
+                dump = json.load(f)
+            max_uid = max_rv = 0
+            for obj in dump.get("objects", []):
+                key = self._key(
+                    obj["apiVersion"], obj["kind"],
+                    obj.get("metadata", {}).get("namespace", ""),
+                    obj["metadata"]["name"],
+                )
+                self._store[key] = obj
+                md = obj.get("metadata", {})
+                uid = md.get("uid", "")
+                if uid.startswith("uid-") and uid[4:].isdigit():
+                    max_uid = max(max_uid, int(uid[4:]))
+                rv = md.get("resourceVersion", "")
+                if str(rv).isdigit():
+                    max_rv = max(max_rv, int(rv))
+            # resume counters past persisted values so new objects never
+            # collide with restored uids (cascade delete keys on uid)
+            import itertools
+
+            self._uid = itertools.count(max_uid + 1)
+            self._rv = itertools.count(max_rv + 1)
+
+    def save(self) -> None:
+        os.makedirs(os.path.dirname(os.path.abspath(self.path)), exist_ok=True)
+        with open(self.path, "w") as f:
+            json.dump({"objects": list(self._store.values())}, f, indent=1)
+
+    # persist after every mutation so CLI invocations compose
+    def create(self, obj: Obj) -> Obj:
+        out = super().create(obj)
+        self.save()
+        return out
+
+    def update(self, obj: Obj) -> Obj:
+        out = super().update(obj)
+        self.save()
+        return out
+
+    def update_status(self, obj: Obj) -> Obj:
+        out = super().update_status(obj)
+        self.save()
+        return out
+
+    def delete(self, api_version: str, kind: str, namespace: str, name: str) -> None:
+        super().delete(api_version, kind, namespace, name)
+        self.save()

@@ -1,0 +1,306 @@
+"""Typed builders for Kubernetes objects.
+
+Replaces the reference's ksonnet/jsonnet manifest layer (the ~320 *.jsonnet/
+*.libsonnet files under ``/root/reference/kubeflow/``): components here are
+plain Python functions returning these dict-shaped objects, golden-tested the
+same way the reference golden-tests jsonnet output
+(``/root/reference/kubeflow/tf-training/tests/tf-job_test.jsonnet``).
+
+Objects are canonical Kubernetes dicts (what you'd get from YAML), built by
+helpers that enforce the fields the platform relies on. Keeping dicts (not
+classes) means serialization, diffing, and server round-trips are identity.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional, Sequence
+
+Obj = Dict[str, Any]
+
+
+def metadata(
+    name: str,
+    namespace: Optional[str] = None,
+    labels: Optional[Mapping[str, str]] = None,
+    annotations: Optional[Mapping[str, str]] = None,
+) -> Obj:
+    md: Obj = {"name": name}
+    if namespace:
+        md["namespace"] = namespace
+    if labels:
+        md["labels"] = dict(labels)
+    if annotations:
+        md["annotations"] = dict(annotations)
+    return md
+
+
+def namespace(name: str, labels: Optional[Mapping[str, str]] = None) -> Obj:
+    return {
+        "apiVersion": "v1",
+        "kind": "Namespace",
+        "metadata": metadata(name, labels=labels),
+    }
+
+
+def config_map(name: str, ns: str, data: Mapping[str, str], **md) -> Obj:
+    return {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": metadata(name, ns, **md),
+        "data": dict(data),
+    }
+
+
+def secret(name: str, ns: str, string_data: Mapping[str, str]) -> Obj:
+    return {
+        "apiVersion": "v1",
+        "kind": "Secret",
+        "metadata": metadata(name, ns),
+        "type": "Opaque",
+        "stringData": dict(string_data),
+    }
+
+
+def service_account(name: str, ns: str) -> Obj:
+    return {
+        "apiVersion": "v1",
+        "kind": "ServiceAccount",
+        "metadata": metadata(name, ns),
+    }
+
+
+def service(
+    name: str,
+    ns: str,
+    selector: Mapping[str, str],
+    ports: Sequence[Mapping[str, Any]],
+    *,
+    headless: bool = False,
+    labels: Optional[Mapping[str, str]] = None,
+    annotations: Optional[Mapping[str, str]] = None,
+) -> Obj:
+    spec: Obj = {"selector": dict(selector), "ports": [dict(p) for p in ports]}
+    if headless:
+        spec["clusterIP"] = "None"
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": metadata(name, ns, labels=labels, annotations=annotations),
+        "spec": spec,
+    }
+
+
+def container(
+    name: str,
+    image: str,
+    *,
+    command: Optional[Sequence[str]] = None,
+    args: Optional[Sequence[str]] = None,
+    env: Optional[Mapping[str, str]] = None,
+    ports: Optional[Sequence[int]] = None,
+    resources: Optional[Mapping[str, Any]] = None,
+    volume_mounts: Optional[Sequence[Mapping[str, str]]] = None,
+) -> Obj:
+    c: Obj = {"name": name, "image": image}
+    if command:
+        c["command"] = list(command)
+    if args:
+        c["args"] = list(args)
+    if env:
+        c["env"] = [{"name": k, "value": str(v)} for k, v in env.items()]
+    if ports:
+        c["ports"] = [{"containerPort": p} for p in ports]
+    if resources:
+        c["resources"] = dict(resources)
+    if volume_mounts:
+        c["volumeMounts"] = [dict(m) for m in volume_mounts]
+    return c
+
+
+def pod_spec(
+    containers: Sequence[Obj],
+    *,
+    service_account_name: Optional[str] = None,
+    volumes: Optional[Sequence[Obj]] = None,
+    node_selector: Optional[Mapping[str, str]] = None,
+    restart_policy: Optional[str] = None,
+    scheduler_name: Optional[str] = None,
+    host_network: bool = False,
+) -> Obj:
+    spec: Obj = {"containers": [dict(c) for c in containers]}
+    if service_account_name:
+        spec["serviceAccountName"] = service_account_name
+    if volumes:
+        spec["volumes"] = [dict(v) for v in volumes]
+    if node_selector:
+        spec["nodeSelector"] = dict(node_selector)
+    if restart_policy:
+        spec["restartPolicy"] = restart_policy
+    if scheduler_name:
+        spec["schedulerName"] = scheduler_name
+    if host_network:
+        spec["hostNetwork"] = True
+    return spec
+
+
+def deployment(
+    name: str,
+    ns: str,
+    pod: Obj,
+    *,
+    replicas: int = 1,
+    labels: Optional[Mapping[str, str]] = None,
+    annotations: Optional[Mapping[str, str]] = None,
+) -> Obj:
+    labels = dict(labels or {"app": name})
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": metadata(name, ns, labels=labels, annotations=annotations),
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": labels},
+            "template": {"metadata": {"labels": labels}, "spec": pod},
+        },
+    }
+
+
+def stateful_set(
+    name: str,
+    ns: str,
+    pod: Obj,
+    *,
+    replicas: int = 1,
+    service_name: Optional[str] = None,
+    labels: Optional[Mapping[str, str]] = None,
+) -> Obj:
+    labels = dict(labels or {"app": name})
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "StatefulSet",
+        "metadata": metadata(name, ns, labels=labels),
+        "spec": {
+            "replicas": replicas,
+            "serviceName": service_name or name,
+            "selector": {"matchLabels": labels},
+            "template": {"metadata": {"labels": labels}, "spec": pod},
+        },
+    }
+
+
+def pod(name: str, ns: str, spec: Obj, labels: Optional[Mapping[str, str]] = None,
+        annotations: Optional[Mapping[str, str]] = None) -> Obj:
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": metadata(name, ns, labels=labels, annotations=annotations),
+        "spec": spec,
+    }
+
+
+def role(name: str, ns: str, rules: Sequence[Mapping[str, Any]]) -> Obj:
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "Role",
+        "metadata": metadata(name, ns),
+        "rules": [dict(r) for r in rules],
+    }
+
+
+def cluster_role(name: str, rules: Sequence[Mapping[str, Any]]) -> Obj:
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRole",
+        "metadata": metadata(name),
+        "rules": [dict(r) for r in rules],
+    }
+
+
+def role_binding(name: str, ns: str, role_name: str, sa: str, sa_ns: str,
+                 cluster: bool = False) -> Obj:
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "RoleBinding",
+        "metadata": metadata(name, ns),
+        "roleRef": {
+            "apiGroup": "rbac.authorization.k8s.io",
+            "kind": "ClusterRole" if cluster else "Role",
+            "name": role_name,
+        },
+        "subjects": [{"kind": "ServiceAccount", "name": sa, "namespace": sa_ns}],
+    }
+
+
+def cluster_role_binding(name: str, role_name: str, sa: str, sa_ns: str) -> Obj:
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRoleBinding",
+        "metadata": metadata(name),
+        "roleRef": {
+            "apiGroup": "rbac.authorization.k8s.io",
+            "kind": "ClusterRole",
+            "name": role_name,
+        },
+        "subjects": [{"kind": "ServiceAccount", "name": sa, "namespace": sa_ns}],
+    }
+
+
+def crd(
+    plural: str,
+    group: str,
+    kind: str,
+    *,
+    versions: Sequence[str] = ("v1",),
+    scope: str = "Namespaced",
+    short_names: Sequence[str] = (),
+    printer_columns: Sequence[Mapping[str, str]] = (),
+    schema: Optional[Obj] = None,
+) -> Obj:
+    vers: List[Obj] = []
+    for i, v in enumerate(versions):
+        entry: Obj = {"name": v, "served": True, "storage": i == 0}
+        entry["schema"] = {
+            "openAPIV3Schema": schema or {"type": "object",
+                                          "x-kubernetes-preserve-unknown-fields": True}
+        }
+        if printer_columns:
+            entry["additionalPrinterColumns"] = [dict(c) for c in printer_columns]
+        vers.append(entry)
+    names: Obj = {"plural": plural, "singular": kind.lower(), "kind": kind}
+    if short_names:
+        names["shortNames"] = list(short_names)
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": metadata(f"{plural}.{group}"),
+        "spec": {
+            "group": group,
+            "names": names,
+            "scope": scope,
+            "versions": vers,
+        },
+    }
+
+
+# --- small helpers the engine uses ---------------------------------------
+
+def gvk(obj: Obj) -> str:
+    return f"{obj.get('apiVersion', '')}/{obj.get('kind', '')}"
+
+
+def obj_key(obj: Obj) -> str:
+    md = obj.get("metadata", {})
+    return f"{gvk(obj)}/{md.get('namespace', '')}/{md.get('name', '')}"
+
+
+def set_owner(obj: Obj, owner: Obj, *, controller: bool = True) -> Obj:
+    """Attach an ownerReference so cascade-delete works (fake + real server)."""
+    ref = {
+        "apiVersion": owner["apiVersion"],
+        "kind": owner["kind"],
+        "name": owner["metadata"]["name"],
+        "uid": owner["metadata"].get("uid", ""),
+        "controller": controller,
+    }
+    obj.setdefault("metadata", {}).setdefault("ownerReferences", []).append(ref)
+    return obj

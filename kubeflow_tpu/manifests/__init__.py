@@ -1,0 +1,11 @@
+"""Manifest engine: component registry + renderers (ksonnet-layer replacement)."""
+
+from kubeflow_tpu.manifests.registry import (  # noqa: F401
+    Component,
+    get_component,
+    list_components,
+    merge_params,
+    render_all,
+    render_component,
+    register,
+)

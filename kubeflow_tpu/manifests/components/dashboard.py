@@ -1,0 +1,46 @@
+"""Central dashboard component (reference: ``components/centraldashboard``,
+deployed by ``/root/reference/kubeflow/common/centraldashboard.libsonnet``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from kubeflow_tpu.config.deployment import DeploymentConfig
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.manifests.registry import register
+
+DEFAULTS: Dict[str, Any] = {
+    "image": "kubeflow-tpu/dashboard:v1alpha1",
+    "port": 8082,
+    "replicas": 1,
+}
+
+
+@register("dashboard", DEFAULTS, "Central dashboard web service")
+def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
+    ns = config.namespace
+    name = "centraldashboard"
+    pod = o.pod_spec(
+        [o.container(
+            name,
+            params["image"],
+            command=["python", "-m", "kubeflow_tpu.dashboard.server"],
+            env={"KFTPU_DASHBOARD_PORT": str(params["port"])},
+            ports=[params["port"]],
+        )],
+        service_account_name=name,
+    )
+    rules = [
+        {"apiGroups": [""], "resources": ["namespaces", "events"],
+         "verbs": ["get", "list"]},
+        {"apiGroups": ["kubeflow-tpu.org"], "resources": ["*"],
+         "verbs": ["get", "list"]},
+    ]
+    return [
+        o.service_account(name, ns),
+        o.cluster_role(name, rules),
+        o.cluster_role_binding(name, name, name, ns),
+        o.deployment(name, ns, pod, replicas=params["replicas"]),
+        o.service(name, ns, {"app": name},
+                  [{"name": "http", "port": 80, "targetPort": params["port"]}]),
+    ]

@@ -1,0 +1,83 @@
+"""JAX model-serving component: versioned model server + traffic-split Service.
+
+Replaces TF-Serving / TensorRT Inference Server behind the same surface:
+gRPC :9000 + REST :8500 ports and per-version Deployments with a
+weight-split Service (reference: ``/root/reference/kubeflow/tf-serving/
+tf-serving-template.libsonnet:33-48``, version split
+``tf-serving-service-template.libsonnet`` / ``prototypes/
+tf-serving-service.jsonnet:8``, prometheus config ``:128-130``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from kubeflow_tpu.config.deployment import DeploymentConfig
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.manifests.registry import register
+
+DEFAULTS: Dict[str, Any] = {
+    "name": "model-server",
+    "image": "kubeflow-tpu/serving:v1alpha1",
+    "model_base_path": "/models/default",
+    "version": "v1",
+    "replicas": 1,
+    "rest_port": 8500,
+    "grpc_port": 9000,
+    "tpu_chips": 0,  # 0 = CPU serving; >0 requests google.com/tpu
+    "batch_timeout_ms": 5,
+    "max_batch_size": 8,
+}
+
+
+@register("serving", DEFAULTS,
+          "JAX/XLA model server (replaces tf-serving / nvidia-inference-server)")
+def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
+    ns = config.namespace
+    name = params["name"]
+    version = params["version"]
+    deploy_name = f"{name}-{version}"
+    labels = {"app": name, "version": version}
+
+    resources: Dict[str, Any] = {}
+    if params["tpu_chips"]:
+        resources = {"limits": {"google.com/tpu": params["tpu_chips"]}}
+
+    env = {
+        "KFTPU_MODEL_BASE_PATH": params["model_base_path"],
+        "KFTPU_REST_PORT": str(params["rest_port"]),
+        "KFTPU_GRPC_PORT": str(params["grpc_port"]),
+        "KFTPU_BATCH_TIMEOUT_MS": str(params["batch_timeout_ms"]),
+        "KFTPU_MAX_BATCH_SIZE": str(params["max_batch_size"]),
+    }
+    pod = o.pod_spec([
+        o.container(
+            "server",
+            params["image"],
+            command=["python", "-m", "kubeflow_tpu.serving.server"],
+            env=env,
+            ports=[params["rest_port"], params["grpc_port"]],
+            resources=resources,
+        )
+    ])
+    deploy = o.deployment(
+        deploy_name, ns, pod, replicas=params["replicas"], labels=labels,
+    )
+    svc = o.service(
+        name,
+        ns,
+        {"app": name},  # selects every version; weights via per-version replicas
+        [
+            {"name": "rest", "port": params["rest_port"],
+             "targetPort": params["rest_port"]},
+            {"name": "grpc", "port": params["grpc_port"],
+             "targetPort": params["grpc_port"]},
+        ],
+        labels={"app": name},
+        annotations={
+            "prometheus.io/scrape": "true",
+            "prometheus.io/path": "/metrics",
+            "prometheus.io/port": str(params["rest_port"]),
+        },
+    )
+    return [deploy, svc]

@@ -1,0 +1,108 @@
+"""TpuJob operator component: CRD + RBAC + operator Deployment + metrics Service.
+
+The single job operator replacing the reference's whole operator family —
+TFJob (``/root/reference/kubeflow/tf-training/tf-job-operator.libsonnet``),
+PyTorchJob, MPIJob, MXJob, ChainerJob, PaddleJob. Its manifest surface keeps
+the TFJob package's ergonomics: namespace-vs-cluster scope (libsonnet
+:216-227), gang-scheduling flag adding podgroup RBAC (:107-109,268-277),
+prometheus scrape annotations on the metrics Service (:180-184) — mapped
+onto SPMD/TPU-slice semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from kubeflow_tpu.config.deployment import DeploymentConfig
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.manifests.registry import register
+
+GROUP = "kubeflow-tpu.org"
+VERSION = "v1alpha1"
+API_VERSION = f"{GROUP}/{VERSION}"
+TPUJOB_KIND = "TpuJob"
+TPUJOB_PLURAL = "tpujobs"
+
+DEFAULTS: Dict[str, Any] = {
+    "image": "kubeflow-tpu/operator:v1alpha1",
+    "cluster_scope": True,
+    "gang_scheduling": True,
+    "monitoring_port": 8443,
+    "replicas": 1,
+}
+
+
+def tpujob_crd() -> o.Obj:
+    return o.crd(
+        TPUJOB_PLURAL,
+        GROUP,
+        TPUJOB_KIND,
+        versions=(VERSION,),
+        short_names=("tj",),
+        printer_columns=(
+            {"name": "State", "type": "string",
+             "jsonPath": ".status.phase"},
+            {"name": "Slices", "type": "integer",
+             "jsonPath": ".spec.slices"},
+            {"name": "Age", "type": "date",
+             "jsonPath": ".metadata.creationTimestamp"},
+        ),
+    )
+
+
+@register("tpujob-operator", DEFAULTS,
+          "Slice-aware TpuJob operator (replaces tf/pytorch/mpi operator family)")
+def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
+    ns = config.namespace
+    name = "tpujob-operator"
+    rules = [
+        {"apiGroups": [GROUP], "resources": [TPUJOB_PLURAL,
+                                             f"{TPUJOB_PLURAL}/status"],
+         "verbs": ["*"]},
+        {"apiGroups": [""], "resources": ["pods", "services", "events",
+                                          "configmaps"],
+         "verbs": ["*"]},
+        {"apiGroups": ["apps"], "resources": ["statefulsets"], "verbs": ["*"]},
+    ]
+    if params["gang_scheduling"]:
+        rules.append({
+            "apiGroups": ["scheduling.k8s.io", "scheduling.sigs.k8s.io"],
+            "resources": ["podgroups", "priorityclasses"],
+            "verbs": ["*"],
+        })
+
+    env = {
+        "KFTPU_OPERATOR_NAMESPACE": "" if params["cluster_scope"] else ns,
+        "KFTPU_GANG_SCHEDULING": str(params["gang_scheduling"]).lower(),
+        "KFTPU_MONITORING_PORT": str(params["monitoring_port"]),
+    }
+    pod = o.pod_spec(
+        [o.container(
+            name,
+            params["image"],
+            command=["python", "-m", "kubeflow_tpu.operators.tpujob"],
+            env=env,
+            ports=[params["monitoring_port"]],
+        )],
+        service_account_name=name,
+    )
+    metrics_svc = o.service(
+        name,
+        ns,
+        {"app": name},
+        [{"name": "monitoring-port", "port": params["monitoring_port"],
+          "targetPort": params["monitoring_port"]}],
+        annotations={
+            "prometheus.io/scrape": "true",
+            "prometheus.io/path": "/metrics",
+            "prometheus.io/port": str(params["monitoring_port"]),
+        },
+    )
+    return [
+        tpujob_crd(),
+        o.service_account(name, ns),
+        o.cluster_role(name, rules),
+        o.cluster_role_binding(name, name, name, ns),
+        o.deployment(name, ns, pod, replicas=params["replicas"]),
+        metrics_svc,
+    ]

@@ -1,0 +1,90 @@
+"""Component registry: name -> manifest renderer.
+
+The reference's equivalent is its ksonnet package library — each component a
+jsonnet package with ``params+env`` defaults merged into prototypes
+(``/root/reference/kubeflow/*/``), assembled per-deployment by the kustomize
+package manager (``kustomize.go:561-642``). Here a component is a Python
+function; params are validated against declared defaults; output is a list
+of canonical k8s dicts that golden tests snapshot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Mapping, Optional
+
+from kubeflow_tpu.config.deployment import ComponentSpec, DeploymentConfig
+from kubeflow_tpu.k8s.objects import Obj, namespace
+
+Renderer = Callable[[DeploymentConfig, Dict[str, Any]], List[Obj]]
+
+
+@dataclasses.dataclass(frozen=True)
+class Component:
+    name: str
+    render: Renderer
+    defaults: Mapping[str, Any]
+    description: str = ""
+
+
+_REGISTRY: Dict[str, Component] = {}
+
+
+def register(
+    name: str,
+    defaults: Optional[Mapping[str, Any]] = None,
+    description: str = "",
+) -> Callable[[Renderer], Renderer]:
+    def wrap(fn: Renderer) -> Renderer:
+        if name in _REGISTRY:
+            raise ValueError(f"component {name!r} already registered")
+        _REGISTRY[name] = Component(name, fn, dict(defaults or {}), description)
+        return fn
+
+    return wrap
+
+
+def get_component(name: str) -> Component:
+    _ensure_builtins()
+    if name not in _REGISTRY:
+        known = ", ".join(sorted(_REGISTRY))
+        raise KeyError(f"unknown component {name!r}; known: {known}")
+    return _REGISTRY[name]
+
+
+def list_components() -> List[Component]:
+    _ensure_builtins()
+    return sorted(_REGISTRY.values(), key=lambda c: c.name)
+
+
+def merge_params(component: Component, overrides: Mapping[str, Any]) -> Dict[str, Any]:
+    params = dict(component.defaults)
+    unknown = set(overrides) - set(params)
+    if unknown:
+        raise ValueError(
+            f"component {component.name!r}: unknown params {sorted(unknown)}; "
+            f"valid: {sorted(params)}"
+        )
+    params.update(overrides)
+    return params
+
+
+def render_component(config: DeploymentConfig, spec: ComponentSpec) -> List[Obj]:
+    comp = get_component(spec.name)
+    params = merge_params(comp, spec.params)
+    return comp.render(config, params)
+
+
+def render_all(config: DeploymentConfig) -> List[Obj]:
+    """Render the full deployment: namespace first, then every component."""
+    config.validate()
+    objs: List[Obj] = [namespace(config.namespace,
+                                 labels={"app.kubernetes.io/part-of": config.name})]
+    for spec in config.components:
+        objs.extend(render_component(config, spec))
+    return objs
+
+
+def _ensure_builtins() -> None:
+    """Import built-in component modules so their @register calls run."""
+    from kubeflow_tpu.manifests import components  # noqa: F401

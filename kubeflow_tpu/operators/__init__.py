@@ -1,0 +1,8 @@
+"""In-cluster controllers: the TpuJob operator and companions."""
+
+from kubeflow_tpu.operators.controller import Controller, WorkQueue  # noqa: F401
+from kubeflow_tpu.operators.tpujob import (  # noqa: F401
+    TpuJobOperator,
+    TpuJobSpec,
+    tpujob,
+)

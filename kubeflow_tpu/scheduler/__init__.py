@@ -1,0 +1,9 @@
+"""Slice-aware gang placement for TPU pod slices."""
+
+from kubeflow_tpu.scheduler.placement import (  # noqa: F401
+    ACCELERATORS,
+    SlicePlacement,
+    accelerator_info,
+    place_gang,
+    ring_order,
+)

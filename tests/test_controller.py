@@ -1,0 +1,91 @@
+"""Watch-driven controller runtime tests: the operator reacts to events
+with no manual reconcile calls."""
+
+import time
+
+import pytest
+
+from kubeflow_tpu.k8s import FakeKubeClient
+from kubeflow_tpu.manifests.components.tpujob_operator import (
+    API_VERSION,
+    TPUJOB_KIND,
+)
+from kubeflow_tpu.operators.controller import WorkQueue
+from kubeflow_tpu.operators.tpujob import JOB_LABEL, TpuJobOperator, tpujob
+
+
+def wait_until(fn, timeout=5.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_workqueue_dedup_and_delay():
+    q = WorkQueue()
+    q.add(("ns", "a"))
+    q.add(("ns", "a"))  # dedup
+    q.add(("ns", "b"), delay=0.2)
+    assert q.get(timeout=1) == ("ns", "a")
+    assert q.get(timeout=0.05) is None  # b not due yet
+    assert q.get(timeout=1) == ("ns", "b")
+    q.shutdown()
+    assert q.get(timeout=0.1) is None
+
+
+def test_controller_end_to_end_lifecycle():
+    client = FakeKubeClient()
+    operator = TpuJobOperator(client)
+    ctrl = operator.build_controller()
+    ctrl.start(workers=2)
+    try:
+        client.create(tpujob("job1", "default", {
+            "image": "img", "slices": 1, "hostsPerSlice": 2,
+        }))
+        assert wait_until(lambda: len(
+            client.list("v1", "Pod", "default",
+                        label_selector={JOB_LABEL: "job1"})) == 2)
+
+        # pod status changes flow back through the owned-watch
+        for pod in client.list("v1", "Pod", "default",
+                               label_selector={JOB_LABEL: "job1"}):
+            pod.setdefault("status", {})["phase"] = "Running"
+            client.update_status(pod)
+        assert wait_until(lambda: client.get(
+            API_VERSION, TPUJOB_KIND, "default", "job1"
+        ).get("status", {}).get("phase") == "Running")
+
+        for pod in client.list("v1", "Pod", "default",
+                               label_selector={JOB_LABEL: "job1"}):
+            pod["status"]["phase"] = "Succeeded"
+            client.update_status(pod)
+        assert wait_until(lambda: client.get(
+            API_VERSION, TPUJOB_KIND, "default", "job1"
+        )["status"]["phase"] == "Succeeded")
+    finally:
+        ctrl.stop()
+
+
+def test_controller_survives_reconcile_exception():
+    client = FakeKubeClient()
+    calls = []
+
+    def bad_reconcile(ns, name):
+        calls.append((ns, name))
+        if len(calls) == 1:
+            raise RuntimeError("boom")
+        return None
+
+    from kubeflow_tpu.operators.controller import Controller
+
+    ctrl = Controller(client, "v1", "ConfigMap", bad_reconcile)
+    ctrl.start()
+    try:
+        client.create({"apiVersion": "v1", "kind": "ConfigMap",
+                       "metadata": {"name": "x", "namespace": "d"}, "data": {}})
+        # first call raises -> runtime requeues -> second call succeeds
+        assert wait_until(lambda: len(calls) >= 2, timeout=10)
+    finally:
+        ctrl.stop()

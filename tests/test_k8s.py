@@ -1,0 +1,121 @@
+"""Fake API server + apply engine tests."""
+
+import pytest
+
+from kubeflow_tpu.k8s import ApiError, FakeKubeClient, objects as o
+from kubeflow_tpu.k8s.apply import apply_all, delete_all, prune, sort_for_apply
+
+
+@pytest.fixture
+def client():
+    return FakeKubeClient()
+
+
+def test_create_get_roundtrip(client):
+    cm = o.config_map("cfg", "ns1", {"a": "1"})
+    created = client.create(cm)
+    assert created["metadata"]["uid"].startswith("uid-")
+    got = client.get("v1", "ConfigMap", "ns1", "cfg")
+    assert got["data"] == {"a": "1"}
+
+
+def test_create_conflict(client):
+    cm = o.config_map("cfg", "ns1", {"a": "1"})
+    client.create(cm)
+    with pytest.raises(ApiError) as ei:
+        client.create(cm)
+    assert ei.value.code == 409
+
+
+def test_get_missing_404(client):
+    with pytest.raises(ApiError) as ei:
+        client.get("v1", "ConfigMap", "ns1", "nope")
+    assert ei.value.code == 404
+
+
+def test_list_with_label_selector(client):
+    client.create(o.service("a", "ns1", {"app": "x"}, [{"port": 80}],
+                            labels={"team": "ml"}))
+    client.create(o.service("b", "ns1", {"app": "y"}, [{"port": 80}],
+                            labels={"team": "web"}))
+    got = client.list("v1", "Service", "ns1", label_selector={"team": "ml"})
+    assert [g["metadata"]["name"] for g in got] == ["a"]
+
+
+def test_update_bumps_resource_version(client):
+    cm = client.create(o.config_map("cfg", "ns1", {"a": "1"}))
+    rv1 = cm["metadata"]["resourceVersion"]
+    cm["data"]["a"] = "2"
+    updated = client.update(cm)
+    assert updated["metadata"]["resourceVersion"] != rv1
+    assert client.get("v1", "ConfigMap", "ns1", "cfg")["data"]["a"] == "2"
+
+
+def test_update_status_subresource_only_touches_status(client):
+    job = {"apiVersion": "kubeflow-tpu.org/v1alpha1", "kind": "TpuJob",
+           "metadata": {"name": "j", "namespace": "ns1"},
+           "spec": {"slices": 1}}
+    client.create(job)
+    client.update_status({**job, "spec": {"slices": 99},
+                          "status": {"phase": "Running"}})
+    got = client.get("kubeflow-tpu.org/v1alpha1", "TpuJob", "ns1", "j")
+    assert got["status"]["phase"] == "Running"
+    assert got["spec"]["slices"] == 1  # spec change via status endpoint ignored
+
+
+def test_watch_replays_and_streams(client):
+    client.create(o.config_map("pre", "ns1", {}))
+    q = client.watch("v1", "ConfigMap", "ns1")
+    evt = q.get_nowait()
+    assert evt.type == "ADDED" and evt.object["metadata"]["name"] == "pre"
+    client.create(o.config_map("post", "ns1", {}))
+    evt = q.get_nowait()
+    assert evt.type == "ADDED" and evt.object["metadata"]["name"] == "post"
+    client.delete("v1", "ConfigMap", "ns1", "post")
+    assert q.get_nowait().type == "DELETED"
+
+
+def test_owner_reference_cascade_delete(client):
+    owner = client.create({"apiVersion": "kubeflow-tpu.org/v1alpha1",
+                           "kind": "TpuJob",
+                           "metadata": {"name": "j", "namespace": "ns1"}})
+    child = o.pod("j-worker-0", "ns1", o.pod_spec([o.container("c", "img")]))
+    o.set_owner(child, owner)
+    client.create(child)
+    client.delete("kubeflow-tpu.org/v1alpha1", "TpuJob", "ns1", "j")
+    assert client.get_or_none("v1", "Pod", "ns1", "j-worker-0") is None
+
+
+def test_sort_for_apply_order():
+    objs = [
+        o.deployment("d", "ns", o.pod_spec([o.container("c", "i")])),
+        o.namespace("ns"),
+        o.crd("things", "g.io", "Thing"),
+        o.service_account("sa", "ns"),
+    ]
+    kinds = [x["kind"] for x in sort_for_apply(objs)]
+    assert kinds == ["CustomResourceDefinition", "Namespace", "ServiceAccount",
+                     "Deployment"]
+
+
+def test_apply_all_is_idempotent(client):
+    objs = [o.namespace("ns1"), o.config_map("cfg", "ns1", {"a": "1"})]
+    apply_all(client, objs)
+    apply_all(client, objs)  # second run updates, no conflict
+    assert len(client.list("v1", "ConfigMap", "ns1")) == 1
+
+
+def test_delete_all_ignores_missing(client):
+    objs = [o.config_map("cfg", "ns1", {})]
+    apply_all(client, objs)
+    delete_all(client, objs)
+    delete_all(client, objs)  # already gone: no raise
+
+
+def test_prune_removes_undesired(client):
+    a = o.config_map("a", "ns1", {})
+    b = o.config_map("b", "ns1", {})
+    apply_all(client, [a, b])
+    pruned = prune(client, desired=[a], observed=client.list("v1", "ConfigMap", "ns1"))
+    assert [p["metadata"]["name"] for p in pruned] == ["b"]
+    assert client.get_or_none("v1", "ConfigMap", "ns1", "b") is None

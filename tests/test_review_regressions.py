@@ -1,0 +1,102 @@
+"""Regression tests for review findings on the platform core."""
+
+import jax  # noqa: F401 — conftest platform override must run first
+
+from kubeflow_tpu.cli.main import build_parser
+from kubeflow_tpu.k8s import FakeKubeClient
+from kubeflow_tpu.k8s.fakefile import FileBackedFakeClient
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.manifests.components.tpujob_operator import (
+    API_VERSION,
+    TPUJOB_KIND,
+)
+from kubeflow_tpu.operators.tpujob import JOB_LABEL, TpuJobOperator, tpujob
+from kubeflow_tpu.scheduler import place_gang
+
+
+def test_partial_slice_placement_no_crash():
+    p = place_gang(slices=1, hosts_per_slice=3, accelerator="v5e-16")
+    assert [x.host for x in p] == [0, 1, 2]
+
+
+def test_fakefile_counters_resume(tmp_path):
+    path = str(tmp_path / "state.json")
+    c1 = FileBackedFakeClient(path)
+    owner = c1.create({"apiVersion": API_VERSION, "kind": TPUJOB_KIND,
+                       "metadata": {"name": "j", "namespace": "d"}})
+    child = o.pod("j-w0", "d", o.pod_spec([o.container("c", "i")]))
+    o.set_owner(child, owner)
+    c1.create(child)
+
+    c2 = FileBackedFakeClient(path)  # new process
+    sec = c2.create(o.secret("unrelated", "d", {"k": "v"}))
+    assert sec["metadata"]["uid"] != owner["metadata"]["uid"]
+    c2.delete("v1", "Secret", "d", "unrelated")
+    # cascade must NOT have taken the old child
+    assert c2.get_or_none("v1", "Pod", "d", "j-w0") is not None
+
+
+def test_missing_worker_recreated():
+    client = FakeKubeClient()
+    op = TpuJobOperator(client)
+    client.create(tpujob("t", "d", {"image": "i", "hostsPerSlice": 2}))
+    op.reconcile("d", "t")
+    client.delete("v1", "Pod", "d", "t-w1")  # eviction
+    op.reconcile("d", "t")
+    pods = client.list("v1", "Pod", "d", label_selector={JOB_LABEL: "t"})
+    assert sorted(p["metadata"]["name"] for p in pods) == ["t-w0", "t-w1"]
+
+
+def test_restart_counter_not_burned_while_terminating():
+    client = FakeKubeClient()
+    op = TpuJobOperator(client)
+    client.create(tpujob("t", "d", {"image": "i", "hostsPerSlice": 2,
+                                    "maxRestarts": 3}))
+    op.reconcile("d", "t")
+    # pod fails but deletion is graceful: it stays with deletionTimestamp
+    pods = client.list("v1", "Pod", "d", label_selector={JOB_LABEL: "t"})
+    for p in pods:
+        p.setdefault("status", {})["phase"] = "Failed"
+        client.update_status(p)
+    op.reconcile("d", "t")  # restart 1: deletes pods (fake: instant)
+    job = client.get(API_VERSION, TPUJOB_KIND, "d", "t")
+    assert job["status"]["restarts"] == 1
+    # simulate a pod stuck Terminating: re-add one with deletionTimestamp
+    stuck = o.pod("t-w0", "d", o.pod_spec([o.container("c", "i")]),
+                  labels={JOB_LABEL: "t"})
+    stuck["metadata"]["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+    stuck["status"] = {"phase": "Failed"}
+    client.create(stuck)
+    for _ in range(5):
+        op.reconcile("d", "t")
+    job = client.get(API_VERSION, TPUJOB_KIND, "d", "t")
+    assert job["status"]["restarts"] == 1  # unchanged while terminating
+
+
+def test_cli_global_verbose_not_lost():
+    args = build_parser().parse_args(["-v", "components"])
+    assert args.verbose is True
+    args = build_parser().parse_args(["components", "-v"])
+    assert args.verbose is True
+    args = build_parser().parse_args(["components"])
+    assert args.verbose is False
+
+
+def test_phase_gauge_recomputed():
+    from kubeflow_tpu.utils import DEFAULT_REGISTRY
+
+    gauge = DEFAULT_REGISTRY.gauge("kftpu_operator_jobs")
+    client = FakeKubeClient()
+    op = TpuJobOperator(client)
+    client.create(tpujob("a", "d", {"image": "i"}))
+    client.create(tpujob("b", "d", {"image": "i"}))
+    op.reconcile("d", "a")
+    op.reconcile("d", "b")
+    assert gauge.get(phase="Pending") == 2
+    for p in client.list("v1", "Pod", "d"):
+        p.setdefault("status", {})["phase"] = "Succeeded"
+        client.update_status(p)
+    op.reconcile("d", "a")
+    op.reconcile("d", "b")
+    assert gauge.get(phase="Succeeded") == 2
+    assert gauge.get(phase="Pending") == 0  # stale label cleared

@@ -1,0 +1,45 @@
+"""Slice-aware placement tests."""
+
+import pytest
+
+from kubeflow_tpu.scheduler import (
+    SlicePlacement,
+    accelerator_info,
+    place_gang,
+    ring_order,
+)
+
+
+def test_accelerator_info():
+    chips, hosts, topo = accelerator_info("v5e-16")
+    assert (chips, hosts, topo) == (16, 4, "4x4")
+    with pytest.raises(ValueError, match="unknown accelerator"):
+        accelerator_info("v99-1")
+
+
+def test_place_gang_slice_major():
+    p = place_gang(slices=2, hosts_per_slice=2, accelerator="v5e-8")
+    assert [(x.slice_index, x.host) for x in p] == [(0, 0), (0, 1), (1, 0), (1, 1)]
+    assert all(x.topology == "2x4" for x in p)
+
+
+def test_place_gang_rejects_oversubscription():
+    with pytest.raises(ValueError, match="hosts"):
+        place_gang(slices=1, hosts_per_slice=4, accelerator="v5e-8")
+
+
+def test_ring_order_snake_is_adjacent():
+    # v5e-64: 16 hosts as a 4x4 host grid; consecutive entries must be
+    # grid-adjacent (the boustrophedon walk)
+    order = ring_order(16, "8x8")
+    assert sorted(order) == list(range(16))
+    cols = 4
+    for a, b in zip(order, order[1:]):
+        ra, ca = divmod(a, cols)
+        rb, cb = divmod(b, cols)
+        assert abs(ra - rb) + abs(ca - cb) == 1, (a, b)
+
+
+def test_ring_order_small_identity():
+    assert ring_order(2, "2x4") == [0, 1]
+    assert ring_order(1, "2x2") == [0]

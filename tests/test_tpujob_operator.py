@@ -1,0 +1,197 @@
+"""TpuJob operator lifecycle tests against the fake API server — the
+envtest tier the reference lacks (SURVEY.md §4 implication)."""
+
+import pytest
+
+from kubeflow_tpu.k8s import FakeKubeClient
+from kubeflow_tpu.manifests.components.tpujob_operator import (
+    API_VERSION,
+    TPUJOB_KIND,
+)
+from kubeflow_tpu.operators.tpujob import (
+    JOB_LABEL,
+    PHASE_FAILED,
+    PHASE_PENDING,
+    PHASE_RESTARTING,
+    PHASE_RUNNING,
+    PHASE_SUCCEEDED,
+    TpuJobOperator,
+    TpuJobSpec,
+    coordinator_address,
+    tpujob,
+)
+from kubeflow_tpu.parallel import distributed as dist
+
+
+@pytest.fixture
+def client():
+    return FakeKubeClient()
+
+
+@pytest.fixture
+def operator(client):
+    return TpuJobOperator(client)
+
+
+def make_job(client, name="train", ns="default", **spec_overrides):
+    spec = {
+        "image": "kubeflow-tpu/examples:latest",
+        "command": ["python", "-m", "train"],
+        "slices": 1,
+        "hostsPerSlice": 2,
+        "accelerator": "v5e-8",
+        **spec_overrides,
+    }
+    return client.create(tpujob(name, ns, spec))
+
+
+def set_pod_phases(client, ns, phase, job="train"):
+    for pod in client.list("v1", "Pod", ns, label_selector={JOB_LABEL: job}):
+        pod.setdefault("status", {})["phase"] = phase
+        client.update_status(pod)
+
+
+def get_job(client, ns="default", name="train"):
+    return client.get(API_VERSION, TPUJOB_KIND, ns, name)
+
+
+def test_creates_gang_and_service(client, operator):
+    make_job(client)
+    operator.reconcile("default", "train")
+    pods = client.list("v1", "Pod", "default", label_selector={JOB_LABEL: "train"})
+    assert len(pods) == 2
+    svc = client.get("v1", "Service", "default", "train")
+    assert svc["spec"]["clusterIP"] == "None"  # headless, for coordinator DNS
+    assert get_job(client)["status"]["phase"] == PHASE_PENDING
+
+
+def test_env_contract_injection(client, operator):
+    make_job(client)
+    operator.reconcile("default", "train")
+    pods = sorted(
+        client.list("v1", "Pod", "default", label_selector={JOB_LABEL: "train"}),
+        key=lambda p: p["metadata"]["name"],
+    )
+    env0 = {e["name"]: e["value"]
+            for e in pods[0]["spec"]["containers"][0]["env"]}
+    env1 = {e["name"]: e["value"]
+            for e in pods[1]["spec"]["containers"][0]["env"]}
+    assert env0[dist.ENV_PROCESS_ID] == "0"
+    assert env1[dist.ENV_PROCESS_ID] == "1"
+    assert env0[dist.ENV_NUM_PROCESSES] == "2"
+    expected = coordinator_address("train", "default", 8476)
+    assert env0[dist.ENV_COORDINATOR] == expected == env1[dist.ENV_COORDINATOR]
+    # TPU resources + topology selector present
+    assert pods[0]["spec"]["containers"][0]["resources"]["limits"][
+        "google.com/tpu"] == 4
+    assert pods[0]["spec"]["nodeSelector"][
+        "cloud.google.com/gke-tpu-accelerator"] == "v5e-8"
+
+
+def test_gang_podgroup_created(client, operator):
+    make_job(client)
+    operator.reconcile("default", "train")
+    pg = client.get("scheduling.sigs.k8s.io/v1alpha1", "PodGroup", "default",
+                    "train")
+    assert pg["spec"]["minMember"] == 2
+
+
+def test_running_then_succeeded(client, operator):
+    make_job(client)
+    operator.reconcile("default", "train")
+    set_pod_phases(client, "default", "Running")
+    operator.reconcile("default", "train")
+    job = get_job(client)
+    assert job["status"]["phase"] == PHASE_RUNNING
+    assert "startTime" in job["status"]
+
+    set_pod_phases(client, "default", "Succeeded")
+    operator.reconcile("default", "train")
+    job = get_job(client)
+    assert job["status"]["phase"] == PHASE_SUCCEEDED
+    assert "completionTime" in job["status"]
+    # terminal: another reconcile is a no-op
+    assert operator.reconcile("default", "train") is None
+
+
+def test_failure_restarts_whole_gang(client, operator):
+    make_job(client)
+    operator.reconcile("default", "train")
+    pods = client.list("v1", "Pod", "default", label_selector={JOB_LABEL: "train"})
+    # one worker dies -> entire gang must be torn down (SPMD all-or-nothing)
+    pod = pods[0]
+    pod.setdefault("status", {})["phase"] = "Failed"
+    client.update_status(pod)
+    operator.reconcile("default", "train")
+    job = get_job(client)
+    assert job["status"]["phase"] == PHASE_RESTARTING
+    assert job["status"]["restarts"] == 1
+    assert client.list("v1", "Pod", "default",
+                       label_selector={JOB_LABEL: "train"}) == []
+    # next reconcile re-creates the gang
+    operator.reconcile("default", "train")
+    assert len(client.list("v1", "Pod", "default",
+                           label_selector={JOB_LABEL: "train"})) == 2
+
+
+def test_restart_policy_never_fails_fast(client, operator):
+    make_job(client, restartPolicy="Never")
+    operator.reconcile("default", "train")
+    set_pod_phases(client, "default", "Failed")
+    operator.reconcile("default", "train")
+    assert get_job(client)["status"]["phase"] == PHASE_FAILED
+
+
+def test_max_restarts_exhausted(client, operator):
+    make_job(client, maxRestarts=1)
+    for _ in range(4):  # create -> fail -> restart -> fail -> Failed
+        operator.reconcile("default", "train")
+        set_pod_phases(client, "default", "Failed")
+        operator.reconcile("default", "train")
+    job = get_job(client)
+    assert job["status"]["phase"] == PHASE_FAILED
+    assert job["status"]["restarts"] == 1
+
+
+def test_invalid_spec_fails(client, operator):
+    client.create({
+        "apiVersion": API_VERSION, "kind": TPUJOB_KIND,
+        "metadata": {"name": "bad", "namespace": "default"},
+        "spec": {"slices": 1},  # no image
+    })
+    operator.reconcile("default", "bad")
+    job = get_job(client, name="bad")
+    assert job["status"]["phase"] == PHASE_FAILED
+    assert job["status"]["conditions"][0]["reason"] == "InvalidSpec"
+
+
+def test_multislice_process_layout(client, operator):
+    make_job(client, slices=2, hostsPerSlice=2, accelerator="v5e-8")
+    operator.reconcile("default", "train")
+    pods = sorted(
+        client.list("v1", "Pod", "default", label_selector={JOB_LABEL: "train"}),
+        key=lambda p: int(p["metadata"]["name"].rsplit("w", 1)[1]),
+    )
+    assert len(pods) == 4
+    envs = [{e["name"]: e["value"] for e in p["spec"]["containers"][0]["env"]}
+            for p in pods]
+    # slice-major layout: first hostsPerSlice ids on slice 0, rest on slice 1
+    assert [e["MEGASCALE_SLICE_ID"] for e in envs] == ["0", "0", "1", "1"]
+    assert all(e["MEGASCALE_NUM_SLICES"] == "2" for e in envs)
+    assert [e[dist.ENV_PROCESS_ID] for e in envs] == ["0", "1", "2", "3"]
+
+
+def test_delete_job_cascades_to_pods(client, operator):
+    make_job(client)
+    operator.reconcile("default", "train")
+    client.delete(API_VERSION, TPUJOB_KIND, "default", "train")
+    assert client.list("v1", "Pod", "default",
+                       label_selector={JOB_LABEL: "train"}) == []
+    assert operator.reconcile("default", "train") is None
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError, match="image"):
+        TpuJobSpec.from_dict({})
+    with pytest.raises(ValueError, match="restartPolicy"):
+        TpuJobSpec.from_dict({"image": "x", "restartPolicy": "Sometimes"})
