@@ -1,0 +1,10 @@
+"""Benchmark pipeline (kubebench equivalent): configure → run → monitor → report."""
+
+from kubeflow_tpu.bench.pipeline import (  # noqa: F401
+    BenchmarkResult,
+    BenchmarkSpec,
+    ClusterRunner,
+    LocalRunner,
+    WORKLOADS,
+    report,
+)

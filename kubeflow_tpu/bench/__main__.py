@@ -1,0 +1,49 @@
+"""CLI: ``python -m kubeflow_tpu.bench run --workload mnist -- --steps 30``."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from kubeflow_tpu.bench.pipeline import (
+    BenchmarkSpec,
+    LocalRunner,
+    WORKLOADS,
+    report,
+)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kubeflow_tpu.bench")
+    sub = p.add_subparsers(dest="command", required=True)
+    rp = sub.add_parser("run", help="run a benchmark locally")
+    rp.add_argument("--name", default=None)
+    rp.add_argument("--workload", required=True,
+                    help=f"one of {sorted(WORKLOADS)} or a module path")
+    rp.add_argument("--out-dir", default="bench_results")
+    rp.add_argument("--timeout", type=float, default=3600.0)
+    rp.add_argument("workload_args", nargs="*",
+                    help="args after -- go to the workload")
+    args = p.parse_args(argv)
+
+    spec = BenchmarkSpec(
+        name=args.name or args.workload,
+        workload=args.workload,
+        args=args.workload_args,
+        timeout_s=args.timeout,
+    )
+    result = LocalRunner().run(spec)
+    paths = report(result, args.out_dir)
+    print(json.dumps({
+        "name": result.name,
+        "status": result.status,
+        "wall_time_s": round(result.wall_time_s, 2),
+        "final_metrics": result.final_metrics,
+        **paths,
+    }))
+    return 0 if result.status == "Succeeded" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

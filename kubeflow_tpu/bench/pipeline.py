@@ -1,0 +1,187 @@
+"""Benchmark pipeline — the kubebench equivalent.
+
+The reference's kubebench runs an Argo workflow: configurator (render job
+from config) → create main job → monitor until ``status.completionTime`` →
+post-job → csv reporter, results on a shared PVC under
+``KUBEBENCH_EXP_RESULT_PATH`` (``/root/reference/kubeflow/kubebench/
+kubebench-job.libsonnet:250-396,118-144``). Here the same pipeline is a
+typed runner with two backends:
+
+- :class:`LocalRunner` — exec the workload module in a subprocess on the
+  attached chips, scrape its JSON-line metrics from stdout;
+- :class:`ClusterRunner` — submit a TpuJob CR, poll its status conditions
+  (the monitor step), read metrics from the experiment results dir.
+
+Both feed the same :func:`report` step emitting csv + json.
+"""
+
+from __future__ import annotations
+
+import csv
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from kubeflow_tpu.k8s.client import KubeClient
+from kubeflow_tpu.manifests.components.tpujob_operator import (
+    API_VERSION,
+    TPUJOB_KIND,
+)
+from kubeflow_tpu.operators.tpujob import tpujob
+
+WORKLOADS = {
+    "mnist": "kubeflow_tpu.examples.mnist",
+    "resnet": "kubeflow_tpu.examples.resnet",
+    "lm": "kubeflow_tpu.examples.lm",
+}
+
+
+@dataclasses.dataclass
+class BenchmarkSpec:
+    """The configurator's input (kubebench config equivalent)."""
+
+    name: str
+    workload: str                      # key into WORKLOADS or a module path
+    args: List[str] = dataclasses.field(default_factory=list)
+    namespace: str = "default"
+    # cluster mode:
+    image: str = "kubeflow-tpu/examples:latest"
+    slices: int = 1
+    hosts_per_slice: int = 1
+    accelerator: str = "v5e-8"
+    timeout_s: float = 3600.0
+
+    def module(self) -> str:
+        return WORKLOADS.get(self.workload, self.workload)
+
+
+@dataclasses.dataclass
+class BenchmarkResult:
+    name: str
+    status: str                        # Succeeded | Failed | Timeout
+    wall_time_s: float
+    metrics: List[Dict[str, Any]]      # parsed JSON metric lines
+
+    @property
+    def final_metrics(self) -> Dict[str, Any]:
+        return self.metrics[-1] if self.metrics else {}
+
+
+class LocalRunner:
+    """Run the workload in a subprocess on this host's devices."""
+
+    def __init__(self, extra_env: Optional[Dict[str, str]] = None) -> None:
+        self.extra_env = dict(extra_env or {})
+
+    def run(self, spec: BenchmarkSpec) -> BenchmarkResult:
+        cmd = [sys.executable, "-m", spec.module(), *spec.args]
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        t0 = time.perf_counter()
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=spec.timeout_s,
+                env=env,
+            )
+            status = "Succeeded" if proc.returncode == 0 else "Failed"
+            stdout = proc.stdout
+        except subprocess.TimeoutExpired as e:
+            status = "Timeout"
+            stdout = e.stdout or ""
+            if isinstance(stdout, bytes):  # TimeoutExpired ignores text=True
+                stdout = stdout.decode(errors="replace")
+        wall = time.perf_counter() - t0
+        metrics = []
+        for line in (stdout or "").splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    metrics.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+        return BenchmarkResult(spec.name, status, wall, metrics)
+
+
+class ClusterRunner:
+    """Submit a TpuJob and monitor it (the create + monitor pipeline steps)."""
+
+    def __init__(self, client: KubeClient, *,
+                 results_dir: Optional[str] = None,
+                 poll_interval_s: float = 5.0) -> None:
+        self.client = client
+        self.results_dir = results_dir
+        self.poll_interval_s = poll_interval_s
+
+    def run(self, spec: BenchmarkSpec) -> BenchmarkResult:
+        job = tpujob(spec.name, spec.namespace, {
+            "image": spec.image,
+            "command": ["python", "-m", spec.module(), *spec.args],
+            "slices": spec.slices,
+            "hostsPerSlice": spec.hosts_per_slice,
+            "accelerator": spec.accelerator,
+            "env": {"KFTPU_RESULTS_DIR": self.results_dir or ""},
+        })
+        self.client.apply(job)
+        t0 = time.perf_counter()
+        status = "Timeout"
+        while time.perf_counter() - t0 < spec.timeout_s:
+            cur = self.client.get_or_none(API_VERSION, TPUJOB_KIND,
+                                          spec.namespace, spec.name)
+            phase = (cur or {}).get("status", {}).get("phase", "")
+            if phase in ("Succeeded", "Failed"):
+                status = phase
+                break
+            time.sleep(self.poll_interval_s)
+        wall = time.perf_counter() - t0
+        metrics = self._collect_metrics(spec)
+        return BenchmarkResult(spec.name, status, wall, metrics)
+
+    def _collect_metrics(self, spec: BenchmarkSpec) -> List[Dict[str, Any]]:
+        if not self.results_dir:
+            return []
+        path = os.path.join(self.results_dir, f"{spec.name}.jsonl")
+        if not os.path.exists(path):
+            return []
+        out = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        out.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        pass
+        return out
+
+
+def report(result: BenchmarkResult, out_dir: str) -> Dict[str, str]:
+    """The reporter step: write ``<name>.csv`` + ``<name>.json`` (kubebench's
+    ``reporter csv`` equivalent, ``kubebench-job.libsonnet:59-62``)."""
+    os.makedirs(out_dir, exist_ok=True)
+    json_path = os.path.join(out_dir, f"{result.name}.json")
+    csv_path = os.path.join(out_dir, f"{result.name}.csv")
+    with open(json_path, "w") as f:
+        json.dump({
+            "name": result.name,
+            "status": result.status,
+            "wall_time_s": round(result.wall_time_s, 3),
+            "final_metrics": result.final_metrics,
+        }, f, indent=1)
+    keys: List[str] = []
+    for m in result.metrics:
+        for k in m:
+            if k not in keys:
+                keys.append(k)
+    with open(csv_path, "w", newline="") as f:
+        writer = csv.DictWriter(f, fieldnames=keys)
+        writer.writeheader()
+        for m in result.metrics:
+            writer.writerow(m)
+    return {"json": json_path, "csv": csv_path}

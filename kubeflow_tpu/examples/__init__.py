@@ -1,0 +1,1 @@
+"""In-framework example workloads (the tf-cnn / examples-prototypes parity)."""

@@ -1,0 +1,76 @@
+"""Launcher scaffolding for in-cluster training workloads.
+
+The reference's workloads bootstrap through ``launcher.py``: parse TF_CONFIG
+into PS flags, exec the benchmark, emit JSON-ish logs
+(``/root/reference/tf-controller-examples/tf-cnn/launcher.py:61-93``). Here
+the scaffolding is: parse the operator's env contract, bring up
+``jax.distributed``, build the mesh, and log structured JSON lines the
+metrics collector can scrape.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+from typing import Any, Dict, Optional
+
+import jax
+
+from kubeflow_tpu.parallel import MeshConfig, ProcessEnv, create_mesh
+from kubeflow_tpu.parallel import distributed as dist
+
+
+def setup_logging() -> None:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(levelname)s|%(asctime)s|%(pathname)s|%(lineno)d| %(message)s",
+        datefmt="%Y-%m-%dT%H:%M:%S",
+        stream=sys.stderr,
+    )
+
+
+def log_metrics(step: int, **metrics: Any) -> None:
+    """One JSON line per step on stdout — the scrape contract for the
+    benchmark reporter and the tuning metrics collector. When the operator
+    injects ``KFTPU_RESULTS_DIR`` (the kubebench experiment-PVC equivalent),
+    the same line is appended to ``<dir>/<job-name>.jsonl`` for the
+    ClusterRunner's collect step."""
+    rec: Dict[str, Any] = {"step": step, "ts": round(time.time(), 3)}
+    for k, v in metrics.items():
+        rec[k] = float(v) if hasattr(v, "__float__") else v
+    line = json.dumps(rec)
+    print(line, flush=True)
+    results_dir = os.environ.get("KFTPU_RESULTS_DIR")
+    if results_dir:
+        job = os.environ.get("KFTPU_JOB_NAME", "job")
+        try:
+            os.makedirs(results_dir, exist_ok=True)
+            with open(os.path.join(results_dir, f"{job}.jsonl"), "a") as f:
+                f.write(line + "\n")
+        except OSError:
+            logging.exception("cannot write results to %s", results_dir)
+
+
+def launcher_init(
+    *, pp: int = 1, tp: Optional[int] = None
+) -> tuple[ProcessEnv, "jax.sharding.Mesh"]:
+    """Distributed bootstrap + mesh over all visible devices."""
+    setup_logging()
+    penv = dist.initialize()
+    from kubeflow_tpu.parallel.mesh import auto_mesh_config
+
+    config = auto_mesh_config(jax.device_count(), pp=pp, tp=tp)
+    mesh = create_mesh(config)
+    logging.info(
+        "launcher up: rank %d/%d, %d devices, mesh dp=%d pp=%d tp=%d",
+        penv.process_id, penv.num_processes, jax.device_count(),
+        config.dp, config.pp, config.tp,
+    )
+    return penv, mesh
+
+
+def checkpoint_dir(default: str = "") -> str:
+    return os.environ.get("KFTPU_CHECKPOINT_DIR", default)

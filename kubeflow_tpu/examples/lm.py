@@ -1,0 +1,104 @@
+"""Transformer LM training job with checkpoint/resume — the flagship workload.
+
+The DDP-BERT-equivalent of BASELINE.md config 3, as SPMD pjit with optional
+tensor parallelism: ``python -m kubeflow_tpu.examples.lm --steps 100 --tp 2``.
+Resumes from ``KFTPU_CHECKPOINT_DIR`` automatically after a gang restart.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.examples.common import checkpoint_dir, launcher_init, log_metrics
+from kubeflow_tpu.models import Transformer, TransformerConfig
+from kubeflow_tpu.train import (
+    TrainState,
+    create_sharded_state,
+    make_lm_train_step,
+    make_optimizer,
+)
+from kubeflow_tpu.train.checkpoint import CheckpointManager
+
+
+def main(argv=None) -> float:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--per-device-batch", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=512)
+    p.add_argument("--vocab-size", type=int, default=32000)
+    p.add_argument("--d-model", type=int, default=768)
+    p.add_argument("--n-layers", type=int, default=12)
+    p.add_argument("--n-heads", type=int, default=12)
+    p.add_argument("--d-ff", type=int, default=3072)
+    p.add_argument("--n-experts", type=int, default=0)
+    p.add_argument("--tp", type=int, default=None)
+    p.add_argument("--learning-rate", type=float, default=3e-4)
+    p.add_argument("--checkpoint-every", type=int, default=50)
+    p.add_argument("--log-every", type=int, default=10)
+    args = p.parse_args(argv)
+
+    penv, mesh = launcher_init(tp=args.tp)
+    config = TransformerConfig(
+        vocab_size=args.vocab_size,
+        d_model=args.d_model,
+        n_layers=args.n_layers,
+        n_heads=args.n_heads,
+        n_kv_heads=args.n_heads,
+        d_ff=args.d_ff,
+        max_seq_len=args.seq_len,
+        n_experts=args.n_experts,
+    )
+    model = Transformer(config)
+    batch = args.per_device_batch * mesh.devices.shape[0]  # dp axis size
+    tx = make_optimizer(args.learning_rate, warmup_steps=20,
+                        decay_steps=args.steps + 1)
+    sample = jnp.zeros((batch, args.seq_len), jnp.int32)
+
+    def init_fn(rng):
+        params = model.init(rng, sample)["params"]
+        return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+
+    state, _ = create_sharded_state(init_fn, jax.random.key(0), mesh)
+
+    ckpt = None
+    start_step = 0
+    if checkpoint_dir():
+        ckpt = CheckpointManager(checkpoint_dir())
+        state, start_step = ckpt.restore_or_init(state)
+    if start_step >= args.steps:
+        # restarted after the final checkpoint: nothing left to train
+        log_metrics(start_step, done=True)
+        if ckpt:
+            ckpt.close()
+        return 0.0
+
+    step_fn = make_lm_train_step(mesh)
+    data_rng = jax.random.key(1234)
+    t0 = time.perf_counter()
+    tokens_done = 0
+    for step in range(start_step + 1, args.steps + 1):
+        rng = jax.random.fold_in(data_rng, step)
+        tokens = jax.random.randint(rng, (batch, args.seq_len), 0,
+                                    config.vocab_size)
+        state, metrics = step_fn(state, tokens)
+        tokens_done += batch * args.seq_len
+        if step % args.log_every == 0 or step == args.steps:
+            tps = tokens_done / (time.perf_counter() - t0)
+            log_metrics(step, loss=metrics["loss"],
+                        grad_norm=metrics["grad_norm"],
+                        tokens_per_sec=tps,
+                        tokens_per_sec_per_chip=tps / jax.device_count())
+        if ckpt and (step % args.checkpoint_every == 0 or step == args.steps):
+            ckpt.save(step, state)
+    if ckpt:
+        ckpt.wait()
+        ckpt.close()
+    return float(metrics["loss"])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,93 @@
+"""MNIST training job — the 1-worker correctness smoke (BASELINE.md config 1).
+
+Runs as a TpuJob workload: ``python -m kubeflow_tpu.examples.mnist``.
+Synthetic data by default (zero-egress clusters); real MNIST via
+``--data-dir`` pointing at pre-staged idx files.
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import os
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.examples.common import checkpoint_dir, launcher_init, log_metrics
+from kubeflow_tpu.models import MnistCnn
+from kubeflow_tpu.train import (
+    TrainState,
+    create_sharded_state,
+    make_image_train_step,
+    make_optimizer,
+)
+
+
+def load_mnist(data_dir: str) -> tuple[np.ndarray, np.ndarray]:
+    """Read pre-staged idx files (train-images-idx3-ubyte.gz etc.)."""
+    def read_idx(path):
+        with gzip.open(path, "rb") as f:
+            magic = struct.unpack(">I", f.read(4))[0]
+            ndim = magic & 0xFF
+            dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+            return np.frombuffer(f.read(), np.uint8).reshape(dims)
+
+    images = read_idx(os.path.join(data_dir, "train-images-idx3-ubyte.gz"))
+    labels = read_idx(os.path.join(data_dir, "train-labels-idx1-ubyte.gz"))
+    return images.astype(np.float32)[..., None] / 255.0, labels.astype(np.int32)
+
+
+def synthetic_mnist(n: int = 4096, seed: int = 0) -> tuple[np.ndarray, np.ndarray]:
+    """Class-conditional gaussian blobs: learnable, so loss/accuracy move."""
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, size=n).astype(np.int32)
+    protos = rng.randn(10, 28, 28, 1).astype(np.float32)
+    images = protos[labels] + 0.3 * rng.randn(n, 28, 28, 1).astype(np.float32)
+    return images, labels
+
+
+def main(argv=None) -> float:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--learning-rate", type=float, default=1e-3)
+    p.add_argument("--data-dir", default="")
+    p.add_argument("--log-every", type=int, default=10)
+    args = p.parse_args(argv)
+
+    penv, mesh = launcher_init()
+    images, labels = (load_mnist(args.data_dir) if args.data_dir
+                      else synthetic_mnist())
+
+    model = MnistCnn()
+    tx = make_optimizer(args.learning_rate, warmup_steps=10,
+                        decay_steps=args.steps)
+    sample = jnp.zeros((2, 28, 28, 1))
+
+    def init_fn(rng):
+        params = model.init(rng, sample)["params"]
+        return TrainState.create(
+            apply_fn=lambda v, x, train=True: model.apply(v, x),
+            params=params, tx=tx,
+        )
+
+    state, _ = create_sharded_state(init_fn, jax.random.key(0), mesh)
+    step_fn = make_image_train_step(mesh)
+
+    rng = np.random.RandomState(penv.process_id)
+    final_acc = 0.0
+    for step in range(1, args.steps + 1):
+        idx = rng.randint(0, len(images), size=args.batch_size)
+        state, metrics = step_fn(state, jnp.asarray(images[idx]),
+                                 jnp.asarray(labels[idx]))
+        if step % args.log_every == 0 or step == args.steps:
+            final_acc = float(metrics["accuracy"])
+            log_metrics(step, loss=metrics["loss"], accuracy=final_acc)
+    return final_acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""ResNet-50 training benchmark — the tf_cnn_benchmarks equivalent.
+
+The reference's headline TFJob runs tf_cnn_benchmarks ResNet-50 with
+synthetic data and reports images/sec (``/root/reference/kubeflow/examples/
+prototypes/tf-job-simple-v1.jsonnet:28-38``). Same contract here, as an SPMD
+pjit loop: ``python -m kubeflow_tpu.examples.resnet --steps 50``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.examples.common import launcher_init, log_metrics
+from kubeflow_tpu.models.resnet import resnet50
+from kubeflow_tpu.train import (
+    TrainState,
+    create_sharded_state,
+    make_image_train_step,
+    make_optimizer,
+)
+
+
+def main(argv=None) -> float:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--warmup-steps", type=int, default=3)
+    p.add_argument("--per-device-batch", type=int, default=128)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--log-every", type=int, default=10)
+    args = p.parse_args(argv)
+
+    penv, mesh = launcher_init()
+    batch = args.per_device_batch * jax.device_count()
+    model = resnet50(num_classes=args.num_classes)
+    tx = make_optimizer(0.1, warmup_steps=10, decay_steps=args.steps + 10)
+
+    images = jax.random.normal(
+        jax.random.key(0), (batch, args.image_size, args.image_size, 3),
+        jnp.bfloat16)
+    labels = jnp.zeros((batch,), jnp.int32)
+
+    def init_fn(rng):
+        variables = model.init(rng, images[:2], train=True)
+        return TrainState.create(
+            apply_fn=model.apply, params=variables["params"],
+            batch_stats=variables["batch_stats"], tx=tx,
+        )
+
+    state, _ = create_sharded_state(init_fn, jax.random.key(0), mesh)
+    step_fn = make_image_train_step(mesh)
+
+    metrics = None
+    for _ in range(args.warmup_steps):
+        state, metrics = step_fn(state, images, labels)
+    if metrics is not None:
+        float(metrics["loss"])  # force completion before the timed section
+
+    t0 = time.perf_counter()
+    for step in range(1, args.steps + 1):
+        state, metrics = step_fn(state, images, labels)
+        if step % args.log_every == 0 or step == args.steps:
+            float(metrics["loss"])
+            elapsed = time.perf_counter() - t0
+            ips = step * batch / elapsed
+            log_metrics(step, loss=metrics["loss"], images_per_sec=ips,
+                        images_per_sec_per_chip=ips / jax.device_count())
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+    ips = args.steps * batch / dt
+    log_metrics(args.steps, final=True, images_per_sec=ips,
+                images_per_sec_per_chip=ips / jax.device_count())
+    return ips
+
+
+if __name__ == "__main__":
+    main()

@@ -231,9 +231,11 @@ class Transformer(nn.Module):
         c = self.config
         c.validate()
         B, S = tokens.shape
+        # tied in/out embedding: d^-0.5 init keeps untrained logits O(1) so
+        # the initial loss sits near ln(vocab) instead of exploding
         embed = self.param(
             "token_embed",
-            nn.initializers.normal(stddev=1.0),
+            nn.initializers.normal(stddev=c.d_model ** -0.5),
             (c.vocab_size, c.d_model),
             c.param_dtype,
         )

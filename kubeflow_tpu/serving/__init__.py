@@ -1,0 +1,10 @@
+"""JAX model serving: versioned model store + REST server (tf-serving parity)."""
+
+from kubeflow_tpu.serving.model_store import (  # noqa: F401
+    LoadedModel,
+    export_model,
+    list_versions,
+    load_latest,
+    load_version,
+)
+from kubeflow_tpu.serving.server import ModelRepository, ModelServer  # noqa: F401

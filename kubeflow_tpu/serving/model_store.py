@@ -1,0 +1,123 @@
+"""Versioned model store: export/load for the serving server.
+
+TF-Serving parity layout (``/root/reference/kubeflow/tf-serving/
+tf-serving-template.libsonnet``: modelBasePath with numeric version
+subdirectories, newest served): ``<base>/<version>/`` holds ``model.yaml``
+(architecture + config) and ``params.npz`` (flattened param leaves). The
+store is format-native to the framework's own models — the tf-serving
+SavedModel role without protobufs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import yaml
+
+MODEL_FILE = "model.yaml"
+PARAMS_FILE = "params.npz"
+
+
+def _flatten(params: Any) -> Dict[str, np.ndarray]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        key = "/".join(
+            str(getattr(p, "key", getattr(p, "name", getattr(p, "idx", p))))
+            for p in path
+        )
+        flat[key] = np.asarray(leaf)
+    return flat
+
+
+def _unflatten(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for key, val in flat.items():
+        parts = key.split("/")
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = jnp.asarray(val)
+    return out
+
+
+def build_model(kind: str, config: Dict[str, Any]):
+    """Instantiate a servable model by kind name."""
+    if kind == "mnist":
+        from kubeflow_tpu.models import MnistCnn
+
+        return MnistCnn(), lambda m, p, x: m.apply({"params": p}, x)
+    if kind == "resnet":
+        from kubeflow_tpu.models.resnet import ResNet, ResNetConfig
+
+        cfg = ResNetConfig(**{**config, "stage_sizes":
+                              tuple(config.get("stage_sizes", (3, 4, 6, 3)))})
+        return ResNet(cfg), lambda m, p, x: m.apply(
+            {"params": p["params"], "batch_stats": p["batch_stats"]},
+            x, train=False)
+    if kind == "transformer":
+        from kubeflow_tpu.models import Transformer, TransformerConfig
+
+        cfg = TransformerConfig(**config)
+        return Transformer(cfg), lambda m, p, x: m.apply({"params": p}, x)
+    raise ValueError(f"unknown model kind {kind!r}")
+
+
+def export_model(
+    path: str,
+    kind: str,
+    params: Any,
+    *,
+    config: Optional[Dict[str, Any]] = None,
+    version: int = 1,
+) -> str:
+    """Write ``<path>/<version>/{model.yaml,params.npz}``; returns the dir."""
+    vdir = os.path.join(path, str(version))
+    os.makedirs(vdir, exist_ok=True)
+    with open(os.path.join(vdir, MODEL_FILE), "w") as f:
+        yaml.safe_dump({"kind": kind, "config": config or {}}, f)
+    np.savez(os.path.join(vdir, PARAMS_FILE), **_flatten(params))
+    return vdir
+
+
+@dataclasses.dataclass
+class LoadedModel:
+    kind: str
+    version: int
+    predict: Callable[[jnp.ndarray], jnp.ndarray]  # jitted, closed over params
+
+
+def list_versions(base_path: str) -> List[int]:
+    if not os.path.isdir(base_path):
+        return []
+    return sorted(
+        int(d) for d in os.listdir(base_path)
+        if d.isdigit() and os.path.isfile(os.path.join(base_path, d, MODEL_FILE))
+    )
+
+
+def load_version(base_path: str, version: int) -> LoadedModel:
+    vdir = os.path.join(base_path, str(version))
+    with open(os.path.join(vdir, MODEL_FILE)) as f:
+        meta = yaml.safe_load(f)
+    kind = meta["kind"]
+    with np.load(os.path.join(vdir, PARAMS_FILE)) as npz:
+        params = _unflatten({k: npz[k] for k in npz.files})
+    model, apply_fn = build_model(kind, meta.get("config", {}) or {})
+
+    @jax.jit
+    def predict(x: jnp.ndarray) -> jnp.ndarray:
+        return apply_fn(model, params, x)
+
+    return LoadedModel(kind=kind, version=version, predict=predict)
+
+
+def load_latest(base_path: str) -> Optional[LoadedModel]:
+    versions = list_versions(base_path)
+    if not versions:
+        return None
+    return load_version(base_path, versions[-1])

@@ -1,0 +1,283 @@
+"""JAX model server: REST predict API with tf-serving-parity surface.
+
+Replaces TF-Serving / TensorRT-IS (reference surface: gRPC :9000 + REST
+:8500, ``tf-serving-template.libsonnet:33-48``; JSON→gRPC bridge
+``components/k8s-model-server/http-proxy/server.py``). Endpoints:
+
+- ``GET /v1/models``                       list models + versions
+- ``GET /v1/models/<name>``                per-model version status
+- ``POST /v1/models/<name>:predict``       ``{"instances": [...]}``
+- ``POST /v1/models/<name>/versions/<v>:predict``  pin a version
+- ``GET /metrics`` / ``GET /healthz``
+
+TPU-minded serving details: inputs are padded to fixed batch shapes so XLA
+never recompiles per request; version hot-reload polls the base path the way
+TF-Serving watches its model dir.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.serving.model_store import (
+    LoadedModel,
+    list_versions,
+    load_version,
+)
+from kubeflow_tpu.utils import DEFAULT_REGISTRY
+
+log = logging.getLogger(__name__)
+
+_requests = DEFAULT_REGISTRY.counter(
+    "kftpu_serving_requests_total", "predict requests")
+_latency = DEFAULT_REGISTRY.gauge(
+    "kftpu_serving_last_latency_seconds", "last predict latency")
+
+_PAD_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
+
+
+def _pad_batch(arr: np.ndarray, max_batch: int) -> Tuple[np.ndarray, int]:
+    """Pad the leading dim up to a fixed bucket to keep XLA shapes stable."""
+    n = arr.shape[0]
+    bucket = next((b for b in _PAD_BUCKETS if b >= n and b <= max_batch),
+                  max_batch)
+    if n == bucket:
+        return arr, n
+    pad = np.zeros((bucket - n,) + arr.shape[1:], arr.dtype)
+    return np.concatenate([arr, pad], axis=0), n
+
+
+class ModelRepository:
+    """Models under ``<base>/<model_name>/<version>/`` with hot reload."""
+
+    def __init__(self, base_path: str, *, poll_interval_s: float = 10.0) -> None:
+        self.base_path = base_path
+        self.poll_interval_s = poll_interval_s
+        self._models: Dict[str, LoadedModel] = {}
+        self._pinned: Dict[Tuple[str, int], LoadedModel] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.refresh()
+
+    def model_names(self) -> list:
+        if not os.path.isdir(self.base_path):
+            return []
+        return sorted(
+            d for d in os.listdir(self.base_path)
+            if os.path.isdir(os.path.join(self.base_path, d)) and
+            list_versions(os.path.join(self.base_path, d))
+        )
+
+    def refresh(self) -> None:
+        for name in self.model_names():
+            mdir = os.path.join(self.base_path, name)
+            versions = list_versions(mdir)
+            if not versions:
+                continue
+            latest = versions[-1]
+            with self._lock:
+                current = self._models.get(name)
+                if current is None or current.version != latest:
+                    log.info("loading model %s version %d", name, latest)
+                    self._models[name] = load_version(mdir, latest)
+
+    def get(self, name: str, version: Optional[int] = None) -> Optional[LoadedModel]:
+        with self._lock:
+            model = self._models.get(name)
+        if model is None:
+            return None
+        if version is not None and model.version != version:
+            with self._lock:
+                cached = self._pinned.get((name, version))
+            if cached is not None:
+                return cached
+            mdir = os.path.join(self.base_path, name)
+            if version in list_versions(mdir):
+                loaded = load_version(mdir, version)
+                with self._lock:
+                    self._pinned[(name, version)] = loaded
+                return loaded
+            return None
+        return model
+
+    def status(self, name: str) -> Optional[Dict[str, Any]]:
+        mdir = os.path.join(self.base_path, name)
+        versions = list_versions(mdir)
+        if not versions:
+            return None
+        with self._lock:
+            served = self._models.get(name)
+        return {
+            "model_version_status": [
+                {"version": str(v),
+                 "state": "AVAILABLE" if served and served.version == v
+                 else "END_OF_LIFE"}
+                for v in versions
+            ]
+        }
+
+    def start_polling(self) -> None:
+        def loop():
+            while not self._stop.wait(self.poll_interval_s):
+                try:
+                    self.refresh()
+                except Exception:  # noqa: BLE001
+                    log.exception("model refresh failed")
+
+        threading.Thread(target=loop, daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class ModelServer:
+    def __init__(self, base_path: str, *, port: int = 8500,
+                 max_batch_size: int = 8, poll_interval_s: float = 10.0) -> None:
+        self.repo = ModelRepository(base_path, poll_interval_s=poll_interval_s)
+        self.port = port
+        self.max_batch_size = max_batch_size
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    # -- request handling --------------------------------------------------
+
+    def handle_predict(self, name: str, version: Optional[int],
+                       body: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+        model = self.repo.get(name, version)
+        if model is None:
+            return 404, {"error": f"model {name!r}"
+                         f"{f' version {version}' if version else ''} not found"}
+        instances = body.get("instances")
+        if instances is None:
+            return 400, {"error": "request body must contain 'instances'"}
+        try:
+            arr = np.asarray(instances)
+            if arr.ndim == 0 or arr.dtype == object:
+                raise ValueError("instances must be a non-empty array")
+            if arr.dtype == np.float64:
+                arr = arr.astype(np.float32)
+        except Exception as e:  # noqa: BLE001
+            return 400, {"error": f"bad instances: {e}"}
+        if arr.shape[0] > self.max_batch_size:
+            return 400, {"error": f"batch {arr.shape[0]} exceeds max "
+                                  f"{self.max_batch_size}"}
+        t0 = time.perf_counter()
+        padded, n = _pad_batch(arr, self.max_batch_size)
+        try:
+            out = np.asarray(model.predict(jnp.asarray(padded)))[:n]
+        except Exception as e:  # noqa: BLE001
+            return 400, {"error": f"predict failed: {type(e).__name__}: {e}"}
+        dt = time.perf_counter() - t0
+        _requests.inc(model=name)
+        _latency.set(dt, model=name)
+        return 200, {"predictions": out.tolist(),
+                     "model_version": str(model.version)}
+
+    # -- HTTP plumbing -----------------------------------------------------
+
+    def _make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code: int, payload: Dict[str, Any]) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                path = self.path.rstrip("/")
+                if path == "/healthz":
+                    self._send(200, {"status": "ok"})
+                elif path == "/metrics":
+                    body = DEFAULT_REGISTRY.expose().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path == "/v1/models":
+                    self._send(200, {"models": server.repo.model_names()})
+                elif path.startswith("/v1/models/"):
+                    name = path[len("/v1/models/"):]
+                    status = server.repo.status(name)
+                    if status is None:
+                        self._send(404, {"error": f"model {name!r} not found"})
+                    else:
+                        self._send(200, status)
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def do_POST(self):  # noqa: N802
+                length = int(self.headers.get("Content-Length", "0"))
+                try:
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                except json.JSONDecodeError:
+                    self._send(400, {"error": "invalid JSON"})
+                    return
+                path = self.path
+                if path.endswith(":predict") and path.startswith("/v1/models/"):
+                    target = path[len("/v1/models/"):-len(":predict")]
+                    version: Optional[int] = None
+                    if "/versions/" in target:
+                        name, _, v = target.partition("/versions/")
+                        if not v.isdigit():
+                            self._send(400, {"error": f"bad version {v!r}"})
+                            return
+                        version = int(v)
+                    else:
+                        name = target
+                    code, payload = server.handle_predict(name, version, body)
+                    self._send(code, payload)
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def log_message(self, *a):
+                pass
+
+        return Handler
+
+    def start(self) -> int:
+        """Start serving on a daemon thread; returns the bound port."""
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port),
+                                          self._make_handler())
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        self.repo.start_polling()
+        log.info("model server on :%d (base_path=%s)", self.port,
+                 self.repo.base_path)
+        return self.port
+
+    def stop(self) -> None:
+        self.repo.stop()
+        if self._httpd:
+            self._httpd.shutdown()
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    base = os.environ.get("KFTPU_MODEL_BASE_PATH", "/models")
+    port = int(os.environ.get("KFTPU_REST_PORT", "8500"))
+    max_batch = int(os.environ.get("KFTPU_MAX_BATCH_SIZE", "8"))
+    server = ModelServer(base, port=port, max_batch_size=max_batch)
+    server.start()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

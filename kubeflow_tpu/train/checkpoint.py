@@ -1,0 +1,69 @@
+"""In-framework checkpoint/resume (SURVEY.md §5 gap).
+
+The reference delegates checkpointing entirely to workloads — its platform
+contribution is storage plumbing (PVCs, GCS/S3 creds injection; see
+``mpi-job.libsonnet:64-82``, ``controller.py:104-116``). On TPU that is not
+enough: a worker failure kills the whole SPMD gang and restart lands on a
+fresh slice (SURVEY.md §7 hard part (b)), so resumable state must be a
+framework primitive. Orbax handles the multi-host coordination; this module
+pins the policy: step-numbered directories, keep-N retention, resume-latest.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+import jax
+
+log = logging.getLogger(__name__)
+
+
+class CheckpointManager:
+    """Save/restore sharded TrainStates under ``<dir>/<step>/``."""
+
+    def __init__(self, directory: str, *, keep: int = 3) -> None:
+        import orbax.checkpoint as ocp
+
+        self.directory = directory
+        self._ocp = ocp
+        self._mgr = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep, create=True, enable_async_checkpointing=True,
+            ),
+        )
+
+    def save(self, step: int, state: Any, *, wait: bool = False) -> None:
+        """Async save; set ``wait`` to block (end of training / tests)."""
+        self._mgr.save(step, args=self._ocp.args.StandardSave(state))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, state: Any, step: Optional[int] = None) -> Any:
+        """Restore into the sharding/structure of ``state`` (abstract ok)."""
+        step = self._mgr.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        return self._mgr.restore(step, args=self._ocp.args.StandardRestore(state))
+
+    def restore_or_init(self, state: Any) -> tuple[Any, int]:
+        """Resume from the latest checkpoint, else keep the fresh state.
+
+        Returns (state, start_step). This is the restart path after a gang
+        re-placement: same code runs on first start and every resume.
+        """
+        step = self.latest_step()
+        if step is None:
+            return state, 0
+        log.info("resuming from %s step %d", self.directory, step)
+        return self.restore(state, step), step
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
