@@ -51,11 +51,19 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     n_experts: int = 0            # 0 => dense MLP
     experts_per_token: int = 2
+    moe_capacity_factor: float = 0.0  # 0 => exact dense dispatch; >0 => GShard
+    # capacity dispatch via kubeflow_tpu.ops.moe (the large-E fast path)
     dtype: Any = jnp.bfloat16     # activation/compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = True
     scan_layers: bool = True
     logits_softcap: float = 0.0
+    # attention core: "dense" O(S²) (XLA-fused, fine to moderate S),
+    # "blockwise" O(S·block) scan, "flash" Pallas kernel, "ring"
+    # sequence-parallel ring attention over the seq mesh axis (long context)
+    attention_impl: str = "dense"
+    attention_block_k: int = 512
+    seq_axis: str = "tp"          # mesh axis ring attention shards sequence over
     rules: AxisRules = DEFAULT_RULES  # logical-axis -> mesh-axis sharding rules
 
     @property
@@ -68,6 +76,8 @@ class TransformerConfig:
             raise ValueError("n_heads must be a multiple of n_kv_heads")
         if self.n_experts and self.experts_per_token > self.n_experts:
             raise ValueError("experts_per_token > n_experts")
+        if self.attention_impl not in ("dense", "blockwise", "flash", "ring"):
+            raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
 
 
 def _constrain(x, rules: AxisRules, *names):
@@ -126,7 +136,12 @@ class Attention(nn.Module):
         q = jnp.einsum("bsd,dhk->bshk", x, wq.astype(c.dtype))
         k = jnp.einsum("bsd,dhk->bshk", x, wk.astype(c.dtype))
         v = jnp.einsum("bsd,dhk->bshk", x, wv.astype(c.dtype))
-        q = _constrain(q, c.rules, "batch", None, "heads", None)
+        if c.attention_impl == "ring":
+            # sequence stays sharded through attention (ring path); heads
+            # replicate — the inverse of the tensor-parallel dense layout
+            q = _constrain(q, c.rules, "batch", "seq", None, None)
+        else:
+            q = _constrain(q, c.rules, "batch", None, "heads", None)
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
 
@@ -135,14 +150,57 @@ class Attention(nn.Module):
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
 
-        scale = Dh ** -0.5
-        logits = jnp.einsum("bshk,bthk->bhst", q, k) * scale
-        mask = jnp.tril(jnp.ones((S, S), dtype=bool))
-        logits = jnp.where(mask[None, None], logits, jnp.finfo(logits.dtype).min)
-        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(c.dtype)
-        out = jnp.einsum("bhst,bthk->bshk", probs, v)
+        out = self._attend(q, k, v)
         out = jnp.einsum("bshk,hkd->bsd", out, wo.astype(c.dtype))
         return _constrain(out, c.rules, "batch", "seq", None)
+
+    def _attend(self, q, k, v):
+        """Dispatch to the configured attention core; causal always."""
+        c = self.config
+        from kubeflow_tpu.ops import attention as att  # local: no cycle
+
+        if c.attention_impl == "dense":
+            return att.reference_attention(q, k, v, causal=True)
+        if c.attention_impl == "blockwise":
+            return att.blockwise_attention(
+                q, k, v, causal=True, block_k=c.attention_block_k
+            )
+        if c.attention_impl == "flash":
+            # largest divisor of S within the block budget (flash requires
+            # block | seq); degenerate divisors fall back to blockwise
+            S = q.shape[1]
+            block = next(
+                (b for b in range(min(c.attention_block_k, S), 0, -1)
+                 if S % b == 0),
+                1,
+            )
+            if block < 16:
+                return att.blockwise_attention(
+                    q, k, v, causal=True, block_k=c.attention_block_k
+                )
+            return att.flash_attention(q, k, v, True, block, block)
+        # ring: sequence-parallel over the seq mesh axis; partial-manual
+        # shard_map (batch/other axes stay auto) on the current mesh
+        mesh = jax.sharding.get_abstract_mesh()
+        if mesh.empty or c.seq_axis not in mesh.axis_names:
+            return att.blockwise_attention(
+                q, k, v, causal=True, block_k=c.attention_block_k
+            )
+        import functools
+
+        from jax.sharding import PartitionSpec as P
+
+        spec = P(None, c.seq_axis, None, None)
+        fn = jax.shard_map(
+            functools.partial(
+                att.ring_attention, axis_name=c.seq_axis, causal=True
+            ),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            axis_names={c.seq_axis},
+        )
+        return fn(q, k, v)
 
 
 class Mlp(nn.Module):
@@ -177,6 +235,32 @@ class MoeMlp(nn.Module):
         w_down = self.param("down_proj", init, (E, F, D), c.param_dtype)
 
         gate_logits = x.astype(jnp.float32) @ w_router  # (B, S, E)
+
+        if c.moe_capacity_factor > 0:
+            # GShard capacity dispatch: experts run once over (E, C, D)
+            # buffers; with "expert"-sharded weights XLA inserts the
+            # AllToAll over the ep group (kubeflow_tpu/ops/moe.py)
+            from kubeflow_tpu.ops.moe import capacity_moe  # local: no cycle
+
+            B, S, _ = x.shape
+
+            def expert_fn(xe):  # (E, C, D) -> (E, C, D)
+                h = jnp.einsum("ecd,edf->ecf", xe, w_gate.astype(xe.dtype))
+                u = jnp.einsum("ecd,edf->ecf", xe, w_up.astype(xe.dtype))
+                h = jax.nn.silu(h) * u
+                h = _constrain(h, c.rules, "expert", None, "expert_mlp")
+                return jnp.einsum("ecf,efd->ecd", h, w_down.astype(xe.dtype))
+
+            y, aux = capacity_moe(
+                x.reshape(B * S, D),
+                gate_logits.reshape(B * S, E),
+                expert_fn,
+                k=K,
+                capacity_factor=c.moe_capacity_factor,
+            )
+            self.sow("losses", "moe_aux", aux)
+            return _constrain(y.reshape(B, S, D), c.rules, "batch", "seq", None)
+
         weights, idx = jax.lax.top_k(gate_logits, K)
         weights = jax.nn.softmax(weights, axis=-1)      # (B, S, K)
         # combine[b, s, e] = sum_k weights[b,s,k] * [idx[b,s,k] == e]

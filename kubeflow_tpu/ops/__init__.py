@@ -1,0 +1,24 @@
+"""TPU compute ops: attention kernels, collectives, MoE dispatch."""
+
+from kubeflow_tpu.ops.attention import (  # noqa: F401
+    blockwise_attention,
+    flash_attention,
+    reference_attention,
+    ring_attention,
+    ring_attention_sharded,
+)
+from kubeflow_tpu.ops.collectives import (  # noqa: F401
+    CollectiveResult,
+    all_gather,
+    all_reduce,
+    all_to_all,
+    bench_all,
+    bench_collective,
+    ppermute_shift,
+    reduce_scatter,
+)
+from kubeflow_tpu.ops.moe import (  # noqa: F401
+    capacity_dispatch,
+    capacity_moe,
+    expert_capacity,
+)

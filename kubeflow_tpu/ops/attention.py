@@ -1,0 +1,321 @@
+"""Attention ops: blockwise, Pallas flash kernel, and ring attention.
+
+Long-context sequence/context parallelism is entirely absent from the
+reference platform (SURVEY.md §5: "no ring attention, no context/sequence
+parallel, no blockwise attention") — it never sees model internals. Here
+they are framework ops:
+
+- :func:`blockwise_attention` — online-softmax attention scanned over KV
+  blocks: O(S) memory, differentiable, XLA-fusable. The inner compute for
+  ring attention and the portable fallback everywhere.
+- :func:`flash_attention` — Pallas TPU kernel for the forward pass (VMEM
+  block tiles, MXU matmuls, f32 accumulators) with a recompute-based custom
+  VJP so training still works; ``interpret=True`` runs the same kernel on
+  CPU in tests.
+- :func:`ring_attention` — sequence-parallel attention over a mesh axis:
+  each device holds a sequence shard of Q/K/V and KV shards rotate around
+  the ring via ``ppermute`` (one ICI hop per step when the axis is laid out
+  on ICI neighbours — the scheduler's placement contract,
+  ``kubeflow_tpu/scheduler/placement.py``), accumulating exactly as
+  blockwise attention does. Causality is enforced from global block offsets.
+
+All functions take ``(B, S, H, D)`` q/k/v (GQA repeat happens in the model)
+and return ``(B, S, H, D)``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _scale(q, sm_scale: Optional[float]) -> float:
+    return sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+
+
+def reference_attention(q, k, v, *, causal: bool = True,
+                        sm_scale: Optional[float] = None):
+    """Plain O(S²)-memory attention; the numerics oracle for the others."""
+    scale = _scale(q, sm_scale)
+    logits = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) * scale
+    if causal:
+        S, T = q.shape[1], k.shape[1]
+        mask = jnp.arange(T)[None, :] <= jnp.arange(S)[:, None] + (T - S)
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhst,bthd->bshd", probs, v)
+
+
+# ---------------------------------------------------------------------------
+# Blockwise attention: online softmax over KV blocks
+# ---------------------------------------------------------------------------
+
+
+def _block_update(carry, kv_block, q, q_pos, kv_pos, scale, causal):
+    """One online-softmax accumulation step over a KV block.
+
+    carry: (o, l, m) f32 accumulators — o (B,Sq,H,D), l,m (B,Sq,H).
+    kv_pos/q_pos: global position vectors for masking; negative kv_pos marks
+    padding (excluded causal or not).
+    """
+    o, l, m = carry
+    k, v = kv_block
+    logits = jnp.einsum("bshd,bthd->bsht", q, k).astype(jnp.float32) * scale
+    valid = kv_pos[None, :] >= 0
+    if causal:
+        valid = valid & (kv_pos[None, :] <= q_pos[:, None])  # (Sq, Skv)
+    logits = jnp.where(valid[None, :, None, :], logits, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+    p = jnp.exp(logits - m_new[..., None])
+    alpha = jnp.exp(m - m_new)
+    l = l * alpha + jnp.sum(p, axis=-1)
+    o = o * alpha[..., None] + jnp.einsum(
+        "bsht,bthd->bshd", p.astype(v.dtype), v
+    ).astype(jnp.float32)
+    return (o, l, m_new)
+
+
+def blockwise_attention(q, k, v, *, causal: bool = True, block_k: int = 512,
+                        sm_scale: Optional[float] = None):
+    """Memory-efficient attention: ``lax.scan`` over KV blocks.
+
+    Never materializes the (S, S) score matrix — peak activation memory is
+    O(S · block_k). Fully differentiable (the scan transposes); XLA keeps
+    the per-block einsums on the MXU.
+    """
+    B, Sq, H, D = q.shape
+    T = k.shape[1]
+    block_k = min(block_k, T)
+    n_blocks = -(-T // block_k)
+    pad = n_blocks * block_k - T
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    scale = _scale(q, sm_scale)
+    q_pos = jnp.arange(Sq) + (T - Sq)  # align ends when Sq != T (decoding)
+
+    ks = k.reshape(B, n_blocks, block_k, H, D).transpose(1, 0, 2, 3, 4)
+    vs = v.reshape(B, n_blocks, block_k, H, D).transpose(1, 0, 2, 3, 4)
+
+    def body(carry, blk):
+        kb, vb, j = blk
+        kv_pos = j * block_k + jnp.arange(block_k)
+        kv_pos = jnp.where(kv_pos < T, kv_pos, -1)  # pad := masked out
+        return (
+            _block_update(carry, (kb, vb), q, q_pos, kv_pos, scale, causal),
+            None,
+        )
+
+    init = (
+        jnp.zeros((B, Sq, H, D), jnp.float32),
+        jnp.zeros((B, Sq, H), jnp.float32),
+        jnp.full((B, Sq, H), NEG_INF, jnp.float32),
+    )
+    (o, l, _), _ = jax.lax.scan(body, init, (ks, vs, jnp.arange(n_blocks)))
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash-attention forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
+                      causal: bool, seq_len: int):
+    """One (batch·head, q-block) program: stream KV blocks through VMEM.
+
+    Refs arrive as (1, block_q, D) / (1, S, D) tiles for one fused
+    batch-head; the f32 (m, l, acc) online-softmax state lives in
+    registers/VMEM locals.
+    """
+    import jax.experimental.pallas as pl  # deferred: test envs without pallas
+
+    i = pl.program_id(1)  # q-block index
+    _, block_q, D = q_ref.shape
+    q = q_ref[0].astype(jnp.float32) * scale
+    q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+
+    n_kv = seq_len // block_k
+    # causal: later KV blocks contribute nothing to this q block
+    hi = n_kv if not causal else (i * block_q + block_q + block_k - 1) // block_k
+
+    def body(j, carry):
+        o, l, m = carry
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, block_k)
+        if causal:
+            kv_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1
+            )
+            s = jnp.where(kv_pos <= q_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        o = o * alpha + jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return (o, l, m_new)
+
+    init = (
+        jnp.zeros((block_q, D), jnp.float32),
+        jnp.zeros((block_q, 1), jnp.float32),
+        jnp.full((block_q, 1), NEG_INF, jnp.float32),
+    )
+    o, l, _ = jax.lax.fori_loop(0, hi, body, init)
+    o_ref[0] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, *, causal: bool, block_q: int, block_k: int,
+               sm_scale: Optional[float], interpret: bool):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, S, H, D = q.shape
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    if S % block_q or S % block_k:
+        raise ValueError(f"seq_len {S} must divide by blocks {block_q}/{block_k}")
+    scale = _scale(q, sm_scale)
+
+    # fuse batch and heads into the grid's first axis; blocks over q second
+    qf = q.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+    kernel = functools.partial(
+        _flash_fwd_kernel, block_k=block_k, scale=scale, causal=causal,
+        seq_len=S,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, S // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 256,
+                    block_k: int = 256, sm_scale: Optional[float] = None,
+                    interpret: Optional[bool] = None):
+    """Pallas flash attention (forward kernel, recompute VJP).
+
+    ``interpret=None`` auto-selects: compiled on TPU, interpreter elsewhere
+    (so CPU tests execute the real kernel).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_fwd(q, k, v, causal=causal, block_q=block_q,
+                      block_k=block_k, sm_scale=sm_scale, interpret=interpret)
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, sm_scale, interpret):
+    out = flash_attention(q, k, v, causal, block_q, block_k, sm_scale,
+                          interpret)
+    return out, (q, k, v)
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, sm_scale, interpret, res, g):
+    q, k, v = res
+    # flash-style backward = recompute through the blockwise formulation;
+    # same O(S·block) memory, and XLA fuses the recompute into the bwd dots
+    _, vjp = jax.vjp(
+        lambda q, k, v: blockwise_attention(
+            q, k, v, causal=causal, block_k=block_k, sm_scale=sm_scale
+        ),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Ring attention: sequence-parallel over a mesh axis
+# ---------------------------------------------------------------------------
+
+
+def ring_attention(q, k, v, *, axis_name: str, causal: bool = True,
+                   sm_scale: Optional[float] = None, block_k: int = 512):
+    """Sequence-parallel attention inside ``shard_map``: rotate KV via ppermute.
+
+    Call within a ``shard_map`` region whose ``axis_name`` shards the
+    sequence dim of q/k/v. Device i holds query block i; KV blocks rotate
+    one ring hop per step so after n steps every query block has seen every
+    KV block. Per-step masking uses global block offsets, so causality holds
+    exactly; blocks strictly ahead of a query block contribute nothing (they
+    are masked; a skip-ahead schedule is a later optimization).
+
+    Gradients flow through ``lax.scan`` + ``ppermute`` (both differentiable),
+    so the same code path trains.
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, Sq, H, D = q.shape
+    scale = _scale(q, sm_scale)
+    q_pos = idx * Sq + jnp.arange(Sq)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def body(carry, step):
+        o, l, m, k_cur, v_cur = carry
+        src = (idx - step) % n  # who this KV block belongs to globally
+        kv_pos = src * Sq + jnp.arange(k_cur.shape[1])
+        o, l, m = _block_update(
+            (o, l, m), (k_cur, v_cur), q, q_pos, kv_pos, scale, causal
+        )
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (o, l, m, k_nxt, v_nxt), None
+
+    # derive accumulators from q so they carry its varying-axes type (the
+    # shard_map vma checker rejects unvarying zeros as a scan carry)
+    o0 = q.astype(jnp.float32) * 0.0
+    l0 = o0[..., 0]
+    init = (o0, l0, l0 + NEG_INF, k, v)
+    (o, l, _, _, _), _ = jax.lax.scan(body, init, jnp.arange(n))
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh, *, seq_axis: str = "tp",
+                           batch_axis: str = "dp", causal: bool = True,
+                           sm_scale: Optional[float] = None):
+    """``shard_map`` wrapper: full (B, S, H, D) arrays in, ring attention on
+    sequence shards over ``seq_axis``. Usable directly under jit."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(batch_axis, seq_axis, None, None)
+    fn = jax.shard_map(
+        functools.partial(
+            ring_attention, axis_name=seq_axis, causal=causal,
+            sm_scale=sm_scale,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

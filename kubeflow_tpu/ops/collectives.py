@@ -1,0 +1,153 @@
+"""Collective wrappers + microbenchmarks over mesh axes.
+
+The reference's collective layer is external NCCL/gloo/gRPC wired by env
+protocols (SURVEY.md §2d); its benchmark story for allreduce is the Horovod
+image inside MPIJob (``/root/reference/kubeflow/mpi-job/``). Here collectives
+are XLA primitives over ICI, and this module gives them a typed surface +
+the bus-bandwidth-style microbenchmark BASELINE.md config 4 asks for.
+
+All wrappers take the *full* (unsharded view) array and a mesh; ``shard_map``
+partitions over the named axis so the collective pattern is explicit and
+XLA lowers it onto the ICI ring of that axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Callable, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@functools.lru_cache(maxsize=None)
+def _mapped(op_key: str, mesh: Mesh, axis: str, shift: int = 0):
+    """Build (once per op/mesh/axis) the jitted shard_map collective.
+
+    Cached so repeated calls — the benchmark loop in particular — reuse one
+    traced executable instead of recompiling per invocation. check_vma off:
+    gather/permute outputs are replicated or shifted in ways the static
+    varying-axes inference can't always prove.
+    """
+    if op_key == "all_reduce":
+        op = functools.partial(jax.lax.psum, axis_name=axis)
+        in_spec, out_spec = P(axis), P()
+    elif op_key == "all_gather":
+        op = functools.partial(jax.lax.all_gather, axis_name=axis, tiled=True)
+        in_spec, out_spec = P(axis), P()
+    elif op_key == "reduce_scatter":
+        op = functools.partial(jax.lax.psum_scatter, axis_name=axis, tiled=True)
+        in_spec, out_spec = P(None, axis), P(axis)
+    elif op_key == "all_to_all":
+        op = functools.partial(
+            jax.lax.all_to_all, axis_name=axis, split_axis=1, concat_axis=0,
+            tiled=True,
+        )
+        in_spec, out_spec = P(axis), P(None, axis)
+    elif op_key == "ppermute":
+        n = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+        perm = [(j, (j + shift) % n) for j in range(n)]
+        op = functools.partial(jax.lax.ppermute, axis_name=axis, perm=perm)
+        in_spec, out_spec = P(axis), P(axis)
+    else:
+        raise ValueError(f"unknown collective {op_key!r}")
+    return jax.jit(
+        jax.shard_map(
+            op, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec,
+            check_vma=False,
+        )
+    )
+
+
+def all_reduce(x, mesh: Mesh, axis: str = "dp"):
+    """Sum over the axis; every shard returns the reduced value (replicated
+    along that axis in the result)."""
+    return _mapped("all_reduce", mesh, axis)(x)
+
+
+def all_gather(x, mesh: Mesh, axis: str = "dp"):
+    return _mapped("all_gather", mesh, axis)(x)
+
+
+def reduce_scatter(x, mesh: Mesh, axis: str = "dp"):
+    return _mapped("reduce_scatter", mesh, axis)(x)
+
+
+def all_to_all(x, mesh: Mesh, axis: str = "dp"):
+    """Transpose shard axis 0 against dim 1 (the MoE dispatch pattern)."""
+    return _mapped("all_to_all", mesh, axis)(x)
+
+
+def ppermute_shift(x, mesh: Mesh, axis: str = "dp", shift: int = 1):
+    """Ring rotation by ``shift`` hops (the ring-attention primitive)."""
+    return _mapped("ppermute", mesh, axis, shift)(x)
+
+
+# ---------------------------------------------------------------------------
+# Microbenchmark (BASELINE.md config 4: the NCCL-allreduce replacement)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class CollectiveResult:
+    op: str
+    size_mb: float
+    n_devices: int
+    mean_s: float
+    # algorithmic bus bandwidth, NCCL-tests convention: allreduce moves
+    # 2(n-1)/n bytes per byte of payload over the slowest link
+    bus_gb_s: float
+
+
+_BUS_FACTOR = {
+    "all_reduce": lambda n: 2 * (n - 1) / n,
+    "all_gather": lambda n: (n - 1) / n,
+    "reduce_scatter": lambda n: (n - 1) / n,
+    "all_to_all": lambda n: (n - 1) / n,
+    "ppermute": lambda n: 1.0,
+}
+
+_OPS: Dict[str, Callable] = {
+    "all_reduce": all_reduce,
+    "all_gather": all_gather,
+    "reduce_scatter": reduce_scatter,
+    "all_to_all": all_to_all,
+    "ppermute": ppermute_shift,
+}
+
+
+def bench_collective(
+    op: str, mesh: Mesh, axis: str = "dp", *, size_mb: float = 64.0,
+    iters: int = 10, warmup: int = 2,
+) -> CollectiveResult:
+    n = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+    n_elem = int(size_mb * 1e6 / 4)
+    n_elem -= n_elem % (n * n)  # divisible for scatter/a2a reshapes
+    x = jnp.arange(n_elem, dtype=jnp.float32)
+    if op in ("reduce_scatter",):
+        x = x.reshape(n, -1)
+    if op in ("all_to_all",):
+        x = x.reshape(n, -1)
+    fn = _OPS[op]
+    for _ in range(warmup):
+        jax.block_until_ready(fn(x, mesh, axis))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(x, mesh, axis)
+    jax.block_until_ready(out)
+    mean_s = (time.perf_counter() - t0) / iters
+    payload = n_elem * 4
+    bus = payload * _BUS_FACTOR[op](n) / mean_s / 1e9
+    return CollectiveResult(op, payload / 1e6, n, mean_s, bus)
+
+
+def bench_all(mesh: Mesh, axis: str = "dp", *, size_mb: float = 64.0,
+              iters: int = 10) -> List[CollectiveResult]:
+    return [
+        bench_collective(op, mesh, axis, size_mb=size_mb, iters=iters)
+        for op in _OPS
+    ]

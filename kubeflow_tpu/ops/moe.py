@@ -1,0 +1,99 @@
+"""Capacity-based MoE dispatch (GShard/Switch style) for expert parallelism.
+
+The reference has no MoE/expert parallelism (SURVEY.md §2c: EP = "ABSENT").
+The model zoo's default MoE path is exact dense top-k dispatch
+(``kubeflow_tpu/models/transformer.py:MoeMlp``) — every expert sees every
+token, masked. That is O(E) compute per token: fine for small E, wrong for
+large E. This module is the capacity fast path: tokens are scattered into
+per-expert buffers of static capacity C, experts run their FFN once over
+(E, C, D), and results combine back weighted by router gates.
+
+TPU-first details: everything is static-shaped einsums (dispatch/combine are
+one-hot tensors — XLA maps them onto the MXU and, with the ``expert`` axis
+sharded over the ``ep`` mesh group, inserts the AllToAll over ICI for the
+scatter/gather automatically — the GSPMD MoE recipe). Tokens overflowing an
+expert's capacity are dropped (contribute zero), the standard
+Switch-Transformer trade; the auxiliary load-balance loss keeps drop rates
+low.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def expert_capacity(n_tokens: int, n_experts: int, k: int,
+                    capacity_factor: float, *, multiple_of: int = 8) -> int:
+    """Static per-expert buffer size: cf · (tokens·k / E), padded up."""
+    c = int(capacity_factor * n_tokens * k / n_experts) + 1
+    return -(-c // multiple_of) * multiple_of
+
+
+def capacity_dispatch(
+    gate_logits: jnp.ndarray,  # (G, E) f32 router logits, G = flattened tokens
+    k: int,
+    capacity: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Build dispatch/combine tensors for top-k capacity routing.
+
+    Returns (dispatch (G,E,C) bool-ish f32, combine (G,E,C) f32, aux_loss).
+    Token t goes to its k chosen experts at the next free slot of each; slots
+    past ``capacity`` drop. Priority is token order (lower t wins a slot),
+    per expert-choice round: all k=0 choices are placed before k=1 choices,
+    matching the GShard implementation.
+    """
+    G, E = gate_logits.shape
+    probs = jax.nn.softmax(gate_logits, axis=-1)
+    weights, idx = jax.lax.top_k(probs, k)            # (G, K)
+    # renormalize the kept top-k mass
+    weights = weights / jnp.maximum(
+        jnp.sum(weights, axis=-1, keepdims=True), 1e-9
+    )
+
+    dispatch = jnp.zeros((G, E, capacity), jnp.float32)
+    combine = jnp.zeros((G, E, capacity), jnp.float32)
+    used = jnp.zeros((E,), jnp.int32)  # slots consumed per expert so far
+    for j in range(k):
+        onehot = jax.nn.one_hot(idx[:, j], E, dtype=jnp.float32)  # (G, E)
+        # position of each token within its expert's buffer this round
+        pos_in_round = jnp.cumsum(onehot, axis=0) - onehot        # (G, E)
+        pos = pos_in_round + used[None, :].astype(jnp.float32)
+        keep = (pos < capacity).astype(jnp.float32) * onehot
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                              dtype=jnp.float32)                  # (G, E, C)
+        dispatch = dispatch + keep[..., None] * slot
+        combine = combine + (keep * weights[:, j:j + 1])[..., None] * slot
+        used = used + jnp.sum(onehot, axis=0).astype(jnp.int32)
+
+    # Switch-style load-balance aux: E · Σ_e (mean router prob)·(mean routed)
+    density = jnp.mean(
+        jax.nn.one_hot(idx[:, 0], E, dtype=jnp.float32), axis=0
+    )
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(density * mean_prob)
+    return dispatch, combine, aux
+
+
+def capacity_moe(
+    x: jnp.ndarray,            # (G, D) flattened tokens
+    gate_logits: jnp.ndarray,  # (G, E)
+    expert_fn: Callable[[jnp.ndarray], jnp.ndarray],  # (E, C, D) -> (E, C, D')
+    *,
+    k: int,
+    capacity_factor: float = 1.25,
+    capacity: Optional[int] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Route → expert_fn over (E, C, D) buffers → combine. Returns (y, aux)."""
+    G, D = x.shape
+    E = gate_logits.shape[-1]
+    C = capacity if capacity is not None else expert_capacity(
+        G, E, k, capacity_factor
+    )
+    dispatch, combine, aux = capacity_dispatch(gate_logits, k, C)
+    expert_in = jnp.einsum("gec,gd->ecd", dispatch.astype(x.dtype), x)
+    expert_out = expert_fn(expert_in)
+    y = jnp.einsum("gec,ecd->gd", combine.astype(expert_out.dtype), expert_out)
+    return y, aux

@@ -20,3 +20,9 @@ from kubeflow_tpu.parallel.distributed import (  # noqa: F401
     from_env,
     initialize,
 )
+from kubeflow_tpu.parallel.pipeline import (  # noqa: F401
+    make_pipelined_lm_forward,
+    merge_stages,
+    pipeline_apply,
+    split_stages,
+)

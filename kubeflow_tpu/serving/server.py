@@ -86,9 +86,14 @@ class ModelRepository:
             latest = versions[-1]
             with self._lock:
                 current = self._models.get(name)
-                if current is None or current.version != latest:
-                    log.info("loading model %s version %d", name, latest)
-                    self._models[name] = load_version(mdir, latest)
+            if current is not None and current.version == latest:
+                continue
+            # load outside the lock (disk read + jit can take seconds);
+            # only the swap is serialized, so predicts never stall on reload
+            log.info("loading model %s version %d", name, latest)
+            loaded = load_version(mdir, latest)
+            with self._lock:
+                self._models[name] = loaded
 
     def get(self, name: str, version: Optional[int] = None) -> Optional[LoadedModel]:
         with self._lock:

@@ -5,6 +5,7 @@ from kubeflow_tpu.train.trainer import (  # noqa: F401
     create_sharded_state,
     make_image_train_step,
     make_lm_train_step,
+    make_pipelined_lm_train_step,
     make_optimizer,
     next_token_loss,
     softmax_cross_entropy,

@@ -35,18 +35,32 @@ class TrainState(train_state.TrainState):
     batch_stats: Any = None
 
 
-def state_partition_specs(state: Any, rules: AxisRules = DEFAULT_RULES) -> Any:
+def _leaf_axes(path, leaf, pipelined: bool):
+    axes = leaf_logical_axes(path, leaf)
+    if pipelined and axes:
+        # scanned "blocks" leaves: leading layer axis becomes the pipeline
+        # stage axis (contiguous L/pp layers per pp rank)
+        from kubeflow_tpu.models.transformer import _path_names
+
+        if "blocks" in _path_names(path):
+            axes = ("stage",) + tuple(axes[1:])
+    return axes
+
+
+def state_partition_specs(state: Any, rules: AxisRules = DEFAULT_RULES,
+                          *, pipelined: bool = False) -> Any:
     """PartitionSpec for every leaf of a (possibly abstract) train state."""
 
     def spec(path, leaf):
-        return logical_to_mesh_axes(leaf_logical_axes(path, leaf), rules)
+        return logical_to_mesh_axes(_leaf_axes(path, leaf, pipelined), rules)
 
     return jax.tree_util.tree_map_with_path(spec, state)
 
 
-def state_shardings(state: Any, mesh: Mesh, rules: AxisRules = DEFAULT_RULES) -> Any:
+def state_shardings(state: Any, mesh: Mesh, rules: AxisRules = DEFAULT_RULES,
+                    *, pipelined: bool = False) -> Any:
     def shard(path, leaf):
-        spec = logical_to_mesh_axes(leaf_logical_axes(path, leaf), rules)
+        spec = logical_to_mesh_axes(_leaf_axes(path, leaf, pipelined), rules)
         shape = getattr(leaf, "shape", ())
         return NamedSharding(mesh, shape_aware_spec(spec, shape, mesh))
 
@@ -80,15 +94,18 @@ def create_sharded_state(
     rng: jax.Array,
     mesh: Mesh,
     rules: AxisRules = DEFAULT_RULES,
+    *,
+    pipelined: bool = False,
 ) -> Tuple[TrainState, Any]:
     """Initialize a TrainState directly into its sharded layout.
 
     ``init_fn`` is traced abstractly to derive per-leaf shardings, then
     jit-compiled with those as out_shardings so every param lands sharded —
     no host-side full materialization (matters when params exceed one HBM).
+    ``pipelined`` shards the scanned layer axis over pp (pipeline stages).
     """
     abstract = jax.eval_shape(init_fn, rng)
-    shardings = state_shardings(abstract, mesh, rules)
+    shardings = state_shardings(abstract, mesh, rules, pipelined=pipelined)
     state = jax.jit(init_fn, out_shardings=shardings)(rng)
     return state, shardings
 
@@ -144,6 +161,50 @@ def make_lm_train_step(
             return jitted(state, tokens)
 
     jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+    return run
+
+
+def make_pipelined_lm_train_step(
+    model,
+    mesh: Mesh,
+    *,
+    n_microbatches: int,
+    rules: AxisRules = DEFAULT_RULES,
+    donate: bool = True,
+):
+    """LM train step with the block stack pipelined over the ``pp`` axis.
+
+    Composes pp with dp/tp: stages are manual over pp
+    (``kubeflow_tpu/parallel/pipeline.py``); dp/tp sharding inside each
+    stage stays auto. State must be created with ``pipelined=True`` so the
+    scanned layer axis lands stage-sharded. MoE auxiliary losses are not
+    collected on this path (the pipeline applies blocks functionally).
+    """
+    from kubeflow_tpu.parallel.pipeline import make_pipelined_lm_forward
+
+    fwd = make_pipelined_lm_forward(model, mesh, n_microbatches=n_microbatches)
+    batch_spec = logical_to_mesh_axes(("batch", "seq"), rules)
+
+    def step(state: TrainState, tokens: jnp.ndarray):
+        tokens = jax.lax.with_sharding_constraint(tokens, batch_spec)
+
+        def loss_fn(params):
+            return next_token_loss(fwd(params, tokens), tokens)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        new_state = state.apply_gradients(grads=grads)
+        return new_state, {
+            "loss": loss,
+            "grad_norm": optax.global_norm(grads),
+            "step": new_state.step,
+        }
+
+    jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+
+    def run(state, tokens):
+        with mesh_context(mesh):
+            return jitted(state, tokens)
+
     return run
 
 

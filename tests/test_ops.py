@@ -1,0 +1,192 @@
+"""Attention kernels, collectives, and MoE dispatch (kubeflow_tpu.ops).
+
+Numerics tier: every op is checked against the dense reference on the
+8-device virtual CPU mesh (conftest), including gradients — the collective
+paths (ring attention, shard_map wrappers) run the same code that lowers to
+ICI collectives on real slices.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from kubeflow_tpu.ops import (
+    all_gather,
+    all_reduce,
+    all_to_all,
+    bench_collective,
+    blockwise_attention,
+    capacity_dispatch,
+    capacity_moe,
+    expert_capacity,
+    flash_attention,
+    ppermute_shift,
+    reference_attention,
+    reduce_scatter,
+    ring_attention_sharded,
+)
+
+
+def qkv(B=2, S=64, H=4, D=16, dtype=jnp.float32):
+    return tuple(
+        jax.random.normal(jax.random.key(i), (B, S, H, D), dtype)
+        for i in range(3)
+    )
+
+
+@pytest.fixture(scope="module")
+def mesh_dp_tp():
+    devs = np.array(jax.devices()[:8]).reshape(2, 1, 4)
+    return Mesh(devs, ("dp", "pp", "tp"))
+
+
+@pytest.fixture(scope="module")
+def mesh_dp():
+    devs = np.array(jax.devices()[:8]).reshape(8, 1, 1)
+    return Mesh(devs, ("dp", "pp", "tp"))
+
+
+class TestBlockwise:
+    def test_matches_reference(self):
+        q, k, v = qkv()
+        ref = reference_attention(q, k, v)
+        out = blockwise_attention(q, k, v, block_k=16)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_block_not_dividing_seq(self):
+        q, k, v = qkv(S=60)
+        ref = reference_attention(q, k, v)
+        out = blockwise_attention(q, k, v, block_k=16)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_non_causal(self):
+        q, k, v = qkv()
+        ref = reference_attention(q, k, v, causal=False)
+        out = blockwise_attention(q, k, v, causal=False, block_k=16)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_non_causal_padded_blocks(self):
+        # regression: pad positions must stay masked without causality
+        q, k, v = qkv(S=60)
+        ref = reference_attention(q, k, v, causal=False)
+        out = blockwise_attention(q, k, v, causal=False, block_k=16)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_gradients_match(self):
+        q, k, v = qkv()
+        g_ref = jax.grad(lambda q: jnp.sum(reference_attention(q, k, v) ** 2))(q)
+        g_blk = jax.grad(
+            lambda q: jnp.sum(blockwise_attention(q, k, v, block_k=16) ** 2)
+        )(q)
+        np.testing.assert_allclose(g_blk, g_ref, atol=1e-4)
+
+
+class TestFlash:
+    def test_matches_reference(self):
+        q, k, v = qkv()
+        ref = reference_attention(q, k, v)
+        out = flash_attention(q, k, v, True, 16, 16)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_gradients_match(self):
+        q, k, v = qkv()
+        g_ref = jax.grad(lambda q: jnp.sum(reference_attention(q, k, v) ** 2))(q)
+        g_fl = jax.grad(
+            lambda q: jnp.sum(flash_attention(q, k, v, True, 16, 16) ** 2)
+        )(q)
+        np.testing.assert_allclose(g_fl, g_ref, atol=1e-4)
+
+    def test_rejects_ragged_blocks(self):
+        q, k, v = qkv(S=60)
+        with pytest.raises(ValueError, match="must divide"):
+            flash_attention(q, k, v, True, 16, 16)
+
+
+class TestRing:
+    def test_matches_reference(self, mesh_dp_tp):
+        q, k, v = qkv()
+        ref = reference_attention(q, k, v)
+        out = ring_attention_sharded(q, k, v, mesh_dp_tp)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_gradients_match(self, mesh_dp_tp):
+        q, k, v = qkv()
+        g_ref = jax.grad(lambda q: jnp.sum(reference_attention(q, k, v) ** 2))(q)
+        g_ring = jax.grad(
+            lambda q: jnp.sum(ring_attention_sharded(q, k, v, mesh_dp_tp) ** 2)
+        )(q)
+        np.testing.assert_allclose(g_ring, g_ref, atol=1e-4)
+
+    def test_long_context_sharded_sequence(self, mesh_dp_tp):
+        # sequence 4x longer than any single shard sees
+        q, k, v = qkv(B=1, S=256)
+        ref = reference_attention(q, k, v)
+        out = ring_attention_sharded(q, k, v, mesh_dp_tp, batch_axis=None)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+class TestCollectives:
+    def test_all_reduce_sums_shards(self, mesh_dp):
+        x = jnp.arange(16, dtype=jnp.float32).reshape(8, 2)
+        out = all_reduce(x, mesh_dp)
+        np.testing.assert_allclose(out[0], np.asarray(x).sum(0))
+
+    def test_all_gather_roundtrip(self, mesh_dp):
+        x = jnp.arange(16, dtype=jnp.float32).reshape(8, 2)
+        np.testing.assert_allclose(all_gather(x, mesh_dp), x)
+
+    def test_reduce_scatter(self, mesh_dp):
+        out = reduce_scatter(jnp.ones((8, 8)), mesh_dp)
+        assert out.shape == (8, 1)
+        np.testing.assert_allclose(out, 8.0)
+
+    def test_all_to_all_preserves_global_view(self, mesh_dp):
+        # a2a transposes which axis is sharded; the global matrix is unchanged
+        x = jnp.arange(64, dtype=jnp.float32).reshape(8, 8)
+        out = all_to_all(x, mesh_dp)
+        np.testing.assert_allclose(out, np.asarray(x))
+
+    def test_ppermute_rotates(self, mesh_dp):
+        x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
+        out = ppermute_shift(x, mesh_dp, shift=1)
+        np.testing.assert_allclose(np.asarray(out)[:, 0], np.roll(np.arange(8), 1))
+
+    def test_bench_returns_bandwidth(self, mesh_dp):
+        r = bench_collective("all_reduce", mesh_dp, size_mb=0.5, iters=2,
+                             warmup=1)
+        assert r.n_devices == 8
+        assert r.mean_s > 0 and r.bus_gb_s > 0
+
+
+class TestMoeDispatch:
+    def test_capacity_rounding(self):
+        assert expert_capacity(128, 8, 2, 1.0) % 8 == 0
+        assert expert_capacity(128, 8, 2, 1.0) >= 128 * 2 // 8
+
+    def test_dispatch_is_permutation_when_ample(self):
+        G, E, K, C = 32, 4, 2, 32
+        logits = jax.random.normal(jax.random.key(0), (G, E))
+        dispatch, combine, _ = capacity_dispatch(logits, K, C)
+        # every token placed exactly K times with ample capacity
+        np.testing.assert_allclose(dispatch.sum(axis=(1, 2)), K)
+        # each slot holds at most one token
+        assert float(jnp.max(dispatch.sum(axis=0))) <= 1.0
+        # combine weights per token sum to 1 (renormalized top-k)
+        np.testing.assert_allclose(combine.sum(axis=(1, 2)), 1.0, atol=1e-5)
+
+    def test_overflow_drops_tokens(self):
+        G, E, K, C = 32, 2, 1, 4
+        logits = jnp.zeros((G, E)).at[:, 0].set(10.0)  # all want expert 0
+        dispatch, _, _ = capacity_dispatch(logits, K, C)
+        assert float(dispatch.sum()) == C  # only C fit
+
+    def test_moe_identity_experts(self):
+        # identity expert_fn + ample capacity => y ≈ x (combine sums to 1)
+        G, D, E = 16, 8, 4
+        x = jax.random.normal(jax.random.key(0), (G, D))
+        logits = jax.random.normal(jax.random.key(1), (G, E))
+        y, aux = capacity_moe(x, logits, lambda e: e, k=2, capacity=G)
+        np.testing.assert_allclose(y, x, atol=1e-5)
+        assert float(aux) > 0

@@ -1,0 +1,163 @@
+"""Pipeline parallelism (kubeflow_tpu.parallel.pipeline).
+
+The pipeline must be *exact*: same outputs and gradients as running the
+layer stack sequentially — the schedule only changes when/where compute
+happens (SURVEY.md §2c: PP absent from the reference; here it's native).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from kubeflow_tpu.models.transformer import Transformer, tiny_config
+from kubeflow_tpu.parallel.pipeline import (
+    make_pipelined_lm_forward,
+    merge_stages,
+    pipeline_apply,
+    split_stages,
+)
+from kubeflow_tpu.train import (
+    TrainState,
+    create_sharded_state,
+    make_optimizer,
+    make_pipelined_lm_train_step,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh_pp4():
+    devs = np.array(jax.devices()[:8]).reshape(1, 4, 2)
+    return Mesh(devs, ("dp", "pp", "tp"))
+
+
+@pytest.fixture(scope="module")
+def mesh_full():
+    devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+    return Mesh(devs, ("dp", "pp", "tp"))
+
+
+L, DIN = 8, 16
+
+
+def _stack():
+    return jax.random.normal(jax.random.key(0), (L, DIN, DIN)) * 0.1
+
+
+def _stage_fn(stage_params, x):
+    def layer(x, W):
+        return jnp.tanh(x @ W), None
+
+    x, _ = jax.lax.scan(layer, x, stage_params)
+    return x
+
+
+def _sequential(Ws, x_mb):
+    def seq(x):
+        for i in range(L):
+            x = jnp.tanh(x @ Ws[i])
+        return x
+
+    return jax.vmap(seq)(x_mb)
+
+
+class TestSplitStages:
+    def test_roundtrip(self):
+        Ws = _stack()
+        staged = split_stages(Ws, 4)
+        assert staged.shape == (4, 2, DIN, DIN)
+        np.testing.assert_allclose(merge_stages(staged), Ws)
+
+    def test_rejects_ragged(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            split_stages(_stack(), 3)
+
+
+class TestPipelineApply:
+    def test_matches_sequential(self, mesh_pp4):
+        Ws = _stack()
+        x = jax.random.normal(jax.random.key(1), (4, 6, DIN))
+        y = pipeline_apply(_stage_fn, split_stages(Ws, 4), x, mesh=mesh_pp4)
+        np.testing.assert_allclose(y, _sequential(Ws, x), atol=1e-6)
+
+    def test_more_microbatches_than_stages(self, mesh_pp4):
+        Ws = _stack()
+        x = jax.random.normal(jax.random.key(1), (7, 3, DIN))
+        y = pipeline_apply(_stage_fn, split_stages(Ws, 4), x, mesh=mesh_pp4)
+        np.testing.assert_allclose(y, _sequential(Ws, x), atol=1e-6)
+
+    def test_gradients_match_sequential(self, mesh_pp4):
+        Ws = _stack()
+        x = jax.random.normal(jax.random.key(1), (4, 6, DIN))
+        g_p = jax.grad(
+            lambda W: jnp.sum(
+                pipeline_apply(_stage_fn, split_stages(W, 4), x, mesh=mesh_pp4)
+                ** 2
+            )
+        )(Ws)
+        g_s = jax.grad(lambda W: jnp.sum(_sequential(W, x) ** 2))(Ws)
+        np.testing.assert_allclose(g_p, g_s, atol=1e-5)
+
+
+class TestPipelinedTransformer:
+    def test_forward_matches_unpipelined(self, mesh_pp4):
+        c = tiny_config(n_layers=4)
+        model = Transformer(c)
+        tokens = jax.random.randint(jax.random.key(2), (8, 16), 0, c.vocab_size)
+        params = model.init(jax.random.key(0), tokens)["params"]
+        fwd = make_pipelined_lm_forward(model, mesh_pp4, n_microbatches=4)
+        np.testing.assert_allclose(
+            fwd(params, tokens),
+            model.apply({"params": params}, tokens),
+            atol=1e-4,
+        )
+
+    def test_rejects_ragged_batch(self, mesh_pp4):
+        c = tiny_config(n_layers=4)
+        model = Transformer(c)
+        tokens = jnp.zeros((6, 16), jnp.int32)
+        params = model.init(jax.random.key(0), jnp.zeros((2, 16), jnp.int32))[
+            "params"
+        ]
+        fwd = make_pipelined_lm_forward(model, mesh_pp4, n_microbatches=4)
+        with pytest.raises(ValueError, match="not divisible"):
+            fwd(params, tokens)
+
+    def test_train_step_full_mesh(self, mesh_full):
+        """dp=2 pp=2 tp=2 with MoE (ep-on-dp): the everything-at-once step."""
+        c = tiny_config(n_layers=4, n_experts=4, moe_capacity_factor=2.0)
+        model = Transformer(c)
+        tokens = jax.random.randint(jax.random.key(1), (8, 16), 0, c.vocab_size)
+        tx = make_optimizer(1e-2, warmup_steps=1, decay_steps=10)
+
+        def init_fn(rng):
+            params = model.init(rng, tokens)["params"]
+            return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+
+        state, _ = create_sharded_state(
+            init_fn, jax.random.key(0), mesh_full, pipelined=True
+        )
+        step = make_pipelined_lm_train_step(model, mesh_full, n_microbatches=2)
+        losses = []
+        for _ in range(4):
+            state, metrics = step(state, tokens)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0]
+        assert np.isfinite(losses).all()
+
+    def test_stage_axis_sharded_over_pp(self, mesh_full):
+        c = tiny_config(n_layers=4)
+        model = Transformer(c)
+        tokens = jnp.zeros((4, 8), jnp.int32)
+        tx = make_optimizer(1e-3, warmup_steps=1, decay_steps=10)
+
+        def init_fn(rng):
+            params = model.init(rng, tokens)["params"]
+            return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+
+        state, shardings = create_sharded_state(
+            init_fn, jax.random.key(0), mesh_full, pipelined=True
+        )
+        spec = shardings.params["blocks"]["attn"]["q_proj"].spec
+        assert spec[0] == "pp"
