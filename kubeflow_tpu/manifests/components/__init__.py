@@ -4,4 +4,5 @@ from kubeflow_tpu.manifests.components import (  # noqa: F401
     dashboard,
     serving,
     tpujob_operator,
+    tuning,
 )

@@ -1,0 +1,118 @@
+"""Tuning component: Study/Trial CRDs + controller + suggestion services.
+
+Manifest parity with the reference's katib package — vizier-core manager +
+per-algorithm suggestion Deployments + studyjob-controller + katib-ui
+(``/root/reference/kubeflow/katib/vizier.libsonnet:99-455``,
+``suggestion.libsonnet:44-240``, ``studyjobcontroller.libsonnet:297-323``) —
+minus the MySQL vizier-db: study state lives in the Study/Trial CR status,
+so there is no separate database to run.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from kubeflow_tpu.config.deployment import DeploymentConfig
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.manifests.components.tpujob_operator import (
+    GROUP,
+    TPUJOB_PLURAL,
+    VERSION,
+)
+from kubeflow_tpu.manifests.registry import register
+
+SUGGESTION_PORT = 6789  # reference: each suggestion service binds :6789
+
+DEFAULTS: Dict[str, Any] = {
+    "image": "kubeflow-tpu/tuning:v1alpha1",
+    "suggestion_algorithms": ["random", "grid", "bayesian", "hyperband"],
+    "monitoring_port": 8444,
+    "replicas": 1,
+}
+
+
+def study_crd() -> o.Obj:
+    return o.crd(
+        "studies", GROUP, "Study",
+        versions=(VERSION,),
+        short_names=("st",),
+        printer_columns=(
+            {"name": "State", "type": "string", "jsonPath": ".status.phase"},
+            {"name": "Trials", "type": "integer",
+             "jsonPath": ".status.trials"},
+            {"name": "Age", "type": "date",
+             "jsonPath": ".metadata.creationTimestamp"},
+        ),
+    )
+
+
+def trial_crd() -> o.Obj:
+    return o.crd(
+        "trials", GROUP, "Trial",
+        versions=(VERSION,),
+        printer_columns=(
+            {"name": "State", "type": "string", "jsonPath": ".status.phase"},
+            {"name": "Age", "type": "date",
+             "jsonPath": ".metadata.creationTimestamp"},
+        ),
+    )
+
+
+@register("tuning", DEFAULTS,
+          "HP tuning: Study controller + suggestion services (katib parity)")
+def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
+    ns = config.namespace
+    name = "study-controller"
+    rules = [
+        {"apiGroups": [GROUP],
+         "resources": ["studies", "studies/status", "trials", "trials/status",
+                       TPUJOB_PLURAL, f"{TPUJOB_PLURAL}/status"],
+         "verbs": ["*"]},
+        {"apiGroups": [""], "resources": ["configmaps", "events"],
+         "verbs": ["*"]},
+    ]
+    pod = o.pod_spec(
+        [o.container(
+            name,
+            params["image"],
+            command=["python", "-m", "kubeflow_tpu.tuning.controller"],
+            env={"KFTPU_MONITORING_PORT": str(params["monitoring_port"])},
+            ports=[params["monitoring_port"]],
+        )],
+        service_account_name=name,
+    )
+    # trial workload pods run under the namespace default SA (the TpuJob
+    # operator sets no serviceAccountName) and must be able to publish
+    # their trial-metrics ConfigMap via report_trial_metrics()
+    metrics_writer = o.role(
+        "trial-metrics-writer", ns,
+        [{"apiGroups": [""], "resources": ["configmaps"],
+          "verbs": ["get", "create", "update", "patch"]}])
+    out = [
+        study_crd(),
+        trial_crd(),
+        o.service_account(name, ns),
+        o.cluster_role(name, rules),
+        o.cluster_role_binding(name, name, name, ns),
+        metrics_writer,
+        o.role_binding("trial-metrics-writer", ns, "trial-metrics-writer",
+                       "default", ns),
+        o.deployment(name, ns, pod, replicas=params["replicas"]),
+    ]
+    # one suggestion Deployment+Service per algorithm, like the reference's
+    # vizier-suggestion-{random,grid,hyperband,bayesianoptimization}
+    for algo in params["suggestion_algorithms"]:
+        sname = f"suggestion-{algo}"
+        spod = o.pod_spec([o.container(
+            sname,
+            params["image"],
+            command=["python", "-m", "kubeflow_tpu.tuning.service"],
+            env={"KFTPU_SUGGESTION_PORT": str(SUGGESTION_PORT)},
+            ports=[SUGGESTION_PORT],
+        )])
+        out.append(o.deployment(sname, ns, spod))
+        out.append(o.service(
+            sname, ns, {"app": sname},
+            [{"name": "api", "port": SUGGESTION_PORT,
+              "targetPort": SUGGESTION_PORT}]))
+    return out

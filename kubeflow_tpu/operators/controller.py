@@ -35,22 +35,34 @@ class _Item:
 
 
 class WorkQueue:
-    """Deduplicating delayed workqueue.
+    """Deduplicating delayed workqueue with single-flight per key.
 
     A key queued with a delay is *promoted* when re-added sooner (a watch
     event must not be swallowed by a pending slow-poll requeue); the stale
     heap entry is skipped at pop time.
+
+    Like the upstream k8s workqueue, a key handed to a worker is in-flight
+    until :meth:`done`: re-adds meanwhile land in a dirty set and re-enqueue
+    on completion, so two workers never reconcile the same key concurrently
+    (which would race object creations against each other).
     """
 
     def __init__(self) -> None:
         self._cond = threading.Condition()
         self._heap: List[_Item] = []
         self._pending: Dict[Tuple[str, str], float] = {}
+        self._processing: set = set()
+        self._dirty: Dict[Tuple[str, str], float] = {}
         self._shutdown = False
 
     def add(self, key: Tuple[str, str], delay: float = 0.0) -> None:
         at = time.monotonic() + delay
         with self._cond:
+            if key in self._processing:
+                prev = self._dirty.get(key)
+                if prev is None or at < prev:
+                    self._dirty[key] = at
+                return
             current = self._pending.get(key)
             if current is not None and current <= at:
                 return  # already due no later than the new request
@@ -69,6 +81,7 @@ class WorkQueue:
                     item = heapq.heappop(self._heap)
                     if self._pending.get(item.key) == item.at:
                         del self._pending[item.key]
+                        self._processing.add(item.key)
                         return item.key
                     # stale entry superseded by a promotion; skip
                 wait = self._heap[0].at - now if self._heap else None
@@ -78,6 +91,18 @@ class WorkQueue:
                         return None
                     wait = remaining if wait is None else min(wait, remaining)
                 self._cond.wait(wait)
+
+    def done(self, key: Tuple[str, str]) -> None:
+        """Worker finished this key; flush any re-adds that arrived mid-flight."""
+        with self._cond:
+            self._processing.discard(key)
+            at = self._dirty.pop(key, None)
+            if at is not None:
+                current = self._pending.get(key)
+                if current is None or at < current:
+                    self._pending[key] = at
+                    heapq.heappush(self._heap, _Item(at, key))
+                    self._cond.notify()
 
     def shutdown(self) -> None:
         with self._cond:
@@ -145,6 +170,7 @@ class Controller:
                 requeue = 5.0
             if requeue is not None:
                 self.queue.add(key, delay=requeue)
+            self.queue.done(key)
 
     def start(self, workers: int = 1) -> None:
         def primary_key(obj: dict) -> Tuple[str, str]:

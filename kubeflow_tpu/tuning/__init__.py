@@ -1,0 +1,37 @@
+"""Hyperparameter tuning: Katib-parity studies on TpuJobs.
+
+Reference surface: Katib's vizier-core + per-algorithm suggestion services +
+studyjob-controller + metrics-collector CronJobs
+(``/root/reference/kubeflow/katib/{vizier,suggestion,studyjobcontroller}.libsonnet``).
+Here a Study CR fans trials out as TpuJobs, suggestion algorithms are an
+in-process library (also servable per-algorithm over HTTP for parity with
+the gRPC suggestion Deployments), and metrics come from the framework's own
+trial-metrics ConfigMaps instead of log-scrape CronJobs (SURVEY.md §7.7).
+"""
+
+from kubeflow_tpu.tuning.search_space import (  # noqa: F401
+    Categorical,
+    Discrete,
+    Double,
+    Int,
+    SearchSpace,
+    parse_parameter,
+)
+from kubeflow_tpu.tuning.suggestions import (  # noqa: F401
+    BayesianOptimization,
+    GridSearch,
+    Hyperband,
+    RandomSearch,
+    Suggestion,
+    TrialRecord,
+    get_suggestion,
+)
+from kubeflow_tpu.tuning.study import (  # noqa: F401
+    STUDY_API_VERSION,
+    STUDY_KIND,
+    TRIAL_KIND,
+    StudySpec,
+    report_trial_metrics,
+    study,
+)
+from kubeflow_tpu.tuning.controller import StudyController  # noqa: F401

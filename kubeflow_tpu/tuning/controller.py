@@ -1,0 +1,392 @@
+"""Study controller: reconciles Study CRs into Trial CRs + TpuJobs.
+
+Reference: katib's studyjob-controller Deployment
+(``/root/reference/kubeflow/katib/studyjobcontroller.libsonnet:297-323``)
+plus vizier-core's trial loop. One reconcile pass: harvest finished trial
+jobs → ask the suggestion algorithm for new assignments → fan out up to
+``parallelTrials`` TpuJobs → aggregate best trial into status.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.k8s.client import ApiError, KubeClient
+from kubeflow_tpu.manifests.components.tpujob_operator import (
+    API_VERSION as TPUJOB_API_VERSION,
+    TPUJOB_KIND,
+)
+from kubeflow_tpu.operators.controller import Controller
+from kubeflow_tpu.operators.tpujob import tpujob
+from kubeflow_tpu.tuning.search_space import SearchSpace
+from kubeflow_tpu.tuning.study import (
+    STUDY_API_VERSION,
+    STUDY_KIND,
+    STUDY_LABEL,
+    TRIAL_KIND,
+    TRIAL_LABEL,
+    StudySpec,
+    read_trial_metrics,
+    substitute,
+    trial as build_trial,
+)
+from kubeflow_tpu.tuning.suggestions import (
+    TrialRecord,
+    get_suggestion,
+    stable_seed,
+)
+from kubeflow_tpu.utils import DEFAULT_REGISTRY
+
+log = logging.getLogger(__name__)
+
+PHASE_RUNNING = "Running"
+PHASE_SUCCEEDED = "Succeeded"
+PHASE_FAILED = "Failed"
+
+TRIAL_PENDING = "Pending"
+TRIAL_RUNNING = "Running"
+TRIAL_SUCCEEDED = "Succeeded"
+TRIAL_FAILED = "Failed"
+TRIAL_KILLED = "Killed"  # study finished while this trial was in flight
+
+_trials_created = DEFAULT_REGISTRY.counter(
+    "kftpu_tuning_trials_created_total", "trials fanned out by the controller")
+
+
+class StudyController:
+    """Drives studies to completion against any :class:`KubeClient`."""
+
+    def __init__(self, client: KubeClient,
+                 namespace: Optional[str] = None) -> None:
+        self.client = client
+        self.namespace = namespace
+
+    # -- reconcile ---------------------------------------------------------
+
+    def reconcile(self, ns: str, name: str) -> Optional[float]:
+        study = self.client.get_or_none(STUDY_API_VERSION, STUDY_KIND, ns, name)
+        if study is None:
+            return None
+        try:
+            spec = StudySpec.from_dict(study["spec"])
+            space = SearchSpace.from_dicts(spec.parameters)
+            # constructing the algorithm validates its name and settings too
+            algo = get_suggestion(
+                spec.algorithm, space, seed=stable_seed(name),
+                settings=spec.algorithm_settings)
+        except (ValueError, KeyError, TypeError) as e:
+            self._set_status(study, {"phase": PHASE_FAILED,
+                                     "message": f"invalid spec: {e}"})
+            return None
+
+        phase = study.get("status", {}).get("phase")
+        if phase in (PHASE_SUCCEEDED, PHASE_FAILED):
+            return None
+
+        # one list per pass instead of a GET per trial
+        jobs = {
+            j["metadata"]["name"]: j
+            for j in self.client.list(TPUJOB_API_VERSION, TPUJOB_KIND, ns,
+                                      label_selector={STUDY_LABEL: name})
+        }
+        trials = [self._sync_trial(ns, study, spec, t, jobs.get(
+                      t["metadata"]["name"]))
+                  for t in self._trials(ns, name)]
+
+        counts = {s: 0 for s in (TRIAL_PENDING, TRIAL_RUNNING,
+                                 TRIAL_SUCCEEDED, TRIAL_FAILED)}
+        for t in trials:
+            ph = self._trial_phase(t)
+            counts[ph] = counts.get(ph, 0) + 1
+        active = counts[TRIAL_PENDING] + counts[TRIAL_RUNNING]
+
+        status: Dict[str, Any] = {
+            "phase": PHASE_RUNNING,
+            "trials": len(trials),
+            "trialsRunning": active,
+            "trialsSucceeded": counts[TRIAL_SUCCEEDED],
+            "trialsFailed": counts[TRIAL_FAILED],
+        }
+        best = self._best(spec, trials)
+        if best is not None:
+            status["bestTrial"] = best
+
+        if counts[TRIAL_FAILED] > spec.max_failed_trials:
+            status["phase"] = PHASE_FAILED
+            status["message"] = (
+                f"{counts[TRIAL_FAILED]} failed trials exceed "
+                f"maxFailedTrials={spec.max_failed_trials}")
+            self._kill_active(ns, trials)
+            self._set_status(study, status)
+            return None
+
+        goal_hit = (
+            best is not None and spec.goal is not None
+            and spec.sign() * best["objective"] >= spec.sign() * spec.goal
+        )
+        exhausted = len(trials) >= spec.max_trials and active == 0
+
+        if goal_hit or exhausted:
+            status["phase"] = PHASE_SUCCEEDED if best is not None else PHASE_FAILED
+            if best is None:
+                status["message"] = "no trial produced the objective metric"
+            self._kill_active(ns, trials)
+            self._set_status(study, status)
+            return None
+
+        want = min(spec.parallel_trials - active,
+                   spec.max_trials - len(trials))
+        if want > 0:
+            try:
+                proposed, created = self._spawn(study, spec, algo, trials, want)
+            except (ValueError, TypeError) as e:
+                # e.g. template substitution produced an invalid TpuJob spec
+                status["phase"] = PHASE_FAILED
+                status["message"] = f"trial spawn failed: {e}"
+                self._kill_active(ns, trials)
+                self._set_status(study, status)
+                return None
+            status["trials"] += created
+            status["trialsRunning"] = active + created
+            if proposed == 0 and active == 0:
+                # the algorithm proposed nothing (grid exhausted, hyperband
+                # schedule complete) → terminal even though maxTrials was
+                # never reached. proposed>0 with created==0 is NOT terminal:
+                # that means creations collided with a concurrent actor.
+                status["phase"] = (PHASE_SUCCEEDED if best is not None
+                                   else PHASE_FAILED)
+                if best is None:
+                    status["message"] = "search space exhausted with no result"
+                self._set_status(study, status)
+                return None
+        self._set_status(study, status)
+        # watches on Trials and TpuJobs drive progress; this is only a
+        # slow-poll safety net
+        return 30.0
+
+    # -- trial lifecycle ---------------------------------------------------
+
+    def _trials(self, ns: str, study_name: str) -> List[o.Obj]:
+        trials = self.client.list(STUDY_API_VERSION, TRIAL_KIND, ns,
+                                  label_selector={STUDY_LABEL: study_name})
+        trials.sort(key=lambda t: int(t["spec"].get("index", 0)))
+        return trials
+
+    def _trial_phase(self, t: o.Obj) -> str:
+        return t.get("status", {}).get("phase", TRIAL_PENDING)
+
+    def _sync_trial(self, ns: str, study: o.Obj, spec: StudySpec, t: o.Obj,
+                    job: Optional[o.Obj]) -> o.Obj:
+        """Mirror the trial's TpuJob phase into the Trial CR; on success
+        harvest the objective metric from the trial-metrics ConfigMap.
+        Returns the (possibly updated) trial so the same reconcile pass
+        counts fresh state."""
+        if self._trial_phase(t) in (TRIAL_SUCCEEDED, TRIAL_FAILED,
+                                    TRIAL_KILLED):
+            return t
+        tname = t["metadata"]["name"]
+        if job is None:
+            # repair: a Trial without its TpuJob (crash between the two
+            # creates, or an earlier partial spawn) would stay Pending and
+            # hold a parallelism slot forever
+            self._create_if_absent(self._build_job(
+                study, spec, t, dict(t["spec"].get("parameters", {}))))
+            return t
+        jphase = job.get("status", {}).get("phase")
+        status = dict(t.get("status", {}))
+        if jphase == "Running" and status.get("phase") != TRIAL_RUNNING:
+            status["phase"] = TRIAL_RUNNING
+        elif jphase == "Failed":
+            status["phase"] = TRIAL_FAILED
+        elif jphase == "Succeeded":
+            metrics = read_trial_metrics(self.client, ns, tname)
+            if metrics is None or spec.objective_metric not in metrics:
+                # job done but metric never reported → the trial is unusable
+                status["phase"] = TRIAL_FAILED
+                status["message"] = (
+                    f"metric {spec.objective_metric!r} not reported")
+            else:
+                status["phase"] = TRIAL_SUCCEEDED
+                status["observation"] = metrics
+        else:
+            return t
+        t = dict(t)
+        t["status"] = status
+        try:
+            return self.client.update_status(t)
+        except ApiError as e:
+            if e.code != 404:
+                raise
+        return t
+
+    def _records(self, spec: StudySpec,
+                 trials: List[o.Obj]) -> List[TrialRecord]:
+        recs = []
+        for t in trials:
+            phase = self._trial_phase(t)
+            obs = t.get("status", {}).get("observation", {})
+            objective = None
+            if phase == TRIAL_SUCCEEDED and spec.objective_metric in obs:
+                objective = spec.sign() * float(obs[spec.objective_metric])
+            recs.append(TrialRecord(
+                parameters=dict(t["spec"].get("parameters", {})),
+                objective=objective,
+                failed=phase == TRIAL_FAILED,
+            ))
+        return recs
+
+    def _build_job(self, study: o.Obj, spec: StudySpec, trial_obj: o.Obj,
+                   params: Dict[str, Any]) -> o.Obj:
+        """Render the trial's TpuJob from the study template + assignment."""
+        name = study["metadata"]["name"]
+        ns = study["metadata"]["namespace"]
+        tname = trial_obj["metadata"]["name"]
+        job_spec = substitute(dict(spec.trial_template), params)
+        env = dict(job_spec.get("env", {}) or {})
+        env.update({
+            "KFTPU_STUDY_NAME": name,
+            "KFTPU_TRIAL_NAME": tname,
+        })
+        for k, v in params.items():
+            env.setdefault(f"KFTPU_PARAM_{k.upper().replace('-', '_')}",
+                           str(v))
+        job_spec["env"] = env
+        job = tpujob(tname, ns, job_spec)
+        job["metadata"]["labels"] = {STUDY_LABEL: name, TRIAL_LABEL: tname}
+        if trial_obj["metadata"].get("uid"):
+            o.set_owner(job, trial_obj)
+        return job
+
+    def _create_if_absent(self, obj: o.Obj) -> None:
+        try:
+            self.client.create(obj)
+        except ApiError as e:
+            if e.code != 409:
+                raise
+
+    def _spawn(self, study: o.Obj, spec: StudySpec, algo,
+               trials: List[o.Obj], want: int) -> tuple:
+        name = study["metadata"]["name"]
+        ns = study["metadata"]["namespace"]
+        assignments = algo.suggest(self._records(spec, trials), want)
+        next_index = (max((int(t["spec"].get("index", 0)) for t in trials),
+                          default=-1) + 1)
+        created = 0
+        for i, params in enumerate(assignments):
+            t = build_trial(study, next_index + i, params)
+            tname = t["metadata"]["name"]
+            try:
+                stored_t = self.client.create(t)
+            except ApiError as e:
+                if e.code != 409:
+                    raise
+                continue
+            job = self._build_job(study, spec, stored_t, params)
+            try:
+                self.client.create(job)
+            except ApiError as e:
+                if e.code != 409:
+                    raise
+                existing = self.client.get_or_none(
+                    TPUJOB_API_VERSION, TPUJOB_KIND, ns, tname)
+                labels = ((existing or {}).get("metadata", {})
+                          .get("labels", {}) or {})
+                if labels.get(TRIAL_LABEL) != tname:
+                    # name collision with a foreign job: a trial without a
+                    # job would count as active forever — roll it back
+                    self.client.delete(STUDY_API_VERSION, TRIAL_KIND, ns, tname)
+                    log.warning("trial %s/%s collides with existing TpuJob; "
+                                "skipped", ns, tname)
+                    continue
+            _trials_created.inc()
+            created += 1
+        return len(assignments), created
+
+    def _kill_active(self, ns: str, trials: List[o.Obj]) -> None:
+        """Terminal study: tear down in-flight trial jobs so they stop
+        holding TPU slices (katib deletes trial workers on completion)."""
+        for t in trials:
+            if self._trial_phase(t) in (TRIAL_SUCCEEDED, TRIAL_FAILED,
+                                        TRIAL_KILLED):
+                continue
+            tname = t["metadata"]["name"]
+            try:
+                self.client.delete(TPUJOB_API_VERSION, TPUJOB_KIND, ns, tname)
+            except ApiError as e:
+                if e.code != 404:
+                    raise
+            t = dict(t)
+            t["status"] = {**t.get("status", {}), "phase": TRIAL_KILLED,
+                           "message": "study completed"}
+            try:
+                self.client.update_status(t)
+            except ApiError as e:
+                if e.code != 404:
+                    raise
+
+    def _best(self, spec: StudySpec,
+              trials: List[o.Obj]) -> Optional[Dict[str, Any]]:
+        best = None
+        for t in trials:
+            obs = t.get("status", {}).get("observation", {})
+            if self._trial_phase(t) != TRIAL_SUCCEEDED:
+                continue
+            if spec.objective_metric not in obs:
+                continue
+            val = float(obs[spec.objective_metric])
+            if best is None or spec.sign() * val > spec.sign() * best["objective"]:
+                best = {
+                    "name": t["metadata"]["name"],
+                    "parameters": dict(t["spec"].get("parameters", {})),
+                    "objective": val,
+                }
+        return best
+
+    def _set_status(self, study: o.Obj, status: Dict[str, Any]) -> None:
+        current = study.get("status", {})
+        if all(current.get(k) == v for k, v in status.items()):
+            return
+        study = dict(study)
+        study["status"] = {**current, **status}
+        try:
+            self.client.update_status(study)
+        except ApiError as e:
+            if e.code != 404:
+                raise
+
+    # -- runtime -----------------------------------------------------------
+
+    def build_controller(self) -> Controller:
+        ctrl = Controller(
+            self.client, STUDY_API_VERSION, STUDY_KIND, self.reconcile,
+            namespace=self.namespace, name="study-controller",
+        )
+
+        def to_study(obj: o.Obj):
+            labels = obj.get("metadata", {}).get("labels", {}) or {}
+            s = labels.get(STUDY_LABEL)
+            if s:
+                return (obj["metadata"].get("namespace", ""), s)
+            return None
+
+        ctrl.watch_owned(STUDY_API_VERSION, TRIAL_KIND, to_study)
+        ctrl.watch_owned(TPUJOB_API_VERSION, TPUJOB_KIND, to_study)
+        return ctrl
+
+
+def main() -> None:
+    import os
+
+    from kubeflow_tpu.k8s.client import HttpKubeClient
+    from kubeflow_tpu.utils import serve_metrics
+
+    logging.basicConfig(level=logging.INFO)
+    ns = os.environ.get("KFTPU_TUNING_NAMESPACE") or None
+    serve_metrics(int(os.environ.get("KFTPU_MONITORING_PORT", "8444")))
+    StudyController(HttpKubeClient(), namespace=ns).build_controller().run_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,200 @@
+"""Typed hyperparameter search space.
+
+Parameter kinds mirror the reference's Katib StudyJob parameterconfigs
+(double/int/categorical/discrete — the four types its suggestion services
+accept, ``/root/reference/kubeflow/katib/studyjobcontroller.libsonnet``
+CRD + the katib-studyjob-test prototype
+``kubeflow/examples/prototypes/katib-studyjob-test.jsonnet``), plus a unit-
+cube encoding so Bayesian optimization can treat the space uniformly.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass
+from typing import Any, Dict, List, Mapping, Sequence, Union
+
+ParamValue = Union[float, int, str]
+
+
+@dataclass(frozen=True)
+class Double:
+    name: str
+    min: float
+    max: float
+    log: bool = False
+
+    def sample(self, rng: random.Random) -> float:
+        if self.log:
+            return math.exp(rng.uniform(math.log(self.min), math.log(self.max)))
+        return rng.uniform(self.min, self.max)
+
+    def grid(self, n: int) -> List[float]:
+        if n == 1:
+            return [self.min]
+        if self.log:
+            lo, hi = math.log(self.min), math.log(self.max)
+            return [math.exp(lo + (hi - lo) * i / (n - 1)) for i in range(n)]
+        return [self.min + (self.max - self.min) * i / (n - 1) for i in range(n)]
+
+    def encode(self, v: ParamValue) -> List[float]:
+        x = float(v)
+        if self.log:
+            lo, hi = math.log(self.min), math.log(self.max)
+            return [(math.log(max(x, 1e-300)) - lo) / (hi - lo or 1.0)]
+        return [(x - self.min) / ((self.max - self.min) or 1.0)]
+
+    def decode(self, u: Sequence[float]) -> float:
+        t = min(max(u[0], 0.0), 1.0)
+        if self.log:
+            lo, hi = math.log(self.min), math.log(self.max)
+            return math.exp(lo + t * (hi - lo))
+        return self.min + t * (self.max - self.min)
+
+    @property
+    def dim(self) -> int:
+        return 1
+
+
+@dataclass(frozen=True)
+class Int:
+    name: str
+    min: int
+    max: int
+
+    def sample(self, rng: random.Random) -> int:
+        return rng.randint(self.min, self.max)
+
+    def grid(self, n: int) -> List[int]:
+        span = self.max - self.min
+        n = min(n, span + 1)
+        if n == 1:
+            return [self.min]
+        vals = sorted({self.min + round(span * i / (n - 1)) for i in range(n)})
+        return [int(v) for v in vals]
+
+    def encode(self, v: ParamValue) -> List[float]:
+        span = (self.max - self.min) or 1
+        return [(float(v) - self.min) / span]
+
+    def decode(self, u: Sequence[float]) -> int:
+        t = min(max(u[0], 0.0), 1.0)
+        return int(round(self.min + t * (self.max - self.min)))
+
+    @property
+    def dim(self) -> int:
+        return 1
+
+
+@dataclass(frozen=True)
+class Categorical:
+    name: str
+    choices: tuple
+
+    def sample(self, rng: random.Random) -> str:
+        return rng.choice(list(self.choices))
+
+    def grid(self, n: int) -> List[str]:
+        return list(self.choices)
+
+    def encode(self, v: ParamValue) -> List[float]:
+        # one-hot: the only encoding that doesn't invent an order
+        return [1.0 if c == v else 0.0 for c in self.choices]
+
+    def decode(self, u: Sequence[float]) -> str:
+        best = max(range(len(self.choices)), key=lambda i: u[i])
+        return self.choices[best]
+
+    @property
+    def dim(self) -> int:
+        return len(self.choices)
+
+
+@dataclass(frozen=True)
+class Discrete:
+    name: str
+    values: tuple
+
+    def sample(self, rng: random.Random) -> float:
+        return rng.choice(list(self.values))
+
+    def grid(self, n: int) -> List[float]:
+        return list(self.values)
+
+    def encode(self, v: ParamValue) -> List[float]:
+        idx = self.values.index(type(self.values[0])(v))
+        span = (len(self.values) - 1) or 1
+        return [idx / span]
+
+    def decode(self, u: Sequence[float]) -> float:
+        t = min(max(u[0], 0.0), 1.0)
+        return self.values[int(round(t * (len(self.values) - 1)))]
+
+    @property
+    def dim(self) -> int:
+        return 1
+
+
+Parameter = Union[Double, Int, Categorical, Discrete]
+
+
+def parse_parameter(d: Mapping[str, Any]) -> Parameter:
+    """Parse one parameter spec dict (the CR-facing schema)."""
+    name = d["name"]
+    ptype = d.get("type", "double")
+    if ptype == "double":
+        return Double(name, float(d["min"]), float(d["max"]),
+                      bool(d.get("log", False)))
+    if ptype == "int":
+        return Int(name, int(d["min"]), int(d["max"]))
+    if ptype == "categorical":
+        return Categorical(name, tuple(d["choices"]))
+    if ptype == "discrete":
+        return Discrete(name, tuple(d["values"]))
+    raise ValueError(f"unknown parameter type {ptype!r} for {name!r}")
+
+
+class SearchSpace:
+    """An ordered set of parameters with a flat unit-cube encoding."""
+
+    def __init__(self, params: Sequence[Parameter]) -> None:
+        if not params:
+            raise ValueError("search space needs at least one parameter")
+        names = [p.name for p in params]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate parameter names in {names}")
+        self.params: List[Parameter] = list(params)
+
+    @classmethod
+    def from_dicts(cls, dicts: Sequence[Mapping[str, Any]]) -> "SearchSpace":
+        return cls([parse_parameter(d) for d in dicts])
+
+    @property
+    def dim(self) -> int:
+        return sum(p.dim for p in self.params)
+
+    def sample(self, rng: random.Random) -> Dict[str, ParamValue]:
+        return {p.name: p.sample(rng) for p in self.params}
+
+    def encode(self, assignment: Mapping[str, ParamValue]) -> List[float]:
+        out: List[float] = []
+        for p in self.params:
+            out.extend(p.encode(assignment[p.name]))
+        return out
+
+    def decode(self, u: Sequence[float]) -> Dict[str, ParamValue]:
+        out: Dict[str, ParamValue] = {}
+        i = 0
+        for p in self.params:
+            out[p.name] = p.decode(u[i:i + p.dim])
+            i += p.dim
+        return out
+
+    def grid(self, points_per_double: int = 5) -> List[Dict[str, ParamValue]]:
+        """Full cartesian grid (GridSearch's enumeration)."""
+        axes = [p.grid(points_per_double) for p in self.params]
+        combos: List[Dict[str, ParamValue]] = [{}]
+        for p, axis in zip(self.params, axes):
+            combos = [dict(c, **{p.name: v}) for c in combos for v in axis]
+        return combos

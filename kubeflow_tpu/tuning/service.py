@@ -1,0 +1,97 @@
+"""Per-algorithm suggestion service over HTTP.
+
+Parity with the reference's suggestion microservices — one Deployment per
+algorithm speaking vizier gRPC on :6789
+(``/root/reference/kubeflow/katib/suggestion.libsonnet:44-240``). The TPU
+build keeps the one-service-per-algorithm deployment shape but speaks JSON
+over HTTP (stdlib only), backed by the same in-process algorithm library the
+controller uses, so remote and in-process suggestions cannot diverge.
+
+POST /suggest
+  {"algorithm": "bayesian", "parameters": [...], "count": 2, "seed": 7,
+   "settings": {...}, "trials": [{"parameters": {...}, "objective": 0.3,
+   "failed": false}, ...]}
+→ {"assignments": [{...}, ...]}
+GET /healthz → {"ok": true, "algorithms": [...]}
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from kubeflow_tpu.tuning.search_space import SearchSpace
+from kubeflow_tpu.tuning.suggestions import (
+    TrialRecord,
+    algorithm_names,
+    get_suggestion,
+)
+
+DEFAULT_PORT = 6789  # same port the reference's suggestion services bind
+
+
+def handle_suggest(body: dict) -> dict:
+    space = SearchSpace.from_dicts(body["parameters"])
+    algo = get_suggestion(
+        body.get("algorithm", "random"), space,
+        seed=int(body.get("seed", 0)), settings=body.get("settings"))
+    trials = [
+        TrialRecord(
+            parameters=t.get("parameters", {}),
+            objective=t.get("objective"),
+            failed=bool(t.get("failed", False)),
+        )
+        for t in body.get("trials", [])
+    ]
+    assignments = algo.suggest(trials, int(body.get("count", 1)))
+    return {"assignments": assignments}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def _send(self, code: int, payload: dict) -> None:
+        data = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802
+        if self.path == "/healthz":
+            self._send(200, {"ok": True, "algorithms": algorithm_names()})
+        else:
+            self._send(404, {"error": "not found"})
+
+    def do_POST(self):  # noqa: N802
+        if self.path != "/suggest":
+            self._send(404, {"error": "not found"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(body, dict):
+                raise ValueError("request body must be a JSON object")
+            self._send(200, handle_suggest(body))
+        except (ValueError, KeyError, TypeError, AttributeError) as e:
+            self._send(400, {"error": str(e)})
+
+    def log_message(self, *a):  # quiet
+        pass
+
+
+def serve(port: int = DEFAULT_PORT,
+          background: bool = False) -> Optional[ThreadingHTTPServer]:
+    srv = ThreadingHTTPServer(("0.0.0.0", port), _Handler)
+    if background:
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv
+    srv.serve_forever()
+    return None
+
+
+if __name__ == "__main__":
+    import os
+
+    serve(int(os.environ.get("KFTPU_SUGGESTION_PORT", str(DEFAULT_PORT))))

@@ -1,0 +1,154 @@
+"""Study / Trial CR types and the trial-metrics contract.
+
+The reference models this as StudyJob CRs whose controller spawns trial
+workers plus a metrics-collector CronJob per trial that scrapes stdout
+(``/root/reference/kubeflow/katib/studyjobcontroller.libsonnet:14-23``
+CRD, ``:107-147`` collector template). Here trials are first-class Trial
+CRs owning TpuJobs, and metrics are pushed by the workload itself via
+:func:`report_trial_metrics` (a labeled ConfigMap) — no log scraping.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Optional
+
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.k8s.client import KubeClient, register_plural
+from kubeflow_tpu.manifests.components.tpujob_operator import GROUP, VERSION
+
+STUDY_API_VERSION = f"{GROUP}/{VERSION}"
+STUDY_KIND = "Study"
+STUDY_PLURAL = "studies"
+TRIAL_KIND = "Trial"
+TRIAL_PLURAL = "trials"
+
+STUDY_LABEL = "kubeflow-tpu.org/study-name"
+TRIAL_LABEL = "kubeflow-tpu.org/trial-name"
+
+register_plural(STUDY_KIND, STUDY_PLURAL)
+register_plural(TRIAL_KIND, TRIAL_PLURAL)
+
+
+@dataclass
+class StudySpec:
+    """Typed view of a Study CR's spec."""
+
+    objective_metric: str
+    objective_type: str = "maximize"  # maximize | minimize
+    goal: Optional[float] = None
+    algorithm: str = "random"
+    algorithm_settings: Dict[str, Any] = field(default_factory=dict)
+    parameters: List[Dict[str, Any]] = field(default_factory=list)
+    parallel_trials: int = 3
+    max_trials: int = 12
+    max_failed_trials: int = 3
+    trial_template: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, spec: Mapping[str, Any]) -> "StudySpec":
+        obj = spec.get("objective", {}) or {}
+        alg = spec.get("algorithm", {}) or {}
+        out = cls(
+            objective_metric=obj.get("metric", ""),
+            objective_type=obj.get("type", "maximize"),
+            goal=obj.get("goal"),
+            algorithm=alg.get("name", "random"),
+            algorithm_settings=dict(alg.get("settings", {}) or {}),
+            parameters=list(spec.get("parameters", []) or []),
+            parallel_trials=int(spec.get("parallelTrials", 3)),
+            max_trials=int(spec.get("maxTrials", 12)),
+            max_failed_trials=int(spec.get("maxFailedTrials", 3)),
+            trial_template=dict(spec.get("trialTemplate", {}) or {}),
+        )
+        out.validate()
+        return out
+
+    def validate(self) -> None:
+        if not self.objective_metric:
+            raise ValueError("spec.objective.metric is required")
+        if self.objective_type not in ("maximize", "minimize"):
+            raise ValueError(
+                f"objective.type must be maximize|minimize, got "
+                f"{self.objective_type!r}")
+        if not self.parameters:
+            raise ValueError("spec.parameters must be non-empty")
+        if self.parallel_trials < 1 or self.max_trials < 1:
+            raise ValueError("parallelTrials and maxTrials must be >= 1")
+        if not self.trial_template.get("image"):
+            raise ValueError("spec.trialTemplate.image is required")
+
+    def sign(self) -> float:
+        """Multiplier mapping raw objective → internal maximize space."""
+        return 1.0 if self.objective_type == "maximize" else -1.0
+
+
+def study(name: str, ns: str, spec: Mapping[str, Any]) -> o.Obj:
+    """Build a Study CR dict (prototype equivalent of
+    ``kubeflow/examples/prototypes/katib-studyjob-test.jsonnet``)."""
+    StudySpec.from_dict(spec)
+    return {
+        "apiVersion": STUDY_API_VERSION,
+        "kind": STUDY_KIND,
+        "metadata": {"name": name, "namespace": ns},
+        "spec": dict(spec),
+    }
+
+
+def trial(study_obj: o.Obj, index: int,
+          parameters: Mapping[str, Any]) -> o.Obj:
+    sname = study_obj["metadata"]["name"]
+    ns = study_obj["metadata"]["namespace"]
+    t = {
+        "apiVersion": STUDY_API_VERSION,
+        "kind": TRIAL_KIND,
+        "metadata": {
+            "name": f"{sname}-t{index}",
+            "namespace": ns,
+            "labels": {STUDY_LABEL: sname},
+        },
+        "spec": {"index": index, "parameters": dict(parameters)},
+    }
+    return o.set_owner(t, study_obj)
+
+
+def substitute(template: Any, parameters: Mapping[str, Any]) -> Any:
+    """Deep-substitute ``${trialParameters.<name>}`` placeholders in strings
+    (the reference's trial templates do the same with go-template worker
+    specs inside the StudyJob CR)."""
+    if isinstance(template, str):
+        out = template
+        for k, v in parameters.items():
+            out = out.replace("${trialParameters.%s}" % k, str(v))
+        return out
+    if isinstance(template, Mapping):
+        return {k: substitute(v, parameters) for k, v in template.items()}
+    if isinstance(template, list):
+        return [substitute(v, parameters) for v in template]
+    return template
+
+
+def metrics_configmap_name(trial_name: str) -> str:
+    return f"{trial_name}-metrics"
+
+
+def report_trial_metrics(client: KubeClient, ns: str, trial_name: str,
+                         metrics: Mapping[str, float]) -> None:
+    """Called by the workload (the trainer's tuning hook) to publish final
+    metrics; replaces the reference's log-scraping metrics-collector."""
+    cm = o.config_map(
+        metrics_configmap_name(trial_name), ns,
+        {k: json.dumps(float(v)) for k, v in metrics.items()},
+    )
+    cm["metadata"]["labels"] = {TRIAL_LABEL: trial_name}
+    client.apply(cm)
+
+
+def read_trial_metrics(client: KubeClient, ns: str,
+                       trial_name: str) -> Optional[Dict[str, float]]:
+    cm = client.get_or_none("v1", "ConfigMap", ns,
+                            metrics_configmap_name(trial_name))
+    if cm is None:
+        return None
+    return {k: float(json.loads(v)) for k, v in (cm.get("data") or {}).items()}

@@ -1,0 +1,264 @@
+"""Suggestion algorithms: random, grid, bayesian, hyperband.
+
+The reference runs one suggestion microservice per algorithm — random, grid,
+hyperband, bayesian-optimization Deployments each speaking vizier gRPC
+(``/root/reference/kubeflow/katib/suggestion.libsonnet:44-240``). Here the
+algorithms are a pure library with one stateless entry point
+(:meth:`Suggestion.suggest` over the full trial history), so the study
+controller, the HTTP suggestion service, and tests all share one code path.
+
+All algorithms treat the objective as MAXIMIZE; the controller negates
+minimize objectives before calling in.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+import random
+from dataclasses import dataclass
+from typing import Any, Dict, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+from kubeflow_tpu.tuning.search_space import ParamValue, SearchSpace
+
+
+@dataclass(frozen=True)
+class TrialRecord:
+    """What the controller knows about one trial, completed or not."""
+
+    parameters: Dict[str, ParamValue]
+    objective: Optional[float] = None  # None while running / if failed
+    failed: bool = False
+
+
+def _key(params: Mapping[str, ParamValue]) -> str:
+    return "|".join(f"{k}={params[k]}" for k in sorted(params))
+
+
+class Suggestion:
+    """Base: propose up to ``count`` new assignments given trial history."""
+
+    name = "base"
+
+    def __init__(self, space: SearchSpace, seed: int = 0,
+                 settings: Optional[Mapping[str, Any]] = None) -> None:
+        self.space = space
+        self.seed = seed
+        self.settings = dict(settings or {})
+
+    def suggest(self, trials: Sequence[TrialRecord],
+                count: int) -> List[Dict[str, ParamValue]]:
+        raise NotImplementedError
+
+
+class RandomSearch(Suggestion):
+    name = "random"
+
+    def suggest(self, trials, count):
+        # deterministic given history length: replayable after controller
+        # restarts without persisted RNG state
+        rng = random.Random(f"{self.seed}:{len(trials)}")
+        return [self.space.sample(rng) for _ in range(count)]
+
+
+class GridSearch(Suggestion):
+    name = "grid"
+
+    def suggest(self, trials, count):
+        points = int(self.settings.get("points_per_double", 5))
+        seen = {_key(t.parameters) for t in trials}
+        out = []
+        for combo in self.space.grid(points):
+            if _key(combo) not in seen:
+                out.append(combo)
+                seen.add(_key(combo))
+            if len(out) >= count:
+                break
+        return out  # may be shorter: grid exhausted
+
+
+class BayesianOptimization(Suggestion):
+    """GP (RBF kernel) + expected improvement over the unit cube.
+
+    numpy-only: Cholesky posterior, EI maximized over a random candidate
+    pool plus perturbations of the incumbent.
+    """
+
+    name = "bayesian"
+
+    def suggest(self, trials, count):
+        n_init = int(self.settings.get("n_initial", 5))
+        done = [t for t in trials if t.objective is not None and not t.failed]
+        rng = random.Random(f"{self.seed}:{len(trials)}")
+        if len(done) < n_init:
+            return [self.space.sample(rng) for _ in range(count)]
+
+        X = np.array([self.space.encode(t.parameters) for t in done])
+        y = np.array([t.objective for t in done], dtype=np.float64)
+        y_mean, y_std = y.mean(), y.std() or 1.0
+        yn = (y - y_mean) / y_std
+
+        ls = float(self.settings.get("length_scale", 0.25))
+        noise = float(self.settings.get("noise", 1e-4))
+        K = self._rbf(X, X, ls) + noise * np.eye(len(X))
+        L = np.linalg.cholesky(K)
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+
+        out: List[Dict[str, ParamValue]] = []
+        seen = {_key(t.parameters) for t in trials}
+        best = float(yn.max())
+        for _ in range(count):
+            cand = self._candidates(rng, X[int(np.argmax(yn))])
+            Ks = self._rbf(X, cand, ls)
+            mu = Ks.T @ alpha
+            v = np.linalg.solve(L, Ks)
+            var = np.maximum(1.0 - np.sum(v * v, axis=0), 1e-12)
+            sigma = np.sqrt(var)
+            z = (mu - best - 0.01) / sigma
+            ei = (mu - best - 0.01) * self._ncdf(z) + sigma * self._npdf(z)
+            for idx in np.argsort(-ei):
+                params = self.space.decode(list(cand[idx]))
+                if _key(params) not in seen:
+                    out.append(params)
+                    seen.add(_key(params))
+                    break
+            else:  # everything duplicate: fall back to random
+                out.append(self.space.sample(rng))
+        return out
+
+    def _candidates(self, rng: random.Random, incumbent: np.ndarray) -> np.ndarray:
+        pool = int(self.settings.get("candidate_pool", 256))
+        d = self.space.dim
+        nprng = np.random.default_rng(rng.getrandbits(32))
+        uniform = nprng.random((pool, d))
+        local = np.clip(
+            incumbent[None, :] + 0.1 * nprng.standard_normal((pool // 4, d)),
+            0.0, 1.0)
+        return np.vstack([uniform, local])
+
+    @staticmethod
+    def _rbf(A: np.ndarray, B: np.ndarray, ls: float) -> np.ndarray:
+        d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / (ls * ls))
+
+    @staticmethod
+    def _ncdf(z: np.ndarray) -> np.ndarray:
+        return 0.5 * (1.0 + np.vectorize(math.erf)(z / math.sqrt(2.0)))
+
+    @staticmethod
+    def _npdf(z: np.ndarray) -> np.ndarray:
+        return np.exp(-0.5 * z * z) / math.sqrt(2.0 * math.pi)
+
+
+class Hyperband(Suggestion):
+    """Hyperband successive halving over a resource parameter.
+
+    ``settings``: ``resource`` (parameter name injected into each trial,
+    e.g. training steps), ``max_resource`` R, ``eta`` (default 3).
+
+    The bracket/rung schedule is deterministic, and trials are proposed in
+    schedule order, so the algorithm reconstructs its position purely from
+    the trial history: trial i fills schedule slot i. Rung k>0 of a bracket
+    only opens once rung k-1 is fully observed; promotions are the top
+    ``1/eta`` configs by objective, re-proposed with ``eta×`` resource.
+    """
+
+    name = "hyperband"
+
+    def __init__(self, space, seed=0, settings=None):
+        super().__init__(space, seed, settings)
+        self.resource = self.settings.get("resource", "resource")
+        self.R = int(self.settings.get("max_resource", 81))
+        self.eta = int(self.settings.get("eta", 3))
+
+    def schedule(self) -> List[List[Dict[str, int]]]:
+        """brackets -> rungs -> {n: configs, r: resource-per-config}."""
+        s_max = int(math.floor(math.log(self.R) / math.log(self.eta)))
+        brackets = []
+        for s in range(s_max, -1, -1):
+            n = int(math.ceil((s_max + 1) * self.eta ** s / (s + 1)))
+            r = self.R * self.eta ** (-s)
+            rungs = []
+            for i in range(s + 1):
+                n_i = int(math.floor(n * self.eta ** (-i)))
+                r_i = int(round(r * self.eta ** i))
+                rungs.append({"n": max(n_i, 1), "r": max(r_i, 1)})
+            brackets.append(rungs)
+        return brackets
+
+    def suggest(self, trials, count):
+        sched = self.schedule()
+        # flatten: slot t -> (bracket, rung, index-in-rung)
+        slots: List[Any] = []
+        for b, rungs in enumerate(sched):
+            for k, rung in enumerate(rungs):
+                for j in range(rung["n"]):
+                    slots.append((b, k, j, rung["r"]))
+
+        out: List[Dict[str, ParamValue]] = []
+        # trials already proposed occupy slots [0, len(trials))
+        for t in range(len(trials), min(len(slots), len(trials) + count)):
+            b, k, j, r = slots[t]
+            if k == 0:
+                rng = random.Random(f"{self.seed}:{b}:{j}")
+                params = self.space.sample(rng)
+            else:
+                promoted = self._promote(sched, trials, b, k)
+                if promoted is None:
+                    break  # previous rung not fully observed yet
+                if j < len(promoted):
+                    params = dict(promoted[j])
+                else:
+                    # failed trials left fewer survivors than the rung has
+                    # slots: spend the leftover budget on fresh configs
+                    # instead of deadlocking the positional schedule
+                    rng = random.Random(f"{self.seed}:fill:{b}:{k}:{j}")
+                    params = self.space.sample(rng)
+            params[self.resource] = r
+            out.append(params)
+        return out
+
+    def _promote(self, sched, trials, bracket: int, rung: int):
+        """Top 1/eta configs of (bracket, rung-1), or None if incomplete."""
+        start = 0
+        for b in range(bracket):
+            start += sum(rg["n"] for rg in sched[b])
+        for k in range(rung - 1):
+            start += sched[bracket][k]["n"]
+        prev_n = sched[bracket][rung - 1]["n"]
+        prev = list(trials)[start:start + prev_n]
+        if len(prev) < prev_n or any(
+                t.objective is None and not t.failed for t in prev):
+            return None
+        scored = [t for t in prev if t.objective is not None]
+        scored.sort(key=lambda t: -t.objective)
+        keep = sched[bracket][rung]["n"]
+        return [
+            {k: v for k, v in t.parameters.items() if k != self.resource}
+            for t in scored[:keep]
+        ]
+
+
+_ALGORITHMS = {
+    cls.name: cls
+    for cls in (RandomSearch, GridSearch, BayesianOptimization, Hyperband)
+}
+
+
+def get_suggestion(name: str, space: SearchSpace, *, seed: int = 0,
+                   settings: Optional[Mapping[str, Any]] = None) -> Suggestion:
+    if name not in _ALGORITHMS:
+        raise ValueError(
+            f"unknown algorithm {name!r}; have {sorted(_ALGORITHMS)}")
+    return _ALGORITHMS[name](space, seed=seed, settings=settings)
+
+
+def algorithm_names() -> List[str]:
+    return sorted(_ALGORITHMS)
+
+
+def stable_seed(study_name: str) -> int:
+    return int.from_bytes(
+        hashlib.sha256(study_name.encode()).digest()[:4], "big")

@@ -35,6 +35,32 @@ def test_workqueue_dedup_and_delay():
     assert q.get(timeout=0.1) is None
 
 
+def test_workqueue_single_flight():
+    # a key being processed is never handed to a second worker; re-adds
+    # mid-flight land in the dirty set and re-enqueue on done()
+    q = WorkQueue()
+    q.add(("ns", "a"))
+    assert q.get(timeout=1) == ("ns", "a")
+    q.add(("ns", "a"))  # arrives while in-flight
+    assert q.get(timeout=0.1) is None  # not handed out again yet
+    q.done(("ns", "a"))
+    assert q.get(timeout=1) == ("ns", "a")  # dirty flushed
+    q.done(("ns", "a"))
+    assert q.get(timeout=0.1) is None
+    q.shutdown()
+
+
+def test_workqueue_done_preserves_requeue_delay():
+    q = WorkQueue()
+    q.add(("ns", "a"))
+    assert q.get(timeout=1) == ("ns", "a")
+    q.add(("ns", "a"), delay=0.3)  # requeue-after issued mid-flight
+    q.done(("ns", "a"))
+    assert q.get(timeout=0.05) is None  # delay honored
+    assert q.get(timeout=1) == ("ns", "a")
+    q.shutdown()
+
+
 def test_controller_end_to_end_lifecycle():
     client = FakeKubeClient()
     operator = TpuJobOperator(client)
