@@ -2,7 +2,9 @@
 
 from kubeflow_tpu.manifests.components import (  # noqa: F401
     dashboard,
+    notebooks,
     serving,
+    tenancy,
     tpujob_operator,
     tuning,
 )

@@ -1,0 +1,86 @@
+"""Notebooks component: Notebook CRD + controller + web-app Deployments.
+
+Manifest parity with the reference's jupyter package + notebook-controller
+deploy (``/root/reference/kubeflow/jupyter/notebooks.libsonnet:7-27`` CRD,
+``notebook_controller.libsonnet``) and jupyter-web-app
+(``components/jupyter-web-app``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from kubeflow_tpu.config.deployment import DeploymentConfig
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.manifests.components.tpujob_operator import GROUP, VERSION
+from kubeflow_tpu.manifests.registry import register
+
+DEFAULTS: Dict[str, Any] = {
+    "image": "kubeflow-tpu/platform:v1alpha1",
+    "enable_culling": False,
+    "cull_idle_minutes": 1440,
+    "webapp_port": 5000,
+}
+
+
+def notebook_crd() -> o.Obj:
+    return o.crd(
+        "notebooks", GROUP, "Notebook",
+        versions=(VERSION,),
+        short_names=("nb",),
+        printer_columns=(
+            {"name": "State", "type": "string", "jsonPath": ".status.phase"},
+            {"name": "Age", "type": "date",
+             "jsonPath": ".metadata.creationTimestamp"},
+        ),
+    )
+
+
+@register("notebooks", DEFAULTS,
+          "Notebook CRD + controller + web app (jupyter parity)")
+def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
+    ns = config.namespace
+    ctrl_name = "notebook-controller"
+    rules = [
+        {"apiGroups": [GROUP],
+         "resources": ["notebooks", "notebooks/status"], "verbs": ["*"]},
+        {"apiGroups": ["apps"], "resources": ["statefulsets"], "verbs": ["*"]},
+        {"apiGroups": [""],
+         "resources": ["pods", "services", "events",
+                       "persistentvolumeclaims", "namespaces"],
+         "verbs": ["*"]},
+    ]
+    ctrl_pod = o.pod_spec(
+        [o.container(
+            ctrl_name,
+            params["image"],
+            command=["python", "-m", "kubeflow_tpu.notebooks.controller"],
+            env={
+                "ENABLE_CULLING": str(params["enable_culling"]).lower(),
+                "CULL_IDLE_TIME": str(params["cull_idle_minutes"]),
+            },
+        )],
+        service_account_name=ctrl_name,
+    )
+    webapp_name = "notebook-webapp"
+    webapp_pod = o.pod_spec(
+        [o.container(
+            webapp_name,
+            params["image"],
+            command=["python", "-m", "kubeflow_tpu.notebooks.webapp"],
+            env={"KFTPU_WEBAPP_PORT": str(params["webapp_port"])},
+            ports=[params["webapp_port"]],
+        )],
+        service_account_name=ctrl_name,
+    )
+    return [
+        notebook_crd(),
+        o.service_account(ctrl_name, ns),
+        o.cluster_role(ctrl_name, rules),
+        o.cluster_role_binding(ctrl_name, ctrl_name, ctrl_name, ns),
+        o.deployment(ctrl_name, ns, ctrl_pod),
+        o.deployment(webapp_name, ns, webapp_pod),
+        o.service(webapp_name, ns, {"app": webapp_name},
+                  [{"name": "http", "port": 80,
+                    "targetPort": params["webapp_port"]}]),
+    ]

@@ -1,0 +1,112 @@
+"""Tenancy component: Profile/PodDefault CRDs, controllers, kfam, roles.
+
+Manifest parity with the reference's profiles package + profile-controller
+(``/root/reference/kubeflow/profiles/``), admission-webhook manifests
+(``kubeflow/admission-webhook/``), and the kfam Deployment
+(``components/access-management/``). Also defines the kubeflow-admin/
+edit/view ClusterRoles every tenant RoleBinding references.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from kubeflow_tpu.config.deployment import DeploymentConfig
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.manifests.components.tpujob_operator import GROUP, VERSION
+from kubeflow_tpu.manifests.registry import register
+
+DEFAULTS: Dict[str, Any] = {
+    "image": "kubeflow-tpu/platform:v1alpha1",
+    "cluster_admins": [],
+    "kfam_port": 8081,
+}
+
+
+def profile_crd() -> o.Obj:
+    return o.crd(
+        "profiles", GROUP, "Profile",
+        versions=(VERSION,),
+        scope="Cluster",
+        printer_columns=(
+            {"name": "State", "type": "string", "jsonPath": ".status.phase"},
+        ),
+    )
+
+
+def poddefault_crd() -> o.Obj:
+    return o.crd("poddefaults", GROUP, "PodDefault", versions=(VERSION,))
+
+
+def tenant_cluster_roles() -> List[o.Obj]:
+    """The admin/edit/view trio tenant RoleBindings reference."""
+    everything = [{"apiGroups": ["", "apps", GROUP],
+                   "resources": ["*"], "verbs": ["*"]}]
+    edit = [
+        {"apiGroups": ["", "apps", GROUP],
+         "resources": ["pods", "services", "configmaps",
+                       "persistentvolumeclaims", "statefulsets",
+                       "tpujobs", "notebooks", "studies", "trials"],
+         "verbs": ["get", "list", "watch", "create", "update", "patch",
+                   "delete"]},
+    ]
+    view = [
+        {"apiGroups": ["", "apps", GROUP],
+         "resources": ["pods", "services", "configmaps", "statefulsets",
+                       "tpujobs", "notebooks", "studies", "trials"],
+         "verbs": ["get", "list", "watch"]},
+    ]
+    return [
+        o.cluster_role("kubeflow-admin", everything),
+        o.cluster_role("kubeflow-edit", edit),
+        o.cluster_role("kubeflow-view", view),
+    ]
+
+
+@register("tenancy", DEFAULTS,
+          "Profiles, PodDefault webhook, access management (kfam parity)")
+def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
+    ns = config.namespace
+    name = "profile-controller"
+    rules = [
+        {"apiGroups": [GROUP],
+         "resources": ["profiles", "profiles/status", "poddefaults"],
+         "verbs": ["*"]},
+        {"apiGroups": [""],
+         "resources": ["namespaces", "serviceaccounts", "resourcequotas"],
+         "verbs": ["*"]},
+        {"apiGroups": ["rbac.authorization.k8s.io"],
+         "resources": ["rolebindings", "clusterroles"], "verbs": ["*"]},
+    ]
+    ctrl_pod = o.pod_spec(
+        [o.container(
+            name, params["image"],
+            command=["python", "-m", "kubeflow_tpu.tenancy.profiles"],
+        )],
+        service_account_name=name,
+    )
+    kfam_pod = o.pod_spec(
+        [o.container(
+            "kfam", params["image"],
+            command=["python", "-m", "kubeflow_tpu.tenancy.kfam"],
+            env={
+                "CLUSTER_ADMINS": ",".join(params["cluster_admins"]),
+                "KFTPU_KFAM_PORT": str(params["kfam_port"]),
+            },
+            ports=[params["kfam_port"]],
+        )],
+        service_account_name=name,
+    )
+    return [
+        profile_crd(),
+        poddefault_crd(),
+        *tenant_cluster_roles(),
+        o.service_account(name, ns),
+        o.cluster_role(name, rules),
+        o.cluster_role_binding(name, name, name, ns),
+        o.deployment(name, ns, ctrl_pod),
+        o.deployment("kfam", ns, kfam_pod),
+        o.service("kfam", ns, {"app": "kfam"},
+                  [{"name": "http", "port": params["kfam_port"],
+                    "targetPort": params["kfam_port"]}]),
+    ]

@@ -70,6 +70,11 @@ def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
          "verbs": ["*"]},
         {"apiGroups": [""], "resources": ["configmaps", "events"],
          "verbs": ["*"]},
+        # the controller provisions trial-metrics-writer Role/RoleBindings
+        # in every namespace where studies run
+        {"apiGroups": ["rbac.authorization.k8s.io"],
+         "resources": ["roles", "rolebindings"],
+         "verbs": ["get", "create", "update"]},
     ]
     pod = o.pod_spec(
         [o.container(

@@ -1,0 +1,18 @@
+"""Notebook subsystem: CRD + controller + culler + web backend.
+
+Reference surface: the notebook-controller kubebuilder program
+(``/root/reference/components/notebook-controller/``), the jupyter ksonnet
+package (``/root/reference/kubeflow/jupyter/``), and the jupyter-web-app
+Flask backend (``/root/reference/components/jupyter-web-app/``). Here the
+controller runs on the framework's own controller runtime, and notebook
+pods are schedulable onto TPU hosts via a chips request.
+"""
+
+from kubeflow_tpu.notebooks.controller import (  # noqa: F401
+    NOTEBOOK_API_VERSION,
+    NOTEBOOK_KIND,
+    NotebookController,
+    notebook,
+)
+from kubeflow_tpu.notebooks.culler import CullingPolicy, should_cull  # noqa: F401
+from kubeflow_tpu.notebooks.webapp import NotebookWebApp  # noqa: F401

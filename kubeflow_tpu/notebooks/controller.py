@@ -1,0 +1,238 @@
+"""Notebook controller: Notebook CR → StatefulSet + Service (+ culling).
+
+Reference: ``/root/reference/components/notebook-controller/controllers/
+notebook_controller.go`` — reconcile at :167-307 builds a StatefulSet
+(replicas 0 when the stop annotation is set) and a Service :80→8888,
+mirrors pod container state into status conditions (:309-336), and drives
+idle culling via annotations + RequeueAfter (:288-305). TPU twist: a
+notebook can request TPU chips, which lands as a ``google.com/tpu``
+resource limit + accelerator node selector.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.k8s.client import ApiError, KubeClient, register_plural
+from kubeflow_tpu.manifests.components.tpujob_operator import GROUP, VERSION
+from kubeflow_tpu.notebooks import culler
+from kubeflow_tpu.operators.controller import Controller
+
+log = logging.getLogger(__name__)
+
+NOTEBOOK_API_VERSION = f"{GROUP}/{VERSION}"
+NOTEBOOK_KIND = "Notebook"
+NOTEBOOK_PLURAL = "notebooks"
+NOTEBOOK_LABEL = "kubeflow-tpu.org/notebook-name"
+
+NOTEBOOK_PORT = 8888
+DEFAULT_IMAGE = "jupyter/scipy-notebook:latest"
+
+register_plural(NOTEBOOK_KIND, NOTEBOOK_PLURAL)
+
+
+@dataclass
+class NotebookSpec:
+    """Typed view of a Notebook CR's spec."""
+
+    image: str = DEFAULT_IMAGE
+    cpu: str = "500m"
+    memory: str = "1Gi"
+    tpu_chips: int = 0
+    accelerator: str = "v5e-8"
+    env: Dict[str, str] = field(default_factory=dict)
+    volumes: List[Dict[str, Any]] = field(default_factory=list)
+    volume_mounts: List[Dict[str, Any]] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, spec: Dict[str, Any]) -> "NotebookSpec":
+        return cls(
+            image=spec.get("image", DEFAULT_IMAGE),
+            cpu=str(spec.get("cpu", "500m")),
+            memory=str(spec.get("memory", "1Gi")),
+            tpu_chips=int(spec.get("tpuChips", 0)),
+            accelerator=spec.get("accelerator", "v5e-8"),
+            env=dict(spec.get("env", {}) or {}),
+            volumes=list(spec.get("volumes", []) or []),
+            volume_mounts=list(spec.get("volumeMounts", []) or []),
+        )
+
+
+def notebook(name: str, ns: str, spec: Optional[Dict[str, Any]] = None) -> o.Obj:
+    return {
+        "apiVersion": NOTEBOOK_API_VERSION,
+        "kind": NOTEBOOK_KIND,
+        "metadata": {"name": name, "namespace": ns},
+        "spec": dict(spec or {}),
+    }
+
+
+def build_statefulset(nb: o.Obj) -> o.Obj:
+    name = nb["metadata"]["name"]
+    ns = nb["metadata"]["namespace"]
+    spec = NotebookSpec.from_dict(nb.get("spec", {}))
+
+    resources: Dict[str, Any] = {
+        "requests": {"cpu": spec.cpu, "memory": spec.memory},
+        "limits": {"cpu": spec.cpu, "memory": spec.memory},
+    }
+    node_selector = None
+    if spec.tpu_chips:
+        resources["limits"]["google.com/tpu"] = spec.tpu_chips
+        node_selector = {
+            "cloud.google.com/gke-tpu-accelerator": spec.accelerator}
+
+    env = dict(spec.env)
+    # same base-url contract as the reference's sync-notebook.jsonnet:12-23
+    env.setdefault("NB_PREFIX", f"/notebook/{ns}/{name}")
+    ctr = o.container(
+        "notebook",
+        spec.image,
+        env=env,
+        ports=[NOTEBOOK_PORT],
+        resources=resources,
+        volume_mounts=spec.volume_mounts or None,
+    )
+    pod = o.pod_spec(
+        [ctr],
+        volumes=spec.volumes or None,
+        node_selector=node_selector,
+    )
+    replicas = 0 if culler.is_stopped(nb) else 1
+    sts = o.stateful_set(
+        name, ns, pod, replicas=replicas, service_name=name,
+        labels={NOTEBOOK_LABEL: name, "app": name},
+    )
+    # a real apiserver defaults fields the builder omits, so comparing the
+    # stored template against the desired one is permanently unequal and
+    # would apply/watch/reconcile in a hot loop; compare this hash instead
+    sts["metadata"].setdefault("annotations", {})[SPEC_HASH_ANNOTATION] = (
+        _spec_hash(sts))
+    return o.set_owner(sts, nb)
+
+
+SPEC_HASH_ANNOTATION = "kubeflow-tpu.org/spec-hash"
+
+
+def _spec_hash(sts: o.Obj) -> str:
+    import hashlib
+    import json
+
+    payload = json.dumps(sts["spec"], sort_keys=True, default=str)
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def build_service(nb: o.Obj) -> o.Obj:
+    name = nb["metadata"]["name"]
+    ns = nb["metadata"]["namespace"]
+    svc = o.service(
+        name, ns, {NOTEBOOK_LABEL: name},
+        [{"name": "http", "port": 80, "targetPort": NOTEBOOK_PORT}],
+        labels={NOTEBOOK_LABEL: name},
+    )
+    return o.set_owner(svc, nb)
+
+
+class NotebookController:
+    """Reconciles Notebook CRs; culls idle notebooks when enabled."""
+
+    def __init__(self, client: KubeClient, namespace: Optional[str] = None,
+                 policy: Optional[culler.CullingPolicy] = None) -> None:
+        self.client = client
+        self.namespace = namespace
+        self.policy = policy or culler.CullingPolicy()
+
+    def reconcile(self, ns: str, name: str) -> Optional[float]:
+        nb = self.client.get_or_none(NOTEBOOK_API_VERSION, NOTEBOOK_KIND,
+                                     ns, name)
+        if nb is None:
+            return None
+
+        if culler.should_cull(nb, self.policy):
+            culler.stop(nb)
+            nb = self.client.update(nb)
+            log.info("culled idle notebook %s/%s", ns, name)
+
+        desired_sts = build_statefulset(nb)
+        existing = self.client.get_or_none("apps/v1", "StatefulSet", ns, name)
+        desired_hash = desired_sts["metadata"]["annotations"][
+            SPEC_HASH_ANNOTATION]
+        if existing is None:
+            self.client.create(desired_sts)
+        elif (existing.get("metadata", {}).get("annotations", {})
+                      .get(SPEC_HASH_ANNOTATION) != desired_hash):
+            self.client.apply(desired_sts)
+        if self.client.get_or_none("v1", "Service", ns, name) is None:
+            try:
+                self.client.create(build_service(nb))
+            except ApiError as e:
+                if e.code != 409:
+                    raise
+
+        self._update_status(nb)
+        if self.policy.enabled and not culler.is_stopped(nb):
+            return self.policy.check_period_seconds
+        return None
+
+    def _update_status(self, nb: o.Obj) -> None:
+        """Mirror the notebook pod's container state into status, the way
+        the reference surfaces pod state (notebook_controller.go:309-336)."""
+        ns = nb["metadata"]["namespace"]
+        name = nb["metadata"]["name"]
+        pods = self.client.list("v1", "Pod", ns,
+                                label_selector={NOTEBOOK_LABEL: name})
+        status: Dict[str, Any] = {"readyReplicas": 0, "phase": "Waiting"}
+        if culler.is_stopped(nb):
+            status["phase"] = "Stopped"
+        for pod in pods:
+            pphase = pod.get("status", {}).get("phase")
+            if pphase == "Running":
+                status["readyReplicas"] = 1
+                status["phase"] = "Running"
+            container_states = pod.get("status", {}).get(
+                "containerStatuses", [])
+            if container_states:
+                status["containerState"] = container_states[0].get("state", {})
+        if nb.get("status") != status:
+            nb = dict(nb)
+            nb["status"] = status
+            try:
+                self.client.update_status(nb)
+            except ApiError as e:
+                if e.code != 404:
+                    raise
+
+    def build_controller(self) -> Controller:
+        ctrl = Controller(
+            self.client, NOTEBOOK_API_VERSION, NOTEBOOK_KIND, self.reconcile,
+            namespace=self.namespace, name="notebook-controller",
+        )
+
+        def pod_to_nb(pod: o.Obj):
+            labels = pod.get("metadata", {}).get("labels", {}) or {}
+            nb = labels.get(NOTEBOOK_LABEL)
+            if nb:
+                return (pod["metadata"].get("namespace", ""), nb)
+            return None
+
+        ctrl.watch_owned("v1", "Pod", pod_to_nb)
+        ctrl.watch_owned("apps/v1", "StatefulSet", pod_to_nb)
+        return ctrl
+
+
+def main() -> None:
+    from kubeflow_tpu.k8s.client import HttpKubeClient
+
+    logging.basicConfig(level=logging.INFO)
+    policy = culler.CullingPolicy.from_env(dict(os.environ))
+    ns = os.environ.get("KFTPU_NOTEBOOK_NAMESPACE") or None
+    NotebookController(HttpKubeClient(), namespace=ns,
+                       policy=policy).build_controller().run_forever()
+
+
+if __name__ == "__main__":
+    main()

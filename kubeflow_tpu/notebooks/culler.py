@@ -1,0 +1,91 @@
+"""Idle-notebook culling policy.
+
+Reference: ``/root/reference/components/notebook-controller/pkg/culler/
+culler.go`` — annotations record last activity; the controller compares
+against a configurable idle window and scales the notebook to zero by
+setting a stop annotation, re-checking on a period via RequeueAfter
+(``notebook_controller.go:288-305``).
+"""
+
+from __future__ import annotations
+
+import calendar
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+# annotation contract (mirrors the reference's kubeflow-resource-stopped /
+# notebooks.kubeflow.org/last-activity pair)
+STOP_ANNOTATION = "kubeflow-tpu.org/notebook-stopped"
+LAST_ACTIVITY_ANNOTATION = "kubeflow-tpu.org/last-activity"
+
+TIME_FMT = "%Y-%m-%dT%H:%M:%SZ"
+
+
+@dataclass(frozen=True)
+class CullingPolicy:
+    enabled: bool = False
+    idle_seconds: float = 1440 * 60  # reference default: 1440 minutes
+    check_period_seconds: float = 60.0
+
+    @classmethod
+    def from_env(cls, env: Dict[str, str]) -> "CullingPolicy":
+        return cls(
+            enabled=env.get("ENABLE_CULLING", "false").lower() == "true",
+            idle_seconds=float(env.get("CULL_IDLE_TIME", "1440")) * 60,
+            check_period_seconds=float(env.get("IDLE_TIME_CHECK_PERIOD",
+                                               "1")) * 60,
+        )
+
+
+def _annotations(notebook: Dict[str, Any]) -> Dict[str, str]:
+    return notebook.get("metadata", {}).get("annotations", {}) or {}
+
+
+def is_stopped(notebook: Dict[str, Any]) -> bool:
+    return STOP_ANNOTATION in _annotations(notebook)
+
+
+def last_activity(notebook: Dict[str, Any]) -> Optional[float]:
+    raw = _annotations(notebook).get(LAST_ACTIVITY_ANNOTATION)
+    if not raw:
+        return None
+    try:
+        # timegm, not mktime: the annotation is UTC (written via gmtime);
+        # mktime would skew idle detection by the host's UTC offset
+        return float(calendar.timegm(time.strptime(raw, TIME_FMT)))
+    except ValueError:
+        return None
+
+
+def touch(notebook: Dict[str, Any], now: Optional[float] = None) -> None:
+    """Record activity now (webapp calls this on user traffic)."""
+    md = notebook.setdefault("metadata", {})
+    md.setdefault("annotations", {})[LAST_ACTIVITY_ANNOTATION] = time.strftime(
+        TIME_FMT, time.gmtime(now if now is not None else time.time()))
+
+
+def should_cull(notebook: Dict[str, Any], policy: CullingPolicy,
+                now: Optional[float] = None) -> bool:
+    """True when the notebook has been idle past the policy window.
+
+    A notebook with no recorded activity is never culled (the reference
+    likewise only culls on a positive idle signal from the jupyter API).
+    """
+    if not policy.enabled or is_stopped(notebook):
+        return False
+    seen = last_activity(notebook)
+    if seen is None:
+        return False
+    now = now if now is not None else time.time()
+    return (now - seen) > policy.idle_seconds
+
+
+def stop(notebook: Dict[str, Any], now: Optional[float] = None) -> None:
+    md = notebook.setdefault("metadata", {})
+    md.setdefault("annotations", {})[STOP_ANNOTATION] = time.strftime(
+        TIME_FMT, time.gmtime(now if now is not None else time.time()))
+
+
+def resume(notebook: Dict[str, Any]) -> None:
+    _annotations(notebook).pop(STOP_ANNOTATION, None)

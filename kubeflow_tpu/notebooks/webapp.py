@@ -1,0 +1,205 @@
+"""Notebook web backend: REST CRUD over Notebook CRs.
+
+Reference: the jupyter-web-app Flask backend
+(``/root/reference/components/jupyter-web-app/backend/kubeflow_jupyter/
+common/base_app.py:20-168`` routes; SubjectAccessReview authz in
+``common/api.py:36-66``). Routes are a pure ``handle()`` function
+(method, path, body, user) → (status, payload) served by a stdlib HTTP
+server, with a pluggable authorizer where the reference calls
+SubjectAccessReview.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from kubeflow_tpu.k8s.client import ApiError, KubeClient
+from kubeflow_tpu.notebooks import culler
+from kubeflow_tpu.notebooks.controller import (
+    NOTEBOOK_API_VERSION,
+    NOTEBOOK_KIND,
+    notebook,
+)
+from kubeflow_tpu.utils.jsonhttp import USER_HEADER, serve_json  # noqa: F401
+
+# authorizer(user, verb, namespace, resource) -> bool
+Authorizer = Callable[[str, str, str, str], bool]
+
+
+def allow_all(user: str, verb: str, ns: str, resource: str) -> bool:
+    return True
+
+
+class NotebookWebApp:
+    """Route table + handlers; independent of any HTTP server."""
+
+    def __init__(self, client: KubeClient,
+                 authorize: Authorizer = allow_all) -> None:
+        self.client = client
+        self.authorize = authorize
+        self.routes = [
+            ("GET", r"^/api/namespaces$", self.list_namespaces),
+            ("GET", r"^/api/namespaces/(?P<ns>[^/]+)/notebooks$",
+             self.list_notebooks),
+            ("POST", r"^/api/namespaces/(?P<ns>[^/]+)/notebooks$",
+             self.create_notebook),
+            ("GET", r"^/api/namespaces/(?P<ns>[^/]+)/notebooks/(?P<name>[^/]+)$",
+             self.get_notebook),
+            ("DELETE",
+             r"^/api/namespaces/(?P<ns>[^/]+)/notebooks/(?P<name>[^/]+)$",
+             self.delete_notebook),
+            ("POST",
+             r"^/api/namespaces/(?P<ns>[^/]+)/notebooks/(?P<name>[^/]+)/stop$",
+             self.stop_notebook),
+            ("POST",
+             r"^/api/namespaces/(?P<ns>[^/]+)/notebooks/(?P<name>[^/]+)/start$",
+             self.start_notebook),
+            ("GET", r"^/api/namespaces/(?P<ns>[^/]+)/poddefaults$",
+             self.list_poddefaults),
+            ("GET", r"^/api/namespaces/(?P<ns>[^/]+)/pvcs$", self.list_pvcs),
+            ("POST", r"^/api/namespaces/(?P<ns>[^/]+)/pvcs$", self.create_pvc),
+        ]
+
+    # -- dispatch ----------------------------------------------------------
+
+    def handle(self, method: str, path: str, body: Optional[Dict[str, Any]],
+               user: str = "") -> Tuple[int, Dict[str, Any]]:
+        for (m, pattern, fn) in self.routes:
+            if m != method:
+                continue
+            match = re.match(pattern, path)
+            if match:
+                try:
+                    return fn(user=user, body=body or {},
+                              **match.groupdict())
+                except ApiError as e:
+                    return e.code, {"success": False, "log": e.message}
+                except (ValueError, KeyError) as e:
+                    return 400, {"success": False, "log": str(e)}
+        return 404, {"success": False, "log": f"no route {method} {path}"}
+
+    def _authz(self, user: str, verb: str, ns: str, resource: str) -> None:
+        if not self.authorize(user, verb, ns, resource):
+            raise ApiError(403, f"{user!r} may not {verb} {resource} in {ns}")
+
+    # -- handlers ----------------------------------------------------------
+
+    def list_namespaces(self, user: str, body: Dict[str, Any]):
+        nss = self.client.list("v1", "Namespace")
+        return 200, {"success": True,
+                     "namespaces": [n["metadata"]["name"] for n in nss]}
+
+    def list_notebooks(self, user: str, body: Dict[str, Any], ns: str):
+        self._authz(user, "list", ns, "notebooks")
+        nbs = self.client.list(NOTEBOOK_API_VERSION, NOTEBOOK_KIND, ns)
+        return 200, {"success": True,
+                     "notebooks": [self._view(nb) for nb in nbs]}
+
+    def get_notebook(self, user: str, body: Dict[str, Any], ns: str,
+                     name: str):
+        self._authz(user, "get", ns, "notebooks")
+        nb = self.client.get(NOTEBOOK_API_VERSION, NOTEBOOK_KIND, ns, name)
+        return 200, {"success": True, "notebook": self._view(nb)}
+
+    def create_notebook(self, user: str, body: Dict[str, Any], ns: str):
+        self._authz(user, "create", ns, "notebooks")
+        name = body.get("name", "")
+        if not name:
+            raise ValueError("name is required")
+        nb = notebook(name, ns, body.get("spec", body.get("notebook", {})))
+        if user:
+            nb["metadata"].setdefault("annotations", {})[
+                "kubeflow-tpu.org/creator"] = user
+        created = self.client.create(nb)
+        return 200, {"success": True, "notebook": self._view(created)}
+
+    def delete_notebook(self, user: str, body: Dict[str, Any], ns: str,
+                        name: str):
+        self._authz(user, "delete", ns, "notebooks")
+        self.client.delete(NOTEBOOK_API_VERSION, NOTEBOOK_KIND, ns, name)
+        return 200, {"success": True}
+
+    def stop_notebook(self, user: str, body: Dict[str, Any], ns: str,
+                      name: str):
+        self._authz(user, "update", ns, "notebooks")
+        nb = self.client.get(NOTEBOOK_API_VERSION, NOTEBOOK_KIND, ns, name)
+        culler.stop(nb)
+        self.client.update(nb)
+        return 200, {"success": True}
+
+    def start_notebook(self, user: str, body: Dict[str, Any], ns: str,
+                       name: str):
+        self._authz(user, "update", ns, "notebooks")
+        nb = self.client.get(NOTEBOOK_API_VERSION, NOTEBOOK_KIND, ns, name)
+        culler.resume(nb)
+        culler.touch(nb)
+        self.client.update(nb)
+        return 200, {"success": True}
+
+    def list_poddefaults(self, user: str, body: Dict[str, Any], ns: str):
+        self._authz(user, "list", ns, "poddefaults")
+        pds = self.client.list("kubeflow-tpu.org/v1alpha1", "PodDefault", ns)
+        return 200, {"success": True, "poddefaults": [
+            {"name": p["metadata"]["name"],
+             "description": p["spec"].get("desc", "")}
+            for p in pds]}
+
+    def list_pvcs(self, user: str, body: Dict[str, Any], ns: str):
+        self._authz(user, "list", ns, "persistentvolumeclaims")
+        pvcs = self.client.list("v1", "PersistentVolumeClaim", ns)
+        return 200, {"success": True, "pvcs": [
+            {"name": p["metadata"]["name"],
+             "size": p["spec"].get("resources", {}).get("requests", {})
+                      .get("storage", ""),
+             "mode": (p["spec"].get("accessModes") or [""])[0]}
+            for p in pvcs]}
+
+    def create_pvc(self, user: str, body: Dict[str, Any], ns: str):
+        self._authz(user, "create", ns, "persistentvolumeclaims")
+        name = body.get("name", "")
+        if not name:
+            raise ValueError("name is required")
+        pvc = {
+            "apiVersion": "v1",
+            "kind": "PersistentVolumeClaim",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {
+                "accessModes": [body.get("mode", "ReadWriteOnce")],
+                "resources": {"requests": {
+                    "storage": body.get("size", "10Gi")}},
+            },
+        }
+        self.client.create(pvc)
+        return 200, {"success": True}
+
+    # -- views -------------------------------------------------------------
+
+    def _view(self, nb: Dict[str, Any]) -> Dict[str, Any]:
+        md = nb.get("metadata", {})
+        spec = nb.get("spec", {})
+        return {
+            "name": md.get("name"),
+            "namespace": md.get("namespace"),
+            "image": spec.get("image", ""),
+            "tpuChips": spec.get("tpuChips", 0),
+            "stopped": culler.is_stopped(nb),
+            "phase": nb.get("status", {}).get("phase", "Waiting"),
+        }
+
+
+def serve(app: NotebookWebApp, port: int = 5000, background: bool = False):
+    return serve_json(app.handle, port, background=background)
+
+
+def main() -> None:
+    import os
+
+    from kubeflow_tpu.k8s.client import HttpKubeClient
+
+    serve(NotebookWebApp(HttpKubeClient()),
+          port=int(os.environ.get("KFTPU_WEBAPP_PORT", "5000")))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""Multi-tenancy: Profile controller, PodDefault webhook, access management.
+
+Reference surface: profile-controller (Profile CRD → Namespace + RBAC,
+``/root/reference/components/profile-controller/``), admission-webhook
+(PodDefault injection, ``components/admission-webhook/``), and kfam
+(``components/access-management/kfam/``) — the trio behind per-user
+namespaces on the platform.
+"""
+
+from kubeflow_tpu.tenancy.profiles import (  # noqa: F401
+    PROFILE_API_VERSION,
+    PROFILE_KIND,
+    ProfileController,
+    profile,
+)
+from kubeflow_tpu.tenancy.poddefault import (  # noqa: F401
+    PODDEFAULT_KIND,
+    apply_pod_defaults,
+    matching_pod_defaults,
+    pod_default,
+    safe_to_apply,
+)
+from kubeflow_tpu.tenancy.kfam import AccessManagementApi  # noqa: F401

@@ -1,0 +1,197 @@
+"""Access management API: profile + binding CRUD with owner/admin authz.
+
+Reference: kfam (``/root/reference/components/access-management/kfam/
+api_default.go`` — ``CreateProfile :115``, ``CreateBinding :92``,
+``QueryClusterAdmin :209``, authz by header-identified user
+``isOwnerOrAdmin :241``; binding manipulation in ``bindings.go``). The
+central dashboard drives this to create workgroups and share namespaces.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from kubeflow_tpu.k8s.client import ApiError, KubeClient
+from kubeflow_tpu.tenancy.profiles import (
+    PROFILE_API_VERSION,
+    PROFILE_KIND,
+    PROFILE_NS_LABEL,
+    profile as build_profile,
+)
+from kubeflow_tpu.utils.jsonhttp import USER_HEADER, serve_json  # noqa: F401
+
+ROLE_TO_CLUSTER_ROLE = {
+    "admin": "kubeflow-admin",
+    "edit": "kubeflow-edit",
+    "view": "kubeflow-view",
+}
+
+
+class AccessManagementApi:
+    """kfam's REST surface as a pure handle() + stdlib server."""
+
+    def __init__(self, client: KubeClient,
+                 cluster_admins: Optional[List[str]] = None) -> None:
+        self.client = client
+        self.cluster_admins = set(cluster_admins or [])
+
+    # -- authz -------------------------------------------------------------
+
+    def is_cluster_admin(self, user: str) -> bool:
+        return user in self.cluster_admins
+
+    def is_owner_or_admin(self, user: str, profile_name: str) -> bool:
+        if not user:
+            return False
+        if self.is_cluster_admin(user):
+            return True
+        prof = self.client.get_or_none(PROFILE_API_VERSION, PROFILE_KIND,
+                                       "", profile_name)
+        if prof is None:
+            return False
+        owner = prof.get("spec", {}).get("owner", {})
+        owner_name = owner.get("name") if isinstance(owner, dict) else owner
+        return owner_name == user
+
+    # -- dispatch ----------------------------------------------------------
+
+    def handle(self, method: str, path: str, body: Optional[Dict[str, Any]],
+               user: str = "") -> Tuple[int, Any]:
+        body = body or {}
+        try:
+            if method == "GET" and path == "/kfam/v1/bindings":
+                return self.read_bindings(user)
+            m = re.match(r"^/kfam/v1/bindings\?namespace=(?P<ns>[^&]+)$", path)
+            if method == "GET" and m:
+                return self.read_bindings(user, m.group("ns"))
+            if method == "POST" and path == "/kfam/v1/bindings":
+                return self.create_binding(user, body)
+            if method == "DELETE" and path == "/kfam/v1/bindings":
+                return self.delete_binding(user, body)
+            if method == "POST" and path == "/kfam/v1/profiles":
+                return self.create_profile(user, body)
+            m = re.match(r"^/kfam/v1/profiles/(?P<name>[^/]+)$", path)
+            if method == "DELETE" and m:
+                return self.delete_profile(user, m.group("name"))
+            m = re.match(r"^/kfam/v1/role/clusteradmin\?user=(?P<u>.+)$", path)
+            if method == "GET" and m:
+                return 200, self.is_cluster_admin(m.group("u"))
+            return 404, {"log": f"no route {method} {path}"}
+        except ApiError as e:
+            return e.code, {"log": e.message}
+        except (ValueError, KeyError) as e:
+            return 400, {"log": str(e)}
+
+    # -- handlers ----------------------------------------------------------
+
+    def create_profile(self, user: str, body: Dict[str, Any]):
+        name = body.get("name", "")
+        owner = body.get("user", user)
+        if not name:
+            raise ValueError("profile name required")
+        # self-service: any authenticated user may create their own profile;
+        # creating for another user requires cluster admin (kfam semantics)
+        if owner != user and not self.is_cluster_admin(user):
+            return 403, {"log": f"{user!r} may not create a profile for "
+                                f"{owner!r}"}
+        # a profile must not seize a pre-existing non-profile namespace
+        # (e.g. kube-system): the controller would grant the owner admin
+        # there and stamp an ownerReference that cascade-deletes it later
+        existing_ns = self.client.get_or_none("v1", "Namespace", "", name)
+        if existing_ns is not None:
+            labels = existing_ns.get("metadata", {}).get("labels", {}) or {}
+            if labels.get(PROFILE_NS_LABEL) != name:
+                return 403, {"log": f"namespace {name!r} already exists and "
+                                    "is not a profile namespace"}
+        prof = build_profile(name, owner,
+                             resource_quota=body.get("resourceQuotaSpec"))
+        try:
+            self.client.create(prof)
+        except ApiError as e:
+            if e.code != 409:
+                raise
+            return 409, {"log": f"profile {name!r} exists"}
+        return 200, {"status": "created"}
+
+    def delete_profile(self, user: str, name: str):
+        if not self.is_owner_or_admin(user, name):
+            return 403, {"log": f"{user!r} is not owner or admin of {name!r}"}
+        self.client.delete(PROFILE_API_VERSION, PROFILE_KIND, "", name)
+        return 200, {"status": "deleted"}
+
+    def create_binding(self, user: str, body: Dict[str, Any]):
+        ns = body.get("referredNamespace", "")
+        subject = body.get("user", "")
+        role = body.get("roleRef", {}).get("name", body.get("role", "edit"))
+        if not (ns and subject):
+            raise ValueError("referredNamespace and user required")
+        if role not in ROLE_TO_CLUSTER_ROLE:
+            raise ValueError(f"unknown role {role!r}")
+        if not self.is_owner_or_admin(user, ns):
+            return 403, {"log": f"{user!r} is not owner or admin of {ns!r}"}
+        rb = {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "RoleBinding",
+            "metadata": {
+                "name": self._binding_name(subject, role),
+                "namespace": ns,
+                "annotations": {"user": subject, "role": role},
+            },
+            "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                        "kind": "ClusterRole",
+                        "name": ROLE_TO_CLUSTER_ROLE[role]},
+            "subjects": [{"apiGroup": "rbac.authorization.k8s.io",
+                          "kind": "User", "name": subject}],
+        }
+        self.client.apply(rb)
+        return 200, {"status": "bound"}
+
+    def delete_binding(self, user: str, body: Dict[str, Any]):
+        ns = body.get("referredNamespace", "")
+        subject = body.get("user", "")
+        role = body.get("roleRef", {}).get("name", body.get("role", "edit"))
+        if not self.is_owner_or_admin(user, ns):
+            return 403, {"log": f"{user!r} is not owner or admin of {ns!r}"}
+        self.client.delete("rbac.authorization.k8s.io/v1", "RoleBinding", ns,
+                           self._binding_name(subject, role))
+        return 200, {"status": "unbound"}
+
+    def read_bindings(self, user: str, ns: Optional[str] = None):
+        out = []
+        bindings = self.client.list("rbac.authorization.k8s.io/v1",
+                                    "RoleBinding", ns)
+        for rb in bindings:
+            ann = rb.get("metadata", {}).get("annotations", {}) or {}
+            if "user" not in ann:
+                continue  # not a kfam-managed binding
+            out.append({
+                "user": ann["user"],
+                "role": ann.get("role", ""),
+                "referredNamespace": rb["metadata"].get("namespace", ""),
+            })
+        return 200, {"bindings": out}
+
+    @staticmethod
+    def _binding_name(subject: str, role: str) -> str:
+        safe = re.sub(r"[^a-z0-9-]", "-", subject.lower())
+        return f"user-{safe}-{role}"
+
+
+def serve(api: AccessManagementApi, port: int = 8081,
+          background: bool = False):
+    return serve_json(api.handle, port, background=background)
+
+
+def main() -> None:
+    import os
+
+    from kubeflow_tpu.k8s.client import HttpKubeClient
+
+    admins = [a for a in os.environ.get("CLUSTER_ADMINS", "").split(",") if a]
+    serve(AccessManagementApi(HttpKubeClient(), cluster_admins=admins),
+          port=int(os.environ.get("KFTPU_KFAM_PORT", "8081")))
+
+
+if __name__ == "__main__":
+    main()

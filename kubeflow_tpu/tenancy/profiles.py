@@ -1,0 +1,194 @@
+"""Profile controller: Profile CR → per-user Namespace + RBAC + quota.
+
+Reference: ``/root/reference/components/profile-controller/controllers/
+profile_controller.go:148-256`` — a cluster-scoped Profile owns a
+Namespace named after it, a default-editor ServiceAccount, RoleBindings
+granting the owner subject admin in that namespace, and (metacontroller
+variant, ``kubeflow/profiles/sync-profile.jsonnet:6-50``) a
+ResourceQuota. TPU twist: the quota can cap ``google.com/tpu`` chips per
+tenant namespace.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.k8s.client import ApiError, KubeClient, register_plural
+from kubeflow_tpu.manifests.components.tpujob_operator import GROUP, VERSION
+from kubeflow_tpu.operators.controller import Controller
+
+log = logging.getLogger(__name__)
+
+PROFILE_API_VERSION = f"{GROUP}/{VERSION}"
+PROFILE_KIND = "Profile"
+PROFILE_PLURAL = "profiles"
+
+PROFILE_NS_LABEL = "kubeflow-tpu.org/profile"
+EDITOR_SA = "default-editor"
+VIEWER_SA = "default-viewer"
+OWNER_BINDING = "namespace-owner"
+PART_OF_LABEL = "app.kubernetes.io/part-of"
+
+register_plural(PROFILE_KIND, PROFILE_PLURAL, cluster_scoped=True)
+
+
+@dataclass
+class ProfileSpec:
+    owner: str = ""  # user email / identity
+    resource_quota: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, spec: Dict[str, Any]) -> "ProfileSpec":
+        owner = spec.get("owner", {})
+        if isinstance(owner, dict):
+            owner = owner.get("name", "")
+        return cls(
+            owner=owner,
+            resource_quota=dict(spec.get("resourceQuotaSpec", {}) or {}),
+        )
+
+
+def profile(name: str, owner: str,
+            resource_quota: Optional[Dict[str, Any]] = None) -> o.Obj:
+    spec: Dict[str, Any] = {"owner": {"kind": "User", "name": owner}}
+    if resource_quota:
+        spec["resourceQuotaSpec"] = resource_quota
+    return {
+        "apiVersion": PROFILE_API_VERSION,
+        "kind": PROFILE_KIND,
+        "metadata": {"name": name},
+        "spec": spec,
+    }
+
+
+def build_namespace(prof: o.Obj) -> o.Obj:
+    name = prof["metadata"]["name"]
+    spec = ProfileSpec.from_dict(prof.get("spec", {}))
+    ns = o.namespace(name, labels={
+        PART_OF_LABEL: "kubeflow-tpu",
+        PROFILE_NS_LABEL: name,
+    })
+    if spec.owner:
+        ns["metadata"].setdefault("annotations", {})["owner"] = spec.owner
+    return o.set_owner(ns, prof)
+
+
+def build_quota(prof: o.Obj) -> Optional[o.Obj]:
+    name = prof["metadata"]["name"]
+    spec = ProfileSpec.from_dict(prof.get("spec", {}))
+    if not spec.resource_quota:
+        return None
+    quota = {
+        "apiVersion": "v1",
+        "kind": "ResourceQuota",
+        "metadata": o.metadata("profile-quota", name),
+        "spec": dict(spec.resource_quota),
+    }
+    return o.set_owner(quota, prof)
+
+
+def build_rbac(prof: o.Obj) -> List[o.Obj]:
+    name = prof["metadata"]["name"]
+    spec = ProfileSpec.from_dict(prof.get("spec", {}))
+    objs: List[o.Obj] = [
+        o.service_account(EDITOR_SA, name),
+        o.service_account(VIEWER_SA, name),
+        o.role_binding(f"{EDITOR_SA}-binding", name, "kubeflow-edit",
+                       EDITOR_SA, name, cluster=True),
+        o.role_binding(f"{VIEWER_SA}-binding", name, "kubeflow-view",
+                       VIEWER_SA, name, cluster=True),
+    ]
+    if spec.owner:
+        rb = {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "RoleBinding",
+            "metadata": o.metadata(
+                OWNER_BINDING, name,
+                annotations={"user": spec.owner, "role": "admin"}),
+            "roleRef": {
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": "ClusterRole",
+                "name": "kubeflow-admin",
+            },
+            "subjects": [{"apiGroup": "rbac.authorization.k8s.io",
+                          "kind": "User", "name": spec.owner}],
+        }
+        objs.append(rb)
+    return [o.set_owner(x, prof) for x in objs]
+
+
+class ProfileController:
+    """Reconciles cluster-scoped Profile CRs into tenant namespaces."""
+
+    def __init__(self, client: KubeClient) -> None:
+        self.client = client
+
+    def reconcile(self, _ns: str, name: str) -> Optional[float]:
+        prof = self.client.get_or_none(PROFILE_API_VERSION, PROFILE_KIND,
+                                       "", name)
+        if prof is None:
+            return None
+
+        # never adopt a pre-existing non-profile namespace: applying would
+        # grant the owner admin there and stamp an ownerReference that
+        # cascade-deletes it when the profile goes away
+        existing_ns = self.client.get_or_none("v1", "Namespace", "", name)
+        if existing_ns is not None:
+            labels = existing_ns.get("metadata", {}).get("labels", {}) or {}
+            if labels.get(PROFILE_NS_LABEL) != name:
+                self._set_status(prof, {
+                    "phase": "Failed",
+                    "message": f"namespace {name!r} already exists and is "
+                               "not owned by this profile"})
+                return None
+
+        self._apply(build_namespace(prof))
+        quota = build_quota(prof)
+        if quota is not None:
+            self._apply(quota)
+        else:
+            try:
+                self.client.delete("v1", "ResourceQuota", name,
+                                   "profile-quota")
+            except ApiError as e:
+                if e.code != 404:
+                    raise
+        for obj in build_rbac(prof):
+            self._apply(obj)
+
+        self._set_status(prof, {"phase": "Ready"})
+        return None
+
+    def _set_status(self, prof: o.Obj, status: Dict[str, Any]) -> None:
+        if prof.get("status") == status:
+            return
+        prof = dict(prof)
+        prof["status"] = status
+        try:
+            self.client.update_status(prof)
+        except ApiError as e:
+            if e.code != 404:
+                raise
+
+    def _apply(self, obj: o.Obj) -> None:
+        self.client.apply(obj)
+
+    def build_controller(self) -> Controller:
+        return Controller(
+            self.client, PROFILE_API_VERSION, PROFILE_KIND, self.reconcile,
+            name="profile-controller",
+        )
+
+
+def main() -> None:
+    from kubeflow_tpu.k8s.client import HttpKubeClient
+
+    logging.basicConfig(level=logging.INFO)
+    ProfileController(HttpKubeClient()).build_controller().run_forever()
+
+
+if __name__ == "__main__":
+    main()

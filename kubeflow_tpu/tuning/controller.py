@@ -62,6 +62,7 @@ class StudyController:
                  namespace: Optional[str] = None) -> None:
         self.client = client
         self.namespace = namespace
+        self._metrics_rbac_done: set = set()
 
     # -- reconcile ---------------------------------------------------------
 
@@ -84,6 +85,11 @@ class StudyController:
         phase = study.get("status", {}).get("phase")
         if phase in (PHASE_SUCCEEDED, PHASE_FAILED):
             return None
+
+        # trial pods (namespace default SA) must be able to publish their
+        # metrics ConfigMap in *this* namespace, not just where the
+        # controller was deployed — ensure the grant wherever studies run
+        self._ensure_metrics_rbac(ns)
 
         # one list per pass instead of a GET per trial
         jobs = {
@@ -265,6 +271,18 @@ class StudyController:
         except ApiError as e:
             if e.code != 409:
                 raise
+
+    def _ensure_metrics_rbac(self, ns: str) -> None:
+        if ns in self._metrics_rbac_done:
+            return
+        role_name = "trial-metrics-writer"
+        self._create_if_absent(o.role(
+            role_name, ns,
+            [{"apiGroups": [""], "resources": ["configmaps"],
+              "verbs": ["get", "create", "update", "patch"]}]))
+        self._create_if_absent(o.role_binding(
+            role_name, ns, role_name, "default", ns))
+        self._metrics_rbac_done.add(ns)
 
     def _spawn(self, study: o.Obj, spec: StudySpec, algo,
                trials: List[o.Obj], want: int) -> tuple:

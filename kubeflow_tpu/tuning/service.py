@@ -17,10 +17,7 @@ GET /healthz → {"ok": true, "algorithms": [...]}
 
 from __future__ import annotations
 
-import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Any, Dict, Optional, Tuple
 
 from kubeflow_tpu.tuning.search_space import SearchSpace
 from kubeflow_tpu.tuning.suggestions import (
@@ -28,6 +25,7 @@ from kubeflow_tpu.tuning.suggestions import (
     algorithm_names,
     get_suggestion,
 )
+from kubeflow_tpu.utils.jsonhttp import serve_json
 
 DEFAULT_PORT = 6789  # same port the reference's suggestion services bind
 
@@ -49,46 +47,22 @@ def handle_suggest(body: dict) -> dict:
     return {"assignments": assignments}
 
 
-class _Handler(BaseHTTPRequestHandler):
-    def _send(self, code: int, payload: dict) -> None:
-        data = json.dumps(payload).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
-
-    def do_GET(self):  # noqa: N802
-        if self.path == "/healthz":
-            self._send(200, {"ok": True, "algorithms": algorithm_names()})
-        else:
-            self._send(404, {"error": "not found"})
-
-    def do_POST(self):  # noqa: N802
-        if self.path != "/suggest":
-            self._send(404, {"error": "not found"})
-            return
+def handle(method: str, path: str, body: Optional[Dict[str, Any]],
+           user: str = "") -> Tuple[int, Any]:
+    if method == "GET" and path == "/healthz":
+        return 200, {"ok": True, "algorithms": algorithm_names()}
+    if method == "POST" and path == "/suggest":
         try:
-            length = int(self.headers.get("Content-Length", "0"))
-            body = json.loads(self.rfile.read(length) or b"{}")
             if not isinstance(body, dict):
                 raise ValueError("request body must be a JSON object")
-            self._send(200, handle_suggest(body))
+            return 200, handle_suggest(body)
         except (ValueError, KeyError, TypeError, AttributeError) as e:
-            self._send(400, {"error": str(e)})
-
-    def log_message(self, *a):  # quiet
-        pass
+            return 400, {"error": str(e)}
+    return 404, {"error": "not found"}
 
 
-def serve(port: int = DEFAULT_PORT,
-          background: bool = False) -> Optional[ThreadingHTTPServer]:
-    srv = ThreadingHTTPServer(("0.0.0.0", port), _Handler)
-    if background:
-        threading.Thread(target=srv.serve_forever, daemon=True).start()
-        return srv
-    srv.serve_forever()
-    return None
+def serve(port: int = DEFAULT_PORT, background: bool = False):
+    return serve_json(handle, port, background=background)
 
 
 if __name__ == "__main__":

@@ -50,10 +50,17 @@ class StudySpec:
     def from_dict(cls, spec: Mapping[str, Any]) -> "StudySpec":
         obj = spec.get("objective", {}) or {}
         alg = spec.get("algorithm", {}) or {}
+        goal = obj.get("goal")
+        if goal is not None:
+            try:
+                goal = float(goal)  # YAML often delivers "0.5" as a string
+            except (TypeError, ValueError):
+                raise ValueError(f"objective.goal must be numeric, got "
+                                 f"{goal!r}") from None
         out = cls(
             objective_metric=obj.get("metric", ""),
             objective_type=obj.get("type", "maximize"),
-            goal=obj.get("goal"),
+            goal=goal,
             algorithm=alg.get("name", "random"),
             algorithm_settings=dict(alg.get("settings", {}) or {}),
             parameters=list(spec.get("parameters", []) or []),

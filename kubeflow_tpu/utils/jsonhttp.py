@@ -1,0 +1,71 @@
+"""Shared JSON-over-HTTP server scaffold for the platform's web services.
+
+One implementation of the dispatch/serve shape used by the notebook web
+app, kfam, and the suggestion service (the reference runs three separate
+Flask/go-kit/gRPC stacks for these; here they share one stdlib server).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional, Tuple
+
+USER_HEADER = "X-Kubeflow-Userid"  # identity header the platform trusts
+
+MAX_BODY_BYTES = 4 << 20  # reject absurd request bodies before parsing
+
+# handle(method, path, body, user) -> (status_code, json_payload)
+Handle = Callable[[str, str, Optional[Dict[str, Any]], str], Tuple[int, Any]]
+
+
+def serve_json(handle: Handle, port: int, *,
+               background: bool = False,
+               host: str = "0.0.0.0") -> Optional[ThreadingHTTPServer]:
+    class Handler(BaseHTTPRequestHandler):
+        def _dispatch(self, method: str) -> None:
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+            except ValueError:
+                length = 0
+            if length > MAX_BODY_BYTES:
+                code, payload = 413, {"log": "request body too large"}
+            else:
+                try:
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                except ValueError:
+                    body = {}
+                user = self.headers.get(USER_HEADER, "")
+                try:
+                    code, payload = handle(method, self.path, body, user)
+                except Exception as e:  # noqa: BLE001 — a server never dies
+                    code, payload = 500, {"log": f"internal error: {e}"}
+            data = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):  # noqa: N802
+            self._dispatch("GET")
+
+        def do_POST(self):  # noqa: N802
+            self._dispatch("POST")
+
+        def do_PUT(self):  # noqa: N802
+            self._dispatch("PUT")
+
+        def do_DELETE(self):  # noqa: N802
+            self._dispatch("DELETE")
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    srv = ThreadingHTTPServer((host, port), Handler)
+    if background:
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv
+    srv.serve_forever()
+    return None

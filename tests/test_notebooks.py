@@ -1,0 +1,201 @@
+"""Notebook controller + culler + web backend tests on the fake cluster.
+
+Reference test model: culler_test.go
+(``/root/reference/components/notebook-controller/pkg/culler/``), and the
+jupyter-web-app routes (``base_app.py:20-168``).
+"""
+
+import time
+
+import pytest
+
+from kubeflow_tpu.config.deployment import ComponentSpec, DeploymentConfig
+from kubeflow_tpu.k8s import FakeKubeClient
+from kubeflow_tpu.manifests.registry import render_component
+from kubeflow_tpu.notebooks import (
+    NOTEBOOK_API_VERSION,
+    NOTEBOOK_KIND,
+    CullingPolicy,
+    NotebookController,
+    NotebookWebApp,
+    notebook,
+    should_cull,
+)
+from kubeflow_tpu.notebooks import culler
+
+
+@pytest.fixture
+def client():
+    return FakeKubeClient()
+
+
+@pytest.fixture
+def ctrl(client):
+    return NotebookController(client)
+
+
+def test_reconcile_creates_statefulset_and_service(client, ctrl):
+    client.create(notebook("nb", "user1", {"image": "jupyter:x"}))
+    ctrl.reconcile("user1", "nb")
+    sts = client.get("apps/v1", "StatefulSet", "user1", "nb")
+    assert sts["spec"]["replicas"] == 1
+    ctr = sts["spec"]["template"]["spec"]["containers"][0]
+    assert ctr["image"] == "jupyter:x"
+    assert {"name": "NB_PREFIX", "value": "/notebook/user1/nb"} in ctr["env"]
+    svc = client.get("v1", "Service", "user1", "nb")
+    assert svc["spec"]["ports"][0]["targetPort"] == 8888
+
+
+def test_tpu_notebook_gets_chips_and_node_selector(client, ctrl):
+    client.create(notebook("nb", "u", {"tpuChips": 4,
+                                       "accelerator": "v5e-8"}))
+    ctrl.reconcile("u", "nb")
+    sts = client.get("apps/v1", "StatefulSet", "u", "nb")
+    pod = sts["spec"]["template"]["spec"]
+    assert pod["containers"][0]["resources"]["limits"]["google.com/tpu"] == 4
+    assert pod["nodeSelector"][
+        "cloud.google.com/gke-tpu-accelerator"] == "v5e-8"
+
+
+def test_stopped_notebook_scales_to_zero(client, ctrl):
+    nb = notebook("nb", "u")
+    culler.stop(nb)
+    client.create(nb)
+    ctrl.reconcile("u", "nb")
+    sts = client.get("apps/v1", "StatefulSet", "u", "nb")
+    assert sts["spec"]["replicas"] == 0
+    got = client.get(NOTEBOOK_API_VERSION, NOTEBOOK_KIND, "u", "nb")
+    assert got["status"]["phase"] == "Stopped"
+
+
+def test_culling_policy():
+    policy = CullingPolicy(enabled=True, idle_seconds=60)
+    nb = notebook("nb", "u")
+    assert not should_cull(nb, policy)  # no activity recorded → never cull
+    culler.touch(nb, now=1000.0)
+    assert not should_cull(nb, policy, now=1030.0)
+    assert should_cull(nb, policy, now=2000.0)
+    assert not should_cull(nb, CullingPolicy(enabled=False), now=2000.0)
+
+
+def test_controller_culls_idle_notebook(client):
+    policy = CullingPolicy(enabled=True, idle_seconds=60,
+                           check_period_seconds=30)
+    ctrl = NotebookController(client, policy=policy)
+    nb = notebook("nb", "u")
+    culler.touch(nb, now=time.time() - 3600)
+    client.create(nb)
+    requeue = ctrl.reconcile("u", "nb")
+    got = client.get(NOTEBOOK_API_VERSION, NOTEBOOK_KIND, "u", "nb")
+    assert culler.is_stopped(got)
+    sts = client.get("apps/v1", "StatefulSet", "u", "nb")
+    assert sts["spec"]["replicas"] == 0
+    assert requeue is None  # stopped notebooks need no further idle checks
+
+
+def test_culler_timestamps_are_utc():
+    # touch() writes UTC; last_activity must read it back as UTC regardless
+    # of the host timezone (regression: mktime skewed by UTC offset)
+    nb = notebook("nb", "u")
+    now = 1_700_000_000.0
+    culler.touch(nb, now=now)
+    assert culler.last_activity(nb) == pytest.approx(now, abs=1.0)
+
+
+def test_no_spurious_statefulset_updates(client, ctrl):
+    # a server that defaults extra template fields must not trigger an
+    # apply/watch hot loop: updates key off the spec-hash annotation
+    client.create(notebook("nb", "u"))
+    ctrl.reconcile("u", "nb")
+    sts = client.get("apps/v1", "StatefulSet", "u", "nb")
+    # simulate apiserver defaulting: mutate stored template fields
+    sts["spec"]["template"]["spec"]["dnsPolicy"] = "ClusterFirst"
+    client.update(sts)
+    rv = client.get("apps/v1", "StatefulSet", "u", "nb")["metadata"][
+        "resourceVersion"]
+    ctrl.reconcile("u", "nb")
+    rv2 = client.get("apps/v1", "StatefulSet", "u", "nb")["metadata"][
+        "resourceVersion"]
+    assert rv == rv2  # no write happened
+    # but a real spec change still propagates
+    nb = client.get(NOTEBOOK_API_VERSION, NOTEBOOK_KIND, "u", "nb")
+    nb["spec"]["image"] = "jupyter:v2"
+    client.update(nb)
+    ctrl.reconcile("u", "nb")
+    sts = client.get("apps/v1", "StatefulSet", "u", "nb")
+    assert sts["spec"]["template"]["spec"]["containers"][0][
+        "image"] == "jupyter:v2"
+
+
+def test_status_tracks_pod(client, ctrl):
+    client.create(notebook("nb", "u"))
+    ctrl.reconcile("u", "nb")
+    client.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "nb-0", "namespace": "u",
+                     "labels": {"kubeflow-tpu.org/notebook-name": "nb"}},
+        "spec": {}, "status": {"phase": "Running"},
+    })
+    ctrl.reconcile("u", "nb")
+    got = client.get(NOTEBOOK_API_VERSION, NOTEBOOK_KIND, "u", "nb")
+    assert got["status"]["phase"] == "Running"
+    assert got["status"]["readyReplicas"] == 1
+
+
+# -- web app ---------------------------------------------------------------
+
+def test_webapp_notebook_crud(client):
+    app = NotebookWebApp(client)
+    code, out = app.handle("POST", "/api/namespaces/u/notebooks",
+                           {"name": "nb", "spec": {"image": "j:1"}},
+                           user="alice@example.com")
+    assert code == 200 and out["success"]
+    code, out = app.handle("GET", "/api/namespaces/u/notebooks", None)
+    assert [n["name"] for n in out["notebooks"]] == ["nb"]
+    assert out["notebooks"][0]["image"] == "j:1"
+    code, out = app.handle("POST", "/api/namespaces/u/notebooks/nb/stop", {})
+    assert code == 200
+    nb = client.get(NOTEBOOK_API_VERSION, NOTEBOOK_KIND, "u", "nb")
+    assert culler.is_stopped(nb)
+    code, out = app.handle("POST", "/api/namespaces/u/notebooks/nb/start", {})
+    nb = client.get(NOTEBOOK_API_VERSION, NOTEBOOK_KIND, "u", "nb")
+    assert not culler.is_stopped(nb)
+    code, out = app.handle("DELETE", "/api/namespaces/u/notebooks/nb", None)
+    assert code == 200
+    code, out = app.handle("GET", "/api/namespaces/u/notebooks/nb", None)
+    assert code == 404
+
+
+def test_webapp_authz_denied(client):
+    app = NotebookWebApp(client, authorize=lambda u, v, ns, r: u == "admin")
+    code, out = app.handle("GET", "/api/namespaces/u/notebooks", None,
+                           user="mallory")
+    assert code == 403
+    code, out = app.handle("GET", "/api/namespaces/u/notebooks", None,
+                           user="admin")
+    assert code == 200
+
+
+def test_webapp_pvc_roundtrip(client):
+    app = NotebookWebApp(client)
+    code, _ = app.handle("POST", "/api/namespaces/u/pvcs",
+                         {"name": "data", "size": "20Gi"})
+    assert code == 200
+    code, out = app.handle("GET", "/api/namespaces/u/pvcs", None)
+    assert out["pvcs"] == [{"name": "data", "size": "20Gi",
+                            "mode": "ReadWriteOnce"}]
+
+
+def test_webapp_unknown_route(client):
+    code, out = NotebookWebApp(client).handle("GET", "/api/bogus", None)
+    assert code == 404
+
+
+def test_notebooks_component_manifests():
+    config = DeploymentConfig(name="demo")
+    objs = render_component(config, ComponentSpec("notebooks"))
+    kinds = [(x["kind"], x["metadata"]["name"]) for x in objs]
+    assert ("CustomResourceDefinition", "notebooks.kubeflow-tpu.org") in kinds
+    assert ("Deployment", "notebook-controller") in kinds
+    assert ("Deployment", "notebook-webapp") in kinds
+    assert ("Service", "notebook-webapp") in kinds

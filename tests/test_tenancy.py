@@ -1,0 +1,264 @@
+"""Tenancy tests: profile reconcile, PodDefault mutation, kfam authz.
+
+Reference test model: profile_controller_suite_test.go (envtest),
+admission-webhook merge/conflict functions (``main.go:98-260``), kfam
+``isOwnerOrAdmin`` (``api_default.go:241``).
+"""
+
+import base64
+import json
+
+import pytest
+
+from kubeflow_tpu.config.deployment import ComponentSpec, DeploymentConfig
+from kubeflow_tpu.k8s import FakeKubeClient
+from kubeflow_tpu.manifests.registry import render_component
+from kubeflow_tpu.tenancy import (
+    AccessManagementApi,
+    ProfileController,
+    apply_pod_defaults,
+    matching_pod_defaults,
+    pod_default,
+    profile,
+    safe_to_apply,
+)
+from kubeflow_tpu.tenancy.poddefault import admission_response, mutate_pod
+from kubeflow_tpu.tenancy.profiles import PROFILE_API_VERSION, PROFILE_KIND
+
+
+@pytest.fixture
+def client():
+    return FakeKubeClient()
+
+
+# -- profiles --------------------------------------------------------------
+
+def test_profile_creates_namespace_rbac_quota(client):
+    ctrl = ProfileController(client)
+    client.create(profile("alice", "alice@example.com",
+                          resource_quota={"hard": {"google.com/tpu": "8"}}))
+    ctrl.reconcile("", "alice")
+
+    ns = client.get("v1", "Namespace", "", "alice")
+    assert ns["metadata"]["annotations"]["owner"] == "alice@example.com"
+    assert ns["metadata"]["labels"]["kubeflow-tpu.org/profile"] == "alice"
+
+    quota = client.get("v1", "ResourceQuota", "alice", "profile-quota")
+    assert quota["spec"]["hard"]["google.com/tpu"] == "8"
+
+    sa = client.get("v1", "ServiceAccount", "alice", "default-editor")
+    assert sa is not None
+    rb = client.get("rbac.authorization.k8s.io/v1", "RoleBinding", "alice",
+                    "namespace-owner")
+    assert rb["subjects"][0]["name"] == "alice@example.com"
+    assert rb["roleRef"]["name"] == "kubeflow-admin"
+
+    prof = client.get(PROFILE_API_VERSION, PROFILE_KIND, "", "alice")
+    assert prof["status"]["phase"] == "Ready"
+
+
+def test_profile_quota_removed_when_spec_drops_it(client):
+    ctrl = ProfileController(client)
+    client.create(profile("bob", "bob@x.com",
+                          resource_quota={"hard": {"pods": "10"}}))
+    ctrl.reconcile("", "bob")
+    assert client.get_or_none("v1", "ResourceQuota", "bob",
+                              "profile-quota") is not None
+    prof = client.get(PROFILE_API_VERSION, PROFILE_KIND, "", "bob")
+    del prof["spec"]["resourceQuotaSpec"]
+    client.update(prof)
+    ctrl.reconcile("", "bob")
+    assert client.get_or_none("v1", "ResourceQuota", "bob",
+                              "profile-quota") is None
+
+
+# -- pod defaults ----------------------------------------------------------
+
+def _pod(labels=None, env=None):
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "p", "namespace": "u",
+                     "labels": dict(labels or {})},
+        "spec": {"containers": [{
+            "name": "main", "image": "x",
+            "env": [{"name": k, "value": v} for k, v in (env or {}).items()],
+        }]},
+    }
+
+
+def test_poddefault_selector_matching():
+    pd = pod_default("gcp-creds", "u", {"inject-creds": "true"},
+                     env={"GOOGLE_APPLICATION_CREDENTIALS": "/secret/key"})
+    assert matching_pod_defaults(_pod({"inject-creds": "true"}), [pd]) == [pd]
+    assert matching_pod_defaults(_pod({}), [pd]) == []
+
+
+def test_poddefault_injection():
+    pd = pod_default(
+        "creds", "u", {"m": "1"},
+        env={"KEY": "/secret/key"},
+        volumes=[{"name": "secret-vol", "secret": {"secretName": "s"}}],
+        volume_mounts=[{"name": "secret-vol", "mountPath": "/secret"}],
+        annotations={"injected": "yes"},
+    )
+    out = apply_pod_defaults(_pod({"m": "1"}), [pd])
+    ctr = out["spec"]["containers"][0]
+    assert {"name": "KEY", "value": "/secret/key"} in ctr["env"]
+    assert ctr["volumeMounts"][0]["mountPath"] == "/secret"
+    assert out["spec"]["volumes"][0]["name"] == "secret-vol"
+    assert out["metadata"]["annotations"]["injected"] == "yes"
+    assert "poddefault.kubeflow-tpu.org/creds" in out["metadata"]["annotations"]
+
+
+def test_poddefault_conflict_detection():
+    pd1 = pod_default("a", "u", {"m": "1"}, env={"KEY": "v1"})
+    pd2 = pod_default("b", "u", {"m": "1"}, env={"KEY": "v2"})
+    ok, why = safe_to_apply(_pod({"m": "1"}), [pd1, pd2])
+    assert not ok and "KEY" in why
+    # same value twice is fine
+    pd3 = pod_default("c", "u", {"m": "1"}, env={"KEY": "v1"})
+    ok, _ = safe_to_apply(_pod({"m": "1"}), [pd1, pd3])
+    assert ok
+    # conflict with the pod's own env
+    ok, _ = safe_to_apply(_pod({"m": "1"}, env={"KEY": "mine"}), [pd1])
+    assert not ok
+
+
+def test_mutate_pod_pipeline(client):
+    client.create(pod_default("creds", "u", {"m": "1"}, env={"K": "v"}))
+    mutated, reason = mutate_pod(client, _pod({"m": "1"}))
+    assert reason == ""
+    assert {"name": "K", "value": "v"} in mutated["spec"]["containers"][0]["env"]
+    unchanged, reason = mutate_pod(client, _pod({}))
+    assert reason == "no matching PodDefaults"
+
+
+def test_admission_review_roundtrip(client):
+    client.create(pod_default("creds", "u", {"m": "1"}, env={"K": "v"}))
+    review = {
+        "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+        "request": {"uid": "abc-123", "object": _pod({"m": "1"})},
+    }
+    out = admission_response(client, review)
+    resp = out["response"]
+    assert resp["uid"] == "abc-123" and resp["allowed"]
+    patch = json.loads(base64.b64decode(resp["patch"]))
+    spec_ops = [p for p in patch if p["path"] == "/spec"]
+    assert spec_ops and {"name": "K", "value": "v"} in (
+        spec_ops[0]["value"]["containers"][0]["env"])
+
+
+# -- kfam ------------------------------------------------------------------
+
+def test_kfam_profile_self_service_and_admin(client):
+    api = AccessManagementApi(client, cluster_admins=["root@x.com"])
+    code, _ = api.handle("POST", "/kfam/v1/profiles",
+                         {"name": "alice", "user": "alice@x.com"},
+                         user="alice@x.com")
+    assert code == 200
+    # non-admin cannot create for someone else
+    code, _ = api.handle("POST", "/kfam/v1/profiles",
+                         {"name": "evil", "user": "bob@x.com"},
+                         user="alice@x.com")
+    assert code == 403
+    # admin can
+    code, _ = api.handle("POST", "/kfam/v1/profiles",
+                         {"name": "bob", "user": "bob@x.com"},
+                         user="root@x.com")
+    assert code == 200
+    assert client.get(PROFILE_API_VERSION, PROFILE_KIND, "", "bob")
+
+
+def test_kfam_binding_lifecycle(client):
+    api = AccessManagementApi(client)
+    api.handle("POST", "/kfam/v1/profiles",
+               {"name": "team", "user": "owner@x.com"}, user="owner@x.com")
+    # owner shares the namespace
+    code, _ = api.handle("POST", "/kfam/v1/bindings",
+                         {"referredNamespace": "team", "user": "dev@x.com",
+                          "role": "edit"},
+                         user="owner@x.com")
+    assert code == 200
+    code, out = api.handle("GET", "/kfam/v1/bindings", None,
+                           user="owner@x.com")
+    assert {"user": "dev@x.com", "role": "edit",
+            "referredNamespace": "team"} in out["bindings"]
+    # non-owner cannot bind
+    code, _ = api.handle("POST", "/kfam/v1/bindings",
+                         {"referredNamespace": "team", "user": "m@x.com",
+                          "role": "admin"},
+                         user="mallory@x.com")
+    assert code == 403
+    # unbind
+    code, _ = api.handle("DELETE", "/kfam/v1/bindings",
+                         {"referredNamespace": "team", "user": "dev@x.com",
+                          "role": "edit"},
+                         user="owner@x.com")
+    assert code == 200
+    _, out = api.handle("GET", "/kfam/v1/bindings", None, user="owner@x.com")
+    assert out["bindings"] == []
+
+
+def test_kfam_delete_profile_requires_owner(client):
+    api = AccessManagementApi(client, cluster_admins=["root@x.com"])
+    api.handle("POST", "/kfam/v1/profiles",
+               {"name": "p", "user": "a@x.com"}, user="a@x.com")
+    code, _ = api.handle("DELETE", "/kfam/v1/profiles/p", None, user="b@x.com")
+    assert code == 403
+    code, _ = api.handle("DELETE", "/kfam/v1/profiles/p", None,
+                         user="root@x.com")
+    assert code == 200
+
+
+def test_profile_refuses_foreign_namespace(client):
+    # a profile must not seize a pre-existing non-profile namespace
+    from kubeflow_tpu.k8s import objects as o
+
+    client.create(o.namespace("kube-system"))
+    ctrl = ProfileController(client)
+    client.create(profile("kube-system", "mallory@x.com"))
+    ctrl.reconcile("", "kube-system")
+    prof = client.get(PROFILE_API_VERSION, PROFILE_KIND, "", "kube-system")
+    assert prof["status"]["phase"] == "Failed"
+    # no admin binding was created there
+    assert client.get_or_none("rbac.authorization.k8s.io/v1", "RoleBinding",
+                              "kube-system", "namespace-owner") is None
+    # and the namespace gained no ownerReference
+    ns = client.get("v1", "Namespace", "", "kube-system")
+    assert not ns["metadata"].get("ownerReferences")
+
+
+def test_kfam_refuses_profile_over_existing_namespace(client):
+    from kubeflow_tpu.k8s import objects as o
+
+    client.create(o.namespace("kube-system"))
+    api = AccessManagementApi(client)
+    code, out = api.handle("POST", "/kfam/v1/profiles",
+                           {"name": "kube-system", "user": "mallory@x.com"},
+                           user="mallory@x.com")
+    assert code == 403
+    assert client.get_or_none(PROFILE_API_VERSION, PROFILE_KIND, "",
+                              "kube-system") is None
+
+
+def test_kfam_clusteradmin_query(client):
+    api = AccessManagementApi(client, cluster_admins=["root@x.com"])
+    code, val = api.handle("GET", "/kfam/v1/role/clusteradmin?user=root@x.com",
+                           None)
+    assert code == 200 and val is True
+    _, val = api.handle("GET", "/kfam/v1/role/clusteradmin?user=joe@x.com",
+                        None)
+    assert val is False
+
+
+def test_tenancy_component_manifests():
+    config = DeploymentConfig(name="demo")
+    objs = render_component(config, ComponentSpec("tenancy"))
+    kinds = [(x["kind"], x["metadata"]["name"]) for x in objs]
+    assert ("CustomResourceDefinition", "profiles.kubeflow-tpu.org") in kinds
+    assert ("CustomResourceDefinition",
+            "poddefaults.kubeflow-tpu.org") in kinds
+    for role in ("kubeflow-admin", "kubeflow-edit", "kubeflow-view"):
+        assert ("ClusterRole", role) in kinds
+    assert ("Deployment", "profile-controller") in kinds
+    assert ("Deployment", "kfam") in kinds

@@ -304,6 +304,30 @@ def test_studyspec_validation():
                              "trialTemplate": {"image": "i"}})
 
 
+def test_studyspec_goal_coercion():
+    base = {"objective": {"metric": "m", "goal": "0.5"},
+            "parameters": [{"name": "x", "type": "double",
+                            "min": 0, "max": 1}],
+            "trialTemplate": {"image": "i"}}
+    assert StudySpec.from_dict(base).goal == 0.5  # YAML string coerced
+    base["objective"]["goal"] = "not-a-number"
+    with pytest.raises(ValueError):
+        StudySpec.from_dict(base)
+
+
+def test_study_controller_provisions_metrics_rbac(client=None):
+    client = FakeKubeClient()
+    ctrl = StudyController(client)
+    client.create(study("s", "team-a", _study_spec()))
+    ctrl.reconcile("team-a", "s")
+    role = client.get("rbac.authorization.k8s.io/v1", "Role", "team-a",
+                      "trial-metrics-writer")
+    assert role["rules"][0]["resources"] == ["configmaps"]
+    rb = client.get("rbac.authorization.k8s.io/v1", "RoleBinding", "team-a",
+                    "trial-metrics-writer")
+    assert rb["subjects"][0]["name"] == "default"
+
+
 def test_study_terminates_when_grid_exhausted():
     # grid has only 3 combos < maxTrials=6: the study must still terminate
     client = FakeKubeClient()
