@@ -1,12 +1,15 @@
-"""CLI: ``python -m kubeflow_tpu.bench run --workload mnist -- --steps 30``."""
+"""CLI: ``python -m kubeflow_tpu.bench run --workload mnist -- --steps 30``
+and the in-cluster reporter step: ``... report --name X --out /results``."""
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from kubeflow_tpu.bench.pipeline import (
+    BenchmarkResult,
     BenchmarkSpec,
     LocalRunner,
     WORKLOADS,
@@ -14,19 +17,7 @@ from kubeflow_tpu.bench.pipeline import (
 )
 
 
-def main(argv=None) -> int:
-    p = argparse.ArgumentParser(prog="kubeflow_tpu.bench")
-    sub = p.add_subparsers(dest="command", required=True)
-    rp = sub.add_parser("run", help="run a benchmark locally")
-    rp.add_argument("--name", default=None)
-    rp.add_argument("--workload", required=True,
-                    help=f"one of {sorted(WORKLOADS)} or a module path")
-    rp.add_argument("--out-dir", default="bench_results")
-    rp.add_argument("--timeout", type=float, default=3600.0)
-    rp.add_argument("workload_args", nargs="*",
-                    help="args after -- go to the workload")
-    args = p.parse_args(argv)
-
+def _cmd_run(args) -> int:
     spec = BenchmarkSpec(
         name=args.name or args.workload,
         workload=args.workload,
@@ -43,6 +34,49 @@ def main(argv=None) -> int:
         **paths,
     }))
     return 0 if result.status == "Succeeded" else 1
+
+
+def _cmd_report(args) -> int:
+    """The benchmark workflow's reporter step: read the workload's metrics
+    JSONL from the shared results dir, emit csv + json (kubebench's
+    ``reporter csv``)."""
+    path = os.path.join(args.out, f"{args.name}.jsonl")
+    metrics = []
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        metrics.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        pass
+    result = BenchmarkResult(
+        args.name, "Succeeded" if metrics else "NoMetrics", 0.0, metrics)
+    paths = report(result, args.out)
+    print(json.dumps({"name": args.name, "status": result.status,
+                      "final_metrics": result.final_metrics, **paths}))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kubeflow_tpu.bench")
+    sub = p.add_subparsers(dest="command", required=True)
+    rp = sub.add_parser("run", help="run a benchmark locally")
+    rp.add_argument("--name", default=None)
+    rp.add_argument("--workload", required=True,
+                    help=f"one of {sorted(WORKLOADS)} or a module path")
+    rp.add_argument("--out-dir", default="bench_results")
+    rp.add_argument("--timeout", type=float, default=3600.0)
+    rp.add_argument("workload_args", nargs="*",
+                    help="args after -- go to the workload")
+    rp.set_defaults(fn=_cmd_run)
+    pp = sub.add_parser("report", help="reporter step for workflow runs")
+    pp.add_argument("--name", required=True)
+    pp.add_argument("--out", default="/results")
+    pp.set_defaults(fn=_cmd_report)
+    args = p.parse_args(argv)
+    return args.fn(args)
 
 
 if __name__ == "__main__":

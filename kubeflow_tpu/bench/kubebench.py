@@ -1,0 +1,78 @@
+"""Kubebench-shaped benchmark workflows on the in-framework engine.
+
+Reference: the kubebench-job Argo prototype — configurator renders the
+main job from config, a resource step creates it with
+``successCondition=status.startTime``, a second resource step waits on
+``status.completionTime``, then post-job + csv reporter run on a shared
+experiment PVC (``/root/reference/kubeflow/kubebench/kubebench-job.
+libsonnet:250-396``; env contract KUBEBENCH_EXP_* ``:118-144``). Here the
+same DAG is rendered onto the native Workflow engine with a TpuJob as the
+main job.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.operators.tpujob import tpujob
+from kubeflow_tpu.workflows.workflow import (
+    container_step,
+    resource_step,
+    workflow,
+)
+
+# kubebench's env contract, carried over
+ENV_EXP_ID = "KUBEBENCH_EXP_ID"
+ENV_EXP_RESULT_PATH = "KUBEBENCH_EXP_RESULT_PATH"
+
+
+def benchmark_workflow(
+    name: str,
+    ns: str,
+    *,
+    job_spec: Dict[str, Any],
+    reporter_image: str = "kubeflow-tpu/platform:v1alpha1",
+    post_job: Optional[Dict[str, Any]] = None,
+    result_path: str = "/results",
+) -> o.Obj:
+    """Render the 4-step kubebench DAG around a TpuJob spec."""
+    job_spec = dict(job_spec)
+    # the workload writes <result_path>/<job-name>.jsonl; the reporter
+    # step reads it back (same contract as ClusterRunner)
+    job_spec["env"] = {**(job_spec.get("env") or {}),
+                       "KFTPU_RESULTS_DIR": result_path}
+    job = tpujob(f"{name}-main", ns, job_spec)
+    steps: List[Dict[str, Any]] = [
+        # launch-main-job: success as soon as the operator records startTime
+        resource_step(
+            "launch-main-job", "create", job,
+            success_condition="status.startTime",
+            failure_condition="status.phase == Failed",
+        ),
+        # wait-for-main-job: completionTime appears on success
+        resource_step(
+            "wait-for-main-job", "create", job,
+            success_condition="status.completionTime",
+            failure_condition="status.phase == Failed",
+            dependencies=["launch-main-job"],
+        ),
+    ]
+    reporter_deps = ["wait-for-main-job"]
+    if post_job is not None:
+        steps.append(container_step(
+            "run-post-job", post_job.get("image", reporter_image),
+            command=post_job.get("command"),
+            args=post_job.get("args"),
+            env={ENV_EXP_ID: name, ENV_EXP_RESULT_PATH: result_path},
+            dependencies=["wait-for-main-job"],
+        ))
+        reporter_deps = ["run-post-job"]
+    steps.append(container_step(
+        "run-reporter", reporter_image,
+        command=["python", "-m", "kubeflow_tpu.bench",
+                 "report", "--name", f"{name}-main", "--out", result_path],
+        env={ENV_EXP_ID: name, ENV_EXP_RESULT_PATH: result_path},
+        dependencies=reporter_deps,
+    ))
+    return workflow(name, ns, steps)

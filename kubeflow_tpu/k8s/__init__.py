@@ -8,4 +8,9 @@ from kubeflow_tpu.k8s.client import (  # noqa: F401
     WatchEvent,
     register_plural,
 )
+from kubeflow_tpu.k8s.helpers import (  # noqa: F401
+    create_if_absent,
+    delete_ignore_missing,
+    update_status_ignore_missing,
+)
 from kubeflow_tpu.k8s import objects  # noqa: F401

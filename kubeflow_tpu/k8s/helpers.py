@@ -1,0 +1,48 @@
+"""Controller-side client idioms shared by every operator.
+
+The 409-tolerant create, 404-tolerant delete, and 404-tolerant status
+update appear in every reconcile loop (the reference's controllers get
+them from controller-runtime's client wrappers); one implementation here
+keeps conflict/not-found policy in a single place.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kubeflow_tpu.k8s.client import ApiError, KubeClient
+from kubeflow_tpu.k8s.objects import Obj
+
+
+def create_if_absent(client: KubeClient, obj: Obj) -> bool:
+    """Create; an existing object (409) is success. Returns True if created."""
+    try:
+        client.create(obj)
+        return True
+    except ApiError as e:
+        if e.code != 409:
+            raise
+        return False
+
+
+def delete_ignore_missing(client: KubeClient, api_version: str, kind: str,
+                          namespace: str, name: str) -> bool:
+    """Delete; an already-gone object (404) is success. True if deleted."""
+    try:
+        client.delete(api_version, kind, namespace, name)
+        return True
+    except ApiError as e:
+        if e.code != 404:
+            raise
+        return False
+
+
+def update_status_ignore_missing(client: KubeClient,
+                                 obj: Obj) -> Optional[Obj]:
+    """Write status; a concurrently-deleted object (404) is a no-op."""
+    try:
+        return client.update_status(obj)
+    except ApiError as e:
+        if e.code != 404:
+            raise
+        return None

@@ -7,4 +7,5 @@ from kubeflow_tpu.manifests.components import (  # noqa: F401
     tenancy,
     tpujob_operator,
     tuning,
+    workflows,
 )

@@ -1,0 +1,76 @@
+"""Workflows component: Workflow/ScheduledWorkflow CRDs + controllers.
+
+Manifest parity with the reference's argo package (CRD + workflow-
+controller + UI, ``/root/reference/kubeflow/argo/argo.libsonnet:13-166``)
+and the pipeline package's scheduledworkflow controller.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from kubeflow_tpu.config.deployment import DeploymentConfig
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.manifests.components.tpujob_operator import GROUP, VERSION
+from kubeflow_tpu.manifests.registry import register
+
+DEFAULTS: Dict[str, Any] = {
+    "image": "kubeflow-tpu/platform:v1alpha1",
+    "cluster_scope": True,
+}
+
+
+def workflow_crd() -> o.Obj:
+    return o.crd(
+        "workflows", GROUP, "Workflow",
+        versions=(VERSION,),
+        short_names=("wf",),
+        printer_columns=(
+            {"name": "State", "type": "string", "jsonPath": ".status.phase"},
+            {"name": "Started", "type": "date",
+             "jsonPath": ".status.startedAt"},
+        ),
+    )
+
+
+def scheduled_workflow_crd() -> o.Obj:
+    return o.crd("scheduledworkflows", GROUP, "ScheduledWorkflow",
+                 versions=(VERSION,), short_names=("swf",))
+
+
+@register("workflows", DEFAULTS,
+          "DAG workflow + cron-schedule controllers (argo/pipelines parity)")
+def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
+    ns = config.namespace
+    name = "workflow-controller"
+    rules = [
+        {"apiGroups": [GROUP], "resources": ["*"], "verbs": ["*"]},
+        {"apiGroups": [""], "resources": ["pods", "configmaps", "events"],
+         "verbs": ["*"]},
+    ]
+    env = {"KFTPU_WORKFLOW_NAMESPACE": "" if params["cluster_scope"] else ns}
+    wf_pod = o.pod_spec(
+        [o.container(
+            name, params["image"],
+            command=["python", "-m", "kubeflow_tpu.workflows.controller"],
+            env=env,
+        )],
+        service_account_name=name,
+    )
+    swf_pod = o.pod_spec(
+        [o.container(
+            "scheduledworkflow-controller", params["image"],
+            command=["python", "-m", "kubeflow_tpu.workflows.cron"],
+            env=env,
+        )],
+        service_account_name=name,
+    )
+    return [
+        workflow_crd(),
+        scheduled_workflow_crd(),
+        o.service_account(name, ns),
+        o.cluster_role(name, rules),
+        o.cluster_role_binding(name, name, name, ns),
+        o.deployment(name, ns, wf_pod),
+        o.deployment("scheduledworkflow-controller", ns, swf_pod),
+    ]

@@ -26,6 +26,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+from kubeflow_tpu.k8s import helpers
 from kubeflow_tpu.k8s import objects as o
 from kubeflow_tpu.k8s.client import ApiError, KubeClient, register_plural
 from kubeflow_tpu.manifests.components.tpujob_operator import (
@@ -329,11 +330,7 @@ class TpuJobOperator:
                     raise
 
     def _create_if_absent(self, obj: o.Obj) -> None:
-        try:
-            self.client.create(obj)
-        except ApiError as e:
-            if e.code != 409:
-                raise
+        helpers.create_if_absent(self.client, obj)
 
     def _handle_failure(self, job: o.Obj, spec: TpuJobSpec,
                         pods: List[o.Obj]) -> Optional[float]:

@@ -12,6 +12,7 @@ from __future__ import annotations
 import logging
 from typing import Any, Dict, List, Optional
 
+from kubeflow_tpu.k8s import helpers
 from kubeflow_tpu.k8s import objects as o
 from kubeflow_tpu.k8s.client import ApiError, KubeClient
 from kubeflow_tpu.manifests.components.tpujob_operator import (
@@ -266,11 +267,7 @@ class StudyController:
         return job
 
     def _create_if_absent(self, obj: o.Obj) -> None:
-        try:
-            self.client.create(obj)
-        except ApiError as e:
-            if e.code != 409:
-                raise
+        helpers.create_if_absent(self.client, obj)
 
     def _ensure_metrics_rbac(self, ns: str) -> None:
         if ns in self._metrics_rbac_done:

@@ -1,0 +1,27 @@
+"""Workflow engine: DAG workflows + cron scheduling on the cluster.
+
+Reference surface: the argo package (Workflow CRD + workflow-controller,
+``/root/reference/kubeflow/argo/argo.libsonnet:13-166``) and the pipeline
+package's ScheduledWorkflow controller
+(``/root/reference/kubeflow/pipeline/*.libsonnet``). The reference's E2E
+harness and kubebench are both Argo DAGs (``testing/workflows/components/
+workflows.libsonnet:58-330``, ``kubeflow/kubebench/kubebench-job.libsonnet:
+250-396``); this engine runs the same shapes natively: container steps
+become Pods, resource steps create CRs and poll a success condition.
+"""
+
+from kubeflow_tpu.workflows.workflow import (  # noqa: F401
+    WORKFLOW_API_VERSION,
+    WORKFLOW_KIND,
+    WorkflowSpec,
+    container_step,
+    resource_step,
+    workflow,
+)
+from kubeflow_tpu.workflows.controller import WorkflowController  # noqa: F401
+from kubeflow_tpu.workflows.cron import (  # noqa: F401
+    SCHEDULED_WORKFLOW_KIND,
+    CronSchedule,
+    ScheduledWorkflowController,
+    scheduled_workflow,
+)

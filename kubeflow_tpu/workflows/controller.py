@@ -1,0 +1,274 @@
+"""Workflow controller: drives DAG steps to completion.
+
+The reference deploys Argo's workflow-controller for this
+(``/root/reference/kubeflow/argo/argo.libsonnet:37-90``); the shapes it
+must execute are the E2E DAG (container tasks with shared volumes) and
+kubebench's resource create/wait steps. Container steps become Pods;
+resource steps create an object then poll its success/failure condition.
+Whole-step retries mirror Argo's retryStrategy.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.k8s.client import ApiError, KubeClient
+from kubeflow_tpu.k8s.helpers import (
+    create_if_absent,
+    delete_ignore_missing,
+    update_status_ignore_missing,
+)
+from kubeflow_tpu.operators.controller import Controller
+from kubeflow_tpu.utils import DEFAULT_REGISTRY
+from kubeflow_tpu.workflows.workflow import (
+    NODE_FAILED,
+    NODE_PENDING,
+    NODE_RUNNING,
+    NODE_SKIPPED,
+    NODE_SUCCEEDED,
+    STEP_CONTAINER,
+    STEP_RESOURCE,
+    WORKFLOW_API_VERSION,
+    WORKFLOW_KIND,
+    WorkflowSpec,
+    eval_condition,
+    substitute_params,
+)
+
+log = logging.getLogger(__name__)
+
+WORKFLOW_LABEL = "kubeflow-tpu.org/workflow-name"
+STEP_LABEL = "kubeflow-tpu.org/workflow-step"
+
+PHASE_RUNNING = "Running"
+PHASE_SUCCEEDED = "Succeeded"
+PHASE_FAILED = "Failed"
+
+_steps_run = DEFAULT_REGISTRY.counter(
+    "kftpu_workflow_steps_total", "workflow steps launched")
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+class WorkflowController:
+    """Reconciles Workflow CRs on any :class:`KubeClient`."""
+
+    def __init__(self, client: KubeClient,
+                 namespace: Optional[str] = None) -> None:
+        self.client = client
+        self.namespace = namespace
+
+    # -- reconcile ---------------------------------------------------------
+
+    def reconcile(self, ns: str, name: str) -> Optional[float]:
+        wf = self.client.get_or_none(WORKFLOW_API_VERSION, WORKFLOW_KIND,
+                                     ns, name)
+        if wf is None:
+            return None
+        if wf.get("status", {}).get("phase") in (PHASE_SUCCEEDED,
+                                                 PHASE_FAILED):
+            return None
+        try:
+            spec = WorkflowSpec.from_dict(
+                substitute_params(wf["spec"], (wf["spec"].get("parameters")
+                                               or {})))
+        except (ValueError, KeyError) as e:
+            self._set_status(wf, {"phase": PHASE_FAILED,
+                                  "message": f"invalid spec: {e}"})
+            return None
+
+        import copy
+
+        # deep copy: _advance/_launch mutate node dicts, and a shallow copy
+        # would alias wf["status"] so _set_status's no-change check would
+        # compare the mutated status against itself and skip the write
+        nodes: Dict[str, Dict[str, Any]] = copy.deepcopy(
+            wf.get("status", {}).get("nodes", {}))
+
+        # 1. advance running nodes from observed pod/resource state
+        for s in spec.steps:
+            node = nodes.get(s["name"])
+            if node and node.get("phase") == NODE_RUNNING:
+                self._advance(ns, name, s, node)
+
+        # 2. propagate skips from failed/skipped dependencies
+        changed = True
+        while changed:
+            changed = False
+            for s in spec.steps:
+                node = nodes.setdefault(s["name"], {"phase": NODE_PENDING})
+                if node["phase"] != NODE_PENDING:
+                    continue
+                dep_phases = [nodes.get(d, {}).get("phase", NODE_PENDING)
+                              for d in s.get("dependencies", [])]
+                if any(p in (NODE_FAILED, NODE_SKIPPED) for p in dep_phases):
+                    node.update({"phase": NODE_SKIPPED,
+                                 "message": "dependency failed"})
+                    changed = True
+
+        # 3. launch ready steps
+        phases = {k: v.get("phase", NODE_PENDING) for k, v in nodes.items()}
+        for step_name in spec.ready_steps(phases):
+            self._launch(ns, wf, spec.step(step_name), nodes[step_name])
+
+        # 4. summarize
+        phases = {k: v.get("phase", NODE_PENDING) for k, v in nodes.items()}
+        status: Dict[str, Any] = {"nodes": nodes, "phase": PHASE_RUNNING}
+        if all(p == NODE_SUCCEEDED for p in phases.values()):
+            status["phase"] = PHASE_SUCCEEDED
+            status["finishedAt"] = _now()
+        elif (any(p in (NODE_FAILED, NODE_SKIPPED) for p in phases.values())
+              and not any(p in (NODE_PENDING, NODE_RUNNING)
+                          for p in phases.values())):
+            status["phase"] = PHASE_FAILED
+            status["finishedAt"] = _now()
+        if "startedAt" not in wf.get("status", {}):
+            status["startedAt"] = _now()
+        else:
+            status["startedAt"] = wf["status"]["startedAt"]
+        self._set_status(wf, status)
+        return None if status["phase"] != PHASE_RUNNING else 1.0
+
+    # -- step execution ----------------------------------------------------
+
+    def _pod_name(self, wf_name: str, step: Dict[str, Any],
+                  attempt: int) -> str:
+        base = f"{wf_name}-{step['name']}"
+        return base if attempt == 0 else f"{base}-r{attempt}"
+
+    def _launch(self, ns: str, wf: o.Obj, step: Dict[str, Any],
+                node: Dict[str, Any]) -> None:
+        _steps_run.inc()
+        wf_name = wf["metadata"]["name"]
+        node["startedAt"] = _now()
+        if step["type"] == STEP_CONTAINER:
+            attempt = int(node.get("attempt", 0))
+            pod = o.pod(
+                self._pod_name(wf_name, step, attempt), ns,
+                o.pod_spec(
+                    [o.container(
+                        "main", step["image"],
+                        command=step.get("command"),
+                        args=step.get("args"),
+                        env=step.get("env"),
+                    )],
+                    restart_policy="Never",
+                ),
+                labels={WORKFLOW_LABEL: wf_name, STEP_LABEL: step["name"]},
+            )
+            o.set_owner(pod, wf)
+            create_if_absent(self.client, pod)
+            node["podName"] = pod["metadata"]["name"]
+            node["phase"] = NODE_RUNNING
+        else:  # resource step
+            manifest = step["manifest"]
+            if step.get("action", "create") == "delete":
+                md = manifest.get("metadata", {})
+                delete_ignore_missing(self.client, manifest["apiVersion"],
+                                      manifest["kind"],
+                                      md.get("namespace", ns), md["name"])
+                node["phase"] = NODE_SUCCEEDED
+                node["finishedAt"] = _now()
+                return
+            manifest = dict(manifest)
+            manifest.setdefault("metadata", {}).setdefault("namespace", ns)
+            create_if_absent(self.client, manifest)
+            node["phase"] = NODE_RUNNING
+            if not step.get("successCondition"):
+                # fire-and-forget create
+                node["phase"] = NODE_SUCCEEDED
+                node["finishedAt"] = _now()
+
+    def _advance(self, ns: str, wf_name: str, step: Dict[str, Any],
+                 node: Dict[str, Any]) -> None:
+        if step["type"] == STEP_CONTAINER:
+            pod = self.client.get_or_none("v1", "Pod", ns,
+                                          node.get("podName", ""))
+            phase = (pod or {}).get("status", {}).get("phase")
+            if phase == "Succeeded":
+                node["phase"] = NODE_SUCCEEDED
+                node["finishedAt"] = _now()
+            elif phase == "Failed" or pod is None:
+                attempt = int(node.get("attempt", 0))
+                if attempt < int(step.get("retries", 0)):
+                    node["attempt"] = attempt + 1
+                    node["phase"] = NODE_PENDING  # relaunched next pass
+                    node["message"] = f"retry {attempt + 1}"
+                else:
+                    node["phase"] = NODE_FAILED
+                    node["finishedAt"] = _now()
+                    node["message"] = "pod failed"
+            return
+        # resource step: poll conditions against the live object
+        manifest = step["manifest"]
+        md = manifest.get("metadata", {})
+        target = self.client.get_or_none(
+            manifest["apiVersion"], manifest["kind"],
+            md.get("namespace", ns), md["name"])
+        if eval_condition(target, step.get("failureCondition", "")):
+            node["phase"] = NODE_FAILED
+            node["finishedAt"] = _now()
+            node["message"] = f"failureCondition {step['failureCondition']!r}"
+        elif eval_condition(target, step.get("successCondition", "")):
+            node["phase"] = NODE_SUCCEEDED
+            node["finishedAt"] = _now()
+        else:
+            import calendar
+
+            # startedAt was written with gmtime; compare in the same frame
+            started = calendar.timegm(time.strptime(
+                node.get("startedAt", _now()), "%Y-%m-%dT%H:%M:%SZ"))
+            if time.time() - started > float(
+                    step.get("timeoutSeconds", 3600.0)):
+                node["phase"] = NODE_FAILED
+                node["finishedAt"] = _now()
+                node["message"] = "timeout"
+
+    # -- helpers -----------------------------------------------------------
+
+    def _set_status(self, wf: o.Obj, status: Dict[str, Any]) -> None:
+        merged = {**wf.get("status", {}), **status}
+        if wf.get("status") == merged:
+            return
+        wf = dict(wf)
+        wf["status"] = merged
+        update_status_ignore_missing(self.client, wf)
+
+    # -- runtime -----------------------------------------------------------
+
+    def build_controller(self) -> Controller:
+        ctrl = Controller(
+            self.client, WORKFLOW_API_VERSION, WORKFLOW_KIND, self.reconcile,
+            namespace=self.namespace, name="workflow-controller",
+            resync_period_s=5.0,
+        )
+
+        def pod_to_wf(pod: o.Obj):
+            labels = pod.get("metadata", {}).get("labels", {}) or {}
+            wf = labels.get(WORKFLOW_LABEL)
+            if wf:
+                return (pod["metadata"].get("namespace", ""), wf)
+            return None
+
+        ctrl.watch_owned("v1", "Pod", pod_to_wf)
+        return ctrl
+
+
+def main() -> None:
+    import os
+
+    from kubeflow_tpu.k8s.client import HttpKubeClient
+
+    logging.basicConfig(level=logging.INFO)
+    ns = os.environ.get("KFTPU_WORKFLOW_NAMESPACE") or None
+    WorkflowController(HttpKubeClient(),
+                       namespace=ns).build_controller().run_forever()
+
+
+if __name__ == "__main__":
+    main()

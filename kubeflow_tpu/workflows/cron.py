@@ -1,0 +1,245 @@
+"""ScheduledWorkflow: cron-triggered Workflow creation.
+
+Reference: the pipeline package's scheduledworkflow CRD controller
+(``/root/reference/kubeflow/pipeline/*.libsonnet``, parts list
+``parts.yaml:38-39``) — a schedule spec periodically stamps out Workflow
+CRs from a template. Supports 5-field cron expressions (minute hour dom
+month dow) with ``*``, lists, ranges, and ``*/n`` steps, plus a simple
+``intervalSeconds`` mode.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.k8s.client import ApiError, KubeClient, register_plural
+from kubeflow_tpu.k8s.helpers import (
+    create_if_absent,
+    delete_ignore_missing,
+    update_status_ignore_missing,
+)
+from kubeflow_tpu.manifests.components.tpujob_operator import GROUP, VERSION
+from kubeflow_tpu.operators.controller import Controller
+from kubeflow_tpu.workflows.workflow import (
+    WORKFLOW_API_VERSION,
+    WORKFLOW_KIND,
+    WorkflowSpec,
+)
+
+log = logging.getLogger(__name__)
+
+SCHEDULED_WORKFLOW_API_VERSION = f"{GROUP}/{VERSION}"
+SCHEDULED_WORKFLOW_KIND = "ScheduledWorkflow"
+SCHEDULED_WORKFLOW_PLURAL = "scheduledworkflows"
+
+register_plural(SCHEDULED_WORKFLOW_KIND, SCHEDULED_WORKFLOW_PLURAL)
+
+
+class CronField:
+    """One field of a cron expression: ``*``, ``*/n``, ``a-b``, ``a,b,c``."""
+
+    def __init__(self, expr: str, lo: int, hi: int) -> None:
+        self.values = self._parse(expr, lo, hi)
+
+    @staticmethod
+    def _parse(expr: str, lo: int, hi: int) -> frozenset:
+        out: set = set()
+        for part in expr.split(","):
+            step = 1
+            if "/" in part:
+                part, _, step_s = part.partition("/")
+                step = int(step_s)
+            if part == "*":
+                rng = range(lo, hi + 1)
+            elif "-" in part:
+                a, _, b = part.partition("-")
+                rng = range(int(a), int(b) + 1)
+            else:
+                rng = range(int(part), int(part) + 1)
+            for v in rng:
+                if v < lo or v > hi:
+                    raise ValueError(f"cron value {v} outside [{lo},{hi}]")
+                if (v - rng.start) % step == 0:
+                    out.add(v)
+        return frozenset(out)
+
+    def matches(self, v: int) -> bool:
+        return v in self.values
+
+
+@dataclass(frozen=True)
+class CronSchedule:
+    minute: CronField
+    hour: CronField
+    dom: CronField
+    month: CronField
+    dow: CronField
+
+    @classmethod
+    def parse(cls, expr: str) -> "CronSchedule":
+        parts = expr.split()
+        if len(parts) != 5:
+            raise ValueError(f"cron needs 5 fields, got {expr!r}")
+        return cls(
+            minute=CronField(parts[0], 0, 59),
+            hour=CronField(parts[1], 0, 23),
+            dom=CronField(parts[2], 1, 31),
+            month=CronField(parts[3], 1, 12),
+            dow=CronField(parts[4], 0, 6),
+        )
+
+    def matches(self, t: float) -> bool:
+        tm = time.gmtime(t)
+        # struct_time: Monday=0..Sunday=6; cron: Sunday=0..Saturday=6
+        return (self.minute.matches(tm.tm_min)
+                and self.hour.matches(tm.tm_hour)
+                and self.dom.matches(tm.tm_mday)
+                and self.month.matches(tm.tm_mon)
+                and self.dow.matches((tm.tm_wday + 1) % 7))
+
+    def next_after(self, t: float, horizon_s: float = 366 * 86400) -> float:
+        """Next matching minute strictly after t."""
+        # scan minute boundaries; cron resolution is one minute
+        start = (int(t) // 60 + 1) * 60
+        for m in range(int(horizon_s // 60)):
+            cand = start + m * 60
+            if self.matches(cand):
+                return float(cand)
+        raise ValueError("no cron match within horizon")
+
+
+def scheduled_workflow(name: str, ns: str, workflow_spec: Dict[str, Any], *,
+                       cron: str = "", interval_seconds: float = 0,
+                       max_history: int = 5) -> o.Obj:
+    if not cron and not interval_seconds:
+        raise ValueError("need cron or intervalSeconds")
+    if cron:
+        CronSchedule.parse(cron)
+    WorkflowSpec.from_dict(workflow_spec)
+    return {
+        "apiVersion": SCHEDULED_WORKFLOW_API_VERSION,
+        "kind": SCHEDULED_WORKFLOW_KIND,
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "cron": cron,
+            "intervalSeconds": interval_seconds,
+            "maxHistory": max_history,
+            "workflowSpec": workflow_spec,
+        },
+    }
+
+
+class ScheduledWorkflowController:
+    """Stamps out Workflow CRs on schedule; prunes old runs."""
+
+    def __init__(self, client: KubeClient,
+                 namespace: Optional[str] = None,
+                 clock=time.time) -> None:
+        self.client = client
+        self.namespace = namespace
+        self.clock = clock
+
+    def reconcile(self, ns: str, name: str) -> Optional[float]:
+        swf = self.client.get_or_none(SCHEDULED_WORKFLOW_API_VERSION,
+                                      SCHEDULED_WORKFLOW_KIND, ns, name)
+        if swf is None:
+            return None
+        if swf.get("status", {}).get("phase") == "Failed":
+            return None  # invalid schedule; edit the spec to recover
+        spec = swf.get("spec", {})
+        now = self.clock()
+        last_run = float(swf.get("status", {}).get("lastRunTime", 0))
+
+        try:
+            due, next_delay = self._due(spec, last_run, now)
+        except ValueError as e:
+            # invalid cron / neither cron nor interval: fail fast instead of
+            # the 5s error-retry hot loop (workflow controller does the same)
+            self._set_status(swf, {"phase": "Failed",
+                                   "message": f"invalid schedule: {e}"})
+            return None
+        if due:
+            run_name = f"{name}-{int(now)}"
+            wf = {
+                "apiVersion": WORKFLOW_API_VERSION,
+                "kind": WORKFLOW_KIND,
+                "metadata": {"name": run_name, "namespace": ns,
+                             "labels": {"kubeflow-tpu.org/scheduled-by": name}},
+                "spec": dict(spec.get("workflowSpec", {})),
+            }
+            o.set_owner(wf, swf)
+            create_if_absent(self.client, wf)
+            swf = dict(swf)
+            swf["status"] = {**swf.get("status", {}),
+                             "lastRunTime": now,
+                             "runs": int(swf.get("status", {})
+                                         .get("runs", 0)) + 1}
+            update_status_ignore_missing(self.client, swf)
+        self._prune(ns, name, int(spec.get("maxHistory", 5)))
+        return next_delay
+
+    def _due(self, spec: Dict[str, Any], last_run: float,
+             now: float) -> tuple:
+        interval = float(spec.get("intervalSeconds", 0) or 0)
+        cron_expr = spec.get("cron", "")
+        if interval:
+            if now - last_run >= interval:
+                return True, interval
+            return False, interval - (now - last_run)
+        if not cron_expr:
+            raise ValueError("need cron or intervalSeconds")
+        sched = CronSchedule.parse(cron_expr)
+        # due when the current minute matches and we haven't already fired
+        # in this minute bucket (elapsed-seconds comparison would skip
+        # consecutive matching minutes after a mid-minute fire)
+        due = sched.matches(now) and int(now // 60) != int(last_run // 60)
+        delay = max(sched.next_after(now) - now, 1.0)
+        return due, delay
+
+    def _prune(self, ns: str, name: str, max_history: int) -> None:
+        runs = self.client.list(
+            WORKFLOW_API_VERSION, WORKFLOW_KIND, ns,
+            label_selector={"kubeflow-tpu.org/scheduled-by": name})
+        terminal = [r for r in runs
+                    if r.get("status", {}).get("phase") in ("Succeeded",
+                                                            "Failed")]
+        terminal.sort(key=lambda r: r["metadata"]["name"])
+        for stale in terminal[:-max_history] if max_history else terminal:
+            delete_ignore_missing(self.client, WORKFLOW_API_VERSION,
+                                  WORKFLOW_KIND, ns,
+                                  stale["metadata"]["name"])
+
+    def _set_status(self, swf: o.Obj, status: Dict[str, Any]) -> None:
+        merged = {**swf.get("status", {}), **status}
+        if swf.get("status") == merged:
+            return
+        swf = dict(swf)
+        swf["status"] = merged
+        update_status_ignore_missing(self.client, swf)
+
+    def build_controller(self) -> Controller:
+        return Controller(
+            self.client, SCHEDULED_WORKFLOW_API_VERSION,
+            SCHEDULED_WORKFLOW_KIND, self.reconcile,
+            namespace=self.namespace, name="scheduledworkflow-controller",
+            resync_period_s=30.0,
+        )
+
+
+def main() -> None:
+    import os
+
+    from kubeflow_tpu.k8s.client import HttpKubeClient
+
+    logging.basicConfig(level=logging.INFO)
+    ns = os.environ.get("KFTPU_WORKFLOW_NAMESPACE") or None
+    ScheduledWorkflowController(
+        HttpKubeClient(), namespace=ns).build_controller().run_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,205 @@
+"""Workflow CR types: DAG of container and resource steps.
+
+Shape parity with Argo as the reference uses it: an entrypoint DAG whose
+tasks have ``dependencies``, container templates with parameterized
+images/args, and resource templates with ``successCondition`` /
+``failureCondition`` polling (the kubebench launch/wait pattern,
+``/root/reference/kubeflow/kubebench/kubebench-job.libsonnet:363-376``).
+Parameters use ``{{workflow.parameters.name}}`` substitution like the
+reference's workflows.libsonnet prototypes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Optional
+
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.k8s.client import register_plural
+from kubeflow_tpu.manifests.components.tpujob_operator import GROUP, VERSION
+
+WORKFLOW_API_VERSION = f"{GROUP}/{VERSION}"
+WORKFLOW_KIND = "Workflow"
+WORKFLOW_PLURAL = "workflows"
+
+register_plural(WORKFLOW_KIND, WORKFLOW_PLURAL)
+
+STEP_CONTAINER = "container"
+STEP_RESOURCE = "resource"
+
+NODE_PENDING = "Pending"
+NODE_RUNNING = "Running"
+NODE_SUCCEEDED = "Succeeded"
+NODE_FAILED = "Failed"
+NODE_SKIPPED = "Skipped"  # dependency failed
+
+
+def container_step(
+    name: str,
+    image: str,
+    *,
+    command: Optional[List[str]] = None,
+    args: Optional[List[str]] = None,
+    env: Optional[Mapping[str, str]] = None,
+    dependencies: Optional[List[str]] = None,
+    retries: int = 0,
+) -> Dict[str, Any]:
+    step: Dict[str, Any] = {
+        "name": name,
+        "type": STEP_CONTAINER,
+        "image": image,
+        "dependencies": list(dependencies or []),
+    }
+    if command:
+        step["command"] = list(command)
+    if args:
+        step["args"] = list(args)
+    if env:
+        step["env"] = dict(env)
+    if retries:
+        step["retries"] = retries
+    return step
+
+
+def resource_step(
+    name: str,
+    action: str,  # create | delete
+    manifest: o.Obj,
+    *,
+    success_condition: str = "",
+    failure_condition: str = "",
+    dependencies: Optional[List[str]] = None,
+    timeout_seconds: float = 3600.0,
+) -> Dict[str, Any]:
+    return {
+        "name": name,
+        "type": STEP_RESOURCE,
+        "action": action,
+        "manifest": manifest,
+        "successCondition": success_condition,
+        "failureCondition": failure_condition,
+        "dependencies": list(dependencies or []),
+        "timeoutSeconds": timeout_seconds,
+    }
+
+
+def workflow(name: str, ns: str, steps: List[Dict[str, Any]],
+             parameters: Optional[Mapping[str, str]] = None) -> o.Obj:
+    spec = {"steps": steps}
+    if parameters:
+        spec["parameters"] = dict(parameters)
+    WorkflowSpec.from_dict(spec)  # validate
+    return {
+        "apiVersion": WORKFLOW_API_VERSION,
+        "kind": WORKFLOW_KIND,
+        "metadata": {"name": name, "namespace": ns},
+        "spec": spec,
+    }
+
+
+@dataclass
+class WorkflowSpec:
+    steps: List[Dict[str, Any]] = field(default_factory=list)
+    parameters: Dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, spec: Mapping[str, Any]) -> "WorkflowSpec":
+        out = cls(
+            steps=list(spec.get("steps", []) or []),
+            parameters=dict(spec.get("parameters", {}) or {}),
+        )
+        out.validate()
+        return out
+
+    def validate(self) -> None:
+        if not self.steps:
+            raise ValueError("workflow needs at least one step")
+        names = [s.get("name", "") for s in self.steps]
+        if len(set(names)) != len(names) or "" in names:
+            raise ValueError(f"step names must be unique and non-empty: "
+                             f"{names}")
+        known = set(names)
+        for s in self.steps:
+            stype = s.get("type")
+            if stype not in (STEP_CONTAINER, STEP_RESOURCE):
+                raise ValueError(
+                    f"step {s.get('name')!r}: unknown type {stype!r}")
+            for dep in s.get("dependencies", []) or []:
+                if dep not in known:
+                    raise ValueError(
+                        f"step {s['name']!r} depends on unknown {dep!r}")
+        self._check_acyclic()
+
+    def _check_acyclic(self) -> None:
+        deps = {s["name"]: set(s.get("dependencies", []) or [])
+                for s in self.steps}
+        done: set = set()
+        while deps:
+            ready = [n for n, d in deps.items() if d <= done]
+            if not ready:
+                raise ValueError(f"dependency cycle among {sorted(deps)}")
+            for n in ready:
+                done.add(n)
+                del deps[n]
+
+    def step(self, name: str) -> Dict[str, Any]:
+        for s in self.steps:
+            if s["name"] == name:
+                return s
+        raise KeyError(name)
+
+    def ready_steps(self, node_phases: Mapping[str, str]) -> List[str]:
+        """Steps whose dependencies all Succeeded and that haven't started."""
+        out = []
+        for s in self.steps:
+            name = s["name"]
+            if node_phases.get(name, NODE_PENDING) != NODE_PENDING:
+                continue
+            if all(node_phases.get(d) == NODE_SUCCEEDED
+                   for d in s.get("dependencies", []) or []):
+                out.append(name)
+        return out
+
+
+def substitute_params(value: Any, params: Mapping[str, str]) -> Any:
+    """Replace ``{{workflow.parameters.<name>}}`` in strings, deep."""
+    if isinstance(value, str):
+        out = value
+        for k, v in params.items():
+            out = out.replace("{{workflow.parameters.%s}}" % k, str(v))
+        return out
+    if isinstance(value, Mapping):
+        return {k: substitute_params(v, params) for k, v in value.items()}
+    if isinstance(value, list):
+        return [substitute_params(v, params) for v in value]
+    return value
+
+
+def eval_condition(obj: Optional[o.Obj], condition: str) -> bool:
+    """Evaluate an Argo-style condition against an object.
+
+    Supported forms (what the reference workflows actually use):
+    ``status.startTime`` (field presence), ``status.phase == Succeeded``,
+    ``status.phase != Failed``.
+    """
+    if not condition:
+        return False
+    if obj is None:
+        return False
+    cond = condition.strip()
+    for op in ("==", "!="):
+        if op in cond:
+            path, _, want = cond.partition(op)
+            got = _lookup(obj, path.strip())
+            eq = str(got) == want.strip()
+            return eq if op == "==" else (got is not None and not eq)
+    return _lookup(obj, cond) not in (None, "", [], {})
+
+
+def _lookup(obj: Any, dotted: str) -> Any:
+    cur = obj
+    for part in dotted.split("."):
+        if not isinstance(cur, Mapping) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur

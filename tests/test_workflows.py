@@ -1,0 +1,355 @@
+"""Workflow engine tests: DAG validation, step execution, retries, skips,
+cron scheduling, and the kubebench-shaped benchmark DAG end-to-end with
+the TpuJob operator (reference shape: kubebench-job.libsonnet:250-396).
+"""
+
+import pytest
+
+from kubeflow_tpu.bench.kubebench import benchmark_workflow
+from kubeflow_tpu.config.deployment import ComponentSpec, DeploymentConfig
+from kubeflow_tpu.k8s import FakeKubeClient
+from kubeflow_tpu.manifests.registry import render_component
+from kubeflow_tpu.operators.tpujob import TpuJobOperator
+from kubeflow_tpu.workflows import (
+    WORKFLOW_API_VERSION,
+    WORKFLOW_KIND,
+    CronSchedule,
+    ScheduledWorkflowController,
+    WorkflowController,
+    container_step,
+    resource_step,
+    scheduled_workflow,
+    workflow,
+)
+from kubeflow_tpu.workflows.workflow import (
+    WorkflowSpec,
+    eval_condition,
+    substitute_params,
+)
+
+
+@pytest.fixture
+def client():
+    return FakeKubeClient()
+
+
+@pytest.fixture
+def ctrl(client):
+    return WorkflowController(client)
+
+
+def finish_pods(client, ns="default", phase="Succeeded", match=None):
+    for pod in client.list("v1", "Pod", ns):
+        if pod.get("status", {}).get("phase") in ("Succeeded", "Failed"):
+            continue
+        if match and match not in pod["metadata"]["name"]:
+            continue
+        pod.setdefault("status", {})["phase"] = phase
+        client.update_status(pod)
+
+
+def get_wf(client, name, ns="default"):
+    return client.get(WORKFLOW_API_VERSION, WORKFLOW_KIND, ns, name)
+
+
+# -- spec validation -------------------------------------------------------
+
+def test_workflow_validation_rejects_cycles():
+    steps = [container_step("a", "img", dependencies=["b"]),
+             container_step("b", "img", dependencies=["a"])]
+    with pytest.raises(ValueError, match="cycle"):
+        WorkflowSpec.from_dict({"steps": steps})
+
+
+def test_workflow_validation_rejects_unknown_dep():
+    with pytest.raises(ValueError, match="unknown"):
+        WorkflowSpec.from_dict(
+            {"steps": [container_step("a", "img", dependencies=["nope"])]})
+
+
+def test_param_substitution():
+    out = substitute_params(
+        {"args": ["--model={{workflow.parameters.model}}"],
+         "nested": {"x": "{{workflow.parameters.n}}"}},
+        {"model": "resnet50", "n": 4})
+    assert out["args"] == ["--model=resnet50"]
+    assert out["nested"]["x"] == "4"
+
+
+def test_eval_condition():
+    obj = {"status": {"phase": "Succeeded", "startTime": "t"}}
+    assert eval_condition(obj, "status.startTime")
+    assert eval_condition(obj, "status.phase == Succeeded")
+    assert not eval_condition(obj, "status.phase == Failed")
+    assert eval_condition(obj, "status.phase != Failed")
+    assert not eval_condition(obj, "status.completionTime")
+    assert not eval_condition(None, "status.startTime")
+
+
+# -- container DAG ---------------------------------------------------------
+
+def test_linear_dag_runs_in_order(client, ctrl):
+    client.create(workflow("w", "default", [
+        container_step("first", "img:1"),
+        container_step("second", "img:2", dependencies=["first"]),
+    ]))
+    ctrl.reconcile("default", "w")
+    pods = client.list("v1", "Pod", "default")
+    assert [p["metadata"]["name"] for p in pods] == ["w-first"]
+
+    finish_pods(client)
+    ctrl.reconcile("default", "w")
+    pods = client.list("v1", "Pod", "default")
+    assert sorted(p["metadata"]["name"] for p in pods) == ["w-first",
+                                                           "w-second"]
+    finish_pods(client)
+    ctrl.reconcile("default", "w")
+    wf = get_wf(client, "w")
+    assert wf["status"]["phase"] == "Succeeded"
+    assert wf["status"]["nodes"]["second"]["phase"] == "Succeeded"
+
+
+def test_parallel_steps_launch_together(client, ctrl):
+    client.create(workflow("w", "default", [
+        container_step("a", "img"),
+        container_step("b", "img"),
+        container_step("join", "img", dependencies=["a", "b"]),
+    ]))
+    ctrl.reconcile("default", "w")
+    assert len(client.list("v1", "Pod", "default")) == 2
+
+
+def test_failure_skips_dependents(client, ctrl):
+    client.create(workflow("w", "default", [
+        container_step("a", "img"),
+        container_step("b", "img", dependencies=["a"]),
+        container_step("c", "img", dependencies=["b"]),
+    ]))
+    ctrl.reconcile("default", "w")
+    finish_pods(client, phase="Failed")
+    ctrl.reconcile("default", "w")
+    wf = get_wf(client, "w")
+    assert wf["status"]["phase"] == "Failed"
+    assert wf["status"]["nodes"]["a"]["phase"] == "Failed"
+    assert wf["status"]["nodes"]["b"]["phase"] == "Skipped"
+    assert wf["status"]["nodes"]["c"]["phase"] == "Skipped"
+
+
+def test_step_retry(client, ctrl):
+    client.create(workflow("w", "default", [
+        container_step("flaky", "img", retries=1),
+    ]))
+    ctrl.reconcile("default", "w")
+    finish_pods(client, phase="Failed")
+    ctrl.reconcile("default", "w")  # observes failure, schedules retry
+    ctrl.reconcile("default", "w")  # launches retry pod
+    pods = client.list("v1", "Pod", "default")
+    assert "w-flaky-r1" in [p["metadata"]["name"] for p in pods]
+    finish_pods(client, match="r1")
+    ctrl.reconcile("default", "w")
+    assert get_wf(client, "w")["status"]["phase"] == "Succeeded"
+
+
+def test_resource_step_waits_for_condition(client, ctrl):
+    target = {"apiVersion": "kubeflow-tpu.org/v1alpha1", "kind": "TpuJob",
+              "metadata": {"name": "job", "namespace": "default"},
+              "spec": {"image": "x"}}
+    client.create(workflow("w", "default", [
+        resource_step("launch", "create", target,
+                      success_condition="status.startTime",
+                      failure_condition="status.phase == Failed"),
+    ]))
+    ctrl.reconcile("default", "w")
+    created = client.get("kubeflow-tpu.org/v1alpha1", "TpuJob", "default",
+                         "job")
+    assert created is not None
+    wf = get_wf(client, "w")
+    assert wf["status"]["nodes"]["launch"]["phase"] == "Running"
+    created.setdefault("status", {})["startTime"] = "t"
+    client.update_status(created)
+    ctrl.reconcile("default", "w")
+    assert get_wf(client, "w")["status"]["phase"] == "Succeeded"
+
+
+# -- kubebench DAG ---------------------------------------------------------
+
+def test_benchmark_workflow_end_to_end(client, ctrl):
+    """The full kubebench shape against the real TpuJob operator."""
+    op = TpuJobOperator(client)
+    wf = benchmark_workflow(
+        "bench-resnet", "default",
+        job_spec={"image": "kubeflow-tpu/examples:latest",
+                  "command": ["python", "-m", "kubeflow_tpu.examples.resnet"],
+                  "slices": 1, "hostsPerSlice": 2})
+    client.create(wf)
+
+    for _ in range(30):
+        ctrl.reconcile("default", "bench-resnet")
+        op.reconcile("default", "bench-resnet-main")
+        # fake kubelet: run worker pods to completion
+        for pod in client.list("v1", "Pod", "default"):
+            ph = pod.get("status", {}).get("phase", "Pending")
+            if "bench-resnet-main" in pod["metadata"]["name"]:
+                if ph == "Pending":
+                    pod.setdefault("status", {})["phase"] = "Running"
+                    client.update_status(pod)
+                elif ph == "Running":
+                    pod["status"]["phase"] = "Succeeded"
+                    client.update_status(pod)
+            elif ph == "Pending":  # reporter container step
+                pod.setdefault("status", {})["phase"] = "Succeeded"
+                client.update_status(pod)
+        wf_state = get_wf(client, "bench-resnet")
+        if wf_state["status"].get("phase") in ("Succeeded", "Failed"):
+            break
+    assert wf_state["status"]["phase"] == "Succeeded"
+    nodes = wf_state["status"]["nodes"]
+    assert nodes["launch-main-job"]["phase"] == "Succeeded"
+    assert nodes["wait-for-main-job"]["phase"] == "Succeeded"
+    assert nodes["run-reporter"]["phase"] == "Succeeded"
+
+
+# -- cron ------------------------------------------------------------------
+
+def test_cron_parse_and_match():
+    sched = CronSchedule.parse("*/15 3 * * *")
+    import calendar
+
+    t = calendar.timegm((2026, 7, 29, 3, 30, 0, 0, 0, 0))
+    assert sched.matches(t)
+    t2 = calendar.timegm((2026, 7, 29, 4, 30, 0, 0, 0, 0))
+    assert not sched.matches(t2)
+    nxt = sched.next_after(t)
+    assert nxt == t + 15 * 60
+
+
+def test_cron_dow_sunday_is_zero():
+    sched = CronSchedule.parse("0 0 * * 0")
+    import calendar
+
+    sunday = calendar.timegm((2026, 8, 2, 0, 0, 0, 0, 0, 0))  # a Sunday
+    monday = calendar.timegm((2026, 8, 3, 0, 0, 0, 0, 0, 0))
+    assert sched.matches(sunday)
+    assert not sched.matches(monday)
+
+
+def test_cron_rejects_bad_exprs():
+    with pytest.raises(ValueError):
+        CronSchedule.parse("* * *")
+    with pytest.raises(ValueError):
+        CronSchedule.parse("99 * * * *")
+
+
+def test_scheduled_workflow_interval(client):
+    now = [1000.0]
+    ctrl = ScheduledWorkflowController(client, clock=lambda: now[0])
+    client.create(scheduled_workflow(
+        "nightly", "default",
+        {"steps": [container_step("s", "img")]},
+        interval_seconds=600, max_history=2))
+    delay = ctrl.reconcile("default", "nightly")
+    runs = client.list(WORKFLOW_API_VERSION, WORKFLOW_KIND, "default")
+    assert len(runs) == 1  # fires immediately on first reconcile
+    assert delay == 600
+    # not due again yet
+    now[0] = 1100.0
+    ctrl.reconcile("default", "nightly")
+    assert len(client.list(WORKFLOW_API_VERSION, WORKFLOW_KIND,
+                           "default")) == 1
+    # due after the interval
+    now[0] = 1700.0
+    ctrl.reconcile("default", "nightly")
+    assert len(client.list(WORKFLOW_API_VERSION, WORKFLOW_KIND,
+                           "default")) == 2
+
+
+def test_cron_fires_in_consecutive_minutes(client):
+    # a mid-minute fire must not suppress the next matching minute
+    import calendar
+
+    base = calendar.timegm((2026, 7, 29, 3, 0, 30, 0, 0, 0))  # 03:00:30
+    now = [float(base)]
+    ctrl = ScheduledWorkflowController(client, clock=lambda: now[0])
+    client.create(scheduled_workflow(
+        "everymin", "default",
+        {"steps": [container_step("s", "img")]},
+        cron="* 3 * * *"))
+    ctrl.reconcile("default", "everymin")
+    assert len(client.list(WORKFLOW_API_VERSION, WORKFLOW_KIND,
+                           "default")) == 1
+    now[0] = float(base + 30)  # 03:01:00 — next minute bucket
+    ctrl.reconcile("default", "everymin")
+    assert len(client.list(WORKFLOW_API_VERSION, WORKFLOW_KIND,
+                           "default")) == 2
+
+
+def test_scheduled_workflow_invalid_schedule_fails_fast(client):
+    now = [1000.0]
+    ctrl = ScheduledWorkflowController(client, clock=lambda: now[0])
+    client.create({
+        "apiVersion": "kubeflow-tpu.org/v1alpha1",
+        "kind": "ScheduledWorkflow",
+        "metadata": {"name": "bad", "namespace": "default"},
+        "spec": {"workflowSpec": {"steps": [container_step("s", "img")]}},
+    })
+    assert ctrl.reconcile("default", "bad") is None
+    swf = client.get("kubeflow-tpu.org/v1alpha1", "ScheduledWorkflow",
+                     "default", "bad")
+    assert swf["status"]["phase"] == "Failed"
+    # terminal: no more reconcile churn
+    assert ctrl.reconcile("default", "bad") is None
+
+
+def test_bench_reporter_cli(tmp_path):
+    import json as _json
+
+    from kubeflow_tpu.bench.__main__ import main as bench_main
+
+    (tmp_path / "bench-resnet-main.jsonl").write_text(
+        '{"step": 1, "images_per_sec": 1000}\n'
+        '{"step": 2, "images_per_sec": 1200}\n')
+    rc = bench_main(["report", "--name", "bench-resnet-main",
+                     "--out", str(tmp_path)])
+    assert rc == 0
+    out = _json.loads((tmp_path / "bench-resnet-main.json").read_text())
+    assert out["final_metrics"]["images_per_sec"] == 1200
+    assert (tmp_path / "bench-resnet-main.csv").exists()
+    # missing metrics file still exits 0 with NoMetrics status
+    assert bench_main(["report", "--name", "ghost",
+                       "--out", str(tmp_path)]) == 0
+
+
+def test_scheduled_workflow_prunes_history(client):
+    now = [1000.0]
+    ctrl = ScheduledWorkflowController(client, clock=lambda: now[0])
+    client.create(scheduled_workflow(
+        "nightly", "default",
+        {"steps": [container_step("s", "img")]},
+        interval_seconds=10, max_history=2))
+    for i in range(5):
+        now[0] = 1000.0 + i * 20
+        ctrl.reconcile("default", "nightly")
+        # mark every run terminal so it is prunable
+        for run in client.list(WORKFLOW_API_VERSION, WORKFLOW_KIND,
+                               "default"):
+            if not run.get("status", {}).get("phase"):
+                run["status"] = {"phase": "Succeeded"}
+                client.update_status(run)
+    now[0] = 1000.0 + 5 * 20
+    ctrl.reconcile("default", "nightly")  # prunes the last terminal run too
+    runs = [r for r in client.list(WORKFLOW_API_VERSION, WORKFLOW_KIND,
+                                   "default")
+            if r.get("status", {}).get("phase") == "Succeeded"]
+    assert len(runs) == 2  # maxHistory enforced over terminal runs
+
+
+def test_workflows_component_manifests():
+    config = DeploymentConfig(name="demo")
+    objs = render_component(config, ComponentSpec("workflows"))
+    kinds = [(x["kind"], x["metadata"]["name"]) for x in objs]
+    assert ("CustomResourceDefinition",
+            "workflows.kubeflow-tpu.org") in kinds
+    assert ("CustomResourceDefinition",
+            "scheduledworkflows.kubeflow-tpu.org") in kinds
+    assert ("Deployment", "workflow-controller") in kinds
+    assert ("Deployment", "scheduledworkflow-controller") in kinds
