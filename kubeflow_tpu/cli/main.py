@@ -70,20 +70,58 @@ def cmd_init(args) -> int:
     return 0
 
 
+def _sync_fake_state(config: DeploymentConfig, args) -> None:
+    """--fake-state must point the platform and k8s phases at the SAME
+    cluster-state file, or fake TPU nodes land in a different 'cluster'
+    than the workload manifests."""
+    if config.platform == "local" and getattr(args, "fake_state", None):
+        config.platform_params["state_file"] = args.fake_state
+
+
+def _platform_phase(config: DeploymentConfig, app_dir: str, action: str,
+                    provision: bool) -> None:
+    from kubeflow_tpu.platform import get_platform
+
+    platform = get_platform(config.platform)
+    report = getattr(platform, action)(config, app_dir,
+                                       dry_run=not provision)
+    if report.get("dry_run"):
+        hint = "" if provision else " (pass --provision to execute)"
+        print(f"platform {action} plan{hint}:")
+        for cmd in report.get("commands", []):
+            print("  " + (" ".join(cmd) if isinstance(cmd, list)
+                          else str(cmd)))
+        if report.get("note"):
+            print(f"  note: {report['note']}")
+    else:
+        print(f"platform {action}: "
+              + ", ".join(f"{k}={v}" for k, v in report.items()
+                          if k != "dry_run"))
+
+
 def cmd_generate(args) -> int:
     config = _app_config(args.app_dir)
-    objs = render_all(config)
-    out_dir = _manifest_path(args.app_dir)
-    os.makedirs(out_dir, exist_ok=True)
-    for f in os.listdir(out_dir):
-        if f.endswith(".yaml"):
-            os.remove(os.path.join(out_dir, f))
-    for i, obj in enumerate(objs):
-        md = obj.get("metadata", {})
-        fname = f"{i:03d}_{obj['kind'].lower()}_{md.get('name', 'unnamed')}.yaml"
-        with open(os.path.join(out_dir, fname), "w") as f:
-            yaml.safe_dump(obj, f, sort_keys=False)
-    print(f"generated {len(objs)} manifests in {out_dir}")
+    phase = getattr(args, "resource", "all")
+    if phase in ("all", "platform"):
+        from kubeflow_tpu.platform import get_platform
+
+        paths = get_platform(config.platform).generate(config, args.app_dir)
+        if paths:
+            print(f"generated platform config: {', '.join(paths)}")
+    if phase in ("all", "k8s"):
+        objs = render_all(config)
+        out_dir = _manifest_path(args.app_dir)
+        os.makedirs(out_dir, exist_ok=True)
+        for f in os.listdir(out_dir):
+            if f.endswith(".yaml"):
+                os.remove(os.path.join(out_dir, f))
+        for i, obj in enumerate(objs):
+            md = obj.get("metadata", {})
+            fname = (f"{i:03d}_{obj['kind'].lower()}_"
+                     f"{md.get('name', 'unnamed')}.yaml")
+            with open(os.path.join(out_dir, fname), "w") as f:
+                yaml.safe_dump(obj, f, sort_keys=False)
+        print(f"generated {len(objs)} manifests in {out_dir}")
     return 0
 
 
@@ -100,18 +138,30 @@ def _load_manifests(app_dir: str) -> List[Obj]:
 
 
 def cmd_apply(args) -> int:
-    objs = _load_manifests(args.app_dir)
-    client = _client(args)
-    applied = apply_all(client, objs)
-    print(f"applied {len(applied)} objects")
+    config = _app_config(args.app_dir)
+    _sync_fake_state(config, args)
+    phase = getattr(args, "resource", "all")
+    if phase in ("all", "platform"):
+        _platform_phase(config, args.app_dir, "apply", args.provision)
+    if phase in ("all", "k8s"):
+        objs = _load_manifests(args.app_dir)
+        client = _client(args)
+        applied = apply_all(client, objs)
+        print(f"applied {len(applied)} objects")
     return 0
 
 
 def cmd_delete(args) -> int:
-    objs = _load_manifests(args.app_dir)
-    client = _client(args)
-    delete_all(client, objs)
-    print(f"deleted {len(objs)} objects")
+    config = _app_config(args.app_dir)
+    _sync_fake_state(config, args)
+    phase = getattr(args, "resource", "all")
+    if phase in ("all", "k8s"):
+        objs = _load_manifests(args.app_dir)
+        client = _client(args)
+        delete_all(client, objs)
+        print(f"deleted {len(objs)} objects")
+    if phase in ("all", "platform"):
+        _platform_phase(config, args.app_dir, "delete", args.provision)
     return 0
 
 
@@ -157,19 +207,28 @@ def build_parser() -> argparse.ArgumentParser:
                     help="override platform (local|gcp-tpu|existing)")
     sp.add_argument("--force", action="store_true")
 
-    app_cmd("generate", cmd_generate, "render manifests from app.yaml")
+    sp = app_cmd("generate", cmd_generate,
+                 "render platform config + manifests from app.yaml")
+    sp.add_argument("resource", nargs="?", default="all",
+                    choices=("all", "platform", "k8s"),
+                    help="phase to generate (kfctl resource enum)")
 
     for name, fn, help_ in (
         ("apply", cmd_apply, "apply generated manifests to the cluster"),
         ("delete", cmd_delete, "delete applied objects"),
     ):
         sp = app_cmd(name, fn, help_)
+        sp.add_argument("resource", nargs="?", default="all",
+                        choices=("all", "platform", "k8s"),
+                        help="phase to act on (kfctl resource enum)")
         sp.add_argument("--server", default=None,
                         help="API server URL (default: in-cluster or fake)")
         sp.add_argument("--insecure", action="store_true",
                         help="skip TLS verification")
         sp.add_argument("--fake-state", default=None,
                         help="file-backed fake cluster state path")
+        sp.add_argument("--provision", action="store_true",
+                        help="execute the platform plan instead of dry-run")
 
     app_cmd("show", cmd_show, "print rendered manifests")
 

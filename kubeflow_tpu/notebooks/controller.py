@@ -82,9 +82,14 @@ def build_statefulset(nb: o.Obj) -> o.Obj:
     }
     node_selector = None
     if spec.tpu_chips:
+        from kubeflow_tpu.platform.slices import slice_shape
+
         resources["limits"]["google.com/tpu"] = spec.tpu_chips
+        # select on the GKE accelerator TYPE the node pool advertises,
+        # not the framework's shape name
         node_selector = {
-            "cloud.google.com/gke-tpu-accelerator": spec.accelerator}
+            "cloud.google.com/gke-tpu-accelerator":
+                slice_shape(spec.accelerator).accelerator}
 
     env = dict(spec.env)
     # same base-url contract as the reference's sync-notebook.jsonnet:12-23

@@ -189,12 +189,18 @@ def build_worker_pod(job: o.Obj, index: int, placement: SlicePlacement) -> o.Obj
         ports=[spec.coordinator_port] if index == 0 else None,
         resources={"limits": {"google.com/tpu": spec.chips_per_host}},
     )
+    # node labels carry the GKE accelerator TYPE (tpu-v5-lite-podslice),
+    # not the framework's shape name (v5e-8) — selecting on the shape name
+    # would never match a real TPU node pool
+    from kubeflow_tpu.platform.slices import slice_shape
+
+    shape = slice_shape(spec.accelerator)
     pspec = o.pod_spec(
         [ctr],
         restart_policy="Never",  # the operator owns restart semantics: a
         # worker restarting alone cannot rejoin the SPMD mesh
         node_selector={
-            "cloud.google.com/gke-tpu-accelerator": spec.accelerator,
+            "cloud.google.com/gke-tpu-accelerator": shape.accelerator,
             "cloud.google.com/gke-tpu-topology": placement.topology,
         },
         scheduler_name="kftpu-gang" if spec.gang_scheduling else None,

@@ -1,0 +1,22 @@
+"""Platform layer: cluster provisioning for TPU workloads.
+
+Reference surface: the Go ``Platform`` interface
+(``/root/reference/bootstrap/pkg/apis/apps/group.go:116-121``: KfApp
+Init/Generate/Apply/Delete + ``GetK8sConfig``) with plugins for
+gcp / aws / minikube / dockerfordesktop / existing_arrikto
+(``bootstrap/pkg/kfapp/*/``). The TPU build replaces the GPU node-pool DM
+configs (``deployment/gke/deployment_manager_configs/cluster.jinja:
+167-169``) and the gpu-driver DaemonSet (``kubeflow/gcp/gpu-driver.
+libsonnet``) with TPU pod-slice node pools — no driver installer; the TPU
+runtime is part of the node image.
+"""
+
+from kubeflow_tpu.platform.base import Platform, get_platform  # noqa: F401
+from kubeflow_tpu.platform.slices import (  # noqa: F401
+    SliceShape,
+    SLICE_SHAPES,
+    slice_shape,
+    node_pool_for,
+)
+from kubeflow_tpu.platform.gcp import GcpTpuPlatform  # noqa: F401
+from kubeflow_tpu.platform.local import ExistingPlatform, LocalPlatform  # noqa: F401

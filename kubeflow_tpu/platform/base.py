@@ -1,0 +1,61 @@
+"""Platform interface + registry.
+
+Mirrors the reference's Go ``Platform`` contract: a platform plugin does
+``Generate`` (emit infra config to the app dir) and ``Apply``/``Delete``
+(drive the cloud control plane), and yields a k8s client for the layers
+above (``/root/reference/bootstrap/pkg/apis/apps/group.go:104-121``;
+coordinator phase split ``coordinator.go:715-917``).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Callable, Dict, List, Optional
+
+from kubeflow_tpu.config.deployment import DeploymentConfig
+from kubeflow_tpu.k8s.client import KubeClient
+
+
+class Platform(abc.ABC):
+    """One provisioning backend (gcp-tpu, local, existing)."""
+
+    name = "base"
+
+    @abc.abstractmethod
+    def generate(self, config: DeploymentConfig, app_dir: str) -> List[str]:
+        """Emit infra config files into the app dir; returns paths."""
+
+    @abc.abstractmethod
+    def apply(self, config: DeploymentConfig, app_dir: str, *,
+              dry_run: bool = True) -> Dict:
+        """Provision (or plan) the infrastructure. Returns a report dict;
+        with ``dry_run`` the report carries the commands that would run."""
+
+    @abc.abstractmethod
+    def delete(self, config: DeploymentConfig, app_dir: str, *,
+               dry_run: bool = True) -> Dict:
+        """Tear down (or plan tearing down) the infrastructure."""
+
+    def kube_client(self, config: DeploymentConfig) -> Optional[KubeClient]:
+        """Client for the provisioned cluster; None when not applicable."""
+        return None
+
+
+_PLATFORMS: Dict[str, Callable[[], Platform]] = {}
+
+
+def register_platform(name: str):
+    def wrap(cls):
+        _PLATFORMS[name] = cls
+        return cls
+    return wrap
+
+
+def get_platform(name: str) -> Platform:
+    # import built-ins so their register_platform calls run
+    from kubeflow_tpu.platform import gcp, local  # noqa: F401
+
+    if name not in _PLATFORMS:
+        known = ", ".join(sorted(_PLATFORMS))
+        raise ValueError(f"unknown platform {name!r}; known: {known}")
+    return _PLATFORMS[name]()

@@ -1,0 +1,205 @@
+"""GCP TPU platform: GKE cluster + TPU pod-slice node pools.
+
+Replaces the reference's Deployment-Manager path
+(``/root/reference/bootstrap/pkg/kfapp/gcp/gcp.go`` — ``generateDMConfigs
+:1269`` renders the jinja templates under ``deployment/gke/``, ``updateDM
+:650`` drives the DM API with ``blockingWait :328`` backoff, IAM bindings
+``writeIamBindingsFile :1071``). Here Generate renders declarative cluster
+config + a gcloud command plan into ``<app>/gcp_config/``; Apply executes
+the plan via the gcloud CLI when present (with retry/backoff) or returns
+it as a dry-run report. The GPU node pool + driver DaemonSet are replaced
+by TPU slice pools (:mod:`kubeflow_tpu.platform.slices`); IAP/ingress
+stays at the manifest layer.
+
+platformParams (``app.yaml`` spec.platformParams):
+  project, zone, cluster_name (default: deployment name),
+  slices: [{shape: v5e-8, count: 1, spot: false, reservation: ""}],
+  cpu_pool_machine_type, cpu_pool_size, network, workload_identity
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import time
+from typing import Any, Dict, List
+
+import yaml
+
+from kubeflow_tpu.config.deployment import DeploymentConfig
+from kubeflow_tpu.platform.base import Platform, register_platform
+from kubeflow_tpu.platform.slices import node_pool_for, slice_shape
+
+GCP_CONFIG_DIR = "gcp_config"
+
+
+def _params(config: DeploymentConfig) -> Dict[str, Any]:
+    p = dict(config.platform_params)
+    p.setdefault("project", "")
+    p.setdefault("zone", "us-central2-b")
+    p.setdefault("cluster_name", config.name)
+    p.setdefault("slices", [{"shape": "v5e-8", "count": 1}])
+    p.setdefault("cpu_pool_machine_type", "e2-standard-8")
+    p.setdefault("cpu_pool_size", 2)
+    p.setdefault("network", "default")
+    p.setdefault("workload_identity", True)
+    return p
+
+
+def cluster_config(config: DeploymentConfig) -> Dict[str, Any]:
+    """The cluster + node-pool declaration (cluster.jinja equivalent)."""
+    p = _params(config)
+    pools: List[Dict[str, Any]] = [{
+        "name": "cpu-pool",
+        "machineType": p["cpu_pool_machine_type"],
+        "initialNodeCount": p["cpu_pool_size"],
+        "config": {"labels": {"kubeflow-tpu.org/pool": "cpu"}},
+    }]
+    for s in p["slices"]:
+        pools.append(node_pool_for(
+            s["shape"], count=int(s.get("count", 1)),
+            spot=bool(s.get("spot", False)),
+            reserved=s.get("reservation", "")))
+    cluster: Dict[str, Any] = {
+        "name": p["cluster_name"],
+        "project": p["project"],
+        "zone": p["zone"],
+        "network": p["network"],
+        "releaseChannel": "regular",
+        "nodePools": pools,
+    }
+    if p["workload_identity"] and p["project"]:
+        cluster["workloadIdentityConfig"] = {
+            "workloadPool": f"{p['project']}.svc.id.goog"}
+    return cluster
+
+
+def iam_bindings(config: DeploymentConfig) -> List[Dict[str, str]]:
+    """Service-account role bindings (writeIamBindingsFile equivalent)."""
+    p = _params(config)
+    if not p["project"]:
+        return []
+    sa = f"{config.name}-admin@{p['project']}.iam.gserviceaccount.com"
+    return [
+        {"member": f"serviceAccount:{sa}", "role": role}
+        for role in ("roles/container.admin",
+                     "roles/storage.objectAdmin",
+                     "roles/logging.logWriter",
+                     "roles/monitoring.metricWriter")
+    ]
+
+
+def gcloud_plan(config: DeploymentConfig) -> List[List[str]]:
+    """The create-side command plan Apply executes."""
+    p = _params(config)
+    c = cluster_config(config)
+    project_args = ["--project", p["project"]] if p["project"] else []
+    plan = [[
+        "gcloud", "container", "clusters", "create", c["name"],
+        "--zone", p["zone"], "--network", p["network"],
+        "--release-channel", "regular",
+        "--num-nodes", str(p["cpu_pool_size"]),
+        "--machine-type", p["cpu_pool_machine_type"],
+        *(["--workload-pool", c["workloadIdentityConfig"]["workloadPool"]]
+          if "workloadIdentityConfig" in c else []),
+        *project_args,
+    ]]
+    for pool in c["nodePools"]:
+        if pool["name"] == "cpu-pool":
+            continue
+        shape = slice_shape(pool["config"]["labels"][
+            "kubeflow-tpu.org/slice-shape"])
+        cmd = [
+            "gcloud", "container", "node-pools", "create", pool["name"],
+            "--cluster", c["name"], "--zone", p["zone"],
+            "--machine-type", shape.machine_type,
+            "--tpu-topology", shape.topology,
+            "--num-nodes", str(pool["initialNodeCount"]),
+            *project_args,
+        ]
+        if pool["config"].get("spot"):
+            cmd.append("--spot")
+        if "reservationAffinity" in pool["config"]:
+            cmd += ["--reservation-affinity", "specific", "--reservation",
+                    pool["config"]["reservationAffinity"]["values"][0]]
+        plan.append(cmd)
+    plan.append([
+        "gcloud", "container", "clusters", "get-credentials", c["name"],
+        "--zone", p["zone"], *project_args,
+    ])
+    return plan
+
+
+@register_platform("gcp-tpu")
+class GcpTpuPlatform(Platform):
+    name = "gcp-tpu"
+
+    max_attempts = 3
+    backoff_s = 10.0
+
+    def generate(self, config: DeploymentConfig, app_dir: str) -> List[str]:
+        out_dir = os.path.join(app_dir, GCP_CONFIG_DIR)
+        os.makedirs(out_dir, exist_ok=True)
+        paths = []
+        for fname, payload in (
+            ("cluster.yaml", cluster_config(config)),
+            ("iam_bindings.yaml", iam_bindings(config)),
+            ("plan.json", gcloud_plan(config)),
+        ):
+            path = os.path.join(out_dir, fname)
+            with open(path, "w") as f:
+                if fname.endswith(".json"):
+                    json.dump(payload, f, indent=1)
+                else:
+                    yaml.safe_dump(payload, f, sort_keys=False)
+            paths.append(path)
+        return paths
+
+    def apply(self, config: DeploymentConfig, app_dir: str, *,
+              dry_run: bool = True) -> Dict:
+        plan = self._load_plan(config, app_dir)
+        if dry_run or not shutil.which("gcloud"):
+            return {"dry_run": True, "commands": plan,
+                    "note": "gcloud not executed"
+                            + ("" if dry_run else " (binary not found)")}
+        executed = []
+        for cmd in plan:
+            self._run_with_backoff(cmd)
+            executed.append(cmd)
+        return {"dry_run": False, "commands": executed}
+
+    def delete(self, config: DeploymentConfig, app_dir: str, *,
+               dry_run: bool = True) -> Dict:
+        p = _params(config)
+        cmd = ["gcloud", "container", "clusters", "delete",
+               p["cluster_name"], "--zone", p["zone"], "--quiet"]
+        if p["project"]:
+            cmd += ["--project", p["project"]]
+        if dry_run or not shutil.which("gcloud"):
+            return {"dry_run": True, "commands": [cmd]}
+        self._run_with_backoff(cmd)
+        return {"dry_run": False, "commands": [cmd]}
+
+    def _load_plan(self, config: DeploymentConfig,
+                   app_dir: str) -> List[List[str]]:
+        path = os.path.join(app_dir, GCP_CONFIG_DIR, "plan.json")
+        if os.path.exists(path):
+            with open(path) as f:
+                return json.load(f)
+        return gcloud_plan(config)
+
+    def _run_with_backoff(self, cmd: List[str]) -> None:
+        """blockingWait-style retry (gcp.go:328-371 exponential backoff)."""
+        delay = self.backoff_s
+        for attempt in range(1, self.max_attempts + 1):
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode == 0:
+                return
+            if attempt == self.max_attempts:
+                raise RuntimeError(
+                    f"{' '.join(cmd)} failed after {attempt} attempts: "
+                    f"{proc.stderr.strip()[-500:]}")
+            time.sleep(delay)
+            delay *= 2

@@ -1,0 +1,147 @@
+"""Local and BYO-cluster platforms.
+
+Reference analogues: minikube / dockerfordesktop plugins
+(``/root/reference/bootstrap/pkg/kfapp/minikube/minikube.go``,
+``dockerfordesktop/dockerfordesktop.go``) and existing_arrikto
+(``existing_arrikto/existing.go`` — BYO cluster, no provisioning).
+
+- :class:`LocalPlatform` — dev loop: a file-backed fake API server plus
+  *fake slice* node objects advertising ``google.com/tpu`` capacity with
+  the same accelerator/topology labels real GKE TPU pools carry, so gang
+  placement and node selection exercise the real code paths with no cloud.
+- :class:`ExistingPlatform` — BYO cluster: no provisioning; Apply only
+  verifies the API server is reachable.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import yaml
+
+from kubeflow_tpu.config.deployment import DeploymentConfig
+from kubeflow_tpu.k8s.client import ApiError, HttpKubeClient, KubeClient
+from kubeflow_tpu.k8s.fakefile import FileBackedFakeClient
+from kubeflow_tpu.k8s.helpers import create_if_absent
+from kubeflow_tpu.platform.base import Platform, register_platform
+from kubeflow_tpu.platform.slices import slice_shape
+
+LOCAL_CONFIG_DIR = "local_config"
+
+
+def fake_slice_nodes(shape_name: str, *, count: int = 1) -> List[Dict]:
+    """Node objects mimicking one or more TPU slices for the dev loop."""
+    shape = slice_shape(shape_name)
+    nodes = []
+    for s in range(count):
+        for h in range(shape.hosts):
+            nodes.append({
+                "apiVersion": "v1",
+                "kind": "Node",
+                "metadata": {
+                    "name": f"fake-{shape.name}-s{s}-h{h}",
+                    "labels": {
+                        "cloud.google.com/gke-tpu-accelerator":
+                            shape.accelerator,
+                        "cloud.google.com/gke-tpu-topology": shape.topology,
+                        "kubeflow-tpu.org/slice-shape": shape.name,
+                        "kubeflow-tpu.org/slice-index": str(s),
+                        "kubeflow-tpu.org/fake": "true",
+                    },
+                },
+                "status": {
+                    "capacity": {"google.com/tpu": shape.chips_per_host,
+                                 "cpu": "8", "memory": "32Gi"},
+                    "conditions": [{"type": "Ready", "status": "True"}],
+                },
+            })
+    return nodes
+
+
+@register_platform("local")
+class LocalPlatform(Platform):
+    name = "local"
+
+    def generate(self, config: DeploymentConfig, app_dir: str) -> List[str]:
+        out_dir = os.path.join(app_dir, LOCAL_CONFIG_DIR)
+        os.makedirs(out_dir, exist_ok=True)
+        shapes = config.platform_params.get(
+            "slices", [{"shape": "v5e-8", "count": 1}])
+        nodes: List[Dict] = []
+        for s in shapes:
+            nodes.extend(fake_slice_nodes(s["shape"],
+                                          count=int(s.get("count", 1))))
+        path = os.path.join(out_dir, "fake_nodes.yaml")
+        with open(path, "w") as f:
+            yaml.safe_dump_all(nodes, f, sort_keys=False)
+        return [path]
+
+    def apply(self, config: DeploymentConfig, app_dir: str, *,
+              dry_run: bool = True) -> Dict:
+        """Seed fake slice nodes into the file-backed cluster state."""
+        path = os.path.join(app_dir, LOCAL_CONFIG_DIR, "fake_nodes.yaml")
+        if not os.path.exists(path):
+            self.generate(config, app_dir)
+        with open(path) as f:
+            nodes = [n for n in yaml.safe_load_all(f) if n]
+        if dry_run:
+            return {"dry_run": True,
+                    "commands": [f"seed {len(nodes)} fake TPU node(s) into "
+                                 "the local cluster state"]}
+        client = self.kube_client(config, app_dir)
+        for node in nodes:
+            create_if_absent(client, node)
+        return {"dry_run": False, "nodes": len(nodes)}
+
+    def delete(self, config: DeploymentConfig, app_dir: str, *,
+               dry_run: bool = True) -> Dict:
+        client = self.kube_client(config, app_dir)
+        fakes = [
+            node["metadata"]["name"] for node in client.list("v1", "Node")
+            if (node.get("metadata", {}).get("labels", {}) or {})
+            .get("kubeflow-tpu.org/fake") == "true"
+        ]
+        if dry_run:
+            return {"dry_run": True,
+                    "commands": [f"remove fake TPU node {n}" for n in fakes]}
+        for name in fakes:
+            client.delete("v1", "Node", "", name)
+        return {"dry_run": False, "nodes_removed": len(fakes)}
+
+    def kube_client(self, config: DeploymentConfig,
+                    app_dir: str = ".") -> KubeClient:
+        state = config.platform_params.get(
+            "state_file", os.path.join(app_dir, ".cluster.json"))
+        return FileBackedFakeClient(state)
+
+
+@register_platform("existing")
+class ExistingPlatform(Platform):
+    name = "existing"
+
+    def generate(self, config: DeploymentConfig, app_dir: str) -> List[str]:
+        return []  # nothing to provision
+
+    def apply(self, config: DeploymentConfig, app_dir: str, *,
+              dry_run: bool = True) -> Dict:
+        client = self.kube_client(config)
+        try:
+            client.list("v1", "Namespace")  # read-only reachability probe
+            return {"dry_run": dry_run, "reachable": True,
+                    "commands": ["verify API server reachability"]}
+        except (ApiError, OSError) as e:
+            return {"dry_run": dry_run, "reachable": False, "error": str(e),
+                    "commands": ["verify API server reachability"]}
+
+    def delete(self, config: DeploymentConfig, app_dir: str, *,
+               dry_run: bool = True) -> Dict:
+        return {"dry_run": True, "note": "existing cluster is not deleted"}
+
+    def kube_client(self, config: DeploymentConfig) -> Optional[KubeClient]:
+        server = config.platform_params.get("server", "")
+        if server:
+            return HttpKubeClient(
+                base_url=server,
+                verify=not config.platform_params.get("insecure", False))
+        return HttpKubeClient()

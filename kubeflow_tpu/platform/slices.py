@@ -1,0 +1,114 @@
+"""TPU slice inventory: shapes, topologies, and node-pool derivation.
+
+The platform's equivalent of the reference's GPU accelerator node-pool
+config (``/root/reference/deployment/gke/deployment_manager_configs/
+cluster-kubeflow.yaml:56-66`` — gpu-pool with ``nvidia-tesla-k80``). A TPU
+slice is indivisible and topology-addressed: provisioning asks for whole
+pod slices, and the scheduler places gangs onto them (SURVEY.md §7 hard
+part (a)).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+
+@dataclass(frozen=True)
+class SliceShape:
+    """One provisionable slice type."""
+
+    accelerator: str       # GKE accelerator label, e.g. "tpu-v5-lite-podslice"
+    generation: str        # v4 | v5e | v5p | v6e
+    topology: str          # chip grid, e.g. "4x8"
+    chips: int             # total chips in the slice
+    hosts: int             # VMs in the slice (chips / chips-per-host)
+    chips_per_host: int
+    machine_type: str
+
+    @property
+    def name(self) -> str:
+        return f"{self.generation}-{self.chips}"
+
+
+def _v5e(topology: str, chips: int, hosts: int) -> SliceShape:
+    return SliceShape("tpu-v5-lite-podslice", "v5e", topology, chips, hosts,
+                      chips // hosts, "ct5lp-hightpu-4t")
+
+
+def _v5p(topology: str, chips: int, hosts: int) -> SliceShape:
+    return SliceShape("tpu-v5p-slice", "v5p", topology, chips, hosts,
+                      chips // hosts, "ct5p-hightpu-4t")
+
+
+def _v4(topology: str, chips: int, hosts: int) -> SliceShape:
+    return SliceShape("tpu-v4-podslice", "v4", topology, chips, hosts,
+                      chips // hosts, "ct4p-hightpu-4t")
+
+
+def _v6e(topology: str, chips: int, hosts: int) -> SliceShape:
+    return SliceShape("tpu-v6e-slice", "v6e", topology, chips, hosts,
+                      chips // hosts, "ct6e-standard-4t")
+
+
+# the provisionable shapes (single host → full pod) per generation
+SLICE_SHAPES: Dict[str, SliceShape] = {s.name: s for s in [
+    _v5e("2x2", 4, 1), _v5e("2x4", 8, 2), _v5e("4x4", 16, 4),
+    _v5e("4x8", 32, 8), _v5e("8x8", 64, 16), _v5e("8x16", 128, 32),
+    _v5e("16x16", 256, 64),
+    _v5p("2x2x1", 4, 1), _v5p("2x2x2", 8, 2), _v5p("2x2x4", 16, 4),
+    _v5p("2x4x4", 32, 8),
+    _v5p("4x4x4", 64, 16), _v5p("4x4x8", 128, 32), _v5p("4x8x8", 256, 64),
+    _v4("2x2x1", 4, 1), _v4("2x2x2", 8, 2), _v4("2x2x4", 16, 4),
+    _v4("2x4x4", 32, 8), _v4("4x4x4", 64, 16), _v4("4x4x8", 128, 32),
+    _v6e("2x2", 4, 1), _v6e("2x4", 8, 2), _v6e("4x4", 16, 4),
+    _v6e("4x8", 32, 8), _v6e("8x8", 64, 16), _v6e("8x16", 128, 32),
+    _v6e("16x16", 256, 64),
+]}
+
+
+def slice_shape(name: str) -> SliceShape:
+    """Look up e.g. ``v5e-8`` / ``v5p-128``."""
+    if name not in SLICE_SHAPES:
+        known = ", ".join(sorted(SLICE_SHAPES))
+        raise ValueError(f"unknown slice shape {name!r}; known: {known}")
+    return SLICE_SHAPES[name]
+
+
+def node_pool_for(name: str, *, count: int = 1, spot: bool = False,
+                  reserved: str = "") -> Dict:
+    """Render the GKE node-pool config for ``count`` slices of this shape.
+
+    Replaces the reference's GPU pool (``cluster.jinja:167-169``): selector
+    labels are ``cloud.google.com/gke-tpu-accelerator`` + ``-topology``,
+    which is what TpuJob worker pods node-select on, and there is NO driver
+    DaemonSet — TPU runtime ships in the node image.
+    """
+    shape = slice_shape(name)
+    pool: Dict = {
+        "name": f"tpu-{shape.name}",
+        "machineType": shape.machine_type,
+        # one node per TPU host VM; a slice of H hosts needs H nodes that
+        # GKE provisions atomically per slice
+        "initialNodeCount": shape.hosts * count,
+        "placementPolicy": {"tpuTopology": shape.topology,
+                            "type": "COMPACT"},
+        "config": {
+            "labels": {
+                "cloud.google.com/gke-tpu-accelerator": shape.accelerator,
+                "cloud.google.com/gke-tpu-topology": shape.topology,
+                "kubeflow-tpu.org/slice-shape": shape.name,
+            },
+            "taints": [{"key": "google.com/tpu", "value": "present",
+                        "effect": "NO_SCHEDULE"}],
+        },
+    }
+    if spot:
+        pool["config"]["spot"] = True
+    if reserved:
+        pool["config"]["reservationAffinity"] = {
+            "consumeReservationType": "SPECIFIC_RESERVATION",
+            "key": "compute.googleapis.com/reservation-name",
+            "values": [reserved],
+        }
+    return pool

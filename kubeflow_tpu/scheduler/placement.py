@@ -18,20 +18,13 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Dict, List, Tuple
 
-# accelerator type -> (chips, hosts, physical topology string).
-# v5e hosts carry 4 chips (v5p: 4 chips / 2x2x1 per host).
+# accelerator type -> (chips, hosts, physical topology string), derived
+# from the platform's provisioning inventory so placement and node pools
+# can never disagree about slice geometry
+from kubeflow_tpu.platform.slices import SLICE_SHAPES  # noqa: E402
+
 ACCELERATORS: Dict[str, Tuple[int, int, str]] = {
-    "v5e-4": (4, 1, "2x2"),
-    "v5e-8": (8, 2, "2x4"),
-    "v5e-16": (16, 4, "4x4"),
-    "v5e-32": (32, 8, "4x8"),
-    "v5e-64": (64, 16, "8x8"),
-    "v5e-128": (128, 32, "8x16"),
-    "v5e-256": (256, 64, "16x16"),
-    "v5p-8": (8, 2, "2x2x2"),
-    "v5p-16": (16, 4, "2x2x4"),
-    "v6e-8": (8, 2, "2x4"),
-    "v6e-256": (256, 64, "16x16"),
+    name: (s.chips, s.hosts, s.topology) for name, s in SLICE_SHAPES.items()
 }
 
 

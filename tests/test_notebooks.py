@@ -53,8 +53,10 @@ def test_tpu_notebook_gets_chips_and_node_selector(client, ctrl):
     sts = client.get("apps/v1", "StatefulSet", "u", "nb")
     pod = sts["spec"]["template"]["spec"]
     assert pod["containers"][0]["resources"]["limits"]["google.com/tpu"] == 4
+    # the selector must carry the GKE accelerator type the node pool
+    # advertises, not the framework shape name
     assert pod["nodeSelector"][
-        "cloud.google.com/gke-tpu-accelerator"] == "v5e-8"
+        "cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
 
 
 def test_stopped_notebook_scales_to_zero(client, ctrl):

@@ -1,0 +1,216 @@
+"""Platform layer tests: slice inventory, GCP config generation, local
+fake-slice provisioning, CLI phase wiring.
+
+Reference test model: gcp_test.go table tests over generated DM configs
+(``/root/reference/bootstrap/pkg/kfapp/gcp/gcp_test.go``).
+"""
+
+import json
+import os
+
+import pytest
+import yaml
+
+from kubeflow_tpu.config.deployment import DeploymentConfig
+from kubeflow_tpu.platform import (
+    GcpTpuPlatform,
+    LocalPlatform,
+    get_platform,
+    node_pool_for,
+    slice_shape,
+)
+from kubeflow_tpu.platform.gcp import cluster_config, gcloud_plan, iam_bindings
+from kubeflow_tpu.platform.local import fake_slice_nodes
+
+
+def _gcp_config(**params):
+    return DeploymentConfig(
+        name="demo", platform="gcp-tpu",
+        platform_params={"project": "my-proj", "zone": "us-east5-a",
+                         **params})
+
+
+# -- slice inventory -------------------------------------------------------
+
+def test_slice_shapes_consistent():
+    for name, shape in __import__(
+            "kubeflow_tpu.platform.slices",
+            fromlist=["SLICE_SHAPES"]).SLICE_SHAPES.items():
+        assert shape.chips == shape.hosts * shape.chips_per_host
+        assert shape.name == name
+        dims = 1
+        for d in shape.topology.split("x"):
+            dims *= int(d)
+        assert dims == shape.chips  # topology product == chip count
+
+
+def test_slice_shape_lookup():
+    s = slice_shape("v5e-32")
+    assert s.hosts == 8 and s.topology == "4x8"
+    with pytest.raises(ValueError, match="unknown slice shape"):
+        slice_shape("v9-1024")
+
+
+def test_node_pool_labels_match_tpujob_selectors():
+    # the labels the node pool advertises must be exactly what
+    # build_worker_pod node-selects on (operators/tpujob.py)
+    pool = node_pool_for("v5e-8", count=2)
+    labels = pool["config"]["labels"]
+    assert labels["cloud.google.com/gke-tpu-accelerator"] == (
+        "tpu-v5-lite-podslice")
+    assert labels["cloud.google.com/gke-tpu-topology"] == "2x4"
+    assert pool["initialNodeCount"] == 4  # 2 slices x 2 hosts
+    assert pool["placementPolicy"]["tpuTopology"] == "2x4"
+
+
+def test_node_pool_spot_and_reservation():
+    pool = node_pool_for("v5e-8", spot=True, reserved="my-res")
+    assert pool["config"]["spot"] is True
+    assert pool["config"]["reservationAffinity"]["values"] == ["my-res"]
+
+
+# -- gcp platform ----------------------------------------------------------
+
+def test_gcp_cluster_config_no_gpu_anywhere():
+    config = _gcp_config(slices=[{"shape": "v5p-32", "count": 1}])
+    c = cluster_config(config)
+    dumped = yaml.safe_dump(c)
+    assert "nvidia" not in dumped  # no GPU pools, no driver installer
+    assert c["workloadIdentityConfig"]["workloadPool"] == (
+        "my-proj.svc.id.goog")
+    tpu_pools = [p for p in c["nodePools"] if p["name"] != "cpu-pool"]
+    assert len(tpu_pools) == 1
+    assert tpu_pools[0]["initialNodeCount"] == 8  # v5p-32 = 8 hosts
+
+
+def test_gcp_generate_writes_configs(tmp_path):
+    config = _gcp_config()
+    paths = GcpTpuPlatform().generate(config, str(tmp_path))
+    names = {os.path.basename(p) for p in paths}
+    assert names == {"cluster.yaml", "iam_bindings.yaml", "plan.json"}
+    plan = json.load(open(os.path.join(tmp_path, "gcp_config", "plan.json")))
+    assert plan[0][:4] == ["gcloud", "container", "clusters", "create"]
+    assert any("--tpu-topology" in cmd for cmd in plan)
+    assert plan[-1][3] == "get-credentials"
+
+
+def test_gcp_apply_dry_run_returns_plan(tmp_path):
+    config = _gcp_config()
+    platform = GcpTpuPlatform()
+    platform.generate(config, str(tmp_path))
+    report = platform.apply(config, str(tmp_path), dry_run=True)
+    assert report["dry_run"] is True
+    assert any("clusters" in " ".join(cmd) for cmd in report["commands"])
+
+
+def test_gcp_iam_bindings():
+    binds = iam_bindings(_gcp_config())
+    assert {"member": "serviceAccount:demo-admin@my-proj.iam"
+                      ".gserviceaccount.com",
+            "role": "roles/container.admin"} in binds
+    assert iam_bindings(DeploymentConfig(
+        name="demo", platform="gcp-tpu")) == []
+
+
+def test_gcloud_plan_honors_spot():
+    config = _gcp_config(slices=[{"shape": "v5e-8", "count": 1,
+                                  "spot": True}])
+    plan = gcloud_plan(config)
+    pool_cmds = [c for c in plan if "node-pools" in c]
+    assert pool_cmds and "--spot" in pool_cmds[0]
+
+
+# -- local platform --------------------------------------------------------
+
+def test_fake_slice_nodes_shape():
+    nodes = fake_slice_nodes("v5e-8", count=2)
+    assert len(nodes) == 4  # 2 slices x 2 hosts
+    n = nodes[0]
+    assert n["status"]["capacity"]["google.com/tpu"] == 4
+    assert n["metadata"]["labels"][
+        "cloud.google.com/gke-tpu-topology"] == "2x4"
+
+
+def test_local_platform_seeds_and_removes_nodes(tmp_path):
+    config = DeploymentConfig(
+        name="demo", platform="local",
+        platform_params={"slices": [{"shape": "v5e-8", "count": 1}],
+                         "state_file": str(tmp_path / "state.json")})
+    platform = LocalPlatform()
+    platform.generate(config, str(tmp_path))
+    # dry-run must not mutate cluster state (the CLI's no---provision path)
+    report = platform.apply(config, str(tmp_path), dry_run=True)
+    assert report["dry_run"] is True
+    client = platform.kube_client(config, str(tmp_path))
+    assert client.list("v1", "Node") == []
+
+    report = platform.apply(config, str(tmp_path), dry_run=False)
+    assert report["nodes"] == 2
+    client = platform.kube_client(config, str(tmp_path))
+    assert len(client.list("v1", "Node")) == 2
+
+    report = platform.delete(config, str(tmp_path), dry_run=True)
+    assert report["dry_run"] is True
+    client = platform.kube_client(config, str(tmp_path))
+    assert len(client.list("v1", "Node")) == 2  # untouched
+
+    platform.delete(config, str(tmp_path), dry_run=False)
+    client = platform.kube_client(config, str(tmp_path))
+    assert client.list("v1", "Node") == []
+
+
+def test_cli_fake_state_shared_between_phases(tmp_path, capsys):
+    # fake TPU nodes and workload manifests must land in the SAME state file
+    from kubeflow_tpu.cli.main import main
+    from kubeflow_tpu.k8s.fakefile import FileBackedFakeClient
+
+    app = str(tmp_path / "app")
+    state = str(tmp_path / "shared.json")
+    main(["init", app, "--preset", "minimal", "--platform", "local"])
+    main(["generate", app])
+    assert main(["apply", app, "--fake-state", state, "--provision"]) == 0
+    client = FileBackedFakeClient(state)
+    nodes = client.list("v1", "Node")
+    assert nodes, "fake TPU nodes must be in the shared state file"
+    assert client.list("v1", "Namespace"), "manifests must be there too"
+
+
+def test_get_platform_registry():
+    assert get_platform("gcp-tpu").name == "gcp-tpu"
+    assert get_platform("local").name == "local"
+    assert get_platform("existing").name == "existing"
+    with pytest.raises(ValueError, match="unknown platform"):
+        get_platform("aws")
+
+
+# -- CLI phases ------------------------------------------------------------
+
+def test_cli_generate_platform_phase(tmp_path, capsys):
+    from kubeflow_tpu.cli.main import main
+
+    app = str(tmp_path / "app")
+    assert main(["init", app, "--preset", "minimal",
+                 "--platform", "gcp-tpu"]) == 0
+    # inject platform params
+    cfg = DeploymentConfig.load(os.path.join(app, "app.yaml"))
+    cfg.platform_params = {"project": "p", "zone": "z"}
+    cfg.save(os.path.join(app, "app.yaml"))
+    assert main(["generate", app, "platform"]) == 0
+    assert os.path.exists(os.path.join(app, "gcp_config", "cluster.yaml"))
+    assert not os.path.exists(os.path.join(app, "manifests"))
+    assert main(["generate", app, "k8s"]) == 0
+    assert os.path.exists(os.path.join(app, "manifests"))
+    out = capsys.readouterr().out
+    assert "generated platform config" in out
+
+
+def test_cli_apply_platform_dry_run(tmp_path, capsys):
+    from kubeflow_tpu.cli.main import main
+
+    app = str(tmp_path / "app")
+    main(["init", app, "--preset", "minimal", "--platform", "gcp-tpu"])
+    main(["generate", app])
+    assert main(["apply", app, "platform"]) == 0
+    out = capsys.readouterr().out
+    assert "platform apply plan" in out
+    assert "gcloud container clusters create" in out

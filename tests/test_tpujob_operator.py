@@ -85,7 +85,7 @@ def test_env_contract_injection(client, operator):
     assert pods[0]["spec"]["containers"][0]["resources"]["limits"][
         "google.com/tpu"] == 4
     assert pods[0]["spec"]["nodeSelector"][
-        "cloud.google.com/gke-tpu-accelerator"] == "v5e-8"
+        "cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
 
 
 def test_gang_podgroup_created(client, operator):
