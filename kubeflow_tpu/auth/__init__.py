@@ -1,0 +1,10 @@
+"""Auth: basic-auth gatekeeper + login flow.
+
+Reference: the gatekeeper auth server (``/root/reference/components/
+gatekeeper/auth/AuthServer.go:62-153`` — password + signed-cookie auth
+behind the ingress' external-auth hook) and the kflogin web UI
+(``components/kflogin``), deployed by ``kubeflow/common/basic-auth.
+libsonnet``.
+"""
+
+from kubeflow_tpu.auth.gatekeeper import AuthServer, hash_password  # noqa: F401

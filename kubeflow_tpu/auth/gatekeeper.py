@@ -1,0 +1,151 @@
+"""Basic-auth gatekeeper: login + external-auth verdicts for the ingress.
+
+The reference's flow (``AuthServer.go:62-153``): the ingress sends every
+request to the auth server first; a valid signed cookie (or basic-auth
+header) yields 200 and the request proceeds, otherwise 401 and the UI
+redirects to the login page. Passwords are stored as salted PBKDF2 hashes;
+cookies are HMAC-signed with an expiry.
+
+Routes:
+- ``POST /login``  {"username", "password"} → cookie on success
+- ``GET  /logout`` → expired cookie
+- ``GET  /verify`` → 200/401 external-auth verdict; the cookie arrives in
+  the ``Cookie`` header (``kftpu-auth=...``), the ``X-Auth-Cookie``
+  header, or a ``{"cookie": ...}`` body for in-process callers
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import os
+import time
+from typing import Any, Dict, Optional, Tuple
+
+COOKIE_NAME = "kftpu-auth"
+DEFAULT_TTL_S = 24 * 3600
+
+
+def hash_password(password: str, salt: Optional[bytes] = None) -> str:
+    """Salted PBKDF2; returns ``salt$hash`` hex."""
+    salt = salt if salt is not None else os.urandom(16)
+    digest = hashlib.pbkdf2_hmac("sha256", password.encode(), salt, 100_000)
+    return f"{salt.hex()}${digest.hex()}"
+
+
+def check_password(password: str, stored: str) -> bool:
+    try:
+        salt_hex, _, want = stored.partition("$")
+        got = hashlib.pbkdf2_hmac("sha256", password.encode(),
+                                  bytes.fromhex(salt_hex), 100_000)
+        return hmac.compare_digest(got.hex(), want)
+    except ValueError:
+        return False
+
+
+class AuthServer:
+    """users: {username: password_hash}; secret signs session cookies."""
+
+    def __init__(self, users: Dict[str, str], secret: bytes,
+                 ttl_s: float = DEFAULT_TTL_S) -> None:
+        self.users = dict(users)
+        self.secret = secret
+        self.ttl_s = ttl_s
+
+    # -- cookies -----------------------------------------------------------
+
+    def _sign(self, payload: bytes) -> str:
+        mac = hmac.new(self.secret, payload, hashlib.sha256).hexdigest()
+        return base64.urlsafe_b64encode(payload).decode() + "." + mac
+
+    def issue_cookie(self, username: str,
+                     now: Optional[float] = None) -> str:
+        payload = json.dumps({
+            "user": username,
+            "exp": (now if now is not None else time.time()) + self.ttl_s,
+        }).encode()
+        return self._sign(payload)
+
+    def verify_cookie(self, cookie: str,
+                      now: Optional[float] = None) -> Optional[str]:
+        """Returns the username, or None when invalid/expired."""
+        try:
+            b64, _, mac = cookie.rpartition(".")
+            payload = base64.urlsafe_b64decode(b64.encode())
+        except (ValueError, TypeError):
+            return None
+        want = hmac.new(self.secret, payload, hashlib.sha256).hexdigest()
+        if not hmac.compare_digest(want, mac):
+            return None
+        try:
+            data = json.loads(payload)
+        except json.JSONDecodeError:
+            return None
+        if (now if now is not None else time.time()) > float(
+                data.get("exp", 0)):
+            return None
+        return data.get("user")
+
+    # -- routes ------------------------------------------------------------
+
+    def handle(self, method: str, path: str, body: Optional[Dict[str, Any]],
+               user: str = "",
+               headers: Optional[Dict[str, str]] = None) -> Tuple[int, Any]:
+        body = body or {}
+        if method == "POST" and path == "/login":
+            username = body.get("username", "")
+            password = body.get("password", "")
+            stored = self.users.get(username)
+            if stored is None or not check_password(password, stored):
+                return 401, {"error": "invalid credentials"}
+            return 200, {"cookie": self.issue_cookie(username),
+                         "cookieName": COOKIE_NAME}
+        if method == "GET" and path == "/logout":
+            return 200, {"cookie": "", "cookieName": COOKIE_NAME}
+        if path == "/verify":
+            cookie = self._extract_cookie(body, headers)
+            username = self.verify_cookie(cookie) if cookie else None
+            if username is None:
+                return 401, {"authenticated": False}
+            return 200, {"authenticated": True, "user": username}
+        return 404, {"error": f"no route {method} {path}"}
+
+    @staticmethod
+    def _extract_cookie(body: Dict[str, Any],
+                        headers: Optional[Dict[str, str]]) -> str:
+        """The ingress external-auth hook sends a bodyless GET with the
+        session in the Cookie (or X-Auth-Cookie) header; in-process
+        callers pass {"cookie": ...}."""
+        if body.get("cookie"):
+            return str(body["cookie"])
+        headers = {k.lower(): v for k, v in (headers or {}).items()}
+        if headers.get("x-auth-cookie"):
+            return headers["x-auth-cookie"]
+        for part in headers.get("cookie", "").split(";"):
+            name, _, value = part.strip().partition("=")
+            if name == COOKIE_NAME:
+                return value
+        return ""
+
+
+def main() -> None:
+    import logging
+
+    from kubeflow_tpu.utils.jsonhttp import serve_json
+
+    users_json = os.environ.get("KFTPU_AUTH_USERS", "{}")
+    secret = os.environ.get("KFTPU_AUTH_SECRET", "").encode()
+    if not secret:
+        # no configured signing secret: generate an ephemeral one rather
+        # than crashlooping; sessions just reset when the pod restarts
+        logging.getLogger(__name__).warning(
+            "KFTPU_AUTH_SECRET unset; using an ephemeral signing secret")
+        secret = os.urandom(32)
+    server = AuthServer(json.loads(users_json), secret)
+    serve_json(server.handle, int(os.environ.get("KFTPU_AUTH_PORT", "8085")))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+"""Central dashboard backend.
+
+Reference: ``/root/reference/components/centraldashboard/`` — an Express
+(TS) server with REST routes (``app/api.ts:78-150``), a swappable metrics
+service (``app/metrics_service.ts`` + ``stackdriver_metrics_service.ts``
+behind ``metrics_service_factory.ts``), and workgroup flows through kfam
+(``app/api_workgroup.ts``).
+"""
+
+from kubeflow_tpu.dashboard.server import (  # noqa: F401
+    DashboardApi,
+    MetricsService,
+    RegistryMetricsService,
+)

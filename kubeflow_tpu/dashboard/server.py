@@ -1,0 +1,162 @@
+"""Dashboard REST API: env-info, namespaces, activities, metrics, workgroup.
+
+Route parity with the reference's Express server
+(``/root/reference/components/centraldashboard/app/api.ts:78-150``):
+
+- ``GET /api/env-info``            — platform + namespaces + user
+- ``GET /api/namespaces``          — namespace list
+- ``GET /api/activities/<ns>``     — k8s Events, newest first (api.ts:131-136)
+- ``GET /api/metrics/<type>``      — behind a swappable MetricsService
+  (``metrics_service_factory.ts``; Stackdriver impl swapped for one
+  reading the framework's own Prometheus registry)
+- ``GET /api/workgroup/exists``    — profile/workgroup flow via kfam
+  (``api_workgroup.ts``)
+- ``GET /api/dashboard-links``     — component cards for the UI shell
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, List, Optional, Tuple
+
+import kubeflow_tpu
+from kubeflow_tpu.k8s.client import ApiError, KubeClient
+from kubeflow_tpu.tenancy.kfam import AccessManagementApi
+from kubeflow_tpu.tenancy.profiles import PROFILE_API_VERSION, PROFILE_KIND
+from kubeflow_tpu.utils import DEFAULT_REGISTRY
+from kubeflow_tpu.utils.jsonhttp import serve_json
+
+
+class MetricsService(abc.ABC):
+    """Swappable metrics backend (reference MetricsService interface)."""
+
+    @abc.abstractmethod
+    def query(self, metric_type: str) -> List[Dict[str, Any]]: ...
+
+
+class RegistryMetricsService(MetricsService):
+    """Serves the framework's own registry instead of Stackdriver."""
+
+    PREFIXES = {
+        "podcpu": "kftpu_",          # closest equivalents by prefix
+        "podmem": "kftpu_",
+        "cluster": "kftpu_",
+    }
+
+    def __init__(self, registry=DEFAULT_REGISTRY) -> None:
+        self.registry = registry
+
+    def query(self, metric_type: str) -> List[Dict[str, Any]]:
+        prefix = self.PREFIXES.get(metric_type, metric_type)
+        out = []
+        for line in self.registry.expose().splitlines():
+            if line.startswith("#") or not line.strip():
+                continue
+            name, _, value = line.rpartition(" ")
+            if name.startswith(prefix):
+                out.append({"metric": name, "value": float(value)})
+        return out
+
+
+class DashboardApi:
+    """Pure handle() route table served via the shared JSON scaffold."""
+
+    def __init__(self, client: KubeClient, *,
+                 metrics: Optional[MetricsService] = None,
+                 kfam: Optional[AccessManagementApi] = None,
+                 platform: str = "gcp-tpu") -> None:
+        self.client = client
+        self.metrics = metrics or RegistryMetricsService()
+        self.kfam = kfam or AccessManagementApi(client)
+        self.platform = platform
+
+    def handle(self, method: str, path: str, body: Optional[Dict[str, Any]],
+               user: str = "") -> Tuple[int, Any]:
+        try:
+            if method != "GET":
+                return 405, {"error": "dashboard API is read-only"}
+            if path == "/api/env-info":
+                return 200, self.env_info(user)
+            if path == "/api/namespaces":
+                return 200, self.namespaces()
+            if path.startswith("/api/activities/"):
+                return 200, self.activities(path.rsplit("/", 1)[1])
+            if path.startswith("/api/metrics/"):
+                return 200, self.metrics.query(path.rsplit("/", 1)[1])
+            if path == "/api/workgroup/exists":
+                return 200, self.workgroup_exists(user)
+            if path == "/api/dashboard-links":
+                return 200, self.dashboard_links()
+            return 404, {"error": f"no route {path}"}
+        except ApiError as e:
+            return e.code, {"error": e.message}
+
+    # -- handlers ----------------------------------------------------------
+
+    def env_info(self, user: str) -> Dict[str, Any]:
+        return {
+            "user": user or "anonymous",
+            "platform": {"kind": self.platform,
+                         "version": kubeflow_tpu.__version__},
+            "namespaces": [n["name"] for n in self.namespaces()],
+            "isClusterAdmin": self.kfam.is_cluster_admin(user),
+        }
+
+    def namespaces(self) -> List[Dict[str, str]]:
+        out = []
+        for ns in self.client.list("v1", "Namespace"):
+            md = ns.get("metadata", {})
+            out.append({"name": md.get("name", ""),
+                        "owner": (md.get("annotations", {}) or {})
+                        .get("owner", "")})
+        return out
+
+    def activities(self, ns: str) -> List[Dict[str, Any]]:
+        events = self.client.list("v1", "Event", ns)
+        events.sort(key=lambda e: e.get("lastTimestamp", ""), reverse=True)
+        return [{
+            "time": e.get("lastTimestamp", ""),
+            "type": e.get("type", "Normal"),
+            "reason": e.get("reason", ""),
+            "message": e.get("message", ""),
+            "object": (e.get("involvedObject", {}) or {}).get("name", ""),
+        } for e in events]
+
+    def workgroup_exists(self, user: str) -> Dict[str, Any]:
+        profiles = self.client.list(PROFILE_API_VERSION, PROFILE_KIND)
+        owned = []
+        for p in profiles:
+            owner = p.get("spec", {}).get("owner", {})
+            name = owner.get("name") if isinstance(owner, dict) else owner
+            if name == user:
+                owned.append(p["metadata"]["name"])
+        return {"hasWorkgroup": bool(owned), "workgroups": owned}
+
+    def dashboard_links(self) -> List[Dict[str, str]]:
+        """The iframe cards the UI shell embeds (iframe-link.js parity)."""
+        return [
+            {"text": "Notebooks", "link": "/notebooks/", "icon": "book"},
+            {"text": "TPU Jobs", "link": "/tpujobs/", "icon": "donut-large"},
+            {"text": "Studies (HP tuning)", "link": "/tuning/",
+             "icon": "tune"},
+            {"text": "Workflows", "link": "/workflows/",
+             "icon": "device-hub"},
+            {"text": "Model Serving", "link": "/serving/",
+             "icon": "cloud-upload"},
+            {"text": "Manage Contributors", "link": "/workgroup/",
+             "icon": "people"},
+        ]
+
+
+def main() -> None:
+    import os
+
+    from kubeflow_tpu.k8s.client import HttpKubeClient
+
+    api = DashboardApi(HttpKubeClient())
+    serve_json(api.handle,
+               int(os.environ.get("KFTPU_DASHBOARD_PORT", "8082")))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""Auth component: gatekeeper + availability prober Deployments.
+
+Reference manifests: ``/root/reference/kubeflow/common/basic-auth.
+libsonnet`` (kflogin + gatekeeper deploy) and the metric-collector deploy
+(``kubeflow/gcp/metric-collector``-adjacent; prober source
+``metric-collector/service-readiness/metric_collect.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from kubeflow_tpu.config.deployment import DeploymentConfig
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.manifests.registry import register
+
+DEFAULTS: Dict[str, Any] = {
+    "image": "kubeflow-tpu/platform:v1alpha1",
+    "auth_port": 8085,
+    "secret_name": "kftpu-auth",
+    # {"admin": "<salt$hash>"} from kubeflow_tpu.auth.hash_password — never
+    # plaintext (reference stores the hash too: buildBasicAuthSecret
+    # gcp.go:1486)
+    "users": {},
+    "cookie_secret": "",  # empty → gatekeeper uses an ephemeral secret
+    "probe_url": "http://centraldashboard",
+    "probe_period_s": 30,
+    "monitoring_port": 8090,
+}
+
+
+@register("auth", DEFAULTS,
+          "Basic-auth gatekeeper + availability prober (basic-auth parity)")
+def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
+    ns = config.namespace
+    gk_pod = o.pod_spec([
+        o.container(
+            "gatekeeper", params["image"],
+            command=["python", "-m", "kubeflow_tpu.auth.gatekeeper"],
+            env={"KFTPU_AUTH_PORT": str(params["auth_port"])},
+            ports=[params["auth_port"]],
+        )
+    ])
+    # credentials come from a Secret, never inline env (reference:
+    # buildBasicAuthSecret gcp.go:1486); rendered below so the pod never
+    # crashloops on a missing ref
+    gk_pod["containers"][0]["envFrom"] = [
+        {"secretRef": {"name": params["secret_name"]}}]
+    import json as _json
+
+    auth_secret = o.secret(params["secret_name"], ns, {
+        "KFTPU_AUTH_USERS": _json.dumps(dict(params["users"])),
+        "KFTPU_AUTH_SECRET": params["cookie_secret"],
+    })
+    prober_pod = o.pod_spec([
+        o.container(
+            "availability-prober", params["image"],
+            command=["python", "-m", "kubeflow_tpu.utils.availability"],
+            env={
+                "KFTPU_PROBE_URL": params["probe_url"],
+                "KFTPU_PROBE_PERIOD_S": str(params["probe_period_s"]),
+                "KFTPU_MONITORING_PORT": str(params["monitoring_port"]),
+            },
+            ports=[params["monitoring_port"]],
+        )
+    ])
+    metrics_svc = o.service(
+        "availability-prober", ns, {"app": "availability-prober"},
+        [{"name": "metrics", "port": params["monitoring_port"],
+          "targetPort": params["monitoring_port"]}],
+        annotations={
+            "prometheus.io/scrape": "true",
+            "prometheus.io/path": "/metrics",
+            "prometheus.io/port": str(params["monitoring_port"]),
+        },
+    )
+    return [
+        auth_secret,
+        o.deployment("gatekeeper", ns, gk_pod),
+        o.service("gatekeeper", ns, {"app": "gatekeeper"},
+                  [{"name": "http", "port": params["auth_port"],
+                    "targetPort": params["auth_port"]}]),
+        o.deployment("availability-prober", ns, prober_pod),
+        metrics_svc,
+    ]

@@ -27,7 +27,68 @@ DEFAULTS: Dict[str, Any] = {
     "tpu_chips": 0,  # 0 = CPU serving; >0 requests google.com/tpu
     "batch_timeout_ms": 5,
     "max_batch_size": 8,
+    # version -> weight (e.g. {"v1": 90, "v2": 10}); empty = single version.
+    # Renders one Deployment per version + an Istio VirtualService carrying
+    # the weights (tf-serving-service-template.libsonnet trafficRule parity)
+    "traffic_split": {},
+    # request-logging http proxy sidecar service (k8s-model-server/http-proxy)
+    "proxy": False,
+    "proxy_port": 8008,
 }
+
+
+def istio_virtual_service(name: str, ns: str, ports: List[int],
+                          splits: Dict[str, int]) -> o.Obj:
+    """Weighted version routing (reference: Istio VS weighting in
+    ``tf-serving-service-template.libsonnet``; ``trafficRule`` "v1:100").
+
+    One match-per-port http route so REST and gRPC each keep their own
+    port while sharing the same version weights — a catch-all route would
+    rewrite gRPC traffic onto the REST port.
+    """
+    total = sum(splits.values())
+    if total != 100:
+        raise ValueError(f"traffic_split weights must sum to 100, got {total}")
+    for version, weight in splits.items():
+        if not 0 <= int(weight) <= 100:
+            raise ValueError(
+                f"traffic_split weight for {version!r} must be in [0,100], "
+                f"got {weight}")
+    return {
+        "apiVersion": "networking.istio.io/v1beta1",
+        "kind": "VirtualService",
+        "metadata": o.metadata(name, ns),
+        "spec": {
+            "hosts": [name],
+            "http": [
+                {
+                    "match": [{"port": port}],
+                    "route": [
+                        {"destination": {"host": name,
+                                         "subset": version,
+                                         "port": {"number": port}},
+                         "weight": weight}
+                        for version, weight in sorted(splits.items())
+                    ],
+                }
+                for port in ports
+            ],
+        },
+    }
+
+
+def istio_destination_rule(name: str, ns: str,
+                           versions: List[str]) -> o.Obj:
+    return {
+        "apiVersion": "networking.istio.io/v1beta1",
+        "kind": "DestinationRule",
+        "metadata": o.metadata(name, ns),
+        "spec": {
+            "host": name,
+            "subsets": [{"name": v, "labels": {"version": v}}
+                        for v in sorted(versions)],
+        },
+    }
 
 
 @register("serving", DEFAULTS,
@@ -35,9 +96,6 @@ DEFAULTS: Dict[str, Any] = {
 def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
     ns = config.namespace
     name = params["name"]
-    version = params["version"]
-    deploy_name = f"{name}-{version}"
-    labels = {"app": name, "version": version}
 
     resources: Dict[str, Any] = {}
     if params["tpu_chips"]:
@@ -50,23 +108,29 @@ def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
         "KFTPU_BATCH_TIMEOUT_MS": str(params["batch_timeout_ms"]),
         "KFTPU_MAX_BATCH_SIZE": str(params["max_batch_size"]),
     }
-    pod = o.pod_spec([
-        o.container(
-            "server",
-            params["image"],
-            command=["python", "-m", "kubeflow_tpu.serving.server"],
-            env=env,
-            ports=[params["rest_port"], params["grpc_port"]],
-            resources=resources,
-        )
-    ])
-    deploy = o.deployment(
-        deploy_name, ns, pod, replicas=params["replicas"], labels=labels,
-    )
+
+    def version_deploy(version: str) -> o.Obj:
+        labels = {"app": name, "version": version}
+        pod = o.pod_spec([
+            o.container(
+                "server",
+                params["image"],
+                command=["python", "-m", "kubeflow_tpu.serving.server"],
+                env=env,
+                ports=[params["rest_port"], params["grpc_port"]],
+                resources=resources,
+            )
+        ])
+        return o.deployment(f"{name}-{version}", ns, pod,
+                            replicas=params["replicas"], labels=labels)
+
+    splits: Dict[str, int] = dict(params["traffic_split"] or {})
+    versions = sorted(splits) if splits else [params["version"]]
+    out: List[o.Obj] = [version_deploy(v) for v in versions]
     svc = o.service(
         name,
         ns,
-        {"app": name},  # selects every version; weights via per-version replicas
+        {"app": name},  # selects every version; Istio VS carries the weights
         [
             {"name": "rest", "port": params["rest_port"],
              "targetPort": params["rest_port"]},
@@ -80,4 +144,26 @@ def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
             "prometheus.io/port": str(params["rest_port"]),
         },
     )
-    return [deploy, svc]
+    out.append(svc)
+    if splits:
+        out.append(istio_destination_rule(name, ns, versions))
+        out.append(istio_virtual_service(
+            name, ns, [params["rest_port"], params["grpc_port"]], splits))
+    if params["proxy"]:
+        proxy_pod = o.pod_spec([
+            o.container(
+                "http-proxy",
+                params["image"],
+                command=["python", "-m", "kubeflow_tpu.serving.proxy"],
+                env={"KFTPU_PROXY_PORT": str(params["proxy_port"]),
+                     "KFTPU_BACKEND_URL":
+                         f"http://{name}:{params['rest_port']}"},
+                ports=[params["proxy_port"]],
+            )
+        ])
+        out.append(o.deployment(f"{name}-proxy", ns, proxy_pod))
+        out.append(o.service(
+            f"{name}-proxy", ns, {"app": f"{name}-proxy"},
+            [{"name": "http", "port": params["proxy_port"],
+              "targetPort": params["proxy_port"]}]))
+    return out

@@ -1,0 +1,89 @@
+"""Request-logging HTTP proxy in front of the model server.
+
+Reference: the k8s-model-server http-proxy — a tornado bridge converting
+JSON requests into model-server calls, paired with a fluentd sidecar that
+tails request logs (``/root/reference/components/k8s-model-server/
+http-proxy/server.py``; request-logging docs in the same dir). This proxy
+forwards ``POST /model/<name>:predict`` to the backend's
+``/v1/models/<name>:predict`` and emits one structured JSONL log line per
+request (latency, status, model, batch size) — the stream a log shipper
+tails instead of a fluentd sidecar.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional, Tuple
+
+from kubeflow_tpu.utils import DEFAULT_REGISTRY
+from kubeflow_tpu.utils.jsonhttp import serve_json
+
+_proxied = DEFAULT_REGISTRY.counter(
+    "kftpu_proxy_requests_total", "proxied predict requests")
+
+
+class PredictProxy:
+    def __init__(self, backend_url: str, *, log_stream=None,
+                 timeout_s: float = 30.0) -> None:
+        self.backend_url = backend_url.rstrip("/")
+        self.log_stream = log_stream if log_stream is not None else sys.stdout
+        self.timeout_s = timeout_s
+
+    def handle(self, method: str, path: str, body: Optional[Dict[str, Any]],
+               user: str = "") -> Tuple[int, Any]:
+        if method == "GET" and path == "/healthz":
+            return 200, {"ok": True, "backend": self.backend_url}
+        if method != "POST" or not (path.startswith("/model/")
+                                    and path.endswith(":predict")):
+            return 404, {"error": "use POST /model/<name>:predict"}
+        model = path[len("/model/"):-len(":predict")]
+        t0 = time.perf_counter()
+        code, payload = self._forward(model, body or {})
+        latency_ms = (time.perf_counter() - t0) * 1000.0
+        _proxied.inc(model=model)
+        self._log({
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "model": model,
+            "status": code,
+            "latency_ms": round(latency_ms, 2),
+            "instances": len((body or {}).get("instances", []) or []),
+            "user": user or None,
+        })
+        return code, payload
+
+    def _forward(self, model: str,
+                 body: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+        url = f"{self.backend_url}/v1/models/{model}:predict"
+        req = urllib.request.Request(
+            url, data=json.dumps(body).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                return resp.status, json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read() or b"{}")
+            except json.JSONDecodeError:
+                return e.code, {"error": f"backend returned {e.code}"}
+        except (urllib.error.URLError, OSError) as e:
+            return 502, {"error": f"backend unreachable: {e}"}
+
+    def _log(self, record: Dict[str, Any]) -> None:
+        self.log_stream.write(json.dumps(record) + "\n")
+        self.log_stream.flush()
+
+
+def main() -> None:
+    import os
+
+    proxy = PredictProxy(
+        os.environ.get("KFTPU_BACKEND_URL", "http://localhost:8500"))
+    serve_json(proxy.handle, int(os.environ.get("KFTPU_PROXY_PORT", "8008")))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""Availability prober: the metric-collector equivalent.
+
+Reference: ``/root/reference/metric-collector/service-readiness/
+metric_collect.py:21-38`` — a loop probing the deployment's public
+endpoint and exporting a binary ``kubeflow_availability`` prometheus
+gauge. Same contract here, on the framework's own registry.
+"""
+
+from __future__ import annotations
+
+import threading
+import urllib.error
+import urllib.request
+from typing import Optional
+
+from kubeflow_tpu.utils import DEFAULT_REGISTRY
+
+_availability = DEFAULT_REGISTRY.gauge(
+    "kubeflow_availability", "1 when the probed endpoint answers 200")
+_probes = DEFAULT_REGISTRY.counter(
+    "kubeflow_availability_probes_total", "availability probes issued")
+
+
+def probe(url: str, timeout_s: float = 10.0) -> bool:
+    """One probe; records the gauge and returns reachability."""
+    _probes.inc(target=url)
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            up = 200 <= resp.status < 400
+    except (urllib.error.URLError, OSError, ValueError):
+        up = False
+    _availability.set(1.0 if up else 0.0, target=url)
+    return up
+
+
+class AvailabilityProber:
+    """Background loop probing on a period (the CronJob-ish collector)."""
+
+    def __init__(self, url: str, *, period_s: float = 30.0,
+                 timeout_s: float = 10.0) -> None:
+        self.url = url
+        self.period_s = period_s
+        self.timeout_s = timeout_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        def loop() -> None:
+            while not self._stop.wait(self.period_s):
+                probe(self.url, self.timeout_s)
+
+        probe(self.url, self.timeout_s)  # prime the gauge immediately
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def main() -> None:
+    import os
+
+    from kubeflow_tpu.utils import serve_metrics
+
+    url = os.environ.get("KFTPU_PROBE_URL", "http://centraldashboard")
+    period = float(os.environ.get("KFTPU_PROBE_PERIOD_S", "30"))
+    serve_metrics(int(os.environ.get("KFTPU_MONITORING_PORT", "8090")))
+    prober = AvailabilityProber(url, period_s=period)
+    prober.start()
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()

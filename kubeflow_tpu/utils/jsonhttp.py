@@ -7,6 +7,7 @@ Flask/go-kit/gRPC stacks for these; here they share one stdlib server).
 
 from __future__ import annotations
 
+import inspect
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -16,13 +17,24 @@ USER_HEADER = "X-Kubeflow-Userid"  # identity header the platform trusts
 
 MAX_BODY_BYTES = 4 << 20  # reject absurd request bodies before parsing
 
-# handle(method, path, body, user) -> (status_code, json_payload)
+# handle(method, path, body, user) -> (status_code, json_payload);
+# a handler declaring a 5th parameter also receives the request headers
+# (needed by e.g. the gatekeeper's cookie-based /verify)
 Handle = Callable[[str, str, Optional[Dict[str, Any]], str], Tuple[int, Any]]
+
+
+def _wants_headers(handle: Handle) -> bool:
+    try:
+        return len(inspect.signature(handle).parameters) >= 5
+    except (TypeError, ValueError):
+        return False
 
 
 def serve_json(handle: Handle, port: int, *,
                background: bool = False,
                host: str = "0.0.0.0") -> Optional[ThreadingHTTPServer]:
+    pass_headers = _wants_headers(handle)
+
     class Handler(BaseHTTPRequestHandler):
         def _dispatch(self, method: str) -> None:
             try:
@@ -38,7 +50,11 @@ def serve_json(handle: Handle, port: int, *,
                     body = {}
                 user = self.headers.get(USER_HEADER, "")
                 try:
-                    code, payload = handle(method, self.path, body, user)
+                    if pass_headers:
+                        code, payload = handle(method, self.path, body, user,
+                                               dict(self.headers))
+                    else:
+                        code, payload = handle(method, self.path, body, user)
                 except Exception as e:  # noqa: BLE001 — a server never dies
                     code, payload = 500, {"log": f"internal error: {e}"}
             data = json.dumps(payload).encode()

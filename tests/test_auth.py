@@ -1,0 +1,164 @@
+"""Gatekeeper auth + availability prober tests.
+
+Reference: AuthServer.go:62-153 (password + cookie auth),
+metric_collect.py:21-38 (availability gauge).
+"""
+
+import pytest
+
+from kubeflow_tpu.auth import AuthServer, hash_password
+from kubeflow_tpu.auth.gatekeeper import check_password
+from kubeflow_tpu.config.deployment import ComponentSpec, DeploymentConfig
+from kubeflow_tpu.manifests.registry import render_component
+from kubeflow_tpu.utils import DEFAULT_REGISTRY
+from kubeflow_tpu.utils.availability import AvailabilityProber, probe
+
+
+@pytest.fixture
+def server():
+    return AuthServer({"admin": hash_password("hunter2")}, secret=b"s3cret",
+                      ttl_s=3600)
+
+
+def test_password_hash_roundtrip():
+    stored = hash_password("pw")
+    assert check_password("pw", stored)
+    assert not check_password("wrong", stored)
+    assert not check_password("pw", "garbage")
+    # same password, different salt → different hash
+    assert hash_password("pw") != hash_password("pw")
+
+
+def test_login_issues_verifiable_cookie(server):
+    code, out = server.handle("POST", "/login",
+                              {"username": "admin", "password": "hunter2"})
+    assert code == 200
+    cookie = out["cookie"]
+    code, verdict = server.handle("GET", "/verify", {"cookie": cookie})
+    assert code == 200
+    assert verdict == {"authenticated": True, "user": "admin"}
+
+
+def test_login_rejects_bad_credentials(server):
+    assert server.handle("POST", "/login",
+                         {"username": "admin",
+                          "password": "wrong"})[0] == 401
+    assert server.handle("POST", "/login",
+                         {"username": "ghost",
+                          "password": "hunter2"})[0] == 401
+
+
+def test_verify_rejects_tampered_and_expired(server):
+    cookie = server.issue_cookie("admin", now=1000.0)
+    # valid at issue time
+    assert server.verify_cookie(cookie, now=1000.0) == "admin"
+    # expired
+    assert server.verify_cookie(cookie, now=1000.0 + 3601) is None
+    # tampered payload
+    b64, _, mac = cookie.rpartition(".")
+    assert server.verify_cookie("AAAA" + b64 + "." + mac) is None
+    # foreign secret
+    other = AuthServer({}, secret=b"other")
+    assert other.verify_cookie(cookie, now=1000.0) is None
+    code, verdict = server.handle("GET", "/verify", {"cookie": "junk"})
+    assert code == 401 and verdict["authenticated"] is False
+
+
+def test_logout_clears_cookie(server):
+    code, out = server.handle("GET", "/logout", None)
+    assert code == 200 and out["cookie"] == ""
+
+
+def test_verify_reads_cookie_from_headers(server):
+    # the ingress external-auth hook sends a bodyless GET with the session
+    # in the Cookie header (regression: body-only lookup locked everyone out)
+    cookie = server.issue_cookie("admin")
+    code, verdict = server.handle(
+        "GET", "/verify", None,
+        headers={"Cookie": f"other=1; kftpu-auth={cookie}"})
+    assert code == 200 and verdict["user"] == "admin"
+    code, verdict = server.handle(
+        "GET", "/verify", None, headers={"X-Auth-Cookie": cookie})
+    assert code == 200 and verdict["user"] == "admin"
+    assert server.handle("GET", "/verify", None, headers={})[0] == 401
+
+
+def test_verify_over_http_with_cookie_header(server):
+    import json as _json
+    import urllib.request
+
+    from kubeflow_tpu.utils.jsonhttp import serve_json
+
+    srv = serve_json(server.handle, 0, background=True)
+    port = srv.server_address[1]
+    cookie = server.issue_cookie("admin")
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/verify",
+        headers={"Cookie": f"kftpu-auth={cookie}"})
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        out = _json.loads(resp.read())
+    assert out == {"authenticated": True, "user": "admin"}
+    srv.shutdown()
+
+
+# -- availability prober ---------------------------------------------------
+
+def test_probe_up_and_down():
+    import http.server
+    import threading
+
+    class Ok(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"ok")
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), Ok)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}/"
+    assert probe(url) is True
+    assert DEFAULT_REGISTRY.gauge("kubeflow_availability").get(
+        target=url) == 1.0
+    httpd.shutdown()
+    down = "http://127.0.0.1:1/"
+    assert probe(down, timeout_s=0.5) is False
+    assert DEFAULT_REGISTRY.gauge("kubeflow_availability").get(
+        target=down) == 0.0
+
+
+def test_prober_primes_gauge_immediately():
+    prober = AvailabilityProber("http://127.0.0.1:1/", period_s=3600,
+                                timeout_s=0.2)
+    prober.start()
+    assert DEFAULT_REGISTRY.gauge("kubeflow_availability").get(
+        target="http://127.0.0.1:1/") == 0.0
+    prober.stop()
+
+
+def test_auth_component_manifests():
+    import json as _json
+
+    config = DeploymentConfig(name="demo")
+    stored = hash_password("pw")
+    objs = render_component(config, ComponentSpec(
+        "auth", params={"users": {"admin": stored},
+                        "cookie_secret": "sign-me"}))
+    kinds = [(x["kind"], x["metadata"]["name"]) for x in objs]
+    assert ("Secret", "kftpu-auth") in kinds  # rendered, not assumed
+    assert ("Deployment", "gatekeeper") in kinds
+    assert ("Deployment", "availability-prober") in kinds
+    gk = [x for x in objs if x["metadata"]["name"] == "gatekeeper"
+          and x["kind"] == "Deployment"][0]
+    ctr = gk["spec"]["template"]["spec"]["containers"][0]
+    # credentials via Secret ref, never inline env
+    assert ctr["envFrom"] == [{"secretRef": {"name": "kftpu-auth"}}]
+    secret = [x for x in objs if x["kind"] == "Secret"][0]
+    assert _json.loads(
+        secret["stringData"]["KFTPU_AUTH_USERS"])["admin"] == stored
+    # the hash, never the plaintext password
+    assert "pw" not in secret["stringData"]["KFTPU_AUTH_USERS"].replace(
+        stored, "")

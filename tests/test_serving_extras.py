@@ -1,0 +1,209 @@
+"""Serving extras + dashboard backend tests.
+
+Reference surfaces: traffic-split Istio weighting
+(``tf-serving-service-template.libsonnet``), http-proxy request bridge
+(``components/k8s-model-server/http-proxy/server.py``), batch predict
+(``kubeflow/tf-batch-predict``), dashboard REST (``app/api.ts:78-150``).
+"""
+
+import io
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.config.deployment import ComponentSpec, DeploymentConfig
+from kubeflow_tpu.dashboard import DashboardApi
+from kubeflow_tpu.k8s import FakeKubeClient
+from kubeflow_tpu.manifests.registry import render_component
+from kubeflow_tpu.serving import (
+    ModelServer,
+    PredictProxy,
+    batch_predict_job,
+    export_model,
+    run_batch_predict,
+)
+from kubeflow_tpu.tenancy import profile
+
+
+@pytest.fixture(scope="module")
+def mnist_repo(tmp_path_factory):
+    from kubeflow_tpu.models import MnistCnn
+
+    repo = tmp_path_factory.mktemp("models")
+    model = MnistCnn()
+    x = np.zeros((1, 28, 28, 1), np.float32)
+    params = jax.jit(model.init)(jax.random.key(0), x)["params"]
+    export_model(str(repo / "mnist"), "mnist", params, version=1)
+    return repo
+
+
+# -- traffic split ---------------------------------------------------------
+
+def test_serving_traffic_split_manifests():
+    config = DeploymentConfig(name="demo")
+    objs = render_component(config, ComponentSpec(
+        "serving", params={"traffic_split": {"v1": 90, "v2": 10}}))
+    kinds = [(x["kind"], x["metadata"]["name"]) for x in objs]
+    assert ("Deployment", "model-server-v1") in kinds
+    assert ("Deployment", "model-server-v2") in kinds
+    vs = [x for x in objs if x["kind"] == "VirtualService"][0]
+    # one weighted route per port: REST and gRPC keep their own ports
+    assert [r["match"][0]["port"] for r in vs["spec"]["http"]] == [8500, 9000]
+    for http_route in vs["spec"]["http"]:
+        port = http_route["match"][0]["port"]
+        routes = http_route["route"]
+        assert [(r["destination"]["subset"], r["weight"])
+                for r in routes] == [("v1", 90), ("v2", 10)]
+        assert all(r["destination"]["port"]["number"] == port
+                   for r in routes)
+    dr = [x for x in objs if x["kind"] == "DestinationRule"][0]
+    assert [s["name"] for s in dr["spec"]["subsets"]] == ["v1", "v2"]
+
+
+def test_serving_traffic_split_must_sum_100():
+    config = DeploymentConfig(name="demo")
+    with pytest.raises(ValueError, match="sum to 100"):
+        render_component(config, ComponentSpec(
+            "serving", params={"traffic_split": {"v1": 50, "v2": 20}}))
+    with pytest.raises(ValueError, match=r"in \[0,100\]"):
+        render_component(config, ComponentSpec(
+            "serving", params={"traffic_split": {"v1": 150, "v2": -50}}))
+
+
+def test_serving_proxy_manifests():
+    config = DeploymentConfig(name="demo")
+    objs = render_component(config, ComponentSpec("serving",
+                                                  params={"proxy": True}))
+    kinds = [(x["kind"], x["metadata"]["name"]) for x in objs]
+    assert ("Deployment", "model-server-proxy") in kinds
+    assert ("Service", "model-server-proxy") in kinds
+
+
+# -- http proxy ------------------------------------------------------------
+
+def test_proxy_forwards_and_logs(mnist_repo):
+    server = ModelServer(str(mnist_repo), port=0)
+    port = server.start()
+    logbuf = io.StringIO()
+    proxy = PredictProxy(f"http://127.0.0.1:{port}", log_stream=logbuf)
+    body = {"instances": np.zeros((2, 28, 28, 1)).tolist()}
+    code, payload = proxy.handle("POST", "/model/mnist:predict", body,
+                                 user="alice")
+    assert code == 200, payload
+    assert len(payload["predictions"]) == 2
+    record = json.loads(logbuf.getvalue().splitlines()[0])
+    assert record["model"] == "mnist"
+    assert record["status"] == 200
+    assert record["instances"] == 2
+    assert record["user"] == "alice"
+    assert record["latency_ms"] > 0
+    server.stop()
+
+
+def test_proxy_backend_down_is_502():
+    proxy = PredictProxy("http://127.0.0.1:1", log_stream=io.StringIO())
+    code, payload = proxy.handle("POST", "/model/m:predict",
+                                 {"instances": [[1]]})
+    assert code == 502
+    assert "unreachable" in payload["error"]
+
+
+def test_proxy_health_and_404():
+    proxy = PredictProxy("http://b", log_stream=io.StringIO())
+    assert proxy.handle("GET", "/healthz", None)[0] == 200
+    assert proxy.handle("GET", "/model/m:predict", None)[0] == 404
+
+
+# -- batch predict ---------------------------------------------------------
+
+def test_batch_predict_end_to_end(mnist_repo, tmp_path):
+    inp = tmp_path / "in.jsonl"
+    with open(inp, "w") as f:
+        for _ in range(7):  # deliberately not a multiple of batch size
+            f.write(json.dumps(np.zeros((28, 28, 1)).tolist()) + "\n")
+    out = tmp_path / "out.jsonl"
+    summary = run_batch_predict(str(mnist_repo / "mnist"), str(inp),
+                                str(out), batch_size=4)
+    assert summary["instances"] == 7
+    assert summary["model_version"] == 1
+    preds = [json.loads(line) for line in open(out)]
+    assert len(preds) == 7
+    assert len(preds[0]["prediction"]) == 10  # mnist logits
+
+
+def test_batch_predict_missing_model(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        run_batch_predict(str(tmp_path / "nope"), "in", "out")
+
+
+def test_batch_predict_job_manifest():
+    job = batch_predict_job(
+        "bp", "kubeflow", model_base_path="/models/m",
+        input_path="/data/in.jsonl", output_path="/data/out.jsonl",
+        tpu_chips=4)
+    assert job["kind"] == "Job"
+    ctr = job["spec"]["template"]["spec"]["containers"][0]
+    assert "--model-base-path" in ctr["args"]
+    assert ctr["resources"]["limits"]["google.com/tpu"] == 4
+    assert job["spec"]["template"]["spec"]["restartPolicy"] == "OnFailure"
+
+
+# -- dashboard -------------------------------------------------------------
+
+@pytest.fixture
+def dash_client():
+    client = FakeKubeClient()
+    from kubeflow_tpu.k8s import objects as o
+
+    client.create(o.namespace("alice"))
+    ns = client.get("v1", "Namespace", "", "alice")
+    ns["metadata"]["annotations"] = {"owner": "alice@x.com"}
+    client.update(ns)
+    client.create(profile("alice", "alice@x.com"))
+    client.create({
+        "apiVersion": "v1", "kind": "Event",
+        "metadata": {"name": "e1", "namespace": "alice"},
+        "lastTimestamp": "2026-07-29T10:00:00Z", "type": "Normal",
+        "reason": "Created", "message": "job created",
+        "involvedObject": {"name": "train"},
+    })
+    return client
+
+
+def test_dashboard_env_info_and_namespaces(dash_client):
+    api = DashboardApi(dash_client, platform="gcp-tpu")
+    code, info = api.handle("GET", "/api/env-info", None, user="alice@x.com")
+    assert code == 200
+    assert info["user"] == "alice@x.com"
+    assert "alice" in info["namespaces"]
+    assert info["platform"]["kind"] == "gcp-tpu"
+    code, nss = api.handle("GET", "/api/namespaces", None)
+    assert {"name": "alice", "owner": "alice@x.com"} in nss
+
+
+def test_dashboard_activities(dash_client):
+    api = DashboardApi(dash_client)
+    code, acts = api.handle("GET", "/api/activities/alice", None)
+    assert code == 200
+    assert acts[0]["reason"] == "Created"
+    assert acts[0]["object"] == "train"
+
+
+def test_dashboard_workgroup(dash_client):
+    api = DashboardApi(dash_client)
+    _, wg = api.handle("GET", "/api/workgroup/exists", None,
+                       user="alice@x.com")
+    assert wg == {"hasWorkgroup": True, "workgroups": ["alice"]}
+    _, wg = api.handle("GET", "/api/workgroup/exists", None, user="bob@x.com")
+    assert wg["hasWorkgroup"] is False
+
+
+def test_dashboard_metrics_and_links(dash_client):
+    api = DashboardApi(dash_client)
+    code, metrics = api.handle("GET", "/api/metrics/kftpu_", None)
+    assert code == 200 and isinstance(metrics, list)
+    _, links = api.handle("GET", "/api/dashboard-links", None)
+    assert any(card["text"] == "TPU Jobs" for card in links)
+    assert api.handle("POST", "/api/env-info", {})[0] == 405
