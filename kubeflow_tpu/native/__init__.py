@@ -1,0 +1,10 @@
+"""Native (C++) runtime cores, loaded via ctypes.
+
+The compute path is JAX/XLA; these are the *runtime* pieces around it
+(scheduler placement today; candidates tomorrow: IO, batching). Each core
+has a pure-Python twin with identical semantics — the native library is a
+drop-in accelerator, never a behavioral fork — and builds on demand with
+g++ (no pybind11 dependency; plain C ABI + ctypes).
+"""
+
+from kubeflow_tpu.native.build import load_library, native_available  # noqa: F401

@@ -1,0 +1,86 @@
+"""On-demand build + ctypes loading for the native cores.
+
+Builds ``placement.cc`` into ``_kftpu_native.so`` next to the sources the
+first time it's needed (g++ -O2 -shared -fPIC; ~100ms), then caches by
+source mtime. Every consumer must tolerate ``load_library() is None`` and
+fall back to its Python twin — a missing compiler can never break the
+framework.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "placement.cc")
+_LIB = os.path.join(_DIR, "_kftpu_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB):
+        return True
+    return os.path.getmtime(_SRC) > os.path.getmtime(_LIB)
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+           "-o", _LIB, _SRC]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        log.warning("native build unavailable (%s); using Python fallback", e)
+        return False
+    if proc.returncode != 0:
+        log.warning("native build failed; using Python fallback:\n%s",
+                    proc.stderr[-800:])
+        return False
+    return True
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building if required; None on failure."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _load_failed:
+            return None
+        if _needs_build() and not _build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError as e:
+            log.warning("could not load %s (%s); using Python fallback",
+                        _LIB, e)
+            _load_failed = True
+            return None
+        lib.kftpu_place_slices.restype = ctypes.c_int32
+        lib.kftpu_place_slices.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.kftpu_ring_order.restype = ctypes.c_int32
+        lib.kftpu_ring_order.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return load_library() is not None

@@ -1,0 +1,98 @@
+// Native gang-placement core.
+//
+// The hot path of the slice-aware scheduler (SURVEY.md §7 hard part (a)):
+// given a cluster inventory of TPU slices (free-host counts per slice,
+// grouped into ICI "pods" by adjacency) and a request for S slices x H
+// hosts, pick concrete slices atomically so that
+//   1) only fully-free matching slices are used (a slice is indivisible),
+//   2) multi-slice jobs land on adjacency-close slices (DCN hops scale
+//      with id distance in the inventory ordering),
+//   3) fragmentation is minimized (best-fit: prefer exact-capacity
+//      slices over larger ones).
+// Plus the boustrophedon host ring used for ICI-neighbor ordering.
+//
+// The reference has no native scheduling (optional kube-batch podgroups
+// only); this core exists because placement over thousands-of-slice
+// inventories sits on the operator's reconcile path.
+//
+// Exposed as a C ABI for ctypes; Python fallback implements the same
+// algorithm (kubeflow_tpu/scheduler/native.py) and tests assert equality.
+
+#include <algorithm>
+#include <cstdint>
+#include <vector>
+
+extern "C" {
+
+// Choose `want` slices from an inventory of `n` slices.
+//   slice_hosts[i]  — host count of slice i's shape
+//   free_hosts[i]   — currently free hosts in slice i
+//   need_hosts      — hosts required per chosen slice (H)
+//   out[want]       — chosen slice indices (inventory order)
+// Returns 0 on success, -1 if infeasible.
+//
+// Algorithm: among feasible slices (fully free AND shape-host count ==
+// need_hosts preferred; larger fully-free slices allowed as fallback),
+// choose a contiguous-in-id window of `want` feasible slices minimizing
+// (a) total wasted hosts, then (b) window span (adjacency proxy).
+int32_t kftpu_place_slices(const int32_t* slice_hosts,
+                           const int32_t* free_hosts,
+                           int32_t n,
+                           int32_t want,
+                           int32_t need_hosts,
+                           int32_t* out) {
+  if (want <= 0 || n <= 0 || want > n) return -1;
+  // feasible = fully free and big enough
+  std::vector<int32_t> feas;
+  feas.reserve(n);
+  for (int32_t i = 0; i < n; ++i) {
+    if (free_hosts[i] == slice_hosts[i] && slice_hosts[i] >= need_hosts) {
+      feas.push_back(i);
+    }
+  }
+  if ((int32_t)feas.size() < want) return -1;
+
+  // slide a window of `want` feasible slices; score = (waste, span)
+  int64_t best_waste = INT64_MAX;
+  int64_t best_span = INT64_MAX;
+  int32_t best_start = -1;
+  for (int32_t s = 0; s + want <= (int32_t)feas.size(); ++s) {
+    int64_t waste = 0;
+    for (int32_t k = 0; k < want; ++k) {
+      waste += slice_hosts[feas[s + k]] - need_hosts;
+    }
+    int64_t span = feas[s + want - 1] - feas[s];
+    if (waste < best_waste ||
+        (waste == best_waste && span < best_span)) {
+      best_waste = waste;
+      best_span = span;
+      best_start = s;
+    }
+  }
+  if (best_start < 0) return -1;
+  for (int32_t k = 0; k < want; ++k) out[k] = feas[best_start + k];
+  return 0;
+}
+
+// Boustrophedon (snake) host ring over a rows x cols host grid.
+// out[n_hosts] receives the visitation order; identity when the grid
+// doesn't tile. Mirrors scheduler.placement.ring_order.
+int32_t kftpu_ring_order(int32_t n_hosts, int32_t rows, int32_t cols,
+                         int32_t* out) {
+  if (n_hosts <= 0) return -1;
+  if (rows <= 0 || cols <= 0 || rows * cols != n_hosts || n_hosts <= 2) {
+    for (int32_t i = 0; i < n_hosts; ++i) out[i] = i;
+    return 0;
+  }
+  int32_t idx = 0;
+  for (int32_t r = 0; r < rows; ++r) {
+    if (r % 2 == 0) {
+      for (int32_t c = 0; c < cols; ++c) out[idx++] = r * cols + c;
+    } else {
+      for (int32_t c = cols - 1; c >= 0; --c) out[idx++] = r * cols + c;
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
@@ -36,6 +37,11 @@ from kubeflow_tpu.manifests.components.tpujob_operator import (
 )
 from kubeflow_tpu.operators.controller import Controller
 from kubeflow_tpu.parallel import distributed as dist
+from kubeflow_tpu.scheduler.inventory import (
+    ASSIGNED_SLICE_LABEL,
+    SLICE_INDEX_LABEL,
+    GangScheduler,
+)
 from kubeflow_tpu.scheduler.placement import SlicePlacement, place_gang
 from kubeflow_tpu.utils import DEFAULT_REGISTRY
 
@@ -162,7 +168,8 @@ def build_podgroup(job: o.Obj) -> o.Obj:
     return o.set_owner(pg, job)
 
 
-def build_worker_pod(job: o.Obj, index: int, placement: SlicePlacement) -> o.Obj:
+def build_worker_pod(job: o.Obj, index: int, placement: SlicePlacement,
+                     concrete_slice: Optional[str] = None) -> o.Obj:
     name = job["metadata"]["name"]
     ns = job["metadata"]["namespace"]
     spec = TpuJobSpec.from_dict(job["spec"])
@@ -207,12 +214,16 @@ def build_worker_pod(job: o.Obj, index: int, placement: SlicePlacement) -> o.Obj
     )
     pspec["hostname"] = worker_name(name, index)
     pspec["subdomain"] = name
-    pod = o.pod(
-        worker_name(name, index), ns, pspec,
-        labels={JOB_LABEL: name,
-                SLICE_LABEL: str(placement.slice_index),
-                HOST_LABEL: str(placement.host)},
-    )
+    labels = {JOB_LABEL: name,
+              SLICE_LABEL: str(placement.slice_index),
+              HOST_LABEL: str(placement.host)}
+    if concrete_slice:
+        # the gang scheduler chose an exact cluster slice: pin to it and
+        # record the claim so inventory accounting sees this host as busy
+        labels[ASSIGNED_SLICE_LABEL] = concrete_slice
+        pspec["nodeSelector"][SLICE_INDEX_LABEL] = (
+            concrete_slice.rsplit("_", 1)[1])
+    pod = o.pod(worker_name(name, index), ns, pspec, labels=labels)
     return o.set_owner(pod, job)
 
 
@@ -238,6 +249,11 @@ class TpuJobOperator:
         self.client = client
         self.namespace = namespace
         self.gang_scheduling = gang_scheduling
+        # placement is read-inventory-then-create: without serialization,
+        # two workers reconciling DIFFERENT jobs concurrently both see the
+        # same slice free and double-book it (kube-scheduler likewise runs
+        # one scheduling cycle at a time)
+        self._placement_lock = threading.Lock()
 
     # -- reconcile ---------------------------------------------------------
 
@@ -270,7 +286,15 @@ class TpuJobOperator:
             return 1.0
 
         if not pods:
-            self._create_gang(job, spec)
+            if not self._create_gang(job, spec):
+                # concrete inventory exists but no free slice window: hold
+                # the whole gang (never partial pods) and retry
+                self._set_status(
+                    job, PHASE_PENDING,
+                    conditions=[_condition("Unschedulable", "NoFreeSlices",
+                                           f"need {spec.slices} free "
+                                           f"{spec.accelerator} slice(s)")])
+                return 15.0
             self._set_status(job, PHASE_PENDING, restarts=self._restarts(job),
                              conditions=[_condition("Created", "GangCreated")])
             return 1.0
@@ -287,7 +311,12 @@ class TpuJobOperator:
         if len(pods) < spec.num_workers:
             # a worker went missing (eviction, manual delete): the SPMD mesh
             # cannot proceed without it — recreate absent members in place
-            self._create_gang(job, spec)
+            if not self._create_gang(job, spec):
+                self._set_status(
+                    job, PHASE_PENDING,
+                    conditions=[_condition("Unschedulable", "NoFreeSlices",
+                                           "cannot re-place lost worker")])
+                return 15.0
             return 2.0
         if counts["Succeeded"] == spec.num_workers:
             self._set_status(job, PHASE_SUCCEEDED,
@@ -311,7 +340,14 @@ class TpuJobOperator:
     def _restarts(self, job: o.Obj) -> int:
         return int(job.get("status", {}).get("restarts", 0))
 
-    def _create_gang(self, job: o.Obj, spec: TpuJobSpec) -> None:
+    def _create_gang(self, job: o.Obj, spec: TpuJobSpec) -> bool:
+        """Create the whole gang atomically. Returns False (creating
+        nothing) when a concrete slice inventory exists but has no
+        feasible free window — partial gangs would deadlock the mesh."""
+        with self._placement_lock:
+            return self._create_gang_locked(job, spec)
+
+    def _create_gang_locked(self, job: o.Obj, spec: TpuJobSpec) -> bool:
         name = job["metadata"]["name"]
         ns = job["metadata"]["namespace"]
         placements = place_gang(
@@ -319,13 +355,52 @@ class TpuJobOperator:
             hosts_per_slice=spec.hosts_per_slice,
             accelerator=spec.accelerator,
         )
+        concrete: Optional[List[str]] = None
+        scheduler = GangScheduler(self.client)
+        inv = scheduler.inventory(spec.accelerator)
+        if inv:
+            # adopt slices already claimed by this job's surviving pods so
+            # recreate-absent-members keeps siblings on their slice; a
+            # logical slice whose pods ALL died is fully free again and
+            # assignable fresh
+            claimed = self._existing_assignment(ns, name)
+            missing = [k for k in range(spec.slices) if k not in claimed]
+            if missing:
+                fresh = scheduler.assign(
+                    spec.accelerator, len(missing), spec.hosts_per_slice,
+                    inventory=inv)
+                if fresh is None:
+                    return False
+                claimed.update(zip(missing, fresh))
+            concrete = [claimed[k] for k in range(spec.slices)]
         self._create_if_absent(build_service(job))
         if spec.gang_scheduling and self.gang_scheduling:
             self._create_if_absent(build_podgroup(job))
         for i in range(spec.num_workers):
-            self._create_if_absent(build_worker_pod(job, i, placements[i]))
-        log.info("created gang for %s/%s: %d workers over %d slice(s)",
-                 ns, name, spec.num_workers, spec.slices)
+            chosen = (concrete[placements[i].slice_index]
+                      if concrete else None)
+            self._create_if_absent(build_worker_pod(job, i, placements[i],
+                                                    concrete_slice=chosen))
+        log.info("created gang for %s/%s: %d workers over %d slice(s)%s",
+                 ns, name, spec.num_workers, spec.slices,
+                 f" on {concrete}" if concrete else "")
+        return True
+
+    def _existing_assignment(self, ns: str, name: str) -> Dict[int, str]:
+        """logical slice ordinal -> concrete slice id already claimed by
+        this job's live pods (empty when nothing is claimed)."""
+        by_ordinal: Dict[int, str] = {}
+        for pod in self.client.list("v1", "Pod", ns,
+                                    label_selector={JOB_LABEL: name}):
+            labels = pod.get("metadata", {}).get("labels", {}) or {}
+            assigned = labels.get(ASSIGNED_SLICE_LABEL)
+            # only live pods hold a claim — the same filter inventory's
+            # busy accounting uses, or an adopted slice could simultaneously
+            # be handed out as free
+            phase = pod.get("status", {}).get("phase", "Pending")
+            if assigned and phase in ("Pending", "Running"):
+                by_ordinal[int(labels.get(SLICE_LABEL, "0"))] = assigned
+        return by_ordinal
 
     def _delete_pods(self, ns: str, pods: List[o.Obj]) -> None:
         for pod in pods:
@@ -376,9 +451,19 @@ class TpuJobOperator:
             status["startTime"] = _condition("", "")["lastTransitionTime"]
         if completion and "completionTime" not in status:
             status["completionTime"] = _condition("", "")["lastTransitionTime"]
+        appended = False
         if conditions:
-            status.setdefault("conditions", []).extend(conditions)
-        if changed or conditions or workers is not None:
+            existing = status.setdefault("conditions", [])
+            for cond in conditions:
+                last = existing[-1] if existing else {}
+                # dedup repeats (e.g. the 15s Unschedulable hold) or the
+                # conditions list grows without bound while a job waits
+                if (last.get("type") == cond["type"]
+                        and last.get("reason") == cond["reason"]):
+                    continue
+                existing.append(cond)
+                appended = True
+        if changed or appended or workers is not None:
             job = dict(job)
             job["status"] = status
             try:

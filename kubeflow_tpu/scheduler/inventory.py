@@ -1,0 +1,137 @@
+"""Cluster slice inventory + concrete gang assignment.
+
+Extends the logical placement of :mod:`kubeflow_tpu.scheduler.placement`
+(worker index → slice ordinal) with *cluster* awareness: which concrete
+slices exist (node labels ``kubeflow-tpu.org/slice-shape`` /
+``slice-index`` written by the platform layer), which are fully free
+(occupied = any running worker pod pinned to that slice), and which to
+hand a new gang. Selection is best-fit + adjacency-window — implemented
+twice with identical semantics: the native C++ core
+(``kubeflow_tpu/native/placement.cc``) and the Python twin below; tests
+assert they agree.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+from kubeflow_tpu.k8s.client import KubeClient
+from kubeflow_tpu.native import load_library
+
+SHAPE_LABEL = "kubeflow-tpu.org/slice-shape"
+SLICE_INDEX_LABEL = "kubeflow-tpu.org/slice-index"
+ASSIGNED_SLICE_LABEL = "kubeflow-tpu.org/assigned-slice"
+
+
+@dataclass(frozen=True)
+class SliceInfo:
+    """One concrete slice in the cluster."""
+
+    slice_id: str          # "<shape>_<index>" (label-safe), stable id
+    shape: str             # e.g. v5e-8
+    hosts: int             # host count of the shape
+    free_hosts: int        # hosts with no assigned worker pod
+
+
+def choose_slices_py(slice_hosts: Sequence[int], free_hosts: Sequence[int],
+                     want: int, need_hosts: int) -> Optional[List[int]]:
+    """Python twin of ``kftpu_place_slices`` (same scoring, same result)."""
+    n = len(slice_hosts)
+    if want <= 0 or n <= 0 or want > n:
+        return None
+    feas = [i for i in range(n)
+            if free_hosts[i] == slice_hosts[i]
+            and slice_hosts[i] >= need_hosts]
+    if len(feas) < want:
+        return None
+    best = None  # (waste, span, start)
+    for s in range(len(feas) - want + 1):
+        window = feas[s:s + want]
+        waste = sum(slice_hosts[i] - need_hosts for i in window)
+        span = window[-1] - window[0]
+        if best is None or (waste, span) < best[:2]:
+            best = (waste, span, s)
+    s = best[2]
+    return feas[s:s + want]
+
+
+def choose_slices(slice_hosts: Sequence[int], free_hosts: Sequence[int],
+                  want: int, need_hosts: int) -> Optional[List[int]]:
+    """Native core when available, Python twin otherwise."""
+    lib = load_library()
+    if lib is None:
+        return choose_slices_py(slice_hosts, free_hosts, want, need_hosts)
+    n = len(slice_hosts)
+    arr = ctypes.c_int32 * n
+    out = (ctypes.c_int32 * max(want, 1))()
+    rc = lib.kftpu_place_slices(
+        arr(*slice_hosts), arr(*free_hosts), n, want, need_hosts, out)
+    if rc != 0:
+        return None
+    return [out[i] for i in range(want)]
+
+
+class GangScheduler:
+    """Assigns whole gangs onto concrete free slices.
+
+    The reference's analogue is optional kube-batch podgroups with no
+    topology model (``tf-job-operator.libsonnet:107-109``); here the
+    whole-slice constraint and adjacency preference are first-class.
+    """
+
+    def __init__(self, client: KubeClient) -> None:
+        self.client = client
+
+    def inventory(self, shape: str) -> List[SliceInfo]:
+        """Concrete slices of ``shape``, with free-host accounting."""
+        nodes = self.client.list("v1", "Node",
+                                 label_selector={SHAPE_LABEL: shape})
+        hosts_per_slice: Dict[str, int] = {}
+        for node in nodes:
+            labels = node.get("metadata", {}).get("labels", {}) or {}
+            idx = labels.get(SLICE_INDEX_LABEL, "0")
+            hosts_per_slice[idx] = hosts_per_slice.get(idx, 0) + 1
+
+        # occupied hosts: running/pending worker pods pinned to a slice
+        busy: Dict[str, int] = {}
+        for pod in self.client.list("v1", "Pod"):
+            labels = pod.get("metadata", {}).get("labels", {}) or {}
+            assigned = labels.get(ASSIGNED_SLICE_LABEL, "")
+            phase = pod.get("status", {}).get("phase", "Pending")
+            if assigned.startswith(f"{shape}_") and phase in ("Pending",
+                                                             "Running"):
+                idx = assigned.rsplit("_", 1)[1]
+                busy[idx] = busy.get(idx, 0) + 1
+
+        out = []
+        for idx in sorted(hosts_per_slice, key=lambda s: int(s)):
+            hosts = hosts_per_slice[idx]
+            out.append(SliceInfo(
+                slice_id=f"{shape}_{idx}",
+                shape=shape,
+                hosts=hosts,
+                free_hosts=max(hosts - busy.get(idx, 0), 0),
+            ))
+        return out
+
+    def assign(self, shape: str, slices: int, hosts_per_slice: int,
+               inventory: Optional[List[SliceInfo]] = None,
+               ) -> Optional[List[str]]:
+        """Concrete slice ids for a gang, or None when infeasible.
+
+        Empty inventory also returns None — on real GKE the TPU placement
+        policy owns slice packing and the operator falls back to
+        selector-only scheduling. Pass ``inventory`` to reuse an existing
+        scan instead of re-listing the cluster.
+        """
+        inv = inventory if inventory is not None else self.inventory(shape)
+        if not inv:
+            return None
+        chosen = choose_slices(
+            [s.hosts for s in inv], [s.free_hosts for s in inv],
+            slices, hosts_per_slice)
+        if chosen is None:
+            return None
+        return [inv[i].slice_id for i in chosen]

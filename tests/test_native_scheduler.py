@@ -1,0 +1,247 @@
+"""Native placement core + cluster inventory + topology-aware operator.
+
+The C++ core (kubeflow_tpu/native/placement.cc) and its Python twin must
+produce identical assignments; the operator must place whole gangs onto
+concrete free slices and hold (never partially create) when capacity is
+missing.
+"""
+
+import random
+
+import pytest
+
+from kubeflow_tpu.k8s import FakeKubeClient
+from kubeflow_tpu.native import load_library, native_available
+from kubeflow_tpu.operators.tpujob import (
+    JOB_LABEL,
+    TpuJobOperator,
+    tpujob,
+)
+from kubeflow_tpu.platform.local import fake_slice_nodes
+from kubeflow_tpu.scheduler.inventory import (
+    ASSIGNED_SLICE_LABEL,
+    GangScheduler,
+    choose_slices,
+    choose_slices_py,
+)
+
+
+def test_native_library_builds_and_loads():
+    # the toolchain is part of the environment contract; if this fails the
+    # native path silently degrades, which we do NOT want silently in CI
+    assert native_available(), "g++ build of placement.cc failed"
+
+
+def test_native_ring_order_matches_python():
+    import ctypes
+
+    from kubeflow_tpu.scheduler.placement import ring_order
+
+    lib = load_library()
+    for (n, topo, rows, cols) in [(8, "4x8", 2, 4), (16, "8x8", 4, 4),
+                                  (2, "2x4", 1, 2), (4, "4x4", 2, 2)]:
+        out = (ctypes.c_int32 * n)()
+        assert lib.kftpu_ring_order(n, rows, cols, out) == 0
+        assert list(out) == ring_order(n, topo)
+
+
+def test_choose_slices_best_fit_and_adjacency():
+    # exact-fit slices preferred over oversized ones
+    hosts = [4, 2, 2, 4]
+    free = [4, 2, 2, 4]
+    assert choose_slices_py(hosts, free, 2, 2) == [1, 2]
+    # occupied slices skipped even if bigger
+    free = [4, 1, 2, 4]
+    assert choose_slices_py(hosts, free, 1, 2) == [2]
+    # adjacency: prefer the tighter window among equal-waste options
+    hosts = [2, 2, 2, 2, 2]
+    free = [2, 0, 2, 2, 2]
+    assert choose_slices_py(hosts, free, 2, 2) == [2, 3]
+    # infeasible
+    assert choose_slices_py(hosts, [0] * 5, 1, 2) is None
+    assert choose_slices_py(hosts, free, 6, 2) is None
+
+
+def test_native_matches_python_fuzz():
+    assert native_available()
+    rng = random.Random(0)
+    for _ in range(300):
+        n = rng.randint(1, 20)
+        hosts = [rng.choice([1, 2, 4, 8]) for _ in range(n)]
+        free = [rng.choice([0, h // 2, h]) for h in hosts]
+        want = rng.randint(1, 4)
+        need = rng.choice([1, 2, 4])
+        assert choose_slices(hosts, free, want, need) == \
+            choose_slices_py(hosts, free, want, need), (hosts, free, want,
+                                                        need)
+
+
+# -- inventory + operator integration --------------------------------------
+
+def _seed_nodes(client, shape="v5e-8", count=3):
+    for node in fake_slice_nodes(shape, count=count):
+        client.create(node)
+
+
+def test_inventory_counts_free_hosts():
+    client = FakeKubeClient()
+    _seed_nodes(client, count=2)
+    sched = GangScheduler(client)
+    inv = sched.inventory("v5e-8")
+    assert [(s.slice_id, s.hosts, s.free_hosts) for s in inv] == [
+        ("v5e-8_0", 2, 2), ("v5e-8_1", 2, 2)]
+    # a claimed pod makes its slice busy
+    client.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "p", "namespace": "d",
+                     "labels": {ASSIGNED_SLICE_LABEL: "v5e-8_0"}},
+        "spec": {}, "status": {"phase": "Running"},
+    })
+    inv = sched.inventory("v5e-8")
+    assert inv[0].free_hosts == 1 and inv[1].free_hosts == 2
+
+
+def test_operator_pins_gang_to_concrete_slice():
+    client = FakeKubeClient()
+    _seed_nodes(client, count=3)
+    op = TpuJobOperator(client)
+    client.create(tpujob("j1", "default", {
+        "image": "x", "slices": 1, "hostsPerSlice": 2,
+        "accelerator": "v5e-8"}))
+    op.reconcile("default", "j1")
+    pods = client.list("v1", "Pod", "default",
+                       label_selector={JOB_LABEL: "j1"})
+    assert len(pods) == 2
+    assigned = {p["metadata"]["labels"][ASSIGNED_SLICE_LABEL] for p in pods}
+    assert len(assigned) == 1  # whole gang on one slice
+    sel = pods[0]["spec"]["nodeSelector"]
+    assert sel["kubeflow-tpu.org/slice-index"] == (
+        assigned.pop().rsplit("_", 1)[1])
+
+
+def test_two_jobs_get_disjoint_slices():
+    client = FakeKubeClient()
+    _seed_nodes(client, count=2)
+    op = TpuJobOperator(client)
+    for name in ("j1", "j2"):
+        client.create(tpujob(name, "default", {
+            "image": "x", "slices": 1, "hostsPerSlice": 2,
+            "accelerator": "v5e-8"}))
+        op.reconcile("default", name)
+    s1 = {p["metadata"]["labels"][ASSIGNED_SLICE_LABEL]
+          for p in client.list("v1", "Pod", "default",
+                               label_selector={JOB_LABEL: "j1"})}
+    s2 = {p["metadata"]["labels"][ASSIGNED_SLICE_LABEL]
+          for p in client.list("v1", "Pod", "default",
+                               label_selector={JOB_LABEL: "j2"})}
+    assert s1 and s2 and s1.isdisjoint(s2)
+
+
+def test_job_holds_when_no_capacity():
+    client = FakeKubeClient()
+    _seed_nodes(client, count=1)  # one slice only
+    op = TpuJobOperator(client)
+    client.create(tpujob("big", "default", {
+        "image": "x", "slices": 2, "hostsPerSlice": 2,
+        "accelerator": "v5e-8"}))
+    requeue = op.reconcile("default", "big")
+    # nothing partially created
+    assert client.list("v1", "Pod", "default",
+                       label_selector={JOB_LABEL: "big"}) == []
+    job = client.get("kubeflow-tpu.org/v1alpha1", "TpuJob", "default", "big")
+    conds = job["status"]["conditions"]
+    assert any(c["reason"] == "NoFreeSlices" for c in conds)
+    assert requeue is not None  # retries when capacity frees up
+
+
+def test_hold_conditions_do_not_grow_unbounded():
+    client = FakeKubeClient()
+    _seed_nodes(client, count=1)
+    op = TpuJobOperator(client)
+    client.create(tpujob("big", "default", {
+        "image": "x", "slices": 2, "hostsPerSlice": 2,
+        "accelerator": "v5e-8"}))
+    for _ in range(5):  # five hold retries
+        op.reconcile("default", "big")
+    job = client.get("kubeflow-tpu.org/v1alpha1", "TpuJob", "default", "big")
+    unsched = [c for c in job["status"]["conditions"]
+               if c["reason"] == "NoFreeSlices"]
+    assert len(unsched) == 1  # deduped, not one per retry
+
+
+def test_adoption_ignores_terminal_pod_claims():
+    # a Succeeded pod's stale claim must not be adopted (its slice shows
+    # free in inventory and could be double-booked)
+    client = FakeKubeClient()
+    _seed_nodes(client, count=2)
+    op = TpuJobOperator(client)
+    client.create(tpujob("j", "default", {
+        "image": "x", "slices": 1, "hostsPerSlice": 2,
+        "accelerator": "v5e-8"}))
+    op.reconcile("default", "j")
+    assert op._existing_assignment("default", "j")  # live pods claim
+    for pod in client.list("v1", "Pod", "default",
+                           label_selector={JOB_LABEL: "j"}):
+        pod.setdefault("status", {})["phase"] = "Succeeded"
+        client.update_status(pod)
+    assert op._existing_assignment("default", "j") == {}
+
+
+def test_held_job_schedules_after_capacity_frees():
+    client = FakeKubeClient()
+    _seed_nodes(client, count=1)
+    op = TpuJobOperator(client)
+    client.create(tpujob("j1", "default", {
+        "image": "x", "slices": 1, "hostsPerSlice": 2,
+        "accelerator": "v5e-8"}))
+    op.reconcile("default", "j1")
+    client.create(tpujob("j2", "default", {
+        "image": "x", "slices": 1, "hostsPerSlice": 2,
+        "accelerator": "v5e-8"}))
+    op.reconcile("default", "j2")
+    assert client.list("v1", "Pod", "default",
+                       label_selector={JOB_LABEL: "j2"}) == []
+    # j1 finishes → its pods terminate → slice frees
+    for pod in client.list("v1", "Pod", "default",
+                           label_selector={JOB_LABEL: "j1"}):
+        pod.setdefault("status", {})["phase"] = "Succeeded"
+        client.update_status(pod)
+    op.reconcile("default", "j2")
+    assert len(client.list("v1", "Pod", "default",
+                           label_selector={JOB_LABEL: "j2"})) == 2
+
+
+def test_recreated_member_keeps_surviving_siblings_slice():
+    client = FakeKubeClient()
+    _seed_nodes(client, count=3)
+    op = TpuJobOperator(client)
+    client.create(tpujob("j", "default", {
+        "image": "x", "slices": 1, "hostsPerSlice": 2,
+        "accelerator": "v5e-8"}))
+    op.reconcile("default", "j")
+    pods = client.list("v1", "Pod", "default",
+                       label_selector={JOB_LABEL: "j"})
+    original = pods[0]["metadata"]["labels"][ASSIGNED_SLICE_LABEL]
+    # evict one worker (no Failed status: plain disappearance)
+    client.delete("v1", "Pod", "default", pods[0]["metadata"]["name"])
+    op.reconcile("default", "j")
+    pods = client.list("v1", "Pod", "default",
+                       label_selector={JOB_LABEL: "j"})
+    assert len(pods) == 2
+    assert all(p["metadata"]["labels"][ASSIGNED_SLICE_LABEL] == original
+               for p in pods)
+
+
+def test_no_inventory_falls_back_to_selector_only():
+    # real GKE: no slice-index-labeled nodes visible; placement policy owns
+    # packing and the operator must not block
+    client = FakeKubeClient()
+    op = TpuJobOperator(client)
+    client.create(tpujob("j", "default", {
+        "image": "x", "slices": 1, "hostsPerSlice": 2,
+        "accelerator": "v5e-8"}))
+    op.reconcile("default", "j")
+    pods = client.list("v1", "Pod", "default",
+                       label_selector={JOB_LABEL: "j"})
+    assert len(pods) == 2
+    assert ASSIGNED_SLICE_LABEL not in pods[0]["metadata"]["labels"]
