@@ -1,0 +1,9 @@
+"""Bootstrap deploy server: run deployment flows server-side over REST.
+
+Reference: ``/root/reference/bootstrap/cmd/bootstrap/app/ksServer.go`` —
+the long-running service behind the click-to-deploy UI with per-project
+locks (``GetProjectLock :358``), endpoints ``/kfctl/e2eDeploy``,
+``/kfctl/apps/apply`` (``:900-906``), and a ``/metrics`` endpoint.
+"""
+
+from kubeflow_tpu.bootstrap.server import DeployServer  # noqa: F401

@@ -1,0 +1,208 @@
+"""Deploy REST service: init/generate/apply flows with per-deployment locks.
+
+Route parity with ksServer (``/root/reference/bootstrap/cmd/bootstrap/
+app/ksServer.go:900-906``):
+
+- ``POST /kfctl/e2eDeploy``  {"name", "preset", "platform", "namespace",
+  "components": {...param overrides}} — full init→generate→apply in a
+  background thread (the reference's flow takes minutes; clients poll)
+- ``GET  /kfctl/status/<name>`` — deployment phase + log tail
+- ``POST /kfctl/apps/apply``  {"name"} — re-apply an existing deployment
+- ``DELETE /kfctl/deployments/<name>`` — tear down
+- ``GET  /metrics`` handled by the shared metrics server
+
+Per-deployment mutexes mirror ``GetProjectLock`` (ksServer.go:358-368):
+concurrent requests for one deployment serialize; different deployments
+run in parallel.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+import traceback
+from typing import Any, Dict, Optional, Tuple
+
+from kubeflow_tpu.config import DeploymentConfig, preset
+from kubeflow_tpu.config.deployment import ComponentSpec
+from kubeflow_tpu.k8s.apply import apply_all, delete_all
+from kubeflow_tpu.k8s.client import KubeClient
+from kubeflow_tpu.manifests import render_all
+from kubeflow_tpu.utils import DEFAULT_REGISTRY
+from kubeflow_tpu.utils.jsonhttp import serve_json
+
+log = logging.getLogger(__name__)
+
+_deploys = DEFAULT_REGISTRY.counter(
+    "kftpu_bootstrap_deploys_total", "e2eDeploy requests accepted")
+
+PHASE_PENDING = "Pending"
+PHASE_RUNNING = "Running"
+PHASE_SUCCEEDED = "Succeeded"
+PHASE_FAILED = "Failed"
+
+
+class DeployServer:
+    """Holds deployment state; serves the kfctl REST surface."""
+
+    def __init__(self, client: KubeClient, *, app_root: str = "/tmp/kftpu",
+                 run_async: bool = True) -> None:
+        self.client = client
+        self.app_root = app_root
+        self.run_async = run_async
+        self._state_lock = threading.Lock()
+        self._locks: Dict[str, threading.Lock] = {}
+        self._status: Dict[str, Dict[str, Any]] = {}
+
+    # -- locks (GetProjectLock parity) -------------------------------------
+
+    def _lock_for(self, name: str) -> threading.Lock:
+        with self._state_lock:
+            return self._locks.setdefault(name, threading.Lock())
+
+    def _set(self, name: str, phase: str, message: str = "") -> None:
+        with self._state_lock:
+            entry = self._status.setdefault(name, {"log": []})
+            entry["phase"] = phase
+            if message:
+                entry["log"] = (entry.get("log", []) + [message])[-50:]
+            entry["updatedAt"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                               time.gmtime())
+
+    # -- flows -------------------------------------------------------------
+
+    def _deploy_flow(self, name: str, body: Dict[str, Any]) -> None:
+        with self._lock_for(name):
+            try:
+                self._set(name, PHASE_RUNNING, "building config")
+                config = preset(body.get("preset", "standard"), name)
+                config.namespace = body.get("namespace", config.namespace)
+                if body.get("platform"):
+                    config.platform = body["platform"]
+                for comp, params in (body.get("components") or {}).items():
+                    spec = config.component(comp)
+                    if spec is None:
+                        config.components.append(
+                            ComponentSpec(comp, params=dict(params)))
+                    else:
+                        spec.params.update(params)
+                config.validate()
+                app_dir = os.path.join(self.app_root, name)
+                os.makedirs(app_dir, exist_ok=True)
+                config.save(os.path.join(app_dir, "app.yaml"))
+
+                self._set(name, PHASE_RUNNING, "rendering manifests")
+                objs = render_all(config)
+                self._set(name, PHASE_RUNNING,
+                          f"applying {len(objs)} objects")
+                apply_all(self.client, objs)
+                self._set(name, PHASE_SUCCEEDED,
+                          f"applied {len(objs)} objects")
+            except Exception as e:  # noqa: BLE001 — reported via status
+                log.error("deploy %s failed:\n%s", name,
+                          traceback.format_exc())
+                self._set(name, PHASE_FAILED, f"{type(e).__name__}: {e}")
+
+    def _delete_flow(self, name: str) -> None:
+        with self._lock_for(name):
+            try:
+                app_dir = os.path.join(self.app_root, name, "app.yaml")
+                if not os.path.exists(app_dir):
+                    self._set(name, PHASE_FAILED, "unknown deployment")
+                    return
+                config = DeploymentConfig.load(app_dir)
+                objs = render_all(config)
+                delete_all(self.client, objs)
+                self._set(name, PHASE_SUCCEEDED, "deleted")
+            except Exception as e:  # noqa: BLE001
+                self._set(name, PHASE_FAILED, f"{type(e).__name__}: {e}")
+
+    def _run(self, target, *args) -> None:
+        if self.run_async:
+            threading.Thread(target=target, args=args, daemon=True).start()
+        else:
+            target(*args)
+
+    # -- routes ------------------------------------------------------------
+
+    def handle(self, method: str, path: str, body: Optional[Dict[str, Any]],
+               user: str = "") -> Tuple[int, Any]:
+        body = body or {}
+        if method == "POST" and path == "/kfctl/e2eDeploy":
+            name = body.get("name", "")
+            if not name:
+                return 400, {"error": "name is required"}
+            # atomic check-and-set: a second POST racing the Pending window
+            # must not queue a duplicate flow
+            with self._state_lock:
+                current = self._status.get(name, {}).get("phase")
+                if current in (PHASE_PENDING, PHASE_RUNNING):
+                    return 409, {
+                        "error": f"deployment {name!r} already in progress"}
+                entry = self._status.setdefault(name, {"log": []})
+                entry["phase"] = PHASE_PENDING
+                entry["log"] = (entry.get("log", []) + ["accepted"])[-50:]
+                entry["updatedAt"] = time.strftime(
+                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+            _deploys.inc()
+            self._run(self._deploy_flow, name, body)
+            return 200, {"name": name, "phase": PHASE_PENDING}
+        if method == "POST" and path == "/kfctl/apps/apply":
+            name = body.get("name", "")
+            if not name:
+                return 400, {"error": "name is required"}
+            app_yaml = os.path.join(self.app_root, name, "app.yaml")
+            if not os.path.exists(app_yaml):
+                return 404, {"error": f"deployment {name!r} not found"}
+            self._set(name, PHASE_PENDING, "re-apply accepted")
+            self._run(self._reapply_flow, name)
+            return 200, {"name": name, "phase": PHASE_PENDING}
+        if method == "GET" and path.startswith("/kfctl/status/"):
+            name = path.rsplit("/", 1)[1]
+            with self._state_lock:
+                status = self._status.get(name)
+            if status is None:
+                return 404, {"error": f"deployment {name!r} not found"}
+            return 200, {"name": name, **status}
+        if method == "DELETE" and path.startswith("/kfctl/deployments/"):
+            name = path.rsplit("/", 1)[1]
+            if not os.path.exists(os.path.join(self.app_root, name,
+                                               "app.yaml")):
+                return 404, {"error": f"deployment {name!r} not found"}
+            self._set(name, PHASE_PENDING, "delete accepted")
+            self._run(self._delete_flow, name)
+            return 200, {"name": name, "phase": PHASE_PENDING}
+        if method == "GET" and path == "/healthz":
+            return 200, {"ok": True}
+        return 404, {"error": f"no route {method} {path}"}
+
+    def _reapply_flow(self, name: str) -> None:
+        with self._lock_for(name):
+            try:
+                config = DeploymentConfig.load(
+                    os.path.join(self.app_root, name, "app.yaml"))
+                objs = render_all(config)
+                apply_all(self.client, objs)
+                self._set(name, PHASE_SUCCEEDED,
+                          f"re-applied {len(objs)} objects")
+            except Exception as e:  # noqa: BLE001
+                self._set(name, PHASE_FAILED, f"{type(e).__name__}: {e}")
+
+
+def main() -> None:
+    from kubeflow_tpu.k8s.client import HttpKubeClient
+    from kubeflow_tpu.utils import serve_metrics
+
+    logging.basicConfig(level=logging.INFO)
+    serve_metrics(int(os.environ.get("KFTPU_MONITORING_PORT", "8091")))
+    server = DeployServer(
+        HttpKubeClient(),
+        app_root=os.environ.get("KFTPU_APP_ROOT", "/tmp/kftpu"))
+    serve_json(server.handle,
+               int(os.environ.get("KFTPU_BOOTSTRAP_PORT", "8086")))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+"""Test/CI harness: multi-process collective tier + E2E trigger config.
+
+Reference: the Argo-on-Prow system — ``prow_config.yaml`` maps changed
+paths to E2E workflow components (``/root/reference/prow_config.yaml:
+1-140``), ``testing/workflows/components/workflows.libsonnet:58-330``
+builds the DAG, and ``kubeflow.testing.test_helper`` emits junit XML.
+This package adds the tier the reference lacks (SURVEY.md §4): a
+multi-process CPU ``jax.distributed`` simulation that exercises the
+operator's exact env contract without a cluster.
+"""
+
+from kubeflow_tpu.testing.multiprocess import (  # noqa: F401
+    ProcResult,
+    run_multiprocess,
+)
+from kubeflow_tpu.testing.harness import (  # noqa: F401
+    CiConfig,
+    e2e_workflow,
+    junit_xml,
+    triggered_workflows,
+)

@@ -1,0 +1,126 @@
+"""CI trigger config, the E2E DAG, and junit output.
+
+Reference pieces: ``prow_config.yaml`` (path → workflow mapping with
+``include_dirs``), the ``kfTests`` Argo DAG (``testing/workflows/
+components/workflows.libsonnet:58-330``: build → deploy → parallel test
+tasks → teardown), and junit XML artifacts via
+``kubeflow.testing.test_helper``. The DAG here renders onto the native
+Workflow engine so the same controller that runs kubebench runs CI.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Sequence
+import re
+from xml.sax.saxutils import escape, quoteattr
+
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.workflows.workflow import container_step, workflow
+
+
+@dataclass
+class CiConfig:
+    """path-glob → workflow-name mapping (prow_config.yaml equivalent)."""
+
+    # e.g. [{"name": "e2e-full", "include": ["kubeflow_tpu/**", "tests/**"]},
+    #       {"name": "e2e-serving", "include": ["kubeflow_tpu/serving/**"]}]
+    workflows: List[Dict[str, Any]] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "CiConfig":
+        return cls(workflows=list(d.get("workflows", []) or []))
+
+
+def triggered_workflows(config: CiConfig,
+                        changed_files: Sequence[str]) -> List[str]:
+    """Workflow names whose include globs match any changed file; a
+    workflow with no include list always triggers (prow semantics)."""
+    out = []
+    for wf in config.workflows:
+        globs = wf.get("include", []) or []
+        if not globs or any(
+            fnmatch.fnmatch(f, g) for f in changed_files for g in globs
+        ):
+            out.append(wf["name"])
+    return out
+
+
+def e2e_workflow(
+    name: str,
+    ns: str,
+    *,
+    image: str = "kubeflow-tpu/platform:v1alpha1",
+    tests: Sequence[str] = ("tests/",),
+    include_multiprocess: bool = True,
+    processes: int = 4,
+) -> o.Obj:
+    """The kfTests DAG on the native engine: checkout/setup → deploy the
+    platform to the in-cluster fake → parallel test tasks → teardown."""
+    steps: List[Dict[str, Any]] = [
+        container_step(
+            "setup", image,
+            command=["python", "-m", "kubeflow_tpu.cli", "init", "/app",
+                     "--preset", "standard"],
+        ),
+        container_step(
+            "deploy", image,
+            command=["python", "-m", "kubeflow_tpu.cli", "apply", "/app",
+                     "--provision"],
+            dependencies=["setup"],
+        ),
+    ]
+    test_names = []
+    for i, target in enumerate(tests):
+        # step names feed pod names: DNS-1123 only
+        safe = re.sub(r"[^a-z0-9-]", "-",
+                      target.strip("/").replace("/", "-").lower()).strip("-")
+        tname = f"test-{i}-{safe}"
+        test_names.append(tname)
+        steps.append(container_step(
+            tname, image,
+            command=["python", "-m", "pytest", target, "-x", "-q"],
+            dependencies=["deploy"],
+            retries=1,  # the reference retries flaky E2E tasks too
+        ))
+    if include_multiprocess:
+        test_names.append("test-collectives")
+        steps.append(container_step(
+            "test-collectives", image,
+            command=["python", "-m",
+                     "kubeflow_tpu.testing.run_collective_check",
+                     "--processes", str(processes)],
+            dependencies=["deploy"],
+        ))
+    steps.append(container_step(
+        "teardown", image,
+        command=["python", "-m", "kubeflow_tpu.cli", "delete", "/app",
+                 "--provision"],
+        # with no test steps, teardown must still wait for deploy or it
+        # races the platform apply
+        dependencies=test_names or ["deploy"],
+    ))
+    return workflow(name, ns, steps)
+
+
+def junit_xml(suite: str, results: Sequence[Mapping[str, Any]]) -> str:
+    """results: [{"name", "time_s", "failure": optional str}] → junit XML
+    (the artifact shape testgrid consumes)."""
+    failures = sum(1 for r in results if r.get("failure"))
+    lines = [
+        '<?xml version="1.0" encoding="UTF-8"?>',
+        f'<testsuite name={quoteattr(suite)} tests="{len(results)}" '
+        f'failures="{failures}">',
+    ]
+    for r in results:
+        t = float(r.get("time_s", 0.0))
+        lines.append(f'  <testcase name={quoteattr(r["name"])} '
+                     f'time="{t:.3f}"'
+                     + ("/>" if not r.get("failure") else ">"))
+        if r.get("failure"):
+            lines.append(f'    <failure>{escape(str(r["failure"]))}'
+                         "</failure>")
+            lines.append("  </testcase>")
+    lines.append("</testsuite>")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,77 @@
+"""Multi-process ``jax.distributed`` simulation on CPU.
+
+Spawns N subprocesses wired with the SAME env contract the TpuJob
+operator injects into worker pods (:mod:`kubeflow_tpu.parallel.
+distributed`: coordinator address, process count/id), so cross-process
+collectives are exercised end-to-end on localhost — the test tier the
+reference punts to real CI clusters (SURVEY.md §4). Process 0 hosts the
+coordinator, exactly like worker-0 behind the headless Service.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+from kubeflow_tpu.parallel import distributed as dist
+
+
+@dataclass
+class ProcResult:
+    process_id: int
+    returncode: int
+    stdout: str
+    stderr: str
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_multiprocess(
+    workload: Sequence[str],
+    num_processes: int,
+    *,
+    env: Optional[Dict[str, str]] = None,
+    timeout_s: float = 180.0,
+    job_name: str = "mp-test",
+) -> List[ProcResult]:
+    """Run ``workload`` (argv after the interpreter) in N coordinated
+    processes; returns per-process results (caller asserts)."""
+    port = _free_port()
+    procs = []
+    for pid in range(num_processes):
+        penv = dict(os.environ)
+        penv.update(env or {})
+        penv.update({
+            dist.ENV_COORDINATOR: f"127.0.0.1:{port}",
+            dist.ENV_NUM_PROCESSES: str(num_processes),
+            dist.ENV_PROCESS_ID: str(pid),
+            dist.ENV_JOB_NAME: job_name,
+            # each process gets exactly one virtual CPU device so the
+            # global device count equals the process count, like one TPU
+            # host per pod
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, *workload],
+            env=penv, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True))
+    out: List[ProcResult] = []
+    for pid, proc in enumerate(procs):
+        try:
+            stdout, stderr = proc.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            stdout, stderr = proc.communicate()
+            out.append(ProcResult(pid, -9, stdout, stderr))
+            continue
+        out.append(ProcResult(pid, proc.returncode, stdout, stderr))
+    return out

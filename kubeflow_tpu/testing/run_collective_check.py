@@ -1,0 +1,36 @@
+"""CLI driver for the multi-process collective check.
+
+``python -m kubeflow_tpu.testing.run_collective_check --processes 4``
+spawns the coordinated subprocesses and exits non-zero if any rank fails
+— the command the E2E DAG's ``test-collectives`` step runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from kubeflow_tpu.testing.multiprocess import run_multiprocess
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--processes", type=int, default=4)
+    p.add_argument("--timeout", type=float, default=180.0)
+    args = p.parse_args(argv)
+    results = run_multiprocess(
+        ["-m", "kubeflow_tpu.testing.collective_check"],
+        args.processes, timeout_s=args.timeout)
+    ok = all(r.returncode == 0 for r in results)
+    for r in results:
+        line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
+        print(f"rank {r.process_id}: rc={r.returncode} {line}")
+        if r.returncode != 0 and r.stderr:
+            print(r.stderr[-500:], file=sys.stderr)
+    print(json.dumps({"processes": args.processes, "ok": ok}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
