@@ -37,6 +37,7 @@ WORKLOADS = {
     "mnist": "kubeflow_tpu.examples.mnist",
     "resnet": "kubeflow_tpu.examples.resnet",
     "lm": "kubeflow_tpu.examples.lm",
+    "bert": "kubeflow_tpu.examples.bert",
 }
 
 

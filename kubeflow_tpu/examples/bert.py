@@ -1,0 +1,108 @@
+"""BERT masked-LM pretraining job — the DDP-BERT baseline workload.
+
+BASELINE.md config 3 (DDP BERT-base step time + scaling) as SPMD pjit:
+``python -m kubeflow_tpu.examples.bert --steps 100``. Synthetic token
+streams with 15% masking; checkpoint/resume like the LM flagship.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.examples.common import checkpoint_dir, launcher_init, log_metrics
+from kubeflow_tpu.models.bert import Bert, BertConfig, mask_tokens
+from kubeflow_tpu.train import (
+    TrainState,
+    create_sharded_state,
+    make_mlm_train_step,
+    make_optimizer,
+)
+from kubeflow_tpu.train.checkpoint import CheckpointManager
+
+
+def main(argv=None) -> float:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--per-device-batch", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--vocab-size", type=int, default=30522)
+    p.add_argument("--d-model", type=int, default=768)
+    p.add_argument("--n-layers", type=int, default=12)
+    p.add_argument("--n-heads", type=int, default=12)
+    p.add_argument("--d-ff", type=int, default=3072)
+    p.add_argument("--tp", type=int, default=None)
+    p.add_argument("--learning-rate", type=float, default=1e-4)
+    p.add_argument("--checkpoint-every", type=int, default=50)
+    p.add_argument("--log-every", type=int, default=10)
+    args = p.parse_args(argv)
+
+    penv, mesh = launcher_init(tp=args.tp)
+    config = BertConfig(
+        vocab_size=args.vocab_size,
+        d_model=args.d_model,
+        n_layers=args.n_layers,
+        n_heads=args.n_heads,
+        d_ff=args.d_ff,
+        max_seq_len=args.seq_len,
+    )
+    model = Bert(config)
+    batch = args.per_device_batch * mesh.devices.shape[0]
+    tx = make_optimizer(args.learning_rate, warmup_steps=20,
+                        decay_steps=args.steps + 1)
+    sample = jnp.zeros((batch, args.seq_len), jnp.int32)
+
+    def init_fn(rng):
+        params = model.init(rng, sample)["params"]
+        return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+
+    state, _ = create_sharded_state(init_fn, jax.random.key(0), mesh)
+
+    ckpt = None
+    start_step = 0
+    if checkpoint_dir():
+        ckpt = CheckpointManager(checkpoint_dir())
+        state, start_step = ckpt.restore_or_init(state)
+    if start_step >= args.steps:
+        log_metrics(start_step, done=True)
+        if ckpt:
+            ckpt.close()
+        return 0.0
+
+    step_fn = make_mlm_train_step(mesh)
+    data_rng = jax.random.key(99)
+    tokens_per_step = batch * args.seq_len
+    last_loss = float("nan")
+    t_window = time.perf_counter()
+    for step in range(start_step, args.steps):
+        data_rng, tok_rng, mask_rng = jax.random.split(data_rng, 3)
+        labels = jax.random.randint(
+            tok_rng, (batch, args.seq_len), 0, args.vocab_size, jnp.int32)
+        tokens, weights = mask_tokens(mask_rng, labels)
+        state, metrics = step_fn(state, tokens, labels, weights)
+        if (step + 1) % args.log_every == 0 or step + 1 == args.steps:
+            last_loss = float(metrics["loss"])
+            dt = time.perf_counter() - t_window
+            steps_done = (step + 1 - start_step) % args.log_every or \
+                args.log_every
+            log_metrics(
+                step + 1,
+                loss=round(last_loss, 4),
+                tokens_per_sec=round(tokens_per_step * steps_done / dt, 1),
+                step_time_ms=round(dt / steps_done * 1e3, 2),
+            )
+            t_window = time.perf_counter()
+        if ckpt and (step + 1) % args.checkpoint_every == 0:
+            ckpt.save(state, step + 1)
+    if ckpt:
+        ckpt.save(state, args.steps)
+        ckpt.close()
+    log_metrics(args.steps, loss=round(last_loss, 4), done=True)
+    return last_loss
+
+
+if __name__ == "__main__":
+    main()

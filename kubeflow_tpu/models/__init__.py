@@ -13,4 +13,11 @@ from kubeflow_tpu.models.resnet import (  # noqa: F401
     resnet18_thin,
     resnet50,
 )
+from kubeflow_tpu.models.bert import (  # noqa: F401
+    Bert,
+    BertConfig,
+    bert_base,
+    bert_large,
+    bert_tiny,
+)
 from kubeflow_tpu.models.mnist import MnistCnn  # noqa: F401

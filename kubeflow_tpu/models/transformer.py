@@ -63,6 +63,7 @@ class TransformerConfig:
     # sequence-parallel ring attention over the seq mesh axis (long context)
     attention_impl: str = "dense"
     attention_block_k: int = 512
+    causal: bool = True           # False => bidirectional (encoder/BERT)
     seq_axis: str = "tp"          # mesh axis ring attention shards sequence over
     rules: AxisRules = DEFAULT_RULES  # logical-axis -> mesh-axis sharding rules
 
@@ -155,15 +156,15 @@ class Attention(nn.Module):
         return _constrain(out, c.rules, "batch", "seq", None)
 
     def _attend(self, q, k, v):
-        """Dispatch to the configured attention core; causal always."""
+        """Dispatch to the configured attention core (causal per config)."""
         c = self.config
         from kubeflow_tpu.ops import attention as att  # local: no cycle
 
         if c.attention_impl == "dense":
-            return att.reference_attention(q, k, v, causal=True)
+            return att.reference_attention(q, k, v, causal=c.causal)
         if c.attention_impl == "blockwise":
             return att.blockwise_attention(
-                q, k, v, causal=True, block_k=c.attention_block_k
+                q, k, v, causal=c.causal, block_k=c.attention_block_k
             )
         if c.attention_impl == "flash":
             # largest divisor of S within the block budget (flash requires
@@ -176,15 +177,15 @@ class Attention(nn.Module):
             )
             if block < 16:
                 return att.blockwise_attention(
-                    q, k, v, causal=True, block_k=c.attention_block_k
+                    q, k, v, causal=c.causal, block_k=c.attention_block_k
                 )
-            return att.flash_attention(q, k, v, True, block, block)
+            return att.flash_attention(q, k, v, c.causal, block, block)
         # ring: sequence-parallel over the seq mesh axis; partial-manual
         # shard_map (batch/other axes stay auto) on the current mesh
         mesh = jax.sharding.get_abstract_mesh()
         if mesh.empty or c.seq_axis not in mesh.axis_names:
             return att.blockwise_attention(
-                q, k, v, causal=True, block_k=c.attention_block_k
+                q, k, v, causal=c.causal, block_k=c.attention_block_k
             )
         import functools
 
@@ -193,7 +194,7 @@ class Attention(nn.Module):
         spec = P(None, c.seq_axis, None, None)
         fn = jax.shard_map(
             functools.partial(
-                att.ring_attention, axis_name=c.seq_axis, causal=True
+                att.ring_attention, axis_name=c.seq_axis, causal=c.causal
             ),
             mesh=mesh,
             in_specs=(spec, spec, spec),

@@ -59,6 +59,11 @@ def build_model(kind: str, config: Dict[str, Any]):
         return ResNet(cfg), lambda m, p, x: m.apply(
             {"params": p["params"], "batch_stats": p["batch_stats"]},
             x, train=False)
+    if kind == "bert":
+        from kubeflow_tpu.models.bert import Bert, BertConfig
+
+        cfg = BertConfig(**config)
+        return Bert(cfg), lambda m, p, x: m.apply({"params": p}, x)
     if kind == "transformer":
         from kubeflow_tpu.models import Transformer, TransformerConfig
 

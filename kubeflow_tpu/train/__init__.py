@@ -5,6 +5,8 @@ from kubeflow_tpu.train.trainer import (  # noqa: F401
     create_sharded_state,
     make_image_train_step,
     make_lm_train_step,
+    make_mlm_train_step,
+    masked_lm_loss,
     make_pipelined_lm_train_step,
     make_optimizer,
     next_token_loss,

@@ -164,6 +164,52 @@ def make_lm_train_step(
     return run
 
 
+def masked_lm_loss(logits: jnp.ndarray, labels: jnp.ndarray,
+                   weights: jnp.ndarray) -> jnp.ndarray:
+    """MLM objective: cross-entropy at masked positions only."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(jnp.sum(weights), 1.0)
+    return -jnp.sum(ll * weights) / denom
+
+
+def make_mlm_train_step(
+    mesh: Mesh,
+    rules: AxisRules = DEFAULT_RULES,
+    *,
+    donate: bool = True,
+):
+    """Jitted SPMD masked-LM step: (state, tokens, labels, weights) ->
+    (state, metrics). ``tokens`` are the corrupted inputs; ``labels`` the
+    originals; ``weights`` mark masked positions."""
+    batch_spec = logical_to_mesh_axes(("batch", "seq"), rules)
+
+    def step(state: TrainState, tokens, labels, weights):
+        tokens = jax.lax.with_sharding_constraint(tokens, batch_spec)
+        labels = jax.lax.with_sharding_constraint(labels, batch_spec)
+        weights = jax.lax.with_sharding_constraint(weights, batch_spec)
+
+        def loss_fn(params):
+            logits = state.apply_fn({"params": params}, tokens)
+            return masked_lm_loss(logits, labels, weights)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        new_state = state.apply_gradients(grads=grads)
+        metrics = {
+            "loss": loss,
+            "grad_norm": optax.global_norm(grads),
+            "step": new_state.step,
+        }
+        return new_state, metrics
+
+    def run(state, tokens, labels, weights):
+        with mesh_context(mesh):
+            return jitted(state, tokens, labels, weights)
+
+    jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+    return run
+
+
 def make_pipelined_lm_train_step(
     model,
     mesh: Mesh,
