@@ -130,6 +130,28 @@ class AuthServer:
         return ""
 
 
+def cookie_authenticator(secret: bytes):
+    """serve_json authenticator: gatekeeper session cookie → username.
+
+    Lets kfam/webapp/dashboard/bootstrap validate the signed cookie
+    themselves instead of blindly trusting the client-supplied user header
+    (which any in-cluster pod can spoof)."""
+    verifier = AuthServer({}, secret)
+
+    def authenticate(headers: Dict[str, str]) -> Optional[str]:
+        cookie = AuthServer._extract_cookie({}, headers)
+        return verifier.verify_cookie(cookie) if cookie else None
+
+    return authenticate
+
+
+def authenticator_from_env():
+    """``KFTPU_AUTH_SECRET`` set → cookie authenticator; unset → None
+    (the manifests then rely on NetworkPolicy to wall the service off)."""
+    secret = os.environ.get("KFTPU_AUTH_SECRET", "")
+    return cookie_authenticator(secret.encode()) if secret else None
+
+
 def main() -> None:
     import logging
 

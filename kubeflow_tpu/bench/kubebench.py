@@ -35,13 +35,30 @@ def benchmark_workflow(
     reporter_image: str = "kubeflow-tpu/platform:v1alpha1",
     post_job: Optional[Dict[str, Any]] = None,
     result_path: str = "/results",
+    experiment_pvc: str = "",
 ) -> o.Obj:
-    """Render the 4-step kubebench DAG around a TpuJob spec."""
+    """Render the 4-step kubebench DAG around a TpuJob spec.
+
+    ``experiment_pvc`` mounts a shared PVC at ``result_path`` across the
+    main job, post-job, and reporter — without it each step sees its own
+    empty filesystem and the reporter reads nothing (the reference runs
+    every step on a shared experiment PVC,
+    ``kubebench-job.libsonnet:160-176``).
+    """
+    volumes: List[Dict[str, Any]] = []
+    mounts: List[Dict[str, Any]] = []
+    if experiment_pvc:
+        volumes = [{"name": "experiment",
+                    "persistentVolumeClaim": {"claimName": experiment_pvc}}]
+        mounts = [{"name": "experiment", "mountPath": result_path}]
     job_spec = dict(job_spec)
     # the workload writes <result_path>/<job-name>.jsonl; the reporter
     # step reads it back (same contract as ClusterRunner)
     job_spec["env"] = {**(job_spec.get("env") or {}),
                        "KFTPU_RESULTS_DIR": result_path}
+    if experiment_pvc:
+        job_spec["volumes"] = (job_spec.get("volumes") or []) + volumes
+        job_spec["volumeMounts"] = (job_spec.get("volumeMounts") or []) + mounts
     job = tpujob(f"{name}-main", ns, job_spec)
     steps: List[Dict[str, Any]] = [
         # launch-main-job: success as soon as the operator records startTime
@@ -66,6 +83,8 @@ def benchmark_workflow(
             args=post_job.get("args"),
             env={ENV_EXP_ID: name, ENV_EXP_RESULT_PATH: result_path},
             dependencies=["wait-for-main-job"],
+            volumes=volumes or None,
+            volume_mounts=mounts or None,
         ))
         reporter_deps = ["run-post-job"]
     steps.append(container_step(
@@ -74,5 +93,7 @@ def benchmark_workflow(
                  "report", "--name", f"{name}-main", "--out", result_path],
         env={ENV_EXP_ID: name, ENV_EXP_RESULT_PATH: result_path},
         dependencies=reporter_deps,
+        volumes=volumes or None,
+        volume_mounts=mounts or None,
     ))
     return workflow(name, ns, steps)

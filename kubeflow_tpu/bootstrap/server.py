@@ -197,11 +197,14 @@ def main() -> None:
 
     logging.basicConfig(level=logging.INFO)
     serve_metrics(int(os.environ.get("KFTPU_MONITORING_PORT", "8091")))
+    from kubeflow_tpu.auth.gatekeeper import authenticator_from_env
+
     server = DeployServer(
         HttpKubeClient(),
         app_root=os.environ.get("KFTPU_APP_ROOT", "/tmp/kftpu"))
     serve_json(server.handle,
-               int(os.environ.get("KFTPU_BOOTSTRAP_PORT", "8086")))
+               int(os.environ.get("KFTPU_BOOTSTRAP_PORT", "8086")),
+               authenticator=authenticator_from_env())
 
 
 if __name__ == "__main__":

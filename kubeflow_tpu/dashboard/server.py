@@ -153,9 +153,12 @@ def main() -> None:
 
     from kubeflow_tpu.k8s.client import HttpKubeClient
 
+    from kubeflow_tpu.auth.gatekeeper import authenticator_from_env
+
     api = DashboardApi(HttpKubeClient())
     serve_json(api.handle,
-               int(os.environ.get("KFTPU_DASHBOARD_PORT", "8082")))
+               int(os.environ.get("KFTPU_DASHBOARD_PORT", "8082")),
+               authenticator=authenticator_from_env())
 
 
 if __name__ == "__main__":

@@ -245,6 +245,41 @@ def cluster_role_binding(name: str, role_name: str, sa: str, sa_ns: str) -> Obj:
     }
 
 
+def network_policy(
+    name: str,
+    ns: str,
+    pod_selector: Mapping[str, str],
+    *,
+    from_pod_labels: Sequence[Mapping[str, str]] = (),
+    from_namespace_labels: Sequence[Mapping[str, str]] = (),
+    ports: Sequence[int] = (),
+) -> Obj:
+    """Ingress-only NetworkPolicy: selected pods accept traffic solely from
+    the listed pod/namespace selectors (header-trusting web services must
+    not be reachable by arbitrary in-cluster pods)."""
+    peers: list = [{"podSelector": {"matchLabels": dict(l)}}
+                   for l in from_pod_labels]
+    peers += [{"namespaceSelector": {"matchLabels": dict(l)}}
+              for l in from_namespace_labels]
+    if not peers:
+        # an empty "from" list means ALL sources to the NetworkPolicy API —
+        # the opposite of what a caller of a lockdown helper intends
+        raise ValueError("network_policy needs at least one allowed peer")
+    rule: Dict[str, Any] = {"from": peers}
+    if ports:
+        rule["ports"] = [{"protocol": "TCP", "port": p} for p in ports]
+    return {
+        "apiVersion": "networking.k8s.io/v1",
+        "kind": "NetworkPolicy",
+        "metadata": metadata(name, ns),
+        "spec": {
+            "podSelector": {"matchLabels": dict(pod_selector)},
+            "policyTypes": ["Ingress"],
+            "ingress": [rule],
+        },
+    }
+
+
 def crd(
     plural: str,
     group: str,

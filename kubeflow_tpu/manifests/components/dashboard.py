@@ -7,6 +7,7 @@ from typing import Any, Dict, List
 
 from kubeflow_tpu.config.deployment import DeploymentConfig
 from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.manifests.components.edge import edge_only_policy
 from kubeflow_tpu.manifests.registry import register
 
 DEFAULTS: Dict[str, Any] = {
@@ -43,4 +44,5 @@ def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
         o.deployment(name, ns, pod, replicas=params["replicas"]),
         o.service(name, ns, {"app": name},
                   [{"name": "http", "port": 80, "targetPort": params["port"]}]),
+        edge_only_policy(name, ns, name, params["port"]),
     ]

@@ -1,0 +1,34 @@
+"""Edge/ingress conventions shared by the web-service components.
+
+The platform's web services (dashboard, notebook web app, kfam, bootstrap)
+authorize on the ``X-Kubeflow-Userid`` header, so they must only be
+reachable through the authenticating edge — the ingress gateway and the
+gatekeeper (reference: every UI sits behind the Ambassador/Istio gateway +
+IAP or basic-auth, ``/root/reference/kubeflow/common/ambassador.libsonnet:
+152-179``, ``/root/reference/kubeflow/gcp/iap.libsonnet``). These label
+selectors are the contract between the gateway component and the
+NetworkPolicies each web component renders.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from kubeflow_tpu.k8s import objects as o
+
+# pods allowed to talk to header-trusting backends
+INGRESS_POD_LABELS = {"app": "kftpu-ingressgateway"}
+GATEKEEPER_POD_LABELS = {"app": "gatekeeper"}
+PROBER_POD_LABELS = {"app": "availability-prober"}
+
+
+def edge_only_policy(name: str, ns: str, app_label: str,
+                     port: int, *, extra_from: List[dict] = ()) -> o.Obj:
+    """NetworkPolicy locking ``app=<app_label>`` to the edge pods (plus the
+    availability prober, whose whole job is reaching these services)."""
+    return o.network_policy(
+        f"{name}-edge-only", ns, {"app": app_label},
+        from_pod_labels=[INGRESS_POD_LABELS, GATEKEEPER_POD_LABELS,
+                         PROBER_POD_LABELS, *list(extra_from)],
+        ports=[port],
+    )

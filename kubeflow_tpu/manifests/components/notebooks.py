@@ -12,6 +12,7 @@ from typing import Any, Dict, List
 
 from kubeflow_tpu.config.deployment import DeploymentConfig
 from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.manifests.components.edge import edge_only_policy
 from kubeflow_tpu.manifests.components.tpujob_operator import GROUP, VERSION
 from kubeflow_tpu.manifests.registry import register
 
@@ -83,4 +84,8 @@ def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
         o.service(webapp_name, ns, {"app": webapp_name},
                   [{"name": "http", "port": 80,
                     "targetPort": params["webapp_port"]}]),
+        edge_only_policy(
+            webapp_name, ns, webapp_name, params["webapp_port"],
+            # the dashboard embeds the notebook manager and proxies its API
+            extra_from=[{"app": "centraldashboard"}]),
     ]

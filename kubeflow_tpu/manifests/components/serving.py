@@ -109,14 +109,20 @@ def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
         "KFTPU_MAX_BATCH_SIZE": str(params["max_batch_size"]),
     }
 
-    def version_deploy(version: str) -> o.Obj:
+    def version_deploy(version: str, pin: bool) -> o.Obj:
         labels = {"app": name, "version": version}
+        # Under a traffic split, pin each backend to its own model version so
+        # the Istio-weighted split actually routes between different models
+        # (tf-serving runs one server per version dir for the same reason:
+        # tf-serving-service-template.libsonnet per-version deployments).
+        # Single-version serving stays unpinned: hot-reload of the latest
+        # version is the advertised behavior there.
         pod = o.pod_spec([
             o.container(
                 "server",
                 params["image"],
                 command=["python", "-m", "kubeflow_tpu.serving.server"],
-                env=env,
+                env={**env, "KFTPU_MODEL_VERSION": version} if pin else env,
                 ports=[params["rest_port"], params["grpc_port"]],
                 resources=resources,
             )
@@ -126,7 +132,8 @@ def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
 
     splits: Dict[str, int] = dict(params["traffic_split"] or {})
     versions = sorted(splits) if splits else [params["version"]]
-    out: List[o.Obj] = [version_deploy(v) for v in versions]
+    out: List[o.Obj] = [version_deploy(v, pin=bool(splits))
+                        for v in versions]
     svc = o.service(
         name,
         ns,

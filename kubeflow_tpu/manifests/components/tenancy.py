@@ -13,6 +13,7 @@ from typing import Any, Dict, List
 
 from kubeflow_tpu.config.deployment import DeploymentConfig
 from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.manifests.components.edge import edge_only_policy
 from kubeflow_tpu.manifests.components.tpujob_operator import GROUP, VERSION
 from kubeflow_tpu.manifests.registry import register
 
@@ -109,4 +110,8 @@ def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
         o.service("kfam", ns, {"app": "kfam"},
                   [{"name": "http", "port": params["kfam_port"],
                     "targetPort": params["kfam_port"]}]),
+        edge_only_policy(
+            "kfam", ns, "kfam", params["kfam_port"],
+            # the dashboard's workgroup flow calls kfam server-side
+            extra_from=[{"app": "centraldashboard"}]),
     ]

@@ -188,17 +188,21 @@ class NotebookWebApp:
         }
 
 
-def serve(app: NotebookWebApp, port: int = 5000, background: bool = False):
-    return serve_json(app.handle, port, background=background)
+def serve(app: NotebookWebApp, port: int = 5000, background: bool = False,
+          authenticator=None):
+    return serve_json(app.handle, port, background=background,
+                      authenticator=authenticator)
 
 
 def main() -> None:
     import os
 
+    from kubeflow_tpu.auth.gatekeeper import authenticator_from_env
     from kubeflow_tpu.k8s.client import HttpKubeClient
 
     serve(NotebookWebApp(HttpKubeClient()),
-          port=int(os.environ.get("KFTPU_WEBAPP_PORT", "5000")))
+          port=int(os.environ.get("KFTPU_WEBAPP_PORT", "5000")),
+          authenticator=authenticator_from_env())
 
 
 if __name__ == "__main__":

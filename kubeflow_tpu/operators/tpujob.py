@@ -84,6 +84,10 @@ class TpuJobSpec:
     restart_policy: str = "OnFailure"  # Never | OnFailure
     max_restarts: int = 3
     gang_scheduling: bool = True
+    # pod volumes + per-worker mounts (kubebench runs on a shared experiment
+    # PVC: /root/reference/kubeflow/kubebench/kubebench-job.libsonnet:160-176)
+    volumes: List[Dict[str, Any]] = field(default_factory=list)
+    volume_mounts: List[Dict[str, Any]] = field(default_factory=list)
 
     @property
     def num_workers(self) -> int:
@@ -104,6 +108,8 @@ class TpuJobSpec:
             restart_policy=spec.get("restartPolicy", "OnFailure"),
             max_restarts=int(spec.get("maxRestarts", 3)),
             gang_scheduling=bool(spec.get("gangScheduling", True)),
+            volumes=list(spec.get("volumes", []) or []),
+            volume_mounts=list(spec.get("volumeMounts", []) or []),
         )
         out.validate()
         return out
@@ -195,6 +201,7 @@ def build_worker_pod(job: o.Obj, index: int, placement: SlicePlacement,
         env=env,
         ports=[spec.coordinator_port] if index == 0 else None,
         resources={"limits": {"google.com/tpu": spec.chips_per_host}},
+        volume_mounts=spec.volume_mounts or None,
     )
     # node labels carry the GKE accelerator TYPE (tpu-v5-lite-podslice),
     # not the framework's shape name (v5e-8) — selecting on the shape name
@@ -211,6 +218,7 @@ def build_worker_pod(job: o.Obj, index: int, placement: SlicePlacement,
             "cloud.google.com/gke-tpu-topology": placement.topology,
         },
         scheduler_name="kftpu-gang" if spec.gang_scheduling else None,
+        volumes=spec.volumes or None,
     )
     pspec["hostname"] = worker_name(name, index)
     pspec["subdomain"] = name

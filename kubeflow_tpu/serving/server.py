@@ -59,9 +59,15 @@ def _pad_batch(arr: np.ndarray, max_batch: int) -> Tuple[np.ndarray, int]:
 class ModelRepository:
     """Models under ``<base>/<model_name>/<version>/`` with hot reload."""
 
-    def __init__(self, base_path: str, *, poll_interval_s: float = 10.0) -> None:
+    def __init__(self, base_path: str, *, poll_interval_s: float = 10.0,
+                 pin_version: Optional[int] = None) -> None:
         self.base_path = base_path
         self.poll_interval_s = poll_interval_s
+        # When set (KFTPU_MODEL_VERSION from the per-version traffic-split
+        # Deployment), serve exactly this version instead of hot-loading the
+        # latest — otherwise every canary backend converges on the same model
+        # and the Istio weight split is a no-op.
+        self.pin_version = pin_version
         self._models: Dict[str, LoadedModel] = {}
         self._pinned: Dict[Tuple[str, int], LoadedModel] = {}
         self._lock = threading.Lock()
@@ -83,7 +89,15 @@ class ModelRepository:
             versions = list_versions(mdir)
             if not versions:
                 continue
-            latest = versions[-1]
+            if self.pin_version is not None:
+                if self.pin_version not in versions:
+                    log.warning("pinned version %d absent for model %s "
+                                "(have %s); waiting", self.pin_version, name,
+                                versions)
+                    continue
+                latest = self.pin_version
+            else:
+                latest = versions[-1]
             with self._lock:
                 current = self._models.get(name)
             if current is not None and current.version == latest:
@@ -146,8 +160,10 @@ class ModelRepository:
 
 class ModelServer:
     def __init__(self, base_path: str, *, port: int = 8500,
-                 max_batch_size: int = 8, poll_interval_s: float = 10.0) -> None:
-        self.repo = ModelRepository(base_path, poll_interval_s=poll_interval_s)
+                 max_batch_size: int = 8, poll_interval_s: float = 10.0,
+                 pin_version: Optional[int] = None) -> None:
+        self.repo = ModelRepository(base_path, poll_interval_s=poll_interval_s,
+                                    pin_version=pin_version)
         self.port = port
         self.max_batch_size = max_batch_size
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -270,12 +286,24 @@ class ModelServer:
             self._httpd.shutdown()
 
 
+def parse_pin_version(raw: Optional[str]) -> Optional[int]:
+    """``"3"`` or the manifest's version label ``"v3"`` → 3; empty → None."""
+    if not raw:
+        return None
+    digits = raw[1:] if raw[:1] in ("v", "V") else raw
+    if not digits.isdigit():
+        raise ValueError(f"KFTPU_MODEL_VERSION must be N or vN, got {raw!r}")
+    return int(digits)
+
+
 def main() -> None:
     logging.basicConfig(level=logging.INFO)
     base = os.environ.get("KFTPU_MODEL_BASE_PATH", "/models")
     port = int(os.environ.get("KFTPU_REST_PORT", "8500"))
     max_batch = int(os.environ.get("KFTPU_MAX_BATCH_SIZE", "8"))
-    server = ModelServer(base, port=port, max_batch_size=max_batch)
+    server = ModelServer(base, port=port, max_batch_size=max_batch,
+                         pin_version=parse_pin_version(
+                             os.environ.get("KFTPU_MODEL_VERSION")))
     server.start()
     try:
         while True:

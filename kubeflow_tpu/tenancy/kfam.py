@@ -179,18 +179,21 @@ class AccessManagementApi:
 
 
 def serve(api: AccessManagementApi, port: int = 8081,
-          background: bool = False):
-    return serve_json(api.handle, port, background=background)
+          background: bool = False, authenticator=None):
+    return serve_json(api.handle, port, background=background,
+                      authenticator=authenticator)
 
 
 def main() -> None:
     import os
 
+    from kubeflow_tpu.auth.gatekeeper import authenticator_from_env
     from kubeflow_tpu.k8s.client import HttpKubeClient
 
     admins = [a for a in os.environ.get("CLUSTER_ADMINS", "").split(",") if a]
     serve(AccessManagementApi(HttpKubeClient(), cluster_admins=admins),
-          port=int(os.environ.get("KFTPU_KFAM_PORT", "8081")))
+          port=int(os.environ.get("KFTPU_KFAM_PORT", "8081")),
+          authenticator=authenticator_from_env())
 
 
 if __name__ == "__main__":

@@ -230,8 +230,20 @@ class StudyController:
 
     def _records(self, spec: StudySpec,
                  trials: List[o.Obj]) -> List[TrialRecord]:
+        """History keyed by the persisted ``spec.index``, densely.
+
+        A trial deleted by the collision rollback in :meth:`_spawn` leaves a
+        hole; filling it with a failed placeholder keeps every later trial
+        in its original slot, so positional algorithms (hyperband's
+        bracket/rung schedule) score the right windows instead of shifting
+        one slot per deletion."""
+        by_index = {int(t["spec"].get("index", 0)): t for t in trials}
         recs = []
-        for t in trials:
+        for i in range(max(by_index, default=-1) + 1):
+            t = by_index.get(i)
+            if t is None:
+                recs.append(TrialRecord(parameters={}, failed=True))
+                continue
             phase = self._trial_phase(t)
             obs = t.get("status", {}).get("observation", {})
             objective = None

@@ -22,6 +22,12 @@ MAX_BODY_BYTES = 4 << 20  # reject absurd request bodies before parsing
 # (needed by e.g. the gatekeeper's cookie-based /verify)
 Handle = Callable[[str, str, Optional[Dict[str, Any]], str], Tuple[int, Any]]
 
+# authenticator(headers) -> verified username or None (reject). When one is
+# configured, the verified identity REPLACES the client-supplied user header
+# — otherwise any in-cluster pod can spoof an admin by setting the header
+# (kfam applies RoleBindings, bootstrap drives cluster-wide applies).
+Authenticator = Callable[[Dict[str, str]], Optional[str]]
+
 
 def _wants_headers(handle: Handle) -> bool:
     try:
@@ -32,7 +38,9 @@ def _wants_headers(handle: Handle) -> bool:
 
 def serve_json(handle: Handle, port: int, *,
                background: bool = False,
-               host: str = "0.0.0.0") -> Optional[ThreadingHTTPServer]:
+               host: str = "0.0.0.0",
+               authenticator: Optional[Authenticator] = None,
+               ) -> Optional[ThreadingHTTPServer]:
     pass_headers = _wants_headers(handle)
 
     class Handler(BaseHTTPRequestHandler):
@@ -49,6 +57,12 @@ def serve_json(handle: Handle, port: int, *,
                 except ValueError:
                     body = {}
                 user = self.headers.get(USER_HEADER, "")
+                if authenticator is not None:
+                    verified = authenticator(dict(self.headers))
+                    if verified is None:
+                        self._reply(401, {"log": "authentication required"})
+                        return
+                    user = verified
                 try:
                     if pass_headers:
                         code, payload = handle(method, self.path, body, user,
@@ -57,6 +71,9 @@ def serve_json(handle: Handle, port: int, *,
                         code, payload = handle(method, self.path, body, user)
                 except Exception as e:  # noqa: BLE001 — a server never dies
                     code, payload = 500, {"log": f"internal error: {e}"}
+            self._reply(code, payload)
+
+        def _reply(self, code: int, payload: Any) -> None:
             data = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")

@@ -156,8 +156,10 @@ class WorkflowController:
                         command=step.get("command"),
                         args=step.get("args"),
                         env=step.get("env"),
+                        volume_mounts=step.get("volumeMounts"),
                     )],
                     restart_policy="Never",
+                    volumes=step.get("volumes"),
                 ),
                 labels={WORKFLOW_LABEL: wf_name, STEP_LABEL: step["name"]},
             )

@@ -111,6 +111,16 @@ class CronSchedule:
                 return float(cand)
         raise ValueError("no cron match within horizon")
 
+    def prev_at_or_before(self, t: float,
+                          horizon_s: float = 366 * 86400) -> Optional[float]:
+        """Most recent matching minute at or before t, or None."""
+        start = (int(t) // 60) * 60
+        for m in range(int(horizon_s // 60)):
+            cand = start - m * 60
+            if self.matches(cand):
+                return float(cand)
+        return None
+
 
 def scheduled_workflow(name: str, ns: str, workflow_spec: Dict[str, Any], *,
                        cron: str = "", interval_seconds: float = 0,
@@ -193,12 +203,27 @@ class ScheduledWorkflowController:
         if not cron_expr:
             raise ValueError("need cron or intervalSeconds")
         sched = CronSchedule.parse(cron_expr)
-        # due when the current minute matches and we haven't already fired
-        # in this minute bucket (elapsed-seconds comparison would skip
-        # consecutive matching minutes after a mid-minute fire)
-        due = sched.matches(now) and int(now // 60) != int(last_run // 60)
         delay = max(sched.next_after(now) - now, 1.0)
-        return due, delay
+        if not last_run:
+            # never ran: fire only when the current minute matches (a fresh
+            # schedule shouldn't backfill matches from before it existed)
+            return sched.matches(now), delay
+        if sched.next_after(last_run) > now:
+            return False, max(sched.next_after(last_run) - now, 1.0)
+        # A match came due while the controller was down or the worker was
+        # busy past the matching minute (e.g. hourly '0 * * * *' reconciled
+        # at :01). Like CronJob's startingDeadlineSeconds, judge the MOST
+        # RECENT missed occurrence against the backfill window — an old
+        # out-of-window miss must not mask a fresh in-window one. The
+        # reference's ScheduledWorkflow controller does the same catch-up.
+        # floor of one minute so a live match (within its own minute bucket)
+        # always fires no matter how small the configured window
+        window = max(float(spec.get("catchUpWindowSeconds", 3600)), 60.0)
+        latest_missed = sched.prev_at_or_before(now)
+        if latest_missed is not None and latest_missed > last_run \
+                and now - latest_missed <= window:
+            return True, delay
+        return False, delay
 
     def _prune(self, ns: str, name: str, max_history: int) -> None:
         runs = self.client.list(

@@ -43,6 +43,8 @@ def container_step(
     env: Optional[Mapping[str, str]] = None,
     dependencies: Optional[List[str]] = None,
     retries: int = 0,
+    volumes: Optional[List[Dict[str, Any]]] = None,
+    volume_mounts: Optional[List[Dict[str, Any]]] = None,
 ) -> Dict[str, Any]:
     step: Dict[str, Any] = {
         "name": name,
@@ -58,6 +60,10 @@ def container_step(
         step["env"] = dict(env)
     if retries:
         step["retries"] = retries
+    if volumes:
+        step["volumes"] = [dict(v) for v in volumes]
+    if volume_mounts:
+        step["volumeMounts"] = [dict(m) for m in volume_mounts]
     return step
 
 

@@ -1,0 +1,359 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md)."""
+
+import calendar
+import json
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.k8s import FakeKubeClient
+from kubeflow_tpu.k8s import objects as o
+
+
+# -- 1. traffic split pins each backend to its own model version ------------
+
+
+def test_traffic_split_deployments_pin_version():
+    from kubeflow_tpu.config.deployment import DeploymentConfig
+    from kubeflow_tpu.manifests.components.serving import render
+
+    config = DeploymentConfig(name="d", namespace="kf")
+    objs = render(config, {
+        **__import__("kubeflow_tpu.manifests.components.serving",
+                     fromlist=["DEFAULTS"]).DEFAULTS,
+        "traffic_split": {"v1": 90, "v2": 10},
+    })
+    deploys = {obj["metadata"]["name"]: obj for obj in objs
+               if obj["kind"] == "Deployment"}
+    for version in ("v1", "v2"):
+        ctr = (deploys[f"model-server-{version}"]["spec"]["template"]["spec"]
+               ["containers"][0])
+        env = {e["name"]: e["value"] for e in ctr["env"]}
+        assert env["KFTPU_MODEL_VERSION"] == version
+
+
+def test_parse_pin_version():
+    from kubeflow_tpu.serving.server import parse_pin_version
+
+    assert parse_pin_version(None) is None
+    assert parse_pin_version("") is None
+    assert parse_pin_version("3") == 3
+    assert parse_pin_version("v7") == 7
+    with pytest.raises(ValueError):
+        parse_pin_version("latest")
+
+
+def test_pinned_repository_serves_pinned_not_latest(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.models import MnistCnn
+    from kubeflow_tpu.serving import ModelServer, export_model
+
+    model = MnistCnn()
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 28, 28, 1)))["params"]
+    export_model(str(tmp_path / "mnist"), "mnist", params, version=1)
+    zero = jax.tree_util.tree_map(jnp.zeros_like, params)
+    export_model(str(tmp_path / "mnist"), "mnist", zero, version=2)
+
+    pinned = ModelServer(str(tmp_path), port=0, pin_version=1)
+    assert pinned.repo.get("mnist").version == 1
+    latest = ModelServer(str(tmp_path), port=0)
+    assert latest.repo.get("mnist").version == 2
+    # pinned output matches the v1 params, not the zeroed v2 params
+    x = jnp.ones((1, 28, 28, 1))
+    np.testing.assert_allclose(
+        np.asarray(pinned.repo.get("mnist").predict(x)),
+        np.asarray(model.apply({"params": params}, x)), atol=1e-5)
+
+
+def test_pinned_repository_waits_for_absent_version(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import MnistCnn
+    from kubeflow_tpu.serving import ModelServer, export_model
+
+    model = MnistCnn()
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 28, 28, 1)))["params"]
+    export_model(str(tmp_path / "mnist"), "mnist", params, version=1)
+    server = ModelServer(str(tmp_path), port=0, pin_version=5)
+    assert server.repo.get("mnist") is None
+    export_model(str(tmp_path / "mnist"), "mnist", params, version=5)
+    server.repo.refresh()
+    assert server.repo.get("mnist").version == 5
+
+
+# -- 2. kubebench DAG rides a shared experiment PVC -------------------------
+
+
+def test_benchmark_workflow_mounts_experiment_pvc():
+    from kubeflow_tpu.bench.kubebench import benchmark_workflow
+
+    wf = benchmark_workflow(
+        "exp", "kf",
+        job_spec={"image": "img"},
+        post_job={"image": "post"},
+        experiment_pvc="exp-pvc",
+    )
+    steps = {s["name"]: s for s in wf["spec"]["steps"]}
+    job_spec = steps["launch-main-job"]["manifest"]["spec"]
+    assert job_spec["volumes"][0]["persistentVolumeClaim"]["claimName"] == \
+        "exp-pvc"
+    assert job_spec["volumeMounts"][0]["mountPath"] == "/results"
+    for step_name in ("run-post-job", "run-reporter"):
+        step = steps[step_name]
+        assert step["volumes"][0]["persistentVolumeClaim"]["claimName"] == \
+            "exp-pvc"
+        assert step["volumeMounts"][0]["mountPath"] == "/results"
+
+
+def test_tpujob_worker_pod_carries_volumes():
+    from kubeflow_tpu.operators.tpujob import build_worker_pod, tpujob
+    from kubeflow_tpu.scheduler.placement import SlicePlacement
+
+    job = tpujob("j", "kf", {
+        "image": "img",
+        "volumes": [{"name": "exp",
+                     "persistentVolumeClaim": {"claimName": "exp-pvc"}}],
+        "volumeMounts": [{"name": "exp", "mountPath": "/results"}],
+    })
+    pod = build_worker_pod(
+        job, 0, SlicePlacement(slice_index=0, host=0, topology="2x4",
+                               accelerator="tpu-v5-lite-podslice"))
+    spec = pod["spec"]
+    assert spec["volumes"][0]["persistentVolumeClaim"]["claimName"] == \
+        "exp-pvc"
+    assert spec["containers"][0]["volumeMounts"][0]["mountPath"] == "/results"
+
+
+def test_workflow_controller_renders_step_volumes(tmp_path):
+    from kubeflow_tpu.workflows.controller import WorkflowController
+    from kubeflow_tpu.workflows.workflow import container_step, workflow
+
+    client = FakeKubeClient()
+    ctrl = WorkflowController(client)
+    wf = workflow("w", "kf", [container_step(
+        "s", "img",
+        volumes=[{"name": "v", "emptyDir": {}}],
+        volume_mounts=[{"name": "v", "mountPath": "/data"}])])
+    client.create(wf)
+    ctrl.reconcile("kf", "w")
+    pods = client.list("v1", "Pod", "kf")
+    assert len(pods) == 1
+    spec = pods[0]["spec"]
+    assert spec["volumes"] == [{"name": "v", "emptyDir": {}}]
+    assert spec["containers"][0]["volumeMounts"] == [
+        {"name": "v", "mountPath": "/data"}]
+
+
+# -- 3. header-trusting services sit behind cookie auth / NetworkPolicy -----
+
+
+def _request(url, method="GET", headers=None):
+    req = urllib.request.Request(url, method=method,
+                                 headers=dict(headers or {}))
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_serve_json_authenticator_rejects_and_overrides_header():
+    from kubeflow_tpu.auth.gatekeeper import AuthServer, cookie_authenticator
+    from kubeflow_tpu.utils.jsonhttp import serve_json
+
+    secret = b"test-secret"
+    issuer = AuthServer({}, secret)
+    seen = {}
+
+    def handle(method, path, body, user):
+        seen["user"] = user
+        return 200, {"user": user}
+
+    srv = serve_json(handle, 0, background=True, host="127.0.0.1",
+                     authenticator=cookie_authenticator(secret))
+    try:
+        port = srv.server_address[1]
+        base = f"http://127.0.0.1:{port}"
+        # no cookie → 401 even with a spoofed identity header
+        code, _ = _request(base + "/x",
+                           headers={"X-Kubeflow-Userid": "admin"})
+        assert code == 401
+        assert "user" not in seen
+        # valid cookie → the cookie's identity wins over the spoofed header
+        cookie = issuer.issue_cookie("alice")
+        code, payload = _request(
+            base + "/x",
+            headers={"X-Kubeflow-Userid": "admin",
+                     "Cookie": f"kftpu-auth={cookie}"})
+        assert code == 200
+        assert payload["user"] == "alice"
+    finally:
+        srv.shutdown()
+
+
+def test_authenticator_from_env(monkeypatch):
+    from kubeflow_tpu.auth.gatekeeper import authenticator_from_env
+
+    monkeypatch.delenv("KFTPU_AUTH_SECRET", raising=False)
+    assert authenticator_from_env() is None
+    monkeypatch.setenv("KFTPU_AUTH_SECRET", "s3cret")
+    auth = authenticator_from_env()
+    assert auth is not None
+    assert auth({}) is None  # no cookie → reject
+
+
+def test_web_components_render_network_policies():
+    from kubeflow_tpu.config.deployment import ComponentSpec, DeploymentConfig
+    from kubeflow_tpu.manifests import components  # noqa: F401 — registers
+    from kubeflow_tpu.manifests.registry import render_component
+
+    config = DeploymentConfig(name="d", namespace="kf")
+    for component, app in (("dashboard", "centraldashboard"),
+                           ("notebooks", "notebook-webapp"),
+                           ("tenancy", "kfam")):
+        objs = render_component(config, ComponentSpec(name=component))
+        nps = [obj for obj in objs if obj["kind"] == "NetworkPolicy"]
+        assert nps, f"{component} renders no NetworkPolicy"
+        np_obj = nps[0]
+        assert np_obj["spec"]["podSelector"]["matchLabels"]["app"] == app
+        peers = np_obj["spec"]["ingress"][0]["from"]
+        assert {"podSelector": {"matchLabels":
+                                {"app": "kftpu-ingressgateway"}}} in peers
+
+
+# -- 4. cron catch-up of missed runs ----------------------------------------
+
+
+def test_cron_catches_up_missed_run():
+    from kubeflow_tpu.workflows.cron import ScheduledWorkflowController
+    from kubeflow_tpu.workflows.cron import scheduled_workflow
+    from kubeflow_tpu.workflows.workflow import (
+        WORKFLOW_API_VERSION,
+        WORKFLOW_KIND,
+        container_step,
+    )
+
+    client = FakeKubeClient()
+    base = calendar.timegm((2026, 7, 29, 3, 0, 10, 0, 0, 0))  # 03:00:10
+    now = [float(base)]
+    ctrl = ScheduledWorkflowController(client, clock=lambda: now[0])
+    client.create(scheduled_workflow(
+        "hourly", "default",
+        {"steps": [container_step("s", "img")]},
+        cron="0 * * * *"))
+    ctrl.reconcile("default", "hourly")
+    assert len(client.list(WORKFLOW_API_VERSION, WORKFLOW_KIND,
+                           "default")) == 1
+    # the controller sleeps through 04:00 and reconciles at 04:01:30 —
+    # the missed run must fire (the old matches(now)-only rule skipped it)
+    now[0] = float(base + 3600 + 80)
+    ctrl.reconcile("default", "hourly")
+    assert len(client.list(WORKFLOW_API_VERSION, WORKFLOW_KIND,
+                           "default")) == 2
+
+
+def test_cron_skips_misses_beyond_backfill_window():
+    from kubeflow_tpu.workflows.cron import ScheduledWorkflowController
+    from kubeflow_tpu.workflows.cron import scheduled_workflow
+    from kubeflow_tpu.workflows.workflow import (
+        WORKFLOW_API_VERSION,
+        WORKFLOW_KIND,
+        container_step,
+    )
+
+    client = FakeKubeClient()
+    base = calendar.timegm((2026, 7, 29, 3, 0, 10, 0, 0, 0))
+    now = [float(base)]
+    ctrl = ScheduledWorkflowController(client, clock=lambda: now[0])
+    swf = scheduled_workflow(
+        "hourly", "default",
+        {"steps": [container_step("s", "img")]},
+        cron="0 * * * *")
+    swf["spec"]["catchUpWindowSeconds"] = 90
+    client.create(swf)
+    ctrl.reconcile("default", "hourly")
+    # down for 3 hours, reconciling at 06:05: the most recent miss (06:00)
+    # is older than the 90s window → skip, don't backfill
+    now[0] = float(base + 3 * 3600 + 290)
+    ctrl.reconcile("default", "hourly")
+    assert len(client.list(WORKFLOW_API_VERSION, WORKFLOW_KIND,
+                           "default")) == 1
+    # next live match still fires
+    now[0] = float(base + 4 * 3600 - 8)  # 07:00:02
+    ctrl.reconcile("default", "hourly")
+    assert len(client.list(WORKFLOW_API_VERSION, WORKFLOW_KIND,
+                           "default")) == 2
+
+
+def test_cron_recent_miss_fires_despite_old_misses():
+    # CronJob startingDeadlineSeconds parity: an out-of-window OLD miss must
+    # not mask a fresh in-window one
+    from kubeflow_tpu.workflows.cron import ScheduledWorkflowController
+    from kubeflow_tpu.workflows.cron import scheduled_workflow
+    from kubeflow_tpu.workflows.workflow import (
+        WORKFLOW_API_VERSION,
+        WORKFLOW_KIND,
+        container_step,
+    )
+
+    client = FakeKubeClient()
+    base = calendar.timegm((2026, 7, 29, 3, 0, 10, 0, 0, 0))
+    now = [float(base)]
+    ctrl = ScheduledWorkflowController(client, clock=lambda: now[0])
+    swf = scheduled_workflow(
+        "hourly", "default",
+        {"steps": [container_step("s", "img")]},
+        cron="0 * * * *")
+    swf["spec"]["catchUpWindowSeconds"] = 600
+    client.create(swf)
+    ctrl.reconcile("default", "hourly")
+    # down through 04:00 and 05:00, back at 06:02 — 06:00 is within the
+    # window and must fire even though 04:00/05:00 are beyond it
+    now[0] = float(base + 3 * 3600 + 110)
+    ctrl.reconcile("default", "hourly")
+    assert len(client.list(WORKFLOW_API_VERSION, WORKFLOW_KIND,
+                           "default")) == 2
+
+
+# -- 5. hyperband records stay slot-aligned after a trial deletion ----------
+
+
+def test_records_fill_deleted_trial_slots():
+    from kubeflow_tpu.tuning.controller import StudyController
+    from kubeflow_tpu.tuning.study import STUDY_API_VERSION, TRIAL_KIND
+    from kubeflow_tpu.tuning.study import StudySpec
+
+    client = FakeKubeClient()
+    ctrl = StudyController(client)
+    spec = StudySpec.from_dict({
+        "objective": {"metric": "acc", "type": "maximize"},
+        "parameters": [
+            {"name": "lr", "type": "double", "min": 0.001, "max": 0.1}],
+        "trialTemplate": {"image": "img"},
+    })
+
+    def trial_obj(index, acc=None):
+        t = {
+            "apiVersion": STUDY_API_VERSION,
+            "kind": TRIAL_KIND,
+            "metadata": {"name": f"s-t{index}", "namespace": "d"},
+            "spec": {"index": index, "parameters": {"lr": 0.01 * (index + 1)}},
+            "status": {},
+        }
+        if acc is not None:
+            t["status"] = {"phase": "Succeeded", "observation": {"acc": acc}}
+        return t
+
+    # trial 1 was rolled back (name collision) — a hole in the index space
+    trials = [trial_obj(0, acc=0.5), trial_obj(2, acc=0.9)]
+    recs = ctrl._records(spec, trials)
+    assert len(recs) == 3
+    assert recs[0].objective == 0.5
+    assert recs[1].failed and recs[1].objective is None  # placeholder
+    assert recs[2].objective == 0.9
