@@ -1,82 +1,45 @@
-"""Headline benchmark: ResNet-50 training throughput, images/sec/chip.
+"""Headline benchmark: ResNet-50 training throughput + MFU, plus the four
+other BASELINE.md configs.
 
 Mirrors the reference's kubebench + tf_cnn_benchmarks ResNet-50 headline
 workload (BASELINE.md config 2; reference harness
 ``/root/reference/kubeflow/kubebench/kubebench-job.libsonnet:250-396``).
-Runs the in-framework SPMD train step on whatever chips are attached and
-prints ONE JSON line.
-
-``vs_baseline`` compares against the reference era's GPU path: tf_cnn_benchmarks
-ResNet-50 on one V100 (fp32, batch 64, ~2019) ≈ 360 images/sec — the number
-the north star asks to match per-chip on TPU.
+Prints ONE JSON line: the headline metric stays
+``resnet50_train_images_per_sec_per_chip`` with ``vs_baseline`` against the
+reference era's GPU path (tf_cnn_benchmarks ResNet-50 on one V100, fp32,
+batch 64, ~2019 ≈ 360 images/sec — the north-star per-chip target), and the
+``extras`` key carries MFU plus the MNIST-smoke, BERT step-time, allreduce,
+and serving-latency configs (BASELINE.md configs 1, 3, 4, 5) so every
+baseline config emits numbers each round.
 """
 
 from __future__ import annotations
 
 import json
 import sys
-import time
 
 REFERENCE_GPU_IMAGES_PER_SEC = 360.0
-BATCH = 128
-WARMUP_STEPS = 3
-MEASURE_STEPS = 10
 
 
 def main() -> None:
-    import jax
-    import jax.numpy as jnp
+    from kubeflow_tpu.bench.suite import run_all
 
-    from kubeflow_tpu.models.resnet import resnet50
-    from kubeflow_tpu.parallel import MeshConfig, create_mesh
-    from kubeflow_tpu.train import (
-        TrainState,
-        create_sharded_state,
-        make_image_train_step,
-        make_optimizer,
-    )
-
-    n_chips = jax.device_count()
-    mesh = create_mesh(MeshConfig(dp=n_chips))
-    model = resnet50(num_classes=1000)
-    batch = BATCH * n_chips
-
-    rng = jax.random.key(0)
-    images = jax.random.normal(rng, (batch, 224, 224, 3), jnp.bfloat16)
-    labels = jnp.zeros((batch,), jnp.int32)
-    tx = make_optimizer(0.1, warmup_steps=10, decay_steps=1000)
-
-    def init_fn(rng):
-        variables = model.init(rng, images[:2], train=True)
-        return TrainState.create(
-            apply_fn=model.apply,
-            params=variables["params"],
-            batch_stats=variables["batch_stats"],
-            tx=tx,
-        )
-
-    state, _ = create_sharded_state(init_fn, rng, mesh)
-    step = make_image_train_step(mesh)
-
-    for _ in range(WARMUP_STEPS):
-        state, metrics = step(state, images, labels)
-    float(metrics["loss"])  # host transfer: block_until_ready alone does not
-    # guarantee completion on every PJRT transport (observed on axon)
-
-    t0 = time.perf_counter()
-    for _ in range(MEASURE_STEPS):
-        state, metrics = step(state, images, labels)
-    float(metrics["loss"])
-    dt = time.perf_counter() - t0
-
-    images_per_sec = MEASURE_STEPS * batch / dt
-    per_chip = images_per_sec / n_chips
-    print(json.dumps({
+    results = run_all()
+    headline = results.get("resnet50", {})
+    value = float(headline.get("images_per_sec_per_chip", 0.0))
+    line = {
         "metric": "resnet50_train_images_per_sec_per_chip",
-        "value": round(per_chip, 2),
+        "value": round(value, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": round(per_chip / REFERENCE_GPU_IMAGES_PER_SEC, 3),
-    }))
+        "vs_baseline": round(value / REFERENCE_GPU_IMAGES_PER_SEC, 3),
+    }
+    if "mfu" in headline:
+        line["mfu"] = headline["mfu"]
+        line["tflops_per_chip"] = headline["tflops_per_chip"]
+    line["extras"] = results
+    print(json.dumps(line))
+    if value <= 0:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,387 @@
+"""The five BASELINE.md benchmark configs, runnable on whatever chips exist.
+
+Reference counterpart: the kubebench pipeline drives ``tf_cnn_benchmarks``
+workloads and a csv reporter (``/root/reference/kubeflow/kubebench/
+kubebench-job.libsonnet:250-396``); the reference publishes no numbers
+(BASELINE.md), so each config here *measures* and reports:
+
+1. ``mnist``      — tf-cnn MNIST 1-worker parity: correctness smoke
+                    (loss must fall) + images/sec.
+2. ``resnet50``   — the headline: SPMD training throughput, images/sec/chip
+                    + achieved TFLOP/s + MFU.
+3. ``bert``       — DDP BERT-base parity: masked-LM step time + MFU.
+4. ``allreduce``  — MPI/NCCL ring-allreduce parity: XLA AllReduce bus GB/s.
+5. ``serving``    — tf-serving parity: REST predict p50/p99 latency + QPS.
+
+MFU accounting: FLOPs per step are analytic model FLOPs (the MFU
+convention — rematerialization or backend-specific lowering must not
+inflate the score), adjusted for the exact model variant under test; peak
+comes from the device kind (override: ``KFTPU_PEAK_TFLOPS``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+# bf16 peak TFLOP/s per chip by device kind (substring match, lowercase)
+_PEAK_TFLOPS = {
+    "v5 lite": 197.0,   # v5e
+    "v5litepod": 197.0,
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v4": 275.0,
+    "v6 lite": 918.0,   # v6e / Trillium
+    "v6e": 918.0,
+    "v3": 123.0,
+    "v2": 46.0,
+    "cpu": 0.0,         # MFU meaningless on host CPU
+}
+
+
+def peak_flops_per_chip() -> float:
+    """bf16 peak FLOP/s of one attached chip (0.0 = unknown/CPU)."""
+    import jax
+
+    override = os.environ.get("KFTPU_PEAK_TFLOPS")
+    if override:
+        return float(override) * 1e12
+    kind = jax.devices()[0].device_kind.lower()
+    for key, tflops in _PEAK_TFLOPS.items():
+        if key in kind:
+            return tflops * 1e12
+    return 0.0
+
+
+def resnet50_train_flops_per_image(stem: str) -> float:
+    """Analytic fwd+bwd FLOPs per 224² image (3 × forward).
+
+    The standard 7×7-stem ResNet-50 forward is ~4.11 GFLOP; the
+    space_to_depth stem replaces the 0.236 GFLOP stem conv with a
+    0.077 GFLOP 2×2 conv over folded pixels — the MFU constant must match
+    the model actually compiled or the score is inflated."""
+    fwd = 4.11e9 if stem == "conv" else 4.11e9 - 0.236e9 + 0.077e9
+    return 3.0 * fwd
+
+
+def _timed_steps(step: Callable, n_steps: int, warmup: int,
+                 sync: Callable[[], None]) -> float:
+    """Seconds per step, after warmup; ``sync`` forces device completion
+    (a host transfer — block_until_ready alone does not guarantee
+    completion on every PJRT transport; observed on axon)."""
+    for _ in range(warmup):
+        step()
+    sync()
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        step()
+    sync()
+    return (time.perf_counter() - t0) / n_steps
+
+
+def _mfu(flops_per_step: Optional[float], sec_per_step: float,
+         n_chips: int) -> Dict[str, float]:
+    peak = peak_flops_per_chip()
+    if not flops_per_step or not peak:
+        return {}
+    achieved = flops_per_step / sec_per_step
+    return {
+        "tflops_per_chip": round(achieved / n_chips / 1e12, 2),
+        "mfu": round(achieved / (peak * n_chips), 4),
+    }
+
+
+# -- config 1: MNIST smoke ---------------------------------------------------
+
+
+def bench_mnist(steps: int = 30, batch: int = 256) -> Dict[str, Any]:
+    """tf-cnn MNIST 1-worker parity: loss must fall while we time it."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from kubeflow_tpu.models import MnistCnn
+    from kubeflow_tpu.parallel import MeshConfig, create_mesh
+    from kubeflow_tpu.train import (
+        TrainState, create_sharded_state, make_image_train_step,
+    )
+
+    mesh = create_mesh(MeshConfig(dp=jax.device_count()))
+    model = MnistCnn()
+    rng = jax.random.key(0)
+    # synthetic-but-learnable task: label = quadrant of the brightest pixel
+    images = jax.random.uniform(rng, (batch, 28, 28, 1), jnp.float32)
+    flat = images.reshape(batch, -1).argmax(axis=1)
+    labels = ((flat // 28 // 14) * 2 + (flat % 28) // 14).astype(jnp.int32)
+
+    def init_fn(rng):
+        params = model.init(rng, images[:2])["params"]
+        return TrainState.create(
+            apply_fn=lambda v, x, train=True: model.apply(v, x),
+            params=params, tx=optax.adam(1e-3))
+
+    state, _ = create_sharded_state(init_fn, rng, mesh)
+    step = make_image_train_step(mesh)
+    state, first = step(state, images, labels)
+    first_loss = float(first["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, images, labels)
+    last_loss = float(metrics["loss"])
+    dt = time.perf_counter() - t0
+    return {
+        "images_per_sec": round(steps * batch / dt, 1),
+        "first_loss": round(first_loss, 4),
+        "last_loss": round(last_loss, 4),
+        "learned": last_loss < first_loss,
+    }
+
+
+# -- config 2: ResNet-50 training (the headline) -----------------------------
+
+
+def bench_resnet50(batch_per_chip: int = 256, steps: int = 20,
+                   warmup: int = 5) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from kubeflow_tpu.models.resnet import resnet50
+    from kubeflow_tpu.parallel import MeshConfig, create_mesh
+    from kubeflow_tpu.train import (
+        TrainState, create_sharded_state, make_image_train_step,
+    )
+
+    n_chips = jax.device_count()
+    mesh = create_mesh(MeshConfig(dp=n_chips))
+    model = resnet50(num_classes=1000)
+    stem = model.config.stem
+    batch = batch_per_chip * n_chips
+    rng = jax.random.key(0)
+    images = jax.random.normal(rng, (batch, 224, 224, 3), jnp.bfloat16)
+    labels = jax.random.randint(rng, (batch,), 0, 1000)
+    # the reference workload trains with momentum SGD
+    # (tf_cnn_benchmarks defaults); matching it also keeps the optimizer
+    # update bandwidth-light next to adamw's two moment buffers
+    tx = optax.sgd(0.1, momentum=0.9, nesterov=False)
+
+    def init_fn(rng):
+        variables = model.init(rng, images[:2], train=True)
+        return TrainState.create(
+            apply_fn=model.apply, params=variables["params"],
+            batch_stats=variables["batch_stats"], tx=tx)
+
+    state, _ = create_sharded_state(init_fn, rng, mesh)
+    step = make_image_train_step(mesh)
+
+    holder = {"state": state}
+
+    def one():
+        holder["state"], holder["m"] = step(holder["state"], images, labels)
+
+    sec = _timed_steps(one, steps, warmup,
+                       sync=lambda: float(holder["m"]["loss"]))
+    ips = batch / sec
+    return {
+        "images_per_sec_per_chip": round(ips / n_chips, 2),
+        "n_chips": n_chips,
+        "batch_per_chip": batch_per_chip,
+        "stem": stem,
+        **_mfu(resnet50_train_flops_per_image(stem) * batch, sec, n_chips),
+    }
+
+
+# -- config 3: BERT-base step time -------------------------------------------
+
+
+def bench_bert(batch_per_chip: int = 16, seq_len: int = 512,
+               steps: int = 10, warmup: int = 3) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.bert import Bert, bert_base
+    from kubeflow_tpu.parallel import MeshConfig, create_mesh
+    from kubeflow_tpu.train import (
+        TrainState, create_sharded_state, make_mlm_train_step, make_optimizer,
+    )
+
+    n_chips = jax.device_count()
+    mesh = create_mesh(MeshConfig(dp=n_chips))
+    cfg = bert_base()
+    model = Bert(cfg)
+    batch = batch_per_chip * n_chips
+    rng = jax.random.key(0)
+    tokens = jax.random.randint(rng, (batch, seq_len), 0, cfg.vocab_size)
+    labels = jax.random.randint(jax.random.key(1), (batch, seq_len), 0,
+                                cfg.vocab_size)
+    weights = (jax.random.uniform(jax.random.key(2), (batch, seq_len))
+               < 0.15).astype(jnp.float32)
+    tx = make_optimizer(1e-4, warmup_steps=10, decay_steps=1000)
+
+    def init_fn(rng):
+        params = model.init(rng, tokens[:2])["params"]
+        return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+
+    state, _ = create_sharded_state(init_fn, rng, mesh)
+    step = make_mlm_train_step(mesh)
+
+    holder = {"state": state}
+
+    def one():
+        holder["state"], holder["m"] = step(holder["state"], tokens, labels,
+                                            weights)
+
+    sec = _timed_steps(one, steps, warmup,
+                       sync=lambda: float(holder["m"]["loss"]))
+    # analytic transformer train FLOPs: 6·N·D (N params, D tokens) plus the
+    # attention score/value matmuls, 12·L·S²·d per token fwd+bwd
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(state.params))
+    flops_per_step = (6 * n_params * batch * seq_len
+                      + 12 * cfg.n_layers * batch * seq_len * seq_len
+                      * cfg.d_model)
+    return {
+        "step_time_ms": round(sec * 1e3, 2),
+        "tokens_per_sec_per_chip": round(batch * seq_len / sec / n_chips, 1),
+        "n_chips": n_chips,
+        "batch_per_chip": batch_per_chip,
+        "seq_len": seq_len,
+        **_mfu(flops_per_step, sec, n_chips),
+    }
+
+
+# -- config 4: allreduce microbench ------------------------------------------
+
+
+def bench_allreduce(size_mb: float = 64.0, iters: int = 10) -> Dict[str, Any]:
+    import jax
+
+    from kubeflow_tpu.ops.collectives import bench_collective
+    from kubeflow_tpu.parallel import MeshConfig, create_mesh
+
+    n = jax.device_count()
+    if n < 2:
+        # a 1-chip allreduce is the identity; report the honest non-result
+        # (the scaling curve needs a multi-chip slice — see
+        # tests/test_distributed.py for the virtual-mesh tier)
+        return {"n_chips": n, "skipped": "needs >=2 chips"}
+    mesh = create_mesh(MeshConfig(dp=n))
+    res = bench_collective("all_reduce", mesh, "dp", size_mb=size_mb,
+                           iters=iters)
+    return {
+        "bus_gb_per_sec": round(res.bus_gb_s, 2),
+        "payload_mb": round(res.size_mb, 1),
+        "mean_ms": round(res.mean_s * 1e3, 3),
+        "n_chips": n,
+    }
+
+
+# -- config 5: serving latency/QPS -------------------------------------------
+
+
+def bench_serving(requests: int = 200, batch: int = 8,
+                  image_size: int = 224) -> Dict[str, Any]:
+    """REST predict p50/p99 + QPS through the real ModelServer HTTP path."""
+    import tempfile
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.resnet import ResNet, ResNetConfig
+    from kubeflow_tpu.serving import ModelServer, export_model
+
+    # serving-size ResNet-50; fp32 params exported, bf16 compute
+    cfg = ResNetConfig(stage_sizes=(3, 4, 6, 3), num_classes=1000)
+    model = ResNet(cfg)
+    rng = jax.random.key(0)
+    x0 = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
+    variables = model.init(rng, x0, train=False)
+
+    with tempfile.TemporaryDirectory() as d:
+        export_model(
+            os.path.join(d, "resnet"), "resnet",
+            {"params": variables["params"],
+             "batch_stats": variables["batch_stats"]},
+            version=1,
+            config={"stage_sizes": list(cfg.stage_sizes),
+                    "num_classes": cfg.num_classes,
+                    "stem": cfg.stem},
+            input_shape=(image_size, image_size, 3))
+        server = ModelServer(d, port=0, max_batch_size=batch,
+                             poll_interval_s=3600)
+        port = server.start()
+        url = f"http://127.0.0.1:{port}/v1/models/resnet:predict"
+        payload = json.dumps({
+            "instances": np.random.rand(
+                batch, image_size, image_size, 3).astype(np.float32).tolist()
+        }).encode()
+
+        def predict():
+            req = urllib.request.Request(
+                url, data=payload,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                json.loads(resp.read())
+
+        predict()  # compile
+        lat = []
+        t0 = time.perf_counter()
+        for _ in range(requests):
+            t = time.perf_counter()
+            predict()
+            lat.append(time.perf_counter() - t)
+        wall = time.perf_counter() - t0
+        server.stop()
+
+    lat_ms = np.array(lat) * 1e3
+    n_chips = jax.device_count()
+    return {
+        "p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
+        "p99_ms": round(float(np.percentile(lat_ms, 99)), 2),
+        "qps_per_chip": round(requests * batch / wall / n_chips, 1),
+        "batch": batch,
+        "n_chips": n_chips,
+    }
+
+
+# -- runner ------------------------------------------------------------------
+
+CONFIGS: Dict[str, Callable[[], Dict[str, Any]]] = {
+    "mnist": bench_mnist,
+    "resnet50": bench_resnet50,
+    "bert": bench_bert,
+    "allreduce": bench_allreduce,
+    "serving": bench_serving,
+}
+
+
+def run_all(only: Optional[list] = None) -> Dict[str, Dict[str, Any]]:
+    """Run every config; one failing config must not kill the rest."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for name, fn in CONFIGS.items():
+        if only and name not in only:
+            continue
+        try:
+            out[name] = fn()
+        except Exception as e:  # noqa: BLE001
+            out[name] = {"error": f"{type(e).__name__}: {e}"}
+    return out
+
+
+def main() -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(description="BASELINE.md bench suite")
+    p.add_argument("configs", nargs="*", choices=[*CONFIGS, []],
+                   help="subset to run (default: all)")
+    args = p.parse_args()
+    print(json.dumps(run_all(args.configs or None)))
+
+
+if __name__ == "__main__":
+    main()

@@ -204,7 +204,8 @@ def main() -> None:
         app_root=os.environ.get("KFTPU_APP_ROOT", "/tmp/kftpu"))
     serve_json(server.handle,
                int(os.environ.get("KFTPU_BOOTSTRAP_PORT", "8086")),
-               authenticator=authenticator_from_env())
+               authenticator=authenticator_from_env(),
+               static_dir=os.path.join(os.path.dirname(__file__), "static"))
 
 
 if __name__ == "__main__":

@@ -135,7 +135,11 @@ class DashboardApi:
     def dashboard_links(self) -> List[Dict[str, str]]:
         """The iframe cards the UI shell embeds (iframe-link.js parity)."""
         return [
-            {"text": "Notebooks", "link": "/notebooks/", "icon": "book"},
+            # /jupyter/ is the gateway's prefix-stripped route to the
+            # notebook web app (reference mounts jupyter-web-app the same
+            # way); the other links are iframe placeholders until their
+            # routes land
+            {"text": "Notebooks", "link": "/jupyter/", "icon": "book"},
             {"text": "TPU Jobs", "link": "/tpujobs/", "icon": "donut-large"},
             {"text": "Studies (HP tuning)", "link": "/tuning/",
              "icon": "tune"},
@@ -158,7 +162,8 @@ def main() -> None:
     api = DashboardApi(HttpKubeClient())
     serve_json(api.handle,
                int(os.environ.get("KFTPU_DASHBOARD_PORT", "8082")),
-               authenticator=authenticator_from_env())
+               authenticator=authenticator_from_env(),
+               static_dir=os.path.join(os.path.dirname(__file__), "static"))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,113 @@
+// Central dashboard frontend. Plain fetch() against the DashboardApi
+// routes (kubeflow_tpu/dashboard/server.py); no framework.
+
+"use strict";
+
+const $ = (id) => document.getElementById(id);
+
+function showError(msg) {
+  const el = $("error");
+  el.textContent = msg;
+  el.style.display = "block";
+}
+
+async function api(path) {
+  const resp = await fetch(path, { credentials: "same-origin" });
+  if (resp.status === 401) {
+    // gatekeeper cookie missing/expired → login page
+    window.location.href = "/login.html?next=" +
+      encodeURIComponent(window.location.pathname);
+    throw new Error("unauthenticated");
+  }
+  if (!resp.ok) throw new Error(path + " → HTTP " + resp.status);
+  return resp.json();
+}
+
+function esc(s) {
+  const d = document.createElement("div");
+  d.textContent = String(s == null ? "" : s);
+  return d.innerHTML;
+}
+
+// icon names come from /api/dashboard-links (material names in the
+// reference); map to simple glyphs
+const ICONS = {
+  book: "\u{1F4D3}", "donut-large": "\u{25D4}", tune: "\u{1F39B}",
+  "device-hub": "\u{2B21}", "cloud-upload": "\u{2601}", people: "\u{1F465}",
+};
+
+async function loadCards() {
+  const links = await api("/api/dashboard-links");
+  $("cards").innerHTML = links.map((l) => `
+    <a class="card" href="${esc(l.link)}">
+      <div class="icon">${ICONS[l.icon] || "\u{25A4}"}</div>
+      <h3>${esc(l.text)}</h3>
+      <p>${esc(l.link)}</p>
+    </a>`).join("");
+}
+
+async function loadEnv() {
+  const env = await api("/api/env-info");
+  $("user-chip").textContent =
+    `${env.user} · ${env.platform.kind} ${env.platform.version}` +
+    (env.isClusterAdmin ? " · admin" : "");
+  const sel = $("ns-select");
+  sel.innerHTML = env.namespaces
+    .map((n) => `<option value="${esc(n)}">${esc(n)}</option>`).join("");
+  const saved = localStorage.getItem("kftpu-ns");
+  if (saved && env.namespaces.includes(saved)) sel.value = saved;
+  return sel.value;
+}
+
+async function loadActivities(ns) {
+  $("activity-ns").textContent = ns || "—";
+  if (!ns) { $("activities").innerHTML = ""; return; }
+  const acts = await api("/api/activities/" + encodeURIComponent(ns));
+  $("activities").innerHTML = acts.length
+    ? acts.slice(0, 30).map((a) => `
+      <tr>
+        <td>${esc(a.time)}</td>
+        <td><span class="pill ${esc(a.type)}">${esc(a.type)}</span></td>
+        <td>${esc(a.reason)}</td>
+        <td>${esc(a.object)}</td>
+        <td>${esc(a.message)}</td>
+      </tr>`).join("")
+    : `<tr><td colspan="5">no recent events in ${esc(ns)}</td></tr>`;
+}
+
+async function loadMetrics() {
+  const metrics = await api("/api/metrics/cluster");
+  $("metrics").innerHTML = metrics.length
+    ? metrics.slice(0, 40).map((m) => `
+      <tr><td>${esc(m.metric)}</td><td>${esc(m.value)}</td></tr>`).join("")
+    : "<tr><td colspan=2>no metrics reported yet</td></tr>";
+}
+
+async function loadWorkgroup() {
+  const wg = await api("/api/workgroup/exists");
+  if (wg.hasWorkgroup) {
+    $("workgroup-panel").style.display = "";
+    $("workgroup-info").textContent =
+      "Your workgroups: " + wg.workgroups.join(", ");
+  }
+}
+
+async function main() {
+  try {
+    await loadCards();
+    const ns = await loadEnv();
+    await Promise.all([loadActivities(ns), loadMetrics(), loadWorkgroup()]);
+    $("ns-select").addEventListener("change", (e) => {
+      localStorage.setItem("kftpu-ns", e.target.value);
+      loadActivities(e.target.value).catch((err) => showError(err.message));
+    });
+    setInterval(() => {
+      loadActivities($("ns-select").value).catch(() => {});
+      loadMetrics().catch(() => {});
+    }, 15000);
+  } catch (err) {
+    if (err.message !== "unauthenticated") showError(err.message);
+  }
+}
+
+main();

@@ -1,0 +1,148 @@
+// Notebook manager frontend over the NotebookWebApp REST routes
+// (kubeflow_tpu/notebooks/webapp.py). Relative API paths: works at /
+// (port-forward) and at /jupyter/ (gateway prefix-strip) unchanged.
+
+"use strict";
+
+const $ = (id) => document.getElementById(id);
+
+function showError(msg) {
+  const el = $("error");
+  el.textContent = msg;
+  el.style.display = "block";
+  setTimeout(() => { el.style.display = "none"; }, 8000);
+}
+
+function esc(s) {
+  const d = document.createElement("div");
+  d.textContent = String(s == null ? "" : s);
+  return d.innerHTML;
+}
+
+async function api(path, opts) {
+  const resp = await fetch(path, {
+    credentials: "same-origin",
+    headers: { "Content-Type": "application/json" },
+    ...opts,
+  });
+  if (resp.status === 401) {
+    window.location.href = "/login.html?next=" +
+      encodeURIComponent(window.location.pathname);
+    throw new Error("unauthenticated");
+  }
+  const body = await resp.json().catch(() => ({}));
+  if (!resp.ok || body.success === false) {
+    throw new Error(body.log || path + " → HTTP " + resp.status);
+  }
+  return body;
+}
+
+const ns = () => encodeURIComponent($("ns-select").value);
+
+async function loadNamespaces() {
+  const body = await api("api/namespaces");
+  const sel = $("ns-select");
+  sel.innerHTML = body.namespaces
+    .map((n) => `<option value="${esc(n)}">${esc(n)}</option>`).join("");
+  const saved = localStorage.getItem("kftpu-ns");
+  if (saved && body.namespaces.includes(saved)) sel.value = saved;
+}
+
+function statusOf(nb) {
+  if (nb.stopped) return "Stopped";
+  return nb.phase || "Waiting";
+}
+
+async function loadNotebooks() {
+  const body = await api(`api/namespaces/${ns()}/notebooks`);
+  $("notebooks").innerHTML = body.notebooks.length
+    ? body.notebooks.map((nb) => `
+      <tr>
+        <td><a href="/${esc(nb.namespace)}/${esc(nb.name)}/">${esc(nb.name)}</a></td>
+        <td>${esc(nb.image)}</td>
+        <td>${esc(nb.tpuChips)}</td>
+        <td><span class="pill ${esc(statusOf(nb))}">${esc(statusOf(nb))}</span></td>
+        <td>
+          ${nb.stopped
+            ? `<button data-act="start" data-name="${esc(nb.name)}">Start</button>`
+            : `<button class="secondary" data-act="stop" data-name="${esc(nb.name)}">Stop</button>`}
+          <button class="danger" data-act="delete" data-name="${esc(nb.name)}">Delete</button>
+        </td>
+      </tr>`).join("")
+    : "<tr><td colspan=5>no notebooks in this namespace</td></tr>";
+}
+
+async function loadPvcs() {
+  const body = await api(`api/namespaces/${ns()}/pvcs`);
+  $("pvcs").innerHTML = body.pvcs.length
+    ? body.pvcs.map((p) => `
+      <tr><td>${esc(p.name)}</td><td>${esc(p.size)}</td>
+          <td>${esc(p.mode)}</td></tr>`).join("")
+    : "<tr><td colspan=3>no volumes</td></tr>";
+  $("nb-pvc").innerHTML = '<option value="">none</option>' +
+    body.pvcs.map((p) =>
+      `<option value="${esc(p.name)}">${esc(p.name)}</option>`).join("");
+}
+
+function refresh() {
+  Promise.all([loadNotebooks(), loadPvcs()])
+    .catch((e) => { if (e.message !== "unauthenticated") showError(e.message); });
+}
+
+$("create-form").addEventListener("submit", async (e) => {
+  e.preventDefault();
+  const spec = {
+    image: $("nb-image").value,
+    tpuChips: Number($("nb-tpus").value),
+  };
+  if ($("nb-pvc").value) spec.workspaceVolume = $("nb-pvc").value;
+  try {
+    await api(`api/namespaces/${ns()}/notebooks`, {
+      method: "POST",
+      body: JSON.stringify({ name: $("nb-name").value, spec }),
+    });
+    $("nb-name").value = "";
+    refresh();
+  } catch (err) { showError(err.message); }
+});
+
+$("pvc-form").addEventListener("submit", async (e) => {
+  e.preventDefault();
+  try {
+    await api(`api/namespaces/${ns()}/pvcs`, {
+      method: "POST",
+      body: JSON.stringify({
+        name: $("pvc-name").value,
+        size: $("pvc-size").value + "Gi",
+      }),
+    });
+    $("pvc-name").value = "";
+    refresh();
+  } catch (err) { showError(err.message); }
+});
+
+$("notebooks").addEventListener("click", async (e) => {
+  const btn = e.target.closest("button[data-act]");
+  if (!btn) return;
+  const name = encodeURIComponent(btn.dataset.name);
+  try {
+    if (btn.dataset.act === "delete") {
+      if (!window.confirm(`Delete notebook ${btn.dataset.name}?`)) return;
+      await api(`api/namespaces/${ns()}/notebooks/${name}`,
+                { method: "DELETE" });
+    } else {
+      await api(`api/namespaces/${ns()}/notebooks/${name}/${btn.dataset.act}`,
+                { method: "POST" });
+    }
+    refresh();
+  } catch (err) { showError(err.message); }
+});
+
+$("ns-select").addEventListener("change", () => {
+  localStorage.setItem("kftpu-ns", $("ns-select").value);
+  refresh();
+});
+
+loadNamespaces().then(refresh)
+  .catch((e) => { if (e.message !== "unauthenticated") showError(e.message); });
+setInterval(refresh, 15000);

@@ -189,9 +189,13 @@ class NotebookWebApp:
 
 
 def serve(app: NotebookWebApp, port: int = 5000, background: bool = False,
-          authenticator=None):
+          authenticator=None, with_ui: bool = True):
+    import os
+
+    static = (os.path.join(os.path.dirname(__file__), "static")
+              if with_ui else None)
     return serve_json(app.handle, port, background=background,
-                      authenticator=authenticator)
+                      authenticator=authenticator, static_dir=static)
 
 
 def main() -> None:

@@ -1,0 +1,181 @@
+"""gRPC predict service sharing the REST server's model repository.
+
+TF-Serving parity: the reference model server's primary surface is gRPC
+:9000 with REST :8500 secondary (``/root/reference/kubeflow/tf-serving/
+tf-serving-template.libsonnet:33-48``); its clients speak gRPC through the
+http-proxy JSON bridge (``components/k8s-model-server/http-proxy/
+server.py:29-35``). Service stubs are hand-wired generic method handlers
+(no grpc_tools dependency); messages come from ``predict.proto`` →
+``predict_pb2.py``.
+"""
+
+from __future__ import annotations
+
+import logging
+from concurrent import futures
+from typing import Optional, Tuple
+
+import grpc
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.serving import predict_pb2 as pb
+from kubeflow_tpu.serving.server import ModelRepository, _pad_batch
+from kubeflow_tpu.utils import DEFAULT_REGISTRY
+
+log = logging.getLogger(__name__)
+
+SERVICE_NAME = "kubeflow_tpu.serving.PredictionService"
+
+_grpc_requests = DEFAULT_REGISTRY.counter(
+    "kftpu_serving_grpc_requests_total", "gRPC predict requests")
+
+# numpy has no bfloat16; ml_dtypes (a jax dep) provides the wire dtype
+try:
+    import ml_dtypes
+
+    _BFLOAT16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    _BFLOAT16 = None
+
+
+def _np_dtype(name: str) -> np.dtype:
+    if name == "bfloat16":
+        if _BFLOAT16 is None:
+            raise ValueError("bfloat16 wire dtype needs ml_dtypes")
+        return _BFLOAT16
+    return np.dtype(name)
+
+
+def tensor_to_array(t: pb.Tensor) -> np.ndarray:
+    dtype = _np_dtype(t.dtype or "float32")
+    arr = np.frombuffer(t.data, dtype=dtype)
+    shape = tuple(t.shape)
+    if int(np.prod(shape, dtype=np.int64)) != arr.size:
+        raise ValueError(f"shape {shape} does not match {arr.size} elements")
+    return arr.reshape(shape)
+
+
+def array_to_tensor(arr: np.ndarray) -> pb.Tensor:
+    arr = np.ascontiguousarray(arr)
+    return pb.Tensor(shape=list(arr.shape), dtype=arr.dtype.name,
+                     data=arr.tobytes())
+
+
+class PredictionServicer:
+    """Unary handlers over the shared ModelRepository."""
+
+    def __init__(self, repo: ModelRepository, *, max_batch_size: int = 8) -> None:
+        self.repo = repo
+        self.max_batch_size = max_batch_size
+
+    # -- RPCs --------------------------------------------------------------
+
+    def Predict(self, request: pb.PredictRequest,
+                context: grpc.ServicerContext) -> pb.PredictResponse:
+        model = self.repo.get(request.model_name,
+                              request.version or None)
+        if model is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"model {request.model_name!r} not found")
+        try:
+            arr = tensor_to_array(request.inputs)
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        if arr.ndim == 0 or arr.shape[0] > self.max_batch_size:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"batch must be in [1, {self.max_batch_size}]")
+        padded, n = _pad_batch(arr, self.max_batch_size)
+        try:
+            out = np.asarray(model.predict(jnp.asarray(padded)))[:n]
+        except Exception as e:  # noqa: BLE001 — surface as INVALID_ARGUMENT
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"predict failed: {type(e).__name__}: {e}")
+        _grpc_requests.inc(model=request.model_name)
+        return pb.PredictResponse(outputs=array_to_tensor(out),
+                                  model_version=model.version)
+
+    def GetModelStatus(self, request: pb.ModelStatusRequest,
+                       context: grpc.ServicerContext) -> pb.ModelStatusResponse:
+        status = self.repo.status(request.model_name)
+        if status is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"model {request.model_name!r} not found")
+        return pb.ModelStatusResponse(model_version_status=[
+            pb.ModelVersionStatus(version=int(s["version"]), state=s["state"])
+            for s in status["model_version_status"]
+        ])
+
+    def ListModels(self, request: pb.ListModelsRequest,
+                   context: grpc.ServicerContext) -> pb.ListModelsResponse:
+        return pb.ListModelsResponse(models=self.repo.model_names())
+
+
+def _handlers(servicer: PredictionServicer) -> grpc.GenericRpcHandler:
+    method_handlers = {
+        "Predict": grpc.unary_unary_rpc_method_handler(
+            servicer.Predict,
+            request_deserializer=pb.PredictRequest.FromString,
+            response_serializer=pb.PredictResponse.SerializeToString),
+        "GetModelStatus": grpc.unary_unary_rpc_method_handler(
+            servicer.GetModelStatus,
+            request_deserializer=pb.ModelStatusRequest.FromString,
+            response_serializer=pb.ModelStatusResponse.SerializeToString),
+        "ListModels": grpc.unary_unary_rpc_method_handler(
+            servicer.ListModels,
+            request_deserializer=pb.ListModelsRequest.FromString,
+            response_serializer=pb.ListModelsResponse.SerializeToString),
+    }
+    return grpc.method_handlers_generic_handler(SERVICE_NAME, method_handlers)
+
+
+def serve_grpc(repo: ModelRepository, port: int = 9000, *,
+               max_batch_size: int = 8,
+               max_workers: int = 8) -> Tuple[grpc.Server, int]:
+    """Start the gRPC server on a daemon thread pool; returns (server, port)."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers(
+        (_handlers(PredictionServicer(repo, max_batch_size=max_batch_size)),))
+    bound = server.add_insecure_port(f"0.0.0.0:{port}")
+    server.start()
+    log.info("gRPC prediction service on :%d", bound)
+    return server, bound
+
+
+class PredictClient:
+    """Thin typed client over a grpc channel (no generated stubs needed)."""
+
+    def __init__(self, target: str) -> None:
+        self.channel = grpc.insecure_channel(target)
+        base = f"/{SERVICE_NAME}/"
+        self._predict = self.channel.unary_unary(
+            base + "Predict",
+            request_serializer=pb.PredictRequest.SerializeToString,
+            response_deserializer=pb.PredictResponse.FromString)
+        self._status = self.channel.unary_unary(
+            base + "GetModelStatus",
+            request_serializer=pb.ModelStatusRequest.SerializeToString,
+            response_deserializer=pb.ModelStatusResponse.FromString)
+        self._list = self.channel.unary_unary(
+            base + "ListModels",
+            request_serializer=pb.ListModelsRequest.SerializeToString,
+            response_deserializer=pb.ListModelsResponse.FromString)
+
+    def predict(self, model_name: str, inputs: np.ndarray,
+                version: Optional[int] = None,
+                timeout: float = 120.0) -> Tuple[np.ndarray, int]:
+        resp = self._predict(pb.PredictRequest(
+            model_name=model_name, version=version or 0,
+            inputs=array_to_tensor(np.asarray(inputs))), timeout=timeout)
+        return tensor_to_array(resp.outputs), resp.model_version
+
+    def model_status(self, model_name: str, timeout: float = 30.0):
+        resp = self._status(pb.ModelStatusRequest(model_name=model_name),
+                            timeout=timeout)
+        return [(s.version, s.state) for s in resp.model_version_status]
+
+    def list_models(self, timeout: float = 30.0):
+        return list(self._list(pb.ListModelsRequest(), timeout=timeout).models)
+
+    def close(self) -> None:
+        self.channel.close()

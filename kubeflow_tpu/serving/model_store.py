@@ -54,7 +54,13 @@ def build_model(kind: str, config: Dict[str, Any]):
     if kind == "resnet":
         from kubeflow_tpu.models.resnet import ResNet, ResNetConfig
 
-        cfg = ResNetConfig(**{**config, "stage_sizes":
+        # stem defaults to "conv" HERE (not ResNetConfig's default): models
+        # exported before the space_to_depth stem existed have "stem_conv"
+        # params, and the stem choice decides the param tree — a saved model
+        # must deserialize against the architecture it was trained with
+        cfg = ResNetConfig(**{**config,
+                              "stem": config.get("stem", "conv"),
+                              "stage_sizes":
                               tuple(config.get("stage_sizes", (3, 4, 6, 3)))})
         return ResNet(cfg), lambda m, p, x: m.apply(
             {"params": p["params"], "batch_stats": p["batch_stats"]},
@@ -79,14 +85,34 @@ def export_model(
     *,
     config: Optional[Dict[str, Any]] = None,
     version: int = 1,
+    input_shape: Optional[Tuple[int, ...]] = None,
+    input_dtype: str = "float32",
 ) -> str:
-    """Write ``<path>/<version>/{model.yaml,params.npz}``; returns the dir."""
+    """Write ``<path>/<version>/{model.yaml,params.npz}``; returns the dir.
+
+    ``input_shape`` (without the batch dim) lets the server warm up every
+    padded batch bucket at load time, so no client request ever pays the
+    XLA compile (tf-serving's warmup-assets role; SURVEY §7 hard part (d)).
+    """
     vdir = os.path.join(path, str(version))
     os.makedirs(vdir, exist_ok=True)
+    meta: Dict[str, Any] = {"kind": kind, "config": config or {}}
+    if input_shape is None:
+        input_shape = _DEFAULT_INPUT_SHAPES.get(kind)
+    if input_shape is not None:
+        meta["input_shape"] = [int(d) for d in input_shape]
+        meta["input_dtype"] = input_dtype
     with open(os.path.join(vdir, MODEL_FILE), "w") as f:
-        yaml.safe_dump({"kind": kind, "config": config or {}}, f)
+        yaml.safe_dump(meta, f)
     np.savez(os.path.join(vdir, PARAMS_FILE), **_flatten(params))
     return vdir
+
+
+# per-sample input shapes for warmup when the exporter doesn't say
+_DEFAULT_INPUT_SHAPES: Dict[str, Tuple[int, ...]] = {
+    "mnist": (28, 28, 1),
+    "resnet": (224, 224, 3),
+}
 
 
 @dataclasses.dataclass
@@ -94,6 +120,20 @@ class LoadedModel:
     kind: str
     version: int
     predict: Callable[[jnp.ndarray], jnp.ndarray]  # jitted, closed over params
+    input_shape: Optional[Tuple[int, ...]] = None  # per-sample, for warmup
+    input_dtype: str = "float32"
+
+    def warmup(self, batch_sizes) -> int:
+        """Precompile predict for each batch bucket; returns count warmed."""
+        if self.input_shape is None:
+            return 0
+        warmed = 0
+        for b in batch_sizes:
+            x = jnp.zeros((int(b), *self.input_shape),
+                          jnp.dtype(self.input_dtype))
+            jax.block_until_ready(self.predict(x))
+            warmed += 1
+        return warmed
 
 
 def list_versions(base_path: str) -> List[int]:
@@ -118,7 +158,11 @@ def load_version(base_path: str, version: int) -> LoadedModel:
     def predict(x: jnp.ndarray) -> jnp.ndarray:
         return apply_fn(model, params, x)
 
-    return LoadedModel(kind=kind, version=version, predict=predict)
+    shape = meta.get("input_shape")
+    return LoadedModel(
+        kind=kind, version=version, predict=predict,
+        input_shape=tuple(shape) if shape else None,
+        input_dtype=meta.get("input_dtype", "float32"))
 
 
 def load_latest(base_path: str) -> Optional[LoadedModel]:

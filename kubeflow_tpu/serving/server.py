@@ -60,9 +60,13 @@ class ModelRepository:
     """Models under ``<base>/<model_name>/<version>/`` with hot reload."""
 
     def __init__(self, base_path: str, *, poll_interval_s: float = 10.0,
-                 pin_version: Optional[int] = None) -> None:
+                 pin_version: Optional[int] = None,
+                 warmup_batches: Tuple[int, ...] = ()) -> None:
         self.base_path = base_path
         self.poll_interval_s = poll_interval_s
+        # padded batch buckets to precompile at load time, before the new
+        # version is swapped in — no client request pays the XLA compile
+        self.warmup_batches = tuple(warmup_batches)
         # When set (KFTPU_MODEL_VERSION from the per-version traffic-split
         # Deployment), serve exactly this version instead of hot-loading the
         # latest — otherwise every canary backend converges on the same model
@@ -102,12 +106,27 @@ class ModelRepository:
                 current = self._models.get(name)
             if current is not None and current.version == latest:
                 continue
-            # load outside the lock (disk read + jit can take seconds);
-            # only the swap is serialized, so predicts never stall on reload
+            # load + warm up outside the lock (disk read + jit can take
+            # seconds); only the swap is serialized, so predicts never
+            # stall on reload
             log.info("loading model %s version %d", name, latest)
             loaded = load_version(mdir, latest)
+            self._warmup(name, loaded)
             with self._lock:
                 self._models[name] = loaded
+
+    def _warmup(self, name: str, loaded: LoadedModel) -> None:
+        if not self.warmup_batches:
+            return
+        t0 = time.perf_counter()
+        try:
+            n = loaded.warmup(self.warmup_batches)
+        except Exception:  # noqa: BLE001 — warmup is best-effort
+            log.exception("warmup failed for %s v%d", name, loaded.version)
+            return
+        if n:
+            log.info("warmed %d batch buckets for %s v%d in %.1fs",
+                     n, name, loaded.version, time.perf_counter() - t0)
 
     def get(self, name: str, version: Optional[int] = None) -> Optional[LoadedModel]:
         with self._lock:
@@ -121,6 +140,10 @@ class ModelRepository:
                 return cached
             mdir = os.path.join(self.base_path, name)
             if version in list_versions(mdir):
+                # no warmup here: this runs inside a client request, and
+                # compiling every bucket synchronously would multiply the
+                # first-request latency it is meant to prevent — the request
+                # compiles just its own bucket
                 loaded = load_version(mdir, version)
                 with self._lock:
                     self._pinned[(name, version)] = loaded
@@ -161,9 +184,12 @@ class ModelRepository:
 class ModelServer:
     def __init__(self, base_path: str, *, port: int = 8500,
                  max_batch_size: int = 8, poll_interval_s: float = 10.0,
-                 pin_version: Optional[int] = None) -> None:
+                 pin_version: Optional[int] = None,
+                 warmup: bool = False) -> None:
+        buckets = tuple(b for b in _PAD_BUCKETS if b <= max_batch_size)
         self.repo = ModelRepository(base_path, poll_interval_s=poll_interval_s,
-                                    pin_version=pin_version)
+                                    pin_version=pin_version,
+                                    warmup_batches=buckets if warmup else ())
         self.port = port
         self.max_batch_size = max_batch_size
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -296,20 +322,62 @@ def parse_pin_version(raw: Optional[str]) -> Optional[int]:
     return int(digits)
 
 
+def enable_compile_cache(base_path: str) -> None:
+    """Persistent XLA compile cache: version reloads and server restarts
+    reuse compiled executables instead of paying cold XLA compiles
+    (SURVEY §7 hard part (d): serving cold-start)."""
+    cache_dir = os.environ.get(
+        "KFTPU_COMPILE_CACHE_DIR",
+        os.path.join(base_path, ".xla-compile-cache"))
+    if not cache_dir or cache_dir.lower() == "off":
+        return
+    import tempfile
+
+    import jax
+
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError:
+        # model volumes are commonly mounted read-only (tf-serving-style
+        # PVC); fall back to local scratch rather than crashlooping —
+        # restarts lose the cache but version reloads within the pod keep it
+        cache_dir = os.path.join(tempfile.gettempdir(), "kftpu-xla-cache")
+        os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # serving recompiles are per-bucket and small; cache them all
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    log.info("XLA compile cache at %s", cache_dir)
+
+
 def main() -> None:
     logging.basicConfig(level=logging.INFO)
     base = os.environ.get("KFTPU_MODEL_BASE_PATH", "/models")
     port = int(os.environ.get("KFTPU_REST_PORT", "8500"))
+    grpc_port = int(os.environ.get("KFTPU_GRPC_PORT", "9000"))
     max_batch = int(os.environ.get("KFTPU_MAX_BATCH_SIZE", "8"))
+    enable_compile_cache(base)
     server = ModelServer(base, port=port, max_batch_size=max_batch,
                          pin_version=parse_pin_version(
-                             os.environ.get("KFTPU_MODEL_VERSION")))
+                             os.environ.get("KFTPU_MODEL_VERSION")),
+                         warmup=os.environ.get("KFTPU_WARMUP", "1") != "0")
     server.start()
+    grpc_server = None  # keep the reference: grpc.Server dies when GC'd
+    if grpc_port:
+        try:
+            from kubeflow_tpu.serving.grpc_server import serve_grpc
+
+            grpc_server, _ = serve_grpc(server.repo, grpc_port,
+                                        max_batch_size=max_batch)
+        except ImportError as e:
+            log.warning("gRPC disabled (grpc not importable: %s); "
+                        "serving REST only", e)
     try:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
         server.stop()
+        if grpc_server is not None:
+            grpc_server.stop(grace=1.0)
 
 
 if __name__ == "__main__":

@@ -9,6 +9,8 @@ from __future__ import annotations
 
 import inspect
 import json
+import mimetypes
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, Optional, Tuple
@@ -16,6 +18,10 @@ from typing import Any, Callable, Dict, Optional, Tuple
 USER_HEADER = "X-Kubeflow-Userid"  # identity header the platform trusts
 
 MAX_BODY_BYTES = 4 << 20  # reject absurd request bodies before parsing
+
+# static assets the login flow itself needs — served without a session
+# cookie even when an authenticator is configured
+PUBLIC_STATIC = frozenset({"login.html", "style.css"})
 
 # handle(method, path, body, user) -> (status_code, json_payload);
 # a handler declaring a 5th parameter also receives the request headers
@@ -40,11 +46,64 @@ def serve_json(handle: Handle, port: int, *,
                background: bool = False,
                host: str = "0.0.0.0",
                authenticator: Optional[Authenticator] = None,
+               static_dir: Optional[str] = None,
                ) -> Optional[ThreadingHTTPServer]:
+    """``static_dir`` also serves a browser frontend: GET paths outside
+    ``/api`` resolve to files under it (``/`` → ``index.html``), giving the
+    UI and its API one origin — the reference splits these across an
+    Express static server + API routes (centraldashboard ``app/api.ts``).
+
+    With an ``authenticator`` configured, static files are auth-gated like
+    everything else except the login flow's own assets (PUBLIC_STATIC) —
+    otherwise the login page would be unreachable and the flow dead-ends.
+    """
     pass_headers = _wants_headers(handle)
 
     class Handler(BaseHTTPRequestHandler):
+        def _try_static(self, path: str, authenticated: bool) -> bool:
+            if static_dir is None or path.startswith("/api"):
+                return False
+            rel = path.lstrip("/") or "index.html"
+            if not authenticated and rel not in PUBLIC_STATIC:
+                return False
+            full = os.path.realpath(os.path.join(static_dir, rel))
+            # stay inside static_dir (no ../ escapes)
+            if not full.startswith(os.path.realpath(static_dir) + os.sep):
+                return False
+            if os.path.isdir(full):
+                full = os.path.join(full, "index.html")
+            if not os.path.isfile(full):
+                return False
+            ctype = mimetypes.guess_type(full)[0] or "application/octet-stream"
+            with open(full, "rb") as f:
+                data = f.read()
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+            return True
+
         def _dispatch(self, method: str) -> None:
+            verified: Optional[str] = None
+            if authenticator is not None:
+                verified = authenticator(dict(self.headers))
+            clean_path = self.path.split("?")[0]
+            if method == "GET" and self._try_static(
+                    clean_path,
+                    authenticated=authenticator is None or verified is not None):
+                return
+            if (authenticator is not None and verified is None
+                    and method == "GET" and static_dir is not None
+                    and not clean_path.startswith("/api")
+                    and clean_path.lstrip("/") not in PUBLIC_STATIC):
+                # browser page load without a session: send the human to the
+                # login page instead of a bare JSON 401
+                self.send_response(302)
+                self.send_header("Location", "/login.html?next=" + clean_path)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
             try:
                 length = int(self.headers.get("Content-Length", "0"))
             except ValueError:
@@ -58,7 +117,6 @@ def serve_json(handle: Handle, port: int, *,
                     body = {}
                 user = self.headers.get(USER_HEADER, "")
                 if authenticator is not None:
-                    verified = authenticator(dict(self.headers))
                     if verified is None:
                         self._reply(401, {"log": "authentication required"})
                         return

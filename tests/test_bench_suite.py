@@ -1,0 +1,56 @@
+"""BASELINE.md bench suite: structure, error isolation, and the light
+configs end-to-end on the virtual CPU mesh (the heavy resnet/bert configs
+run on the real chip via bench.py)."""
+
+import jax
+
+from kubeflow_tpu.bench import suite
+
+
+def test_mnist_config_learns():
+    out = suite.bench_mnist(steps=8, batch=64)
+    assert out["learned"], out
+    assert out["images_per_sec"] > 0
+
+
+def test_allreduce_config_on_virtual_mesh():
+    out = suite.bench_allreduce(size_mb=0.5, iters=2)
+    assert out["n_chips"] == jax.device_count()
+    if jax.device_count() >= 2:
+        assert out["bus_gb_per_sec"] > 0
+    else:
+        assert "skipped" in out
+
+
+def test_serving_config_reports_latency():
+    out = suite.bench_serving(requests=2, batch=2, image_size=64)
+    assert out["p50_ms"] > 0
+    assert out["p99_ms"] >= out["p50_ms"]
+    assert out["qps_per_chip"] > 0
+
+
+def test_run_all_isolates_failures(monkeypatch):
+    def boom():
+        raise RuntimeError("kaput")
+
+    monkeypatch.setitem(suite.CONFIGS, "resnet50", boom)
+    monkeypatch.setitem(suite.CONFIGS, "bert", boom)
+    monkeypatch.setitem(suite.CONFIGS, "serving", boom)
+    out = suite.run_all(only=["mnist", "resnet50"])
+    assert "error" in out["resnet50"]
+    assert out["mnist"]["images_per_sec"] > 0
+    assert "bert" not in out  # respected the subset
+
+
+def test_peak_flops_detection(monkeypatch):
+    monkeypatch.setenv("KFTPU_PEAK_TFLOPS", "123.5")
+    assert suite.peak_flops_per_chip() == 123.5e12
+    monkeypatch.delenv("KFTPU_PEAK_TFLOPS")
+    # CPU devices → 0.0 (MFU meaningless), never a crash
+    assert suite.peak_flops_per_chip() == 0.0
+
+
+def test_mfu_math():
+    assert suite._mfu(None, 1.0, 1) == {}
+    out = suite._mfu(12.33e9 * 256, 1.0, 1)
+    assert out == {}  # CPU: no peak → no MFU claimed

@@ -1,0 +1,158 @@
+"""Browser UX tier: static frontends served next to the JSON APIs."""
+
+import json
+import os
+import re
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.k8s import FakeKubeClient
+from kubeflow_tpu.utils.jsonhttp import serve_json
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read(), resp.headers.get("Content-Type")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), e.headers.get("Content-Type")
+
+
+@pytest.fixture
+def dashboard_server():
+    from kubeflow_tpu.dashboard.server import DashboardApi
+
+    client = FakeKubeClient()
+    client.create({"apiVersion": "v1", "kind": "Namespace",
+                   "metadata": {"name": "kubeflow"}})
+    api = DashboardApi(client)
+    srv = serve_json(
+        api.handle, 0, background=True, host="127.0.0.1",
+        static_dir=os.path.join(REPO, "kubeflow_tpu/dashboard/static"))
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def test_dashboard_serves_ui_and_api(dashboard_server):
+    code, body, ctype = _get(dashboard_server + "/")
+    assert code == 200 and b"<html" in body and "text/html" in ctype
+    code, body, ctype = _get(dashboard_server + "/app.js")
+    assert code == 200 and "javascript" in ctype
+    code, body, ctype = _get(dashboard_server + "/style.css")
+    assert code == 200 and "css" in ctype
+    code, body, _ = _get(dashboard_server + "/login.html")
+    assert code == 200 and b"login-form" in body
+    # API still routes
+    code, body, ctype = _get(dashboard_server + "/api/env-info")
+    assert code == 200 and "json" in ctype
+    assert json.loads(body)["namespaces"] == ["kubeflow"]
+
+
+def test_dashboard_static_traversal_blocked(dashboard_server):
+    code, _, _ = _get(dashboard_server + "/../../etc/passwd")
+    assert code == 404
+    code, _, _ = _get(dashboard_server + "/%2e%2e/%2e%2e/etc/passwd")
+    assert code == 404
+
+
+def test_webapp_serves_notebook_manager():
+    from kubeflow_tpu.notebooks.webapp import NotebookWebApp, serve
+
+    client = FakeKubeClient()
+    client.create({"apiVersion": "v1", "kind": "Namespace",
+                   "metadata": {"name": "kubeflow"}})
+    srv = serve(NotebookWebApp(client), port=0, background=True)
+    try:
+        base = f"http://{srv.server_address[0]}:{srv.server_address[1]}"
+        code, body, _ = _get(base + "/")
+        assert code == 200 and b"Notebooks" in body
+        code, body, _ = _get(base + "/notebooks.js")
+        assert code == 200
+        code, body, _ = _get(base + "/api/namespaces")
+        assert json.loads(body)["namespaces"] == ["kubeflow"]
+    finally:
+        srv.shutdown()
+
+
+def test_bootstrap_serves_deploy_ui(tmp_path):
+    from kubeflow_tpu.bootstrap.server import DeployServer
+
+    server = DeployServer(FakeKubeClient(), app_root=str(tmp_path))
+    srv = serve_json(
+        server.handle, 0, background=True, host="127.0.0.1",
+        static_dir=os.path.join(REPO, "kubeflow_tpu/bootstrap/static"))
+    try:
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        code, body, _ = _get(base + "/")
+        assert code == 200 and b"deploy-form" in body
+        code, body, _ = _get(base + "/healthz")
+        assert code == 200 and json.loads(body) == {"ok": True}
+    finally:
+        srv.shutdown()
+
+
+def test_static_served_without_auth_but_api_guarded():
+    """login.html must stay reachable when cookie auth is on; the API not."""
+    from kubeflow_tpu.auth.gatekeeper import cookie_authenticator
+    from kubeflow_tpu.dashboard.server import DashboardApi
+
+    api = DashboardApi(FakeKubeClient())
+    srv = serve_json(
+        api.handle, 0, background=True, host="127.0.0.1",
+        authenticator=cookie_authenticator(b"secret"),
+        static_dir=os.path.join(REPO, "kubeflow_tpu/dashboard/static"))
+    try:
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        code, _, _ = _get(base + "/login.html")
+        assert code == 200
+        code, _, _ = _get(base + "/style.css")
+        assert code == 200  # login page's stylesheet is public too
+        code, _, _ = _get(base + "/api/env-info")
+        assert code == 401
+        # non-public static is gated: browser gets bounced to login
+        opener = urllib.request.build_opener(_NoRedirect)
+        try:
+            opener.open(base + "/app.js", timeout=10)
+            raise AssertionError("expected 302")
+        except urllib.error.HTTPError as e:
+            assert e.code == 302
+            assert e.headers["Location"].startswith("/login.html")
+        # with a valid cookie the app shell serves
+        from kubeflow_tpu.auth.gatekeeper import AuthServer
+
+        cookie = AuthServer({}, b"secret").issue_cookie("alice")
+        req = urllib.request.Request(
+            base + "/app.js", headers={"Cookie": f"kftpu-auth={cookie}"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 200
+    finally:
+        srv.shutdown()
+
+
+class _NoRedirect(urllib.request.HTTPRedirectHandler):
+    def redirect_request(self, *a, **k):
+        return None
+
+
+def test_html_references_resolve():
+    """Every src/href in the shipped pages points at a shipped file."""
+    static_dirs = [
+        os.path.join(REPO, "kubeflow_tpu", d, "static")
+        for d in ("dashboard", "notebooks", "bootstrap")
+    ]
+    for sdir in static_dirs:
+        for fname in os.listdir(sdir):
+            if not fname.endswith(".html"):
+                continue
+            html = open(os.path.join(sdir, fname)).read()
+            for ref in re.findall(r'(?:src|href)="([^"]+)"', html):
+                if ref.startswith(("http", "#", "/")):
+                    ref = ref.lstrip("/")
+                if not ref or "{" in ref:
+                    continue
+                assert os.path.isfile(os.path.join(sdir, ref)), \
+                    f"{fname} references missing asset {ref!r} in {sdir}"
