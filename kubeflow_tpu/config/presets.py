@@ -22,7 +22,7 @@ def _minimal(name: str) -> DeploymentConfig:
 
 
 def _standard(name: str) -> DeploymentConfig:
-    """Operator + serving + dashboard on an existing cluster."""
+    """Operator + serving + portal stack on an existing cluster."""
     return DeploymentConfig(
         name=name,
         platform="existing",
@@ -30,6 +30,10 @@ def _standard(name: str) -> DeploymentConfig:
             ComponentSpec("tpujob-operator"),
             ComponentSpec("serving"),
             ComponentSpec("dashboard"),
+            ComponentSpec("notebooks"),
+            ComponentSpec("tenancy"),
+            ComponentSpec("auth"),
+            ComponentSpec("gateway"),
         ],
     )
 

@@ -1,0 +1,12 @@
+"""Edge tier: ingress reverse proxy, TLS material, gateway manifests.
+
+The reference fronts every UI/API with an API gateway + auth pair —
+Ambassador (``/root/reference/kubeflow/common/ambassador.libsonnet:152-179``)
+or the IAP/Envoy ingress (``/root/reference/kubeflow/gcp/iap.libsonnet``),
+with basic-auth via gatekeeper + kflogin. Here the gateway is in-framework:
+:mod:`kubeflow_tpu.edge.proxy` terminates the session cookie, stamps the
+verified identity header, and routes path prefixes to the platform's
+services.
+"""
+
+from kubeflow_tpu.edge.proxy import EdgeProxy, Route  # noqa: F401

@@ -1,0 +1,96 @@
+"""Self-signed TLS material for in-cluster webhooks and edge TLS.
+
+The reference's admission webhook ships cert Secrets in its manifests and
+the API server trusts them via ``caBundle`` on the
+MutatingWebhookConfiguration (``/root/reference/components/
+admission-webhook/main.go:69`` + its manifests). Here the webhook pod
+mints its own CA + server cert at bootstrap (cert-manager's
+self-signed-issuer role, ``/root/reference/kubeflow/gcp/
+cert-manager.libsonnet``) and patches the caBundle itself.
+"""
+
+from __future__ import annotations
+
+import base64
+import datetime
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import rsa
+from cryptography.x509.oid import NameOID
+
+
+@dataclass(frozen=True)
+class CertPair:
+    cert_pem: bytes
+    key_pem: bytes
+
+    @property
+    def cert_b64(self) -> str:
+        return base64.b64encode(self.cert_pem).decode()
+
+
+def _key() -> rsa.RSAPrivateKey:
+    return rsa.generate_private_key(public_exponent=65537, key_size=2048)
+
+
+def _key_pem(key: rsa.RSAPrivateKey) -> bytes:
+    return key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.TraditionalOpenSSL,
+        serialization.NoEncryption())
+
+
+def make_ca(common_name: str = "kubeflow-tpu-ca",
+            days: int = 3650) -> Tuple[CertPair, rsa.RSAPrivateKey]:
+    key = _key()
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=days))
+            .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                           critical=True)
+            .sign(key, hashes.SHA256()))
+    return CertPair(cert.public_bytes(serialization.Encoding.PEM),
+                    _key_pem(key)), key
+
+
+def make_server_cert(ca: CertPair, ca_key: rsa.RSAPrivateKey,
+                     dns_names: List[str], days: int = 825) -> CertPair:
+    """Server cert for the in-cluster DNS names (``svc.ns.svc`` forms)."""
+    key = _key()
+    ca_cert = x509.load_pem_x509_certificate(ca.cert_pem)
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(x509.Name([x509.NameAttribute(
+                NameOID.COMMON_NAME, dns_names[0])]))
+            .issuer_name(ca_cert.subject)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=days))
+            .add_extension(x509.SubjectAlternativeName(
+                [x509.DNSName(n) for n in dns_names]), critical=False)
+            .add_extension(x509.BasicConstraints(ca=False, path_length=None),
+                           critical=True)
+            .sign(ca_key, hashes.SHA256()))
+    return CertPair(cert.public_bytes(serialization.Encoding.PEM),
+                    _key_pem(key))
+
+
+def webhook_certs(service: str, namespace: str) -> Tuple[CertPair, CertPair]:
+    """(ca, server) pair for ``<service>.<namespace>.svc``."""
+    ca, ca_key = make_ca()
+    server = make_server_cert(ca, ca_key, [
+        f"{service}.{namespace}.svc",
+        f"{service}.{namespace}.svc.cluster.local",
+        "localhost",
+    ])
+    return ca, server

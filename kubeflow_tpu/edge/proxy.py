@@ -1,0 +1,249 @@
+"""Ingress reverse proxy: auth at the edge + prefix routing.
+
+The ambassador/IAP-envoy role (reference:
+``/root/reference/kubeflow/common/ambassador.libsonnet:152-179`` routes,
+``/root/reference/kubeflow/gcp/iap.libsonnet`` auth-at-edge): one
+process in front of every web service that
+
+- verifies the gatekeeper session cookie on each request,
+- STRIPS any client-supplied ``X-Kubeflow-Userid`` and stamps the
+  verified identity instead (the backends trust this header — see
+  ``kubeflow_tpu/utils/jsonhttp.py``),
+- routes path prefixes to backend services (``/jupyter/`` →
+  notebook web app with the prefix stripped, ``/serving/`` → model
+  server, ``/login``/``/logout``/``/verify`` → gatekeeper, everything
+  else → central dashboard),
+- leaves the login page itself reachable without a session.
+
+Routes are static config (env ``KFTPU_ROUTES`` JSON), not CRDs: the
+platform's service set is known at deploy time, and the per-notebook
+dynamic routes ride Istio VirtualServices rendered by the notebook
+controller instead.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import urllib.error
+import urllib.request
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from kubeflow_tpu.utils.jsonhttp import USER_HEADER
+from kubeflow_tpu.utils.metrics import DEFAULT_REGISTRY
+
+log = logging.getLogger(__name__)
+
+_proxied = DEFAULT_REGISTRY.counter(
+    "kftpu_edge_requests_total", "requests routed by the edge proxy")
+_denied = DEFAULT_REGISTRY.counter(
+    "kftpu_edge_denied_total", "requests denied at the edge")
+
+# request paths that must work without a session (the login flow)
+PUBLIC_PATHS = ("/login", "/login.html", "/style.css", "/logout", "/healthz")
+
+# hop-by-hop headers never forwarded (RFC 7230 §6.1)
+_HOP_BY_HOP = {"connection", "keep-alive", "proxy-authenticate",
+               "proxy-authorization", "te", "trailers",
+               "transfer-encoding", "upgrade", "host"}
+
+
+@dataclass(frozen=True)
+class Route:
+    prefix: str          # e.g. "/jupyter/"
+    target: str          # e.g. "http://notebook-webapp"
+    strip_prefix: bool = True
+
+    def matches(self, path: str) -> bool:
+        return path == self.prefix.rstrip("/") or path.startswith(self.prefix)
+
+    def rewrite(self, path: str) -> str:
+        if not self.strip_prefix:
+            return path
+        out = path[len(self.prefix.rstrip("/")):]
+        return out if out.startswith("/") else "/" + out
+
+
+def default_routes(*, dashboard: str = "http://centraldashboard",
+                   webapp: str = "http://notebook-webapp",
+                   serving: str = "http://model-server:8500",
+                   gatekeeper: str = "http://gatekeeper:8085") -> List[Route]:
+    return [
+        Route("/login", gatekeeper, strip_prefix=False),
+        Route("/logout", gatekeeper, strip_prefix=False),
+        Route("/jupyter/", webapp),
+        Route("/serving/", serving),
+        Route("/", dashboard, strip_prefix=False),  # catch-all, keep last
+    ]
+
+
+def routes_from_env() -> List[Route]:
+    raw = os.environ.get("KFTPU_ROUTES", "")
+    if not raw:
+        return default_routes()
+    return [Route(r["prefix"], r["target"], bool(r.get("stripPrefix", True)))
+            for r in json.loads(raw)]
+
+
+class EdgeProxy:
+    """Threaded reverse proxy with cookie auth via the gatekeeper."""
+
+    def __init__(self, routes: List[Route], *,
+                 verify_url: Optional[str] = None,
+                 authenticator=None) -> None:
+        """``verify_url``: the gatekeeper's external-auth endpoint
+        (GET, cookie in headers → 200/401, reference AuthServer.go flow);
+        ``authenticator``: in-process alternative (headers → user|None).
+        Neither set = auth disabled (dev mode)."""
+        self.routes = list(routes)
+        self.verify_url = verify_url
+        self.authenticator = authenticator
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    # -- auth --------------------------------------------------------------
+
+    def authenticate(self, headers: Dict[str, str]) -> Optional[str]:
+        if self.authenticator is not None:
+            return self.authenticator(headers)
+        if not self.verify_url:
+            return headers.get(USER_HEADER, "") or "anonymous"
+        req = urllib.request.Request(self.verify_url)
+        if headers.get("Cookie"):
+            req.add_header("Cookie", headers["Cookie"])
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                verdict = json.loads(resp.read())
+                return verdict.get("user")
+        except urllib.error.HTTPError:
+            return None
+        except OSError:
+            log.warning("gatekeeper unreachable at %s", self.verify_url)
+            return None
+
+    def route_for(self, path: str) -> Optional[Route]:
+        for r in self.routes:
+            if r.matches(path):
+                return r
+        return None
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _make_handler(self):
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _forward(self) -> None:
+                path = self.path
+                clean = path.split("?")[0]
+                route = proxy.route_for(clean)
+                if route is None:
+                    self._send(404, b'{"error": "no route"}')
+                    return
+                headers = {k: v for k, v in self.headers.items()
+                           if k.lower() not in _HOP_BY_HOP}
+                # never trust identity headers from outside the mesh
+                headers.pop(USER_HEADER, None)
+                public = clean in PUBLIC_PATHS or clean.rstrip("/") in (
+                    p.rstrip("/") for p in PUBLIC_PATHS)
+                if not public and (proxy.verify_url or proxy.authenticator):
+                    user = proxy.authenticate(
+                        {k: v for k, v in self.headers.items()})
+                    if user is None:
+                        _denied.inc()
+                        if self.command == "GET" and "text/html" in \
+                                self.headers.get("Accept", ""):
+                            self.send_response(302)
+                            self.send_header(
+                                "Location", "/login.html?next=" + clean)
+                            self.send_header("Content-Length", "0")
+                            self.end_headers()
+                            return
+                        self._send(401, b'{"log": "authentication required"}')
+                        return
+                    headers[USER_HEADER] = user
+                length = int(self.headers.get("Content-Length", "0") or 0)
+                body = self.rfile.read(length) if length else None
+                target = route.target.rstrip("/") + route.rewrite(path)
+                req = urllib.request.Request(target, data=body,
+                                             headers=headers,
+                                             method=self.command)
+                try:
+                    with urllib.request.urlopen(req, timeout=120) as resp:
+                        data = resp.read()
+                        self.send_response(resp.status)
+                        for k, v in resp.headers.items():
+                            if k.lower() not in _HOP_BY_HOP and \
+                                    k.lower() != "content-length":
+                                self.send_header(k, v)
+                        self.send_header("Content-Length", str(len(data)))
+                        self.end_headers()
+                        self.wfile.write(data)
+                        _proxied.inc(route=route.prefix)
+                except urllib.error.HTTPError as e:
+                    data = e.read()
+                    self.send_response(e.code)
+                    self.send_header("Content-Type",
+                                     e.headers.get("Content-Type",
+                                                   "application/json"))
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                except OSError as e:
+                    self._send(502, json.dumps(
+                        {"error": f"upstream {route.target}: {e}"}).encode())
+
+            def _send(self, code: int, data: bytes) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802
+                if self.path.split("?")[0] == "/healthz":
+                    self._send(200, b'{"ok": true}')
+                    return
+                self._forward()
+
+            do_POST = do_PUT = do_DELETE = do_PATCH = _forward
+
+            def log_message(self, *a):
+                pass
+
+        return Handler
+
+    def start(self, port: int = 8080) -> int:
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", port),
+                                          self._make_handler())
+        port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+        log.info("edge proxy on :%d (%d routes)", port, len(self.routes))
+        return port
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+
+
+def main() -> None:
+    import time
+
+    logging.basicConfig(level=logging.INFO)
+    proxy = EdgeProxy(
+        routes_from_env(),
+        verify_url=os.environ.get("KFTPU_VERIFY_URL",
+                                  "http://gatekeeper:8085/verify") or None)
+    proxy.start(int(os.environ.get("KFTPU_EDGE_PORT", "8080")))
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    main()

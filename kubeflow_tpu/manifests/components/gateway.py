@@ -1,0 +1,131 @@
+"""Ingress gateway component: edge proxy Deployment + routes.
+
+Replaces the reference's Ambassador API gateway
+(``/root/reference/kubeflow/common/ambassador.libsonnet:152-179``) and the
+IAP/basic-auth ingress pair (``/root/reference/kubeflow/gcp/iap.libsonnet``,
+``basic-auth-ingress``): one in-framework reverse proxy
+(:mod:`kubeflow_tpu.edge.proxy`) that authenticates at the edge via the
+gatekeeper and routes prefixes to the platform services. With
+``use_istio`` it additionally renders an Istio Gateway + VirtualServices
+carrying the same routes for mesh environments.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+from kubeflow_tpu.config.deployment import DeploymentConfig
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.manifests.components.edge import INGRESS_POD_LABELS
+from kubeflow_tpu.manifests.registry import register
+
+DEFAULTS: Dict[str, Any] = {
+    "image": "kubeflow-tpu/platform:v1alpha1",
+    "port": 8080,
+    "replicas": 1,
+    "hostname": "*",
+    "use_istio": False,
+    # prefix -> {service, port, stripPrefix}; merged over the built-ins
+    "extra_routes": {},
+}
+
+GATEWAY_NAME = "kftpu-ingressgateway"
+
+
+def _routes(params: Dict[str, Any]) -> List[Dict[str, Any]]:
+    routes = [
+        {"prefix": "/login", "target": "http://gatekeeper:8085",
+         "stripPrefix": False},
+        {"prefix": "/logout", "target": "http://gatekeeper:8085",
+         "stripPrefix": False},
+        {"prefix": "/jupyter/", "target": "http://notebook-webapp",
+         "stripPrefix": True},
+        {"prefix": "/serving/", "target": "http://model-server:8500",
+         "stripPrefix": True},
+        {"prefix": "/deploy/", "target": "http://bootstrap:8086",
+         "stripPrefix": True},
+    ]
+    for prefix, spec in sorted((params.get("extra_routes") or {}).items()):
+        routes.append({"prefix": prefix,
+                       "target": f"http://{spec['service']}:"
+                                 f"{spec.get('port', 80)}",
+                       "stripPrefix": bool(spec.get("stripPrefix", True))})
+    # catch-all last: the dashboard shell owns every unclaimed path
+    routes.append({"prefix": "/", "target": "http://centraldashboard",
+                   "stripPrefix": False})
+    return routes
+
+
+def istio_gateway(ns: str, hostname: str) -> o.Obj:
+    return {
+        "apiVersion": "networking.istio.io/v1beta1",
+        "kind": "Gateway",
+        "metadata": o.metadata("kubeflow-gateway", ns),
+        "spec": {
+            "selector": {"istio": "ingressgateway"},
+            "servers": [{
+                "hosts": [hostname],
+                "port": {"name": "http", "number": 80, "protocol": "HTTP"},
+            }],
+        },
+    }
+
+
+def istio_route(ns: str, name: str, prefix: str, service: str, port: int,
+                strip: bool) -> o.Obj:
+    http: Dict[str, Any] = {
+        "match": [{"uri": {"prefix": prefix}}],
+        "route": [{"destination": {
+            "host": f"{service}.{ns}.svc.cluster.local",
+            "port": {"number": port}}}],
+    }
+    if strip:
+        http["rewrite"] = {"uri": "/"}
+    return {
+        "apiVersion": "networking.istio.io/v1beta1",
+        "kind": "VirtualService",
+        "metadata": o.metadata(name, ns),
+        "spec": {"hosts": ["*"], "gateways": ["kubeflow-gateway"],
+                 "http": [http]},
+    }
+
+
+@register("gateway", DEFAULTS,
+          "Edge reverse proxy + routes (ambassador / IAP-envoy parity)")
+def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
+    ns = config.namespace
+    routes = _routes(params)
+    pod = o.pod_spec([
+        o.container(
+            GATEWAY_NAME,
+            params["image"],
+            command=["python", "-m", "kubeflow_tpu.edge.proxy"],
+            env={
+                "KFTPU_EDGE_PORT": str(params["port"]),
+                "KFTPU_VERIFY_URL": "http://gatekeeper:8085/verify",
+                "KFTPU_ROUTES": json.dumps(routes),
+            },
+            ports=[params["port"]],
+        )
+    ])
+    out: List[o.Obj] = [
+        o.deployment(GATEWAY_NAME, ns, pod, replicas=params["replicas"],
+                     labels=dict(INGRESS_POD_LABELS)),
+        o.service(GATEWAY_NAME, ns, dict(INGRESS_POD_LABELS),
+                  [{"name": "http", "port": 80,
+                    "targetPort": params["port"]}],
+                  labels=dict(INGRESS_POD_LABELS)),
+    ]
+    if params["use_istio"]:
+        out.append(istio_gateway(ns, params["hostname"]))
+        for r in routes:
+            if r["prefix"] == "/":
+                name, service, port = "kftpu-dashboard", "centraldashboard", 80
+            else:
+                service, _, port_s = r["target"][len("http://"):].partition(":")
+                port = int(port_s or 80)
+                name = "kftpu-" + r["prefix"].strip("/").replace("/", "-")
+            out.append(istio_route(ns, name, r["prefix"], service, port,
+                                   r["stripPrefix"] and r["prefix"] != "/"))
+    return out

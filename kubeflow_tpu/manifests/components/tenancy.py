@@ -98,15 +98,45 @@ def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
         )],
         service_account_name=name,
     )
+    from kubeflow_tpu.tenancy.webhook import (
+        WEBHOOK_PORT,
+        WEBHOOK_SERVICE,
+        webhook_configuration,
+    )
+
+    webhook_pod = o.pod_spec(
+        [o.container(
+            WEBHOOK_SERVICE, params["image"],
+            command=["python", "-m", "kubeflow_tpu.tenancy.webhook"],
+            env={"KFTPU_NAMESPACE": ns},
+            ports=[WEBHOOK_PORT],
+        )],
+        service_account_name=name,
+    )
+    webhook_rules = [
+        # bootstrap: store the cert Secret + patch its own caBundle
+        {"apiGroups": [""], "resources": ["secrets"],
+         "verbs": ["get", "create"]},
+        {"apiGroups": ["admissionregistration.k8s.io"],
+         "resources": ["mutatingwebhookconfigurations"],
+         "verbs": ["get", "create", "update"]},
+    ]
     return [
         profile_crd(),
         poddefault_crd(),
         *tenant_cluster_roles(),
         o.service_account(name, ns),
-        o.cluster_role(name, rules),
+        o.cluster_role(name, rules + webhook_rules),
         o.cluster_role_binding(name, name, name, ns),
         o.deployment(name, ns, ctrl_pod),
         o.deployment("kfam", ns, kfam_pod),
+        o.deployment(WEBHOOK_SERVICE, ns, webhook_pod),
+        o.service(WEBHOOK_SERVICE, ns, {"app": WEBHOOK_SERVICE},
+                  [{"name": "https", "port": WEBHOOK_PORT,
+                    "targetPort": WEBHOOK_PORT}]),
+        # rendered without caBundle; the webhook pod patches trust in at
+        # bootstrap (see kubeflow_tpu/tenancy/webhook.py)
+        webhook_configuration(ns),
         o.service("kfam", ns, {"app": "kfam"},
                   [{"name": "http", "port": params["kfam_port"],
                     "targetPort": params["kfam_port"]}]),

@@ -142,14 +142,53 @@ def build_service(nb: o.Obj) -> o.Obj:
     return o.set_owner(svc, nb)
 
 
+def build_virtual_service(nb: o.Obj, *,
+                          gateway: str = "kubeflow/kubeflow-gateway") -> o.Obj:
+    """Istio route for the notebook's browser path.
+
+    The reference controller creates one per Notebook when USE_ISTIO
+    (``/root/reference/components/notebook-controller/pkg/controller/
+    notebook/notebook_controller.go:208-243``): /notebook/<ns>/<name>/ on
+    the shared gateway, rewritten to the pod's base path."""
+    name = nb["metadata"]["name"]
+    ns = nb["metadata"]["namespace"]
+    prefix = f"/notebook/{ns}/{name}/"
+    vs = {
+        "apiVersion": "networking.istio.io/v1beta1",
+        "kind": "VirtualService",
+        "metadata": {"name": f"notebook-{name}", "namespace": ns,
+                     "labels": {NOTEBOOK_LABEL: name}},
+        "spec": {
+            "hosts": ["*"],
+            "gateways": [gateway],
+            "http": [{
+                "match": [{"uri": {"prefix": prefix}}],
+                "rewrite": {"uri": prefix},  # NB_PREFIX keeps the base path
+                "route": [{"destination": {
+                    "host": f"{name}.{ns}.svc.cluster.local",
+                    "port": {"number": 80},
+                }}],
+                "timeout": "300s",
+            }],
+        },
+    }
+    return o.set_owner(vs, nb)
+
+
 class NotebookController:
     """Reconciles Notebook CRs; culls idle notebooks when enabled."""
 
     def __init__(self, client: KubeClient, namespace: Optional[str] = None,
-                 policy: Optional[culler.CullingPolicy] = None) -> None:
+                 policy: Optional[culler.CullingPolicy] = None,
+                 use_istio: Optional[bool] = None) -> None:
+        import os
+
         self.client = client
         self.namespace = namespace
         self.policy = policy or culler.CullingPolicy()
+        # reference gates the per-notebook VirtualService on USE_ISTIO
+        self.use_istio = (os.environ.get("USE_ISTIO", "").lower()
+                          in ("1", "true") if use_istio is None else use_istio)
 
     def reconcile(self, ns: str, name: str) -> Optional[float]:
         nb = self.client.get_or_none(NOTEBOOK_API_VERSION, NOTEBOOK_KIND,
@@ -177,6 +216,15 @@ class NotebookController:
             except ApiError as e:
                 if e.code != 409:
                     raise
+        if self.use_istio:
+            vs = build_virtual_service(nb)
+            if self.client.get_or_none(vs["apiVersion"], vs["kind"], ns,
+                                       vs["metadata"]["name"]) is None:
+                try:
+                    self.client.create(vs)
+                except ApiError as e:
+                    if e.code != 409:
+                        raise
 
         self._update_status(nb)
         if self.policy.enabled and not culler.is_stopped(nb):

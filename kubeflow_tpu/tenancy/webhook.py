@@ -1,0 +1,205 @@
+"""PodDefault admission webhook server: TLS endpoint + self-registration.
+
+The reference's admission-webhook is a Go HTTPS server the API server
+calls per pod create (``/root/reference/components/admission-webhook/
+main.go:69``), registered by a MutatingWebhookConfiguration with a
+``caBundle``. Here the server reuses the in-framework mutation pipeline
+(:func:`kubeflow_tpu.tenancy.poddefault.admission_response`) and
+bootstraps its own trust on startup: mint CA + server cert
+(:mod:`kubeflow_tpu.edge.certs`), store them in a Secret, and patch the
+MutatingWebhookConfiguration's ``caBundle`` — the cert-manager role,
+collapsed into the webhook pod.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import ssl
+import tempfile
+import threading
+import os
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.k8s.client import ApiError, KubeClient
+from kubeflow_tpu.tenancy.poddefault import admission_response
+
+log = logging.getLogger(__name__)
+
+WEBHOOK_NAME = "kftpu-poddefault-webhook"
+WEBHOOK_SECRET = "poddefault-webhook-certs"
+WEBHOOK_SERVICE = "poddefault-webhook"
+WEBHOOK_PORT = 8443
+
+
+def webhook_configuration(ns: str, *, ca_bundle: str = "") -> o.Obj:
+    """MutatingWebhookConfiguration targeting the webhook Service.
+
+    ``caBundle`` may be empty at render time; the server patches it in at
+    bootstrap (reference ships static cert Secrets instead)."""
+    webhook = {
+        "name": "poddefault.kubeflow-tpu.org",
+        "admissionReviewVersions": ["v1"],
+        "sideEffects": "None",
+        "failurePolicy": "Ignore",  # reference choice: never block pods
+        "clientConfig": {
+            "service": {"name": WEBHOOK_SERVICE, "namespace": ns,
+                        "path": "/mutate", "port": WEBHOOK_PORT},
+        },
+        "rules": [{
+            "apiGroups": [""],
+            "apiVersions": ["v1"],
+            "operations": ["CREATE"],
+            "resources": ["pods"],
+        }],
+        "namespaceSelector": {
+            "matchLabels": {"app.kubernetes.io/part-of": "kubeflow-profile"},
+        },
+    }
+    if ca_bundle:
+        webhook["clientConfig"]["caBundle"] = ca_bundle
+    return {
+        "apiVersion": "admissionregistration.k8s.io/v1",
+        "kind": "MutatingWebhookConfiguration",
+        "metadata": {"name": WEBHOOK_NAME},
+        "webhooks": [webhook],
+    }
+
+
+def bootstrap_certs(client: KubeClient, ns: str) -> Tuple[bytes, bytes]:
+    """Ensure the cert Secret exists and the webhook config trusts it.
+
+    Returns (cert_pem, key_pem) for the server socket. Reuses an existing
+    Secret so restarts don't rotate trust out from under the API server."""
+    from kubeflow_tpu.edge.certs import webhook_certs
+
+    existing = client.get_or_none("v1", "Secret", ns, WEBHOOK_SECRET)
+    parsed = _secret_fields(existing)
+    if parsed is None:
+        ca, server = webhook_certs(WEBHOOK_SERVICE, ns)
+        cert_pem, key_pem = server.cert_pem, server.key_pem
+        ca_b64 = ca.cert_b64
+        secret = o.secret(WEBHOOK_SECRET, ns, {
+            "tls.crt": cert_pem.decode(),
+            "tls.key": key_pem.decode(),
+            "ca.crt.b64": ca_b64,
+        })
+        try:
+            client.create(secret)
+        except ApiError as e:
+            if e.code != 409:
+                raise
+            # lost the create race (another replica / restart won): serve
+            # THEIR certs — patching our fresh CA over a Secret holding the
+            # old key would desynchronize trust and break TLS verification
+            parsed = _secret_fields(
+                client.get("v1", "Secret", ns, WEBHOOK_SECRET))
+            if parsed is None:
+                raise RuntimeError(
+                    f"Secret {WEBHOOK_SECRET} exists but holds no certs")
+    if parsed is not None:
+        cert_pem, key_pem, ca_b64 = parsed
+    # register / update the caBundle
+    config = webhook_configuration(ns, ca_bundle=ca_b64)
+    try:
+        client.create(config)
+    except ApiError as e:
+        if e.code != 409:
+            raise
+        live = client.get(config["apiVersion"],
+                          "MutatingWebhookConfiguration", "", WEBHOOK_NAME)
+        live["webhooks"] = config["webhooks"]
+        client.update(live)
+    return cert_pem, key_pem
+
+
+class WebhookServer:
+    """HTTPS AdmissionReview endpoint (POST /mutate)."""
+
+    def __init__(self, client: KubeClient, *, cert_pem: bytes,
+                 key_pem: bytes) -> None:
+        self.client = client
+        self.cert_pem = cert_pem
+        self.key_pem = key_pem
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    def _make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802
+                if self.path.split("?")[0] != "/mutate":
+                    self._send(404, {"error": "not found"})
+                    return
+                length = int(self.headers.get("Content-Length", "0") or 0)
+                try:
+                    review = json.loads(self.rfile.read(length) or b"{}")
+                except json.JSONDecodeError:
+                    self._send(400, {"error": "invalid JSON"})
+                    return
+                self._send(200, admission_response(server.client, review))
+
+            def do_GET(self):  # noqa: N802
+                if self.path.split("?")[0] == "/healthz":
+                    self._send(200, {"ok": True})
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def _send(self, code: int, payload) -> None:
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *a):
+                pass
+
+        return Handler
+
+    def start(self, port: int = WEBHOOK_PORT) -> int:
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", port),
+                                          self._make_handler())
+        # the ssl module wants file paths; keep them for the server lifetime
+        self._certdir = tempfile.TemporaryDirectory(prefix="kftpu-webhook-")
+        cert_file = os.path.join(self._certdir.name, "tls.crt")
+        key_file = os.path.join(self._certdir.name, "tls.key")
+        with open(cert_file, "wb") as f:
+            f.write(self.cert_pem)
+        with open(key_file, "wb") as f:
+            f.write(self.key_pem)
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(cert_file, key_file)
+        self._httpd.socket = ctx.wrap_socket(self._httpd.socket,
+                                             server_side=True)
+        port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+        log.info("poddefault webhook (TLS) on :%d", port)
+        return port
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+
+
+def main() -> None:
+    import time
+
+    from kubeflow_tpu.k8s.client import HttpKubeClient
+
+    logging.basicConfig(level=logging.INFO)
+    ns = os.environ.get("KFTPU_NAMESPACE", "kubeflow")
+    client = HttpKubeClient()
+    cert_pem, key_pem = bootstrap_certs(client, ns)
+    WebhookServer(client, cert_pem=cert_pem, key_pem=key_pem).start(
+        int(os.environ.get("KFTPU_WEBHOOK_PORT", str(WEBHOOK_PORT))))
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    main()
