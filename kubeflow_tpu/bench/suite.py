@@ -83,6 +83,18 @@ def _timed_steps(step: Callable, n_steps: int, warmup: int,
     return (time.perf_counter() - t0) / n_steps
 
 
+def _capture_trace(step: Callable, sync: Callable[[], None],
+                   logdir: str, n_steps: int = 3) -> None:
+    """Profile n compiled steps AFTER timing (capture overhead must not
+    contaminate the reported numbers); trace lands in ``logdir``."""
+    from kubeflow_tpu.utils.profiler import trace
+
+    with trace(logdir):
+        for _ in range(n_steps):
+            step()
+        sync()
+
+
 def _mfu(flops_per_step: Optional[float], sec_per_step: float,
          n_chips: int) -> Dict[str, float]:
     peak = peak_flops_per_chip()
@@ -145,7 +157,8 @@ def bench_mnist(steps: int = 30, batch: int = 256) -> Dict[str, Any]:
 
 
 def bench_resnet50(batch_per_chip: int = 256, steps: int = 20,
-                   warmup: int = 5) -> Dict[str, Any]:
+                   warmup: int = 5,
+                   profile_dir: Optional[str] = None) -> Dict[str, Any]:
     import jax
     import jax.numpy as jnp
     import optax
@@ -185,6 +198,8 @@ def bench_resnet50(batch_per_chip: int = 256, steps: int = 20,
 
     sec = _timed_steps(one, steps, warmup,
                        sync=lambda: float(holder["m"]["loss"]))
+    if profile_dir:
+        _capture_trace(one, lambda: float(holder["m"]["loss"]), profile_dir)
     ips = batch / sec
     return {
         "images_per_sec_per_chip": round(ips / n_chips, 2),
@@ -199,7 +214,8 @@ def bench_resnet50(batch_per_chip: int = 256, steps: int = 20,
 
 
 def bench_bert(batch_per_chip: int = 16, seq_len: int = 512,
-               steps: int = 10, warmup: int = 3) -> Dict[str, Any]:
+               steps: int = 10, warmup: int = 3,
+               profile_dir: Optional[str] = None) -> Dict[str, Any]:
     import jax
     import jax.numpy as jnp
 
@@ -237,6 +253,8 @@ def bench_bert(batch_per_chip: int = 16, seq_len: int = 512,
 
     sec = _timed_steps(one, steps, warmup,
                        sync=lambda: float(holder["m"]["loss"]))
+    if profile_dir:
+        _capture_trace(one, lambda: float(holder["m"]["loss"]), profile_dir)
     # analytic transformer train FLOPs: 6·N·D (N params, D tokens) plus the
     # attention score/value matmuls, 12·L·S²·d per token fwd+bwd
     n_params = sum(int(np.prod(p.shape))
@@ -265,10 +283,15 @@ def bench_allreduce(size_mb: float = 64.0, iters: int = 10) -> Dict[str, Any]:
 
     n = jax.device_count()
     if n < 2:
-        # a 1-chip allreduce is the identity; report the honest non-result
-        # (the scaling curve needs a multi-chip slice — see
-        # tests/test_distributed.py for the virtual-mesh tier)
-        return {"n_chips": n, "skipped": "needs >=2 chips"}
+        # a 1-chip allreduce is the identity. Still record the 8-device
+        # virtual CPU mesh number (subprocess — the parent is pinned to the
+        # TPU platform) so regressions in the collective path stay visible
+        # round-over-round even on 1-chip hardware.
+        out: Dict[str, Any] = {"n_chips": n, "skipped": "needs >=2 chips"}
+        virt = _virtual_mesh_allreduce(size_mb=8.0, iters=iters)
+        if virt is not None:
+            out["virtual_cpu_mesh"] = virt
+        return out
     mesh = create_mesh(MeshConfig(dp=n))
     res = bench_collective("all_reduce", mesh, "dp", size_mb=size_mb,
                            iters=iters)
@@ -280,12 +303,65 @@ def bench_allreduce(size_mb: float = 64.0, iters: int = 10) -> Dict[str, Any]:
     }
 
 
+def _virtual_mesh_allreduce(*, size_mb: float, iters: int,
+                            n_devices: int = 8) -> Optional[Dict[str, Any]]:
+    """AllReduce bus bandwidth over an 8-device virtual CPU mesh, measured
+    in a subprocess (the parent interpreter is already pinned to its
+    platform). Tracks the collective *code path*, not hardware speed.
+    Returns None (with a logged warning) when the subprocess fails, so the
+    published key always has the success shape."""
+    import logging
+    import subprocess
+    import sys
+
+    prog = (
+        "import os, json\n"
+        f"os.environ['XLA_FLAGS'] = "
+        f"'--xla_force_host_platform_device_count={n_devices}'\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from kubeflow_tpu.ops.collectives import bench_collective\n"
+        "from kubeflow_tpu.parallel import MeshConfig, create_mesh\n"
+        f"mesh = create_mesh(MeshConfig(dp={n_devices}))\n"
+        f"r = bench_collective('all_reduce', mesh, 'dp', "
+        f"size_mb={size_mb}, iters={iters})\n"
+        "print(json.dumps({'bus_gb_per_sec': round(r.bus_gb_s, 2), "
+        "'payload_mb': round(r.size_mb, 1), "
+        "'mean_ms': round(r.mean_s * 1e3, 3), "
+        f"'n_devices': {n_devices}}}))\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", prog], capture_output=True, text=True,
+            timeout=300, cwd=os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))))
+        if proc.returncode:
+            logging.getLogger(__name__).warning(
+                "virtual-mesh allreduce failed: %s",
+                proc.stderr.strip()[-300:])
+            return None
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (subprocess.TimeoutExpired, OSError, ValueError) as e:
+        logging.getLogger(__name__).warning(
+            "virtual-mesh allreduce failed: %s: %s", type(e).__name__, e)
+        return None
+
+
 # -- config 5: serving latency/QPS -------------------------------------------
 
 
 def bench_serving(requests: int = 200, batch: int = 8,
-                  image_size: int = 224) -> Dict[str, Any]:
-    """REST predict p50/p99 + QPS through the real ModelServer HTTP path."""
+                  image_size: int = 224,
+                  rest_requests: int = 30) -> Dict[str, Any]:
+    """Predict p50/p99 + QPS through BOTH serving surfaces.
+
+    Primary numbers are the gRPC :9000 binary-tensor path — the reference
+    model server's primary surface (``/root/reference/kubeflow/tf-serving/
+    tf-serving-template.libsonnet:33-48``) and the one a production client
+    uses. The REST JSON path (``rest_*`` keys, fewer iterations — the
+    batch-8 224² request is ~24 MB of ASCII floats) is measured separately
+    so the JSON encode/decode overhead is itself visible rather than
+    masquerading as model latency."""
     import tempfile
     import urllib.request
 
@@ -294,6 +370,7 @@ def bench_serving(requests: int = 200, batch: int = 8,
 
     from kubeflow_tpu.models.resnet import ResNet, ResNetConfig
     from kubeflow_tpu.serving import ModelServer, export_model
+    from kubeflow_tpu.serving.grpc_server import PredictClient, serve_grpc
 
     # serving-size ResNet-50; fp32 params exported, bf16 compute
     cfg = ResNetConfig(stage_sizes=(3, 4, 6, 3), num_classes=1000)
@@ -301,6 +378,18 @@ def bench_serving(requests: int = 200, batch: int = 8,
     rng = jax.random.key(0)
     x0 = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
     variables = model.init(rng, x0, train=False)
+
+    def timed(fn, n):
+        lat = []
+        t0 = time.perf_counter()
+        for _ in range(n):
+            t = time.perf_counter()
+            fn()
+            lat.append(time.perf_counter() - t)
+        wall = time.perf_counter() - t0
+        ms = np.array(lat) * 1e3
+        return (round(float(np.percentile(ms, 50)), 2),
+                round(float(np.percentile(ms, 99)), 2), wall)
 
     with tempfile.TemporaryDirectory() as d:
         export_model(
@@ -315,35 +404,44 @@ def bench_serving(requests: int = 200, batch: int = 8,
         server = ModelServer(d, port=0, max_batch_size=batch,
                              poll_interval_s=3600)
         port = server.start()
-        url = f"http://127.0.0.1:{port}/v1/models/resnet:predict"
-        payload = json.dumps({
-            "instances": np.random.rand(
-                batch, image_size, image_size, 3).astype(np.float32).tolist()
-        }).encode()
+        grpc_server, grpc_port = serve_grpc(server.repo, port=0,
+                                            max_batch_size=batch)
+        client = PredictClient(f"127.0.0.1:{grpc_port}")
+        try:
+            images = np.random.rand(
+                batch, image_size, image_size, 3).astype(np.float32)
 
-        def predict():
-            req = urllib.request.Request(
-                url, data=payload,
-                headers={"Content-Type": "application/json"})
-            with urllib.request.urlopen(req, timeout=120) as resp:
-                json.loads(resp.read())
+            client.predict("resnet", images)  # compile
+            grpc_p50, grpc_p99, grpc_wall = timed(
+                lambda: client.predict("resnet", images), requests)
 
-        predict()  # compile
-        lat = []
-        t0 = time.perf_counter()
-        for _ in range(requests):
-            t = time.perf_counter()
-            predict()
-            lat.append(time.perf_counter() - t)
-        wall = time.perf_counter() - t0
-        server.stop()
+            url = f"http://127.0.0.1:{port}/v1/models/resnet:predict"
+            payload = json.dumps({"instances": images.tolist()}).encode()
 
-    lat_ms = np.array(lat) * 1e3
+            def rest_predict():
+                req = urllib.request.Request(
+                    url, data=payload,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=120) as resp:
+                    json.loads(resp.read())
+
+            rest_predict()  # warm
+            rest_p50, rest_p99, rest_wall = timed(rest_predict, rest_requests)
+        finally:
+            client.close()
+            grpc_server.stop(grace=0)
+            server.stop()
+
     n_chips = jax.device_count()
     return {
-        "p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
-        "p99_ms": round(float(np.percentile(lat_ms, 99)), 2),
-        "qps_per_chip": round(requests * batch / wall / n_chips, 1),
+        "p50_ms": grpc_p50,
+        "p99_ms": grpc_p99,
+        "qps_per_chip": round(requests * batch / grpc_wall / n_chips, 1),
+        "transport": "grpc",
+        "rest_p50_ms": rest_p50,
+        "rest_p99_ms": rest_p99,
+        "rest_qps_per_chip": round(
+            rest_requests * batch / rest_wall / n_chips, 1),
         "batch": batch,
         "n_chips": n_chips,
     }
@@ -360,14 +458,23 @@ CONFIGS: Dict[str, Callable[[], Dict[str, Any]]] = {
 }
 
 
-def run_all(only: Optional[list] = None) -> Dict[str, Dict[str, Any]]:
-    """Run every config; one failing config must not kill the rest."""
+def run_all(only: Optional[list] = None,
+            profile_dir: Optional[str] = None) -> Dict[str, Dict[str, Any]]:
+    """Run every config; one failing config must not kill the rest.
+
+    ``profile_dir`` captures an XLA trace of the resnet50/bert hot loops
+    into ``<profile_dir>/<config>/`` (after timing, so capture overhead
+    never contaminates the numbers)."""
     out: Dict[str, Dict[str, Any]] = {}
     for name, fn in CONFIGS.items():
         if only and name not in only:
             continue
         try:
-            out[name] = fn()
+            if profile_dir and name in ("resnet50", "bert"):
+                out[name] = fn(profile_dir=os.path.join(profile_dir, name))
+                out[name]["trace_dir"] = os.path.join(profile_dir, name)
+            else:
+                out[name] = fn()
         except Exception as e:  # noqa: BLE001
             out[name] = {"error": f"{type(e).__name__}: {e}"}
     return out
@@ -379,8 +486,11 @@ def main() -> None:
     p = argparse.ArgumentParser(description="BASELINE.md bench suite")
     p.add_argument("configs", nargs="*", choices=[*CONFIGS, []],
                    help="subset to run (default: all)")
+    p.add_argument("--profile", metavar="DIR", default=None,
+                   help="capture XLA profiler traces of the hot loops")
     args = p.parse_args()
-    print(json.dumps(run_all(args.configs or None)))
+    print(json.dumps(run_all(args.configs or None,
+                             profile_dir=args.profile)))
 
 
 if __name__ == "__main__":

@@ -26,12 +26,15 @@ from __future__ import annotations
 import json
 import logging
 import os
+import selectors
+import socket
 import threading
 import urllib.error
 import urllib.request
 from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
+from urllib.parse import urlsplit
 
 from kubeflow_tpu.utils.jsonhttp import USER_HEADER
 from kubeflow_tpu.utils.metrics import DEFAULT_REGISTRY
@@ -102,6 +105,7 @@ class EdgeProxy:
         self.routes = list(routes)
         self.verify_url = verify_url
         self.authenticator = authenticator
+        self.tunnel_idle_s = 300.0  # WebSocket idle reclaim (Jupyter pings)
         self._httpd: Optional[ThreadingHTTPServer] = None
 
     # -- auth --------------------------------------------------------------
@@ -167,6 +171,9 @@ class EdgeProxy:
                         self._send(401, b'{"log": "authentication required"}')
                         return
                     headers[USER_HEADER] = user
+                if self._is_upgrade():
+                    self._tunnel(route, route.rewrite(path), headers)
+                    return
                 length = int(self.headers.get("Content-Length", "0") or 0)
                 body = self.rfile.read(length) if length else None
                 target = route.target.rstrip("/") + route.rewrite(path)
@@ -197,6 +204,93 @@ class EdgeProxy:
                 except OSError as e:
                     self._send(502, json.dumps(
                         {"error": f"upstream {route.target}: {e}"}).encode())
+
+            def _is_upgrade(self) -> bool:
+                return ("upgrade" in self.headers.get("Connection", "").lower()
+                        and self.headers.get("Upgrade", "").lower()
+                        == "websocket")
+
+            def _tunnel(self, route: Route, target_path: str,
+                        headers: Dict[str, str]) -> None:
+                """HTTP/1.1 Upgrade passthrough (RFC 6455 handshake relay).
+
+                Replays the client's upgrade request upstream, then splices
+                raw bytes in both directions — the upstream's 101 response
+                and every WebSocket frame after it pass through untouched.
+                This is what lets a Jupyter kernel channel (which is a
+                WebSocket under ``/api/kernels/.../channels``) survive the
+                auth-at-edge hop; the reference relies on ambassador for
+                the same (``/root/reference/kubeflow/common/
+                ambassador.libsonnet:152-179``)."""
+                u = urlsplit(route.target)
+                port = u.port or (443 if u.scheme == "https" else 80)
+                try:
+                    upstream = socket.create_connection(
+                        (u.hostname, port), timeout=10)
+                except OSError as e:
+                    self._send(502, json.dumps(
+                        {"error": f"upstream {route.target}: {e}"}).encode())
+                    return
+                if u.scheme == "https":
+                    import ssl
+
+                    upstream = ssl.create_default_context().wrap_socket(
+                        upstream, server_hostname=u.hostname)
+                # replay the handshake: identity-stamped headers plus the
+                # hop-by-hop upgrade pair the forwarding filter stripped
+                lines = [f"{self.command} {target_path} HTTP/1.1",
+                         f"Host: {u.netloc}",
+                         "Connection: Upgrade",
+                         f"Upgrade: {self.headers.get('Upgrade')}"]
+                lines += [f"{k}: {v}" for k, v in headers.items()]
+                try:
+                    upstream.sendall(
+                        ("\r\n".join(lines) + "\r\n\r\n").encode())
+                except OSError as e:
+                    upstream.close()
+                    self._send(502, json.dumps(
+                        {"error": f"upstream {route.target}: {e}"}).encode())
+                    return
+                _proxied.inc(route=route.prefix)
+                client = self.connection
+                # drain bytes the request parser read ahead into rfile (a
+                # client may pipeline its first frame with the handshake);
+                # zero-timeout so an empty buffer doesn't block on the OS
+                client.settimeout(0)
+                try:
+                    pending = self.rfile.read1(65536)
+                except (OSError, ValueError):
+                    pending = b""
+                finally:
+                    client.settimeout(None)
+                if pending:
+                    upstream.sendall(pending)
+                sel = selectors.DefaultSelector()
+                sel.register(client, selectors.EVENT_READ, upstream)
+                sel.register(upstream, selectors.EVENT_READ, client)
+                try:
+                    alive = True
+                    while alive:
+                        events = sel.select(timeout=proxy.tunnel_idle_s)
+                        if not events:
+                            break  # idle tunnel: reclaim the thread
+                        for key, _ in events:
+                            try:
+                                data = key.fileobj.recv(65536)
+                                if not data:
+                                    alive = False
+                                    break
+                                key.data.sendall(data)
+                            except OSError:
+                                alive = False
+                                break
+                finally:
+                    sel.close()
+                    try:
+                        upstream.close()
+                    except OSError:
+                        pass
+                    self.close_connection = True
 
             def _send(self, code: int, data: bytes) -> None:
                 self.send_response(code)

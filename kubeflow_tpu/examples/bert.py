@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from kubeflow_tpu.examples.common import checkpoint_dir, launcher_init, log_metrics
+from kubeflow_tpu.parallel.mesh import data_parallel_size
 from kubeflow_tpu.models.bert import Bert, BertConfig, mask_tokens
 from kubeflow_tpu.train import (
     TrainState,
@@ -22,6 +23,7 @@ from kubeflow_tpu.train import (
     make_optimizer,
 )
 from kubeflow_tpu.train.checkpoint import CheckpointManager
+from kubeflow_tpu.utils.profiler import StepProfiler
 
 
 def main(argv=None) -> float:
@@ -50,7 +52,7 @@ def main(argv=None) -> float:
         max_seq_len=args.seq_len,
     )
     model = Bert(config)
-    batch = args.per_device_batch * mesh.devices.shape[0]
+    batch = args.per_device_batch * data_parallel_size(mesh)
     tx = make_optimizer(args.learning_rate, warmup_steps=20,
                         decay_steps=args.steps + 1)
     sample = jnp.zeros((batch, args.seq_len), jnp.int32)
@@ -77,7 +79,9 @@ def main(argv=None) -> float:
     tokens_per_step = batch * args.seq_len
     last_loss = float("nan")
     t_window = time.perf_counter()
+    prof = StepProfiler.from_env()
     for step in range(start_step, args.steps):
+        prof.step(step)
         data_rng, tok_rng, mask_rng = jax.random.split(data_rng, 3)
         labels = jax.random.randint(
             tok_rng, (batch, args.seq_len), 0, args.vocab_size, jnp.int32)
@@ -100,6 +104,7 @@ def main(argv=None) -> float:
     if ckpt:
         ckpt.save(state, args.steps)
         ckpt.close()
+    prof.close()
     log_metrics(args.steps, loss=round(last_loss, 4), done=True)
     return last_loss
 

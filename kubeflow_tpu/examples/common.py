@@ -57,17 +57,29 @@ def log_metrics(step: int, **metrics: Any) -> None:
 def launcher_init(
     *, pp: int = 1, tp: Optional[int] = None
 ) -> tuple[ProcessEnv, "jax.sharding.Mesh"]:
-    """Distributed bootstrap + mesh over all visible devices."""
+    """Distributed bootstrap + mesh over all visible devices.
+
+    Consumes the operator's full env contract: on a multi-slice job
+    (``MEGASCALE_NUM_SLICES > 1``) the mesh gets a ``dcn`` outer-dp axis
+    across slices; pp/tp always stay within one slice so their per-layer
+    collectives never cross DCN."""
     setup_logging()
     penv = dist.initialize()
     from kubeflow_tpu.parallel.mesh import auto_mesh_config
 
-    config = auto_mesh_config(jax.device_count(), pp=pp, tp=tp)
-    mesh = create_mesh(config)
+    if penv.is_multislice:
+        per_slice = jax.device_count() // penv.num_slices
+        slice_cfg = auto_mesh_config(per_slice, pp=pp, tp=tp)
+        mesh = dist.multislice_mesh(penv, pp=slice_cfg.pp, tp=slice_cfg.tp)
+        config = MeshConfig(dcn=penv.num_slices, dp=slice_cfg.dp,
+                            pp=slice_cfg.pp, tp=slice_cfg.tp)
+    else:
+        config = auto_mesh_config(jax.device_count(), pp=pp, tp=tp)
+        mesh = create_mesh(config)
     logging.info(
-        "launcher up: rank %d/%d, %d devices, mesh dp=%d pp=%d tp=%d",
+        "launcher up: rank %d/%d, %d devices, mesh dcn=%d dp=%d pp=%d tp=%d",
         penv.process_id, penv.num_processes, jax.device_count(),
-        config.dp, config.pp, config.tp,
+        config.dcn, config.dp, config.pp, config.tp,
     )
     return penv, mesh
 

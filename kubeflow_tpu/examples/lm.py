@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from kubeflow_tpu.examples.common import checkpoint_dir, launcher_init, log_metrics
+from kubeflow_tpu.parallel.mesh import data_parallel_size
 from kubeflow_tpu.models import Transformer, TransformerConfig
 from kubeflow_tpu.train import (
     TrainState,
@@ -22,6 +23,7 @@ from kubeflow_tpu.train import (
     make_optimizer,
 )
 from kubeflow_tpu.train.checkpoint import CheckpointManager
+from kubeflow_tpu.utils.profiler import StepProfiler
 
 
 def main(argv=None) -> float:
@@ -53,7 +55,7 @@ def main(argv=None) -> float:
         n_experts=args.n_experts,
     )
     model = Transformer(config)
-    batch = args.per_device_batch * mesh.devices.shape[0]  # dp axis size
+    batch = args.per_device_batch * data_parallel_size(mesh)
     tx = make_optimizer(args.learning_rate, warmup_steps=20,
                         decay_steps=args.steps + 1)
     sample = jnp.zeros((batch, args.seq_len), jnp.int32)
@@ -77,10 +79,12 @@ def main(argv=None) -> float:
         return 0.0
 
     step_fn = make_lm_train_step(mesh)
+    prof = StepProfiler.from_env()
     data_rng = jax.random.key(1234)
     t0 = time.perf_counter()
     tokens_done = 0
     for step in range(start_step + 1, args.steps + 1):
+        prof.step(step)
         rng = jax.random.fold_in(data_rng, step)
         tokens = jax.random.randint(rng, (batch, args.seq_len), 0,
                                     config.vocab_size)
@@ -94,6 +98,7 @@ def main(argv=None) -> float:
                         tokens_per_sec_per_chip=tps / jax.device_count())
         if ckpt and (step % args.checkpoint_every == 0 or step == args.steps):
             ckpt.save(step, state)
+    prof.close()
     if ckpt:
         ckpt.wait()
         ckpt.close()

@@ -22,6 +22,7 @@ from kubeflow_tpu.train import (
     make_image_train_step,
     make_optimizer,
 )
+from kubeflow_tpu.utils.profiler import StepProfiler
 
 
 def main(argv=None) -> float:
@@ -60,8 +61,10 @@ def main(argv=None) -> float:
     if metrics is not None:
         float(metrics["loss"])  # force completion before the timed section
 
+    prof = StepProfiler.from_env()
     t0 = time.perf_counter()
     for step in range(1, args.steps + 1):
+        prof.step(step)
         state, metrics = step_fn(state, images, labels)
         if step % args.log_every == 0 or step == args.steps:
             float(metrics["loss"])
@@ -70,6 +73,7 @@ def main(argv=None) -> float:
             log_metrics(step, loss=metrics["loss"], images_per_sec=ips,
                         images_per_sec_per_chip=ips / jax.device_count())
     float(metrics["loss"])
+    prof.close()
     dt = time.perf_counter() - t0
     ips = args.steps * batch / dt
     log_metrics(args.steps, final=True, images_per_sec=ips,

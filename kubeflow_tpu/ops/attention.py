@@ -302,12 +302,21 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = True,
 
 
 def ring_attention_sharded(q, k, v, mesh, *, seq_axis: str = "tp",
-                           batch_axis: str = "dp", causal: bool = True,
+                           batch_axis=("dcn", "dp"), causal: bool = True,
                            sm_scale: Optional[float] = None):
     """``shard_map`` wrapper: full (B, S, H, D) arrays in, ring attention on
-    sequence shards over ``seq_axis``. Usable directly under jit."""
+    sequence shards over ``seq_axis``. Usable directly under jit.
+
+    ``batch_axis`` may be a name, a tuple of names, or None; names absent
+    from ``mesh`` are dropped, so the default works on plain dp/tp meshes
+    and on the 4-axis dcn mesh alike."""
     from jax.sharding import PartitionSpec as P
 
+    if batch_axis is not None:
+        axes = ((batch_axis,) if isinstance(batch_axis, str)
+                else tuple(batch_axis))
+        axes = tuple(a for a in axes if a in mesh.axis_names)
+        batch_axis = (axes[0] if len(axes) == 1 else axes) if axes else None
     spec = P(batch_axis, seq_axis, None, None)
     fn = jax.shard_map(
         functools.partial(

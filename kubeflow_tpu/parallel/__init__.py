@@ -19,6 +19,7 @@ from kubeflow_tpu.parallel.distributed import (  # noqa: F401
     ProcessEnv,
     from_env,
     initialize,
+    multislice_mesh,
 )
 from kubeflow_tpu.parallel.pipeline import (  # noqa: F401
     make_pipelined_lm_forward,

@@ -33,6 +33,10 @@ ENV_NUM_PROCESSES = "KFTPU_NUM_PROCESSES"
 ENV_PROCESS_ID = "KFTPU_PROCESS_ID"
 ENV_JOB_NAME = "KFTPU_JOB_NAME"
 ENV_NAMESPACE = "KFTPU_NAMESPACE"
+# Multi-slice topology (also injected by the operator; the names follow the
+# TPU runtime's megascale convention so the XLA runtime picks them up too)
+ENV_SLICE_ID = "MEGASCALE_SLICE_ID"
+ENV_NUM_SLICES = "MEGASCALE_NUM_SLICES"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -44,6 +48,8 @@ class ProcessEnv:
     process_id: int
     job_name: str = ""
     namespace: str = "default"
+    slice_id: int = 0
+    num_slices: int = 1
 
     @property
     def is_distributed(self) -> bool:
@@ -52,6 +58,10 @@ class ProcessEnv:
     @property
     def is_coordinator(self) -> bool:
         return self.process_id == 0
+
+    @property
+    def is_multislice(self) -> bool:
+        return self.num_slices > 1
 
 
 def from_env(environ=None) -> ProcessEnv:
@@ -62,6 +72,8 @@ def from_env(environ=None) -> ProcessEnv:
         process_id=int(env.get(ENV_PROCESS_ID, "0")),
         job_name=env.get(ENV_JOB_NAME, ""),
         namespace=env.get(ENV_NAMESPACE, "default"),
+        slice_id=int(env.get(ENV_SLICE_ID, "0")),
+        num_slices=int(env.get(ENV_NUM_SLICES, "1")),
     )
 
 
@@ -121,3 +133,48 @@ def initialize(
                 ) from e
             log.warning("coordinator not ready (attempt %d): %s", attempt, e)
             time.sleep(retry_interval_s)
+
+
+def multislice_mesh(
+    penv: Optional[ProcessEnv] = None,
+    *,
+    pp: int = 1,
+    tp: int = 1,
+    devices=None,
+):
+    """Build the cross-slice training mesh from the operator's env contract.
+
+    The operator injects ``MEGASCALE_SLICE_ID``/``MEGASCALE_NUM_SLICES``
+    (``kubeflow_tpu/operators/tpujob.py``), and after
+    :func:`initialize` the global ``jax.devices()`` spans every slice.
+    This maps that topology onto the 4-axis mesh: ``dcn = num_slices``
+    (outer data parallelism — only the gradient allreduce crosses DCN),
+    and the per-slice chips factor into ``dp × pp × tp`` over ICI.
+
+    The reference's equivalent is assembling an MPI hostfile across hosts
+    (``/root/reference/kubeflow/mpi-job/mpi-operator.libsonnet:283-289``);
+    here the mesh *is* the topology and XLA emits the hierarchical
+    collectives (reduce-scatter over ICI, allreduce of the partial sums
+    over DCN, all-gather back over ICI).
+
+    ``devices`` orders slice-major (all of slice 0, then slice 1, …) —
+    this is jax's process-major device order when the operator assigns
+    ranks slice-major, and tests pass virtual CPU devices the same way.
+    """
+    import jax
+
+    from kubeflow_tpu.parallel.mesh import MeshConfig, create_mesh
+
+    penv = penv or from_env()
+    devs = list(devices) if devices is not None else jax.devices()
+    n_slices = penv.num_slices
+    if len(devs) % n_slices:
+        raise ValueError(
+            f"{len(devs)} devices do not divide into {n_slices} slices")
+    per_slice = len(devs) // n_slices
+    if per_slice % (pp * tp):
+        raise ValueError(
+            f"pp*tp={pp * tp} does not divide slice size {per_slice}")
+    config = MeshConfig(
+        dcn=n_slices, dp=per_slice // (pp * tp), pp=pp, tp=tp)
+    return create_mesh(config, devices=devs if devices is not None else None)

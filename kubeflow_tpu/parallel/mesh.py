@@ -9,9 +9,15 @@ are first-class mesh axes, and XLA emits the collectives over ICI.
 
 Physical mesh axes
 ------------------
-``("dp", "pp", "tp")`` — data, pipeline-stage, and tensor axes. Two further
-*logical* parallelism forms ride these physical axes, which is the standard
-TPU mapping:
+``("dcn", "dp", "pp", "tp")`` — cross-slice data, in-slice data,
+pipeline-stage, and tensor axes. ``dcn`` is the multi-slice axis: its
+collectives ride the data-center network between TPU slices (the
+reference's analogue is multi-host MPI ring allreduce over the pod
+network, ``/root/reference/kubeflow/mpi-job/mpi-operator.libsonnet:283-289``),
+so only the once-per-step gradient allreduce is mapped onto it — never
+per-layer tensor collectives. On a single slice ``dcn`` has size 1 and
+vanishes from the compiled program. Two further *logical* parallelism
+forms ride these physical axes, which is the standard TPU mapping:
 
 - **sequence/context parallel (sp)** shards activations' sequence dimension
   over the ``tp`` group (Megatron-style sequence parallelism: the tensor
@@ -33,14 +39,14 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-MESH_AXES = ("dp", "pp", "tp")
+MESH_AXES = ("dcn", "dp", "pp", "tp")
 
 # logical axis -> mesh axis (or None = replicated). Order matters only for
 # first-match lookup; each logical name appears once.
 AxisRules = Tuple[Tuple[str, Optional[Union[str, Tuple[str, ...]]]], ...]
 
 DEFAULT_RULES: AxisRules = (
-    ("batch", ("dp",)),        # per-example batch dim
+    ("batch", ("dcn", "dp")),  # per-example batch dim: outer-dp over DCN × dp
     ("stage", ("pp",)),        # stacked pipeline-stage dim on stage-stacked params
     ("embed", None),           # d_model dim of activations: replicated in tp group
     ("seq", ("tp",)),          # sequence-parallel regions (norms/residual)
@@ -55,18 +61,27 @@ DEFAULT_RULES: AxisRules = (
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
-    """Shape of the device mesh. Product must equal the device count."""
+    """Shape of the device mesh. Product must equal the device count.
+
+    ``dcn`` is the number of TPU slices joined over DCN (outer data
+    parallelism); ``dp``/``pp``/``tp`` describe the per-slice layout."""
 
     dp: int = 1
     pp: int = 1
     tp: int = 1
+    dcn: int = 1
 
     @property
     def size(self) -> int:
+        return self.dcn * self.dp * self.pp * self.tp
+
+    @property
+    def slice_size(self) -> int:
+        """Chips per slice (mesh size within one ICI domain)."""
         return self.dp * self.pp * self.tp
 
-    def axis_sizes(self) -> Tuple[int, int, int]:
-        return (self.dp, self.pp, self.tp)
+    def axis_sizes(self) -> Tuple[int, int, int, int]:
+        return (self.dcn, self.dp, self.pp, self.tp)
 
 
 def auto_mesh_config(
@@ -93,12 +108,14 @@ def create_mesh(
     *,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Build a ``jax.sharding.Mesh`` with axes ``("dp", "pp", "tp")``.
+    """Build a ``jax.sharding.Mesh`` with axes ``("dcn", "dp", "pp", "tp")``.
 
     On real TPU slices, ``mesh_utils.create_device_mesh`` lays the axes out so
     the innermost (tp) axis falls on ICI-adjacent chips — tp/sp collectives
     (the per-layer ones) ride the fastest links, dp allreduce amortises over
-    the step.
+    the step. With ``dcn > 1`` (multi-slice), the hybrid mesh builder places
+    the dcn axis across slices so exactly one collective — the gradient
+    allreduce — crosses DCN, and everything else stays on ICI.
     """
     devs = list(devices) if devices is not None else jax.devices()
     if config is None:
@@ -110,8 +127,19 @@ def create_mesh(
     if devices is None and devs[0].platform == "tpu":
         from jax.experimental import mesh_utils
 
-        arr = mesh_utils.create_device_mesh(config.axis_sizes(), devices=devs)
+        if config.dcn > 1:
+            arr = mesh_utils.create_hybrid_device_mesh(
+                (1, config.dp, config.pp, config.tp),
+                dcn_mesh_shape=(config.dcn, 1, 1, 1),
+                devices=devs,
+            )
+        else:
+            arr = mesh_utils.create_device_mesh(
+                config.axis_sizes(), devices=devs)
     else:
+        # virtual/explicit devices: dcn-major order, i.e. devices are grouped
+        # into contiguous per-slice blocks (matches how jax orders devices by
+        # process and how the operator assigns ranks slice-major)
         arr = np.asarray(devs).reshape(config.axis_sizes())
     return Mesh(arr, MESH_AXES)
 
@@ -143,6 +171,40 @@ def logical_to_mesh_axes(
     return PartitionSpec(*out)
 
 
+def data_parallel_size(mesh: Mesh) -> int:
+    """Global batch-sharding width: product of the dcn and dp axis sizes."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return sizes.get("dcn", 1) * sizes.get("dp", 1)
+
+
+def spec_for_mesh(spec: PartitionSpec, mesh) -> PartitionSpec:
+    """Drop axis names ``mesh`` does not have.
+
+    Models and train steps are written against the full 4-axis rules
+    (batch over ``("dcn", "dp")``); this keeps them runnable on reduced
+    meshes — a plain dp/tp mesh, a collective-test mesh — where the
+    missing axis would otherwise be a hard error. Dropping an absent axis
+    is exact: an axis the mesh lacks has size 1, and sharding over a
+    size-1 axis is replication."""
+    names = set(mesh.axis_names)
+    out = []
+    for entry in spec:
+        if entry is None or entry is PartitionSpec.UNCONSTRAINED:
+            out.append(entry)
+            continue
+        axes = (entry,) if isinstance(entry, str) else tuple(entry)
+        axes = tuple(a for a in axes if a in names)
+        if not axes:
+            out.append(None)
+        elif len(axes) == 1:
+            out.append(axes[0])
+        else:
+            out.append(axes)
+    while out and out[-1] is None:
+        out.pop()
+    return PartitionSpec(*out)
+
+
 def named_sharding(
     mesh: Mesh,
     logical_axes: Sequence[Optional[str]],
@@ -156,14 +218,19 @@ def shard_constraint(x, logical_axes, rules: AxisRules = DEFAULT_RULES):
 
     No-op only when no mesh is current (plain eager/test use); inside a mesh
     a malformed spec raises rather than silently dropping the constraint.
+    Axis names the current mesh lacks are dropped (see
+    :func:`spec_for_mesh`).
     """
     spec = logical_to_mesh_axes(logical_axes, rules)
     try:
-        no_mesh = jax.sharding.get_abstract_mesh().empty
+        mesh = jax.sharding.get_abstract_mesh()
+        no_mesh = mesh.empty
     except AttributeError:
-        no_mesh = False
+        mesh, no_mesh = None, False
     if no_mesh:
         return x
+    if mesh is not None:
+        spec = spec_for_mesh(spec, mesh)
     return jax.lax.with_sharding_constraint(x, spec)
 
 

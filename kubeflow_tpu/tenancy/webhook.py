@@ -68,6 +68,33 @@ def webhook_configuration(ns: str, *, ca_bundle: str = "") -> o.Obj:
     }
 
 
+def _secret_fields(secret) -> Optional[Tuple[bytes, bytes, str]]:
+    """Extract (cert_pem, key_pem, ca_b64) from the webhook cert Secret.
+
+    Accepts both shapes a Secret can arrive in: ``stringData`` (as
+    created through :func:`kubeflow_tpu.k8s.objects.secret` and echoed
+    back by the fake client) and base64 ``data`` (what a real API server
+    returns on read). Returns None when the Secret is absent or any of
+    the three fields is missing, which tells the caller to mint fresh
+    certs."""
+    if secret is None:
+        return None
+    import base64
+
+    fields = {}
+    string_data = secret.get("stringData") or {}
+    data = secret.get("data") or {}
+    for key in ("tls.crt", "tls.key", "ca.crt.b64"):
+        if key in string_data:
+            fields[key] = string_data[key]
+        elif key in data:
+            fields[key] = base64.b64decode(data[key]).decode()
+        else:
+            return None
+    return (fields["tls.crt"].encode(), fields["tls.key"].encode(),
+            fields["ca.crt.b64"])
+
+
 def bootstrap_certs(client: KubeClient, ns: str) -> Tuple[bytes, bytes]:
     """Ensure the cert Secret exists and the webhook config trusts it.
 

@@ -26,6 +26,7 @@ from kubeflow_tpu.parallel.mesh import (
     logical_to_mesh_axes,
     mesh_context,
     shape_aware_spec,
+    spec_for_mesh,
 )
 
 
@@ -60,7 +61,9 @@ def state_partition_specs(state: Any, rules: AxisRules = DEFAULT_RULES,
 def state_shardings(state: Any, mesh: Mesh, rules: AxisRules = DEFAULT_RULES,
                     *, pipelined: bool = False) -> Any:
     def shard(path, leaf):
-        spec = logical_to_mesh_axes(_leaf_axes(path, leaf, pipelined), rules)
+        spec = spec_for_mesh(
+            logical_to_mesh_axes(_leaf_axes(path, leaf, pipelined), rules),
+            mesh)
         shape = getattr(leaf, "shape", ())
         return NamedSharding(mesh, shape_aware_spec(spec, shape, mesh))
 
@@ -132,7 +135,7 @@ def make_lm_train_step(
     donate: bool = True,
 ):
     """Build the jitted SPMD LM train step: (state, tokens) -> (state, metrics)."""
-    batch_spec = logical_to_mesh_axes(("batch", "seq"), rules)
+    batch_spec = spec_for_mesh(logical_to_mesh_axes(("batch", "seq"), rules), mesh)
 
     def step(state: TrainState, tokens: jnp.ndarray):
         tokens = jax.lax.with_sharding_constraint(tokens, batch_spec)
@@ -182,7 +185,7 @@ def make_mlm_train_step(
     """Jitted SPMD masked-LM step: (state, tokens, labels, weights) ->
     (state, metrics). ``tokens`` are the corrupted inputs; ``labels`` the
     originals; ``weights`` mark masked positions."""
-    batch_spec = logical_to_mesh_axes(("batch", "seq"), rules)
+    batch_spec = spec_for_mesh(logical_to_mesh_axes(("batch", "seq"), rules), mesh)
 
     def step(state: TrainState, tokens, labels, weights):
         tokens = jax.lax.with_sharding_constraint(tokens, batch_spec)
@@ -229,7 +232,7 @@ def make_pipelined_lm_train_step(
     from kubeflow_tpu.parallel.pipeline import make_pipelined_lm_forward
 
     fwd = make_pipelined_lm_forward(model, mesh, n_microbatches=n_microbatches)
-    batch_spec = logical_to_mesh_axes(("batch", "seq"), rules)
+    batch_spec = spec_for_mesh(logical_to_mesh_axes(("batch", "seq"), rules), mesh)
 
     def step(state: TrainState, tokens: jnp.ndarray):
         tokens = jax.lax.with_sharding_constraint(tokens, batch_spec)
@@ -261,8 +264,9 @@ def make_image_train_step(
     donate: bool = True,
 ):
     """Jitted SPMD classifier train step with BN-stat updates (ResNet path)."""
-    batch_spec = logical_to_mesh_axes(("batch", None, None, None), rules)
-    label_spec = logical_to_mesh_axes(("batch",), rules)
+    batch_spec = spec_for_mesh(
+        logical_to_mesh_axes(("batch", None, None, None), rules), mesh)
+    label_spec = spec_for_mesh(logical_to_mesh_axes(("batch",), rules), mesh)
 
     def step(state: TrainState, images: jnp.ndarray, labels: jnp.ndarray):
         images = jax.lax.with_sharding_constraint(images, batch_spec)

@@ -6,3 +6,8 @@ from kubeflow_tpu.utils.metrics import (  # noqa: F401
     Registry,
     serve_metrics,
 )
+from kubeflow_tpu.utils.profiler import (  # noqa: F401
+    StepProfiler,
+    annotate,
+    trace,
+)

@@ -1,0 +1,114 @@
+"""XLA/JAX profiler trace capture — the observability tier SURVEY §5 names.
+
+The reference's observability stops at Prometheus scrape annotations on
+the operator pods (``/root/reference/kubeflow/tf-training/
+tf-job-operator.libsonnet:180-184``); it has no kernel-level tracing at
+all. On TPU the profiler is the difference between guessing and knowing
+where a step's time goes (MXU idle vs HBM-bound vs host-bound), so trace
+capture is first-class here:
+
+- :func:`trace` — context manager around any block; writes a TensorBoard-
+  loadable trace directory (``plugins/profile/...``).
+- :class:`StepProfiler` — capture a step window ``[start, stop)`` inside a
+  training loop, driven by env (``KFTPU_PROFILE_DIR``,
+  ``KFTPU_PROFILE_START``, ``KFTPU_PROFILE_STEPS``) so the operator can
+  switch it on for any job without code changes.
+- annotations re-exported (``annotate``/``TraceAnnotation``) so runtime
+  phases (data load, step, checkpoint) show up as named spans on the
+  trace's host timeline.
+
+The captured directory is what the TensorBoard component
+(``kubeflow_tpu/manifests/components/tensorboard.py``) points at.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+from typing import Iterator, Optional
+
+log = logging.getLogger(__name__)
+
+ENV_PROFILE_DIR = "KFTPU_PROFILE_DIR"
+ENV_PROFILE_START = "KFTPU_PROFILE_START"
+ENV_PROFILE_STEPS = "KFTPU_PROFILE_STEPS"
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """Capture a device+host trace of the enclosed block into ``logdir``."""
+    import jax
+
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        log.info("profiler trace written to %s", logdir)
+
+
+def annotate(name: str):
+    """Named span on the profiler's host timeline (no-op cost when idle)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepProfiler:
+    """Captures steps ``[start, start+n)`` of a training loop.
+
+    Call :meth:`step` once per loop iteration with the global step number;
+    the profiler starts/stops the trace on the right boundaries. Inactive
+    (no logdir) it costs one integer compare per step.
+
+    >>> prof = StepProfiler.from_env()          # or StepProfiler(dir, 10, 3)
+    >>> for step in range(steps):
+    ...     prof.step(step)
+    ...     state, m = train_step(state, batch)
+    >>> prof.close()                            # safety stop at loop exit
+    """
+
+    def __init__(self, logdir: Optional[str], start: int = 10,
+                 n_steps: int = 3) -> None:
+        self.logdir = logdir
+        self.start = start
+        self.stop = start + n_steps
+        self._tracing = False
+
+    @classmethod
+    def from_env(cls, environ=None) -> "StepProfiler":
+        env = os.environ if environ is None else environ
+        return cls(
+            env.get(ENV_PROFILE_DIR) or None,
+            start=int(env.get(ENV_PROFILE_START, "10")),
+            n_steps=int(env.get(ENV_PROFILE_STEPS, "3")),
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.logdir)
+
+    def step(self, step: int) -> None:
+        if not self.logdir:
+            return
+        import jax
+
+        if not self._tracing and self.start <= step < self.stop:
+            os.makedirs(self.logdir, exist_ok=True)
+            jax.profiler.start_trace(self.logdir)
+            self._tracing = True
+        elif self._tracing and step >= self.stop:
+            jax.profiler.stop_trace()
+            self._tracing = False
+            log.info("profiler trace (steps %d..%d) written to %s",
+                     self.start, self.stop - 1, self.logdir)
+
+    def close(self) -> None:
+        if self._tracing:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._tracing = False
+            log.info("profiler trace written to %s", self.logdir)

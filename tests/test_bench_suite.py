@@ -22,11 +22,23 @@ def test_allreduce_config_on_virtual_mesh():
         assert "skipped" in out
 
 
+def test_virtual_mesh_allreduce_subprocess():
+    out = suite._virtual_mesh_allreduce(size_mb=0.25, iters=2, n_devices=4)
+    assert out is not None and "error" not in out, out
+    assert out["bus_gb_per_sec"] > 0
+    assert out["n_devices"] == 4
+
+
 def test_serving_config_reports_latency():
-    out = suite.bench_serving(requests=2, batch=2, image_size=64)
+    out = suite.bench_serving(requests=2, batch=2, image_size=64,
+                              rest_requests=2)
+    assert out["transport"] == "grpc"
     assert out["p50_ms"] > 0
     assert out["p99_ms"] >= out["p50_ms"]
     assert out["qps_per_chip"] > 0
+    assert out["rest_p50_ms"] > 0
+    # binary tensors must beat multi-MB JSON text round-trips
+    assert out["p50_ms"] <= out["rest_p50_ms"]
 
 
 def test_run_all_isolates_failures(monkeypatch):

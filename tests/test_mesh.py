@@ -28,20 +28,47 @@ def test_auto_mesh_config():
 
 def test_create_mesh_axes():
     mesh = create_mesh(MeshConfig(dp=2, pp=2, tp=2))
-    assert mesh.axis_names == ("dp", "pp", "tp")
-    assert mesh.devices.shape == (2, 2, 2)
+    assert mesh.axis_names == ("dcn", "dp", "pp", "tp")
+    assert mesh.devices.shape == (1, 2, 2, 2)
     with pytest.raises(ValueError):
         create_mesh(MeshConfig(dp=16))
 
 
+def test_create_multislice_mesh():
+    mesh = create_mesh(MeshConfig(dcn=2, dp=2, tp=2))
+    assert mesh.devices.shape == (2, 2, 1, 2)
+    # slice-major: first dcn block is exactly devices 0..3
+    import numpy as np
+
+    assert [d.id for d in np.ravel(mesh.devices[0])] == [0, 1, 2, 3]
+    assert [d.id for d in np.ravel(mesh.devices[1])] == [4, 5, 6, 7]
+
+
 def test_logical_to_mesh_axes():
-    assert logical_to_mesh_axes(("batch", None, "mlp")) == P("dp", None, "tp")
+    assert logical_to_mesh_axes(("batch", None, "mlp")) == P(
+        ("dcn", "dp"), None, "tp"
+    )
     assert logical_to_mesh_axes(("embed",)) == P()
     assert logical_to_mesh_axes(("expert", "embed", "expert_mlp")) == P(
         "dp", None, "tp"
     )
     with pytest.raises(KeyError):
         logical_to_mesh_axes(("nonsense",))
+
+
+def test_multislice_mesh_from_env():
+    from kubeflow_tpu.parallel import from_env, multislice_mesh
+
+    penv = from_env({
+        "MEGASCALE_SLICE_ID": "1", "MEGASCALE_NUM_SLICES": "2",
+        "KFTPU_NUM_PROCESSES": "2", "KFTPU_PROCESS_ID": "1",
+        "KFTPU_COORDINATOR_ADDRESS": "job-0:8476",
+    })
+    assert penv.is_multislice and penv.slice_id == 1
+    mesh = multislice_mesh(penv, tp=2, devices=jax.devices())
+    assert mesh.devices.shape == (2, 2, 1, 2)
+    with pytest.raises(ValueError):
+        multislice_mesh(penv, tp=3, devices=jax.devices())
 
 
 def test_validate_mesh_for_model():
