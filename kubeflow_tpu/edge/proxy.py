@@ -149,10 +149,11 @@ class EdgeProxy:
                 if route is None:
                     self._send(404, b'{"error": "no route"}')
                     return
+                # drop hop-by-hop headers and — never trust identity from
+                # outside the mesh — any casing of the identity header
                 headers = {k: v for k, v in self.headers.items()
-                           if k.lower() not in _HOP_BY_HOP}
-                # never trust identity headers from outside the mesh
-                headers.pop(USER_HEADER, None)
+                           if k.lower() not in _HOP_BY_HOP
+                           and k.lower() != USER_HEADER.lower()}
                 public = clean in PUBLIC_PATHS or clean.rstrip("/") in (
                     p.rstrip("/") for p in PUBLIC_PATHS)
                 if not public and (proxy.verify_url or proxy.authenticator):
@@ -236,6 +237,9 @@ class EdgeProxy:
 
                     upstream = ssl.create_default_context().wrap_socket(
                         upstream, server_hostname=u.hostname)
+                # the connect timeout must not govern the splice: a slow
+                # frame mid-tunnel is not connection death
+                upstream.settimeout(None)
                 # replay the handshake: identity-stamped headers plus the
                 # hop-by-hop upgrade pair the forwarding filter stripped
                 lines = [f"{self.command} {target_path} HTTP/1.1",
@@ -280,6 +284,11 @@ class EdgeProxy:
                                 if not data:
                                     alive = False
                                     break
+                                # TLS: drain plaintext buffered inside the
+                                # SSL object — select() only sees the raw fd
+                                while getattr(key.fileobj, "pending",
+                                              lambda: 0)():
+                                    data += key.fileobj.recv(65536)
                                 key.data.sendall(data)
                             except OSError:
                                 alive = False

@@ -164,6 +164,7 @@ def make_lm_train_step(
             return jitted(state, tokens)
 
     jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+    run.jitted = jitted  # AOT handle (bench roofline / HLO inspection)
     return run
 
 
@@ -210,6 +211,7 @@ def make_mlm_train_step(
             return jitted(state, tokens, labels, weights)
 
     jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+    run.jitted = jitted  # AOT handle (bench roofline / HLO inspection)
     return run
 
 
@@ -254,6 +256,7 @@ def make_pipelined_lm_train_step(
         with mesh_context(mesh):
             return jitted(state, tokens)
 
+    run.jitted = jitted  # AOT handle (bench roofline / HLO inspection)
     return run
 
 
@@ -301,4 +304,5 @@ def make_image_train_step(
         with mesh_context(mesh):
             return jitted(state, images, labels)
 
+    run.jitted = jitted  # AOT handle (bench roofline / HLO inspection)
     return run

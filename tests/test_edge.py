@@ -145,9 +145,11 @@ class _WsEchoServer:
             self.path = lines[0].split(" ")[1]
             for line in lines[1:]:
                 k, _, v = line.partition(": ")
-                self.headers[k.lower()] = v
+                # keep duplicates visible (spoofed + stamped identity
+                # headers must not collapse into one dict slot)
+                self.headers.setdefault(k.lower(), []).append(v)
             accept = base64.b64encode(hashlib.sha1(
-                (self.headers["sec-websocket-key"] + self.GUID).encode()
+                (self.headers["sec-websocket-key"][0] + self.GUID).encode()
             ).digest()).decode()
             conn.sendall(
                 b"HTTP/1.1 101 Switching Protocols\r\n"
@@ -173,7 +175,7 @@ class _WsEchoServer:
         self.sock.close()
 
 
-def _ws_handshake_and_echo(host, port, path, cookie=None):
+def _ws_handshake_and_echo(host, port, path, cookie=None, extra=()):
     """Open a WebSocket through a proxy: handshake, one frame, read echo."""
     import base64
     import os as _os
@@ -182,7 +184,8 @@ def _ws_handshake_and_echo(host, port, path, cookie=None):
     key = base64.b64encode(_os.urandom(16)).decode()
     lines = [f"GET {path} HTTP/1.1", f"Host: {host}:{port}",
              "Connection: Upgrade", "Upgrade: websocket",
-             f"Sec-WebSocket-Key: {key}", "Sec-WebSocket-Version: 13"]
+             f"Sec-WebSocket-Key: {key}", "Sec-WebSocket-Version: 13",
+             *extra]
     if cookie:
         lines.append(f"Cookie: {cookie}")
     s = socket.create_connection((host, port), timeout=10)
@@ -230,12 +233,14 @@ def test_websocket_upgrade_through_auth():
         assert status == 401
         status, echo = _ws_handshake_and_echo(
             "127.0.0.1", port, "/jupyter/api/kernels/k1/channels",
-            cookie="session=good")
+            cookie="session=good",
+            # a case-variant spoof of the identity header must be stripped
+            extra=(f"{USER_HEADER.lower()}: admin-spoof",))
         assert status == 101
         assert echo == b"kernel-ping"
-        # prefix stripped + verified identity stamped on the handshake
+        # prefix stripped + ONLY the verified identity on the handshake
         assert ws.path == "/api/kernels/k1/channels"
-        assert ws.headers[USER_HEADER.lower()] == "alice"
+        assert ws.headers[USER_HEADER.lower()] == ["alice"]
     finally:
         proxy.stop()
         ws.close()
