@@ -22,7 +22,11 @@ def _minimal(name: str) -> DeploymentConfig:
 
 
 def _standard(name: str) -> DeploymentConfig:
-    """Operator + serving + portal stack on an existing cluster."""
+    """Operator + serving + portal + tuning/workflow stack on an existing
+    cluster — the katib/argo parity components deploy on the happy path,
+    like the reference's default application list
+    (``/root/reference/bootstrap/config/kfctl_gcp_iap.yaml:18-95``
+    includes katib and pipeline)."""
     return DeploymentConfig(
         name=name,
         platform="existing",
@@ -34,6 +38,8 @@ def _standard(name: str) -> DeploymentConfig:
             ComponentSpec("tenancy"),
             ComponentSpec("auth"),
             ComponentSpec("gateway"),
+            ComponentSpec("tuning"),
+            ComponentSpec("workflows"),
         ],
     )
 

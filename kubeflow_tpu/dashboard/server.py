@@ -48,13 +48,90 @@ class RegistryMetricsService(MetricsService):
 
     def query(self, metric_type: str) -> List[Dict[str, Any]]:
         prefix = self.PREFIXES.get(metric_type, metric_type)
-        out = []
-        for line in self.registry.expose().splitlines():
-            if line.startswith("#") or not line.strip():
-                continue
-            name, _, value = line.rpartition(" ")
-            if name.startswith(prefix):
+        return _parse_prom(self.registry.expose(), prefix)
+
+
+def _parse_prom(text: str, prefix: str) -> List[Dict[str, Any]]:
+    out = []
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        name, _, value = line.rpartition(" ")
+        if name.startswith(prefix):
+            try:
                 out.append({"metric": name, "value": float(value)})
+            except ValueError:
+                continue
+    return out
+
+
+class ClusterMetricsService(MetricsService):
+    """Scrapes the framework components' ``serve_metrics`` endpoints.
+
+    The reference's MetricsService is an explicitly swappable
+    cluster-metrics backend (``/root/reference/components/centraldashboard/
+    app/metrics_service_factory.ts``); this implementation aggregates the
+    operator/serving/controller Prometheus endpoints (targets from
+    ``KFTPU_METRICS_TARGETS``, comma-separated ``name=url`` pairs) so the
+    dashboard's metrics panel shows cluster state, not the dashboard's own
+    request counters. Falls back to the in-process registry when no
+    targets are configured (dev mode)."""
+
+    def __init__(self, targets: Optional[Dict[str, str]] = None,
+                 timeout_s: float = 5.0) -> None:
+        import os
+
+        if targets is None:
+            targets = {}
+            for pair in os.environ.get("KFTPU_METRICS_TARGETS",
+                                       "").split(","):
+                name, _, url = pair.strip().partition("=")
+                if name and url:
+                    targets[name] = url
+        self.targets = targets
+        self.timeout_s = timeout_s
+        self._fallback = RegistryMetricsService()
+
+    def _scrape(self, url: str) -> Optional[str]:
+        import http.client
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(url,
+                                        timeout=self.timeout_s) as resp:
+                return resp.read().decode("utf-8", "replace")
+        except (OSError, http.client.HTTPException, ValueError):
+            # any unreachable/garbled target degrades to up=0, never a 500
+            return None
+
+    @staticmethod
+    def _stamp_target(metric: str, name: str) -> str:
+        """Add target="name" to a metric, merging into existing labels so
+        same-named series from different components stay distinguishable."""
+        if "{" in metric:
+            head, rest = metric.split("{", 1)
+            return f'{head}{{target="{name}",{rest}'
+        return f'{metric}{{target="{name}"}}'
+
+    def query(self, metric_type: str) -> List[Dict[str, Any]]:
+        from concurrent.futures import ThreadPoolExecutor
+
+        if not self.targets:
+            return self._fallback.query(metric_type)
+        prefix = RegistryMetricsService.PREFIXES.get(metric_type,
+                                                     metric_type)
+        items = sorted(self.targets.items())
+        # concurrent scrapes: panel latency is max(target), not the sum of
+        # timeouts when a pod is down
+        with ThreadPoolExecutor(max_workers=min(8, len(items))) as pool:
+            texts = list(pool.map(lambda kv: self._scrape(kv[1]), items))
+        out: List[Dict[str, Any]] = []
+        for (name, _url), text in zip(items, texts):
+            out.append({"metric": f'up{{target="{name}"}}',
+                        "value": 0.0 if text is None else 1.0})
+            for m in _parse_prom(text or "", prefix):
+                m["metric"] = self._stamp_target(m["metric"], name)
+                out.append(m)
         return out
 
 
@@ -64,11 +141,13 @@ class DashboardApi:
     def __init__(self, client: KubeClient, *,
                  metrics: Optional[MetricsService] = None,
                  kfam: Optional[AccessManagementApi] = None,
-                 platform: str = "gcp-tpu") -> None:
+                 platform: str = "gcp-tpu",
+                 run_archive=None) -> None:
         self.client = client
-        self.metrics = metrics or RegistryMetricsService()
+        self.metrics = metrics or ClusterMetricsService()
         self.kfam = kfam or AccessManagementApi(client)
         self.platform = platform
+        self.run_archive = run_archive
 
     def handle(self, method: str, path: str, body: Optional[Dict[str, Any]],
                user: str = "") -> Tuple[int, Any]:
@@ -87,6 +166,18 @@ class DashboardApi:
                 return 200, self.workgroup_exists(user)
             if path == "/api/dashboard-links":
                 return 200, self.dashboard_links()
+            if path.startswith("/api/studies/"):
+                parts = path[len("/api/studies/"):].split("/")
+                if len(parts) == 1:
+                    return 200, self.studies(parts[0])
+                if len(parts) == 2:
+                    return self.study_detail(parts[0], parts[1])
+            if path.startswith("/api/runs/"):
+                parts = path[len("/api/runs/"):].split("/")
+                if len(parts) == 1:
+                    return 200, self.runs(parts[0])
+                if len(parts) == 2:
+                    return self.run_detail(parts[0], parts[1])
             return 404, {"error": f"no route {path}"}
         except ApiError as e:
             return e.code, {"error": e.message}
@@ -132,21 +223,143 @@ class DashboardApi:
                 owned.append(p["metadata"]["name"])
         return {"hasWorkgroup": bool(owned), "workgroups": owned}
 
+    # -- studies (katib-ui parity) ----------------------------------------
+
+    def studies(self, ns: str) -> List[Dict[str, Any]]:
+        """Study list with trial counts + best objective — the katib-ui
+        studies table (``/root/reference/kubeflow/katib/
+        vizier.libsonnet:429-455`` deploys the UI this replaces)."""
+        from kubeflow_tpu.tuning.study import STUDY_API_VERSION, STUDY_KIND
+
+        out = []
+        for s in self.client.list(STUDY_API_VERSION, STUDY_KIND, ns):
+            spec, status = s.get("spec", {}), s.get("status", {})
+            objective = spec.get("objective", {}) or {}
+            algorithm = spec.get("algorithm", {}) or {}
+            out.append({
+                "name": s["metadata"]["name"],
+                "algorithm": algorithm.get("name", "random"),
+                "objective": objective.get("metric", ""),
+                "direction": objective.get("type", "maximize"),
+                "phase": status.get("phase", "Pending"),
+                "trials": status.get("trials", 0),
+                "trialsRunning": status.get("trialsRunning", 0),
+                "bestTrial": status.get("bestTrial"),
+            })
+        out.sort(key=lambda s: s["name"])
+        return out
+
+    def study_detail(self, ns: str, name: str) -> Tuple[int, Any]:
+        """Study + its trials (params, phase, objective) — the data behind
+        an objective-vs-trial curve."""
+        from kubeflow_tpu.tuning.study import (
+            STUDY_API_VERSION,
+            STUDY_KIND,
+            STUDY_LABEL,
+            TRIAL_KIND,
+        )
+
+        study = self.client.get_or_none(STUDY_API_VERSION, STUDY_KIND, ns,
+                                        name)
+        if study is None:
+            return 404, {"error": f"study {name!r} not found"}
+        spec = study.get("spec", {})
+        objective = spec.get("objective", {}) or {}
+        trials = []
+        for t in self.client.list(STUDY_API_VERSION, TRIAL_KIND, ns):
+            labels = t.get("metadata", {}).get("labels", {}) or {}
+            if labels.get(STUDY_LABEL) != name:
+                continue
+            status = t.get("status", {})
+            obs = status.get("observation", {}) or {}
+            trials.append({
+                "name": t["metadata"]["name"],
+                "index": t.get("spec", {}).get("index", 0),
+                "parameters": t.get("spec", {}).get("parameters", {}),
+                "phase": status.get("phase", "Pending"),
+                "objective": obs.get(objective.get("metric", "")),
+            })
+        trials.sort(key=lambda t: (t["index"], t["name"]))
+        return 200, {
+            "name": name,
+            "objective": objective.get("metric", ""),
+            "direction": objective.get("type", "maximize"),
+            "spec": spec,
+            "status": study.get("status", {}),
+            "trials": trials,
+        }
+
+    # -- workflow runs (KFP runs-page parity) -----------------------------
+
+    def runs(self, ns: str) -> List[Dict[str, Any]]:
+        """Live Workflow CRs merged with the persisted run archive, so
+        history survives CR deletion (KFP api-server runs list,
+        ``/root/reference/kubeflow/pipeline/pipeline-apiserver.libsonnet``)."""
+        from kubeflow_tpu.workflows.workflow import (
+            WORKFLOW_API_VERSION,
+            WORKFLOW_KIND,
+        )
+
+        by_key: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        if self.run_archive is not None:
+            for rec in self.run_archive.list(ns):
+                rec["live"] = False
+                by_key[(rec["name"], rec.get("uid", ""))] = rec
+        for wf in self.client.list(WORKFLOW_API_VERSION, WORKFLOW_KIND, ns):
+            md, status = wf.get("metadata", {}), wf.get("status", {})
+            nodes = status.get("nodes", {}) or {}
+            by_key[(md.get("name", ""), md.get("uid", ""))] = {
+                "name": md.get("name", ""),
+                "uid": md.get("uid", ""),
+                "phase": status.get("phase", "Pending"),
+                "startedAt": status.get("startedAt", ""),
+                "finishedAt": status.get("finishedAt", ""),
+                "steps": len(nodes),
+                "succeededSteps": sum(1 for n in nodes.values()
+                                      if n.get("phase") == "Succeeded"),
+                "live": True,
+            }
+        out = list(by_key.values())
+        out.sort(key=lambda r: r.get("startedAt", ""), reverse=True)
+        return out
+
+    def run_detail(self, ns: str, name: str) -> Tuple[int, Any]:
+        from kubeflow_tpu.workflows.workflow import (
+            WORKFLOW_API_VERSION,
+            WORKFLOW_KIND,
+        )
+
+        wf = self.client.get_or_none(WORKFLOW_API_VERSION, WORKFLOW_KIND,
+                                     ns, name)
+        if wf is None and self.run_archive is not None:
+            rec = self.run_archive.get(ns, name)
+            if rec is not None:
+                return 200, {"name": name, "live": False,
+                             "spec": rec.get("spec", {}),
+                             "status": rec.get("status", {})}
+        if wf is None:
+            return 404, {"error": f"run {name!r} not found"}
+        return 200, {"name": name, "live": True,
+                     "spec": wf.get("spec", {}),
+                     "status": wf.get("status", {})}
+
     def dashboard_links(self) -> List[Dict[str, str]]:
         """The iframe cards the UI shell embeds (iframe-link.js parity)."""
         return [
             # /jupyter/ is the gateway's prefix-stripped route to the
             # notebook web app (reference mounts jupyter-web-app the same
-            # way); the other links are iframe placeholders until their
-            # routes land
+            # way); studies/runs are dashboard-served pages over the
+            # /api/studies + /api/runs routes
             {"text": "Notebooks", "link": "/jupyter/", "icon": "book"},
             {"text": "TPU Jobs", "link": "/tpujobs/", "icon": "donut-large"},
-            {"text": "Studies (HP tuning)", "link": "/tuning/",
+            {"text": "Studies (HP tuning)", "link": "/studies.html",
              "icon": "tune"},
-            {"text": "Workflows", "link": "/workflows/",
+            {"text": "Workflow Runs", "link": "/runs.html",
              "icon": "device-hub"},
             {"text": "Model Serving", "link": "/serving/",
              "icon": "cloud-upload"},
+            {"text": "TensorBoard", "link": "/tensorboard/",
+             "icon": "timeline"},
             {"text": "Manage Contributors", "link": "/workgroup/",
              "icon": "people"},
         ]
@@ -158,8 +371,9 @@ def main() -> None:
     from kubeflow_tpu.k8s.client import HttpKubeClient
 
     from kubeflow_tpu.auth.gatekeeper import authenticator_from_env
+    from kubeflow_tpu.workflows.archive import RunArchive
 
-    api = DashboardApi(HttpKubeClient())
+    api = DashboardApi(HttpKubeClient(), run_archive=RunArchive.from_env())
     serve_json(api.handle,
                int(os.environ.get("KFTPU_DASHBOARD_PORT", "8082")),
                authenticator=authenticator_from_env(),

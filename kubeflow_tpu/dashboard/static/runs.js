@@ -1,0 +1,93 @@
+// Workflow runs page over /api/runs/<ns> (live CRs + RunArchive merge).
+
+"use strict";
+
+const $ = (id) => document.getElementById(id);
+
+function showError(msg) {
+  const el = $("error");
+  el.textContent = msg;
+  el.style.display = "block";
+}
+
+async function api(path) {
+  const resp = await fetch(path, { credentials: "same-origin" });
+  if (resp.status === 401) {
+    window.location.href = "/login.html?next=" +
+      encodeURIComponent(window.location.pathname);
+    throw new Error("unauthenticated");
+  }
+  if (!resp.ok) throw new Error(path + " → HTTP " + resp.status);
+  return resp.json();
+}
+
+function esc(s) {
+  const d = document.createElement("div");
+  d.textContent = String(s == null ? "" : s);
+  return d.innerHTML;
+}
+
+async function openRun(ns, name) {
+  const d = await api(`/api/runs/${encodeURIComponent(ns)}/` +
+                      encodeURIComponent(name));
+  $("detail-panel").style.display = "";
+  $("detail-title").textContent =
+    `${name} — ${d.status.phase || "Pending"}` +
+    (d.live ? "" : " (archived)");
+  const nodes = Object.entries(d.status.nodes || {});
+  $("nodes").innerHTML = nodes.length
+    ? nodes.map(([step, n]) => `
+      <tr>
+        <td>${esc(step)}</td>
+        <td><span class="pill ${esc(n.phase)}">${esc(n.phase)}</span></td>
+        <td>${esc(n.startedAt || "—")}</td>
+        <td>${esc(n.finishedAt || "—")}</td>
+        <td>${esc(n.message || "")}</td>
+      </tr>`).join("")
+    : "<tr><td colspan=5>no steps recorded</td></tr>";
+  $("detail-panel").scrollIntoView({ behavior: "smooth" });
+}
+
+async function loadRuns(ns) {
+  const runs = await api("/api/runs/" + encodeURIComponent(ns));
+  $("runs").innerHTML = runs.length
+    ? runs.map((r) => `
+      <tr>
+        <td><a href="#" data-run="${esc(r.name)}">${esc(r.name)}</a></td>
+        <td><span class="pill ${esc(r.phase)}">${esc(r.phase)}</span></td>
+        <td>${esc(r.succeededSteps)}/${esc(r.steps)}</td>
+        <td>${esc(r.startedAt || "—")}</td>
+        <td>${esc(r.finishedAt || "—")}</td>
+        <td>${r.live ? "live" : "archive"}</td>
+      </tr>`).join("")
+    : "<tr><td colspan=6>no runs in this namespace</td></tr>";
+  for (const a of document.querySelectorAll("a[data-run]")) {
+    a.addEventListener("click", (e) => {
+      e.preventDefault();
+      openRun(ns, a.dataset.run).catch((err) => showError(err.message));
+    });
+  }
+}
+
+async function main() {
+  try {
+    const env = await api("/api/env-info");
+    $("user-chip").textContent = env.user;
+    const sel = $("ns-select");
+    sel.innerHTML = env.namespaces
+      .map((n) => `<option value="${esc(n)}">${esc(n)}</option>`).join("");
+    const saved = localStorage.getItem("kftpu-ns");
+    if (saved && env.namespaces.includes(saved)) sel.value = saved;
+    await loadRuns(sel.value);
+    sel.addEventListener("change", () => {
+      localStorage.setItem("kftpu-ns", sel.value);
+      $("detail-panel").style.display = "none";
+      loadRuns(sel.value).catch((err) => showError(err.message));
+    });
+    setInterval(() => loadRuns(sel.value).catch(() => {}), 15000);
+  } catch (err) {
+    if (err.message !== "unauthenticated") showError(err.message);
+  }
+}
+
+main();

@@ -1,0 +1,188 @@
+// Studies page: list studies, expand into trials + objective chart.
+// Data: /api/studies/<ns> and /api/studies/<ns>/<name>
+// (kubeflow_tpu/dashboard/server.py).
+
+"use strict";
+
+const $ = (id) => document.getElementById(id);
+
+function showError(msg) {
+  const el = $("error");
+  el.textContent = msg;
+  el.style.display = "block";
+}
+
+async function api(path) {
+  const resp = await fetch(path, { credentials: "same-origin" });
+  if (resp.status === 401) {
+    window.location.href = "/login.html?next=" +
+      encodeURIComponent(window.location.pathname);
+    throw new Error("unauthenticated");
+  }
+  if (!resp.ok) throw new Error(path + " → HTTP " + resp.status);
+  return resp.json();
+}
+
+function esc(s) {
+  const d = document.createElement("div");
+  d.textContent = String(s == null ? "" : s);
+  return d.innerHTML;
+}
+
+function fmt(v) {
+  if (v == null) return "—";
+  const n = Number(v);
+  return Number.isFinite(n)
+    ? (Math.abs(n) >= 1000 || (n !== 0 && Math.abs(n) < 0.01)
+        ? n.toExponential(3) : n.toPrecision(4))
+    : esc(v);
+}
+
+// Objective-vs-trial chart: single series (no legend needed — the panel
+// title names it), 2px line, 8px hover targets, recessive grid, text in
+// ink tokens, per-point tooltip.
+function drawChart(trials, direction) {
+  const svg = $("objective-chart");
+  const tip = $("chart-tip");
+  const pts = trials
+    .map((t, i) => ({ i, t }))
+    .filter((p) => p.t.objective != null)
+    .map((p, k) => ({ k, i: p.i, name: p.t.name,
+                      v: Number(p.t.objective) }));
+  svg.innerHTML = "";
+  if (!pts.length) {
+    svg.innerHTML =
+      '<text x="20" y="30">no completed trials reported the objective yet' +
+      "</text>";
+    return;
+  }
+  const W = 920, H = 240, L = 64, R = 16, T = 16, B = 34;
+  const xs = (k) => pts.length === 1
+    ? (L + (W - L - R) / 2)
+    : L + (k * (W - L - R)) / (pts.length - 1);
+  let lo = Math.min(...pts.map((p) => p.v));
+  let hi = Math.max(...pts.map((p) => p.v));
+  if (lo === hi) { lo -= Math.abs(lo) * 0.1 || 1; hi += Math.abs(hi) * 0.1 || 1; }
+  const ys = (v) => T + (1 - (v - lo) / (hi - lo)) * (H - T - B);
+  const NS = "http://www.w3.org/2000/svg";
+  const el = (tag, attrs, text) => {
+    const e = document.createElementNS(NS, tag);
+    for (const [k, v] of Object.entries(attrs)) e.setAttribute(k, v);
+    if (text != null) e.textContent = text;
+    return e;
+  };
+  // recessive horizontal grid at 4 ticks + y labels
+  for (let g = 0; g <= 3; g++) {
+    const v = lo + (g * (hi - lo)) / 3;
+    const y = ys(v);
+    svg.appendChild(el("line", { x1: L, x2: W - R, y1: y, y2: y,
+                                 class: "gridline" }));
+    svg.appendChild(el("text", { x: L - 8, y: y + 4,
+                                 "text-anchor": "end" }, fmt(v)));
+  }
+  svg.appendChild(el("line", { x1: L, x2: W - R, y1: H - B, y2: H - B,
+                               class: "axisline" }));
+  svg.appendChild(el("text", { x: (L + W - R) / 2, y: H - 8,
+                               "text-anchor": "middle" },
+                    "trial (completion order)"));
+  // running best line (the curve a tuner reads) + per-trial dots
+  const sign = direction === "maximize" ? 1 : -1;
+  let best = null;
+  const bestPts = pts.map((p) => {
+    if (best == null || sign * p.v > sign * best) best = p.v;
+    return { x: xs(p.k), y: ys(best) };
+  });
+  svg.appendChild(el("polyline", {
+    points: bestPts.map((p) => `${p.x},${p.y}`).join(" "),
+    fill: "none", stroke: "#1a73e8", "stroke-width": 2,
+    "stroke-linejoin": "round",
+  }));
+  for (const p of pts) {
+    const dot = el("circle", {
+      cx: xs(p.k), cy: ys(p.v), r: 4,
+      fill: "#1a73e8", stroke: "var(--surface)", "stroke-width": 2,
+    });
+    // hover target larger than the mark
+    const hit = el("circle", { cx: xs(p.k), cy: ys(p.v), r: 10,
+                               fill: "transparent" });
+    hit.addEventListener("mouseenter", () => {
+      dot.setAttribute("r", 6);
+      tip.innerHTML = `<b>${esc(p.name)}</b>objective: ${fmt(p.v)}`;
+      tip.style.display = "block";
+      tip.style.left = Math.min(xs(p.k) + 12, W - 180) + "px";
+      tip.style.top = (ys(p.v) - 10) + "px";
+    });
+    hit.addEventListener("mouseleave", () => {
+      dot.setAttribute("r", 4);
+      tip.style.display = "none";
+    });
+    svg.appendChild(dot);
+    svg.appendChild(hit);
+  }
+}
+
+async function openStudy(ns, name) {
+  const d = await api(`/api/studies/${encodeURIComponent(ns)}/` +
+                      encodeURIComponent(name));
+  $("detail-panel").style.display = "";
+  $("detail-title").textContent =
+    `${name} — ${d.objective || "objective"} (${d.direction})`;
+  drawChart(d.trials, d.direction);
+  $("trials").innerHTML = d.trials.length
+    ? d.trials.map((t) => `
+      <tr>
+        <td>${esc(t.name)}</td>
+        <td><code>${esc(JSON.stringify(t.parameters))}</code></td>
+        <td><span class="pill ${esc(t.phase)}">${esc(t.phase)}</span></td>
+        <td>${fmt(t.objective)}</td>
+      </tr>`).join("")
+    : "<tr><td colspan=4>no trials yet</td></tr>";
+  $("detail-panel").scrollIntoView({ behavior: "smooth" });
+}
+
+async function loadStudies(ns) {
+  const studies = await api("/api/studies/" + encodeURIComponent(ns));
+  $("studies").innerHTML = studies.length
+    ? studies.map((s) => `
+      <tr>
+        <td><a href="#" data-study="${esc(s.name)}">${esc(s.name)}</a></td>
+        <td>${esc(s.algorithm)}</td>
+        <td>${esc(s.objective)} (${esc(s.direction)})</td>
+        <td><span class="pill ${esc(s.phase)}">${esc(s.phase)}</span></td>
+        <td>${esc(s.trials)}${s.trialsRunning
+            ? ` (${esc(s.trialsRunning)} running)` : ""}</td>
+        <td>${s.bestTrial
+            ? `${fmt(s.bestTrial.objective)} · ${esc(s.bestTrial.name)}`
+            : "—"}</td>
+      </tr>`).join("")
+    : "<tr><td colspan=6>no studies in this namespace</td></tr>";
+  for (const a of document.querySelectorAll("a[data-study]")) {
+    a.addEventListener("click", (e) => {
+      e.preventDefault();
+      openStudy(ns, a.dataset.study).catch((err) => showError(err.message));
+    });
+  }
+}
+
+async function main() {
+  try {
+    const env = await api("/api/env-info");
+    $("user-chip").textContent = env.user;
+    const sel = $("ns-select");
+    sel.innerHTML = env.namespaces
+      .map((n) => `<option value="${esc(n)}">${esc(n)}</option>`).join("");
+    const saved = localStorage.getItem("kftpu-ns");
+    if (saved && env.namespaces.includes(saved)) sel.value = saved;
+    await loadStudies(sel.value);
+    sel.addEventListener("change", () => {
+      localStorage.setItem("kftpu-ns", sel.value);
+      $("detail-panel").style.display = "none";
+      loadStudies(sel.value).catch((err) => showError(err.message));
+    });
+    setInterval(() => loadStudies(sel.value).catch(() => {}), 15000);
+  } catch (err) {
+    if (err.message !== "unauthenticated") showError(err.message);
+  }
+}
+
+main();

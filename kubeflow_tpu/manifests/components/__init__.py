@@ -7,6 +7,7 @@ from kubeflow_tpu.manifests.components import (  # noqa: F401
     notebooks,
     serving,
     tenancy,
+    tensorboard,
     tpujob_operator,
     tuning,
     workflows,

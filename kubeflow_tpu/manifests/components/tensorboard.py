@@ -1,0 +1,96 @@
+"""TensorBoard component: visualize training logs + profiler traces.
+
+Reference: ``/root/reference/kubeflow/tensorboard/tensorboard.libsonnet``
+(Service + Deployment + optional Istio VirtualService at
+``/tensorboard/<name>/``, ambassador mapping annotation, gcp/aws log-dir
+volume variants). The TPU build keeps the same surface and points the log
+dir at either a PVC (mounted read-only — the trainer's profiler/metrics
+write side, ``kubeflow_tpu/utils/profiler.py``) or a ``gs://`` path read
+directly by TensorBoard. This is where the committed XLA traces
+(``bench.py --profile``) get opened.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from kubeflow_tpu.config.deployment import DeploymentConfig
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.manifests.registry import register
+
+DEFAULTS: Dict[str, Any] = {
+    "name": "tensorboard",
+    "image": "tensorflow/tensorflow:2.15.0",
+    "log_dir": "/logs",          # mount point, or a gs:// url
+    "pvc": "training-logs",      # PVC holding the logs; "" when log_dir
+                                 # is a gs:// url read directly
+    "port": 80,
+    "target_port": 6006,
+    "replicas": 1,
+    "inject_istio": False,       # VirtualService at /tensorboard/<name>/
+    "cpu": "1",
+    "memory": "1Gi",
+    "cpu_limit": "4",
+    "memory_limit": "4Gi",
+}
+
+
+def _virtual_service(name: str, ns: str, port: int) -> o.Obj:
+    """Prefix route + rewrite, the libsonnet istioVirtualService shape."""
+    return {
+        "apiVersion": "networking.istio.io/v1beta1",
+        "kind": "VirtualService",
+        "metadata": o.metadata(name, ns),
+        "spec": {
+            "hosts": ["*"],
+            "gateways": ["kubeflow-gateway"],
+            "http": [{
+                "match": [{"uri": {"prefix": f"/tensorboard/{name}/"}}],
+                "rewrite": {"uri": "/"},
+                "route": [{"destination": {
+                    "host": f"{name}.{ns}.svc.cluster.local",
+                    "port": {"number": port},
+                }}],
+            }],
+        },
+    }
+
+
+@register("tensorboard", DEFAULTS,
+          "TensorBoard over a training-logs PVC or GCS path")
+def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
+    ns = config.namespace
+    name = params["name"]
+    log_dir = params["log_dir"]
+    use_pvc = bool(params["pvc"]) and not str(log_dir).startswith("gs://")
+
+    mounts = ([{"name": "logs", "mountPath": log_dir, "readOnly": True}]
+              if use_pvc else None)
+    volumes = ([{"name": "logs",
+                 "persistentVolumeClaim": {"claimName": params["pvc"],
+                                           "readOnly": True}}]
+               if use_pvc else None)
+    ctr = o.container(
+        name, params["image"],
+        command=["tensorboard"],
+        args=[f"--logdir={log_dir}", f"--port={params['target_port']}",
+              "--bind_all"],
+        ports=[params["target_port"]],
+        resources={
+            "requests": {"cpu": params["cpu"],
+                         "memory": params["memory"]},
+            "limits": {"cpu": params["cpu_limit"],
+                       "memory": params["memory_limit"]},
+        },
+        volume_mounts=mounts,
+    )
+    objs: List[o.Obj] = [
+        o.deployment(name, ns, o.pod_spec([ctr], volumes=volumes),
+                     replicas=int(params["replicas"])),
+        o.service(name, ns, {"app": name},
+                  [{"name": "tb", "port": int(params["port"]),
+                    "targetPort": int(params["target_port"])}]),
+    ]
+    if params["inject_istio"]:
+        objs.append(_virtual_service(name, ns, int(params["port"])))
+    return objs

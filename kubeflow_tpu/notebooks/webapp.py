@@ -21,23 +21,26 @@ from kubeflow_tpu.notebooks.controller import (
     NOTEBOOK_KIND,
     notebook,
 )
+from kubeflow_tpu.tenancy.authz import allow_all, default_authorizer  # noqa: F401
 from kubeflow_tpu.utils.jsonhttp import USER_HEADER, serve_json  # noqa: F401
 
 # authorizer(user, verb, namespace, resource) -> bool
 Authorizer = Callable[[str, str, str, str], bool]
 
 
-def allow_all(user: str, verb: str, ns: str, resource: str) -> bool:
-    return True
-
-
 class NotebookWebApp:
-    """Route table + handlers; independent of any HTTP server."""
+    """Route table + handlers; independent of any HTTP server.
+
+    Authorization defaults to Profile-RBAC per request (the reference's
+    SubjectAccessReview flow, ``/root/reference/components/jupyter-web-app/
+    backend/kubeflow_jupyter/common/api.py:36-66``); ``allow_all`` must be
+    passed explicitly (or via ``KFTPU_DEV_ALLOW_ALL=1``) for dev use."""
 
     def __init__(self, client: KubeClient,
-                 authorize: Authorizer = allow_all) -> None:
+                 authorize: Optional[Authorizer] = None) -> None:
         self.client = client
-        self.authorize = authorize
+        self.authorize = (authorize if authorize is not None
+                          else default_authorizer(client))
         self.routes = [
             ("GET", r"^/api/namespaces$", self.list_namespaces),
             ("GET", r"^/api/namespaces/(?P<ns>[^/]+)/notebooks$",

@@ -132,6 +132,19 @@ def gcloud_plan(config: DeploymentConfig) -> List[List[str]]:
     return plan
 
 
+def kubeconfig_path(app_dir: str) -> str:
+    """Where Apply materializes cluster credentials (GetK8sConfig parity:
+    ``gcp.go:200`` builds a rest.Config; here the kubeconfig file is the
+    hand-off to the k8s apply layer and kubectl alike)."""
+    return os.path.join(app_dir, GCP_CONFIG_DIR, "kubeconfig")
+
+
+def kube_context(config: DeploymentConfig) -> str:
+    """The context name get-credentials writes (gke_<project>_<zone>_<name>)."""
+    p = _params(config)
+    return f"gke_{p['project']}_{p['zone']}_{p['cluster_name']}"
+
+
 @register_platform("gcp-tpu")
 class GcpTpuPlatform(Platform):
     name = "gcp-tpu"
@@ -157,6 +170,11 @@ class GcpTpuPlatform(Platform):
             paths.append(path)
         return paths
 
+    # operation polling (blockingWait, gcp.go:328-371)
+    op_poll_initial_s = 5.0
+    op_poll_max_s = 60.0
+    op_timeout_s = 1800.0
+
     def apply(self, config: DeploymentConfig, app_dir: str, *,
               dry_run: bool = True) -> Dict:
         plan = self._load_plan(config, app_dir)
@@ -164,11 +182,72 @@ class GcpTpuPlatform(Platform):
             return {"dry_run": True, "commands": plan,
                     "note": "gcloud not executed"
                             + ("" if dry_run else " (binary not found)")}
+        p = _params(config)
+        kubeconfig = kubeconfig_path(app_dir)
         executed = []
         for cmd in plan:
-            self._run_with_backoff(cmd)
+            env = None
+            if "get-credentials" in cmd:
+                # GetK8sConfig parity: credentials land in the app dir's
+                # own kubeconfig, not the user's ~/.kube/config
+                os.makedirs(os.path.dirname(kubeconfig), exist_ok=True)
+                env = {**os.environ, "KUBECONFIG": kubeconfig}
+            self._run_with_backoff(cmd, env=env)
             executed.append(cmd)
-        return {"dry_run": False, "commands": executed}
+            if cmd[:2] == ["gcloud", "container"] and "create" in cmd:
+                # the CLI can return while the server-side operation is
+                # still provisioning (and always does with --async);
+                # blockingWait on the cluster's operations
+                self.wait_for_operations(p["project"], p["zone"],
+                                         p["cluster_name"])
+        return {"dry_run": False, "commands": executed,
+                "kubeconfig": kubeconfig,
+                "context": kube_context(config)}
+
+    def wait_for_operations(self, project: str, zone: str,
+                            cluster: str) -> None:
+        """Poll THIS cluster's operations until none are pending — the
+        ``blockingWait`` loop (``gcp.go:328-371``): exponential backoff,
+        surfacing operation errors, hard timeout.
+
+        Lists all operations and filters client-side by targetLink so (a)
+        an op that fails by transitioning to DONE-with-error is seen, and
+        (b) other teams' operations in a shared project/zone neither block
+        nor fail this apply."""
+        deadline = time.monotonic() + self.op_timeout_s
+        delay = self.op_poll_initial_s
+        marker = f"/clusters/{cluster}"
+        while True:
+            cmd = ["gcloud", "container", "operations", "list",
+                   "--zone", zone, "--format", "json"]
+            if project:
+                cmd += ["--project", project]
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode == 0:
+                try:
+                    ops = json.loads(proc.stdout or "[]")
+                except ValueError:
+                    ops = []
+                mine = [op for op in ops
+                        if marker in op.get("targetLink", "")
+                        or op.get("targetLink", "").endswith(marker)]
+                errored = [op for op in mine
+                           if op.get("status") == "DONE"
+                           and (op.get("error")
+                                or op.get("statusMessage"))]
+                if errored:
+                    op = errored[0]
+                    raise RuntimeError(
+                        f"operation {op.get('name', '?')} failed: "
+                        f"{op.get('statusMessage') or op.get('error')}")
+                if not any(op.get("status") != "DONE" for op in mine):
+                    return
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"operations still pending after "
+                    f"{self.op_timeout_s:.0f}s in zone {zone}")
+            time.sleep(delay)
+            delay = min(delay * 2, self.op_poll_max_s)
 
     def delete(self, config: DeploymentConfig, app_dir: str, *,
                dry_run: bool = True) -> Dict:
@@ -190,11 +269,12 @@ class GcpTpuPlatform(Platform):
                 return json.load(f)
         return gcloud_plan(config)
 
-    def _run_with_backoff(self, cmd: List[str]) -> None:
-        """blockingWait-style retry (gcp.go:328-371 exponential backoff)."""
+    def _run_with_backoff(self, cmd: List[str], env=None) -> None:
+        """Per-command retry with exponential backoff."""
         delay = self.backoff_s
         for attempt in range(1, self.max_attempts + 1):
-            proc = subprocess.run(cmd, capture_output=True, text=True)
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  env=env)
             if proc.returncode == 0:
                 return
             if attempt == self.max_attempts:

@@ -18,6 +18,11 @@ from kubeflow_tpu.workflows.workflow import (  # noqa: F401
     resource_step,
     workflow,
 )
+from kubeflow_tpu.workflows.archive import (  # noqa: F401
+    ArtifactStore,
+    RunArchive,
+    store_artifact,
+)
 from kubeflow_tpu.workflows.controller import WorkflowController  # noqa: F401
 from kubeflow_tpu.workflows.cron import (  # noqa: F401
     SCHEDULED_WORKFLOW_KIND,

@@ -1,0 +1,217 @@
+"""Run history + artifact store that survive the workflow controller.
+
+KFP-persistence parity: the reference's pipeline package runs an
+api-service backed by MySQL plus a MinIO artifact store so run history
+outlives both the Argo controller and the Workflow CRs
+(``/root/reference/kubeflow/pipeline/pipeline-apiserver.libsonnet``,
+``mysql.libsonnet``, ``minio.libsonnet``). The TPU build collapses that
+to two small stores on a PVC/GCS-mounted directory — no database pod to
+operate, same durability contract:
+
+- :class:`RunArchive` — one JSON document per run (keyed ns/name/uid),
+  written on every status transition, so a deleted Workflow CR or a
+  restarted controller loses nothing.
+- :class:`ArtifactStore` — content-addressed-ish artifact files under
+  ``<root>/<ns>/<run>/<step>/<name>``; workloads report artifacts with
+  :func:`store_artifact` (the ``KFTPU_ARTIFACT_DIR`` env the operator
+  injects plays the role of Argo's sidecar-upload to MinIO).
+
+The dashboard's runs page reads the merge of live CRs and this archive.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import tempfile
+from typing import Any, Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+ENV_ARCHIVE_DIR = "KFTPU_RUN_ARCHIVE_DIR"
+ENV_ARTIFACT_DIR = "KFTPU_ARTIFACT_DIR"
+
+_SAFE = re.compile(r"[^A-Za-z0-9._-]")
+
+
+def _safe(part: str) -> str:
+    """One path segment: strip separators/specials, never empty."""
+    return _SAFE.sub("_", part) or "_"
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path), prefix=".tmp-")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+class RunArchive:
+    """Append/update store of workflow run records under ``root``."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+
+    @classmethod
+    def from_env(cls, environ=None) -> Optional["RunArchive"]:
+        env = os.environ if environ is None else environ
+        root = env.get(ENV_ARCHIVE_DIR)
+        return cls(root) if root else None
+
+    def _path(self, ns: str, name: str, uid: str) -> str:
+        return os.path.join(self.root, _safe(ns),
+                            f"{_safe(name)}.{_safe(uid or 'nouid')}.json")
+
+    def record(self, wf: Dict[str, Any]) -> None:
+        """Persist the run's current spec+status (idempotent, atomic)."""
+        md = wf.get("metadata", {})
+        rec = {
+            "name": md.get("name", ""),
+            "namespace": md.get("namespace", ""),
+            "uid": md.get("uid", ""),
+            "labels": md.get("labels", {}) or {},
+            "spec": wf.get("spec", {}),
+            "status": wf.get("status", {}),
+        }
+        try:
+            self._write(rec)
+        except OSError:
+            # archive unavailability must never wedge reconciliation —
+            # the CR still carries the status; log and move on
+            log.exception("run archive write failed for %s/%s",
+                          rec["namespace"], rec["name"])
+
+    def _write(self, rec: Dict[str, Any]) -> None:
+        _atomic_write(
+            self._path(rec["namespace"], rec["name"], rec["uid"]),
+            json.dumps(rec, sort_keys=True).encode())
+
+    def list(self, ns: str) -> List[Dict[str, Any]]:
+        """Run summaries for a namespace, newest start first."""
+        d = os.path.join(self.root, _safe(ns))
+        out = []
+        try:
+            files = os.listdir(d)
+        except OSError:
+            return []
+        for fn in files:
+            if not fn.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(d, fn)) as f:
+                    rec = json.load(f)
+            except (OSError, ValueError):
+                continue
+            status = rec.get("status", {})
+            nodes = status.get("nodes", {}) or {}
+            out.append({
+                "name": rec.get("name", ""),
+                "uid": rec.get("uid", ""),
+                "phase": status.get("phase", ""),
+                "startedAt": status.get("startedAt", ""),
+                "finishedAt": status.get("finishedAt", ""),
+                "steps": len(nodes),
+                "succeededSteps": sum(
+                    1 for n in nodes.values()
+                    if n.get("phase") == "Succeeded"),
+            })
+        out.sort(key=lambda r: r.get("startedAt", ""), reverse=True)
+        return out
+
+    def get(self, ns: str, name: str,
+            uid: str = "") -> Optional[Dict[str, Any]]:
+        """Full record; without ``uid``, the newest run of that name."""
+        if uid:
+            try:
+                with open(self._path(ns, name, uid)) as f:
+                    return json.load(f)
+            except (OSError, ValueError):
+                return None
+        candidates = []
+        d = os.path.join(self.root, _safe(ns))
+        try:
+            files = os.listdir(d)
+        except OSError:
+            return None
+        prefix = f"{_safe(name)}."
+        for fn in files:
+            if fn.startswith(prefix) and fn.endswith(".json"):
+                try:
+                    with open(os.path.join(d, fn)) as f:
+                        candidates.append(json.load(f))
+                except (OSError, ValueError):
+                    continue
+        if not candidates:
+            return None
+        candidates.sort(
+            key=lambda r: r.get("status", {}).get("startedAt", ""))
+        return candidates[-1]
+
+
+class ArtifactStore:
+    """File/PVC-backed artifact store (the MinIO role, collapsed)."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+
+    @classmethod
+    def from_env(cls, environ=None) -> Optional["ArtifactStore"]:
+        env = os.environ if environ is None else environ
+        root = env.get(ENV_ARTIFACT_DIR)
+        return cls(root) if root else None
+
+    def _dir(self, ns: str, run: str, step: str) -> str:
+        return os.path.join(self.root, _safe(ns), _safe(run), _safe(step))
+
+    def put(self, ns: str, run: str, step: str, name: str,
+            data: bytes) -> str:
+        path = os.path.join(self._dir(ns, run, step), _safe(name))
+        _atomic_write(path, data)
+        return path
+
+    def get(self, ns: str, run: str, step: str, name: str) -> bytes:
+        with open(os.path.join(self._dir(ns, run, step), _safe(name)),
+                  "rb") as f:
+            return f.read()
+
+    def list(self, ns: str, run: str) -> List[Dict[str, Any]]:
+        base = os.path.join(self.root, _safe(ns), _safe(run))
+        out = []
+        for cur, _dirs, files in os.walk(base):
+            for fn in files:
+                full = os.path.join(cur, fn)
+                out.append({
+                    "step": os.path.relpath(cur, base),
+                    "name": fn,
+                    "bytes": os.path.getsize(full),
+                })
+        out.sort(key=lambda a: (a["step"], a["name"]))
+        return out
+
+
+def store_artifact(name: str, data: bytes, environ=None) -> Optional[str]:
+    """Workload-side artifact report (Argo sidecar-upload equivalent).
+
+    Inside a workflow-step pod the controller injects
+    ``KFTPU_ARTIFACT_DIR`` plus the run/step identity; a no-op (returns
+    None) outside that context so workloads can call it unconditionally.
+    """
+    env = os.environ if environ is None else environ
+    store = ArtifactStore.from_env(env)
+    if store is None:
+        return None
+    return store.put(
+        env.get("KFTPU_NAMESPACE", "default"),
+        env.get("KFTPU_WORKFLOW_NAME", env.get("KFTPU_JOB_NAME", "run")),
+        env.get("KFTPU_WORKFLOW_STEP", "step"),
+        name, data)

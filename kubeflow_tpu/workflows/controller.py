@@ -56,12 +56,18 @@ def _now() -> str:
 
 
 class WorkflowController:
-    """Reconciles Workflow CRs on any :class:`KubeClient`."""
+    """Reconciles Workflow CRs on any :class:`KubeClient`.
+
+    ``archive`` (a :class:`kubeflow_tpu.workflows.archive.RunArchive`)
+    persists every status transition, so run history survives controller
+    restarts and CR deletion — the KFP persistence-agent role."""
 
     def __init__(self, client: KubeClient,
-                 namespace: Optional[str] = None) -> None:
+                 namespace: Optional[str] = None,
+                 archive=None) -> None:
         self.client = client
         self.namespace = namespace
+        self.archive = archive
 
     # -- reconcile ---------------------------------------------------------
 
@@ -148,6 +154,17 @@ class WorkflowController:
         node["startedAt"] = _now()
         if step["type"] == STEP_CONTAINER:
             attempt = int(node.get("attempt", 0))
+            env = dict(step.get("env") or {})
+            # artifact-store identity for kubeflow_tpu.workflows.archive.
+            # store_artifact (the Argo sidecar-upload contract)
+            env.setdefault("KFTPU_WORKFLOW_NAME", wf_name)
+            env.setdefault("KFTPU_WORKFLOW_STEP", step["name"])
+            env.setdefault("KFTPU_NAMESPACE", ns)
+            import os as _os
+
+            if _os.environ.get("KFTPU_ARTIFACT_DIR"):
+                env.setdefault("KFTPU_ARTIFACT_DIR",
+                               _os.environ["KFTPU_ARTIFACT_DIR"])
             pod = o.pod(
                 self._pod_name(wf_name, step, attempt), ns,
                 o.pod_spec(
@@ -155,7 +172,7 @@ class WorkflowController:
                         "main", step["image"],
                         command=step.get("command"),
                         args=step.get("args"),
-                        env=step.get("env"),
+                        env=env,
                         volume_mounts=step.get("volumeMounts"),
                     )],
                     restart_policy="Never",
@@ -240,6 +257,8 @@ class WorkflowController:
         wf = dict(wf)
         wf["status"] = merged
         update_status_ignore_missing(self.client, wf)
+        if self.archive is not None:
+            self.archive.record(wf)
 
     # -- runtime -----------------------------------------------------------
 
@@ -266,10 +285,13 @@ def main() -> None:
 
     from kubeflow_tpu.k8s.client import HttpKubeClient
 
+    from kubeflow_tpu.workflows.archive import RunArchive
+
     logging.basicConfig(level=logging.INFO)
     ns = os.environ.get("KFTPU_WORKFLOW_NAMESPACE") or None
-    WorkflowController(HttpKubeClient(),
-                       namespace=ns).build_controller().run_forever()
+    WorkflowController(
+        HttpKubeClient(), namespace=ns,
+        archive=RunArchive.from_env()).build_controller().run_forever()
 
 
 if __name__ == "__main__":

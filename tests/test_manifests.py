@@ -78,6 +78,44 @@ def test_serving_requests_tpu(config):
     assert ports == {"rest": 8500, "grpc": 9000}  # tf-serving parity ports
 
 
+def test_tensorboard_golden(config):
+    objs = render_component(config, ComponentSpec("tensorboard"))
+    kinds = [x["kind"] for x in objs]
+    assert kinds == ["Deployment", "Service"]
+    deploy, svc = objs
+    ctr = deploy["spec"]["template"]["spec"]["containers"][0]
+    assert "--logdir=/logs" in ctr["args"]
+    assert ctr["volumeMounts"][0]["readOnly"] is True
+    vols = deploy["spec"]["template"]["spec"]["volumes"]
+    assert vols[0]["persistentVolumeClaim"]["claimName"] == "training-logs"
+    assert svc["spec"]["ports"][0]["targetPort"] == 6006
+
+
+def test_tensorboard_gcs_and_istio(config):
+    objs = render_component(config, ComponentSpec("tensorboard", params={
+        "log_dir": "gs://bucket/logs", "pvc": "", "inject_istio": True}))
+    kinds = [x["kind"] for x in objs]
+    assert kinds == ["Deployment", "Service", "VirtualService"]
+    ctr = objs[0]["spec"]["template"]["spec"]["containers"][0]
+    assert "--logdir=gs://bucket/logs" in ctr["args"]
+    assert "volumeMounts" not in ctr  # gs:// read directly, no PVC
+    vs = objs[2]
+    match = vs["spec"]["http"][0]["match"][0]["uri"]["prefix"]
+    assert match == "/tensorboard/tensorboard/"
+
+
+def test_standard_preset_includes_tuning_and_workflows():
+    cfg = preset("standard", "demo")
+    names = [c.name for c in cfg.components]
+    assert "tuning" in names and "workflows" in names
+    objs = render_all(cfg)
+    kinds = {(x["kind"], x["metadata"]["name"]) for x in objs}
+    assert ("CustomResourceDefinition", "studies.kubeflow-tpu.org") in kinds \
+        or any(k == "CustomResourceDefinition" and "stud" in n
+               for k, n in kinds)
+    assert any("workflow" in n for k, n in kinds if k == "Deployment")
+
+
 def test_render_all_prepends_namespace(config):
     objs = render_all(config)
     assert objs[0]["kind"] == "Namespace"

@@ -112,6 +112,87 @@ def test_gcp_iam_bindings():
         name="demo", platform="gcp-tpu")) == []
 
 
+def _fake_gcloud(tmp_path, script_body):
+    """Drop a fake `gcloud` on PATH that records its argv per call."""
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir(exist_ok=True)
+    gcloud = bin_dir / "gcloud"
+    gcloud.write_text("#!/bin/sh\n" + script_body)
+    gcloud.chmod(0o755)
+    return str(bin_dir)
+
+
+def test_gcp_apply_executes_waits_and_wires_kubeconfig(tmp_path,
+                                                       monkeypatch):
+    """Real (non-dry) apply against a fake gcloud: every plan command runs,
+    blockingWait polls operations after each create until the pending list
+    drains (gcp.go:328-371), and get-credentials lands in the app dir's own
+    kubeconfig (GetK8sConfig parity, gcp.go:200)."""
+    calls = tmp_path / "calls.log"
+    ops_state = tmp_path / "ops_state"
+    ops_state.write_text("2")  # first two polls report a pending op
+    script = f'''echo "$@" >> {calls}
+case "$*" in
+  *"operations list"*)
+    n=$(cat {ops_state})
+    if [ "$n" -gt 0 ]; then
+      echo $((n - 1)) > {ops_state}
+      echo '[{{"name": "op-123", "status": "RUNNING", "targetLink": "https://container.googleapis.com/v1/projects/my-proj/zones/us-east5-a/clusters/demo"}}, {{"name": "op-other", "status": "RUNNING", "statusMessage": "someone else", "targetLink": ".../clusters/not-ours"}}]'
+    else
+      echo '[{{"name": "op-other", "status": "RUNNING", "statusMessage": "someone else", "targetLink": ".../clusters/not-ours"}}]'
+    fi
+    ;;
+  *get-credentials*)
+    echo "ctx" > "$KUBECONFIG"
+    ;;
+esac
+exit 0
+'''
+    monkeypatch.setenv("PATH", _fake_gcloud(tmp_path, script) + os.pathsep
+                       + os.environ["PATH"])
+    config = _gcp_config()
+    platform = GcpTpuPlatform()
+    platform.backoff_s = 0.0
+    platform.op_poll_initial_s = 0.0
+    platform.generate(config, str(tmp_path))
+    report = platform.apply(config, str(tmp_path), dry_run=False)
+    assert report["dry_run"] is False
+    assert report["context"] == "gke_my-proj_us-east5-a_demo"
+    assert os.path.exists(report["kubeconfig"])  # credential hand-off
+    logged = calls.read_text().splitlines()
+    # every plan command executed, operations polled after the creates
+    assert sum("clusters create" in line for line in logged) == 1
+    assert sum("operations list" in line for line in logged) >= 3
+    assert any("get-credentials" in line for line in logged)
+
+
+def test_gcp_wait_for_operations_surfaces_errors(tmp_path, monkeypatch):
+    """A DONE-with-error operation on OUR cluster raises (GKE ops fail by
+    transitioning to DONE with statusMessage set, not by staying pending)."""
+    script = ('echo \'[{"name": "op-9", "status": "DONE", '
+              '"statusMessage": "quota exceeded", '
+              '"targetLink": ".../clusters/demo"}]\'\nexit 0\n')
+    monkeypatch.setenv("PATH", _fake_gcloud(tmp_path, script) + os.pathsep
+                       + os.environ["PATH"])
+    platform = GcpTpuPlatform()
+    platform.op_poll_initial_s = 0.0
+    with pytest.raises(RuntimeError, match="quota exceeded"):
+        platform.wait_for_operations("my-proj", "us-central2-b", "demo")
+
+
+def test_gcp_wait_ignores_other_clusters_operations(tmp_path, monkeypatch):
+    """Another team's pending/errored ops in the shared zone must neither
+    block nor fail this cluster's apply."""
+    script = ('echo \'[{"name": "op-x", "status": "RUNNING", '
+              '"statusMessage": "their problem", '
+              '"targetLink": ".../clusters/theirs"}]\'\nexit 0\n')
+    monkeypatch.setenv("PATH", _fake_gcloud(tmp_path, script) + os.pathsep
+                       + os.environ["PATH"])
+    platform = GcpTpuPlatform()
+    platform.op_poll_initial_s = 0.0
+    platform.wait_for_operations("my-proj", "us-central2-b", "demo")  # no raise
+
+
 def test_gcloud_plan_honors_spot():
     config = _gcp_config(slices=[{"shape": "v5e-8", "count": 1,
                                   "spot": True}])

@@ -206,4 +206,104 @@ def test_dashboard_metrics_and_links(dash_client):
     assert code == 200 and isinstance(metrics, list)
     _, links = api.handle("GET", "/api/dashboard-links", None)
     assert any(card["text"] == "TPU Jobs" for card in links)
+    assert any(card["link"] == "/studies.html" for card in links)
+    assert any(card["link"] == "/runs.html" for card in links)
     assert api.handle("POST", "/api/env-info", {})[0] == 405
+
+
+def test_dashboard_cluster_metrics_scrapes_targets(dash_client):
+    """Weak-8 fix: the metrics panel aggregates component serve_metrics
+    endpoints, not the dashboard's own process registry."""
+    from kubeflow_tpu.dashboard.server import ClusterMetricsService
+    from kubeflow_tpu.utils.metrics import Registry, serve_metrics
+
+    reg = Registry()
+    reg.counter("kftpu_test_jobs_total", "jobs").inc()
+    t = serve_metrics(0, reg)
+    try:
+        port = t.server.server_address[1]
+        svc = ClusterMetricsService(
+            {"operator": f"http://127.0.0.1:{port}/metrics",
+             "down": "http://127.0.0.1:9/metrics"})
+        out = svc.query("kftpu_")
+        by_metric = {m["metric"]: m["value"] for m in out}
+        assert by_metric['up{target="operator"}'] == 1.0
+        assert by_metric['up{target="down"}'] == 0.0
+        assert any("kftpu_test_jobs_total" in k and v == 1.0
+                   for k, v in by_metric.items())
+    finally:
+        t.server.shutdown()
+
+
+def test_dashboard_studies_pages(dash_client):
+    from kubeflow_tpu.tuning.study import STUDY_LABEL, study, trial
+
+    s = study("opt-lr", "alice", {
+        "algorithm": {"name": "bayesian"},
+        "objective": {"metric": "loss", "type": "minimize"},
+        "parameters": [{"name": "lr", "type": "double", "min": 1e-4,
+                        "max": 1e-1}],
+        "trialTemplate": {"image": "img"},
+    })
+    dash_client.create(s)
+    s = dash_client.get(s["apiVersion"], s["kind"], "alice", "opt-lr")
+    s["status"] = {"phase": "Running", "trials": 2, "trialsRunning": 1,
+                   "bestTrial": {"name": "opt-lr-0", "objective": 0.4}}
+    dash_client.update_status(s)
+    t0 = trial(s, 0, {"lr": 0.01})
+    t0["status"] = {"phase": "Succeeded", "observation": {"loss": 0.4}}
+    t1 = trial(s, 1, {"lr": 0.05})
+    t1["status"] = {"phase": "Running"}
+    dash_client.create(t0)
+    dash_client.create(t1)
+
+    api = DashboardApi(dash_client)
+    code, studies = api.handle("GET", "/api/studies/alice", None)
+    assert code == 200
+    assert studies[0]["name"] == "opt-lr"
+    assert studies[0]["bestTrial"]["objective"] == 0.4
+
+    code, detail = api.handle("GET", "/api/studies/alice/opt-lr", None)
+    assert code == 200
+    objs = {t["name"]: t["objective"] for t in detail["trials"]}
+    assert objs[t0["metadata"]["name"]] == 0.4
+    assert objs[t1["metadata"]["name"]] is None
+    assert api.handle("GET", "/api/studies/alice/nope", None)[0] == 404
+
+
+def test_dashboard_runs_merges_live_and_archive(dash_client, tmp_path):
+    from kubeflow_tpu.workflows import RunArchive, WorkflowController
+    from kubeflow_tpu.workflows.workflow import (
+        WORKFLOW_API_VERSION,
+        container_step,
+        workflow,
+    )
+
+    archive = RunArchive(str(tmp_path / "runs"))
+    ctrl = WorkflowController(dash_client, archive=archive)
+    dash_client.create(workflow("old-run", "alice",
+                                [container_step("a", "img")]))
+    ctrl.reconcile("alice", "old-run")
+    for pod in dash_client.list("v1", "Pod", "alice"):
+        pod.setdefault("status", {})["phase"] = "Succeeded"
+        dash_client.update_status(pod)
+    ctrl.reconcile("alice", "old-run")
+    dash_client.delete(WORKFLOW_API_VERSION, "Workflow", "alice", "old-run")
+    dash_client.create(workflow("live-run", "alice",
+                                [container_step("b", "img")]))
+    ctrl.reconcile("alice", "live-run")
+
+    api = DashboardApi(dash_client, run_archive=archive)
+    code, runs = api.handle("GET", "/api/runs/alice", None)
+    assert code == 200
+    by_name = {r["name"]: r for r in runs}
+    assert by_name["old-run"]["live"] is False
+    assert by_name["old-run"]["phase"] == "Succeeded"
+    assert by_name["live-run"]["live"] is True
+
+    code, detail = api.handle("GET", "/api/runs/alice/old-run", None)
+    assert code == 200 and detail["live"] is False
+    assert detail["status"]["nodes"]["a"]["phase"] == "Succeeded"
+    code, detail = api.handle("GET", "/api/runs/alice/live-run", None)
+    assert code == 200 and detail["live"] is True
+    assert api.handle("GET", "/api/runs/alice/nope", None)[0] == 404

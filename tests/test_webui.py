@@ -52,6 +52,20 @@ def test_dashboard_serves_ui_and_api(dashboard_server):
     assert json.loads(body)["namespaces"] == ["kubeflow"]
 
 
+def test_dashboard_serves_studies_and_runs_pages(dashboard_server):
+    for page, marker in (("/studies.html", b"objective-chart"),
+                         ("/runs.html", b"Workflow Runs"),
+                         ("/studies.js", b"drawChart"),
+                         ("/runs.js", b"loadRuns")):
+        code, body, _ = _get(dashboard_server + page)
+        assert code == 200 and marker in body, page
+    # the API routes the pages consume exist (empty namespace → empty lists)
+    code, body, _ = _get(dashboard_server + "/api/studies/kubeflow")
+    assert code == 200 and json.loads(body) == []
+    code, body, _ = _get(dashboard_server + "/api/runs/kubeflow")
+    assert code == 200 and json.loads(body) == []
+
+
 def test_dashboard_static_traversal_blocked(dashboard_server):
     code, _, _ = _get(dashboard_server + "/../../etc/passwd")
     assert code == 404

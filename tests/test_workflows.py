@@ -52,6 +52,91 @@ def get_wf(client, name, ns="default"):
     return client.get(WORKFLOW_API_VERSION, WORKFLOW_KIND, ns, name)
 
 
+# -- run archive (KFP persistence parity) ----------------------------------
+
+def test_run_archive_survives_cr_deletion(client, tmp_path):
+    """Run history must outlive the Workflow CR (the mysql/api-server role,
+    /root/reference/kubeflow/pipeline/pipeline-apiserver.libsonnet)."""
+    from kubeflow_tpu.workflows import RunArchive
+
+    archive = RunArchive(str(tmp_path / "runs"))
+    ctrl = WorkflowController(client, archive=archive)
+    client.create(workflow("w", "default", [container_step("a", "img")]))
+    ctrl.reconcile("default", "w")
+    finish_pods(client)
+    ctrl.reconcile("default", "w")
+    assert get_wf(client, "w")["status"]["phase"] == "Succeeded"
+
+    client.delete(WORKFLOW_API_VERSION, WORKFLOW_KIND, "default", "w")
+    runs = archive.list("default")
+    assert len(runs) == 1
+    assert runs[0]["phase"] == "Succeeded"
+    assert runs[0]["succeededSteps"] == 1
+    full = archive.get("default", "w")
+    assert full["status"]["nodes"]["a"]["phase"] == "Succeeded"
+
+
+def test_run_archive_survives_controller_restart(client, tmp_path):
+    """Kill the controller mid-run; a fresh instance over the same archive
+    directory finishes the run with nothing lost."""
+    from kubeflow_tpu.workflows import RunArchive
+
+    root = str(tmp_path / "runs")
+    ctrl1 = WorkflowController(client, archive=RunArchive(root))
+    client.create(workflow("w", "default", [
+        container_step("first", "img"),
+        container_step("second", "img", dependencies=["first"]),
+    ]))
+    ctrl1.reconcile("default", "w")
+    finish_pods(client)
+    del ctrl1  # controller restart
+
+    ctrl2 = WorkflowController(client, archive=RunArchive(root))
+    ctrl2.reconcile("default", "w")
+    finish_pods(client)
+    ctrl2.reconcile("default", "w")
+    rec = RunArchive(root).get("default", "w")
+    assert rec["status"]["phase"] == "Succeeded"
+    assert set(rec["status"]["nodes"]) == {"first", "second"}
+
+
+def test_artifact_store_roundtrip(tmp_path):
+    from kubeflow_tpu.workflows import ArtifactStore, store_artifact
+
+    store = ArtifactStore(str(tmp_path / "artifacts"))
+    store.put("ns1", "run1", "train", "metrics.json", b'{"loss": 0.1}')
+    assert store.get("ns1", "run1", "train", "metrics.json") == \
+        b'{"loss": 0.1}'
+    listing = store.list("ns1", "run1")
+    assert listing == [{"step": "train", "name": "metrics.json",
+                        "bytes": 13}]
+    # workload-side helper: no-op without the env contract
+    assert store_artifact("x", b"y", environ={}) is None
+    path = store_artifact("out.bin", b"data", environ={
+        "KFTPU_ARTIFACT_DIR": str(tmp_path / "artifacts"),
+        "KFTPU_NAMESPACE": "ns1", "KFTPU_WORKFLOW_NAME": "run1",
+        "KFTPU_WORKFLOW_STEP": "eval"})
+    assert path and store.get("ns1", "run1", "eval", "out.bin") == b"data"
+
+
+def test_workflow_steps_get_artifact_env(client, tmp_path, monkeypatch):
+    """Container steps inherit the artifact-store contract from the
+    controller (the Argo sidecar-upload wiring)."""
+    from kubeflow_tpu.workflows import RunArchive
+
+    monkeypatch.setenv("KFTPU_ARTIFACT_DIR", str(tmp_path / "a"))
+    ctrl = WorkflowController(client,
+                              archive=RunArchive(str(tmp_path / "r")))
+    client.create(workflow("w", "default", [container_step("s1", "img")]))
+    ctrl.reconcile("default", "w")
+    pod = client.list("v1", "Pod", "default")[0]
+    env = {e["name"]: e["value"]
+           for e in pod["spec"]["containers"][0].get("env", [])}
+    assert env["KFTPU_WORKFLOW_NAME"] == "w"
+    assert env["KFTPU_WORKFLOW_STEP"] == "s1"
+    assert env["KFTPU_ARTIFACT_DIR"] == str(tmp_path / "a")
+
+
 # -- spec validation -------------------------------------------------------
 
 def test_workflow_validation_rejects_cycles():
