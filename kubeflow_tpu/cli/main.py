@@ -181,6 +181,29 @@ def cmd_components(args) -> int:
     return 0
 
 
+def cmd_images(args) -> int:
+    """Release tooling (reference ``releasing/`` parity): list every image
+    the app renders; ``--retag``/``--registry`` pin new coordinates into
+    app.yaml so the next generate/apply ships them."""
+    from kubeflow_tpu.manifests.images import rendered_images, retag_config
+
+    config = _app_config(args.app_dir)
+    if args.retag or args.registry:
+        if not args.retag:
+            raise SystemExit("--registry requires --retag TAG")
+        changes = retag_config(config, args.retag, args.registry or "")
+        with open(os.path.join(args.app_dir, "app.yaml"), "w") as f:
+            f.write(config.to_yaml())
+        for old, new in sorted(changes.items()):
+            print(f"{old} -> {new}")
+        print(f"retagged {len(changes)} image(s); run `ctl generate` to "
+              "re-render")
+        return 0
+    for where, ctr, image in rendered_images(config):
+        print(f"{where:45s} {ctr:12s} {image}")
+    return 0
+
+
 def cmd_version(args) -> int:
     print(f"ctl (kubeflow_tpu) {kubeflow_tpu.__version__}")
     return 0
@@ -231,6 +254,13 @@ def build_parser() -> argparse.ArgumentParser:
                         help="execute the platform plan instead of dry-run")
 
     app_cmd("show", cmd_show, "print rendered manifests")
+
+    sp = app_cmd("images", cmd_images,
+                 "list rendered images / retag a release")
+    sp.add_argument("--retag", default=None, metavar="TAG",
+                    help="pin all component images to TAG in app.yaml")
+    sp.add_argument("--registry", default=None,
+                    help="also move images to this registry (with --retag)")
 
     sp = sub.add_parser("components", help="list available components")
     # SUPPRESS keeps the global -v value instead of overwriting it with False

@@ -142,12 +142,25 @@ class DashboardApi:
                  metrics: Optional[MetricsService] = None,
                  kfam: Optional[AccessManagementApi] = None,
                  platform: str = "gcp-tpu",
-                 run_archive=None) -> None:
+                 run_archive=None,
+                 authorize=None) -> None:
+        from kubeflow_tpu.tenancy.authz import default_authorizer
+
         self.client = client
         self.metrics = metrics or ClusterMetricsService()
         self.kfam = kfam or AccessManagementApi(client)
         self.platform = platform
         self.run_archive = run_archive
+        # namespace-scoped tenant data (studies, runs) goes through the
+        # same Profile-RBAC default as the notebook webapp; allow_all only
+        # behind the explicit dev flag
+        self.authorize = (authorize if authorize is not None
+                          else default_authorizer(client))
+
+    def _authz(self, user: str, ns: str, resource: str) -> None:
+        if not self.authorize(user, "get", ns, resource):
+            raise ApiError(403,
+                           f"{user!r} may not view {resource} in {ns!r}")
 
     def handle(self, method: str, path: str, body: Optional[Dict[str, Any]],
                user: str = "") -> Tuple[int, Any]:
@@ -168,12 +181,14 @@ class DashboardApi:
                 return 200, self.dashboard_links()
             if path.startswith("/api/studies/"):
                 parts = path[len("/api/studies/"):].split("/")
+                self._authz(user, parts[0], "studies")
                 if len(parts) == 1:
                     return 200, self.studies(parts[0])
                 if len(parts) == 2:
                     return self.study_detail(parts[0], parts[1])
             if path.startswith("/api/runs/"):
                 parts = path[len("/api/runs/"):].split("/")
+                self._authz(user, parts[0], "workflows")
                 if len(parts) == 1:
                     return 200, self.runs(parts[0])
                 if len(parts) == 2:

@@ -2,32 +2,7 @@
 // routes (kubeflow_tpu/dashboard/server.py); no framework.
 
 "use strict";
-
-const $ = (id) => document.getElementById(id);
-
-function showError(msg) {
-  const el = $("error");
-  el.textContent = msg;
-  el.style.display = "block";
-}
-
-async function api(path) {
-  const resp = await fetch(path, { credentials: "same-origin" });
-  if (resp.status === 401) {
-    // gatekeeper cookie missing/expired → login page
-    window.location.href = "/login.html?next=" +
-      encodeURIComponent(window.location.pathname);
-    throw new Error("unauthenticated");
-  }
-  if (!resp.ok) throw new Error(path + " → HTTP " + resp.status);
-  return resp.json();
-}
-
-function esc(s) {
-  const d = document.createElement("div");
-  d.textContent = String(s == null ? "" : s);
-  return d.innerHTML;
-}
+// helpers ($, showError, api, esc) come from common.js
 
 // icon names come from /api/dashboard-links (material names in the
 // reference); map to simple glyphs

@@ -1,0 +1,31 @@
+// Shared helpers for the dashboard pages (index, studies, runs): element
+// lookup, error banner, authenticated fetch with the 401 → login
+// redirect, and HTML escaping. Loaded before each page's script.
+
+"use strict";
+
+const $ = (id) => document.getElementById(id);
+
+function showError(msg) {
+  const el = $("error");
+  el.textContent = msg;
+  el.style.display = "block";
+}
+
+async function api(path) {
+  const resp = await fetch(path, { credentials: "same-origin" });
+  if (resp.status === 401) {
+    // gatekeeper cookie missing/expired → login page
+    window.location.href = "/login.html?next=" +
+      encodeURIComponent(window.location.pathname);
+    throw new Error("unauthenticated");
+  }
+  if (!resp.ok) throw new Error(path + " → HTTP " + resp.status);
+  return resp.json();
+}
+
+function esc(s) {
+  const d = document.createElement("div");
+  d.textContent = String(s == null ? "" : s);
+  return d.innerHTML;
+}

@@ -3,31 +3,7 @@
 // (kubeflow_tpu/dashboard/server.py).
 
 "use strict";
-
-const $ = (id) => document.getElementById(id);
-
-function showError(msg) {
-  const el = $("error");
-  el.textContent = msg;
-  el.style.display = "block";
-}
-
-async function api(path) {
-  const resp = await fetch(path, { credentials: "same-origin" });
-  if (resp.status === 401) {
-    window.location.href = "/login.html?next=" +
-      encodeURIComponent(window.location.pathname);
-    throw new Error("unauthenticated");
-  }
-  if (!resp.ok) throw new Error(path + " → HTTP " + resp.status);
-  return resp.json();
-}
-
-function esc(s) {
-  const d = document.createElement("div");
-  d.textContent = String(s == null ? "" : s);
-  return d.innerHTML;
-}
+// helpers ($, showError, api, esc) come from common.js
 
 function fmt(v) {
   if (v == null) return "—";

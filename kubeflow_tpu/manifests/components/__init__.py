@@ -10,5 +10,6 @@ from kubeflow_tpu.manifests.components import (  # noqa: F401
     tensorboard,
     tpujob_operator,
     tuning,
+    usage,
     workflows,
 )

@@ -1,0 +1,60 @@
+"""Anonymous usage-reporting component — spartakus-volunteer parity.
+
+Reference: ``/root/reference/kubeflow/common/spartakus.libsonnet``
+(ClusterRole reading nodes + Deployment with a random ``cluster-id``
+arg, gated by ``reportUsage``). Opt-out: the component renders nothing
+when ``enabled`` is false, and the report carries only anonymous coarse
+facts (``kubeflow_tpu/utils/usage.py``).
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Dict, List
+
+from kubeflow_tpu.config.deployment import DeploymentConfig
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.manifests.registry import register
+
+DEFAULTS: Dict[str, Any] = {
+    "enabled": True,
+    "image": "kubeflow-tpu/platform:v1alpha1",
+    "collector_url": "",      # empty = reporter idles (nothing sent)
+    "cluster_id": "",         # empty = random uuid at render time
+    "interval_hours": 24,
+}
+
+
+@register("usage-reporting", DEFAULTS,
+          "Anonymous opt-out usage reporting (spartakus parity)")
+def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
+    if not params["enabled"]:
+        return []
+    ns = config.namespace
+    name = "usage-reporter"
+    # render must be idempotent: a fresh uuid4 per render would diff every
+    # generate, roll the Deployment on each apply, and reset the collector's
+    # longitudinal identity. Derive a stable id from the deployment identity
+    # instead (uuid5 — not reversible to anything not already anonymous).
+    cluster_id = params["cluster_id"] or str(uuid.uuid5(
+        uuid.NAMESPACE_DNS, f"kftpu.{config.name}.{ns}"))
+    pod = o.pod_spec(
+        [o.container(
+            name, params["image"],
+            command=["python", "-m", "kubeflow_tpu.utils.usage"],
+            env={
+                "KFTPU_USAGE_COLLECTOR_URL": params["collector_url"],
+                "KFTPU_USAGE_CLUSTER_ID": cluster_id,
+            },
+        )],
+        service_account_name=name,
+    )
+    return [
+        o.service_account(name, ns),
+        o.cluster_role(name, [
+            {"apiGroups": [""], "resources": ["nodes"],
+             "verbs": ["get", "list"]},
+        ]),
+        o.cluster_role_binding(name, name, name, ns),
+        o.deployment(name, ns, pod),
+    ]

@@ -1,0 +1,71 @@
+"""Release tooling: enumerate + retag component images.
+
+Reference parity: ``/root/reference/releasing/`` (image build/tag
+scripts) and the per-component image params threaded through the ksonnet
+configs. Here every component exposes its image as a typed param, so a
+release is a config rewrite: enumerate the images a deployment renders,
+then pin a new registry/tag across all components in ``app.yaml`` —
+``ctl images <app> [--retag TAG] [--registry REG]``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+from kubeflow_tpu.config.deployment import DeploymentConfig
+from kubeflow_tpu.manifests.registry import get_component, render_all
+
+
+def rendered_images(config: DeploymentConfig) -> List[Tuple[str, str, str]]:
+    """(kind/name, container, image) for every container the config renders,
+    initContainers included — the ground truth of what a release ships."""
+    out = []
+    for obj in render_all(config):
+        tmpl = obj.get("spec", {}).get("template", {})
+        pod = tmpl.get("spec", {}) if tmpl else obj.get("spec", {})
+        where = f"{obj['kind']}/{obj.get('metadata', {}).get('name', '')}"
+        for key in ("initContainers", "containers"):
+            for c in pod.get(key, []) or []:
+                if "image" in c:
+                    out.append((where, c["name"], c["image"]))
+    return out
+
+
+def _retag(image: str, tag: str, registry: str = "") -> str:
+    """Pin ``image`` to ``tag`` (and optionally a new registry prefix).
+
+    Digest-pinned references (``repo/img@sha256:...``) are returned
+    unchanged — rewriting the digest's hex to a tag would produce an
+    invalid reference, and silently replacing a content pin with a
+    mutable tag would defeat the pin."""
+    if "@" in image:
+        return image
+    # split a trailing :tag — but not a registry :port (which precedes a /)
+    base = image
+    if ":" in image.rsplit("/", 1)[-1]:
+        base = image.rsplit(":", 1)[0]
+    if registry:
+        base = f"{registry.rstrip('/')}/{base.rsplit('/', 1)[-1]}"
+    return f"{base}:{tag}"
+
+
+def retag_config(config: DeploymentConfig, tag: str,
+                 registry: str = "") -> Dict[str, str]:
+    """Pin every component's image params to ``tag`` in-place.
+
+    Any param named ``image`` or ``*_image`` counts. Returns
+    {old: new} for reporting. The caller persists the config."""
+    changes: Dict[str, str] = {}
+    for spec in config.components:
+        comp = get_component(spec.name)
+        for key, default in comp.defaults.items():
+            if key != "image" and not key.endswith("_image"):
+                continue
+            current = spec.params.get(key, default)
+            if not isinstance(current, str) or not current:
+                continue
+            new = _retag(current, tag, registry)
+            if new != current:
+                spec.params[key] = new
+                changes[current] = new
+    return changes

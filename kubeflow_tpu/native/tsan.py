@@ -1,0 +1,52 @@
+"""ThreadSanitizer tier for the native cores — `go test -race` parity.
+
+The reference's Go controllers get race coverage from the Go race
+detector in CI; the framework's C++ runtime gets the same from a TSan
+build of the stress harness (``stress_main.cc``): compile
+``placement.cc`` + harness with ``-fsanitize=thread``, run it
+multi-threaded, fail on any ThreadSanitizer report. Wired into the test
+suite (``tests/test_native_scheduler.py``), skipping cleanly where the
+toolchain lacks libtsan.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Optional, Tuple
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SOURCES = [os.path.join(_DIR, "placement.cc"),
+            os.path.join(_DIR, "stress_main.cc")]
+_BIN = os.path.join(_DIR, "_kftpu_tsan_stress")
+
+
+def build_tsan_stress() -> Optional[str]:
+    """Build the TSan stress binary; None when the toolchain can't."""
+    if (os.path.exists(_BIN)
+            and all(os.path.getmtime(s) <= os.path.getmtime(_BIN)
+                    for s in _SOURCES)):
+        return _BIN
+    cmd = ["g++", "-std=c++17", "-O1", "-g", "-fsanitize=thread",
+           "-pthread", "-o", _BIN, *_SOURCES]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=180)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    return _BIN if proc.returncode == 0 else None
+
+
+def run_tsan_stress(n_threads: int = 8,
+                    iters: int = 300) -> Tuple[bool, str]:
+    """(clean, report). clean=False on races, invalid results, or crash."""
+    path = build_tsan_stress()
+    if path is None:
+        raise RuntimeError("TSan toolchain unavailable")
+    proc = subprocess.run(
+        [path, str(n_threads), str(iters)], capture_output=True, text=True,
+        timeout=300,
+        env={**os.environ, "TSAN_OPTIONS": "halt_on_error=0 exitcode=66"})
+    report = (proc.stdout + proc.stderr)[-4000:]
+    clean = proc.returncode == 0 and "ThreadSanitizer" not in report
+    return clean, report

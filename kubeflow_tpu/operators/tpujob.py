@@ -53,6 +53,11 @@ PODGROUP_API = "scheduling.sigs.k8s.io/v1alpha1"
 JOB_LABEL = "kubeflow-tpu.org/job-name"
 SLICE_LABEL = "kubeflow-tpu.org/slice"
 HOST_LABEL = "kubeflow-tpu.org/host"
+# the gang topology a pod was built for; a live pod whose shape disagrees
+# with the current spec marks an elastic resize (spec.slices edited on a
+# running job) — the distributed env (world size, slice count) is baked
+# into every worker, so a resize is a coordinated re-gang, never in-place
+GANG_SHAPE_LABEL = "kubeflow-tpu.org/gang-shape"
 
 PHASE_PENDING = "Pending"
 PHASE_RUNNING = "Running"
@@ -88,6 +93,11 @@ class TpuJobSpec:
     # PVC: /root/reference/kubeflow/kubebench/kubebench-job.libsonnet:160-176)
     volumes: List[Dict[str, Any]] = field(default_factory=list)
     volume_mounts: List[Dict[str, Any]] = field(default_factory=list)
+    # pre-run data staging: each {"source": "gs://...", "target": "/data"}
+    # becomes an init container copying the object tree into an emptyDir
+    # mounted at target — the openmpi-controller's S3/GCS download role
+    # (/root/reference/kubeflow/openmpi/ sidecar data staging), TPU-style
+    data_staging: List[Dict[str, str]] = field(default_factory=list)
 
     @property
     def num_workers(self) -> int:
@@ -110,6 +120,7 @@ class TpuJobSpec:
             gang_scheduling=bool(spec.get("gangScheduling", True)),
             volumes=list(spec.get("volumes", []) or []),
             volume_mounts=list(spec.get("volumeMounts", []) or []),
+            data_staging=list(spec.get("dataStaging", []) or []),
         )
         out.validate()
         return out
@@ -121,6 +132,12 @@ class TpuJobSpec:
             raise ValueError("slices and hostsPerSlice must be >= 1")
         if self.restart_policy not in ("Never", "OnFailure"):
             raise ValueError(f"invalid restartPolicy {self.restart_policy!r}")
+        for d in self.data_staging:
+            if not d.get("source", "").startswith(("gs://", "s3://")):
+                raise ValueError(
+                    "dataStaging.source must be a gs:// or s3:// url")
+            if not d.get("target", "").startswith("/"):
+                raise ValueError("dataStaging.target must be an absolute path")
 
 
 def tpujob(name: str, ns: str, spec: Dict[str, Any]) -> o.Obj:
@@ -137,6 +154,10 @@ def tpujob(name: str, ns: str, spec: Dict[str, Any]) -> o.Obj:
 
 def worker_name(job_name: str, index: int) -> str:
     return f"{job_name}-w{index}"
+
+
+def gang_shape(spec: "TpuJobSpec") -> str:
+    return f"{spec.slices}x{spec.hosts_per_slice}"
 
 
 def coordinator_address(job_name: str, ns: str, port: int) -> str:
@@ -193,6 +214,26 @@ def build_worker_pod(job: o.Obj, index: int, placement: SlicePlacement,
         "MEGASCALE_NUM_SLICES": str(spec.slices),
     })
 
+    volumes = list(spec.volumes)
+    mounts = list(spec.volume_mounts)
+    init_containers: List[o.Obj] = []
+    for k, staging in enumerate(spec.data_staging):
+        vol = f"staged-{k}"
+        volumes.append({"name": vol, "emptyDir": {}})
+        mounts.append({"name": vol, "mountPath": staging["target"]})
+        tool = ("gcloud storage cp -r"
+                if staging["source"].startswith("gs://")
+                else "aws s3 cp --recursive")
+        init_containers.append(o.container(
+            f"stage-{k}",
+            staging.get("image", "google/cloud-sdk:slim"),
+            command=["sh", "-c",
+                     f"{tool} '{staging['source']}' "
+                     f"'{staging['target']}/'"],
+            volume_mounts=[{"name": vol,
+                            "mountPath": staging["target"]}],
+        ))
+
     ctr = o.container(
         "worker",
         spec.image,
@@ -201,7 +242,7 @@ def build_worker_pod(job: o.Obj, index: int, placement: SlicePlacement,
         env=env,
         ports=[spec.coordinator_port] if index == 0 else None,
         resources={"limits": {"google.com/tpu": spec.chips_per_host}},
-        volume_mounts=spec.volume_mounts or None,
+        volume_mounts=mounts or None,
     )
     # node labels carry the GKE accelerator TYPE (tpu-v5-lite-podslice),
     # not the framework's shape name (v5e-8) — selecting on the shape name
@@ -218,13 +259,16 @@ def build_worker_pod(job: o.Obj, index: int, placement: SlicePlacement,
             "cloud.google.com/gke-tpu-topology": placement.topology,
         },
         scheduler_name="kftpu-gang" if spec.gang_scheduling else None,
-        volumes=spec.volumes or None,
+        volumes=volumes or None,
     )
+    if init_containers:
+        pspec["initContainers"] = init_containers
     pspec["hostname"] = worker_name(name, index)
     pspec["subdomain"] = name
     labels = {JOB_LABEL: name,
               SLICE_LABEL: str(placement.slice_index),
-              HOST_LABEL: str(placement.host)}
+              HOST_LABEL: str(placement.host),
+              GANG_SHAPE_LABEL: gang_shape(spec)}
     if concrete_slice:
         # the gang scheduler chose an exact cluster slice: pin to it and
         # record the claim so inventory accounting sees this host as busy
@@ -313,6 +357,25 @@ class TpuJobOperator:
 
         status_update: Dict[str, Any] = {"workers": counts}
 
+        # elastic resize: spec.slices / hostsPerSlice edited under a live
+        # gang. Every worker bakes the world size + slice count into its
+        # env, so the whole gang re-places at the new shape; this does NOT
+        # consume a failure restart. Pods predating the shape label are
+        # left alone (their shape is unknowable).
+        shape = gang_shape(spec)
+        stale = [p for p in pods
+                 if (p.get("metadata", {}).get("labels", {}) or {})
+                 .get(GANG_SHAPE_LABEL, shape) != shape]
+        if stale:
+            self._delete_pods(ns, pods)
+            self._set_status(
+                job, PHASE_RESTARTING,
+                conditions=[_condition("Resizing", "ElasticResize",
+                                       f"re-gang to {shape}")])
+            log.info("elastic resize for %s/%s: re-gang to %s", ns, name,
+                     shape)
+            return 1.0
+
         if counts["Failed"] > 0:
             return self._handle_failure(job, spec, pods)
 
@@ -383,7 +446,18 @@ class TpuJobOperator:
             concrete = [claimed[k] for k in range(spec.slices)]
         self._create_if_absent(build_service(job))
         if spec.gang_scheduling and self.gang_scheduling:
-            self._create_if_absent(build_podgroup(job))
+            pg = build_podgroup(job)
+            live_pg = self.client.get_or_none(PODGROUP_API, "PodGroup", ns,
+                                              name)
+            if live_pg is None:
+                self._create_if_absent(pg)
+            elif (live_pg.get("spec", {}).get("minMember")
+                  != pg["spec"]["minMember"]):
+                # elastic resize: the gang barrier must match the new shape
+                live_pg = dict(live_pg)
+                live_pg["spec"] = {**live_pg.get("spec", {}),
+                                   "minMember": pg["spec"]["minMember"]}
+                self.client.update(live_pg)
         for i in range(spec.num_workers):
             chosen = (concrete[placements[i].slice_index]
                       if concrete else None)

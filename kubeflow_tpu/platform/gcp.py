@@ -24,7 +24,7 @@ import os
 import shutil
 import subprocess
 import time
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 import yaml
 
@@ -212,11 +212,23 @@ class GcpTpuPlatform(Platform):
 
         Lists all operations and filters client-side by targetLink so (a)
         an op that fails by transitioning to DONE-with-error is seen, and
-        (b) other teams' operations in a shared project/zone neither block
-        nor fail this apply."""
+        (b) other teams' operations in a shared project/zone — or on a
+        cluster whose name merely extends ours ("demo-prod" vs "demo") —
+        neither block nor fail this apply. Historical DONE ops present at
+        the first poll are baselined out: a failed attempt a retry already
+        recovered from (or last week's failed upgrade) must not fail a
+        successful apply."""
         deadline = time.monotonic() + self.op_timeout_s
         delay = self.op_poll_initial_s
         marker = f"/clusters/{cluster}"
+
+        def targets_cluster(op) -> bool:
+            link = op.get("targetLink", "")
+            # exact segment match: the link either ends at the cluster name
+            # or descends into it (/clusters/<name>/nodePools/...)
+            return link.endswith(marker) or (marker + "/") in link
+
+        baseline_done: Optional[set] = None
         while True:
             cmd = ["gcloud", "container", "operations", "list",
                    "--zone", zone, "--format", "json"]
@@ -228,11 +240,13 @@ class GcpTpuPlatform(Platform):
                     ops = json.loads(proc.stdout or "[]")
                 except ValueError:
                     ops = []
-                mine = [op for op in ops
-                        if marker in op.get("targetLink", "")
-                        or op.get("targetLink", "").endswith(marker)]
+                mine = [op for op in ops if targets_cluster(op)]
+                if baseline_done is None:
+                    baseline_done = {op.get("name") for op in mine
+                                     if op.get("status") == "DONE"}
                 errored = [op for op in mine
                            if op.get("status") == "DONE"
+                           and op.get("name") not in baseline_done
                            and (op.get("error")
                                 or op.get("statusMessage"))]
                 if errored:

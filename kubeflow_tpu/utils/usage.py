@@ -1,0 +1,97 @@
+"""Anonymous usage reporting — spartakus-volunteer parity, opt-out.
+
+Reference: ``/root/reference/kubeflow/common/spartakus.libsonnet`` deploys
+a spartakus-volunteer with a random cluster uuid and node-reading RBAC,
+gated by a ``reportUsage`` param. Here the reporter is a small in-repo
+loop: it builds a report of {anonymous cluster id, framework version,
+node count, TPU accelerator types} — never names, namespaces, images, or
+workloads — and POSTs it to the configured collector. Disabled unless a
+collector URL is configured, and removable by dropping the component
+(`usage-reporting`) from the deployment config.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+import urllib.request
+import uuid
+from typing import Any, Dict, Optional
+
+import kubeflow_tpu
+from kubeflow_tpu.k8s.client import ApiError, KubeClient
+
+log = logging.getLogger(__name__)
+
+ENV_COLLECTOR_URL = "KFTPU_USAGE_COLLECTOR_URL"
+ENV_CLUSTER_ID = "KFTPU_USAGE_CLUSTER_ID"
+
+
+def build_report(client: KubeClient, cluster_id: str) -> Dict[str, Any]:
+    """The spartakus report shape: anonymous id + coarse cluster facts."""
+    try:
+        nodes = client.list("v1", "Node")
+    except ApiError:
+        nodes = []
+    accelerators: Dict[str, int] = {}
+    for n in nodes:
+        labels = n.get("metadata", {}).get("labels", {}) or {}
+        acc = labels.get("cloud.google.com/gke-tpu-accelerator")
+        if acc:
+            accelerators[acc] = accelerators.get(acc, 0) + 1
+    return {
+        "clusterID": cluster_id,
+        "version": kubeflow_tpu.__version__,
+        "nodes": len(nodes),
+        "tpuAccelerators": accelerators,
+        "timestamp": int(time.time()),
+    }
+
+
+class UsageReporter:
+    """Periodic anonymous report POSTs (the volunteer loop)."""
+
+    def __init__(self, client: KubeClient, collector_url: str,
+                 cluster_id: Optional[str] = None,
+                 interval_s: float = 24 * 3600.0) -> None:
+        self.client = client
+        self.collector_url = collector_url
+        self.cluster_id = cluster_id or str(uuid.uuid4())
+        self.interval_s = interval_s
+
+    def report_once(self, timeout_s: float = 10.0) -> bool:
+        payload = json.dumps(
+            build_report(self.client, self.cluster_id)).encode()
+        req = urllib.request.Request(
+            self.collector_url, data=payload,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                return 200 <= resp.status < 300
+        except OSError as e:
+            log.info("usage report skipped (collector unreachable: %s)", e)
+            return False
+
+    def run_forever(self) -> None:  # pragma: no cover — thin loop
+        while True:
+            self.report_once()
+            time.sleep(self.interval_s)
+
+
+def main() -> None:  # pragma: no cover — container entrypoint
+    from kubeflow_tpu.k8s.client import HttpKubeClient
+
+    logging.basicConfig(level=logging.INFO)
+    url = os.environ.get(ENV_COLLECTOR_URL, "")
+    if not url:
+        log.info("no %s configured; usage reporting disabled",
+                 ENV_COLLECTOR_URL)
+        return
+    UsageReporter(HttpKubeClient(), url,
+                  cluster_id=os.environ.get(ENV_CLUSTER_ID)).run_forever()
+
+
+if __name__ == "__main__":
+    main()

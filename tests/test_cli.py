@@ -75,3 +75,25 @@ def test_fake_state_survives_processes(app_dir):
         "tpujobs.kubeflow-tpu.org",
     )
     assert crd is not None
+
+
+def test_images_list_and_retag(app_dir, capsys):
+    """Release tooling: enumerate rendered images, pin a release tag
+    (reference releasing/ parity)."""
+    assert main(["init", app_dir, "--preset", "standard"]) == 0
+    assert main(["images", app_dir]) == 0
+    out = capsys.readouterr().out
+    assert "kubeflow-tpu/operator" in out or "kubeflow-tpu" in out
+
+    assert main(["images", app_dir, "--retag", "v1.2.3",
+                 "--registry", "gcr.io/my-proj"]) == 0
+    out = capsys.readouterr().out
+    assert "-> gcr.io/my-proj/" in out and ":v1.2.3" in out
+
+    # the rewrite landed in app.yaml and re-renders with the new tags
+    assert main(["images", app_dir]) == 0
+    out = capsys.readouterr().out
+    for _, line in enumerate(out.strip().splitlines()):
+        image = line.split()[-1]
+        if "/" in image:  # every component image now carries the release
+            assert image.endswith(":v1.2.3") or "gcr.io" not in image

@@ -140,3 +140,21 @@ def test_config_yaml_roundtrip(config):
     back = DeploymentConfig.from_yaml(text)
     assert back.to_dict() == config.to_dict()
     assert back.component("serving").params["tpu_chips"] == 4
+
+
+def test_usage_reporting_component(config):
+    objs = render_component(config, ComponentSpec("usage-reporting", params={
+        "collector_url": "http://collector:8765/report",
+        "cluster_id": "fixed-id"}))
+    kinds = [x["kind"] for x in objs]
+    assert kinds == ["ServiceAccount", "ClusterRole", "ClusterRoleBinding",
+                     "Deployment"]
+    role = objs[1]
+    assert role["rules"][0]["resources"] == ["nodes"]  # read-only, nodes only
+    env = {e["name"]: e["value"] for e in
+           objs[3]["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["KFTPU_USAGE_CLUSTER_ID"] == "fixed-id"
+    # opt-out renders nothing
+    assert render_component(
+        config, ComponentSpec("usage-reporting",
+                              params={"enabled": False})) == []

@@ -167,11 +167,20 @@ exit 0
 
 
 def test_gcp_wait_for_operations_surfaces_errors(tmp_path, monkeypatch):
-    """A DONE-with-error operation on OUR cluster raises (GKE ops fail by
-    transitioning to DONE with statusMessage set, not by staying pending)."""
-    script = ('echo \'[{"name": "op-9", "status": "DONE", '
-              '"statusMessage": "quota exceeded", '
-              '"targetLink": ".../clusters/demo"}]\'\nexit 0\n')
+    """An op from THIS apply that transitions RUNNING -> DONE-with-error
+    raises (GKE ops fail by completing with statusMessage set, not by
+    staying pending)."""
+    state = tmp_path / "state"
+    state.write_text("1")
+    script = f'''n=$(cat {state})
+if [ "$n" -gt 0 ]; then
+  echo 0 > {state}
+  echo '[{{"name": "op-9", "status": "RUNNING", "targetLink": ".../clusters/demo"}}]'
+else
+  echo '[{{"name": "op-9", "status": "DONE", "statusMessage": "quota exceeded", "targetLink": ".../clusters/demo"}}]'
+fi
+exit 0
+'''
     monkeypatch.setenv("PATH", _fake_gcloud(tmp_path, script) + os.pathsep
                        + os.environ["PATH"])
     platform = GcpTpuPlatform()
@@ -180,12 +189,31 @@ def test_gcp_wait_for_operations_surfaces_errors(tmp_path, monkeypatch):
         platform.wait_for_operations("my-proj", "us-central2-b", "demo")
 
 
+def test_gcp_wait_baselines_historical_errors(tmp_path, monkeypatch):
+    """A DONE-with-error op already present at the first poll (a failed
+    attempt a retry recovered from, or last week's failed upgrade) must
+    NOT fail a successful apply."""
+    script = ('echo \'[{"name": "op-old", "status": "DONE", '
+              '"statusMessage": "was bad last week", '
+              '"targetLink": ".../clusters/demo"}]\'\nexit 0\n')
+    monkeypatch.setenv("PATH", _fake_gcloud(tmp_path, script) + os.pathsep
+                       + os.environ["PATH"])
+    platform = GcpTpuPlatform()
+    platform.op_poll_initial_s = 0.0
+    platform.wait_for_operations("my-proj", "us-central2-b", "demo")  # no raise
+
+
 def test_gcp_wait_ignores_other_clusters_operations(tmp_path, monkeypatch):
-    """Another team's pending/errored ops in the shared zone must neither
-    block nor fail this cluster's apply."""
+    """Another team's pending/errored ops — including on a cluster whose
+    name extends ours — must neither block nor fail this cluster's apply."""
     script = ('echo \'[{"name": "op-x", "status": "RUNNING", '
               '"statusMessage": "their problem", '
-              '"targetLink": ".../clusters/theirs"}]\'\nexit 0\n')
+              '"targetLink": ".../clusters/theirs"}, '
+              '{"name": "op-y", "status": "RUNNING", '
+              '"targetLink": ".../clusters/demo-prod"}, '
+              '{"name": "op-z", "status": "RUNNING", '
+              '"targetLink": ".../clusters/demo-prod/nodePools/p0"}]\''
+              '\nexit 0\n')
     monkeypatch.setenv("PATH", _fake_gcloud(tmp_path, script) + os.pathsep
                        + os.environ["PATH"])
     platform = GcpTpuPlatform()

@@ -258,17 +258,23 @@ def test_dashboard_studies_pages(dash_client):
     dash_client.create(t1)
 
     api = DashboardApi(dash_client)
-    code, studies = api.handle("GET", "/api/studies/alice", None)
+    u = "alice@x.com"  # owns profile "alice" (Profile-RBAC default authz)
+    code, studies = api.handle("GET", "/api/studies/alice", None, user=u)
     assert code == 200
     assert studies[0]["name"] == "opt-lr"
     assert studies[0]["bestTrial"]["objective"] == 0.4
 
-    code, detail = api.handle("GET", "/api/studies/alice/opt-lr", None)
+    code, detail = api.handle("GET", "/api/studies/alice/opt-lr", None,
+                              user=u)
     assert code == 200
     objs = {t["name"]: t["objective"] for t in detail["trials"]}
     assert objs[t0["metadata"]["name"]] == 0.4
     assert objs[t1["metadata"]["name"]] is None
-    assert api.handle("GET", "/api/studies/alice/nope", None)[0] == 404
+    assert api.handle("GET", "/api/studies/alice/nope", None,
+                      user=u)[0] == 404
+    # cross-tenant reads are denied by default (no profile/binding)
+    assert api.handle("GET", "/api/studies/alice", None,
+                      user="mallory")[0] == 403
 
 
 def test_dashboard_runs_merges_live_and_archive(dash_client, tmp_path):
@@ -294,16 +300,21 @@ def test_dashboard_runs_merges_live_and_archive(dash_client, tmp_path):
     ctrl.reconcile("alice", "live-run")
 
     api = DashboardApi(dash_client, run_archive=archive)
-    code, runs = api.handle("GET", "/api/runs/alice", None)
+    u = "alice@x.com"
+    code, runs = api.handle("GET", "/api/runs/alice", None, user=u)
     assert code == 200
     by_name = {r["name"]: r for r in runs}
     assert by_name["old-run"]["live"] is False
     assert by_name["old-run"]["phase"] == "Succeeded"
     assert by_name["live-run"]["live"] is True
 
-    code, detail = api.handle("GET", "/api/runs/alice/old-run", None)
+    code, detail = api.handle("GET", "/api/runs/alice/old-run", None, user=u)
     assert code == 200 and detail["live"] is False
     assert detail["status"]["nodes"]["a"]["phase"] == "Succeeded"
-    code, detail = api.handle("GET", "/api/runs/alice/live-run", None)
+    code, detail = api.handle("GET", "/api/runs/alice/live-run", None,
+                              user=u)
     assert code == 200 and detail["live"] is True
-    assert api.handle("GET", "/api/runs/alice/nope", None)[0] == 404
+    assert api.handle("GET", "/api/runs/alice/nope", None, user=u)[0] == 404
+    # a workflow spec (commands/env) must not leak across tenants
+    assert api.handle("GET", "/api/runs/alice/live-run", None,
+                      user="mallory")[0] == 403

@@ -181,6 +181,40 @@ def test_multislice_process_layout(client, operator):
     assert [e[dist.ENV_PROCESS_ID] for e in envs] == ["0", "1", "2", "3"]
 
 
+def test_elastic_resize_regangs_without_burning_restart(client, operator):
+    """Editing spec.slices on a running job re-places the whole gang at the
+    new shape with fresh world-size env — and does not consume a failure
+    restart (SURVEY §2c elastic scaling)."""
+    make_job(client, slices=1, hostsPerSlice=2)
+    operator.reconcile("default", "train")
+    set_pod_phases(client, "default", "Running")
+    operator.reconcile("default", "train")
+    assert get_job(client)["status"]["phase"] == PHASE_RUNNING
+
+    job = get_job(client)
+    job["spec"]["slices"] = 2
+    client.update(job)
+    operator.reconcile("default", "train")
+    job = get_job(client)
+    assert job["status"]["phase"] == PHASE_RESTARTING
+    assert job["status"].get("restarts", 0) == 0  # resize, not failure
+    conds = [c["reason"] for c in job["status"]["conditions"]]
+    assert "ElasticResize" in conds
+    assert client.list("v1", "Pod", "default") == []
+
+    # next pass re-creates the gang at the new shape with updated env
+    operator.reconcile("default", "train")
+    pods = client.list("v1", "Pod", "default")
+    assert len(pods) == 4  # 2 slices x 2 hosts
+    env = {e["name"]: e["value"]
+           for e in pods[0]["spec"]["containers"][0]["env"]}
+    assert env[dist.ENV_NUM_PROCESSES] == "4"
+    assert env["MEGASCALE_NUM_SLICES"] == "2"
+    pg = client.get("scheduling.sigs.k8s.io/v1alpha1", "PodGroup",
+                    "default", "train")
+    assert pg["spec"]["minMember"] == 4  # gang barrier resized too
+
+
 def test_delete_job_cascades_to_pods(client, operator):
     make_job(client)
     operator.reconcile("default", "train")
@@ -188,6 +222,32 @@ def test_delete_job_cascades_to_pods(client, operator):
     assert client.list("v1", "Pod", "default",
                        label_selector={JOB_LABEL: "train"}) == []
     assert operator.reconcile("default", "train") is None
+
+
+def test_data_staging_init_container(client, operator):
+    """dataStaging renders a download init container + emptyDir shared into
+    the worker (the openmpi-controller S3/GCS staging role)."""
+    make_job(client, dataStaging=[
+        {"source": "gs://bucket/imagenet", "target": "/data"}])
+    operator.reconcile("default", "train")
+    pod = client.list("v1", "Pod", "default")[0]
+    init = pod["spec"]["initContainers"][0]
+    assert "gcloud storage cp -r" in init["command"][2]
+    assert "gs://bucket/imagenet" in init["command"][2]
+    vols = {v["name"] for v in pod["spec"]["volumes"]}
+    assert "staged-0" in vols
+    worker_mounts = {m["mountPath"]
+                     for m in pod["spec"]["containers"][0]["volumeMounts"]}
+    assert "/data" in worker_mounts
+
+
+def test_data_staging_validation():
+    with pytest.raises(ValueError, match="gs:// or s3://"):
+        TpuJobSpec.from_dict({"image": "x", "dataStaging": [
+            {"source": "http://nope", "target": "/data"}]})
+    with pytest.raises(ValueError, match="absolute"):
+        TpuJobSpec.from_dict({"image": "x", "dataStaging": [
+            {"source": "gs://b/p", "target": "data"}]})
 
 
 def test_spec_validation():

@@ -1,0 +1,60 @@
+"""Anonymous usage reporting (spartakus parity): report shape + POST."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from kubeflow_tpu.k8s import FakeKubeClient
+from kubeflow_tpu.utils.usage import UsageReporter, build_report
+
+
+def _node(name, accelerator=None):
+    labels = {}
+    if accelerator:
+        labels["cloud.google.com/gke-tpu-accelerator"] = accelerator
+    return {"apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": name, "labels": labels}}
+
+
+def test_report_shape_is_anonymous():
+    client = FakeKubeClient()
+    client.create(_node("n0", "tpu-v5-lite-podslice"))
+    client.create(_node("n1", "tpu-v5-lite-podslice"))
+    client.create(_node("cpu0"))
+    report = build_report(client, "cid-1")
+    assert report["clusterID"] == "cid-1"
+    assert report["nodes"] == 3
+    assert report["tpuAccelerators"] == {"tpu-v5-lite-podslice": 2}
+    # nothing identifying: no names, namespaces, images, workloads
+    assert set(report) == {"clusterID", "version", "nodes",
+                           "tpuAccelerators", "timestamp"}
+
+
+def test_reporter_posts_to_collector():
+    received = []
+
+    class Sink(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", "0"))
+            received.append(json.loads(self.rfile.read(n)))
+            self.send_response(204)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Sink)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{srv.server_address[1]}/report"
+        reporter = UsageReporter(FakeKubeClient(), url, cluster_id="cid-2")
+        assert reporter.report_once() is True
+        assert received[0]["clusterID"] == "cid-2"
+    finally:
+        srv.shutdown()
+
+
+def test_reporter_tolerates_unreachable_collector():
+    reporter = UsageReporter(FakeKubeClient(), "http://127.0.0.1:9/x",
+                             cluster_id="cid-3")
+    assert reporter.report_once(timeout_s=2) is False  # never raises

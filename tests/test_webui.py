@@ -26,10 +26,12 @@ def _get(url):
 def dashboard_server():
     from kubeflow_tpu.dashboard.server import DashboardApi
 
+    from kubeflow_tpu.tenancy.authz import allow_all
+
     client = FakeKubeClient()
     client.create({"apiVersion": "v1", "kind": "Namespace",
                    "metadata": {"name": "kubeflow"}})
-    api = DashboardApi(client)
+    api = DashboardApi(client, authorize=allow_all)  # page-serving fixture
     srv = serve_json(
         api.handle, 0, background=True, host="127.0.0.1",
         static_dir=os.path.join(REPO, "kubeflow_tpu/dashboard/static"))
