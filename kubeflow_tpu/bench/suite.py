@@ -427,12 +427,16 @@ def bench_serving(requests: int = 200, batch: int = 8,
     from kubeflow_tpu.serving import ModelServer, export_model
     from kubeflow_tpu.serving.grpc_server import PredictClient, serve_grpc
 
-    # serving-size ResNet-50; fp32 params exported, bf16 compute
+    # serving-size ResNet-50; fp32 params exported, bf16 compute.
+    # init under jit: eager init would execute every op individually over
+    # the device transport (minutes on a remote chip) instead of one
+    # compiled program
     cfg = ResNetConfig(stage_sizes=(3, 4, 6, 3), num_classes=1000)
     model = ResNet(cfg)
     rng = jax.random.key(0)
     x0 = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
-    variables = model.init(rng, x0, train=False)
+    variables = jax.jit(
+        lambda r: model.init(r, x0, train=False))(rng)
 
     def timed(fn, n):
         lat = []

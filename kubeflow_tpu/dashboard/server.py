@@ -172,7 +172,11 @@ class DashboardApi:
             if path == "/api/namespaces":
                 return 200, self.namespaces()
             if path.startswith("/api/activities/"):
-                return 200, self.activities(path.rsplit("/", 1)[1])
+                ns = path.rsplit("/", 1)[1]
+                # k8s Events carry workload names/failure messages —
+                # namespace-scoped tenant data, same guard as studies/runs
+                self._authz(user, ns, "events")
+                return 200, self.activities(ns)
             if path.startswith("/api/metrics/"):
                 return 200, self.metrics.query(path.rsplit("/", 1)[1])
             if path == "/api/workgroup/exists":

@@ -96,6 +96,24 @@ def routes_from_env() -> List[Route]:
             for r in json.loads(raw)]
 
 
+IAP_EMAIL_HEADER = "X-Goog-Authenticated-User-Email"
+
+
+def iap_authenticator(headers: Dict[str, str]) -> Optional[str]:
+    """Identity from Cloud IAP's authenticated-user header.
+
+    Parity with the reference's IAP ingress (``/root/reference/kubeflow/
+    gcp/iap.libsonnet`` — envoy checks the IAP JWT and forwards identity).
+    Trust boundary: this proxy must only be reachable through the
+    GCLB+IAP path (the NetworkPolicy the gateway component renders), where
+    IAP strips any client-supplied copy of the header and sets
+    ``accounts.google.com:<email>``."""
+    value = headers.get(IAP_EMAIL_HEADER, "")
+    if not value:
+        return None
+    return value.split(":", 1)[-1] or None
+
+
 class EdgeProxy:
     """Threaded reverse proxy with cookie auth via the gatekeeper."""
 
@@ -343,10 +361,15 @@ def main() -> None:
     import time
 
     logging.basicConfig(level=logging.INFO)
-    proxy = EdgeProxy(
-        routes_from_env(),
-        verify_url=os.environ.get("KFTPU_VERIFY_URL",
-                                  "http://gatekeeper:8085/verify") or None)
+    if os.environ.get("KFTPU_EDGE_AUTH_MODE", "cookie") == "iap":
+        proxy = EdgeProxy(routes_from_env(),
+                          authenticator=iap_authenticator)
+    else:
+        proxy = EdgeProxy(
+            routes_from_env(),
+            verify_url=os.environ.get("KFTPU_VERIFY_URL",
+                                      "http://gatekeeper:8085/verify")
+            or None)
     proxy.start(int(os.environ.get("KFTPU_EDGE_PORT", "8080")))
     while True:
         time.sleep(3600)

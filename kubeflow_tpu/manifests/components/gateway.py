@@ -26,6 +26,13 @@ DEFAULTS: Dict[str, Any] = {
     "replicas": 1,
     "hostname": "*",
     "use_istio": False,
+    # GCP Cloud IAP in front of the gateway (the reference's iap.libsonnet
+    # envoy+ESP stack, collapsed onto GKE-native BackendConfig IAP): renders
+    # Ingress + BackendConfig + optional ManagedCertificate, and switches
+    # the proxy to trust IAP's authenticated-user header
+    "use_iap": False,
+    "iap_oauth_secret": "kftpu-oauth",   # Secret: client_id/client_secret
+    "managed_cert_domain": "",           # e.g. kubeflow.example.com
     # prefix -> {service, port, stripPrefix}; merged over the built-ins
     "extra_routes": {},
 }
@@ -91,21 +98,97 @@ def istio_route(ns: str, name: str, prefix: str, service: str, port: int,
     }
 
 
+# GCLB/IAP proxy + health-check source ranges (fixed, documented GCP CIDRs)
+GCLB_SOURCE_RANGES = ("130.211.0.0/22", "35.191.0.0/16")
+
+
+def iap_gateway_policy(ns: str, port: int) -> o.Obj:
+    """NetworkPolicy: in IAP mode the gateway accepts traffic ONLY from the
+    Google load balancer ranges. This is what makes trusting the IAP
+    identity header sound — without it any in-cluster pod could forge
+    ``X-Goog-Authenticated-User-Email`` and impersonate anyone."""
+    return {
+        "apiVersion": "networking.k8s.io/v1",
+        "kind": "NetworkPolicy",
+        "metadata": o.metadata(f"{GATEWAY_NAME}-glb-only", ns),
+        "spec": {
+            "podSelector": {"matchLabels": dict(INGRESS_POD_LABELS)},
+            "policyTypes": ["Ingress"],
+            "ingress": [{
+                "from": [{"ipBlock": {"cidr": c}}
+                         for c in GCLB_SOURCE_RANGES],
+                "ports": [{"protocol": "TCP", "port": port}],
+            }],
+        },
+    }
+
+
+def iap_backend_config(ns: str, oauth_secret: str) -> o.Obj:
+    """GKE BackendConfig enabling Cloud IAP on the gateway's backend —
+    the whole envoy+JWT-check deployment of ``iap.libsonnet`` collapsed
+    into the load balancer (``iap.libsonnet:1-100`` wires the same OAuth
+    client credentials into ESP)."""
+    return {
+        "apiVersion": "cloud.google.com/v1",
+        "kind": "BackendConfig",
+        "metadata": o.metadata(GATEWAY_NAME, ns),
+        "spec": {"iap": {
+            "enabled": True,
+            "oauthclientCredentials": {"secretName": oauth_secret},
+        }},
+    }
+
+
+def iap_ingress(ns: str, domain: str) -> List[o.Obj]:
+    """GCLB Ingress → gateway Service (+ ManagedCertificate when a domain
+    is configured; the reference used cloud-endpoints + cert jobs)."""
+    annotations = {"kubernetes.io/ingress.class": "gce"}
+    out: List[o.Obj] = []
+    if domain:
+        annotations["networking.gke.io/managed-certificates"] = GATEWAY_NAME
+        out.append({
+            "apiVersion": "networking.gke.io/v1",
+            "kind": "ManagedCertificate",
+            "metadata": o.metadata(GATEWAY_NAME, ns),
+            "spec": {"domains": [domain]},
+        })
+    out.insert(0, {
+        "apiVersion": "networking.k8s.io/v1",
+        "kind": "Ingress",
+        "metadata": o.metadata(GATEWAY_NAME, ns, annotations=annotations),
+        "spec": {"defaultBackend": {"service": {
+            "name": GATEWAY_NAME, "port": {"number": 80}}}},
+    })
+    return out
+
+
 @register("gateway", DEFAULTS,
           "Edge reverse proxy + routes (ambassador / IAP-envoy parity)")
 def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
     ns = config.namespace
     routes = _routes(params)
+    env = {
+        "KFTPU_EDGE_PORT": str(params["port"]),
+        "KFTPU_VERIFY_URL": "http://gatekeeper:8085/verify",
+        "KFTPU_ROUTES": json.dumps(routes),
+    }
+    svc_annotations: Dict[str, str] = {}
+    if params["use_iap"]:
+        # identity comes from IAP's header, not the gatekeeper cookie; the
+        # GCLB is the only path in (NEG annotation pins container-native LB)
+        env["KFTPU_EDGE_AUTH_MODE"] = "iap"
+        env.pop("KFTPU_VERIFY_URL")
+        svc_annotations = {
+            "cloud.google.com/neg": '{"ingress": true}',
+            "cloud.google.com/backend-config":
+                json.dumps({"default": GATEWAY_NAME}),
+        }
     pod = o.pod_spec([
         o.container(
             GATEWAY_NAME,
             params["image"],
             command=["python", "-m", "kubeflow_tpu.edge.proxy"],
-            env={
-                "KFTPU_EDGE_PORT": str(params["port"]),
-                "KFTPU_VERIFY_URL": "http://gatekeeper:8085/verify",
-                "KFTPU_ROUTES": json.dumps(routes),
-            },
+            env=env,
             ports=[params["port"]],
         )
     ])
@@ -115,8 +198,13 @@ def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
         o.service(GATEWAY_NAME, ns, dict(INGRESS_POD_LABELS),
                   [{"name": "http", "port": 80,
                     "targetPort": params["port"]}],
-                  labels=dict(INGRESS_POD_LABELS)),
+                  labels=dict(INGRESS_POD_LABELS),
+                  annotations=svc_annotations or None),
     ]
+    if params["use_iap"]:
+        out.append(iap_backend_config(ns, params["iap_oauth_secret"]))
+        out.extend(iap_ingress(ns, params["managed_cert_domain"]))
+        out.append(iap_gateway_policy(ns, params["port"]))
     if params["use_istio"]:
         out.append(istio_gateway(ns, params["hostname"]))
         for r in routes:

@@ -93,10 +93,12 @@ class TpuJobSpec:
     # PVC: /root/reference/kubeflow/kubebench/kubebench-job.libsonnet:160-176)
     volumes: List[Dict[str, Any]] = field(default_factory=list)
     volume_mounts: List[Dict[str, Any]] = field(default_factory=list)
-    # pre-run data staging: each {"source": "gs://...", "target": "/data"}
-    # becomes an init container copying the object tree into an emptyDir
-    # mounted at target — the openmpi-controller's S3/GCS download role
-    # (/root/reference/kubeflow/openmpi/ sidecar data staging), TPU-style
+    # pre-run data staging: each {"source": "gs://...", "target": "/data",
+    # "image": <optional downloader image>} becomes an init container
+    # copying the object tree into an emptyDir mounted at target — the
+    # openmpi-controller's S3/GCS download role (/root/reference/kubeflow/
+    # openmpi/ sidecar data staging), TPU-style. The downloader image
+    # defaults per scheme (cloud-sdk for gs://, aws-cli for s3://).
     data_staging: List[Dict[str, str]] = field(default_factory=list)
 
     @property
@@ -221,12 +223,13 @@ def build_worker_pod(job: o.Obj, index: int, placement: SlicePlacement,
         vol = f"staged-{k}"
         volumes.append({"name": vol, "emptyDir": {}})
         mounts.append({"name": vol, "mountPath": staging["target"]})
-        tool = ("gcloud storage cp -r"
-                if staging["source"].startswith("gs://")
-                else "aws s3 cp --recursive")
+        is_gcs = staging["source"].startswith("gs://")
+        tool = "gcloud storage cp -r" if is_gcs else "aws s3 cp --recursive"
+        default_image = ("google/cloud-sdk:slim" if is_gcs
+                         else "amazon/aws-cli:2")
         init_containers.append(o.container(
             f"stage-{k}",
-            staging.get("image", "google/cloud-sdk:slim"),
+            staging.get("image", default_image),
             command=["sh", "-c",
                      f"{tool} '{staging['source']}' "
                      f"'{staging['target']}/'"],

@@ -86,9 +86,12 @@ def main() -> None:  # pragma: no cover — container entrypoint
     logging.basicConfig(level=logging.INFO)
     url = os.environ.get(ENV_COLLECTOR_URL, "")
     if not url:
-        log.info("no %s configured; usage reporting disabled",
+        # idle, don't exit: returning would make the default-rendered
+        # Deployment (no collector configured) crash-loop forever
+        log.info("no %s configured; usage reporting idle",
                  ENV_COLLECTOR_URL)
-        return
+        while True:
+            time.sleep(24 * 3600)
     UsageReporter(HttpKubeClient(), url,
                   cluster_id=os.environ.get(ENV_CLUSTER_ID)).run_forever()
 

@@ -386,3 +386,71 @@ def test_notebook_controller_creates_virtual_service():
     assert client2.get_or_none("networking.istio.io/v1beta1",
                                "VirtualService", "team-a",
                                "notebook-nb2") is None
+
+
+# -- IAP mode (gcp/iap.libsonnet parity) -------------------------------------
+
+
+def test_iap_authenticator_parses_identity():
+    from kubeflow_tpu.edge.proxy import IAP_EMAIL_HEADER, iap_authenticator
+
+    assert iap_authenticator(
+        {IAP_EMAIL_HEADER: "accounts.google.com:alice@x.com"}) == \
+        "alice@x.com"
+    assert iap_authenticator({}) is None
+    assert iap_authenticator({IAP_EMAIL_HEADER: ""}) is None
+
+
+def test_proxy_iap_mode_stamps_identity():
+    """Behind IAP, the proxy trusts the LB's identity header and stamps it
+    (replacing any spoofed in-mesh identity header)."""
+    from kubeflow_tpu.edge.proxy import IAP_EMAIL_HEADER, iap_authenticator
+
+    backend = _backend("dashboard")
+    proxy = EdgeProxy(
+        [Route("/", f"http://127.0.0.1:{backend.server_address[1]}",
+               strip_prefix=False)],
+        authenticator=iap_authenticator)
+    port = proxy.start(0)
+    try:
+        code, payload = _get(
+            f"http://127.0.0.1:{port}/api/env-info",
+            headers={IAP_EMAIL_HEADER: "accounts.google.com:alice@x.com",
+                     USER_HEADER: "admin-spoof"})
+        assert code == 200
+        assert payload["user"] == "alice@x.com"
+        code, _ = _get(f"http://127.0.0.1:{port}/api/env-info")
+        assert code == 401  # no IAP header, no entry
+    finally:
+        proxy.stop()
+        backend.shutdown()
+
+
+def test_gateway_component_iap_manifests():
+    from kubeflow_tpu.config.deployment import ComponentSpec, DeploymentConfig
+    from kubeflow_tpu.manifests.registry import render_component
+
+    config = DeploymentConfig(name="demo")
+    objs = render_component(config, ComponentSpec("gateway", params={
+        "use_iap": True, "managed_cert_domain": "kf.example.com"}))
+    kinds = [x["kind"] for x in objs]
+    assert kinds == ["Deployment", "Service", "BackendConfig", "Ingress",
+                     "ManagedCertificate", "NetworkPolicy"]
+    deploy, svc, bc, ing, cert, np_ = objs
+    # header trust requires the GCLB-only lockdown
+    cidrs = {f["ipBlock"]["cidr"]
+             for f in np_["spec"]["ingress"][0]["from"]}
+    assert cidrs == {"130.211.0.0/22", "35.191.0.0/16"}
+    env = {e["name"]: e["value"]
+           for e in deploy["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["KFTPU_EDGE_AUTH_MODE"] == "iap"
+    assert "KFTPU_VERIFY_URL" not in env
+    assert bc["spec"]["iap"]["enabled"] is True
+    assert bc["spec"]["iap"]["oauthclientCredentials"]["secretName"] == \
+        "kftpu-oauth"
+    ann = svc["metadata"]["annotations"]
+    assert json.loads(ann["cloud.google.com/backend-config"]) == {
+        "default": "kftpu-ingressgateway"}
+    assert ing["metadata"]["annotations"][
+        "networking.gke.io/managed-certificates"] == "kftpu-ingressgateway"
+    assert cert["spec"]["domains"] == ["kf.example.com"]

@@ -185,10 +185,14 @@ def test_dashboard_env_info_and_namespaces(dash_client):
 
 def test_dashboard_activities(dash_client):
     api = DashboardApi(dash_client)
-    code, acts = api.handle("GET", "/api/activities/alice", None)
+    code, acts = api.handle("GET", "/api/activities/alice", None,
+                            user="alice@x.com")
     assert code == 200
     assert acts[0]["reason"] == "Created"
     assert acts[0]["object"] == "train"
+    # events carry workload names/failure text: cross-tenant reads denied
+    assert api.handle("GET", "/api/activities/alice", None,
+                      user="mallory")[0] == 403
 
 
 def test_dashboard_workgroup(dash_client):
