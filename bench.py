@@ -24,14 +24,16 @@ REFERENCE_GPU_IMAGES_PER_SEC = 360.0
 def main() -> None:
     import argparse
 
-    from kubeflow_tpu.bench.suite import run_all
+    from kubeflow_tpu.bench.suite import run_all_isolated
 
     p = argparse.ArgumentParser()
     p.add_argument("--profile", metavar="DIR", default=None,
                    help="capture XLA profiler traces into DIR")
     args = p.parse_args()
 
-    results = run_all(profile_dir=args.profile)
+    # each config in its own subprocess under a hard timeout: a wedged
+    # device transport must never stop the one-JSON-line contract
+    results = run_all_isolated(profile_dir=args.profile)
     headline = results.get("resnet50", {})
     value = float(headline.get("images_per_sec_per_chip", 0.0))
     line = {

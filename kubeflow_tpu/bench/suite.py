@@ -327,6 +327,77 @@ def bench_bert(batch_per_chip: int = 16, seq_len: int = 512,
     }
 
 
+# -- long-context training (the capability the reference lacks) -------------
+
+
+def bench_longcontext(seq_len: int = 8192, batch_per_chip: int = 1,
+                      steps: int = 8, warmup: int = 2,
+                      d_model: int = 1024, n_layers: int = 8,
+                      n_heads: int = 16, d_ff: int = 4096,
+                      profile_dir: Optional[str] = None) -> Dict[str, Any]:
+    """Long-sequence LM training throughput with the Pallas flash-attention
+    path — the long-context capability SURVEY §5 names as first-class (the
+    reference's training stack has no sequence-parallel/long-context story
+    at all). On one chip this exercises the flash kernel + remat; the
+    sequence-parallel ring path over tp is covered by the virtual-mesh
+    tier (tests/test_ops.py) and the multichip dryrun."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import Transformer, TransformerConfig
+    from kubeflow_tpu.parallel import MeshConfig, create_mesh
+    from kubeflow_tpu.train import (
+        TrainState, create_sharded_state, make_lm_train_step, make_optimizer,
+    )
+
+    n_chips = jax.device_count()
+    mesh = create_mesh(MeshConfig(dp=n_chips))
+    config = TransformerConfig(
+        vocab_size=32000, d_model=d_model, n_layers=n_layers,
+        n_heads=n_heads, n_kv_heads=n_heads, d_ff=d_ff,
+        max_seq_len=seq_len, attention_impl="flash", remat=True,
+    )
+    model = Transformer(config)
+    batch = batch_per_chip * n_chips
+    tokens = jax.random.randint(jax.random.key(0), (batch, seq_len), 0,
+                                config.vocab_size)
+    tx = make_optimizer(3e-4, warmup_steps=5, decay_steps=100)
+
+    def init_fn(rng):
+        # init over a 2-example slice: param shapes don't depend on batch,
+        # and a full-batch init would execute a whole extra forward
+        params = model.init(rng, tokens[:2])["params"]
+        return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+
+    state, _ = create_sharded_state(init_fn, jax.random.key(0), mesh)
+    step = make_lm_train_step(mesh)
+    holder = {"state": state}
+
+    def one():
+        holder["state"], holder["m"] = step(holder["state"], tokens)
+
+    sec = _timed_steps(one, steps, warmup,
+                       sync=lambda: float(holder["m"]["loss"]))
+    if profile_dir:
+        _capture_trace(one, lambda: float(holder["m"]["loss"]), profile_dir)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(holder["state"].params))
+    # 6·N·D plus causal attention matmuls (12·L·S²·d per token, halved for
+    # causality) — remat recompute is excluded per the MFU convention
+    flops_per_step = (6 * n_params * batch * seq_len
+                      + 6 * config.n_layers * batch * seq_len * seq_len
+                      * config.d_model)
+    return {
+        "tokens_per_sec_per_chip": round(batch * seq_len / sec / n_chips, 1),
+        "step_time_ms": round(sec * 1e3, 2),
+        "seq_len": seq_len,
+        "batch_per_chip": batch_per_chip,
+        "attention": "flash(pallas)+remat",
+        "n_chips": n_chips,
+        **_mfu(flops_per_step, sec, n_chips),
+    }
+
+
 # -- config 4: allreduce microbench ------------------------------------------
 
 
@@ -516,24 +587,28 @@ CONFIGS: Dict[str, Callable[[], Dict[str, Any]]] = {
     "mnist": bench_mnist,
     "resnet50": bench_resnet50,
     "bert": bench_bert,
+    "longcontext": bench_longcontext,
     "allreduce": bench_allreduce,
     "serving": bench_serving,
 }
+
+
+_PROFILABLE = ("resnet50", "bert", "longcontext")
 
 
 def run_all(only: Optional[list] = None,
             profile_dir: Optional[str] = None) -> Dict[str, Dict[str, Any]]:
     """Run every config; one failing config must not kill the rest.
 
-    ``profile_dir`` captures an XLA trace of the resnet50/bert hot loops
-    into ``<profile_dir>/<config>/`` (after timing, so capture overhead
-    never contaminates the numbers)."""
+    ``profile_dir`` captures an XLA trace of the training hot loops into
+    ``<profile_dir>/<config>/`` (after timing, so capture overhead never
+    contaminates the numbers)."""
     out: Dict[str, Dict[str, Any]] = {}
     for name, fn in CONFIGS.items():
         if only and name not in only:
             continue
         try:
-            if profile_dir and name in ("resnet50", "bert"):
+            if profile_dir and name in _PROFILABLE:
                 out[name] = fn(profile_dir=os.path.join(profile_dir, name))
                 out[name]["trace_dir"] = os.path.join(profile_dir, name)
             else:
@@ -541,6 +616,73 @@ def run_all(only: Optional[list] = None,
         except Exception as e:  # noqa: BLE001
             out[name] = {"error": f"{type(e).__name__}: {e}"}
     return out
+
+
+def run_all_isolated(only: Optional[list] = None,
+                     profile_dir: Optional[str] = None,
+                     timeout_s: Optional[float] = None
+                     ) -> Dict[str, Dict[str, Any]]:
+    """run_all with each config in its OWN subprocess under a hard
+    timeout.
+
+    A wedged device transport (observed: a killed client can leave the
+    remote chip tunnel blocking every subsequent device op indefinitely)
+    would otherwise hang the whole bench run without emitting the one
+    JSON line the driver records; a subprocess can always be killed.
+    Timeout default: ``KFTPU_BENCH_TIMEOUT_S`` (900)."""
+    import subprocess
+    import sys
+
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("KFTPU_BENCH_TIMEOUT_S", "900"))
+    out: Dict[str, Dict[str, Any]] = {}
+    names = [n for n in CONFIGS if not only or n in only]
+    for i, name in enumerate(names):
+        args = [name]
+        if profile_dir and name in _PROFILABLE:
+            args += ["--profile", profile_dir]
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m", "kubeflow_tpu.bench.suite", *args],
+                capture_output=True, text=True, timeout=timeout_s,
+                cwd=os.path.dirname(os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__)))))
+        except subprocess.TimeoutExpired:
+            out[name] = {"error": f"timeout after {timeout_s:.0f}s "
+                                  "(device transport hung?)"}
+            # killing a client mid-device-op can wedge the transport for
+            # everyone after (see .claude/skills/verify gotchas): probe
+            # before burning the full timeout on each remaining config
+            if not _device_alive():
+                for rest in names[i + 1:]:
+                    out[rest] = {"error": "skipped: device transport "
+                                          "wedged after timeout"}
+                break
+            continue
+        except OSError as e:
+            out[name] = {"error": f"{type(e).__name__}: {e}"}
+            continue
+        try:
+            payload = json.loads(proc.stdout.strip().splitlines()[-1])
+            out[name] = payload.get(name, payload)
+        except (ValueError, IndexError):
+            out[name] = {"error": (proc.stderr.strip() or "no output")
+                         [-300:]}
+    return out
+
+
+def _device_alive(timeout_s: float = 60.0) -> bool:
+    """Cheap device-transport probe in a killable subprocess."""
+    import subprocess
+    import sys
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True, timeout=timeout_s)
+        return proc.returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False
 
 
 def main() -> None:

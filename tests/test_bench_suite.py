@@ -66,3 +66,67 @@ def test_mfu_math():
     assert suite._mfu(None, 1.0, 1) == {}
     out = suite._mfu(12.33e9 * 256, 1.0, 1)
     assert out == {}  # CPU: no peak → no MFU claimed
+
+
+def test_longcontext_config_on_virtual_mesh():
+    # tiny model: the CPU tier checks the path, the chip checks the speed
+    out = suite.bench_longcontext(seq_len=512, batch_per_chip=1, steps=2,
+                                  warmup=1, d_model=64, n_layers=2,
+                                  n_heads=4, d_ff=128)
+    assert out["tokens_per_sec_per_chip"] > 0
+    assert out["attention"] == "flash(pallas)+remat"
+    assert out["seq_len"] == 512
+
+
+def test_run_all_isolated_survives_hung_config(monkeypatch, tmp_path):
+    """A config that never returns must time out to an error entry, not
+    hang the bench (the wedged-device-transport contract)."""
+    import json as _json
+    import sys
+
+    fake = tmp_path / "fake_suite.py"
+    # stand-in for `python -m kubeflow_tpu.bench.suite <config>`
+    fake.write_text(
+        "import sys, time, json\n"
+        "name = sys.argv[1]\n"
+        "if name == 'mnist':\n"
+        "    print(json.dumps({'mnist': {'images_per_sec': 1.0}}))\n"
+        "else:\n"
+        "    time.sleep(60)\n")
+    import subprocess as _sp
+
+    real_run = _sp.run
+
+    def fake_run(cmd, **kw):
+        cmd = [sys.executable, str(fake), cmd[cmd.index("kubeflow_tpu.bench.suite") + 1]]
+        return real_run(cmd, **kw)
+
+    monkeypatch.setattr(_sp, "run", fake_run)
+    monkeypatch.setattr(suite, "_device_alive", lambda timeout_s=60.0: True)
+    out = suite.run_all_isolated(only=["mnist", "resnet50"], timeout_s=10.0)
+    assert out["mnist"] == {"images_per_sec": 1.0}
+    assert "timeout" in out["resnet50"]["error"]
+
+
+def test_run_all_isolated_skips_rest_when_transport_wedged(monkeypatch,
+                                                           tmp_path):
+    """After a timeout, a failing device probe marks the remaining configs
+    skipped instead of burning the full timeout on each."""
+    import subprocess as _sp
+    import sys
+
+    fake = tmp_path / "fake_suite.py"
+    fake.write_text("import time; time.sleep(60)\n")
+    real_run = _sp.run
+
+    def fake_run(cmd, **kw):
+        cmd = [sys.executable, str(fake), "x"]
+        return real_run(cmd, **kw)
+
+    monkeypatch.setattr(_sp, "run", fake_run)
+    monkeypatch.setattr(suite, "_device_alive", lambda timeout_s=60.0: False)
+    out = suite.run_all_isolated(only=["mnist", "resnet50", "bert"],
+                                 timeout_s=3.0)
+    assert "timeout" in out["mnist"]["error"]
+    assert "wedged" in out["resnet50"]["error"]
+    assert "wedged" in out["bert"]["error"]
