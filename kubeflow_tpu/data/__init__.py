@@ -1,0 +1,16 @@
+"""Input pipeline: native threaded batcher + sharded device feed.
+
+The tf.data role of the reference's workloads
+(``/root/reference/tf-controller-examples/tf-cnn/``), rebuilt for the TPU
+host: C++ producer threads assemble shuffled batches
+(``kubeflow_tpu/native/dataloader.cc``), Python keeps the device fed with
+an async double-buffer sharded over the mesh's data axes.
+"""
+
+from kubeflow_tpu.data.loader import (  # noqa: F401
+    DataLoader,
+    PyDataLoader,
+    device_feed,
+    read_shards,
+    write_shards,
+)

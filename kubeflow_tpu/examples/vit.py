@@ -1,0 +1,91 @@
+"""ViT image-classification training job.
+
+Transformer-native vision workload beside the ResNet baseline (the
+reference's vision examples are all tf_cnn_benchmarks CNNs,
+``/root/reference/tf-controller-examples/tf-cnn/``):
+``python -m kubeflow_tpu.examples.vit --steps 100``. Synthetic data;
+same launcher/env contract as every other workload.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.examples.common import launcher_init, log_metrics
+from kubeflow_tpu.models import ViT, ViTConfig
+from kubeflow_tpu.train import (
+    TrainState,
+    create_sharded_state,
+    make_image_train_step,
+    make_optimizer,
+)
+from kubeflow_tpu.utils.profiler import StepProfiler
+
+
+def main(argv=None) -> float:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--per-device-batch", type=int, default=64)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--patch-size", type=int, default=16)
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--d-model", type=int, default=768)
+    p.add_argument("--n-layers", type=int, default=12)
+    p.add_argument("--n-heads", type=int, default=12)
+    p.add_argument("--d-ff", type=int, default=3072)
+    p.add_argument("--tp", type=int, default=None)
+    p.add_argument("--log-every", type=int, default=10)
+    args = p.parse_args(argv)
+
+    penv, mesh = launcher_init(tp=args.tp)
+    batch = args.per_device_batch * jax.device_count()
+    model = ViT(ViTConfig(
+        image_size=args.image_size, patch_size=args.patch_size,
+        num_classes=args.num_classes, d_model=args.d_model,
+        n_layers=args.n_layers, n_heads=args.n_heads, d_ff=args.d_ff))
+    tx = make_optimizer(3e-4, warmup_steps=10, decay_steps=args.steps + 10)
+
+    images = jax.random.normal(
+        jax.random.key(0), (batch, args.image_size, args.image_size, 3),
+        jnp.bfloat16)
+    labels = jnp.zeros((batch,), jnp.int32)
+
+    def init_fn(rng):
+        params = model.init(rng, images[:2])["params"]
+        return TrainState.create(
+            apply_fn=lambda v, x, train=True: model.apply(v, x),
+            params=params, tx=tx)
+
+    state, _ = create_sharded_state(init_fn, jax.random.key(0), mesh)
+    step_fn = make_image_train_step(mesh)
+
+    metrics = None
+    state, metrics = step_fn(state, images, labels)
+    float(metrics["loss"])  # force compile + first step before timing
+
+    prof = StepProfiler.from_env()
+    t0 = time.perf_counter()
+    for step in range(1, args.steps + 1):
+        prof.step(step)
+        state, metrics = step_fn(state, images, labels)
+        if step % args.log_every == 0 or step == args.steps:
+            float(metrics["loss"])
+            elapsed = time.perf_counter() - t0
+            ips = step * batch / elapsed
+            log_metrics(step, loss=metrics["loss"], images_per_sec=ips,
+                        images_per_sec_per_chip=ips / jax.device_count())
+    float(metrics["loss"])
+    prof.close()
+    dt = time.perf_counter() - t0
+    ips = args.steps * batch / dt
+    log_metrics(args.steps, final=True, images_per_sec=ips,
+                images_per_sec_per_chip=ips / jax.device_count())
+    return ips
+
+
+if __name__ == "__main__":
+    main()

@@ -1,4 +1,5 @@
-"""In-framework model zoo: transformer LM (flagship), ResNet, MNIST CNN."""
+"""In-framework model zoo: transformer LM (flagship), ResNet, BERT, ViT,
+MNIST CNN."""
 
 from kubeflow_tpu.models.transformer import (  # noqa: F401
     Transformer,
@@ -19,5 +20,12 @@ from kubeflow_tpu.models.bert import (  # noqa: F401
     bert_base,
     bert_large,
     bert_tiny,
+)
+from kubeflow_tpu.models.vit import (  # noqa: F401
+    ViT,
+    ViTConfig,
+    vit_base,
+    vit_large,
+    vit_tiny,
 )
 from kubeflow_tpu.models.mnist import MnistCnn  # noqa: F401

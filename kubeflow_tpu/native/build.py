@@ -19,7 +19,8 @@ from typing import Optional
 log = logging.getLogger(__name__)
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "placement.cc")
+_SOURCES = [os.path.join(_DIR, "placement.cc"),
+            os.path.join(_DIR, "dataloader.cc")]
 _LIB = os.path.join(_DIR, "_kftpu_native.so")
 
 _lock = threading.Lock()
@@ -30,12 +31,13 @@ _load_failed = False
 def _needs_build() -> bool:
     if not os.path.exists(_LIB):
         return True
-    return os.path.getmtime(_SRC) > os.path.getmtime(_LIB)
+    return any(os.path.getmtime(src) > os.path.getmtime(_LIB)
+               for src in _SOURCES)
 
 
 def _build() -> bool:
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-           "-o", _LIB, _SRC]
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           "-o", _LIB, *_SOURCES]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=120)
@@ -78,6 +80,19 @@ def load_library() -> Optional[ctypes.CDLL]:
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
             ctypes.POINTER(ctypes.c_int32),
         ]
+        lib.kftpu_loader_create.restype = ctypes.c_void_p
+        lib.kftpu_loader_create.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_uint64,
+        ]
+        lib.kftpu_loader_next.restype = ctypes.c_int64
+        lib.kftpu_loader_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float)]
+        lib.kftpu_loader_ready.restype = ctypes.c_int32
+        lib.kftpu_loader_ready.argtypes = [ctypes.c_void_p]
+        lib.kftpu_loader_destroy.restype = None
+        lib.kftpu_loader_destroy.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 

@@ -22,6 +22,13 @@ int32_t kftpu_place_slices(const int32_t* slice_hosts,
                            int32_t want, int32_t need_hosts, int32_t* out);
 int32_t kftpu_ring_order(int32_t n_hosts, int32_t rows, int32_t cols,
                          int32_t* out);
+void* kftpu_loader_create(const float* data, int64_t n_records,
+                          int64_t record_len, int64_t batch,
+                          int32_t n_threads, int32_t pool_size,
+                          uint64_t seed);
+int64_t kftpu_loader_next(void* handle, float* out);
+int32_t kftpu_loader_ready(void* handle);
+void kftpu_loader_destroy(void* handle);
 }
 
 namespace {
@@ -60,6 +67,50 @@ void hammer(int seed, int iters, int* failures) {
 
 }  // namespace
 
+namespace {
+
+// the loader's producers + several consumer threads against one handle:
+// free-list/ready-queue locking, atomic epoch cursor, epoch reshuffle
+int loader_stress(int n_consumers, int batches_per_consumer) {
+  const int64_t n_records = 64, record_len = 8, batch = 16;
+  std::vector<float> data(
+      static_cast<size_t>(n_records * record_len));
+  for (int64_t i = 0; i < n_records; ++i) {
+    data[static_cast<size_t>(i * record_len)] = static_cast<float>(i);
+  }
+  void* h = kftpu_loader_create(data.data(), n_records, record_len, batch,
+                                /*n_threads=*/4, /*pool_size=*/4,
+                                /*seed=*/42);
+  if (!h) return 1;
+  std::vector<std::thread> consumers;
+  std::vector<int> bad(static_cast<size_t>(n_consumers), 0);
+  for (int c = 0; c < n_consumers; ++c) {
+    consumers.emplace_back([&, c] {
+      std::vector<float> out(static_cast<size_t>(batch * record_len));
+      for (int k = 0; k < batches_per_consumer; ++k) {
+        if (kftpu_loader_next(h, out.data()) < 0) {
+          ++bad[static_cast<size_t>(c)];
+          return;
+        }
+        for (int64_t r = 0; r < batch; ++r) {
+          const float id = out[static_cast<size_t>(r * record_len)];
+          if (id < 0 || id >= static_cast<float>(n_records)) {
+            ++bad[static_cast<size_t>(c)];
+          }
+        }
+        (void)kftpu_loader_ready(h);
+      }
+    });
+  }
+  for (auto& th : consumers) th.join();
+  kftpu_loader_destroy(h);
+  int total = 0;
+  for (int b : bad) total += b;
+  return total;
+}
+
+}  // namespace
+
 int main(int argc, char** argv) {
   const int n_threads = argc > 1 ? std::atoi(argv[1]) : 8;
   const int iters = argc > 2 ? std::atoi(argv[2]) : 300;
@@ -71,10 +122,13 @@ int main(int argc, char** argv) {
   for (auto& th : threads) th.join();
   int total = 0;
   for (int f : failures) total += f;
+  total += loader_stress(/*n_consumers=*/4,
+                         /*batches_per_consumer=*/iters / 2);
   if (total) {
     std::fprintf(stderr, "stress: %d invalid results\n", total);
     return 1;
   }
-  std::printf("stress ok: %d threads x %d iters\n", n_threads, iters);
+  std::printf("stress ok: %d threads x %d iters (+loader)\n", n_threads,
+              iters);
   return 0;
 }

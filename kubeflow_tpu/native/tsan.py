@@ -17,6 +17,7 @@ from typing import Optional, Tuple
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SOURCES = [os.path.join(_DIR, "placement.cc"),
+            os.path.join(_DIR, "dataloader.cc"),
             os.path.join(_DIR, "stress_main.cc")]
 _BIN = os.path.join(_DIR, "_kftpu_tsan_stress")
 
