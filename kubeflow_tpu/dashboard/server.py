@@ -183,6 +183,13 @@ class DashboardApi:
                 return 200, self.workgroup_exists(user)
             if path == "/api/dashboard-links":
                 return 200, self.dashboard_links()
+            if path.startswith("/api/tpujobs/"):
+                parts = path[len("/api/tpujobs/"):].split("/")
+                self._authz(user, parts[0], "tpujobs")
+                if len(parts) == 1:
+                    return 200, self.tpujobs(parts[0])
+                if len(parts) == 2:
+                    return self.tpujob_detail(parts[0], parts[1])
             if path.startswith("/api/studies/"):
                 parts = path[len("/api/studies/"):].split("/")
                 self._authz(user, parts[0], "studies")
@@ -241,6 +248,67 @@ class DashboardApi:
             if name == user:
                 owned.append(p["metadata"]["name"])
         return {"hasWorkgroup": bool(owned), "workgroups": owned}
+
+    # -- TPU jobs (the tf-job dashboard role) ------------------------------
+
+    def tpujobs(self, ns: str) -> List[Dict[str, Any]]:
+        """Job list with phase/shape/restarts — the reference's tf-job
+        dashboard table (``/root/reference/components/tf-job-dashboard``)
+        for the unified TpuJob."""
+        from kubeflow_tpu.manifests.components.tpujob_operator import (
+            API_VERSION,
+            TPUJOB_KIND,
+        )
+
+        out = []
+        for j in self.client.list(API_VERSION, TPUJOB_KIND, ns):
+            spec, status = j.get("spec", {}), j.get("status", {})
+            workers = status.get("workers", {}) or {}
+            out.append({
+                "name": j["metadata"]["name"],
+                "phase": status.get("phase", "Pending"),
+                "slices": spec.get("slices", 1),
+                "hostsPerSlice": spec.get("hostsPerSlice", 1),
+                "accelerator": spec.get("accelerator", ""),
+                "restarts": status.get("restarts", 0),
+                "workersRunning": workers.get("Running", 0),
+                "workersTotal": int(spec.get("slices", 1))
+                * int(spec.get("hostsPerSlice", 1)),
+                "startTime": status.get("startTime", ""),
+            })
+        out.sort(key=lambda j: j["name"])
+        return out
+
+    def tpujob_detail(self, ns: str, name: str) -> Tuple[int, Any]:
+        from kubeflow_tpu.manifests.components.tpujob_operator import (
+            API_VERSION,
+            TPUJOB_KIND,
+        )
+
+        job = self.client.get_or_none(API_VERSION, TPUJOB_KIND, ns, name)
+        if job is None:
+            return 404, {"error": f"tpujob {name!r} not found"}
+        pods = self.client.list("v1", "Pod", ns, label_selector={
+            "kubeflow-tpu.org/job-name": name})
+        workers = [{
+            "name": p["metadata"]["name"],
+            "phase": p.get("status", {}).get("phase", "Pending"),
+            "slice": (p["metadata"].get("labels", {}) or {}).get(
+                "kubeflow-tpu.org/slice", ""),
+            "host": (p["metadata"].get("labels", {}) or {}).get(
+                "kubeflow-tpu.org/host", ""),
+        } for p in pods]
+        # numeric placement order (string sort puts slice "10" before "2")
+        def order(w):
+            return (int(w["slice"] or -1), int(w["host"] or -1))
+
+        workers.sort(key=order)
+        return 200, {
+            "name": name,
+            "spec": job.get("spec", {}),
+            "status": job.get("status", {}),
+            "workers": workers,
+        }
 
     # -- studies (katib-ui parity) ----------------------------------------
 
@@ -370,7 +438,8 @@ class DashboardApi:
             # way); studies/runs are dashboard-served pages over the
             # /api/studies + /api/runs routes
             {"text": "Notebooks", "link": "/jupyter/", "icon": "book"},
-            {"text": "TPU Jobs", "link": "/tpujobs/", "icon": "donut-large"},
+            {"text": "TPU Jobs", "link": "/tpujobs.html",
+             "icon": "donut-large"},
             {"text": "Studies (HP tuning)", "link": "/studies.html",
              "icon": "tune"},
             {"text": "Workflow Runs", "link": "/runs.html",

@@ -1,0 +1,68 @@
+// TPU Jobs page over /api/tpujobs/<ns> (list + worker-gang detail).
+
+"use strict";
+// helpers ($, showError, api, esc) come from common.js
+
+async function openJob(ns, name) {
+  const d = await api(`/api/tpujobs/${encodeURIComponent(ns)}/` +
+                      encodeURIComponent(name));
+  $("detail-panel").style.display = "";
+  $("detail-title").textContent =
+    `${name} — ${d.status.phase || "Pending"}` +
+    (d.status.restarts ? ` (${d.status.restarts} restarts)` : "");
+  $("workers").innerHTML = d.workers.length
+    ? d.workers.map((w) => `
+      <tr>
+        <td>${esc(w.name)}</td>
+        <td>${esc(w.slice)}</td>
+        <td>${esc(w.host)}</td>
+        <td><span class="pill ${esc(w.phase)}">${esc(w.phase)}</span></td>
+      </tr>`).join("")
+    : "<tr><td colspan=4>no worker pods</td></tr>";
+  $("detail-panel").scrollIntoView({ behavior: "smooth" });
+}
+
+async function loadJobs(ns) {
+  const jobs = await api("/api/tpujobs/" + encodeURIComponent(ns));
+  $("jobs").innerHTML = jobs.length
+    ? jobs.map((j) => `
+      <tr>
+        <td><a href="#" data-job="${esc(j.name)}">${esc(j.name)}</a></td>
+        <td><span class="pill ${esc(j.phase)}">${esc(j.phase)}</span></td>
+        <td>${esc(j.slices)}×${esc(j.hostsPerSlice)}</td>
+        <td>${esc(j.accelerator)}</td>
+        <td>${esc(j.workersRunning)}/${esc(j.workersTotal)}</td>
+        <td>${esc(j.restarts)}</td>
+        <td>${esc(j.startTime || "—")}</td>
+      </tr>`).join("")
+    : "<tr><td colspan=7>no TPU jobs in this namespace</td></tr>";
+  for (const a of document.querySelectorAll("a[data-job]")) {
+    a.addEventListener("click", (e) => {
+      e.preventDefault();
+      openJob(ns, a.dataset.job).catch((err) => showError(err.message));
+    });
+  }
+}
+
+async function main() {
+  try {
+    const env = await api("/api/env-info");
+    $("user-chip").textContent = env.user;
+    const sel = $("ns-select");
+    sel.innerHTML = env.namespaces
+      .map((n) => `<option value="${esc(n)}">${esc(n)}</option>`).join("");
+    const saved = localStorage.getItem("kftpu-ns");
+    if (saved && env.namespaces.includes(saved)) sel.value = saved;
+    await loadJobs(sel.value);
+    sel.addEventListener("change", () => {
+      localStorage.setItem("kftpu-ns", sel.value);
+      $("detail-panel").style.display = "none";
+      loadJobs(sel.value).catch((err) => showError(err.message));
+    });
+    setInterval(() => loadJobs(sel.value).catch(() => {}), 10000);
+  } catch (err) {
+    if (err.message !== "unauthenticated") showError(err.message);
+  }
+}
+
+main();

@@ -110,28 +110,33 @@ class DataLoader:
         self.records = np.ascontiguousarray(records, dtype=np.float32)
         if self.records.ndim != 2:
             raise ValueError("records must be (N, record_len)")
+        # validate BEFORE the native call: a nullptr from create would
+        # otherwise masquerade as "toolchain unavailable" and the Python
+        # twin must reject exactly what the native one rejects
         if not 0 < int(batch) <= len(self.records):
-            # validate BEFORE the native call: a nullptr from create would
-            # otherwise masquerade as "toolchain unavailable" and the
-            # Python twin must reject exactly what the native one rejects
             raise ValueError(
                 f"batch {batch} must be in [1, {len(self.records)}] "
                 "(drop-remainder batching needs at least one full batch)")
+        if int(n_threads) < 1 or int(pool_size) < 2:
+            raise ValueError("need n_threads >= 1 and pool_size >= 2")
         self.batch = int(batch)
         self.record_len = self.records.shape[1]
         self._lib = load_library()
         self._handle = None
         self._fallback: Optional[PyDataLoader] = None
         if self._lib is not None:
+            # the native loader BORROWS self.records' buffer — this object
+            # keeps the array alive until close()
             self._handle = self._lib.kftpu_loader_create(
                 self.records.ctypes.data_as(
                     ctypes.POINTER(ctypes.c_float)),
                 self.records.shape[0], self.record_len, self.batch,
                 int(n_threads), int(pool_size), int(seed))
-        if not self._handle:
+        if self._handle:
+            self._out = np.empty((self.batch, self.record_len), np.float32)
+        else:
             self._handle = None
             self._fallback = PyDataLoader(self.records, batch, seed=seed)
-        self._out = np.empty((self.batch, self.record_len), np.float32)
 
     @property
     def native(self) -> bool:
@@ -199,10 +204,17 @@ def device_feed(loader, mesh, *, reshape=None, transform=None,
         return jax.tree_util.tree_map(
             lambda a: jax.device_put(a, sharding), arr)
 
+    if steps is not None and steps <= 0:
+        return
     pending = put(loader.next()[0])  # prime the double buffer
     produced = 0
-    while steps is None or produced < steps:
+    while True:
+        produced += 1
+        if steps is not None and produced >= steps:
+            # last batch: no lookahead fetch (a finite feed consumes
+            # exactly `steps` batches from the loader)
+            yield pending
+            return
         nxt = put(loader.next()[0])  # dispatch next transfer...
         yield pending                 # ...while the caller computes
         pending = nxt
-        produced += 1

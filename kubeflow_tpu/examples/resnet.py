@@ -44,10 +44,18 @@ def main(argv=None) -> float:
     model = resnet50(num_classes=args.num_classes)
     tx = make_optimizer(0.1, warmup_steps=10, decay_steps=args.steps + 10)
 
-    images = jax.random.normal(
-        jax.random.key(0), (batch, args.image_size, args.image_size, 3),
-        jnp.bfloat16)
-    labels = jnp.zeros((batch,), jnp.int32)
+    # synthetic tensors only when no real data: on the --data-dir path
+    # init needs just a 2-example shape carrier, not a full resident batch
+    if args.data_dir:
+        images = jax.random.normal(
+            jax.random.key(0), (2, args.image_size, args.image_size, 3),
+            jnp.bfloat16)
+        labels = None
+    else:
+        images = jax.random.normal(
+            jax.random.key(0),
+            (batch, args.image_size, args.image_size, 3), jnp.bfloat16)
+        labels = jnp.zeros((batch,), jnp.int32)
 
     def init_fn(rng):
         variables = model.init(rng, images[:2], train=True)

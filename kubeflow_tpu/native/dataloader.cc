@@ -41,8 +41,11 @@ struct Batch {
 };
 
 struct Loader {
-  // immutable after construction
-  std::vector<float> records;  // all shards, concatenated
+  // immutable after construction. `records` is BORROWED: the caller
+  // (kubeflow_tpu/data/loader.py keeps the numpy array alive for the
+  // handle's lifetime) owns the memory — copying ImageNet-scale datasets
+  // into the loader would double host RAM
+  const float* records = nullptr;
   int64_t n_records = 0;
   int64_t record_len = 0;
   int64_t batch = 0;
@@ -105,7 +108,7 @@ struct Loader {
       buf->epoch = claim(&idx);
       for (int64_t i = 0; i < batch; ++i) {
         std::memcpy(buf->data.data() + i * record_len,
-                    records.data() + idx[static_cast<size_t>(i)] * record_len,
+                    records + idx[static_cast<size_t>(i)] * record_len,
                     static_cast<size_t>(record_len) * sizeof(float));
       }
       {
@@ -121,7 +124,8 @@ struct Loader {
 
 extern "C" {
 
-// Create a loader over `data` (n_records x record_len floats, copied).
+// Create a loader over `data` (n_records x record_len floats, BORROWED:
+// the caller must keep the buffer alive until kftpu_loader_destroy).
 // Returns an opaque handle, or null on invalid arguments.
 void* kftpu_loader_create(const float* data, int64_t n_records,
                           int64_t record_len, int64_t batch,
@@ -132,7 +136,7 @@ void* kftpu_loader_create(const float* data, int64_t n_records,
     return nullptr;
   }
   auto* l = new Loader();
-  l->records.assign(data, data + n_records * record_len);
+  l->records = data;
   l->n_records = n_records;
   l->record_len = record_len;
   l->batch = batch;

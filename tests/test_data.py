@@ -145,3 +145,22 @@ def test_both_loaders_reject_oversized_batch():
         PyDataLoader(recs, batch=16)
     with pytest.raises(ValueError, match="batch 16"):
         DataLoader(recs, batch=16)
+
+
+def test_device_feed_consumes_exactly_steps_batches():
+    import jax  # noqa: F401 — feed needs a backend
+
+    from kubeflow_tpu.parallel import MeshConfig, create_mesh
+
+    mesh = create_mesh(MeshConfig(dp=8))
+    recs = _records(64, 4)
+    loader = PyDataLoader(recs, batch=16, seed=0)
+    got = list(device_feed(loader, mesh, steps=2))
+    assert len(got) == 2
+    # exactly 2 fetched: the next feed continues at batch 3, skipping none
+    check = PyDataLoader(recs, batch=16, seed=0)
+    check.next(), check.next()
+    np.testing.assert_array_equal(
+        np.asarray(next(device_feed(loader, mesh, steps=1))),
+        check.next()[0])
+    assert list(device_feed(loader, mesh, steps=0)) == []

@@ -281,6 +281,33 @@ def test_dashboard_studies_pages(dash_client):
                       user="mallory")[0] == 403
 
 
+def test_dashboard_tpujobs_pages(dash_client):
+    from kubeflow_tpu.operators.tpujob import TpuJobOperator, tpujob
+
+    dash_client.create(tpujob("train", "alice", {
+        "image": "img", "slices": 2, "hostsPerSlice": 2,
+        "accelerator": "v5e-8"}))
+    TpuJobOperator(dash_client).reconcile("alice", "train")
+
+    api = DashboardApi(dash_client)
+    u = "alice@x.com"
+    code, jobs = api.handle("GET", "/api/tpujobs/alice", None, user=u)
+    assert code == 200
+    assert jobs[0]["name"] == "train"
+    assert jobs[0]["slices"] == 2 and jobs[0]["workersTotal"] == 4
+
+    code, detail = api.handle("GET", "/api/tpujobs/alice/train", None,
+                              user=u)
+    assert code == 200
+    assert len(detail["workers"]) == 4
+    slices = {w["slice"] for w in detail["workers"]}
+    assert slices == {"0", "1"}
+    assert api.handle("GET", "/api/tpujobs/alice/nope", None,
+                      user=u)[0] == 404
+    assert api.handle("GET", "/api/tpujobs/alice", None,
+                      user="mallory")[0] == 403
+
+
 def test_dashboard_runs_merges_live_and_archive(dash_client, tmp_path):
     from kubeflow_tpu.workflows import RunArchive, WorkflowController
     from kubeflow_tpu.workflows.workflow import (

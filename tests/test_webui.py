@@ -57,14 +57,18 @@ def test_dashboard_serves_ui_and_api(dashboard_server):
 def test_dashboard_serves_studies_and_runs_pages(dashboard_server):
     for page, marker in (("/studies.html", b"objective-chart"),
                          ("/runs.html", b"Workflow Runs"),
+                         ("/tpujobs.html", b"TPU Jobs"),
                          ("/studies.js", b"drawChart"),
-                         ("/runs.js", b"loadRuns")):
+                         ("/runs.js", b"loadRuns"),
+                         ("/tpujobs.js", b"loadJobs")):
         code, body, _ = _get(dashboard_server + page)
         assert code == 200 and marker in body, page
     # the API routes the pages consume exist (empty namespace → empty lists)
     code, body, _ = _get(dashboard_server + "/api/studies/kubeflow")
     assert code == 200 and json.loads(body) == []
     code, body, _ = _get(dashboard_server + "/api/runs/kubeflow")
+    assert code == 200 and json.loads(body) == []
+    code, body, _ = _get(dashboard_server + "/api/tpujobs/kubeflow")
     assert code == 200 and json.loads(body) == []
 
 
