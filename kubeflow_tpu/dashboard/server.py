@@ -298,9 +298,13 @@ class DashboardApi:
             "host": (p["metadata"].get("labels", {}) or {}).get(
                 "kubeflow-tpu.org/host", ""),
         } for p in pods]
-        # numeric placement order (string sort puts slice "10" before "2")
+        # numeric placement order (string sort puts slice "10" before "2");
+        # foreign pods with non-numeric labels sort last, never 500
         def order(w):
-            return (int(w["slice"] or -1), int(w["host"] or -1))
+            try:
+                return (0, int(w["slice"] or -1), int(w["host"] or -1), "")
+            except ValueError:
+                return (1, 0, 0, f"{w['slice']}/{w['host']}")
 
         workers.sort(key=order)
         return 200, {

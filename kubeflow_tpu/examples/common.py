@@ -86,3 +86,47 @@ def launcher_init(
 
 def checkpoint_dir(default: str = "") -> str:
     return os.environ.get("KFTPU_CHECKPOINT_DIR", default)
+
+
+def report_tuning_metrics(step: int, metrics: Dict[str, Any],
+                          *, final: bool = False, client=None) -> None:
+    """Publish trial metrics when running inside a study (no-op outside).
+
+    The study controller injects ``KFTPU_TRIAL_NAME`` and
+    ``KFTPU_OBJECTIVE_METRIC``; this appends the objective's step series
+    (what median early stopping reads) and, on ``final``, the metrics the
+    controller harvests on success. Failures only log — a metrics hiccup
+    must never kill a training step."""
+    trial = os.environ.get("KFTPU_TRIAL_NAME")
+    if not trial:
+        return
+    # exactly one reporter per gang: every worker shares the trial env,
+    # and concurrent read-modify-writes of the one metrics ConfigMap
+    # would drop or duplicate history points
+    if dist.from_env().process_id != 0:
+        return
+    ns = os.environ.get("KFTPU_NAMESPACE", "default")
+    objective = os.environ.get("KFTPU_OBJECTIVE_METRIC", "")
+    try:
+        from kubeflow_tpu.tuning.study import (
+            append_trial_history,
+            report_trial_metrics,
+        )
+
+        if client is None:
+            from kubeflow_tpu.k8s.client import HttpKubeClient
+
+            # one client for the trial's lifetime, not one per step
+            client = getattr(report_tuning_metrics, "_client", None)
+            if client is None:
+                client = HttpKubeClient()
+                report_tuning_metrics._client = client
+        if objective and objective in metrics:
+            append_trial_history(client, ns, trial, step,
+                                 float(metrics[objective]))
+        if final:
+            report_trial_metrics(client, ns, trial, {
+                k: float(v) for k, v in metrics.items()
+                if hasattr(v, "__float__")})
+    except Exception:  # noqa: BLE001
+        logging.exception("trial metrics report failed (continuing)")

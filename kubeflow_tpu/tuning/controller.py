@@ -51,6 +51,7 @@ TRIAL_RUNNING = "Running"
 TRIAL_SUCCEEDED = "Succeeded"
 TRIAL_FAILED = "Failed"
 TRIAL_KILLED = "Killed"  # study finished while this trial was in flight
+TRIAL_STOPPED = "EarlyStopped"  # median rule killed it; observation kept
 
 _trials_created = DEFAULT_REGISTRY.counter(
     "kftpu_tuning_trials_created_total", "trials fanned out by the controller")
@@ -101,6 +102,12 @@ class StudyController:
         trials = [self._sync_trial(ns, study, spec, t, jobs.get(
                       t["metadata"]["name"]))
                   for t in self._trials(ns, name)]
+        if spec.early_stopping == "median":
+            # completed-peer histories read ONCE per pass, not once per
+            # running trial (the same one-list-per-pass rule as `jobs`)
+            peer_hist = self._peer_histories(ns, trials)
+            trials = [self._maybe_early_stop(ns, spec, t, peer_hist)
+                      for t in trials]
 
         counts = {s: 0 for s in (TRIAL_PENDING, TRIAL_RUNNING,
                                  TRIAL_SUCCEEDED, TRIAL_FAILED)}
@@ -115,6 +122,7 @@ class StudyController:
             "trialsRunning": active,
             "trialsSucceeded": counts[TRIAL_SUCCEEDED],
             "trialsFailed": counts[TRIAL_FAILED],
+            "trialsEarlyStopped": counts.get(TRIAL_STOPPED, 0),
         }
         best = self._best(spec, trials)
         if best is not None:
@@ -191,7 +199,9 @@ class StudyController:
         Returns the (possibly updated) trial so the same reconcile pass
         counts fresh state."""
         if self._trial_phase(t) in (TRIAL_SUCCEEDED, TRIAL_FAILED,
-                                    TRIAL_KILLED):
+                                    TRIAL_KILLED, TRIAL_STOPPED):
+            # terminal — and for EarlyStopped the job was deliberately
+            # deleted, so the job-repair path below must not resurrect it
             return t
         tname = t["metadata"]["name"]
         if job is None:
@@ -228,6 +238,80 @@ class StudyController:
                 raise
         return t
 
+    def _peer_histories(self, ns: str,
+                        trials: List[o.Obj]) -> Dict[str, list]:
+        """Step histories of terminal trials (the early-stop comparison
+        set), fetched once per reconcile pass."""
+        from kubeflow_tpu.tuning.study import read_trial_history
+
+        out: Dict[str, list] = {}
+        for t in trials:
+            if self._trial_phase(t) in (TRIAL_SUCCEEDED, TRIAL_STOPPED):
+                name = t["metadata"]["name"]
+                out[name] = read_trial_history(self.client, ns, name)
+        return out
+
+    def _maybe_early_stop(self, ns: str, spec: StudySpec, t: o.Obj,
+                          peer_hist: Dict[str, list]) -> o.Obj:
+        """Median stopping rule (katib earlystopping medianstop parity):
+        kill a running trial whose best objective so far is worse than the
+        median of completed trials' best values at the same step count.
+        The trial keeps its best-so-far as its observation, so the
+        suggestion history and bestTrial stay informed."""
+        from statistics import median
+
+        from kubeflow_tpu.tuning.study import read_trial_history
+
+        if self._trial_phase(t) != TRIAL_RUNNING:
+            return t
+        settings = spec.early_stopping_settings
+        min_trials = int(settings.get("minTrials", 3))
+        min_steps = int(settings.get("minSteps", 1))
+        tname = t["metadata"]["name"]
+        history = read_trial_history(self.client, ns, tname)
+        # empty histories always pass (a malformed minSteps <= 0 must not
+        # make max() crash the reconcile loop)
+        if not history or len(history) < min_steps:
+            return t
+        cur_step = max(s for s, _ in history)
+        sign = spec.sign()
+        my_best = max(sign * v for _, v in history)
+
+        peers = []
+        for other_name, oh in peer_hist.items():
+            if other_name == tname:
+                continue
+            upto = [sign * v for s, v in oh if s <= cur_step]
+            if upto:
+                peers.append(max(upto))
+        if len(peers) < min_trials or my_best >= median(peers):
+            return t
+
+        # kill: delete the TpuJob (cascade takes the gang), keep the
+        # best-so-far observation
+        try:
+            self.client.delete(TPUJOB_API_VERSION, TPUJOB_KIND, ns, tname)
+        except ApiError as e:
+            if e.code != 404:
+                raise
+        t = dict(t)
+        t["status"] = {
+            **t.get("status", {}),
+            "phase": TRIAL_STOPPED,
+            "message": (f"median stopping at step {cur_step}: best "
+                        f"{sign * my_best:.6g} worse than median of "
+                        f"{len(peers)} completed trials"),
+            "observation": {spec.objective_metric: sign * my_best},
+        }
+        log.info("early-stopped trial %s/%s at step %d", ns, tname,
+                 cur_step)
+        try:
+            return self.client.update_status(t)
+        except ApiError as e:
+            if e.code != 404:
+                raise
+        return t
+
     def _records(self, spec: StudySpec,
                  trials: List[o.Obj]) -> List[TrialRecord]:
         """History keyed by the persisted ``spec.index``, densely.
@@ -247,7 +331,10 @@ class StudyController:
             phase = self._trial_phase(t)
             obs = t.get("status", {}).get("observation", {})
             objective = None
-            if phase == TRIAL_SUCCEEDED and spec.objective_metric in obs:
+            # early-stopped trials carry their best-so-far observation —
+            # valid history for the suggestion algorithm (katib semantics)
+            if (phase in (TRIAL_SUCCEEDED, TRIAL_STOPPED)
+                    and spec.objective_metric in obs):
                 objective = spec.sign() * float(obs[spec.objective_metric])
             recs.append(TrialRecord(
                 parameters=dict(t["spec"].get("parameters", {})),
@@ -267,6 +354,9 @@ class StudyController:
         env.update({
             "KFTPU_STUDY_NAME": name,
             "KFTPU_TRIAL_NAME": tname,
+            # lets the generic launcher hook report the right step series
+            # for early stopping without workload-specific wiring
+            "KFTPU_OBJECTIVE_METRIC": spec.objective_metric,
         })
         for k, v in params.items():
             env.setdefault(f"KFTPU_PARAM_{k.upper().replace('-', '_')}",
@@ -358,7 +448,8 @@ class StudyController:
         best = None
         for t in trials:
             obs = t.get("status", {}).get("observation", {})
-            if self._trial_phase(t) != TRIAL_SUCCEEDED:
+            # early-stopped observations are real measurements too
+            if self._trial_phase(t) not in (TRIAL_SUCCEEDED, TRIAL_STOPPED):
                 continue
             if spec.objective_metric not in obs:
                 continue

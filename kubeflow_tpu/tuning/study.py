@@ -12,10 +12,10 @@ from __future__ import annotations
 
 import json
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Mapping, Optional
+from typing import Any, Dict, List, Mapping, Optional, Tuple
 
 from kubeflow_tpu.k8s import objects as o
-from kubeflow_tpu.k8s.client import KubeClient, register_plural
+from kubeflow_tpu.k8s.client import ApiError, KubeClient, register_plural
 from kubeflow_tpu.manifests.components.tpujob_operator import GROUP, VERSION
 
 STUDY_API_VERSION = f"{GROUP}/{VERSION}"
@@ -45,6 +45,10 @@ class StudySpec:
     max_trials: int = 12
     max_failed_trials: int = 3
     trial_template: Dict[str, Any] = field(default_factory=dict)
+    # early stopping (katib earlystopping-service parity): "" = off,
+    # "median" = median stopping rule over trials' reported step history
+    early_stopping: str = ""
+    early_stopping_settings: Dict[str, Any] = field(default_factory=dict)
 
     @classmethod
     def from_dict(cls, spec: Mapping[str, Any]) -> "StudySpec":
@@ -68,6 +72,11 @@ class StudySpec:
             max_trials=int(spec.get("maxTrials", 12)),
             max_failed_trials=int(spec.get("maxFailedTrials", 3)),
             trial_template=dict(spec.get("trialTemplate", {}) or {}),
+            early_stopping=(spec.get("earlyStopping", {}) or {}).get(
+                "name", ""),
+            early_stopping_settings=dict(
+                (spec.get("earlyStopping", {}) or {}).get("settings", {})
+                or {}),
         )
         out.validate()
         return out
@@ -85,6 +94,10 @@ class StudySpec:
             raise ValueError("parallelTrials and maxTrials must be >= 1")
         if not self.trial_template.get("image"):
             raise ValueError("spec.trialTemplate.image is required")
+        if self.early_stopping not in ("", "median"):
+            raise ValueError(
+                f"unknown earlyStopping.name {self.early_stopping!r} "
+                "(supported: median)")
 
     def sign(self) -> float:
         """Multiplier mapping raw objective → internal maximize space."""
@@ -143,11 +156,14 @@ def metrics_configmap_name(trial_name: str) -> str:
 def report_trial_metrics(client: KubeClient, ns: str, trial_name: str,
                          metrics: Mapping[str, float]) -> None:
     """Called by the workload (the trainer's tuning hook) to publish final
-    metrics; replaces the reference's log-scraping metrics-collector."""
-    cm = o.config_map(
-        metrics_configmap_name(trial_name), ns,
-        {k: json.dumps(float(v)) for k, v in metrics.items()},
-    )
+    metrics; replaces the reference's log-scraping metrics-collector.
+    Merges over existing data so a step history reported earlier
+    (:func:`append_trial_history`) survives the final report."""
+    name = metrics_configmap_name(trial_name)
+    existing = client.get_or_none("v1", "ConfigMap", ns, name)
+    data = dict((existing or {}).get("data") or {})
+    data.update({k: json.dumps(float(v)) for k, v in metrics.items()})
+    cm = o.config_map(name, ns, data)
     cm["metadata"]["labels"] = {TRIAL_LABEL: trial_name}
     client.apply(cm)
 
@@ -158,4 +174,47 @@ def read_trial_metrics(client: KubeClient, ns: str,
                             metrics_configmap_name(trial_name))
     if cm is None:
         return None
-    return {k: float(json.loads(v)) for k, v in (cm.get("data") or {}).items()}
+    return {k: float(json.loads(v))
+            for k, v in (cm.get("data") or {}).items()
+            if k != HISTORY_KEY}
+
+
+HISTORY_KEY = "__history__"
+
+
+def append_trial_history(client: KubeClient, ns: str, trial_name: str,
+                         step: int, value: float) -> None:
+    """Workload-side intermediate metric report (one point per eval step).
+
+    The step series is what the median early-stopping rule reads —
+    katib's metrics-collector sidecar scraped the same from stdout
+    (``/root/reference/kubeflow/katib/studyjobcontroller.libsonnet:107-147``
+    collector template); here the workload reports directly."""
+    name = metrics_configmap_name(trial_name)
+    cm = client.get_or_none("v1", "ConfigMap", ns, name)
+    if cm is None:
+        cm = o.config_map(name, ns, {})
+        cm["metadata"]["labels"] = {TRIAL_LABEL: trial_name}
+        try:
+            client.create(cm)
+        except ApiError as e:
+            if e.code != 409:
+                raise
+            cm = client.get("v1", "ConfigMap", ns, name)
+    data = dict(cm.get("data") or {})
+    history = json.loads(data.get(HISTORY_KEY, "[]"))
+    history.append([int(step), float(value)])
+    data[HISTORY_KEY] = json.dumps(history)
+    cm = dict(cm)
+    cm["data"] = data
+    client.update(cm)
+
+
+def read_trial_history(client: KubeClient, ns: str,
+                       trial_name: str) -> List[Tuple[int, float]]:
+    cm = client.get_or_none("v1", "ConfigMap", ns,
+                            metrics_configmap_name(trial_name))
+    if cm is None:
+        return []
+    raw = (cm.get("data") or {}).get(HISTORY_KEY, "[]")
+    return [(int(s), float(v)) for s, v in json.loads(raw)]

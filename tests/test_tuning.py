@@ -477,3 +477,124 @@ def test_tuning_component_manifests():
     for algo in ("random", "grid", "bayesian", "hyperband"):
         assert ("Deployment", f"suggestion-{algo}") in kinds
         assert ("Service", f"suggestion-{algo}") in kinds
+
+
+# -- early stopping (katib earlystopping parity) ----------------------------
+
+def test_median_early_stopping_kills_lagging_trial():
+    """Three completed trials with good step histories; a running trial
+    whose curve is clearly worse gets killed at the median rule, keeps its
+    best-so-far observation, and does NOT get its job resurrected."""
+    from kubeflow_tpu.tuning.study import append_trial_history
+
+    client = FakeKubeClient()
+    ctrl = StudyController(client)
+    client.create(study("s", "default", _study_spec(
+        parallelTrials=4, maxTrials=8,
+        earlyStopping={"name": "median",
+                       "settings": {"minTrials": 3, "minSteps": 2}})))
+    ctrl.reconcile("default", "s")  # spawns 4 trials
+    trials = client.list(STUDY_API_VERSION, TRIAL_KIND, "default")
+    assert len(trials) == 4
+    names = [t["metadata"]["name"] for t in trials]
+    # three finish with strong histories
+    for tname in names[:3]:
+        for step, v in ((1, 0.5), (2, 0.7), (3, 0.8)):
+            append_trial_history(client, "default", tname, step, v)
+        report_trial_metrics(client, "default", tname, {"accuracy": 0.8})
+        job = client.get(TPUJOB_API_VERSION, TPUJOB_KIND, "default", tname)
+        job.setdefault("status", {})["phase"] = "Succeeded"
+        client.update_status(job)
+    # the fourth runs with a clearly-worse curve
+    lag = names[3]
+    job = client.get(TPUJOB_API_VERSION, TPUJOB_KIND, "default", lag)
+    job.setdefault("status", {})["phase"] = "Running"
+    client.update_status(job)
+    for step, v in ((1, 0.1), (2, 0.15), (3, 0.2)):
+        append_trial_history(client, "default", lag, step, v)
+
+    ctrl.reconcile("default", "s")
+    t = client.get(STUDY_API_VERSION, TRIAL_KIND, "default", lag)
+    assert t["status"]["phase"] == "EarlyStopped"
+    assert t["status"]["observation"]["accuracy"] == pytest.approx(0.2)
+    assert client.get_or_none(TPUJOB_API_VERSION, TPUJOB_KIND, "default",
+                              lag) is None
+    s = client.get(STUDY_API_VERSION, STUDY_KIND, "default", "s")
+    assert s["status"]["trialsEarlyStopped"] == 1
+
+    # next pass: the stopped trial's job must NOT be recreated
+    ctrl.reconcile("default", "s")
+    assert client.get_or_none(TPUJOB_API_VERSION, TPUJOB_KIND, "default",
+                              lag) is None
+
+
+def test_median_early_stopping_needs_min_trials():
+    """With fewer completed peers than minTrials, nothing is stopped."""
+    from kubeflow_tpu.tuning.study import append_trial_history
+
+    client = FakeKubeClient()
+    ctrl = StudyController(client)
+    client.create(study("s", "default", _study_spec(
+        parallelTrials=2, earlyStopping={"name": "median",
+                                         "settings": {"minTrials": 3}})))
+    ctrl.reconcile("default", "s")
+    names = [t["metadata"]["name"]
+             for t in client.list(STUDY_API_VERSION, TRIAL_KIND, "default")]
+    for tname in names:
+        job = client.get(TPUJOB_API_VERSION, TPUJOB_KIND, "default", tname)
+        job.setdefault("status", {})["phase"] = "Running"
+        client.update_status(job)
+        append_trial_history(client, "default", tname, 1, 0.01)
+    ctrl.reconcile("default", "s")
+    for tname in names:
+        t = client.get(STUDY_API_VERSION, TRIAL_KIND, "default", tname)
+        assert t["status"].get("phase") != "EarlyStopped"
+
+
+def test_studyspec_rejects_unknown_early_stopping():
+    with pytest.raises(ValueError, match="earlyStopping"):
+        StudySpec.from_dict(_study_spec(earlyStopping={"name": "bogus"}))
+
+
+def test_trial_history_roundtrip():
+    from kubeflow_tpu.tuning.study import (
+        append_trial_history,
+        read_trial_history,
+        read_trial_metrics,
+    )
+
+    client = FakeKubeClient()
+    append_trial_history(client, "default", "t1", 1, 0.5)
+    append_trial_history(client, "default", "t1", 2, 0.75)
+    assert read_trial_history(client, "default", "t1") == [(1, 0.5),
+                                                           (2, 0.75)]
+    # final metrics live in the same ConfigMap, history key excluded
+    report_trial_metrics(client, "default", "t1", {"accuracy": 0.9})
+    assert read_trial_metrics(client, "default", "t1") == {"accuracy": 0.9}
+    assert read_trial_history(client, "default", "t1") == [(1, 0.5),
+                                                           (2, 0.75)]
+
+
+def test_report_tuning_metrics_hook(monkeypatch):
+    """The launcher hook publishes history + finals under the trial env
+    contract and is a no-op outside a study."""
+    from kubeflow_tpu.examples.common import report_tuning_metrics
+    from kubeflow_tpu.tuning.study import (
+        read_trial_history,
+        read_trial_metrics,
+    )
+
+    client = FakeKubeClient()
+    # outside a study: nothing happens, nothing raises
+    report_tuning_metrics(1, {"accuracy": 0.5}, client=client)
+
+    monkeypatch.setenv("KFTPU_TRIAL_NAME", "s-t0")
+    monkeypatch.setenv("KFTPU_NAMESPACE", "default")
+    monkeypatch.setenv("KFTPU_OBJECTIVE_METRIC", "accuracy")
+    report_tuning_metrics(1, {"accuracy": 0.5, "loss": 2.0}, client=client)
+    report_tuning_metrics(2, {"accuracy": 0.7, "loss": 1.0}, client=client,
+                          final=True)
+    assert read_trial_history(client, "default", "s-t0") == [(1, 0.5),
+                                                             (2, 0.7)]
+    finals = read_trial_metrics(client, "default", "s-t0")
+    assert finals == {"accuracy": 0.7, "loss": 1.0}
