@@ -4,8 +4,10 @@ from kubeflow_tpu.manifests.components import (  # noqa: F401
     auth,
     dashboard,
     gateway,
+    monitoring,
     notebooks,
     serving,
+    storage,
     tenancy,
     tensorboard,
     tpujob_operator,

@@ -1,0 +1,117 @@
+"""Cluster monitoring: Prometheus scraper + optional Stackdriver bridge.
+
+Reference: ``/root/reference/kubeflow/gcp/prometheus.libsonnet`` — a
+Prometheus Deployment (nodes/services/endpoints/pods read RBAC, k8s
+service-discovery scrape config) whose ``stackdriver-prometheus-sidecar``
+exports to Cloud Monitoring. Here the scrape targets are the framework's
+own ``serve_metrics`` endpoints (every component Service annotates
+``prometheus.io/scrape``), and the sidecar renders only when a GCP
+project is configured.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import yaml
+
+from kubeflow_tpu.config.deployment import DeploymentConfig
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.manifests.registry import register
+
+DEFAULTS: Dict[str, Any] = {
+    "image": "prom/prometheus:v2.45.0",
+    "sidecar_image": "gcr.io/stackdriver-prometheus/stackdriver-prometheus-sidecar:0.10.1",
+    "port": 9090,
+    "scrape_interval": "30s",
+    # non-empty project enables the Stackdriver export sidecar
+    "project": "",
+    "cluster": "",
+    "zone": "",
+    "retention": "6h",
+}
+
+
+def scrape_config(interval: str) -> str:
+    """Pod-annotation service discovery, the libsonnet scrape shape."""
+    return yaml.safe_dump({
+        "global": {"scrape_interval": interval},
+        "scrape_configs": [{
+            "job_name": "kftpu-components",
+            "kubernetes_sd_configs": [{"role": "endpoints"}],
+            "relabel_configs": [
+                {"source_labels":
+                     ["__meta_kubernetes_service_annotation_prometheus_io_scrape"],
+                 "action": "keep", "regex": "true"},
+                # honor the per-service metrics port/path annotations the
+                # framework's Services set (multi-port services would
+                # otherwise be scraped on every endpoint port)
+                {"source_labels":
+                     ["__address__",
+                      "__meta_kubernetes_service_annotation_prometheus_io_port"],
+                 "action": "replace",
+                 "regex": r"([^:]+)(?::\d+)?;(\d+)",
+                 "replacement": "$1:$2",
+                 "target_label": "__address__"},
+                {"source_labels":
+                     ["__meta_kubernetes_service_annotation_prometheus_io_path"],
+                 "action": "replace", "regex": "(.+)",
+                 "target_label": "__metrics_path__"},
+                {"source_labels": ["__meta_kubernetes_namespace"],
+                 "action": "replace", "target_label": "namespace"},
+                {"source_labels": ["__meta_kubernetes_service_name"],
+                 "action": "replace", "target_label": "service"},
+            ],
+        }],
+    }, sort_keys=False)
+
+
+@register("monitoring", DEFAULTS,
+          "Prometheus scraper + optional Stackdriver bridge (gcp parity)")
+def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
+    ns = config.namespace
+    name = "prometheus"
+    containers = [o.container(
+        name, params["image"],
+        args=["--config.file=/etc/prometheus/prometheus.yaml",
+              "--storage.tsdb.path=/prometheus",
+              f"--storage.tsdb.retention.time={params['retention']}"],
+        ports=[params["port"]],
+        volume_mounts=[{"name": "config", "mountPath": "/etc/prometheus"},
+                       {"name": "data", "mountPath": "/prometheus"}],
+    )]
+    if params["project"]:
+        # the sidecar tails Prometheus's WAL, so both containers share the
+        # /prometheus data volume (the libsonnet pairs them the same way)
+        containers.append(o.container(
+            "stackdriver-sidecar", params["sidecar_image"],
+            args=[f"--stackdriver.project-id={params['project']}",
+                  f"--stackdriver.kubernetes.location={params['zone']}",
+                  "--stackdriver.kubernetes.cluster-name="
+                  f"{params['cluster']}",
+                  "--prometheus.wal-directory=/prometheus/wal"],
+            volume_mounts=[{"name": "data", "mountPath": "/prometheus"}],
+        ))
+    pod = o.pod_spec(
+        containers,
+        service_account_name=name,
+        volumes=[{"name": "config", "configMap": {"name": name}},
+                 {"name": "data", "emptyDir": {}}],
+    )
+    return [
+        o.service_account(name, ns),
+        o.cluster_role(name, [
+            {"apiGroups": [""],
+             "resources": ["nodes", "nodes/proxy", "services",
+                           "endpoints", "pods"],
+             "verbs": ["get", "list", "watch"]},
+        ]),
+        o.cluster_role_binding(name, name, name, ns),
+        o.config_map(name, ns,
+                     {"prometheus.yaml":
+                      scrape_config(params["scrape_interval"])}),
+        o.deployment(name, ns, pod),
+        o.service(name, ns, {"app": name},
+                  [{"name": "http", "port": int(params["port"]),
+                    "targetPort": int(params["port"])}]),
+    ]

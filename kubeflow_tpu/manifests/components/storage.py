@@ -1,0 +1,61 @@
+"""Shared-filesystem storage plumbing: NFS/Filestore PV + PVC pair.
+
+Reference: ``/root/reference/kubeflow/gcp/google-cloud-filestore-pv.libsonnet``
+(and the aws-efs twin) — a ReadWriteMany NFS PersistentVolume bound to a
+same-named claim, the storage notebooks/checkpoints/kubebench experiment
+dirs mount. Same shape here; the TPU use cases are checkpoint dirs
+(orbax), TensorBoard log dirs, and the workflow run-archive/artifact
+store.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from kubeflow_tpu.config.deployment import DeploymentConfig
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.manifests.registry import register
+
+DEFAULTS: Dict[str, Any] = {
+    "name": "kubeflow-shared",
+    "server_ip": "",          # Filestore/NFS server address (required)
+    "path": "/shared",
+    "capacity": "1Ti",
+    "storage_class": "nfs-storage",
+}
+
+
+@register("nfs-storage", DEFAULTS,
+          "ReadWriteMany NFS/Filestore PV + PVC (filestore-pv parity)")
+def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
+    if not params["server_ip"]:
+        raise ValueError("nfs-storage: server_ip is required "
+                         "(the Filestore/NFS endpoint)")
+    ns = config.namespace
+    name = params["name"]
+    sc = params["storage_class"]
+    return [
+        {
+            "apiVersion": "v1",
+            "kind": "PersistentVolume",
+            "metadata": {"name": name},
+            "spec": {
+                "capacity": {"storage": params["capacity"]},
+                "accessModes": ["ReadWriteMany"],
+                "nfs": {"path": params["path"],
+                        "server": params["server_ip"]},
+                "storageClassName": sc,
+            },
+        },
+        {
+            "apiVersion": "v1",
+            "kind": "PersistentVolumeClaim",
+            "metadata": o.metadata(name, ns),
+            "spec": {
+                "accessModes": ["ReadWriteMany"],
+                "storageClassName": sc,
+                "resources": {"requests":
+                              {"storage": params["capacity"]}},
+            },
+        },
+    ]

@@ -158,3 +158,48 @@ def test_usage_reporting_component(config):
     assert render_component(
         config, ComponentSpec("usage-reporting",
                               params={"enabled": False})) == []
+
+
+def test_monitoring_component(config):
+    import yaml as _yaml
+
+    objs = render_component(config, ComponentSpec("monitoring"))
+    kinds = [x["kind"] for x in objs]
+    assert kinds == ["ServiceAccount", "ClusterRole", "ClusterRoleBinding",
+                     "ConfigMap", "Deployment", "Service"]
+    scrape = _yaml.safe_load(objs[3]["data"]["prometheus.yaml"])
+    relabels = scrape["scrape_configs"][0]["relabel_configs"]
+    assert relabels[0]["action"] == "keep" and relabels[0]["regex"] == "true"
+    # the annotated metrics port/path must win over raw endpoint ports
+    targets = {r.get("target_label") for r in relabels}
+    assert {"__address__", "__metrics_path__"} <= targets
+    # no project -> no stackdriver sidecar
+    ctrs = objs[4]["spec"]["template"]["spec"]["containers"]
+    assert [c["name"] for c in ctrs] == ["prometheus"]
+
+    objs = render_component(config, ComponentSpec("monitoring", params={
+        "project": "my-proj", "cluster": "demo", "zone": "us-east5-a"}))
+    deploy = [x for x in objs if x["kind"] == "Deployment"][0]
+    ctrs = deploy["spec"]["template"]["spec"]["containers"]
+    assert [c["name"] for c in ctrs] == ["prometheus",
+                                         "stackdriver-sidecar"]
+    # the sidecar tails the WAL: both containers share /prometheus
+    for c in ctrs:
+        assert {"name": "data", "mountPath": "/prometheus"} in \
+            c["volumeMounts"]
+    vols = {v["name"] for v in deploy["spec"]["template"]["spec"]["volumes"]}
+    assert vols == {"config", "data"}
+    assert "--storage.tsdb.path=/prometheus" in ctrs[0]["args"]
+
+
+def test_nfs_storage_component(config):
+    objs = render_component(config, ComponentSpec("nfs-storage", params={
+        "server_ip": "10.0.0.2"}))
+    pv, pvc = objs
+    assert pv["kind"] == "PersistentVolume"
+    assert pv["spec"]["nfs"] == {"path": "/shared", "server": "10.0.0.2"}
+    assert pv["spec"]["accessModes"] == ["ReadWriteMany"]
+    assert pvc["kind"] == "PersistentVolumeClaim"
+    assert pvc["spec"]["storageClassName"] == "nfs-storage"
+    with pytest.raises(ValueError, match="server_ip"):
+        render_component(config, ComponentSpec("nfs-storage"))
