@@ -59,8 +59,9 @@ class TransformerConfig:
     scan_layers: bool = True
     logits_softcap: float = 0.0
     # attention core: "dense" O(S²) (XLA-fused, fine to moderate S),
-    # "blockwise" O(S·block) scan, "flash" Pallas kernel, "ring"
-    # sequence-parallel ring attention over the seq mesh axis (long context)
+    # "blockwise" O(S·block) scan, "flash" Pallas kernel, "ring"/"ulysses"
+    # sequence-parallel attention over the seq mesh axis (ppermute KV
+    # rotation vs all_to_all seq↔heads re-shard; both long-context)
     attention_impl: str = "dense"
     attention_block_k: int = 512
     causal: bool = True           # False => bidirectional (encoder/BERT)
@@ -77,7 +78,8 @@ class TransformerConfig:
             raise ValueError("n_heads must be a multiple of n_kv_heads")
         if self.n_experts and self.experts_per_token > self.n_experts:
             raise ValueError("experts_per_token > n_experts")
-        if self.attention_impl not in ("dense", "blockwise", "flash", "ring"):
+        if self.attention_impl not in ("dense", "blockwise", "flash",
+                                       "ring", "ulysses"):
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
 
 
@@ -137,8 +139,8 @@ class Attention(nn.Module):
         q = jnp.einsum("bsd,dhk->bshk", x, wq.astype(c.dtype))
         k = jnp.einsum("bsd,dhk->bshk", x, wk.astype(c.dtype))
         v = jnp.einsum("bsd,dhk->bshk", x, wv.astype(c.dtype))
-        if c.attention_impl == "ring":
-            # sequence stays sharded through attention (ring path); heads
+        if c.attention_impl in ("ring", "ulysses"):
+            # sequence stays sharded through attention (SP paths); heads
             # replicate — the inverse of the tensor-parallel dense layout
             q = _constrain(q, c.rules, "batch", "seq", None, None)
         else:
@@ -146,7 +148,10 @@ class Attention(nn.Module):
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
 
-        if KH != H:
+        if KH != H and c.attention_impl != "ulysses":
+            # GQA repeat for the cores that want full heads; ulysses
+            # repeats AFTER its KV all_to_alls so the collectives carry
+            # only the distinct KV heads
             rep = H // KH
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
@@ -180,10 +185,14 @@ class Attention(nn.Module):
                     q, k, v, causal=c.causal, block_k=c.attention_block_k
                 )
             return att.flash_attention(q, k, v, c.causal, block, block)
-        # ring: sequence-parallel over the seq mesh axis; partial-manual
-        # shard_map (batch/other axes stay auto) on the current mesh
+        # ring / ulysses: sequence-parallel over the seq mesh axis;
+        # partial-manual shard_map (batch/other axes stay auto)
         mesh = jax.sharding.get_abstract_mesh()
         if mesh.empty or c.seq_axis not in mesh.axis_names:
+            if k.shape[2] != q.shape[2]:  # ulysses defers the GQA repeat
+                rep = q.shape[2] // k.shape[2]
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
             return att.blockwise_attention(
                 q, k, v, causal=c.causal, block_k=c.attention_block_k
             )
@@ -191,11 +200,16 @@ class Attention(nn.Module):
 
         from jax.sharding import PartitionSpec as P
 
+        if c.attention_impl == "ulysses":
+            core = functools.partial(
+                att.ulysses_attention, axis_name=c.seq_axis,
+                causal=c.causal, block_k=c.attention_block_k)
+        else:
+            core = functools.partial(
+                att.ring_attention, axis_name=c.seq_axis, causal=c.causal)
         spec = P(None, c.seq_axis, None, None)
         fn = jax.shard_map(
-            functools.partial(
-                att.ring_attention, axis_name=c.seq_axis, causal=c.causal
-            ),
+            core,
             mesh=mesh,
             in_specs=(spec, spec, spec),
             out_specs=spec,

@@ -6,6 +6,8 @@ from kubeflow_tpu.ops.attention import (  # noqa: F401
     reference_attention,
     ring_attention,
     ring_attention_sharded,
+    ulysses_attention,
+    ulysses_attention_sharded,
 )
 from kubeflow_tpu.ops.collectives import (  # noqa: F401
     CollectiveResult,

@@ -111,11 +111,12 @@ def blockwise_attention(q, k, v, *, causal: bool = True, block_k: int = 512,
             None,
         )
 
-    init = (
-        jnp.zeros((B, Sq, H, D), jnp.float32),
-        jnp.zeros((B, Sq, H), jnp.float32),
-        jnp.full((B, Sq, H), NEG_INF, jnp.float32),
-    )
+    # accumulators derive from q so they carry its varying-axes type when
+    # running inside shard_map (e.g. ulysses_attention) — the vma checker
+    # rejects unvarying zeros as a scan carry, exactly as in ring_attention
+    o0 = (q * 0).astype(jnp.float32)
+    l0 = o0[..., 0]
+    init = (o0, l0, l0 + NEG_INF)
     (o, l, _), _ = jax.lax.scan(body, init, (ks, vs, jnp.arange(n_blocks)))
     return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
@@ -301,15 +302,56 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = True,
     return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
-def ring_attention_sharded(q, k, v, mesh, *, seq_axis: str = "tp",
-                           batch_axis=("dcn", "dp"), causal: bool = True,
-                           sm_scale: Optional[float] = None):
-    """``shard_map`` wrapper: full (B, S, H, D) arrays in, ring attention on
-    sequence shards over ``seq_axis``. Usable directly under jit.
+def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = True,
+                      sm_scale: Optional[float] = None,
+                      block_k: int = 512):
+    """DeepSpeed-Ulysses-style sequence parallelism inside ``shard_map``.
 
-    ``batch_axis`` may be a name, a tuple of names, or None; names absent
-    from ``mesh`` are dropped, so the default works on plain dp/tp meshes
-    and on the 4-axis dcn mesh alike."""
+    The ring's alternative collective pattern: instead of rotating KV
+    shards (n-1 ``ppermute`` hops), two ``all_to_all``s re-shard
+    sequence↔heads — q/k/v arrive sequence-sharded ``(B, S/n, H, D)``,
+    leave the first all_to_all head-sharded with the FULL sequence
+    ``(B, S, H/n, D)``, attend locally (blockwise: O(S) memory), and the
+    second all_to_all restores sequence sharding. On TPU both all_to_alls
+    ride ICI; Ulysses wins when heads divide evenly and S/n is small
+    (fewer collective phases), ring wins at extreme S (no full-sequence
+    residency).
+
+    GQA: k/v may arrive with fewer heads than q (``KH < H``); the repeat
+    to ``H`` happens AFTER the KV all_to_alls so the collectives carry
+    only the distinct KV heads. Requires ``H % n == 0`` and
+    ``KH % n == 0``.
+    """
+    n = jax.lax.axis_size(axis_name)
+    H, KH = q.shape[2], k.shape[2]
+    if H % n or KH % n:
+        raise ValueError(
+            f"ulysses needs q heads {H} and kv heads {KH} divisible by "
+            f"axis size {n}")
+
+    def seq_to_heads(x):
+        # (B, S/n, h, D) -> (B, S, h/n, D)
+        return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if KH != H:
+        rep = H // KH
+        kg = jnp.repeat(kg, rep, axis=2)
+        vg = jnp.repeat(vg, rep, axis=2)
+    o = blockwise_attention(qg, kg, vg, causal=causal, sm_scale=sm_scale,
+                            block_k=block_k)
+    return heads_to_seq(o)
+
+
+def _sharded_seq_attention(core, q, k, v, mesh, seq_axis, batch_axis):
+    """Shared shard_map wrapper for the sequence-parallel cores: filters
+    ``batch_axis`` names absent from ``mesh`` (plain dp/tp meshes and the
+    4-axis dcn mesh both work), shards the sequence dim over ``seq_axis``."""
     from jax.sharding import PartitionSpec as P
 
     if batch_axis is not None:
@@ -318,13 +360,32 @@ def ring_attention_sharded(q, k, v, mesh, *, seq_axis: str = "tp",
         axes = tuple(a for a in axes if a in mesh.axis_names)
         batch_axis = (axes[0] if len(axes) == 1 else axes) if axes else None
     spec = P(batch_axis, seq_axis, None, None)
-    fn = jax.shard_map(
-        functools.partial(
-            ring_attention, axis_name=seq_axis, causal=causal,
-            sm_scale=sm_scale,
-        ),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-    )
+    fn = jax.shard_map(core, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
     return fn(q, k, v)
+
+
+def ulysses_attention_sharded(q, k, v, mesh, *, seq_axis: str = "tp",
+                              batch_axis=("dcn", "dp"),
+                              causal: bool = True,
+                              sm_scale: Optional[float] = None):
+    """``shard_map`` wrapper: full (B, S, H, D) arrays in, Ulysses
+    all-to-all sequence parallelism over ``seq_axis``. Usable under jit."""
+    return _sharded_seq_attention(
+        functools.partial(ulysses_attention, axis_name=seq_axis,
+                          causal=causal, sm_scale=sm_scale),
+        q, k, v, mesh, seq_axis, batch_axis)
+
+
+def ring_attention_sharded(q, k, v, mesh, *, seq_axis: str = "tp",
+                           batch_axis=("dcn", "dp"), causal: bool = True,
+                           sm_scale: Optional[float] = None):
+    """``shard_map`` wrapper: full (B, S, H, D) arrays in, ring attention on
+    sequence shards over ``seq_axis``. Usable directly under jit.
+
+    ``batch_axis`` may be a name, a tuple of names, or None; names absent
+    from ``mesh`` are dropped (see :func:`_sharded_seq_attention`)."""
+    return _sharded_seq_attention(
+        functools.partial(ring_attention, axis_name=seq_axis,
+                          causal=causal, sm_scale=sm_scale),
+        q, k, v, mesh, seq_axis, batch_axis)
