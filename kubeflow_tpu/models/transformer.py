@@ -148,13 +148,13 @@ class Attention(nn.Module):
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
 
-        if KH != H and c.attention_impl != "ulysses":
+        if c.attention_impl != "ulysses":
             # GQA repeat for the cores that want full heads; ulysses
             # repeats AFTER its KV all_to_alls so the collectives carry
-            # only the distinct KV heads
-            rep = H // KH
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
+            # only the distinct KV heads (kubeflow_tpu/ops/attention.py)
+            from kubeflow_tpu.ops.attention import gqa_repeat
+
+            k, v = gqa_repeat(q, k, v)
 
         out = self._attend(q, k, v)
         out = jnp.einsum("bshk,hkd->bsd", out, wo.astype(c.dtype))
@@ -189,10 +189,7 @@ class Attention(nn.Module):
         # partial-manual shard_map (batch/other axes stay auto)
         mesh = jax.sharding.get_abstract_mesh()
         if mesh.empty or c.seq_axis not in mesh.axis_names:
-            if k.shape[2] != q.shape[2]:  # ulysses defers the GQA repeat
-                rep = q.shape[2] // k.shape[2]
-                k = jnp.repeat(k, rep, axis=2)
-                v = jnp.repeat(v, rep, axis=2)
+            k, v = att.gqa_repeat(q, k, v)  # ulysses deferred the repeat
             return att.blockwise_attention(
                 q, k, v, causal=c.causal, block_k=c.attention_block_k
             )

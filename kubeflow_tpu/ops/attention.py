@@ -38,6 +38,15 @@ def _scale(q, sm_scale: Optional[float]) -> float:
     return sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
 
 
+def gqa_repeat(q, k, v):
+    """Repeat grouped KV heads up to q's head count (no-op when equal)."""
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return k, v
+
+
 def reference_attention(q, k, v, *, causal: bool = True,
                         sm_scale: Optional[float] = None):
     """Plain O(S²)-memory attention; the numerics oracle for the others."""
@@ -339,10 +348,7 @@ def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = True,
                                   concat_axis=2, tiled=True)
 
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    if KH != H:
-        rep = H // KH
-        kg = jnp.repeat(kg, rep, axis=2)
-        vg = jnp.repeat(vg, rep, axis=2)
+    kg, vg = gqa_repeat(qg, kg, vg)
     o = blockwise_attention(qg, kg, vg, causal=causal, sm_scale=sm_scale,
                             block_k=block_k)
     return heads_to_seq(o)
