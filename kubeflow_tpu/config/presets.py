@@ -40,6 +40,7 @@ def _standard(name: str) -> DeploymentConfig:
             ComponentSpec("gateway"),
             ComponentSpec("tuning"),
             ComponentSpec("workflows"),
+            ComponentSpec("dataprep"),
         ],
     )
 

@@ -33,6 +33,12 @@ from kubeflow_tpu.native.build import load_library
 SHARD_SUFFIX = ".f32"
 
 
+def shard_path(root: str, index: int) -> str:
+    """Canonical shard filename — the writer, reader, and the dataprep
+    map/reduce stages must agree on it byte-for-byte."""
+    return os.path.join(root, f"shard-{index:05d}{SHARD_SUFFIX}")
+
+
 def write_shards(path: str, records: np.ndarray, *,
                  shards: int = 1) -> list:
     """Write (N, record_len) float32 ``records`` as raw shard files."""
@@ -43,7 +49,7 @@ def write_shards(path: str, records: np.ndarray, *,
     os.makedirs(path, exist_ok=True)
     out = []
     for i, part in enumerate(np.array_split(records, shards)):
-        fname = os.path.join(path, f"shard-{i:05d}{SHARD_SUFFIX}")
+        fname = shard_path(path, i)
         part.tofile(fname)
         out.append(fname)
     return out

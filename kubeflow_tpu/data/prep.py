@@ -1,0 +1,125 @@
+"""In-container side of DataPrepJob — the executor role.
+
+The reference's spark package runs JVM executors inside pods created by
+the spark-operator (``/root/reference/kubeflow/spark/all.libsonnet``);
+the operator hands each executor its partition assignment. Here the
+:class:`~kubeflow_tpu.operators.dataprep.DataPrepOperator` hands each
+mapper pod a contiguous shard range through the ``KFTPU_PREP_*`` env
+contract, and this module is what runs inside the pod: parse the
+contract, map a record-transform over the assigned shards, and (in the
+reduce pod) concatenate mapper output into final training shards in the
+loader's native format (:mod:`kubeflow_tpu.data.loader`).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from kubeflow_tpu.data.loader import shard_path
+
+
+def shard_range(worker_id: int, num_workers: int,
+                num_shards: int) -> Tuple[int, int]:
+    """[start, stop) shard indices for one mapper.
+
+    Deterministic contiguous partition — the first ``num_shards %
+    num_workers`` mappers take one extra shard. A retried mapper
+    recomputes exactly the same range, so retries are idempotent at the
+    shard level.
+    """
+    if not (0 <= worker_id < num_workers):
+        raise ValueError(f"worker_id {worker_id} not in [0, {num_workers})")
+    if num_workers > num_shards:
+        raise ValueError(f"num_workers {num_workers} > num_shards {num_shards}")
+    base, extra = divmod(num_shards, num_workers)
+    start = worker_id * base + min(worker_id, extra)
+    stop = start + base + (1 if worker_id < extra else 0)
+    return start, stop
+
+
+@dataclass(frozen=True)
+class PrepContext:
+    """The operator's env contract, parsed."""
+
+    worker_id: int
+    num_workers: int
+    num_shards: int
+    input: str
+    output: str
+
+    @classmethod
+    def from_env(cls, env=None) -> "PrepContext":
+        env = os.environ if env is None else env
+        return cls(
+            worker_id=int(env.get("KFTPU_PREP_WORKER_ID", "0")),
+            num_workers=int(env.get("KFTPU_PREP_NUM_WORKERS", "1")),
+            num_shards=int(env.get("KFTPU_PREP_NUM_SHARDS", "1")),
+            input=env.get("KFTPU_PREP_INPUT", ""),
+            output=env.get("KFTPU_PREP_OUTPUT", ""),
+        )
+
+    @property
+    def shards(self) -> range:
+        start, stop = shard_range(self.worker_id, self.num_workers,
+                                  self.num_shards)
+        return range(start, stop)
+
+
+def run_map(ctx: PrepContext,
+            fn: Callable[[np.ndarray], np.ndarray],
+            *, record_len: int) -> List[str]:
+    """Apply ``fn`` to each assigned input shard, write output shards.
+
+    Output is written shard-for-shard under the same index, so the
+    global shard numbering survives the map stage and any subset of
+    mappers can be retried without renumbering.
+    """
+    os.makedirs(ctx.output, exist_ok=True)
+    written = []
+    for i in ctx.shards:
+        raw = np.fromfile(shard_path(ctx.input, i), dtype=np.float32)
+        if raw.size % record_len:
+            raise ValueError(f"shard {i}: {raw.size} floats not divisible "
+                             f"by record_len={record_len}")
+        out = np.ascontiguousarray(fn(raw.reshape(-1, record_len)),
+                                   dtype=np.float32)
+        if out.ndim != 2 or out.shape[1] != record_len:
+            # a width-changing transform would reframe silently at reduce
+            # time (N×4 packs into 8-float rows whenever N is even)
+            raise ValueError(
+                f"map fn returned shape {out.shape}; expected (*, {record_len})")
+        tmp = shard_path(ctx.output, i) + ".tmp"
+        out.tofile(tmp)
+        os.replace(tmp, shard_path(ctx.output, i))  # atomic publish
+        written.append(shard_path(ctx.output, i))
+    return written
+
+
+def run_reduce(ctx: PrepContext,
+               fn: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+               *, record_len: int, out_shards: int = 1) -> List[str]:
+    """Concatenate all mapper output, optionally transform, re-shard.
+
+    The Spark driver's collect/repartition stage: runs once, after every
+    mapper has published its shards.
+    """
+    parts = []
+    for i in range(ctx.num_shards):
+        raw = np.fromfile(shard_path(ctx.output, i), dtype=np.float32)
+        parts.append(raw.reshape(-1, record_len))
+    merged = np.concatenate(parts, axis=0)
+    if fn is not None:
+        merged = np.ascontiguousarray(fn(merged), dtype=np.float32)
+    final_dir = os.path.join(ctx.output, "final")
+    os.makedirs(final_dir, exist_ok=True)
+    out = []
+    for i, part in enumerate(np.array_split(merged, out_shards)):
+        fname = shard_path(final_dir, i)
+        part.tofile(fname + ".tmp")
+        os.replace(fname + ".tmp", fname)  # atomic publish
+        out.append(fname)
+    return out

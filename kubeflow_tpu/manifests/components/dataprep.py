@@ -1,0 +1,51 @@
+"""DataPrep component: DataPrepJob CRD + operator Deployment + RBAC.
+
+Manifest parity with the reference's spark package — operator Deployment,
+CRD, service account and RBAC for pod management
+(``/root/reference/kubeflow/spark/all.libsonnet``) — recast as the
+framework's batch map/reduce operator
+(:mod:`kubeflow_tpu.operators.dataprep`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from kubeflow_tpu.config.deployment import DeploymentConfig
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.manifests.registry import register
+
+DEFAULTS: Dict[str, Any] = {
+    "image": "kubeflow-tpu/platform:v1alpha1",
+    "cluster_scope": True,
+}
+
+
+@register("dataprep", DEFAULTS,
+          "batch data-preparation operator (spark parity)")
+def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
+    from kubeflow_tpu.operators.dataprep import dataprep_crd
+
+    ns = config.namespace
+    name = "dataprep-operator"
+    rules = [
+        {"apiGroups": ["kubeflow-tpu.org"], "resources": ["dataprepjobs",
+         "dataprepjobs/status"], "verbs": ["*"]},
+        {"apiGroups": [""], "resources": ["pods", "events"], "verbs": ["*"]},
+    ]
+    env = {"KFTPU_DATAPREP_NAMESPACE": "" if params["cluster_scope"] else ns}
+    pod = o.pod_spec(
+        [o.container(
+            name, params["image"],
+            command=["python", "-m", "kubeflow_tpu.operators.dataprep"],
+            env=env,
+        )],
+        service_account_name=name,
+    )
+    return [
+        dataprep_crd(),
+        o.service_account(name, ns),
+        o.cluster_role(name, rules),
+        o.cluster_role_binding(name, name, name, ns),
+        o.deployment(name, ns, pod),
+    ]

@@ -1,6 +1,11 @@
 """In-cluster controllers: the TpuJob operator and companions."""
 
 from kubeflow_tpu.operators.controller import Controller, WorkQueue  # noqa: F401
+from kubeflow_tpu.operators.dataprep import (  # noqa: F401
+    DataPrepOperator,
+    DataPrepSpec,
+    dataprep_job,
+)
 from kubeflow_tpu.operators.tpujob import (  # noqa: F401
     TpuJobOperator,
     TpuJobSpec,

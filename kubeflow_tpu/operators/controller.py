@@ -28,6 +28,18 @@ log = logging.getLogger(__name__)
 ReconcileFn = Callable[[str, str], Optional[float]]
 
 
+def make_condition(ctype: str, reason: str, message: str = "") -> dict:
+    """Status condition in the k8s shape every operator here emits."""
+    return {
+        "type": ctype,
+        "status": "True",
+        "reason": reason,
+        "message": message,
+        "lastTransitionTime": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                            time.gmtime()),
+    }
+
+
 @dataclass(order=True)
 class _Item:
     at: float

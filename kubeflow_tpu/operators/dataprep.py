@@ -1,0 +1,411 @@
+"""DataPrepJob operator — distributed batch data preparation.
+
+The reference deploys the spark-operator for this role: a SparkApplication
+CRD whose driver coordinates executor pods over input partitions
+(``/root/reference/kubeflow/spark/all.libsonnet``, operator Deployment +
+CRD + RBAC). A TPU platform has no JVM cluster to host; the shape that
+survives is *partitioned map + single reduce over shard files*:
+
+- a job declares ``numShards`` input partitions and ``workers`` mapper
+  pods; each mapper receives a contiguous shard range through the
+  ``KFTPU_PREP_*`` env contract (:mod:`kubeflow_tpu.data.prep` is the
+  in-container side, the executor role);
+- mappers are independent (no gang): a failed mapper is retried alone up
+  to ``maxRetries`` — unlike :class:`~kubeflow_tpu.operators.tpujob.
+  TpuJobOperator`, whose SPMD semantics force whole-gang restarts;
+- when every mapper succeeds an optional ``reduce`` pod runs once over
+  the combined output (the Spark driver's collect stage);
+- status mirrors SparkApplication ergonomics: phase + per-state worker
+  counts + per-worker retry counts.
+
+Shard files are the framework's native record format
+(:func:`kubeflow_tpu.data.loader.write_shards`), so prepared data feeds
+the training loader with no conversion step.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from kubeflow_tpu.k8s import helpers
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.k8s.client import KubeClient, register_plural
+from kubeflow_tpu.manifests.components.tpujob_operator import GROUP, VERSION
+from kubeflow_tpu.operators.controller import Controller, make_condition
+from kubeflow_tpu.utils import DEFAULT_REGISTRY
+
+log = logging.getLogger(__name__)
+
+API_VERSION = f"{GROUP}/{VERSION}"
+DATAPREP_KIND = "DataPrepJob"
+DATAPREP_PLURAL = "dataprepjobs"
+register_plural(DATAPREP_KIND, DATAPREP_PLURAL)
+
+JOB_LABEL = "kubeflow-tpu.org/dataprep-name"
+ROLE_LABEL = "kubeflow-tpu.org/dataprep-role"
+WORKER_LABEL = "kubeflow-tpu.org/dataprep-worker"
+ATTEMPT_LABEL = "kubeflow-tpu.org/dataprep-attempt"
+# fingerprint of the assignment inputs each pod's shard range and env
+# were computed from (workers × numShards); a live pod whose fingerprint
+# disagrees with the spec marks a mid-run resize — shard coverage is a
+# pure function of (id, workers, shards), so the whole map stage
+# re-fans-out at the new shape (shard-level idempotence makes this safe)
+ASSIGNMENT_LABEL = "kubeflow-tpu.org/dataprep-assignment"
+
+PHASE_PENDING = "Pending"
+PHASE_MAPPING = "Mapping"
+PHASE_REDUCING = "Reducing"
+PHASE_SUCCEEDED = "Succeeded"
+PHASE_FAILED = "Failed"
+
+ENV_WORKER_ID = "KFTPU_PREP_WORKER_ID"
+ENV_NUM_WORKERS = "KFTPU_PREP_NUM_WORKERS"
+ENV_NUM_SHARDS = "KFTPU_PREP_NUM_SHARDS"
+ENV_INPUT = "KFTPU_PREP_INPUT"
+ENV_OUTPUT = "KFTPU_PREP_OUTPUT"
+
+_retries = DEFAULT_REGISTRY.counter(
+    "kftpu_dataprep_worker_retries_total", "dataprep mapper retries")
+
+
+@dataclass
+class DataPrepSpec:
+    """Typed view of a DataPrepJob CR's spec."""
+
+    image: str
+    command: List[str] = field(default_factory=list)
+    args: List[str] = field(default_factory=list)
+    env: Dict[str, str] = field(default_factory=dict)
+    input: str = ""
+    output: str = ""
+    num_shards: int = 1
+    workers: int = 1
+    max_retries: int = 3
+    # optional reduce stage: {"command": [...], "args": [...]}; image
+    # defaults to the mapper image
+    reduce: Optional[Dict[str, Any]] = None
+    volumes: List[Dict[str, Any]] = field(default_factory=list)
+    volume_mounts: List[Dict[str, Any]] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, spec: Dict[str, Any]) -> "DataPrepSpec":
+        out = cls(
+            image=spec.get("image", ""),
+            command=list(spec.get("command", []) or []),
+            args=list(spec.get("args", []) or []),
+            env=dict(spec.get("env", {}) or {}),
+            input=spec.get("input", ""),
+            output=spec.get("output", ""),
+            num_shards=int(spec.get("numShards", 1)),
+            workers=int(spec.get("workers", 1)),
+            max_retries=int(spec.get("maxRetries", 3)),
+            reduce=spec.get("reduce"),
+            volumes=list(spec.get("volumes", []) or []),
+            volume_mounts=list(spec.get("volumeMounts", []) or []),
+        )
+        out.validate()
+        return out
+
+    def validate(self) -> None:
+        if not self.image:
+            raise ValueError("spec.image is required")
+        if self.num_shards < 1:
+            raise ValueError("numShards must be >= 1")
+        if self.workers < 1:
+            raise ValueError("workers must be >= 1")
+        if self.workers > self.num_shards:
+            raise ValueError(
+                f"workers ({self.workers}) > numShards ({self.num_shards}): "
+                "a mapper with zero shards is a wasted pod")
+        if self.reduce is not None and not isinstance(self.reduce, dict):
+            raise ValueError("reduce must be a mapping with command/args")
+
+
+def dataprep_crd() -> o.Obj:
+    return o.crd(
+        DATAPREP_PLURAL, GROUP, DATAPREP_KIND,
+        versions=(VERSION,),
+        short_names=("dpj",),
+        printer_columns=(
+            {"name": "Phase", "type": "string", "jsonPath": ".status.phase"},
+            {"name": "Workers", "type": "string",
+             "jsonPath": ".status.workers.Succeeded"},
+        ),
+    )
+
+
+def dataprep_job(name: str, ns: str, spec: Dict[str, Any]) -> o.Obj:
+    DataPrepSpec.from_dict(spec)  # validate early, at submit time
+    return {
+        "apiVersion": API_VERSION,
+        "kind": DATAPREP_KIND,
+        "metadata": {"name": name, "namespace": ns},
+        "spec": spec,
+    }
+
+
+def _worker_name(job: str, index: int, attempt: int) -> str:
+    return f"{job}-map-{index}-r{attempt}"
+
+
+def _assignment(spec: DataPrepSpec) -> str:
+    return f"{spec.workers}x{spec.num_shards}"
+
+
+_condition = make_condition
+
+
+class DataPrepOperator:
+    """Reconciles DataPrepJob CRs into mapper pods + an optional reduce pod."""
+
+    def __init__(self, client: KubeClient, namespace: Optional[str] = None) -> None:
+        self.client = client
+        self.namespace = namespace
+
+    # -- reconcile ---------------------------------------------------------
+
+    def reconcile(self, ns: str, name: str) -> Optional[float]:
+        job = self.client.get_or_none(API_VERSION, DATAPREP_KIND, ns, name)
+        if job is None:
+            return None
+        phase = job.get("status", {}).get("phase", PHASE_PENDING)
+        if phase in (PHASE_SUCCEEDED, PHASE_FAILED):
+            return None
+
+        pods = [p for p in self.client.list(
+            "v1", "Pod", ns, label_selector={JOB_LABEL: name})
+            if not p.get("metadata", {}).get("deletionTimestamp")]
+        mappers = [p for p in pods
+                   if p["metadata"]["labels"].get(ROLE_LABEL) == "map"]
+        reducers = [p for p in pods
+                    if p["metadata"]["labels"].get(ROLE_LABEL) == "reduce"]
+
+        try:
+            spec = DataPrepSpec.from_dict(job["spec"])
+        except ValueError as e:
+            # a spec edited into invalidity mid-run must also tear down
+            # the live pods — Failed is terminal, nobody reconciles after
+            self._teardown(ns, pods)
+            self._set_status(job, PHASE_FAILED,
+                             conditions=[_condition("Failed", "InvalidSpec", str(e))])
+            return None
+
+        retries: Dict[str, int] = dict(
+            job.get("status", {}).get("workerRetries", {}))
+
+        # mid-run resize: any live mapper built for a different
+        # workers×shards shape has a stale shard assignment — drop the
+        # whole map stage (and any reducer consuming pre-resize output)
+        # and re-fan-out
+        stale = [p for p in mappers
+                 if p["metadata"]["labels"].get(ASSIGNMENT_LABEL)
+                 != _assignment(spec)]
+        if stale:
+            # delete terminal pods too: a Succeeded mapper's stale
+            # COUNT_LABEL would re-trigger this branch forever
+            self._teardown(ns, pods, include_terminal=True)
+            self._set_status(
+                job, PHASE_PENDING, workerRetries={},
+                conditions=[_condition("Resizing", "WorkerCountChanged",
+                                       f"re-map with {spec.workers} workers")])
+            return 1.0
+
+        # index mappers by worker id, newest attempt wins
+        by_worker: Dict[int, o.Obj] = {}
+        for p in mappers:
+            wid = int(p["metadata"]["labels"][WORKER_LABEL])
+            cur = by_worker.get(wid)
+            if cur is None or (int(p["metadata"]["labels"][ATTEMPT_LABEL])
+                               > int(cur["metadata"]["labels"][ATTEMPT_LABEL])):
+                by_worker[wid] = p
+
+        # two passes: decide first, act second — creating retry pods in
+        # the same sweep that discovers an exhausted sibling would orphan
+        # them when the job then goes terminal
+        counts = {"Pending": 0, "Running": 0, "Succeeded": 0, "Failed": 0}
+        to_create: List[int] = []      # worker ids needing a (re)created pod
+        to_replace: List[o.Obj] = []   # failed attempts superseded by retry
+        for wid in range(spec.workers):
+            pod = by_worker.get(wid)
+            if pod is None:
+                to_create.append(wid)
+                counts["Pending"] += 1
+                continue
+            pphase = pod.get("status", {}).get("phase", "Pending")
+            if pphase == "Failed":
+                if retries.get(str(wid), 0) >= spec.max_retries:
+                    counts["Failed"] += 1
+                    continue
+                # retry this mapper alone — shard assignment is a pure
+                # function of (worker id, workers, shards), so the new
+                # attempt reprocesses exactly its own range
+                retries[str(wid)] = retries.get(str(wid), 0) + 1
+                to_replace.append(pod)
+                to_create.append(wid)
+                counts["Pending"] += 1
+                continue
+            counts[pphase] = counts.get(pphase, 0) + 1
+
+        status: Dict[str, Any] = {"workers": counts, "workerRetries": retries}
+
+        if counts["Failed"] > 0:
+            # kill still-running siblings: the job is dead, don't leave
+            # mappers burning cluster resources (the Spark driver likewise
+            # tears down executors on failure)
+            self._teardown(ns, pods)
+            self._set_status(job, PHASE_FAILED, **status, conditions=[
+                _condition("Failed", "MapperRetriesExhausted",
+                           f"{counts['Failed']} mapper(s) exceeded "
+                           f"maxRetries={spec.max_retries}")])
+            return None
+
+        for pod in to_replace:
+            _retries.inc()
+            helpers.delete_ignore_missing(self.client, "v1", "Pod", ns,
+                                          pod["metadata"]["name"])
+        for wid in to_create:
+            self.client.create(self._mapper(job, spec, wid,
+                                            retries.get(str(wid), 0)))
+
+        if counts["Succeeded"] < spec.workers:
+            self._set_status(
+                job, PHASE_MAPPING, **status,
+                conditions=[_condition("Mapping", "MappersRunning")])
+            return 2.0
+
+        # all mappers done
+        if spec.reduce is None:
+            self._set_status(job, PHASE_SUCCEEDED, **status,
+                             conditions=[_condition("Succeeded", "AllMappersDone")])
+            return None
+
+        if not reducers:
+            self.client.create(self._reducer(job, spec))
+            self._set_status(job, PHASE_REDUCING, **status,
+                             conditions=[_condition("Reducing", "ReduceStarted")])
+            return 2.0
+        rphase = reducers[0].get("status", {}).get("phase", "Pending")
+        if rphase == "Succeeded":
+            self._set_status(job, PHASE_SUCCEEDED, **status,
+                             conditions=[_condition("Succeeded", "ReduceDone")])
+            return None
+        if rphase == "Failed":
+            self._set_status(job, PHASE_FAILED, **status,
+                             conditions=[_condition("Failed", "ReduceFailed")])
+            return None
+        self._set_status(job, PHASE_REDUCING, **status)
+        return 2.0
+
+    def _teardown(self, ns: str, pods: List[o.Obj], *,
+                  include_terminal: bool = False) -> None:
+        """Delete this job's pods (non-terminal only, unless asked)."""
+        for p in pods:
+            if (include_terminal
+                    or p.get("status", {}).get("phase") not in ("Succeeded",
+                                                                "Failed")):
+                helpers.delete_ignore_missing(
+                    self.client, "v1", "Pod", ns, p["metadata"]["name"])
+
+    # -- pod builders ------------------------------------------------------
+
+    def _common_env(self, spec: DataPrepSpec) -> Dict[str, str]:
+        env = dict(spec.env)
+        env[ENV_NUM_WORKERS] = str(spec.workers)
+        env[ENV_NUM_SHARDS] = str(spec.num_shards)
+        if spec.input:
+            env[ENV_INPUT] = spec.input
+        if spec.output:
+            env[ENV_OUTPUT] = spec.output
+        return env
+
+    def _mapper(self, job: o.Obj, spec: DataPrepSpec, wid: int,
+                attempt: int) -> o.Obj:
+        name = job["metadata"]["name"]
+        ns = job["metadata"]["namespace"]
+        env = self._common_env(spec)
+        env[ENV_WORKER_ID] = str(wid)
+        ctr = o.container(
+            "mapper", spec.image,
+            command=spec.command or None, args=spec.args or None, env=env,
+            volume_mounts=spec.volume_mounts or None,
+        )
+        pspec = o.pod_spec([ctr], restart_policy="Never",
+                           volumes=spec.volumes or None)
+        pod = o.pod(_worker_name(name, wid, attempt), ns, pspec,
+                    labels={JOB_LABEL: name, ROLE_LABEL: "map",
+                            WORKER_LABEL: str(wid),
+                            ATTEMPT_LABEL: str(attempt),
+                            ASSIGNMENT_LABEL: _assignment(spec)})
+        return o.set_owner(pod, job)
+
+    def _reducer(self, job: o.Obj, spec: DataPrepSpec) -> o.Obj:
+        name = job["metadata"]["name"]
+        ns = job["metadata"]["namespace"]
+        red = spec.reduce or {}
+        ctr = o.container(
+            "reducer", red.get("image", spec.image),
+            command=red.get("command") or None,
+            args=red.get("args") or None,
+            env=self._common_env(spec),
+            volume_mounts=spec.volume_mounts or None,
+        )
+        pspec = o.pod_spec([ctr], restart_policy="Never",
+                           volumes=spec.volumes or None)
+        pod = o.pod(f"{name}-reduce", ns, pspec,
+                    labels={JOB_LABEL: name, ROLE_LABEL: "reduce"})
+        return o.set_owner(pod, job)
+
+    # -- status ------------------------------------------------------------
+
+    def _set_status(self, job: o.Obj, phase: str, *,
+                    conditions: Optional[List[Dict[str, Any]]] = None,
+                    **fields: Any) -> None:
+        status = dict(job.get("status", {}))
+        status["phase"] = phase
+        status.update(fields)
+        if conditions:
+            existing = list(status.get("conditions", []))
+            for cond in conditions:
+                last = existing[-1] if existing else {}
+                # dedup repeats or the list churns (and a status write
+                # fires) on every 2s requeue while mappers run
+                if (last.get("type") == cond["type"]
+                        and last.get("reason") == cond["reason"]):
+                    continue
+                existing.append(cond)
+            status["conditions"] = existing[-10:]
+        if status != job.get("status"):
+            job["status"] = status
+            helpers.update_status_ignore_missing(self.client, job)
+
+    # -- controller wiring -------------------------------------------------
+
+    def controller(self) -> Controller:
+        ctrl = Controller(self.client, API_VERSION, DATAPREP_KIND,
+                          self.reconcile, namespace=self.namespace,
+                          name="dataprep-operator")
+        ctrl.watch_owned("v1", "Pod", _pod_key)
+        return ctrl
+
+
+def _pod_key(pod: o.Obj):
+    name = (pod.get("metadata", {}).get("labels", {}) or {}).get(JOB_LABEL)
+    if not name:
+        return None
+    return (pod["metadata"].get("namespace", ""), name)
+
+
+def main() -> None:  # pragma: no cover - container entrypoint
+    import os
+
+    from kubeflow_tpu.k8s.client import HttpKubeClient
+
+    client = HttpKubeClient.in_cluster()
+    ns = os.environ.get("KFTPU_DATAPREP_NAMESPACE") or None
+    DataPrepOperator(client, namespace=ns).controller().run_forever()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

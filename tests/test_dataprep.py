@@ -1,0 +1,401 @@
+"""DataPrepJob tests: spark-parity batch map/reduce.
+
+Reference role: the spark package's SparkApplication operator
+(``/root/reference/kubeflow/spark/all.libsonnet``) — partitioned
+executors plus a driver collect stage. Covered here: shard-range math,
+operator fan-out/retry/reduce semantics on the fake client, the
+end-to-end map→reduce data path on real files, and the golden manifest.
+"""
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.config.deployment import ComponentSpec, DeploymentConfig
+from kubeflow_tpu.data import prep, read_shards, write_shards
+from kubeflow_tpu.k8s import FakeKubeClient
+from kubeflow_tpu.manifests.registry import render_component
+from kubeflow_tpu.operators.dataprep import (
+    API_VERSION,
+    DATAPREP_KIND,
+    DataPrepOperator,
+    DataPrepSpec,
+    dataprep_job,
+)
+
+
+@pytest.fixture
+def client():
+    return FakeKubeClient()
+
+
+@pytest.fixture
+def op(client):
+    return DataPrepOperator(client)
+
+
+def make_job(client, *, workers=2, num_shards=4, reduce=None, name="prep",
+             max_retries=2):
+    spec = {"image": "img", "command": ["python", "-m", "prep"],
+            "numShards": num_shards, "workers": workers,
+            "maxRetries": max_retries,
+            "input": "/in", "output": "/out"}
+    if reduce is not None:
+        spec["reduce"] = reduce
+    job = dataprep_job(name, "default", spec)
+    client.create(job)
+    return job
+
+
+def pods(client, role=None, ns="default"):
+    out = client.list("v1", "Pod", ns)
+    if role:
+        out = [p for p in out
+               if p["metadata"]["labels"].get(
+                   "kubeflow-tpu.org/dataprep-role") == role]
+    return out
+
+
+def set_phase(client, pod, phase):
+    pod.setdefault("status", {})["phase"] = phase
+    client.update_status(pod)
+
+
+def get_job(client, name="prep"):
+    return client.get(API_VERSION, DATAPREP_KIND, "default", name)
+
+
+# -- shard-range math ------------------------------------------------------
+
+def test_shard_range_partitions_exactly():
+    for workers, shards in [(1, 1), (3, 10), (4, 4), (5, 17), (8, 64)]:
+        covered = []
+        for w in range(workers):
+            start, stop = prep.shard_range(w, workers, shards)
+            covered.extend(range(start, stop))
+        assert covered == list(range(shards))
+
+
+def test_shard_range_balanced():
+    # 10 shards over 3 workers: sizes 4,3,3 — never differ by more than 1
+    sizes = [len(range(*prep.shard_range(w, 3, 10))) for w in range(3)]
+    assert sizes == [4, 3, 3]
+
+
+def test_shard_range_rejects_bad_ids():
+    with pytest.raises(ValueError):
+        prep.shard_range(3, 3, 10)
+    with pytest.raises(ValueError):
+        prep.shard_range(0, 5, 3)
+
+
+def test_context_from_env():
+    ctx = prep.PrepContext.from_env({
+        "KFTPU_PREP_WORKER_ID": "1", "KFTPU_PREP_NUM_WORKERS": "2",
+        "KFTPU_PREP_NUM_SHARDS": "5", "KFTPU_PREP_INPUT": "/in",
+        "KFTPU_PREP_OUTPUT": "/out"})
+    assert list(ctx.shards) == [3, 4]
+    assert ctx.input == "/in"
+
+
+# -- spec validation -------------------------------------------------------
+
+def test_spec_rejects_more_workers_than_shards():
+    with pytest.raises(ValueError, match="workers"):
+        DataPrepSpec.from_dict({"image": "i", "workers": 5, "numShards": 2})
+
+
+def test_spec_requires_image():
+    with pytest.raises(ValueError, match="image"):
+        DataPrepSpec.from_dict({"workers": 1, "numShards": 1})
+
+
+# -- operator --------------------------------------------------------------
+
+def test_map_fanout_and_env_contract(client, op):
+    make_job(client, workers=2, num_shards=4)
+    op.reconcile("default", "prep")
+    mappers = pods(client, "map")
+    assert len(mappers) == 2
+    envs = {c["name"]: c["value"]
+            for p in mappers
+            for c in p["spec"]["containers"][0]["env"]
+            if p["metadata"]["labels"]["kubeflow-tpu.org/dataprep-worker"] == "0"}
+    assert envs["KFTPU_PREP_WORKER_ID"] == "0"
+    assert envs["KFTPU_PREP_NUM_WORKERS"] == "2"
+    assert envs["KFTPU_PREP_NUM_SHARDS"] == "4"
+    assert envs["KFTPU_PREP_INPUT"] == "/in"
+    assert get_job(client)["status"]["phase"] == "Mapping"
+
+
+def test_no_reduce_job_succeeds_when_mappers_done(client, op):
+    make_job(client, workers=2, num_shards=4)
+    op.reconcile("default", "prep")
+    for p in pods(client, "map"):
+        set_phase(client, p, "Succeeded")
+    op.reconcile("default", "prep")
+    status = get_job(client)["status"]
+    assert status["phase"] == "Succeeded"
+    assert status["workers"]["Succeeded"] == 2
+
+
+def test_reduce_runs_after_all_mappers(client, op):
+    make_job(client, workers=2, num_shards=4,
+             reduce={"command": ["python", "-m", "reduce"]})
+    op.reconcile("default", "prep")
+    mappers = pods(client, "map")
+    set_phase(client, mappers[0], "Succeeded")
+    op.reconcile("default", "prep")
+    assert pods(client, "reduce") == []  # one mapper still out
+    set_phase(client, mappers[1], "Succeeded")
+    op.reconcile("default", "prep")
+    red = pods(client, "reduce")
+    assert len(red) == 1
+    assert red[0]["spec"]["containers"][0]["command"] == [
+        "python", "-m", "reduce"]
+    assert get_job(client)["status"]["phase"] == "Reducing"
+    set_phase(client, red[0], "Succeeded")
+    op.reconcile("default", "prep")
+    assert get_job(client)["status"]["phase"] == "Succeeded"
+
+
+def test_failed_mapper_retried_alone(client, op):
+    make_job(client, workers=2, num_shards=4)
+    op.reconcile("default", "prep")
+    m0 = [p for p in pods(client, "map")
+          if p["metadata"]["labels"]["kubeflow-tpu.org/dataprep-worker"] == "0"][0]
+    m1 = [p for p in pods(client, "map")
+          if p["metadata"]["labels"]["kubeflow-tpu.org/dataprep-worker"] == "1"][0]
+    set_phase(client, m0, "Failed")
+    set_phase(client, m1, "Running")
+    op.reconcile("default", "prep")
+    mappers = pods(client, "map")
+    # worker 0 replaced with a new attempt; worker 1 untouched
+    names = sorted(p["metadata"]["name"] for p in mappers)
+    assert names == ["prep-map-0-r1", "prep-map-1-r0"]
+    assert get_job(client)["status"]["workerRetries"] == {"0": 1}
+    assert get_job(client)["status"]["phase"] == "Mapping"
+
+
+def test_mapper_retries_exhausted_fails_job(client, op):
+    make_job(client, workers=1, num_shards=1, max_retries=1)
+    op.reconcile("default", "prep")
+    set_phase(client, pods(client, "map")[0], "Failed")
+    op.reconcile("default", "prep")  # retry 1
+    set_phase(client, pods(client, "map")[0], "Failed")
+    op.reconcile("default", "prep")  # exhausted
+    status = get_job(client)["status"]
+    assert status["phase"] == "Failed"
+    assert status["conditions"][-1]["reason"] == "MapperRetriesExhausted"
+
+
+def test_worker_resize_refans_map_stage(client, op):
+    """spec.workers edited mid-run: shard assignment is baked into every
+    mapper's env, so the map stage re-fans-out at the new count — no
+    mapper may keep a stale range (silent shard loss otherwise)."""
+    make_job(client, workers=2, num_shards=4)
+    op.reconcile("default", "prep")
+    set_phase(client, pods(client, "map")[0], "Succeeded")
+    job = get_job(client)
+    job["spec"]["workers"] = 4
+    client.update(job)
+    op.reconcile("default", "prep")  # detects stale count, deletes gang
+    assert pods(client, "map") == []
+    assert get_job(client)["status"]["workerRetries"] == {}
+    op.reconcile("default", "prep")  # re-fans out at the new count
+    mappers = pods(client, "map")
+    assert len(mappers) == 4
+    assert all(p["metadata"]["labels"]["kubeflow-tpu.org/dataprep-assignment"]
+               == "4x4" for p in mappers)
+
+
+def test_num_shards_resize_refans_map_stage(client, op):
+    """numShards is an assignment input too — editing it mid-run must
+    re-fan-out, not finish with stale shard coverage."""
+    make_job(client, workers=2, num_shards=4)
+    op.reconcile("default", "prep")
+    job = get_job(client)
+    job["spec"]["numShards"] = 8
+    client.update(job)
+    op.reconcile("default", "prep")
+    assert pods(client, "map") == []
+    op.reconcile("default", "prep")
+    envs = {c["name"]: c["value"]
+            for c in pods(client, "map")[0]["spec"]["containers"][0]["env"]}
+    assert envs["KFTPU_PREP_NUM_SHARDS"] == "8"
+
+
+def test_map_fn_must_preserve_record_len(tmp_path):
+    records = np.ones((8, 4), dtype=np.float32)
+    write_shards(str(tmp_path / "in"), records, shards=1)
+    ctx = prep.PrepContext.from_env({
+        "KFTPU_PREP_NUM_SHARDS": "1",
+        "KFTPU_PREP_INPUT": str(tmp_path / "in"),
+        "KFTPU_PREP_OUTPUT": str(tmp_path / "out")})
+    with pytest.raises(ValueError, match="expected"):
+        prep.run_map(ctx, lambda x: x[:, :2], record_len=4)
+
+
+def test_failed_job_tears_down_running_mappers(client, op):
+    """Retry exhaustion must not strand still-running siblings."""
+    make_job(client, workers=2, num_shards=4, max_retries=0)
+    op.reconcile("default", "prep")
+    m = pods(client, "map")
+    set_phase(client, m[0], "Failed")
+    set_phase(client, m[1], "Running")
+    op.reconcile("default", "prep")
+    assert get_job(client)["status"]["phase"] == "Failed"
+    left = [p["metadata"]["name"] for p in pods(client, "map")]
+    assert left == [m[0]["metadata"]["name"]]  # only the terminal pod remains
+
+
+def test_exhausted_worker_does_not_orphan_sibling_retry(client, op):
+    """A retry pod must never be created in the same sweep that discovers
+    an exhausted sibling — the job goes terminal and nothing would ever
+    supervise the orphan."""
+    make_job(client, workers=2, num_shards=4, max_retries=1)
+    op.reconcile("default", "prep")
+    m = pods(client, "map")
+    w0 = [p for p in m if p["metadata"]["labels"][
+        "kubeflow-tpu.org/dataprep-worker"] == "0"][0]
+    w1 = [p for p in m if p["metadata"]["labels"][
+        "kubeflow-tpu.org/dataprep-worker"] == "1"][0]
+    set_phase(client, w1, "Failed")
+    op.reconcile("default", "prep")  # w1 burns its one retry
+    w1b = [p for p in pods(client, "map") if p["metadata"]["labels"][
+        "kubeflow-tpu.org/dataprep-worker"] == "1"][0]
+    set_phase(client, w1b, "Failed")   # w1 exhausted
+    set_phase(client, w0, "Failed")    # w0 fails in the same window
+    op.reconcile("default", "prep")
+    assert get_job(client)["status"]["phase"] == "Failed"
+    # no fresh w0 retry pod may exist — only the two terminal attempts
+    live = [p for p in pods(client, "map")
+            if p.get("status", {}).get("phase") not in ("Succeeded", "Failed")]
+    assert live == []
+
+
+def test_resize_during_reducing_kills_reducer(client, op):
+    """A resize that lands while the reducer runs must kill it: it is
+    consuming pre-resize map output."""
+    make_job(client, workers=2, num_shards=4, reduce={"args": ["r"]})
+    op.reconcile("default", "prep")
+    for p in pods(client, "map"):
+        set_phase(client, p, "Succeeded")
+    op.reconcile("default", "prep")
+    assert len(pods(client, "reduce")) == 1
+    job = get_job(client)
+    job["spec"]["workers"] = 4
+    client.update(job)
+    op.reconcile("default", "prep")
+    assert pods(client, "reduce") == []
+    assert pods(client, "map") == []
+
+
+def test_invalid_spec_edit_mid_run_tears_down_pods(client, op):
+    make_job(client, workers=2, num_shards=4)
+    op.reconcile("default", "prep")
+    job = get_job(client)
+    job["spec"]["workers"] = 99  # > numShards: invalid
+    client.update(job)
+    op.reconcile("default", "prep")
+    assert get_job(client)["status"]["phase"] == "Failed"
+    assert pods(client, "map") == []
+
+
+def test_mapping_conditions_deduped_across_requeues(client, op):
+    """Repeated reconciles while mapping must not churn status writes or
+    fill the conditions ring with identical entries."""
+    make_job(client, workers=1, num_shards=1)
+    for _ in range(5):
+        op.reconcile("default", "prep")
+    conds = get_job(client)["status"]["conditions"]
+    assert [c["reason"] for c in conds].count("MappersRunning") == 1
+
+
+def test_reduce_failure_fails_job(client, op):
+    make_job(client, workers=1, num_shards=1, reduce={"args": ["r"]})
+    op.reconcile("default", "prep")
+    set_phase(client, pods(client, "map")[0], "Succeeded")
+    op.reconcile("default", "prep")
+    set_phase(client, pods(client, "reduce")[0], "Failed")
+    op.reconcile("default", "prep")
+    assert get_job(client)["status"]["phase"] == "Failed"
+
+
+def test_invalid_spec_fails_fast(client, op):
+    client.create({"apiVersion": API_VERSION, "kind": DATAPREP_KIND,
+                   "metadata": {"name": "bad", "namespace": "default"},
+                   "spec": {"workers": 1}})
+    op.reconcile("default", "bad")
+    job = get_job(client, "bad")
+    assert job["status"]["phase"] == "Failed"
+    assert "image" in job["status"]["conditions"][-1]["message"]
+
+
+def test_pods_owned_for_cascade_delete(client, op):
+    make_job(client, workers=1, num_shards=1)
+    op.reconcile("default", "prep")
+    owner = pods(client)[0]["metadata"]["ownerReferences"][0]
+    assert owner["kind"] == DATAPREP_KIND and owner["name"] == "prep"
+
+
+# -- runtime data path -----------------------------------------------------
+
+def test_map_reduce_end_to_end(tmp_path):
+    """Two mappers normalize their shard ranges; reduce merges + re-shards
+    into the loader's final format. What the pods would actually run."""
+    rng = np.random.default_rng(0)
+    records = rng.normal(3.0, 2.0, size=(64, 8)).astype(np.float32)
+    write_shards(str(tmp_path / "in"), records, shards=4)
+
+    env = {"KFTPU_PREP_NUM_WORKERS": "2", "KFTPU_PREP_NUM_SHARDS": "4",
+           "KFTPU_PREP_INPUT": str(tmp_path / "in"),
+           "KFTPU_PREP_OUTPUT": str(tmp_path / "out")}
+    for wid in range(2):
+        ctx = prep.PrepContext.from_env({**env,
+                                         "KFTPU_PREP_WORKER_ID": str(wid)})
+        prep.run_map(ctx, lambda x: x - 3.0, record_len=8)
+
+    ctx = prep.PrepContext.from_env(env)
+    out = prep.run_reduce(ctx, record_len=8, out_shards=2)
+    assert len(out) == 2
+    final = read_shards(str(tmp_path / "out" / "final"), record_len=8)
+    np.testing.assert_allclose(final, records - 3.0, rtol=1e-6)
+
+
+def test_map_is_idempotent_per_shard(tmp_path):
+    """A retried mapper reprocesses exactly its own range — same output."""
+    records = np.arange(32, dtype=np.float32).reshape(8, 4)
+    write_shards(str(tmp_path / "in"), records, shards=4)
+    env = {"KFTPU_PREP_WORKER_ID": "1", "KFTPU_PREP_NUM_WORKERS": "2",
+           "KFTPU_PREP_NUM_SHARDS": "4",
+           "KFTPU_PREP_INPUT": str(tmp_path / "in"),
+           "KFTPU_PREP_OUTPUT": str(tmp_path / "out")}
+    ctx = prep.PrepContext.from_env(env)
+    first = prep.run_map(ctx, lambda x: x * 2, record_len=4)
+    again = prep.run_map(ctx, lambda x: x * 2, record_len=4)
+    assert first == again
+    assert [f.rsplit("/", 1)[1] for f in first] == [
+        "shard-00002.f32", "shard-00003.f32"]
+
+
+# -- manifest --------------------------------------------------------------
+
+def test_dataprep_component_golden():
+    cfg = DeploymentConfig(name="d", platform="local",
+                           components=[ComponentSpec("dataprep")])
+    objs = render_component(cfg, cfg.components[0])
+    kinds = [o["kind"] for o in objs]
+    assert kinds == ["CustomResourceDefinition", "ServiceAccount",
+                     "ClusterRole", "ClusterRoleBinding", "Deployment"]
+    crd = objs[0]
+    assert crd["spec"]["names"]["kind"] == "DataPrepJob"
+    dep = objs[-1]
+    cmd = dep["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert cmd == ["python", "-m", "kubeflow_tpu.operators.dataprep"]
+
+
+def test_standard_preset_includes_dataprep():
+    from kubeflow_tpu.config.presets import preset
+
+    cfg = preset("standard", "demo")
+    assert "dataprep" in [c.name for c in cfg.components]
