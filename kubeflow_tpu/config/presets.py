@@ -41,6 +41,7 @@ def _standard(name: str) -> DeploymentConfig:
             ComponentSpec("tuning"),
             ComponentSpec("workflows"),
             ComponentSpec("dataprep"),
+            ComponentSpec("inference-graph"),
         ],
     )
 

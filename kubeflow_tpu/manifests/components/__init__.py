@@ -5,6 +5,7 @@ from kubeflow_tpu.manifests.components import (  # noqa: F401
     dashboard,
     dataprep,
     gateway,
+    inferencegraph,
     monitoring,
     notebooks,
     serving,

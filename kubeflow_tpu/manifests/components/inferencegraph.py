@@ -1,0 +1,55 @@
+"""InferenceGraph component: CRD + graph controller Deployment + RBAC.
+
+Manifest parity with the reference's seldon package — cluster-manager
+Deployment + SeldonDeployment CRD + RBAC
+(``/root/reference/kubeflow/seldon/core.libsonnet``) — recast onto the
+framework's inference-graph controller
+(:mod:`kubeflow_tpu.serving.graph_controller`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from kubeflow_tpu.config.deployment import DeploymentConfig
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.manifests.registry import register
+
+DEFAULTS: Dict[str, Any] = {
+    "image": "kubeflow-tpu/platform:v1alpha1",
+    "cluster_scope": True,
+}
+
+
+@register("inference-graph", DEFAULTS,
+          "inference graph controller: chains/routers/ensembles (seldon parity)")
+def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
+    from kubeflow_tpu.serving.graph_controller import inference_graph_crd
+
+    ns = config.namespace
+    name = "inferencegraph-controller"
+    rules = [
+        {"apiGroups": ["kubeflow-tpu.org"],
+         "resources": ["inferencegraphs", "inferencegraphs/status"],
+         "verbs": ["*"]},
+        {"apiGroups": ["apps"], "resources": ["deployments"], "verbs": ["*"]},
+        {"apiGroups": [""], "resources": ["services", "events"],
+         "verbs": ["*"]},
+    ]
+    env = {"KFTPU_GRAPH_NAMESPACE": "" if params["cluster_scope"] else ns}
+    pod = o.pod_spec(
+        [o.container(
+            name, params["image"],
+            command=["python", "-m",
+                     "kubeflow_tpu.serving.graph_controller"],
+            env=env,
+        )],
+        service_account_name=name,
+    )
+    return [
+        inference_graph_crd(),
+        o.service_account(name, ns),
+        o.cluster_role(name, rules),
+        o.cluster_role_binding(name, name, name, ns),
+        o.deployment(name, ns, pod),
+    ]

@@ -40,6 +40,40 @@ def make_condition(ctype: str, reason: str, message: str = "") -> dict:
     }
 
 
+def set_phase_status(client: KubeClient, obj: dict, phase: str, *,
+                     conditions: Optional[List[dict]] = None,
+                     max_conditions: int = 10,
+                     **fields) -> None:
+    """Shared status writer: phase + fields + a deduped condition ring.
+
+    Repeat conditions (same type+reason as the last entry) are dropped so
+    a requeue loop neither churns status writes every few seconds nor
+    evicts useful history from the ring. Writes only when something
+    actually changed; a concurrently-deleted object is a no-op.
+    """
+    from kubeflow_tpu.k8s.client import ApiError
+
+    status = dict(obj.get("status", {}))
+    status["phase"] = phase
+    status.update(fields)
+    if conditions:
+        existing = list(status.get("conditions", []))
+        for cond in conditions:
+            last = existing[-1] if existing else {}
+            if (last.get("type") == cond["type"]
+                    and last.get("reason") == cond["reason"]):
+                continue
+            existing.append(cond)
+        status["conditions"] = existing[-max_conditions:]
+    if status != obj.get("status"):
+        obj["status"] = status
+        try:
+            client.update_status(obj)
+        except ApiError as e:
+            if e.code != 404:
+                raise
+
+
 @dataclass(order=True)
 class _Item:
     at: float

@@ -33,7 +33,11 @@ from kubeflow_tpu.k8s import helpers
 from kubeflow_tpu.k8s import objects as o
 from kubeflow_tpu.k8s.client import KubeClient, register_plural
 from kubeflow_tpu.manifests.components.tpujob_operator import GROUP, VERSION
-from kubeflow_tpu.operators.controller import Controller, make_condition
+from kubeflow_tpu.operators.controller import (
+    Controller,
+    make_condition as _condition,
+    set_phase_status,
+)
 from kubeflow_tpu.utils import DEFAULT_REGISTRY
 
 log = logging.getLogger(__name__)
@@ -154,7 +158,6 @@ def _assignment(spec: DataPrepSpec) -> str:
     return f"{spec.workers}x{spec.num_shards}"
 
 
-_condition = make_condition
 
 
 class DataPrepOperator:
@@ -362,23 +365,8 @@ class DataPrepOperator:
     def _set_status(self, job: o.Obj, phase: str, *,
                     conditions: Optional[List[Dict[str, Any]]] = None,
                     **fields: Any) -> None:
-        status = dict(job.get("status", {}))
-        status["phase"] = phase
-        status.update(fields)
-        if conditions:
-            existing = list(status.get("conditions", []))
-            for cond in conditions:
-                last = existing[-1] if existing else {}
-                # dedup repeats or the list churns (and a status write
-                # fires) on every 2s requeue while mappers run
-                if (last.get("type") == cond["type"]
-                        and last.get("reason") == cond["reason"]):
-                    continue
-                existing.append(cond)
-            status["conditions"] = existing[-10:]
-        if status != job.get("status"):
-            job["status"] = status
-            helpers.update_status_ignore_missing(self.client, job)
+        set_phase_status(self.client, job, phase, conditions=conditions,
+                         **fields)
 
     # -- controller wiring -------------------------------------------------
 

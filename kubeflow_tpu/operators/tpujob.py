@@ -23,7 +23,6 @@ from __future__ import annotations
 import logging
 import os
 import threading
-import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -35,7 +34,10 @@ from kubeflow_tpu.manifests.components.tpujob_operator import (
     TPUJOB_KIND,
     TPUJOB_PLURAL,
 )
-from kubeflow_tpu.operators.controller import Controller
+from kubeflow_tpu.operators.controller import (
+    Controller,
+    make_condition as _condition,
+)
 from kubeflow_tpu.parallel import distributed as dist
 from kubeflow_tpu.scheduler.inventory import (
     ASSIGNED_SLICE_LABEL,
@@ -286,14 +288,6 @@ def _pod_phase(pod: o.Obj) -> str:
     return pod.get("status", {}).get("phase", "Pending")
 
 
-def _condition(ctype: str, reason: str, message: str = "") -> Dict[str, Any]:
-    return {
-        "type": ctype,
-        "status": "True",
-        "reason": reason,
-        "message": message,
-        "lastTransitionTime": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-    }
 
 
 class TpuJobOperator:

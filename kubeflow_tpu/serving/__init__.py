@@ -13,3 +13,12 @@ from kubeflow_tpu.serving.batch_predict import (  # noqa: F401
     batch_predict_job,
     run_batch_predict,
 )
+from kubeflow_tpu.serving.graph import (  # noqa: F401
+    GraphExecutor,
+    GraphNode,
+    HttpNodeCaller,
+)
+from kubeflow_tpu.serving.graph_controller import (  # noqa: F401
+    InferenceGraphController,
+    inference_graph,
+)

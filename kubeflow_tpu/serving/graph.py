@@ -1,0 +1,315 @@
+"""Inference graphs — chained, routed, and ensembled model serving.
+
+The reference ships Seldon core for this (``/root/reference/kubeflow/
+seldon/core.libsonnet``: the SeldonDeployment CRD + cluster manager +
+service-orchestrator engine that walks a predictor graph per request).
+This module is the engine role, TPU-framework-native: a typed graph of
+nodes over the framework's own model servers
+(:mod:`kubeflow_tpu.serving.server`), one JSON payload convention
+(``{"instances": ...}`` → ``{"predictions": ...}``) end to end.
+
+Node types (Seldon's vocabulary, same tree semantics):
+
+- ``model`` / ``transformer`` — call the node's backend, then pipe the
+  output through the child chain (a transformer is a model whose output
+  feeds the next stage; the split exists for readability of graphs);
+- ``router`` — pick ONE child per request: static ``weights`` or
+  ``epsilon_greedy`` over recorded reward feedback (Seldon's MAB router);
+- ``combiner`` — fan the input to ALL children and merge their
+  predictions: ``mean`` (ensemble average) or ``vote`` (argmax majority).
+
+The executor is transport-agnostic: a *caller* maps node name → callable
+(HTTP to in-cluster Services in production, in-process functions in
+tests — the same seam :class:`~kubeflow_tpu.k8s.client.KubeClient` gives
+operators).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import re
+import threading
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+NODE_TYPES = ("model", "transformer", "router", "combiner")
+ROUTER_STRATEGIES = ("weights", "epsilon_greedy")
+COMBINERS = ("mean", "vote")
+
+# payload convention shared with the model server
+Payload = Dict[str, Any]
+NodeCaller = Callable[[str, Payload], Payload]
+
+
+class GraphError(Exception):
+    """Invalid graph spec or failed node call."""
+
+
+# node names become k8s object names (controller) and model names (URLs);
+# DNS-1123 keeps both worlds valid
+_NAME_RE = re.compile(r"^[a-z0-9]([-a-z0-9]{0,61}[a-z0-9])?$")
+
+
+@dataclass
+class GraphNode:
+    name: str
+    type: str
+    children: List["GraphNode"] = field(default_factory=list)
+    # router-only
+    strategy: str = "weights"
+    weights: Dict[str, float] = field(default_factory=dict)
+    epsilon: float = 0.1
+    # combiner-only
+    combine: str = "mean"
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any], *, _seen=None) -> "GraphNode":
+        _seen = set() if _seen is None else _seen
+        name = d.get("name", "")
+        if not name:
+            raise GraphError("node missing 'name'")
+        if not _NAME_RE.match(name):
+            raise GraphError(
+                f"node name {name!r} must be a DNS-1123 label "
+                "(lowercase alphanumerics and '-')")
+        if name in _seen:
+            raise GraphError(f"duplicate node name {name!r}")
+        _seen.add(name)
+        ntype = d.get("type", "model")
+        if ntype not in NODE_TYPES:
+            raise GraphError(f"node {name!r}: unknown type {ntype!r}")
+        node = cls(
+            name=name,
+            type=ntype,
+            children=[cls.from_dict(c, _seen=_seen)
+                      for c in d.get("children", []) or []],
+            strategy=d.get("strategy", "weights"),
+            weights=dict(d.get("weights", {}) or {}),
+            epsilon=float(d.get("epsilon", 0.1)),
+            combine=d.get("combine", "mean"),
+        )
+        node.validate()
+        return node
+
+    def validate(self) -> None:
+        if self.type == "router":
+            if len(self.children) < 2:
+                raise GraphError(f"router {self.name!r} needs >=2 children")
+            if self.strategy not in ROUTER_STRATEGIES:
+                raise GraphError(f"router {self.name!r}: unknown strategy "
+                                 f"{self.strategy!r}")
+            if self.strategy == "weights":
+                missing = [c.name for c in self.children
+                           if c.name not in self.weights]
+                if missing:
+                    raise GraphError(
+                        f"router {self.name!r}: no weight for {missing}")
+                if any(w < 0 for w in self.weights.values()):
+                    # random.choices silently misroutes on non-monotonic
+                    # cumulative weights instead of erroring
+                    raise GraphError(
+                        f"router {self.name!r}: weights must be >= 0")
+                if sum(self.weights.values()) <= 0:
+                    raise GraphError(
+                        f"router {self.name!r}: weights must sum > 0")
+        if self.type == "combiner":
+            if len(self.children) < 2:
+                raise GraphError(f"combiner {self.name!r} needs >=2 children")
+            if self.combine not in COMBINERS:
+                raise GraphError(f"combiner {self.name!r}: unknown combine "
+                                 f"{self.combine!r}")
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"name": self.name, "type": self.type}
+        if self.children:
+            d["children"] = [c.to_dict() for c in self.children]
+        if self.type == "router":
+            d["strategy"] = self.strategy
+            if self.weights:
+                d["weights"] = dict(self.weights)
+            d["epsilon"] = self.epsilon
+        if self.type == "combiner":
+            d["combine"] = self.combine
+        return d
+
+    def backend_nodes(self) -> List[str]:
+        """Names of nodes that need a model backend (model/transformer)."""
+        out = [self.name] if self.type in ("model", "transformer") else []
+        for c in self.children:
+            out.extend(c.backend_nodes())
+        return out
+
+
+class RouterState:
+    """Per-router reward statistics for epsilon-greedy routing.
+
+    Seldon's multi-armed-bandit router keeps (pulls, reward) per child
+    and exploits the best arm with probability 1-ε. Feedback arrives via
+    the orchestrator's ``:feedback`` endpoint after the caller scores a
+    prediction.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._pulls: Dict[Tuple[str, str], int] = {}
+        self._reward: Dict[Tuple[str, str], float] = {}
+
+    def record(self, router: str, child: str, reward: float) -> None:
+        key = (router, child)
+        with self._lock:
+            self._pulls[key] = self._pulls.get(key, 0) + 1
+            self._reward[key] = self._reward.get(key, 0.0) + reward
+
+    def mean_reward(self, router: str, child: str) -> float:
+        key = (router, child)
+        with self._lock:
+            n = self._pulls.get(key, 0)
+            return self._reward.get(key, 0.0) / n if n else 0.0
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {
+                f"{r}/{c}": {"pulls": n,
+                             "mean_reward": self._reward.get((r, c), 0.0) / n}
+                for (r, c), n in self._pulls.items() if n
+            }
+
+
+class GraphExecutor:
+    """Walks a graph per request, calling node backends through ``caller``."""
+
+    def __init__(self, root: GraphNode, caller: NodeCaller, *,
+                 seed: Optional[int] = None) -> None:
+        self.root = root
+        self.caller = caller
+        self.routers = RouterState()
+        self._rng = random.Random(seed)
+
+    # -- predict -----------------------------------------------------------
+
+    def predict(self, payload: Payload) -> Payload:
+        """Evaluate the graph; the response carries the route taken."""
+        route: List[str] = []
+        out = self._eval(self.root, payload, route)
+        out["route"] = route
+        return out
+
+    def _eval(self, node: GraphNode, payload: Payload,
+              route: List[str]) -> Payload:
+        if node.type in ("model", "transformer"):
+            route.append(node.name)
+            out = self.caller(node.name, payload)
+            # chain: each child consumes the previous stage's predictions
+            for child in node.children:
+                out = self._eval(child, _as_input(out), route)
+            return out
+        if node.type == "router":
+            child = self._route(node)
+            route.append(f"{node.name}->{child.name}")
+            return self._eval(child, payload, route)
+        # combiner: same input to every child concurrently — ensemble
+        # latency is max(children), not sum (this is the serving hot
+        # path). Each child records into its own sub-route, appended in
+        # child order afterwards, so routes stay deterministic and router
+        # decisions under a combiner still receive feedback credit.
+        route.append(node.name)
+        sub_routes: List[List[str]] = [[] for _ in node.children]
+        with ThreadPoolExecutor(max_workers=len(node.children)) as pool:
+            futs = [pool.submit(self._eval, c, payload, sub_routes[i])
+                    for i, c in enumerate(node.children)]
+            outs = [f.result() for f in futs]
+        for sub in sub_routes:
+            route.extend(sub)
+        return _combine(node.combine, outs)
+
+    def _route(self, node: GraphNode) -> GraphNode:
+        if node.strategy == "weights":
+            names = [c.name for c in node.children]
+            weights = [node.weights[n] for n in names]
+            pick = self._rng.choices(names, weights=weights, k=1)[0]
+        else:  # epsilon_greedy
+            if self._rng.random() < node.epsilon:
+                pick = self._rng.choice([c.name for c in node.children])
+            else:
+                pick = max(node.children,
+                           key=lambda c: self.routers.mean_reward(
+                               node.name, c.name)).name
+        return next(c for c in node.children if c.name == pick)
+
+    # -- feedback ----------------------------------------------------------
+
+    def feedback(self, route: List[str], reward: float) -> int:
+        """Credit a reward to every router decision on a taken route."""
+        n = 0
+        for hop in route:
+            if "->" in hop:
+                router, child = hop.split("->", 1)
+                self.routers.record(router, child, reward)
+                n += 1
+        return n
+
+
+def _as_input(out: Payload) -> Payload:
+    """A stage's predictions become the next stage's instances."""
+    if "predictions" in out:
+        return {"instances": out["predictions"]}
+    return out
+
+
+def _combine(how: str, outs: List[Payload]) -> Payload:
+    preds = [o.get("predictions") for o in outs]
+    if any(p is None for p in preds):
+        raise GraphError("combiner child returned no predictions")
+    if how == "mean":
+        import numpy as np
+
+        arrs = [np.asarray(p, dtype=np.float32) for p in preds]
+        shapes = {a.shape for a in arrs}
+        if len(shapes) != 1:
+            raise GraphError(f"combiner 'mean' shape mismatch: {shapes}")
+        merged = np.mean(arrs, axis=0)
+        return {"predictions": merged.tolist(),
+                "combined_from": len(arrs)}
+    # vote: per-instance argmax majority over children
+    import numpy as np
+
+    arrs = [np.asarray(p) for p in preds]
+    if any(a.ndim != 2 for a in arrs):
+        raise GraphError("combiner 'vote' needs (batch, classes) outputs")
+    votes = np.stack([a.argmax(axis=-1) for a in arrs])  # (children, batch)
+    n_classes = arrs[0].shape[-1]
+    counts = np.apply_along_axis(
+        lambda col: np.bincount(col, minlength=n_classes), 0, votes)
+    return {"predictions": counts.argmax(axis=0).tolist(),
+            "combined_from": len(arrs)}
+
+
+# -- HTTP caller (production transport) ------------------------------------
+
+class HttpNodeCaller:
+    """node name → model-server URL; the in-cluster transport."""
+
+    def __init__(self, backends: Dict[str, str], *,
+                 timeout_s: float = 30.0) -> None:
+        self.backends = {k: v.rstrip("/") for k, v in backends.items()}
+        self.timeout_s = timeout_s
+
+    def __call__(self, node: str, payload: Payload) -> Payload:
+        base = self.backends.get(node)
+        if base is None:
+            raise GraphError(f"no backend configured for node {node!r}")
+        url = f"{base}/v1/models/{node}:predict"
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            raise GraphError(f"node {node!r} returned {e.code}") from e
+        except (urllib.error.URLError, OSError) as e:
+            raise GraphError(f"node {node!r} unreachable: {e}") from e

@@ -1,0 +1,264 @@
+"""InferenceGraph controller — SeldonDeployment's cluster-manager role.
+
+The reference's seldon package deploys a cluster manager that turns a
+SeldonDeployment CR (predictor graph) into per-model Deployments plus an
+injected service-orchestrator engine
+(``/root/reference/kubeflow/seldon/core.libsonnet``, CRD + manager
+Deployment + RBAC). Same shape here: an ``InferenceGraph`` CR declares a
+node tree (:mod:`kubeflow_tpu.serving.graph`) and per-backend model
+configs; the controller materializes
+
+- one model-server Deployment + Service per ``model``/``transformer``
+  node (the framework's own server, pinned to the node's model path);
+- one orchestrator Deployment + Service running
+  :mod:`kubeflow_tpu.serving.graph_server` with the graph and the
+  node→Service URL map in env.
+
+Everything is owner-referenced to the CR for cascade delete.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from kubeflow_tpu.k8s import helpers
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.k8s.client import KubeClient, register_plural
+from kubeflow_tpu.manifests.components.tpujob_operator import GROUP, VERSION
+from kubeflow_tpu.operators.controller import (
+    Controller,
+    make_condition,
+    set_phase_status,
+)
+from kubeflow_tpu.serving.graph import GraphError, GraphNode
+
+log = logging.getLogger(__name__)
+
+API_VERSION = f"{GROUP}/{VERSION}"
+GRAPH_KIND = "InferenceGraph"
+GRAPH_PLURAL = "inferencegraphs"
+register_plural(GRAPH_KIND, GRAPH_PLURAL)
+
+GRAPH_LABEL = "kubeflow-tpu.org/inference-graph"
+
+PHASE_PENDING = "Pending"
+PHASE_READY = "Ready"
+PHASE_FAILED = "Failed"
+
+REST_PORT = 8500
+
+
+@dataclass
+class InferenceGraphSpec:
+    graph: Dict[str, Any]
+    # node name -> {"basePath": str, "tpuChips": int, "replicas": int,
+    #               "maxBatchSize": int}
+    models: Dict[str, Dict[str, Any]]
+    image: str = "kubeflow-tpu/serving:v1alpha1"
+    orchestrator_image: str = "kubeflow-tpu/serving:v1alpha1"
+    port: int = 8600
+
+    root: Optional[GraphNode] = field(init=False, default=None)
+
+    @classmethod
+    def from_dict(cls, spec: Dict[str, Any]) -> "InferenceGraphSpec":
+        out = cls(
+            graph=spec.get("graph") or {},
+            models=dict(spec.get("models", {}) or {}),
+            image=spec.get("image", cls.image),
+            orchestrator_image=spec.get("orchestratorImage",
+                                        cls.orchestrator_image),
+            port=int(spec.get("port", cls.port)),
+        )
+        out.validate()
+        return out
+
+    def validate(self) -> None:
+        if not self.graph:
+            raise ValueError("spec.graph is required")
+        try:
+            self.root = GraphNode.from_dict(self.graph)
+        except GraphError as e:
+            raise ValueError(str(e)) from e
+        missing = [n for n in self.root.backend_nodes()
+                   if n not in self.models
+                   or not self.models[n].get("basePath")]
+        if missing:
+            raise ValueError(
+                f"spec.models missing basePath for backend node(s) {missing}")
+        # the controller names the engine's objects "<graph>-orchestrator";
+        # a backend node by that name would collide and route into itself
+        if "orchestrator" in self.root.backend_nodes():
+            raise ValueError("node name 'orchestrator' is reserved")
+
+
+def inference_graph_crd() -> o.Obj:
+    return o.crd(
+        GRAPH_PLURAL, GROUP, GRAPH_KIND,
+        versions=(VERSION,),
+        short_names=("igraph",),
+        printer_columns=(
+            {"name": "Phase", "type": "string", "jsonPath": ".status.phase"},
+            {"name": "Nodes", "type": "integer",
+             "jsonPath": ".status.backendCount"},
+        ),
+    )
+
+
+def inference_graph(name: str, ns: str, spec: Dict[str, Any]) -> o.Obj:
+    InferenceGraphSpec.from_dict(spec)  # validate at submit time
+    return {
+        "apiVersion": API_VERSION,
+        "kind": GRAPH_KIND,
+        "metadata": {"name": name, "namespace": ns},
+        "spec": spec,
+    }
+
+
+class InferenceGraphController:
+    """Reconciles InferenceGraph CRs into model servers + orchestrator."""
+
+    def __init__(self, client: KubeClient,
+                 namespace: Optional[str] = None) -> None:
+        self.client = client
+        self.namespace = namespace
+
+    def reconcile(self, ns: str, name: str) -> Optional[float]:
+        ig = self.client.get_or_none(API_VERSION, GRAPH_KIND, ns, name)
+        if ig is None:
+            return None
+        try:
+            spec = InferenceGraphSpec.from_dict(ig["spec"])
+        except ValueError as e:
+            self._set_status(ig, PHASE_FAILED, conditions=[
+                make_condition("Failed", "InvalidSpec", str(e))])
+            return None
+
+        backends = spec.root.backend_nodes()
+        for node in backends:
+            self.client.apply(self._model_deploy(ig, spec, node))
+            self.client.apply(self._model_service(ig, node))
+        self.client.apply(self._orchestrator_deploy(ig, spec, backends))
+        self.client.apply(self._orchestrator_service(ig, spec))
+
+        # prune backends dropped by a graph edit — otherwise replaced
+        # nodes keep serving (and burning chips) forever
+        keep = ({f"{name}-{n}" for n in backends}
+                | {f"{name}-orchestrator", name})
+        sel = {GRAPH_LABEL: name}
+        for kind in ("Deployment", "Service"):
+            api = "apps/v1" if kind == "Deployment" else "v1"
+            for obj in self.client.list(api, kind, ns, label_selector=sel):
+                oname = obj["metadata"]["name"]
+                if oname not in keep:
+                    helpers.delete_ignore_missing(self.client, api, kind, ns,
+                                                  oname)
+
+        self._set_status(
+            ig, PHASE_READY, backendCount=len(backends),
+            backends=sorted(backends),
+            conditions=[make_condition("Ready", "GraphMaterialized",
+                                       f"{len(backends)} backend(s)")])
+        return 30.0
+
+    # -- object builders ---------------------------------------------------
+
+    def _labels(self, ig: o.Obj) -> Dict[str, str]:
+        return {GRAPH_LABEL: ig["metadata"]["name"]}
+
+    def _model_deploy(self, ig: o.Obj, spec: InferenceGraphSpec,
+                      node: str) -> o.Obj:
+        name = ig["metadata"]["name"]
+        ns = ig["metadata"]["namespace"]
+        cfg = spec.models[node]
+        resources: Dict[str, Any] = {}
+        if int(cfg.get("tpuChips", 0)):
+            resources = {"limits": {"google.com/tpu": int(cfg["tpuChips"])}}
+        env = {
+            "KFTPU_MODEL_BASE_PATH": cfg["basePath"],
+            "KFTPU_REST_PORT": str(REST_PORT),
+            "KFTPU_MAX_BATCH_SIZE": str(cfg.get("maxBatchSize", 8)),
+        }
+        pod = o.pod_spec([o.container(
+            "server", spec.image,
+            command=["python", "-m", "kubeflow_tpu.serving.server"],
+            env=env, ports=[REST_PORT], resources=resources or None,
+        )])
+        dep = o.deployment(
+            f"{name}-{node}", ns, pod,
+            replicas=int(cfg.get("replicas", 1)),
+            labels={**self._labels(ig), "app": f"{name}-{node}"})
+        return o.set_owner(dep, ig)
+
+    def _model_service(self, ig: o.Obj, node: str) -> o.Obj:
+        name = ig["metadata"]["name"]
+        ns = ig["metadata"]["namespace"]
+        svc = o.service(
+            f"{name}-{node}", ns, {"app": f"{name}-{node}"},
+            [{"name": "rest", "port": REST_PORT, "targetPort": REST_PORT}],
+            labels=self._labels(ig))
+        return o.set_owner(svc, ig)
+
+    def _orchestrator_deploy(self, ig: o.Obj, spec: InferenceGraphSpec,
+                             backends: List[str]) -> o.Obj:
+        name = ig["metadata"]["name"]
+        ns = ig["metadata"]["namespace"]
+        urls = {n: f"http://{name}-{n}.{ns}.svc:{REST_PORT}"
+                for n in backends}
+        # model-server paths are /v1/models/<name>:predict; the node name
+        # inside the pod is the model name, so point each node's URL at a
+        # server whose repository holds that node's model under basePath
+        env = {
+            "KFTPU_GRAPH": json.dumps(spec.root.to_dict(), sort_keys=True),
+            "KFTPU_GRAPH_BACKENDS": json.dumps(urls, sort_keys=True),
+            "KFTPU_GRAPH_PORT": str(spec.port),
+        }
+        pod = o.pod_spec([o.container(
+            "orchestrator", spec.orchestrator_image,
+            command=["python", "-m", "kubeflow_tpu.serving.graph_server"],
+            env=env, ports=[spec.port],
+        )])
+        dep = o.deployment(
+            f"{name}-orchestrator", ns, pod,
+            labels={**self._labels(ig), "app": f"{name}-orchestrator"})
+        return o.set_owner(dep, ig)
+
+    def _orchestrator_service(self, ig: o.Obj,
+                              spec: InferenceGraphSpec) -> o.Obj:
+        name = ig["metadata"]["name"]
+        ns = ig["metadata"]["namespace"]
+        svc = o.service(
+            name, ns, {"app": f"{name}-orchestrator"},
+            [{"name": "http", "port": spec.port, "targetPort": spec.port}],
+            labels=self._labels(ig))
+        return o.set_owner(svc, ig)
+
+    # -- status ------------------------------------------------------------
+
+    def _set_status(self, ig: o.Obj, phase: str, *,
+                    conditions: Optional[List[Dict[str, Any]]] = None,
+                    **fields: Any) -> None:
+        set_phase_status(self.client, ig, phase, conditions=conditions,
+                         **fields)
+
+    def controller(self) -> Controller:
+        return Controller(self.client, API_VERSION, GRAPH_KIND,
+                          self.reconcile, namespace=self.namespace,
+                          name="inferencegraph-controller")
+
+
+def main() -> None:  # pragma: no cover - container entrypoint
+    import os
+
+    from kubeflow_tpu.k8s.client import HttpKubeClient
+
+    client = HttpKubeClient.in_cluster()
+    ns = os.environ.get("KFTPU_GRAPH_NAMESPACE") or None
+    InferenceGraphController(client, namespace=ns).controller().run_forever()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

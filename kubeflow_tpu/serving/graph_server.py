@@ -1,0 +1,77 @@
+"""Inference-graph orchestrator service — Seldon's engine, as a server.
+
+Runs in the orchestrator pod the
+:class:`~kubeflow_tpu.serving.graph_controller.InferenceGraphController`
+deploys (Seldon equivalent: the service-orchestrator container injected
+into every SeldonDeployment predictor pod,
+``/root/reference/kubeflow/seldon/core.libsonnet``). Reads the graph and
+the node→Service URL map from env, then serves:
+
+- ``POST /v1/graph:predict`` — walk the graph, return predictions + the
+  route taken;
+- ``POST /v1/graph:feedback`` — ``{"route": [...], "reward": r}`` credits
+  router decisions (the MAB reward channel);
+- ``GET /v1/graph`` — the graph spec + live router statistics;
+- ``GET /healthz``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+from kubeflow_tpu.serving.graph import (
+    GraphError,
+    GraphExecutor,
+    GraphNode,
+    HttpNodeCaller,
+)
+from kubeflow_tpu.utils import DEFAULT_REGISTRY
+from kubeflow_tpu.utils.jsonhttp import serve_json
+
+_requests = DEFAULT_REGISTRY.counter(
+    "kftpu_graph_requests_total", "inference-graph predict requests")
+
+
+class GraphService:
+    def __init__(self, executor: GraphExecutor) -> None:
+        self.executor = executor
+
+    def handle(self, method: str, path: str, body: Optional[Dict[str, Any]],
+               user: str = "") -> Tuple[int, Any]:
+        if method == "GET" and path == "/healthz":
+            return 200, {"ok": True}
+        if method == "GET" and path == "/v1/graph":
+            return 200, {"graph": self.executor.root.to_dict(),
+                         "routers": self.executor.routers.snapshot()}
+        if method == "POST" and path == "/v1/graph:predict":
+            if not body or "instances" not in body:
+                return 400, {"error": "body must contain 'instances'"}
+            try:
+                out = self.executor.predict({"instances": body["instances"]})
+            except GraphError as e:
+                return 502, {"error": str(e)}
+            _requests.inc()
+            return 200, out
+        if method == "POST" and path == "/v1/graph:feedback":
+            route = (body or {}).get("route")
+            reward = (body or {}).get("reward")
+            if not isinstance(route, list) or not isinstance(reward,
+                                                             (int, float)):
+                return 400, {"error": "body must contain 'route' (list) and "
+                                      "'reward' (number)"}
+            n = self.executor.feedback(route, float(reward))
+            return 200, {"credited": n}
+        return 404, {"error": "unknown endpoint"}
+
+
+def main() -> None:  # pragma: no cover - container entrypoint
+    root = GraphNode.from_dict(json.loads(os.environ["KFTPU_GRAPH"]))
+    backends = json.loads(os.environ.get("KFTPU_GRAPH_BACKENDS", "{}"))
+    service = GraphService(GraphExecutor(root, HttpNodeCaller(backends)))
+    serve_json(service.handle, int(os.environ.get("KFTPU_GRAPH_PORT", "8600")))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,351 @@
+"""Inference-graph tests (seldon parity): graph spec validation, executor
+semantics (chain, router, combiner, feedback), the orchestrator HTTP
+service, the controller materializing model servers + orchestrator, and
+an end-to-end graph over live model servers.
+
+Reference role: SeldonDeployment predictor graphs + service orchestrator
+(``/root/reference/kubeflow/seldon/core.libsonnet``).
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.config.deployment import ComponentSpec, DeploymentConfig
+from kubeflow_tpu.k8s import FakeKubeClient
+from kubeflow_tpu.manifests.registry import render_component
+from kubeflow_tpu.serving.graph import (
+    GraphError,
+    GraphExecutor,
+    GraphNode,
+)
+from kubeflow_tpu.serving.graph_controller import (
+    API_VERSION,
+    GRAPH_KIND,
+    InferenceGraphController,
+    inference_graph,
+)
+from kubeflow_tpu.serving.graph_server import GraphService
+
+
+def node(name, type="model", **kw):
+    return {"name": name, "type": type, **kw}
+
+
+# -- spec ------------------------------------------------------------------
+
+def test_router_requires_weights_for_children():
+    with pytest.raises(GraphError, match="no weight"):
+        GraphNode.from_dict(node("r", "router", children=[
+            node("a"), node("b")], weights={"a": 50}))
+
+
+def test_router_needs_two_children():
+    with pytest.raises(GraphError, match=">=2"):
+        GraphNode.from_dict(node("r", "router", children=[node("a")],
+                                 weights={"a": 100}))
+
+
+def test_duplicate_node_names_rejected():
+    with pytest.raises(GraphError, match="duplicate"):
+        GraphNode.from_dict(node("m", children=[node("m")]))
+
+
+def test_node_names_must_be_dns_labels():
+    with pytest.raises(GraphError, match="DNS-1123"):
+        GraphNode.from_dict(node("My_Model"))
+
+
+def test_negative_router_weight_rejected():
+    # random.choices silently misroutes on negative weights — must be
+    # caught at validation, not at request time
+    with pytest.raises(GraphError, match=">= 0"):
+        GraphNode.from_dict(node("r", "router",
+                                 weights={"a": 2, "b": -1},
+                                 children=[node("a"), node("b")]))
+
+
+def test_orchestrator_node_name_reserved():
+    from kubeflow_tpu.serving.graph_controller import InferenceGraphSpec
+
+    with pytest.raises(ValueError, match="reserved"):
+        InferenceGraphSpec.from_dict({
+            "graph": node("orchestrator"),
+            "models": {"orchestrator": {"basePath": "/m"}}})
+
+
+def test_backend_nodes_excludes_routers_and_combiners():
+    root = GraphNode.from_dict(node("c", "combiner", children=[
+        node("a"), node("b")]))
+    assert root.backend_nodes() == ["a", "b"]
+
+
+def test_round_trip_to_dict():
+    d = node("r", "router", strategy="weights",
+             weights={"a": 90.0, "b": 10.0},
+             children=[node("a"), node("b")])
+    root = GraphNode.from_dict(d)
+    assert GraphNode.from_dict(root.to_dict()).to_dict() == root.to_dict()
+
+
+# -- executor --------------------------------------------------------------
+
+def calls_to(fn_map):
+    calls = []
+
+    def caller(name, payload):
+        calls.append((name, payload))
+        return fn_map[name](payload)
+
+    return caller, calls
+
+
+def test_chain_pipes_predictions_to_next_stage():
+    caller, calls = calls_to({
+        "pre": lambda p: {"predictions": [[x * 2 for x in row]
+                                          for row in p["instances"]]},
+        "clf": lambda p: {"predictions": [[sum(row)] for row in p["instances"]]},
+    })
+    root = GraphNode.from_dict(node("pre", "transformer",
+                                    children=[node("clf")]))
+    out = GraphExecutor(root, caller).predict({"instances": [[1, 2]]})
+    assert out["predictions"] == [[6]]          # (1*2 + 2*2)
+    assert calls[1][1] == {"instances": [[2, 4]]}
+    assert out["route"] == ["pre", "clf"]
+
+
+def test_weighted_router_distributes_by_weight():
+    caller, _ = calls_to({"a": lambda p: {"predictions": [0]},
+                          "b": lambda p: {"predictions": [1]}})
+    root = GraphNode.from_dict(node("r", "router",
+                                    weights={"a": 80, "b": 20},
+                                    children=[node("a"), node("b")]))
+    ex = GraphExecutor(root, caller, seed=0)
+    picks = [ex.predict({"instances": [1]})["route"][0] for _ in range(400)]
+    frac_a = sum(1 for p in picks if p == "r->a") / len(picks)
+    assert 0.7 < frac_a < 0.9
+
+
+def test_epsilon_greedy_learns_from_feedback():
+    caller, _ = calls_to({"a": lambda p: {"predictions": [0]},
+                          "b": lambda p: {"predictions": [1]}})
+    root = GraphNode.from_dict(node("r", "router", strategy="epsilon_greedy",
+                                    epsilon=0.1,
+                                    children=[node("a"), node("b")]))
+    ex = GraphExecutor(root, caller, seed=1)
+    # teach it that b pays: exploit phase must prefer b afterwards
+    ex.feedback(["r->a"], 0.0)
+    ex.feedback(["r->b"], 1.0)
+    picks = [ex.predict({"instances": [1]})["route"][0] for _ in range(300)]
+    frac_b = sum(1 for p in picks if p == "r->b") / len(picks)
+    assert frac_b > 0.8  # 1-ε exploitation + ε/2 exploration
+    assert ex.routers.snapshot()["r/b"]["mean_reward"] == 1.0
+
+
+def test_combiner_mean_averages_children():
+    caller, _ = calls_to({
+        "a": lambda p: {"predictions": [[0.0, 1.0]]},
+        "b": lambda p: {"predictions": [[1.0, 0.0]]},
+    })
+    root = GraphNode.from_dict(node("c", "combiner", combine="mean",
+                                    children=[node("a"), node("b")]))
+    out = GraphExecutor(root, caller).predict({"instances": [[1]]})
+    assert out["predictions"] == [[0.5, 0.5]]
+    assert out["combined_from"] == 2
+
+
+def test_combiner_vote_majority():
+    caller, _ = calls_to({
+        "a": lambda p: {"predictions": [[0.9, 0.1], [0.1, 0.9]]},
+        "b": lambda p: {"predictions": [[0.8, 0.2], [0.2, 0.8]]},
+        "c": lambda p: {"predictions": [[0.2, 0.8], [0.3, 0.7]]},
+    })
+    root = GraphNode.from_dict(node("v", "combiner", combine="vote",
+                                    children=[node("a"), node("b"),
+                                              node("c")]))
+    out = GraphExecutor(root, caller).predict({"instances": [[1], [2]]})
+    assert out["predictions"] == [0, 1]  # 2/3 vote class 0, then class 1
+
+
+def test_combiner_mean_shape_mismatch_raises():
+    caller, _ = calls_to({
+        "a": lambda p: {"predictions": [[0.0, 1.0]]},
+        "b": lambda p: {"predictions": [[1.0]]},
+    })
+    root = GraphNode.from_dict(node("c", "combiner",
+                                    children=[node("a"), node("b")]))
+    with pytest.raises(GraphError, match="shape mismatch"):
+        GraphExecutor(root, caller).predict({"instances": [[1]]})
+
+
+# -- orchestrator service --------------------------------------------------
+
+@pytest.fixture
+def service():
+    caller, _ = calls_to({"m": lambda p: {"predictions": [[1.0]]}})
+    root = GraphNode.from_dict(node("m"))
+    return GraphService(GraphExecutor(root, caller))
+
+
+def test_service_predict_and_introspection(service):
+    code, out = service.handle("POST", "/v1/graph:predict",
+                               {"instances": [[1]]})
+    assert code == 200 and out["predictions"] == [[1.0]]
+    code, out = service.handle("GET", "/v1/graph", None)
+    assert code == 200 and out["graph"]["name"] == "m"
+
+
+def test_service_feedback_roundtrip():
+    caller, _ = calls_to({"a": lambda p: {"predictions": [0]},
+                          "b": lambda p: {"predictions": [1]}})
+    root = GraphNode.from_dict(node("r", "router", strategy="epsilon_greedy",
+                                    children=[node("a"), node("b")]))
+    svc = GraphService(GraphExecutor(root, caller, seed=0))
+    code, out = svc.handle("POST", "/v1/graph:predict", {"instances": [1]})
+    code, credit = svc.handle("POST", "/v1/graph:feedback",
+                              {"route": out["route"], "reward": 1.0})
+    assert code == 200 and credit["credited"] == 1
+
+
+def test_service_rejects_bad_payloads(service):
+    assert service.handle("POST", "/v1/graph:predict", {})[0] == 400
+    assert service.handle("POST", "/v1/graph:feedback",
+                          {"route": "x", "reward": 1})[0] == 400
+
+
+# -- controller ------------------------------------------------------------
+
+GRAPH_SPEC = {
+    "graph": node("r", "router", weights={"v1": 90, "v2": 10}, children=[
+        node("v1"), node("v2")]),
+    "models": {"v1": {"basePath": "/models/v1"},
+               "v2": {"basePath": "/models/v2", "tpuChips": 1}},
+}
+
+
+def test_controller_materializes_graph():
+    client = FakeKubeClient()
+    ctrl = InferenceGraphController(client)
+    client.create(inference_graph("ab", "default", GRAPH_SPEC))
+    ctrl.reconcile("default", "ab")
+    deps = {d["metadata"]["name"]
+            for d in client.list("apps/v1", "Deployment", "default")}
+    assert deps == {"ab-v1", "ab-v2", "ab-orchestrator"}
+    svcs = {s["metadata"]["name"]
+            for s in client.list("v1", "Service", "default")}
+    assert svcs == {"ab-v1", "ab-v2", "ab"}
+    orch = client.get("apps/v1", "Deployment", "default", "ab-orchestrator")
+    env = {e["name"]: e["value"] for e in
+           orch["spec"]["template"]["spec"]["containers"][0]["env"]}
+    backends = json.loads(env["KFTPU_GRAPH_BACKENDS"])
+    assert backends["v1"] == "http://ab-v1.default.svc:8500"
+    ig = client.get(API_VERSION, GRAPH_KIND, "default", "ab")
+    assert ig["status"]["phase"] == "Ready"
+    assert ig["status"]["backendCount"] == 2
+    # tpuChips flows through to the node deployment
+    v2 = client.get("apps/v1", "Deployment", "default", "ab-v2")
+    lim = v2["spec"]["template"]["spec"]["containers"][0]["resources"]["limits"]
+    assert lim == {"google.com/tpu": 1}
+
+
+def test_controller_prunes_dropped_backends():
+    client = FakeKubeClient()
+    ctrl = InferenceGraphController(client)
+    client.create(inference_graph("ab", "default", GRAPH_SPEC))
+    ctrl.reconcile("default", "ab")
+    ig = client.get(API_VERSION, GRAPH_KIND, "default", "ab")
+    ig["spec"] = {"graph": node("v1"),
+                  "models": {"v1": {"basePath": "/models/v1"}}}
+    client.update(ig)
+    ctrl.reconcile("default", "ab")
+    deps = {d["metadata"]["name"]
+            for d in client.list("apps/v1", "Deployment", "default")}
+    assert deps == {"ab-v1", "ab-orchestrator"}
+
+
+def test_controller_invalid_spec_fails():
+    client = FakeKubeClient()
+    ctrl = InferenceGraphController(client)
+    client.create({"apiVersion": API_VERSION, "kind": GRAPH_KIND,
+                   "metadata": {"name": "bad", "namespace": "default"},
+                   "spec": {"graph": node("m"), "models": {}}})
+    ctrl.reconcile("default", "bad")
+    ig = client.get(API_VERSION, GRAPH_KIND, "default", "bad")
+    assert ig["status"]["phase"] == "Failed"
+    assert "basePath" in ig["status"]["conditions"][-1]["message"]
+
+
+def test_objects_owned_for_cascade_delete():
+    client = FakeKubeClient()
+    InferenceGraphController(client).reconcile  # construct only
+    client.create(inference_graph("ab", "default", GRAPH_SPEC))
+    InferenceGraphController(client).reconcile("default", "ab")
+    client.delete(API_VERSION, GRAPH_KIND, "default", "ab")
+    assert client.list("apps/v1", "Deployment", "default") == []
+    assert client.list("v1", "Service", "default") == []
+
+
+# -- end to end over live model servers ------------------------------------
+
+def test_graph_end_to_end_over_live_server(tmp_path):
+    """Two exported models behind a real ModelServer, ensembled by the
+    executor over HTTP — request in, averaged predictions out."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import MnistCnn
+    from kubeflow_tpu.serving.graph import HttpNodeCaller
+    from kubeflow_tpu.serving.model_store import export_model
+    from kubeflow_tpu.serving.server import ModelServer
+
+    model = MnistCnn()
+    for name, seed in (("m1", 0), ("m2", 1)):
+        params = model.init(jax.random.key(seed),
+                            jnp.zeros((1, 28, 28, 1)))["params"]
+        export_model(str(tmp_path / name), "mnist", params, version=1)
+
+    srv = ModelServer(str(tmp_path), port=0)
+    port = srv.start()
+    url = f"http://127.0.0.1:{port}"
+    try:
+        root = GraphNode.from_dict(node("c", "combiner", combine="mean",
+                                        children=[node("m1"), node("m2")]))
+        ex = GraphExecutor(root, HttpNodeCaller({"m1": url, "m2": url}))
+        x = np.random.default_rng(0).normal(
+            size=(2, 28, 28, 1)).astype(np.float32)
+        out = ex.predict({"instances": x.tolist()})
+        singles = []
+        for name in ("m1", "m2"):
+            req = urllib.request.Request(
+                f"{url}/v1/models/{name}:predict",
+                data=json.dumps({"instances": x.tolist()}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                singles.append(json.load(resp)["predictions"])
+        want = np.mean([np.asarray(s) for s in singles], axis=0)
+        np.testing.assert_allclose(np.asarray(out["predictions"]), want,
+                                   rtol=1e-5)
+        assert out["route"] == ["c", "m1", "m2"]
+    finally:
+        srv.stop()
+
+
+# -- manifest --------------------------------------------------------------
+
+def test_inference_graph_component_golden():
+    cfg = DeploymentConfig(name="d", platform="local",
+                           components=[ComponentSpec("inference-graph")])
+    objs = render_component(cfg, cfg.components[0])
+    kinds = [obj["kind"] for obj in objs]
+    assert kinds == ["CustomResourceDefinition", "ServiceAccount",
+                     "ClusterRole", "ClusterRoleBinding", "Deployment"]
+    assert objs[0]["spec"]["names"]["kind"] == "InferenceGraph"
+
+
+def test_standard_preset_includes_inference_graph():
+    from kubeflow_tpu.config.presets import preset
+
+    cfg = preset("standard", "demo")
+    assert "inference-graph" in [c.name for c in cfg.components]
