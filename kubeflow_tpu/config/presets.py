@@ -42,6 +42,7 @@ def _standard(name: str) -> DeploymentConfig:
             ComponentSpec("workflows"),
             ComponentSpec("dataprep"),
             ComponentSpec("inference-graph"),
+            ComponentSpec("model-registry"),
         ],
     )
 

@@ -450,6 +450,8 @@ class DashboardApi:
              "icon": "device-hub"},
             {"text": "Model Serving", "link": "/serving/",
              "icon": "cloud-upload"},
+            {"text": "Model Registry", "link": "/models.html",
+             "icon": "collections-bookmark"},
             {"text": "TensorBoard", "link": "/tensorboard/",
              "icon": "timeline"},
             {"text": "Manage Contributors", "link": "/workgroup/",

@@ -29,3 +29,10 @@ function esc(s) {
   d.textContent = String(s == null ? "" : s);
   return d.innerHTML;
 }
+
+// esc() covers text nodes only (innerHTML leaves quotes alone); anything
+// interpolated into an HTML *attribute* value must go through this or a
+// quoted name like x" onmouseover="... becomes a live handler
+function escAttr(s) {
+  return esc(s).replace(/"/g, "&quot;").replace(/'/g, "&#39;");
+}

@@ -1,0 +1,105 @@
+// Models page: list registered models, expand into versions with stage
+// promotion. Data: the model-registry service behind the edge route
+// /registry/ (kubeflow_tpu/serving/registry.py).
+
+"use strict";
+// helpers ($, showError, api, esc) come from common.js
+
+async function apiPost(path, body) {
+  const resp = await fetch(path, {
+    method: "POST",
+    credentials: "same-origin",
+    headers: { "Content-Type": "application/json" },
+    body: JSON.stringify(body),
+  });
+  if (resp.status === 401) {
+    window.location.href = "/login.html?next=" +
+      encodeURIComponent(window.location.pathname);
+    throw new Error("unauthenticated");
+  }
+  if (!resp.ok) throw new Error(path + " → HTTP " + resp.status);
+  return resp.json();
+}
+
+function stageChip(stage) {
+  const s = esc(stage || "none");
+  return '<span class="stage stage-' + s + '">' + s + "</span>";
+}
+
+function fmtMetrics(metrics) {
+  const keys = Object.keys(metrics || {});
+  if (!keys.length) return "—";
+  return keys.sort().map((k) =>
+    esc(k) + "=" + esc(Number(metrics[k]).toPrecision(4))).join(", ");
+}
+
+function fmtLineage(lineage) {
+  const keys = Object.keys(lineage || {});
+  if (!keys.length) return "—";
+  return keys.sort().map((k) => esc(k) + ": " + esc(lineage[k])).join("; ");
+}
+
+async function showModel(name) {
+  const data = await api("/registry/api/registry/models/" +
+                         encodeURIComponent(name) + "/versions");
+  $("detail-panel").style.display = "";
+  $("detail-title").textContent = name;
+  const rows = data.versions.map((v) => {
+    const canPromote = v.stage !== "production";
+    return "<tr><td>" + esc(v.version) + "</td>" +
+      "<td>" + esc(v.kind || "—") + "</td>" +
+      "<td>" + stageChip(v.stage) + "</td>" +
+      "<td>" + fmtMetrics(v.metrics) + "</td>" +
+      "<td>" + fmtLineage(v.lineage) + "</td>" +
+      "<td>" + esc(v.registered_at || "") + "</td>" +
+      "<td>" + (canPromote
+        ? '<button class="promote" data-model="' + escAttr(name) +
+          '" data-version="' + escAttr(v.version) + '">promote</button>'
+        : "") + "</td></tr>";
+  });
+  $("versions").innerHTML = rows.join("") ||
+    '<tr><td colspan="7">no versions</td></tr>';
+  for (const btn of document.querySelectorAll("button.promote")) {
+    btn.onclick = async () => {
+      try {
+        await apiPost("/registry/api/registry/models/" +
+          encodeURIComponent(btn.dataset.model) + "/versions/" +
+          encodeURIComponent(btn.dataset.version) + ":transition",
+          { stage: "production" });
+        await refresh();
+        await showModel(btn.dataset.model);
+      } catch (e) {
+        showError("promote failed: " + e.message);
+      }
+    };
+  }
+}
+
+async function refresh() {
+  const data = await api("/registry/api/registry/models");
+  const rows = data.models.map((m) =>
+    '<tr><td><a href="#" class="model-link" data-name="' + escAttr(m.name) +
+    '">' + esc(m.name) + "</a></td>" +
+    "<td>" + esc(m.versions) + "</td>" +
+    "<td>" + esc(m.latest == null ? "—" : m.latest) + "</td>" +
+    "<td>" + (m.production == null ? "—" : stageChip("production") +
+              " v" + esc(m.production)) + "</td></tr>");
+  $("models").innerHTML = rows.join("") ||
+    '<tr><td colspan="4">no models registered yet</td></tr>';
+  for (const link of document.querySelectorAll("a.model-link")) {
+    link.onclick = (ev) => {
+      ev.preventDefault();
+      showModel(link.dataset.name).catch((e) => showError(e.message));
+    };
+  }
+}
+
+(async () => {
+  try {
+    const env = await api("/api/env-info");
+    $("user-chip").textContent = env.user;
+    await refresh();
+  } catch (e) {
+    if (e.message !== "unauthenticated") showError(e.message);
+  }
+})();

@@ -75,13 +75,17 @@ def default_routes(*, dashboard: str = "http://centraldashboard",
                    webapp: str = "http://notebook-webapp",
                    serving: str = "http://model-server:8500",
                    gatekeeper: str = "http://gatekeeper:8085",
-                   tensorboard: str = "http://tensorboard:80") -> List[Route]:
+                   tensorboard: str = "http://tensorboard:80",
+                   registry: str = "http://model-registry:6543") -> List[Route]:
     return [
         Route("/login", gatekeeper, strip_prefix=False),
         Route("/logout", gatekeeper, strip_prefix=False),
         Route("/jupyter/", webapp),
         Route("/serving/", serving),
         Route("/tensorboard/", tensorboard),
+        # model registry API behind auth (modeldb-frontend role; the
+        # dashboard's models page drives it)
+        Route("/registry/", registry),
         Route("/", dashboard, strip_prefix=False),  # catch-all, keep last
         # the dashboard's /studies.html + /runs.html pages (katib-ui / KFP
         # runs parity) ride the catch-all

@@ -6,6 +6,7 @@ from kubeflow_tpu.manifests.components import (  # noqa: F401
     dataprep,
     gateway,
     inferencegraph,
+    modelregistry,
     monitoring,
     notebooks,
     serving,

@@ -1,0 +1,68 @@
+"""Model registry component: Deployment + Service over a registry PVC.
+
+Manifest parity with the reference's modeldb package — backend Deployment
+:6543 + frontend + db (``/root/reference/kubeflow/modeldb/
+modeldb.libsonnet``) — collapsed to the framework's file-backed registry
+service (:mod:`kubeflow_tpu.serving.registry`): no database pod, the PVC
+is the store, the dashboard is the frontend.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from kubeflow_tpu.config.deployment import DeploymentConfig
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.manifests.registry import register
+
+DEFAULTS: Dict[str, Any] = {
+    "name": "model-registry",
+    "image": "kubeflow-tpu/serving:v1alpha1",
+    "port": 6543,  # modeldb backend's port, kept for familiarity
+    "registry_dir": "/registry",
+    "pvc": "model-registry",
+    "replicas": 1,
+}
+
+
+@register("model-registry", DEFAULTS,
+          "model registry/metadata service (modeldb parity)")
+def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
+    ns = config.namespace
+    name = params["name"]
+    use_pvc = bool(params["pvc"])
+    mounts = ([{"name": "store", "mountPath": params["registry_dir"]}]
+              if use_pvc else None)
+    volumes = ([{"name": "store",
+                 "persistentVolumeClaim": {"claimName": params["pvc"]}}]
+               if use_pvc else None)
+    pod = o.pod_spec(
+        [o.container(
+            name, params["image"],
+            command=["python", "-m", "kubeflow_tpu.serving.registry"],
+            env={"KFTPU_MODEL_REGISTRY_DIR": params["registry_dir"],
+                 "KFTPU_REGISTRY_PORT": str(params["port"])},
+            ports=[params["port"]],
+            volume_mounts=mounts,
+        )],
+        volumes=volumes,
+    )
+    out: List[o.Obj] = []
+    if use_pvc:
+        out.append({
+            "apiVersion": "v1",
+            "kind": "PersistentVolumeClaim",
+            "metadata": o.metadata(params["pvc"], ns),
+            "spec": {
+                "accessModes": ["ReadWriteOnce"],
+                "resources": {"requests": {"storage": "1Gi"}},
+            },
+        })
+    out.extend([
+        o.deployment(name, ns, pod, replicas=params["replicas"]),
+        o.service(name, ns, {"app": name},
+                  [{"name": "http", "port": params["port"],
+                    "targetPort": params["port"]}],
+                  labels={"app": name}),
+    ])
+    return out

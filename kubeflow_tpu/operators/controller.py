@@ -51,7 +51,7 @@ def set_phase_status(client: KubeClient, obj: dict, phase: str, *,
     evicts useful history from the ring. Writes only when something
     actually changed; a concurrently-deleted object is a no-op.
     """
-    from kubeflow_tpu.k8s.client import ApiError
+    from kubeflow_tpu.k8s.helpers import update_status_ignore_missing
 
     status = dict(obj.get("status", {}))
     status["phase"] = phase
@@ -67,11 +67,7 @@ def set_phase_status(client: KubeClient, obj: dict, phase: str, *,
         status["conditions"] = existing[-max_conditions:]
     if status != obj.get("status"):
         obj["status"] = status
-        try:
-            client.update_status(obj)
-        except ApiError as e:
-            if e.code != 404:
-                raise
+        update_status_ignore_missing(client, obj)
 
 
 @dataclass(order=True)

@@ -22,3 +22,8 @@ from kubeflow_tpu.serving.graph_controller import (  # noqa: F401
     InferenceGraphController,
     inference_graph,
 )
+from kubeflow_tpu.serving.registry import (  # noqa: F401
+    ModelRegistry,
+    RegistryService,
+    register_export,
+)

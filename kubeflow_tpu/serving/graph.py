@@ -179,6 +179,18 @@ class RouterState:
             }
 
 
+def _combiner_fanout(node: GraphNode) -> int:
+    """Pool workers one request can occupy: each combiner submits all
+    children but the last (which runs inline in the calling thread)."""
+    own = max(0, len(node.children) - 1) if node.type == "combiner" else 0
+    return own + sum(_combiner_fanout(c) for c in node.children)
+
+
+# headroom for concurrent in-flight requests sharing the executor's pool;
+# threads are created lazily, so a generous cap costs nothing until used
+_POOL_CONCURRENCY = 32
+
+
 class GraphExecutor:
     """Walks a graph per request, calling node backends through ``caller``."""
 
@@ -188,6 +200,14 @@ class GraphExecutor:
         self.caller = caller
         self.routers = RouterState()
         self._rng = random.Random(seed)
+        # one long-lived pool for combiner fan-out — per-request executor
+        # creation would churn threads on the serving hot path. The last
+        # child of every combiner runs inline in the caller's thread, so
+        # each request always makes progress even with the pool saturated.
+        fanout = _combiner_fanout(root)
+        self._pool = (ThreadPoolExecutor(
+            max_workers=max(fanout * _POOL_CONCURRENCY, 4))
+            if fanout else None)
 
     # -- predict -----------------------------------------------------------
 
@@ -218,10 +238,10 @@ class GraphExecutor:
         # decisions under a combiner still receive feedback credit.
         route.append(node.name)
         sub_routes: List[List[str]] = [[] for _ in node.children]
-        with ThreadPoolExecutor(max_workers=len(node.children)) as pool:
-            futs = [pool.submit(self._eval, c, payload, sub_routes[i])
-                    for i, c in enumerate(node.children)]
-            outs = [f.result() for f in futs]
+        futs = [self._pool.submit(self._eval, c, payload, sub_routes[i])
+                for i, c in enumerate(node.children[:-1])]
+        last = self._eval(node.children[-1], payload, sub_routes[-1])
+        outs = [f.result() for f in futs] + [last]
         for sub in sub_routes:
             route.extend(sub)
         return _combine(node.combine, outs)

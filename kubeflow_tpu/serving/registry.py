@@ -1,0 +1,333 @@
+"""Model registry — experiment/model metadata tracking (modeldb parity).
+
+The reference deploys ModelDB for this: a backend + frontend + mongo
+stack recording models, experiment runs, their metrics and lineage
+(``/root/reference/kubeflow/modeldb/modeldb.libsonnet``: backend :6543,
+frontend :3000, db). Here the same capability is a file-backed registry
+service over the framework's own model store — no database pod, same
+durability contract as the run archive
+(:mod:`kubeflow_tpu.workflows.archive`):
+
+- every *registered* model version records kind/config, training
+  metrics, lineage (the TpuJob / workflow / dataset / commit that
+  produced it), and a lifecycle stage;
+- stages gate serving: ``none → staging → production → archived`` —
+  the production alias answers "which version does the traffic split
+  point at" without editing manifests;
+- the REST API (:class:`RegistryService`) is what the dashboard's
+  models page and CI promotion steps drive.
+
+Registration happens at export time (:func:`register_export` wraps
+:func:`kubeflow_tpu.serving.model_store.export_model`) or explicitly
+via the API.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from kubeflow_tpu.utils import DEFAULT_REGISTRY
+from kubeflow_tpu.workflows.archive import _atomic_write
+
+ENV_REGISTRY_DIR = "KFTPU_MODEL_REGISTRY_DIR"
+
+STAGES = ("none", "staging", "production", "archived")
+
+# names map 1:1 to store filenames AND to serving model names; restricting
+# to this set means no sanitizing (which would silently merge distinct
+# names like "a/b" and "a_b" into one document)
+_MODEL_NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,127}$")
+
+_registrations = DEFAULT_REGISTRY.counter(
+    "kftpu_registry_versions_total", "model versions registered")
+
+
+class RegistryError(Exception):
+    """Bad registry request (client error: invalid name/stage)."""
+
+
+class NotFoundError(RegistryError):
+    """Unknown model or version."""
+
+
+def _check_name(model: str) -> str:
+    if not _MODEL_NAME_RE.match(model) or model in (".", ".."):
+        raise RegistryError(
+            f"invalid model name {model!r}: alphanumerics, '.', '_', '-' "
+            "only (must start alphanumeric)")
+    return model
+
+
+class ModelRegistry:
+    """One JSON document per model under ``root`` (PVC/GCS mount).
+
+    Writes are read-modify-write over the per-model document; the lock
+    serializes them across the service's request threads. Running more
+    than one replica over the same PVC would need file locking instead —
+    the manifest defaults to one replica for this reason.
+    """
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+        self._lock = threading.Lock()
+        os.makedirs(root, exist_ok=True)
+
+    # -- paths -------------------------------------------------------------
+
+    def _path(self, model: str) -> str:
+        return os.path.join(self.root, f"{_check_name(model)}.json")
+
+    def _load(self, model: str) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self._path(model)) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+        except json.JSONDecodeError:
+            return None
+
+    def _store(self, doc: Dict[str, Any]) -> None:
+        _atomic_write(self._path(doc["name"]),
+                      json.dumps(doc, indent=1, sort_keys=True).encode())
+
+    # -- write path --------------------------------------------------------
+
+    def register(self, model: str, version: int, *,
+                 kind: str = "",
+                 config: Optional[Dict[str, Any]] = None,
+                 metrics: Optional[Dict[str, float]] = None,
+                 lineage: Optional[Dict[str, str]] = None,
+                 base_path: str = "") -> Dict[str, Any]:
+        """Record (or re-record) a model version's metadata."""
+        version = int(version)
+        with self._lock:
+            doc = self._load(model) or {"name": model, "versions": {}}
+            entry = {
+                "version": version,
+                "kind": kind,
+                "config": dict(config or {}),
+                "metrics": {k: float(v) for k, v in (metrics or {}).items()},
+                "lineage": dict(lineage or {}),
+                "base_path": base_path,
+                "stage": doc["versions"].get(str(version), {}).get("stage",
+                                                                   "none"),
+                "registered_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                               time.gmtime()),
+            }
+            doc["versions"][str(version)] = entry
+            self._store(doc)
+        _registrations.inc(model=model)
+        return entry
+
+    def transition(self, model: str, version: int,
+                   stage: str) -> Dict[str, Any]:
+        """Move a version to a lifecycle stage.
+
+        Promoting to ``production`` demotes the previous production
+        version to ``archived`` — exactly one production version per
+        model, so the serving alias is unambiguous.
+        """
+        if stage not in STAGES:
+            raise RegistryError(f"invalid stage {stage!r}; valid: {STAGES}")
+        with self._lock:
+            doc = self._load(model)
+            if doc is None or str(int(version)) not in doc["versions"]:
+                raise NotFoundError(f"unknown version {model}/{version}")
+            if stage == "production":
+                for v, e in doc["versions"].items():
+                    if (e.get("stage") == "production"
+                            and v != str(int(version))):
+                        e["stage"] = "archived"
+            doc["versions"][str(int(version))]["stage"] = stage
+            self._store(doc)
+            return doc["versions"][str(int(version))]
+
+    def log_metrics(self, model: str, version: int,
+                    metrics: Dict[str, float]) -> Dict[str, Any]:
+        with self._lock:
+            doc = self._load(model)
+            if doc is None or str(int(version)) not in doc["versions"]:
+                raise NotFoundError(f"unknown version {model}/{version}")
+            entry = doc["versions"][str(int(version))]
+            entry["metrics"].update({k: float(v) for k, v in metrics.items()})
+            self._store(doc)
+            return entry
+
+    # -- read path ---------------------------------------------------------
+
+    def models(self) -> List[Dict[str, Any]]:
+        out = []
+        for fname in sorted(os.listdir(self.root)):
+            if not fname.endswith(".json"):
+                continue
+            doc = self._load(fname[:-len(".json")])
+            if doc is None:
+                continue
+            versions = doc.get("versions", {})
+            prod = next((e for e in versions.values()
+                         if e.get("stage") == "production"), None)
+            out.append({
+                "name": doc["name"],
+                "versions": len(versions),
+                "production": prod["version"] if prod else None,
+                "latest": max((e["version"] for e in versions.values()),
+                              default=None),
+            })
+        return out
+
+    def versions(self, model: str) -> List[Dict[str, Any]]:
+        doc = self._load(model)
+        if doc is None:
+            raise NotFoundError(f"unknown model {model!r}")
+        return sorted(doc["versions"].values(), key=lambda e: e["version"])
+
+    def get(self, model: str, version: int) -> Dict[str, Any]:
+        doc = self._load(model)
+        if doc is None or str(int(version)) not in doc.get("versions", {}):
+            raise NotFoundError(f"unknown version {model}/{version}")
+        return doc["versions"][str(int(version))]
+
+    def production(self, model: str) -> Optional[Dict[str, Any]]:
+        """The serving alias: the single production-stage version."""
+        doc = self._load(model)
+        if doc is None:
+            return None
+        return next((e for e in doc["versions"].values()
+                     if e.get("stage") == "production"), None)
+
+    def search(self, metric: str, *, minimum: Optional[float] = None,
+               model: Optional[str] = None) -> List[Dict[str, Any]]:
+        """Versions ranked by a metric (best first) — the leaderboard
+        query ModelDB's experiment comparison answers."""
+        hits = []
+        for m in self.models():
+            if model is not None and m["name"] != model:
+                continue
+            for e in self.versions(m["name"]):
+                if metric not in e["metrics"]:
+                    continue
+                val = e["metrics"][metric]
+                if minimum is not None and val < minimum:
+                    continue
+                hits.append({"model": m["name"], **e})
+        return sorted(hits, key=lambda e: e["metrics"][metric], reverse=True)
+
+
+def register_export(registry: ModelRegistry, path: str, kind: str,
+                    params: Any, *,
+                    config: Optional[Dict[str, Any]] = None,
+                    version: int = 1,
+                    metrics: Optional[Dict[str, float]] = None,
+                    lineage: Optional[Dict[str, str]] = None,
+                    **export_kw: Any) -> str:
+    """Export a model version AND register it in one step."""
+    from kubeflow_tpu.serving.model_store import export_model
+
+    model = os.path.basename(os.path.normpath(path))
+    vdir = export_model(path, kind, params, config=config, version=version,
+                        **export_kw)
+    registry.register(model, version, kind=kind, config=config or {},
+                      metrics=metrics, lineage=lineage, base_path=path)
+    return vdir
+
+
+class RegistryService:
+    """REST surface (modeldb backend role), served by ``serve_json``.
+
+    - ``GET  /api/registry/models``
+    - ``GET  /api/registry/models/<m>/versions``
+    - ``GET  /api/registry/models/<m>/production``
+    - ``POST /api/registry/models/<m>/versions``           (register)
+    - ``POST /api/registry/models/<m>/versions/<v>:metrics``
+    - ``POST /api/registry/models/<m>/versions/<v>:transition``
+    - ``GET  /api/registry/search?metric=...&min=...``
+    """
+
+    def __init__(self, registry: ModelRegistry) -> None:
+        self.registry = registry
+
+    def handle(self, method: str, path: str, body: Optional[Dict[str, Any]],
+               user: str = "") -> Tuple[int, Any]:
+        try:
+            return self._route(method, path, body or {})
+        except NotFoundError as e:
+            return 404, {"error": str(e)}
+        except RegistryError as e:
+            return 400, {"error": str(e)}
+
+    def _route(self, method: str, path: str,
+               body: Dict[str, Any]) -> Tuple[int, Any]:
+        if method == "GET" and path == "/healthz":
+            return 200, {"ok": True}
+        if path == "/api/registry/models" and method == "GET":
+            return 200, {"models": self.registry.models()}
+        if path.startswith("/api/registry/search") and method == "GET":
+            q = _query(path)
+            if "metric" not in q:
+                return 400, {"error": "search needs ?metric="}
+            minimum = float(q["min"]) if "min" in q else None
+            return 200, {"results": self.registry.search(
+                q["metric"], minimum=minimum, model=q.get("model"))}
+        parts = path.strip("/").split("/")
+        # api/registry/models/<m>/...
+        if len(parts) >= 4 and parts[:3] == ["api", "registry", "models"]:
+            model = parts[3]
+            rest = parts[4:]
+            if rest == ["versions"] and method == "GET":
+                return 200, {"versions": self.registry.versions(model)}
+            if rest == ["versions"] and method == "POST":
+                if "version" not in body:
+                    return 400, {"error": "body needs 'version'"}
+                entry = self.registry.register(
+                    model, int(body["version"]),
+                    kind=body.get("kind", ""),
+                    config=body.get("config"),
+                    metrics=body.get("metrics"),
+                    lineage=body.get("lineage"),
+                    base_path=body.get("basePath", ""))
+                return 200, entry
+            if rest == ["production"] and method == "GET":
+                prod = self.registry.production(model)
+                if prod is None:
+                    return 404, {"error": f"no production version of "
+                                          f"{model!r}"}
+                return 200, prod
+            if (len(rest) == 2 and rest[0] == "versions"
+                    and method == "POST"):
+                vpart = rest[1]
+                if vpart.endswith(":metrics"):
+                    entry = self.registry.log_metrics(
+                        model, int(vpart[:-len(":metrics")]),
+                        body.get("metrics", {}))
+                    return 200, entry
+                if vpart.endswith(":transition"):
+                    if "stage" not in body:
+                        return 400, {"error": "body needs 'stage'"}
+                    entry = self.registry.transition(
+                        model, int(vpart[:-len(":transition")]),
+                        body["stage"])
+                    return 200, entry
+        return 404, {"error": "unknown endpoint"}
+
+
+def _query(path: str) -> Dict[str, str]:
+    from urllib.parse import parse_qsl, urlsplit
+
+    return dict(parse_qsl(urlsplit(path).query))
+
+
+def main() -> None:  # pragma: no cover - container entrypoint
+    from kubeflow_tpu.utils.jsonhttp import serve_json
+
+    registry = ModelRegistry(os.environ.get(ENV_REGISTRY_DIR, "/registry"))
+    serve_json(RegistryService(registry).handle,
+               int(os.environ.get("KFTPU_REGISTRY_PORT", "6543")))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

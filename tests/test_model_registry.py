@@ -1,0 +1,200 @@
+"""Model registry tests (modeldb parity): version records, lifecycle
+stages, metric leaderboard, REST surface, export integration, and
+durability across service restarts.
+
+Reference role: the modeldb backend/frontend/db stack
+(``/root/reference/kubeflow/modeldb/modeldb.libsonnet``).
+"""
+
+import pytest
+
+from kubeflow_tpu.config.deployment import ComponentSpec, DeploymentConfig
+from kubeflow_tpu.manifests.registry import render_component
+from kubeflow_tpu.serving.registry import (
+    ModelRegistry,
+    RegistryError,
+    RegistryService,
+    register_export,
+)
+
+
+@pytest.fixture
+def reg(tmp_path):
+    return ModelRegistry(str(tmp_path / "registry"))
+
+
+# -- store -----------------------------------------------------------------
+
+def test_register_and_list(reg):
+    reg.register("resnet", 1, kind="resnet",
+                 metrics={"top1": 0.71},
+                 lineage={"job": "train-abc", "dataset": "imagenet"})
+    reg.register("resnet", 2, kind="resnet", metrics={"top1": 0.74})
+    models = reg.models()
+    assert models == [{"name": "resnet", "versions": 2,
+                       "production": None, "latest": 2}]
+    v1 = reg.get("resnet", 1)
+    assert v1["lineage"]["job"] == "train-abc"
+
+
+def test_production_promotion_demotes_previous(reg):
+    reg.register("m", 1)
+    reg.register("m", 2)
+    reg.transition("m", 1, "production")
+    reg.transition("m", 2, "production")
+    assert reg.get("m", 1)["stage"] == "archived"
+    assert reg.production("m")["version"] == 2
+    assert reg.models()[0]["production"] == 2
+
+
+def test_invalid_stage_rejected(reg):
+    reg.register("m", 1)
+    with pytest.raises(RegistryError, match="invalid stage"):
+        reg.transition("m", 1, "shipping")
+
+
+def test_unknown_version_raises(reg):
+    with pytest.raises(RegistryError, match="unknown"):
+        reg.transition("m", 1, "staging")
+    with pytest.raises(RegistryError, match="unknown"):
+        reg.log_metrics("m", 1, {"a": 1})
+
+
+def test_metric_leaderboard(reg):
+    reg.register("a", 1, metrics={"top1": 0.70})
+    reg.register("a", 2, metrics={"top1": 0.75})
+    reg.register("b", 1, metrics={"top1": 0.72})
+    hits = reg.search("top1")
+    assert [(h["model"], h["version"]) for h in hits] == [
+        ("a", 2), ("b", 1), ("a", 1)]
+    hits = reg.search("top1", minimum=0.71)
+    assert len(hits) == 2
+
+
+def test_registry_survives_reopen(tmp_path):
+    """The PVC is the database: a new service instance over the same dir
+    sees everything (modeldb's durability via mongo, here via files)."""
+    ModelRegistry(str(tmp_path)).register("m", 1, metrics={"loss": 0.5})
+    reg2 = ModelRegistry(str(tmp_path))
+    assert reg2.get("m", 1)["metrics"]["loss"] == 0.5
+
+
+def test_model_name_with_path_chars_rejected(reg):
+    # silently sanitizing would merge distinct names ("a/b" vs "a_b")
+    # into one document; reject at the door instead
+    for bad in ("../evil", "a/b", "", "x\" onmouseover=\"alert(1)", "-lead"):
+        with pytest.raises(RegistryError, match="invalid model name"):
+            reg.register(bad, 1)
+
+
+def test_invalid_stage_is_400_not_404(reg):
+    reg.register("m", 1)
+    from kubeflow_tpu.serving.registry import RegistryService
+
+    svc = RegistryService(reg)
+    code, out = svc.handle("POST",
+                           "/api/registry/models/m/versions/1:transition",
+                           {"stage": "shipping"})
+    assert code == 400 and "invalid stage" in out["error"]
+
+
+# -- REST surface ----------------------------------------------------------
+
+@pytest.fixture
+def svc(reg):
+    return RegistryService(reg)
+
+
+def test_rest_register_and_fetch(svc):
+    code, entry = svc.handle("POST", "/api/registry/models/m/versions",
+                             {"version": 1, "kind": "bert",
+                              "metrics": {"f1": 0.9},
+                              "lineage": {"job": "j1"}})
+    assert code == 200 and entry["kind"] == "bert"
+    code, out = svc.handle("GET", "/api/registry/models", None)
+    assert code == 200 and out["models"][0]["name"] == "m"
+    code, out = svc.handle("GET", "/api/registry/models/m/versions", None)
+    assert code == 200 and out["versions"][0]["metrics"]["f1"] == 0.9
+
+
+def test_rest_transition_and_production(svc):
+    svc.handle("POST", "/api/registry/models/m/versions", {"version": 1})
+    code, _ = svc.handle("POST",
+                         "/api/registry/models/m/versions/1:transition",
+                         {"stage": "production"})
+    assert code == 200
+    code, prod = svc.handle("GET", "/api/registry/models/m/production", None)
+    assert code == 200 and prod["version"] == 1
+
+
+def test_rest_metrics_append(svc):
+    svc.handle("POST", "/api/registry/models/m/versions", {"version": 1})
+    code, entry = svc.handle("POST",
+                             "/api/registry/models/m/versions/1:metrics",
+                             {"metrics": {"top1": 0.8}})
+    assert code == 200 and entry["metrics"]["top1"] == 0.8
+
+
+def test_rest_search(svc):
+    svc.handle("POST", "/api/registry/models/a/versions",
+               {"version": 1, "metrics": {"top1": 0.7}})
+    svc.handle("POST", "/api/registry/models/b/versions",
+               {"version": 1, "metrics": {"top1": 0.9}})
+    code, out = svc.handle("GET",
+                           "/api/registry/search?metric=top1&min=0.8", None)
+    assert code == 200
+    assert [h["model"] for h in out["results"]] == ["b"]
+
+
+def test_rest_errors(svc):
+    assert svc.handle("GET", "/api/registry/models/nope/versions",
+                      None)[0] == 404
+    assert svc.handle("POST", "/api/registry/models/m/versions", {})[0] == 400
+    assert svc.handle("GET", "/api/registry/search", None)[0] == 400
+    assert svc.handle("POST", "/api/registry/models/m/versions/1:transition",
+                      {"stage": "production"})[0] == 404
+
+
+# -- export integration ----------------------------------------------------
+
+def test_register_export_records_and_exports(tmp_path, reg):
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import MnistCnn
+    from kubeflow_tpu.serving.model_store import load_latest
+
+    model = MnistCnn()
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    vdir = register_export(reg, str(tmp_path / "mnist"), "mnist", params,
+                           version=2, metrics={"acc": 0.99},
+                           lineage={"job": "mnist-train-1"})
+    assert vdir.endswith("/2")
+    assert load_latest(str(tmp_path / "mnist")).version == 2
+    entry = reg.get("mnist", 2)
+    assert entry["metrics"]["acc"] == 0.99
+    assert entry["lineage"]["job"] == "mnist-train-1"
+    assert entry["base_path"].endswith("mnist")
+
+
+# -- manifest --------------------------------------------------------------
+
+def test_model_registry_component_golden():
+    cfg = DeploymentConfig(name="d", platform="local",
+                           components=[ComponentSpec("model-registry")])
+    objs = render_component(cfg, cfg.components[0])
+    kinds = [obj["kind"] for obj in objs]
+    assert kinds == ["PersistentVolumeClaim", "Deployment", "Service"]
+    dep = objs[1]
+    env = {e["name"]: e["value"] for e in
+           dep["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["KFTPU_MODEL_REGISTRY_DIR"] == "/registry"
+    mounts = dep["spec"]["template"]["spec"]["containers"][0]["volumeMounts"]
+    assert mounts[0]["mountPath"] == "/registry"
+
+
+def test_standard_preset_includes_model_registry():
+    from kubeflow_tpu.config.presets import preset
+
+    cfg = preset("standard", "demo")
+    assert "model-registry" in [c.name for c in cfg.components]
