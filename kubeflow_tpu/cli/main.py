@@ -14,6 +14,7 @@ from __future__ import annotations
 import argparse
 import logging
 import os
+import re
 import sys
 from typing import List, Optional
 
@@ -22,7 +23,7 @@ import yaml
 import kubeflow_tpu
 from kubeflow_tpu.config import DeploymentConfig, preset
 from kubeflow_tpu.k8s.apply import apply_all, delete_all
-from kubeflow_tpu.k8s.client import HttpKubeClient, KubeClient
+from kubeflow_tpu.k8s.client import ApiError, HttpKubeClient, KubeClient
 from kubeflow_tpu.k8s.fakefile import FileBackedFakeClient
 from kubeflow_tpu.k8s.objects import Obj
 from kubeflow_tpu.manifests import list_components, render_all
@@ -204,6 +205,136 @@ def cmd_images(args) -> int:
     return 0
 
 
+def cmd_gc(args) -> int:
+    """Prune cluster objects this deployment no longer renders.
+
+    The reference's gc tool cleans stale deployments
+    (``/root/reference/bootstrap/cmd/gc/main.go``); here staleness is
+    precise: every rendered object carries ``app.kubernetes.io/part-of``
+    (:func:`render_all`), so anything in the cluster wearing this
+    deployment's label that the current manifests don't contain was left
+    behind by a removed component — delete it (kubectl apply --prune
+    role)."""
+    from kubeflow_tpu.k8s.apply import prune
+    from kubeflow_tpu.k8s.objects import obj_key
+    from kubeflow_tpu.manifests.registry import PART_OF_LABEL
+
+    config = _app_config(args.app_dir)
+    _sync_fake_state(config, args)
+    desired = _load_manifests(args.app_dir)
+    client = _client(args)
+    selector = {PART_OF_LABEL: config.name}
+    # observed kinds = kinds we render now ∪ every kind any builtin
+    # component renders (a removed component may have held the only
+    # object of its kind)
+    kinds = {(obj["apiVersion"], obj["kind"]) for obj in desired}
+    kinds |= {("apps/v1", "Deployment"), ("apps/v1", "StatefulSet"),
+              ("v1", "Service"), ("v1", "ConfigMap"), ("v1", "Secret"),
+              ("v1", "ServiceAccount"), ("v1", "PersistentVolumeClaim"),
+              ("rbac.authorization.k8s.io/v1", "ClusterRole"),
+              ("rbac.authorization.k8s.io/v1", "ClusterRoleBinding"),
+              ("rbac.authorization.k8s.io/v1", "Role"),
+              ("rbac.authorization.k8s.io/v1", "RoleBinding"),
+              ("apiextensions.k8s.io/v1", "CustomResourceDefinition"),
+              ("networking.k8s.io/v1", "NetworkPolicy"),
+              ("admissionregistration.k8s.io/v1",
+               "MutatingWebhookConfiguration")}
+    observed = []
+    for api, kind in sorted(kinds):
+        if kind == "Namespace":
+            continue  # never gc the namespace out from under the app
+        try:
+            observed.extend(client.list(api, kind,
+                                        label_selector=selector))
+        except ApiError:
+            continue  # kind not served (e.g. CRD already gone)
+    want = {obj_key(d) for d in desired}
+    stale = [obj for obj in observed if obj_key(obj) not in want]
+    if args.dry_run:
+        for obj in stale:
+            print(f"would delete {obj_key(obj)}")
+        print(f"{len(stale)} stale object(s) (dry run)")
+        return 0
+    pruned = prune(client, desired, stale)
+    for obj in pruned:
+        print(f"deleted {obj_key(obj)}")
+    print(f"pruned {len(pruned)} stale object(s)")
+    return 0
+
+
+_SCAFFOLD_TEMPLATE = '''\
+"""{title} component."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from kubeflow_tpu.config.deployment import DeploymentConfig
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.manifests.registry import register
+
+DEFAULTS: Dict[str, Any] = {{
+    "image": "kubeflow-tpu/platform:v1alpha1",
+    "replicas": 1,
+}}
+
+
+@register("{name}", DEFAULTS, "{title}")
+def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
+    ns = config.namespace
+    name = "{name}"
+    pod = o.pod_spec([
+        o.container(name, params["image"]),
+    ])
+    return [
+        o.deployment(name, ns, pod, replicas=params["replicas"]),
+        o.service(name, ns, {{"app": name}},
+                  [{{"name": "http", "port": 80, "targetPort": 8080}}]),
+    ]
+'''
+
+_SCAFFOLD_TEST_TEMPLATE = '''\
+"""Golden test for the {name} component."""
+
+import {pyname}  # noqa: F401 — importing runs the @register call
+
+from kubeflow_tpu.config.deployment import ComponentSpec, DeploymentConfig
+from kubeflow_tpu.manifests.registry import render_component
+
+
+def test_{pyname}_golden():
+    cfg = DeploymentConfig(name="d", platform="local",
+                           components=[ComponentSpec("{name}")])
+    objs = render_component(cfg, cfg.components[0])
+    assert [o["kind"] for o in objs] == ["Deployment", "Service"]
+'''
+
+
+def cmd_scaffold(args) -> int:
+    """New-component stub (reference ``kubeflow/new-package-stub`` role):
+    a registered renderer module + its golden test, ready to edit."""
+    name = args.name
+    if not re.match(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$", name):
+        raise SystemExit(f"component name {name!r} must be a DNS-1123 label")
+    pyname = name.replace("-", "_")
+    out_dir = args.out or "."
+    os.makedirs(out_dir, exist_ok=True)
+    title = name.replace("-", " ")
+    comp_path = os.path.join(out_dir, f"{pyname}.py")
+    test_path = os.path.join(out_dir, f"test_{pyname}.py")
+    for path in (comp_path, test_path):
+        if os.path.exists(path) and not args.force:
+            raise SystemExit(f"{path} exists (use --force to overwrite)")
+    with open(comp_path, "w") as f:
+        f.write(_SCAFFOLD_TEMPLATE.format(name=name, title=title))
+    with open(test_path, "w") as f:
+        f.write(_SCAFFOLD_TEST_TEMPLATE.format(name=name, pyname=pyname))
+    print(f"scaffolded {comp_path} + {test_path}")
+    print("import the module (so @register runs) and add it to your "
+          "deployment's components")
+    return 0
+
+
 def cmd_version(args) -> int:
     print(f"ctl (kubeflow_tpu) {kubeflow_tpu.__version__}")
     return 0
@@ -261,6 +392,23 @@ def build_parser() -> argparse.ArgumentParser:
                     help="pin all component images to TAG in app.yaml")
     sp.add_argument("--registry", default=None,
                     help="also move images to this registry (with --retag)")
+
+    sp = app_cmd("gc", cmd_gc,
+                 "prune cluster objects no longer in the manifests")
+    sp.add_argument("--dry-run", action="store_true",
+                    help="list stale objects without deleting")
+    sp.add_argument("--server", default=None,
+                    help="API server URL (default: in-cluster or fake)")
+    sp.add_argument("--insecure", action="store_true",
+                    help="skip TLS verification")
+    sp.add_argument("--fake-state", default=None,
+                    help="file-backed fake cluster state path")
+
+    sp = sub.add_parser("scaffold", help="generate a new component stub")
+    sp.add_argument("name", help="component name (DNS-1123 label)")
+    sp.add_argument("--out", default=None, help="output directory")
+    sp.add_argument("--force", action="store_true")
+    sp.set_defaults(fn=cmd_scaffold)
 
     sp = sub.add_parser("components", help="list available components")
     # SUPPRESS keeps the global -v value instead of overwriting it with False

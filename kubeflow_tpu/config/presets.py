@@ -43,6 +43,7 @@ def _standard(name: str) -> DeploymentConfig:
             ComponentSpec("dataprep"),
             ComponentSpec("inference-graph"),
             ComponentSpec("model-registry"),
+            ComponentSpec("application"),
         ],
     )
 

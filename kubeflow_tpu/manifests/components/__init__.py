@@ -1,6 +1,7 @@
 """Built-in platform components. Importing this package registers them all."""
 
 from kubeflow_tpu.manifests.components import (  # noqa: F401
+    application,
     auth,
     dashboard,
     dataprep,

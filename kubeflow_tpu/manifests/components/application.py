@@ -1,0 +1,66 @@
+"""Application component: CRD + aggregator controller + the deployment's
+own Application CR.
+
+Manifest parity with the reference's application package
+(``/root/reference/kubeflow/application/application.libsonnet``): the
+Application CRD, the controller that assembles grouped status, and one
+Application CR describing THIS deployment — selecting on the
+``app.kubernetes.io/part-of`` label every rendered object carries.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from kubeflow_tpu.config.deployment import DeploymentConfig
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.manifests.registry import PART_OF_LABEL, register
+
+DEFAULTS: Dict[str, Any] = {
+    "image": "kubeflow-tpu/platform:v1alpha1",
+    "cluster_scope": True,
+}
+
+
+@register("application", DEFAULTS,
+          "application CRD + aggregated platform health (sig-apps parity)")
+def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
+    from kubeflow_tpu.operators.application import application, application_crd
+
+    ns = config.namespace
+    name = "application-controller"
+    rules = [
+        {"apiGroups": ["kubeflow-tpu.org"],
+         "resources": ["applications", "applications/status"], "verbs": ["*"]},
+        {"apiGroups": ["apps"], "resources": ["deployments", "statefulsets"],
+         "verbs": ["get", "list", "watch"]},
+        {"apiGroups": [""],
+         "resources": ["services", "pods", "configmaps", "secrets",
+                       "serviceaccounts", "persistentvolumeclaims"],
+         "verbs": ["get", "list", "watch"]},
+    ]
+    env = {"KFTPU_APPLICATION_NAMESPACE": "" if params["cluster_scope"] else ns}
+    pod = o.pod_spec(
+        [o.container(
+            name, params["image"],
+            command=["python", "-m", "kubeflow_tpu.operators.application"],
+            env=env,
+        )],
+        service_account_name=name,
+    )
+    return [
+        application_crd(),
+        o.service_account(name, ns),
+        o.cluster_role(name, rules),
+        o.cluster_role_binding(name, name, name, ns),
+        o.deployment(name, ns, pod),
+        # this deployment's own grouped-health CR
+        application(
+            config.name, ns,
+            selector={PART_OF_LABEL: config.name},
+            component_kinds=["Deployment", "StatefulSet", "Service"],
+            descriptor={
+                "type": "kubeflow-tpu",
+                "components": [c.name for c in config.components],
+            }),
+    ]

@@ -75,13 +75,24 @@ def render_component(config: DeploymentConfig, spec: ComponentSpec) -> List[Obj]
     return comp.render(config, params)
 
 
+PART_OF_LABEL = "app.kubernetes.io/part-of"
+
+
 def render_all(config: DeploymentConfig) -> List[Obj]:
-    """Render the full deployment: namespace first, then every component."""
+    """Render the full deployment: namespace first, then every component.
+
+    Every object is stamped with the ``app.kubernetes.io/part-of`` label
+    (kustomize commonLabels role): the Application aggregator selects on
+    it and ``ctl gc`` prunes stale cluster objects by it.
+    """
     config.validate()
     objs: List[Obj] = [namespace(config.namespace,
-                                 labels={"app.kubernetes.io/part-of": config.name})]
+                                 labels={PART_OF_LABEL: config.name})]
     for spec in config.components:
         objs.extend(render_component(config, spec))
+    for obj in objs:
+        labels = obj.setdefault("metadata", {}).setdefault("labels", {})
+        labels.setdefault(PART_OF_LABEL, config.name)
     return objs
 
 

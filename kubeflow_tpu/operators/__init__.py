@@ -1,6 +1,10 @@
 """In-cluster controllers: the TpuJob operator and companions."""
 
 from kubeflow_tpu.operators.controller import Controller, WorkQueue  # noqa: F401
+from kubeflow_tpu.operators.application import (  # noqa: F401
+    ApplicationController,
+    application,
+)
 from kubeflow_tpu.operators.dataprep import (  # noqa: F401
     DataPrepOperator,
     DataPrepSpec,

@@ -1,0 +1,171 @@
+"""Application aggregator — grouped health for a deployed stack.
+
+The reference's application package deploys the SIG-Apps Application CRD
+plus a metacontroller sync that assembles one status over a label-selected
+group of resources (``/root/reference/kubeflow/application/
+application.libsonnet:213-345``: componentKinds + selector → assembled
+Application CR). Same contract here, as a native reconcile loop:
+
+- an ``Application`` CR declares a label ``selector`` and the
+  ``componentKinds`` it owns (every manifest object carries
+  ``app.kubernetes.io/part-of`` via
+  :func:`kubeflow_tpu.manifests.registry.render_all`);
+- the controller lists matching resources per kind and derives each
+  component's readiness (Deployments/StatefulSets: ready==desired
+  replicas; Pods: phase; anything else: exists);
+- status aggregates: total/ready counts, per-component table, and a
+  single Ready/Progressing condition — the dashboard's one-look answer
+  to "is the platform healthy".
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.k8s.client import KubeClient, register_plural
+from kubeflow_tpu.manifests.components.tpujob_operator import GROUP, VERSION
+from kubeflow_tpu.operators.controller import (
+    Controller,
+    make_condition,
+    set_phase_status,
+)
+
+log = logging.getLogger(__name__)
+
+API_VERSION = f"{GROUP}/{VERSION}"
+APPLICATION_KIND = "Application"
+APPLICATION_PLURAL = "applications"
+register_plural(APPLICATION_KIND, APPLICATION_PLURAL)
+
+PHASE_READY = "Ready"
+PHASE_PROGRESSING = "Progressing"
+
+# kind -> apiVersion for the component kinds the aggregator understands;
+# mirrors the reference's componentKinds entries (application.libsonnet
+# emits {group, kind} pairs for exactly this set plus its CRDs)
+KIND_API: Dict[str, str] = {
+    "Deployment": "apps/v1",
+    "StatefulSet": "apps/v1",
+    "Service": "v1",
+    "Pod": "v1",
+    "ConfigMap": "v1",
+    "Secret": "v1",
+    "ServiceAccount": "v1",
+    "PersistentVolumeClaim": "v1",
+}
+
+
+def application_crd() -> o.Obj:
+    return o.crd(
+        APPLICATION_PLURAL, GROUP, APPLICATION_KIND,
+        versions=(VERSION,),
+        short_names=("app",),
+        printer_columns=(
+            {"name": "Phase", "type": "string", "jsonPath": ".status.phase"},
+            {"name": "Ready", "type": "string",
+             "jsonPath": ".status.ready"},
+        ),
+    )
+
+
+def application(name: str, ns: str, *,
+                selector: Dict[str, str],
+                component_kinds: Optional[List[str]] = None,
+                descriptor: Optional[Dict[str, Any]] = None) -> o.Obj:
+    """Build an Application CR (the app.k8s.io shape, framework group)."""
+    kinds = component_kinds or ["Deployment", "StatefulSet", "Service"]
+    unknown = [k for k in kinds if k not in KIND_API]
+    if unknown:
+        raise ValueError(f"unsupported componentKinds {unknown}; "
+                         f"known: {sorted(KIND_API)}")
+    return {
+        "apiVersion": API_VERSION,
+        "kind": APPLICATION_KIND,
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "selector": {"matchLabels": dict(selector)},
+            "componentKinds": list(kinds),
+            "descriptor": dict(descriptor or {}),
+        },
+    }
+
+
+def _readiness(obj: o.Obj) -> Tuple[bool, str]:
+    """(ready, human detail) for one component resource."""
+    kind = obj.get("kind", "")
+    status = obj.get("status", {}) or {}
+    if kind in ("Deployment", "StatefulSet"):
+        want = int(obj.get("spec", {}).get("replicas", 1))
+        have = int(status.get("readyReplicas", 0))
+        return have >= want, f"{have}/{want} replicas"
+    if kind == "Pod":
+        phase = status.get("phase", "Pending")
+        return phase in ("Running", "Succeeded"), phase
+    # config-shaped objects are ready by existing
+    return True, "exists"
+
+
+class ApplicationController:
+    """Reconciles Application CRs into an aggregated component status."""
+
+    def __init__(self, client: KubeClient,
+                 namespace: Optional[str] = None) -> None:
+        self.client = client
+        self.namespace = namespace
+
+    def reconcile(self, ns: str, name: str) -> Optional[float]:
+        app = self.client.get_or_none(API_VERSION, APPLICATION_KIND, ns, name)
+        if app is None:
+            return None
+        spec = app.get("spec", {})
+        selector = (spec.get("selector", {}) or {}).get("matchLabels", {})
+        kinds = [k for k in spec.get("componentKinds", []) if k in KIND_API]
+
+        components: List[Dict[str, Any]] = []
+        ready_n = 0
+        for kind in kinds:
+            for obj in self.client.list(KIND_API[kind], kind, ns,
+                                        label_selector=selector or None):
+                ready, detail = _readiness(obj)
+                ready_n += int(ready)
+                components.append({
+                    "kind": kind,
+                    "name": obj["metadata"]["name"],
+                    "ready": ready,
+                    "detail": detail,
+                })
+
+        total = len(components)
+        phase = PHASE_READY if ready_n == total else PHASE_PROGRESSING
+        cond = (make_condition("Ready", "AllComponentsReady")
+                if phase == PHASE_READY else
+                make_condition("Progressing", "ComponentsNotReady",
+                               f"{total - ready_n} of {total} not ready"))
+        set_phase_status(
+            self.client, app, phase,
+            ready=f"{ready_n}/{total}",
+            components=components,
+            conditions=[cond])
+        # components change as pods roll; keep the status fresh
+        return 15.0
+
+    def controller(self) -> Controller:
+        return Controller(self.client, API_VERSION, APPLICATION_KIND,
+                          self.reconcile, namespace=self.namespace,
+                          name="application-controller")
+
+
+def main() -> None:  # pragma: no cover - container entrypoint
+    import os
+
+    from kubeflow_tpu.k8s.client import HttpKubeClient
+
+    client = HttpKubeClient.in_cluster()
+    ns = os.environ.get("KFTPU_APPLICATION_NAMESPACE") or None
+    ApplicationController(client, namespace=ns).controller().run_forever()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -186,6 +186,16 @@ def _combiner_fanout(node: GraphNode) -> int:
     return own + sum(_combiner_fanout(c) for c in node.children)
 
 
+def _has_nested_combiner(node: GraphNode, *, inside: bool = False) -> bool:
+    """True when a combiner sits below another combiner anywhere."""
+    if node.type == "combiner":
+        if inside:
+            return True
+        inside = True
+    return any(_has_nested_combiner(c, inside=inside)
+               for c in node.children)
+
+
 # headroom for concurrent in-flight requests sharing the executor's pool;
 # threads are created lazily, so a generous cap costs nothing until used
 _POOL_CONCURRENCY = 32
@@ -202,12 +212,16 @@ class GraphExecutor:
         self._rng = random.Random(seed)
         # one long-lived pool for combiner fan-out — per-request executor
         # creation would churn threads on the serving hot path. The last
-        # child of every combiner runs inline in the caller's thread, so
-        # each request always makes progress even with the pool saturated.
+        # child of every combiner runs inline in the caller's thread.
+        # NESTED combiners can still deadlock any bounded shared pool
+        # (pool workers block on tasks queued behind other requests'
+        # workers), so that rare shape falls back to per-request threads:
+        # correctness over thread reuse.
         fanout = _combiner_fanout(root)
+        self._nested = _has_nested_combiner(root)
         self._pool = (ThreadPoolExecutor(
             max_workers=max(fanout * _POOL_CONCURRENCY, 4))
-            if fanout else None)
+            if fanout and not self._nested else None)
 
     # -- predict -----------------------------------------------------------
 
@@ -238,10 +252,32 @@ class GraphExecutor:
         # decisions under a combiner still receive feedback credit.
         route.append(node.name)
         sub_routes: List[List[str]] = [[] for _ in node.children]
-        futs = [self._pool.submit(self._eval, c, payload, sub_routes[i])
-                for i, c in enumerate(node.children[:-1])]
+        if self._pool is not None:
+            futs = [self._pool.submit(self._eval, c, payload, sub_routes[i])
+                    for i, c in enumerate(node.children[:-1])]
+        else:  # nested combiners: per-request threads, deadlock-free
+            results: List[Any] = [None] * (len(node.children) - 1)
+
+            def run(i: int, c: GraphNode) -> None:
+                try:
+                    results[i] = ("ok", self._eval(c, payload, sub_routes[i]))
+                except Exception as e:  # noqa: BLE001 — re-raised below
+                    results[i] = ("err", e)
+
+            threads = [threading.Thread(target=run, args=(i, c))
+                       for i, c in enumerate(node.children[:-1])]
+            for t in threads:
+                t.start()
         last = self._eval(node.children[-1], payload, sub_routes[-1])
-        outs = [f.result() for f in futs] + [last]
+        if self._pool is not None:
+            outs = [f.result() for f in futs] + [last]
+        else:
+            for t in threads:
+                t.join()
+            for tag, val in results:
+                if tag == "err":
+                    raise val
+            outs = [val for _, val in results] + [last]
         for sub in sub_routes:
             route.extend(sub)
         return _combine(node.combine, outs)

@@ -165,7 +165,10 @@ class ModelRegistry:
         for fname in sorted(os.listdir(self.root)):
             if not fname.endswith(".json"):
                 continue
-            doc = self._load(fname[:-len(".json")])
+            name = fname[:-len(".json")]
+            if not _MODEL_NAME_RE.match(name):
+                continue  # stray file on the PVC, not one of ours
+            doc = self._load(name)
             if doc is None:
                 continue
             versions = doc.get("versions", {})
@@ -228,7 +231,9 @@ def register_export(registry: ModelRegistry, path: str, kind: str,
     """Export a model version AND register it in one step."""
     from kubeflow_tpu.serving.model_store import export_model
 
-    model = os.path.basename(os.path.normpath(path))
+    model = _check_name(os.path.basename(os.path.normpath(path)))
+    # name validated BEFORE the export writes anything: a bad name must
+    # not leave an exported-but-unregistered version on disk
     vdir = export_model(path, kind, params, config=config, version=version,
                         **export_kw)
     registry.register(model, version, kind=kind, config=config or {},
@@ -259,6 +264,10 @@ class RegistryService:
             return 404, {"error": str(e)}
         except RegistryError as e:
             return 400, {"error": str(e)}
+        except (ValueError, TypeError) as e:
+            # non-integer version, non-float min, etc — client errors,
+            # not the 500 serve_json's blanket handler would report
+            return 400, {"error": f"bad request: {e}"}
 
     def _route(self, method: str, path: str,
                body: Dict[str, Any]) -> Tuple[int, Any]:

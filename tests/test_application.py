@@ -1,0 +1,203 @@
+"""Application aggregator + gc + scaffold tests.
+
+Reference roles: the application package's assembled status
+(``/root/reference/kubeflow/application/application.libsonnet:213-345``),
+the gc tool (``/root/reference/bootstrap/cmd/gc/main.go``), and the
+new-package-stub (``/root/reference/kubeflow/new-package-stub``).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from kubeflow_tpu.config.deployment import ComponentSpec, DeploymentConfig
+from kubeflow_tpu.k8s import FakeKubeClient
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.manifests.registry import PART_OF_LABEL, render_all, render_component
+from kubeflow_tpu.operators.application import (
+    API_VERSION,
+    APPLICATION_KIND,
+    ApplicationController,
+    application,
+)
+
+
+@pytest.fixture
+def client():
+    return FakeKubeClient()
+
+
+@pytest.fixture
+def ctrl(client):
+    return ApplicationController(client)
+
+
+def get_app(client, name="stack", ns="default"):
+    return client.get(API_VERSION, APPLICATION_KIND, ns, name)
+
+
+# -- aggregator ------------------------------------------------------------
+
+def test_aggregates_ready_components(client, ctrl):
+    sel = {PART_OF_LABEL: "stack"}
+    dep = o.deployment("web", "default", o.pod_spec([o.container("c", "i")]),
+                       labels={"app": "web", **sel})
+    dep["status"] = {"readyReplicas": 1}
+    client.create(dep)
+    client.create(o.service("web", "default", {"app": "web"},
+                            [{"port": 80}], labels=sel))
+    client.create(application("stack", "default", selector=sel))
+    ctrl.reconcile("default", "stack")
+    status = get_app(client)["status"]
+    assert status["phase"] == "Ready"
+    assert status["ready"] == "2/2"
+    kinds = {(c["kind"], c["ready"]) for c in status["components"]}
+    assert kinds == {("Deployment", True), ("Service", True)}
+
+
+def test_progressing_until_replicas_ready(client, ctrl):
+    sel = {PART_OF_LABEL: "stack"}
+    dep = o.deployment("web", "default", o.pod_spec([o.container("c", "i")]),
+                       replicas=3, labels={"app": "web", **sel})
+    dep["status"] = {"readyReplicas": 1}
+    client.create(dep)
+    client.create(application("stack", "default", selector=sel))
+    ctrl.reconcile("default", "stack")
+    status = get_app(client)["status"]
+    assert status["phase"] == "Progressing"
+    assert status["components"][0]["detail"] == "1/3 replicas"
+    # rollout completes → Ready
+    dep["status"] = {"readyReplicas": 3}
+    client.update_status(dep)
+    ctrl.reconcile("default", "stack")
+    assert get_app(client)["status"]["phase"] == "Ready"
+
+
+def test_selector_scopes_the_aggregation(client, ctrl):
+    sel = {PART_OF_LABEL: "stack"}
+    client.create(o.service("mine", "default", {"a": "b"}, [{"port": 1}],
+                            labels=sel))
+    client.create(o.service("other", "default", {"a": "b"}, [{"port": 1}],
+                            labels={PART_OF_LABEL: "other-stack"}))
+    client.create(application("stack", "default", selector=sel,
+                              component_kinds=["Service"]))
+    ctrl.reconcile("default", "stack")
+    names = [c["name"] for c in get_app(client)["status"]["components"]]
+    assert names == ["mine"]
+
+
+def test_unsupported_component_kind_rejected():
+    with pytest.raises(ValueError, match="unsupported"):
+        application("a", "ns", selector={}, component_kinds=["Node"])
+
+
+# -- part-of stamping ------------------------------------------------------
+
+def test_render_all_stamps_part_of_label():
+    cfg = DeploymentConfig(name="demo", platform="local",
+                           components=[ComponentSpec("tpujob-operator"),
+                                       ComponentSpec("serving")])
+    for obj in render_all(cfg):
+        assert obj["metadata"]["labels"][PART_OF_LABEL] == "demo", obj["kind"]
+
+
+def test_application_component_renders_own_cr():
+    cfg = DeploymentConfig(name="demo", platform="local",
+                           components=[ComponentSpec("application")])
+    objs = render_component(cfg, cfg.components[0])
+    kinds = [obj["kind"] for obj in objs]
+    assert kinds == ["CustomResourceDefinition", "ServiceAccount",
+                     "ClusterRole", "ClusterRoleBinding", "Deployment",
+                     "Application"]
+    cr = objs[-1]
+    assert cr["spec"]["selector"]["matchLabels"] == {PART_OF_LABEL: "demo"}
+    assert cr["spec"]["descriptor"]["components"] == ["application"]
+
+
+# -- ctl gc ----------------------------------------------------------------
+
+def run_ctl(*argv, cwd):
+    return subprocess.run(
+        [sys.executable, "-m", "kubeflow_tpu.cli", *argv],
+        capture_output=True, text=True, cwd=cwd,
+        env={**os.environ, "PYTHONPATH": "/root/repo"})
+
+
+def test_gc_prunes_stale_objects(tmp_path):
+    app = str(tmp_path / "app")
+    state = str(tmp_path / "state.json")
+    r = run_ctl("init", app, "--preset", "minimal", "--name", "demo",
+                cwd=str(tmp_path))
+    assert r.returncode == 0, r.stderr
+    assert run_ctl("generate", app, cwd=str(tmp_path)).returncode == 0
+    assert run_ctl("apply", app, "k8s", "--fake-state", state,
+                   cwd=str(tmp_path)).returncode == 0
+
+    # drop a component's worth of objects by planting a stale labeled one
+    from kubeflow_tpu.k8s.fakefile import FileBackedFakeClient
+
+    client = FileBackedFakeClient(state)
+    client.create(o.service("left-behind", "kubeflow-tpu", {"a": "b"},
+                            [{"port": 1}],
+                            labels={PART_OF_LABEL: "demo"}))
+    client.create(o.service("unrelated", "kubeflow-tpu", {"a": "b"},
+                            [{"port": 1}]))
+
+    r = run_ctl("gc", app, "--dry-run", "--fake-state", state,
+                cwd=str(tmp_path))
+    assert r.returncode == 0, r.stderr
+    assert "left-behind" in r.stdout and "1 stale" in r.stdout
+
+    r = run_ctl("gc", app, "--fake-state", state, cwd=str(tmp_path))
+    assert r.returncode == 0, r.stderr
+    assert "pruned 1 stale" in r.stdout
+
+    client = FileBackedFakeClient(state)
+    names = [s["metadata"]["name"]
+             for s in client.list("v1", "Service", "kubeflow-tpu")]
+    assert "left-behind" not in names
+    assert "unrelated" in names  # unlabeled objects are never touched
+
+
+# -- ctl scaffold ----------------------------------------------------------
+
+def test_scaffold_writes_working_component(tmp_path):
+    r = run_ctl("scaffold", "my-widget", "--out", str(tmp_path),
+                cwd=str(tmp_path))
+    assert r.returncode == 0, r.stderr
+    comp = tmp_path / "my_widget.py"
+    assert comp.exists() and (tmp_path / "test_my_widget.py").exists()
+    # the stub must import, register, and render out of the box
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("my_widget", str(comp))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    from kubeflow_tpu.manifests.registry import get_component, merge_params
+
+    c = get_component("my-widget")
+    cfg = DeploymentConfig(name="d", platform="local", components=[])
+    objs = c.render(cfg, merge_params(c, {}))
+    assert [obj["kind"] for obj in objs] == ["Deployment", "Service"]
+
+
+def test_scaffolded_test_passes_out_of_the_box(tmp_path):
+    """The generated golden test must run green as written."""
+    r = run_ctl("scaffold", "box-fresh", "--out", str(tmp_path),
+                cwd=str(tmp_path))
+    assert r.returncode == 0, r.stderr
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+         str(tmp_path / "test_box_fresh.py")],
+        capture_output=True, text=True, cwd=str(tmp_path),
+        env={**os.environ, "PYTHONPATH": f"/root/repo:{tmp_path}"})
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_scaffold_rejects_bad_names(tmp_path):
+    r = run_ctl("scaffold", "My_Widget", "--out", str(tmp_path),
+                cwd=str(tmp_path))
+    assert r.returncode != 0
+    assert "DNS-1123" in r.stderr

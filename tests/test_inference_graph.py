@@ -169,6 +169,36 @@ def test_combiner_vote_majority():
     assert out["predictions"] == [0, 1]  # 2/3 vote class 0, then class 1
 
 
+def test_nested_combiner_works_and_uses_threads():
+    """Combiner under combiner: the shared pool is skipped (it could
+    deadlock under concurrency); results and routes stay correct."""
+    caller, _ = calls_to({
+        "a": lambda p: {"predictions": [[2.0]]},
+        "b": lambda p: {"predictions": [[4.0]]},
+        "c": lambda p: {"predictions": [[6.0]]},
+    })
+    root = GraphNode.from_dict(node("outer", "combiner", children=[
+        node("inner", "combiner", children=[node("a"), node("b")]),
+        node("c")]))
+    ex = GraphExecutor(root, caller)
+    assert ex._pool is None  # nested shape: per-request threads
+    out = ex.predict({"instances": [[1]]})
+    assert out["predictions"] == [[4.5]]  # mean(mean(2,4)=3, 6)
+
+
+def test_nested_combiner_propagates_child_errors():
+    def boom(p):
+        raise GraphError("backend down")
+
+    caller, _ = calls_to({"a": boom, "b": lambda p: {"predictions": [[1.0]]},
+                          "c": lambda p: {"predictions": [[1.0]]}})
+    root = GraphNode.from_dict(node("outer", "combiner", children=[
+        node("inner", "combiner", children=[node("a"), node("b")]),
+        node("c")]))
+    with pytest.raises(GraphError, match="backend down"):
+        GraphExecutor(root, caller).predict({"instances": [[1]]})
+
+
 def test_combiner_mean_shape_mismatch_raises():
     caller, _ = calls_to({
         "a": lambda p: {"predictions": [[0.0, 1.0]]},

@@ -87,6 +87,37 @@ def test_model_name_with_path_chars_rejected(reg):
             reg.register(bad, 1)
 
 
+def test_stray_files_do_not_break_listing(reg):
+    reg.register("good", 1)
+    import os
+
+    with open(os.path.join(reg.root, "My Model.json"), "w") as f:
+        f.write("{}")
+    with open(os.path.join(reg.root, "notes.txt"), "w") as f:
+        f.write("hi")
+    assert [m["name"] for m in reg.models()] == ["good"]
+
+
+def test_rest_bad_numeric_input_is_400(svc):
+    svc.handle("POST", "/api/registry/models/m/versions", {"version": 1})
+    assert svc.handle("POST", "/api/registry/models/m/versions",
+                      {"version": "abc"})[0] == 400
+    assert svc.handle("POST",
+                      "/api/registry/models/m/versions/abc:transition",
+                      {"stage": "staging"})[0] == 400
+    assert svc.handle("GET", "/api/registry/search?metric=x&min=oops",
+                      None)[0] == 400
+
+
+def test_register_export_bad_name_writes_nothing(tmp_path, reg):
+    from kubeflow_tpu.serving.registry import register_export
+
+    with pytest.raises(RegistryError, match="invalid model name"):
+        register_export(reg, str(tmp_path / "my model"), "mnist", {},
+                        version=1)
+    assert not (tmp_path / "my model").exists()
+
+
 def test_invalid_stage_is_400_not_404(reg):
     reg.register("m", 1)
     from kubeflow_tpu.serving.registry import RegistryService
