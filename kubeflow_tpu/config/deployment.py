@@ -90,9 +90,18 @@ class DeploymentConfig:
         if not self.name or not self.name.replace("-", "").isalnum():
             raise ValueError(f"invalid deployment name {self.name!r}")
         if self.platform not in PLATFORMS:
-            raise ValueError(
-                f"unknown platform {self.platform!r}; choose from {PLATFORMS}"
-            )
+            # not a builtin: accept any platform the registry can resolve
+            # (out-of-tree modules loaded via KFTPU_PLATFORM_PLUGINS — the
+            # reference's .so plugin surface, group.go LoadKfApp). The
+            # membership check never instantiates the plugin, so plugin
+            # constructor errors cannot masquerade as "unknown platform".
+            from kubeflow_tpu.platform.base import platform_known
+
+            if not platform_known(self.platform):
+                raise ValueError(
+                    f"unknown platform {self.platform!r}; builtins: "
+                    f"{PLATFORMS} (or a KFTPU_PLATFORM_PLUGINS module)"
+                )
         seen = set()
         for comp in self.components:
             if comp.name in seen:

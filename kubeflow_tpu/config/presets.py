@@ -52,6 +52,7 @@ def _gcp_tpu(name: str) -> DeploymentConfig:
     """Full GCP deployment targeting TPU pod slices."""
     cfg = _standard(name)
     cfg.platform = "gcp-tpu"
+    cfg.components.append(ComponentSpec("credentials"))
     cfg.platform_params = {
         "project": "",
         "zone": "us-central2-b",

@@ -1,0 +1,54 @@
+"""Cloud-credentials PodDefault component.
+
+Parity with the reference's credentials-pod-preset package
+(``/root/reference/kubeflow/credentials-pod-preset/``): a PodPreset that
+mounts a service-account key Secret and points
+``GOOGLE_APPLICATION_CREDENTIALS`` at it for every pod opting in via a
+label. Here it rides the framework's PodDefault machinery
+(:mod:`kubeflow_tpu.tenancy.poddefault`) — the admission webhook the
+tenancy component deploys performs the injection.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from kubeflow_tpu.config.deployment import DeploymentConfig
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.manifests.registry import register
+
+DEFAULTS: Dict[str, Any] = {
+    "secret_name": "gcp-credentials",
+    "key_file": "key.json",
+    "mount_path": "/secret/gcp",
+    "label": "inject-gcp-credentials",
+}
+
+
+@register("credentials", DEFAULTS,
+          "GOOGLE_APPLICATION_CREDENTIALS PodDefault (credentials-pod-preset parity)")
+def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
+    from kubeflow_tpu.tenancy.poddefault import pod_default
+
+    from kubeflow_tpu.tenancy.profiles import SYNC_PODDEFAULTS_LABEL
+
+    ns = config.namespace
+    mount = params["mount_path"].rstrip("/")
+    pd = pod_default(
+        "gcp-credentials", ns,
+        {params["label"]: "true"},
+        desc="mount GCP service-account key + set "
+             "GOOGLE_APPLICATION_CREDENTIALS",
+        env={"GOOGLE_APPLICATION_CREDENTIALS":
+             f"{mount}/{params['key_file']}"},
+        volumes=[{"name": "gcp-credentials",
+                  "secret": {"secretName": params["secret_name"]}}],
+        volume_mounts=[{"name": "gcp-credentials",
+                        "mountPath": mount,
+                        "readOnly": True}],
+    )
+    # tenant pods live in per-profile namespaces; the profile controller
+    # copies sync-labeled PodDefaults there (the webhook only consults
+    # the pod's own namespace)
+    pd["metadata"]["labels"] = {SYNC_PODDEFAULTS_LABEL: "true"}
+    return [pd]

@@ -83,6 +83,9 @@ def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
         [o.container(
             name, params["image"],
             command=["python", "-m", "kubeflow_tpu.tenancy.profiles"],
+            # PodDefault sync sources ONLY from this namespace (tenant
+            # namespaces must never be sync sources)
+            env={"KFTPU_PLATFORM_NAMESPACE": ns},
         )],
         service_account_name=name,
     )

@@ -135,13 +135,15 @@ def blockwise_attention(q, k, v, *, causal: bool = True, block_k: int = 512,
 # ---------------------------------------------------------------------------
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
-                      causal: bool, seq_len: int):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                      scale: float, causal: bool, seq_len: int):
     """One (batch·head, q-block) program: stream KV blocks through VMEM.
 
     Refs arrive as (1, block_q, D) / (1, S, D) tiles for one fused
     batch-head; the f32 (m, l, acc) online-softmax state lives in
-    registers/VMEM locals.
+    registers/VMEM locals. Also emits the per-row logsumexp — the
+    backward kernels recompute probabilities from it without a second
+    online-softmax pass.
     """
     import jax.experimental.pallas as pl  # deferred: test envs without pallas
 
@@ -182,8 +184,16 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
         jnp.zeros((block_q, 1), jnp.float32),
         jnp.full((block_q, 1), NEG_INF, jnp.float32),
     )
-    o, l, _ = jax.lax.fori_loop(0, hi, body, init)
+    o, l, m = jax.lax.fori_loop(0, hi, body, init)
     o_ref[0] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    # (1, block_q, 1): the trailing singleton keeps the TPU block layout
+    # legal (last two dims must divide (8, 128) or equal the array's)
+    lse_ref[0] = m + jnp.log(jnp.maximum(l, 1e-30))
+
+
+def _fuse_heads(x):
+    B, S, H, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
 
 
 def _flash_fwd(q, k, v, *, causal: bool, block_q: int, block_k: int,
@@ -199,15 +209,13 @@ def _flash_fwd(q, k, v, *, causal: bool, block_q: int, block_k: int,
     scale = _scale(q, sm_scale)
 
     # fuse batch and heads into the grid's first axis; blocks over q second
-    qf = q.transpose(0, 2, 1, 3).reshape(B * H, S, D)
-    kf = k.transpose(0, 2, 1, 3).reshape(B * H, S, D)
-    vf = v.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    qf, kf, vf = _fuse_heads(q), _fuse_heads(k), _fuse_heads(v)
 
     kernel = functools.partial(
         _flash_fwd_kernel, block_k=block_k, scale=scale, causal=causal,
         seq_len=S,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, S // block_q),
         in_specs=[
@@ -218,12 +226,172 @@ def _flash_fwd(q, k, v, *, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0),
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, S, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(B, H, S, D).transpose(0, 2, 1, 3), lse
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_k: int, scale: float, causal: bool,
+                         seq_len: int):
+    """dQ for one (batch·head, q-block): stream KV blocks, recompute P
+    from the saved logsumexp, accumulate dS·K."""
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(1)
+    _, block_q, D = q_ref.shape
+    qs = q_ref[0].astype(jnp.float32) * scale  # pre-scaled, as in fwd
+    g = g_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]    # (block_q, 1)
+    delta = delta_ref[0]
+    q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+
+    n_kv = seq_len // block_k
+    hi = n_kv if not causal else (i * block_q + block_q + block_k - 1) // block_k
+
+    def body(j, acc):
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(qs, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            kv_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            s = jnp.where(kv_pos <= q_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(g, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return acc + jax.lax.dot_general(ds, kb, (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    acc = jax.lax.fori_loop(0, hi, body, jnp.zeros((block_q, D), jnp.float32))
+    dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, scale: float,
+                          causal: bool, seq_len: int):
+    """dK/dV for one (batch·head, kv-block): stream Q blocks at or after
+    it (causal skip), recompute P, accumulate Pᵀ·dO and dSᵀ·Q."""
+    import jax.experimental.pallas as pl
+
+    j = pl.program_id(1)
+    _, block_k, D = k_ref.shape
+    kb = k_ref[0].astype(jnp.float32)
+    vb = v_ref[0].astype(jnp.float32)
+    kv_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+
+    n_q = seq_len // block_q
+    lo = (j * block_k) // block_q if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        qs = q_ref[0, pl.ds(i * block_q, block_q), :].astype(
+            jnp.float32) * scale
+        g = g_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), :]    # (block_q, 1)
+        delta = delta_ref[0, pl.ds(i * block_q, block_q), :]
+        s = jax.lax.dot_general(qs, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0)
+            s = jnp.where(kv_pos <= q_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)  # (block_q, block_k)
+        dv = dv + jax.lax.dot_general(p, g, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(g, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        # dK = dSᵀ·(q·scale) — the scale chains through the pre-scaled q
+        dk = dk + jax.lax.dot_general(ds, qs, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    zero = jnp.zeros((block_k, D), jnp.float32)
+    dk, dv = jax.lax.fori_loop(lo, n_q, body, (zero, zero))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, g, *, causal: bool, block_q: int,
+               block_k: int, sm_scale: Optional[float], interpret: bool):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, S, H, D = q.shape
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    scale = _scale(q, sm_scale)
+
+    qf, kf, vf = _fuse_heads(q), _fuse_heads(k), _fuse_heads(v)
+    gf, of = _fuse_heads(g), _fuse_heads(o)
+    # delta_r = Σ_d dO·O — one cheap fused elementwise+reduce in XLA;
+    # trailing singleton for a legal TPU block layout (see lse)
+    delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    full = lambda b, i: (b, 0, 0)  # noqa: E731
+    blk_q = lambda b, i: (b, i, 0)  # noqa: E731
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_k=block_k, scale=scale,
+                          causal=causal, seq_len=S),
+        grid=(B * H, S // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), blk_q, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, D), full, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, D), full, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, D), blk_q, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), blk_q, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), blk_q, memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), blk_q,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
         interpret=interpret,
-    )(qf, kf, vf)
-    return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+    )(qf, kf, vf, gf, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q, scale=scale,
+                          causal=causal, seq_len=S),
+        grid=(B * H, S // block_k),
+        in_specs=[
+            pl.BlockSpec((1, S, D), full, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), blk_q, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), blk_q, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, D), full, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, 1), full, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, 1), full, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), blk_q, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), blk_q, memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, S, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, S, D), v.dtype),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse, delta)
+
+    unfuse = lambda x: x.reshape(B, H, S, D).transpose(0, 2, 1, 3)  # noqa: E731
+    return unfuse(dq), unfuse(dk), unfuse(dv)
+
+
+def _resolve_interpret(interpret: Optional[bool]) -> bool:
+    return (jax.default_backend() != "tpu") if interpret is None else interpret
 
 
 @functools.partial(
@@ -232,34 +400,34 @@ def _flash_fwd(q, k, v, *, causal: bool, block_q: int, block_k: int,
 def flash_attention(q, k, v, causal: bool = True, block_q: int = 256,
                     block_k: int = 256, sm_scale: Optional[float] = None,
                     interpret: Optional[bool] = None):
-    """Pallas flash attention (forward kernel, recompute VJP).
+    """Pallas flash attention: fwd AND bwd kernels (saved-LSE backward).
+
+    The backward is the standard flash split — a dQ kernel streaming KV
+    blocks and a dK/dV kernel streaming Q blocks — recomputing P from the
+    forward's saved logsumexp, so training never materializes (S, S) and
+    both passes run on the MXU from VMEM tiles.
 
     ``interpret=None`` auto-selects: compiled on TPU, interpreter elsewhere
-    (so CPU tests execute the real kernel).
+    (so CPU tests execute the real kernels).
     """
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    return _flash_fwd(q, k, v, causal=causal, block_q=block_q,
-                      block_k=block_k, sm_scale=sm_scale, interpret=interpret)
+    out, _ = _flash_fwd(q, k, v, causal=causal, block_q=block_q,
+                        block_k=block_k, sm_scale=sm_scale,
+                        interpret=_resolve_interpret(interpret))
+    return out
 
 
 def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, sm_scale, interpret):
-    out = flash_attention(q, k, v, causal, block_q, block_k, sm_scale,
-                          interpret)
-    return out, (q, k, v)
+    out, lse = _flash_fwd(q, k, v, causal=causal, block_q=block_q,
+                          block_k=block_k, sm_scale=sm_scale,
+                          interpret=_resolve_interpret(interpret))
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(causal, block_q, block_k, sm_scale, interpret, res, g):
-    q, k, v = res
-    # flash-style backward = recompute through the blockwise formulation;
-    # same O(S·block) memory, and XLA fuses the recompute into the bwd dots
-    _, vjp = jax.vjp(
-        lambda q, k, v: blockwise_attention(
-            q, k, v, causal=causal, block_k=block_k, sm_scale=sm_scale
-        ),
-        q, k, v,
-    )
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_bwd(q, k, v, out, lse, g, causal=causal, block_q=block_q,
+                      block_k=block_k, sm_scale=sm_scale,
+                      interpret=_resolve_interpret(interpret))
 
 
 flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

@@ -51,11 +51,51 @@ def register_platform(name: str):
     return wrap
 
 
-def get_platform(name: str) -> Platform:
+def load_platform_plugins(env: Optional[Dict[str, str]] = None) -> List[str]:
+    """Import out-of-tree platform modules named in KFTPU_PLATFORM_PLUGINS.
+
+    The reference loads platform plugins as Go ``.so`` files
+    (``LoadKfApp``, ``/root/reference/bootstrap/pkg/apis/apps/
+    group.go:43-125``); the Python equivalent is an import hook: each
+    comma-separated module is imported so its ``@register_platform``
+    decorators run. Returns the modules imported.
+    """
+    import importlib
+    import os
+
+    raw = (env if env is not None else os.environ).get(
+        "KFTPU_PLATFORM_PLUGINS", "")
+    loaded = []
+    for mod in filter(None, (m.strip() for m in raw.split(","))):
+        importlib.import_module(mod)
+        loaded.append(mod)
+    return loaded
+
+
+def platform_known(name: str) -> bool:
+    """Membership check WITHOUT instantiating (config validation must
+    not run a plugin's constructor, and must not mask its errors).
+
+    A broken KFTPU_PLATFORM_PLUGINS module surfaces as ValueError so
+    every caller that treats validation failures uniformly (CLI,
+    bootstrap server) reports it as a config error, not a traceback.
+    """
     # import built-ins so their register_platform calls run
     from kubeflow_tpu.platform import gcp, local  # noqa: F401
 
-    if name not in _PLATFORMS:
+    if name in _PLATFORMS:
+        return True
+    try:
+        load_platform_plugins()
+    except Exception as e:  # noqa: BLE001 — a plugin body can raise anything
+        raise ValueError(
+            f"KFTPU_PLATFORM_PLUGINS failed to import: "
+            f"{type(e).__name__}: {e}") from e
+    return name in _PLATFORMS
+
+
+def get_platform(name: str) -> Platform:
+    if not platform_known(name):
         known = ", ".join(sorted(_PLATFORMS))
         raise ValueError(f"unknown platform {name!r}; known: {known}")
     return _PLATFORMS[name]()

@@ -85,6 +85,10 @@ class PredictionServicer:
         if arr.ndim == 0 or arr.shape[0] > self.max_batch_size:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                           f"batch must be in [1, {self.max_batch_size}]")
+        if np.issubdtype(arr.dtype, np.integer):
+            # image clients send uint8 pixels (4× less wire/transfer than
+            # f32 — TF-Serving's image convention); models take floats
+            arr = arr.astype(np.float32)
         padded, n = _pad_batch(arr, self.max_batch_size)
         try:
             out = np.asarray(model.predict(jnp.asarray(padded)))[:n]
@@ -129,11 +133,22 @@ def _handlers(servicer: PredictionServicer) -> grpc.GenericRpcHandler:
     return grpc.method_handlers_generic_handler(SERVICE_NAME, method_handlers)
 
 
+# a batch-8 224×224×3 fp32 tensor is ~4.8 MB — over gRPC's 4 MB default;
+# TF-Serving raises both directions the same way for image workloads
+MAX_MESSAGE_BYTES = 64 * 1024 * 1024
+
+_CHANNEL_OPTIONS = [
+    ("grpc.max_send_message_length", MAX_MESSAGE_BYTES),
+    ("grpc.max_receive_message_length", MAX_MESSAGE_BYTES),
+]
+
+
 def serve_grpc(repo: ModelRepository, port: int = 9000, *,
                max_batch_size: int = 8,
                max_workers: int = 8) -> Tuple[grpc.Server, int]:
     """Start the gRPC server on a daemon thread pool; returns (server, port)."""
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers),
+                         options=_CHANNEL_OPTIONS)
     server.add_generic_rpc_handlers(
         (_handlers(PredictionServicer(repo, max_batch_size=max_batch_size)),))
     bound = server.add_insecure_port(f"0.0.0.0:{port}")
@@ -146,7 +161,8 @@ class PredictClient:
     """Thin typed client over a grpc channel (no generated stubs needed)."""
 
     def __init__(self, target: str) -> None:
-        self.channel = grpc.insecure_channel(target)
+        self.channel = grpc.insecure_channel(target,
+                                             options=_CHANNEL_OPTIONS)
         base = f"/{SERVICE_NAME}/"
         self._predict = self.channel.unary_unary(
             base + "Predict",

@@ -27,6 +27,9 @@ PROFILE_KIND = "Profile"
 PROFILE_PLURAL = "profiles"
 
 PROFILE_NS_LABEL = "kubeflow-tpu.org/profile"
+# PodDefaults carrying this label are copied into every profile namespace
+# (the webhook only consults the pod's own namespace)
+SYNC_PODDEFAULTS_LABEL = "kubeflow-tpu.org/sync-to-profiles"
 EDITOR_SA = "default-editor"
 VIEWER_SA = "default-viewer"
 OWNER_BINDING = "namespace-owner"
@@ -121,10 +124,17 @@ def build_rbac(prof: o.Obj) -> List[o.Obj]:
 
 
 class ProfileController:
-    """Reconciles cluster-scoped Profile CRs into tenant namespaces."""
+    """Reconciles cluster-scoped Profile CRs into tenant namespaces.
 
-    def __init__(self, client: KubeClient) -> None:
+    ``platform_namespace`` is the ONLY namespace PodDefault sync sources
+    from — sourcing cluster-wide would let any tenant label a PodDefault
+    and have it injected into every other tenant's pods.
+    """
+
+    def __init__(self, client: KubeClient, *,
+                 platform_namespace: str = "kubeflow") -> None:
         self.client = client
+        self.platform_namespace = platform_namespace
 
     def reconcile(self, _ns: str, name: str) -> Optional[float]:
         prof = self.client.get_or_none(PROFILE_API_VERSION, PROFILE_KIND,
@@ -158,9 +168,45 @@ class ProfileController:
                     raise
         for obj in build_rbac(prof):
             self._apply(obj)
+        self._sync_pod_defaults(name)
 
         self._set_status(prof, {"phase": "Ready"})
         return None
+
+    def _sync_pod_defaults(self, ns: str) -> None:
+        """Replicate platform PodDefaults into the tenant namespace.
+
+        The admission webhook only consults PodDefaults in the pod's own
+        namespace (reference behavior, ``filterPodDefaults``), so a
+        platform-wide default — e.g. the credentials component's
+        GOOGLE_APPLICATION_CREDENTIALS preset — must exist in every
+        profile namespace. Sources are PodDefaults labeled
+        ``kubeflow-tpu.org/sync-to-profiles: "true"`` IN THE PLATFORM
+        NAMESPACE only (a tenant must not be able to label one and have
+        it injected into other tenants); clones drop the sync label so
+        they are never mistaken for sources.
+        """
+        import copy as _copy
+
+        from kubeflow_tpu.tenancy.poddefault import (
+            PODDEFAULT_API_VERSION,
+            PODDEFAULT_KIND,
+        )
+
+        for pd in self.client.list(
+                PODDEFAULT_API_VERSION, PODDEFAULT_KIND,
+                self.platform_namespace,
+                label_selector={SYNC_PODDEFAULTS_LABEL: "true"}):
+            labels = {k: v
+                      for k, v in (pd["metadata"].get("labels", {}) or {}).items()
+                      if k != SYNC_PODDEFAULTS_LABEL}
+            clone = _copy.deepcopy(pd)
+            clone["metadata"] = {
+                "name": pd["metadata"]["name"],
+                "namespace": ns,
+                "labels": labels,
+            }
+            self._apply(clone)
 
     def _set_status(self, prof: o.Obj, status: Dict[str, Any]) -> None:
         if prof.get("status") == status:
@@ -187,7 +233,13 @@ def main() -> None:
     from kubeflow_tpu.k8s.client import HttpKubeClient
 
     logging.basicConfig(level=logging.INFO)
-    ProfileController(HttpKubeClient()).build_controller().run_forever()
+    import os
+
+    ProfileController(
+        HttpKubeClient(),
+        platform_namespace=os.environ.get("KFTPU_PLATFORM_NAMESPACE",
+                                          "kubeflow"),
+    ).build_controller().run_forever()
 
 
 if __name__ == "__main__":

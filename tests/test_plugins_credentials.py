@@ -1,0 +1,218 @@
+"""Platform plugin loading + credentials PodDefault tests.
+
+Reference roles: the .so platform plugin loader (``LoadKfApp``,
+``/root/reference/bootstrap/pkg/apis/apps/group.go:43-125``) and the
+credentials-pod-preset package
+(``/root/reference/kubeflow/credentials-pod-preset/``).
+"""
+
+import os
+import sys
+import textwrap
+
+import pytest
+
+from kubeflow_tpu.config.deployment import ComponentSpec, DeploymentConfig
+from kubeflow_tpu.manifests.registry import render_component
+from kubeflow_tpu.platform.base import get_platform, load_platform_plugins
+
+
+def test_platform_plugin_loaded_from_env(tmp_path, monkeypatch):
+    (tmp_path / "acme_platform.py").write_text(textwrap.dedent("""
+        from kubeflow_tpu.platform.base import Platform, register_platform
+
+        @register_platform("acme-cloud")
+        class AcmePlatform(Platform):
+            name = "acme-cloud"
+            def generate(self, config, app_dir):
+                return []
+            def apply(self, config, app_dir, *, dry_run=True):
+                return {"dry_run": dry_run, "provider": "acme"}
+            def delete(self, config, app_dir, *, dry_run=True):
+                return {"dry_run": dry_run}
+    """))
+    monkeypatch.syspath_prepend(str(tmp_path))
+    monkeypatch.setenv("KFTPU_PLATFORM_PLUGINS", "acme_platform")
+    platform = get_platform("acme-cloud")
+    cfg = DeploymentConfig(name="d", platform="acme-cloud", components=[])
+    assert platform.apply(cfg, ".")["provider"] == "acme"
+
+
+def test_plugin_env_lists_modules(tmp_path, monkeypatch):
+    (tmp_path / "noop_plugin.py").write_text("LOADED = True\n")
+    monkeypatch.syspath_prepend(str(tmp_path))
+    loaded = load_platform_plugins({"KFTPU_PLATFORM_PLUGINS":
+                                    "noop_plugin, ,"})
+    assert loaded == ["noop_plugin"]
+
+
+def test_config_validate_accepts_plugin_platform(tmp_path, monkeypatch):
+    """DeploymentConfig.validate must consult the plugin registry, not
+    just the builtin tuple — otherwise `ctl generate` rejects any app
+    using an out-of-tree platform."""
+    (tmp_path / "zeta_platform.py").write_text(textwrap.dedent("""
+        from kubeflow_tpu.platform.base import Platform, register_platform
+
+        @register_platform("zeta-cloud")
+        class ZetaPlatform(Platform):
+            name = "zeta-cloud"
+            def generate(self, config, app_dir):
+                return []
+            def apply(self, config, app_dir, *, dry_run=True):
+                return {"dry_run": dry_run}
+            def delete(self, config, app_dir, *, dry_run=True):
+                return {"dry_run": dry_run}
+    """))
+    monkeypatch.syspath_prepend(str(tmp_path))
+    monkeypatch.setenv("KFTPU_PLATFORM_PLUGINS", "zeta_platform")
+    DeploymentConfig(name="d", platform="zeta-cloud",
+                     components=[]).validate()
+
+
+def test_unknown_platform_still_errors(monkeypatch):
+    monkeypatch.delenv("KFTPU_PLATFORM_PLUGINS", raising=False)
+    with pytest.raises(ValueError, match="unknown platform"):
+        get_platform("nope-cloud")
+
+
+def test_bad_plugin_module_raises(monkeypatch):
+    monkeypatch.setenv("KFTPU_PLATFORM_PLUGINS", "definitely_not_a_module")
+    with pytest.raises(ModuleNotFoundError):
+        load_platform_plugins()
+
+
+# -- credentials component -------------------------------------------------
+
+def test_credentials_pod_default_golden():
+    cfg = DeploymentConfig(name="d", platform="local",
+                           components=[ComponentSpec("credentials")])
+    objs = render_component(cfg, cfg.components[0])
+    assert len(objs) == 1
+    pd = objs[0]
+    assert pd["kind"] == "PodDefault"
+    spec = pd["spec"]
+    assert spec["selector"]["matchLabels"] == {"inject-gcp-credentials": "true"}
+    env = {e["name"]: e["value"] for e in spec["env"]}
+    assert env["GOOGLE_APPLICATION_CREDENTIALS"] == "/secret/gcp/key.json"
+    assert spec["volumes"][0]["secret"]["secretName"] == "gcp-credentials"
+    assert spec["volumeMounts"][0]["readOnly"] is True
+
+
+def test_credentials_reach_tenant_pods_via_profile_sync():
+    """End-to-end across namespaces: the component renders the PodDefault
+    into the platform namespace; the profile controller copies it into
+    the tenant namespace (the webhook only consults the pod's own
+    namespace); the webhook pipeline then injects it into a tenant pod."""
+    from kubeflow_tpu.k8s import FakeKubeClient
+    from kubeflow_tpu.k8s import objects as o
+    from kubeflow_tpu.tenancy.poddefault import mutate_pod
+    from kubeflow_tpu.tenancy.profiles import ProfileController, profile
+
+    cfg = DeploymentConfig(name="d", platform="local",
+                           components=[ComponentSpec("credentials")])
+    client = FakeKubeClient()
+    client.create(render_component(cfg, cfg.components[0])[0])  # ns kubeflow
+
+    client.create(profile("alice-ns", "alice"))
+    ProfileController(client).reconcile("", "alice-ns")
+
+    pod = o.pod("train", "alice-ns",
+                o.pod_spec([o.container("c", "img")]),
+                labels={"inject-gcp-credentials": "true"})
+    mutated, msg = mutate_pod(client, pod)
+    assert msg == ""
+    ctr = mutated["spec"]["containers"][0]
+    env = {e["name"]: e["value"] for e in ctr["env"]}
+    assert env["GOOGLE_APPLICATION_CREDENTIALS"] == "/secret/gcp/key.json"
+    assert ctr["volumeMounts"][0]["mountPath"] == "/secret/gcp"
+
+
+def test_validate_reports_broken_plugin_env_as_value_error(monkeypatch):
+    monkeypatch.setenv("KFTPU_PLATFORM_PLUGINS", "definitely_not_a_module")
+    with pytest.raises(ValueError, match="failed to import"):
+        DeploymentConfig(name="d", platform="mystery-cloud",
+                         components=[]).validate()
+
+
+def test_plugin_body_errors_become_value_errors(tmp_path, monkeypatch):
+    """Any import-time failure (not just ImportError) must surface as a
+    config ValueError — callers treat validation failures uniformly."""
+    (tmp_path / "explode_plugin.py").write_text(
+        'raise RuntimeError("boom at import")\n')
+    monkeypatch.syspath_prepend(str(tmp_path))
+    monkeypatch.setenv("KFTPU_PLATFORM_PLUGINS", "explode_plugin")
+    with pytest.raises(ValueError, match="RuntimeError: boom"):
+        DeploymentConfig(name="d", platform="mystery-cloud",
+                         components=[]).validate()
+
+
+def test_tenant_pod_defaults_are_never_sync_sources():
+    """A tenant labeling a PodDefault in their own namespace must NOT get
+    it replicated into other tenants' namespaces (cross-tenant injection),
+    and clones drop the sync label so they never become sources."""
+    from kubeflow_tpu.k8s import FakeKubeClient
+    from kubeflow_tpu.tenancy.poddefault import pod_default
+    from kubeflow_tpu.tenancy.profiles import (
+        SYNC_PODDEFAULTS_LABEL,
+        ProfileController,
+        profile,
+    )
+
+    client = FakeKubeClient()
+    evil = pod_default("evil", "bob-ns", {"x": "y"},
+                       env={"X": "pwned"})
+    evil["metadata"]["labels"] = {SYNC_PODDEFAULTS_LABEL: "true"}
+    client.create(evil)
+
+    good = pod_default("gcp-credentials", "kubeflow", {"a": "b"},
+                       env={"OK": "1"})
+    good["metadata"]["labels"] = {SYNC_PODDEFAULTS_LABEL: "true"}
+    client.create(good)
+
+    ctrl = ProfileController(client, platform_namespace="kubeflow")
+    client.create(profile("alice-ns", "alice"))
+    ctrl.reconcile("", "alice-ns")
+
+    names = [pd["metadata"]["name"] for pd in client.list(
+        "kubeflow-tpu.org/v1alpha1", "PodDefault", "alice-ns")]
+    assert names == ["gcp-credentials"]
+    clone = client.get("kubeflow-tpu.org/v1alpha1", "PodDefault",
+                       "alice-ns", "gcp-credentials")
+    assert SYNC_PODDEFAULTS_LABEL not in (
+        clone["metadata"].get("labels") or {})
+
+
+def test_updated_platform_pod_default_propagates():
+    """Re-reconciling after the platform edits the source must propagate
+    the new spec (no stale-clone overwrite)."""
+    from kubeflow_tpu.k8s import FakeKubeClient
+    from kubeflow_tpu.tenancy.poddefault import pod_default
+    from kubeflow_tpu.tenancy.profiles import (
+        SYNC_PODDEFAULTS_LABEL,
+        ProfileController,
+        profile,
+    )
+
+    client = FakeKubeClient()
+    src = pod_default("creds", "kubeflow", {"a": "b"}, env={"P": "old"})
+    src["metadata"]["labels"] = {SYNC_PODDEFAULTS_LABEL: "true"}
+    client.create(src)
+    ctrl = ProfileController(client, platform_namespace="kubeflow")
+    client.create(profile("alice-ns", "alice"))
+    ctrl.reconcile("", "alice-ns")
+
+    src = client.get("kubeflow-tpu.org/v1alpha1", "PodDefault",
+                     "kubeflow", "creds")
+    src["spec"]["env"] = [{"name": "P", "value": "new"}]
+    client.update(src)
+    ctrl.reconcile("", "alice-ns")
+    clone = client.get("kubeflow-tpu.org/v1alpha1", "PodDefault",
+                       "alice-ns", "creds")
+    assert clone["spec"]["env"] == [{"name": "P", "value": "new"}]
+
+
+def test_gcp_preset_includes_credentials():
+    from kubeflow_tpu.config.presets import preset
+
+    cfg = preset("gcp-tpu", "demo")
+    assert "credentials" in [c.name for c in cfg.components]
