@@ -173,6 +173,10 @@ class DashboardApi:
                 return 200, self.namespaces()
             if path.startswith("/api/activities/"):
                 ns = path.rsplit("/", 1)[1]
+                if not ns:
+                    # empty ns = cluster-wide list at the client layer —
+                    # a cross-tenant leak; reject before authz
+                    return 404, {"error": f"no route {path}"}
                 # k8s Events carry workload names/failure messages —
                 # namespace-scoped tenant data, same guard as studies/runs
                 self._authz(user, ns, "events")
@@ -185,6 +189,8 @@ class DashboardApi:
                 return 200, self.dashboard_links()
             if path.startswith("/api/tpujobs/"):
                 parts = path[len("/api/tpujobs/"):].split("/")
+                if not parts[0]:
+                    return 404, {"error": f"no route {path}"}
                 self._authz(user, parts[0], "tpujobs")
                 if len(parts) == 1:
                     return 200, self.tpujobs(parts[0])
@@ -192,6 +198,8 @@ class DashboardApi:
                     return self.tpujob_detail(parts[0], parts[1])
             if path.startswith("/api/studies/"):
                 parts = path[len("/api/studies/"):].split("/")
+                if not parts[0]:
+                    return 404, {"error": f"no route {path}"}
                 self._authz(user, parts[0], "studies")
                 if len(parts) == 1:
                     return 200, self.studies(parts[0])
@@ -199,11 +207,21 @@ class DashboardApi:
                     return self.study_detail(parts[0], parts[1])
             if path.startswith("/api/runs/"):
                 parts = path[len("/api/runs/"):].split("/")
+                if not parts[0]:
+                    return 404, {"error": f"no route {path}"}
                 self._authz(user, parts[0], "workflows")
                 if len(parts) == 1:
                     return 200, self.runs(parts[0])
                 if len(parts) == 2:
                     return self.run_detail(parts[0], parts[1])
+            if path.startswith("/api/applications/"):
+                parts = path[len("/api/applications/"):].split("/")
+                # empty ns would become a CLUSTER-WIDE list at the client
+                # layer — a cross-tenant leak; reject before authz
+                if len(parts) != 1 or not parts[0]:
+                    return 404, {"error": f"no route {path}"}
+                self._authz(user, parts[0], "applications")
+                return 200, self.applications(parts[0])
             return 404, {"error": f"no route {path}"}
         except ApiError as e:
             return e.code, {"error": e.message}
@@ -433,6 +451,28 @@ class DashboardApi:
         return 200, {"name": name, "live": True,
                      "spec": wf.get("spec", {}),
                      "status": wf.get("status", {})}
+
+    def applications(self, ns: str) -> List[Dict[str, Any]]:
+        """Aggregated platform health: the Application CRs' status (the
+        one-look 'is the stack healthy' panel; reference concept:
+        ``/root/reference/kubeflow/application/application.libsonnet``)."""
+        from kubeflow_tpu.operators.application import (
+            API_VERSION as APP_API,
+            APPLICATION_KIND,
+        )
+
+        out = []
+        for app in self.client.list(APP_API, APPLICATION_KIND, ns):
+            status = app.get("status", {}) or {}
+            failing = [c for c in status.get("components", [])
+                       if not c.get("ready")]
+            out.append({
+                "name": app["metadata"]["name"],
+                "phase": status.get("phase", "Unknown"),
+                "ready": status.get("ready", "—"),
+                "failing": [f"{c['kind']}/{c['name']}" for c in failing[:8]],
+            })
+        return out
 
     def dashboard_links(self) -> List[Dict[str, str]]:
         """The iframe cards the UI shell embeds (iframe-link.js parity)."""

@@ -50,6 +50,22 @@ async function loadActivities(ns) {
     : `<tr><td colspan="5">no recent events in ${esc(ns)}</td></tr>`;
 }
 
+async function loadApplications(ns) {
+  $("health-ns").textContent = ns || "—";
+  if (!ns) { $("applications").innerHTML = ""; return; }
+  const apps = await api("/api/applications/" + encodeURIComponent(ns));
+  $("applications").innerHTML = apps.length
+    ? apps.map((a) => `
+      <tr>
+        <td>${esc(a.name)}</td>
+        <td><span class="pill ${a.phase === "Ready" ? "Normal" : "Warning"}">
+            ${esc(a.phase)}</span></td>
+        <td>${esc(a.ready)}</td>
+        <td>${a.failing.length ? esc(a.failing.join(", ")) : "—"}</td>
+      </tr>`).join("")
+    : `<tr><td colspan="4">no Application CRs in ${esc(ns)}</td></tr>`;
+}
+
 async function loadMetrics() {
   const metrics = await api("/api/metrics/cluster");
   $("metrics").innerHTML = metrics.length
@@ -71,12 +87,15 @@ async function main() {
   try {
     await loadCards();
     const ns = await loadEnv();
-    await Promise.all([loadActivities(ns), loadMetrics(), loadWorkgroup()]);
+    await Promise.all([loadActivities(ns), loadApplications(ns),
+                       loadMetrics(), loadWorkgroup()]);
     $("ns-select").addEventListener("change", (e) => {
       localStorage.setItem("kftpu-ns", e.target.value);
       loadActivities(e.target.value).catch((err) => showError(err.message));
+      loadApplications(e.target.value).catch((err) => showError(err.message));
     });
     setInterval(() => {
+      loadApplications($("ns-select").value).catch(() => {});
       loadActivities($("ns-select").value).catch(() => {});
       loadMetrics().catch(() => {});
     }, 15000);

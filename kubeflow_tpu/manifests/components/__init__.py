@@ -6,6 +6,7 @@ from kubeflow_tpu.manifests.components import (  # noqa: F401
     credentials,
     dashboard,
     dataprep,
+    echo,
     gateway,
     inferencegraph,
     modelregistry,

@@ -1,0 +1,43 @@
+"""Echo server component — the route-debugging tool.
+
+Parity with the reference's echo-server (``/root/reference/kubeflow/
+common/echo-server.libsonnet``): a trivial Deployment + Service that
+reflects request details, used to verify gateway/edge routing before
+pointing it at real services. The container runs the framework's own
+echo module (no external image needed).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from kubeflow_tpu.config.deployment import DeploymentConfig
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.manifests.registry import register
+
+DEFAULTS: Dict[str, Any] = {
+    "name": "echo-server",
+    "image": "kubeflow-tpu/platform:v1alpha1",
+    "port": 8080,
+    "replicas": 1,
+}
+
+
+@register("echo-server", DEFAULTS,
+          "request-echo service for route debugging (echo-server parity)")
+def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
+    ns = config.namespace
+    name = params["name"]
+    pod = o.pod_spec([o.container(
+        name, params["image"],
+        command=["python", "-m", "kubeflow_tpu.utils.echo"],
+        env={"KFTPU_ECHO_PORT": str(params["port"])},
+        ports=[params["port"]],
+    )])
+    return [
+        o.deployment(name, ns, pod, replicas=params["replicas"]),
+        o.service(name, ns, {"app": name},
+                  [{"name": "http", "port": params["port"],
+                    "targetPort": params["port"]}],
+                  labels={"app": name}),
+    ]

@@ -30,6 +30,8 @@ PROFILE_NS_LABEL = "kubeflow-tpu.org/profile"
 # PodDefaults carrying this label are copied into every profile namespace
 # (the webhook only consults the pod's own namespace)
 SYNC_PODDEFAULTS_LABEL = "kubeflow-tpu.org/sync-to-profiles"
+# stamped on the clones so sync can prune ones whose source disappeared
+SYNCED_PODDEFAULT_LABEL = "kubeflow-tpu.org/synced-poddefault"
 EDITOR_SA = "default-editor"
 VIEWER_SA = "default-viewer"
 OWNER_BINDING = "namespace-owner"
@@ -183,23 +185,30 @@ class ProfileController:
         profile namespace. Sources are PodDefaults labeled
         ``kubeflow-tpu.org/sync-to-profiles: "true"`` IN THE PLATFORM
         NAMESPACE only (a tenant must not be able to label one and have
-        it injected into other tenants); clones drop the sync label so
-        they are never mistaken for sources.
+        it injected into other tenants). Clones drop the sync label (so
+        they are never mistaken for sources) and the part-of label (so
+        ``ctl gc`` never prunes them as stale manifest objects), carry a
+        managed-by marker instead, and clones whose source disappeared
+        are deleted — removing the credentials component actually
+        revokes the injection.
         """
         import copy as _copy
 
+        from kubeflow_tpu.manifests.registry import PART_OF_LABEL
         from kubeflow_tpu.tenancy.poddefault import (
             PODDEFAULT_API_VERSION,
             PODDEFAULT_KIND,
         )
 
-        for pd in self.client.list(
-                PODDEFAULT_API_VERSION, PODDEFAULT_KIND,
-                self.platform_namespace,
-                label_selector={SYNC_PODDEFAULTS_LABEL: "true"}):
+        sources = self.client.list(
+            PODDEFAULT_API_VERSION, PODDEFAULT_KIND,
+            self.platform_namespace,
+            label_selector={SYNC_PODDEFAULTS_LABEL: "true"})
+        for pd in sources:
             labels = {k: v
                       for k, v in (pd["metadata"].get("labels", {}) or {}).items()
-                      if k != SYNC_PODDEFAULTS_LABEL}
+                      if k not in (SYNC_PODDEFAULTS_LABEL, PART_OF_LABEL)}
+            labels[SYNCED_PODDEFAULT_LABEL] = "true"
             clone = _copy.deepcopy(pd)
             clone["metadata"] = {
                 "name": pd["metadata"]["name"],
@@ -207,6 +216,18 @@ class ProfileController:
                 "labels": labels,
             }
             self._apply(clone)
+        want = {pd["metadata"]["name"] for pd in sources}
+        for clone in self.client.list(
+                PODDEFAULT_API_VERSION, PODDEFAULT_KIND, ns,
+                label_selector={SYNCED_PODDEFAULT_LABEL: "true"}):
+            if clone["metadata"]["name"] not in want:
+                try:
+                    self.client.delete(PODDEFAULT_API_VERSION,
+                                       PODDEFAULT_KIND, ns,
+                                       clone["metadata"]["name"])
+                except ApiError as e:
+                    if e.code != 404:
+                        raise
 
     def _set_status(self, prof: o.Obj, status: Dict[str, Any]) -> None:
         if prof.get("status") == status:

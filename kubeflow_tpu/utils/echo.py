@@ -1,0 +1,38 @@
+"""Request-echo service: reflects method/path/headers/body as JSON.
+
+The in-container side of the ``echo-server`` component (reference:
+``/root/reference/kubeflow/common/echo-server.libsonnet`` runs an
+external echo image; here the framework serves its own). Point an edge
+route or Istio VirtualService at it to see exactly what a backend
+receives — prefix stripping, auth headers, websocket upgrade attempts.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+from kubeflow_tpu.utils.jsonhttp import serve_json
+
+
+class EchoService:
+    def handle(self, method: str, path: str, body: Optional[Dict[str, Any]],
+               user: str, headers: Dict[str, str]) -> Tuple[int, Any]:
+        if path == "/healthz":
+            return 200, {"ok": True}
+        return 200, {
+            "method": method,
+            "path": path,
+            "user": user or None,
+            "headers": dict(headers),
+            "body": body,
+        }
+
+
+def main() -> None:  # pragma: no cover - container entrypoint
+    serve_json(EchoService().handle,
+               int(os.environ.get("KFTPU_ECHO_PORT", "8080")))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

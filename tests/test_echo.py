@@ -1,0 +1,38 @@
+"""Echo server tests (kubeflow/common echo-server parity)."""
+
+import json
+import urllib.request
+
+from kubeflow_tpu.config.deployment import ComponentSpec, DeploymentConfig
+from kubeflow_tpu.manifests.registry import render_component
+from kubeflow_tpu.utils.echo import EchoService
+from kubeflow_tpu.utils.jsonhttp import serve_json
+
+
+def test_echo_reflects_request_over_live_socket():
+    httpd = serve_json(EchoService().handle, 0, background=True)
+    try:
+        port = httpd.server_address[1]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/some/route?x=1",
+            data=json.dumps({"hello": "world"}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Kubeflow-Userid": "alice"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            out = json.load(resp)
+        assert out["method"] == "POST"
+        assert out["path"] == "/some/route?x=1"
+        assert out["body"] == {"hello": "world"}
+        assert out["user"] == "alice"
+        assert "X-Kubeflow-Userid" in out["headers"]
+    finally:
+        httpd.shutdown()
+
+
+def test_echo_component_golden():
+    cfg = DeploymentConfig(name="d", platform="local",
+                           components=[ComponentSpec("echo-server")])
+    objs = render_component(cfg, cfg.components[0])
+    assert [o["kind"] for o in objs] == ["Deployment", "Service"]
+    cmd = objs[0]["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert cmd == ["python", "-m", "kubeflow_tpu.utils.echo"]

@@ -182,6 +182,56 @@ def test_tenant_pod_defaults_are_never_sync_sources():
         clone["metadata"].get("labels") or {})
 
 
+def test_removed_source_prunes_tenant_clones():
+    """Deleting (or un-labeling) the platform source must revoke the
+    injection: tenant clones are pruned on the next reconcile."""
+    from kubeflow_tpu.k8s import FakeKubeClient
+    from kubeflow_tpu.tenancy.poddefault import pod_default
+    from kubeflow_tpu.tenancy.profiles import (
+        SYNC_PODDEFAULTS_LABEL,
+        ProfileController,
+        profile,
+    )
+
+    client = FakeKubeClient()
+    src = pod_default("creds", "kubeflow", {"a": "b"}, env={"P": "1"})
+    src["metadata"]["labels"] = {SYNC_PODDEFAULTS_LABEL: "true"}
+    client.create(src)
+    ctrl = ProfileController(client, platform_namespace="kubeflow")
+    client.create(profile("alice-ns", "alice"))
+    ctrl.reconcile("", "alice-ns")
+    assert client.list("kubeflow-tpu.org/v1alpha1", "PodDefault", "alice-ns")
+
+    client.delete("kubeflow-tpu.org/v1alpha1", "PodDefault", "kubeflow",
+                  "creds")
+    ctrl.reconcile("", "alice-ns")
+    assert client.list("kubeflow-tpu.org/v1alpha1", "PodDefault",
+                       "alice-ns") == []
+
+
+def test_clones_do_not_carry_part_of_label():
+    """`ctl gc` prunes by the part-of label against rendered manifests;
+    tenant clones are controller-managed, not manifest objects — carrying
+    the label would get them gc'd."""
+    from kubeflow_tpu.config.presets import preset  # noqa: F401
+    from kubeflow_tpu.k8s import FakeKubeClient
+    from kubeflow_tpu.manifests.registry import PART_OF_LABEL
+    from kubeflow_tpu.tenancy.profiles import ProfileController, profile
+
+    cfg = DeploymentConfig(name="demo", platform="local",
+                           components=[ComponentSpec("credentials")])
+    client = FakeKubeClient()
+    src = render_component(cfg, cfg.components[0])[0]
+    src["metadata"].setdefault("labels", {})[PART_OF_LABEL] = "demo"
+    client.create(src)
+    ctrl = ProfileController(client, platform_namespace="kubeflow")
+    client.create(profile("alice-ns", "alice"))
+    ctrl.reconcile("", "alice-ns")
+    clone = client.get("kubeflow-tpu.org/v1alpha1", "PodDefault",
+                       "alice-ns", "gcp-credentials")
+    assert PART_OF_LABEL not in (clone["metadata"].get("labels") or {})
+
+
 def test_updated_platform_pod_default_propagates():
     """Re-reconciling after the platform edits the source must propagate
     the new spec (no stale-clone overwrite)."""

@@ -115,6 +115,49 @@ def test_bootstrap_serves_deploy_ui(tmp_path):
         srv.shutdown()
 
 
+def test_applications_health_route():
+    """/api/applications/<ns> surfaces Application CR aggregate status
+    (the reference's grouped-health concept, application.libsonnet)."""
+    from kubeflow_tpu.dashboard.server import DashboardApi
+    from kubeflow_tpu.operators.application import (
+        ApplicationController,
+        application,
+    )
+    from kubeflow_tpu.k8s import objects as o
+    from kubeflow_tpu.manifests.registry import PART_OF_LABEL
+
+    client = FakeKubeClient()
+    sel = {PART_OF_LABEL: "demo"}
+    dep = o.deployment("web", "kubeflow",
+                       o.pod_spec([o.container("c", "i")]),
+                       replicas=2, labels={"app": "web", **sel})
+    dep["status"] = {"readyReplicas": 1}
+    client.create(dep)
+    client.create(application("demo", "kubeflow", selector=sel))
+    ApplicationController(client).reconcile("kubeflow", "demo")
+
+    api = DashboardApi(client, authorize=lambda *a: True)
+    code, apps = api.handle("GET", "/api/applications/kubeflow", None,
+                            "alice")
+    assert code == 200
+    # `ready` counts components (this 1 Deployment is 1/2-rolled-out, so
+    # not ready), not replicas
+    assert apps == [{"name": "demo", "phase": "Progressing",
+                     "ready": "0/1", "failing": ["Deployment/web"]}]
+
+
+def test_namespaced_routes_reject_empty_namespace():
+    """An empty trailing ns segment must 404, not become a cluster-wide
+    list (cross-tenant leak through the client layer)."""
+    from kubeflow_tpu.dashboard.server import DashboardApi
+
+    api = DashboardApi(FakeKubeClient(), authorize=lambda *a: True)
+    for path in ("/api/applications/", "/api/activities/",
+                 "/api/tpujobs/", "/api/studies/", "/api/runs/"):
+        code, _ = api.handle("GET", path, None, "alice")
+        assert code == 404, path
+
+
 def test_static_served_without_auth_but_api_guarded():
     """login.html must stay reachable when cookie auth is on; the API not."""
     from kubeflow_tpu.auth.gatekeeper import cookie_authenticator
