@@ -473,6 +473,62 @@ def _virtual_mesh_allreduce(*, size_mb: float, iters: int,
         return None
 
 
+def bench_decode(batch: int = 8, prompt_len: int = 128,
+                 new_tokens: int = 128, d_model: int = 1024,
+                 n_layers: int = 8, n_heads: int = 16,
+                 d_ff: int = 4096) -> Dict[str, Any]:
+    """Autoregressive generation throughput (KV-cache decode loop).
+
+    The LLM-serving hot path the reference has no story for: prefill +
+    ``lax.scan`` over single-token steps, all one compiled program
+    (``kubeflow_tpu/models/decode.py``). Decode is memory-bound (every
+    step reads all params + the KV cache), so the roofline here is
+    HBM bytes/token, not FLOPs."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import Transformer, TransformerConfig
+    from kubeflow_tpu.models.decode import make_generate
+
+    n_chips = jax.device_count()
+    config = TransformerConfig(
+        vocab_size=32000, d_model=d_model, n_layers=n_layers,
+        n_heads=n_heads, n_kv_heads=n_heads, d_ff=d_ff,
+        max_seq_len=prompt_len + new_tokens, remat=False)
+    model = Transformer(config)
+    prompt = jax.random.randint(jax.random.key(0), (batch, prompt_len), 0,
+                                config.vocab_size)
+    params = jax.jit(model.init)(jax.random.key(1), prompt[:2])["params"]
+
+    fn = make_generate(config, max_new_tokens=new_tokens)
+    true_len = jnp.int32(prompt_len)
+    rng = jax.random.key(2)
+
+    out = fn(params, prompt, true_len, rng)  # compile
+    _ = np.asarray(out)
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        out = fn(params, prompt, true_len, rng)
+    _ = np.asarray(out)
+    dt = (time.perf_counter() - t0) / reps
+
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(params))
+    # per decoded token the chip reads every param (bf16) once — the
+    # memory-bound roofline for batch-small decode
+    total_new = batch * new_tokens
+    return {
+        "tokens_per_sec_per_chip": round(total_new / dt / n_chips, 1),
+        "ms_per_token": round(dt / new_tokens * 1e3, 3),
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "n_params_m": round(n_params / 1e6, 1),
+        "n_chips": n_chips,
+    }
+
+
 # -- config 5: serving latency/QPS -------------------------------------------
 
 
@@ -601,6 +657,7 @@ CONFIGS: Dict[str, Callable[[], Dict[str, Any]]] = {
     "longcontext": bench_longcontext,
     "allreduce": bench_allreduce,
     "serving": bench_serving,
+    "decode": bench_decode,
 }
 
 

@@ -29,3 +29,9 @@ from kubeflow_tpu.models.vit import (  # noqa: F401
     vit_tiny,
 )
 from kubeflow_tpu.models.mnist import MnistCnn  # noqa: F401
+from kubeflow_tpu.models.decode import (  # noqa: F401
+    decode_step,
+    generate,
+    make_generate,
+    prefill,
+)

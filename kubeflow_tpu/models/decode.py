@@ -1,0 +1,148 @@
+"""Autoregressive generation with a KV cache — the LLM serving hot loop.
+
+The reference platform serves models as opaque TF-Serving containers
+(``/root/reference/kubeflow/tf-serving/``) and has no generation story;
+a TPU-native framework must own it, XLA-style: everything below is
+traced once and compiled — static shapes, ``lax.scan`` over decode
+steps, no Python in the loop.
+
+Shapes are the whole design:
+
+- prompts are right-padded to a bucket (one compiled prefill per
+  bucket, like the model server's padded batch buckets); the cache
+  write index is then reset to each row's true length, so the padded
+  tail is dead weight that the next real tokens overwrite before any
+  attention can see it (masking is by absolute position);
+- the per-step state is the flax ``cache`` collection the decode-mode
+  :class:`~kubeflow_tpu.models.transformer.Transformer` maintains
+  (K/V ``(L, B, max_seq_len, KH, Dh)`` + write index, stacked over
+  layers by ``nn.scan``) — donated through the scan so XLA updates it
+  in place;
+- sampling is greedy (``temperature=0``) or temperature-scaled
+  categorical with a threaded PRNG key.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.models.transformer import Transformer, TransformerConfig
+
+
+def _decode_model(config: TransformerConfig) -> Transformer:
+    return Transformer(config, decode=True)
+
+
+def prefill(config: TransformerConfig, params, tokens: jnp.ndarray,
+            true_len: Optional[jnp.ndarray] = None):
+    """Run the prompt through the decode-mode model, fill the cache.
+
+    ``tokens``: (B, S) right-padded prompts; ``true_len``: a SCALAR
+    actual length shared by the batch (defaults to S) — the serving
+    layer pads each request's batch to one bucket, so lengths are
+    uniform per call. (Per-row ragged lengths are not supported: rows
+    shorter than the longest would keep attendable pad K/V between
+    their length and the shared write index.) Returns
+    (next_token_logits, cache) where logits are the last real token's.
+    """
+    model = _decode_model(config)
+    B, S = tokens.shape
+    if true_len is None:
+        true_len = S
+    true_len = jnp.asarray(true_len, jnp.int32)
+    if true_len.ndim != 0:
+        raise ValueError("true_len must be a scalar (uniform prompt "
+                         "length per call)")
+
+    logits, variables = model.apply({"params": params}, tokens,
+                                    mutable=["cache"])
+    cache = variables["cache"]
+    # the write index advanced to S (the padded bucket); pull it back to
+    # the true length so the next tokens overwrite the padded tail —
+    # pad positions are masked (kv_pos <= q_pos) until overwritten
+    cache = jax.tree_util.tree_map_with_path(
+        lambda path, leaf: (true_len.astype(leaf.dtype)
+                            * jnp.ones_like(leaf)
+                            if path[-1].key == "index" else leaf),
+        cache)
+    last = jnp.take_along_axis(
+        logits,
+        jnp.broadcast_to(true_len - 1, (B,))[:, None, None], axis=1)[:, 0]
+    return last, cache
+
+
+def decode_step(config: TransformerConfig, params, cache,
+                token: jnp.ndarray):
+    """One token in, one token's logits out; cache advances by one."""
+    model = _decode_model(config)
+    logits, variables = model.apply(
+        {"params": params, "cache": cache}, token[:, None],
+        mutable=["cache"])
+    return logits[:, 0], variables["cache"]
+
+
+def _sample(logits: jnp.ndarray, temperature, rng: Optional[jax.Array],
+            greedy: bool) -> jnp.ndarray:
+    if greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        rng, logits / temperature, axis=-1).astype(jnp.int32)
+
+
+def generate(config: TransformerConfig, params, prompt: jnp.ndarray,
+             *, max_new_tokens: int,
+             true_len: Optional[jnp.ndarray] = None,
+             temperature: float = 0.0,
+             rng: Optional[jax.Array] = None) -> jnp.ndarray:
+    """Prefill + scan decode; returns (B, max_new_tokens) int32.
+
+    Fully traceable: wrap in ``jax.jit`` (static ``config`` and
+    ``max_new_tokens``). ``temperature`` may be a traced array — the
+    greedy/sampling split is decided statically by whether it is the
+    Python float 0.0, so a serving layer can compile ONE sampling
+    program for all temperatures.
+    """
+    greedy = isinstance(temperature, (int, float)) and temperature == 0.0
+    if not greedy:
+        if rng is None:
+            raise ValueError("sampling (temperature > 0) needs an rng key")
+        if isinstance(temperature, (int, float)) and temperature < 0:
+            raise ValueError("temperature must be >= 0")
+    if rng is None:
+        rng = jax.random.key(0)  # unused by greedy; keeps the scan carry
+
+    last_logits, cache = prefill(config, params, prompt, true_len)
+    rng, sub = jax.random.split(rng)
+    first = _sample(last_logits, temperature, sub, greedy)
+
+    def step(carry, _):
+        cache, token, rng = carry
+        logits, cache = decode_step(config, params, cache, token)
+        rng, sub = jax.random.split(rng)
+        nxt = _sample(logits, temperature, sub, greedy)
+        return (cache, nxt, rng), nxt
+
+    if max_new_tokens == 1:
+        return first[:, None]
+    (_, _, _), rest = jax.lax.scan(
+        step, (cache, first, rng), None, length=max_new_tokens - 1)
+    # scan stacks on axis 0: (T-1, B) -> (B, T-1)
+    return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+
+def make_generate(config: TransformerConfig, *, max_new_tokens: int,
+                  temperature: float = 0.0):
+    """Jitted generate closure: (params, prompt, true_len, rng) -> tokens."""
+    import functools
+
+    @functools.partial(jax.jit, donate_argnums=())
+    def fn(params, prompt, true_len, rng):
+        return generate(config, params, prompt,
+                        max_new_tokens=max_new_tokens,
+                        true_len=true_len, temperature=temperature,
+                        rng=rng)
+
+    return fn

@@ -123,6 +123,7 @@ def apply_rope(x: jnp.ndarray, sin: jnp.ndarray, cos: jnp.ndarray) -> jnp.ndarra
 
 class Attention(nn.Module):
     config: TransformerConfig
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, sin, cos):
@@ -139,6 +140,12 @@ class Attention(nn.Module):
         q = jnp.einsum("bsd,dhk->bshk", x, wq.astype(c.dtype))
         k = jnp.einsum("bsd,dhk->bshk", x, wk.astype(c.dtype))
         v = jnp.einsum("bsd,dhk->bshk", x, wv.astype(c.dtype))
+
+        if self.decode:
+            out = self._decode_attend(q, k, v, sin, cos)
+            out = jnp.einsum("bshk,hkd->bsd", out, wo.astype(c.dtype))
+            return _constrain(out, c.rules, "batch", "seq", None)
+
         if c.attention_impl in ("ring", "ulysses"):
             # sequence stays sharded through attention (SP paths); heads
             # replicate — the inverse of the tensor-parallel dense layout
@@ -159,6 +166,50 @@ class Attention(nn.Module):
         out = self._attend(q, k, v)
         out = jnp.einsum("bshk,hkd->bsd", out, wo.astype(c.dtype))
         return _constrain(out, c.rules, "batch", "seq", None)
+
+    def _decode_attend(self, q, k, v, sin_full, cos_full):
+        """Autoregressive attention with a KV cache (static shapes).
+
+        ``sin_full``/``cos_full`` span ``max_seq_len``; the cache index
+        variable tracks the absolute write position, so rope uses true
+        positions and masking is by absolute position — everything under
+        one jit with no data-dependent shapes (XLA-friendly: one compiled
+        prefill per prompt bucket, one compiled step).
+        """
+        c = self.config
+        B, S, KH, Dh = k.shape
+        Smax = c.max_seq_len
+
+        idx_var = self.variable("cache", "index",
+                                lambda: jnp.zeros((), jnp.int32))
+        ck = self.variable("cache", "k", jnp.zeros, (B, Smax, KH, Dh),
+                           c.dtype)
+        cv = self.variable("cache", "v", jnp.zeros, (B, Smax, KH, Dh),
+                           c.dtype)
+        idx = idx_var.value
+
+        sin = jax.lax.dynamic_slice_in_dim(sin_full, idx, S, 0)
+        cos = jax.lax.dynamic_slice_in_dim(cos_full, idx, S, 0)
+        q = apply_rope(q, sin, cos)
+        k = apply_rope(k, sin, cos)
+
+        ck.value = jax.lax.dynamic_update_slice_in_dim(ck.value, k, idx,
+                                                       axis=1)
+        cv.value = jax.lax.dynamic_update_slice_in_dim(cv.value, v, idx,
+                                                       axis=1)
+        idx_var.value = idx + S
+
+        from kubeflow_tpu.ops.attention import NEG_INF, gqa_repeat
+
+        kc, vc = gqa_repeat(q, ck.value, cv.value)
+        logits = jnp.einsum("bshd,bthd->bhst", q, kc).astype(jnp.float32)
+        logits = logits * (Dh ** -0.5)
+        q_pos = idx + jnp.arange(S)
+        kv_pos = jnp.arange(Smax)
+        mask = kv_pos[None, :] <= q_pos[:, None]  # (S, Smax)
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhst,bthd->bshd", probs, vc)
 
     def _attend(self, q, k, v):
         """Dispatch to the configured attention core (causal per config)."""
@@ -305,13 +356,14 @@ class MoeMlp(nn.Module):
 
 class Block(nn.Module):
     config: TransformerConfig
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, aux):
         sin, cos = aux
         c = self.config
         h = RMSNorm(param_dtype=c.param_dtype, name="attn_norm")(x)
-        x = x + Attention(c, name="attn")(h, sin, cos)
+        x = x + Attention(c, decode=self.decode, name="attn")(h, sin, cos)
         h = RMSNorm(param_dtype=c.param_dtype, name="mlp_norm")(x)
         mlp = MoeMlp(c, name="moe") if c.n_experts else Mlp(c, name="mlp")
         x = x + mlp(h)
@@ -320,6 +372,10 @@ class Block(nn.Module):
 
 class Transformer(nn.Module):
     config: TransformerConfig
+    # autoregressive mode: attention maintains a "cache" collection (KV
+    # cache + write index, stacked over layers by nn.scan); apply with
+    # mutable=["cache"] — see kubeflow_tpu/models/decode.py
+    decode: bool = False
 
     @nn.compact
     def __call__(self, tokens: jnp.ndarray) -> jnp.ndarray:
@@ -337,23 +393,27 @@ class Transformer(nn.Module):
         )
         x = jnp.take(embed.astype(c.dtype), tokens, axis=0)
         x = _constrain(x, c.rules, "batch", "seq", None)
-        sin, cos = rope_tables(S, c.head_dim, c.rope_theta)
+        # decode mode uses absolute positions: full tables, sliced at the
+        # cache index inside each attention
+        sin, cos = rope_tables(c.max_seq_len if self.decode else S,
+                               c.head_dim, c.rope_theta)
 
         block_cls = Block
-        if c.remat:
+        if c.remat and not self.decode:
             block_cls = nn.remat(Block, prevent_cse=False)
         if c.scan_layers:
             x, _ = nn.scan(
                 block_cls,
-                variable_axes={"params": 0, "losses": 0},
+                variable_axes={"params": 0, "losses": 0, "cache": 0},
                 split_rngs={"params": True},
                 in_axes=nn.broadcast,
                 length=c.n_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(c, name="blocks")(x, (sin, cos))
+            )(c, decode=self.decode, name="blocks")(x, (sin, cos))
         else:
             for i in range(c.n_layers):
-                x, _ = block_cls(c, name=f"block_{i}")(x, (sin, cos))
+                x, _ = block_cls(c, decode=self.decode,
+                                 name=f"block_{i}")(x, (sin, cos))
 
         x = RMSNorm(param_dtype=c.param_dtype, name="final_norm")(x)
         logits = jnp.einsum(

@@ -122,6 +122,11 @@ class LoadedModel:
     predict: Callable[[jnp.ndarray], jnp.ndarray]  # jitted, closed over params
     input_shape: Optional[Tuple[int, ...]] = None  # per-sample, for warmup
     input_dtype: str = "float32"
+    # autoregressive path (transformer kind): (prompt, true_len, max_new,
+    # temperature, rng_seed, greedy=) -> (B, max_new) int32; None for
+    # non-LM kinds. max_seq_len bounds prompt bucket + new tokens.
+    generate: Optional[Callable[..., jnp.ndarray]] = None
+    max_seq_len: Optional[int] = None
 
     def warmup(self, batch_sizes) -> int:
         """Precompile predict for each batch bucket; returns count warmed."""
@@ -158,11 +163,33 @@ def load_version(base_path: str, version: int) -> LoadedModel:
     def predict(x: jnp.ndarray) -> jnp.ndarray:
         return apply_fn(model, params, x)
 
+    generate = None
+    max_seq_len = None
+    if kind == "transformer":
+        from kubeflow_tpu.models.decode import generate as _generate
+
+        import functools
+
+        max_seq_len = model.config.max_seq_len
+
+        # greedy is the only static sampling decision: every temperature
+        # shares one compiled sampling program (a client sweeping
+        # temperatures must not mint unbounded XLA cache entries)
+        @functools.partial(jax.jit, static_argnames=("max_new", "greedy"))
+        def generate(prompt, true_len, max_new, temperature, rng_seed, *,
+                     greedy):
+            return _generate(
+                model.config, params, prompt,
+                max_new_tokens=max_new, true_len=true_len,
+                temperature=0.0 if greedy else temperature,
+                rng=jax.random.key(rng_seed))
+
     shape = meta.get("input_shape")
     return LoadedModel(
         kind=kind, version=version, predict=predict,
         input_shape=tuple(shape) if shape else None,
-        input_dtype=meta.get("input_dtype", "float32"))
+        input_dtype=meta.get("input_dtype", "float32"),
+        generate=generate, max_seq_len=max_seq_len)
 
 
 def load_latest(base_path: str) -> Optional[LoadedModel]:

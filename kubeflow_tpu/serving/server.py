@@ -228,6 +228,85 @@ class ModelServer:
         return 200, {"predictions": out.tolist(),
                      "model_version": str(model.version)}
 
+    def handle_generate(self, name: str, version: Optional[int],
+                        body: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+        """Autoregressive generation (transformer models): prompts are
+        right-padded to a power-of-two bucket, so the compiled prefill is
+        reused across prompt lengths (one compile per bucket, like the
+        predict path's padded batch buckets)."""
+        model = self.repo.get(name, version)
+        if model is None:
+            return 404, {"error": f"model {name!r} not found"}
+        if model.generate is None:
+            return 400, {"error": f"model {name!r} (kind {model.kind!r}) "
+                                  "does not support :generate"}
+        prompts = body.get("prompt_tokens")
+        if not prompts:
+            return 400, {"error": "body must contain 'prompt_tokens' "
+                                  "(batch of int token lists)"}
+        try:
+            max_new = int(body.get("max_new_tokens", 16))
+            temperature = float(body.get("temperature", 0.0))
+            seed = int(body.get("seed", 0))
+            lens = {len(p) for p in prompts}
+            if len(lens) != 1:
+                return 400, {"error": "all prompts in one call must share "
+                                      "a length (pad client-side or split "
+                                      "calls)"}
+            true_len = lens.pop()
+            if true_len < 1:
+                return 400, {"error": "empty prompt"}
+            arr = np.asarray(prompts, dtype=np.int32)
+        except (TypeError, ValueError) as e:
+            return 400, {"error": f"bad prompt_tokens: {e}"}
+        if max_new < 1:
+            return 400, {"error": "max_new_tokens must be >= 1"}
+        if temperature < 0:
+            # a negative temperature silently inverts the distribution
+            return 400, {"error": "temperature must be >= 0"}
+        if arr.shape[0] > self.max_batch_size:
+            return 400, {"error": f"batch {arr.shape[0]} exceeds max "
+                                  f"{self.max_batch_size}"}
+        ctx = model.max_seq_len or 0
+
+        def pow2(n: int) -> int:
+            b = 1
+            while b < n:
+                b *= 2
+            return b
+
+        # prompt bucket: one compiled prefill per bucket, capped at the
+        # model context (3072-context models serve 2100-token prompts)
+        bucket = min(pow2(true_len), ctx)
+        # new-token bucket likewise (a client sweeping max_new_tokens
+        # must not mint unbounded compiled programs); decode the bucket,
+        # return the first max_new
+        new_bucket = min(pow2(max_new), max(ctx - bucket, 0))
+        if bucket < true_len or new_bucket < max_new:
+            return 400, {"error": f"prompt ({true_len}) + max_new_tokens "
+                                  f"({max_new}) exceed the model context "
+                                  f"({ctx}); cache writes past it would "
+                                  "silently clamp"}
+        padded = np.zeros((arr.shape[0], bucket), np.int32)
+        padded[:, :true_len] = arr
+        # batch padded like the predict path: one compiled shape
+        padded, n = _pad_batch(padded, self.max_batch_size)
+        t0 = time.perf_counter()
+        try:
+            out = np.asarray(model.generate(
+                jnp.asarray(padded), jnp.int32(true_len), new_bucket,
+                jnp.float32(temperature), seed,
+                greedy=temperature == 0.0))[:n, :max_new]
+        except Exception as e:  # noqa: BLE001
+            return 400, {"error": f"generate failed: "
+                                  f"{type(e).__name__}: {e}"}
+        dt = time.perf_counter() - t0
+        _requests.inc(model=name)
+        _latency.set(dt, model=name)
+        return 200, {"tokens": out.tolist(),
+                     "model_version": str(model.version),
+                     "tokens_per_sec": round(out.size / dt, 1)}
+
     # -- HTTP plumbing -----------------------------------------------------
 
     def _make_handler(self):
@@ -274,8 +353,11 @@ class ModelServer:
                     self._send(400, {"error": "invalid JSON"})
                     return
                 path = self.path
-                if path.endswith(":predict") and path.startswith("/v1/models/"):
-                    target = path[len("/v1/models/"):-len(":predict")]
+                handlers = {":predict": server.handle_predict,
+                            ":generate": server.handle_generate}
+                verb = next((s for s in handlers if path.endswith(s)), None)
+                if verb and path.startswith("/v1/models/"):
+                    target = path[len("/v1/models/"):-len(verb)]
                     version: Optional[int] = None
                     if "/versions/" in target:
                         name, _, v = target.partition("/versions/")
@@ -285,7 +367,7 @@ class ModelServer:
                         version = int(v)
                     else:
                         name = target
-                    code, payload = server.handle_predict(name, version, body)
+                    code, payload = handlers[verb](name, version, body)
                     self._send(code, payload)
                 else:
                     self._send(404, {"error": "not found"})
