@@ -114,6 +114,22 @@ def generate(config: TransformerConfig, params, prompt: jnp.ndarray,
     if rng is None:
         rng = jax.random.key(0)  # unused by greedy; keeps the scan carry
 
+    # cache writes past max_seq_len silently clamp (dynamic_update_slice
+    # semantics) — reject overruns where the start is known eagerly. A
+    # traced true_len (inside an outer jit, e.g. the serving wrapper) is
+    # the caller's contract: the padded prompt width would over-reject.
+    if true_len is None:
+        start = prompt.shape[1]
+    elif isinstance(true_len, jax.core.Tracer):
+        start = None
+    else:
+        start = int(true_len)
+    if start is not None and start + max_new_tokens > config.max_seq_len:
+        raise ValueError(
+            f"prompt length {start} + max_new_tokens "
+            f"{max_new_tokens} exceeds max_seq_len {config.max_seq_len}: "
+            "cache writes past the end would silently clamp")
+
     last_logits, cache = prefill(config, params, prompt, true_len)
     rng, sub = jax.random.split(rng)
     first = _sample(last_logits, temperature, sub, greedy)

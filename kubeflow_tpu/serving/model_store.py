@@ -124,9 +124,11 @@ class LoadedModel:
     input_dtype: str = "float32"
     # autoregressive path (transformer kind): (prompt, true_len, max_new,
     # temperature, rng_seed, greedy=) -> (B, max_new) int32; None for
-    # non-LM kinds. max_seq_len bounds prompt bucket + new tokens.
+    # non-LM kinds. max_seq_len bounds prompt + new tokens; vocab_size
+    # bounds token ids (both would silently clamp otherwise).
     generate: Optional[Callable[..., jnp.ndarray]] = None
     max_seq_len: Optional[int] = None
+    vocab_size: Optional[int] = None
 
     def warmup(self, batch_sizes) -> int:
         """Precompile predict for each batch bucket; returns count warmed."""
@@ -164,13 +166,14 @@ def load_version(base_path: str, version: int) -> LoadedModel:
         return apply_fn(model, params, x)
 
     generate = None
-    max_seq_len = None
+    max_seq_len = vocab_size = None
     if kind == "transformer":
         from kubeflow_tpu.models.decode import generate as _generate
 
         import functools
 
         max_seq_len = model.config.max_seq_len
+        vocab_size = model.config.vocab_size
 
         # greedy is the only static sampling decision: every temperature
         # shares one compiled sampling program (a client sweeping
@@ -189,7 +192,7 @@ def load_version(base_path: str, version: int) -> LoadedModel:
         kind=kind, version=version, predict=predict,
         input_shape=tuple(shape) if shape else None,
         input_dtype=meta.get("input_dtype", "float32"),
-        generate=generate, max_seq_len=max_seq_len)
+        generate=generate, max_seq_len=max_seq_len, vocab_size=vocab_size)
 
 
 def load_latest(base_path: str) -> Optional[LoadedModel]:

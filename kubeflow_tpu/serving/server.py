@@ -41,6 +41,10 @@ _requests = DEFAULT_REGISTRY.counter(
     "kftpu_serving_requests_total", "predict requests")
 _latency = DEFAULT_REGISTRY.gauge(
     "kftpu_serving_last_latency_seconds", "last predict latency")
+_gen_requests = DEFAULT_REGISTRY.counter(
+    "kftpu_serving_generate_requests_total", "generate requests")
+_gen_latency = DEFAULT_REGISTRY.gauge(
+    "kftpu_serving_generate_last_latency_seconds", "last generate latency")
 
 _PAD_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
 
@@ -264,9 +268,17 @@ class ModelServer:
         if temperature < 0:
             # a negative temperature silently inverts the distribution
             return 400, {"error": "temperature must be >= 0"}
+        if arr.ndim != 2:
+            return 400, {"error": f"prompt_tokens must be a 2-D batch of "
+                                  f"token lists, got shape {arr.shape}"}
         if arr.shape[0] > self.max_batch_size:
             return 400, {"error": f"batch {arr.shape[0]} exceeds max "
                                   f"{self.max_batch_size}"}
+        if model.vocab_size and (arr.min() < 0
+                                 or arr.max() >= model.vocab_size):
+            # out-of-range ids would silently clamp in the embedding take
+            return 400, {"error": f"token ids must be in [0, "
+                                  f"{model.vocab_size})"}
         ctx = model.max_seq_len or 0
 
         def pow2(n: int) -> int:
@@ -280,8 +292,11 @@ class ModelServer:
         bucket = min(pow2(true_len), ctx)
         # new-token bucket likewise (a client sweeping max_new_tokens
         # must not mint unbounded compiled programs); decode the bucket,
-        # return the first max_new
-        new_bucket = min(pow2(max_new), max(ctx - bucket, 0))
+        # return the first max_new. Decode writes start at true_len (the
+        # cache index resets there), so the budget is ctx - true_len —
+        # NOT ctx - bucket, which would reject any prompt past half the
+        # context.
+        new_bucket = min(pow2(max_new), max(ctx - true_len, 0))
         if bucket < true_len or new_bucket < max_new:
             return 400, {"error": f"prompt ({true_len}) + max_new_tokens "
                                   f"({max_new}) exceed the model context "
@@ -301,8 +316,8 @@ class ModelServer:
             return 400, {"error": f"generate failed: "
                                   f"{type(e).__name__}: {e}"}
         dt = time.perf_counter() - t0
-        _requests.inc(model=name)
-        _latency.set(dt, model=name)
+        _gen_requests.inc(model=name)
+        _gen_latency.set(dt, model=name)
         return 200, {"tokens": out.tolist(),
                      "model_version": str(model.version),
                      "tokens_per_sec": round(out.size / dt, 1)}

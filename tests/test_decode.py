@@ -228,8 +228,35 @@ def test_serving_generate_rejects_ragged_prompts(tmp_path, setup):
         code, out = srv.handle_generate(
             "lm", None, {"prompt_tokens": [[1, 2]] * 99})
         assert code == 400 and "batch" in out["error"]
+        # a prompt past half the context must still generate: the budget
+        # is ctx - true_len, NOT ctx - pow2_bucket (ctx=32 here)
+        code, out = srv.handle_generate(
+            "lm", None, {"prompt_tokens": [[1] * 20],
+                         "max_new_tokens": 4})
+        assert code == 200, out
+        assert len(out["tokens"][0]) == 4
+        # misshaped (3-D) prompts are a 400, not a handler crash
+        code, out = srv.handle_generate(
+            "lm", None, {"prompt_tokens": [[[1, 2], [3, 4]]]})
+        assert code == 400 and "2-D" in out["error"]
+        # out-of-vocab ids would silently clamp in the embedding
+        code, out = srv.handle_generate(
+            "lm", None, {"prompt_tokens": [[999999, 1]]})
+        assert code == 400 and "token ids" in out["error"]
+        code, out = srv.handle_generate(
+            "lm", None, {"prompt_tokens": [[-5, 1]]})
+        assert code == 400
     finally:
         srv.stop()
+
+
+def test_generate_rejects_context_overrun(setup):
+    """The library API errors on overruns instead of silently clamping
+    cache writes (max_seq_len=32 in the fixture)."""
+    config, _, params, prompt = setup
+    with pytest.raises(ValueError, match="max_seq_len"):
+        generate(config, params, prompt,
+                 max_new_tokens=config.max_seq_len)
 
 
 def test_serving_generate_temperatures_share_one_compile(tmp_path, setup):
@@ -264,6 +291,38 @@ def test_serving_generate_temperatures_share_one_compile(tmp_path, setup):
         assert lm.generate._cache_size() == 1
     finally:
         srv.stop()
+
+
+def test_decode_on_sharded_mesh(setup):
+    """Generation with tensor-parallel-sharded params on the virtual
+    mesh: the multi-chip serving path. Results must match unsharded
+    greedy decode exactly."""
+    import jax.numpy as _jnp
+    from jax.sharding import NamedSharding
+
+    from kubeflow_tpu.models import param_partition_specs
+    from kubeflow_tpu.parallel import MeshConfig, create_mesh
+    from kubeflow_tpu.parallel.mesh import (
+        logical_to_mesh_axes,
+        mesh_context,
+        shape_aware_spec,
+    )
+
+    config, model, params, prompt = setup
+    want = generate(config, params, prompt, max_new_tokens=5)
+
+    mesh = create_mesh(MeshConfig(dp=2, tp=4))
+    specs = param_partition_specs(params)
+    sharded = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(
+            x, NamedSharding(mesh, shape_aware_spec(s, x.shape, mesh))),
+        params, specs, is_leaf=lambda x: not isinstance(x, dict))
+    tokens = jax.device_put(
+        prompt, NamedSharding(mesh, logical_to_mesh_axes(("batch", None))))
+    with mesh_context(mesh):
+        got = jax.jit(lambda p, t: generate(
+            config, p, t, max_new_tokens=5))(sharded, tokens)
+    np.testing.assert_array_equal(got, want)
 
 
 def test_softcap_decode():
