@@ -237,6 +237,12 @@ def cmd_gc(args) -> int:
               ("rbac.authorization.k8s.io/v1", "RoleBinding"),
               ("apiextensions.k8s.io/v1", "CustomResourceDefinition"),
               ("networking.k8s.io/v1", "NetworkPolicy"),
+              ("networking.k8s.io/v1", "Ingress"),
+              ("networking.istio.io/v1beta1", "Gateway"),
+              ("networking.istio.io/v1beta1", "VirtualService"),
+              ("networking.istio.io/v1beta1", "DestinationRule"),
+              ("cloud.google.com/v1", "BackendConfig"),
+              ("networking.gke.io/v1", "ManagedCertificate"),
               ("admissionregistration.k8s.io/v1",
                "MutatingWebhookConfiguration")}
     observed = []

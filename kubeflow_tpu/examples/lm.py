@@ -41,6 +41,12 @@ def main(argv=None) -> float:
     p.add_argument("--learning-rate", type=float, default=3e-4)
     p.add_argument("--checkpoint-every", type=int, default=50)
     p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--export", default=None, metavar="DIR",
+                   help="export the trained model for serving "
+                        "(versioned model-store layout)")
+    p.add_argument("--generate", type=int, default=0, metavar="N",
+                   help="after training, greedy-decode N tokens as a "
+                        "smoke sample")
     args = p.parse_args(argv)
 
     penv, mesh = launcher_init(tp=args.tp)
@@ -72,8 +78,12 @@ def main(argv=None) -> float:
         ckpt = CheckpointManager(checkpoint_dir())
         state, start_step = ckpt.restore_or_init(state)
     if start_step >= args.steps:
-        # restarted after the final checkpoint: nothing left to train
+        # restarted after the final checkpoint: nothing left to train —
+        # but the export/sample side effects must still happen, or a
+        # job preempted between its last checkpoint and exit never
+        # delivers the model it was asked to export
         log_metrics(start_step, done=True)
+        _finish(args, config, state)
         if ckpt:
             ckpt.close()
         return 0.0
@@ -102,7 +112,45 @@ def main(argv=None) -> float:
     if ckpt:
         ckpt.wait()
         ckpt.close()
+    _finish(args, config, state)
     return float(metrics["loss"])
+
+
+def _finish(args, config, state) -> None:
+    """Post-training side effects: sample + export (also on the
+    restarted-after-final-checkpoint path)."""
+    if args.generate:
+        # train -> decode, end to end: greedy sample from the trained
+        # weights through the KV-cache path (models/decode.py)
+        from kubeflow_tpu.models.decode import generate
+
+        prompt_len = max(1, min(8, config.max_seq_len // 2))
+        max_new = min(args.generate, config.max_seq_len - prompt_len)
+        if max_new < 1:
+            log_metrics(args.steps, sample_skipped=(
+                f"max_seq_len {config.max_seq_len} leaves no room to "
+                "generate"))
+        else:
+            prompt = jax.random.randint(jax.random.key(7),
+                                        (1, prompt_len), 0,
+                                        config.vocab_size)
+            out = generate(config, state.params, prompt,
+                           max_new_tokens=max_new)
+            log_metrics(args.steps, sample_tokens=out[0].tolist())
+    if args.export:
+        from kubeflow_tpu.serving import export_model
+
+        vdir = export_model(
+            args.export, "transformer", state.params, version=1,
+            config={"vocab_size": config.vocab_size,
+                    "d_model": config.d_model,
+                    "n_layers": config.n_layers,
+                    "n_heads": config.n_heads,
+                    "n_kv_heads": config.n_kv_heads,
+                    "d_ff": config.d_ff,
+                    "max_seq_len": config.max_seq_len,
+                    "n_experts": config.n_experts})
+        log_metrics(args.steps, exported=vdir)
 
 
 if __name__ == "__main__":

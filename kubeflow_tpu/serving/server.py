@@ -295,8 +295,13 @@ class ModelServer:
         # return the first max_new. Decode writes start at true_len (the
         # cache index resets there), so the budget is ctx - true_len —
         # NOT ctx - bucket, which would reject any prompt past half the
-        # context.
-        new_bucket = min(pow2(max_new), max(ctx - true_len, 0))
+        # context. The clamped value is rounded DOWN to a power of two:
+        # a raw ctx - true_len clamp would mint one compiled program per
+        # distinct prompt length near the context end.
+        budget = max(ctx - true_len, 0)
+        new_bucket = pow2(max_new)
+        while new_bucket > budget:
+            new_bucket //= 2
         if bucket < true_len or new_bucket < max_new:
             return 400, {"error": f"prompt ({true_len}) + max_new_tokens "
                                   f"({max_new}) exceed the model context "

@@ -250,6 +250,55 @@ def test_serving_generate_rejects_ragged_prompts(tmp_path, setup):
         srv.stop()
 
 
+def test_lm_example_generate_small_context(tmp_path, capsys):
+    """--generate with a tiny --seq-len must sample (or skip cleanly),
+    never crash in the scan."""
+    from kubeflow_tpu.examples.lm import main
+
+    main(["--steps", "2", "--per-device-batch", "1", "--seq-len", "8",
+          "--vocab-size", "32", "--d-model", "8", "--n-layers", "1",
+          "--n-heads", "2", "--d-ff", "16", "--log-every", "2",
+          "--generate", "4"])
+    out = capsys.readouterr().out
+    assert "sample_tokens" in out
+
+
+def test_serving_generate_near_context_end_buckets_pow2(tmp_path, setup):
+    """A prompt near the context end must not mint per-length compiled
+    programs: the clamped new-token bucket stays a power of two."""
+    from kubeflow_tpu.serving import export_model
+    from kubeflow_tpu.serving.server import ModelServer
+
+    config, _, params, _ = setup  # max_seq_len = 32
+    export_model(str(tmp_path / "lm"), "transformer", params, version=1,
+                 config={"vocab_size": config.vocab_size,
+                         "d_model": config.d_model,
+                         "n_layers": config.n_layers,
+                         "n_heads": config.n_heads,
+                         "n_kv_heads": config.n_kv_heads,
+                         "d_ff": config.d_ff,
+                         "max_seq_len": config.max_seq_len,
+                         "dtype": "float32", "remat": False})
+    srv = ModelServer(str(tmp_path), port=0, poll_interval_s=3600)
+    srv.start()
+    try:
+        lm = srv.repo.get("lm")
+        # budgets 7, 6, 5 all round down to the pow2 bucket 4
+        for tl in (25, 26, 27):
+            code, _ = srv.handle_generate(
+                "lm", None, {"prompt_tokens": [[1] * tl],
+                             "max_new_tokens": 3})
+            assert code == 200
+        assert lm.generate._cache_size() == 1
+        # but an unservable ask is an honest 400
+        code, out = srv.handle_generate(
+            "lm", None, {"prompt_tokens": [[1] * 30],
+                         "max_new_tokens": 3})
+        assert code == 400 and "context" in out["error"]
+    finally:
+        srv.stop()
+
+
 def test_generate_rejects_context_overrun(setup):
     """The library API errors on overruns instead of silently clamping
     cache writes (max_seq_len=32 in the fixture)."""
@@ -323,6 +372,25 @@ def test_decode_on_sharded_mesh(setup):
         got = jax.jit(lambda p, t: generate(
             config, p, t, max_new_tokens=5))(sharded, tokens)
     np.testing.assert_array_equal(got, want)
+
+
+def test_lm_example_train_generate_export(tmp_path, capsys):
+    """The flagship loop end to end: train → greedy sample → export →
+    reload with a generate-capable LoadedModel."""
+    from kubeflow_tpu.examples.lm import main
+    from kubeflow_tpu.serving import load_latest
+
+    loss = main(["--steps", "3", "--per-device-batch", "1",
+                 "--seq-len", "16", "--vocab-size", "64",
+                 "--d-model", "16", "--n-layers", "1", "--n-heads", "2",
+                 "--d-ff", "32", "--log-every", "3",
+                 "--export", str(tmp_path / "lm"), "--generate", "4"])
+    assert loss == loss  # finite
+    out = capsys.readouterr().out
+    assert "sample_tokens" in out and "exported" in out
+    m = load_latest(str(tmp_path / "lm"))
+    assert m.kind == "transformer" and m.generate is not None
+    assert m.max_seq_len == 16 and m.vocab_size == 64
 
 
 def test_softcap_decode():
