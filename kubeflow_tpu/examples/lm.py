@@ -138,18 +138,11 @@ def _finish(args, config, state) -> None:
                            max_new_tokens=max_new)
             log_metrics(args.steps, sample_tokens=out[0].tolist())
     if args.export:
-        from kubeflow_tpu.serving import export_model
+        from kubeflow_tpu.serving import export_model, transformer_export_config
 
         vdir = export_model(
             args.export, "transformer", state.params, version=1,
-            config={"vocab_size": config.vocab_size,
-                    "d_model": config.d_model,
-                    "n_layers": config.n_layers,
-                    "n_heads": config.n_heads,
-                    "n_kv_heads": config.n_kv_heads,
-                    "d_ff": config.d_ff,
-                    "max_seq_len": config.max_seq_len,
-                    "n_experts": config.n_experts})
+            config=transformer_export_config(config))
         log_metrics(args.steps, exported=vdir)
 
 

@@ -446,11 +446,13 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = True,
     sequence dim of q/k/v. Device i holds query block i; KV blocks rotate
     one ring hop per step so after n steps every query block has seen every
     KV block. Per-step masking uses global block offsets, so causality holds
-    exactly; blocks strictly ahead of a query block contribute nothing (they
-    are masked; a skip-ahead schedule is a later optimization).
+    exactly; a KV block strictly AHEAD of this device's query block is
+    skipped entirely via ``lax.cond`` (its contribution is fully masked),
+    so causal rings do ~half the attention FLOPs — the ppermute still runs
+    every step to keep the ring schedule uniform across devices.
 
-    Gradients flow through ``lax.scan`` + ``ppermute`` (both differentiable),
-    so the same code path trains.
+    Gradients flow through ``lax.scan`` + ``ppermute`` + ``cond`` (all
+    differentiable), so the same code path trains.
     """
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
@@ -463,9 +465,18 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = True,
         o, l, m, k_cur, v_cur = carry
         src = (idx - step) % n  # who this KV block belongs to globally
         kv_pos = src * Sq + jnp.arange(k_cur.shape[1])
-        o, l, m = _block_update(
-            (o, l, m), (k_cur, v_cur), q, q_pos, kv_pos, scale, causal
-        )
+
+        def attend(acc):
+            return _block_update(acc, (k_cur, v_cur), q, q_pos, kv_pos,
+                                 scale, causal)
+
+        if causal:
+            # src > idx ⇒ every kv position is ahead of every query
+            # position on this device: skip the whole block's compute
+            o, l, m = jax.lax.cond(src > idx, lambda acc: acc, attend,
+                                   (o, l, m))
+        else:
+            o, l, m = attend((o, l, m))
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         return (o, l, m, k_nxt, v_nxt), None

@@ -3,6 +3,7 @@
 from kubeflow_tpu.serving.model_store import (  # noqa: F401
     LoadedModel,
     export_model,
+    transformer_export_config,
     list_versions,
     load_latest,
     load_version,

@@ -78,6 +78,36 @@ def build_model(kind: str, config: Dict[str, Any]):
     raise ValueError(f"unknown model kind {kind!r}")
 
 
+def transformer_export_config(config, **overrides) -> Dict[str, Any]:
+    """The serving-relevant TransformerConfig fields as an export dict.
+
+    One source of truth for what ``export_model(..., "transformer")``
+    must record — hand-copied field lists silently drop serving-relevant
+    fields (a soft-capped model exported without ``logits_softcap``
+    reloads with different logits).
+    """
+    import jax.numpy as jnp
+
+    out: Dict[str, Any] = {
+        "vocab_size": config.vocab_size,
+        "d_model": config.d_model,
+        "n_layers": config.n_layers,
+        "n_heads": config.n_heads,
+        "n_kv_heads": config.n_kv_heads,
+        "d_ff": config.d_ff,
+        "max_seq_len": config.max_seq_len,
+        "n_experts": config.n_experts,
+        "experts_per_token": config.experts_per_token,
+        "logits_softcap": config.logits_softcap,
+        "rope_theta": config.rope_theta,
+        "scan_layers": config.scan_layers,
+        "dtype": jnp.dtype(config.dtype).name,
+        "remat": False,  # serving never trains
+    }
+    out.update(overrides)
+    return out
+
+
 def export_model(
     path: str,
     kind: str,

@@ -302,6 +302,11 @@ class ModelServer:
         new_bucket = pow2(max_new)
         while new_bucket > budget:
             new_bucket //= 2
+        if new_bucket < max_new <= budget:
+            # the pow2 bucket doesn't fit but the exact ask does (prompt
+            # 29 + max_new 3 in a 32-context model): serve it exactly —
+            # a rare tail case, so the per-value compile is acceptable
+            new_bucket = max_new
         if bucket < true_len or new_bucket < max_new:
             return 400, {"error": f"prompt ({true_len}) + max_new_tokens "
                                   f"({max_new}) exceed the model context "

@@ -30,7 +30,10 @@ def test_virtual_mesh_allreduce_subprocess():
 
 
 def test_serving_config_reports_latency():
-    out = suite.bench_serving(requests=2, batch=2, image_size=64,
+    # 128² keeps the JSON payload multi-MB, so binary-beats-JSON is
+    # structural (parse cost), not scheduler noise — a 64² batch-2 run
+    # flaked under full-suite load
+    out = suite.bench_serving(requests=2, batch=2, image_size=128,
                               rest_requests=2)
     assert out["transport"] == "grpc"
     assert out["p50_ms"] > 0
@@ -38,11 +41,8 @@ def test_serving_config_reports_latency():
     assert out["qps_per_chip"] > 0
     assert out["rest_p50_ms"] > 0
     assert out["uint8_p50_ms"] > 0
-    # binary tensors beat JSON round-trips — but at this tiny test size
-    # (64² batch 2, ~100 KB JSON) the gap is scheduler noise under a
-    # loaded suite run, so allow generous slack; the structural 10×+
-    # difference is asserted by the real bench at 224² batch 8
-    assert out["p50_ms"] <= out["rest_p50_ms"] * 3
+    # binary tensors beat multi-MB JSON text round-trips
+    assert out["p50_ms"] <= out["rest_p50_ms"]
 
 
 def test_run_all_isolates_failures(monkeypatch):

@@ -20,6 +20,7 @@ from kubeflow_tpu.models.decode import (
     make_generate,
     prefill,
 )
+from kubeflow_tpu.serving import transformer_export_config
 
 
 def small_config(**kw):
@@ -150,14 +151,7 @@ def test_serving_generate_endpoint(tmp_path, setup):
 
     config, model, params, prompt = setup
     export_model(str(tmp_path / "lm"), "transformer", params, version=1,
-                 config={"vocab_size": config.vocab_size,
-                         "d_model": config.d_model,
-                         "n_layers": config.n_layers,
-                         "n_heads": config.n_heads,
-                         "n_kv_heads": config.n_kv_heads,
-                         "d_ff": config.d_ff,
-                         "max_seq_len": config.max_seq_len,
-                         "dtype": "float32", "remat": False})
+                 config=transformer_export_config(config))
     srv = ModelServer(str(tmp_path), port=0, poll_interval_s=3600)
     port = srv.start()
     try:
@@ -199,14 +193,7 @@ def test_serving_generate_rejects_ragged_prompts(tmp_path, setup):
 
     config, model, params, _ = setup
     export_model(str(tmp_path / "lm"), "transformer", params, version=1,
-                 config={"vocab_size": config.vocab_size,
-                         "d_model": config.d_model,
-                         "n_layers": config.n_layers,
-                         "n_heads": config.n_heads,
-                         "n_kv_heads": config.n_kv_heads,
-                         "d_ff": config.d_ff,
-                         "max_seq_len": config.max_seq_len,
-                         "dtype": "float32", "remat": False})
+                 config=transformer_export_config(config))
     srv = ModelServer(str(tmp_path), port=0, poll_interval_s=3600)
     srv.start()
     try:
@@ -271,14 +258,7 @@ def test_serving_generate_near_context_end_buckets_pow2(tmp_path, setup):
 
     config, _, params, _ = setup  # max_seq_len = 32
     export_model(str(tmp_path / "lm"), "transformer", params, version=1,
-                 config={"vocab_size": config.vocab_size,
-                         "d_model": config.d_model,
-                         "n_layers": config.n_layers,
-                         "n_heads": config.n_heads,
-                         "n_kv_heads": config.n_kv_heads,
-                         "d_ff": config.d_ff,
-                         "max_seq_len": config.max_seq_len,
-                         "dtype": "float32", "remat": False})
+                 config=transformer_export_config(config))
     srv = ModelServer(str(tmp_path), port=0, poll_interval_s=3600)
     srv.start()
     try:
@@ -290,6 +270,13 @@ def test_serving_generate_near_context_end_buckets_pow2(tmp_path, setup):
                              "max_new_tokens": 3})
             assert code == 200
         assert lm.generate._cache_size() == 1
+        # exact-fit tail: prompt 29 + max_new 3 = 32 fits even though
+        # pow2(3)=4 does not — served exactly, not rejected
+        code, out = srv.handle_generate(
+            "lm", None, {"prompt_tokens": [[1] * 29],
+                         "max_new_tokens": 3})
+        assert code == 200, out
+        assert len(out["tokens"][0]) == 3
         # but an unservable ask is an honest 400
         code, out = srv.handle_generate(
             "lm", None, {"prompt_tokens": [[1] * 30],
@@ -318,14 +305,7 @@ def test_serving_generate_temperatures_share_one_compile(tmp_path, setup):
 
     config, model, params, prompt = setup
     export_model(str(tmp_path / "lm"), "transformer", params, version=1,
-                 config={"vocab_size": config.vocab_size,
-                         "d_model": config.d_model,
-                         "n_layers": config.n_layers,
-                         "n_heads": config.n_heads,
-                         "n_kv_heads": config.n_kv_heads,
-                         "d_ff": config.d_ff,
-                         "max_seq_len": config.max_seq_len,
-                         "dtype": "float32", "remat": False})
+                 config=transformer_export_config(config))
     srv = ModelServer(str(tmp_path), port=0, poll_interval_s=3600)
     srv.start()
     try:
