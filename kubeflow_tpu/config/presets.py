@@ -44,8 +44,14 @@ def _standard(name: str) -> DeploymentConfig:
             ComponentSpec("inference-graph"),
             ComponentSpec("model-registry"),
             ComponentSpec("application"),
+            ComponentSpec("monitoring"),
+            ComponentSpec("tensorboard"),
+            ComponentSpec("usage-reporting"),
         ],
     )
+    # deliberately not in any preset: echo-server (a debugging tool you
+    # add when diagnosing routes) and nfs-storage (needs a real NFS/
+    # Filestore endpoint ip; `ctl` users add it with server_ip set)
 
 
 def _gcp_tpu(name: str) -> DeploymentConfig:

@@ -80,15 +80,19 @@ def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
         volume_mounts=[{"name": "config", "mountPath": "/etc/prometheus"},
                        {"name": "data", "mountPath": "/prometheus"}],
     )]
-    if params["project"]:
+    # the component param wins; otherwise the platform's project flows
+    # through (the gcp-tpu preset user fills platform_params.project once)
+    project = params["project"] or config.platform_params.get("project", "")
+    if project:
         # the sidecar tails Prometheus's WAL, so both containers share the
         # /prometheus data volume (the libsonnet pairs them the same way)
         containers.append(o.container(
             "stackdriver-sidecar", params["sidecar_image"],
-            args=[f"--stackdriver.project-id={params['project']}",
-                  f"--stackdriver.kubernetes.location={params['zone']}",
+            args=[f"--stackdriver.project-id={project}",
+                  "--stackdriver.kubernetes.location="
+                  f"{params['zone'] or config.platform_params.get('zone', '')}",
                   "--stackdriver.kubernetes.cluster-name="
-                  f"{params['cluster']}",
+                  f"{params['cluster'] or config.platform_params.get('cluster', '')}",
                   "--prometheus.wal-directory=/prometheus/wal"],
             volume_mounts=[{"name": "data", "mountPath": "/prometheus"}],
         ))

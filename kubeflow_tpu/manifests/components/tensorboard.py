@@ -24,6 +24,11 @@ DEFAULTS: Dict[str, Any] = {
     "log_dir": "/logs",          # mount point, or a gs:// url
     "pvc": "training-logs",      # PVC holding the logs; "" when log_dir
                                  # is a gs:// url read directly
+    "create_pvc": True,          # render the PVC too, so the preset's
+                                 # happy path schedules out of the box
+                                 # (set False to bind an existing claim,
+                                 # e.g. nfs-storage's RWX one)
+    "pvc_size": "10Gi",
     "port": 80,
     "target_port": 6006,
     "replicas": 1,
@@ -84,7 +89,18 @@ def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
         },
         volume_mounts=mounts,
     )
-    objs: List[o.Obj] = [
+    objs: List[o.Obj] = []
+    if use_pvc and params["create_pvc"]:
+        objs.append({
+            "apiVersion": "v1",
+            "kind": "PersistentVolumeClaim",
+            "metadata": o.metadata(params["pvc"], ns),
+            "spec": {
+                "accessModes": ["ReadWriteOnce"],
+                "resources": {"requests": {"storage": params["pvc_size"]}},
+            },
+        })
+    objs += [
         o.deployment(name, ns, o.pod_spec([ctr], volumes=volumes),
                      replicas=int(params["replicas"])),
         o.service(name, ns, {"app": name},

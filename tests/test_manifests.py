@@ -81,14 +81,39 @@ def test_serving_requests_tpu(config):
 def test_tensorboard_golden(config):
     objs = render_component(config, ComponentSpec("tensorboard"))
     kinds = [x["kind"] for x in objs]
-    assert kinds == ["Deployment", "Service"]
-    deploy, svc = objs
+    # the PVC renders too, so the preset happy path schedules without a
+    # separately-created claim
+    assert kinds == ["PersistentVolumeClaim", "Deployment", "Service"]
+    pvc, deploy, svc = objs
+    assert pvc["metadata"]["name"] == "training-logs"
     ctr = deploy["spec"]["template"]["spec"]["containers"][0]
     assert "--logdir=/logs" in ctr["args"]
     assert ctr["volumeMounts"][0]["readOnly"] is True
     vols = deploy["spec"]["template"]["spec"]["volumes"]
     assert vols[0]["persistentVolumeClaim"]["claimName"] == "training-logs"
     assert svc["spec"]["ports"][0]["targetPort"] == 6006
+
+
+def test_tensorboard_existing_claim_skips_pvc(config):
+    objs = render_component(config, ComponentSpec(
+        "tensorboard", {"create_pvc": False}))
+    assert [x["kind"] for x in objs] == ["Deployment", "Service"]
+
+
+def test_monitoring_sidecar_from_platform_params():
+    """gcp-tpu users fill platform_params once; the Stackdriver sidecar
+    must pick the project up from there."""
+    from kubeflow_tpu.config.presets import preset
+
+    cfg = preset("gcp-tpu", "demo")
+    cfg.platform_params.update(project="my-proj", zone="us-central2-b",
+                               cluster="demo-cluster")
+    objs = render_component(cfg, ComponentSpec("monitoring"))
+    deploy = next(o for o in objs if o["kind"] == "Deployment")
+    ctrs = deploy["spec"]["template"]["spec"]["containers"]
+    sidecar = next(c for c in ctrs if c["name"] == "stackdriver-sidecar")
+    assert "--stackdriver.project-id=my-proj" in sidecar["args"]
+    assert any("cluster-name=demo-cluster" in a for a in sidecar["args"])
 
 
 def test_tensorboard_gcs_and_istio(config):
