@@ -249,6 +249,10 @@ def cmd_gc(args) -> int:
     for api, kind in sorted(kinds):
         if kind == "Namespace":
             continue  # never gc the namespace out from under the app
+        if kind == "PersistentVolumeClaim" and not args.include_pvcs:
+            # PVCs hold state (training logs, the model registry);
+            # pruning one deletes data, not just config — opt-in only
+            continue
         try:
             observed.extend(client.list(api, kind,
                                         label_selector=selector))
@@ -403,6 +407,9 @@ def build_parser() -> argparse.ArgumentParser:
                  "prune cluster objects no longer in the manifests")
     sp.add_argument("--dry-run", action="store_true",
                     help="list stale objects without deleting")
+    sp.add_argument("--include-pvcs", action="store_true",
+                    help="also prune stale PersistentVolumeClaims "
+                         "(DELETES THE DATA they hold)")
     sp.add_argument("--server", default=None,
                     help="API server URL (default: in-cluster or fake)")
     sp.add_argument("--insecure", action="store_true",

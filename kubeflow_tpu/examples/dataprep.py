@@ -1,0 +1,82 @@
+"""In-container entrypoint for DataPrepJob mappers/reducers.
+
+The spark-parity job's executor image: the operator injects the
+``KFTPU_PREP_*`` env contract (:mod:`kubeflow_tpu.operators.dataprep`)
+and this module runs the stage named by ``--stage`` with a built-in
+record transform — or import :mod:`kubeflow_tpu.data.prep` directly for
+custom transforms.
+
+Built-in transforms (all float32 record files, ``--record-len`` wide):
+
+- ``normalize``  — per-feature standardize to mean 0 / std 1 (stats per
+  shard for map; global for reduce);
+- ``scale``      — multiply by ``--factor``;
+- ``identity``   — copy (useful to re-shard via the reduce stage).
+
+Example CR (see also docs/QUICKSTART.md §6b)::
+
+    dataprep_job("prep", ns, {
+        "image": "kubeflow-tpu/platform:v1alpha1",
+        "command": ["python", "-m", "kubeflow_tpu.examples.dataprep"],
+        "args": ["--stage", "map", "--transform", "normalize",
+                 "--record-len", "16"],
+        "numShards": 64, "workers": 8,
+        "input": "/data/raw", "output": "/data/ready",
+        "reduce": {"args": ["--stage", "reduce", "--record-len", "16",
+                            "--out-shards", "8"]},
+    })
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from kubeflow_tpu.data import prep
+
+
+def _transform(name: str, factor: float):
+    if name == "normalize":
+        def normalize(x: np.ndarray) -> np.ndarray:
+            mu = x.mean(axis=0, keepdims=True)
+            sd = x.std(axis=0, keepdims=True)
+            return (x - mu) / np.maximum(sd, 1e-6)
+
+        return normalize
+    if name == "scale":
+        return lambda x: x * factor
+    if name == "identity":
+        return lambda x: x
+    raise SystemExit(f"unknown transform {name!r}")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--stage", choices=("map", "reduce"), required=True)
+    p.add_argument("--transform", default="identity",
+                   help="normalize|scale|identity")
+    p.add_argument("--factor", type=float, default=1.0)
+    p.add_argument("--record-len", type=int, required=True)
+    p.add_argument("--out-shards", type=int, default=1,
+                   help="final shard count (reduce stage)")
+    args = p.parse_args(argv)
+
+    ctx = prep.PrepContext.from_env()
+    fn = _transform(args.transform, args.factor)
+    if args.stage == "map":
+        written = prep.run_map(ctx, fn, record_len=args.record_len)
+        print(f"mapped shards {list(ctx.shards)} -> {len(written)} files")
+    else:
+        # reduce applies the transform globally only for normalize (its
+        # per-shard map stats are approximations; the reduce recomputes
+        # exact global stats), otherwise it just merges + re-shards
+        gfn = fn if args.transform == "normalize" else None
+        written = prep.run_reduce(ctx, gfn, record_len=args.record_len,
+                                  out_shards=args.out_shards)
+        print(f"reduced {ctx.num_shards} shards -> {len(written)} final")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

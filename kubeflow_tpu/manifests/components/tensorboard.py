@@ -29,6 +29,11 @@ DEFAULTS: Dict[str, Any] = {
                                  # (set False to bind an existing claim,
                                  # e.g. nfs-storage's RWX one)
     "pvc_size": "10Gi",
+    # RWO shares writer (trainer) and reader (tensorboard) only when they
+    # land on one node; multi-node clusters should bind an RWX claim
+    # instead (nfs-storage component) or set this to ReadWriteMany where
+    # the storage class supports it
+    "pvc_access_mode": "ReadWriteOnce",
     "port": 80,
     "target_port": 6006,
     "replicas": 1,
@@ -96,7 +101,7 @@ def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
             "kind": "PersistentVolumeClaim",
             "metadata": o.metadata(params["pvc"], ns),
             "spec": {
-                "accessModes": ["ReadWriteOnce"],
+                "accessModes": [params["pvc_access_mode"]],
                 "resources": {"requests": {"storage": params["pvc_size"]}},
             },
         })

@@ -161,6 +161,34 @@ def test_gc_prunes_stale_objects(tmp_path):
     assert "unrelated" in names  # unlabeled objects are never touched
 
 
+def test_gc_spares_pvcs_by_default(tmp_path):
+    """A stale labeled PVC holds DATA — gc must not touch it without the
+    explicit --include-pvcs opt-in."""
+    app = str(tmp_path / "app")
+    state = str(tmp_path / "state.json")
+    run_ctl("init", app, "--preset", "minimal", "--name", "demo",
+            cwd=str(tmp_path))
+    run_ctl("generate", app, cwd=str(tmp_path))
+    run_ctl("apply", app, "k8s", "--fake-state", state, cwd=str(tmp_path))
+
+    from kubeflow_tpu.k8s.fakefile import FileBackedFakeClient
+
+    client = FileBackedFakeClient(state)
+    client.create({"apiVersion": "v1", "kind": "PersistentVolumeClaim",
+                   "metadata": {"name": "old-logs", "namespace": "kubeflow",
+                                "labels": {PART_OF_LABEL: "demo"}},
+                   "spec": {}})
+    r = run_ctl("gc", app, "--fake-state", state, cwd=str(tmp_path))
+    assert r.returncode == 0 and "pruned 0" in r.stdout
+    client = FileBackedFakeClient(state)
+    assert client.get("v1", "PersistentVolumeClaim", "kubeflow",
+                      "old-logs")
+
+    r = run_ctl("gc", app, "--include-pvcs", "--fake-state", state,
+                cwd=str(tmp_path))
+    assert "pruned 1" in r.stdout
+
+
 # -- ctl scaffold ----------------------------------------------------------
 
 def test_scaffold_writes_working_component(tmp_path):

@@ -378,6 +378,46 @@ def test_map_is_idempotent_per_shard(tmp_path):
         "shard-00002.f32", "shard-00003.f32"]
 
 
+def test_example_entrypoint_map_reduce(tmp_path, monkeypatch, capsys):
+    """The in-container example module runs both stages off the env
+    contract alone — what the operator-created pods execute."""
+    from kubeflow_tpu.examples.dataprep import main
+
+    rng = np.random.default_rng(3)
+    records = rng.normal(5.0, 3.0, size=(64, 8)).astype(np.float32)
+    write_shards(str(tmp_path / "in"), records, shards=4)
+    base_env = {"KFTPU_PREP_NUM_WORKERS": "2", "KFTPU_PREP_NUM_SHARDS": "4",
+                "KFTPU_PREP_INPUT": str(tmp_path / "in"),
+                "KFTPU_PREP_OUTPUT": str(tmp_path / "out")}
+    for wid in range(2):
+        for k, v in {**base_env, "KFTPU_PREP_WORKER_ID": str(wid)}.items():
+            monkeypatch.setenv(k, v)
+        assert main(["--stage", "map", "--transform", "normalize",
+                     "--record-len", "8"]) == 0
+    for k, v in base_env.items():
+        monkeypatch.setenv(k, v)
+    assert main(["--stage", "reduce", "--transform", "normalize",
+                 "--record-len", "8", "--out-shards", "2"]) == 0
+    final = read_shards(str(tmp_path / "out" / "final"), record_len=8)
+    np.testing.assert_allclose(final.mean(axis=0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(final.std(axis=0), 1.0, atol=1e-3)
+
+
+def test_controller_restart_preserves_retry_budget(client):
+    """Retry accounting lives in CR status, so a restarted operator keeps
+    counting where the old one stopped (no infinite retry loops)."""
+    op1 = DataPrepOperator(client)
+    make_job(client, workers=1, num_shards=1, max_retries=1)
+    op1.reconcile("default", "prep")
+    set_phase(client, pods(client, "map")[0], "Failed")
+    op1.reconcile("default", "prep")  # burns the single retry
+
+    op2 = DataPrepOperator(client)  # fresh controller, same cluster
+    set_phase(client, pods(client, "map")[0], "Failed")
+    op2.reconcile("default", "prep")
+    assert get_job(client)["status"]["phase"] == "Failed"
+
+
 # -- manifest --------------------------------------------------------------
 
 def test_dataprep_component_golden():
