@@ -345,6 +345,70 @@ def cmd_scaffold(args) -> int:
     return 0
 
 
+def cmd_promote(args) -> int:
+    """Promote a model version to production: registry stage transition
+    plus the serving traffic split, in one step.
+
+    The modeldb↔tf-serving glue the reference never had: the registry
+    records WHICH version is production
+    (:mod:`kubeflow_tpu.serving.registry`), the serving component's
+    ``traffic_split`` decides WHERE traffic goes — promote keeps them in
+    lockstep. ``--canary N`` sends N% to the new version and the rest to
+    the current production version instead of cutting over.
+    """
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    config = _app_config(args.app_dir)
+    spec = next((c for c in config.components if c.name == "serving"), None)
+    if spec is None:
+        raise SystemExit("app has no 'serving' component to promote into")
+    version = f"v{int(args.version)}"
+    if args.canary:
+        if not 0 < args.canary < 100:
+            raise SystemExit("--canary must be in (0, 100)")
+        current = spec.params.get("traffic_split") or {}
+        stable = next(
+            (v for v, w in sorted(current.items(), key=lambda kv: -kv[1])
+             if v != version),
+            spec.params.get("version", "v1"))
+        if stable == version:
+            raise SystemExit(
+                f"{version} is already the only serving version — a "
+                "canary against itself is meaningless; promote without "
+                "--canary")
+        split = {stable: 100 - args.canary, version: args.canary}
+    else:
+        split = {version: 100}
+
+    # registry first: a rejected transition must not leave app.yaml
+    # routing traffic to a version the registry refused
+    if args.registry_url:
+        url = (f"{args.registry_url.rstrip('/')}/api/registry/models/"
+               f"{args.model}/versions/{int(args.version)}:transition")
+        req = urllib.request.Request(
+            url, data=_json.dumps({"stage": "production"}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                entry = _json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            raise SystemExit(
+                f"registry transition failed: {e.code} {e.read().decode()}")
+        except (urllib.error.URLError, OSError) as e:
+            raise SystemExit(f"registry unreachable: {e}")
+        print(f"registry: {args.model} v{entry['version']} -> "
+              f"{entry['stage']}")
+
+    spec.params["traffic_split"] = split
+    with open(os.path.join(args.app_dir, APP_YAML), "w") as f:
+        f.write(config.to_yaml())
+    print(f"serving traffic_split -> {split}")
+    print("run `ctl generate` + `ctl apply` to roll the split out")
+    return 0
+
+
 def cmd_version(args) -> int:
     print(f"ctl (kubeflow_tpu) {kubeflow_tpu.__version__}")
     return 0
@@ -416,6 +480,17 @@ def build_parser() -> argparse.ArgumentParser:
                     help="skip TLS verification")
     sp.add_argument("--fake-state", default=None,
                     help="file-backed fake cluster state path")
+
+    sp = app_cmd("promote", cmd_promote,
+                 "promote a model version: registry stage + traffic split")
+    sp.add_argument("model", help="registry model name")
+    sp.add_argument("version", type=int, help="version number to promote")
+    sp.add_argument("--canary", type=int, default=0, metavar="PCT",
+                    help="send PCT%% to the new version instead of 100")
+    sp.add_argument("--registry-url", default=None,
+                    help="model-registry base URL (e.g. through the edge "
+                         "proxy: https://host/registry); omitted = only "
+                         "the serving split is updated")
 
     sp = sub.add_parser("scaffold", help="generate a new component stub")
     sp.add_argument("name", help="component name (DNS-1123 label)")

@@ -8,9 +8,11 @@ custom transforms.
 
 Built-in transforms (all float32 record files, ``--record-len`` wide):
 
-- ``normalize``  — per-feature standardize to mean 0 / std 1 (stats per
-  shard for map; global for reduce);
-- ``scale``      — multiply by ``--factor``;
+- ``normalize``  — per-feature standardize to mean 0 / std 1 with EXACT
+  global stats: mappers copy shards through, the reduce stage computes
+  the statistics over the full merged set (per-shard normalization at
+  map time would destroy cross-shard scale information);
+- ``scale``      — multiply by ``--factor`` (elementwise: map-local);
 - ``identity``   — copy (useful to re-shard via the reduce stage).
 
 Example CR (see also docs/QUICKSTART.md §6b)::
@@ -65,12 +67,13 @@ def main(argv=None) -> int:
     ctx = prep.PrepContext.from_env()
     fn = _transform(args.transform, args.factor)
     if args.stage == "map":
-        written = prep.run_map(ctx, fn, record_len=args.record_len)
+        # normalize is a GLOBAL transform: mapping with per-shard stats
+        # would squash cross-shard scale/offset irreversibly before the
+        # reduce sees the data — mappers copy, the reduce normalizes
+        map_fn = (lambda x: x) if args.transform == "normalize" else fn
+        written = prep.run_map(ctx, map_fn, record_len=args.record_len)
         print(f"mapped shards {list(ctx.shards)} -> {len(written)} files")
     else:
-        # reduce applies the transform globally only for normalize (its
-        # per-shard map stats are approximations; the reduce recomputes
-        # exact global stats), otherwise it just merges + re-shards
         gfn = fn if args.transform == "normalize" else None
         written = prep.run_reduce(ctx, gfn, record_len=args.record_len,
                                   out_shards=args.out_shards)

@@ -1,0 +1,110 @@
+"""`ctl promote` tests: registry stage + serving traffic split lockstep."""
+
+import os
+import subprocess
+import sys
+
+import yaml
+
+from kubeflow_tpu.serving.registry import ModelRegistry, RegistryService
+from kubeflow_tpu.utils.jsonhttp import serve_json
+
+
+def run_ctl(*argv, cwd):
+    return subprocess.run(
+        [sys.executable, "-m", "kubeflow_tpu.cli", *argv],
+        capture_output=True, text=True, cwd=cwd,
+        env={**os.environ, "PYTHONPATH": "/root/repo"})
+
+
+def serving_params(app_dir):
+    with open(os.path.join(app_dir, "app.yaml")) as f:
+        doc = yaml.safe_load(f)
+    comp = next(c for c in doc["spec"]["components"]
+                if c["name"] == "serving")
+    return comp.get("params", {})
+
+
+def test_promote_cutover_and_canary(tmp_path):
+    app = str(tmp_path / "app")
+    assert run_ctl("init", app, "--preset", "standard", "--name", "demo",
+                   cwd=str(tmp_path)).returncode == 0
+
+    r = run_ctl("promote", app, "resnet", "2", cwd=str(tmp_path))
+    assert r.returncode == 0, r.stderr
+    assert serving_params(app)["traffic_split"] == {"v2": 100}
+
+    # canary on top of the current production version
+    r = run_ctl("promote", app, "resnet", "3", "--canary", "10",
+                cwd=str(tmp_path))
+    assert r.returncode == 0, r.stderr
+    assert serving_params(app)["traffic_split"] == {"v2": 90, "v3": 10}
+
+    # the rendered manifests carry the weighted Istio VS
+    assert run_ctl("generate", app, cwd=str(tmp_path)).returncode == 0
+    vs_files = [f for f in os.listdir(os.path.join(app, "manifests"))
+                if "virtualservice" in f]
+    assert vs_files
+
+
+def test_promote_with_live_registry(tmp_path):
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    reg.register("resnet", 1)
+    reg.register("resnet", 2)
+    httpd = serve_json(RegistryService(reg).handle, 0, background=True)
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        app = str(tmp_path / "app")
+        run_ctl("init", app, "--preset", "standard", "--name", "demo",
+                cwd=str(tmp_path))
+        r = run_ctl("promote", app, "resnet", "2",
+                    "--registry-url", url, cwd=str(tmp_path))
+        assert r.returncode == 0, r.stderr
+        assert reg.production("resnet")["version"] == 2
+
+        # unknown version: registry rejects, exit non-zero
+        r = run_ctl("promote", app, "resnet", "9",
+                    "--registry-url", url, cwd=str(tmp_path))
+        assert r.returncode != 0
+    finally:
+        httpd.shutdown()
+
+
+def test_canary_onto_only_version_rejected(tmp_path):
+    """Canarying the version that is already the only one would write a
+    split summing to the canary percent — refuse it."""
+    app = str(tmp_path / "app")
+    run_ctl("init", app, "--preset", "standard", "--name", "demo",
+            cwd=str(tmp_path))
+    r = run_ctl("promote", app, "m", "1", "--canary", "10",
+                cwd=str(tmp_path))
+    assert r.returncode != 0 and "itself" in r.stderr
+    assert "traffic_split" not in serving_params(app)
+
+
+def test_failed_registry_transition_leaves_config_untouched(tmp_path):
+    """Registry-first ordering: a rejected transition must not leave
+    app.yaml routing traffic to the refused version."""
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    reg.register("m", 1)
+    httpd = serve_json(RegistryService(reg).handle, 0, background=True)
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        app = str(tmp_path / "app")
+        run_ctl("init", app, "--preset", "standard", "--name", "demo",
+                cwd=str(tmp_path))
+        r = run_ctl("promote", app, "m", "9", "--registry-url", url,
+                    cwd=str(tmp_path))
+        assert r.returncode != 0
+        assert "traffic_split" not in serving_params(app)
+    finally:
+        httpd.shutdown()
+
+
+def test_promote_requires_serving_component(tmp_path):
+    app = str(tmp_path / "app")
+    run_ctl("init", app, "--preset", "minimal", "--name", "demo",
+            cwd=str(tmp_path))
+    r = run_ctl("promote", app, "m", "1", cwd=str(tmp_path))
+    assert r.returncode != 0
+    assert "serving" in r.stderr
