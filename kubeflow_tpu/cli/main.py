@@ -383,12 +383,15 @@ def cmd_promote(args) -> int:
         split = {version: 100}
 
     # registry first: a rejected transition must not leave app.yaml
-    # routing traffic to a version the registry refused
+    # routing traffic to a version the registry refused. A canary is
+    # marked STAGING — production stays on the version carrying the
+    # bulk of the traffic until the full cutover.
+    stage = "staging" if args.canary else "production"
     if args.registry_url:
         url = (f"{args.registry_url.rstrip('/')}/api/registry/models/"
                f"{args.model}/versions/{int(args.version)}:transition")
         req = urllib.request.Request(
-            url, data=_json.dumps({"stage": "production"}).encode(),
+            url, data=_json.dumps({"stage": stage}).encode(),
             headers={"Content-Type": "application/json"})
         try:
             with urllib.request.urlopen(req, timeout=30) as resp:
@@ -406,6 +409,54 @@ def cmd_promote(args) -> int:
         f.write(config.to_yaml())
     print(f"serving traffic_split -> {split}")
     print("run `ctl generate` + `ctl apply` to roll the split out")
+    return 0
+
+
+def cmd_status(args) -> int:
+    """One-look deployment health from the cluster: the Application
+    aggregate (grouped component readiness) plus live TpuJobs — the CLI
+    face of the dashboard's health panel."""
+    from kubeflow_tpu.operators.application import (
+        API_VERSION as APP_API,
+        APPLICATION_KIND,
+    )
+
+    config = _app_config(args.app_dir)
+    _sync_fake_state(config, args)
+    client = _client(args)
+    ns = config.namespace
+
+    apps = client.list(APP_API, APPLICATION_KIND, ns)
+    if not apps:
+        print(f"no Application CRs in {ns!r} — is the 'application' "
+              "component deployed (and the controller running)?")
+    for app in apps:
+        status = app.get("status", {}) or {}
+        print(f"application {app['metadata']['name']}: "
+              f"{status.get('phase', 'Unknown')} "
+              f"({status.get('ready', '—')} components ready)")
+        for comp in status.get("components", []):
+            if not comp.get("ready") or args.verbose:
+                mark = "ok" if comp.get("ready") else "NOT READY"
+                print(f"  {comp['kind']}/{comp['name']}: {mark} "
+                      f"({comp.get('detail', '')})")
+
+    from kubeflow_tpu.manifests.components.tpujob_operator import (
+        API_VERSION as JOB_API,
+        TPUJOB_KIND,
+    )
+
+    jobs = client.list(JOB_API, TPUJOB_KIND, ns)
+    if jobs:
+        print(f"tpujobs in {ns!r}:")
+        for job in jobs:
+            status = job.get("status", {}) or {}
+            workers = status.get("workers", {}) or {}
+            print(f"  {job['metadata']['name']}: "
+                  f"{status.get('phase', 'Pending')} "
+                  f"(workers {workers.get('Running', 0)} running / "
+                  f"{workers.get('Failed', 0)} failed, "
+                  f"restarts {status.get('restarts', 0)})")
     return 0
 
 
@@ -480,6 +531,18 @@ def build_parser() -> argparse.ArgumentParser:
                     help="skip TLS verification")
     sp.add_argument("--fake-state", default=None,
                     help="file-backed fake cluster state path")
+
+    sp = app_cmd("status", cmd_status,
+                 "deployment health: Application aggregate + TpuJobs")
+    sp.add_argument("--server", default=None,
+                    help="API server URL (default: in-cluster or fake)")
+    sp.add_argument("--insecure", action="store_true",
+                    help="skip TLS verification")
+    sp.add_argument("--fake-state", default=None,
+                    help="file-backed fake cluster state path")
+    sp.add_argument("-v", "--verbose", action="store_true",
+                    default=argparse.SUPPRESS,
+                    help="also list healthy components")
 
     sp = app_cmd("promote", cmd_promote,
                  "promote a model version: registry stage + traffic split")

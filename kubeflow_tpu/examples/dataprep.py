@@ -24,7 +24,10 @@ Example CR (see also docs/QUICKSTART.md §6b)::
                  "--record-len", "16"],
         "numShards": 64, "workers": 8,
         "input": "/data/raw", "output": "/data/ready",
-        "reduce": {"args": ["--stage", "reduce", "--record-len", "16",
+        # normalize is applied BY THE REDUCE (global stats): its args
+        # must carry the transform too, or the output stays raw
+        "reduce": {"args": ["--stage", "reduce", "--transform",
+                            "normalize", "--record-len", "16",
                             "--out-shards", "8"]},
     })
 """
@@ -70,7 +73,12 @@ def main(argv=None) -> int:
         # normalize is a GLOBAL transform: mapping with per-shard stats
         # would squash cross-shard scale/offset irreversibly before the
         # reduce sees the data — mappers copy, the reduce normalizes
-        map_fn = (lambda x: x) if args.transform == "normalize" else fn
+        map_fn = fn
+        if args.transform == "normalize":
+            map_fn = lambda x: x  # noqa: E731
+            print("NOTE: normalize applies at the reduce stage (global "
+                  "stats); the job's reduce args must include "
+                  "'--transform normalize' or the output stays raw")
         written = prep.run_map(ctx, map_fn, record_len=args.record_len)
         print(f"mapped shards {list(ctx.shards)} -> {len(written)} files")
     else:

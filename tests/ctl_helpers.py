@@ -1,0 +1,16 @@
+"""Shared helper for tests that drive the ctl CLI as a subprocess."""
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_ctl(*argv, cwd):
+    return subprocess.run(
+        [sys.executable, "-m", "kubeflow_tpu.cli", *argv],
+        capture_output=True, text=True, cwd=cwd,
+        env={**os.environ,
+             "PYTHONPATH": REPO_ROOT + os.pathsep
+             + os.environ.get("PYTHONPATH", "")})

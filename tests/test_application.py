@@ -118,11 +118,7 @@ def test_application_component_renders_own_cr():
 
 # -- ctl gc ----------------------------------------------------------------
 
-def run_ctl(*argv, cwd):
-    return subprocess.run(
-        [sys.executable, "-m", "kubeflow_tpu.cli", *argv],
-        capture_output=True, text=True, cwd=cwd,
-        env={**os.environ, "PYTHONPATH": "/root/repo"})
+from ctl_helpers import run_ctl  # noqa: E402 — section-local import
 
 
 def test_gc_prunes_stale_objects(tmp_path):
@@ -187,6 +183,32 @@ def test_gc_spares_pvcs_by_default(tmp_path):
     r = run_ctl("gc", app, "--include-pvcs", "--fake-state", state,
                 cwd=str(tmp_path))
     assert "pruned 1" in r.stdout
+
+
+def test_ctl_status_reports_application_health(tmp_path):
+    app = str(tmp_path / "app")
+    state = str(tmp_path / "state.json")
+    run_ctl("init", app, "--preset", "minimal", "--name", "demo",
+            cwd=str(tmp_path))
+    run_ctl("generate", app, cwd=str(tmp_path))
+    run_ctl("apply", app, "k8s", "--fake-state", state, cwd=str(tmp_path))
+
+    from kubeflow_tpu.k8s.fakefile import FileBackedFakeClient
+    from kubeflow_tpu.operators.application import application
+
+    # minimal preset has no application component: plant the CR and
+    # aggregate, as the controller would
+    client = FileBackedFakeClient(state)
+    client.create(application("demo", "kubeflow",
+                              selector={PART_OF_LABEL: "demo"}))
+    from kubeflow_tpu.operators.application import ApplicationController
+
+    ApplicationController(client).reconcile("kubeflow", "demo")
+
+    r = run_ctl("status", app, "--fake-state", state, cwd=str(tmp_path))
+    assert r.returncode == 0, r.stderr
+    assert "application demo:" in r.stdout
+    assert "NOT READY" in r.stdout  # fake deployments have no replicas
 
 
 # -- ctl scaffold ----------------------------------------------------------

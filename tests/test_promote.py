@@ -1,20 +1,12 @@
 """`ctl promote` tests: registry stage + serving traffic split lockstep."""
 
 import os
-import subprocess
-import sys
 
 import yaml
 
+from ctl_helpers import run_ctl
 from kubeflow_tpu.serving.registry import ModelRegistry, RegistryService
 from kubeflow_tpu.utils.jsonhttp import serve_json
-
-
-def run_ctl(*argv, cwd):
-    return subprocess.run(
-        [sys.executable, "-m", "kubeflow_tpu.cli", *argv],
-        capture_output=True, text=True, cwd=cwd,
-        env={**os.environ, "PYTHONPATH": "/root/repo"})
 
 
 def serving_params(app_dir):
@@ -60,6 +52,15 @@ def test_promote_with_live_registry(tmp_path):
         r = run_ctl("promote", app, "resnet", "2",
                     "--registry-url", url, cwd=str(tmp_path))
         assert r.returncode == 0, r.stderr
+        assert reg.production("resnet")["version"] == 2
+
+        # canary marks STAGING — production stays on the bulk-traffic
+        # version until full cutover
+        reg.register("resnet", 3)
+        r = run_ctl("promote", app, "resnet", "3", "--canary", "10",
+                    "--registry-url", url, cwd=str(tmp_path))
+        assert r.returncode == 0, r.stderr
+        assert reg.get("resnet", 3)["stage"] == "staging"
         assert reg.production("resnet")["version"] == 2
 
         # unknown version: registry rejects, exit non-zero
