@@ -426,7 +426,10 @@ def cmd_status(args) -> int:
     client = _client(args)
     ns = config.namespace
 
-    apps = client.list(APP_API, APPLICATION_KIND, ns)
+    try:
+        apps = client.list(APP_API, APPLICATION_KIND, ns)
+    except ApiError:
+        apps = []  # CRD not installed on this cluster
     if not apps:
         print(f"no Application CRs in {ns!r} — is the 'application' "
               "component deployed (and the controller running)?")
@@ -446,7 +449,10 @@ def cmd_status(args) -> int:
         TPUJOB_KIND,
     )
 
-    jobs = client.list(JOB_API, TPUJOB_KIND, ns)
+    try:
+        jobs = client.list(JOB_API, TPUJOB_KIND, ns)
+    except ApiError:
+        jobs = []  # CRD not installed on this cluster
     if jobs:
         print(f"tpujobs in {ns!r}:")
         for job in jobs:

@@ -20,7 +20,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubeflow_tpu.serving import predict_pb2 as pb
-from kubeflow_tpu.serving.server import ModelRepository, _pad_batch
+from kubeflow_tpu.serving.server import (
+    ModelRepository,
+    _pad_batch,
+    run_generate,
+)
 from kubeflow_tpu.utils import DEFAULT_REGISTRY
 
 log = logging.getLogger(__name__)
@@ -99,6 +103,36 @@ class PredictionServicer:
         return pb.PredictResponse(outputs=array_to_tensor(out),
                                   model_version=model.version)
 
+    def Generate(self, request: pb.GenerateRequest,
+                 context: grpc.ServicerContext) -> pb.GenerateResponse:
+        """Autoregressive generation over binary prompt tensors — the
+        fast-path twin of the REST ``:generate`` endpoint (shared core:
+        ``kubeflow_tpu.serving.server.run_generate``)."""
+        model = self.repo.get(request.model_name, request.version or None)
+        if model is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"model {request.model_name!r} not found")
+        try:
+            prompt = tensor_to_array(request.prompt)
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        body = {
+            "prompt_tokens": prompt,
+            "max_new_tokens": request.max_new_tokens or 16,
+            "temperature": request.temperature,
+            "seed": request.seed,
+            "true_len": request.true_len,
+        }
+        code, payload = run_generate(model, body, self.max_batch_size,
+                                     model_name=request.model_name)
+        if code != 200:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          payload.get("error", "generate failed"))
+        return pb.GenerateResponse(
+            tokens=array_to_tensor(np.asarray(payload["tokens"],
+                                              np.int32)),
+            model_version=int(payload["model_version"]))
+
     def GetModelStatus(self, request: pb.ModelStatusRequest,
                        context: grpc.ServicerContext) -> pb.ModelStatusResponse:
         status = self.repo.status(request.model_name)
@@ -129,6 +163,10 @@ def _handlers(servicer: PredictionServicer) -> grpc.GenericRpcHandler:
             servicer.ListModels,
             request_deserializer=pb.ListModelsRequest.FromString,
             response_serializer=pb.ListModelsResponse.SerializeToString),
+        "Generate": grpc.unary_unary_rpc_method_handler(
+            servicer.Generate,
+            request_deserializer=pb.GenerateRequest.FromString,
+            response_serializer=pb.GenerateResponse.SerializeToString),
     }
     return grpc.method_handlers_generic_handler(SERVICE_NAME, method_handlers)
 
@@ -176,6 +214,10 @@ class PredictClient:
             base + "ListModels",
             request_serializer=pb.ListModelsRequest.SerializeToString,
             response_deserializer=pb.ListModelsResponse.FromString)
+        self._generate = self.channel.unary_unary(
+            base + "Generate",
+            request_serializer=pb.GenerateRequest.SerializeToString,
+            response_deserializer=pb.GenerateResponse.FromString)
 
     def predict(self, model_name: str, inputs: np.ndarray,
                 version: Optional[int] = None,
@@ -184,6 +226,18 @@ class PredictClient:
             model_name=model_name, version=version or 0,
             inputs=array_to_tensor(np.asarray(inputs))), timeout=timeout)
         return tensor_to_array(resp.outputs), resp.model_version
+
+    def generate(self, model_name: str, prompt: np.ndarray, *,
+                 max_new_tokens: int = 16, true_len: int = 0,
+                 temperature: float = 0.0, seed: int = 0,
+                 version: Optional[int] = None,
+                 timeout: float = 300.0) -> Tuple[np.ndarray, int]:
+        resp = self._generate(pb.GenerateRequest(
+            model_name=model_name, version=version or 0,
+            prompt=array_to_tensor(np.asarray(prompt, np.int32)),
+            true_len=true_len, max_new_tokens=max_new_tokens,
+            temperature=temperature, seed=seed), timeout=timeout)
+        return tensor_to_array(resp.tokens), resp.model_version
 
     def model_status(self, model_name: str, timeout: float = 30.0):
         resp = self._status(pb.ModelStatusRequest(model_name=model_name),

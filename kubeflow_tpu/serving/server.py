@@ -49,6 +49,109 @@ _gen_latency = DEFAULT_REGISTRY.gauge(
 _PAD_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
 
 
+def run_generate(model, body: Dict[str, Any], max_batch_size: int, *,
+                 model_name: str = "") -> Tuple[int, Dict[str, Any]]:
+    """The generate core shared by the REST ``:generate`` endpoint and
+    the gRPC ``Generate`` RPC: validation, prompt/new-token bucketing,
+    the compiled decode call. Returns (http-style status, payload)."""
+    if model.generate is None:
+        return 400, {"error": f"model {model_name!r} (kind "
+                              f"{model.kind!r}) does not support generate"}
+    prompts = body.get("prompt_tokens")
+    if prompts is None:
+        return 400, {"error": "request must carry 'prompt_tokens' "
+                              "(batch of int token lists)"}
+    try:
+        max_new = int(body.get("max_new_tokens", 16))
+        temperature = float(body.get("temperature", 0.0))
+        seed = int(body.get("seed", 0))
+        # iterating also rejects scalars/0-d tensors (TypeError → 400)
+        lens = {len(p) for p in prompts}
+        if not lens:
+            return 400, {"error": "prompt_tokens batch is empty"}
+        if len(lens) != 1:
+            return 400, {"error": "all prompts in one call must share "
+                                  "a length (pad client-side or split "
+                                  "calls)"}
+        width = lens.pop()
+        true_len = int(body.get("true_len", 0)) or width
+        if not 1 <= true_len <= width:
+            return 400, {"error": f"true_len {true_len} must be in "
+                                  f"[1, {width}]"}
+        arr = np.asarray(prompts, dtype=np.int32)
+    except (TypeError, ValueError) as e:
+        return 400, {"error": f"bad prompt_tokens: {e}"}
+    if max_new < 1:
+        return 400, {"error": "max_new_tokens must be >= 1"}
+    if temperature < 0:
+        # a negative temperature silently inverts the distribution
+        return 400, {"error": "temperature must be >= 0"}
+    if arr.ndim != 2:
+        return 400, {"error": f"prompt_tokens must be a 2-D batch of "
+                              f"token lists, got shape {arr.shape}"}
+    if arr.shape[0] > max_batch_size:
+        return 400, {"error": f"batch {arr.shape[0]} exceeds max "
+                              f"{max_batch_size}"}
+    real = arr[:, :true_len]  # pad columns never reach the model
+    if model.vocab_size and (real.min() < 0
+                             or real.max() >= model.vocab_size):
+        # out-of-range ids would silently clamp in the embedding take
+        return 400, {"error": f"token ids must be in [0, "
+                              f"{model.vocab_size})"}
+    ctx = model.max_seq_len or 0
+
+    def pow2(n: int) -> int:
+        b = 1
+        while b < n:
+            b *= 2
+        return b
+
+    # prompt bucket: one compiled prefill per bucket, capped at the
+    # model context (3072-context models serve 2100-token prompts)
+    bucket = min(pow2(true_len), ctx)
+    # new-token bucket likewise (a client sweeping max_new_tokens
+    # must not mint unbounded compiled programs); decode the bucket,
+    # return the first max_new. Decode writes start at true_len (the
+    # cache index resets there), so the budget is ctx - true_len —
+    # NOT ctx - bucket, which would reject any prompt past half the
+    # context. The clamped value is rounded DOWN to a power of two:
+    # a raw ctx - true_len clamp would mint one compiled program per
+    # distinct prompt length near the context end.
+    budget = max(ctx - true_len, 0)
+    new_bucket = pow2(max_new)
+    while new_bucket > budget:
+        new_bucket //= 2
+    if new_bucket < max_new <= budget:
+        # the pow2 bucket doesn't fit but the exact ask does (prompt
+        # 29 + max_new 3 in a 32-context model): serve it exactly —
+        # a rare tail case, so the per-value compile is acceptable
+        new_bucket = max_new
+    if bucket < true_len or new_bucket < max_new:
+        return 400, {"error": f"prompt ({true_len}) + max_new_tokens "
+                              f"({max_new}) exceed the model context "
+                              f"({ctx}); cache writes past it would "
+                              "silently clamp"}
+    padded = np.zeros((arr.shape[0], bucket), np.int32)
+    padded[:, :true_len] = arr[:, :true_len]
+    # batch padded like the predict path: one compiled shape
+    padded, n = _pad_batch(padded, max_batch_size)
+    t0 = time.perf_counter()
+    try:
+        out = np.asarray(model.generate(
+            jnp.asarray(padded), jnp.int32(true_len), new_bucket,
+            jnp.float32(temperature), seed,
+            greedy=temperature == 0.0))[:n, :max_new]
+    except Exception as e:  # noqa: BLE001
+        return 400, {"error": f"generate failed: "
+                              f"{type(e).__name__}: {e}"}
+    dt = time.perf_counter() - t0
+    _gen_requests.inc(model=model_name)
+    _gen_latency.set(dt, model=model_name)
+    return 200, {"tokens": out.tolist(),
+                 "model_version": str(model.version),
+                 "tokens_per_sec": round(out.size / dt, 1)}
+
+
 def _pad_batch(arr: np.ndarray, max_batch: int) -> Tuple[np.ndarray, int]:
     """Pad the leading dim up to a fixed bucket to keep XLA shapes stable."""
     n = arr.shape[0]
@@ -241,96 +344,8 @@ class ModelServer:
         model = self.repo.get(name, version)
         if model is None:
             return 404, {"error": f"model {name!r} not found"}
-        if model.generate is None:
-            return 400, {"error": f"model {name!r} (kind {model.kind!r}) "
-                                  "does not support :generate"}
-        prompts = body.get("prompt_tokens")
-        if not prompts:
-            return 400, {"error": "body must contain 'prompt_tokens' "
-                                  "(batch of int token lists)"}
-        try:
-            max_new = int(body.get("max_new_tokens", 16))
-            temperature = float(body.get("temperature", 0.0))
-            seed = int(body.get("seed", 0))
-            lens = {len(p) for p in prompts}
-            if len(lens) != 1:
-                return 400, {"error": "all prompts in one call must share "
-                                      "a length (pad client-side or split "
-                                      "calls)"}
-            true_len = lens.pop()
-            if true_len < 1:
-                return 400, {"error": "empty prompt"}
-            arr = np.asarray(prompts, dtype=np.int32)
-        except (TypeError, ValueError) as e:
-            return 400, {"error": f"bad prompt_tokens: {e}"}
-        if max_new < 1:
-            return 400, {"error": "max_new_tokens must be >= 1"}
-        if temperature < 0:
-            # a negative temperature silently inverts the distribution
-            return 400, {"error": "temperature must be >= 0"}
-        if arr.ndim != 2:
-            return 400, {"error": f"prompt_tokens must be a 2-D batch of "
-                                  f"token lists, got shape {arr.shape}"}
-        if arr.shape[0] > self.max_batch_size:
-            return 400, {"error": f"batch {arr.shape[0]} exceeds max "
-                                  f"{self.max_batch_size}"}
-        if model.vocab_size and (arr.min() < 0
-                                 or arr.max() >= model.vocab_size):
-            # out-of-range ids would silently clamp in the embedding take
-            return 400, {"error": f"token ids must be in [0, "
-                                  f"{model.vocab_size})"}
-        ctx = model.max_seq_len or 0
-
-        def pow2(n: int) -> int:
-            b = 1
-            while b < n:
-                b *= 2
-            return b
-
-        # prompt bucket: one compiled prefill per bucket, capped at the
-        # model context (3072-context models serve 2100-token prompts)
-        bucket = min(pow2(true_len), ctx)
-        # new-token bucket likewise (a client sweeping max_new_tokens
-        # must not mint unbounded compiled programs); decode the bucket,
-        # return the first max_new. Decode writes start at true_len (the
-        # cache index resets there), so the budget is ctx - true_len —
-        # NOT ctx - bucket, which would reject any prompt past half the
-        # context. The clamped value is rounded DOWN to a power of two:
-        # a raw ctx - true_len clamp would mint one compiled program per
-        # distinct prompt length near the context end.
-        budget = max(ctx - true_len, 0)
-        new_bucket = pow2(max_new)
-        while new_bucket > budget:
-            new_bucket //= 2
-        if new_bucket < max_new <= budget:
-            # the pow2 bucket doesn't fit but the exact ask does (prompt
-            # 29 + max_new 3 in a 32-context model): serve it exactly —
-            # a rare tail case, so the per-value compile is acceptable
-            new_bucket = max_new
-        if bucket < true_len or new_bucket < max_new:
-            return 400, {"error": f"prompt ({true_len}) + max_new_tokens "
-                                  f"({max_new}) exceed the model context "
-                                  f"({ctx}); cache writes past it would "
-                                  "silently clamp"}
-        padded = np.zeros((arr.shape[0], bucket), np.int32)
-        padded[:, :true_len] = arr
-        # batch padded like the predict path: one compiled shape
-        padded, n = _pad_batch(padded, self.max_batch_size)
-        t0 = time.perf_counter()
-        try:
-            out = np.asarray(model.generate(
-                jnp.asarray(padded), jnp.int32(true_len), new_bucket,
-                jnp.float32(temperature), seed,
-                greedy=temperature == 0.0))[:n, :max_new]
-        except Exception as e:  # noqa: BLE001
-            return 400, {"error": f"generate failed: "
-                                  f"{type(e).__name__}: {e}"}
-        dt = time.perf_counter() - t0
-        _gen_requests.inc(model=name)
-        _gen_latency.set(dt, model=name)
-        return 200, {"tokens": out.tolist(),
-                     "model_version": str(model.version),
-                     "tokens_per_sec": round(out.size / dt, 1)}
+        return run_generate(model, body, self.max_batch_size,
+                            model_name=name)
 
     # -- HTTP plumbing -----------------------------------------------------
 

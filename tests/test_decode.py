@@ -187,6 +187,50 @@ def test_serving_generate_endpoint(tmp_path, setup):
         srv.stop()
 
 
+def test_grpc_generate_matches_rest(tmp_path, setup):
+    """The gRPC Generate RPC (binary prompt tensors) and the REST
+    :generate endpoint share one core — same tokens out."""
+    from kubeflow_tpu.serving import ModelServer, export_model
+    from kubeflow_tpu.serving.grpc_server import PredictClient, serve_grpc
+
+    config, model, params, prompt = setup
+    export_model(str(tmp_path / "lm"), "transformer", params, version=1,
+                 config=transformer_export_config(config))
+    srv = ModelServer(str(tmp_path), port=0, poll_interval_s=3600)
+    srv.start()
+    grpc_srv, grpc_port = serve_grpc(srv.repo, 0)
+    client = PredictClient(f"127.0.0.1:{grpc_port}")
+    try:
+        tokens, version = client.generate(
+            "lm", np.asarray(prompt), max_new_tokens=4)
+        want = full_forward_greedy(model, params, prompt, 4)
+        np.testing.assert_array_equal(tokens, want)
+        assert version == 1
+        # right-padded prompt with an out-of-vocab PAD id: the pad
+        # columns never reach the model, so this must succeed
+        padded = np.full((prompt.shape[0], 8), -1, np.int32)
+        padded[:, :prompt.shape[1]] = prompt
+        tokens_p, _ = client.generate("lm", padded, max_new_tokens=4,
+                                      true_len=prompt.shape[1])
+        np.testing.assert_array_equal(tokens_p, want)
+
+        # errors surface as INVALID_ARGUMENT with the core's message
+        import grpc as _grpc
+
+        with pytest.raises(_grpc.RpcError) as ei:
+            client.generate("lm", np.asarray(prompt), max_new_tokens=999)
+        assert ei.value.code() == _grpc.StatusCode.INVALID_ARGUMENT
+        assert "context" in ei.value.details()
+        # a scalar prompt tensor is a clean INVALID_ARGUMENT, not UNKNOWN
+        with pytest.raises(_grpc.RpcError) as ei:
+            client.generate("lm", np.int32(5))
+        assert ei.value.code() == _grpc.StatusCode.INVALID_ARGUMENT
+    finally:
+        client.close()
+        grpc_srv.stop(grace=None)
+        srv.stop()
+
+
 def test_serving_generate_rejects_ragged_prompts(tmp_path, setup):
     from kubeflow_tpu.serving import export_model
     from kubeflow_tpu.serving.server import ModelServer
