@@ -705,6 +705,12 @@ def run_all_isolated(only: Optional[list] = None,
         timeout_s = float(os.environ.get("KFTPU_BENCH_TIMEOUT_S", "900"))
     out: Dict[str, Dict[str, Any]] = {}
     names = [n for n in CONFIGS if not only or n in only]
+    # pre-flight: a transport wedged by an EARLIER session would burn the
+    # first config's full timeout before the in-loop bailout triggers
+    if names and not _device_alive():
+        return {name: {"error": "skipped: device transport unreachable "
+                                "at bench start"}
+                for name in names}
     for i, name in enumerate(names):
         args = [name]
         if profile_dir and name in _PROFILABLE:

@@ -426,10 +426,17 @@ def cmd_status(args) -> int:
     client = _client(args)
     ns = config.namespace
 
-    try:
-        apps = client.list(APP_API, APPLICATION_KIND, ns)
-    except ApiError:
-        apps = []  # CRD not installed on this cluster
+    def list_or_absent(api, kind):
+        try:
+            return client.list(api, kind, ns)
+        except ApiError as e:
+            if e.code == 404:
+                return []  # CRD not installed on this cluster
+            # auth/server failures must not masquerade as "nothing there"
+            raise SystemExit(f"status: cluster error listing {kind}: "
+                             f"{e.code} {e.message}")
+
+    apps = list_or_absent(APP_API, APPLICATION_KIND)
     if not apps:
         print(f"no Application CRs in {ns!r} — is the 'application' "
               "component deployed (and the controller running)?")
@@ -449,10 +456,7 @@ def cmd_status(args) -> int:
         TPUJOB_KIND,
     )
 
-    try:
-        jobs = client.list(JOB_API, TPUJOB_KIND, ns)
-    except ApiError:
-        jobs = []  # CRD not installed on this cluster
+    jobs = list_or_absent(JOB_API, TPUJOB_KIND)
     if jobs:
         print(f"tpujobs in {ns!r}:")
         for job in jobs:

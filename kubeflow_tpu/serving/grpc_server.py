@@ -84,7 +84,8 @@ class PredictionServicer:
                           f"model {request.model_name!r} not found")
         try:
             arr = tensor_to_array(request.inputs)
-        except ValueError as e:
+        except (ValueError, TypeError) as e:
+            # TypeError: np.dtype on a garbage dtype string
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         if arr.ndim == 0 or arr.shape[0] > self.max_batch_size:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT,
@@ -114,7 +115,8 @@ class PredictionServicer:
                           f"model {request.model_name!r} not found")
         try:
             prompt = tensor_to_array(request.prompt)
-        except ValueError as e:
+        except (ValueError, TypeError) as e:
+            # TypeError: np.dtype on a garbage dtype string
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         body = {
             "prompt_tokens": prompt,
@@ -128,6 +130,7 @@ class PredictionServicer:
         if code != 200:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                           payload.get("error", "generate failed"))
+        _grpc_requests.inc(model=request.model_name)
         return pb.GenerateResponse(
             tokens=array_to_tensor(np.asarray(payload["tokens"],
                                               np.int32)),

@@ -128,9 +128,21 @@ def test_run_all_isolated_skips_rest_when_transport_wedged(monkeypatch,
         return real_run(cmd, **kw)
 
     monkeypatch.setattr(_sp, "run", fake_run)
-    monkeypatch.setattr(suite, "_device_alive", lambda timeout_s=60.0: False)
+    # alive at pre-flight, wedged after the first config's timeout
+    calls = iter([True, False])
+    monkeypatch.setattr(suite, "_device_alive",
+                        lambda timeout_s=60.0: next(calls))
     out = suite.run_all_isolated(only=["mnist", "resnet50", "bert"],
                                  timeout_s=3.0)
     assert "timeout" in out["mnist"]["error"]
     assert "wedged" in out["resnet50"]["error"]
     assert "wedged" in out["bert"]["error"]
+
+
+def test_run_all_isolated_preflight_skips_everything(monkeypatch):
+    """A transport already wedged by an earlier session must not burn
+    the first config's full timeout either."""
+    monkeypatch.setattr(suite, "_device_alive", lambda timeout_s=60.0: False)
+    out = suite.run_all_isolated(only=["mnist", "resnet50"], timeout_s=60.0)
+    assert all("unreachable at bench start" in v["error"]
+               for v in out.values())
