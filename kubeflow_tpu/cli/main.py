@@ -426,11 +426,13 @@ def cmd_status(args) -> int:
     client = _client(args)
     ns = config.namespace
 
+    from kubeflow_tpu.k8s.client import API_NOT_FOUND
+
     def list_or_absent(api, kind):
         try:
             return client.list(api, kind, ns)
         except ApiError as e:
-            if e.code == 404:
+            if e.code == API_NOT_FOUND:
                 return []  # CRD not installed on this cluster
             # auth/server failures must not masquerade as "nothing there"
             raise SystemExit(f"status: cluster error listing {kind}: "

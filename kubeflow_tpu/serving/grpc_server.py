@@ -33,6 +33,8 @@ SERVICE_NAME = "kubeflow_tpu.serving.PredictionService"
 
 _grpc_requests = DEFAULT_REGISTRY.counter(
     "kftpu_serving_grpc_requests_total", "gRPC predict requests")
+_grpc_generates = DEFAULT_REGISTRY.counter(
+    "kftpu_serving_grpc_generate_requests_total", "gRPC generate requests")
 
 # numpy has no bfloat16; ml_dtypes (a jax dep) provides the wire dtype
 try:
@@ -130,7 +132,7 @@ class PredictionServicer:
         if code != 200:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                           payload.get("error", "generate failed"))
-        _grpc_requests.inc(model=request.model_name)
+        _grpc_generates.inc(model=request.model_name)
         return pb.GenerateResponse(
             tokens=array_to_tensor(np.asarray(payload["tokens"],
                                               np.int32)),
