@@ -23,7 +23,12 @@ import yaml
 import kubeflow_tpu
 from kubeflow_tpu.config import DeploymentConfig, preset
 from kubeflow_tpu.k8s.apply import apply_all, delete_all
-from kubeflow_tpu.k8s.client import ApiError, HttpKubeClient, KubeClient
+from kubeflow_tpu.k8s.client import (
+    API_NOT_FOUND,
+    ApiError,
+    HttpKubeClient,
+    KubeClient,
+)
 from kubeflow_tpu.k8s.fakefile import FileBackedFakeClient
 from kubeflow_tpu.k8s.objects import Obj
 from kubeflow_tpu.manifests import list_components, render_all
@@ -425,8 +430,6 @@ def cmd_status(args) -> int:
     _sync_fake_state(config, args)
     client = _client(args)
     ns = config.namespace
-
-    from kubeflow_tpu.k8s.client import API_NOT_FOUND
 
     def list_or_absent(api, kind):
         try:

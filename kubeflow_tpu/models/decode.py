@@ -40,37 +40,36 @@ def prefill(config: TransformerConfig, params, tokens: jnp.ndarray,
             true_len: Optional[jnp.ndarray] = None):
     """Run the prompt through the decode-mode model, fill the cache.
 
-    ``tokens``: (B, S) right-padded prompts; ``true_len``: a SCALAR
-    actual length shared by the batch (defaults to S) — the serving
-    layer pads each request's batch to one bucket, so lengths are
-    uniform per call. (Per-row ragged lengths are not supported: rows
-    shorter than the longest would keep attendable pad K/V between
-    their length and the shared write index.) Returns
-    (next_token_logits, cache) where logits are the last real token's.
+    ``tokens``: (B, S) right-padded prompts; ``true_len``: the actual
+    prompt length(s) — a scalar shared by the batch or a (B,) vector for
+    RAGGED batches (defaults to S). Each row's write position resets to
+    its own length, so its generated tokens land contiguously after its
+    prompt; a shorter row's pad tail stays causally masked until
+    overwritten. Returns (next_token_logits, cache) where logits are
+    each row's LAST REAL token's.
     """
     model = _decode_model(config)
     B, S = tokens.shape
     if true_len is None:
         true_len = S
     true_len = jnp.asarray(true_len, jnp.int32)
-    if true_len.ndim != 0:
-        raise ValueError("true_len must be a scalar (uniform prompt "
-                         "length per call)")
+    if true_len.ndim > 1:
+        raise ValueError("true_len must be a scalar or a (B,) vector")
+    lens = jnp.broadcast_to(true_len, (B,))
 
     logits, variables = model.apply({"params": params}, tokens,
                                     mutable=["cache"])
     cache = variables["cache"]
-    # the write index advanced to S (the padded bucket); pull it back to
-    # the true length so the next tokens overwrite the padded tail —
-    # pad positions are masked (kv_pos <= q_pos) until overwritten
+    # the write positions advanced to S (the padded bucket); pull each
+    # row back to its true length so its next tokens overwrite the pad
+    # tail — pad positions are masked (kv_pos <= q_pos) until overwritten
     cache = jax.tree_util.tree_map_with_path(
-        lambda path, leaf: (true_len.astype(leaf.dtype)
-                            * jnp.ones_like(leaf)
-                            if path[-1].key == "index" else leaf),
+        lambda path, leaf: (jnp.broadcast_to(lens, leaf.shape)
+                            .astype(leaf.dtype)
+                            if path[-1].key == "positions" else leaf),
         cache)
     last = jnp.take_along_axis(
-        logits,
-        jnp.broadcast_to(true_len - 1, (B,))[:, None, None], axis=1)[:, 0]
+        logits, (lens - 1)[:, None, None], axis=1)[:, 0]
     return last, cache
 
 
@@ -114,16 +113,16 @@ def generate(config: TransformerConfig, params, prompt: jnp.ndarray,
     if rng is None:
         rng = jax.random.key(0)  # unused by greedy; keeps the scan carry
 
-    # cache writes past max_seq_len silently clamp (dynamic_update_slice
-    # semantics) — reject overruns where the start is known eagerly. A
-    # traced true_len (inside an outer jit, e.g. the serving wrapper) is
-    # the caller's contract: the padded prompt width would over-reject.
+    # cache writes past max_seq_len silently clamp (scatter semantics) —
+    # reject overruns where the start is known eagerly. A traced
+    # true_len (inside an outer jit, e.g. the serving wrapper) is the
+    # caller's contract: the padded prompt width would over-reject.
     if true_len is None:
         start = prompt.shape[1]
     elif isinstance(true_len, jax.core.Tracer):
         start = None
     else:
-        start = int(true_len)
+        start = int(jnp.max(jnp.asarray(true_len)))
     if start is not None and start + max_new_tokens > config.max_seq_len:
         raise ValueError(
             f"prompt length {start} + max_new_tokens "

@@ -111,14 +111,21 @@ def rope_tables(seq_len: int, head_dim: int, theta: float) -> Tuple[jnp.ndarray,
     return jnp.sin(angles), jnp.cos(angles)
 
 
-def apply_rope(x: jnp.ndarray, sin: jnp.ndarray, cos: jnp.ndarray) -> jnp.ndarray:
-    """x: (B, S, H, Dh); rotate pairs (even, odd) halves interleaved as split."""
+def _rotate(x: jnp.ndarray, sin: jnp.ndarray,
+            cos: jnp.ndarray) -> jnp.ndarray:
+    """The rope rotation core; sin/cos arrive pre-broadcast to x's rank.
+    ONE definition — training, prefill, and the per-row decode step must
+    rotate identically or generation diverges from prefill."""
     x1, x2 = jnp.split(x, 2, axis=-1)
-    sin = sin[None, :, None, :].astype(x.dtype)
-    cos = cos[None, :, None, :].astype(x.dtype)
     return jnp.concatenate(
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
     )
+
+
+def apply_rope(x: jnp.ndarray, sin: jnp.ndarray, cos: jnp.ndarray) -> jnp.ndarray:
+    """x: (B, S, H, Dh); rotate pairs (even, odd) halves interleaved as split."""
+    return _rotate(x, sin[None, :, None, :].astype(x.dtype),
+                   cos[None, :, None, :].astype(x.dtype))
 
 
 class Attention(nn.Module):
@@ -170,44 +177,67 @@ class Attention(nn.Module):
     def _decode_attend(self, q, k, v, sin_full, cos_full):
         """Autoregressive attention with a KV cache (static shapes).
 
-        ``sin_full``/``cos_full`` span ``max_seq_len``; the cache index
-        variable tracks the absolute write position, so rope uses true
-        positions and masking is by absolute position — everything under
-        one jit with no data-dependent shapes (XLA-friendly: one compiled
-        prefill per prompt bucket, one compiled step).
+        ``sin_full``/``cos_full`` span ``max_seq_len``. The cache carries
+        PER-ROW write positions: every row's tokens sit contiguously at
+        their logical positions (physical slot == logical position), so
+        masking stays purely causal even for ragged batches — everything
+        under one jit with no data-dependent shapes (one compiled prefill
+        per prompt bucket, one compiled step).
+
+        - prefill (S > 1): all rows start at position 0, one dynamic
+          slice write; the caller then resets positions to each row's
+          true length (see :func:`kubeflow_tpu.models.decode.prefill`) —
+          a row's pad tail is masked (kv_pos > its positions) until the
+          generated tokens overwrite it;
+        - step (S == 1): per-row scatter write + per-row rope position.
         """
         c = self.config
         B, S, KH, Dh = k.shape
         Smax = c.max_seq_len
 
-        idx_var = self.variable("cache", "index",
-                                lambda: jnp.zeros((), jnp.int32))
+        pos_var = self.variable("cache", "positions",
+                                lambda: jnp.zeros((B,), jnp.int32))
         ck = self.variable("cache", "k", jnp.zeros, (B, Smax, KH, Dh),
                            c.dtype)
         cv = self.variable("cache", "v", jnp.zeros, (B, Smax, KH, Dh),
                            c.dtype)
-        idx = idx_var.value
-
-        sin = jax.lax.dynamic_slice_in_dim(sin_full, idx, S, 0)
-        cos = jax.lax.dynamic_slice_in_dim(cos_full, idx, S, 0)
-        q = apply_rope(q, sin, cos)
-        k = apply_rope(k, sin, cos)
-
-        ck.value = jax.lax.dynamic_update_slice_in_dim(ck.value, k, idx,
-                                                       axis=1)
-        cv.value = jax.lax.dynamic_update_slice_in_dim(cv.value, v, idx,
-                                                       axis=1)
-        idx_var.value = idx + S
+        pos = pos_var.value  # (B,)
 
         from kubeflow_tpu.ops.attention import NEG_INF, gqa_repeat
+
+        if S == 1:
+            # one token per row at its own position
+            sin = jnp.take(sin_full, pos, axis=0)[:, None, None, :].astype(
+                q.dtype)
+            cos = jnp.take(cos_full, pos, axis=0)[:, None, None, :].astype(
+                q.dtype)
+            q = _rotate(q, sin, cos)
+            k = _rotate(k, sin, cos)
+            rows = jnp.arange(B)
+            ck.value = ck.value.at[rows, pos].set(k[:, 0])
+            cv.value = cv.value.at[rows, pos].set(v[:, 0])
+            q_pos = pos[:, None]  # (B, 1)
+        else:
+            # prefill: rows share a start (a fresh cache starts at 0)
+            idx = pos[0]
+            sin = jax.lax.dynamic_slice_in_dim(sin_full, idx, S, 0)
+            cos = jax.lax.dynamic_slice_in_dim(cos_full, idx, S, 0)
+            q = apply_rope(q, sin, cos)
+            k = apply_rope(k, sin, cos)
+            ck.value = jax.lax.dynamic_update_slice_in_dim(ck.value, k,
+                                                           idx, axis=1)
+            cv.value = jax.lax.dynamic_update_slice_in_dim(cv.value, v,
+                                                           idx, axis=1)
+            q_pos = (idx + jnp.arange(S))[None, :]  # (1, S) → rows share
+        pos_var.value = pos + S
 
         kc, vc = gqa_repeat(q, ck.value, cv.value)
         logits = jnp.einsum("bshd,bthd->bhst", q, kc).astype(jnp.float32)
         logits = logits * (Dh ** -0.5)
-        q_pos = idx + jnp.arange(S)
         kv_pos = jnp.arange(Smax)
-        mask = kv_pos[None, :] <= q_pos[:, None]  # (S, Smax)
-        logits = jnp.where(mask[None, None], logits, NEG_INF)
+        # (B or 1, S, Smax): per-row causal bound
+        mask = kv_pos[None, None, :] <= q_pos[:, :, None]
+        logits = jnp.where(mask[:, None], logits, NEG_INF)
         probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
         return jnp.einsum("bhst,bthd->bshd", probs, vc)
 

@@ -114,6 +114,18 @@ _QUANT_MIN_ELEMS = 4096
 _QUANT_SCALE_SUFFIX = "::scale"
 
 
+def _is_float_dtype(dtype: np.dtype) -> bool:
+    """np.floating misses ml_dtypes.bfloat16 (registered kind 'V')."""
+    if np.issubdtype(dtype, np.floating):
+        return True
+    try:
+        import ml_dtypes
+
+        return dtype == np.dtype(ml_dtypes.bfloat16)
+    except ImportError:  # pragma: no cover
+        return False
+
+
 def _quantize_leaf(arr: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     """Symmetric per-output-channel int8 (last axis = channels)."""
     flat = arr.reshape(-1, arr.shape[-1]).astype(np.float32)
@@ -161,19 +173,30 @@ def export_model(
     flat = _flatten(params)
     if quantize:
         stored: Dict[str, np.ndarray] = {}
-        quantized = []
+        quantized: Dict[str, str] = {}  # key -> original dtype name
         for key, leaf in flat.items():
             arr = np.asarray(leaf)
-            if (np.issubdtype(arr.dtype, np.floating)
+            if (_is_float_dtype(arr.dtype)
                     and arr.size >= _QUANT_MIN_ELEMS and arr.ndim >= 2):
                 q, scale = _quantize_leaf(arr)
                 stored[key] = q
                 stored[key + _QUANT_SCALE_SUFFIX] = scale
-                quantized.append(key)
+                quantized[key] = arr.dtype.name
             else:
                 stored[key] = arr
         meta["quantized_leaves"] = quantized
         flat = stored
+    # npz cannot represent ml_dtypes (bf16 writes as raw void and loads
+    # as an invalid V2): store such leaves as float32 with their dtype
+    # recorded, restored at load
+    cast_leaves: Dict[str, str] = {}
+    for key, leaf in list(flat.items()):
+        arr = np.asarray(leaf)
+        if arr.dtype.kind == "V":
+            cast_leaves[key] = arr.dtype.name
+            flat[key] = arr.astype(np.float32)
+    if cast_leaves:
+        meta["cast_leaves"] = cast_leaves
     with open(os.path.join(vdir, MODEL_FILE), "w") as f:
         yaml.safe_dump(meta, f)
     np.savez(os.path.join(vdir, PARAMS_FILE), **flat)
@@ -231,15 +254,23 @@ def load_version(base_path: str, version: int) -> LoadedModel:
     kind = meta["kind"]
     with np.load(os.path.join(vdir, PARAMS_FILE)) as npz:
         raw = {k: npz[k] for k in npz.files}
-    quantized = set(meta.get("quantized_leaves", []) or [])
+    quantized = meta.get("quantized_leaves") or {}
+    if isinstance(quantized, list):  # early artifacts: no dtype record
+        quantized = {k: "float32" for k in quantized}
     if quantized:
         flat = {}
         for k, v in raw.items():
             if k.endswith(_QUANT_SCALE_SUFFIX):
                 continue
-            flat[k] = (_dequantize_leaf(v, raw[k + _QUANT_SCALE_SUFFIX])
-                       if k in quantized else v)
+            if k in quantized:
+                deq = _dequantize_leaf(v, raw[k + _QUANT_SCALE_SUFFIX])
+                flat[k] = deq.astype(np.dtype(quantized[k]))
+            else:
+                flat[k] = v
         raw = flat
+    for k, dtype_name in (meta.get("cast_leaves") or {}).items():
+        if k in raw:
+            raw[k] = raw[k].astype(np.dtype(dtype_name))
     params = _unflatten(raw)
     model, apply_fn = build_model(kind, meta.get("config", {}) or {})
 

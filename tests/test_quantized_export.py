@@ -56,6 +56,27 @@ def test_small_leaves_stay_exact(tmp_path):
     assert meta["quantized_leaves"]  # but the big kernels are
 
 
+def test_bfloat16_params_quantize_and_restore_dtype(tmp_path):
+    """bf16 kernels must quantize (np.floating misses ml_dtypes.bfloat16)
+    and reload AS bf16 — not silently full-size or dtype-drifted."""
+    import yaml
+
+    model, params = _params()
+    bf16 = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(x, jnp.bfloat16), params)
+    export_model(str(tmp_path / "full"), "mnist", bf16, version=1)
+    export_model(str(tmp_path / "q"), "mnist", bf16, version=1,
+                 quantize=True)
+    assert _npz_size(tmp_path / "q") < 0.7 * _npz_size(tmp_path / "full")
+    with open(tmp_path / "q" / "1" / "model.yaml") as f:
+        meta = yaml.safe_load(f)
+    assert meta["quantized_leaves"]
+    assert all(d == "bfloat16" for d in meta["quantized_leaves"].values())
+    lm = load_latest(str(tmp_path / "q"))
+    x = jnp.zeros((1, 28, 28, 1))
+    assert lm.predict(x).shape == (1, 10)
+
+
 def test_quantized_transformer_generates(tmp_path):
     """The decode path works from a quantized artifact (params dequantize
     at load; generation still runs greedily end to end)."""
