@@ -65,20 +65,33 @@ def run_generate(model, body: Dict[str, Any], max_batch_size: int, *,
         max_new = int(body.get("max_new_tokens", 16))
         temperature = float(body.get("temperature", 0.0))
         seed = int(body.get("seed", 0))
-        # iterating also rejects scalars/0-d tensors (TypeError → 400)
-        lens = {len(p) for p in prompts}
-        if not lens:
+        # RAGGED batches are first-class: each row keeps its own length
+        # (per-row cache positions in the decode core); iterating also
+        # rejects scalars/0-d tensors (TypeError → 400)
+        row_lens = [len(p) for p in prompts]
+        if not row_lens:
             return 400, {"error": "prompt_tokens batch is empty"}
-        if len(lens) != 1:
-            return 400, {"error": "all prompts in one call must share "
-                                  "a length (pad client-side or split "
-                                  "calls)"}
-        width = lens.pop()
-        true_len = int(body.get("true_len", 0)) or width
-        if not 1 <= true_len <= width:
-            return 400, {"error": f"true_len {true_len} must be in "
-                                  f"[1, {width}]"}
-        arr = np.asarray(prompts, dtype=np.int32)
+        if min(row_lens) < 1:
+            return 400, {"error": "empty prompt row"}
+        width = max(row_lens)
+        if isinstance(prompts, np.ndarray):
+            arr = prompts.astype(np.int32)
+        else:
+            arr = np.zeros((len(prompts), width), np.int32)
+            for i, p in enumerate(prompts):
+                arr[i, :row_lens[i]] = np.asarray(p, dtype=np.int32)
+        # an explicit scalar true_len marks the shared real length of
+        # every (right-padded) row — the gRPC tensor convention, also
+        # honored for REST clients that pad client-side. The array is
+        # sliced to it so the prompt bucket never undershoots the data.
+        explicit = int(body.get("true_len", 0))
+        if explicit:
+            if not 1 <= explicit <= width:
+                return 400, {"error": f"true_len {explicit} must be in "
+                                      f"[1, {width}]"}
+            row_lens = [explicit] * arr.shape[0]
+            width = explicit
+            arr = arr[:, :explicit]
     except (TypeError, ValueError) as e:
         return 400, {"error": f"bad prompt_tokens: {e}"}
     if max_new < 1:
@@ -92,12 +105,17 @@ def run_generate(model, body: Dict[str, Any], max_batch_size: int, *,
     if arr.shape[0] > max_batch_size:
         return 400, {"error": f"batch {arr.shape[0]} exceeds max "
                               f"{max_batch_size}"}
-    real = arr[:, :true_len]  # pad columns never reach the model
-    if model.vocab_size and (real.min() < 0
-                             or real.max() >= model.vocab_size):
+    lens_arr = np.asarray(row_lens, np.int32)
+    # pad columns never reach the model — check only real tokens
+    col = np.arange(width)[None, :]
+    real_mask = col < lens_arr[:, None]
+    real_vals = arr[real_mask]
+    if model.vocab_size and real_vals.size and (
+            real_vals.min() < 0 or real_vals.max() >= model.vocab_size):
         # out-of-range ids would silently clamp in the embedding take
         return 400, {"error": f"token ids must be in [0, "
                               f"{model.vocab_size})"}
+    true_len = int(lens_arr.max())
     ctx = model.max_seq_len or 0
 
     def pow2(n: int) -> int:
@@ -132,13 +150,16 @@ def run_generate(model, body: Dict[str, Any], max_batch_size: int, *,
                               f"({ctx}); cache writes past it would "
                               "silently clamp"}
     padded = np.zeros((arr.shape[0], bucket), np.int32)
-    padded[:, :true_len] = arr[:, :true_len]
-    # batch padded like the predict path: one compiled shape
+    padded[:, :width] = arr
+    # batch padded like the predict path: one compiled shape; filler
+    # rows get length 1 (length 0 would index position -1 at prefill)
     padded, n = _pad_batch(padded, max_batch_size)
+    lens_padded = np.ones((padded.shape[0],), np.int32)
+    lens_padded[:n] = lens_arr
     t0 = time.perf_counter()
     try:
         out = np.asarray(model.generate(
-            jnp.asarray(padded), jnp.int32(true_len), new_bucket,
+            jnp.asarray(padded), jnp.asarray(lens_padded), new_bucket,
             jnp.float32(temperature), seed,
             greedy=temperature == 0.0))[:n, :max_new]
     except Exception as e:  # noqa: BLE001

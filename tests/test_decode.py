@@ -236,6 +236,14 @@ def test_grpc_generate_matches_rest(tmp_path, setup):
         tokens_p, _ = client.generate("lm", padded, max_new_tokens=4,
                                       true_len=prompt.shape[1])
         np.testing.assert_array_equal(tokens_p, want)
+        # true_len whose pow2 bucket is below the padded width must
+        # still serve (regression: bucket sized from true_len used to
+        # crash the broadcast into the narrower bucket)
+        wide = np.zeros((prompt.shape[0], 16), np.int32)
+        wide[:, :prompt.shape[1]] = prompt
+        tokens_w, _ = client.generate("lm", wide, max_new_tokens=4,
+                                      true_len=prompt.shape[1])
+        np.testing.assert_array_equal(tokens_w, want)
 
         # errors surface as INVALID_ARGUMENT with the core's message
         import grpc as _grpc
@@ -254,7 +262,7 @@ def test_grpc_generate_matches_rest(tmp_path, setup):
         srv.stop()
 
 
-def test_serving_generate_rejects_ragged_prompts(tmp_path, setup):
+def test_serving_generate_validation(tmp_path, setup):
     from kubeflow_tpu.serving import export_model
     from kubeflow_tpu.serving.server import ModelServer
 
@@ -264,9 +272,13 @@ def test_serving_generate_rejects_ragged_prompts(tmp_path, setup):
     srv = ModelServer(str(tmp_path), port=0, poll_interval_s=3600)
     srv.start()
     try:
+        # ragged REST batches are first-class now: each row generates
+        # from its own length
         code, out = srv.handle_generate("lm", None,
-                                        {"prompt_tokens": [[1, 2], [3]]})
-        assert code == 400 and "share a length" in out["error"]
+                                        {"prompt_tokens": [[1, 2], [3]],
+                                         "max_new_tokens": 2})
+        assert code == 200, out
+        assert len(out["tokens"]) == 2
         code, out = srv.handle_generate("lm", None, {})
         assert code == 400
         # context overflow must be a 400, not silently-clamped garbage
@@ -292,7 +304,9 @@ def test_serving_generate_rejects_ragged_prompts(tmp_path, setup):
         # misshaped (3-D) prompts are a 400, not a handler crash
         code, out = srv.handle_generate(
             "lm", None, {"prompt_tokens": [[[1, 2], [3, 4]]]})
-        assert code == 400 and "2-D" in out["error"]
+        assert code == 400
+        assert ("2-D" in out["error"]
+                or "bad prompt_tokens" in out["error"])
         # out-of-vocab ids would silently clamp in the embedding
         code, out = srv.handle_generate(
             "lm", None, {"prompt_tokens": [[999999, 1]]})
@@ -349,6 +363,41 @@ def test_serving_generate_near_context_end_buckets_pow2(tmp_path, setup):
             "lm", None, {"prompt_tokens": [[1] * 30],
                          "max_new_tokens": 3})
         assert code == 400 and "context" in out["error"]
+    finally:
+        srv.stop()
+
+
+def test_serving_ragged_rows_match_solo_requests(tmp_path, setup):
+    """Each row of a ragged REST batch must generate exactly what a
+    solo request for that prompt generates."""
+    from kubeflow_tpu.serving import ModelServer, export_model
+
+    config, model, params, _ = setup
+    export_model(str(tmp_path / "lm"), "transformer", params, version=1,
+                 config=transformer_export_config(config))
+    srv = ModelServer(str(tmp_path), port=0, poll_interval_s=3600)
+    srv.start()
+    try:
+        rows = [[5, 9, 2], [7, 1, 3, 8, 4]]
+        code, batch = srv.handle_generate(
+            "lm", None, {"prompt_tokens": rows, "max_new_tokens": 4})
+        assert code == 200, batch
+        for i, row in enumerate(rows):
+            code, solo = srv.handle_generate(
+                "lm", None, {"prompt_tokens": [row],
+                             "max_new_tokens": 4})
+            assert code == 200
+            assert batch["tokens"][i] == solo["tokens"][0], f"row {i}"
+        # a REST client that pads client-side and passes true_len gets
+        # the unpadded behavior (the old documented contract)
+        code, via_tl = srv.handle_generate(
+            "lm", None, {"prompt_tokens": [rows[0] + [0, 0]],
+                         "true_len": 3, "max_new_tokens": 4})
+        assert code == 200, via_tl
+        code, solo = srv.handle_generate(
+            "lm", None, {"prompt_tokens": [rows[0]],
+                         "max_new_tokens": 4})
+        assert via_tl["tokens"][0] == solo["tokens"][0]
     finally:
         srv.stop()
 
