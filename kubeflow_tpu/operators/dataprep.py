@@ -207,7 +207,7 @@ class DataPrepOperator:
                  != _assignment(spec)]
         if stale:
             # delete terminal pods too: a Succeeded mapper's stale
-            # COUNT_LABEL would re-trigger this branch forever
+            # ASSIGNMENT_LABEL would re-trigger this branch forever
             self._teardown(ns, pods, include_terminal=True)
             self._set_status(
                 job, PHASE_PENDING, workerRetries={},

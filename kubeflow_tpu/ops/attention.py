@@ -8,10 +8,11 @@ they are framework ops:
 - :func:`blockwise_attention` — online-softmax attention scanned over KV
   blocks: O(S) memory, differentiable, XLA-fusable. The inner compute for
   ring attention and the portable fallback everywhere.
-- :func:`flash_attention` — Pallas TPU kernel for the forward pass (VMEM
-  block tiles, MXU matmuls, f32 accumulators) with a recompute-based custom
-  VJP so training still works; ``interpret=True`` runs the same kernel on
-  CPU in tests.
+- :func:`flash_attention` — Pallas TPU kernels for the forward AND backward
+  pass (VMEM block tiles, MXU matmuls, f32 accumulators): the forward saves
+  the per-row logsumexp, and dedicated dQ and dK/dV kernels replay blocks
+  against it instead of recomputing the softmax; ``interpret=True`` runs the
+  same kernels on CPU in tests.
 - :func:`ring_attention` — sequence-parallel attention over a mesh axis:
   each device holds a sequence shard of Q/K/V and KV shards rotate around
   the ring via ``ppermute`` (one ICI hop per step when the axis is laid out

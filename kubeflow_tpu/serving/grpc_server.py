@@ -92,6 +92,12 @@ class PredictionServicer:
         if arr.ndim == 0 or arr.shape[0] > self.max_batch_size:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                           f"batch must be in [1, {self.max_batch_size}]")
+        if model.input_shape and tuple(arr.shape[1:]) != tuple(model.input_shape):
+            # keep shape mismatches in the client-error class — inside the
+            # jitted predict they would surface as INTERNAL
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"instance shape {tuple(arr.shape[1:])} != model "
+                          f"input {tuple(model.input_shape)}")
         if np.issubdtype(arr.dtype, np.integer):
             # image clients send uint8 pixels (4× less wire/transfer than
             # f32 — TF-Serving's image convention); models take floats
@@ -99,8 +105,8 @@ class PredictionServicer:
         padded, n = _pad_batch(arr, self.max_batch_size)
         try:
             out = np.asarray(model.predict(jnp.asarray(padded)))[:n]
-        except Exception as e:  # noqa: BLE001 — surface as INVALID_ARGUMENT
-            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+        except Exception as e:  # noqa: BLE001 — execution fault, not client
+            context.abort(grpc.StatusCode.INTERNAL,
                           f"predict failed: {type(e).__name__}: {e}")
         _grpc_requests.inc(model=request.model_name)
         return pb.PredictResponse(outputs=array_to_tensor(out),
@@ -130,7 +136,9 @@ class PredictionServicer:
         code, payload = run_generate(model, body, self.max_batch_size,
                                      model_name=request.model_name)
         if code != 200:
-            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+            # 4xx = the request was bad; 5xx = the model/runtime faulted
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT if code < 500
+                          else grpc.StatusCode.INTERNAL,
                           payload.get("error", "generate failed"))
         _grpc_generates.inc(model=request.model_name)
         return pb.GenerateResponse(

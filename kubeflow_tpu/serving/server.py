@@ -163,7 +163,9 @@ def run_generate(model, body: Dict[str, Any], max_batch_size: int, *,
             jnp.float32(temperature), seed,
             greedy=temperature == 0.0))[:n, :max_new]
     except Exception as e:  # noqa: BLE001
-        return 400, {"error": f"generate failed: "
+        # validation all happened above — a failure here is the model /
+        # runtime (XLA faults, OOM), a server error, not a client one
+        return 500, {"error": f"generate failed: "
                               f"{type(e).__name__}: {e}"}
     dt = time.perf_counter() - t0
     _gen_requests.inc(model=model_name)
@@ -344,12 +346,18 @@ class ModelServer:
         if arr.shape[0] > self.max_batch_size:
             return 400, {"error": f"batch {arr.shape[0]} exceeds max "
                                   f"{self.max_batch_size}"}
+        if model.input_shape and tuple(arr.shape[1:]) != tuple(model.input_shape):
+            # catch shape mismatches here so they stay client errors —
+            # inside the jitted predict they'd surface as opaque 500s
+            return 400, {"error": f"instance shape {tuple(arr.shape[1:])} "
+                                  f"!= model input {tuple(model.input_shape)}"}
         t0 = time.perf_counter()
         padded, n = _pad_batch(arr, self.max_batch_size)
         try:
             out = np.asarray(model.predict(jnp.asarray(padded)))[:n]
         except Exception as e:  # noqa: BLE001
-            return 400, {"error": f"predict failed: {type(e).__name__}: {e}"}
+            # inputs validated above — this is an execution fault
+            return 500, {"error": f"predict failed: {type(e).__name__}: {e}"}
         dt = time.perf_counter() - t0
         _requests.inc(model=name)
         _latency.set(dt, model=name)
