@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from kubeflow_tpu.models.transformer import Transformer, TransformerConfig
+from kubeflow_tpu.ops.attention import NEG_INF
 
 
 def _decode_model(config: TransformerConfig) -> Transformer:
@@ -83,18 +84,84 @@ def decode_step(config: TransformerConfig, params, cache,
     return logits[:, 0], variables["cache"]
 
 
+def sample_logits(logits: jnp.ndarray, rng: jax.Array, *,
+                  temperature=1.0, top_k=0, top_p=1.0) -> jnp.ndarray:
+    """Sample token ids from ``(B, V)`` logits — the serving sampler.
+
+    Every parameter may be a Python scalar or a ``(B,)`` array, so ONE
+    compiled program serves requests with different sampling settings
+    sharing a decode batch (the continuous-batching engine's contract):
+
+    - ``temperature``: 0 → greedy (argmax) for that row; >0 scales.
+    - ``top_k``: keep only the k highest logits (0 or ≥V → no filter).
+    - ``top_p``: nucleus — keep the smallest prefix of the sorted
+      distribution with cumulative probability ≥ p (1.0 → no filter).
+
+    Filters compose HF-style: temperature, then top-k, then top-p.
+    Fully jittable: one descending sort of the vocab axis drives both
+    filters (threshold-based, static shapes, no boolean gather).
+    """
+    B, V = logits.shape
+    logits = logits.astype(jnp.float32)
+    temp = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (B,))
+    k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (B,))
+    p = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (B,))
+
+    greedy_row = temp <= 0.0
+    scaled = logits / jnp.where(greedy_row, 1.0, temp)[:, None]
+
+    srt = jnp.sort(scaled, axis=-1)[:, ::-1]  # (B, V) descending
+    # top-k: per-row threshold at the k-th largest (k<=0 → keep all)
+    k_eff = jnp.where(k <= 0, V, jnp.minimum(k, V))
+    kth = jnp.take_along_axis(srt, (k_eff - 1)[:, None], axis=-1)
+    keep = scaled >= kth
+    # top-p on the k-filtered distribution: renormalised cumulative
+    # mass strictly BEFORE each sorted position; a position is kept
+    # while that prefix mass is < p (the first is always kept). In
+    # sorted order the k-filter is positional: the first k_eff entries.
+    srt_masked = jnp.where(jnp.arange(V)[None, :] < k_eff[:, None],
+                           srt, NEG_INF)
+    probs = jax.nn.softmax(srt_masked, axis=-1)
+    before = jnp.cumsum(probs, axis=-1) - probs
+    kept_sorted = before < p[:, None]
+    # smallest kept sorted logit = the acceptance threshold
+    n_kept = jnp.sum(kept_sorted, axis=-1)  # >= 1
+    p_thresh = jnp.take_along_axis(srt, (n_kept - 1)[:, None], axis=-1)
+    keep = keep & (scaled >= p_thresh)
+    masked = jnp.where(keep, scaled, NEG_INF)
+    sampled = jax.random.categorical(rng, masked, axis=-1)
+    out = jnp.where(greedy_row, jnp.argmax(logits, axis=-1), sampled)
+    return out.astype(jnp.int32)
+
+
 def _sample(logits: jnp.ndarray, temperature, rng: Optional[jax.Array],
-            greedy: bool) -> jnp.ndarray:
+            greedy: bool, top_k=0, top_p=1.0) -> jnp.ndarray:
     if greedy:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jax.random.categorical(
-        rng, logits / temperature, axis=-1).astype(jnp.int32)
+    # the sort-free fast path needs the filters statically off and the
+    # temperature scalar — a (B,) temperature (per-row greedy mix) must
+    # go through sample_logits, whose broadcasting and temp<=0 handling
+    # are per-row. A 0-d TRACED temperature stays on the fast path (the
+    # serving closure traces it; its greedy split is static, so a traced
+    # temperature is guaranteed > 0 here).
+    scalar_temp = (isinstance(temperature, (int, float)) or
+                   getattr(temperature, "ndim", None) == 0)
+    static_nofilter = (
+        scalar_temp and
+        isinstance(top_k, int) and top_k == 0 and
+        isinstance(top_p, (int, float)) and top_p >= 1.0)
+    if static_nofilter:
+        return jax.random.categorical(
+            rng, logits / temperature, axis=-1).astype(jnp.int32)
+    return sample_logits(logits, rng, temperature=temperature,
+                         top_k=top_k, top_p=top_p)
 
 
 def generate(config: TransformerConfig, params, prompt: jnp.ndarray,
              *, max_new_tokens: int,
              true_len: Optional[jnp.ndarray] = None,
              temperature: float = 0.0,
+             top_k=0, top_p=1.0,
              rng: Optional[jax.Array] = None) -> jnp.ndarray:
     """Prefill + scan decode; returns (B, max_new_tokens) int32.
 
@@ -102,7 +169,10 @@ def generate(config: TransformerConfig, params, prompt: jnp.ndarray,
     ``max_new_tokens``). ``temperature`` may be a traced array — the
     greedy/sampling split is decided statically by whether it is the
     Python float 0.0, so a serving layer can compile ONE sampling
-    program for all temperatures.
+    program for all temperatures. ``top_k``/``top_p`` likewise may be
+    traced (scalars or per-row vectors, see :func:`sample_logits`);
+    their no-filter defaults are recognised statically so the plain
+    temperature path compiles without the vocab sort.
     """
     greedy = isinstance(temperature, (int, float)) and temperature == 0.0
     if not greedy:
@@ -110,6 +180,10 @@ def generate(config: TransformerConfig, params, prompt: jnp.ndarray,
             raise ValueError("sampling (temperature > 0) needs an rng key")
         if isinstance(temperature, (int, float)) and temperature < 0:
             raise ValueError("temperature must be >= 0")
+    if isinstance(top_k, int) and top_k < 0:
+        raise ValueError("top_k must be >= 0 (0 = no filter)")
+    if isinstance(top_p, (int, float)) and not 0.0 < top_p <= 1.0:
+        raise ValueError("top_p must be in (0, 1]")
     if rng is None:
         rng = jax.random.key(0)  # unused by greedy; keeps the scan carry
 
@@ -131,13 +205,13 @@ def generate(config: TransformerConfig, params, prompt: jnp.ndarray,
 
     last_logits, cache = prefill(config, params, prompt, true_len)
     rng, sub = jax.random.split(rng)
-    first = _sample(last_logits, temperature, sub, greedy)
+    first = _sample(last_logits, temperature, sub, greedy, top_k, top_p)
 
     def step(carry, _):
         cache, token, rng = carry
         logits, cache = decode_step(config, params, cache, token)
         rng, sub = jax.random.split(rng)
-        nxt = _sample(logits, temperature, sub, greedy)
+        nxt = _sample(logits, temperature, sub, greedy, top_k, top_p)
         return (cache, nxt, rng), nxt
 
     if max_new_tokens == 1:
@@ -149,7 +223,8 @@ def generate(config: TransformerConfig, params, prompt: jnp.ndarray,
 
 
 def make_generate(config: TransformerConfig, *, max_new_tokens: int,
-                  temperature: float = 0.0):
+                  temperature: float = 0.0, top_k: int = 0,
+                  top_p: float = 1.0):
     """Jitted generate closure: (params, prompt, true_len, rng) -> tokens."""
     import functools
 
@@ -158,6 +233,7 @@ def make_generate(config: TransformerConfig, *, max_new_tokens: int,
         return generate(config, params, prompt,
                         max_new_tokens=max_new_tokens,
                         true_len=true_len, temperature=temperature,
+                        top_k=top_k, top_p=top_p,
                         rng=rng)
 
     return fn

@@ -105,6 +105,10 @@ class PredictionServicer:
         padded, n = _pad_batch(arr, self.max_batch_size)
         try:
             out = np.asarray(model.predict(jnp.asarray(padded)))[:n]
+        except (TypeError, ValueError) as e:
+            # JAX shape/dtype mismatches — request data, not the server
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"predict failed: {type(e).__name__}: {e}")
         except Exception as e:  # noqa: BLE001 — execution fault, not client
             context.abort(grpc.StatusCode.INTERNAL,
                           f"predict failed: {type(e).__name__}: {e}")
@@ -112,11 +116,10 @@ class PredictionServicer:
         return pb.PredictResponse(outputs=array_to_tensor(out),
                                   model_version=model.version)
 
-    def Generate(self, request: pb.GenerateRequest,
-                 context: grpc.ServicerContext) -> pb.GenerateResponse:
-        """Autoregressive generation over binary prompt tensors — the
-        fast-path twin of the REST ``:generate`` endpoint (shared core:
-        ``kubeflow_tpu.serving.server.run_generate``)."""
+    def _generate_inputs(self, request: pb.GenerateRequest,
+                         context: grpc.ServicerContext):
+        """Shared Generate/GenerateStream request decoding: model lookup
+        + the run_generate body dict. Aborts the RPC on bad input."""
         model = self.repo.get(request.model_name, request.version or None)
         if model is None:
             context.abort(grpc.StatusCode.NOT_FOUND,
@@ -126,13 +129,23 @@ class PredictionServicer:
         except (ValueError, TypeError) as e:
             # TypeError: np.dtype on a garbage dtype string
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-        body = {
+        return model, {
             "prompt_tokens": prompt,
             "max_new_tokens": request.max_new_tokens or 16,
             "temperature": request.temperature,
             "seed": request.seed,
             "true_len": request.true_len,
+            "top_k": request.top_k,
+            # proto3 default 0.0 means "unset" — no filter
+            "top_p": request.top_p or 1.0,
         }
+
+    def Generate(self, request: pb.GenerateRequest,
+                 context: grpc.ServicerContext) -> pb.GenerateResponse:
+        """Autoregressive generation over binary prompt tensors — the
+        fast-path twin of the REST ``:generate`` endpoint (shared core:
+        ``kubeflow_tpu.serving.server.run_generate``)."""
+        model, body = self._generate_inputs(request, context)
         code, payload = run_generate(model, body, self.max_batch_size,
                                      model_name=request.model_name)
         if code != 200:
@@ -145,6 +158,27 @@ class PredictionServicer:
             tokens=array_to_tensor(np.asarray(payload["tokens"],
                                               np.int32)),
             model_version=int(payload["model_version"]))
+
+    def GenerateStream(self, request: pb.GenerateRequest,
+                       context: grpc.ServicerContext):
+        """Server-streaming generation: one :class:`GenerateChunk` per
+        decode position (a row of tokens across the batch), then a
+        final ``done`` chunk. Chunks arrive as the generation core
+        yields them."""
+        model, body = self._generate_inputs(request, context)
+        code, payload = run_generate(model, body, self.max_batch_size,
+                                     model_name=request.model_name,
+                                     stream=True)
+        if code != 200:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT if code < 500
+                          else grpc.StatusCode.INTERNAL,
+                          payload.get("error", "generate failed"))
+        _grpc_generates.inc(model=request.model_name)
+        version = int(payload["model_version"])
+        for step_tokens in payload["token_stream"]:
+            yield pb.GenerateChunk(tokens=step_tokens,
+                                   model_version=version)
+        yield pb.GenerateChunk(done=True, model_version=version)
 
     def GetModelStatus(self, request: pb.ModelStatusRequest,
                        context: grpc.ServicerContext) -> pb.ModelStatusResponse:
@@ -180,6 +214,10 @@ def _handlers(servicer: PredictionServicer) -> grpc.GenericRpcHandler:
             servicer.Generate,
             request_deserializer=pb.GenerateRequest.FromString,
             response_serializer=pb.GenerateResponse.SerializeToString),
+        "GenerateStream": grpc.unary_stream_rpc_method_handler(
+            servicer.GenerateStream,
+            request_deserializer=pb.GenerateRequest.FromString,
+            response_serializer=pb.GenerateChunk.SerializeToString),
     }
     return grpc.method_handlers_generic_handler(SERVICE_NAME, method_handlers)
 
@@ -231,6 +269,10 @@ class PredictClient:
             base + "Generate",
             request_serializer=pb.GenerateRequest.SerializeToString,
             response_deserializer=pb.GenerateResponse.FromString)
+        self._generate_stream = self.channel.unary_stream(
+            base + "GenerateStream",
+            request_serializer=pb.GenerateRequest.SerializeToString,
+            response_deserializer=pb.GenerateChunk.FromString)
 
     def predict(self, model_name: str, inputs: np.ndarray,
                 version: Optional[int] = None,
@@ -243,14 +285,33 @@ class PredictClient:
     def generate(self, model_name: str, prompt: np.ndarray, *,
                  max_new_tokens: int = 16, true_len: int = 0,
                  temperature: float = 0.0, seed: int = 0,
+                 top_k: int = 0, top_p: float = 1.0,
                  version: Optional[int] = None,
                  timeout: float = 300.0) -> Tuple[np.ndarray, int]:
         resp = self._generate(pb.GenerateRequest(
             model_name=model_name, version=version or 0,
             prompt=array_to_tensor(np.asarray(prompt, np.int32)),
             true_len=true_len, max_new_tokens=max_new_tokens,
-            temperature=temperature, seed=seed), timeout=timeout)
+            temperature=temperature, seed=seed,
+            top_k=top_k, top_p=top_p), timeout=timeout)
         return tensor_to_array(resp.tokens), resp.model_version
+
+    def generate_stream(self, model_name: str, prompt: np.ndarray, *,
+                        max_new_tokens: int = 16, true_len: int = 0,
+                        temperature: float = 0.0, seed: int = 0,
+                        top_k: int = 0, top_p: float = 1.0,
+                        version: Optional[int] = None,
+                        timeout: float = 300.0):
+        """Yield ``(B,)`` int32 token arrays as decode steps complete."""
+        for chunk in self._generate_stream(pb.GenerateRequest(
+                model_name=model_name, version=version or 0,
+                prompt=array_to_tensor(np.asarray(prompt, np.int32)),
+                true_len=true_len, max_new_tokens=max_new_tokens,
+                temperature=temperature, seed=seed,
+                top_k=top_k, top_p=top_p), timeout=timeout):
+            if chunk.done:
+                return
+            yield np.asarray(chunk.tokens, np.int32)
 
     def model_status(self, model_name: str, timeout: float = 30.0):
         resp = self._status(pb.ModelStatusRequest(model_name=model_name),

@@ -218,9 +218,11 @@ class LoadedModel:
     input_shape: Optional[Tuple[int, ...]] = None  # per-sample, for warmup
     input_dtype: str = "float32"
     # autoregressive path (transformer kind): (prompt, true_len, max_new,
-    # temperature, rng_seed, greedy=) -> (B, max_new) int32; None for
-    # non-LM kinds. max_seq_len bounds prompt + new tokens; vocab_size
-    # bounds token ids (both would silently clamp otherwise).
+    # temperature, rng_seed, greedy=, top_k=, top_p=, filtered=) ->
+    # (B, max_new) int32; None for non-LM kinds. greedy/filtered are
+    # static (compile-splitting) flags; top_k/top_p are traced and only
+    # honored when filtered=True. max_seq_len bounds prompt + new tokens;
+    # vocab_size bounds token ids (both would silently clamp otherwise).
     generate: Optional[Callable[..., jnp.ndarray]] = None
     max_seq_len: Optional[int] = None
     vocab_size: Optional[int] = None
@@ -288,16 +290,20 @@ def load_version(base_path: str, version: int) -> LoadedModel:
         max_seq_len = model.config.max_seq_len
         vocab_size = model.config.vocab_size
 
-        # greedy is the only static sampling decision: every temperature
-        # shares one compiled sampling program (a client sweeping
-        # temperatures must not mint unbounded XLA cache entries)
-        @functools.partial(jax.jit, static_argnames=("max_new", "greedy"))
+        # greedy and filtered are the only static sampling decisions:
+        # every temperature/top_k/top_p shares one compiled sampling
+        # program (a client sweeping them must not mint unbounded XLA
+        # cache entries); the unfiltered path stays sort-free
+        @functools.partial(jax.jit,
+                           static_argnames=("max_new", "greedy", "filtered"))
         def generate(prompt, true_len, max_new, temperature, rng_seed, *,
-                     greedy):
+                     greedy, top_k=0, top_p=1.0, filtered=False):
             return _generate(
                 model.config, params, prompt,
                 max_new_tokens=max_new, true_len=true_len,
                 temperature=0.0 if greedy else temperature,
+                top_k=top_k if filtered else 0,
+                top_p=top_p if filtered else 1.0,
                 rng=jax.random.key(rng_seed))
 
     shape = meta.get("input_shape")

@@ -50,10 +50,15 @@ _PAD_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
 
 
 def run_generate(model, body: Dict[str, Any], max_batch_size: int, *,
-                 model_name: str = "") -> Tuple[int, Dict[str, Any]]:
+                 model_name: str = "",
+                 stream: bool = False) -> Tuple[int, Dict[str, Any]]:
     """The generate core shared by the REST ``:generate`` endpoint and
     the gRPC ``Generate`` RPC: validation, prompt/new-token bucketing,
-    the compiled decode call. Returns (http-style status, payload)."""
+    the compiled decode call. Returns (http-style status, payload).
+
+    With ``stream=True`` the payload carries ``token_stream`` — an
+    iterator of per-step token lists (one ``(B,)`` row per decode
+    position) — instead of the dense ``tokens`` matrix."""
     if model.generate is None:
         return 400, {"error": f"model {model_name!r} (kind "
                               f"{model.kind!r}) does not support generate"}
@@ -64,6 +69,8 @@ def run_generate(model, body: Dict[str, Any], max_batch_size: int, *,
     try:
         max_new = int(body.get("max_new_tokens", 16))
         temperature = float(body.get("temperature", 0.0))
+        top_k = int(body.get("top_k", 0))
+        top_p = float(body.get("top_p", 1.0))
         seed = int(body.get("seed", 0))
         # RAGGED batches are first-class: each row keeps its own length
         # (per-row cache positions in the decode core); iterating also
@@ -99,6 +106,13 @@ def run_generate(model, body: Dict[str, Any], max_batch_size: int, *,
     if temperature < 0:
         # a negative temperature silently inverts the distribution
         return 400, {"error": "temperature must be >= 0"}
+    if not 0 <= top_k < 2**31:
+        return 400, {"error": "top_k must be in [0, 2**31) (0 = no filter)"}
+    if not 0.0 < top_p <= 1.0:
+        return 400, {"error": "top_p must be in (0, 1]"}
+    if not -2**31 <= seed < 2**31:
+        # the seed is a traced int32 in the compiled sampler
+        return 400, {"error": "seed must fit in int32"}
     if arr.ndim != 2:
         return 400, {"error": f"prompt_tokens must be a 2-D batch of "
                               f"token lists, got shape {arr.shape}"}
@@ -158,18 +172,33 @@ def run_generate(model, body: Dict[str, Any], max_batch_size: int, *,
     lens_padded[:n] = lens_arr
     t0 = time.perf_counter()
     try:
+        greedy = temperature == 0.0
         out = np.asarray(model.generate(
             jnp.asarray(padded), jnp.asarray(lens_padded), new_bucket,
             jnp.float32(temperature), seed,
-            greedy=temperature == 0.0))[:n, :max_new]
+            greedy=greedy,
+            top_k=jnp.int32(top_k), top_p=jnp.float32(top_p),
+            # greedy ignores the filters — don't mint a second compiled
+            # program for greedy+filtered requests
+            filtered=(top_k > 0 or top_p < 1.0) and not greedy,
+            ))[:n, :max_new]
+    except (TypeError, ValueError) as e:
+        # JAX surfaces shape/dtype mismatches as TypeError/ValueError —
+        # request-data problems the schema checks above can't see
+        return 400, {"error": f"generate failed: "
+                              f"{type(e).__name__}: {e}"}
     except Exception as e:  # noqa: BLE001
-        # validation all happened above — a failure here is the model /
-        # runtime (XLA faults, OOM), a server error, not a client one
+        # anything else is the model / runtime (XLA faults, OOM) — a
+        # server error, not a client one
         return 500, {"error": f"generate failed: "
                               f"{type(e).__name__}: {e}"}
     dt = time.perf_counter() - t0
     _gen_requests.inc(model=model_name)
     _gen_latency.set(dt, model=model_name)
+    if stream:
+        return 200, {"token_stream": (out[:, t].tolist()
+                                      for t in range(out.shape[1])),
+                     "model_version": str(model.version)}
     return 200, {"tokens": out.tolist(),
                  "model_version": str(model.version),
                  "tokens_per_sec": round(out.size / dt, 1)}
@@ -355,8 +384,12 @@ class ModelServer:
         padded, n = _pad_batch(arr, self.max_batch_size)
         try:
             out = np.asarray(model.predict(jnp.asarray(padded)))[:n]
+        except (TypeError, ValueError) as e:
+            # JAX surfaces shape/dtype mismatches as TypeError/ValueError;
+            # models without input_shape metadata can't be pre-checked
+            return 400, {"error": f"predict failed: {type(e).__name__}: {e}"}
         except Exception as e:  # noqa: BLE001
-            # inputs validated above — this is an execution fault
+            # anything else is an execution fault (XLA runtime, OOM)
             return 500, {"error": f"predict failed: {type(e).__name__}: {e}"}
         dt = time.perf_counter() - t0
         _requests.inc(model=name)
@@ -365,7 +398,8 @@ class ModelServer:
                      "model_version": str(model.version)}
 
     def handle_generate(self, name: str, version: Optional[int],
-                        body: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+                        body: Dict[str, Any],
+                        stream: bool = False) -> Tuple[int, Dict[str, Any]]:
         """Autoregressive generation (transformer models): prompts are
         right-padded to a power-of-two bucket, so the compiled prefill is
         reused across prompt lengths (one compile per bucket, like the
@@ -374,7 +408,7 @@ class ModelServer:
         if model is None:
             return 404, {"error": f"model {name!r} not found"}
         return run_generate(model, body, self.max_batch_size,
-                            model_name=name)
+                            model_name=name, stream=stream)
 
     # -- HTTP plumbing -----------------------------------------------------
 
@@ -382,6 +416,10 @@ class ModelServer:
         server = self
 
         class Handler(BaseHTTPRequestHandler):
+            # chunked transfer (the streaming generate path) needs 1.1;
+            # every non-streamed response still sets Content-Length
+            protocol_version = "HTTP/1.1"
+
             def _send(self, code: int, payload: Dict[str, Any]) -> None:
                 body = json.dumps(payload).encode()
                 self.send_response(code)
@@ -436,6 +474,34 @@ class ModelServer:
                         version = int(v)
                     else:
                         name = target
+                    if verb == ":generate" and body.get("stream"):
+                        code, payload = server.handle_generate(
+                            name, version, body, stream=True)
+                        if code != 200:
+                            self._send(code, payload)
+                            return
+                        # JSON-lines over chunked transfer: one line per
+                        # decode step, flushed as the generation core
+                        # yields it
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/jsonlines")
+                        self.send_header("Transfer-Encoding", "chunked")
+                        self.end_headers()
+
+                        def chunk(obj):
+                            line = json.dumps(obj).encode() + b"\n"
+                            self.wfile.write(
+                                f"{len(line):x}\r\n".encode() + line +
+                                b"\r\n")
+                            self.wfile.flush()
+
+                        for toks in payload["token_stream"]:
+                            chunk({"tokens": toks})
+                        chunk({"done": True,
+                               "model_version": payload["model_version"]})
+                        self.wfile.write(b"0\r\n\r\n")
+                        return
                     code, payload = handlers[verb](name, version, body)
                     self._send(code, payload)
                 else:

@@ -1,0 +1,146 @@
+"""Oracle tests for the serving sampler (top-k / top-p / temperature).
+
+The reference platform has no sampling surface at all (TF-Serving is an
+opaque predict box); these are the support-set oracles any LM serving
+stack must satisfy: a filter may only ever assign probability to tokens
+inside its support, and the support is computable exactly from the
+logits on the host.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models.decode import sample_logits
+
+
+def _draws(logits, n, **kw):
+    keys = jax.random.split(jax.random.key(0), n)
+    out = jax.jit(jax.vmap(lambda k: sample_logits(logits, k, **kw)))(keys)
+    return np.asarray(out)  # (n, B)
+
+
+def test_greedy_rows_are_argmax():
+    logits = jnp.asarray(np.random.default_rng(0).normal(size=(3, 17)),
+                         jnp.float32)
+    out = _draws(logits, 4, temperature=0.0)
+    assert (out == np.argmax(np.asarray(logits), -1)[None]).all()
+
+
+def test_top_k_one_is_argmax_even_with_temperature():
+    logits = jnp.asarray(np.random.default_rng(1).normal(size=(2, 33)),
+                         jnp.float32)
+    out = _draws(logits, 16, temperature=5.0, top_k=1)
+    assert (out == np.argmax(np.asarray(logits), -1)[None]).all()
+
+
+def test_top_k_support_set():
+    rng = np.random.default_rng(2)
+    logits_np = rng.normal(size=(4, 50)).astype(np.float32)
+    k = 3
+    out = _draws(jnp.asarray(logits_np), 64, temperature=1.0, top_k=k)
+    topk = np.argsort(-logits_np, axis=-1)[:, :k]  # (B, k) support
+    for b in range(logits_np.shape[0]):
+        assert set(out[:, b]) <= set(topk[b]), f"row {b} escaped top-{k}"
+
+
+def test_top_k_all_kept_matches_plain_sampling():
+    """k >= V (and k=0) must not change the distribution: same key, same
+    sample as the unfiltered categorical."""
+    logits = jnp.asarray(np.random.default_rng(3).normal(size=(2, 11)),
+                         jnp.float32)
+    key = jax.random.key(7)
+    plain = jax.random.categorical(key, logits, axis=-1)
+    for k in (0, 11, 99):
+        got = sample_logits(logits, key, temperature=1.0, top_k=k)
+        assert (np.asarray(got) == np.asarray(plain)).all()
+
+
+def test_top_p_tiny_keeps_only_top_token():
+    logits = jnp.asarray(np.random.default_rng(4).normal(size=(3, 29)),
+                         jnp.float32)
+    out = _draws(logits, 32, temperature=1.0, top_p=1e-6)
+    assert (out == np.argmax(np.asarray(logits), -1)[None]).all()
+
+
+def test_top_p_support_set_matches_host_oracle():
+    """The sampled support must equal the nucleus computed on the host:
+    the smallest prefix of the sorted distribution with mass >= p."""
+    rng = np.random.default_rng(5)
+    # peaked logits so the nucleus is small and the test is sharp
+    logits_np = (3.0 * rng.normal(size=(4, 40))).astype(np.float32)
+    p = 0.7
+    out = _draws(jnp.asarray(logits_np), 256, temperature=1.0, top_p=p)
+    for b in range(logits_np.shape[0]):
+        srt = np.sort(logits_np[b])[::-1]
+        probs = np.exp(srt - srt.max())
+        probs /= probs.sum()
+        before = np.cumsum(probs) - probs
+        n_keep = int((before < p).sum())
+        support = set(np.argsort(-logits_np[b])[:n_keep])
+        drawn = set(out[:, b])
+        assert drawn <= support, f"row {b}: {drawn - support} outside nucleus"
+        # 256 draws at p=0.7 over a peaked head should hit >1 token
+        # unless the nucleus itself is a single token
+        if n_keep > 1:
+            assert len(drawn) > 1
+
+
+def test_top_k_and_top_p_compose():
+    """top_p applies to the RENORMALISED top-k distribution."""
+    logits_np = np.array([[0.0, -0.1, -0.2, -10.0, -10.0]], np.float32)
+    # top_k=3 keeps {0,1,2}; renormalised they are ~{.36,.33,.30};
+    # top_p=0.5 then keeps {0,1} (0.36 < 0.5, 0.36+0.33 > 0.5)
+    out = _draws(jnp.asarray(logits_np), 128, temperature=1.0,
+                 top_k=3, top_p=0.5)
+    assert set(out[:, 0]) == {0, 1}
+
+
+def test_per_row_params_mix_in_one_call():
+    """Rows with different sampling settings share one compiled call —
+    the continuous-batching engine's contract."""
+    rng = np.random.default_rng(6)
+    logits_np = rng.normal(size=(3, 21)).astype(np.float32)
+    out = _draws(jnp.asarray(logits_np), 64,
+                 temperature=jnp.asarray([0.0, 1.0, 1.0]),
+                 top_k=jnp.asarray([0, 1, 4], jnp.int32),
+                 top_p=jnp.asarray([1.0, 1.0, 1.0]))
+    am = np.argmax(logits_np, -1)
+    assert (out[:, 0] == am[0]).all()          # greedy row
+    assert (out[:, 1] == am[1]).all()          # top-1 row
+    top4 = set(np.argsort(-logits_np[2])[:4])
+    assert set(out[:, 2]) <= top4              # top-4 row
+
+
+def test_temperature_sharpens():
+    """Low temperature must concentrate draws on the argmax."""
+    logits = jnp.asarray([[1.0, 0.8, 0.5, 0.0]], jnp.float32)
+    cold = _draws(logits, 200, temperature=0.05)
+    hot = _draws(logits, 200, temperature=5.0)
+    am = 0
+    assert (cold[:, 0] == am).mean() > 0.95
+    assert (hot[:, 0] == am).mean() < 0.7
+
+
+def test_generate_accepts_filters_and_validates():
+    from kubeflow_tpu.models.decode import generate
+    from kubeflow_tpu.models import Transformer, TransformerConfig
+
+    config = TransformerConfig(vocab_size=31, d_model=16, n_layers=1,
+                               n_heads=2, n_kv_heads=2, d_ff=32,
+                               max_seq_len=16, dtype=jnp.float32,
+                               remat=False)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    params = Transformer(config).init(jax.random.key(0), prompt)["params"]
+    out = generate(config, params, prompt, max_new_tokens=4,
+                   temperature=1.0, top_k=1, rng=jax.random.key(1))
+    ref = generate(config, params, prompt, max_new_tokens=4)
+    # top_k=1 sampling must equal greedy decoding token-for-token
+    assert (np.asarray(out) == np.asarray(ref)).all()
+    with pytest.raises(ValueError, match="top_k"):
+        generate(config, params, prompt, max_new_tokens=2,
+                 temperature=1.0, top_k=-1, rng=jax.random.key(1))
+    with pytest.raises(ValueError, match="top_p"):
+        generate(config, params, prompt, max_new_tokens=2,
+                 temperature=1.0, top_p=0.0, rng=jax.random.key(1))

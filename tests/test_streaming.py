@@ -1,0 +1,112 @@
+"""Streaming generation surfaces: REST chunked JSON-lines and gRPC
+server-streaming — both must deliver exactly the tokens the unary path
+produces, one decode position at a time.
+
+Reference bar being exceeded: TF-Serving's surface is unary predict only
+(``/root/reference/kubeflow/tf-serving/tf-serving-template.libsonnet:33-48``);
+an LM serving stack needs incremental token delivery.
+"""
+
+import http.client
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import Transformer, TransformerConfig
+from kubeflow_tpu.serving import ModelServer, export_model, transformer_export_config
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    config = TransformerConfig(vocab_size=97, d_model=32, n_layers=2,
+                               n_heads=4, n_kv_heads=2, d_ff=64,
+                               max_seq_len=32, dtype=jnp.float32,
+                               remat=False)
+    prompt = jax.random.randint(jax.random.key(1), (2, 5), 0,
+                                config.vocab_size)
+    params = Transformer(config).init(jax.random.key(0), prompt)["params"]
+    base = tmp_path_factory.mktemp("models")
+    export_model(str(base / "lm"), "transformer", params, version=1,
+                 config=transformer_export_config(config))
+    srv = ModelServer(str(base), port=0, poll_interval_s=3600)
+    port = srv.start()
+    yield srv, port, np.asarray(prompt)
+    srv.stop()
+
+
+def _unary(port, body):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request("POST", "/v1/models/lm:generate", json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    out = json.loads(resp.read())
+    conn.close()
+    return resp.status, out
+
+
+def _stream(port, body):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request("POST", "/v1/models/lm:generate",
+                 json.dumps({**body, "stream": True}),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    lines = [json.loads(ln) for ln in resp.read().splitlines() if ln]
+    conn.close()
+    return resp.status, resp.getheader("Transfer-Encoding"), lines
+
+
+def test_rest_stream_matches_unary(served):
+    srv, port, prompt = served
+    body = {"prompt_tokens": prompt.tolist(), "max_new_tokens": 4}
+    s1, unary = _unary(port, body)
+    s2, te, lines = _stream(port, body)
+    assert s1 == s2 == 200
+    assert te == "chunked"
+    assert lines[-1]["done"] is True
+    assert lines[-1]["model_version"] == unary["model_version"]
+    steps = [ln["tokens"] for ln in lines[:-1]]
+    # steps are per-position rows: transpose back to (B, T)
+    np.testing.assert_array_equal(np.asarray(steps).T, unary["tokens"])
+
+
+def test_rest_stream_validation_errors_are_plain_json(served):
+    srv, port, prompt = served
+    status, out = _unary(port, {"prompt_tokens": [[1]], "top_p": 7,
+                                "stream": True})
+    assert status == 400 and "top_p" in out["error"]
+
+
+def test_grpc_stream_matches_unary(served):
+    grpc = pytest.importorskip("grpc")  # noqa: F841
+    from kubeflow_tpu.serving.grpc_server import PredictClient, serve_grpc
+
+    srv, port, prompt = served
+    gsrv, gport = serve_grpc(srv.repo, 0, max_batch_size=8)
+    try:
+        cli = PredictClient(f"127.0.0.1:{gport}")
+        unary, ver = cli.generate("lm", prompt, max_new_tokens=4)
+        steps = list(cli.generate_stream("lm", prompt, max_new_tokens=4))
+        assert len(steps) == 4
+        np.testing.assert_array_equal(np.stack(steps, axis=1), unary)
+        cli.close()
+    finally:
+        gsrv.stop(grace=0.5)
+
+
+def test_grpc_stream_rejects_bad_model(served):
+    grpc = pytest.importorskip("grpc")
+    from kubeflow_tpu.serving.grpc_server import PredictClient, serve_grpc
+
+    srv, port, prompt = served
+    gsrv, gport = serve_grpc(srv.repo, 0, max_batch_size=8)
+    try:
+        cli = PredictClient(f"127.0.0.1:{gport}")
+        with pytest.raises(grpc.RpcError) as ei:
+            list(cli.generate_stream("nope", prompt))
+        assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+        cli.close()
+    finally:
+        gsrv.stop(grace=0.5)
