@@ -529,6 +529,67 @@ def bench_decode(batch: int = 8, prompt_len: int = 128,
     }
 
 
+def bench_decode_engine(concurrency: int = 48, slots: int = 32,
+                        prompt_len: int = 128, new_tokens: int = 128,
+                        steps_per_sync: int = 8, d_model: int = 1024,
+                        n_layers: int = 8, n_heads: int = 16,
+                        d_ff: int = 4096) -> Dict[str, Any]:
+    """Continuous-batching serving throughput: ``concurrency`` generate
+    requests share the DecodeEngine's ``slots``-row decode batch
+    (``kubeflow_tpu/serving/engine.py``) — the production :generate
+    path. Decode is HBM-bound per step, so throughput scales with
+    effective batch until cache traffic dominates; this measures the
+    engine at effective batch = ``slots`` (vs ``bench_decode``'s fixed
+    whole-request batch), including prefill, admission, and sampling
+    overheads — the number a capacity planner uses."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import Transformer, TransformerConfig
+    from kubeflow_tpu.serving.engine import DecodeEngine
+
+    n_chips = jax.device_count()
+    config = TransformerConfig(
+        vocab_size=32000, d_model=d_model, n_layers=n_layers,
+        n_heads=n_heads, n_kv_heads=n_heads, d_ff=d_ff,
+        max_seq_len=prompt_len + new_tokens, remat=False)
+    model = Transformer(config)
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, config.vocab_size,
+                           (concurrency, prompt_len), dtype=np.int32)
+    params = jax.jit(model.init)(
+        jax.random.key(1),
+        jnp.asarray(prompts[:2]))["params"]
+
+    eng = DecodeEngine(config, params, slots=slots,
+                       steps_per_sync=steps_per_sync, autostart=False,
+                       name="bench")
+    def drain():
+        while eng.active_count or not eng._pending.empty():
+            eng.run_once(timeout=0.01)
+
+    # warm the three compiled programs (prefill bucket, insert, step)
+    warm = eng.submit(prompts[0], max_new=steps_per_sync + 1)
+    drain()
+    list(warm.stream())
+
+    t0 = time.perf_counter()
+    reqs = [eng.submit(p, max_new=new_tokens) for p in prompts]
+    drain()
+    total = sum(len(r.result()) for r in reqs)
+    dt = time.perf_counter() - t0
+    return {
+        "tokens_per_sec_per_chip": round(total / dt / n_chips, 1),
+        "effective_batch": slots,
+        "concurrency": concurrency,
+        "steps_per_sync": steps_per_sync,
+        "new_tokens": new_tokens,
+        "prompt_len": prompt_len,
+        "engine_steps": eng.steps_total,
+        "n_chips": n_chips,
+    }
+
+
 # -- config 5: serving latency/QPS -------------------------------------------
 
 
@@ -658,6 +719,7 @@ CONFIGS: Dict[str, Callable[[], Dict[str, Any]]] = {
     "allreduce": bench_allreduce,
     "serving": bench_serving,
     "decode": bench_decode,
+    "decode_engine": bench_decode_engine,
 }
 
 

@@ -9,6 +9,7 @@ from kubeflow_tpu.serving.model_store import (  # noqa: F401
     load_version,
 )
 from kubeflow_tpu.serving.server import ModelRepository, ModelServer  # noqa: F401
+from kubeflow_tpu.serving.engine import DecodeEngine  # noqa: F401
 from kubeflow_tpu.serving.proxy import PredictProxy  # noqa: F401
 from kubeflow_tpu.serving.batch_predict import (  # noqa: F401
     batch_predict_job,

@@ -1,0 +1,389 @@
+"""Continuous-batching decode engine: concurrent generate requests share
+one compiled decode step.
+
+The reference platform's serving tier batches at the RPC layer
+(TF-Serving's ``enable_batching`` scheduler,
+``/root/reference/kubeflow/tf-serving/tf-serving-template.libsonnet:33-48``)
+— whole requests queue for a fixed-shape batch. That is the wrong shape
+for autoregressive decoding, where a request is a *sequence* of steps:
+batching whole requests serializes callers behind the longest
+generation. TPU-first, the engine instead owns a persistent device-side
+KV cache with ``slots`` independent rows and runs ONE compiled
+single-token step over all of them, forever:
+
+- **submit** — a request (prompt + sampling params) joins the admission
+  queue; its prompt is prefilled at batch 1 into a fresh cache row
+  (one compiled prefill per power-of-two prompt bucket, exactly the
+  unary path's bucketing) and the row is written into a free slot of
+  the engine cache with one ``dynamic_update_slice`` (the compiled
+  *insert* — cheap: it touches one row);
+- **step** — every active slot advances one token under one jit:
+  per-row cache positions (the decode core's ragged-batch contract,
+  ``kubeflow_tpu/models/transformer.py:_decode_attend``), per-row
+  sampling parameters, and per-row PRNG keys derived as
+  ``fold_in(key(seed), step_index)`` so a request's tokens are
+  reproducible regardless of which co-tenants share its batch;
+- tokens stream to per-request queues the moment the host sees them —
+  time-to-first-token is one prefill + one step, not one full
+  generation.
+
+Static shapes everywhere: the engine batch is fixed at ``slots``, idle
+rows decode garbage that nothing reads (their writes land in rows the
+next insert overwrites), and XLA compiles exactly three programs per
+model — prefill (per prompt bucket), insert, step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue
+import threading
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.models.decode import prefill, decode_step, sample_logits
+from kubeflow_tpu.utils import DEFAULT_REGISTRY
+
+log = logging.getLogger(__name__)
+
+_steps_total = DEFAULT_REGISTRY.counter(
+    "kftpu_engine_steps_total", "shared decode steps executed")
+_tokens_total = DEFAULT_REGISTRY.counter(
+    "kftpu_engine_tokens_total", "tokens produced by the decode engine")
+_occupancy = DEFAULT_REGISTRY.gauge(
+    "kftpu_engine_active_slots", "active slots in the decode batch")
+_queue_depth = DEFAULT_REGISTRY.gauge(
+    "kftpu_engine_pending_requests", "requests waiting for a slot")
+
+_END = object()  # per-request stream sentinel
+
+
+def pow2_bucket(n: int, cap: int) -> int:
+    """Round ``n`` up to a power of two, capped at ``cap`` — the shared
+    compiled-program bucketing rule for prompts (one compiled prefill
+    per bucket, in both the unary path and engine admission)."""
+    b = 1
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
+def _batch_axis(leaf: jnp.ndarray) -> int:
+    """Cache leaves are ``positions`` (B,)|(L, B) or ``k``/``v``
+    (B, S, KH, Dh)|(L, B, S, KH, Dh) depending on whether layers are
+    stacked by ``nn.scan`` — the batch axis is determined by rank."""
+    return {1: 0, 2: 1, 4: 0, 5: 1}[leaf.ndim]
+
+
+@dataclasses.dataclass
+class _Request:
+    prompt: np.ndarray           # (S,) int32, true length (no padding)
+    max_new: int
+    temperature: float
+    top_k: int
+    top_p: float
+    seed: int
+    eos_id: Optional[int]
+    out: "queue.Queue[Any]" = dataclasses.field(
+        default_factory=queue.Queue)
+    error: Optional[Exception] = None
+    # consumed tokens, so stream()/result() are replayable (a second
+    # call must not block on the drained queue)
+    _seen: List[int] = dataclasses.field(default_factory=list)
+    _done: bool = False
+
+    def stream(self):
+        """Yield token ids as the engine produces them (replayable:
+        tokens already consumed are yielded first)."""
+        yield from list(self._seen)
+        while not self._done:
+            tok = self.out.get()
+            if tok is _END:
+                self._done = True
+                if self.error is not None:
+                    raise self.error
+                return
+            self._seen.append(tok)
+            yield tok
+        if self.error is not None:
+            raise self.error
+
+    def result(self) -> List[int]:
+        return list(self.stream())
+
+
+@dataclasses.dataclass
+class _Slot:
+    req: _Request
+    step_idx: int      # sampling step counter (0 was the prefill sample)
+    produced: int      # tokens emitted so far
+    last_token: int
+
+
+class DecodeEngine:
+    """One engine per loaded transformer model version.
+
+    ``submit()`` is thread-safe and returns a handle whose ``stream()``
+    yields tokens as decode steps complete. The engine thread runs
+    admit → step forever; ``close()`` drains it.
+    """
+
+    def __init__(self, config, params, *, slots: int = 8,
+                 steps_per_sync: int = 1,
+                 autostart: bool = True, name: str = "") -> None:
+        self.config = config
+        self.slots = slots
+        # decode steps executed on-device per host round-trip: >1 hides
+        # dispatch/transfer latency (the dominant cost when the host is
+        # remote from the chip) at the price of admission/EOS reacting
+        # up to that many tokens late — tokens past a row's EOS or
+        # budget are computed and discarded
+        self.steps_per_sync = max(1, int(steps_per_sync))
+        self.name = name or "model"
+        self._params = params
+        self._pending: "queue.Queue[_Request]" = queue.Queue()
+        self._active: List[Optional[_Slot]] = [None] * slots
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()  # guards _active between admit/step
+
+        Smax = config.max_seq_len
+
+        @jax.jit
+        def _prefill_and_sample(params, prompt, true_len, temperature,
+                                top_k, top_p, seed):
+            logits, cache = prefill(config, params, prompt, true_len)
+            key = jax.random.fold_in(jax.random.key(seed), 0)
+            tok = sample_logits(logits, key, temperature=temperature,
+                                top_k=top_k, top_p=top_p)
+            return tok[0], cache
+
+        def _insert(engine_cache, row_cache, slot):
+            return jax.tree_util.tree_map(
+                lambda big, row: jax.lax.dynamic_update_slice(
+                    big, row.astype(big.dtype),
+                    tuple(slot if a == _batch_axis(big) else 0
+                          for a in range(big.ndim))),
+                engine_cache, row_cache)
+
+        self._insert = jax.jit(_insert, donate_argnums=(0,))
+
+        K = self.steps_per_sync
+
+        def _step(params, cache, tokens, seeds, step_idx, temps, top_k,
+                  top_p):
+            """K decode steps under one jit; returns (cache, (K, B))."""
+
+            def one(row_logits, seed, idx, t, k, p):
+                key = jax.random.fold_in(jax.random.key(seed), idx)
+                return sample_logits(row_logits[None], key, temperature=t,
+                                     top_k=k, top_p=p)[0]
+
+            def body(carry, t):
+                cache, tokens = carry
+                logits, cache = decode_step(config, params, cache, tokens)
+                nxt = jax.vmap(one)(logits, seeds, step_idx + t, temps,
+                                    top_k, top_p)
+                return (cache, nxt), nxt
+
+            (cache, _), toks = jax.lax.scan(
+                body, (cache, tokens), jnp.arange(K))
+            return cache, toks
+
+        self._step = jax.jit(_step, donate_argnums=(1,))
+        self._prefill = _prefill_and_sample
+
+        # engine cache: the decode cache shape at batch = slots, zeroed.
+        # eval_shape on prefill gives the layout without running it.
+        probe = jnp.zeros((1, 1), jnp.int32)
+        shapes = jax.eval_shape(
+            lambda p: prefill(config, p, probe)[1], params)
+        self._cache = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(
+                tuple(slots if a == _batch_axis(s) else d
+                      for a, d in enumerate(s.shape)), s.dtype),
+            shapes)
+        # host-side per-slot sampling state, padded to the batch
+        self._tokens = np.zeros((slots,), np.int32)
+        self._seeds = np.zeros((slots,), np.int32)
+        self._stepidx = np.zeros((slots,), np.int32)
+        self._temps = np.zeros((slots,), np.float32)
+        self._topk = np.zeros((slots,), np.int32)
+        self._topp = np.ones((slots,), np.float32)
+        self.steps_total = 0
+        self.tokens_total = 0
+        if autostart:
+            self.start()
+
+    # -- public API --------------------------------------------------------
+
+    def submit(self, prompt, *, max_new: int, temperature: float = 0.0,
+               top_k: int = 0, top_p: float = 1.0, seed: int = 0,
+               eos_id: Optional[int] = None) -> _Request:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if prompt.size + max_new > self.config.max_seq_len:
+            raise ValueError(
+                f"prompt {prompt.size} + max_new {max_new} exceeds "
+                f"context {self.config.max_seq_len}")
+        req = _Request(prompt=prompt, max_new=max_new,
+                       temperature=float(temperature), top_k=int(top_k),
+                       top_p=float(top_p), seed=int(seed), eos_id=eos_id)
+        # the lock orders this against close()'s drain: a submit must
+        # either land before the drain (and be failed by it) or see the
+        # stop flag and raise — never sit in a queue nobody reads
+        with self._lock:
+            if self._stop.is_set():
+                raise RuntimeError("decode engine closed")
+            self._pending.put(req)
+        _queue_depth.set(self._pending.qsize(), model=self.name)
+        return req
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"decode-engine-{self.name}")
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        # fail whatever is still in flight — a hung client is worse than
+        # a retried request (version retirement path). The lock pairs
+        # with submit(): after this drain no new request can enqueue.
+        with self._lock:
+            active = [s.req for s in self._active if s is not None]
+            self._active = [None] * self.slots
+            while True:
+                try:
+                    active.append(self._pending.get_nowait())
+                except queue.Empty:
+                    break
+        for req in active:
+            req.error = RuntimeError("decode engine closed")
+            req.out.put(_END)
+
+    @property
+    def active_count(self) -> int:
+        with self._lock:
+            return sum(s is not None for s in self._active)
+
+    # -- engine internals --------------------------------------------------
+
+    def _admit_one(self, req: _Request, slot: int) -> None:
+        """Prefill the request's prompt and write it into ``slot``."""
+        S = req.prompt.size
+        bucket = pow2_bucket(S, self.config.max_seq_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :S] = req.prompt
+        tok, row_cache = self._prefill(
+            self._params, jnp.asarray(padded),
+            jnp.asarray([S], jnp.int32), jnp.float32(req.temperature),
+            jnp.int32(req.top_k), jnp.float32(req.top_p),
+            jnp.int32(req.seed))
+        self._cache = self._insert(self._cache, row_cache,
+                                   jnp.int32(slot))
+        first = int(tok)
+        st = _Slot(req=req, step_idx=1, produced=0, last_token=first)
+        self._emit(st, first)
+        if not self._finished(st, first):
+            with self._lock:
+                self._active[slot] = st
+        self._tokens[slot] = first
+        self._seeds[slot] = req.seed
+        self._stepidx[slot] = 1
+        self._temps[slot] = req.temperature
+        self._topk[slot] = req.top_k
+        self._topp[slot] = req.top_p
+
+    def _emit(self, slot: _Slot, token: int) -> None:
+        slot.produced += 1
+        self.tokens_total += 1
+        _tokens_total.inc(model=self.name)
+        slot.req.out.put(token)
+
+    def _finished(self, slot: _Slot, token: int) -> bool:
+        done = (slot.produced >= slot.req.max_new or
+                (slot.req.eos_id is not None and token == slot.req.eos_id))
+        if done:
+            slot.req.out.put(_END)
+        return done
+
+    def run_once(self, timeout: float = 0.1) -> bool:
+        """One admit + step cycle; returns True if any work happened.
+        The background loop calls this forever; tests call it directly
+        (``autostart=False``) for deterministic schedules."""
+        worked = self._admit(timeout)
+        with self._lock:
+            active = [(i, s) for i, s in enumerate(self._active)
+                      if s is not None]
+        if not active:
+            return worked
+        self._cache, toks = self._step(
+            self._params, self._cache, jnp.asarray(self._tokens),
+            jnp.asarray(self._seeds), jnp.asarray(self._stepidx),
+            jnp.asarray(self._temps), jnp.asarray(self._topk),
+            jnp.asarray(self._topp))
+        toks = np.asarray(toks)  # (K, B)
+        K = toks.shape[0]
+        self.steps_total += K
+        _steps_total.inc(K, model=self.name)
+        self._stepidx += K
+        self._tokens = toks[-1].copy()
+        for i, slot in active:
+            for t in range(K):
+                tok = int(toks[t, i])
+                slot.last_token = tok
+                slot.step_idx += 1
+                self._emit(slot, tok)
+                if self._finished(slot, tok):
+                    # tokens past EOS/budget in this chunk are discarded
+                    with self._lock:
+                        self._active[i] = None
+                    break
+        _occupancy.set(self.active_count, model=self.name)
+        return True
+
+    def _admit(self, timeout: float) -> bool:
+        """Move pending requests into free slots (prefill + insert)."""
+        admitted = False
+        with self._lock:
+            free = [i for i, s in enumerate(self._active) if s is None]
+        block = not any(s is not None for s in self._active)
+        for slot in free:
+            try:
+                req = self._pending.get(block=block and not admitted,
+                                        timeout=timeout)
+            except queue.Empty:
+                break
+            try:
+                self._admit_one(req, slot)
+            except Exception as e:  # noqa: BLE001 — surface to the caller
+                req.error = e
+                req.out.put(_END)
+            admitted = True
+        _queue_depth.set(self._pending.qsize(), model=self.name)
+        _occupancy.set(self.active_count, model=self.name)
+        return admitted
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.run_once()
+            except Exception:  # noqa: BLE001
+                log.exception("decode engine step failed")
+                # fail every in-flight request rather than hanging clients
+                with self._lock:
+                    active = [s for s in self._active if s is not None]
+                    self._active = [None] * self.slots
+                for s in active:
+                    s.req.error = RuntimeError("decode engine step failed")
+                    s.req.out.put(_END)

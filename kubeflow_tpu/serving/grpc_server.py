@@ -129,7 +129,7 @@ class PredictionServicer:
         except (ValueError, TypeError) as e:
             # TypeError: np.dtype on a garbage dtype string
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-        return model, {
+        body = {
             "prompt_tokens": prompt,
             "max_new_tokens": request.max_new_tokens or 16,
             "temperature": request.temperature,
@@ -139,6 +139,9 @@ class PredictionServicer:
             # proto3 default 0.0 means "unset" — no filter
             "top_p": request.top_p or 1.0,
         }
+        if request.HasField("eos_id"):
+            body["eos_id"] = request.eos_id
+        return model, body
 
     def Generate(self, request: pb.GenerateRequest,
                  context: grpc.ServicerContext) -> pb.GenerateResponse:
@@ -146,8 +149,10 @@ class PredictionServicer:
         fast-path twin of the REST ``:generate`` endpoint (shared core:
         ``kubeflow_tpu.serving.server.run_generate``)."""
         model, body = self._generate_inputs(request, context)
-        code, payload = run_generate(model, body, self.max_batch_size,
-                                     model_name=request.model_name)
+        code, payload = run_generate(
+            model, body, self.max_batch_size,
+            model_name=request.model_name,
+            engine=self.repo.engine_for(request.model_name, model))
         if code != 200:
             # 4xx = the request was bad; 5xx = the model/runtime faulted
             context.abort(grpc.StatusCode.INVALID_ARGUMENT if code < 500
@@ -166,18 +171,23 @@ class PredictionServicer:
         final ``done`` chunk. Chunks arrive as the generation core
         yields them."""
         model, body = self._generate_inputs(request, context)
-        code, payload = run_generate(model, body, self.max_batch_size,
-                                     model_name=request.model_name,
-                                     stream=True)
+        code, payload = run_generate(
+            model, body, self.max_batch_size,
+            model_name=request.model_name, stream=True,
+            engine=self.repo.engine_for(request.model_name, model))
         if code != 200:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT if code < 500
                           else grpc.StatusCode.INTERNAL,
                           payload.get("error", "generate failed"))
         _grpc_generates.inc(model=request.model_name)
         version = int(payload["model_version"])
-        for step_tokens in payload["token_stream"]:
-            yield pb.GenerateChunk(tokens=step_tokens,
-                                   model_version=version)
+        try:
+            for step_tokens in payload["token_stream"]:
+                yield pb.GenerateChunk(tokens=step_tokens,
+                                       model_version=version)
+        except Exception as e:  # noqa: BLE001 — mid-stream engine fault
+            context.abort(grpc.StatusCode.INTERNAL,
+                          f"generate failed: {type(e).__name__}: {e}")
         yield pb.GenerateChunk(done=True, model_version=version)
 
     def GetModelStatus(self, request: pb.ModelStatusRequest,
@@ -282,33 +292,46 @@ class PredictClient:
             inputs=array_to_tensor(np.asarray(inputs))), timeout=timeout)
         return tensor_to_array(resp.outputs), resp.model_version
 
-    def generate(self, model_name: str, prompt: np.ndarray, *,
-                 max_new_tokens: int = 16, true_len: int = 0,
-                 temperature: float = 0.0, seed: int = 0,
-                 top_k: int = 0, top_p: float = 1.0,
-                 version: Optional[int] = None,
-                 timeout: float = 300.0) -> Tuple[np.ndarray, int]:
-        resp = self._generate(pb.GenerateRequest(
+    def _generate_request(self, model_name, prompt, *, max_new_tokens,
+                          true_len, temperature, seed, top_k, top_p,
+                          eos_id, version) -> "pb.GenerateRequest":
+        req = pb.GenerateRequest(
             model_name=model_name, version=version or 0,
             prompt=array_to_tensor(np.asarray(prompt, np.int32)),
             true_len=true_len, max_new_tokens=max_new_tokens,
             temperature=temperature, seed=seed,
-            top_k=top_k, top_p=top_p), timeout=timeout)
+            top_k=top_k, top_p=top_p)
+        if eos_id is not None:
+            req.eos_id = eos_id
+        return req
+
+    def generate(self, model_name: str, prompt: np.ndarray, *,
+                 max_new_tokens: int = 16, true_len: int = 0,
+                 temperature: float = 0.0, seed: int = 0,
+                 top_k: int = 0, top_p: float = 1.0,
+                 eos_id: Optional[int] = None,
+                 version: Optional[int] = None,
+                 timeout: float = 300.0) -> Tuple[np.ndarray, int]:
+        resp = self._generate(self._generate_request(
+            model_name, prompt, max_new_tokens=max_new_tokens,
+            true_len=true_len, temperature=temperature, seed=seed,
+            top_k=top_k, top_p=top_p, eos_id=eos_id, version=version),
+            timeout=timeout)
         return tensor_to_array(resp.tokens), resp.model_version
 
     def generate_stream(self, model_name: str, prompt: np.ndarray, *,
                         max_new_tokens: int = 16, true_len: int = 0,
                         temperature: float = 0.0, seed: int = 0,
                         top_k: int = 0, top_p: float = 1.0,
+                        eos_id: Optional[int] = None,
                         version: Optional[int] = None,
                         timeout: float = 300.0):
         """Yield ``(B,)`` int32 token arrays as decode steps complete."""
-        for chunk in self._generate_stream(pb.GenerateRequest(
-                model_name=model_name, version=version or 0,
-                prompt=array_to_tensor(np.asarray(prompt, np.int32)),
-                true_len=true_len, max_new_tokens=max_new_tokens,
-                temperature=temperature, seed=seed,
-                top_k=top_k, top_p=top_p), timeout=timeout):
+        for chunk in self._generate_stream(self._generate_request(
+                model_name, prompt, max_new_tokens=max_new_tokens,
+                true_len=true_len, temperature=temperature, seed=seed,
+                top_k=top_k, top_p=top_p, eos_id=eos_id,
+                version=version), timeout=timeout):
             if chunk.done:
                 return
             yield np.asarray(chunk.tokens, np.int32)

@@ -226,6 +226,11 @@ class LoadedModel:
     generate: Optional[Callable[..., jnp.ndarray]] = None
     max_seq_len: Optional[int] = None
     vocab_size: Optional[int] = None
+    # transformer kind: the config + params the continuous-batching
+    # decode engine builds its compiled prefill/insert/step from
+    # (kubeflow_tpu/serving/engine.py); None for non-LM kinds
+    lm_config: Any = None
+    lm_params: Any = None
 
     def warmup(self, batch_sizes) -> int:
         """Precompile predict for each batch bucket; returns count warmed."""
@@ -311,7 +316,9 @@ def load_version(base_path: str, version: int) -> LoadedModel:
         kind=kind, version=version, predict=predict,
         input_shape=tuple(shape) if shape else None,
         input_dtype=meta.get("input_dtype", "float32"),
-        generate=generate, max_seq_len=max_seq_len, vocab_size=vocab_size)
+        generate=generate, max_seq_len=max_seq_len, vocab_size=vocab_size,
+        lm_config=model.config if kind == "transformer" else None,
+        lm_params=params if kind == "transformer" else None)
 
 
 def load_latest(base_path: str) -> Optional[LoadedModel]:

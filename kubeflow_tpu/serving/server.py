@@ -50,15 +50,24 @@ _PAD_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
 
 
 def run_generate(model, body: Dict[str, Any], max_batch_size: int, *,
-                 model_name: str = "",
-                 stream: bool = False) -> Tuple[int, Dict[str, Any]]:
+                 model_name: str = "", stream: bool = False,
+                 engine=None) -> Tuple[int, Dict[str, Any]]:
     """The generate core shared by the REST ``:generate`` endpoint and
     the gRPC ``Generate`` RPC: validation, prompt/new-token bucketing,
     the compiled decode call. Returns (http-style status, payload).
 
     With ``stream=True`` the payload carries ``token_stream`` — an
     iterator of per-step token lists (one ``(B,)`` row per decode
-    position) — instead of the dense ``tokens`` matrix."""
+    position) — instead of the dense ``tokens`` matrix.
+
+    With ``engine`` set (a :class:`~kubeflow_tpu.serving.engine.DecodeEngine`),
+    each prompt row becomes an engine request sharing the engine's
+    decode batch with every other in-flight caller: tokens stream as
+    steps complete, ``eos_id`` stops a row early (the dense response
+    right-pads finished rows with their final token), and row *i*
+    samples reproducibly from ``seed + i`` regardless of co-tenants.
+    Greedy output is identical to the bucketed batch path; sampled
+    output is reproducible but not bitwise-equal to it."""
     if model.generate is None:
         return 400, {"error": f"model {model_name!r} (kind "
                               f"{model.kind!r}) does not support generate"}
@@ -113,6 +122,20 @@ def run_generate(model, body: Dict[str, Any], max_batch_size: int, *,
     if not -2**31 <= seed < 2**31:
         # the seed is a traced int32 in the compiled sampler
         return 400, {"error": "seed must fit in int32"}
+    eos_id = body.get("eos_id")
+    if eos_id is not None:
+        try:
+            eos_id = int(eos_id)
+        except (TypeError, ValueError):
+            return 400, {"error": "eos_id must be an int token id"}
+        if model.vocab_size and not 0 <= eos_id < model.vocab_size:
+            return 400, {"error": f"eos_id must be in [0, "
+                                  f"{model.vocab_size})"}
+        if engine is None:
+            # only the engine path watches for EOS; honoring it half the
+            # time silently would be worse than refusing
+            return 400, {"error": "eos_id requires the decode engine "
+                                  "(server started with decode_slots=0)"}
     if arr.ndim != 2:
         return 400, {"error": f"prompt_tokens must be a 2-D batch of "
                               f"token lists, got shape {arr.shape}"}
@@ -129,18 +152,22 @@ def run_generate(model, body: Dict[str, Any], max_batch_size: int, *,
         # out-of-range ids would silently clamp in the embedding take
         return 400, {"error": f"token ids must be in [0, "
                               f"{model.vocab_size})"}
+    from kubeflow_tpu.serving.engine import pow2_bucket
+
     true_len = int(lens_arr.max())
     ctx = model.max_seq_len or 0
 
-    def pow2(n: int) -> int:
-        b = 1
-        while b < n:
-            b *= 2
-        return b
+    if engine is not None:
+        return _run_generate_engine(
+            engine, arr, row_lens, max_new=max_new, ctx=ctx,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            seed=seed, eos_id=eos_id, stream=stream,
+            model_name=model_name, model_version=model.version)
 
     # prompt bucket: one compiled prefill per bucket, capped at the
-    # model context (3072-context models serve 2100-token prompts)
-    bucket = min(pow2(true_len), ctx)
+    # model context (3072-context models serve 2100-token prompts) —
+    # the same rule engine admission uses (pow2_bucket)
+    bucket = pow2_bucket(true_len, ctx)
     # new-token bucket likewise (a client sweeping max_new_tokens
     # must not mint unbounded compiled programs); decode the bucket,
     # return the first max_new. Decode writes start at true_len (the
@@ -150,7 +177,7 @@ def run_generate(model, body: Dict[str, Any], max_batch_size: int, *,
     # a raw ctx - true_len clamp would mint one compiled program per
     # distinct prompt length near the context end.
     budget = max(ctx - true_len, 0)
-    new_bucket = pow2(max_new)
+    new_bucket = pow2_bucket(max_new, 1 << 30)
     while new_bucket > budget:
         new_bucket //= 2
     if new_bucket < max_new <= budget:
@@ -204,6 +231,76 @@ def run_generate(model, body: Dict[str, Any], max_batch_size: int, *,
                  "tokens_per_sec": round(out.size / dt, 1)}
 
 
+def _run_generate_engine(engine, arr, row_lens, *, max_new, ctx,
+                         temperature, top_k, top_p, seed, eos_id,
+                         stream, model_name,
+                         model_version) -> Tuple[int, Dict[str, Any]]:
+    """Engine half of :func:`run_generate`: one engine request per
+    prompt row, sharing the decode batch with all other callers."""
+    over = [l for l in row_lens if l + max_new > ctx]
+    if over:
+        return 400, {"error": f"prompt ({max(over)}) + max_new_tokens "
+                              f"({max_new}) exceed the model context "
+                              f"({ctx})"}
+    t0 = time.perf_counter()
+    try:
+        # per-row seeds derive from the request seed; int32 wraparound
+        # keeps row seeds valid for any validated base seed
+        reqs = [engine.submit(arr[i, :row_lens[i]], max_new=max_new,
+                              temperature=temperature, top_k=top_k,
+                              top_p=top_p,
+                              seed=int((np.int64(seed) + i) & 0x7FFFFFFF),
+                              eos_id=eos_id)
+                for i in range(arr.shape[0])]
+    except ValueError as e:
+        return 400, {"error": str(e)}
+    except RuntimeError as e:
+        # engine closed mid-request (version rollover) — retryable
+        return 503, {"error": str(e)}
+    _gen_requests.inc(model=model_name)
+
+    if stream:
+        def steps():
+            iters = [r.stream() for r in reqs]
+            lasts = [0] * len(iters)
+            done = [False] * len(iters)
+            while True:
+                fresh = False
+                for i, it in enumerate(iters):
+                    if done[i]:
+                        continue
+                    try:
+                        lasts[i] = next(it)
+                        fresh = True
+                    except StopIteration:
+                        done[i] = True
+                if not fresh:
+                    return
+                # finished rows repeat their final token (EOS) so the
+                # line stays a full (B,) row
+                yield [int(t) for t in lasts]
+
+        return 200, {"token_stream": steps(),
+                     "model_version": str(model_version)}
+
+    try:
+        rows = [r.result() for r in reqs]
+    except ValueError as e:
+        return 400, {"error": f"generate failed: {e}"}
+    except Exception as e:  # noqa: BLE001 — engine/runtime fault
+        return 500, {"error": f"generate failed: "
+                              f"{type(e).__name__}: {e}"}
+    dt = time.perf_counter() - t0
+    produced = sum(len(r) for r in rows)
+    # EOS-terminated rows are right-padded with their final token so the
+    # response keeps the dense (B, max_new) contract
+    out = [row + [row[-1]] * (max_new - len(row)) for row in rows]
+    _gen_latency.set(dt, model=model_name)
+    return 200, {"tokens": out,
+                 "model_version": str(model_version),
+                 "tokens_per_sec": round(produced / dt, 1)}
+
+
 def _pad_batch(arr: np.ndarray, max_batch: int) -> Tuple[np.ndarray, int]:
     """Pad the leading dim up to a fixed bucket to keep XLA shapes stable."""
     n = arr.shape[0]
@@ -220,7 +317,9 @@ class ModelRepository:
 
     def __init__(self, base_path: str, *, poll_interval_s: float = 10.0,
                  pin_version: Optional[int] = None,
-                 warmup_batches: Tuple[int, ...] = ()) -> None:
+                 warmup_batches: Tuple[int, ...] = (),
+                 decode_slots: int = 0,
+                 decode_steps_per_sync: int = 1) -> None:
         self.base_path = base_path
         self.poll_interval_s = poll_interval_s
         # padded batch buckets to precompile at load time, before the new
@@ -231,11 +330,39 @@ class ModelRepository:
         # latest — otherwise every canary backend converges on the same model
         # and the Istio weight split is a no-op.
         self.pin_version = pin_version
+        # > 0: transformer models serve :generate through a shared
+        # continuous-batching DecodeEngine with this many slots
+        # (concurrent callers share one compiled decode step)
+        self.decode_slots = decode_slots
+        self.decode_steps_per_sync = decode_steps_per_sync
         self._models: Dict[str, LoadedModel] = {}
         self._pinned: Dict[Tuple[str, int], LoadedModel] = {}
+        self._engines: Dict[Tuple[str, int], Any] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self.refresh()
+
+    def engine_for(self, name: str, model: LoadedModel):
+        """The continuous-batching engine for this model version (created
+        lazily), or None when disabled / not an LM."""
+        if self.decode_slots <= 0 or model.lm_config is None:
+            return None
+        key = (name, model.version)
+        with self._lock:
+            eng = self._engines.get(key)
+        if eng is not None:
+            return eng
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        eng = DecodeEngine(model.lm_config, model.lm_params,
+                           slots=self.decode_slots,
+                           steps_per_sync=self.decode_steps_per_sync,
+                           name=name)
+        with self._lock:
+            race = self._engines.setdefault(key, eng)
+        if race is not eng:
+            eng.close()
+        return race
 
     def model_names(self) -> list:
         if not os.path.isdir(self.base_path):
@@ -273,6 +400,17 @@ class ModelRepository:
             self._warmup(name, loaded)
             with self._lock:
                 self._models[name] = loaded
+                # retire the outgoing version's decode engine (it holds a
+                # full KV cache) — but keep engines for versions still
+                # served from _pinned (explicit-version canary clients).
+                # close() fails that engine's in-flight requests; clients
+                # retry against the new version.
+                stale = [k for k in self._engines
+                         if k[0] == name and k[1] != latest
+                         and k not in self._pinned]
+                retired = [self._engines.pop(k) for k in stale]
+            for eng in retired:
+                eng.close()
 
     def _warmup(self, name: str, loaded: LoadedModel) -> None:
         if not self.warmup_batches:
@@ -338,17 +476,25 @@ class ModelRepository:
 
     def stop(self) -> None:
         self._stop.set()
+        with self._lock:
+            engines = list(self._engines.values())
+            self._engines.clear()
+        for eng in engines:
+            eng.close()
 
 
 class ModelServer:
     def __init__(self, base_path: str, *, port: int = 8500,
                  max_batch_size: int = 8, poll_interval_s: float = 10.0,
                  pin_version: Optional[int] = None,
-                 warmup: bool = False) -> None:
+                 warmup: bool = False, decode_slots: int = 0,
+                 decode_steps_per_sync: int = 1) -> None:
         buckets = tuple(b for b in _PAD_BUCKETS if b <= max_batch_size)
         self.repo = ModelRepository(base_path, poll_interval_s=poll_interval_s,
                                     pin_version=pin_version,
-                                    warmup_batches=buckets if warmup else ())
+                                    warmup_batches=buckets if warmup else (),
+                                    decode_slots=decode_slots,
+                                    decode_steps_per_sync=decode_steps_per_sync)
         self.port = port
         self.max_batch_size = max_batch_size
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -408,7 +554,8 @@ class ModelServer:
         if model is None:
             return 404, {"error": f"model {name!r} not found"}
         return run_generate(model, body, self.max_batch_size,
-                            model_name=name, stream=stream)
+                            model_name=name, stream=stream,
+                            engine=self.repo.engine_for(name, model))
 
     # -- HTTP plumbing -----------------------------------------------------
 
@@ -496,10 +643,16 @@ class ModelServer:
                                 b"\r\n")
                             self.wfile.flush()
 
-                        for toks in payload["token_stream"]:
-                            chunk({"tokens": toks})
-                        chunk({"done": True,
-                               "model_version": payload["model_version"]})
+                        try:
+                            for toks in payload["token_stream"]:
+                                chunk({"tokens": toks})
+                            chunk({"done": True,
+                                   "model_version":
+                                       payload["model_version"]})
+                        except Exception as e:  # noqa: BLE001
+                            # mid-stream failure: the 200 is already on
+                            # the wire, so the error becomes a line
+                            chunk({"error": f"{type(e).__name__}: {e}"})
                         self.wfile.write(b"0\r\n\r\n")
                         return
                     code, payload = handlers[verb](name, version, body)
@@ -576,7 +729,14 @@ def main() -> None:
     server = ModelServer(base, port=port, max_batch_size=max_batch,
                          pin_version=parse_pin_version(
                              os.environ.get("KFTPU_MODEL_VERSION")),
-                         warmup=os.environ.get("KFTPU_WARMUP", "1") != "0")
+                         warmup=os.environ.get("KFTPU_WARMUP", "1") != "0",
+                         # continuous batching is the production default;
+                         # 0 falls back to whole-request bucketed batches
+                         decode_slots=int(
+                             os.environ.get("KFTPU_DECODE_SLOTS", "8")),
+                         decode_steps_per_sync=int(
+                             os.environ.get("KFTPU_DECODE_STEPS_PER_SYNC",
+                                            "4")))
     server.start()
     grpc_server = None  # keep the reference: grpc.Server dies when GC'd
     if grpc_port:

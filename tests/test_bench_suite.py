@@ -72,6 +72,18 @@ def test_mfu_math():
     assert out == {}  # CPU: no peak → no MFU claimed
 
 
+def test_decode_engine_config_tiny():
+    # tiny model: the CPU tier checks the continuous-batching path end to
+    # end (prefill/insert/chunked step/drain); the chip checks the speed
+    out = suite.bench_decode_engine(concurrency=3, slots=2, prompt_len=8,
+                                    new_tokens=8, steps_per_sync=4,
+                                    d_model=32, n_layers=2, n_heads=2,
+                                    d_ff=64)
+    assert out["tokens_per_sec_per_chip"] > 0
+    assert out["effective_batch"] == 2
+    assert out["engine_steps"] > 0
+
+
 def test_longcontext_config_on_virtual_mesh():
     # tiny model: the CPU tier checks the path, the chip checks the speed
     out = suite.bench_longcontext(seq_len=512, batch_per_chip=1, steps=2,
