@@ -62,6 +62,10 @@ _queue_depth = DEFAULT_REGISTRY.gauge(
 _END = object()  # per-request stream sentinel
 
 
+class EngineClosed(RuntimeError):
+    """The engine was shut down (version rollover) — retryable."""
+
+
 def pow2_bucket(n: int, cap: int) -> int:
     """Round ``n`` up to a power of two, capped at ``cap`` — the shared
     compiled-program bucketing rule for prompts (one compiled prefill
@@ -119,9 +123,9 @@ class _Request:
 @dataclasses.dataclass
 class _Slot:
     req: _Request
-    step_idx: int      # sampling step counter (0 was the prefill sample)
-    produced: int      # tokens emitted so far
-    last_token: int
+    produced: int = 0  # tokens emitted so far (1 after the prefill sample);
+    # the device-facing step/token state lives in the engine's host-side
+    # arrays (_stepidx/_tokens) — the slot only tracks delivery
 
 
 class DecodeEngine:
@@ -239,7 +243,7 @@ class DecodeEngine:
         # stop flag and raise — never sit in a queue nobody reads
         with self._lock:
             if self._stop.is_set():
-                raise RuntimeError("decode engine closed")
+                raise EngineClosed("decode engine closed")
             self._pending.put(req)
         _queue_depth.set(self._pending.qsize(), model=self.name)
         return req
@@ -268,7 +272,7 @@ class DecodeEngine:
                 except queue.Empty:
                     break
         for req in active:
-            req.error = RuntimeError("decode engine closed")
+            req.error = EngineClosed("decode engine closed")
             req.out.put(_END)
 
     @property
@@ -292,7 +296,7 @@ class DecodeEngine:
         self._cache = self._insert(self._cache, row_cache,
                                    jnp.int32(slot))
         first = int(tok)
-        st = _Slot(req=req, step_idx=1, produced=0, last_token=first)
+        st = _Slot(req=req)
         self._emit(st, first)
         if not self._finished(st, first):
             with self._lock:
@@ -341,8 +345,6 @@ class DecodeEngine:
         for i, slot in active:
             for t in range(K):
                 tok = int(toks[t, i])
-                slot.last_token = tok
-                slot.step_idx += 1
                 self._emit(slot, tok)
                 if self._finished(slot, tok):
                     # tokens past EOS/budget in this chunk are discarded

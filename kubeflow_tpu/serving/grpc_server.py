@@ -154,9 +154,7 @@ class PredictionServicer:
             model_name=request.model_name,
             engine=self.repo.engine_for(request.model_name, model))
         if code != 200:
-            # 4xx = the request was bad; 5xx = the model/runtime faulted
-            context.abort(grpc.StatusCode.INVALID_ARGUMENT if code < 500
-                          else grpc.StatusCode.INTERNAL,
+            context.abort(_status_for(code),
                           payload.get("error", "generate failed"))
         _grpc_generates.inc(model=request.model_name)
         return pb.GenerateResponse(
@@ -176,8 +174,7 @@ class PredictionServicer:
             model_name=request.model_name, stream=True,
             engine=self.repo.engine_for(request.model_name, model))
         if code != 200:
-            context.abort(grpc.StatusCode.INVALID_ARGUMENT if code < 500
-                          else grpc.StatusCode.INTERNAL,
+            context.abort(_status_for(code),
                           payload.get("error", "generate failed"))
         _grpc_generates.inc(model=request.model_name)
         version = int(payload["model_version"])
@@ -204,6 +201,16 @@ class PredictionServicer:
     def ListModels(self, request: pb.ListModelsRequest,
                    context: grpc.ServicerContext) -> pb.ListModelsResponse:
         return pb.ListModelsResponse(models=self.repo.model_names())
+
+
+def _status_for(code: int) -> "grpc.StatusCode":
+    """HTTP-style core status → gRPC: 4xx = the request was bad, 503 =
+    retryable rollover, other 5xx = the model/runtime faulted."""
+    if code < 500:
+        return grpc.StatusCode.INVALID_ARGUMENT
+    if code == 503:
+        return grpc.StatusCode.UNAVAILABLE
+    return grpc.StatusCode.INTERNAL
 
 
 def _handlers(servicer: PredictionServicer) -> grpc.GenericRpcHandler:

@@ -28,6 +28,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from kubeflow_tpu.serving.engine import EngineClosed, pow2_bucket
 from kubeflow_tpu.serving.model_store import (
     LoadedModel,
     list_versions,
@@ -152,8 +153,6 @@ def run_generate(model, body: Dict[str, Any], max_batch_size: int, *,
         # out-of-range ids would silently clamp in the embedding take
         return 400, {"error": f"token ids must be in [0, "
                               f"{model.vocab_size})"}
-    from kubeflow_tpu.serving.engine import pow2_bucket
-
     true_len = int(lens_arr.max())
     ctx = model.max_seq_len or 0
 
@@ -254,31 +253,35 @@ def _run_generate_engine(engine, arr, row_lens, *, max_new, ctx,
                 for i in range(arr.shape[0])]
     except ValueError as e:
         return 400, {"error": str(e)}
-    except RuntimeError as e:
+    except EngineClosed as e:
         # engine closed mid-request (version rollover) — retryable
         return 503, {"error": str(e)}
     _gen_requests.inc(model=model_name)
 
     if stream:
         def steps():
-            iters = [r.stream() for r in reqs]
-            lasts = [0] * len(iters)
-            done = [False] * len(iters)
-            while True:
-                fresh = False
-                for i, it in enumerate(iters):
-                    if done[i]:
-                        continue
-                    try:
-                        lasts[i] = next(it)
-                        fresh = True
-                    except StopIteration:
-                        done[i] = True
-                if not fresh:
-                    return
-                # finished rows repeat their final token (EOS) so the
-                # line stays a full (B,) row
-                yield [int(t) for t in lasts]
+            try:
+                iters = [r.stream() for r in reqs]
+                lasts = [0] * len(iters)
+                done = [False] * len(iters)
+                while True:
+                    fresh = False
+                    for i, it in enumerate(iters):
+                        if done[i]:
+                            continue
+                        try:
+                            lasts[i] = next(it)
+                            fresh = True
+                        except StopIteration:
+                            done[i] = True
+                    if not fresh:
+                        return
+                    # finished rows repeat their final token (EOS) so
+                    # the line stays a full (B,) row
+                    yield [int(t) for t in lasts]
+            finally:
+                _gen_latency.set(time.perf_counter() - t0,
+                                 model=model_name)
 
         return 200, {"token_stream": steps(),
                      "model_version": str(model_version)}
@@ -287,6 +290,10 @@ def _run_generate_engine(engine, arr, row_lens, *, max_new, ctx,
         rows = [r.result() for r in reqs]
     except ValueError as e:
         return 400, {"error": f"generate failed: {e}"}
+    except EngineClosed as e:
+        # rollover killed the in-flight generation — retryable, not a
+        # server fault
+        return 503, {"error": f"generate failed: {e}"}
     except Exception as e:  # noqa: BLE001 — engine/runtime fault
         return 500, {"error": f"generate failed: "
                               f"{type(e).__name__}: {e}"}
@@ -344,12 +351,24 @@ class ModelRepository:
 
     def engine_for(self, name: str, model: LoadedModel):
         """The continuous-batching engine for this model version (created
-        lazily), or None when disabled / not an LM."""
+        lazily), or None when disabled / not an LM. None also during a
+        version rollover race (the model handed in is no longer served),
+        so the caller falls back to the unary bucketed path rather than
+        resurrecting a just-retired engine's KV cache."""
         if self.decode_slots <= 0 or model.lm_config is None:
             return None
         key = (name, model.version)
+
+        def allowed_locked() -> bool:
+            current = self._models.get(name)
+            return ((current is not None and
+                     current.version == model.version) or
+                    key in self._pinned)
+
         with self._lock:
             eng = self._engines.get(key)
+            if eng is None and not allowed_locked():
+                return None
         if eng is not None:
             return eng
         from kubeflow_tpu.serving.engine import DecodeEngine
@@ -359,7 +378,10 @@ class ModelRepository:
                            steps_per_sync=self.decode_steps_per_sync,
                            name=name)
         with self._lock:
-            race = self._engines.setdefault(key, eng)
+            if not allowed_locked():
+                race = None  # retired while we were building
+            else:
+                race = self._engines.setdefault(key, eng)
         if race is not eng:
             eng.close()
         return race

@@ -39,26 +39,34 @@ def run_multiprocess(
     num_processes: int,
     *,
     env: Optional[Dict[str, str]] = None,
+    env_per_process: Optional[Sequence[Dict[str, str]]] = None,
     timeout_s: float = 180.0,
     job_name: str = "mp-test",
 ) -> List[ProcResult]:
     """Run ``workload`` (argv after the interpreter) in N coordinated
-    processes; returns per-process results (caller asserts)."""
+    processes; returns per-process results (caller asserts).
+    ``env_per_process[i]`` adds rank-specific vars (e.g. the operator's
+    per-slice ``MEGASCALE_SLICE_ID`` injection)."""
     port = _free_port()
     procs = []
     for pid in range(num_processes):
         penv = dict(os.environ)
+        penv.update({
+            # each process defaults to exactly one virtual CPU device so
+            # the global device count equals the process count, like one
+            # TPU host per pod; callers override XLA_FLAGS for fatter
+            # hosts (e.g. 4 devices/process for the multislice tier)
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        })
         penv.update(env or {})
+        if env_per_process is not None:
+            penv.update(env_per_process[pid])
         penv.update({
             dist.ENV_COORDINATOR: f"127.0.0.1:{port}",
             dist.ENV_NUM_PROCESSES: str(num_processes),
             dist.ENV_PROCESS_ID: str(pid),
             dist.ENV_JOB_NAME: job_name,
-            # each process gets exactly one virtual CPU device so the
-            # global device count equals the process count, like one TPU
-            # host per pod
-            "JAX_PLATFORMS": "cpu",
-            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
         })
         procs.append(subprocess.Popen(
             [sys.executable, *workload],
