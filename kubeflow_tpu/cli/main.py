@@ -475,6 +475,15 @@ def cmd_status(args) -> int:
     return 0
 
 
+def cmd_trace_top(args) -> int:
+    from kubeflow_tpu.bench.trace_tools import main as trace_main
+
+    argv = [args.trace_dir, "--top", str(args.top)]
+    if args.json:
+        argv.append("--json")
+    return trace_main(argv)
+
+
 def cmd_version(args) -> int:
     print(f"ctl (kubeflow_tpu) {kubeflow_tpu.__version__}")
     return 0
@@ -581,6 +590,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-v", "--verbose", action="store_true",
                     default=argparse.SUPPRESS)
     sp.set_defaults(fn=cmd_components)
+
+    sp = sub.add_parser("trace-top",
+                        help="per-op device-time table from a profiler "
+                             "trace dir (the auditable PERF.md breakdown)")
+    sp.add_argument("trace_dir")
+    sp.add_argument("--top", type=int, default=20)
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_trace_top)
 
     sp = sub.add_parser("version", help="print version")
     sp.set_defaults(fn=cmd_version)

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubeflow_tpu.serving import predict_pb2 as pb
+from kubeflow_tpu.serving.engine import EngineClosed
 from kubeflow_tpu.serving.server import (
     ModelRepository,
     _pad_batch,
@@ -182,6 +183,10 @@ class PredictionServicer:
             for step_tokens in payload["token_stream"]:
                 yield pb.GenerateChunk(tokens=step_tokens,
                                        model_version=version)
+        except EngineClosed as e:
+            # rollover mid-stream — retryable, same class as pre-stream
+            context.abort(grpc.StatusCode.UNAVAILABLE,
+                          f"generate failed: {e}")
         except Exception as e:  # noqa: BLE001 — mid-stream engine fault
             context.abort(grpc.StatusCode.INTERNAL,
                           f"generate failed: {type(e).__name__}: {e}")

@@ -47,6 +47,10 @@ def run_multiprocess(
     processes; returns per-process results (caller asserts).
     ``env_per_process[i]`` adds rank-specific vars (e.g. the operator's
     per-slice ``MEGASCALE_SLICE_ID`` injection)."""
+    if env_per_process is not None and len(env_per_process) != num_processes:
+        raise ValueError(
+            f"env_per_process has {len(env_per_process)} entries for "
+            f"{num_processes} processes")
     port = _free_port()
     procs = []
     for pid in range(num_processes):

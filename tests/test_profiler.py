@@ -49,3 +49,69 @@ def test_step_profiler_from_env(monkeypatch, tmp_path):
     monkeypatch.setenv("KFTPU_PROFILE_STEPS", "1")
     prof = StepProfiler.from_env()
     assert prof.enabled and prof.start == 0 and prof.stop == 1
+
+
+def _write_fake_trace(d, run="run1"):
+    """Synthesize the profiler's trace.json.gz layout: one device pid
+    with an 'XLA Ops' lane plus a host pid that must be ignored."""
+    import gzip
+    import json
+
+    pdir = os.path.join(d, "plugins", "profile", run)
+    os.makedirs(pdir, exist_ok=True)
+    events = [
+        {"ph": "M", "pid": 3, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 3, "tid": 3, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        {"ph": "M", "pid": 3, "tid": 1, "name": "thread_name",
+         "args": {"name": "Steps"}},
+        {"ph": "M", "pid": 9, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        {"ph": "M", "pid": 9, "tid": 1, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        {"ph": "X", "pid": 3, "tid": 3, "name": "fusion.1",
+         "ts": 0, "dur": 300.0},
+        {"ph": "X", "pid": 3, "tid": 3, "name": "fusion.1",
+         "ts": 400, "dur": 100.0},
+        {"ph": "X", "pid": 3, "tid": 3, "name": "copy.2",
+         "ts": 600, "dur": 100.0},
+        {"ph": "X", "pid": 3, "tid": 1, "name": "step 0",
+         "ts": 0, "dur": 700.0},
+        # host-lane event with a device-like name: must not count
+        {"ph": "X", "pid": 9, "tid": 1, "name": "fusion.1",
+         "ts": 0, "dur": 9999.0},
+    ]
+    path = os.path.join(pdir, "vm.trace.json.gz")
+    with gzip.open(path, "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    return path
+
+
+def test_trace_top_aggregates_device_ops(tmp_path):
+    from kubeflow_tpu.bench.trace_tools import format_top_ops, top_ops
+
+    _write_fake_trace(str(tmp_path))
+    report = top_ops(str(tmp_path), top=5)
+    assert report["devices"] == ["/device:TPU:0"]
+    assert report["steps"] == 1
+    assert report["device_total_ms"] == 0.5
+    ops = {o["name"]: o for o in report["ops"]}
+    assert ops["fusion.1"]["total_ms"] == 0.4
+    assert ops["fusion.1"]["count"] == 2
+    assert ops["fusion.1"]["pct"] == 80.0
+    assert ops["copy.2"]["pct"] == 20.0
+    table = format_top_ops(report)
+    assert "fusion.1" in table and "80.0" in table
+
+
+def test_trace_top_cli(tmp_path, capsys):
+    import json
+
+    from kubeflow_tpu.cli.main import main as ctl_main
+
+    _write_fake_trace(str(tmp_path))
+    assert ctl_main(["trace-top", str(tmp_path), "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["ops"][0]["name"] == "fusion.1"
+    assert ctl_main(["trace-top", str(tmp_path / "missing")]) == 1
