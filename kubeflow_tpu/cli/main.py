@@ -191,9 +191,53 @@ def cmd_images(args) -> int:
     """Release tooling (reference ``releasing/`` parity): list every image
     the app renders; ``--retag``/``--registry`` pin new coordinates into
     app.yaml so the next generate/apply ships them."""
-    from kubeflow_tpu.manifests.images import rendered_images, retag_config
+    from kubeflow_tpu.manifests.images import (
+        digest_map_from_cluster,
+        pin_config,
+        rendered_images,
+        retag_config,
+    )
 
     config = _app_config(args.app_dir)
+    if args.pin:
+        if args.retag or args.registry:
+            raise SystemExit("--pin cannot be combined with "
+                             "--retag/--registry (pin first, or retag "
+                             "first and then pin the new tags)")
+        ambiguous = []
+        if args.pin == "cluster":
+            digests, ambiguous = digest_map_from_cluster(_client(args))
+        else:
+            with open(args.pin) as f:
+                digests = yaml.safe_load(f) or {}
+            digests = digests.get("images", digests)
+        changes, missing = pin_config(config, digests)
+        config.save(os.path.join(args.app_dir, APP_YAML))
+        # the lock records {original tagged ref: digest} so it feeds
+        # straight back into `--pin FILE` for another app dir; merge
+        # with any existing lock (a re-pin with nothing to change must
+        # not wipe the release record)
+        lock_path = os.path.join(args.app_dir, "images.lock.yaml")
+        lock: dict = {"images": {}}
+        if os.path.exists(lock_path):
+            with open(lock_path) as f:
+                lock = yaml.safe_load(f) or lock
+        lock["images"].update(
+            {old: new.rsplit("@", 1)[1] for old, new in changes.items()})
+        with open(lock_path, "w") as f:
+            yaml.safe_dump(lock, f, sort_keys=True)
+        for old, new in sorted(changes.items()):
+            print(f"{old} -> {new}")
+        for img in ambiguous:
+            print(f"AMBIGUOUS {img} (running with multiple digests — "
+                  "mid-rollout?)")
+        for img in missing:
+            if img not in ambiguous:
+                print(f"UNRESOLVED {img} (not running on the cluster / "
+                      "not in the digest file)")
+        print(f"pinned {len(changes)} image(s) "
+              f"({len(missing)} unresolved); lock: {lock_path}")
+        return 0 if not missing else 1
     if args.retag or args.registry:
         if not args.retag:
             raise SystemExit("--registry requires --retag TAG")
@@ -536,11 +580,21 @@ def build_parser() -> argparse.ArgumentParser:
     app_cmd("show", cmd_show, "print rendered manifests")
 
     sp = app_cmd("images", cmd_images,
-                 "list rendered images / retag a release")
+                 "list rendered images / retag or digest-pin a release")
     sp.add_argument("--retag", default=None, metavar="TAG",
                     help="pin all component images to TAG in app.yaml")
     sp.add_argument("--registry", default=None,
                     help="also move images to this registry (with --retag)")
+    sp.add_argument("--pin", default=None, metavar="cluster|FILE",
+                    help="rewrite images to content digests: 'cluster' "
+                         "resolves from running pods' imageIDs, FILE is "
+                         "a yaml {image: sha256:...} map; writes "
+                         "images.lock.yaml")
+    sp.add_argument("--server", default=None,
+                    help="API server URL (with --pin cluster)")
+    sp.add_argument("--insecure", action="store_true")
+    sp.add_argument("--fake-state", default=None,
+                    help="file-backed fake cluster state path")
 
     sp = app_cmd("gc", cmd_gc,
                  "prune cluster objects no longer in the manifests")

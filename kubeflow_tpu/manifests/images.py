@@ -69,3 +69,68 @@ def retag_config(config: DeploymentConfig, tag: str,
                 spec.params[key] = new
                 changes[current] = new
     return changes
+
+
+def digest_map_from_cluster(client) -> Tuple[Dict[str, str], List[str]]:
+    """``(image -> sha256 digest, ambiguous images)`` observed on the
+    RUNNING cluster.
+
+    Kubelet reports the resolved content digest of every pulled image in
+    ``status.containerStatuses[].imageID`` — a registry-less resolver
+    (reference parity: ``/root/reference/releasing/add_image_shas.py``
+    queried gcloud; here the cluster itself is the source of truth, so
+    pinning needs no registry egress). An image tag observed with TWO
+    different digests (mid-rollout) is AMBIGUOUS: it is excluded from
+    the map and listed, never silently resolved to whichever pod
+    iterated first."""
+    seen: Dict[str, set] = {}
+    for pod in client.list("v1", "Pod"):
+        statuses = (pod.get("status", {}).get("containerStatuses") or [])
+        for cs in statuses:
+            image, iid = cs.get("image"), cs.get("imageID", "")
+            if image and "@sha256:" in iid:
+                seen.setdefault(image, set()).add(
+                    "sha256:" + iid.rsplit("@sha256:", 1)[1])
+    ambiguous = sorted(i for i, ds in seen.items() if len(ds) > 1)
+    return ({i: next(iter(ds)) for i, ds in seen.items()
+             if len(ds) == 1}, ambiguous)
+
+
+def _pin(image: str, digest: str) -> str:
+    """``repo/img:tag`` -> ``repo/img@sha256:...`` (tag dropped: a
+    digest reference is immutable; keeping the tag would be decorative
+    and some runtimes reject tag+digest)."""
+    base = image
+    if ":" in image.rsplit("/", 1)[-1]:
+        base = image.rsplit(":", 1)[0]
+    return f"{base}@{digest}"
+
+
+def pin_config(config: DeploymentConfig, digests: Dict[str, str]
+               ) -> Tuple[Dict[str, str], List[str]]:
+    """Rewrite every component image param to its content digest.
+
+    Returns ``({old: new}, [unresolvable images])``. Already-pinned
+    (``@``) refs are left alone. The caller persists the config and the
+    lock manifest, after which every ``ctl generate`` renders immutable
+    references — the reference's add_image_shas/apply_image_tags flow
+    collapsed into one config rewrite."""
+    changes: Dict[str, str] = {}
+    missing: List[str] = []
+    for spec in config.components:
+        comp = get_component(spec.name)
+        for key, default in comp.defaults.items():
+            if key != "image" and not key.endswith("_image"):
+                continue
+            current = spec.params.get(key, default)
+            if not isinstance(current, str) or not current or "@" in current:
+                continue
+            digest = digests.get(current)
+            if digest is None:
+                if current not in missing:
+                    missing.append(current)
+                continue
+            new = _pin(current, digest)
+            spec.params[key] = new
+            changes[current] = new
+    return changes, missing

@@ -97,3 +97,100 @@ def test_images_list_and_retag(app_dir, capsys):
         image = line.split()[-1]
         if "/" in image:  # every component image now carries the release
             assert image.endswith(":v1.2.3") or "gcr.io" not in image
+
+
+def test_images_pin_roundtrip(app_dir, capsys):
+    """Digest pinning (reference releasing/add_image_shas.py parity):
+    resolve from a digest file, rewrite app.yaml to immutable @sha256
+    refs, emit images.lock.yaml; re-pin and retag are no-ops on pinned
+    refs."""
+    import yaml as _yaml
+
+    from kubeflow_tpu.manifests.images import rendered_images
+    from kubeflow_tpu.config.deployment import DeploymentConfig
+
+    assert main(["init", app_dir, "--preset", "minimal"]) == 0
+    assert main(["images", app_dir]) == 0
+    images = {ln.split()[-1] for ln in capsys.readouterr().out.splitlines()
+              if "/" in (ln.split()[-1] if ln.split() else "")}
+    digest = "sha256:" + "ab" * 32
+    dfile = os.path.join(app_dir, "digests.yaml")
+    with open(dfile, "w") as f:
+        _yaml.safe_dump({img: digest for img in images}, f)
+
+    assert main(["images", app_dir, "--pin", dfile]) == 0
+    out = capsys.readouterr().out
+    assert f"@{digest}" in out and "UNRESOLVED" not in out
+
+    # app.yaml now renders digest references only
+    config = DeploymentConfig.load(os.path.join(app_dir, "app.yaml"))
+    rendered = [img for _, _, img in rendered_images(config)]
+    assert rendered and all("@sha256:" in img for img in rendered)
+    # the lock keys are the ORIGINAL tagged refs: it round-trips as a
+    # --pin input for a fresh app dir
+    lock_path = os.path.join(app_dir, "images.lock.yaml")
+    with open(lock_path) as f:
+        lock = _yaml.safe_load(f)
+    assert set(lock["images"]) == images
+    assert all(d.startswith("sha256:") for d in lock["images"].values())
+    app2 = app_dir + "-2"
+    assert main(["init", app2, "--preset", "minimal"]) == 0
+    assert main(["images", app2, "--pin", lock_path]) == 0
+    out2 = capsys.readouterr().out
+    assert "UNRESOLVED" not in out2 and f"@{digest}" in out2
+
+    # pinning again: nothing to change, exit 0, lock record SURVIVES
+    assert main(["images", app_dir, "--pin", dfile]) == 0
+    assert "pinned 0 image(s)" in capsys.readouterr().out
+    with open(lock_path) as f:
+        assert _yaml.safe_load(f)["images"] == lock["images"]
+    # conflicting release flags are rejected
+    with pytest.raises(SystemExit, match="cannot be combined"):
+        main(["images", app_dir, "--pin", dfile, "--retag", "v2"])
+    # retag must not clobber content pins
+    assert main(["images", app_dir, "--retag", "v9"]) == 0
+    config = DeploymentConfig.load(os.path.join(app_dir, "app.yaml"))
+    assert all("@sha256:" in img
+               for _, _, img in rendered_images(config))
+
+
+def test_images_pin_from_cluster_and_missing(app_dir, capsys):
+    """--pin cluster resolves digests from running pods' imageIDs; images
+    not running anywhere are reported UNRESOLVED with exit 1."""
+    assert main(["init", app_dir, "--preset", "minimal"]) == 0
+    assert main(["images", app_dir]) == 0
+    images = sorted({ln.split()[-1]
+                     for ln in capsys.readouterr().out.splitlines()
+                     if ln.split() and "/" in ln.split()[-1]})
+    state = os.path.join(app_dir, ".cluster.json")
+    client = FileBackedFakeClient(state)
+    digest = "sha256:" + "cd" * 32
+    # only the FIRST image runs on the cluster
+    client.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "w0", "namespace": "default"},
+        "status": {"phase": "Running", "containerStatuses": [
+            {"name": "c", "image": images[0],
+             "imageID": f"docker-pullable://{images[0]}@{digest}"}]}})
+    rc = main(["images", app_dir, "--pin", "cluster",
+               "--fake-state", state])
+    out = capsys.readouterr().out
+    assert f"{images[0]} -> " in out and digest in out
+    if len(images) > 1:
+        assert rc == 1 and "UNRESOLVED" in out
+    else:
+        assert rc == 0
+
+    # a tag seen with TWO digests (mid-rollout) is ambiguous, never
+    # silently resolved
+    client.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "w1", "namespace": "default"},
+        "status": {"phase": "Running", "containerStatuses": [
+            {"name": "c", "image": images[0],
+             "imageID": f"docker-pullable://{images[0]}@sha256:{'ef' * 32}"}]}})
+    assert main(["init", app_dir + "-amb", "--preset", "minimal"]) == 0
+    rc = main(["images", app_dir + "-amb", "--pin", "cluster",
+               "--fake-state", state])
+    out = capsys.readouterr().out
+    assert rc == 1 and f"AMBIGUOUS {images[0]}" in out
