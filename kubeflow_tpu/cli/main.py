@@ -221,7 +221,12 @@ def cmd_images(args) -> int:
         lock: dict = {"images": {}}
         if os.path.exists(lock_path):
             with open(lock_path) as f:
-                lock = yaml.safe_load(f) or lock
+                prior = yaml.safe_load(f) or {}
+            # accept both lock shapes --pin FILE accepts: a bare
+            # {image: digest} map or the {"images": {...}} wrapper
+            images = prior.get("images", prior)
+            if isinstance(images, dict):
+                lock["images"].update(images)
         lock["images"].update(
             {old: new.rsplit("@", 1)[1] for old, new in changes.items()})
         with open(lock_path, "w") as f:

@@ -17,6 +17,7 @@ Route parity with the reference's Express server
 from __future__ import annotations
 
 import abc
+import os
 from typing import Any, Dict, List, Optional, Tuple
 
 import kubeflow_tpu
@@ -143,6 +144,7 @@ class DashboardApi:
                  kfam: Optional[AccessManagementApi] = None,
                  platform: str = "gcp-tpu",
                  run_archive=None,
+                 artifact_store=None,
                  authorize=None) -> None:
         from kubeflow_tpu.tenancy.authz import default_authorizer
 
@@ -151,6 +153,7 @@ class DashboardApi:
         self.kfam = kfam or AccessManagementApi(client)
         self.platform = platform
         self.run_archive = run_archive
+        self.artifact_store = artifact_store
         # namespace-scoped tenant data (studies, runs) goes through the
         # same Profile-RBAC default as the notebook webapp; allow_all only
         # behind the explicit dev flag
@@ -214,6 +217,17 @@ class DashboardApi:
                     return 200, self.runs(parts[0])
                 if len(parts) == 2:
                     return self.run_detail(parts[0], parts[1])
+            if path.startswith("/api/artifacts/"):
+                parts = path[len("/api/artifacts/"):].split("/")
+                if len(parts) < 2 or not parts[0] or not parts[1]:
+                    return 404, {"error": f"no route {path}"}
+                # artifacts belong to workflow runs — same guard
+                self._authz(user, parts[0], "workflows")
+                if len(parts) == 2:
+                    return self.artifacts(parts[0], parts[1])
+                if len(parts) == 4:
+                    return self.artifact_download(*parts)
+                return 404, {"error": f"no route {path}"}
             if path.startswith("/api/applications/"):
                 parts = path[len("/api/applications/"):].split("/")
                 # empty ns would become a CLUSTER-WIDE list at the client
@@ -445,12 +459,38 @@ class DashboardApi:
             if rec is not None:
                 return 200, {"name": name, "live": False,
                              "spec": rec.get("spec", {}),
-                             "status": rec.get("status", {})}
+                             "status": rec.get("status", {}),
+                             "artifacts": self._artifact_list(ns, name)}
         if wf is None:
             return 404, {"error": f"run {name!r} not found"}
         return 200, {"name": name, "live": True,
                      "spec": wf.get("spec", {}),
-                     "status": wf.get("status", {})}
+                     "status": wf.get("status", {}),
+                     "artifacts": self._artifact_list(ns, name)}
+
+    def _artifact_list(self, ns: str, run: str) -> List[Dict[str, Any]]:
+        if self.artifact_store is None:
+            return []
+        return self.artifact_store.list(ns, run)
+
+    def artifacts(self, ns: str, run: str) -> Tuple[int, Any]:
+        return 200, self._artifact_list(ns, run)
+
+    def artifact_download(self, ns: str, run: str, step: str,
+                          name: str) -> Tuple[int, Any]:
+        """Raw artifact bytes (the MinIO-console role, one GET)."""
+        from kubeflow_tpu.utils.jsonhttp import RawResponse
+
+        if self.artifact_store is None:
+            return 404, {"error": "no artifact store configured"}
+        path = self.artifact_store.path(ns, run, step, name)
+        if not os.path.isfile(path):
+            return 404, {"error": f"artifact {step}/{name} not found"}
+        import mimetypes
+
+        ctype = mimetypes.guess_type(name)[0] or "application/octet-stream"
+        # streamed from disk: checkpoints are GB-scale
+        return 200, RawResponse(ctype, path=path, download_name=name)
 
     def applications(self, ns: str) -> List[Dict[str, Any]]:
         """Aggregated platform health: the Application CRs' status (the
@@ -505,9 +545,10 @@ def main() -> None:
     from kubeflow_tpu.k8s.client import HttpKubeClient
 
     from kubeflow_tpu.auth.gatekeeper import authenticator_from_env
-    from kubeflow_tpu.workflows.archive import RunArchive
+    from kubeflow_tpu.workflows.archive import ArtifactStore, RunArchive
 
-    api = DashboardApi(HttpKubeClient(), run_archive=RunArchive.from_env())
+    api = DashboardApi(HttpKubeClient(), run_archive=RunArchive.from_env(),
+                       artifact_store=ArtifactStore.from_env())
     serve_json(api.handle,
                int(os.environ.get("KFTPU_DASHBOARD_PORT", "8082")),
                authenticator=authenticator_from_env(),

@@ -39,11 +39,52 @@ function fmtLineage(lineage) {
   return keys.sort().map((k) => esc(k) + ": " + esc(lineage[k])).join("; ");
 }
 
+// Deep links for lineage keys the platform owns pages for: the chain a
+// reviewer walks "which run / study / job produced this artifact".
+const LINEAGE_LINKS = {
+  run: (v) => "/runs.html#" + encodeURIComponent(v),
+  workflow: (v) => "/runs.html#" + encodeURIComponent(v),
+  study: (v) => "/studies.html#" + encodeURIComponent(v),
+  trial: (v) => "/studies.html#" + encodeURIComponent(v),
+  tpujob: (v) => "/tpujobs.html#" + encodeURIComponent(v),
+  job: (v) => "/tpujobs.html#" + encodeURIComponent(v),
+};
+// provenance reads source → process → artifact
+const LINEAGE_ORDER = ["dataset", "commit", "tpujob", "job", "study",
+                      "trial", "workflow", "run"];
+
+function drawLineage(name, lineage) {
+  const keys = Object.keys(lineage || {});
+  const panel = $("lineage-panel");
+  if (!keys.length) { panel.style.display = "none"; return; }
+  panel.style.display = "";
+  keys.sort((a, b) => {
+    const ia = LINEAGE_ORDER.indexOf(a), ib = LINEAGE_ORDER.indexOf(b);
+    return (ia < 0 ? 99 : ia) - (ib < 0 ? 99 : ib) || (a < b ? -1 : 1);
+  });
+  const chips = keys.map((k) => {
+    const v = String(lineage[k]);
+    const body = '<span class="lineage-key">' + esc(k) + "</span>" +
+                 '<span class="lineage-val">' + esc(v) + "</span>";
+    return LINEAGE_LINKS[k]
+      ? '<a class="lineage-node" href="' + LINEAGE_LINKS[k](v) + '">' +
+        body + "</a>"
+      : '<span class="lineage-node">' + body + "</span>";
+  });
+  chips.push('<span class="lineage-node lineage-self">' +
+             '<span class="lineage-key">model</span>' +
+             '<span class="lineage-val">' + esc(name) + "</span></span>");
+  $("lineage-chain").innerHTML =
+    chips.join('<span class="lineage-arrow">→</span>');
+}
+
 async function showModel(name) {
   const data = await api("/registry/api/registry/models/" +
                          encodeURIComponent(name) + "/versions");
   $("detail-panel").style.display = "";
   $("detail-title").textContent = name;
+  const latest = data.versions[data.versions.length - 1];
+  drawLineage(name, latest ? latest.lineage : null);
   const rows = data.versions.map((v) => {
     const canPromote = v.stage !== "production";
     return "<tr><td>" + esc(v.version) + "</td>" +

@@ -3,6 +3,90 @@
 "use strict";
 // helpers ($, showError, api, esc) come from common.js
 
+// Phase colors for DAG nodes (pill palette twins).
+const PHASE_FILL = {
+  Succeeded: "#188038", Running: "#1a73e8", Failed: "#d93025",
+  Error: "#d93025", Pending: "#9aa0a6",
+};
+
+// Layered left-to-right DAG of spec.steps (dependencies), each node
+// colored by its status.nodes phase — the KFP graph view's role.
+function drawDag(steps, nodes) {
+  const svg = $("dag");
+  svg.innerHTML = "";
+  if (!steps.length) { svg.setAttribute("height", 0); return; }
+  const depth = {};
+  const byName = {};
+  for (const s of steps) byName[s.name] = s;
+  const depthOf = (name, seen) => {
+    if (depth[name] != null) return depth[name];
+    if (!byName[name] || (seen && seen.has(name))) return 0;
+    const mark = seen || new Set();
+    mark.add(name);
+    const deps = byName[name].dependencies || [];
+    const d = deps.length
+      ? 1 + Math.max(...deps.map((p) => depthOf(p, mark))) : 0;
+    depth[name] = d;
+    return d;
+  };
+  steps.forEach((s) => depthOf(s.name));
+  const cols = [];
+  for (const s of steps) {
+    const d = depth[s.name] || 0;
+    (cols[d] = cols[d] || []).push(s.name);
+  }
+  const W = 960, CW = Math.max(140, Math.min(220, W / cols.length));
+  const RH = 44, NH = 28, NW = Math.min(CW - 36, 150);
+  // cols may be sparse (a step depending on a name not in the spec
+  // leaves depth-0 empty) — holes must not poison the height
+  const H = Math.max(...cols.map((c) => (c || []).length)) * RH + 24;
+  svg.setAttribute("height", H);
+  const pos = {};
+  cols.forEach((col, ci) => col.forEach((name, ri) => {
+    pos[name] = { x: 12 + ci * CW, y: 12 + ri * RH };
+  }));
+  const NS = "http://www.w3.org/2000/svg";
+  const el = (tag, attrs, text) => {
+    const e = document.createElementNS(NS, tag);
+    for (const [k, v] of Object.entries(attrs)) e.setAttribute(k, v);
+    if (text != null) e.textContent = text;
+    return e;
+  };
+  for (const s of steps) {
+    for (const dep of s.dependencies || []) {
+      if (!pos[dep]) continue;
+      const a = pos[dep], b = pos[s.name];
+      const x1 = a.x + NW, y1 = a.y + NH / 2,
+            x2 = b.x, y2 = b.y + NH / 2, mx = (x1 + x2) / 2;
+      svg.appendChild(el("path", {
+        d: `M${x1},${y1} C${mx},${y1} ${mx},${y2} ${x2},${y2}`,
+        fill: "none", stroke: "#9aa0a6", "stroke-width": 1.5,
+      }));
+    }
+  }
+  for (const s of steps) {
+    const p = pos[s.name];
+    const phase = (nodes[s.name] || {}).phase || "Pending";
+    svg.appendChild(el("rect", {
+      x: p.x, y: p.y, width: NW, height: NH, rx: 6,
+      fill: PHASE_FILL[phase] || PHASE_FILL.Pending, opacity: 0.9,
+    }));
+    const label = el("text", {
+      x: p.x + NW / 2, y: p.y + NH / 2 + 4, "text-anchor": "middle",
+      fill: "#fff", "font-size": "12",
+    }, s.name.length > 20 ? s.name.slice(0, 19) + "…" : s.name);
+    const tip = el("title", {}, `${s.name}: ${phase}`);
+    label.appendChild(tip);
+    svg.appendChild(label);
+  }
+}
+
+function fmtBytes(n) {
+  if (n >= 1 << 20) return (n / (1 << 20)).toFixed(1) + " MiB";
+  if (n >= 1 << 10) return (n / (1 << 10)).toFixed(1) + " KiB";
+  return n + " B";
+}
+
 async function openRun(ns, name) {
   const d = await api(`/api/runs/${encodeURIComponent(ns)}/` +
                       encodeURIComponent(name));
@@ -10,9 +94,11 @@ async function openRun(ns, name) {
   $("detail-title").textContent =
     `${name} — ${d.status.phase || "Pending"}` +
     (d.live ? "" : " (archived)");
-  const nodes = Object.entries(d.status.nodes || {});
-  $("nodes").innerHTML = nodes.length
-    ? nodes.map(([step, n]) => `
+  const nodes = d.status.nodes || {};
+  drawDag(d.spec.steps || [], nodes);
+  const rows = Object.entries(nodes);
+  $("nodes").innerHTML = rows.length
+    ? rows.map(([step, n]) => `
       <tr>
         <td>${esc(step)}</td>
         <td><span class="pill ${esc(n.phase)}">${esc(n.phase)}</span></td>
@@ -21,6 +107,17 @@ async function openRun(ns, name) {
         <td>${esc(n.message || "")}</td>
       </tr>`).join("")
     : "<tr><td colspan=5>no steps recorded</td></tr>";
+  const arts = d.artifacts || [];
+  $("artifacts").innerHTML = arts.length
+    ? arts.map((a) => `
+      <tr>
+        <td>${esc(a.step)}</td>
+        <td><a href="/api/artifacts/${encodeURIComponent(ns)}/${
+          encodeURIComponent(name)}/${encodeURIComponent(a.step)}/${
+          encodeURIComponent(a.name)}">${esc(a.name)}</a></td>
+        <td>${fmtBytes(a.bytes)}</td>
+      </tr>`).join("")
+    : "<tr><td colspan=3>no artifacts reported</td></tr>";
   $("detail-panel").scrollIntoView({ behavior: "smooth" });
 }
 
@@ -55,6 +152,13 @@ async function main() {
     const saved = localStorage.getItem("kftpu-ns");
     if (saved && env.namespaces.includes(saved)) sel.value = saved;
     await loadRuns(sel.value);
+    // deep links (model-lineage chips, shared URLs): /runs.html#<run>
+    const openFromHash = () => {
+      const h = decodeURIComponent(location.hash.slice(1));
+      if (h) openRun(sel.value, h).catch((err) => showError(err.message));
+    };
+    openFromHash();
+    window.addEventListener("hashchange", openFromHash);
     sel.addEventListener("change", () => {
       localStorage.setItem("kftpu-ns", sel.value);
       $("detail-panel").style.display = "none";

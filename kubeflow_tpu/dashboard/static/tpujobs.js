@@ -54,6 +54,13 @@ async function main() {
     const saved = localStorage.getItem("kftpu-ns");
     if (saved && env.namespaces.includes(saved)) sel.value = saved;
     await loadJobs(sel.value);
+    // deep links (model-lineage chips, shared URLs): /tpujobs.html#<job>
+    const openFromHash = () => {
+      const h = decodeURIComponent(location.hash.slice(1));
+      if (h) openJob(sel.value, h).catch((err) => showError(err.message));
+    };
+    openFromHash();
+    window.addEventListener("hashchange", openFromHash);
     sel.addEventListener("change", () => {
       localStorage.setItem("kftpu-ns", sel.value);
       $("detail-panel").style.display = "none";

@@ -31,6 +31,14 @@ def rendered_images(config: DeploymentConfig) -> List[Tuple[str, str, str]]:
     return out
 
 
+def _strip_tag(image: str) -> str:
+    """Drop a trailing ``:tag`` — but not a registry ``:port`` (which
+    precedes a ``/``). Shared by retag and digest-pin rewrites."""
+    if ":" in image.rsplit("/", 1)[-1]:
+        return image.rsplit(":", 1)[0]
+    return image
+
+
 def _retag(image: str, tag: str, registry: str = "") -> str:
     """Pin ``image`` to ``tag`` (and optionally a new registry prefix).
 
@@ -40,10 +48,7 @@ def _retag(image: str, tag: str, registry: str = "") -> str:
     mutable tag would defeat the pin."""
     if "@" in image:
         return image
-    # split a trailing :tag — but not a registry :port (which precedes a /)
-    base = image
-    if ":" in image.rsplit("/", 1)[-1]:
-        base = image.rsplit(":", 1)[0]
+    base = _strip_tag(image)
     if registry:
         base = f"{registry.rstrip('/')}/{base.rsplit('/', 1)[-1]}"
     return f"{base}:{tag}"
@@ -100,10 +105,7 @@ def _pin(image: str, digest: str) -> str:
     """``repo/img:tag`` -> ``repo/img@sha256:...`` (tag dropped: a
     digest reference is immutable; keeping the tag would be decorative
     and some runtimes reject tag+digest)."""
-    base = image
-    if ":" in image.rsplit("/", 1)[-1]:
-        base = image.rsplit(":", 1)[0]
-    return f"{base}@{digest}"
+    return f"{_strip_tag(image)}@{digest}"
 
 
 def pin_config(config: DeploymentConfig, digests: Dict[str, str]

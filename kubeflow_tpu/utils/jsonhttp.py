@@ -35,6 +35,24 @@ Handle = Callable[[str, str, Optional[Dict[str, Any]], str], Tuple[int, Any]]
 Authenticator = Callable[[Dict[str, str]], Optional[str]]
 
 
+class RawResponse:
+    """A handler may return this instead of a JSON payload to serve raw
+    bytes (artifact downloads): ``(code, RawResponse(ctype, data))`` or,
+    for large files, ``RawResponse(ctype, path=...)`` — the server then
+    streams from disk instead of buffering the file (multi-GB training
+    checkpoints must not be held in the dashboard's memory)."""
+
+    def __init__(self, content_type: str, data: Optional[bytes] = None,
+                 download_name: Optional[str] = None,
+                 path: Optional[str] = None) -> None:
+        if (data is None) == (path is None):
+            raise ValueError("exactly one of data/path is required")
+        self.content_type = content_type
+        self.data = data
+        self.path = path
+        self.download_name = download_name
+
+
 def _wants_headers(handle: Handle) -> bool:
     try:
         return len(inspect.signature(handle).parameters) >= 5
@@ -132,6 +150,25 @@ def serve_json(handle: Handle, port: int, *,
             self._reply(code, payload)
 
         def _reply(self, code: int, payload: Any) -> None:
+            if isinstance(payload, RawResponse):
+                size = (len(payload.data) if payload.data is not None
+                        else os.path.getsize(payload.path))
+                self.send_response(code)
+                self.send_header("Content-Type", payload.content_type)
+                self.send_header("Content-Length", str(size))
+                if payload.download_name:
+                    self.send_header(
+                        "Content-Disposition",
+                        f'attachment; filename="{payload.download_name}"')
+                self.end_headers()
+                if payload.data is not None:
+                    self.wfile.write(payload.data)
+                else:
+                    import shutil
+
+                    with open(payload.path, "rb") as f:
+                        shutil.copyfileobj(f, self.wfile, 1 << 20)
+                return
             data = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")

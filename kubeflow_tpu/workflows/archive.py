@@ -179,9 +179,13 @@ class ArtifactStore:
         _atomic_write(path, data)
         return path
 
+    def path(self, ns: str, run: str, step: str, name: str) -> str:
+        """Sanitized on-disk path of one artifact (for streamed serving
+        — checkpoints can be multi-GB and must not be buffered)."""
+        return os.path.join(self._dir(ns, run, step), _safe(name))
+
     def get(self, ns: str, run: str, step: str, name: str) -> bytes:
-        with open(os.path.join(self._dir(ns, run, step), _safe(name)),
-                  "rb") as f:
+        with open(self.path(ns, run, step, name), "rb") as f:
             return f.read()
 
     def list(self, ns: str, run: str) -> List[Dict[str, Any]]:

@@ -219,3 +219,92 @@ def test_html_references_resolve():
                     continue
                 assert os.path.isfile(os.path.join(sdir, ref)), \
                     f"{fname} references missing asset {ref!r} in {sdir}"
+
+
+def test_run_detail_dag_and_artifacts(tmp_path):
+    """Run drill-down (VERDICT r3 #6): the detail API carries the step
+    DAG inputs (spec.steps dependencies + per-step phases) and the
+    run's artifacts; artifact files download raw through the dashboard."""
+    from kubeflow_tpu.dashboard.server import DashboardApi
+    from kubeflow_tpu.utils.jsonhttp import RawResponse
+    from kubeflow_tpu.workflows.archive import ArtifactStore
+    from kubeflow_tpu.workflows.workflow import (
+        WORKFLOW_API_VERSION,
+        WORKFLOW_KIND,
+    )
+
+    client = FakeKubeClient()
+    client.create({
+        "apiVersion": WORKFLOW_API_VERSION, "kind": WORKFLOW_KIND,
+        "metadata": {"name": "r1", "namespace": "team-a", "uid": "u1"},
+        "spec": {"steps": [
+            {"name": "setup"},
+            {"name": "train", "dependencies": ["setup"]},
+            {"name": "eval", "dependencies": ["train"]}]},
+        "status": {"phase": "Running", "nodes": {
+            "setup": {"phase": "Succeeded"},
+            "train": {"phase": "Running"}}}})
+    store = ArtifactStore(str(tmp_path))
+    store.put("team-a", "r1", "train", "metrics.json", b'{"loss": 1}')
+    api = DashboardApi(client, artifact_store=store,
+                       authorize=lambda *a: True)
+
+    code, d = api.handle("GET", "/api/runs/team-a/r1", None, "u")
+    assert code == 200
+    assert [s["name"] for s in d["spec"]["steps"]] == [
+        "setup", "train", "eval"]
+    assert d["artifacts"] == [
+        {"step": "train", "name": "metrics.json", "bytes": 11}]
+
+    code, arts = api.handle("GET", "/api/artifacts/team-a/r1", None, "u")
+    assert code == 200 and arts[0]["name"] == "metrics.json"
+    code, raw = api.handle(
+        "GET", "/api/artifacts/team-a/r1/train/metrics.json", None, "u")
+    assert code == 200 and isinstance(raw, RawResponse)
+    # large artifacts stream from disk: the response carries a path
+    assert raw.data is None
+    with open(raw.path, "rb") as f:
+        assert f.read() == b'{"loss": 1}'
+    assert raw.content_type == "application/json"
+    code, _ = api.handle(
+        "GET", "/api/artifacts/team-a/r1/train/nope.bin", None, "u")
+    assert code == 404
+
+    # artifact routes are namespace-guarded like runs
+    denied = DashboardApi(client, artifact_store=store,
+                          authorize=lambda *a: False)
+    code, _ = denied.handle(
+        "GET", "/api/artifacts/team-a/r1", None, "mallory")
+    assert code == 403
+
+
+def test_artifact_download_over_http(tmp_path):
+    """RawResponse serves bytes end-to-end through serve_json."""
+    from kubeflow_tpu.dashboard.server import DashboardApi
+    from kubeflow_tpu.workflows.archive import ArtifactStore
+
+    from kubeflow_tpu.tenancy.authz import allow_all
+
+    store = ArtifactStore(str(tmp_path))
+    store.put("ns1", "run1", "train", "model.bin", b"\x00\x01binary")
+    api = DashboardApi(FakeKubeClient(), artifact_store=store,
+                       authorize=allow_all)
+    srv = serve_json(api.handle, 0, background=True, host="127.0.0.1")
+    try:
+        url = (f"http://127.0.0.1:{srv.server_address[1]}"
+               "/api/artifacts/ns1/run1/train/model.bin")
+        code, body, ctype = _get(url)
+        assert code == 200 and body == b"\x00\x01binary"
+        assert "octet-stream" in ctype
+    finally:
+        srv.shutdown()
+
+
+def test_runs_page_ships_dag_and_artifact_views(dashboard_server):
+    code, body, _ = _get(dashboard_server + "/runs.html")
+    assert code == 200
+    assert b'id="dag"' in body and b'id="artifacts"' in body
+    code, body, _ = _get(dashboard_server + "/runs.js")
+    assert b"drawDag" in body and b"/api/artifacts/" in body
+    code, body, _ = _get(dashboard_server + "/models.js")
+    assert b"drawLineage" in body and b"lineage-chain" in body
