@@ -223,7 +223,10 @@ def bench_resnet50(batch_per_chip: int = 256, steps: int = 20,
 
     n_chips = jax.device_count()
     mesh = create_mesh(MeshConfig(dp=n_chips))
-    model = resnet50(num_classes=1000)
+    # KFTPU_RESNET_ACT_COMPRESS=1: int8 forward-saved conv inputs
+    # (ops/act_compress.py) — the PERF.md bandwidth-lever A/B switch
+    model = resnet50(num_classes=1000, act_compress=os.environ.get(
+        "KFTPU_RESNET_ACT_COMPRESS", "0") == "1")
     stem = model.config.stem
     batch = batch_per_chip * n_chips
     rng = jax.random.key(0)

@@ -218,15 +218,22 @@ class DashboardApi:
                 if len(parts) == 2:
                     return self.run_detail(parts[0], parts[1])
             if path.startswith("/api/artifacts/"):
-                parts = path[len("/api/artifacts/"):].split("/")
+                from urllib.parse import unquote
+
+                # segments are percent-decoded (artifact steps can be
+                # nested paths, sent as one %2F-encoded segment)
+                parts = [unquote(p) for p in
+                         path[len("/api/artifacts/"):].split("/")]
                 if len(parts) < 2 or not parts[0] or not parts[1]:
                     return 404, {"error": f"no route {path}"}
                 # artifacts belong to workflow runs — same guard
                 self._authz(user, parts[0], "workflows")
                 if len(parts) == 2:
                     return self.artifacts(parts[0], parts[1])
-                if len(parts) == 4:
-                    return self.artifact_download(*parts)
+                if len(parts) >= 4:
+                    return self.artifact_download(
+                        parts[0], parts[1], "/".join(parts[2:-1]),
+                        parts[-1])
                 return 404, {"error": f"no route {path}"}
             if path.startswith("/api/applications/"):
                 parts = path[len("/api/applications/"):].split("/")

@@ -36,3 +36,32 @@ function esc(s) {
 function escAttr(s) {
   return esc(s).replace(/"/g, "&quot;").replace(/'/g, "&#39;");
 }
+
+// Deep-link plumbing shared by the list pages: "#<name>" opens a detail
+// in the current namespace, "#<ns>/<name>" switches namespace first
+// (model-lineage chips link cross-namespace). One implementation so the
+// three pages can't drift.
+function wireHashOpen(sel, loadFn, openFn) {
+  const openFromHash = async () => {
+    const h = decodeURIComponent(location.hash.slice(1));
+    if (!h) return;
+    let ns = sel.value;
+    let name = h;
+    const i = h.indexOf("/");
+    if (i > 0) {
+      const wantNs = h.slice(0, i);
+      name = h.slice(i + 1);
+      if ([...sel.options].some((o) => o.value === wantNs)) {
+        if (sel.value !== wantNs) {
+          sel.value = wantNs;
+          await loadFn(wantNs);
+        }
+        ns = wantNs;
+      }
+    }
+    await openFn(ns, name);
+  };
+  openFromHash().catch((err) => showError(err.message));
+  window.addEventListener("hashchange", () =>
+    openFromHash().catch((err) => showError(err.message)));
+}

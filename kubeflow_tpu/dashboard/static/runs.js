@@ -38,8 +38,9 @@ function drawDag(steps, nodes) {
   const W = 960, CW = Math.max(140, Math.min(220, W / cols.length));
   const RH = 44, NH = 28, NW = Math.min(CW - 36, 150);
   // cols may be sparse (a step depending on a name not in the spec
-  // leaves depth-0 empty) — holes must not poison the height
-  const H = Math.max(...cols.map((c) => (c || []).length)) * RH + 24;
+  // leaves depth-0 empty) — Array.from visits holes, .map skips them
+  const H = Math.max(...Array.from(cols, (c) => (c || []).length))
+    * RH + 24;
   svg.setAttribute("height", H);
   const pos = {};
   cols.forEach((col, ci) => col.forEach((name, ri) => {
@@ -152,13 +153,8 @@ async function main() {
     const saved = localStorage.getItem("kftpu-ns");
     if (saved && env.namespaces.includes(saved)) sel.value = saved;
     await loadRuns(sel.value);
-    // deep links (model-lineage chips, shared URLs): /runs.html#<run>
-    const openFromHash = () => {
-      const h = decodeURIComponent(location.hash.slice(1));
-      if (h) openRun(sel.value, h).catch((err) => showError(err.message));
-    };
-    openFromHash();
-    window.addEventListener("hashchange", openFromHash);
+    // deep links: /runs.html#<run> or #<ns>/<run> (lineage chips)
+    wireHashOpen(sel, loadRuns, openRun);
     sel.addEventListener("change", () => {
       localStorage.setItem("kftpu-ns", sel.value);
       $("detail-panel").style.display = "none";

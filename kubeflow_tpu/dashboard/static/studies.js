@@ -150,13 +150,8 @@ async function main() {
     const saved = localStorage.getItem("kftpu-ns");
     if (saved && env.namespaces.includes(saved)) sel.value = saved;
     await loadStudies(sel.value);
-    // deep links (model-lineage chips, shared URLs): /studies.html#<study>
-    const openFromHash = () => {
-      const h = decodeURIComponent(location.hash.slice(1));
-      if (h) openStudy(sel.value, h).catch((err) => showError(err.message));
-    };
-    openFromHash();
-    window.addEventListener("hashchange", openFromHash);
+    // deep links: /studies.html#<study> or #<ns>/<study>
+    wireHashOpen(sel, loadStudies, openStudy);
     sel.addEventListener("change", () => {
       localStorage.setItem("kftpu-ns", sel.value);
       $("detail-panel").style.display = "none";

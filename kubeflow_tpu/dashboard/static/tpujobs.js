@@ -54,13 +54,8 @@ async function main() {
     const saved = localStorage.getItem("kftpu-ns");
     if (saved && env.namespaces.includes(saved)) sel.value = saved;
     await loadJobs(sel.value);
-    // deep links (model-lineage chips, shared URLs): /tpujobs.html#<job>
-    const openFromHash = () => {
-      const h = decodeURIComponent(location.hash.slice(1));
-      if (h) openJob(sel.value, h).catch((err) => showError(err.message));
-    };
-    openFromHash();
-    window.addEventListener("hashchange", openFromHash);
+    // deep links: /tpujobs.html#<job> or #<ns>/<job>
+    wireHashOpen(sel, loadJobs, openJob);
     sel.addEventListener("change", () => {
       localStorage.setItem("kftpu-ns", sel.value);
       $("detail-panel").style.display = "none";

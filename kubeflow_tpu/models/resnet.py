@@ -38,6 +38,11 @@ class ResNetConfig:
     # tiles well. Mathematically the same function class (the equivalent
     # 2x2/1 conv sees every original pixel of the 4x4 block).
     stem: str = "space_to_depth"
+    # int8 forward-saved conv inputs (ops/act_compress.py): halves the
+    # backward's activation read traffic on the HBM-bound train step at
+    # the cost of bounded gradient quantization error — PERF.md's open
+    # bandwidth lever; loss-parity gated in tests/test_act_compress.py
+    act_compress: bool = False
 
 
 class BottleneckBlock(nn.Module):
@@ -46,12 +51,21 @@ class BottleneckBlock(nn.Module):
     dtype: Any
     param_dtype: Any
     bn_dtype: Any = jnp.float32
+    act_compress: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool):
-        conv = partial(
-            nn.Conv, use_bias=False, dtype=self.dtype, param_dtype=self.param_dtype
-        )
+        if self.act_compress:
+            from kubeflow_tpu.ops.act_compress import Int8Conv
+
+            # same param names/shapes as nn.Conv — checkpoints carry over
+            conv = partial(Int8Conv, dtype=self.dtype,
+                           param_dtype=self.param_dtype)
+        else:
+            conv = partial(
+                nn.Conv, use_bias=False, dtype=self.dtype,
+                param_dtype=self.param_dtype
+            )
         norm = partial(
             nn.BatchNorm,
             use_running_average=not train,
@@ -132,6 +146,7 @@ class ResNet(nn.Module):
                     dtype=c.dtype,
                     param_dtype=c.param_dtype,
                     bn_dtype=c.bn_dtype,
+                    act_compress=c.act_compress,
                     name=f"stage{i}_block{j}",
                 )(x, train=train)
         x = jnp.mean(x, axis=(1, 2))

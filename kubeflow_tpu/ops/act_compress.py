@@ -1,0 +1,109 @@
+"""Compressed-activation training: int8 forward-saved tensors.
+
+PERF.md's open ResNet lever: the train step is HBM-bound, and roughly
+half the activation traffic is the backward pass re-reading forward
+activations (every conv's input is saved for its weight gradient).
+Storing those residuals in int8 (per-channel absmax scale) cuts their
+HBM footprint and read traffic 2× vs bf16 / 4× vs f32, at the cost of a
+bounded quantization error in the gradients — the ActNN/GACT recipe,
+expressed the JAX way as a ``custom_vjp`` around the op:
+
+- forward: run the op exactly (full precision); save the INPUT as
+  ``(int8 values, per-channel scales)`` instead of the raw tensor;
+- backward: dequantize and differentiate the op at the dequantized
+  point (straight-through with respect to the rounding).
+
+No reference counterpart (the reference never sees model internals);
+technique reference: ActNN (arXiv:2104.14129) / MLPerf-era activation
+compression. The loss-parity gate lives in ``tests/test_act_compress.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def quantize_int8(x: jnp.ndarray):
+    """Per-channel (last axis) symmetric absmax int8 quantization.
+
+    Returns ``(q int8, scale f32)`` with ``x ≈ q * scale``. Zero
+    channels get scale 0 (and dequantize to exact zeros).
+    """
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)),
+                     axis=tuple(range(x.ndim - 1)), keepdims=True)
+    scale = absmax / 127.0
+    q = jnp.where(scale > 0, x.astype(jnp.float32) / jnp.where(
+        scale > 0, scale, 1.0), 0.0)
+    q = jnp.clip(jnp.round(q), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_int8(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    return q.astype(jnp.float32) * scale
+
+
+def int8_checkpoint(fn: Callable) -> Callable:
+    """Wrap pure ``fn(params, x) -> y`` so the backward pass sees an
+    int8-saved ``x``.
+
+    The forward runs ``fn`` exactly; only the residual changes: ``x`` is
+    saved quantized and the backward recomputes ``fn``'s VJP at the
+    dequantized point. ``params`` is saved by reference (it is live in
+    the optimizer anyway).
+    """
+
+    @jax.custom_vjp
+    def wrapped(params, x):
+        return fn(params, x)
+
+    def fwd(params, x):
+        y = fn(params, x)
+        q, scale = quantize_int8(x)
+        # residuals must be jax types; a 0-size array carries x's dtype
+        return y, (params, q, scale, jnp.zeros((0,), x.dtype))
+
+    def bwd(res, g):
+        params, q, scale, dtype_token = res
+        x = dequantize_int8(q, scale).astype(dtype_token.dtype)
+        _, vjp = jax.vjp(fn, params, x)
+        return vjp(g)
+
+    wrapped.defvjp(fwd, bwd)
+    return wrapped
+
+
+class Int8Conv(nn.Module):
+    """``nn.Conv``-shaped conv (no bias) whose backward reads its input
+    from an int8 residual — drop-in for the HBM-bound ResNet blocks.
+
+    Same param shape/name as ``nn.Conv`` (``kernel``: (KH, KW, Cin,
+    Cout)), so checkpoints swap between compressed and plain configs.
+    """
+
+    features: int
+    kernel_size: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: str = "SAME"
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = self.kernel_size
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (kh, kw, x.shape[-1], self.features), self.param_dtype)
+
+        def conv(k, xx):
+            # no preferred_element_type: its transpose rejects the
+            # mixed-dtype cotangent, and the MXU accumulates bf16
+            # contractions in f32 regardless (nn.Conv semantics)
+            return jax.lax.conv_general_dilated(
+                xx, k.astype(self.dtype), self.strides, self.padding,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+        return int8_checkpoint(conv)(kernel, x.astype(self.dtype))

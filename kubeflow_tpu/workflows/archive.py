@@ -171,7 +171,13 @@ class ArtifactStore:
         return cls(root) if root else None
 
     def _dir(self, ns: str, run: str, step: str) -> str:
-        return os.path.join(self.root, _safe(ns), _safe(run), _safe(step))
+        # step may be a NESTED path (list() reports os.walk relpaths like
+        # "train/ckpt-1000" when a workload wrote a checkpoint tree);
+        # sanitize per segment so nesting round-trips but ".." never
+        # escapes the store
+        segs = [_safe(s) for s in step.split("/")
+                if s and s not in (".", "..")] or ["_"]
+        return os.path.join(self.root, _safe(ns), _safe(run), *segs)
 
     def put(self, ns: str, run: str, step: str, name: str,
             data: bytes) -> str:

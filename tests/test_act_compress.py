@@ -1,0 +1,136 @@
+"""Int8 compressed-activation training (the PERF.md ResNet bandwidth
+lever): quantization round-trip bounds, gradient fidelity vs the exact
+conv, and the loss-parity gate on a real train loop.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from kubeflow_tpu.ops.act_compress import (
+    Int8Conv,
+    dequantize_int8,
+    int8_checkpoint,
+    quantize_int8,
+)
+
+
+def test_quantize_roundtrip_bound():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(4, 8, 8, 16)) * 3.0, jnp.float32)
+    q, scale = quantize_int8(x)
+    assert q.dtype == jnp.int8 and scale.shape == (1, 1, 1, 16)
+    err = np.abs(np.asarray(dequantize_int8(q, scale) - x))
+    # absmax/127 is the per-channel quantization step; round-to-nearest
+    # error is at most half a step
+    bound = np.asarray(scale)[0, 0, 0] * 0.5 + 1e-7
+    assert (err <= bound[None, None, None, :]).all()
+
+
+def test_quantize_zero_channel_exact():
+    x = jnp.zeros((2, 3, 3, 4))
+    q, scale = quantize_int8(x)
+    assert (np.asarray(dequantize_int8(q, scale)) == 0).all()
+
+
+def test_int8_checkpoint_forward_exact_backward_close():
+    """Forward is bit-exact (compression only touches the residual);
+    gradients match the exact op to quantization tolerance."""
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(2, 8, 8, 8)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(3, 3, 8, 16)) * 0.1, jnp.float32)
+
+    def conv(kernel, xx):
+        return jax.lax.conv_general_dilated(
+            xx, kernel, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    wrapped = int8_checkpoint(conv)
+
+    def loss_exact(kernel, xx):
+        return jnp.sum(conv(kernel, xx) ** 2)
+
+    def loss_comp(kernel, xx):
+        return jnp.sum(wrapped(kernel, xx) ** 2)
+
+    np.testing.assert_array_equal(
+        np.asarray(jax.jit(wrapped)(k, x)), np.asarray(conv(k, x)))
+    ge = jax.grad(loss_exact, argnums=(0, 1))(k, x)
+    gc = jax.grad(loss_comp, argnums=(0, 1))(k, x)
+    for exact, comp in zip(ge, gc):
+        denom = np.linalg.norm(np.asarray(exact)) + 1e-8
+        rel = np.linalg.norm(np.asarray(exact - comp)) / denom
+        assert rel < 0.02, rel  # int8 per-channel keeps grads within 2%
+
+
+def test_int8conv_matches_nn_conv_params_and_forward():
+    """Int8Conv is checkpoint-compatible with nn.Conv (same param tree)
+    and computes the same forward function."""
+    import flax.linen as nn
+
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(2, 8, 8, 4)), jnp.float32)
+    ours = Int8Conv(features=8, kernel_size=(3, 3), dtype=jnp.float32)
+    ref = nn.Conv(features=8, kernel_size=(3, 3), use_bias=False,
+                  dtype=jnp.float32)
+    p1 = ours.init(jax.random.key(0), x)["params"]
+    p2 = ref.init(jax.random.key(0), x)["params"]
+    assert jax.tree_util.tree_structure(p1) == jax.tree_util.tree_structure(p2)
+    assert p1["kernel"].shape == p2["kernel"].shape
+    y1 = ours.apply({"params": p2}, x)  # swap params across impls
+    y2 = ref.apply({"params": p2}, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.slow
+def test_resnet_loss_parity_gate():
+    """The PERF.md gate: N train steps with act_compress on/off must
+    track each other — compression is a bandwidth optimization, not a
+    model change."""
+    from kubeflow_tpu.models.resnet import ResNet, ResNetConfig
+
+    rng = np.random.default_rng(3)
+    images = jnp.asarray(rng.normal(size=(8, 32, 32, 3)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 10, size=(8,)), jnp.int32)
+
+    def run(act_compress):
+        cfg = ResNetConfig(stage_sizes=(1, 1), num_classes=10, width=16,
+                           dtype=jnp.float32, bn_dtype=jnp.float32,
+                           stem="conv", act_compress=act_compress)
+        model = ResNet(cfg)
+        variables = model.init(jax.random.key(0), images, train=True)
+        params, batch_stats = variables["params"], variables["batch_stats"]
+        tx = optax.sgd(0.05, momentum=0.9)
+        opt = tx.init(params)
+
+        @jax.jit
+        def step(params, batch_stats, opt):
+            def loss_fn(p):
+                logits, mut = model.apply(
+                    {"params": p, "batch_stats": batch_stats}, images,
+                    train=True, mutable=["batch_stats"])
+                one = jax.nn.one_hot(labels, 10)
+                return -jnp.mean(jnp.sum(
+                    one * jax.nn.log_softmax(logits), -1)), mut
+
+            (loss, mut), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            upd, opt = tx.update(grads, opt)
+            return optax.apply_updates(params, upd), \
+                mut["batch_stats"], opt, loss
+
+        losses = []
+        for _ in range(6):
+            params, batch_stats, opt, loss = step(params, batch_stats, opt)
+            losses.append(float(loss))
+        return losses
+
+    exact = run(False)
+    comp = run(True)
+    # same init, same data: curves must track closely and both descend
+    assert exact[-1] < exact[0] and comp[-1] < comp[0]
+    for e, c in zip(exact, comp):
+        assert abs(e - c) < 0.08 * max(abs(e), 1.0), (exact, comp)

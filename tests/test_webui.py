@@ -308,3 +308,31 @@ def test_runs_page_ships_dag_and_artifact_views(dashboard_server):
     assert b"drawDag" in body and b"/api/artifacts/" in body
     code, body, _ = _get(dashboard_server + "/models.js")
     assert b"drawLineage" in body and b"lineage-chain" in body
+
+
+def test_nested_artifact_steps_roundtrip(tmp_path):
+    """Checkpoint trees produce nested step relpaths; list() entries must
+    resolve through the download route (percent-encoded step)."""
+    from urllib.parse import quote
+
+    from kubeflow_tpu.dashboard.server import DashboardApi
+    from kubeflow_tpu.workflows.archive import ArtifactStore
+
+    store = ArtifactStore(str(tmp_path))
+    store.put("ns1", "r1", "train/ckpt-1000", "data-0", b"weights")
+    api = DashboardApi(FakeKubeClient(), artifact_store=store,
+                       authorize=lambda *a: True)
+    arts = store.list("ns1", "r1")
+    assert arts == [{"step": "train/ckpt-1000", "name": "data-0",
+                     "bytes": 7}]
+    url = ("/api/artifacts/ns1/r1/" +
+           quote(arts[0]["step"], safe="") + "/" + arts[0]["name"])
+    code, raw = api.handle("GET", url, None, "u")
+    assert code == 200
+    with open(raw.path, "rb") as f:
+        assert f.read() == b"weights"
+    # traversal segments are stripped, never escape the store
+    code, _ = api.handle(
+        "GET", "/api/artifacts/ns1/r1/" + quote("../../ns2", safe="") +
+        "/data-0", None, "u")
+    assert code == 404
