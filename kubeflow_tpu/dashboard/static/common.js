@@ -50,14 +50,18 @@ function wireHashOpen(sel, loadFn, openFn) {
     const i = h.indexOf("/");
     if (i > 0) {
       const wantNs = h.slice(0, i);
-      name = h.slice(i + 1);
-      if ([...sel.options].some((o) => o.value === wantNs)) {
-        if (sel.value !== wantNs) {
-          sel.value = wantNs;
-          await loadFn(wantNs);
-        }
-        ns = wantNs;
+      if (![...sel.options].some((o) => o.value === wantNs)) {
+        // never fall through to a SAME-NAMED object in another
+        // namespace — that would show wrong data without a hint
+        showError("namespace " + wantNs + " is not accessible");
+        return;
       }
+      name = h.slice(i + 1);
+      if (sel.value !== wantNs) {
+        sel.value = wantNs;
+        await loadFn(wantNs);
+      }
+      ns = wantNs;
     }
     await openFn(ns, name);
   };

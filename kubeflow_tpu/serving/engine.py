@@ -137,10 +137,15 @@ class DecodeEngine:
     """
 
     def __init__(self, config, params, *, slots: int = 8,
-                 steps_per_sync: int = 1,
+                 steps_per_sync: int = 1, mesh=None,
                  autostart: bool = True, name: str = "") -> None:
         self.config = config
         self.slots = slots
+        # multi-chip serving: with a Mesh (params already placed with
+        # tensor-parallel shardings, e.g. via models.param_partition_specs)
+        # every compiled engine program runs under it, and the model's
+        # logical-axis constraints shard the KV cache over the same axes
+        self.mesh = mesh
         # decode steps executed on-device per host round-trip: >1 hides
         # dispatch/transfer latency (the dominant cost when the host is
         # remote from the chip) at the price of admission/EOS reacting
@@ -154,6 +159,15 @@ class DecodeEngine:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()  # guards _active between admit/step
+
+        if mesh is not None:
+            from kubeflow_tpu.parallel.mesh import mesh_context
+
+            self._mesh_ctx = lambda: mesh_context(mesh)
+        else:
+            import contextlib
+
+            self._mesh_ctx = contextlib.nullcontext
 
         Smax = config.max_seq_len
 
@@ -206,11 +220,44 @@ class DecodeEngine:
         probe = jnp.zeros((1, 1), jnp.int32)
         shapes = jax.eval_shape(
             lambda p: prefill(config, p, probe)[1], params)
-        self._cache = jax.tree_util.tree_map(
-            lambda s: jnp.zeros(
-                tuple(slots if a == _batch_axis(s) else d
-                      for a, d in enumerate(s.shape)), s.dtype),
-            shapes)
+
+        def _engine_shape(s):
+            return tuple(slots if a == _batch_axis(s) else d
+                         for a, d in enumerate(s.shape))
+
+        def _zeros_tree():
+            return jax.tree_util.tree_map(
+                lambda s: jnp.zeros(_engine_shape(s), s.dtype), shapes)
+
+        if mesh is None:
+            self._cache = _zeros_tree()
+        else:
+            # k/v leaves shard their kv-heads axis (rank-2 from the end)
+            # per the model's logical rules, so the full-context cache
+            # never materializes on one device; shape_aware_spec drops
+            # the axis when it doesn't divide (GQA kv heads < tp)
+            from jax.sharding import NamedSharding
+
+            from kubeflow_tpu.parallel.mesh import (
+                logical_to_mesh_axes,
+                shape_aware_spec,
+            )
+
+            def _sharding(s):
+                shape = _engine_shape(s)
+                names = [None] * len(shape)
+                if len(shape) >= 4:
+                    names[-2] = "heads"
+                spec = shape_aware_spec(
+                    logical_to_mesh_axes(names, config.rules), shape,
+                    mesh)
+                return NamedSharding(mesh, spec)
+
+            with self._mesh_ctx():
+                self._cache = jax.jit(
+                    _zeros_tree,
+                    out_shardings=jax.tree_util.tree_map(
+                        _sharding, shapes))()
         # host-side per-slot sampling state, padded to the batch
         self._tokens = np.zeros((slots,), np.int32)
         self._seeds = np.zeros((slots,), np.int32)
@@ -288,13 +335,14 @@ class DecodeEngine:
         bucket = pow2_bucket(S, self.config.max_seq_len)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :S] = req.prompt
-        tok, row_cache = self._prefill(
-            self._params, jnp.asarray(padded),
-            jnp.asarray([S], jnp.int32), jnp.float32(req.temperature),
-            jnp.int32(req.top_k), jnp.float32(req.top_p),
-            jnp.int32(req.seed))
-        self._cache = self._insert(self._cache, row_cache,
-                                   jnp.int32(slot))
+        with self._mesh_ctx():
+            tok, row_cache = self._prefill(
+                self._params, jnp.asarray(padded),
+                jnp.asarray([S], jnp.int32), jnp.float32(req.temperature),
+                jnp.int32(req.top_k), jnp.float32(req.top_p),
+                jnp.int32(req.seed))
+            self._cache = self._insert(self._cache, row_cache,
+                                       jnp.int32(slot))
         first = int(tok)
         st = _Slot(req=req)
         self._emit(st, first)
@@ -331,11 +379,12 @@ class DecodeEngine:
                       if s is not None]
         if not active:
             return worked
-        self._cache, toks = self._step(
-            self._params, self._cache, jnp.asarray(self._tokens),
-            jnp.asarray(self._seeds), jnp.asarray(self._stepidx),
-            jnp.asarray(self._temps), jnp.asarray(self._topk),
-            jnp.asarray(self._topp))
+        with self._mesh_ctx():
+            self._cache, toks = self._step(
+                self._params, self._cache, jnp.asarray(self._tokens),
+                jnp.asarray(self._seeds), jnp.asarray(self._stepidx),
+                jnp.asarray(self._temps), jnp.asarray(self._topk),
+                jnp.asarray(self._topp))
         toks = np.asarray(toks)  # (K, B)
         K = toks.shape[0]
         self.steps_total += K

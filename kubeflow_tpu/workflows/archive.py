@@ -37,8 +37,11 @@ _SAFE = re.compile(r"[^A-Za-z0-9._-]")
 
 
 def _safe(part: str) -> str:
-    """One path segment: strip separators/specials, never empty."""
-    return _SAFE.sub("_", part) or "_"
+    """One path segment: strip separators/specials, never empty — and
+    never a dot segment ("."/".." pass the character filter but would
+    walk out of the store)."""
+    part = _SAFE.sub("_", part)
+    return "_" if part in ("", ".", "..") else part
 
 
 def _atomic_write(path: str, data: bytes) -> None:
@@ -173,10 +176,9 @@ class ArtifactStore:
     def _dir(self, ns: str, run: str, step: str) -> str:
         # step may be a NESTED path (list() reports os.walk relpaths like
         # "train/ckpt-1000" when a workload wrote a checkpoint tree);
-        # sanitize per segment so nesting round-trips but ".." never
-        # escapes the store
-        segs = [_safe(s) for s in step.split("/")
-                if s and s not in (".", "..")] or ["_"]
+        # sanitize per segment — _safe neutralizes dot segments, so
+        # nesting round-trips but nothing escapes the store
+        segs = [_safe(s) for s in step.split("/") if s] or ["_"]
         return os.path.join(self.root, _safe(ns), _safe(run), *segs)
 
     def put(self, ns: str, run: str, step: str, name: str,

@@ -275,3 +275,49 @@ def test_server_without_engine_rejects_eos(tmp_path, lm):
         assert resp.status == 400 and "decode engine" in out["error"]
     finally:
         srv.stop()
+
+
+def test_engine_on_sharded_mesh(lm):
+    """Multi-chip serving: the engine with tensor-parallel-sharded
+    params on the virtual mesh must match unsharded greedy decode
+    exactly (the sharded twin of test_decode_on_sharded_mesh, through
+    the continuous-batching path)."""
+    from jax.sharding import NamedSharding
+
+    from kubeflow_tpu.models import param_partition_specs
+    from kubeflow_tpu.parallel import MeshConfig, create_mesh
+    from kubeflow_tpu.parallel.mesh import shape_aware_spec
+
+    config, params = lm
+    mesh = create_mesh(MeshConfig(dp=2, tp=4))
+    specs = param_partition_specs(params)
+    sharded = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(
+            x, NamedSharding(mesh, shape_aware_spec(s, x.shape, mesh))),
+        params, specs, is_leaf=lambda x: not isinstance(x, dict))
+    eng = DecodeEngine(config, sharded, slots=2, mesh=mesh,
+                       autostart=False)
+    r1 = eng.submit([5, 11, 17], max_new=6)
+    r2 = eng.submit([3, 2, 9, 23], max_new=4)
+    for _ in range(10):
+        eng.run_once(timeout=0.01)
+    assert r1.result() == _oracle(config, params, [5, 11, 17], 6)
+    assert r2.result() == _oracle(config, params, [3, 2, 9, 23], 4)
+
+    # tp=2 divides the 2 kv heads: the engine cache k/v leaves must be
+    # CREATED sharded over tp (never one full copy per device)
+    mesh2 = create_mesh(MeshConfig(dp=4, tp=2))
+    sharded2 = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(
+            x, NamedSharding(mesh2, shape_aware_spec(s, x.shape, mesh2))),
+        params, specs, is_leaf=lambda x: not isinstance(x, dict))
+    eng2 = DecodeEngine(config, sharded2, slots=2, mesh=mesh2,
+                        autostart=False)
+    kv_specs = [leaf.sharding.spec
+                for leaf in jax.tree_util.tree_leaves(eng2._cache)
+                if leaf.ndim >= 4]
+    assert kv_specs and all("tp" in str(s) for s in kv_specs), kv_specs
+    r3 = eng2.submit([5, 11, 17], max_new=6)
+    for _ in range(8):
+        eng2.run_once(timeout=0.01)
+    assert r3.result() == _oracle(config, params, [5, 11, 17], 6)
