@@ -10,9 +10,10 @@ reference era's GPU path (tf_cnn_benchmarks ResNet-50 on one V100, fp32,
 batch 64, ~2019 ≈ 360 images/sec — the north-star per-chip target), and the
 ``extras`` key carries MFU plus the MNIST-smoke, BERT step-time, allreduce,
 and serving-latency configs (BASELINE.md configs 1, 3, 4, 5) so every
-baseline config emits numbers each round — plus the two TPU-first configs
+baseline config emits numbers each round — plus the three TPU-first configs
 the reference has no counterpart for: ``longcontext`` (seq-8192 flash
-training) and ``decode`` (KV-cache generation).
+training), ``decode`` (KV-cache generation), and ``decode_engine``
+(continuous-batching serving throughput at effective batch 32).
 """
 
 from __future__ import annotations
