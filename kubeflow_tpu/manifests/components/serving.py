@@ -27,6 +27,13 @@ DEFAULTS: Dict[str, Any] = {
     "tpu_chips": 0,  # 0 = CPU serving; >0 requests google.com/tpu
     "batch_timeout_ms": 5,
     "max_batch_size": 8,
+    # continuous-batching decode engine slots for LM :generate (0 = the
+    # whole-request bucketed fallback) and on-device steps per host sync
+    "decode_slots": 8,
+    "decode_steps_per_sync": 4,
+    # "" = single-chip; "tp=4" serves LMs tensor-parallel across the
+    # pod's chips (params + KV cache sharded over the mesh)
+    "serving_mesh": "",
     # version -> weight (e.g. {"v1": 90, "v2": 10}); empty = single version.
     # Renders one Deployment per version + an Istio VirtualService carrying
     # the weights (tf-serving-service-template.libsonnet trafficRule parity)
@@ -107,6 +114,10 @@ def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
         "KFTPU_GRPC_PORT": str(params["grpc_port"]),
         "KFTPU_BATCH_TIMEOUT_MS": str(params["batch_timeout_ms"]),
         "KFTPU_MAX_BATCH_SIZE": str(params["max_batch_size"]),
+        "KFTPU_DECODE_SLOTS": str(params["decode_slots"]),
+        "KFTPU_DECODE_STEPS_PER_SYNC": str(params["decode_steps_per_sync"]),
+        **({"KFTPU_SERVING_MESH": params["serving_mesh"]}
+           if params["serving_mesh"] else {}),
     }
 
     def version_deploy(version: str, pin: bool) -> o.Obj:

@@ -34,15 +34,38 @@ def _flatten(params: Any) -> Dict[str, np.ndarray]:
     return flat
 
 
-def _unflatten(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
+def _unflatten(flat: Dict[str, np.ndarray], *,
+               to_device: bool = True) -> Dict[str, Any]:
+    """``to_device=False`` keeps leaves as host arrays — the sharded
+    load path must go host → per-device shards without ever committing
+    the full tree to the default device."""
     out: Dict[str, Any] = {}
     for key, val in flat.items():
         parts = key.split("/")
         node = out
         for p in parts[:-1]:
             node = node.setdefault(p, {})
-        node[parts[-1]] = jnp.asarray(val)
+        node[parts[-1]] = jnp.asarray(val) if to_device else val
     return out
+
+
+def shard_lm_params(params, mesh):
+    """Place an LM's params on ``mesh`` with the models' logical
+    partition specs (tensor-parallel serving). Works from host arrays:
+    each device receives only its shard — the full tree is never
+    materialized on one chip (the whole point when the model doesn't
+    fit one HBM)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    from kubeflow_tpu.models import param_partition_specs
+    from kubeflow_tpu.parallel.mesh import shape_aware_spec
+
+    specs = param_partition_specs(params)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(
+            x, NamedSharding(mesh, shape_aware_spec(s, np.shape(x), mesh))),
+        params, specs, is_leaf=lambda x: not isinstance(x, dict))
 
 
 def build_model(kind: str, config: Dict[str, Any]):
@@ -254,7 +277,12 @@ def list_versions(base_path: str) -> List[int]:
     )
 
 
-def load_version(base_path: str, version: int) -> LoadedModel:
+def load_version(base_path: str, version: int,
+                 mesh=None) -> LoadedModel:
+    """``mesh`` (transformer kind only): params land SHARDED over it at
+    load — the serving tier's tensor-parallel path. One copy in HBM,
+    shared by the decode engine and the unary fallback (jit follows
+    input shardings)."""
     vdir = os.path.join(base_path, str(version))
     with open(os.path.join(vdir, MODEL_FILE)) as f:
         meta = yaml.safe_load(f)
@@ -278,8 +306,11 @@ def load_version(base_path: str, version: int) -> LoadedModel:
     for k, dtype_name in (meta.get("cast_leaves") or {}).items():
         if k in raw:
             raw[k] = raw[k].astype(np.dtype(dtype_name))
-    params = _unflatten(raw)
+    sharded_load = mesh is not None and kind == "transformer"
+    params = _unflatten(raw, to_device=not sharded_load)
     model, apply_fn = build_model(kind, meta.get("config", {}) or {})
+    if sharded_load:
+        params = shard_lm_params(params, mesh)
 
     @jax.jit
     def predict(x: jnp.ndarray) -> jnp.ndarray:

@@ -230,6 +230,32 @@ def run_generate(model, body: Dict[str, Any], max_batch_size: int, *,
                  "tokens_per_sec": round(out.size / dt, 1)}
 
 
+def parse_serving_mesh(raw: Optional[str]):
+    """``"tp=4"`` / ``"dp=2,tp=4"`` → a device mesh (None when unset).
+    The env-facing twin of the trainer's MeshConfig."""
+    if not raw:
+        return None
+    from kubeflow_tpu.parallel import MeshConfig, create_mesh
+
+    kw = {}
+    for part in raw.split(","):
+        k, _, v = part.partition("=")
+        k = k.strip()
+        if k not in ("dcn", "dp", "pp", "tp"):
+            raise ValueError(f"KFTPU_SERVING_MESH axis {k!r} (want "
+                             "dcn/dp/pp/tp)")
+        if k in kw:
+            raise ValueError(f"KFTPU_SERVING_MESH repeats axis {k!r}")
+        try:
+            kw[k] = int(v)
+        except ValueError:
+            raise ValueError(
+                f"KFTPU_SERVING_MESH axis {k!r} needs an integer size, "
+                f"got {v.strip()!r} (format: 'tp=4' or 'dp=2,tp=4')"
+            ) from None
+    return create_mesh(MeshConfig(**kw))
+
+
 def _run_generate_engine(engine, arr, row_lens, *, max_new, ctx,
                          temperature, top_k, top_p, seed, eos_id,
                          stream, model_name,
@@ -326,7 +352,8 @@ class ModelRepository:
                  pin_version: Optional[int] = None,
                  warmup_batches: Tuple[int, ...] = (),
                  decode_slots: int = 0,
-                 decode_steps_per_sync: int = 1) -> None:
+                 decode_steps_per_sync: int = 1,
+                 decode_mesh=None) -> None:
         self.base_path = base_path
         self.poll_interval_s = poll_interval_s
         # padded batch buckets to precompile at load time, before the new
@@ -342,10 +369,19 @@ class ModelRepository:
         # (concurrent callers share one compiled decode step)
         self.decode_slots = decode_slots
         self.decode_steps_per_sync = decode_steps_per_sync
+        # a jax.sharding.Mesh: LMs too big for one chip serve through
+        # the engine with tensor-parallel-sharded params + KV cache
+        # (KFTPU_SERVING_MESH, e.g. "tp=4"); params are sharded once at
+        # engine creation via the models' logical partition specs
+        self.decode_mesh = decode_mesh
         self._models: Dict[str, LoadedModel] = {}
         self._pinned: Dict[Tuple[str, int], LoadedModel] = {}
         self._engines: Dict[Tuple[str, int], Any] = {}
         self._lock = threading.Lock()
+        # engine construction allocates a full KV cache on device —
+        # serialize it so racing first-callers can't transiently double
+        # the HBM footprint
+        self._engine_create_lock = threading.Lock()
         self._stop = threading.Event()
         self.refresh()
 
@@ -373,15 +409,23 @@ class ModelRepository:
             return eng
         from kubeflow_tpu.serving.engine import DecodeEngine
 
-        eng = DecodeEngine(model.lm_config, model.lm_params,
-                           slots=self.decode_slots,
-                           steps_per_sync=self.decode_steps_per_sync,
-                           name=name)
-        with self._lock:
-            if not allowed_locked():
-                race = None  # retired while we were building
-            else:
-                race = self._engines.setdefault(key, eng)
+        with self._engine_create_lock:
+            with self._lock:
+                eng = self._engines.get(key)  # a racer built it first
+                if eng is not None:
+                    return eng
+            # lm_params were sharded over decode_mesh at LOAD time
+            # (load_version), so the engine shares the one in-HBM copy
+            eng = DecodeEngine(model.lm_config, model.lm_params,
+                               slots=self.decode_slots,
+                               steps_per_sync=self.decode_steps_per_sync,
+                               mesh=self.decode_mesh,
+                               name=name)
+            with self._lock:
+                if not allowed_locked():
+                    race = None  # retired while we were building
+                else:
+                    race = self._engines.setdefault(key, eng)
         if race is not eng:
             eng.close()
         return race
@@ -418,7 +462,7 @@ class ModelRepository:
             # seconds); only the swap is serialized, so predicts never
             # stall on reload
             log.info("loading model %s version %d", name, latest)
-            loaded = load_version(mdir, latest)
+            loaded = load_version(mdir, latest, mesh=self.decode_mesh)
             self._warmup(name, loaded)
             with self._lock:
                 self._models[name] = loaded
@@ -463,7 +507,8 @@ class ModelRepository:
                 # compiling every bucket synchronously would multiply the
                 # first-request latency it is meant to prevent — the request
                 # compiles just its own bucket
-                loaded = load_version(mdir, version)
+                loaded = load_version(mdir, version,
+                                      mesh=self.decode_mesh)
                 with self._lock:
                     self._pinned[(name, version)] = loaded
                 return loaded
@@ -510,13 +555,15 @@ class ModelServer:
                  max_batch_size: int = 8, poll_interval_s: float = 10.0,
                  pin_version: Optional[int] = None,
                  warmup: bool = False, decode_slots: int = 0,
-                 decode_steps_per_sync: int = 1) -> None:
+                 decode_steps_per_sync: int = 1,
+                 decode_mesh=None) -> None:
         buckets = tuple(b for b in _PAD_BUCKETS if b <= max_batch_size)
         self.repo = ModelRepository(base_path, poll_interval_s=poll_interval_s,
                                     pin_version=pin_version,
                                     warmup_batches=buckets if warmup else (),
                                     decode_slots=decode_slots,
-                                    decode_steps_per_sync=decode_steps_per_sync)
+                                    decode_steps_per_sync=decode_steps_per_sync,
+                                    decode_mesh=decode_mesh)
         self.port = port
         self.max_batch_size = max_batch_size
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -758,7 +805,11 @@ def main() -> None:
                              os.environ.get("KFTPU_DECODE_SLOTS", "8")),
                          decode_steps_per_sync=int(
                              os.environ.get("KFTPU_DECODE_STEPS_PER_SYNC",
-                                            "4")))
+                                            "4")),
+                         # "tp=4": serve LMs tensor-parallel over the
+                         # pod's chips (params + KV cache sharded)
+                         decode_mesh=parse_serving_mesh(
+                             os.environ.get("KFTPU_SERVING_MESH")))
     server.start()
     grpc_server = None  # keep the reference: grpc.Server dies when GC'd
     if grpc_port:
