@@ -74,6 +74,34 @@ def prefill(config: TransformerConfig, params, tokens: jnp.ndarray,
     return last, cache
 
 
+def prefill_continue(config: TransformerConfig, params, cache,
+                     tokens: jnp.ndarray, suffix_len, total_len):
+    """Extend an existing prefilled cache by a (right-padded) suffix.
+
+    The prefix-caching primitive: ``cache`` holds a prompt prefix (its
+    write positions sit at the prefix length — all rows share it, the
+    multi-token apply's contract); ``tokens`` (B, S) is the right-padded
+    continuation, ``suffix_len`` its true per-row length (scalar or
+    (B,)) and ``total_len`` the full prompt length (prefix + suffix).
+    Returns (last real token's logits, cache positioned at total_len) —
+    exactly :func:`prefill`'s contract, at the suffix's cost.
+    """
+    model = _decode_model(config)
+    B, S = tokens.shape
+    suffix = jnp.broadcast_to(jnp.asarray(suffix_len, jnp.int32), (B,))
+    total = jnp.broadcast_to(jnp.asarray(total_len, jnp.int32), (B,))
+    logits, variables = model.apply({"params": params, "cache": cache},
+                                    tokens, mutable=["cache"])
+    new_cache = jax.tree_util.tree_map_with_path(
+        lambda path, leaf: (jnp.broadcast_to(total, leaf.shape)
+                            .astype(leaf.dtype)
+                            if path[-1].key == "positions" else leaf),
+        variables["cache"])
+    last = jnp.take_along_axis(
+        logits, (suffix - 1)[:, None, None], axis=1)[:, 0]
+    return last, new_cache
+
+
 def decode_step(config: TransformerConfig, params, cache,
                 token: jnp.ndarray):
     """One token in, one token's logits out; cache advances by one."""

@@ -35,6 +35,7 @@ model — prefill (per prompt bucket), insert, step.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import logging
 import queue
@@ -45,7 +46,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from kubeflow_tpu.models.decode import prefill, decode_step, sample_logits
+from kubeflow_tpu.models.decode import (
+    decode_step,
+    prefill,
+    prefill_continue,
+    sample_logits,
+)
 from kubeflow_tpu.utils import DEFAULT_REGISTRY
 
 log = logging.getLogger(__name__)
@@ -58,6 +64,10 @@ _occupancy = DEFAULT_REGISTRY.gauge(
     "kftpu_engine_active_slots", "active slots in the decode batch")
 _queue_depth = DEFAULT_REGISTRY.gauge(
     "kftpu_engine_pending_requests", "requests waiting for a slot")
+_prefix_hits = DEFAULT_REGISTRY.counter(
+    "kftpu_engine_prefix_hits_total", "prefix-cache hits at admission")
+_prefix_misses = DEFAULT_REGISTRY.counter(
+    "kftpu_engine_prefix_misses_total", "prefix-cache misses at admission")
 
 _END = object()  # per-request stream sentinel
 
@@ -92,6 +102,9 @@ class _Request:
     top_p: float
     seed: int
     eos_id: Optional[int]
+    # first N prompt tokens are a reusable prefix (shared system
+    # prompt): its prefill is served from the engine's prefix cache
+    prefix_len: int = 0
     out: "queue.Queue[Any]" = dataclasses.field(
         default_factory=queue.Queue)
     error: Optional[Exception] = None
@@ -138,6 +151,7 @@ class DecodeEngine:
 
     def __init__(self, config, params, *, slots: int = 8,
                  steps_per_sync: int = 1, mesh=None,
+                 prefix_cache_entries: int = 4,
                  autostart: bool = True, name: str = "") -> None:
         self.config = config
         self.slots = slots
@@ -179,6 +193,28 @@ class DecodeEngine:
             tok = sample_logits(logits, key, temperature=temperature,
                                 top_k=top_k, top_p=top_p)
             return tok[0], cache
+
+        @jax.jit
+        def _continue_and_sample(params, cache, suffix, suffix_len,
+                                 total_len, temperature, top_k, top_p,
+                                 seed):
+            logits, cache = prefill_continue(
+                config, params, cache, suffix, suffix_len, total_len)
+            key = jax.random.fold_in(jax.random.key(seed), 0)
+            tok = sample_logits(logits, key, temperature=temperature,
+                                top_k=top_k, top_p=top_p)
+            return tok[0], cache
+
+        self._continue = _continue_and_sample
+        # LRU of prefilled prompt prefixes: (len, token bytes) →
+        # 1-row cache. Entries are full-context rows, so the cap is
+        # deliberately small; _continue never mutates a stored entry
+        # (functional apply, no donation).
+        self._prefix_entries = max(0, int(prefix_cache_entries))
+        self._prefix_store: "collections.OrderedDict" = \
+            collections.OrderedDict()
+        self.prefix_hits = 0
+        self.prefix_misses = 0
 
         def _insert(engine_cache, row_cache, slot):
             return jax.tree_util.tree_map(
@@ -274,7 +310,8 @@ class DecodeEngine:
 
     def submit(self, prompt, *, max_new: int, temperature: float = 0.0,
                top_k: int = 0, top_p: float = 1.0, seed: int = 0,
-               eos_id: Optional[int] = None) -> _Request:
+               eos_id: Optional[int] = None,
+               prefix_len: int = 0) -> _Request:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
@@ -282,9 +319,17 @@ class DecodeEngine:
             raise ValueError(
                 f"prompt {prompt.size} + max_new {max_new} exceeds "
                 f"context {self.config.max_seq_len}")
+        prefix_len = int(prefix_len)
+        if prefix_len and not 0 < prefix_len < prompt.size:
+            raise ValueError(
+                f"prefix_len {prefix_len} must be in (0, prompt length "
+                f"{prompt.size}) — the suffix may not be empty")
+        if self._prefix_entries == 0:
+            prefix_len = 0  # cache disabled: fall back to full prefill
         req = _Request(prompt=prompt, max_new=max_new,
                        temperature=float(temperature), top_k=int(top_k),
-                       top_p=float(top_p), seed=int(seed), eos_id=eos_id)
+                       top_p=float(top_p), seed=int(seed), eos_id=eos_id,
+                       prefix_len=prefix_len)
         # the lock orders this against close()'s drain: a submit must
         # either land before the drain (and be failed by it) or see the
         # stop flag and raise — never sit in a queue nobody reads
@@ -329,18 +374,63 @@ class DecodeEngine:
 
     # -- engine internals --------------------------------------------------
 
+    def _prefix_cache_row(self, prefix: np.ndarray):
+        """The 1-row cache holding this prefilled prefix (LRU)."""
+        key = (prefix.size, prefix.tobytes())
+        cached = self._prefix_store.get(key)
+        if cached is not None:
+            self._prefix_store.move_to_end(key)
+            self.prefix_hits += 1
+            _prefix_hits.inc(model=self.name)
+            return cached
+        self.prefix_misses += 1
+        _prefix_misses.inc(model=self.name)
+        N = prefix.size
+        bucket = pow2_bucket(N, self.config.max_seq_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :N] = prefix
+        # sampling args are dummies — only the cache is kept
+        _, pcache = self._prefill(
+            self._params, jnp.asarray(padded),
+            jnp.asarray([N], jnp.int32), jnp.float32(0.0),
+            jnp.int32(0), jnp.float32(1.0), jnp.int32(0))
+        self._prefix_store[key] = pcache
+        while len(self._prefix_store) > self._prefix_entries:
+            self._prefix_store.popitem(last=False)
+        return pcache
+
     def _admit_one(self, req: _Request, slot: int) -> None:
         """Prefill the request's prompt and write it into ``slot``."""
         S = req.prompt.size
-        bucket = pow2_bucket(S, self.config.max_seq_len)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :S] = req.prompt
         with self._mesh_ctx():
-            tok, row_cache = self._prefill(
-                self._params, jnp.asarray(padded),
-                jnp.asarray([S], jnp.int32), jnp.float32(req.temperature),
-                jnp.int32(req.top_k), jnp.float32(req.top_p),
-                jnp.int32(req.seed))
+            if req.prefix_len:
+                N = req.prefix_len
+                pcache = self._prefix_cache_row(req.prompt[:N])
+                suf = S - N
+                sbucket = pow2_bucket(suf, self.config.max_seq_len)
+                if N + sbucket > self.config.max_seq_len:
+                    # a padded suffix would start-clamp its cache write
+                    # past the context end; serve the exact length (a
+                    # rare boundary compile, like the unary tail case)
+                    sbucket = suf
+                padded = np.zeros((1, sbucket), np.int32)
+                padded[0, :suf] = req.prompt[N:]
+                tok, row_cache = self._continue(
+                    self._params, pcache, jnp.asarray(padded),
+                    jnp.asarray([suf], jnp.int32),
+                    jnp.asarray([S], jnp.int32),
+                    jnp.float32(req.temperature), jnp.int32(req.top_k),
+                    jnp.float32(req.top_p), jnp.int32(req.seed))
+            else:
+                bucket = pow2_bucket(S, self.config.max_seq_len)
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, :S] = req.prompt
+                tok, row_cache = self._prefill(
+                    self._params, jnp.asarray(padded),
+                    jnp.asarray([S], jnp.int32),
+                    jnp.float32(req.temperature),
+                    jnp.int32(req.top_k), jnp.float32(req.top_p),
+                    jnp.int32(req.seed))
             self._cache = self._insert(self._cache, row_cache,
                                        jnp.int32(slot))
         first = int(tok)

@@ -139,6 +139,7 @@ class PredictionServicer:
             "top_k": request.top_k,
             # proto3 default 0.0 means "unset" — no filter
             "top_p": request.top_p or 1.0,
+            "prefix_len": request.prefix_len,
         }
         if request.HasField("eos_id"):
             body["eos_id"] = request.eos_id
@@ -306,13 +307,14 @@ class PredictClient:
 
     def _generate_request(self, model_name, prompt, *, max_new_tokens,
                           true_len, temperature, seed, top_k, top_p,
-                          eos_id, version) -> "pb.GenerateRequest":
+                          eos_id, version,
+                          prefix_len: int = 0) -> "pb.GenerateRequest":
         req = pb.GenerateRequest(
             model_name=model_name, version=version or 0,
             prompt=array_to_tensor(np.asarray(prompt, np.int32)),
             true_len=true_len, max_new_tokens=max_new_tokens,
             temperature=temperature, seed=seed,
-            top_k=top_k, top_p=top_p)
+            top_k=top_k, top_p=top_p, prefix_len=prefix_len)
         if eos_id is not None:
             req.eos_id = eos_id
         return req
@@ -322,12 +324,14 @@ class PredictClient:
                  temperature: float = 0.0, seed: int = 0,
                  top_k: int = 0, top_p: float = 1.0,
                  eos_id: Optional[int] = None,
+                 prefix_len: int = 0,
                  version: Optional[int] = None,
                  timeout: float = 300.0) -> Tuple[np.ndarray, int]:
         resp = self._generate(self._generate_request(
             model_name, prompt, max_new_tokens=max_new_tokens,
             true_len=true_len, temperature=temperature, seed=seed,
-            top_k=top_k, top_p=top_p, eos_id=eos_id, version=version),
+            top_k=top_k, top_p=top_p, eos_id=eos_id, version=version,
+            prefix_len=prefix_len),
             timeout=timeout)
         return tensor_to_array(resp.tokens), resp.model_version
 
@@ -336,6 +340,7 @@ class PredictClient:
                         temperature: float = 0.0, seed: int = 0,
                         top_k: int = 0, top_p: float = 1.0,
                         eos_id: Optional[int] = None,
+                        prefix_len: int = 0,
                         version: Optional[int] = None,
                         timeout: float = 300.0):
         """Yield ``(B,)`` int32 token arrays as decode steps complete."""
@@ -343,7 +348,8 @@ class PredictClient:
                 model_name, prompt, max_new_tokens=max_new_tokens,
                 true_len=true_len, temperature=temperature, seed=seed,
                 top_k=top_k, top_p=top_p, eos_id=eos_id,
-                version=version), timeout=timeout):
+                version=version, prefix_len=prefix_len),
+                timeout=timeout):
             if chunk.done:
                 return
             yield np.asarray(chunk.tokens, np.int32)

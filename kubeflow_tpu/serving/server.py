@@ -123,6 +123,19 @@ def run_generate(model, body: Dict[str, Any], max_batch_size: int, *,
     if not -2**31 <= seed < 2**31:
         # the seed is a traced int32 in the compiled sampler
         return 400, {"error": "seed must fit in int32"}
+    try:
+        prefix_len = int(body.get("prefix_len", 0))
+    except (TypeError, ValueError):
+        return 400, {"error": "prefix_len must be an int"}
+    if prefix_len:
+        if engine is None:
+            return 400, {"error": "prefix_len requires the decode "
+                                  "engine (server started with "
+                                  "decode_slots=0)"}
+        if not 0 < prefix_len < min(row_lens):
+            return 400, {"error": f"prefix_len {prefix_len} must be in "
+                                  f"(0, shortest prompt row "
+                                  f"{min(row_lens)})"}
     eos_id = body.get("eos_id")
     if eos_id is not None:
         try:
@@ -160,7 +173,8 @@ def run_generate(model, body: Dict[str, Any], max_batch_size: int, *,
         return _run_generate_engine(
             engine, arr, row_lens, max_new=max_new, ctx=ctx,
             temperature=temperature, top_k=top_k, top_p=top_p,
-            seed=seed, eos_id=eos_id, stream=stream,
+            seed=seed, eos_id=eos_id, prefix_len=prefix_len,
+            stream=stream,
             model_name=model_name, model_version=model.version)
 
     # prompt bucket: one compiled prefill per bucket, capped at the
@@ -258,7 +272,7 @@ def parse_serving_mesh(raw: Optional[str]):
 
 def _run_generate_engine(engine, arr, row_lens, *, max_new, ctx,
                          temperature, top_k, top_p, seed, eos_id,
-                         stream, model_name,
+                         prefix_len, stream, model_name,
                          model_version) -> Tuple[int, Dict[str, Any]]:
     """Engine half of :func:`run_generate`: one engine request per
     prompt row, sharing the decode batch with all other callers."""
@@ -275,7 +289,7 @@ def _run_generate_engine(engine, arr, row_lens, *, max_new, ctx,
                               temperature=temperature, top_k=top_k,
                               top_p=top_p,
                               seed=int((np.int64(seed) + i) & 0x7FFFFFFF),
-                              eos_id=eos_id)
+                              eos_id=eos_id, prefix_len=prefix_len)
                 for i in range(arr.shape[0])]
     except ValueError as e:
         return 400, {"error": str(e)}

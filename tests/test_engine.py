@@ -373,3 +373,124 @@ def test_parse_serving_mesh_validation():
         parse_serving_mesh("tp=abc")
     with pytest.raises(ValueError, match="repeats"):
         parse_serving_mesh("tp=2,tp=4")
+
+
+def test_prefix_cache_matches_full_prefill(lm):
+    """prefix_len requests must be token-identical to full prefill —
+    hit and miss paths both — and the store must actually be hit."""
+    config, params = lm
+    eng = DecodeEngine(config, params, slots=2, autostart=False)
+    sys_prompt = [7, 3, 19, 4]
+    p1 = sys_prompt + [5, 11]
+    p2 = sys_prompt + [9, 23, 2]
+    want1 = _oracle(config, params, p1, 5)
+    want2 = _oracle(config, params, p2, 5)
+
+    r1 = eng.submit(p1, max_new=5, prefix_len=4)  # miss
+    for _ in range(8):
+        eng.run_once(timeout=0.01)
+    r2 = eng.submit(p2, max_new=5, prefix_len=4)  # hit
+    for _ in range(8):
+        eng.run_once(timeout=0.01)
+    assert r1.result() == want1
+    assert r2.result() == want2
+    assert eng.prefix_misses == 1 and eng.prefix_hits == 1
+
+    # a stored prefix row is immutable: re-serving the FIRST prompt
+    # after the second's continuation must still be exact
+    r3 = eng.submit(p1, max_new=5, prefix_len=4)
+    for _ in range(8):
+        eng.run_once(timeout=0.01)
+    assert r3.result() == want1
+    assert eng.prefix_hits == 2
+
+
+def test_prefix_cache_sampled_reproducibility(lm):
+    """Sampling through the prefix path must equal the full-prefill
+    path for the same seed (same logits, same fold_in(seed, 0))."""
+    config, params = lm
+    eng = DecodeEngine(config, params, slots=2, autostart=False)
+    p = [7, 3, 19, 4, 5, 11]
+    a = eng.submit(p, max_new=6, temperature=0.9, seed=5)
+    for _ in range(8):
+        eng.run_once(timeout=0.01)
+    b = eng.submit(p, max_new=6, temperature=0.9, seed=5, prefix_len=4)
+    for _ in range(8):
+        eng.run_once(timeout=0.01)
+    assert a.result() == b.result()
+
+
+def test_prefix_cache_eviction_and_validation(lm):
+    config, params = lm
+    eng = DecodeEngine(config, params, slots=2, prefix_cache_entries=2,
+                       autostart=False)
+    for i in range(3):  # 3 distinct prefixes, cap 2 → first evicted
+        r = eng.submit([10 + i, 3, 19, 4, 5], max_new=2, prefix_len=4)
+        for _ in range(4):
+            eng.run_once(timeout=0.01)
+        r.result()
+    assert len(eng._prefix_store) == 2
+    r = eng.submit([10, 3, 19, 4, 5], max_new=2, prefix_len=4)  # miss again
+    for _ in range(4):
+        eng.run_once(timeout=0.01)
+    r.result()
+    assert eng.prefix_misses == 4
+    with pytest.raises(ValueError, match="prefix_len"):
+        eng.submit([1, 2, 3], max_new=2, prefix_len=3)  # empty suffix
+    with pytest.raises(ValueError, match="prefix_len"):
+        eng.submit([1, 2, 3], max_new=2, prefix_len=-1)
+
+
+def test_prefix_cache_near_context_end(lm):
+    """Suffix bucket that would overflow the context falls back to the
+    exact length instead of clamp-corrupting the cache write."""
+    config, params = lm  # max_seq_len 48
+    # 47 tokens, prefix 42, suffix 5: pow2(5)=8 and 42+8 > 48, so the
+    # exact-length fallback branch MUST fire (and stay correct)
+    prompt = list(range(1, 48))
+    eng = DecodeEngine(config, params, slots=2, autostart=False)
+    r = eng.submit(prompt, max_new=1, prefix_len=42)
+    for _ in range(4):
+        eng.run_once(timeout=0.01)
+    assert r.result() == _oracle(config, params, prompt, 1)
+    # and the non-overflow case still buckets (different prefix)
+    p2 = list(range(2, 45))  # 43 tokens, prefix 41, suffix 2
+    r2 = eng.submit(p2, max_new=3, prefix_len=41)
+    for _ in range(6):
+        eng.run_once(timeout=0.01)
+    assert r2.result() == _oracle(config, params, p2, 3)
+
+
+def test_server_prefix_len_validation(tmp_path, lm):
+    import http.client
+    import json
+
+    from kubeflow_tpu.serving import (ModelServer, export_model,
+                                      transformer_export_config)
+
+    config, params = lm
+    export_model(str(tmp_path / "lm"), "transformer", params, version=1,
+                 config=transformer_export_config(config))
+    srv = ModelServer(str(tmp_path), port=0, poll_interval_s=3600,
+                      decode_slots=2)
+    port = srv.start()
+
+    def post(body):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        conn.request("POST", "/v1/models/lm:generate", json.dumps(body),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        out = json.loads(resp.read())
+        conn.close()
+        return resp.status, out
+
+    try:
+        code, out = post({"prompt_tokens": [[7, 3, 19, 4, 5, 11]],
+                          "max_new_tokens": 4, "prefix_len": 4})
+        assert code == 200
+        assert out["tokens"][0] == _oracle(config, params,
+                                           [7, 3, 19, 4, 5, 11], 4)
+        code, out = post({"prompt_tokens": [[1, 2]], "prefix_len": 2})
+        assert code == 400 and "prefix_len" in out["error"]
+    finally:
+        srv.stop()
