@@ -24,10 +24,13 @@ Shapes are the whole design:
 
 from __future__ import annotations
 
+import dataclasses
+import functools
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from kubeflow_tpu.models.transformer import Transformer, TransformerConfig
 from kubeflow_tpu.ops.attention import NEG_INF
@@ -79,8 +82,9 @@ def prefill_continue(config: TransformerConfig, params, cache,
     """Extend an existing prefilled cache by a (right-padded) suffix.
 
     The prefix-caching primitive: ``cache`` holds a prompt prefix (its
-    write positions sit at the prefix length — all rows share it, the
-    multi-token apply's contract); ``tokens`` (B, S) is the right-padded
+    write positions sit at the prefix length; rows sharing a start take
+    the contiguous fast path — per-row ragged starts need
+    ``config.ragged_decode``); ``tokens`` (B, S) is the right-padded
     continuation, ``suffix_len`` its true per-row length (scalar or
     (B,)) and ``total_len`` the full prompt length (prefix + suffix).
     Returns (last real token's logits, cache positioned at total_len) —
@@ -248,6 +252,152 @@ def generate(config: TransformerConfig, params, prompt: jnp.ndarray,
         step, (cache, first, rng), None, length=max_new_tokens - 1)
     # scan stacks on axis 0: (T-1, B) -> (B, T-1)
     return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+
+def speculative_generate(config: TransformerConfig, params,
+                         draft_config: TransformerConfig, draft_params,
+                         prompt: jnp.ndarray, *, max_new_tokens: int,
+                         draft_len: int = 4,
+                         true_len: Optional[jnp.ndarray] = None):
+    """Greedy speculative decoding: a small draft model proposes
+    ``draft_len`` tokens per round, the target verifies them in ONE
+    multi-token forward, and every accepted token costs the target
+    1/draft_len of a decode step.
+
+    Output matches ``generate(config, params, prompt, ...)`` token for
+    token (greedy verification accepts a proposal iff it equals the
+    target's argmax) — speculation changes the cost, never the policy.
+    Caveat: the k-token verify and the 1-token step are different XLA
+    programs; under reduced precision (bf16) a near-tie argmax can
+    resolve differently and diverge the tail. Exactness is guaranteed
+    at f32 (the test tier); at bf16 the stream remains a valid greedy
+    stream of the target up to tie-breaks.
+
+    TPU-first detail: the decode cache stores token t at physical slot
+    t (``transformer.py:_decode_attend``), so rejecting draft tokens is
+    a ROLLBACK-BY-RESET — set the per-row write position back to the
+    accepted length and the stale tail is dead weight the next tokens
+    overwrite before attention can see it. No copies, no re-prefill,
+    ragged per-row acceptance for free.
+
+    Returns ``(tokens (B, max_new_tokens) int32, stats)`` with
+    ``stats = {"rounds": R, "draft_tokens": R*draft_len, "accepted":
+    total draft tokens accepted}`` — acceptance/draft_tokens is the
+    acceptance rate that decides whether the draft pays for itself.
+    """
+    B, S = prompt.shape
+    k = int(draft_len)
+    if k < 1:
+        raise ValueError("draft_len must be >= 1")
+    if config.vocab_size != draft_config.vocab_size:
+        raise ValueError("draft and target must share a vocabulary")
+    # each round may advance up to k cache slots past the final output;
+    # the real footprint starts at the TRUE prompt length when known
+    # eagerly (a traced true_len is the caller's contract, like
+    # generate())
+    if true_len is None:
+        start = S
+    elif isinstance(true_len, jax.core.Tracer):
+        start = None
+    else:
+        start = int(jnp.max(jnp.asarray(true_len)))
+    for name, c in (("target", config), ("draft", draft_config)):
+        if start is not None and start + max_new_tokens + k > c.max_seq_len:
+            raise ValueError(
+                f"prompt {start} + max_new_tokens {max_new_tokens} + "
+                f"draft_len {k} exceeds {name} max_seq_len "
+                f"{c.max_seq_len} (speculation needs slack for "
+                "in-flight proposals)")
+
+    t_logits, t_cache = _prefill_jit(config)(params, prompt, true_len)
+    _, d_cache = _prefill_jit(draft_config)(draft_params, prompt,
+                                            true_len)
+    first = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+
+    spec_round = _spec_round_fn(config, draft_config, k)
+    emitted = [[int(first[b])] for b in range(B)]
+    pending = first
+    rounds = accepted_total = 0
+    while min(len(e) for e in emitted) < max_new_tokens:
+        t_cache, d_cache, out, m, pending, n = spec_round(
+            params, draft_params, t_cache, d_cache, pending)
+        out, m, n = np.asarray(out), np.asarray(m), np.asarray(n)
+        rounds += 1
+        accepted_total += int(n.sum())
+        for b in range(B):
+            emitted[b].extend(int(t) for t in out[b, :m[b]])
+    tokens = np.asarray([e[:max_new_tokens] for e in emitted], np.int32)
+    stats = {"rounds": rounds, "draft_tokens": rounds * k,
+             "accepted": accepted_total}
+    return jnp.asarray(tokens), stats
+
+
+@functools.lru_cache(maxsize=32)
+def _prefill_jit(config: TransformerConfig):
+    """Compiled prefill per (config, shape) — cached across calls so a
+    serving loop never re-traces."""
+    return jax.jit(functools.partial(prefill, config))
+
+
+@functools.lru_cache(maxsize=16)
+def _spec_round_fn(config: TransformerConfig,
+                   draft_config: TransformerConfig, k: int):
+    """Compiled propose-verify round, cached per (configs, draft_len) —
+    a fresh closure per generate call would retrace both models every
+    time."""
+    # the verify writes k tokens from PER-ROW ragged positions
+    ragged = dataclasses.replace(config, ragged_decode=True)
+
+    @jax.jit
+    def spec_round(params, draft_params, t_cache, d_cache, pending):
+        B = pending.shape[0]
+
+        def dstep(carry, _):
+            cache, tok = carry
+            logits, cache = decode_step(draft_config, draft_params,
+                                        cache, tok)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (cache, nxt), nxt
+
+        (d_cache2, _), xs = jax.lax.scan(dstep, (d_cache, pending),
+                                         None, length=k)
+        xs = xs.T  # (B, k): proposals x1..xk
+        # verify: the target processes (pending, x1..x_{k-1}) in one
+        # forward; logits[i] is its prediction for position i+1
+        seq = jnp.concatenate([pending[:, None], xs[:, :k - 1]], axis=1)
+        model = _decode_model(ragged)
+        logits, variables = model.apply(
+            {"params": params, "cache": t_cache}, seq, mutable=["cache"])
+        t_cache2 = variables["cache"]
+        preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, k)
+        match = xs == preds
+        # accepted = length of the all-True prefix
+        n = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+        idx = jnp.arange(k)[None, :]
+        rows = jnp.arange(B)
+        correction = preds[rows, jnp.minimum(n, k - 1)]
+        out = jnp.where(idx < n[:, None], xs, 0)
+        # at index n the target's own token replaces the rejected one
+        out = jnp.where(idx == n[:, None], correction[:, None], out)
+        m = jnp.where(n < k, n + 1, k)  # emitted this round, per row
+        new_pending = jnp.where(n < k, correction, xs[:, k - 1])
+        # rollback-by-reset: the verify advanced every row k slots, but
+        # only (pending, x1..x_n) are valid — n+1 entries on rejection
+        # rounds, all k on full acceptance (x_k was proposed, never
+        # written). Pull each row back by the overshoot.
+        delta = jnp.maximum(k - n - 1, 0)
+
+        def reset(path, leaf):
+            if path[-1].key != "positions":
+                return leaf
+            return (leaf - jnp.broadcast_to(delta, leaf.shape)
+                    ).astype(leaf.dtype)
+
+        t_cache2 = jax.tree_util.tree_map_with_path(reset, t_cache2)
+        d_cache2 = jax.tree_util.tree_map_with_path(reset, d_cache2)
+        return t_cache2, d_cache2, out, m, new_pending, n
+
+    return spec_round
 
 
 def make_generate(config: TransformerConfig, *, max_new_tokens: int,

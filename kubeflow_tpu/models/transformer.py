@@ -67,6 +67,10 @@ class TransformerConfig:
     causal: bool = True           # False => bidirectional (encoder/BERT)
     seq_axis: str = "tp"          # mesh axis ring attention shards sequence over
     rules: AxisRules = DEFAULT_RULES  # logical-axis -> mesh-axis sharding rules
+    # decode mode only: multi-token applies write from PER-ROW start
+    # positions (speculative verification, ragged continuation) instead
+    # of the contiguous shared-start prefill fast path
+    ragged_decode: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -184,11 +188,13 @@ class Attention(nn.Module):
         under one jit with no data-dependent shapes (one compiled prefill
         per prompt bucket, one compiled step).
 
-        - prefill (S > 1): all rows start at position 0, one dynamic
-          slice write; the caller then resets positions to each row's
-          true length (see :func:`kubeflow_tpu.models.decode.prefill`) —
-          a row's pad tail is masked (kv_pos > its positions) until the
-          generated tokens overwrite it;
+        - multi-token (S > 1): each row writes S tokens from its OWN
+          current position (fresh prefill: 0; prefix continuation and
+          speculative verification: ragged per-row starts); the caller
+          then resets positions to each row's true length (see
+          :func:`kubeflow_tpu.models.decode.prefill`) — a row's pad
+          tail is masked (kv_pos > its positions) until the generated
+          tokens overwrite it;
         - step (S == 1): per-row scatter write + per-row rope position.
         """
         c = self.config
@@ -217,8 +223,24 @@ class Attention(nn.Module):
             ck.value = ck.value.at[rows, pos].set(k[:, 0])
             cv.value = cv.value.at[rows, pos].set(v[:, 0])
             q_pos = pos[:, None]  # (B, 1)
+        elif c.ragged_decode:
+            # multi-token with per-row starts (speculative verify,
+            # ragged prefix continuation): per-row rope gather + one
+            # batched scatter. Statically selected — the common
+            # shared-start prefill keeps its contiguous slice-update.
+            q_pos = pos[:, None] + jnp.arange(S)[None, :]  # (B, S)
+            sin = jnp.take(sin_full, q_pos, axis=0)[:, :, None, :].astype(
+                q.dtype)
+            cos = jnp.take(cos_full, q_pos, axis=0)[:, :, None, :].astype(
+                q.dtype)
+            q = _rotate(q, sin, cos)
+            k = _rotate(k, sin, cos)
+            rows2d = jnp.broadcast_to(jnp.arange(B)[:, None], (B, S))
+            ck.value = ck.value.at[rows2d, q_pos].set(k)
+            cv.value = cv.value.at[rows2d, q_pos].set(v)
         else:
-            # prefill: rows share a start (a fresh cache starts at 0)
+            # prefill: rows share a start (a fresh cache starts at 0;
+            # the engine's 1-row prefix continuation shares trivially)
             idx = pos[0]
             sin = jax.lax.dynamic_slice_in_dim(sin_full, idx, S, 0)
             cos = jax.lax.dynamic_slice_in_dim(cos_full, idx, S, 0)

@@ -1,0 +1,86 @@
+"""Greedy speculative decoding: the output must be the target model's
+greedy stream EXACTLY — speculation changes the cost, never the text.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import Transformer, TransformerConfig
+from kubeflow_tpu.models.decode import generate, speculative_generate
+
+
+def _mk(seed, **kw):
+    base = dict(vocab_size=61, d_model=32, n_layers=2, n_heads=4,
+                n_kv_heads=2, d_ff=64, max_seq_len=64,
+                dtype=jnp.float32, remat=False)
+    base.update(kw)
+    config = TransformerConfig(**base)
+    params = Transformer(config).init(
+        jax.random.key(seed), np.zeros((1, 8), np.int32))["params"]
+    return config, params
+
+
+@pytest.fixture(scope="module")
+def models():
+    target = _mk(0)
+    draft = _mk(1, d_model=16, n_layers=1, n_heads=2, d_ff=32)
+    return target, draft
+
+
+def test_matches_target_greedy_exactly(models):
+    (tc, tp), (dc, dp) = models
+    prompt = jnp.asarray([[5, 11, 17, 3]], jnp.int32)
+    want = np.asarray(generate(tc, tp, prompt, max_new_tokens=12))
+    for k in (1, 2, 4, 7):
+        got, stats = speculative_generate(
+            tc, tp, dc, dp, prompt, max_new_tokens=12, draft_len=k)
+        np.testing.assert_array_equal(np.asarray(got), want), k
+        assert stats["rounds"] >= 1
+        assert 0 <= stats["accepted"] <= stats["draft_tokens"]
+
+
+def test_ragged_batch_matches_per_row(models):
+    """Per-row acceptance: each batch row must equal its solo greedy
+    decode even though rows accept different proposal counts."""
+    (tc, tp), (dc, dp) = models
+    prompts = [[5, 11, 17], [9, 2], [40, 41, 42, 43]]
+    width = max(len(p) for p in prompts)
+    arr = np.zeros((3, width), np.int32)
+    lens = np.asarray([len(p) for p in prompts], np.int32)
+    for i, p in enumerate(prompts):
+        arr[i, :len(p)] = p
+    got, _ = speculative_generate(
+        tc, tp, dc, dp, jnp.asarray(arr), max_new_tokens=10,
+        draft_len=3, true_len=jnp.asarray(lens))
+    for i, p in enumerate(prompts):
+        want = np.asarray(generate(
+            tc, tp, jnp.asarray([p], jnp.int32), max_new_tokens=10))[0]
+        np.testing.assert_array_equal(np.asarray(got)[i], want)
+
+
+def test_perfect_draft_accepts_everything(models):
+    """Draft == target: every proposal is the target's own argmax, so
+    acceptance must be 100% and rounds ~ max_new/draft_len."""
+    (tc, tp), _ = models
+    prompt = jnp.asarray([[5, 11, 17, 3]], jnp.int32)
+    got, stats = speculative_generate(
+        tc, tp, tc, tp, prompt, max_new_tokens=12, draft_len=4)
+    want = np.asarray(generate(tc, tp, prompt, max_new_tokens=12))
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert stats["accepted"] == stats["draft_tokens"]
+    assert stats["rounds"] == 3  # 12 tokens = 1 prefill + ceil(11/4)
+
+
+def test_validates_slack_and_vocab(models):
+    (tc, tp), (dc, dp) = models
+    prompt = jnp.asarray([[1] * 50], jnp.int32)
+    with pytest.raises(ValueError, match="slack"):
+        speculative_generate(tc, tp, dc, dp, prompt,
+                             max_new_tokens=12, draft_len=4)
+    other_dc, other_dp = _mk(2, vocab_size=37)
+    with pytest.raises(ValueError, match="vocabulary"):
+        speculative_generate(tc, tp, other_dc, other_dp,
+                             jnp.asarray([[1, 2]], jnp.int32),
+                             max_new_tokens=4)
