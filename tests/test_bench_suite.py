@@ -155,6 +155,15 @@ def test_run_all_isolated_preflight_skips_everything(monkeypatch):
     """A transport already wedged by an earlier session must not burn
     the first config's full timeout either."""
     monkeypatch.setattr(suite, "_device_alive", lambda timeout_s=60.0: False)
-    out = suite.run_all_isolated(only=["mnist", "resnet50"], timeout_s=60.0)
-    assert all("unreachable at bench start" in v["error"]
+    probes = []
+    monkeypatch.setattr(suite.time, "sleep", lambda s: probes.append(s))
+    out = suite.run_all_isolated(only=["mnist", "resnet50"],
+                                 timeout_s=60.0, probe_retries=3,
+                                 probe_wait_s=0.01)
+    assert all("unreachable at bench start (3 probes)" in v["error"]
                for v in out.values())
+    assert probes == [0.01, 0.01]  # retried with spacing, then gave up
+    # retries <= 0 still probes once and reports the real count
+    out = suite.run_all_isolated(only=["mnist"], timeout_s=60.0,
+                                 probe_retries=0)
+    assert "(1 probes)" in out["mnist"]["error"]
