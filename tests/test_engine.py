@@ -494,3 +494,22 @@ def test_server_prefix_len_validation(tmp_path, lm):
         assert code == 400 and "prefix_len" in out["error"]
     finally:
         srv.stop()
+
+
+def test_engine_with_moe_model():
+    """The engine's prefill/insert/step must handle an MoE transformer
+    (aux-loss collections + expert dispatch under decode mode)."""
+    config = TransformerConfig(vocab_size=61, d_model=32, n_layers=2,
+                               n_heads=4, n_kv_heads=2, d_ff=64,
+                               max_seq_len=32, n_experts=4,
+                               experts_per_token=2,
+                               dtype=jnp.float32, remat=False)
+    params = Transformer(config).init(
+        jax.random.key(0), np.zeros((1, 8), np.int32))["params"]
+    eng = DecodeEngine(config, params, slots=2, autostart=False)
+    r1 = eng.submit([5, 11, 17], max_new=5)
+    r2 = eng.submit([9, 2], max_new=4)
+    for _ in range(8):
+        eng.run_once(timeout=0.01)
+    assert r1.result() == _oracle(config, params, [5, 11, 17], 5)
+    assert r2.result() == _oracle(config, params, [9, 2], 4)
