@@ -23,6 +23,7 @@ controller instead.
 
 from __future__ import annotations
 
+import http.client
 import json
 import logging
 import os
@@ -207,19 +208,53 @@ class EdgeProxy:
                 req = urllib.request.Request(target, data=body,
                                              headers=headers,
                                              method=self.command)
+                headers_sent = False
                 try:
                     with urllib.request.urlopen(req, timeout=120) as resp:
-                        data = resp.read()
                         self.send_response(resp.status)
+                        clen = resp.headers.get("Content-Length")
                         for k, v in resp.headers.items():
                             if k.lower() not in _HOP_BY_HOP and \
                                     k.lower() != "content-length":
                                 self.send_header(k, v)
-                        self.send_header("Content-Length", str(len(data)))
-                        self.end_headers()
-                        self.wfile.write(data)
+                        bodiless = (resp.status in (204, 304)
+                                    or self.command == "HEAD")
+                        if bodiless:
+                            # chunked framing is forbidden on 204/304;
+                            # a stray terminator would desync keep-alive
+                            self.end_headers()
+                            headers_sent = True
+                        elif clen is not None:
+                            # sized upstream: stream through verbatim
+                            self.send_header("Content-Length", clen)
+                            self.end_headers()
+                            headers_sent = True
+                            while True:
+                                block = resp.read(1 << 16)
+                                if not block:
+                                    break
+                                self.wfile.write(block)
+                        else:
+                            # chunked upstream (streamed :generate):
+                            # re-chunk AS DATA ARRIVES — buffering here
+                            # would undo the server's token streaming
+                            self.send_header("Transfer-Encoding",
+                                             "chunked")
+                            self.end_headers()
+                            headers_sent = True
+                            while True:
+                                block = resp.read1(1 << 16)
+                                if not block:
+                                    break
+                                self.wfile.write(
+                                    f"{len(block):x}\r\n".encode() +
+                                    block + b"\r\n")
+                                self.wfile.flush()
+                            self.wfile.write(b"0\r\n\r\n")
                         _proxied.inc(route=route.prefix)
                 except urllib.error.HTTPError as e:
+                    # ordered before OSError (HTTPError subclasses it):
+                    # upstream 4xx/5xx bodies forward as-is
                     data = e.read()
                     self.send_response(e.code)
                     self.send_header("Content-Type",
@@ -228,7 +263,17 @@ class EdgeProxy:
                     self.send_header("Content-Length", str(len(data)))
                     self.end_headers()
                     self.wfile.write(data)
-                except OSError as e:
+                except (OSError, http.client.HTTPException) as e:
+                    if headers_sent:
+                        # mid-stream upstream death (reset, truncation —
+                        # IncompleteRead is an HTTPException): the status
+                        # line is long gone, so abort the connection
+                        # instead of corrupting the body with a second
+                        # response
+                        log.warning("upstream %s died mid-stream: %s",
+                                    route.target, e)
+                        self.close_connection = True
+                        return
                     self._send(502, json.dumps(
                         {"error": f"upstream {route.target}: {e}"}).encode())
 

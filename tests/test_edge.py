@@ -454,3 +454,102 @@ def test_gateway_component_iap_manifests():
     assert ing["metadata"]["annotations"][
         "networking.gke.io/managed-certificates"] == "kftpu-ingressgateway"
     assert cert["spec"]["domains"] == ["kf.example.com"]
+
+
+def test_chunked_streaming_through_edge():
+    """A chunked upstream (the model server's streamed :generate) must
+    arrive INCREMENTALLY through the edge — the first chunk reaches the
+    client while the upstream is still producing (VERDICT r3 #2's
+    streaming surface must survive the gateway)."""
+    import http.client
+    import http.server
+    import threading
+    import time
+
+    produced = {"last_emit": None}
+
+    class SlowChunky(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_GET(self):  # noqa: N802
+            self.send_response(200)
+            self.send_header("Content-Type", "application/jsonlines")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            for i in range(3):
+                line = f'{{"tokens": [{i}]}}\n'.encode()
+                self.wfile.write(f"{len(line):x}\r\n".encode() + line +
+                                 b"\r\n")
+                self.wfile.flush()
+                time.sleep(0.4)
+            produced["last_emit"] = time.monotonic()
+            self.wfile.write(b"0\r\n\r\n")
+
+        def log_message(self, *a):
+            pass
+
+    upstream = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                               SlowChunky)
+    threading.Thread(target=upstream.serve_forever, daemon=True).start()
+    proxy = EdgeProxy(
+        [Route("/serving/", f"http://127.0.0.1:"
+               f"{upstream.server_address[1]}")])
+    port = proxy.start(0)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("GET", "/serving/v1/models/lm:generate")
+        resp = conn.getresponse()
+        first = resp.read1(4096)
+        t_first = time.monotonic()
+        rest = resp.read()
+        conn.close()
+        assert resp.status == 200
+        body = (first + rest).decode()
+        assert body.splitlines() == ['{"tokens": [0]}', '{"tokens": [1]}',
+                                     '{"tokens": [2]}']
+        # the first chunk arrived BEFORE the upstream finished emitting
+        assert produced["last_emit"] is not None
+        assert t_first < produced["last_emit"], (
+            "edge buffered the stream instead of forwarding chunks")
+    finally:
+        proxy.stop()
+        upstream.shutdown()
+
+
+def test_bodiless_204_through_edge():
+    """204 responses must not grow chunked framing (forbidden by RFC
+    7230 §3.3.1 and a keep-alive desync if the terminator leaks)."""
+    import http.client
+    import http.server
+    import threading
+
+    class NoContent(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_GET(self):  # noqa: N802
+            self.send_response(204)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    upstream = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                               NoContent)
+    threading.Thread(target=upstream.serve_forever, daemon=True).start()
+    proxy = EdgeProxy(
+        [Route("/x/", f"http://127.0.0.1:{upstream.server_address[1]}")])
+    port = proxy.start(0)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("GET", "/x/thing")
+        resp = conn.getresponse()
+        assert resp.status == 204
+        assert resp.getheader("Transfer-Encoding") is None
+        assert resp.read() == b""
+        # keep-alive connection stays usable (no stray terminator bytes)
+        conn.request("GET", "/x/thing")
+        assert conn.getresponse().status == 204
+        conn.close()
+    finally:
+        proxy.stop()
+        upstream.shutdown()
