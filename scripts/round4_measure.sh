@@ -1,0 +1,23 @@
+#!/bin/bash
+# Round-4 measurement runbook — run the moment the device transport
+# answers (probe first: timeout 60 python -c "import jax; print(jax.devices())").
+# Produces: bench JSON (all 8 configs), traces/r04/*, act-compress A/B.
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== probe =="
+timeout 90 python -c "import jax; print(jax.devices())" || exit 1
+
+echo "== full bench + traces =="
+python bench.py --profile traces/r04 | tee /tmp/bench_r04.json
+
+echo "== act-compress A/B (resnet50 only) =="
+KFTPU_RESNET_ACT_COMPRESS=1 python -m kubeflow_tpu.bench.suite resnet50 \
+  | tee /tmp/resnet_actcompress.json
+
+echo "== trace tables =="
+for d in traces/r04/*/; do
+  echo "--- $d"; python -m kubeflow_tpu.cli trace-top "$d" --top 12 || true
+done
+
+echo "Done. Commit traces/r04 + update PERF.md with the numbers."
