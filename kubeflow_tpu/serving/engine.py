@@ -29,8 +29,13 @@ single-token step over all of them, forever:
 
 Static shapes everywhere: the engine batch is fixed at ``slots``, idle
 rows decode garbage that nothing reads (their writes land in rows the
-next insert overwrites), and XLA compiles exactly three programs per
-model — prefill (per prompt bucket), insert, step.
+next insert overwrites), and the compiled-program inventory is small
+and bounded: prefill (per prompt bucket), insert, the general sampled
+step, the all-greedy argmax step (dispatched whenever no in-flight
+request samples — it skips the per-row sampler entirely), and the
+prefix-continuation (per suffix bucket). ``precompile=True`` builds
+both step programs up front so a mid-serving workload shift never
+pauses co-tenants on an XLA compile.
 """
 
 from __future__ import annotations
@@ -152,6 +157,7 @@ class DecodeEngine:
     def __init__(self, config, params, *, slots: int = 8,
                  steps_per_sync: int = 1, mesh=None,
                  prefix_cache_entries: int = 4,
+                 precompile: bool = False,
                  autostart: bool = True, name: str = "") -> None:
         self.config = config
         self.slots = slots
@@ -248,7 +254,24 @@ class DecodeEngine:
                 body, (cache, tokens), jnp.arange(K))
             return cache, toks
 
+        def _step_greedy(params, cache, tokens):
+            """The all-greedy fast path: no per-row sampler, no vocab
+            sort — argmax only. Dispatched when every in-flight request
+            is greedy (the host knows each slot's sampling params), the
+            common serving load and the bench configuration."""
+
+            def body(carry, _):
+                cache, tokens = carry
+                logits, cache = decode_step(config, params, cache, tokens)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (cache, nxt), nxt
+
+            (cache, _), toks = jax.lax.scan(
+                body, (cache, tokens), None, length=K)
+            return cache, toks
+
         self._step = jax.jit(_step, donate_argnums=(1,))
+        self._step_greedy = jax.jit(_step_greedy, donate_argnums=(1,))
         self._prefill = _prefill_and_sample
 
         # engine cache: the decode cache shape at batch = slots, zeroed.
@@ -303,8 +326,27 @@ class DecodeEngine:
         self._topp = np.ones((slots,), np.float32)
         self.steps_total = 0
         self.tokens_total = 0
+        self.greedy_steps = 0  # steps served by the argmax fast path
+        if precompile:
+            self._precompile_steps()
         if autostart:
             self.start()
+
+    def _precompile_steps(self) -> None:
+        """Run BOTH step programs once on the empty batch so the
+        greedy↔sampled dispatch switch never stalls in-flight streams
+        on a mid-serving XLA compile. Every slot is idle, so the junk
+        tokens land in rows the next insert fully overwrites."""
+        B = self.slots
+        toks = jnp.zeros((B,), jnp.int32)
+        vec_i = jnp.zeros((B,), jnp.int32)
+        ones_f = jnp.ones((B,), jnp.float32)
+        with self._mesh_ctx():
+            self._cache, _ = self._step_greedy(
+                self._params, self._cache, toks)
+            self._cache, _ = self._step(
+                self._params, self._cache, toks, vec_i, vec_i, ones_f,
+                vec_i, ones_f)
 
     # -- public API --------------------------------------------------------
 
@@ -469,15 +511,25 @@ class DecodeEngine:
                       if s is not None]
         if not active:
             return worked
+        # greedy rows ignore seeds/filters entirely, so when EVERY
+        # active slot is greedy the cheap argmax step is bit-identical
+        # — and skips the per-row sampler (vocab sort) each token
+        all_greedy = all(s.req.temperature <= 0.0 for _, s in active)
         with self._mesh_ctx():
-            self._cache, toks = self._step(
-                self._params, self._cache, jnp.asarray(self._tokens),
-                jnp.asarray(self._seeds), jnp.asarray(self._stepidx),
-                jnp.asarray(self._temps), jnp.asarray(self._topk),
-                jnp.asarray(self._topp))
+            if all_greedy:
+                self._cache, toks = self._step_greedy(
+                    self._params, self._cache, jnp.asarray(self._tokens))
+            else:
+                self._cache, toks = self._step(
+                    self._params, self._cache, jnp.asarray(self._tokens),
+                    jnp.asarray(self._seeds), jnp.asarray(self._stepidx),
+                    jnp.asarray(self._temps), jnp.asarray(self._topk),
+                    jnp.asarray(self._topp))
         toks = np.asarray(toks)  # (K, B)
         K = toks.shape[0]
         self.steps_total += K
+        if all_greedy:
+            self.greedy_steps += K
         _steps_total.inc(K, model=self.name)
         self._stepidx += K
         self._tokens = toks[-1].copy()

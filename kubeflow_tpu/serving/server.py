@@ -434,6 +434,9 @@ class ModelRepository:
                                slots=self.decode_slots,
                                steps_per_sync=self.decode_steps_per_sync,
                                mesh=self.decode_mesh,
+                               # same opt-in as predict bucket warmup:
+                               # compile both step programs up front
+                               precompile=bool(self.warmup_batches),
                                name=name)
             with self._lock:
                 if not allowed_locked():

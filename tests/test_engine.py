@@ -513,3 +513,41 @@ def test_engine_with_moe_model():
         eng.run_once(timeout=0.01)
     assert r1.result() == _oracle(config, params, [5, 11, 17], 5)
     assert r2.result() == _oracle(config, params, [9, 2], 4)
+
+
+def test_greedy_fast_path_dispatch(lm):
+    """All-greedy batches take the argmax step (no per-row sampler);
+    a sampled co-tenant switches to the general step, and the greedy
+    request's tokens are identical either way."""
+    config, params = lm
+    want = _oracle(config, params, [5, 11, 17], 8)
+    eng = DecodeEngine(config, params, slots=4, autostart=False)
+    g = eng.submit([5, 11, 17], max_new=8)
+    for _ in range(10):
+        eng.run_once(timeout=0.01)
+    assert g.result() == want
+    assert eng.greedy_steps == eng.steps_total > 0
+
+    eng2 = DecodeEngine(config, params, slots=4, autostart=False)
+    g2 = eng2.submit([5, 11, 17], max_new=8)
+    s2 = eng2.submit([9, 2], max_new=8, temperature=0.9, seed=1)
+    for _ in range(12):
+        eng2.run_once(timeout=0.01)
+    assert g2.result() == want          # same tokens on the general path
+    assert len(s2.result()) == 8
+    assert eng2.greedy_steps < eng2.steps_total  # sampler path was used
+
+
+def test_precompile_steps_then_serve(lm):
+    """precompile=True warms both step programs on the empty batch and
+    serving afterwards is still oracle-exact (the junk rows are fully
+    overwritten at admission)."""
+    config, params = lm
+    eng = DecodeEngine(config, params, slots=2, precompile=True,
+                       autostart=False)
+    r = eng.submit([5, 11, 17], max_new=6)
+    s = eng.submit([9, 2], max_new=6, temperature=0.8, seed=4)
+    for _ in range(10):
+        eng.run_once(timeout=0.01)
+    assert r.result() == _oracle(config, params, [5, 11, 17], 6)
+    assert len(s.result()) == 6
