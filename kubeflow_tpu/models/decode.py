@@ -117,7 +117,8 @@ def decode_step(config: TransformerConfig, params, cache,
 
 
 def sample_logits(logits: jnp.ndarray, rng: jax.Array, *,
-                  temperature=1.0, top_k=0, top_p=1.0) -> jnp.ndarray:
+                  temperature=1.0, top_k=0, top_p=1.0,
+                  bound: Optional[int] = None) -> jnp.ndarray:
     """Sample token ids from ``(B, V)`` logits — the serving sampler.
 
     Every parameter may be a Python scalar or a ``(B,)`` array, so ONE
@@ -132,6 +133,24 @@ def sample_logits(logits: jnp.ndarray, rng: jax.Array, *,
     Filters compose HF-style: temperature, then top-k, then top-p.
     Fully jittable: one descending sort of the vocab axis drives both
     filters (threshold-based, static shapes, no boolean gather).
+
+    ``bound`` (a STATIC int) selects the bounded TPU-fast path: only the
+    top-``bound`` logits per row are extracted with ``lax.top_k`` — no
+    full-vocab sort, no (B, V) sorted materialization (at engine batch
+    32 the sort is 32 vocab sorts per token). Semantics under the bound:
+
+    - top-k is exact for ``k <= bound``; larger k clamps to ``bound``
+      (the serving cap — public APIs cap top_k the same way);
+    - top-p nucleus masses are EXACT (the softmax denominator is a
+      full-vocab logsumexp — no sort needed), but a flat distribution
+      whose nucleus overflows ``bound`` candidates truncates to the
+      bound's top tokens;
+    - ``k <= 0`` with ``p >= 1`` rows are unfiltered — exact full-vocab
+      categorical; ``temperature <= 0`` rows are exact argmax.
+
+    Bounded and unbounded paths draw different (identically
+    distributed) samples for the same key — switching the engine's
+    sampler changes sampled streams, like any sampler upgrade.
     """
     B, V = logits.shape
     logits = logits.astype(jnp.float32)
@@ -141,6 +160,34 @@ def sample_logits(logits: jnp.ndarray, rng: jax.Array, *,
 
     greedy_row = temp <= 0.0
     scaled = logits / jnp.where(greedy_row, 1.0, temp)[:, None]
+
+    if bound is not None and int(bound) > 0 and int(bound) < V:
+        M = int(bound)
+        topv, topi = jax.lax.top_k(scaled, M)  # (B, M) descending
+        k_eff = jnp.where(k <= 0, M, jnp.minimum(k, M))
+        pos = jnp.arange(M)[None, :]
+        kmask = pos < k_eff[:, None]
+        # compose parity with the sort path: top-p renormalizes over
+        # the k-filtered distribution — over the FULL vocab when no k
+        # filter is set (exact via logsumexp), over the kept top-k
+        # candidates otherwise
+        full_lse = jax.scipy.special.logsumexp(scaled, axis=-1)
+        k_lse = jax.scipy.special.logsumexp(
+            jnp.where(kmask, topv, NEG_INF), axis=-1)
+        denom = jnp.where(k <= 0, full_lse, k_lse)
+        probs = jnp.exp(topv - denom[:, None]) * kmask
+        before = jnp.cumsum(probs, axis=-1) - probs
+        keep = kmask & ((before < p[:, None]) | (p[:, None] >= 1.0))
+        rng_m, rng_v = jax.random.split(rng)
+        choice = jax.random.categorical(
+            rng_m, jnp.where(keep, topv, NEG_INF), axis=-1)
+        bounded_tok = jnp.take_along_axis(
+            topi, choice[:, None], axis=-1)[:, 0]
+        unfiltered = (k <= 0) & (p >= 1.0)
+        full_tok = jax.random.categorical(rng_v, scaled, axis=-1)
+        out = jnp.where(greedy_row, jnp.argmax(logits, axis=-1),
+                        jnp.where(unfiltered, full_tok, bounded_tok))
+        return out.astype(jnp.int32)
 
     srt = jnp.sort(scaled, axis=-1)[:, ::-1]  # (B, V) descending
     # top-k: per-row threshold at the k-th largest (k<=0 → keep all)
@@ -155,7 +202,9 @@ def sample_logits(logits: jnp.ndarray, rng: jax.Array, *,
                            srt, NEG_INF)
     probs = jax.nn.softmax(srt_masked, axis=-1)
     before = jnp.cumsum(probs, axis=-1) - probs
-    kept_sorted = before < p[:, None]
+    # p >= 1.0 must be a strict no-op: f32 cumsum rounding can push
+    # `before` to exactly 1.0 for tail tokens, which `< p` would mask
+    kept_sorted = (before < p[:, None]) | (p[:, None] >= 1.0)
     # smallest kept sorted logit = the acceptance threshold
     n_kept = jnp.sum(kept_sorted, axis=-1)  # >= 1
     p_thresh = jnp.take_along_axis(srt, (n_kept - 1)[:, None], axis=-1)
@@ -318,6 +367,15 @@ def speculative_generate(config: TransformerConfig, params,
     emitted = [[int(first[b])] for b in range(B)]
     pending = first
     rounds = accepted_total = 0
+    # Ragged batches (B>1): a fast row keeps decoding past
+    # max_new_tokens while slow rows catch up; its overshoot tokens are
+    # sliced off below and its cache writes past max_seq_len are
+    # DROPPED by jnp scatter out-of-bounds semantics (`.at[pos].set`
+    # drops OOB writes — the same invariant the decode engine's idle
+    # slots rely on). The kept tokens never depend on an OOB write: a
+    # row's first max_new_tokens are all produced from in-bounds cache
+    # state (guaranteed by the max_seq_len slack check above), so the
+    # reliance is confined to the discarded tail.
     while min(len(e) for e in emitted) < max_new_tokens:
         t_cache, d_cache, out, m, pending, n = spec_round(
             params, draft_params, t_cache, d_cache, pending)

@@ -43,6 +43,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import logging
+import os
 import queue
 import threading
 from typing import Any, List, Optional
@@ -73,6 +74,12 @@ _prefix_hits = DEFAULT_REGISTRY.counter(
     "kftpu_engine_prefix_hits_total", "prefix-cache hits at admission")
 _prefix_misses = DEFAULT_REGISTRY.counter(
     "kftpu_engine_prefix_misses_total", "prefix-cache misses at admission")
+_prefix_bytes_g = DEFAULT_REGISTRY.gauge(
+    "kftpu_engine_prefix_cache_bytes",
+    "HBM bytes held by cached prompt-prefix KV rows")
+_prefix_budget_g = DEFAULT_REGISTRY.gauge(
+    "kftpu_engine_prefix_cache_budget_bytes",
+    "prefix-cache byte budget (entries evict LRU to stay under it)")
 
 _END = object()  # per-request stream sentinel
 
@@ -157,10 +164,20 @@ class DecodeEngine:
     def __init__(self, config, params, *, slots: int = 8,
                  steps_per_sync: int = 1, mesh=None,
                  prefix_cache_entries: int = 4,
+                 prefix_cache_bytes: Optional[int] = None,
+                 sampler_bound: Optional[int] = None,
                  precompile: bool = False,
                  autostart: bool = True, name: str = "") -> None:
         self.config = config
         self.slots = slots
+        # lax.top_k-bounded sampler (models/decode.py:sample_logits
+        # ``bound``): avoids the per-token full-vocab sort the exact
+        # sampler pays at every sampled step — 0 selects the exact sort
+        # path, None reads KFTPU_SAMPLER_BOUND (default 64)
+        if sampler_bound is None:
+            sampler_bound = int(os.environ.get("KFTPU_SAMPLER_BOUND",
+                                               "64"))
+        self.sampler_bound = int(sampler_bound)
         # multi-chip serving: with a Mesh (params already placed with
         # tensor-parallel shardings, e.g. via models.param_partition_specs)
         # every compiled engine program runs under it, and the model's
@@ -190,6 +207,7 @@ class DecodeEngine:
             self._mesh_ctx = contextlib.nullcontext
 
         Smax = config.max_seq_len
+        bnd = self.sampler_bound if self.sampler_bound > 0 else None
 
         @jax.jit
         def _prefill_and_sample(params, prompt, true_len, temperature,
@@ -197,7 +215,7 @@ class DecodeEngine:
             logits, cache = prefill(config, params, prompt, true_len)
             key = jax.random.fold_in(jax.random.key(seed), 0)
             tok = sample_logits(logits, key, temperature=temperature,
-                                top_k=top_k, top_p=top_p)
+                                top_k=top_k, top_p=top_p, bound=bnd)
             return tok[0], cache
 
         @jax.jit
@@ -208,19 +226,23 @@ class DecodeEngine:
                 config, params, cache, suffix, suffix_len, total_len)
             key = jax.random.fold_in(jax.random.key(seed), 0)
             tok = sample_logits(logits, key, temperature=temperature,
-                                top_k=top_k, top_p=top_p)
+                                top_k=top_k, top_p=top_p, bound=bnd)
             return tok[0], cache
 
         self._continue = _continue_and_sample
         # LRU of prefilled prompt prefixes: (len, token bytes) →
-        # 1-row cache. Entries are full-context rows, so the cap is
-        # deliberately small; _continue never mutates a stored entry
-        # (functional apply, no donation).
-        self._prefix_entries = max(0, int(prefix_cache_entries))
+        # 1-row cache, BYTE-budgeted (every entry is a full-context row,
+        # so the HBM cost scales with max_seq_len × layers — an entry
+        # count hides it from the operator). Budget resolution: the
+        # explicit ``prefix_cache_bytes`` arg, else KFTPU_PREFIX_CACHE_
+        # BYTES, else ``prefix_cache_entries`` × the per-row byte size
+        # (computed below once the cache layout is known). _continue
+        # never mutates a stored entry (functional apply, no donation).
         self._prefix_store: "collections.OrderedDict" = \
             collections.OrderedDict()
         self.prefix_hits = 0
         self.prefix_misses = 0
+        self.prefix_cache_bytes = 0  # bytes currently held
 
         def _insert(engine_cache, row_cache, slot):
             return jax.tree_util.tree_map(
@@ -241,7 +263,7 @@ class DecodeEngine:
             def one(row_logits, seed, idx, t, k, p):
                 key = jax.random.fold_in(jax.random.key(seed), idx)
                 return sample_logits(row_logits[None], key, temperature=t,
-                                     top_k=k, top_p=p)[0]
+                                     top_k=k, top_p=p, bound=bnd)[0]
 
             def body(carry, t):
                 cache, tokens = carry
@@ -279,6 +301,19 @@ class DecodeEngine:
         probe = jnp.zeros((1, 1), jnp.int32)
         shapes = jax.eval_shape(
             lambda p: prefill(config, p, probe)[1], params)
+        # a stored prefix row IS this batch-1 full-context cache — its
+        # byte size anchors the prefix-cache budget
+        self._prefix_row_bytes = int(sum(
+            int(np.prod(s.shape)) * jnp.dtype(s.dtype).itemsize
+            for s in jax.tree_util.tree_leaves(shapes)))
+        if prefix_cache_bytes is None:
+            env = os.environ.get("KFTPU_PREFIX_CACHE_BYTES")
+            prefix_cache_bytes = int(env) if env else None
+        if prefix_cache_bytes is None:
+            prefix_cache_bytes = (max(0, int(prefix_cache_entries))
+                                  * self._prefix_row_bytes)
+        self._prefix_budget_bytes = max(0, int(prefix_cache_bytes))
+        _prefix_budget_g.set(self._prefix_budget_bytes, model=self.name)
 
         def _engine_shape(s):
             return tuple(slots if a == _batch_axis(s) else d
@@ -366,8 +401,10 @@ class DecodeEngine:
             raise ValueError(
                 f"prefix_len {prefix_len} must be in (0, prompt length "
                 f"{prompt.size}) — the suffix may not be empty")
-        if self._prefix_entries == 0:
-            prefix_len = 0  # cache disabled: fall back to full prefill
+        if self._prefix_budget_bytes < self._prefix_row_bytes:
+            # cache disabled, or one full-context row alone would bust
+            # the byte budget: honor it by serving the full prefill
+            prefix_len = 0
         req = _Request(prompt=prompt, max_new=max_new,
                        temperature=float(temperature), top_k=int(top_k),
                        top_p=float(top_p), seed=int(seed), eos_id=eos_id,
@@ -410,6 +447,12 @@ class DecodeEngine:
             req.out.put(_END)
 
     @property
+    def closed(self) -> bool:
+        """True once the engine can no longer serve (explicit close or
+        a step failure that invalidated the donated cache)."""
+        return self._stop.is_set()
+
+    @property
     def active_count(self) -> int:
         with self._lock:
             return sum(s is not None for s in self._active)
@@ -436,9 +479,17 @@ class DecodeEngine:
             self._params, jnp.asarray(padded),
             jnp.asarray([N], jnp.int32), jnp.float32(0.0),
             jnp.int32(0), jnp.float32(1.0), jnp.int32(0))
-        self._prefix_store[key] = pcache
-        while len(self._prefix_store) > self._prefix_entries:
+        # byte-budget admission: evict LRU until the new row fits
+        # (submit() already routed away callers that can never fit)
+        while (self._prefix_store and self.prefix_cache_bytes
+                + self._prefix_row_bytes > self._prefix_budget_bytes):
             self._prefix_store.popitem(last=False)
+            self.prefix_cache_bytes -= self._prefix_row_bytes
+        if (self.prefix_cache_bytes + self._prefix_row_bytes
+                <= self._prefix_budget_bytes):
+            self._prefix_store[key] = pcache
+            self.prefix_cache_bytes += self._prefix_row_bytes
+        _prefix_bytes_g.set(self.prefix_cache_bytes, model=self.name)
         return pcache
 
     def _admit_one(self, req: _Request, slot: int) -> None:
@@ -572,11 +623,24 @@ class DecodeEngine:
             try:
                 self.run_once()
             except Exception:  # noqa: BLE001
-                log.exception("decode engine step failed")
-                # fail every in-flight request rather than hanging clients
+                log.exception("decode engine step failed; closing engine")
+                # the step's donated cache is invalidated — this engine
+                # can never step again. Close it: in-flight AND pending
+                # requests fail with the retryable EngineClosed (503 /
+                # UNAVAILABLE), later submits raise the same, and the
+                # repository evicts closed engines so the next request
+                # builds a fresh one instead of landing here forever.
                 with self._lock:
-                    active = [s for s in self._active if s is not None]
+                    self._stop.set()
+                    failed = [s.req for s in self._active
+                              if s is not None]
                     self._active = [None] * self.slots
-                for s in active:
-                    s.req.error = RuntimeError("decode engine step failed")
-                    s.req.out.put(_END)
+                    while True:
+                        try:
+                            failed.append(self._pending.get_nowait())
+                        except queue.Empty:
+                            break
+                for req in failed:
+                    req.error = EngineClosed("decode engine step failed")
+                    req.out.put(_END)
+                return

@@ -197,6 +197,49 @@ def test_close_fails_inflight_requests(lm):
         req.result()
 
 
+def test_step_failure_self_closes_and_repo_rebuilds(tmp_path, lm):
+    """A step failure invalidates the donated cache, so the engine must
+    self-close (in-flight + pending fail with the retryable
+    EngineClosed) and the repository must evict it so the next request
+    gets a fresh engine instead of a permanent 500 well."""
+    from kubeflow_tpu.serving import (export_model,
+                                      transformer_export_config)
+    from kubeflow_tpu.serving.engine import EngineClosed
+    from kubeflow_tpu.serving.server import ModelRepository
+
+    config, params = lm
+    export_model(str(tmp_path / "lm"), "transformer", params, version=1,
+                 config=transformer_export_config(config))
+    repo = ModelRepository(str(tmp_path), poll_interval_s=3600,
+                           decode_slots=2)
+    model = repo._models["lm"]
+    eng = repo.engine_for("lm", model)
+    assert eng is not None
+
+    def boom(*a, **k):
+        raise RuntimeError("injected step failure")
+
+    eng._step_greedy = boom
+    eng._step = boom
+    req = eng.submit([5, 11], max_new=4)
+    pend = eng.submit([7, 2], max_new=4)  # may land active or pending
+    with pytest.raises(EngineClosed):
+        req.result()
+    with pytest.raises(EngineClosed):
+        pend.result()
+    assert eng.closed
+    with pytest.raises(EngineClosed):
+        eng.submit([3], max_new=2)
+    # the repository replaces the corpse with a working engine
+    eng2 = repo.engine_for("lm", model)
+    assert eng2 is not None and eng2 is not eng and not eng2.closed
+    try:
+        r = eng2.submit([5, 11, 17], max_new=4)
+        assert r.result() == _oracle(config, params, [5, 11, 17], 4)
+    finally:
+        eng2.close()
+
+
 def test_server_integration_engine_path(tmp_path, lm):
     """ModelServer(decode_slots>0): unary + streamed + eos through the
     engine, greedy identical to the non-engine server."""
@@ -439,6 +482,54 @@ def test_prefix_cache_eviction_and_validation(lm):
         eng.submit([1, 2, 3], max_new=2, prefix_len=3)  # empty suffix
     with pytest.raises(ValueError, match="prefix_len"):
         eng.submit([1, 2, 3], max_new=2, prefix_len=-1)
+
+
+def test_prefix_cache_byte_budget(lm):
+    """The cache is budgeted in BYTES (each entry is a full-context KV
+    row): a 1.5-row budget holds exactly one entry and evicts LRU; the
+    held-bytes accounting tracks the store and never exceeds budget."""
+    config, params = lm
+    probe = DecodeEngine(config, params, slots=2, autostart=False)
+    row = probe._prefix_row_bytes
+    assert row > 0
+    eng = DecodeEngine(config, params, slots=2,
+                       prefix_cache_bytes=int(1.5 * row),
+                       autostart=False)
+    assert eng._prefix_budget_bytes == int(1.5 * row)
+    for i in range(3):
+        r = eng.submit([10 + i, 3, 19, 4, 5], max_new=2, prefix_len=4)
+        for _ in range(4):
+            eng.run_once(timeout=0.01)
+        r.result()
+        assert len(eng._prefix_store) == 1           # 2nd row never fits
+        assert eng.prefix_cache_bytes == row
+        assert eng.prefix_cache_bytes <= eng._prefix_budget_bytes
+    assert eng.prefix_misses == 3                    # every new prefix evicts
+    # LRU: the LAST prefix is the survivor
+    r = eng.submit([12, 3, 19, 4, 5], max_new=2, prefix_len=4)
+    for _ in range(4):
+        eng.run_once(timeout=0.01)
+    r.result()
+    assert eng.prefix_hits == 1
+
+
+def test_prefix_cache_entry_larger_than_budget(lm):
+    """When ONE full-context row exceeds the budget the budget wins:
+    nothing is cached, prefix requests are served by full prefill, and
+    output is still exact."""
+    config, params = lm
+    eng = DecodeEngine(config, params, slots=2, prefix_cache_bytes=128,
+                       autostart=False)
+    assert eng._prefix_row_bytes > 128
+    p = [7, 3, 19, 4, 5, 11]
+    want = _oracle(config, params, p, 5)
+    r = eng.submit(p, max_new=5, prefix_len=4)
+    for _ in range(8):
+        eng.run_once(timeout=0.01)
+    assert r.result() == want
+    assert len(eng._prefix_store) == 0
+    assert eng.prefix_cache_bytes == 0
+    assert eng.prefix_hits == 0 and eng.prefix_misses == 0
 
 
 def test_prefix_cache_near_context_end(lm):

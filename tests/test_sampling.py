@@ -57,6 +57,23 @@ def test_top_k_all_kept_matches_plain_sampling():
         assert (np.asarray(got) == np.asarray(plain)).all()
 
 
+def test_top_p_one_is_strict_noop_even_under_cumsum_rounding():
+    """p=1.0 must keep EVERY token: the engine routes all requests
+    through the sampler with traced per-row p, and f32 cumsum rounding
+    over a large vocab can push the before-mass of tail tokens to
+    exactly 1.0 — those must not be masked. Same key + p=1.0 must match
+    the unfiltered categorical bit for bit."""
+    rng = np.random.default_rng(5)
+    # near-uniform large vocab maximises accumulated cumsum error
+    logits = jnp.asarray(rng.normal(scale=1e-3, size=(2, 8192)),
+                         jnp.float32)
+    for key in jax.random.split(jax.random.key(11), 8):
+        plain = jax.random.categorical(key, logits, axis=-1)
+        got = sample_logits(logits, key, temperature=1.0,
+                            top_p=jnp.asarray([1.0, 1.0], jnp.float32))
+        assert (np.asarray(got) == np.asarray(plain)).all()
+
+
 def test_top_p_tiny_keeps_only_top_token():
     logits = jnp.asarray(np.random.default_rng(4).normal(size=(3, 29)),
                          jnp.float32)
@@ -111,6 +128,69 @@ def test_per_row_params_mix_in_one_call():
     assert (out[:, 1] == am[1]).all()          # top-1 row
     top4 = set(np.argsort(-logits_np[2])[:4])
     assert set(out[:, 2]) <= top4              # top-4 row
+
+
+def test_bounded_sampler_support_sets_match_exact_path():
+    """The lax.top_k-bounded sampler (the engine's per-token path —
+    avoids the full-vocab sort) must keep the same support sets as the
+    exact sort path for every filter that fits the bound."""
+    rng = np.random.default_rng(7)
+    logits_np = (3.0 * rng.normal(size=(4, 100))).astype(np.float32)
+    logits = jnp.asarray(logits_np)
+    # top-k support, k within bound
+    out = _draws(logits, 64, temperature=1.0, top_k=5, bound=16)
+    topk = np.argsort(-logits_np, axis=-1)[:, :5]
+    for b in range(4):
+        assert set(out[:, b]) <= set(topk[b])
+    # nucleus support (peaked logits keep it inside the bound)
+    p = 0.7
+    out = _draws(logits, 256, temperature=1.0, top_p=p, bound=16)
+    for b in range(4):
+        srt = np.sort(logits_np[b])[::-1]
+        probs = np.exp(srt - srt.max()); probs /= probs.sum()
+        before = np.cumsum(probs) - probs
+        support = set(np.argsort(-logits_np[b])[:int((before < p).sum())])
+        assert set(out[:, b]) <= support
+    # greedy + per-row mix still exact
+    out = _draws(logits, 32,
+                 temperature=jnp.asarray([0.0, 1.0, 1.0, 1.0]),
+                 top_k=jnp.asarray([0, 1, 3, 0], jnp.int32),
+                 top_p=jnp.asarray([1.0, 1.0, 1.0, 1.0]), bound=16)
+    am = np.argmax(logits_np, -1)
+    assert (out[:, 0] == am[0]).all()
+    assert (out[:, 1] == am[1]).all()
+    top3 = set(np.argsort(-logits_np[2])[:3])
+    assert set(out[:, 2]) <= top3
+
+
+def test_bounded_sampler_unfiltered_rows_are_exact_full_vocab():
+    """k<=0 & p>=1 rows bypass the bound entirely: same distribution as
+    a full-vocab categorical (support may exceed the bound)."""
+    rng = np.random.default_rng(8)
+    # flat logits: any bounded truncation would be visible in support
+    logits = jnp.asarray(rng.normal(scale=0.05, size=(1, 100)),
+                         jnp.float32)
+    out = _draws(logits, 512, temperature=1.0, bound=8)
+    assert len(set(out[:, 0])) > 8, "unfiltered row was truncated"
+
+
+def test_bounded_sampler_clamps_k_to_bound():
+    """top_k above the bound clamps to the bound (the serving cap)."""
+    rng = np.random.default_rng(9)
+    logits_np = rng.normal(size=(1, 60)).astype(np.float32)
+    out = _draws(jnp.asarray(logits_np), 512, temperature=2.0, top_k=50,
+                 bound=8)
+    top8 = set(np.argsort(-logits_np[0])[:8])
+    assert set(out[:, 0]) <= top8
+
+
+def test_bounded_sampler_compose_renormalizes_within_k():
+    """Compose parity with the sort path: top_p applies to the
+    RENORMALISED top-k distribution under the bound too."""
+    logits_np = np.array([[0.0, -0.1, -0.2, -10.0, -10.0]], np.float32)
+    out = _draws(jnp.asarray(logits_np), 128, temperature=1.0,
+                 top_k=3, top_p=0.5, bound=4)
+    assert set(out[:, 0]) == {0, 1}
 
 
 def test_temperature_sharpens():
