@@ -171,6 +171,7 @@ def export_model(
     input_shape: Optional[Tuple[int, ...]] = None,
     input_dtype: str = "float32",
     quantize: bool = False,
+    draft_of: Optional[str] = None,
 ) -> str:
     """Write ``<path>/<version>/{model.yaml,params.npz}``; returns the dir.
 
@@ -184,10 +185,19 @@ def export_model(
     Dequantized to float at load — a storage/transfer optimization with
     a small, bounded numeric delta (weights round to 1/127 of their
     per-channel max), not a changed serving dtype.
+
+    ``draft_of="<model>"`` or ``"<model>@<version>"`` marks this export
+    as a speculative-decoding DRAFT for the named target model (same
+    store): the serving repository pairs it with its target at load and
+    routes ``speculative: true`` generate requests through the pair
+    (``kubeflow_tpu/train/distill.py`` is the recipe that produces
+    drafts; an unversioned pairing follows the target's served version).
     """
     vdir = os.path.join(path, str(version))
     os.makedirs(vdir, exist_ok=True)
     meta: Dict[str, Any] = {"kind": kind, "config": config or {}}
+    if draft_of:
+        meta["draft_of"] = str(draft_of)
     if input_shape is None:
         input_shape = _DEFAULT_INPUT_SHAPES.get(kind)
     if input_shape is not None:
@@ -220,9 +230,15 @@ def export_model(
             flat[key] = arr.astype(np.float32)
     if cast_leaves:
         meta["cast_leaves"] = cast_leaves
-    with open(os.path.join(vdir, MODEL_FILE), "w") as f:
-        yaml.safe_dump(meta, f)
+    # params first, meta last and ATOMICALLY: list_versions keys on
+    # model.yaml's existence, so its rename publishes the version only
+    # once the artifact is complete (and a concurrent draft scan never
+    # reads a half-written yaml)
+    from kubeflow_tpu.workflows.archive import _atomic_write
+
     np.savez(os.path.join(vdir, PARAMS_FILE), **flat)
+    _atomic_write(os.path.join(vdir, MODEL_FILE),
+                  yaml.safe_dump(meta).encode())
     return vdir
 
 
@@ -231,6 +247,18 @@ _DEFAULT_INPUT_SHAPES: Dict[str, Tuple[int, ...]] = {
     "mnist": (28, 28, 1),
     "resnet": (224, 224, 3),
 }
+
+
+@dataclasses.dataclass(frozen=True)
+class DraftPair:
+    """A paired speculative draft. Immutable and swapped through ONE
+    ``LoadedModel.draft`` reference, so request threads snapshot
+    config+params+ref atomically (no torn reads across a repair/detach
+    by the poll thread)."""
+
+    config: Any
+    params: Any
+    ref: str  # "<draft name>@<version>"
 
 
 @dataclasses.dataclass
@@ -254,6 +282,12 @@ class LoadedModel:
     # (kubeflow_tpu/serving/engine.py); None for non-LM kinds
     lm_config: Any = None
     lm_params: Any = None
+    # speculative-decoding pair: a store sibling exporting
+    # ``draft_of: <this model>[@<version>]`` attaches here at load
+    # (ModelRepository._attach_draft) and ``speculative: true`` generate
+    # requests route through models/decode.py:speculative_generate.
+    # One attribute = one atomic swap (see DraftPair).
+    draft: Optional[DraftPair] = None
 
     def warmup(self, batch_sizes) -> int:
         """Precompile predict for each batch bucket; returns count warmed."""
@@ -350,6 +384,34 @@ def load_version(base_path: str, version: int,
         generate=generate, max_seq_len=max_seq_len, vocab_size=vocab_size,
         lm_config=model.config if kind == "transformer" else None,
         lm_params=params if kind == "transformer" else None)
+
+
+def find_draft_for(store_root: str, target_name: str,
+                   target_version: int) -> Optional[Tuple[str, int]]:
+    """The store sibling declaring itself this target's speculative
+    draft: ``model.yaml`` carries ``draft_of: "<target>"`` (follows the
+    target across versions) or ``"<target>@<version>"`` (pinned).
+    Returns ``(draft_name, draft_version)`` — the newest matching
+    version of the first matching model name — or None."""
+    if not os.path.isdir(store_root):
+        return None
+    want = {target_name, f"{target_name}@{target_version}"}
+    for d in sorted(os.listdir(store_root)):
+        mdir = os.path.join(store_root, d)
+        if d == target_name or not os.path.isdir(mdir):
+            continue
+        for v in reversed(list_versions(mdir)):
+            try:
+                with open(os.path.join(mdir, str(v), MODEL_FILE)) as f:
+                    meta = yaml.safe_load(f) or {}
+            except (OSError, yaml.YAMLError):
+                # a mid-write or corrupt sibling must not abort the scan
+                continue
+            if not isinstance(meta, dict):
+                continue
+            if meta.get("draft_of") in want:
+                return d, v
+    return None
 
 
 def load_latest(base_path: str) -> Optional[LoadedModel]:

@@ -46,6 +46,18 @@ _gen_requests = DEFAULT_REGISTRY.counter(
     "kftpu_serving_generate_requests_total", "generate requests")
 _gen_latency = DEFAULT_REGISTRY.gauge(
     "kftpu_serving_generate_last_latency_seconds", "last generate latency")
+_spec_requests = DEFAULT_REGISTRY.counter(
+    "kftpu_serving_speculative_requests_total",
+    "generate requests served through a speculative draft pair")
+_spec_draft_tokens = DEFAULT_REGISTRY.counter(
+    "kftpu_serving_speculative_draft_tokens_total",
+    "draft tokens proposed to the target verifier")
+_spec_accepted_tokens = DEFAULT_REGISTRY.counter(
+    "kftpu_serving_speculative_accepted_tokens_total",
+    "draft tokens the target verifier accepted")
+_spec_rate = DEFAULT_REGISTRY.gauge(
+    "kftpu_serving_speculative_last_acceptance_rate",
+    "acceptance rate (accepted/proposed) of the last speculative request")
 
 _PAD_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
 
@@ -169,6 +181,38 @@ def run_generate(model, body: Dict[str, Any], max_batch_size: int, *,
     true_len = int(lens_arr.max())
     ctx = model.max_seq_len or 0
 
+    if body.get("speculative"):
+        # draft-assisted greedy decoding through the paired draft
+        # (models/decode.py:speculative_generate); bypasses the engine —
+        # speculation optimizes single-stream latency, the engine
+        # optimizes aggregate throughput
+        try:
+            draft_len = int(body.get("draft_len", 4))
+        except (TypeError, ValueError):
+            return 400, {"error": "draft_len must be an int"}
+        if not 1 <= draft_len <= 16:
+            return 400, {"error": "draft_len must be in [1, 16]"}
+        draft = model.draft  # one atomic snapshot (see DraftPair)
+        if draft is None:
+            return 400, {"error": f"model {model_name!r} has no paired "
+                                  "speculative draft (export one with "
+                                  "export_model(..., draft_of=...); see "
+                                  "kubeflow_tpu/train/distill.py)"}
+        if temperature != 0.0:
+            return 400, {"error": "speculative decoding is greedy-only "
+                                  "(temperature must be 0)"}
+        if stream:
+            return 400, {"error": "speculative decoding does not "
+                                  "stream (tokens emit in verified "
+                                  "chunks)"}
+        if eos_id is not None or prefix_len:
+            return 400, {"error": "eos_id/prefix_len require the "
+                                  "engine path; drop 'speculative' to "
+                                  "use them"}
+        return _run_generate_speculative(
+            model, draft, arr, lens_arr, max_new=max_new, ctx=ctx,
+            draft_len=draft_len, model_name=model_name)
+
     if engine is not None:
         return _run_generate_engine(
             engine, arr, row_lens, max_new=max_new, ctx=ctx,
@@ -242,6 +286,62 @@ def run_generate(model, body: Dict[str, Any], max_batch_size: int, *,
     return 200, {"tokens": out.tolist(),
                  "model_version": str(model.version),
                  "tokens_per_sec": round(out.size / dt, 1)}
+
+
+def _run_generate_speculative(model, draft, arr, lens_arr, *, max_new,
+                              ctx, draft_len,
+                              model_name) -> Tuple[int, Dict[str, Any]]:
+    """Speculative half of :func:`run_generate`: the paired draft
+    proposes ``draft_len`` tokens per round, the target verifies them in
+    one multi-token forward. Greedy output matches the plain path token
+    for token (at f32 exactly; at bf16 up to argmax tie-breaks); the
+    response and /metrics carry the acceptance stats that decide whether
+    the draft pays for itself. Batches are served at their exact size
+    (no filler-row padding — filler would contaminate the acceptance
+    rate)."""
+    from kubeflow_tpu.models.decode import speculative_generate
+
+    true_len = int(lens_arr.max())
+    bucket = pow2_bucket(true_len, ctx)
+    if bucket < true_len:
+        return 400, {"error": f"prompt ({true_len}) exceeds the model "
+                              f"context ({ctx})"}
+    padded = np.zeros((arr.shape[0], bucket), np.int32)
+    padded[:, :arr.shape[1]] = arr
+    t0 = time.perf_counter()
+    try:
+        toks, stats = speculative_generate(
+            model.lm_config, model.lm_params,
+            draft.config, draft.params,
+            jnp.asarray(padded), max_new_tokens=max_new,
+            draft_len=draft_len, true_len=jnp.asarray(lens_arr))
+    except ValueError as e:
+        # the context-slack check (prompt + max_new + draft_len must fit
+        # BOTH models) raises eagerly — a request-shape problem
+        return 400, {"error": f"generate failed: {e}"}
+    except Exception as e:  # noqa: BLE001
+        return 500, {"error": f"generate failed: "
+                              f"{type(e).__name__}: {e}"}
+    dt = time.perf_counter() - t0
+    out = np.asarray(toks)
+    rate = stats["accepted"] / max(stats["draft_tokens"], 1)
+    _gen_requests.inc(model=model_name)
+    _gen_latency.set(dt, model=model_name)
+    _spec_requests.inc(model=model_name)
+    _spec_draft_tokens.inc(stats["draft_tokens"], model=model_name)
+    _spec_accepted_tokens.inc(stats["accepted"], model=model_name)
+    _spec_rate.set(rate, model=model_name)
+    return 200, {"tokens": out.tolist(),
+                 "model_version": str(model.version),
+                 "tokens_per_sec": round(out.size / dt, 1),
+                 "speculative": {
+                     "draft": draft.ref,
+                     "draft_len": draft_len,
+                     "rounds": stats["rounds"],
+                     "draft_tokens": stats["draft_tokens"],
+                     "accepted": stats["accepted"],
+                     "acceptance_rate": round(rate, 3),
+                 }}
 
 
 def parse_serving_mesh(raw: Optional[str]):
@@ -391,6 +491,9 @@ class ModelRepository:
         self._models: Dict[str, LoadedModel] = {}
         self._pinned: Dict[Tuple[str, int], LoadedModel] = {}
         self._engines: Dict[Tuple[str, int], Any] = {}
+        # (version, store signature) of the last draft scan per model —
+        # the poll loop skips unchanged stores (see _attach_draft)
+        self._draft_scans: Dict[str, Any] = {}
         self._lock = threading.Lock()
         # engine construction allocates a full KV cache on device —
         # serialize it so racing first-callers can't transiently double
@@ -417,6 +520,11 @@ class ModelRepository:
 
         with self._lock:
             eng = self._engines.get(key)
+            if eng is not None and eng.closed:
+                # a step failure self-closed it (its donated KV cache is
+                # invalid) — evict so a fresh engine replaces it
+                self._engines.pop(key, None)
+                eng = None
             if eng is None and not allowed_locked():
                 return None
         if eng is not None:
@@ -426,7 +534,7 @@ class ModelRepository:
         with self._engine_create_lock:
             with self._lock:
                 eng = self._engines.get(key)  # a racer built it first
-                if eng is not None:
+                if eng is not None and not eng.closed:
                     return eng
             # lm_params were sharded over decode_mesh at LOAD time
             # (load_version), so the engine shares the one in-HBM copy
@@ -442,6 +550,9 @@ class ModelRepository:
                 if not allowed_locked():
                     race = None  # retired while we were building
                 else:
+                    prior = self._engines.get(key)
+                    if prior is not None and prior.closed:
+                        self._engines.pop(key, None)  # evict the corpse
                     race = self._engines.setdefault(key, eng)
         if race is not eng:
             eng.close()
@@ -474,12 +585,19 @@ class ModelRepository:
             with self._lock:
                 current = self._models.get(name)
             if current is not None and current.version == latest:
+                # drafts pair/replace/detach on later polls without a
+                # target version bump (cheap: _attach_draft gates on
+                # the store signature and no-ops when nothing changed)
+                if current.lm_config is not None:
+                    self._attach_draft(name, current)
                 continue
             # load + warm up outside the lock (disk read + jit can take
             # seconds); only the swap is serialized, so predicts never
             # stall on reload
             log.info("loading model %s version %d", name, latest)
             loaded = load_version(mdir, latest, mesh=self.decode_mesh)
+            if loaded.lm_config is not None:
+                self._attach_draft(name, loaded)
             self._warmup(name, loaded)
             with self._lock:
                 self._models[name] = loaded
@@ -494,6 +612,77 @@ class ModelRepository:
                 retired = [self._engines.pop(k) for k in stale]
             for eng in retired:
                 eng.close()
+
+    def _store_signature(self) -> Any:
+        """A cheap change marker for the store: one stat per model dir
+        (a new export touches its model dir's mtime). Lets the poll loop
+        skip the O(models × versions) model.yaml walk of a draft scan
+        when nothing was exported since the last scan."""
+        try:
+            names = sorted(os.listdir(self.base_path))
+            return tuple(
+                (d, os.path.getmtime(os.path.join(self.base_path, d)))
+                for d in names
+                if os.path.isdir(os.path.join(self.base_path, d)))
+        except OSError:
+            return None
+
+    def _attach_draft(self, name: str, loaded: LoadedModel) -> None:
+        """Pair a speculative-decoding draft from the same store (a
+        sibling model whose ``model.yaml`` declares ``draft_of`` this
+        model, exported by the ``train/distill.py`` recipe). Pairing is
+        best-effort: a broken draft must never stop its target from
+        serving. Negative results are cached against the store
+        signature so a draft-less store isn't re-walked every poll."""
+        from kubeflow_tpu.serving.model_store import find_draft_for
+
+        sig = (loaded.version, self._store_signature())
+        if self._draft_scans.get(name) == sig:
+            return
+        self._draft_scans[name] = sig
+        try:
+            pair = find_draft_for(self.base_path, name, loaded.version)
+        except Exception:  # noqa: BLE001 — a broken store entry must
+            # never abort the poll round that swaps in new versions
+            log.warning("draft scan failed for %s", name, exc_info=True)
+            return
+        if pair is None:
+            if loaded.draft is not None:
+                # the draft export was deleted: one atomic detach
+                log.info("draft %s for model %s removed — detaching",
+                         loaded.draft.ref, name)
+                loaded.draft = None
+            return
+        dname, dver = pair
+        if loaded.draft is not None and \
+                loaded.draft.ref == f"{dname}@{dver}":
+            return  # unchanged pairing
+        try:
+            # the draft stays replicated (no mesh): it is small by
+            # construction, and speculative_generate runs it alongside
+            # the (possibly sharded) target
+            d = load_version(os.path.join(self.base_path, dname), dver)
+        except Exception:  # noqa: BLE001
+            log.exception("failed to load draft %s@%d for %s",
+                          dname, dver, name)
+            return
+        if d.lm_config is None:
+            log.warning("draft %s@%d for %s is not a transformer — "
+                        "ignoring", dname, dver, name)
+            return
+        if d.lm_config.vocab_size != loaded.lm_config.vocab_size:
+            log.warning("draft %s@%d vocab %d != target %s vocab %d — "
+                        "ignoring", dname, dver, d.lm_config.vocab_size,
+                        name, loaded.lm_config.vocab_size)
+            return
+        # one atomic reference swap: request threads snapshot the whole
+        # pair, so attach/replace can never expose torn config/params
+        from kubeflow_tpu.serving.model_store import DraftPair
+
+        loaded.draft = DraftPair(config=d.lm_config, params=d.lm_params,
+                                 ref=f"{dname}@{dver}")
+        log.info("paired speculative draft %s with model %s@%d",
+                 loaded.draft.ref, name, loaded.version)
 
     def _warmup(self, name: str, loaded: LoadedModel) -> None:
         if not self.warmup_batches:
@@ -539,7 +728,7 @@ class ModelRepository:
             return None
         with self._lock:
             served = self._models.get(name)
-        return {
+        out: Dict[str, Any] = {
             "model_version_status": [
                 {"version": str(v),
                  "state": "AVAILABLE" if served and served.version == v
@@ -547,6 +736,12 @@ class ModelRepository:
                 for v in versions
             ]
         }
+        draft = served.draft if served is not None else None
+        if draft is not None:
+            # the paired speculative draft is part of the serving
+            # surface — operators must be able to see the pairing
+            out["speculative_draft"] = draft.ref
+        return out
 
     def start_polling(self) -> None:
         def loop():

@@ -1,5 +1,11 @@
 """Training loop primitives: sharded state, SPMD train steps, optimizers."""
 
+from kubeflow_tpu.train.distill import (  # noqa: F401
+    distill_draft,
+    make_draft,
+    sample_corpus,
+    truncate_draft,
+)
 from kubeflow_tpu.train.trainer import (  # noqa: F401
     TrainState,
     create_sharded_state,

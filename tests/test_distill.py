@@ -1,0 +1,208 @@
+"""Draft acquisition (truncate + distill) and the speculative serving
+surface: a paired draft+target must serve a request END TO END through
+REST with acceptance stats — the capability bar the reference sets by
+wiring model + server + service in one step
+(``/root/reference/kubeflow/tf-serving/tf-serving-template.libsonnet:33-48``).
+"""
+
+import http.client
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import Transformer, TransformerConfig
+from kubeflow_tpu.models.decode import generate, speculative_generate
+from kubeflow_tpu.train.distill import (
+    distill_draft,
+    make_draft,
+    sample_corpus,
+    truncate_draft,
+)
+
+
+@pytest.fixture(scope="module")
+def target():
+    config = TransformerConfig(vocab_size=61, d_model=32, n_layers=4,
+                               n_heads=4, n_kv_heads=2, d_ff=64,
+                               max_seq_len=64, dtype=jnp.float32,
+                               remat=False)
+    params = Transformer(config).init(
+        jax.random.key(0), np.zeros((1, 8), np.int32))["params"]
+    return config, params
+
+
+def test_truncate_keeps_strided_layers_and_shares_embeddings(target):
+    config, params = target
+    dcfg, dparams = truncate_draft(config, params, 2)
+    assert dcfg.n_layers == 2
+    # stride over 4 layers keeping first+last -> indices {0, 3}
+    got = np.asarray(dparams["blocks"]["attn"]["q_proj"])
+    want = np.asarray(params["blocks"]["attn"]["q_proj"])
+    assert got.shape[0] == 2
+    assert np.array_equal(got[0], want[0])
+    assert np.array_equal(got[1], want[3])
+    assert np.array_equal(np.asarray(dparams["token_embed"]),
+                          np.asarray(params["token_embed"]))
+    # full truncation is the identity: same layers, same logits
+    fcfg, fparams = truncate_draft(config, params, 4)
+    toks = jnp.asarray(np.arange(6)[None, :], jnp.int32)
+    a = Transformer(config).apply({"params": params}, toks)
+    b = Transformer(fcfg).apply({"params": fparams}, toks)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_truncate_validates(target):
+    config, params = target
+    with pytest.raises(ValueError, match="n_layers"):
+        truncate_draft(config, params, 0)
+    with pytest.raises(ValueError, match="n_layers"):
+        truncate_draft(config, params, 9)
+
+
+def test_distill_reduces_kl_and_raises_acceptance(target):
+    """The recipe's whole point: distillation must move the draft toward
+    the target — KL falls, and the speculative acceptance rate on the
+    distillation distribution rises vs the raw truncation."""
+    config, params = target
+    corpus = sample_corpus(config, params, n_seqs=24, seq_len=24, seed=3)
+    assert corpus.shape == (24, 24)
+    dcfg, dparams0 = truncate_draft(config, params, 2)
+    dparams1, stats = distill_draft(config, params, dcfg, dparams0,
+                                    corpus, steps=120, batch=8, lr=3e-3,
+                                    seed=0)
+    assert stats["last_loss"] < stats["first_loss"]
+
+    def acceptance(draft_params):
+        prompt = jnp.asarray(corpus[:4, :6], jnp.int32)
+        _, s = speculative_generate(config, params, dcfg, draft_params,
+                                    prompt, max_new_tokens=12,
+                                    draft_len=4)
+        return s["accepted"] / max(s["draft_tokens"], 1)
+
+    before, after = acceptance(dparams0), acceptance(dparams1)
+    assert after > before, (before, after)
+    assert after > 0.2, after
+
+
+def test_make_draft_one_call(target):
+    config, params = target
+    dcfg, dparams, stats = make_draft(config, params, n_layers=2,
+                                      distill_steps=8, corpus_seqs=8,
+                                      corpus_len=16, batch=4)
+    assert dcfg.n_layers == 2
+    assert stats["last_loss"] < stats["first_loss"] or stats["last_loss"] < 1e-3
+    toks = generate(dcfg, dparams, jnp.asarray([[3, 5]], jnp.int32),
+                    max_new_tokens=4)
+    assert np.asarray(toks).shape == (1, 4)
+
+
+def test_draft_repairs_and_detaches_on_poll(tmp_path, target):
+    """A draft exported AFTER the target loads pairs on the next poll;
+    a replacement draft re-pairs; a deleted draft detaches — all
+    without a target version bump, via one atomic DraftPair swap."""
+    import shutil
+
+    from kubeflow_tpu.serving import (export_model,
+                                      transformer_export_config)
+    from kubeflow_tpu.serving.server import ModelRepository
+
+    config, params = target
+    export_model(str(tmp_path / "lm"), "transformer", params, version=1,
+                 config=transformer_export_config(config))
+    repo = ModelRepository(str(tmp_path), poll_interval_s=3600)
+    model = repo._models["lm"]
+    assert model.draft is None
+
+    dcfg, dparams = truncate_draft(config, params, 2)
+    export_model(str(tmp_path / "lm-draft"), "transformer", dparams,
+                 version=1, config=transformer_export_config(dcfg),
+                 draft_of="lm")
+    repo.refresh()
+    assert model.draft is not None and model.draft.ref == "lm-draft@1"
+
+    # a newer draft version replaces the pairing
+    export_model(str(tmp_path / "lm-draft"), "transformer", dparams,
+                 version=2, config=transformer_export_config(dcfg),
+                 draft_of="lm")
+    repo.refresh()
+    assert model.draft.ref == "lm-draft@2"
+
+    # deleting the draft detaches it
+    shutil.rmtree(str(tmp_path / "lm-draft"))
+    repo.refresh()
+    assert model.draft is None
+
+
+def test_speculative_rest_end_to_end(tmp_path, target):
+    """Export target + distilled draft (draft_of pairing), serve both,
+    POST speculative:true — tokens must equal the plain greedy path and
+    the response + /metrics must carry acceptance stats."""
+    from kubeflow_tpu.serving import (ModelServer, export_model,
+                                      transformer_export_config)
+
+    config, params = target
+    dcfg, dparams, _ = make_draft(config, params, n_layers=2,
+                                  distill_steps=40, corpus_seqs=16,
+                                  corpus_len=20, batch=8, lr=3e-3)
+    export_model(str(tmp_path / "lm"), "transformer", params, version=1,
+                 config=transformer_export_config(config))
+    export_model(str(tmp_path / "lm-draft"), "transformer", dparams,
+                 version=1, config=transformer_export_config(dcfg),
+                 draft_of="lm@1")
+    srv = ModelServer(str(tmp_path), port=0, poll_interval_s=3600,
+                      decode_slots=2)
+    port = srv.start()
+    try:
+        def post(body, verb=":generate", model="lm"):
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=300)
+            conn.request("POST", f"/v1/models/{model}{verb}",
+                         json.dumps(body),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            out = json.loads(resp.read())
+            conn.close()
+            return resp.status, out
+
+        prompt = [[5, 11, 17, 2]]
+        plain_code, plain = post({"prompt_tokens": prompt,
+                                  "max_new_tokens": 8})
+        spec_code, spec = post({"prompt_tokens": prompt,
+                                "max_new_tokens": 8,
+                                "speculative": True, "draft_len": 3})
+        assert plain_code == 200 and spec_code == 200, (plain, spec)
+        assert spec["tokens"] == plain["tokens"]
+        s = spec["speculative"]
+        assert s["draft"] == "lm-draft@1"
+        assert s["draft_tokens"] == s["rounds"] * 3
+        assert 0 <= s["accepted"] <= s["draft_tokens"]
+        assert s["acceptance_rate"] == pytest.approx(
+            s["accepted"] / s["draft_tokens"], abs=1e-3)
+
+        # pairing is visible on the status surface
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("GET", "/v1/models/lm")
+        st = json.loads(conn.getresponse().read())
+        conn.close()
+        assert st.get("speculative_draft") == "lm-draft@1"
+
+        # acceptance stats are exported operator-facing
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("GET", "/metrics")
+        metrics = conn.getresponse().read().decode()
+        conn.close()
+        assert "kftpu_serving_speculative_accepted_tokens_total" in metrics
+        assert "kftpu_serving_speculative_last_acceptance_rate" in metrics
+
+        # guard rails: sampling and unpaired models refuse clearly
+        code, out = post({"prompt_tokens": prompt, "max_new_tokens": 4,
+                          "speculative": True, "temperature": 0.7})
+        assert code == 400 and "greedy-only" in out["error"]
+        code, out = post({"prompt_tokens": prompt, "max_new_tokens": 4,
+                          "speculative": True}, model="lm-draft")
+        assert code == 400 and "no paired" in out["error"]
+    finally:
+        srv.stop()
