@@ -18,6 +18,7 @@ run in parallel.
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import threading
@@ -45,22 +46,48 @@ PHASE_FAILED = "Failed"
 
 
 class DeployServer:
-    """Holds deployment state; serves the kfctl REST surface."""
+    """Holds deployment state; serves the kfctl REST surface.
+
+    ``isolation`` selects where deployment flows execute:
+
+    - ``"thread"`` — in-process background threads (the default; fine
+      for trusted single-tenant use).
+    - ``"process"`` — one OS process per flow
+      (``bootstrap/worker.py``): a wedged or crashing deploy cannot
+      take the service or other deployments down. This is the
+      reference's per-deploy kfctl StatefulSet isolation
+      (``bootstrap/cmd/bootstrap/app/router.go:235,370``) with an OS
+      process as the unit instead of a pod. ``KFTPU_DEPLOY_ISOLATION``
+      sets the default for the container entrypoint.
+
+    Status is exchanged through ``<app_root>/<name>/status.json``
+    (atomic rename) in both modes, so the status route reads one source
+    of truth regardless of which process ran the flow.
+    """
 
     def __init__(self, client: KubeClient, *, app_root: str = "/tmp/kftpu",
-                 run_async: bool = True) -> None:
+                 run_async: bool = True,
+                 isolation: str = "thread") -> None:
+        if isolation not in ("thread", "process"):
+            raise ValueError(f"isolation must be 'thread' or 'process', "
+                             f"got {isolation!r}")
         self.client = client
         self.app_root = app_root
         self.run_async = run_async
+        self.isolation = isolation
         self._state_lock = threading.Lock()
         self._locks: Dict[str, threading.Lock] = {}
         self._status: Dict[str, Dict[str, Any]] = {}
+        self._procs: Dict[str, Any] = {}  # live per-deploy workers
 
     # -- locks (GetProjectLock parity) -------------------------------------
 
     def _lock_for(self, name: str) -> threading.Lock:
         with self._state_lock:
             return self._locks.setdefault(name, threading.Lock())
+
+    def _status_path(self, name: str) -> str:
+        return os.path.join(self.app_root, name, "status.json")
 
     def _set(self, name: str, phase: str, message: str = "") -> None:
         with self._state_lock:
@@ -70,6 +97,64 @@ class DeployServer:
                 entry["log"] = (entry.get("log", []) + [message])[-50:]
             entry["updatedAt"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                                time.gmtime())
+            snapshot = dict(entry)
+        self._persist_status(name, snapshot)
+
+    def _persist_status(self, name: str, snapshot: Dict[str, Any]) -> None:
+        """The cross-process status channel (worker ↔ server): atomic
+        write-then-rename so a reader never sees a torn file."""
+        from kubeflow_tpu.workflows.archive import _atomic_write
+
+        try:
+            _atomic_write(self._status_path(name),
+                          json.dumps(snapshot).encode())
+        except OSError:
+            log.warning("could not persist status for %s", name,
+                        exc_info=True)
+
+    def _accept(self, name: str, message: str) -> bool:
+        """Atomic check-and-accept: refuse (False) when the deployment
+        is in progress (phase, or a live worker process); otherwise mark
+        it Pending with ``message`` and persist. A refused request must
+        never mutate the deployment's status — a 409 that clobbered a
+        worker's final state could wedge the phase at Pending."""
+        with self._state_lock:
+            if self._status.get(name, {}).get("phase") in (
+                    PHASE_PENDING, PHASE_RUNNING):
+                return False
+            proc = self._procs.get(name)
+            if proc is not None and proc.poll() is None:
+                return False
+            entry = self._status.setdefault(name, {"log": []})
+            entry["phase"] = PHASE_PENDING
+            entry["log"] = (entry.get("log", []) + [message])[-50:]
+            entry["updatedAt"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                               time.gmtime())
+            snapshot = dict(entry)
+        self._persist_status(name, snapshot)
+        return True
+
+    def peek_status(self, name: str) -> Dict[str, Any]:
+        """The deployment's status: file and memory merged by
+        freshness. A worker process may have progressed the FILE past
+        this process's memory; a failed persist (disk full) may have
+        left MEMORY ahead of the file. ``updatedAt`` is ISO-8601 UTC,
+        so string comparison orders correctly."""
+        file_status: Dict[str, Any] = {}
+        try:
+            with open(self._status_path(name)) as f:
+                file_status = json.load(f)
+        except (OSError, ValueError):
+            pass
+        with self._state_lock:
+            mem_status = dict(self._status.get(name) or {})
+        if not file_status:
+            return mem_status
+        if not mem_status:
+            return file_status
+        return (file_status
+                if file_status.get("updatedAt", "")
+                >= mem_status.get("updatedAt", "") else mem_status)
 
     # -- flows -------------------------------------------------------------
 
@@ -125,6 +210,119 @@ class DeployServer:
         else:
             target(*args)
 
+    # -- process isolation (per-deploy worker, router.go:235 parity) -------
+
+    def _spawn_worker(self, name: str, flow: str,
+                      body: Optional[Dict[str, Any]] = None) -> bool:
+        """Run ``flow`` for ``name`` in its own OS process
+        (``bootstrap/worker.py``). Returns False when a live worker for
+        this deployment already exists (the caller 409s)."""
+        import subprocess
+        import sys
+
+        # the worker's stderr lands here — when it dies without
+        # reporting, this file is the diagnosis (DEVNULL would make the
+        # exact failures the isolation exists for undiagnosable)
+        wlog_path = os.path.join(self.app_root, name, "worker.log")
+        with self._state_lock:
+            prior = self._procs.get(name)
+            if prior is not None and prior.poll() is None:
+                log.warning("worker for %s still alive; not spawning "
+                            "(raced past the accept gate?)", name)
+                return False
+            env = dict(os.environ)
+            # the fake-cluster state file (tests/local): the worker must
+            # apply into the SAME cluster the server reads
+            state_path = getattr(self.client, "path", None)
+            if state_path:
+                env["KFTPU_FAKE_STATE"] = str(state_path)
+            os.makedirs(os.path.dirname(wlog_path), exist_ok=True)
+            wlog = open(wlog_path, "w")
+            try:
+                proc = subprocess.Popen(
+                    [sys.executable, "-m", "kubeflow_tpu.bootstrap.worker",
+                     self.app_root, name, flow],
+                    stdin=subprocess.PIPE, stdout=subprocess.DEVNULL,
+                    stderr=wlog, env=env, text=True)
+            finally:
+                wlog.close()  # the child holds its own descriptor
+            self._procs[name] = proc
+        try:
+            proc.stdin.write(json.dumps(body or {}))
+            proc.stdin.close()
+        except OSError:
+            pass  # worker died instantly; the reaper reports it
+        t = threading.Thread(target=self._reap, args=(name, proc),
+                             daemon=True)
+        t.start()
+        if not self.run_async:
+            t.join()
+        return True
+
+    def _reap(self, name: str, proc) -> None:
+        """Surface workers that die WITHOUT reporting (segfault,
+        OOM-kill) as Failed, and sync the worker's final status back
+        into server memory — the e2eDeploy duplicate guard reads
+        memory, so a finished process-mode deploy must not read as
+        in-progress forever."""
+        rc = proc.wait()
+        status = self.peek_status(name)
+        # adopt the worker's file status (its log lines included) as
+        # this process's view before any further transition
+        with self._state_lock:
+            if status:
+                self._status[name] = dict(status)
+        if rc != 0 and status.get("phase") in (PHASE_PENDING,
+                                               PHASE_RUNNING):
+            # surface the worker's last stderr lines — the crash the
+            # isolation exists for must be diagnosable from the status
+            tail = ""
+            try:
+                with open(os.path.join(self.app_root, name,
+                                       "worker.log")) as f:
+                    tail = f.read()[-300:].strip()
+            except OSError:
+                pass
+            log.error("deploy worker for %s exited rc=%d; stderr tail: "
+                      "%s", name, rc, tail or "<empty>")
+            self._set(name, PHASE_FAILED,
+                      f"deploy worker exited with code {rc} without "
+                      "reporting" + (f": {tail}" if tail
+                                     else " — see server logs"))
+
+    def _dispatch(self, name: str, flow: str,
+                  body: Optional[Dict[str, Any]] = None) -> bool:
+        """Route a flow to the configured isolation unit. Returns False
+        on a 409-worthy conflict (live worker for this name)."""
+        if self.isolation == "process":
+            return self._spawn_worker(name, flow, body)
+        target = {"deploy": self._deploy_flow,
+                  "delete": self._delete_flow,
+                  "reapply": self._reapply_flow}[flow]
+        self._run(target, *((name, body) if flow == "deploy"
+                            else (name,)))
+        return True
+
+    def _start(self, name: str, flow: str,
+               body: Optional[Dict[str, Any]] = None
+               ) -> Optional[Tuple[int, Any]]:
+        """Dispatch an ACCEPTED flow; on any startup failure roll the
+        Pending phase to Failed (a Pending that nothing will ever
+        advance would 409 the name forever) and return the error
+        response. None = started."""
+        try:
+            ok = self._dispatch(name, flow, body)
+        except Exception as e:  # noqa: BLE001 — fork/IO failures
+            log.exception("failed to start %s flow for %s", flow, name)
+            self._set(name, PHASE_FAILED,
+                      f"failed to start {flow}: {type(e).__name__}: {e}")
+            return 500, {"error": f"failed to start {flow}: {e}"}
+        if not ok:
+            self._set(name, PHASE_FAILED,
+                      f"failed to start {flow}: worker conflict")
+            return 503, {"error": "worker conflict at spawn; retry"}
+        return None
+
     # -- routes ------------------------------------------------------------
 
     def handle(self, method: str, path: str, body: Optional[Dict[str, Any]],
@@ -134,20 +332,19 @@ class DeployServer:
             name = body.get("name", "")
             if not name:
                 return 400, {"error": "name is required"}
-            # atomic check-and-set: a second POST racing the Pending window
-            # must not queue a duplicate flow
-            with self._state_lock:
-                current = self._status.get(name, {}).get("phase")
-                if current in (PHASE_PENDING, PHASE_RUNNING):
-                    return 409, {
-                        "error": f"deployment {name!r} already in progress"}
-                entry = self._status.setdefault(name, {"log": []})
-                entry["phase"] = PHASE_PENDING
-                entry["log"] = (entry.get("log", []) + ["accepted"])[-50:]
-                entry["updatedAt"] = time.strftime(
-                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+            # atomic check-and-accept: a second POST racing the Pending
+            # window must not queue a duplicate flow, and a refused one
+            # must not touch the status. Memory is authoritative for
+            # in-progress-ness (the reaper syncs worker completions
+            # back); the accepted state is persisted so the status file
+            # — the route's source of truth — can't serve a stale run.
+            if not self._accept(name, "accepted"):
+                return 409, {
+                    "error": f"deployment {name!r} already in progress"}
             _deploys.inc()
-            self._run(self._deploy_flow, name, body)
+            err = self._start(name, "deploy", body)
+            if err:
+                return err
             return 200, {"name": name, "phase": PHASE_PENDING}
         if method == "POST" and path == "/kfctl/apps/apply":
             name = body.get("name", "")
@@ -156,14 +353,17 @@ class DeployServer:
             app_yaml = os.path.join(self.app_root, name, "app.yaml")
             if not os.path.exists(app_yaml):
                 return 404, {"error": f"deployment {name!r} not found"}
-            self._set(name, PHASE_PENDING, "re-apply accepted")
-            self._run(self._reapply_flow, name)
+            if not self._accept(name, "re-apply accepted"):
+                return 409, {
+                    "error": f"deployment {name!r} already in progress"}
+            err = self._start(name, "reapply")
+            if err:
+                return err
             return 200, {"name": name, "phase": PHASE_PENDING}
         if method == "GET" and path.startswith("/kfctl/status/"):
             name = path.rsplit("/", 1)[1]
-            with self._state_lock:
-                status = self._status.get(name)
-            if status is None:
+            status = self.peek_status(name)
+            if not status:
                 return 404, {"error": f"deployment {name!r} not found"}
             return 200, {"name": name, **status}
         if method == "DELETE" and path.startswith("/kfctl/deployments/"):
@@ -171,8 +371,12 @@ class DeployServer:
             if not os.path.exists(os.path.join(self.app_root, name,
                                                "app.yaml")):
                 return 404, {"error": f"deployment {name!r} not found"}
-            self._set(name, PHASE_PENDING, "delete accepted")
-            self._run(self._delete_flow, name)
+            if not self._accept(name, "delete accepted"):
+                return 409, {
+                    "error": f"deployment {name!r} already in progress"}
+            err = self._start(name, "delete")
+            if err:
+                return err
             return 200, {"name": name, "phase": PHASE_PENDING}
         if method == "GET" and path == "/healthz":
             return 200, {"ok": True}
@@ -201,7 +405,8 @@ def main() -> None:
 
     server = DeployServer(
         HttpKubeClient(),
-        app_root=os.environ.get("KFTPU_APP_ROOT", "/tmp/kftpu"))
+        app_root=os.environ.get("KFTPU_APP_ROOT", "/tmp/kftpu"),
+        isolation=os.environ.get("KFTPU_DEPLOY_ISOLATION", "thread"))
     serve_json(server.handle,
                int(os.environ.get("KFTPU_BOOTSTRAP_PORT", "8086")),
                authenticator=authenticator_from_env(),

@@ -1,0 +1,82 @@
+"""Per-deployment worker process for the deploy service.
+
+The reference's v2 bootstrap server never runs a deployment in its own
+process: each deploy spawns a dedicated kfctl StatefulSet
+(``/root/reference/bootstrap/cmd/bootstrap/app/router.go:235,370``) so
+one wedged or crashing deploy cannot take the service — or the other
+deployments — down with it. This module is that isolation boundary,
+TPU-framework style: the deploy server (``bootstrap/server.py``,
+``isolation="process"``) spawns
+
+    python -m kubeflow_tpu.bootstrap.worker <app_root> <name> <flow>
+
+with the request body as JSON on stdin; the worker runs exactly the
+same flow implementation the in-process mode uses and reports phase
+transitions through ``<app_root>/<name>/status.json`` (atomic
+write-then-rename, the file the server's status route reads). A worker
+that dies without reporting (segfault, OOM-kill) is detected by the
+server's reaper thread and surfaced as Failed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def build_client():
+    """The worker's cluster client, from the env the server passed:
+    ``KFTPU_FAKE_STATE`` selects the file-backed fake cluster (tests,
+    local dev — the same state file the server uses, so the worker's
+    applies land in the same 'cluster'); otherwise the standard
+    in-cluster/kubeconfig HTTP client."""
+    state = os.environ.get("KFTPU_FAKE_STATE")
+    if state:
+        from kubeflow_tpu.k8s.fakefile import FileBackedFakeClient
+
+        return FileBackedFakeClient(state)
+    from kubeflow_tpu.k8s.client import HttpKubeClient
+
+    return HttpKubeClient()
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv if argv is None else argv)
+    if len(argv) != 4:
+        print("usage: worker <app_root> <name> <deploy|delete|reapply>",
+              file=sys.stderr)
+        return 2
+    app_root, name, flow = argv[1:4]
+    body = {}
+    if flow == "deploy":
+        raw = sys.stdin.read().strip()
+        body = json.loads(raw) if raw else {}
+
+    from kubeflow_tpu.bootstrap.server import DeployServer
+
+    # run_async=False + thread isolation: THIS process is the isolation
+    # unit; the flow runs synchronously and exits
+    srv = DeployServer(build_client(), app_root=app_root,
+                       run_async=False, isolation="thread")
+    # seed from the persisted status so the rolling log survives the
+    # process boundary (thread mode keeps history; process mode must too)
+    prior = srv.peek_status(name)
+    if prior:
+        with srv._state_lock:
+            srv._status[name] = dict(prior)
+    if flow == "deploy":
+        srv._deploy_flow(name, body)
+    elif flow == "delete":
+        srv._delete_flow(name)
+    elif flow == "reapply":
+        srv._reapply_flow(name)
+    else:
+        print(f"unknown flow {flow!r}", file=sys.stderr)
+        return 2
+    phase = srv.peek_status(name).get("phase")
+    return 0 if phase == "Succeeded" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

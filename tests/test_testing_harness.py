@@ -183,3 +183,76 @@ def test_deploy_failure_is_reported(deploy_server):
     _, status = server.handle("GET", "/kfctl/status/bad", None)
     assert status["phase"] == "Failed"
     assert any("nope" in line for line in status["log"])
+
+
+def test_process_isolated_deploy_e2e(tmp_path):
+    """isolation="process": the flow runs in a per-deployment WORKER
+    PROCESS (the reference's per-deploy kfctl StatefulSet role,
+    router.go:235,370) against the shared file-backed cluster; the
+    status file is the cross-process channel the status route reads."""
+    from kubeflow_tpu.k8s.fakefile import FileBackedFakeClient
+
+    state = str(tmp_path / "cluster.json")
+    client = FileBackedFakeClient(state)
+    server = DeployServer(client, app_root=str(tmp_path / "apps"),
+                          run_async=False, isolation="process")
+    code, _ = server.handle("POST", "/kfctl/e2eDeploy",
+                            {"name": "demo", "preset": "minimal"})
+    assert code == 200
+    code, status = server.handle("GET", "/kfctl/status/demo", None)
+    assert code == 200 and status["phase"] == "Succeeded", status
+    # the worker's applies landed in the SAME cluster (fresh read of the
+    # state file — the server's in-memory copy predates the worker)
+    fresh = FileBackedFakeClient(state)
+    assert fresh.get_or_none("v1", "Namespace", "", "kubeflow") is not None
+    assert fresh.list("apps/v1", "Deployment", "kubeflow")
+    # a FINISHED process-mode deploy must not read as in-progress: the
+    # reaper syncs the worker's completion back, so redeploy is a 200
+    code, out = server.handle("POST", "/kfctl/e2eDeploy",
+                              {"name": "demo", "preset": "minimal"})
+    assert code == 200, out
+    _, status = server.handle("GET", "/kfctl/status/demo", None)
+    assert status["phase"] == "Succeeded", status
+    # failures cross the process boundary too
+    code, _ = server.handle("POST", "/kfctl/e2eDeploy",
+                            {"name": "bad", "preset": "nope"})
+    _, status = server.handle("GET", "/kfctl/status/bad", None)
+    assert status["phase"] == "Failed"
+    assert any("nope" in line for line in status["log"])
+
+
+def test_process_isolation_survives_worker_crash(tmp_path, monkeypatch):
+    """A worker that dies WITHOUT reporting (the crash the isolation
+    exists for) must surface as Failed — and must not poison the
+    server: the next deploy still works."""
+    import subprocess
+    import sys
+
+    from kubeflow_tpu.k8s.fakefile import FileBackedFakeClient
+
+    state = str(tmp_path / "cluster.json")
+    server = DeployServer(FileBackedFakeClient(state),
+                          app_root=str(tmp_path / "apps"),
+                          run_async=False, isolation="process")
+
+    real_popen = subprocess.Popen
+
+    def crashing_popen(cmd, **kw):
+        # simulate a segfaulting worker: dies instantly, writes nothing
+        return real_popen([sys.executable, "-c", "import os; os._exit(139)"],
+                          **kw)
+
+    monkeypatch.setattr(subprocess, "Popen", crashing_popen)
+    code, _ = server.handle("POST", "/kfctl/e2eDeploy",
+                            {"name": "demo", "preset": "minimal"})
+    assert code == 200
+    _, status = server.handle("GET", "/kfctl/status/demo", None)
+    assert status["phase"] == "Failed", status
+    assert any("exited with code 139" in line for line in status["log"])
+
+    monkeypatch.undo()
+    code, _ = server.handle("POST", "/kfctl/e2eDeploy",
+                            {"name": "demo", "preset": "minimal"})
+    assert code == 200
+    _, status = server.handle("GET", "/kfctl/status/demo", None)
+    assert status["phase"] == "Succeeded", status
