@@ -198,6 +198,36 @@ def cmd_images(args) -> int:
         retag_config,
     )
 
+    if args.bump:
+        # the freshness bot (reference py/kubeflow/kubeflow/ci +
+        # releasing/auto-update parity): scan a tag catalog for newer
+        # component images, rewrite + changelog + review branch.
+        # propose_updates loads app.yaml itself — no _app_config here.
+        if args.pin or args.retag or args.registry:
+            raise SystemExit("--bump cannot be combined with "
+                             "--pin/--retag/--registry")
+        from kubeflow_tpu.manifests.autoupdate import propose_updates
+
+        report = propose_updates(args.app_dir, args.bump,
+                                 write=args.write,
+                                 git_branch=args.git_branch)
+        for b in report["bumps"]:
+            print(f"{b['component']}.{b['param']}: {b['old_tag']} -> "
+                  f"{b['new_tag']}")
+        if not report["bumps"]:
+            print("all images current")
+        elif report["written"]:
+            print(f"wrote {len(report['bumps'])} bump(s) to app.yaml "
+                  "+ image-bumps.md"
+                  + (f" on branch {report['branch']}"
+                     if report["branch"] else ""))
+        else:
+            print(f"{len(report['bumps'])} bump(s) available "
+                  "(re-run with --write to apply)")
+        if report.get("git_error"):
+            print(f"GIT ERROR: {report['git_error']}")
+            return 1
+        return 0
     config = _app_config(args.app_dir)
     if args.pin:
         if args.retag or args.registry:
@@ -595,6 +625,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "resolves from running pods' imageIDs, FILE is "
                          "a yaml {image: sha256:...} map; writes "
                          "images.lock.yaml")
+    sp.add_argument("--bump", default=None, metavar="CATALOG",
+                    help="scan CATALOG (yaml: image base -> [tags]) for "
+                         "newer component images (the auto-update bot)")
+    sp.add_argument("--write", action="store_true",
+                    help="with --bump: rewrite app.yaml + image-bumps.md")
+    sp.add_argument("--git-branch", default=None, metavar="NAME",
+                    help="with --bump --write: commit the bump to this "
+                         "branch for review (the PR-equivalent)")
     sp.add_argument("--server", default=None,
                     help="API server URL (with --pin cluster)")
     sp.add_argument("--insecure", action="store_true")

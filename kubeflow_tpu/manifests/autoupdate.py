@@ -1,0 +1,248 @@
+"""Automated image-bump proposals — the CI freshness bot.
+
+Reference parity: ``/root/reference/py/kubeflow/kubeflow/ci/`` (the bot
+that opened image-bump PRs whenever a component image was rebuilt) and
+``/root/reference/releasing/auto-update/``. Their role: nobody should
+hand-edit dozens of manifests when an image gets a new release — a bot
+detects newer tags, rewrites the configs, and proposes the change for
+review rather than applying it blind.
+
+TPU-framework shape: component images are typed config params
+(``manifests/images.py``), so a "bump PR" is a config rewrite plus a
+review artifact —
+
+1. :func:`scan_updates` — compare every image param of a deployment
+   against a tag CATALOG (a YAML of ``image-base: [tags...]``, produced
+   by your registry's listing job; no registry egress from here) using
+   version-aware tag ordering.
+2. :func:`apply_updates` — rewrite the config params in place.
+3. :func:`propose_updates` — the bot entrypoint (``ctl images <app>
+   --bump CATALOG``): scan, rewrite ``app.yaml``, emit a changelog
+   (``image-bumps.md``), and — when the app dir lives in a git repo —
+   commit the bump to a dedicated branch for review: the PR-equivalent
+   in a forge-less cluster.
+
+Schedule it with a CronWorkflow (:func:`autoupdate_cron_spec`) the same
+way the reference ran its bot on Prow periodics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import subprocess
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import yaml
+
+from kubeflow_tpu.config.deployment import DeploymentConfig
+from kubeflow_tpu.manifests.images import _strip_tag
+from kubeflow_tpu.manifests.registry import get_component
+
+
+def _tag_of(image: str) -> Optional[str]:
+    """The ``:tag`` of an image ref (None for untagged or digest-pinned
+    refs — a content pin must never be silently replaced by a tag)."""
+    if "@" in image:
+        return None
+    last = image.rsplit("/", 1)[-1]
+    if ":" not in last:
+        return None
+    return last.rsplit(":", 1)[1]
+
+
+def _tag_key(tag: str) -> Tuple:
+    """Version-aware ordering key: numeric runs compare numerically
+    (v1.10 > v1.9, 20200131 > 20190116), alpha runs lexically,
+    pre-release words (rc/alpha/beta/dev) rank below everything. The
+    terminator ``(0, -1)`` makes a bare release beat its own
+    pre-releases (v1.2 > v1.2-rc1) while staying below extensions
+    (v1.2 < v1.2.1). A leading ``v`` is stripped so v-prefixed and bare
+    tags order together (v1.9 < 1.10, 2.0.0 > v1.0.0)."""
+    tag = re.sub(r"^[vV](?=\d)", "", tag)
+    parts: List[Tuple] = []
+    for run in re.findall(r"\d+|[A-Za-z]+", tag):
+        if run.isdigit():
+            parts.append((0, int(run)))
+        elif re.fullmatch(r"rc|alpha|beta|dev|pre|preview", run, re.I):
+            parts.append((-1, run.lower()))
+        else:
+            parts.append((1, run.lower()))
+    parts.append((0, -1))
+    return tuple(parts)
+
+
+def newer_tag(current: str, candidates: List[str]) -> Optional[str]:
+    """The highest candidate strictly newer than ``current`` under
+    version ordering; None when current is already newest. ``latest``
+    and other non-versioned floating tags never win (bumping a pin to
+    a floating tag would be a downgrade in reproducibility)."""
+    floating = {"latest", "master", "main", "nightly"}
+    cur = _tag_key(current)
+    best = None
+    for cand in candidates:
+        if cand in floating or cand == current:
+            continue
+        if _tag_key(cand) > cur and (
+                best is None or _tag_key(cand) > _tag_key(best)):
+            best = cand
+    return best
+
+
+@dataclasses.dataclass
+class ImageBump:
+    component: str
+    param: str
+    image: str      # current full ref
+    old_tag: str
+    new_tag: str
+
+    @property
+    def new_image(self) -> str:
+        return f"{_strip_tag(self.image)}:{self.new_tag}"
+
+
+def scan_updates(config: DeploymentConfig,
+                 catalog: Dict[str, List[str]]) -> List[ImageBump]:
+    """Every image param with a strictly newer tag in ``catalog``
+    (keys: image base without tag, values: available tags)."""
+    bumps: List[ImageBump] = []
+    for spec in config.components:
+        comp = get_component(spec.name)
+        for key, default in comp.defaults.items():
+            if key != "image" and not key.endswith("_image"):
+                continue
+            current = spec.params.get(key, default)
+            if not isinstance(current, str) or not current:
+                continue
+            tag = _tag_of(current)
+            if tag is None:
+                continue
+            tags = catalog.get(_strip_tag(current))
+            if not tags:
+                continue
+            new = newer_tag(tag, list(tags))
+            if new:
+                bumps.append(ImageBump(spec.name, key, current, tag, new))
+    return bumps
+
+
+def apply_updates(config: DeploymentConfig,
+                  bumps: List[ImageBump]) -> Dict[str, str]:
+    """Rewrite the bumped image params in place; returns {old: new}."""
+    changes: Dict[str, str] = {}
+    for b in bumps:
+        spec = config.component(b.component)
+        if spec is None:
+            continue
+        spec.params[b.param] = b.new_image
+        changes[b.image] = b.new_image
+    return changes
+
+
+def _changelog(bumps: List[ImageBump]) -> str:
+    when = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    lines = [f"# Image bumps — {when}", ""]
+    for b in bumps:
+        lines.append(f"- **{b.component}.{b.param}**: "
+                     f"`{b.image}` → `{b.new_image}`")
+    return "\n".join(lines) + "\n"
+
+
+def _git(app_dir: str, *args: str) -> subprocess.CompletedProcess:
+    return subprocess.run(["git", *args], cwd=app_dir,
+                          capture_output=True, text=True, timeout=60)
+
+
+def propose_updates(app_dir: str, catalog_path: str, *,
+                    write: bool = False,
+                    git_branch: Optional[str] = None) -> Dict[str, Any]:
+    """The bot entrypoint. Scans ``<app_dir>/app.yaml`` against the tag
+    catalog; with ``write`` rewrites the config and drops
+    ``image-bumps.md`` beside it; with ``git_branch`` additionally
+    commits the change to that branch (created from the current HEAD)
+    when the app dir is inside a git work tree — the reviewable
+    PR-equivalent: only the bump files are committed, and the original
+    branch is checked out again afterwards, so the operator's working
+    branch is untouched until the proposal is merged. A failed checkout
+    is reported (``git_error``), never silently committed elsewhere.
+    Returns a report dict (also what ``ctl images --bump`` prints)."""
+    app_yaml = os.path.join(app_dir, "app.yaml")
+    config = DeploymentConfig.load(app_yaml)
+    with open(catalog_path) as f:
+        catalog = yaml.safe_load(f) or {}
+    if not isinstance(catalog, dict):
+        raise ValueError(f"catalog {catalog_path} must map image base "
+                         "-> [tags]")
+    bumps = scan_updates(config, catalog)
+    report: Dict[str, Any] = {
+        "bumps": [dataclasses.asdict(b) for b in bumps],
+        "written": False, "branch": None,
+    }
+    if not bumps or not write:
+        return report
+    apply_updates(config, bumps)
+    config.save(app_yaml)
+    log_path = os.path.join(app_dir, "image-bumps.md")
+    with open(log_path, "w") as f:
+        f.write(_changelog(bumps))
+    report["written"] = True
+    if git_branch:
+        inside = _git(app_dir, "rev-parse", "--is-inside-work-tree")
+        if inside.returncode == 0 and inside.stdout.strip() == "true":
+            orig = _git(app_dir, "rev-parse",
+                        "--abbrev-ref", "HEAD").stdout.strip()
+            co = _git(app_dir, "checkout", "-B", git_branch)
+            if co.returncode == 0:
+                msg = (f"Bump {len(bumps)} component image"
+                       f"{'s' if len(bumps) != 1 else ''}")
+                # add (image-bumps.md may be untracked) + pathspec'd
+                # commit: only the bump files, never whatever the
+                # operator happened to have staged
+                _git(app_dir, "add", "--", "app.yaml", "image-bumps.md")
+                commit = _git(app_dir, "commit", "-m", msg, "--",
+                              "app.yaml", "image-bumps.md")
+                if commit.returncode == 0:
+                    report["branch"] = git_branch
+                else:
+                    # a scheduled bot whose commits silently fail would
+                    # look healthy forever — surface it
+                    report["git_error"] = ("commit: " +
+                                           (commit.stderr.strip() or
+                                            commit.stdout.strip())[-200:])
+                # PR semantics: the proposal lives on the review branch;
+                # the working branch returns to where the operator was
+                # (checkout restores their app.yaml on disk too)
+                if orig and orig not in ("HEAD", git_branch):
+                    back = _git(app_dir, "checkout", orig)
+                    if back.returncode != 0:
+                        report["git_error"] = (
+                            f"checkout {orig} (restore): "
+                            + back.stderr.strip()[-200:])
+            else:
+                log_msg = co.stderr.strip()[-200:]
+                report["git_error"] = f"checkout -B {git_branch}: {log_msg}"
+    return report
+
+
+def autoupdate_cron_spec(app_dir: str, catalog_path: str, *,
+                         schedule: str = "0 7 * * 1",
+                         image: str = "kubeflow-tpu/ctl:latest"
+                         ) -> Dict[str, Any]:
+    """A CronWorkflow object that runs the bump bot on a schedule (the
+    reference ran its bot as a Prow periodic;
+    ``workflows/cron.py:scheduled_workflow`` is our scheduler)."""
+    from kubeflow_tpu.workflows.cron import scheduled_workflow
+
+    return scheduled_workflow(
+        "image-autoupdate", "kubeflow",
+        {"steps": [{
+            "name": "bump",
+            "type": "container",
+            "image": image,
+            "command": ["ctl", "images", app_dir, "--bump", catalog_path,
+                        "--write", "--git-branch", "image-bumps"],
+        }]},
+        cron=schedule)

@@ -1,0 +1,145 @@
+"""Image auto-update bot (reference ``py/kubeflow/kubeflow/ci`` +
+``releasing/auto-update`` parity): version-aware tag ordering, config
+rewrite, changelog + review-branch proposal, CLI surface."""
+
+import os
+import subprocess
+
+import yaml
+
+from kubeflow_tpu.config import preset
+from kubeflow_tpu.manifests.autoupdate import (
+    apply_updates,
+    autoupdate_cron_spec,
+    newer_tag,
+    propose_updates,
+    scan_updates,
+)
+
+
+class TestTagOrdering:
+    def test_semver_and_numeric_runs(self):
+        assert newer_tag("v1.9", ["v1.10", "v1.8"]) == "v1.10"
+        assert newer_tag("v1.10", ["v1.9", "v1.2"]) is None
+        assert newer_tag("1.4.0", ["1.4.1", "1.3.9"]) == "1.4.1"
+
+    def test_date_tags(self):
+        assert newer_tag("20190116", ["20200131", "20181201"]) == "20200131"
+
+    def test_prerelease_sorts_below_release(self):
+        assert newer_tag("v1.2-rc1", ["v1.2"]) == "v1.2"
+        assert newer_tag("v1.2", ["v1.2-rc1"]) is None
+
+    def test_floating_tags_never_win(self):
+        assert newer_tag("v1.2", ["latest", "master", "nightly"]) is None
+
+    def test_current_tag_is_not_newer(self):
+        assert newer_tag("v1.2", ["v1.2"]) is None
+
+    def test_v_prefix_normalizes_across_styles(self):
+        # mixed bare/v-prefixed catalogs must not downgrade or miss
+        assert newer_tag("2.0.0", ["v1.0.0"]) is None
+        assert newer_tag("v1.9", ["1.10"]) == "1.10"
+        assert newer_tag("1.9", ["v2.0"]) == "v2.0"
+
+
+def test_scan_and_apply_updates():
+    config = preset("minimal", "demo")
+    catalog = {"kubeflow-tpu/operator": ["v1alpha1", "v1alpha2", "latest"],
+               "kubeflow-tpu/unrelated": ["v9"]}
+    bumps = scan_updates(config, catalog)
+    assert [(b.component, b.old_tag, b.new_tag) for b in bumps] == \
+        [("tpujob-operator", "v1alpha1", "v1alpha2")]
+    changes = apply_updates(config, bumps)
+    assert changes == {"kubeflow-tpu/operator:v1alpha1":
+                       "kubeflow-tpu/operator:v1alpha2"}
+    assert config.component("tpujob-operator").params["image"] == \
+        "kubeflow-tpu/operator:v1alpha2"
+    # idempotent: nothing newer after the bump
+    assert scan_updates(config, catalog) == []
+
+
+def test_digest_pinned_images_never_bumped():
+    config = preset("minimal", "demo")
+    spec = config.component("tpujob-operator")
+    spec.params["image"] = "kubeflow-tpu/operator@sha256:" + "a" * 64
+    catalog = {"kubeflow-tpu/operator": ["v9"]}
+    assert scan_updates(config, catalog) == []
+
+
+def test_propose_updates_writes_config_changelog_and_branch(tmp_path):
+    app = tmp_path / "app"
+    app.mkdir()
+    config = preset("minimal", "demo")
+    config.save(str(app / "app.yaml"))
+    catalog = tmp_path / "catalog.yaml"
+    catalog.write_text(yaml.safe_dump(
+        {"kubeflow-tpu/operator": ["v1alpha2", "v1alpha1"]}))
+
+    # dry-run: report only, nothing written
+    report = propose_updates(str(app), str(catalog))
+    assert len(report["bumps"]) == 1 and not report["written"]
+    assert not (app / "image-bumps.md").exists()
+
+    # a git repo around the app dir: the bump lands on a review branch
+    subprocess.run(["git", "init", "-q", "-b", "main"], cwd=app, check=True)
+    subprocess.run(["git", "-c", "user.email=bot@x", "-c", "user.name=bot",
+                    "add", "-A"], cwd=app, check=True)
+    subprocess.run(["git", "-c", "user.email=bot@x", "-c", "user.name=bot",
+                    "commit", "-q", "-m", "init"], cwd=app, check=True)
+    env = dict(os.environ,
+               GIT_AUTHOR_NAME="bot", GIT_AUTHOR_EMAIL="bot@x",
+               GIT_COMMITTER_NAME="bot", GIT_COMMITTER_EMAIL="bot@x")
+    os.environ.update({k: v for k, v in env.items() if k.startswith("GIT_")})
+    try:
+        report = propose_updates(str(app), str(catalog), write=True,
+                                 git_branch="image-bumps")
+    finally:
+        for k in ("GIT_AUTHOR_NAME", "GIT_AUTHOR_EMAIL",
+                  "GIT_COMMITTER_NAME", "GIT_COMMITTER_EMAIL"):
+            os.environ.pop(k, None)
+    assert report["written"] and report["branch"] == "image-bumps"
+    # PR semantics: the proposal lives on the review branch; the
+    # operator's branch (and its app.yaml) are back where they were
+    head = subprocess.run(["git", "rev-parse", "--abbrev-ref", "HEAD"],
+                          cwd=app, capture_output=True, text=True)
+    assert head.stdout.strip() == "main"
+    assert "v1alpha1" in (app / "app.yaml").read_text()
+    msg = subprocess.run(["git", "log", "-1", "--format=%s", "image-bumps"],
+                         cwd=app, capture_output=True, text=True)
+    assert "Bump 1 component image" in msg.stdout
+    shown = subprocess.run(
+        ["git", "show", "image-bumps:app.yaml"], cwd=app,
+        capture_output=True, text=True)
+    assert "v1alpha2" in shown.stdout
+    log = subprocess.run(
+        ["git", "show", "image-bumps:image-bumps.md"], cwd=app,
+        capture_output=True, text=True)
+    assert "kubeflow-tpu/operator:v1alpha1" in log.stdout
+
+
+def test_cli_images_bump(tmp_path, capsys):
+    from kubeflow_tpu.cli.main import main
+
+    app = tmp_path / "app"
+    app.mkdir()
+    preset("minimal", "demo").save(str(app / "app.yaml"))
+    catalog = tmp_path / "catalog.yaml"
+    catalog.write_text(yaml.safe_dump(
+        {"kubeflow-tpu/operator": ["v1alpha2"]}))
+    rc = main(["images", str(app), "--bump", str(catalog)])
+    out = capsys.readouterr().out
+    assert rc == 0 and "v1alpha1 -> v1alpha2" in out and "--write" in out
+    rc = main(["images", str(app), "--bump", str(catalog), "--write"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "image-bumps.md" in out
+    assert "v1alpha2" in (app / "app.yaml").read_text()
+
+
+def test_autoupdate_cron_spec_is_valid():
+    obj = autoupdate_cron_spec("/apps/demo", "/apps/catalog.yaml",
+                               schedule="0 7 * * 1")
+    assert obj["kind"].lower().startswith("scheduledworkflow")
+    assert obj["spec"]["cron"] == "0 7 * * 1"
+    step = obj["spec"]["workflowSpec"]["steps"][0]
+    assert "--bump" in step["command"]
