@@ -221,7 +221,13 @@ class EdgeProxy:
                                     or self.command == "HEAD")
                         if bodiless:
                             # chunked framing is forbidden on 204/304;
-                            # a stray terminator would desync keep-alive
+                            # a stray terminator would desync keep-alive.
+                            # HEAD responses legally carry the size of
+                            # the body a GET would return — forward it
+                            # (clients use it for existence/size probes)
+                            if self.command == "HEAD" and clen is not None \
+                                    and resp.status not in (204, 304):
+                                self.send_header("Content-Length", clen)
                             self.end_headers()
                             headers_sent = True
                         elif clen is not None:
@@ -262,7 +268,8 @@ class EdgeProxy:
                                                    "application/json"))
                     self.send_header("Content-Length", str(len(data)))
                     self.end_headers()
-                    self.wfile.write(data)
+                    if self.command != "HEAD":  # bodiless by definition
+                        self.wfile.write(data)
                 except (OSError, http.client.HTTPException) as e:
                     if headers_sent:
                         # mid-stream upstream death (reset, truncation —
@@ -377,7 +384,10 @@ class EdgeProxy:
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
-                self.wfile.write(data)
+                # HEAD responses advertise the length but carry no body
+                # — writing one would desync a keep-alive connection
+                if self.command != "HEAD":
+                    self.wfile.write(data)
 
             def do_GET(self):  # noqa: N802
                 if self.path.split("?")[0] == "/healthz":
@@ -386,6 +396,9 @@ class EdgeProxy:
                 self._forward()
 
             do_POST = do_PUT = do_DELETE = do_PATCH = _forward
+            # HEAD forwards like GET; the bodiless branch above keeps
+            # the upstream Content-Length and sends no body
+            do_HEAD = _forward
 
             def log_message(self, *a):
                 pass

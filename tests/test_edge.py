@@ -516,6 +516,66 @@ def test_chunked_streaming_through_edge():
         upstream.shutdown()
 
 
+def test_head_keeps_content_length_through_edge():
+    """HEAD responses legally advertise the size a GET would return;
+    the edge must forward that Content-Length even though no body
+    follows (clients use HEAD for existence/size probes)."""
+    import http.client
+    import http.server
+    import threading
+
+    class Sized(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_HEAD(self):  # noqa: N802
+            self.send_response(200)
+            self.send_header("Content-Length", "1234")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    upstream = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Sized)
+    threading.Thread(target=upstream.serve_forever, daemon=True).start()
+    proxy = EdgeProxy(
+        [Route("/x/", f"http://127.0.0.1:{upstream.server_address[1]}")])
+    port = proxy.start(0)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("HEAD", "/x/artifact.bin")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Content-Length") == "1234"
+        assert resp.read() == b""
+        conn.close()
+    finally:
+        proxy.stop()
+        upstream.shutdown()
+
+
+def test_head_error_responses_stay_bodiless():
+    """Proxy-GENERATED responses to HEAD (404 no-route, upstream 4xx)
+    must not write a body: a keep-alive client reads only the headers,
+    and stray body bytes would desync the next response."""
+    import http.client
+
+    proxy = EdgeProxy([Route("/x/", "http://127.0.0.1:1")])  # dead route
+    port = proxy.start(0)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("HEAD", "/no-such-prefix/thing")
+        resp = conn.getresponse()
+        assert resp.status == 404
+        assert int(resp.getheader("Content-Length")) > 0
+        assert resp.read() == b""
+        # the SAME connection must stay parseable
+        conn.request("HEAD", "/no-such-prefix/thing")
+        assert conn.getresponse().status == 404
+        conn.close()
+    finally:
+        proxy.stop()
+
+
 def test_bodiless_204_through_edge():
     """204 responses must not grow chunked framing (forbidden by RFC
     7230 §3.3.1 and a keep-alive desync if the terminator leaks)."""
