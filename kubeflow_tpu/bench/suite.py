@@ -479,7 +479,8 @@ def _virtual_mesh_allreduce(*, size_mb: float, iters: int,
 def bench_decode(batch: int = 8, prompt_len: int = 128,
                  new_tokens: int = 128, d_model: int = 1024,
                  n_layers: int = 8, n_heads: int = 16,
-                 d_ff: int = 4096) -> Dict[str, Any]:
+                 d_ff: int = 4096,
+                 profile_dir: Optional[str] = None) -> Dict[str, Any]:
     """Autoregressive generation throughput (KV-cache decode loop).
 
     The LLM-serving hot path the reference has no story for: prefill +
@@ -515,6 +516,14 @@ def bench_decode(batch: int = 8, prompt_len: int = 128,
         out = fn(params, prompt, true_len, rng)
     _ = np.asarray(out)
     dt = (time.perf_counter() - t0) / reps
+    if profile_dir:
+        holder: Dict[str, Any] = {}
+
+        def one():
+            holder["out"] = fn(params, prompt, true_len, rng)
+
+        _capture_trace(one, lambda: np.asarray(holder["out"]),
+                       profile_dir, n_steps=1)
 
     n_params = sum(int(np.prod(p.shape))
                    for p in jax.tree_util.tree_leaves(params))
@@ -536,7 +545,9 @@ def bench_decode_engine(concurrency: int = 48, slots: int = 32,
                         prompt_len: int = 128, new_tokens: int = 128,
                         steps_per_sync: int = 8, d_model: int = 1024,
                         n_layers: int = 8, n_heads: int = 16,
-                        d_ff: int = 4096) -> Dict[str, Any]:
+                        d_ff: int = 4096,
+                        profile_dir: Optional[str] = None
+                        ) -> Dict[str, Any]:
     """Continuous-batching serving throughput: ``concurrency`` generate
     requests share the DecodeEngine's ``slots``-row decode batch
     (``kubeflow_tpu/serving/engine.py``) — the production :generate
@@ -566,6 +577,10 @@ def bench_decode_engine(concurrency: int = 48, slots: int = 32,
 
     sample_kw = {"temperature": 0.8, "top_k": 40, "top_p": 0.95}
 
+    def drain(eng):
+        while eng.active_count or not eng._pending.empty():
+            eng.run_once(timeout=0.01)
+
     def run_engine(sampler_bound: Optional[int], sampled: bool):
         """tokens/sec through a fresh engine (params shared in HBM)."""
         eng = DecodeEngine(config, params, slots=slots,
@@ -573,20 +588,16 @@ def bench_decode_engine(concurrency: int = 48, slots: int = 32,
                            sampler_bound=sampler_bound,
                            autostart=False, name="bench")
 
-        def drain():
-            while eng.active_count or not eng._pending.empty():
-                eng.run_once(timeout=0.01)
-
         # warm the compiled programs (prefill bucket, insert, step)
         kw = dict(sample_kw) if sampled else {}
         warm = eng.submit(prompts[0], max_new=steps_per_sync + 1, **kw)
-        drain()
+        drain(eng)
         list(warm.stream())
 
         t0 = time.perf_counter()
         reqs = [eng.submit(p, max_new=new_tokens, seed=i, **kw)
                 for i, p in enumerate(prompts)]
-        drain()
+        drain(eng)
         total = sum(len(r.result()) for r in reqs)
         dt = time.perf_counter() - t0
         return round(total / dt / n_chips, 1), eng.steps_total
@@ -600,6 +611,25 @@ def bench_decode_engine(concurrency: int = 48, slots: int = 32,
     greedy_tps, engine_steps = run_engine(bound, sampled=False)
     sampled_bounded_tps, _ = run_engine(bound, sampled=True)
     sampled_exact_tps, _ = run_engine(0, sampled=True)
+    if profile_dir:
+        # trace a short greedy engine run. jit caches are per engine
+        # instance, so this engine precompiles its step programs and
+        # serves one warm request first — the captured trace is decode
+        # steps, not XLA compiles. Nothing is consumed after the
+        # capture: _capture_trace swallows profiler failures by design,
+        # and a blocking read on a maybe-undrained request could hang
+        # the bench after all measurements already succeeded.
+        eng = DecodeEngine(config, params, slots=slots,
+                           steps_per_sync=steps_per_sync,
+                           sampler_bound=bound, precompile=True,
+                           autostart=False, name="bench-trace")
+        warm = eng.submit(prompts[0], max_new=steps_per_sync + 1)
+        drain(eng)
+        list(warm.stream())
+        eng.submit(prompts[0], max_new=min(new_tokens,
+                                           4 * steps_per_sync))
+        _capture_trace(lambda: drain(eng), lambda: None, profile_dir,
+                       n_steps=1)
     return {
         "tokens_per_sec_per_chip": greedy_tps,
         "sampled_bounded_tokens_per_sec_per_chip": sampled_bounded_tps,
@@ -749,7 +779,8 @@ CONFIGS: Dict[str, Callable[[], Dict[str, Any]]] = {
 }
 
 
-_PROFILABLE = ("resnet50", "bert", "longcontext")
+_PROFILABLE = ("resnet50", "bert", "longcontext", "decode",
+               "decode_engine")
 
 
 def run_all(only: Optional[list] = None,

@@ -1,0 +1,28 @@
+#!/bin/bash
+# Round-5 measurement runbook — run the moment the device transport
+# answers (probe first: timeout 60 python -c "import jax; print(jax.devices())").
+# Produces: bench JSON (all 8 configs, decode-engine in 3 sampler modes),
+# traces/r05/{resnet50,bert,longcontext,decode,decode_engine},
+# act-compress A/B, PERF.md-ready trace-top tables.
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== probe =="
+timeout 90 python -c "import jax; print(jax.devices())" || exit 1
+
+echo "== full bench + traces =="
+python bench.py --profile traces/r05 | tee /tmp/bench_r05.json
+
+echo "== act-compress A/B (resnet50 only) =="
+KFTPU_RESNET_ACT_COMPRESS=1 python -m kubeflow_tpu.bench.suite resnet50 \
+  | tee /tmp/resnet_actcompress.json
+
+echo "== trace tables (paste into PERF.md) =="
+for d in traces/r05/*/; do
+  echo "--- $d"; python -m kubeflow_tpu.cli trace-top "$d" --top 12 || true
+done
+
+echo "Done. Commit traces/r05 + update PERF.md with measured verdicts:"
+echo "  - resnet50 act-compress: keep (>=2900 img/s) or reject with step-time data"
+echo "  - decode_engine: ms/token + tokens/s at batch 32 vs the 0.41 ms/token floor"
+echo "  - sampled bounded vs exact-sort tokens/s at slots=32 (kept/rejected)"
