@@ -143,6 +143,10 @@ class PredictionServicer:
         }
         if request.HasField("eos_id"):
             body["eos_id"] = request.eos_id
+        if request.speculative:
+            body["speculative"] = True
+            if request.draft_len:
+                body["draft_len"] = request.draft_len
         return model, body
 
     def Generate(self, request: pb.GenerateRequest,
@@ -159,10 +163,19 @@ class PredictionServicer:
             context.abort(_status_for(code),
                           payload.get("error", "generate failed"))
         _grpc_generates.inc(model=request.model_name)
-        return pb.GenerateResponse(
+        resp = pb.GenerateResponse(
             tokens=array_to_tensor(np.asarray(payload["tokens"],
                                               np.int32)),
             model_version=int(payload["model_version"]))
+        spec = payload.get("speculative")
+        if spec:
+            resp.speculative.MergeFrom(pb.SpeculativeStats(
+                draft=spec["draft"], draft_len=spec["draft_len"],
+                rounds=spec["rounds"],
+                draft_tokens=spec["draft_tokens"],
+                accepted=spec["accepted"],
+                acceptance_rate=spec["acceptance_rate"]))
+        return resp
 
     def GenerateStream(self, request: pb.GenerateRequest,
                        context: grpc.ServicerContext):
@@ -334,6 +347,34 @@ class PredictClient:
             prefix_len=prefix_len),
             timeout=timeout)
         return tensor_to_array(resp.tokens), resp.model_version
+
+    def generate_speculative(self, model_name: str, prompt: np.ndarray,
+                             *, max_new_tokens: int = 16,
+                             draft_len: int = 0, true_len: int = 0,
+                             version: Optional[int] = None,
+                             timeout: float = 300.0
+                             ) -> Tuple[np.ndarray, int, dict]:
+        """Greedy draft-assisted generation through the model's paired
+        speculative draft. Returns ``(tokens, version, stats)`` with
+        the acceptance accounting (empty dict if the server sent
+        none)."""
+        req = self._generate_request(
+            model_name, prompt, max_new_tokens=max_new_tokens,
+            true_len=true_len, temperature=0.0, seed=0, top_k=0,
+            top_p=1.0, eos_id=None, version=version)
+        req.speculative = True
+        if draft_len:
+            req.draft_len = draft_len
+        resp = self._generate(req, timeout=timeout)
+        stats: dict = {}
+        if resp.HasField("speculative"):
+            s = resp.speculative
+            stats = {"draft": s.draft, "draft_len": s.draft_len,
+                     "rounds": s.rounds,
+                     "draft_tokens": s.draft_tokens,
+                     "accepted": s.accepted,
+                     "acceptance_rate": round(s.acceptance_rate, 3)}
+        return tensor_to_array(resp.tokens), resp.model_version, stats
 
     def generate_stream(self, model_name: str, prompt: np.ndarray, *,
                         max_new_tokens: int = 16, true_len: int = 0,

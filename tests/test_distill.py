@@ -99,6 +99,52 @@ def test_make_draft_one_call(target):
     assert np.asarray(toks).shape == (1, 4)
 
 
+def test_speculative_grpc_end_to_end(tmp_path, target):
+    """The gRPC twin of the REST surface: Generate(speculative=true)
+    returns the plain greedy tokens plus acceptance stats; the
+    streaming RPC refuses it (speculation emits verified chunks)."""
+    import grpc
+    import pytest as _pytest
+
+    from kubeflow_tpu.serving import (ModelServer, export_model,
+                                      transformer_export_config)
+    from kubeflow_tpu.serving.grpc_server import PredictClient, serve_grpc
+
+    config, params = target
+    dcfg, dparams = truncate_draft(config, params, 2)
+    export_model(str(tmp_path / "lm"), "transformer", params, version=1,
+                 config=transformer_export_config(config))
+    export_model(str(tmp_path / "lm-draft"), "transformer", dparams,
+                 version=1, config=transformer_export_config(dcfg),
+                 draft_of="lm@1")
+    srv = ModelServer(str(tmp_path), port=0, poll_interval_s=3600)
+    srv.start()
+    grpc_srv, grpc_port = serve_grpc(srv.repo, 0)
+    client = PredictClient(f"127.0.0.1:{grpc_port}")
+    try:
+        prompt = np.asarray([[5, 11, 17, 2]], np.int32)
+        plain, _ = client.generate("lm", prompt, max_new_tokens=8)
+        toks, version, stats = client.generate_speculative(
+            "lm", prompt, max_new_tokens=8, draft_len=3)
+        assert np.array_equal(toks, plain)
+        assert version == 1
+        assert stats["draft"] == "lm-draft@1"
+        assert stats["draft_tokens"] == stats["rounds"] * 3
+        assert 0 <= stats["accepted"] <= stats["draft_tokens"]
+        # streaming + speculative refuses clearly
+        req = client._generate_request(
+            "lm", prompt, max_new_tokens=4, true_len=0, temperature=0.0,
+            seed=0, top_k=0, top_p=1.0, eos_id=None, version=None)
+        req.speculative = True
+        with _pytest.raises(grpc.RpcError) as err:
+            list(client._generate_stream(req, timeout=60))
+        assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    finally:
+        client.close()
+        grpc_srv.stop(grace=None)
+        srv.stop()
+
+
 def test_draft_repairs_and_detaches_on_poll(tmp_path, target):
     """A draft exported AFTER the target loads pairs on the next poll;
     a replacement draft re-pairs; a deleted draft detaches — all
