@@ -30,12 +30,21 @@ single-token step over all of them, forever:
 Static shapes everywhere: the engine batch is fixed at ``slots``, idle
 rows decode garbage that nothing reads (their writes land in rows the
 next insert overwrites), and the compiled-program inventory is small
-and bounded: prefill (per prompt bucket), insert, the general sampled
-step, the all-greedy argmax step (dispatched whenever no in-flight
-request samples — it skips the per-row sampler entirely), and the
+and bounded: prefill (per prompt bucket), the burst batch-prefill (per
+batch-bucket × prompt-bucket — a burst of same-bucket requests admits
+through ONE prefill instead of sequential row prefills), insert (whole
+row and from-batch-row variants), the general sampled step, the
+all-greedy argmax step (dispatched whenever no in-flight request
+samples — it skips the per-row sampler entirely), and the
 prefix-continuation (per suffix bucket). ``precompile=True`` builds
-both step programs up front so a mid-serving workload shift never
-pauses co-tenants on an XLA compile.
+both STEP programs up front, so a greedy↔sampled workload shift never
+pauses co-tenant decode on an XLA compile. Prefill programs (row and
+batch) compile lazily on the first request of each shape, and since
+admission and stepping share the engine thread that first-shape compile
+does pause in-flight streams — pre-existing row-path behavior; the
+batch path adds batch-bucket shapes to the inventory
+(``KFTPU_ADMIT_BATCH=0`` pins admission back to the row path's one
+program per prompt bucket if that matters more than burst TTFT).
 """
 
 from __future__ import annotations
@@ -166,6 +175,7 @@ class DecodeEngine:
                  prefix_cache_entries: int = 4,
                  prefix_cache_bytes: Optional[int] = None,
                  sampler_bound: Optional[int] = None,
+                 admit_batch_max: Optional[int] = None,
                  precompile: bool = False,
                  autostart: bool = True, name: str = "") -> None:
         self.config = config
@@ -178,6 +188,16 @@ class DecodeEngine:
             sampler_bound = int(os.environ.get("KFTPU_SAMPLER_BOUND",
                                                "64"))
         self.sampler_bound = int(sampler_bound)
+        # burst admission: same-bucket pending requests prefill as ONE
+        # batch of up to this many rows. The cap bounds the transient
+        # HBM spike (a batch prefill materializes that many extra
+        # full-context KV rows until their inserts land) and the
+        # compiled-program inventory; <=1 disables batching entirely
+        # (every request takes the row path). KFTPU_ADMIT_BATCH.
+        if admit_batch_max is None:
+            admit_batch_max = int(os.environ.get("KFTPU_ADMIT_BATCH",
+                                                 "8"))
+        self.admit_batch_max = int(admit_batch_max)
         # multi-chip serving: with a Mesh (params already placed with
         # tensor-parallel shardings, e.g. via models.param_partition_specs)
         # every compiled engine program runs under it, and the model's
@@ -228,6 +248,42 @@ class DecodeEngine:
             tok = sample_logits(logits, key, temperature=temperature,
                                 top_k=top_k, top_p=top_p, bound=bnd)
             return tok[0], cache
+
+        @jax.jit
+        def _prefill_batch_and_sample(params, prompts, true_lens, temps,
+                                      top_ks, top_ps, seeds):
+            """Burst admission: same-bucket requests prefill TOGETHER —
+            one compiled (B, S) prefill instead of B sequential row
+            prefills, with per-row ragged lengths and sampling params
+            (the decode core's contract). Burst time-to-first-token
+            drops from B×prefill to ~one batched prefill."""
+            logits, cache = prefill(config, params, prompts, true_lens)
+
+            def one(row_logits, seed, t, k, p):
+                key = jax.random.fold_in(jax.random.key(seed), 0)
+                return sample_logits(row_logits[None], key,
+                                     temperature=t, top_k=k, top_p=p,
+                                     bound=bnd)[0]
+
+            toks = jax.vmap(one)(logits, seeds, temps, top_ks, top_ps)
+            return toks, cache
+
+        self._prefill_batch = _prefill_batch_and_sample
+
+        def _insert_row(engine_cache, batch_cache, row, slot):
+            def put(big, small):
+                ax = _batch_axis(big)
+                piece = jax.lax.dynamic_slice_in_dim(small, row, 1,
+                                                     axis=ax)
+                return jax.lax.dynamic_update_slice(
+                    big, piece.astype(big.dtype),
+                    tuple(slot if a == ax else 0
+                          for a in range(big.ndim)))
+
+            return jax.tree_util.tree_map(put, engine_cache,
+                                          batch_cache)
+
+        self._insert_row = jax.jit(_insert_row, donate_argnums=(0,))
 
         self._continue = _continue_and_sample
         # LRU of prefilled prompt prefixes: (len, token bytes) →
@@ -362,6 +418,7 @@ class DecodeEngine:
         self.steps_total = 0
         self.tokens_total = 0
         self.greedy_steps = 0  # steps served by the argmax fast path
+        self.batch_prefills = 0  # burst admissions served batched
         if precompile:
             self._precompile_steps()
         if autostart:
@@ -526,7 +583,13 @@ class DecodeEngine:
                     jnp.int32(req.seed))
             self._cache = self._insert(self._cache, row_cache,
                                        jnp.int32(slot))
-        first = int(tok)
+        self._finalize_admission(req, slot, int(tok))
+
+    def _finalize_admission(self, req: _Request, slot: int,
+                            first: int) -> None:
+        """Emit the prefill-sampled first token and arm the slot's
+        host-side step state — shared by the row and batch admission
+        paths so their slot initialization can never diverge."""
         st = _Slot(req=req)
         self._emit(st, first)
         if not self._finished(st, first):
@@ -597,26 +660,105 @@ class DecodeEngine:
         return True
 
     def _admit(self, timeout: float) -> bool:
-        """Move pending requests into free slots (prefill + insert)."""
+        """Move pending requests into free slots.
+
+        A BURST of pending requests sharing a prompt bucket admits
+        through ONE compiled batch prefill (``_admit_batch``) instead of
+        sequential row prefills; singletons and prefix-cached requests
+        keep the row path (its compiled programs already exist)."""
         admitted = False
         with self._lock:
             free = [i for i, s in enumerate(self._active) if s is None]
         block = not any(s is not None for s in self._active)
+        batchable: List[tuple] = []  # (req, slot) — no prefix reuse
         for slot in free:
             try:
                 req = self._pending.get(block=block and not admitted,
                                         timeout=timeout)
             except queue.Empty:
                 break
-            try:
-                self._admit_one(req, slot)
-            except Exception as e:  # noqa: BLE001 — surface to the caller
-                req.error = e
-                req.out.put(_END)
             admitted = True
+            if req.prefix_len or self.admit_batch_max <= 1:
+                self._admit_row_safe(req, slot)
+            else:
+                batchable.append((req, slot))
+        if batchable:
+            groups: dict = {}
+            for req, slot in batchable:
+                b = pow2_bucket(req.prompt.size, self.config.max_seq_len)
+                groups.setdefault(b, []).append((req, slot))
+            for bucket, members in groups.items():
+                # chunk to the batch cap (bounds the transient HBM of
+                # the extra full-context rows the batch prefill holds)
+                for i in range(0, len(members), self.admit_batch_max):
+                    chunk = members[i:i + self.admit_batch_max]
+                    if len(chunk) == 1:
+                        self._admit_row_safe(*chunk[0])
+                        continue
+                    try:
+                        self._admit_batch(bucket, chunk)
+                    except Exception:  # noqa: BLE001
+                        # the burst shares one device call; don't let it
+                        # share the failure — retry each member through
+                        # the row path, which fails (or succeeds)
+                        # per-request
+                        log.exception(
+                            "batched admission failed; retrying %d "
+                            "request(s) individually", len(chunk))
+                        for req, slot in chunk:
+                            self._admit_row_safe(req, slot)
         _queue_depth.set(self._pending.qsize(), model=self.name)
         _occupancy.set(self.active_count, model=self.name)
         return admitted
+
+    def _admit_row_safe(self, req: _Request, slot: int) -> None:
+        """Row-path admission that surfaces failure to THIS caller only."""
+        try:
+            self._admit_one(req, slot)
+        except Exception as e:  # noqa: BLE001 — surface to the caller
+            req.error = e
+            req.out.put(_END)
+
+    def _admit_batch(self, bucket: int, members: List[tuple]) -> None:
+        """One shared prefill for same-bucket requests, then per-row
+        inserts into their slots. Rows pad to a power-of-two batch
+        (bounded compiled-program inventory: batch buckets × prompt
+        buckets); pad rows are length-1 junk nothing reads or inserts.
+        Token-identical to the row path: same ragged per-row lengths,
+        same ``fold_in(key(seed), 0)`` sampling."""
+        k = len(members)
+        bb = pow2_bucket(k, min(self.slots, self.admit_batch_max))
+        prompts = np.zeros((bb, bucket), np.int32)
+        lens = np.ones((bb,), np.int32)
+        temps = np.zeros((bb,), np.float32)
+        tks = np.zeros((bb,), np.int32)
+        tps = np.ones((bb,), np.float32)
+        seeds = np.zeros((bb,), np.int32)
+        for i, (req, _) in enumerate(members):
+            S = req.prompt.size
+            prompts[i, :S] = req.prompt
+            lens[i] = S
+            temps[i] = req.temperature
+            tks[i] = req.top_k
+            tps[i] = req.top_p
+            seeds[i] = req.seed
+        with self._mesh_ctx():
+            toks, bcache = self._prefill_batch(
+                self._params, jnp.asarray(prompts), jnp.asarray(lens),
+                jnp.asarray(temps), jnp.asarray(tks), jnp.asarray(tps),
+                jnp.asarray(seeds))
+            # force completion (host transfer — block_until_ready is not
+            # enough on every transport) BEFORE the donating inserts: a
+            # device-side prefill failure must surface while self._cache
+            # is still intact, so _admit's row-path fallback retries
+            # against a live engine instead of a consumed cache
+            toks = np.asarray(toks)
+            for i, (req, slot) in enumerate(members):
+                self._cache = self._insert_row(
+                    self._cache, bcache, jnp.int32(i), jnp.int32(slot))
+        self.batch_prefills += 1
+        for i, (req, slot) in enumerate(members):
+            self._finalize_admission(req, slot, int(toks[i]))
 
     def _loop(self) -> None:
         while not self._stop.is_set():

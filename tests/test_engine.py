@@ -418,6 +418,112 @@ def test_parse_serving_mesh_validation():
         parse_serving_mesh("tp=2,tp=4")
 
 
+def test_burst_admission_batches_prefills_and_matches_oracles(lm):
+    """A burst of same-bucket requests admits through ONE batched
+    prefill (batch_prefills counts it) and every request still matches
+    its solo greedy decode — ragged lengths included."""
+    config, params = lm
+    eng = DecodeEngine(config, params, slots=8, autostart=False)
+    prompts = [[5, 11, 17], [3, 2], [9, 23, 41, 7], [13]]
+    reqs = [eng.submit(p, max_new=5) for p in prompts]
+    for _ in range(10):
+        eng.run_once(timeout=0.01)
+    for p, r in zip(prompts, reqs):
+        assert r.result() == _oracle(config, params, p, 5), p
+    assert eng.batch_prefills >= 1
+
+
+def test_burst_admission_sampled_matches_row_path(lm):
+    """Sampled requests admitted through the batch prefill produce the
+    SAME first token as the row path (same fold_in(seed, 0), same
+    bounded sampler) — the reproducibility contract survives batching."""
+    config, params = lm
+    # row path: submit alone (singleton group -> _admit_one)
+    eng1 = DecodeEngine(config, params, slots=4, autostart=False)
+    solo = eng1.submit([5, 11, 17], max_new=6, temperature=0.8, seed=42)
+    for _ in range(8):
+        eng1.run_once(timeout=0.01)
+    # batch path: same request inside a same-bucket burst
+    eng2 = DecodeEngine(config, params, slots=4, autostart=False)
+    burst = [eng2.submit([5, 11, 17], max_new=6, temperature=0.8,
+                         seed=42),
+             eng2.submit([9, 23, 41], max_new=6, temperature=1.2,
+                         seed=7)]
+    for _ in range(8):
+        eng2.run_once(timeout=0.01)
+    assert eng2.batch_prefills >= 1
+    assert burst[0].result() == solo.result()
+    assert len(burst[1].result()) == 6
+
+
+def test_burst_admission_mixed_buckets_and_prefix(lm):
+    """Different prompt buckets split into groups (each exact); a
+    prefix_len request rides the row path inside the same burst."""
+    config, params = lm
+    eng = DecodeEngine(config, params, slots=8, autostart=False)
+    sys_prompt = [7, 3, 19, 4]
+    reqs = {
+        "short_a": eng.submit([5, 11], max_new=4),
+        "short_b": eng.submit([3, 2], max_new=4),
+        "long_a": eng.submit([9, 23, 41, 7, 2], max_new=4),
+        "long_b": eng.submit([1, 2, 3, 4, 5, 6], max_new=4),
+        "prefixed": eng.submit(sys_prompt + [5, 11], max_new=4,
+                               prefix_len=4),
+    }
+    for _ in range(10):
+        eng.run_once(timeout=0.01)
+    assert reqs["short_a"].result() == _oracle(config, params, [5, 11], 4)
+    assert reqs["short_b"].result() == _oracle(config, params, [3, 2], 4)
+    assert reqs["long_a"].result() == _oracle(config, params,
+                                              [9, 23, 41, 7, 2], 4)
+    assert reqs["long_b"].result() == _oracle(config, params,
+                                              [1, 2, 3, 4, 5, 6], 4)
+    assert reqs["prefixed"].result() == _oracle(config, params,
+                                                sys_prompt + [5, 11], 4)
+    assert eng.prefix_misses == 1  # the prefixed one used the row path
+    assert eng.batch_prefills >= 1
+
+
+def test_burst_admission_caps_batch_and_falls_back(lm):
+    """admit_batch_max chunks a burst (bounding the transient HBM of
+    extra prefill rows); a failing batch prefill retries every member
+    through the row path instead of failing innocents collectively."""
+    config, params = lm
+    eng = DecodeEngine(config, params, slots=8, admit_batch_max=2,
+                       autostart=False)
+    prompts = [[5, 11], [3, 2], [9, 23], [13, 7]]
+    reqs = [eng.submit(p, max_new=3) for p in prompts]
+    for _ in range(6):
+        eng.run_once(timeout=0.01)
+    for p, r in zip(prompts, reqs):
+        assert r.result() == _oracle(config, params, p, 3), p
+    assert eng.batch_prefills == 2  # 4 same-bucket rows, cap 2 → 2 batches
+
+    # batch prefill blows up → row-path fallback still serves everyone
+    eng2 = DecodeEngine(config, params, slots=4, autostart=False)
+
+    def boom(*a, **k):
+        raise RuntimeError("injected batch prefill failure")
+
+    eng2._prefill_batch = boom
+    reqs2 = [eng2.submit(p, max_new=3) for p in prompts[:2]]
+    for _ in range(6):
+        eng2.run_once(timeout=0.01)
+    for p, r in zip(prompts[:2], reqs2):
+        assert r.result() == _oracle(config, params, p, 3), p
+    assert eng2.batch_prefills == 0
+
+    # admit_batch_max<=1 disables batching outright
+    eng3 = DecodeEngine(config, params, slots=4, admit_batch_max=0,
+                        autostart=False)
+    reqs3 = [eng3.submit(p, max_new=3) for p in prompts[:2]]
+    for _ in range(6):
+        eng3.run_once(timeout=0.01)
+    for p, r in zip(prompts[:2], reqs3):
+        assert r.result() == _oracle(config, params, p, 3), p
+    assert eng3.batch_prefills == 0
+
+
 def test_prefix_cache_matches_full_prefill(lm):
     """prefix_len requests must be token-identical to full prefill —
     hit and miss paths both — and the store must actually be hit."""
