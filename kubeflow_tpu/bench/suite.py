@@ -588,19 +588,47 @@ def bench_decode_engine(concurrency: int = 48, slots: int = 32,
                            sampler_bound=sampler_bound,
                            autostart=False, name="bench")
 
-        # warm the compiled programs (prefill bucket, insert, step)
+        # warm the compiled programs: the row prefill, insert, step —
+        # and every batch-prefill bucket burst admission can hit (a
+        # first-shape compile inside the timed window would be measured
+        # as serving time)
         kw = dict(sample_kw) if sampled else {}
-        warm = eng.submit(prompts[0], max_new=steps_per_sync + 1, **kw)
-        drain(eng)
-        list(warm.stream())
+        n = 1
+        while True:
+            warms = [eng.submit(prompts[i % len(prompts)],
+                                max_new=steps_per_sync + 1, **kw)
+                     for i in range(n)]
+            drain(eng)
+            for w in warms:
+                list(w.stream())
+            if n >= min(eng.admit_batch_max, slots):
+                break
+            n *= 2
 
+        steps0, bp0 = eng.steps_total, eng.batch_prefills
         t0 = time.perf_counter()
         reqs = [eng.submit(p, max_new=new_tokens, seed=i, **kw)
                 for i, p in enumerate(prompts)]
+        # burst TTFT: admit the first wave explicitly (one _admit pass
+        # fills every free slot, and each request's first token is
+        # emitted during its prefill sample) and stamp BEFORE any
+        # decode step runs — the number batched admission improves
+        eng._admit(0.01)
+        wave = reqs[:slots]
+        first_all = (time.perf_counter() - t0
+                     if all(r._seen or r.out.qsize() for r in wave)
+                     else None)
         drain(eng)
         total = sum(len(r.result()) for r in reqs)
         dt = time.perf_counter() - t0
-        return round(total / dt / n_chips, 1), eng.steps_total
+        # None (JSON null) when the stamp was invalid (a wave member
+        # unadmitted/errored) — total run time masquerading as TTFT
+        # would poison any A/B read of this number
+        ttft = (round(first_all * 1e3, 1) if first_all is not None
+                else None)
+        return (round(total / dt / n_chips, 1),
+                eng.steps_total - steps0, ttft,
+                eng.batch_prefills - bp0)
 
     # three sampler modes at the same effective batch: greedy rides the
     # argmax fast-path step; "sampled" pays the per-row sampler — the
@@ -608,9 +636,10 @@ def bench_decode_engine(concurrency: int = 48, slots: int = 32,
     # the PERF.md kept/rejected lever (32 vocab sorts per token at
     # slots=32 on the exact path)
     bound = int(os.environ.get("KFTPU_SAMPLER_BOUND", "64"))
-    greedy_tps, engine_steps = run_engine(bound, sampled=False)
-    sampled_bounded_tps, _ = run_engine(bound, sampled=True)
-    sampled_exact_tps, _ = run_engine(0, sampled=True)
+    greedy_tps, engine_steps, ttft_ms, batch_prefills = run_engine(
+        bound, sampled=False)
+    sampled_bounded_tps, _, _, _ = run_engine(bound, sampled=True)
+    sampled_exact_tps, _, _, _ = run_engine(0, sampled=True)
     if profile_dir:
         # trace a short greedy engine run. jit caches are per engine
         # instance, so this engine precompiles its step programs and
@@ -634,6 +663,8 @@ def bench_decode_engine(concurrency: int = 48, slots: int = 32,
         "tokens_per_sec_per_chip": greedy_tps,
         "sampled_bounded_tokens_per_sec_per_chip": sampled_bounded_tps,
         "sampled_exact_sort_tokens_per_sec_per_chip": sampled_exact_tps,
+        "burst_first_tokens_ms": ttft_ms,
+        "batch_prefills": batch_prefills,
         "sampler_bound": bound,
         "sampled_params": sample_kw,
         "effective_batch": slots,

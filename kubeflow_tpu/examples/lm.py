@@ -8,6 +8,7 @@ Resumes from ``KFTPU_CHECKPOINT_DIR`` automatically after a gang restart.
 from __future__ import annotations
 
 import argparse
+import os
 import time
 
 import jax
@@ -47,6 +48,12 @@ def main(argv=None) -> float:
     p.add_argument("--generate", type=int, default=0, metavar="N",
                    help="after training, greedy-decode N tokens as a "
                         "smoke sample")
+    p.add_argument("--draft-layers", type=int, default=0, metavar="L",
+                   help="with --export: also distill an L-layer draft "
+                        "from the trained model and export it as the "
+                        "paired speculative draft (<export>-draft, "
+                        "draft_of pairing)")
+    p.add_argument("--draft-distill-steps", type=int, default=200)
     args = p.parse_args(argv)
 
     penv, mesh = launcher_init(tp=args.tp)
@@ -144,6 +151,25 @@ def _finish(args, config, state) -> None:
             args.export, "transformer", state.params, version=1,
             config=transformer_export_config(config))
         log_metrics(args.steps, exported=vdir)
+        if args.draft_layers:
+            # train → serve WITH speculative decoding, end to end: a
+            # layer-truncated, self-distilled draft exported as this
+            # model's paired draft (serving routes "speculative": true
+            # requests through it; see train/distill.py)
+            from kubeflow_tpu.train.distill import make_draft
+
+            dcfg, dparams, stats = make_draft(
+                config, state.params, n_layers=args.draft_layers,
+                distill_steps=args.draft_distill_steps)
+            name = os.path.basename(os.path.normpath(args.export))
+            droot = os.path.join(os.path.dirname(
+                os.path.normpath(args.export)), f"{name}-draft")
+            ddir = export_model(
+                droot, "transformer", dparams, version=1,
+                config=transformer_export_config(dcfg),
+                draft_of=f"{name}@1")
+            log_metrics(args.steps, draft_exported=ddir,
+                        draft_distill_loss=stats["last_loss"])
 
 
 if __name__ == "__main__":

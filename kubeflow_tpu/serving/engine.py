@@ -97,6 +97,14 @@ class EngineClosed(RuntimeError):
     """The engine was shut down (version rollover) — retryable."""
 
 
+class _CacheInvalidated(RuntimeError):
+    """A donating device call consumed the engine cache and then
+    failed: the engine can never step again. Raised THROUGH run_once so
+    the loop applies the same close-and-evict protocol as a step
+    failure (row-path retries against a consumed cache would fail every
+    request while keeping the corpse serving)."""
+
+
 def pow2_bucket(n: int, cap: int) -> int:
     """Round ``n`` up to a power of two, capped at ``cap`` — the shared
     compiled-program bucketing rule for prompts (one compiled prefill
@@ -697,11 +705,14 @@ class DecodeEngine:
                         continue
                     try:
                         self._admit_batch(bucket, chunk)
+                    except _CacheInvalidated:
+                        raise  # run_once/_loop closes the engine
                     except Exception:  # noqa: BLE001
                         # the burst shares one device call; don't let it
                         # share the failure — retry each member through
                         # the row path, which fails (or succeeds)
-                        # per-request
+                        # per-request (the engine cache is intact: the
+                        # prefill materialized before any donation)
                         log.exception(
                             "batched admission failed; retrying %d "
                             "request(s) individually", len(chunk))
@@ -753,9 +764,20 @@ class DecodeEngine:
             # is still intact, so _admit's row-path fallback retries
             # against a live engine instead of a consumed cache
             toks = np.asarray(toks)
-            for i, (req, slot) in enumerate(members):
-                self._cache = self._insert_row(
-                    self._cache, bcache, jnp.int32(i), jnp.int32(slot))
+            try:
+                for i, (req, slot) in enumerate(members):
+                    self._cache = self._insert_row(
+                        self._cache, bcache, jnp.int32(i),
+                        jnp.int32(slot))
+            except Exception as e:  # noqa: BLE001 — donation consumed
+                # the cache; fail the chunk retryably and escalate so
+                # the loop closes the engine (no row-path retry can
+                # succeed against a consumed cache)
+                for req, _ in members:
+                    req.error = EngineClosed(
+                        "engine cache invalidated during admission")
+                    req.out.put(_END)
+                raise _CacheInvalidated(str(e)) from e
         self.batch_prefills += 1
         for i, (req, slot) in enumerate(members):
             self._finalize_admission(req, slot, int(toks[i]))

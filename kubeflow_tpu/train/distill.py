@@ -164,6 +164,8 @@ def make_draft(config: TransformerConfig, params: Any, *,
     draft_config, draft_params = truncate_draft(config, params, n_layers)
     if distill_steps > 0:
         if corpus is None:
+            # the self-sampled corpus must fit the target's context
+            corpus_len = min(corpus_len, config.max_seq_len)
             corpus = sample_corpus(config, params, n_seqs=corpus_seqs,
                                    seq_len=corpus_len, seed=seed)
         draft_params, stats = distill_draft(

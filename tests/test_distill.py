@@ -89,9 +89,11 @@ def test_distill_reduces_kl_and_raises_acceptance(target):
 
 def test_make_draft_one_call(target):
     config, params = target
+    # corpus_len beyond the target context must clamp, not raise
     dcfg, dparams, stats = make_draft(config, params, n_layers=2,
                                       distill_steps=8, corpus_seqs=8,
-                                      corpus_len=16, batch=4)
+                                      corpus_len=4 * config.max_seq_len,
+                                      batch=4)
     assert dcfg.n_layers == 2
     assert stats["last_loss"] < stats["first_loss"] or stats["last_loss"] < 1e-3
     toks = generate(dcfg, dparams, jnp.asarray([[3, 5]], jnp.int32),

@@ -524,6 +524,38 @@ def test_burst_admission_caps_batch_and_falls_back(lm):
     assert eng3.batch_prefills == 0
 
 
+def test_burst_insert_failure_closes_engine(lm):
+    """A donating insert that fails mid-burst has consumed the engine
+    cache: the chunk fails retryably (EngineClosed, 503-class), the
+    engine self-closes, and the repository-eviction path can rebuild —
+    NOT the row-path retry (which can never succeed against a consumed
+    cache)."""
+    from kubeflow_tpu.serving.engine import EngineClosed
+
+    config, params = lm
+    eng = DecodeEngine(config, params, slots=4)  # autostarted loop
+    try:
+        def boom(*a, **k):
+            raise RuntimeError("injected insert failure")
+
+        eng._insert_row = boom
+        reqs = [eng.submit([5, 11, 17], max_new=4),
+                eng.submit([3, 2, 9], max_new=4)]
+        for r in reqs:
+            with pytest.raises(EngineClosed):
+                r.result()
+        deadline = 50
+        while not eng.closed and deadline:
+            deadline -= 1
+            import time as _t
+            _t.sleep(0.1)
+        assert eng.closed
+        with pytest.raises(EngineClosed):
+            eng.submit([7], max_new=2)
+    finally:
+        eng.close()
+
+
 def test_prefix_cache_matches_full_prefill(lm):
     """prefix_len requests must be token-identical to full prefill —
     hit and miss paths both — and the store must actually be hit."""
