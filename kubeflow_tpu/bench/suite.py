@@ -541,28 +541,18 @@ def bench_decode(batch: int = 8, prompt_len: int = 128,
     }
 
 
-def bench_decode_engine(concurrency: int = 48, slots: int = 32,
-                        prompt_len: int = 128, new_tokens: int = 128,
-                        steps_per_sync: int = 8, d_model: int = 1024,
-                        n_layers: int = 8, n_heads: int = 16,
-                        d_ff: int = 4096,
-                        profile_dir: Optional[str] = None
-                        ) -> Dict[str, Any]:
-    """Continuous-batching serving throughput: ``concurrency`` generate
-    requests share the DecodeEngine's ``slots``-row decode batch
-    (``kubeflow_tpu/serving/engine.py``) — the production :generate
-    path. Decode is HBM-bound per step, so throughput scales with
-    effective batch until cache traffic dominates; this measures the
-    engine at effective batch = ``slots`` (vs ``bench_decode``'s fixed
-    whole-request batch), including prefill, admission, and sampling
-    overheads — the number a capacity planner uses."""
+def engine_bench_setup(concurrency: int = 48, prompt_len: int = 128,
+                       new_tokens: int = 128, d_model: int = 1024,
+                       n_layers: int = 8, n_heads: int = 16,
+                       d_ff: int = 4096):
+    """The decode-engine bench workload: (config, params, prompts).
+    Shared with ``scripts/sync_sweep.py`` so sweeps measure exactly the
+    bench's shapes."""
     import jax
     import jax.numpy as jnp
 
     from kubeflow_tpu.models import Transformer, TransformerConfig
-    from kubeflow_tpu.serving.engine import DecodeEngine
 
-    n_chips = jax.device_count()
     config = TransformerConfig(
         vocab_size=32000, d_model=d_model, n_layers=n_layers,
         n_heads=n_heads, n_kv_heads=n_heads, d_ff=d_ff,
@@ -574,61 +564,109 @@ def bench_decode_engine(concurrency: int = 48, slots: int = 32,
     params = jax.jit(model.init)(
         jax.random.key(1),
         jnp.asarray(prompts[:2]))["params"]
+    return config, params, prompts
+
+
+def engine_drain(eng) -> None:
+    while eng.active_count or not eng._pending.empty():
+        eng.run_once(timeout=0.01)
+
+
+def engine_throughput(config, params, prompts, *, slots: int,
+                      steps_per_sync: int, new_tokens: int,
+                      sampler_bound: Optional[int], sampled: bool,
+                      sample_kw: Optional[Dict[str, Any]] = None,
+                      name: str = "bench"):
+    """tokens/sec through a fresh engine (params shared in HBM).
+    Returns (tok/s/chip, engine steps, burst TTFT ms, batch prefills)."""
+    import jax
+
+    from kubeflow_tpu.serving.engine import DecodeEngine
+
+    n_chips = jax.device_count()
+    eng = DecodeEngine(config, params, slots=slots,
+                       steps_per_sync=steps_per_sync,
+                       sampler_bound=sampler_bound,
+                       autostart=False, name=name)
+
+    # warm the compiled programs: the row prefill, insert, step —
+    # and every batch-prefill bucket burst admission can hit (a
+    # first-shape compile inside the timed window would be measured
+    # as serving time)
+    kw = dict(sample_kw) if sampled and sample_kw else {}
+    n = 1
+    while True:
+        warms = [eng.submit(prompts[i % len(prompts)],
+                            max_new=steps_per_sync + 1, **kw)
+                 for i in range(n)]
+        engine_drain(eng)
+        for w in warms:
+            list(w.stream())
+        if n >= min(eng.admit_batch_max, slots):
+            break
+        n *= 2
+
+    steps0, bp0 = eng.steps_total, eng.batch_prefills
+    t0 = time.perf_counter()
+    reqs = [eng.submit(p, max_new=new_tokens, seed=i, **kw)
+            for i, p in enumerate(prompts)]
+    # burst TTFT: admit the first wave explicitly (one _admit pass
+    # fills every free slot, and each request's first token is
+    # emitted during its prefill sample) and stamp BEFORE any
+    # decode step runs — the number batched admission improves
+    eng._admit(0.01)
+    wave = reqs[:slots]
+    first_all = (time.perf_counter() - t0
+                 if all(r._seen or r.out.qsize() for r in wave)
+                 else None)
+    engine_drain(eng)
+    total = sum(len(r.result()) for r in reqs)
+    dt = time.perf_counter() - t0
+    # None (JSON null) when the stamp was invalid (a wave member
+    # unadmitted/errored) — total run time masquerading as TTFT
+    # would poison any A/B read of this number
+    ttft = (round(first_all * 1e3, 1) if first_all is not None
+            else None)
+    return (round(total / dt / n_chips, 1),
+            eng.steps_total - steps0, ttft,
+            eng.batch_prefills - bp0)
+
+
+def bench_decode_engine(concurrency: int = 48, slots: int = 32,
+                        prompt_len: int = 128, new_tokens: int = 128,
+                        steps_per_sync: int = 64, d_model: int = 1024,
+                        n_layers: int = 8, n_heads: int = 16,
+                        d_ff: int = 4096,
+                        profile_dir: Optional[str] = None
+                        ) -> Dict[str, Any]:
+    """Continuous-batching serving throughput: ``concurrency`` generate
+    requests share the DecodeEngine's ``slots``-row decode batch
+    (``kubeflow_tpu/serving/engine.py``) — the production :generate
+    path. Decode is HBM-bound per step, so throughput scales with
+    effective batch until cache traffic dominates; this measures the
+    engine at effective batch = ``slots`` (vs ``bench_decode``'s fixed
+    whole-request batch), including prefill, admission, and sampling
+    overheads — the number a capacity planner uses. ``steps_per_sync``
+    defaults to the r5 sweep's measured optimum (PERF.md, 64 — the
+    throughput configuration; serving's latency-bound default lives in
+    the manifest)."""
+    import jax
+
+    from kubeflow_tpu.serving.engine import DecodeEngine
+
+    n_chips = jax.device_count()
+    config, params, prompts = engine_bench_setup(
+        concurrency, prompt_len, new_tokens, d_model, n_layers,
+        n_heads, d_ff)
 
     sample_kw = {"temperature": 0.8, "top_k": 40, "top_p": 0.95}
 
-    def drain(eng):
-        while eng.active_count or not eng._pending.empty():
-            eng.run_once(timeout=0.01)
-
     def run_engine(sampler_bound: Optional[int], sampled: bool):
-        """tokens/sec through a fresh engine (params shared in HBM)."""
-        eng = DecodeEngine(config, params, slots=slots,
-                           steps_per_sync=steps_per_sync,
-                           sampler_bound=sampler_bound,
-                           autostart=False, name="bench")
-
-        # warm the compiled programs: the row prefill, insert, step —
-        # and every batch-prefill bucket burst admission can hit (a
-        # first-shape compile inside the timed window would be measured
-        # as serving time)
-        kw = dict(sample_kw) if sampled else {}
-        n = 1
-        while True:
-            warms = [eng.submit(prompts[i % len(prompts)],
-                                max_new=steps_per_sync + 1, **kw)
-                     for i in range(n)]
-            drain(eng)
-            for w in warms:
-                list(w.stream())
-            if n >= min(eng.admit_batch_max, slots):
-                break
-            n *= 2
-
-        steps0, bp0 = eng.steps_total, eng.batch_prefills
-        t0 = time.perf_counter()
-        reqs = [eng.submit(p, max_new=new_tokens, seed=i, **kw)
-                for i, p in enumerate(prompts)]
-        # burst TTFT: admit the first wave explicitly (one _admit pass
-        # fills every free slot, and each request's first token is
-        # emitted during its prefill sample) and stamp BEFORE any
-        # decode step runs — the number batched admission improves
-        eng._admit(0.01)
-        wave = reqs[:slots]
-        first_all = (time.perf_counter() - t0
-                     if all(r._seen or r.out.qsize() for r in wave)
-                     else None)
-        drain(eng)
-        total = sum(len(r.result()) for r in reqs)
-        dt = time.perf_counter() - t0
-        # None (JSON null) when the stamp was invalid (a wave member
-        # unadmitted/errored) — total run time masquerading as TTFT
-        # would poison any A/B read of this number
-        ttft = (round(first_all * 1e3, 1) if first_all is not None
-                else None)
-        return (round(total / dt / n_chips, 1),
-                eng.steps_total - steps0, ttft,
-                eng.batch_prefills - bp0)
+        return engine_throughput(
+            config, params, prompts, slots=slots,
+            steps_per_sync=steps_per_sync, new_tokens=new_tokens,
+            sampler_bound=sampler_bound, sampled=sampled,
+            sample_kw=sample_kw)
 
     # three sampler modes at the same effective batch: greedy rides the
     # argmax fast-path step; "sampled" pays the per-row sampler — the
@@ -653,11 +691,11 @@ def bench_decode_engine(concurrency: int = 48, slots: int = 32,
                            sampler_bound=bound, precompile=True,
                            autostart=False, name="bench-trace")
         warm = eng.submit(prompts[0], max_new=steps_per_sync + 1)
-        drain(eng)
+        engine_drain(eng)
         list(warm.stream())
         eng.submit(prompts[0], max_new=min(new_tokens,
                                            4 * steps_per_sync))
-        _capture_trace(lambda: drain(eng), lambda: None, profile_dir,
+        _capture_trace(lambda: engine_drain(eng), lambda: None, profile_dir,
                        n_steps=1)
     return {
         "tokens_per_sec_per_chip": greedy_tps,

@@ -278,20 +278,37 @@ class DecodeEngine:
 
         self._prefill_batch = _prefill_batch_and_sample
 
-        def _insert_row(engine_cache, batch_cache, row, slot):
-            def put(big, small):
+        def _insert_rows(engine_cache, batch_cache, slot_ids, valid):
+            """Insert every valid batch-prefill row into its engine slot
+            in ONE device dispatch (a scan of per-row dynamic updates).
+            Burst admission used to pay one dispatch per member; on
+            high-dispatch-latency transports those per-row launches
+            dominated admission wall time (measured round 5: 48 inserts
+            ≈ 1.4 s of the engine bench's 4.2 s). Pad rows (``valid``
+            False) write a slot's current contents back — a no-op."""
+
+            def put(big, small, row, slot, ok):
                 ax = _batch_axis(big)
-                piece = jax.lax.dynamic_slice_in_dim(small, row, 1,
-                                                     axis=ax)
+                piece = jax.lax.dynamic_slice_in_dim(
+                    small, row, 1, axis=ax).astype(big.dtype)
+                idx = tuple(slot if a == ax else 0
+                            for a in range(big.ndim))
+                cur = jax.lax.dynamic_slice(big, idx, piece.shape)
                 return jax.lax.dynamic_update_slice(
-                    big, piece.astype(big.dtype),
-                    tuple(slot if a == ax else 0
-                          for a in range(big.ndim)))
+                    big, jnp.where(ok, piece, cur), idx)
 
-            return jax.tree_util.tree_map(put, engine_cache,
-                                          batch_cache)
+            def body(cache, xs):
+                row, slot, ok = xs
+                return jax.tree_util.tree_map(
+                    lambda big, small: put(big, small, row, slot, ok),
+                    cache, batch_cache), None
 
-        self._insert_row = jax.jit(_insert_row, donate_argnums=(0,))
+            cache, _ = jax.lax.scan(
+                body, engine_cache,
+                (jnp.arange(slot_ids.shape[0]), slot_ids, valid))
+            return cache
+
+        self._insert_rows = jax.jit(_insert_rows, donate_argnums=(0,))
 
         self._continue = _continue_and_sample
         # LRU of prefilled prompt prefixes: (len, token bytes) →
@@ -745,7 +762,9 @@ class DecodeEngine:
         tks = np.zeros((bb,), np.int32)
         tps = np.ones((bb,), np.float32)
         seeds = np.zeros((bb,), np.int32)
-        for i, (req, _) in enumerate(members):
+        slot_ids = np.zeros((bb,), np.int32)
+        valid = np.zeros((bb,), bool)
+        for i, (req, slot) in enumerate(members):
             S = req.prompt.size
             prompts[i, :S] = req.prompt
             lens[i] = S
@@ -753,6 +772,8 @@ class DecodeEngine:
             tks[i] = req.top_k
             tps[i] = req.top_p
             seeds[i] = req.seed
+            slot_ids[i] = slot
+            valid[i] = True
         with self._mesh_ctx():
             toks, bcache = self._prefill_batch(
                 self._params, jnp.asarray(prompts), jnp.asarray(lens),
@@ -765,10 +786,9 @@ class DecodeEngine:
             # against a live engine instead of a consumed cache
             toks = np.asarray(toks)
             try:
-                for i, (req, slot) in enumerate(members):
-                    self._cache = self._insert_row(
-                        self._cache, bcache, jnp.int32(i),
-                        jnp.int32(slot))
+                self._cache = self._insert_rows(
+                    self._cache, bcache, jnp.asarray(slot_ids),
+                    jnp.asarray(valid))
             except Exception as e:  # noqa: BLE001 — donation consumed
                 # the cache; fail the chunk retryably and escalate so
                 # the loop closes the engine (no row-path retry can

@@ -1,0 +1,62 @@
+"""Chip experiment: decode-engine steps_per_sync sweep + dispatch RTT probe.
+
+The r5 measurement decomposed the engine's 1516 tok/s (slots=32,
+sync=8) into a per-dispatch fixed wall cost plus a marginal per-step
+cost; steps_per_sync is the designed amortization lever. This sweeps it
+over EXACTLY the decode-engine bench's workload (the setup/throughput
+helpers are shared with ``bench_decode_engine``) and prints one JSON
+line per point for PERF.md. Env: SWEEP_CONCURRENCY, SWEEP_SLOTS.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.bench.suite import (
+        engine_bench_setup,
+        engine_throughput,
+    )
+
+    # -- dispatch RTT probe: tiny jit op, timed round-trips ---------------
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros((8,), jnp.float32)
+    f(x).block_until_ready()
+    ts = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        f(x).block_until_ready()
+        ts.append((time.perf_counter() - t0) * 1e3)
+    ts.sort()
+    print(json.dumps({"probe": "dispatch_rtt_ms",
+                      "p50": round(ts[len(ts) // 2], 2),
+                      "min": round(ts[0], 2), "max": round(ts[-1], 2)}),
+          flush=True)
+
+    concurrency = int(os.environ.get("SWEEP_CONCURRENCY", "48"))
+    slots = int(os.environ.get("SWEEP_SLOTS", "32"))
+    new_tokens = 128
+    config, params, prompts = engine_bench_setup(concurrency=concurrency,
+                                                 new_tokens=new_tokens)
+
+    for sync in [int(a) for a in sys.argv[1:]] or [8, 16, 32, 64]:
+        t0 = time.perf_counter()
+        tps, steps, _, _ = engine_throughput(
+            config, params, prompts, slots=slots, steps_per_sync=sync,
+            new_tokens=new_tokens, sampler_bound=64, sampled=False,
+            name=f"sweep{sync}")
+        print(json.dumps({
+            "steps_per_sync": sync, "slots": slots,
+            "tokens_per_sec_per_chip": tps,
+            "engine_steps": steps,
+            "wall_s": round(time.perf_counter() - t0, 2)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

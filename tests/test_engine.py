@@ -538,7 +538,7 @@ def test_burst_insert_failure_closes_engine(lm):
         def boom(*a, **k):
             raise RuntimeError("injected insert failure")
 
-        eng._insert_row = boom
+        eng._insert_rows = boom
         reqs = [eng.submit([5, 11, 17], max_new=4),
                 eng.submit([3, 2, 9], max_new=4)]
         for r in reqs:
