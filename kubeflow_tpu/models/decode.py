@@ -336,27 +336,7 @@ def speculative_generate(config: TransformerConfig, params,
     """
     B, S = prompt.shape
     k = int(draft_len)
-    if k < 1:
-        raise ValueError("draft_len must be >= 1")
-    if config.vocab_size != draft_config.vocab_size:
-        raise ValueError("draft and target must share a vocabulary")
-    # each round may advance up to k cache slots past the final output;
-    # the real footprint starts at the TRUE prompt length when known
-    # eagerly (a traced true_len is the caller's contract, like
-    # generate())
-    if true_len is None:
-        start = S
-    elif isinstance(true_len, jax.core.Tracer):
-        start = None
-    else:
-        start = int(jnp.max(jnp.asarray(true_len)))
-    for name, c in (("target", config), ("draft", draft_config)):
-        if start is not None and start + max_new_tokens + k > c.max_seq_len:
-            raise ValueError(
-                f"prompt {start} + max_new_tokens {max_new_tokens} + "
-                f"draft_len {k} exceeds {name} max_seq_len "
-                f"{c.max_seq_len} (speculation needs slack for "
-                "in-flight proposals)")
+    _spec_validate(config, draft_config, S, max_new_tokens, k, true_len)
 
     t_logits, t_cache = _prefill_jit(config)(params, prompt, true_len)
     _, d_cache = _prefill_jit(draft_config)(draft_params, prompt,
@@ -397,6 +377,87 @@ def _prefill_jit(config: TransformerConfig):
     return jax.jit(functools.partial(prefill, config))
 
 
+def _spec_validate(config: TransformerConfig,
+                   draft_config: TransformerConfig, prompt_width: int,
+                   max_new_tokens: int, k: int, true_len) -> None:
+    """Shared eager validation for the speculative variants.
+
+    Each round may advance up to ``k`` cache slots past the final
+    output; the real footprint starts at the TRUE prompt length when
+    known eagerly (a traced ``true_len`` is the caller's contract, like
+    ``generate()``)."""
+    if k < 1:
+        raise ValueError("draft_len must be >= 1")
+    if config.vocab_size != draft_config.vocab_size:
+        raise ValueError("draft and target must share a vocabulary")
+    if true_len is None:
+        start: Optional[int] = prompt_width
+    elif isinstance(true_len, jax.core.Tracer):
+        start = None
+    else:
+        start = int(jnp.max(jnp.asarray(true_len)))
+    for name, c in (("target", config), ("draft", draft_config)):
+        if start is not None and start + max_new_tokens + k > c.max_seq_len:
+            raise ValueError(
+                f"prompt {start} + max_new_tokens {max_new_tokens} + "
+                f"draft_len {k} exceeds {name} max_seq_len "
+                f"{c.max_seq_len} (speculation needs slack for "
+                "in-flight proposals)")
+
+
+def _spec_round_body(ragged_config: TransformerConfig,
+                     draft_config: TransformerConfig, k: int,
+                     params, draft_params, t_cache, d_cache, pending):
+    """One propose-verify-rollback round (traceable; shared by the
+    per-round jit and the fused while_loop path)."""
+    B = pending.shape[0]
+
+    def dstep(carry, _):
+        cache, tok = carry
+        logits, cache = decode_step(draft_config, draft_params,
+                                    cache, tok)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (cache, nxt), nxt
+
+    (d_cache2, _), xs = jax.lax.scan(dstep, (d_cache, pending),
+                                     None, length=k)
+    xs = xs.T  # (B, k): proposals x1..xk
+    # verify: the target processes (pending, x1..x_{k-1}) in one
+    # forward; logits[i] is its prediction for position i+1
+    seq = jnp.concatenate([pending[:, None], xs[:, :k - 1]], axis=1)
+    model = _decode_model(ragged_config)
+    logits, variables = model.apply(
+        {"params": params, "cache": t_cache}, seq, mutable=["cache"])
+    t_cache2 = variables["cache"]
+    preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, k)
+    match = xs == preds
+    # accepted = length of the all-True prefix
+    n = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+    idx = jnp.arange(k)[None, :]
+    rows = jnp.arange(B)
+    correction = preds[rows, jnp.minimum(n, k - 1)]
+    out = jnp.where(idx < n[:, None], xs, 0)
+    # at index n the target's own token replaces the rejected one
+    out = jnp.where(idx == n[:, None], correction[:, None], out)
+    m = jnp.where(n < k, n + 1, k)  # emitted this round, per row
+    new_pending = jnp.where(n < k, correction, xs[:, k - 1])
+    # rollback-by-reset: the verify advanced every row k slots, but
+    # only (pending, x1..x_n) are valid — n+1 entries on rejection
+    # rounds, all k on full acceptance (x_k was proposed, never
+    # written). Pull each row back by the overshoot.
+    delta = jnp.maximum(k - n - 1, 0)
+
+    def reset(path, leaf):
+        if path[-1].key != "positions":
+            return leaf
+        return (leaf - jnp.broadcast_to(delta, leaf.shape)
+                ).astype(leaf.dtype)
+
+    t_cache2 = jax.tree_util.tree_map_with_path(reset, t_cache2)
+    d_cache2 = jax.tree_util.tree_map_with_path(reset, d_cache2)
+    return t_cache2, d_cache2, out, m, new_pending, n
+
+
 @functools.lru_cache(maxsize=16)
 def _spec_round_fn(config: TransformerConfig,
                    draft_config: TransformerConfig, k: int):
@@ -408,54 +469,114 @@ def _spec_round_fn(config: TransformerConfig,
 
     @jax.jit
     def spec_round(params, draft_params, t_cache, d_cache, pending):
-        B = pending.shape[0]
-
-        def dstep(carry, _):
-            cache, tok = carry
-            logits, cache = decode_step(draft_config, draft_params,
-                                        cache, tok)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return (cache, nxt), nxt
-
-        (d_cache2, _), xs = jax.lax.scan(dstep, (d_cache, pending),
-                                         None, length=k)
-        xs = xs.T  # (B, k): proposals x1..xk
-        # verify: the target processes (pending, x1..x_{k-1}) in one
-        # forward; logits[i] is its prediction for position i+1
-        seq = jnp.concatenate([pending[:, None], xs[:, :k - 1]], axis=1)
-        model = _decode_model(ragged)
-        logits, variables = model.apply(
-            {"params": params, "cache": t_cache}, seq, mutable=["cache"])
-        t_cache2 = variables["cache"]
-        preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, k)
-        match = xs == preds
-        # accepted = length of the all-True prefix
-        n = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
-        idx = jnp.arange(k)[None, :]
-        rows = jnp.arange(B)
-        correction = preds[rows, jnp.minimum(n, k - 1)]
-        out = jnp.where(idx < n[:, None], xs, 0)
-        # at index n the target's own token replaces the rejected one
-        out = jnp.where(idx == n[:, None], correction[:, None], out)
-        m = jnp.where(n < k, n + 1, k)  # emitted this round, per row
-        new_pending = jnp.where(n < k, correction, xs[:, k - 1])
-        # rollback-by-reset: the verify advanced every row k slots, but
-        # only (pending, x1..x_n) are valid — n+1 entries on rejection
-        # rounds, all k on full acceptance (x_k was proposed, never
-        # written). Pull each row back by the overshoot.
-        delta = jnp.maximum(k - n - 1, 0)
-
-        def reset(path, leaf):
-            if path[-1].key != "positions":
-                return leaf
-            return (leaf - jnp.broadcast_to(delta, leaf.shape)
-                    ).astype(leaf.dtype)
-
-        t_cache2 = jax.tree_util.tree_map_with_path(reset, t_cache2)
-        d_cache2 = jax.tree_util.tree_map_with_path(reset, d_cache2)
-        return t_cache2, d_cache2, out, m, new_pending, n
+        return _spec_round_body(ragged, draft_config, k, params,
+                                draft_params, t_cache, d_cache, pending)
 
     return spec_round
+
+
+def speculative_generate_fused(config: TransformerConfig, params,
+                               draft_config: TransformerConfig,
+                               draft_params, prompt: jnp.ndarray, *,
+                               max_new_tokens: int, draft_len: int = 4,
+                               true_len: Optional[jnp.ndarray] = None):
+    """:func:`speculative_generate` as ONE traceable program: prefills,
+    every propose-verify-rollback round (``lax.while_loop``), and token
+    assembly all compile into a single XLA computation.
+
+    The host-loop variant pays one device dispatch per round; whenever
+    dispatch/transfer latency is non-negligible (remote transports,
+    small models) those round-trips dominate wall time — measured round
+    5: ~224 ms/round over the tunneled chip vs sub-ms of device compute.
+    Fused, speculation is a single dispatch exactly like the plain
+    ``generate`` scan, so the comparison is pure compute: a round costs
+    one k-token target verify plus k draft steps for ``1 + acceptance·k``
+    emitted tokens.
+
+    Identical round math to ``speculative_generate`` (f32-exact parity
+    is test-gated; at bf16 XLA may fuse the two variants differently, so
+    near-tie argmaxes can diverge — each stream remains a valid greedy
+    stream of the target up to tie-breaks). Ragged rows: a finished row
+    keeps stepping until the slowest row completes; its overshoot
+    tokens land past ``max_new_tokens`` in the output buffer (scatter-
+    drop) and its cache writes past ``max_seq_len`` are dropped by the
+    same out-of-bounds semantics the host variant documents.
+
+    Returns ``(tokens (B, max_new_tokens) int32, stats)``; stats values
+    are 0-d device arrays under tracing (``int()`` them outside jit).
+    Wrap in ``jax.jit`` with params/prompt as ARGUMENTS (closing over
+    params embeds the weights as program constants).
+    """
+    B, S = prompt.shape
+    k = int(draft_len)
+    _spec_validate(config, draft_config, S, max_new_tokens, k, true_len)
+
+    ragged = dataclasses.replace(config, ragged_decode=True)
+    t_logits, t_cache = prefill(config, params, prompt, true_len)
+    _, d_cache = prefill(draft_config, draft_params, prompt, true_len)
+    first = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+
+    # buffer slack: a row at counts==max_new-1 can still write m<=k
+    # tokens; masked positions index `cap` and are scatter-dropped
+    cap = max_new_tokens + k + 1
+    out_buf = jnp.zeros((B, cap), jnp.int32).at[:, 0].set(first)
+    counts = jnp.ones((B,), jnp.int32)
+    rows = jnp.arange(B)
+    idx = jnp.arange(k)[None, :]
+
+    def cond(carry):
+        return jnp.min(carry[4]) < max_new_tokens
+
+    def body(carry):
+        t_cache, d_cache, pending, out_buf, counts, rounds, acc = carry
+        t_cache, d_cache, out, m, pending, n = _spec_round_body(
+            ragged, draft_config, k, params, draft_params, t_cache,
+            d_cache, pending)
+        pos = jnp.where(idx < m[:, None], counts[:, None] + idx, cap)
+        out_buf = out_buf.at[rows[:, None], pos].set(out, mode="drop")
+        return (t_cache, d_cache, pending, out_buf, counts + m,
+                rounds + 1, acc + jnp.sum(n))
+
+    carry = (t_cache, d_cache, first, out_buf, counts,
+             jnp.int32(0), jnp.int32(0))
+    _, _, _, out_buf, _, rounds, accepted = jax.lax.while_loop(
+        cond, body, carry)
+    stats = {"rounds": rounds, "draft_tokens": rounds * k,
+             "accepted": accepted}
+    return out_buf[:, :max_new_tokens], stats
+
+
+@functools.lru_cache(maxsize=16)
+def _spec_fused_fn(config: TransformerConfig,
+                   draft_config: TransformerConfig, k: int,
+                   max_new_tokens: int):
+    @jax.jit
+    def fn(params, draft_params, prompt, true_len):
+        return speculative_generate_fused(
+            config, params, draft_config, draft_params, prompt,
+            max_new_tokens=max_new_tokens, draft_len=k,
+            true_len=true_len)
+
+    return fn
+
+
+def speculative_generate_jit(config: TransformerConfig, params,
+                             draft_config: TransformerConfig,
+                             draft_params, prompt: jnp.ndarray, *,
+                             max_new_tokens: int, draft_len: int = 4,
+                             true_len: Optional[jnp.ndarray] = None):
+    """Serving entry for fused speculation: eager validation (the slack
+    ValueError serving maps to 400 fires before any device work) + a
+    cached compiled program per (configs, draft_len, max_new_tokens,
+    shapes). Stats come back as Python ints like the host-loop
+    variant's."""
+    B, S = prompt.shape
+    _spec_validate(config, draft_config, S, max_new_tokens,
+                   int(draft_len), true_len)
+    fn = _spec_fused_fn(config, draft_config, int(draft_len),
+                        int(max_new_tokens))
+    toks, stats = fn(params, draft_params, prompt, true_len)
+    return toks, {key: int(np.asarray(v)) for key, v in stats.items()}
 
 
 def make_generate(config: TransformerConfig, *, max_new_tokens: int,

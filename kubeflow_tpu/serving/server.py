@@ -299,21 +299,43 @@ def _run_generate_speculative(model, draft, arr, lens_arr, *, max_new,
     the draft pays for itself. Batches are served at their exact size
     (no filler-row padding — filler would contaminate the acceptance
     rate)."""
-    from kubeflow_tpu.models.decode import speculative_generate
+    # the FUSED variant: the whole propose-verify loop is one compiled
+    # program per (configs, draft_len, max_new, shape bucket) — the
+    # host-loop variant pays a device dispatch per round, which
+    # dominates request latency on remote-transport deployments
+    from kubeflow_tpu.models.decode import speculative_generate_jit
 
     true_len = int(lens_arr.max())
     bucket = pow2_bucket(true_len, ctx)
-    if bucket < true_len:
-        return 400, {"error": f"prompt ({true_len}) exceeds the model "
-                              f"context ({ctx})"}
+    # max_new buckets like the plain path (server.py:237) — the fused
+    # program is keyed by (configs, draft_len, max_new, shapes), so a
+    # client sweeping max_new_tokens must not mint unbounded compiled
+    # two-model while_loop programs. The budget subtracts draft_len
+    # from BOTH contexts: speculation keeps up to draft_len in-flight
+    # proposals past the output.
+    budget = max(min(ctx, draft.config.max_seq_len)
+                 - true_len - draft_len, 0)
+    new_bucket = pow2_bucket(max_new, 1 << 30)
+    while new_bucket > budget:
+        new_bucket //= 2
+    if new_bucket < max_new <= budget:
+        # exact ask fits but its pow2 bucket doesn't — rare tail, the
+        # per-value compile is acceptable
+        new_bucket = max_new
+    if bucket < true_len or new_bucket < max_new:
+        return 400, {"error": f"prompt ({true_len}) + max_new_tokens "
+                              f"({max_new}) + draft_len ({draft_len}) "
+                              f"exceed the model context ({ctx}); "
+                              "speculation needs slack for in-flight "
+                              "proposals"}
     padded = np.zeros((arr.shape[0], bucket), np.int32)
     padded[:, :arr.shape[1]] = arr
     t0 = time.perf_counter()
     try:
-        toks, stats = speculative_generate(
+        toks, stats = speculative_generate_jit(
             model.lm_config, model.lm_params,
             draft.config, draft.params,
-            jnp.asarray(padded), max_new_tokens=max_new,
+            jnp.asarray(padded), max_new_tokens=new_bucket,
             draft_len=draft_len, true_len=jnp.asarray(lens_arr))
     except ValueError as e:
         # the context-slack check (prompt + max_new + draft_len must fit
@@ -323,7 +345,9 @@ def _run_generate_speculative(model, draft, arr, lens_arr, *, max_new,
         return 500, {"error": f"generate failed: "
                               f"{type(e).__name__}: {e}"}
     dt = time.perf_counter() - t0
-    out = np.asarray(toks)
+    # stats (rounds/draft/accepted) describe the bucket-width run — the
+    # actual work done — while tokens return only the requested width
+    out = np.asarray(toks)[:, :max_new]
     rate = stats["accepted"] / max(stats["draft_tokens"], 1)
     _gen_requests.inc(model=model_name)
     _gen_latency.set(dt, model=model_name)

@@ -118,9 +118,13 @@ def distill_draft(target_config: TransformerConfig, target_params: Any,
     tx = optax.adamw(lr)
     opt_state = tx.init(draft_params)
 
+    # target params enter as a jit ARGUMENT: closing over them would
+    # embed the full frozen target as HLO constants — catastrophic at
+    # real model sizes (a 167M-param target is a ~334 MB program body;
+    # remote-compile transports reject it outright)
     @jax.jit
-    def step(dparams, opt_state, tokens):
-        t_logits = target.apply({"params": target_params}, tokens)
+    def step(dparams, opt_state, tokens, tparams):
+        t_logits = target.apply({"params": tparams}, tokens)
         t_probs = jax.nn.softmax(t_logits.astype(jnp.float32), axis=-1)
         t_logp = jax.nn.log_softmax(t_logits.astype(jnp.float32), -1)
 
@@ -144,7 +148,8 @@ def distill_draft(target_config: TransformerConfig, target_params: Any,
     for _ in range(steps):
         rows = rng.integers(0, n, size=(batch,))
         draft_params, opt_state, loss = step(
-            draft_params, opt_state, jnp.asarray(corpus[rows]))
+            draft_params, opt_state, jnp.asarray(corpus[rows]),
+            target_params)
         if first_loss is None:
             first_loss = float(loss)
     return draft_params, {"first_loss": round(float(first_loss or 0), 4),

@@ -1,0 +1,146 @@
+"""Chip experiment: speculative decoding speedup on the bench LM.
+
+Builds the decode bench's 167M-param target (d1024/L8), TRAINS it
+briefly on a mixed deterministic/noise next-token task (a random-init
+target's logits are near-uniform, so every argmax is a bf16 coin flip
+between programs and acceptance measures ~0 regardless of draft
+quality), distills a 2-layer draft from the trained target's own
+generations (`train/distill.py:make_draft` — the productized recipe),
+then measures single-stream FUSED greedy decode vs FUSED speculative
+decode wall tok/s at several draft_len k. Speculation is the LATENCY
+lever (the engine is the throughput lever), so batch 1 is the honest
+configuration. Prints JSON lines for PERF.md.
+
+The deterministic fraction of the task (SPEC_DET_FRAC, default 0.8)
+sets the ceiling on acceptance: predictable tokens the draft can learn
+vs noise tokens nobody can — a dial for the acceptance regime.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+
+from kubeflow_tpu.models import Transformer, TransformerConfig
+from kubeflow_tpu.models.decode import (generate,
+                                        speculative_generate_jit)
+from kubeflow_tpu.train.distill import make_draft
+
+
+def _task_batch(rng, batch, seq_len, vocab, det_frac):
+    """Sequences where each next token is a fixed affine map of the
+    previous with prob det_frac, else uniform noise — over a SMALL
+    active vocabulary (256 ids), so the map is learnable in a few
+    hundred steps (a full 32k permutation is not)."""
+    active = min(256, vocab)
+    toks = np.zeros((batch, seq_len), np.int64)
+    toks[:, 0] = rng.integers(0, active, batch)
+    det = rng.random((batch, seq_len)) < det_frac
+    noise = rng.integers(0, active, (batch, seq_len))
+    for t in range(1, seq_len):
+        mapped = (toks[:, t - 1] * 31 + 7) % active
+        toks[:, t] = np.where(det[:, t], mapped, noise[:, t])
+    return jnp.asarray(toks.astype(np.int32))
+
+
+def main():
+    prompt_len, new_tokens = 128, 128
+    # +16 slack: speculation needs room for in-flight draft proposals
+    config = TransformerConfig(
+        vocab_size=32000, d_model=1024, n_layers=8, n_heads=16,
+        n_kv_heads=16, d_ff=4096,
+        max_seq_len=prompt_len + new_tokens + 16, remat=False)
+    model = Transformer(config)
+    rng = np.random.default_rng(0)
+    params = jax.jit(model.init)(
+        jax.random.key(1), jnp.zeros((1, 2), jnp.int32))["params"]
+
+    # -- train the target so its argmax is peaked, not a coin flip ----
+    det_frac = float(os.environ.get("SPEC_DET_FRAC", "0.8"))
+    train_steps = int(os.environ.get("SPEC_TRAIN_STEPS", "150"))
+    tx = optax.adamw(3e-4)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, tokens):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, tokens[:, :-1])
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            nll = -jnp.take_along_axis(
+                logp, tokens[:, 1:, None], axis=-1)
+            return jnp.mean(nll)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    t0 = time.perf_counter()
+    loss = None
+    for _ in range(train_steps):
+        batch = _task_batch(rng, 8, 128, config.vocab_size, det_frac)
+        params, opt_state, loss = train_step(params, opt_state, batch)
+    print(json.dumps({"phase": "train_target", "steps": train_steps,
+                      "det_frac": det_frac,
+                      "final_loss": round(float(loss), 3),
+                      "wall_s": round(time.perf_counter() - t0, 1)}),
+          flush=True)
+
+    prompt = _task_batch(rng, 1, prompt_len, config.vocab_size,
+                         det_frac)
+
+    t0 = time.perf_counter()
+    draft_config, draft_params, stats = make_draft(
+        config, params, n_layers=int(os.environ.get("SPEC_DRAFT_LAYERS",
+                                                    "2")),
+        distill_steps=int(os.environ.get("SPEC_DISTILL_STEPS", "150")))
+    print(json.dumps({"phase": "distill",
+                      "kl_first": round(stats["first_loss"], 3),
+                      "kl_last": round(stats["last_loss"], 3),
+                      "draft_layers": stats["n_layers"],
+                      "wall_s": round(time.perf_counter() - t0, 1)}),
+          flush=True)
+
+    # baseline: plain greedy, ONE compiled program (params as a jit
+    # ARGUMENT — closed-over params would embed 334 MB of constants)
+    gen = jax.jit(lambda pr, pt: generate(config, pr, pt,
+                                          max_new_tokens=new_tokens))
+    np.asarray(gen(params, prompt))  # warm + force
+    t0 = time.perf_counter()
+    base = np.asarray(gen(params, prompt))
+    base_dt = time.perf_counter() - t0
+    print(json.dumps({"phase": "baseline_greedy",
+                      "tokens_per_sec": round(new_tokens / base_dt, 1),
+                      "ms_per_token": round(base_dt / new_tokens * 1e3,
+                                            2)}), flush=True)
+
+    for k in [int(a) for a in sys.argv[1:]] or [4, 8]:
+        toks, st = speculative_generate_jit(
+            config, params, draft_config, draft_params, prompt,
+            max_new_tokens=new_tokens, draft_len=k)
+        np.asarray(toks)  # warm + force
+        t0 = time.perf_counter()
+        toks, st = speculative_generate_jit(
+            config, params, draft_config, draft_params, prompt,
+            max_new_tokens=new_tokens, draft_len=k)
+        toks = np.asarray(toks)
+        dt = time.perf_counter() - t0
+        exact = bool((toks == base).all())
+        acc = float(st["accepted"]) / max(1.0, float(st["draft_tokens"]))
+        print(json.dumps({
+            "phase": f"speculative_k{k}",
+            "tokens_per_sec": round(new_tokens / dt, 1),
+            "ms_per_token": round(dt / new_tokens * 1e3, 2),
+            "acceptance": round(acc, 3),
+            "rounds": int(st["rounds"]),
+            "speedup_vs_greedy": round(base_dt / dt, 2),
+            "token_identical": exact}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

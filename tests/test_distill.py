@@ -230,6 +230,17 @@ def test_speculative_rest_end_to_end(tmp_path, target):
         assert s["acceptance_rate"] == pytest.approx(
             s["accepted"] / s["draft_tokens"], abs=1e-3)
 
+        # non-pow2 max_new buckets up (one compiled program per pow2
+        # bucket, not per client value) and slices back to the ask
+        p7_code, p7 = post({"prompt_tokens": prompt,
+                            "max_new_tokens": 7})
+        s7_code, s7 = post({"prompt_tokens": prompt,
+                            "max_new_tokens": 7,
+                            "speculative": True, "draft_len": 3})
+        assert p7_code == 200 and s7_code == 200, (p7, s7)
+        assert len(s7["tokens"][0]) == 7
+        assert s7["tokens"] == p7["tokens"]
+
         # pairing is visible on the status surface
         conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
         conn.request("GET", "/v1/models/lm")

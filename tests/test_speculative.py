@@ -84,3 +84,69 @@ def test_validates_slack_and_vocab(models):
         speculative_generate(tc, tp, other_dc, other_dp,
                              jnp.asarray([[1, 2]], jnp.int32),
                              max_new_tokens=4)
+
+
+def test_fused_matches_host_loop_and_greedy(models):
+    """speculative_generate_fused (one lax.while_loop program) must
+    produce the target's exact greedy stream and the same round/accept
+    accounting as the host-loop variant (f32 tier)."""
+    from kubeflow_tpu.models.decode import (speculative_generate_fused,
+                                            speculative_generate_jit)
+
+    (tc, tp), (dc, dp) = models
+    prompt = jnp.asarray([[5, 11, 17, 3]], jnp.int32)
+    want = np.asarray(generate(tc, tp, prompt, max_new_tokens=12))
+    for k in (1, 2, 4, 7):
+        host, hstats = speculative_generate(
+            tc, tp, dc, dp, prompt, max_new_tokens=12, draft_len=k)
+        got, stats = speculative_generate_fused(
+            tc, tp, dc, dp, prompt, max_new_tokens=12, draft_len=k)
+        np.testing.assert_array_equal(np.asarray(got), want)
+        assert int(stats["rounds"]) == hstats["rounds"], k
+        assert int(stats["accepted"]) == hstats["accepted"], k
+        # the serving entry: cached jit + int stats
+        got2, stats2 = speculative_generate_jit(
+            tc, tp, dc, dp, prompt, max_new_tokens=12, draft_len=k)
+        np.testing.assert_array_equal(np.asarray(got2), want)
+        assert stats2 == {"rounds": hstats["rounds"],
+                          "draft_tokens": hstats["draft_tokens"],
+                          "accepted": hstats["accepted"]}
+
+
+def test_fused_ragged_batch_matches_per_row(models):
+    """Fused per-row acceptance + scatter-drop overshoot: every ragged
+    row equals its solo greedy decode."""
+    from kubeflow_tpu.models.decode import speculative_generate_fused
+
+    (tc, tp), (dc, dp) = models
+    prompts = [[5, 11, 17], [9, 2], [40, 41, 42, 43]]
+    width = max(len(p) for p in prompts)
+    arr = np.zeros((3, width), np.int32)
+    lens = np.asarray([len(p) for p in prompts], np.int32)
+    for i, p in enumerate(prompts):
+        arr[i, :len(p)] = p
+    got, _ = speculative_generate_fused(
+        tc, tp, dc, dp, jnp.asarray(arr), max_new_tokens=10,
+        draft_len=3, true_len=jnp.asarray(lens))
+    for i, p in enumerate(prompts):
+        want = np.asarray(generate(
+            tc, tp, jnp.asarray([p], jnp.int32), max_new_tokens=10))[0]
+        np.testing.assert_array_equal(np.asarray(got)[i], want)
+
+
+def test_fused_perfect_draft_and_validation(models):
+    from kubeflow_tpu.models.decode import (speculative_generate_fused,
+                                            speculative_generate_jit)
+
+    (tc, tp), (dc, dp) = models
+    prompt = jnp.asarray([[5, 11, 17, 3]], jnp.int32)
+    got, stats = speculative_generate_fused(
+        tc, tp, tc, tp, prompt, max_new_tokens=12, draft_len=4)
+    want = np.asarray(generate(tc, tp, prompt, max_new_tokens=12))
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert int(stats["accepted"]) == int(stats["draft_tokens"])
+    assert int(stats["rounds"]) == 3
+    with pytest.raises(ValueError, match="slack"):
+        speculative_generate_jit(tc, tp, dc, dp,
+                                 jnp.asarray([[1] * 50], jnp.int32),
+                                 max_new_tokens=12, draft_len=4)
