@@ -20,3 +20,20 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def shard_params(params, mesh):
+    """Place a param tree with its tensor-parallel partition specs —
+    the multi-chip serving layout. Shared by every sharded-mesh test
+    (engine, decode, transformer, speculative) so a change to the
+    sharding rules propagates to all of them."""
+    from jax.sharding import NamedSharding
+
+    from kubeflow_tpu.models import param_partition_specs
+    from kubeflow_tpu.parallel.mesh import shape_aware_spec
+
+    specs = param_partition_specs(params)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(
+            x, NamedSharding(mesh, shape_aware_spec(s, x.shape, mesh))),
+        params, specs, is_leaf=lambda x: not isinstance(x, dict))

@@ -442,26 +442,20 @@ def test_decode_on_sharded_mesh(setup):
     """Generation with tensor-parallel-sharded params on the virtual
     mesh: the multi-chip serving path. Results must match unsharded
     greedy decode exactly."""
-    import jax.numpy as _jnp
     from jax.sharding import NamedSharding
 
-    from kubeflow_tpu.models import param_partition_specs
+    from conftest import shard_params
     from kubeflow_tpu.parallel import MeshConfig, create_mesh
     from kubeflow_tpu.parallel.mesh import (
         logical_to_mesh_axes,
         mesh_context,
-        shape_aware_spec,
     )
 
     config, model, params, prompt = setup
     want = generate(config, params, prompt, max_new_tokens=5)
 
     mesh = create_mesh(MeshConfig(dp=2, tp=4))
-    specs = param_partition_specs(params)
-    sharded = jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(
-            x, NamedSharding(mesh, shape_aware_spec(s, x.shape, mesh))),
-        params, specs, is_leaf=lambda x: not isinstance(x, dict))
+    sharded = shard_params(params, mesh)
     tokens = jax.device_put(
         prompt, NamedSharding(mesh, logical_to_mesh_axes(("batch", None))))
     with mesh_context(mesh):

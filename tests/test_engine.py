@@ -327,17 +327,12 @@ def test_engine_on_sharded_mesh(lm):
     the continuous-batching path)."""
     from jax.sharding import NamedSharding
 
-    from kubeflow_tpu.models import param_partition_specs
+    from conftest import shard_params
     from kubeflow_tpu.parallel import MeshConfig, create_mesh
-    from kubeflow_tpu.parallel.mesh import shape_aware_spec
 
     config, params = lm
     mesh = create_mesh(MeshConfig(dp=2, tp=4))
-    specs = param_partition_specs(params)
-    sharded = jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(
-            x, NamedSharding(mesh, shape_aware_spec(s, x.shape, mesh))),
-        params, specs, is_leaf=lambda x: not isinstance(x, dict))
+    sharded = shard_params(params, mesh)
     eng = DecodeEngine(config, sharded, slots=2, mesh=mesh,
                        autostart=False)
     r1 = eng.submit([5, 11, 17], max_new=6)
@@ -350,10 +345,7 @@ def test_engine_on_sharded_mesh(lm):
     # tp=2 divides the 2 kv heads: the engine cache k/v leaves must be
     # CREATED sharded over tp (never one full copy per device)
     mesh2 = create_mesh(MeshConfig(dp=4, tp=2))
-    sharded2 = jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(
-            x, NamedSharding(mesh2, shape_aware_spec(s, x.shape, mesh2))),
-        params, specs, is_leaf=lambda x: not isinstance(x, dict))
+    sharded2 = shard_params(params, mesh2)
     eng2 = DecodeEngine(config, sharded2, slots=2, mesh=mesh2,
                         autostart=False)
     kv_specs = [leaf.sharding.spec

@@ -150,3 +150,40 @@ def test_fused_perfect_draft_and_validation(models):
         speculative_generate_jit(tc, tp, dc, dp,
                                  jnp.asarray([[1] * 50], jnp.int32),
                                  max_new_tokens=12, draft_len=4)
+
+
+def test_fused_speculative_on_sharded_mesh(models):
+    """Fused speculation with tensor-parallel-sharded target AND draft
+    on the virtual mesh (the multi-chip serving layout): tokens must
+    match the unsharded target greedy stream exactly, stats must match
+    the unsharded fused run."""
+    from jax.sharding import NamedSharding
+
+    from conftest import shard_params
+    from kubeflow_tpu.models.decode import speculative_generate_fused
+    from kubeflow_tpu.parallel import MeshConfig, create_mesh
+    from kubeflow_tpu.parallel.mesh import (
+        logical_to_mesh_axes,
+        mesh_context,
+    )
+
+    (tc, tp), (dc, dp) = models
+    # two rows: the batch axis must divide dp=2
+    prompt = jnp.asarray([[5, 11, 17, 3], [9, 2, 40, 7]], jnp.int32)
+    want = np.asarray(generate(tc, tp, prompt, max_new_tokens=10))
+    _, ref_stats = speculative_generate_fused(
+        tc, tp, dc, dp, prompt, max_new_tokens=10, draft_len=3)
+
+    mesh = create_mesh(MeshConfig(dp=2, tp=4))
+    tp_sh, dp_sh = shard_params(tp, mesh), shard_params(dp, mesh)
+    tokens = jax.device_put(
+        prompt, NamedSharding(mesh,
+                              logical_to_mesh_axes(("batch", None))))
+    with mesh_context(mesh):
+        got, stats = jax.jit(
+            lambda a, b, t: speculative_generate_fused(
+                tc, a, dc, b, t, max_new_tokens=10, draft_len=3)
+        )(tp_sh, dp_sh, tokens)
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert int(stats["rounds"]) == int(ref_stats["rounds"])
+    assert int(stats["accepted"]) == int(ref_stats["accepted"])

@@ -99,17 +99,11 @@ def test_sharded_forward_on_mesh():
     model, params, _ = _init(config, batch=8, seq=16)
     mesh = create_mesh(MeshConfig(dp=2, pp=1, tp=4))
     from jax.sharding import NamedSharding
-    from kubeflow_tpu.parallel.mesh import logical_to_mesh_axes, shape_aware_spec
 
-    specs = param_partition_specs(params)
-    params = jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(
-            x, NamedSharding(mesh, shape_aware_spec(s, x.shape, mesh))
-        ),
-        params,
-        specs,
-        is_leaf=lambda x: not isinstance(x, (dict,)),
-    )
+    from conftest import shard_params
+    from kubeflow_tpu.parallel.mesh import logical_to_mesh_axes
+
+    params = shard_params(params, mesh)
     tokens = jax.device_put(
         jnp.zeros((8, 16), jnp.int32),
         NamedSharding(mesh, logical_to_mesh_axes(("batch", None))),
