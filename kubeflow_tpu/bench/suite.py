@@ -333,7 +333,7 @@ def bench_bert(batch_per_chip: int = 16, seq_len: int = 512,
 # -- long-context training (the capability the reference lacks) -------------
 
 
-def bench_longcontext(seq_len: int = 8192, batch_per_chip: int = 1,
+def bench_longcontext(seq_len: int = 8192, batch_per_chip: int = 2,
                       steps: int = 8, warmup: int = 2,
                       d_model: int = 1024, n_layers: int = 8,
                       n_heads: int = 16, d_ff: int = 4096,

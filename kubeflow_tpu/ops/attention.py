@@ -136,65 +136,109 @@ def blockwise_attention(q, k, v, *, causal: bool = True, block_k: int = 512,
 # ---------------------------------------------------------------------------
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
-                      scale: float, causal: bool, seq_len: int):
-    """One (batch·head, q-block) program: stream KV blocks through VMEM.
+def _last_live_kv(i, block_q: int, block_k: int):
+    """Last kv-block index a causal q block ``i`` can see. The SAME
+    expression drives the kv index-map clamp and the kernels' compute
+    gates — they must agree exactly, or a fetched-but-skipped (or
+    skipped-but-computed) step corrupts the accumulator."""
+    return (i * block_q + block_q - 1) // block_k
 
-    Refs arrive as (1, block_q, D) / (1, S, D) tiles for one fused
-    batch-head; the f32 (m, l, acc) online-softmax state lives in
-    registers/VMEM locals. Also emits the per-row logsumexp — the
-    backward kernels recompute probabilities from it without a second
-    online-softmax pass.
+
+def _first_live_q(j, block_q: int, block_k: int):
+    """First q-block index that attends into causal kv block ``j`` —
+    the dkv twin of :func:`_last_live_kv` (same agree-exactly contract
+    between the q index map and the compute gate)."""
+    return (j * block_k) // block_q
+
+
+def _causal_block_mask(s, i, j, block_q: int, block_k: int):
+    """Apply the per-position causal bound to one (block_q, block_k)
+    score tile at q block ``i`` / kv block ``j``."""
+    q_pos = i * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, 1), 0)
+    kv_pos = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block_k), 1)
+    return jnp.where(kv_pos <= q_pos, s, NEG_INF)
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
+                      m_ref, l_ref, *, block_q: int, block_k: int,
+                      scale: float, causal: bool, n_kv: int):
+    """One (batch·head, q-block, kv-block) grid step.
+
+    The KV stream is a GRID dimension (innermost), not an in-kernel
+    loop over a full-sequence VMEM ref: per-step VMEM holds one q block,
+    one k/v block, and the f32 (acc, m, l) online-softmax scratch —
+    independent of sequence length, so the kernel compiles at any
+    context the HBM can hold (the full-S residency variant died at
+    seq 16k: 16.75 MB > the 16 MB scoped-vmem limit). Causal q blocks
+    clamp their kv index map to the last needed block and gate compute
+    with pl.when, so masked-out steps move and compute nothing. Emits
+    the per-row logsumexp at the final kv step — the backward kernels
+    recompute probabilities from it without a second online-softmax
+    pass.
     """
     import jax.experimental.pallas as pl  # deferred: test envs without pallas
 
     i = pl.program_id(1)  # q-block index
-    _, block_q, D = q_ref.shape
-    q = q_ref[0].astype(jnp.float32) * scale
-    q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+    j = pl.program_id(2)  # kv-block index
 
-    n_kv = seq_len // block_k
-    # causal: later KV blocks contribute nothing to this q block
-    hi = n_kv if not causal else (i * block_q + block_q + block_k - 1) // block_k
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
 
-    def body(j, carry):
-        o, l, m = carry
-        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(j * block_k, block_k), :]
+    # causal: kv blocks strictly after this q block contribute nothing
+    live = (j <= _last_live_kv(i, block_q, block_k)) if causal else True
+
+    @pl.when(live)
+    def _update():
+        q = q_ref[0].astype(jnp.float32) * scale
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0]
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (block_q, block_k)
         if causal:
-            kv_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (1, block_k), 1
-            )
-            s = jnp.where(kv_pos <= q_pos, s, NEG_INF)
+            s = _causal_block_mask(s, i, j, block_q, block_k)
+        m = m_ref[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
-        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        o = o * alpha + jax.lax.dot_general(
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1,
+                                                  keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
             p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return (o, l, m_new)
+        m_ref[...] = m_new
 
-    init = (
-        jnp.zeros((block_q, D), jnp.float32),
-        jnp.zeros((block_q, 1), jnp.float32),
-        jnp.full((block_q, 1), NEG_INF, jnp.float32),
-    )
-    o, l, m = jax.lax.fori_loop(0, hi, body, init)
-    o_ref[0] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-    # (1, block_q, 1): the trailing singleton keeps the TPU block layout
-    # legal (last two dims must divide (8, 128) or equal the array's)
-    lse_ref[0] = m + jnp.log(jnp.maximum(l, 1e-30))
+    @pl.when(j == n_kv - 1)
+    def _emit():
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        # (1, block_q, 1): the trailing singleton keeps the TPU block
+        # layout legal (last dims must divide (8, 128) or equal the
+        # array's)
+        lse_ref[0] = m_ref[...] + jnp.log(l)
 
 
 def _fuse_heads(x):
     B, S, H, D = x.shape
     return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+
+def _causal_clamp_kv(block_q: int, block_k: int, causal: bool):
+    """kv-block index map for (b, i, j) grids: under causality, blocks
+    past the last one this q block can see are never fetched (the map
+    clamps to the last live block — a repeat fetch the pipeline elides;
+    the bound is the kernels' own compute-gate expression)."""
+    if not causal:
+        return lambda b, i, j: (b, j, 0)
+    return lambda b, i, j: (
+        b, jnp.minimum(j, _last_live_kv(i, block_q, block_k)), 0)
 
 
 def _flash_fwd(q, k, v, *, causal: bool, block_q: int, block_k: int,
@@ -209,33 +253,41 @@ def _flash_fwd(q, k, v, *, causal: bool, block_q: int, block_k: int,
         raise ValueError(f"seq_len {S} must divide by blocks {block_q}/{block_k}")
     scale = _scale(q, sm_scale)
 
-    # fuse batch and heads into the grid's first axis; blocks over q second
+    # fuse batch and heads into the grid's first axis; q blocks second,
+    # kv stream innermost
     qf, kf, vf = _fuse_heads(q), _fuse_heads(k), _fuse_heads(v)
+    n_kv = S // block_k
 
     kernel = functools.partial(
-        _flash_fwd_kernel, block_k=block_k, scale=scale, causal=causal,
-        seq_len=S,
+        _flash_fwd_kernel, block_q=block_q, block_k=block_k, scale=scale,
+        causal=causal, n_kv=n_kv,
     )
+    kv_map = _causal_clamp_kv(block_q, block_k, causal)
     out, lse = pl.pallas_call(
         kernel,
-        grid=(B * H, S // block_q),
+        grid=(B * H, S // block_q, n_kv),
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0),
+            pl.BlockSpec((1, block_k, D), kv_map,
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0),
+            pl.BlockSpec((1, block_k, D), kv_map,
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
             jax.ShapeDtypeStruct((B * H, S, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         interpret=interpret,
     )(qf, kf, vf)
@@ -243,87 +295,97 @@ def _flash_fwd(q, k, v, *, causal: bool, block_q: int, block_k: int,
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                         dq_ref, *, block_k: int, scale: float, causal: bool,
-                         seq_len: int):
-    """dQ for one (batch·head, q-block): stream KV blocks, recompute P
-    from the saved logsumexp, accumulate dS·K."""
+                         dq_ref, acc_ref, *, block_q: int, block_k: int,
+                         scale: float, causal: bool, n_kv: int):
+    """dQ for one (batch·head, q-block, kv-block) grid step: the KV
+    stream rides the innermost grid dimension (seq-independent VMEM,
+    like the forward), recompute P from the saved logsumexp,
+    accumulate dS·K in f32 scratch, emit at the last kv step."""
     import jax.experimental.pallas as pl
 
     i = pl.program_id(1)
-    _, block_q, D = q_ref.shape
-    qs = q_ref[0].astype(jnp.float32) * scale  # pre-scaled, as in fwd
-    g = g_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]    # (block_q, 1)
-    delta = delta_ref[0]
-    q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+    j = pl.program_id(2)
 
-    n_kv = seq_len // block_k
-    hi = n_kv if not causal else (i * block_q + block_q + block_k - 1) // block_k
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    def body(j, acc):
-        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    live = (j <= _last_live_kv(i, block_q, block_k)) if causal else True
+
+    @pl.when(live)
+    def _update():
+        qs = q_ref[0].astype(jnp.float32) * scale  # pre-scaled, as in fwd
+        g = g_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]    # (block_q, 1)
+        delta = delta_ref[0]
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(qs, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            kv_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (1, block_k), 1)
-            s = jnp.where(kv_pos <= q_pos, s, NEG_INF)
+            s = _causal_block_mask(s, i, j, block_q, block_k)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(g, vb, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        return acc + jax.lax.dot_general(ds, kb, (((1,), (0,)), ((), ())),
-                                         preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] + jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    acc = jax.lax.fori_loop(0, hi, body, jnp.zeros((block_q, D), jnp.float32))
-    dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
+    @pl.when(j == n_kv - 1)
+    def _emit():
+        dq_ref[0] = (acc_ref[...] * scale).astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, *, block_q: int, scale: float,
-                          causal: bool, seq_len: int):
-    """dK/dV for one (batch·head, kv-block): stream Q blocks at or after
-    it (causal skip), recompute P, accumulate Pᵀ·dO and dSᵀ·Q."""
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int,
+                          block_k: int, scale: float, causal: bool,
+                          n_q: int):
+    """dK/dV for one (batch·head, kv-block, q-block) grid step: the Q
+    stream rides the innermost grid dimension; causal steps before this
+    kv block's first contributing q block move and compute nothing.
+    Recompute P, accumulate Pᵀ·dO and dSᵀ·Q in f32 scratch, emit at
+    the last q step (which causality never skips)."""
     import jax.experimental.pallas as pl
 
-    j = pl.program_id(1)
-    _, block_k, D = k_ref.shape
-    kb = k_ref[0].astype(jnp.float32)
-    vb = v_ref[0].astype(jnp.float32)
-    kv_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+    j = pl.program_id(1)  # kv-block index
+    i = pl.program_id(2)  # q-block index
 
-    n_q = seq_len // block_q
-    lo = (j * block_k) // block_q if causal else 0
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    def body(i, carry):
-        dk, dv = carry
-        qs = q_ref[0, pl.ds(i * block_q, block_q), :].astype(
-            jnp.float32) * scale
-        g = g_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * block_q, block_q), :]    # (block_q, 1)
-        delta = delta_ref[0, pl.ds(i * block_q, block_q), :]
+    live = (i >= _first_live_q(j, block_q, block_k)) if causal else True
+
+    @pl.when(live)
+    def _update():
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        qs = q_ref[0].astype(jnp.float32) * scale
+        g = g_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]    # (block_q, 1)
+        delta = delta_ref[0]
         s = jax.lax.dot_general(qs, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            q_pos = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, 1), 0)
-            s = jnp.where(kv_pos <= q_pos, s, NEG_INF)
+            s = _causal_block_mask(s, i, j, block_q, block_k)
         p = jnp.exp(s - lse)  # (block_q, block_k)
-        dv = dv + jax.lax.dot_general(p, g, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
+        dv_acc[...] = dv_acc[...] + jax.lax.dot_general(
+            p, g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(g, vb, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
         # dK = dSᵀ·(q·scale) — the scale chains through the pre-scaled q
-        dk = dk + jax.lax.dot_general(ds, qs, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
-        return dk, dv
+        dk_acc[...] = dk_acc[...] + jax.lax.dot_general(
+            ds, qs, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    zero = jnp.zeros((block_k, D), jnp.float32)
-    dk, dv = jax.lax.fori_loop(lo, n_q, body, (zero, zero))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(i == n_q - 1)
+    def _emit():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _flash_bwd(q, k, v, o, lse, g, *, causal: bool, block_q: int,
@@ -343,17 +405,19 @@ def _flash_bwd(q, k, v, o, lse, g, *, causal: bool, block_q: int,
     delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32),
                     axis=-1, keepdims=True)
 
-    full = lambda b, i: (b, 0, 0)  # noqa: E731
-    blk_q = lambda b, i: (b, i, 0)  # noqa: E731
+    n_q, n_kv = S // block_q, S // block_k
+    blk_q = lambda b, i, j: (b, i, 0)  # noqa: E731
+    kv_map = _causal_clamp_kv(block_q, block_k, causal)
 
     dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, block_k=block_k, scale=scale,
-                          causal=causal, seq_len=S),
-        grid=(B * H, S // block_q),
+        functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
+                          block_k=block_k, scale=scale, causal=causal,
+                          n_kv=n_kv),
+        grid=(B * H, n_q, n_kv),
         in_specs=[
             pl.BlockSpec((1, block_q, D), blk_q, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, S, D), full, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, S, D), full, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), kv_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), kv_map, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_q, D), blk_q, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_q, 1), blk_q, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_q, 1), blk_q, memory_space=pltpu.VMEM),
@@ -361,29 +425,43 @@ def _flash_bwd(q, k, v, o, lse, g, *, causal: bool, block_q: int,
         out_specs=pl.BlockSpec((1, block_q, D), blk_q,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
     )(qf, kf, vf, gf, lse, delta)
 
+    # q-stream index map for (b, j, i) grids: under causality, q blocks
+    # before this kv block's first contributor are never fetched (the
+    # bound is the dkv kernel's own compute-gate expression)
+    if causal:
+        q_map = lambda b, j, i: (  # noqa: E731
+            b, jnp.maximum(i, _first_live_q(j, block_q, block_k)), 0)
+    else:
+        q_map = lambda b, j, i: (b, i, 0)  # noqa: E731
+    blk_kv = lambda b, j, i: (b, j, 0)  # noqa: E731
+
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q, scale=scale,
-                          causal=causal, seq_len=S),
-        grid=(B * H, S // block_k),
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
+                          block_k=block_k, scale=scale, causal=causal,
+                          n_q=n_q),
+        grid=(B * H, n_kv, n_q),
         in_specs=[
-            pl.BlockSpec((1, S, D), full, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, D), blk_q, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, D), blk_q, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, S, D), full, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, S, 1), full, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, S, 1), full, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, D), q_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), blk_kv, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), blk_kv, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, D), q_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), q_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), q_map, memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, D), blk_q, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, D), blk_q, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), blk_kv, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), blk_kv, memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, S, D), k.dtype),
             jax.ShapeDtypeStruct((B * H, S, D), v.dtype),
         ],
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((block_k, D), jnp.float32)],
         interpret=interpret,
     )(qf, kf, vf, gf, lse, delta)
 

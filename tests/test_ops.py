@@ -124,6 +124,20 @@ class TestFlash:
                         g_fl, g_ref, atol=1e-4,
                         err_msg=f"d{name} causal={causal} bq={bq} bk={bk}")
 
+    def test_forward_matches_reference_all_block_shapes(self):
+        """Forward parity across causal×block-shape combos, including
+        ratios where the causal clamp maps and live gates diverge most
+        (block_q = 4×block_k and the reverse)."""
+        q, k, v = qkv(S=64)
+        for causal in (True, False):
+            ref = reference_attention(q, k, v, causal=causal)
+            for bq, bk in ((16, 16), (64, 16), (16, 64), (32, 8),
+                           (8, 32)):
+                out = flash_attention(q, k, v, causal, bq, bk)
+                np.testing.assert_allclose(
+                    out, ref, atol=1e-5,
+                    err_msg=f"causal={causal} bq={bq} bk={bk}")
+
     def test_gradients_match_bf16(self):
         q, k, v = (x.astype(jnp.bfloat16) for x in qkv())
         g_ref = jax.grad(lambda k: jnp.sum(
