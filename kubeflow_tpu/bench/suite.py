@@ -337,6 +337,7 @@ def bench_longcontext(seq_len: int = 8192, batch_per_chip: int = 2,
                       steps: int = 8, warmup: int = 2,
                       d_model: int = 1024, n_layers: int = 8,
                       n_heads: int = 16, d_ff: int = 4096,
+                      loss_chunk: Optional[int] = None,
                       profile_dir: Optional[str] = None) -> Dict[str, Any]:
     """Long-sequence LM training throughput with the Pallas flash-attention
     path — the long-context capability SURVEY §5 names as first-class (the
@@ -360,7 +361,12 @@ def bench_longcontext(seq_len: int = 8192, batch_per_chip: int = 2,
         n_heads=n_heads, n_kv_heads=n_heads, d_ff=d_ff,
         max_seq_len=seq_len, attention_impl="flash", remat=True,
     )
-    model = Transformer(config)
+    # past 16k the full (B, S, V) f32 logit tensor alone approaches HBM
+    # capacity — the chunked-loss path (hidden states out, vocab
+    # projection per chunk) is what makes those contexts trainable
+    if loss_chunk is None and seq_len > 16384:
+        loss_chunk = 4096
+    model = Transformer(config, return_hidden=bool(loss_chunk))
     batch = batch_per_chip * n_chips
     tokens = jax.random.randint(jax.random.key(0), (batch, seq_len), 0,
                                 config.vocab_size)
@@ -373,7 +379,8 @@ def bench_longcontext(seq_len: int = 8192, batch_per_chip: int = 2,
         return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
 
     state, _ = create_sharded_state(init_fn, jax.random.key(0), mesh)
-    step = make_lm_train_step(mesh)
+    step = make_lm_train_step(mesh, loss_chunk=loss_chunk,
+                              logits_softcap=config.logits_softcap)
     holder = {"state": state}
 
     def one():
@@ -396,6 +403,7 @@ def bench_longcontext(seq_len: int = 8192, batch_per_chip: int = 2,
         "seq_len": seq_len,
         "batch_per_chip": batch_per_chip,
         "attention": "flash(pallas)+remat",
+        "loss": f"chunked({loss_chunk})" if loss_chunk else "full_logits",
         "n_chips": n_chips,
         **_mfu(flops_per_step, sec, n_chips),
     }

@@ -431,6 +431,12 @@ class Transformer(nn.Module):
     # cache + write index, stacked over layers by nn.scan); apply with
     # mutable=["cache"] — see kubeflow_tpu/models/decode.py
     decode: bool = False
+    # return the post-final-norm hidden states (B, S, D) instead of
+    # logits: the long-context training path computes the vocab
+    # projection CHUNKED inside the loss (train/trainer.py:
+    # chunked_next_token_loss) — materializing (B, S, V) f32 logits at
+    # seq 65536 is ~8.4 GB and capsizes HBM before attention does
+    return_hidden: bool = False
 
     @nn.compact
     def __call__(self, tokens: jnp.ndarray) -> jnp.ndarray:
@@ -471,6 +477,8 @@ class Transformer(nn.Module):
                                  name=f"block_{i}")(x, (sin, cos))
 
         x = RMSNorm(param_dtype=c.param_dtype, name="final_norm")(x)
+        if self.return_hidden:
+            return _constrain(x, c.rules, "batch", "seq", None)
         logits = jnp.einsum(
             "bsd,vd->bsv", x, embed.astype(c.dtype)
         ).astype(jnp.float32)

@@ -241,6 +241,17 @@ def _causal_clamp_kv(block_q: int, block_k: int, causal: bool):
         b, jnp.minimum(j, _last_live_kv(i, block_q, block_k)), 0)
 
 
+def _causal_clamp_q(block_q: int, block_k: int, causal: bool):
+    """q-block index map for (b, j, i) grids — the dkv twin of
+    :func:`_causal_clamp_kv`: under causality, q blocks before this kv
+    block's first contributor are never fetched (the bound is the dkv
+    kernel's own compute-gate expression, :func:`_first_live_q`)."""
+    if not causal:
+        return lambda b, j, i: (b, i, 0)
+    return lambda b, j, i: (
+        b, jnp.maximum(i, _first_live_q(j, block_q, block_k)), 0)
+
+
 def _flash_fwd(q, k, v, *, causal: bool, block_q: int, block_k: int,
                sm_scale: Optional[float], interpret: bool):
     import jax.experimental.pallas as pl
@@ -429,14 +440,7 @@ def _flash_bwd(q, k, v, o, lse, g, *, causal: bool, block_q: int,
         interpret=interpret,
     )(qf, kf, vf, gf, lse, delta)
 
-    # q-stream index map for (b, j, i) grids: under causality, q blocks
-    # before this kv block's first contributor are never fetched (the
-    # bound is the dkv kernel's own compute-gate expression)
-    if causal:
-        q_map = lambda b, j, i: (  # noqa: E731
-            b, jnp.maximum(i, _first_live_q(j, block_q, block_k)), 0)
-    else:
-        q_map = lambda b, j, i: (b, i, 0)  # noqa: E731
+    q_map = _causal_clamp_q(block_q, block_k, causal)
     blk_kv = lambda b, j, i: (b, j, 0)  # noqa: E731
 
     dk, dv = pl.pallas_call(

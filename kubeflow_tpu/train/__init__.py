@@ -15,6 +15,7 @@ from kubeflow_tpu.train.trainer import (  # noqa: F401
     masked_lm_loss,
     make_pipelined_lm_train_step,
     make_optimizer,
+    chunked_next_token_loss,
     next_token_loss,
     softmax_cross_entropy,
     state_partition_specs,

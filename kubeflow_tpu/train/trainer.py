@@ -127,24 +127,84 @@ def softmax_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarr
     return -jnp.mean(ll)
 
 
+def chunked_next_token_loss(hidden: jnp.ndarray, embed: jnp.ndarray,
+                            tokens: jnp.ndarray, *, chunk: int = 4096,
+                            softcap: float = 0.0) -> jnp.ndarray:
+    """``next_token_loss`` computed from HIDDEN states with the vocab
+    projection done per sequence chunk — (B, S, V) f32 logits are never
+    materialized, and ``jax.checkpoint`` recomputes each chunk's logits
+    in the backward so only (B, chunk, V) lives at once. At seq 65536 /
+    vocab 32k the full-logit path alone is ~8.4 GB; chunked, the loss's
+    working set is chunk/S of that. The math matches the model's head
+    exactly (tied-embedding einsum in activation dtype, f32 softmax,
+    optional softcap) so loss values and gradients are parity-testable
+    against the unchunked path."""
+    B, S, D = hidden.shape
+    n = S - 1
+    h = hidden[:, :-1]
+    tgt = tokens[:, 1:]
+    pad = (-n) % chunk
+    if pad:
+        h = jnp.pad(h, ((0, 0), (0, pad), (0, 0)))
+        tgt = jnp.pad(tgt, ((0, 0), (0, pad)))
+    valid = (jnp.arange(n + pad) < n)
+    nc = (n + pad) // chunk
+    h = h.reshape(B, nc, chunk, D).transpose(1, 0, 2, 3)
+    tgt = tgt.reshape(B, nc, chunk).transpose(1, 0, 2)
+    valid = valid.reshape(nc, chunk)
+
+    @jax.checkpoint
+    def chunk_ll(h_c, t_c, m_c):
+        logits = jnp.einsum("bcd,vd->bcv", h_c,
+                            embed.astype(h_c.dtype)).astype(jnp.float32)
+        if softcap:
+            logits = softcap * jnp.tanh(logits / softcap)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, t_c[..., None], axis=-1)[..., 0]
+        return jnp.sum(ll * m_c[None, :])
+
+    def body(acc, xs):
+        return acc + chunk_ll(*xs), None
+
+    total, _ = jax.lax.scan(body, jnp.float32(0.0), (h, tgt, valid))
+    return -total / (B * n)
+
+
 def make_lm_train_step(
     mesh: Mesh,
     rules: AxisRules = DEFAULT_RULES,
     *,
     moe_aux_weight: float = 0.01,
     donate: bool = True,
+    loss_chunk: Optional[int] = None,
+    logits_softcap: float = 0.0,
 ):
-    """Build the jitted SPMD LM train step: (state, tokens) -> (state, metrics)."""
+    """Build the jitted SPMD LM train step: (state, tokens) -> (state, metrics).
+
+    ``loss_chunk``: long-context mode — ``state.apply_fn`` must return
+    post-final-norm HIDDEN states (``Transformer(config,
+    return_hidden=True)``) and the loss projects to vocab per
+    ``loss_chunk``-token chunk (``chunked_next_token_loss``), so the
+    full (B, S, V) logit tensor never exists. ALWAYS forward the
+    model's ``config.logits_softcap`` here — the chunked loss re-applies
+    the head's softcap itself (the hidden-states model never applies
+    it), and a mismatch silently trains a different objective than the
+    full-logits path."""
     batch_spec = spec_for_mesh(logical_to_mesh_axes(("batch", "seq"), rules), mesh)
 
     def step(state: TrainState, tokens: jnp.ndarray):
         tokens = jax.lax.with_sharding_constraint(tokens, batch_spec)
 
         def loss_fn(params):
-            logits, mut = state.apply_fn(
+            out, mut = state.apply_fn(
                 {"params": params}, tokens, mutable=["losses"]
             )
-            loss = next_token_loss(logits, tokens)
+            if loss_chunk:
+                loss = chunked_next_token_loss(
+                    out, params["token_embed"], tokens,
+                    chunk=loss_chunk, softcap=logits_softcap)
+            else:
+                loss = next_token_loss(out, tokens)
             aux = sum(
                 jnp.sum(v) for v in jax.tree_util.tree_leaves(mut)
             ) if mut else 0.0

@@ -103,3 +103,72 @@ def test_mnist_train_no_batchstats():
         state, m = step(state, images, labels)
         losses.append(float(m["loss"]))
     assert losses[-1] < losses[0], losses
+
+
+def test_chunked_loss_matches_full_logits_path():
+    """chunked_next_token_loss from hidden states must equal
+    next_token_loss on the model's logits — value AND parameter
+    gradients — including ragged S-1 vs chunk and a softcap."""
+    import numpy as np
+
+    from kubeflow_tpu.models import Transformer, TransformerConfig
+    from kubeflow_tpu.train import chunked_next_token_loss, next_token_loss
+
+    config = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_ff=64, max_seq_len=24, dtype=jnp.float32,
+        param_dtype=jnp.float32, logits_softcap=20.0, remat=False)
+    full = Transformer(config)
+    hid = Transformer(config, return_hidden=True)
+    tokens = jax.random.randint(jax.random.key(0), (2, 24), 0, 64)
+    params = full.init(jax.random.key(1), tokens)["params"]
+
+    def loss_full(p):
+        return next_token_loss(full.apply({"params": p}, tokens), tokens)
+
+    def loss_chunked(p):
+        h = hid.apply({"params": p}, tokens)
+        # chunk 8 does not divide S-1=23: exercises the pad+mask path
+        return chunked_next_token_loss(h, p["token_embed"], tokens,
+                                       chunk=8, softcap=20.0)
+
+    lf, gf = jax.value_and_grad(loss_full)(params)
+    lc, gc = jax.value_and_grad(loss_chunked)(params)
+    np.testing.assert_allclose(float(lc), float(lf), rtol=1e-6)
+    for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_flatten_with_path(gf)[0],
+            jax.tree_util.tree_flatten_with_path(gc)[0]):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   atol=1e-5, err_msg=str(pa))
+
+
+def test_lm_train_step_loss_chunk_mode():
+    """make_lm_train_step(loss_chunk=): same loss trajectory as the
+    full-logits step on the virtual mesh."""
+    import numpy as np
+
+    from kubeflow_tpu.models import Transformer, TransformerConfig
+    from kubeflow_tpu.parallel import MeshConfig, create_mesh
+
+    config = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_ff=64, max_seq_len=16, dtype=jnp.float32,
+        param_dtype=jnp.float32, remat=False)
+    tokens = jax.random.randint(jax.random.key(0), (4, 16), 0, 64)
+    mesh = create_mesh(MeshConfig(dp=2, tp=4))
+    tx = make_optimizer(1e-3, warmup_steps=2, decay_steps=10)
+
+    def mk(model, **kw):
+        params = Transformer(config).init(jax.random.key(1),
+                                          tokens[:2])["params"]
+        state = TrainState.create(apply_fn=model.apply, params=params,
+                                  tx=tx)
+        return state, make_lm_train_step(mesh, **kw)
+
+    s1, step1 = mk(Transformer(config))
+    s2, step2 = mk(Transformer(config, return_hidden=True), loss_chunk=8)
+    for _ in range(3):
+        s1, m1 = step1(s1, tokens)
+        s2, m2 = step2(s2, tokens)
+        np.testing.assert_allclose(float(m2["loss"]), float(m1["loss"]),
+                                   rtol=1e-5)
