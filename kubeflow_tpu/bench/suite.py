@@ -225,8 +225,14 @@ def bench_resnet50(batch_per_chip: int = 256, steps: int = 20,
     mesh = create_mesh(MeshConfig(dp=n_chips))
     # KFTPU_RESNET_ACT_COMPRESS=1: int8 forward-saved conv inputs
     # (ops/act_compress.py) — the PERF.md bandwidth-lever A/B switch
-    model = resnet50(num_classes=1000, act_compress=os.environ.get(
-        "KFTPU_RESNET_ACT_COMPRESS", "0") == "1")
+    model = resnet50(
+        num_classes=1000,
+        act_compress=os.environ.get("KFTPU_RESNET_ACT_COMPRESS",
+                                    "0") == "1",
+        # KFTPU_RESNET_FUSED_BN=1: bn2+ReLU fused into conv3's GEMM
+        # (ops/bnconv.py) — the PERF.md normalize-pass lever A/B switch
+        fused_bn_conv=os.environ.get("KFTPU_RESNET_FUSED_BN",
+                                     "0") == "1")
     stem = model.config.stem
     batch = batch_per_chip * n_chips
     rng = jax.random.key(0)

@@ -18,6 +18,7 @@ from functools import partial
 from typing import Any, Sequence, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 
@@ -43,6 +44,67 @@ class ResNetConfig:
     # the cost of bounded gradient quantization error — PERF.md's open
     # bandwidth lever; loss-parity gated in tests/test_act_compress.py
     act_compress: bool = False
+    # fuse bn2-apply+ReLU into conv3's GEMM input side (ops/bnconv.py):
+    # removes one full read+write of the mid-block activation per
+    # bottleneck — PERF.md's named normalize-pass lever; parity gated
+    # in tests/test_bnconv.py
+    fused_bn_conv: bool = False
+
+
+class FusedBnReluConv(nn.Module):
+    """``relu(batchnorm(x)) @ 1x1-conv`` with the normalize pass fused
+    into the GEMM's input side (``ops/bnconv.py``): the (N, H, W, C)
+    activation is read ONCE instead of read + write + read. Owns the
+    same BatchNorm bookkeeping (scale/bias params, running batch_stats,
+    f32 statistics) and conv kernel shape as the ``nn.BatchNorm`` +
+    ``nn.Conv`` pair it replaces; statistics gradients flow through the
+    plain jnp mean/var below — the custom_vjp only covers the GEMM
+    sandwich. Flag-gated (``ResNetConfig.fused_bn_conv``) and REJECTED
+    on the round-5 chip A/B (−39% img/s, +34 GB/step: the custom-op
+    boundary breaks XLA's bn3-stats-into-conv3 fusion and bf16
+    backward chains — PERF.md); kept in-tree, off by default, as the
+    documented negative result."""
+
+    features: int
+    use_running_average: bool
+    dtype: Any
+    param_dtype: Any
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        from kubeflow_tpu.ops.bnconv import fused_scale_relu_matmul
+
+        C = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (C,),
+                           self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros, (C,),
+                          self.param_dtype)
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (1, 1, C, self.features), self.param_dtype)
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((C,), jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((C,), jnp.float32))
+        if self.use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            xf = x.astype(jnp.float32).reshape(-1, C)
+            mean = jnp.mean(xf, axis=0)
+            var = jnp.mean(jnp.square(xf), axis=0) - jnp.square(mean)
+            if not self.is_initializing():
+                m = self.momentum
+                ra_mean.value = m * ra_mean.value + (1 - m) * mean
+                ra_var.value = m * ra_var.value + (1 - m) * var
+        a = scale.astype(jnp.float32) * jax.lax.rsqrt(var + self.epsilon)
+        b = bias.astype(jnp.float32) - mean * a
+        lead = x.shape[:-1]
+        out = fused_scale_relu_matmul(
+            x.reshape(-1, C).astype(self.dtype), a, b,
+            kernel.reshape(C, self.features).astype(self.dtype))
+        return out.reshape(*lead, self.features)
 
 
 class BottleneckBlock(nn.Module):
@@ -52,6 +114,7 @@ class BottleneckBlock(nn.Module):
     param_dtype: Any
     bn_dtype: Any = jnp.float32
     act_compress: bool = False
+    fused_bn_conv: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool):
@@ -80,8 +143,16 @@ class BottleneckBlock(nn.Module):
         y = conv(self.filters, (1, 1), name="conv1")(x)
         y = nn.relu(norm(name="bn1")(y))
         y = conv(self.filters, (3, 3), strides=(self.strides, self.strides), name="conv2")(y)
-        y = nn.relu(norm(name="bn2")(y))
-        y = conv(self.filters * 4, (1, 1), name="conv3")(y)
+        if self.fused_bn_conv:
+            # bn2 -> relu -> conv3 in one pass over the conv2 output
+            y = FusedBnReluConv(
+                self.filters * 4, use_running_average=not train,
+                dtype=self.dtype, param_dtype=self.param_dtype,
+                momentum=0.9, epsilon=1e-5,  # keep == the norm partial
+                name="bn2conv3")(y)
+        else:
+            y = nn.relu(norm(name="bn2")(y))
+            y = conv(self.filters * 4, (1, 1), name="conv3")(y)
         y = norm(name="bn3", scale_init=nn.initializers.zeros)(y)
         if residual.shape != y.shape:
             residual = conv(
@@ -99,6 +170,13 @@ class ResNet(nn.Module):
     def __call__(self, images: jnp.ndarray, train: bool = True) -> jnp.ndarray:
         """images: (B, H, W, 3) -> logits (B, num_classes) float32."""
         c = self.config
+        if c.act_compress and c.fused_bn_conv:
+            # the fused bn2conv3 path bypasses the Int8Conv wrapper for
+            # conv3 — allowing both would silently measure an
+            # undocumented hybrid in any A/B
+            raise ValueError(
+                "act_compress and fused_bn_conv cannot combine: conv3 "
+                "would lose activation compression inside the fused op")
         x = images.astype(c.dtype)
         if c.stem == "space_to_depth":
             # Fold 4×4 pixel blocks into channels: 224²×3 → 56²×48. The
@@ -147,6 +225,7 @@ class ResNet(nn.Module):
                     param_dtype=c.param_dtype,
                     bn_dtype=c.bn_dtype,
                     act_compress=c.act_compress,
+                    fused_bn_conv=c.fused_bn_conv,
                     name=f"stage{i}_block{j}",
                 )(x, train=train)
         x = jnp.mean(x, axis=(1, 2))
