@@ -1,0 +1,34 @@
+"""TPU-slice-aware serving autoscaler.
+
+Closes the loop the reference platform delegates to Knative/KFServing's
+concurrency-based pod autoscaler: request telemetry (proxy + decode
+engine) → sliding stable/panic windows (:mod:`metrics`) → desired
+replica count with burst panic, hysteresis and scale-to-zero
+(:mod:`recommender`) → concrete TPU slices against the scheduler's
+inventory (:mod:`planner`) → warmed, drained replica state
+(:mod:`reconciler`). Everything takes an injectable clock so tests are
+wall-clock-free.
+"""
+
+from kubeflow_tpu.autoscale.metrics import (  # noqa: F401
+    MetricsAggregator,
+    WindowStats,
+)
+from kubeflow_tpu.autoscale.planner import (  # noqa: F401
+    CapacityPlanner,
+    Plan,
+)
+from kubeflow_tpu.autoscale.policy import (  # noqa: F401
+    POLICY_PRESETS,
+    AutoscalePolicy,
+    policy_preset,
+)
+from kubeflow_tpu.autoscale.recommender import (  # noqa: F401
+    Decision,
+    Recommender,
+)
+from kubeflow_tpu.autoscale.reconciler import (  # noqa: F401
+    Autoscaler,
+    ReplicaDriver,
+    ReplicaState,
+)

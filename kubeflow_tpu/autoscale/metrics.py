@@ -1,0 +1,207 @@
+"""Per-model request telemetry over sliding stable/panic windows.
+
+The Knative-KPA shape: the autoscaler does not see raw requests, it
+sees windowed averages of *concurrency* (in-flight requests), queue
+depth and arrival rate. Sources:
+
+- the serving proxy reports every request's start/finish
+  (:meth:`MetricsAggregator.request_start` / ``request_finish``) — the
+  concurrency signal;
+- the reconcile loop polls each model's decode engines and reports slot
+  occupancy + admission-queue depth (:meth:`observe_engine`) — the
+  saturation signal batching hides from per-request concurrency.
+
+Time is injectable (``clock`` callable or explicit ``now=`` on every
+call): tests drive a fake clock, production passes nothing and gets
+``time.monotonic``. Samples land in one-second buckets; a window stat
+is the average over the buckets it covers, so the math is deterministic
+for a deterministic event schedule.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Callable, Deque, Dict, Optional, Tuple
+
+from kubeflow_tpu.utils import DEFAULT_REGISTRY
+
+_inflight_g = DEFAULT_REGISTRY.gauge(
+    "kftpu_autoscale_inflight", "in-flight requests seen by the autoscaler")
+_rps_g = DEFAULT_REGISTRY.gauge(
+    "kftpu_autoscale_stable_rps", "stable-window requests per second")
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowStats:
+    """Aggregates over one sliding window."""
+
+    concurrency: float      # avg in-flight requests (incl. engine slots)
+    queue_depth: float      # avg requests waiting for an engine slot
+    rps: float              # arrivals per second
+    samples: int            # concurrency samples the average is over
+
+    @property
+    def load(self) -> float:
+        """The signal the recommender divides by target concurrency:
+        requests being served plus requests waiting to be served."""
+        return self.concurrency + self.queue_depth
+
+
+@dataclasses.dataclass
+class _Bucket:
+    second: int
+    conc_sum: float = 0.0
+    conc_n: int = 0
+    queue_sum: float = 0.0
+    queue_n: int = 0
+    starts: int = 0
+
+
+class _ModelSeries:
+    """Ring of per-second buckets + the live in-flight gauge."""
+
+    def __init__(self, horizon_s: float) -> None:
+        self.horizon_s = horizon_s
+        self.inflight = 0
+        self.buckets: Deque[_Bucket] = collections.deque()
+
+    def bucket(self, now: float) -> _Bucket:
+        sec = int(now)
+        if self.buckets and self.buckets[-1].second == sec:
+            return self.buckets[-1]
+        b = _Bucket(second=sec)
+        self.buckets.append(b)
+        while self.buckets and self.buckets[0].second < sec - self.horizon_s:
+            self.buckets.popleft()
+        return b
+
+    def sample(self, now: float) -> None:
+        b = self.bucket(now)
+        b.conc_sum += self.inflight
+        b.conc_n += 1
+
+    def window(self, window_s: float, now: float) -> WindowStats:
+        lo = now - window_s
+        conc_sum = conc_n = 0.0
+        q_sum = q_n = 0.0
+        starts = 0
+        for b in self.buckets:
+            if b.second < lo or b.second > now:
+                continue
+            conc_sum += b.conc_sum
+            conc_n += b.conc_n
+            q_sum += b.queue_sum
+            q_n += b.queue_n
+            starts += b.starts
+        # an empty window means nothing happened: the in-flight gauge is
+        # still authoritative (a long-running request with no events in
+        # the window must not read as idle)
+        conc = conc_sum / conc_n if conc_n else float(self.inflight)
+        queue = q_sum / q_n if q_n else 0.0
+        return WindowStats(concurrency=conc, queue_depth=queue,
+                           rps=starts / window_s if window_s > 0 else 0.0,
+                           samples=int(conc_n))
+
+
+class MetricsAggregator:
+    """Thread-safe telemetry sink shared by proxy and reconcile loop."""
+
+    def __init__(self, *, horizon_s: float = 120.0,
+                 clock: Optional[Callable[[], float]] = None) -> None:
+        self.horizon_s = horizon_s
+        self.clock = clock if clock is not None else time.monotonic
+        self._series: Dict[str, _ModelSeries] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, model: str) -> _ModelSeries:
+        s = self._series.get(model)
+        if s is None:
+            s = self._series[model] = _ModelSeries(self.horizon_s)
+        return s
+
+    # -- proxy-facing --------------------------------------------------------
+
+    def request_start(self, model: str,
+                      now: Optional[float] = None) -> None:
+        now = self.clock() if now is None else now
+        with self._lock:
+            s = self._get(model)
+            s.inflight += 1
+            s.bucket(now).starts += 1
+            s.sample(now)
+        _inflight_g.set(s.inflight, model=model)
+
+    def request_finish(self, model: str,
+                       now: Optional[float] = None) -> None:
+        now = self.clock() if now is None else now
+        with self._lock:
+            s = self._get(model)
+            s.inflight = max(0, s.inflight - 1)
+            s.sample(now)
+        _inflight_g.set(s.inflight, model=model)
+
+    # -- reconcile-loop-facing ----------------------------------------------
+
+    def observe(self, model: str, *, queue_depth: float = 0.0,
+                active_slots: Optional[float] = None,
+                now: Optional[float] = None) -> None:
+        """Record one poll of a model's serving backend: admission-queue
+        depth and (optionally) engine slot occupancy. Occupancy counts
+        toward concurrency — continuous batching serves many streams off
+        few HTTP requests, so proxy-side in-flight alone undercounts."""
+        now = self.clock() if now is None else now
+        with self._lock:
+            s = self._get(model)
+            b = s.bucket(now)
+            b.queue_sum += float(queue_depth)
+            b.queue_n += 1
+            if active_slots is not None:
+                b.conc_sum += float(active_slots)
+                b.conc_n += 1
+            else:
+                s.sample(now)
+
+    def observe_engine(self, model: str, engine,
+                       now: Optional[float] = None) -> None:
+        """Poll a :class:`~kubeflow_tpu.serving.engine.DecodeEngine`."""
+        snap = engine.snapshot()
+        self.observe(model, queue_depth=snap["pending"],
+                     active_slots=snap["active_slots"], now=now)
+
+    def tick(self, model: str, now: Optional[float] = None) -> None:
+        """Record a no-event sample so idle seconds read as zero load
+        instead of carrying the last busy bucket forward."""
+        now = self.clock() if now is None else now
+        with self._lock:
+            self._get(model).sample(now)
+
+    # -- read path -----------------------------------------------------------
+
+    def models(self) -> Tuple[str, ...]:
+        with self._lock:
+            return tuple(sorted(self._series))
+
+    def inflight(self, model: str) -> int:
+        with self._lock:
+            return self._get(model).inflight
+
+    def window(self, model: str, window_s: float,
+               now: Optional[float] = None) -> WindowStats:
+        now = self.clock() if now is None else now
+        with self._lock:
+            return self._get(model).window(window_s, now)
+
+    def stats(self, model: str, policy,
+              now: Optional[float] = None) -> Tuple[WindowStats,
+                                                    WindowStats]:
+        """(stable, panic) window stats under one clock read."""
+        now = self.clock() if now is None else now
+        with self._lock:
+            s = self._get(model)
+            stable = s.window(policy.stable_window_s, now)
+            panic = s.window(policy.panic_window_s, now)
+        _rps_g.set(stable.rps, model=model)
+        return stable, panic

@@ -1,0 +1,124 @@
+"""Desired replicas → concrete TPU slices against the scheduler inventory.
+
+A serving replica is not a pod on an arbitrary node: it occupies one
+whole TPU slice of the policy's shape (``platform.slices``), so the
+planner is the bridge between the recommender's integer and
+``scheduler/inventory.py``'s concrete free-slice accounting. Selection
+reuses the gang scheduler's best-fit + adjacency scoring
+(:func:`~kubeflow_tpu.scheduler.inventory.choose_slices`), one slice
+per replica; replica counts prefer power-of-two packing (uniform
+compiled-program buckets across the fleet) and degrade gracefully —
+when inventory can't cover the ask, the planner grants what fits and
+reports the shortfall as an event instead of failing the loop
+(contention-aware degradation, PAPERS: Scheduling Ring-All-Reduce Jobs
+in Multi-Tenant Clusters).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+from kubeflow_tpu.autoscale.policy import AutoscalePolicy
+from kubeflow_tpu.platform.slices import slice_shape
+from kubeflow_tpu.scheduler.inventory import SliceInfo, choose_slices
+from kubeflow_tpu.utils import DEFAULT_REGISTRY
+
+_capped_c = DEFAULT_REGISTRY.counter(
+    "kftpu_autoscale_inventory_capped_total",
+    "scale-ups granted only partially because slice inventory ran out")
+
+
+def pow2_ceil(n: int) -> int:
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclasses.dataclass(frozen=True)
+class Plan:
+    """Concrete outcome of one planning pass."""
+
+    desired: int            # what the recommender asked for
+    granted: int            # replicas the fleet should actually run
+    grow: List[str]         # slice ids to start new replicas on
+    shrink: List[str]       # slice ids whose replicas should drain
+    capped: bool            # True when inventory cut the ask short
+    events: List[str]
+
+
+class CapacityPlanner:
+    def __init__(self, policy: AutoscalePolicy) -> None:
+        self.policy = policy.validate()
+        self.shape = slice_shape(policy.slice_shape)
+
+    def plan(self, desired: int, assigned: Sequence[str],
+             inventory: Sequence[SliceInfo],
+             busy: Sequence[str] = ()) -> Plan:
+        """Round ``desired`` against what the cluster can actually hold.
+
+        ``assigned`` — slice ids current replicas occupy (ready or
+        warming), in age order (oldest first).  ``inventory`` — the
+        scheduler's free-slice scan for the policy shape; slices in
+        ``assigned`` are counted as ours even though the scan reports
+        them busy. ``busy`` — slice ids that must not be granted even
+        if the scan says they are free: a *draining* replica still owns
+        its slice until it is destroyed, and an inventory scan that
+        races the teardown would double-book it.
+        """
+        events: List[str] = []
+        current = len(assigned)
+        target = desired
+        if self.policy.pow2_packing and desired > current:
+            target = min(pow2_ceil(desired), self.policy.max_replicas)
+            if target != desired:
+                events.append(
+                    f"pow2 packing: rounding {desired} -> {target}")
+
+        if target <= current:
+            # shrink newest-first: oldest replicas hold the warmed
+            # compiled-program caches worth keeping
+            shrink = list(assigned[target:])
+            return Plan(desired=desired, granted=target, grow=[],
+                        shrink=shrink, capped=False, events=events)
+
+        want_new = target - current
+        grow = self._select(want_new, assigned, inventory, busy)
+        if len(grow) < want_new and target > desired:
+            # pow2 round-up didn't fit — retry at the raw ask before
+            # declaring the scale-up capped
+            events.append("pow2 target missed inventory; "
+                          f"retrying at {desired}")
+            target = desired
+            want_new = max(target - current, 0)
+            grow = self._select(want_new, assigned, inventory, busy)
+        capped = len(grow) < want_new
+        if capped:
+            _capped_c.inc(shape=self.shape.name)
+            events.append(
+                f"slice inventory exhausted: granted {len(grow)} of "
+                f"{want_new} new {self.shape.name} replicas")
+        return Plan(desired=desired, granted=current + len(grow),
+                    grow=grow, shrink=[], capped=capped, events=events)
+
+    def _select(self, want: int, assigned: Sequence[str],
+                inventory: Sequence[SliceInfo],
+                busy: Sequence[str] = ()) -> List[str]:
+        """Up to ``want`` free slice ids, best-fit-scored, largest
+        feasible count first (graceful degradation)."""
+        if want <= 0:
+            return []
+        ours = set(assigned) | set(busy)
+        free = [s for s in inventory
+                if s.slice_id not in ours and s.free_hosts == s.hosts
+                and s.hosts >= self.shape.hosts]
+        if not free:
+            return []
+        hosts = [s.hosts for s in free]
+        free_hosts = [s.free_hosts for s in free]
+        for k in range(min(want, len(free)), 0, -1):
+            chosen = choose_slices(hosts, free_hosts, k, self.shape.hosts)
+            if chosen is not None:
+                return [free[i].slice_id for i in chosen]
+        return []

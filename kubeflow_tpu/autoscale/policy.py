@@ -1,0 +1,118 @@
+"""Autoscale policy: the knobs of the control loop, with canned presets.
+
+The reference gets these from Knative KPA annotations
+(``autoscaling.knative.dev/target``, ``targetBurstCapacity``, panic
+window percentage...); here the same dials are one frozen dataclass a
+deployment preset or the ``autoscaler`` manifest component fills in.
+Windows follow the KPA split: a long *stable* window for steady-state
+decisions and a short *panic* window so a burst is seen within seconds,
+not after a minute of averaging.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Mapping, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalePolicy:
+    # steady-state in-flight requests one replica is expected to absorb
+    # (Knative's autoscaling.knative.dev/target). For the decode engine
+    # this is slot occupancy, so target ≈ slots keeps replicas saturated.
+    target_concurrency: float = 4.0
+    # sliding-window lengths; the panic window is short so one reconcile
+    # tick inside a burst already sees the spike (KPA default is 10% of
+    # the stable window)
+    stable_window_s: float = 60.0
+    panic_window_s: float = 6.0
+    # enter panic when the panic-window desired count reaches this
+    # multiple of the current ready capacity (KPA panic-threshold 200%)
+    panic_threshold: float = 2.0
+    # per-decision rate bounds: never grow by more than x`up` or shrink
+    # by more than ÷`down` in one tick (ready>0); bounds oscillation
+    max_scale_up_rate: float = 10.0
+    max_scale_down_rate: float = 2.0
+    # hysteresis: desired must stay below current for this long before a
+    # scale-down is applied (prevents flapping around a step edge)
+    scale_down_delay_s: float = 30.0
+    # idle duration (zero concurrency AND empty queue) before dropping
+    # to zero replicas; only honored when min_replicas == 0
+    scale_to_zero_grace_s: float = 30.0
+    min_replicas: int = 0
+    max_replicas: int = 32
+    # TPU slice shape each replica occupies (platform.slices name, e.g.
+    # "v5e-4"); the planner turns replica counts into whole slices
+    slice_shape: str = "v5e-4"
+    # round scale-ups to power-of-two replica counts when inventory
+    # allows: compiled-program buckets and mesh shapes are pow2, so
+    # pow2 fleets keep serving shards uniform
+    pow2_packing: bool = True
+
+    def validate(self) -> "AutoscalePolicy":
+        if self.target_concurrency <= 0:
+            raise ValueError("target_concurrency must be > 0")
+        if not 0 < self.panic_window_s <= self.stable_window_s:
+            raise ValueError(
+                "need 0 < panic_window_s <= stable_window_s, got "
+                f"{self.panic_window_s} / {self.stable_window_s}")
+        if self.panic_threshold < 1.0:
+            raise ValueError("panic_threshold must be >= 1.0")
+        if not 0 <= self.min_replicas <= self.max_replicas:
+            raise ValueError(
+                f"need 0 <= min_replicas <= max_replicas, got "
+                f"{self.min_replicas} / {self.max_replicas}")
+        return self
+
+
+# canned profiles, mirroring config/presets.py's deployment presets:
+# - serving: the default latency-first loop (scale up fast, down slow)
+# - batch: throughput-first — replicas run hot, bursts tolerated longer
+# - dev: one small slice, aggressive scale-to-zero for shared dev pools
+POLICY_PRESETS: Dict[str, AutoscalePolicy] = {
+    "serving": AutoscalePolicy(),
+    "batch": AutoscalePolicy(
+        target_concurrency=16.0,
+        panic_threshold=4.0,
+        scale_down_delay_s=120.0,
+        scale_to_zero_grace_s=300.0,
+    ),
+    "dev": AutoscalePolicy(
+        target_concurrency=2.0,
+        max_replicas=2,
+        scale_down_delay_s=10.0,
+        scale_to_zero_grace_s=10.0,
+        pow2_packing=False,
+    ),
+}
+
+
+def policy_preset(name: str) -> AutoscalePolicy:
+    if name not in POLICY_PRESETS:
+        known = ", ".join(sorted(POLICY_PRESETS))
+        raise KeyError(f"unknown autoscale policy {name!r}; known: {known}")
+    return POLICY_PRESETS[name]
+
+
+def policy_from_env(env: Optional[Mapping[str, str]] = None) -> AutoscalePolicy:
+    """Resolve the policy the manifest component configures via env:
+    ``KFTPU_AUTOSCALE_POLICY`` names a preset, individual
+    ``KFTPU_AUTOSCALE_*`` vars override single fields."""
+    e = os.environ if env is None else env
+    base = policy_preset(e.get("KFTPU_AUTOSCALE_POLICY", "serving"))
+    overrides = {}
+    for field in dataclasses.fields(AutoscalePolicy):
+        var = f"KFTPU_AUTOSCALE_{field.name.upper()}"
+        if var not in e:
+            continue
+        raw = e[var]
+        if field.type == "bool":
+            overrides[field.name] = raw.lower() in ("1", "true", "yes")
+        elif field.type == "int":
+            overrides[field.name] = int(raw)
+        elif field.type == "float":
+            overrides[field.name] = float(raw)
+        else:
+            overrides[field.name] = raw
+    return dataclasses.replace(base, **overrides).validate()

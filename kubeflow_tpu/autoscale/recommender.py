@@ -1,0 +1,139 @@
+"""Window stats → desired replica count (Knative-KPA decision logic).
+
+Stable mode sizes the fleet from the long window; a burst that pushes
+the short panic window past ``panic_threshold``× current capacity flips
+the recommender into panic mode, where it scales straight to the panic
+demand and refuses to scale down until the burst has been quiet for a
+full stable window. Scale-down is additionally delayed
+(``scale_down_delay_s`` hysteresis), and an idle model (no load, empty
+queue) drops to zero only after ``scale_to_zero_grace_s`` — the related
+scheduling work (PAPERS: Prediction-Assisted Online DL Workload
+Scheduling) motivates exactly this asymmetry: react to demand in one
+short window, release capacity slowly enough that prediction error
+never thrashes slices.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+from kubeflow_tpu.autoscale.metrics import WindowStats
+from kubeflow_tpu.autoscale.policy import AutoscalePolicy
+from kubeflow_tpu.utils import DEFAULT_REGISTRY
+
+_desired_g = DEFAULT_REGISTRY.gauge(
+    "kftpu_autoscale_desired_replicas", "recommender desired replicas")
+_panic_g = DEFAULT_REGISTRY.gauge(
+    "kftpu_autoscale_panic_mode", "1 while the recommender is in panic")
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    desired: int
+    panic: bool
+    reason: str
+
+
+class Recommender:
+    """Per-model decision state machine. Not thread-safe on its own —
+    the reconciler serializes calls (one control loop per model)."""
+
+    def __init__(self, policy: AutoscalePolicy, model: str = "") -> None:
+        self.policy = policy.validate()
+        self.model = model or "model"
+        self.panic_mode = False
+        # last instant the panic condition held (panic exit requires a
+        # stable window of quiet after this)
+        self._panic_until: float = 0.0
+        # highest desired seen during the current panic — panic never
+        # scales down, even if the burst sags mid-panic
+        self._panic_high: int = 0
+        # when `desired < current` started holding (hysteresis anchor)
+        self._below_since: Optional[float] = None
+        self._idle_since: Optional[float] = None
+
+    def _raw_desired(self, stats: WindowStats) -> int:
+        return int(math.ceil(stats.load / self.policy.target_concurrency))
+
+    def recommend(self, stable: WindowStats, panic: WindowStats,
+                  current: int, now: float) -> Decision:
+        """One decision tick.
+
+        ``current`` is the replica count the fleet is actually at
+        (ready + warming): rate limits and the panic threshold are
+        relative to real capacity, not to a prior recommendation.
+        """
+        p = self.policy
+        want_stable = self._raw_desired(stable)
+        want_panic = self._raw_desired(panic)
+
+        # -- panic entry/exit ------------------------------------------------
+        # capacity the panic demand is compared against; at zero
+        # replicas any demand is a panic (cold-start burst)
+        threshold = max(current, 1) * p.panic_threshold
+        if want_panic >= threshold and panic.load > 0:
+            self._panic_until = now + p.stable_window_s
+            if not self.panic_mode:
+                self.panic_mode = True
+                self._panic_high = 0
+        elif self.panic_mode and now >= self._panic_until:
+            self.panic_mode = False
+            self._panic_high = 0
+
+        if self.panic_mode:
+            desired = max(want_panic, current, self._panic_high)
+            self._panic_high = desired
+            reason = (f"panic: window load {panic.load:.1f} needs "
+                      f"{want_panic} replicas (have {current})")
+        else:
+            desired = want_stable
+            reason = (f"stable: window load {stable.load:.1f} / target "
+                      f"{p.target_concurrency:g}")
+
+        # -- scale to zero ----------------------------------------------------
+        # an idle model heads to zero only after the grace period; until
+        # then at least one replica stays (Knative's grace window). The
+        # grace-ok zero bypasses rate limits and hysteresis below (both
+        # only act on desired > 0).
+        idle = stable.load <= 0 and panic.load <= 0
+        if idle and not self.panic_mode:
+            if self._idle_since is None:
+                self._idle_since = now
+            if (p.min_replicas == 0
+                    and now - self._idle_since >= p.scale_to_zero_grace_s):
+                desired = 0
+                reason = (f"idle {now - self._idle_since:.0f}s >= grace "
+                          f"{p.scale_to_zero_grace_s:g}s: scale to zero")
+            elif desired == 0 and current > 0:
+                desired = 1
+                reason += " (scale-to-zero grace pending)"
+        else:
+            self._idle_since = None
+
+        # -- rate limits ------------------------------------------------------
+        if current > 0 and desired > 0:
+            up_cap = max(int(math.floor(current * p.max_scale_up_rate)),
+                         current + 1)
+            down_cap = int(math.floor(current / p.max_scale_down_rate))
+            if desired > up_cap:
+                desired, reason = up_cap, reason + " (rate-limited up)"
+            if desired < down_cap:
+                desired, reason = down_cap, reason + " (rate-limited down)"
+
+        # -- scale-down hysteresis -------------------------------------------
+        if 0 < desired < current:
+            if self._below_since is None:
+                self._below_since = now
+            if now - self._below_since < p.scale_down_delay_s:
+                desired = current
+                reason += " (scale-down held)"
+        elif desired >= current:
+            self._below_since = None
+
+        desired = min(max(desired, p.min_replicas), p.max_replicas)
+        _desired_g.set(desired, model=self.model)
+        _panic_g.set(1.0 if self.panic_mode else 0.0, model=self.model)
+        return Decision(desired=desired, panic=self.panic_mode,
+                        reason=reason)

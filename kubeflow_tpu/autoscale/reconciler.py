@@ -1,0 +1,269 @@
+"""Reconcile loop: decisions → warmed, drained replica state.
+
+The loop closes what Knative's KPA + activator pair does for the
+reference platform: every tick it promotes finished warmups, retires
+drained replicas, asks the recommender for a count, asks the planner
+for concrete slices, and drives a :class:`ReplicaDriver` to make the
+fleet match. Two ordering guarantees the serving tier depends on:
+
+- **warm before admit** — a new replica is created, its compile/prefill
+  warmup hook runs, and only a replica the driver reports warm counts
+  as admitting capacity (``can_admit``). A cold TPU replica answering
+  traffic would serve its first requests through XLA compiles.
+- **drain before destroy** — scale-down marks a replica draining (no
+  new admissions) and destroys it only once the driver reports zero
+  in-flight work.
+
+State transitions are synchronous inside ``reconcile`` and time is an
+explicit parameter, so tests schedule bursts and idles deterministically.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Tuple
+
+from kubeflow_tpu.autoscale.metrics import MetricsAggregator
+from kubeflow_tpu.autoscale.planner import CapacityPlanner, Plan
+from kubeflow_tpu.autoscale.policy import AutoscalePolicy
+from kubeflow_tpu.autoscale.recommender import Decision, Recommender
+from kubeflow_tpu.scheduler.inventory import SliceInfo
+from kubeflow_tpu.utils import DEFAULT_REGISTRY
+
+_ready_g = DEFAULT_REGISTRY.gauge(
+    "kftpu_autoscale_ready_replicas", "replicas warmed and admitting")
+_warming_g = DEFAULT_REGISTRY.gauge(
+    "kftpu_autoscale_warming_replicas", "replicas created but not warm")
+_draining_g = DEFAULT_REGISTRY.gauge(
+    "kftpu_autoscale_draining_replicas", "replicas draining before stop")
+
+WARMING, READY, DRAINING = "warming", "ready", "draining"
+
+
+class ReplicaDriver:
+    """How the autoscaler touches actual serving capacity.
+
+    Subclasses bind the loop to a backend: stub replicas in tests, a
+    Deployment-scaling driver on a cluster, in-process engines in dev.
+    ``create`` may return any handle; the reconciler treats it opaquely.
+    """
+
+    def create(self, model: str, slice_id: str) -> Any:
+        raise NotImplementedError
+
+    def warmup(self, model: str, handle: Any) -> None:
+        """Start the compile/prefill warmup for a fresh replica. May
+        complete asynchronously; ``is_warm`` gates admission."""
+        raise NotImplementedError
+
+    def is_warm(self, model: str, handle: Any) -> bool:
+        raise NotImplementedError
+
+    def drain(self, model: str, handle: Any) -> None:
+        """Stop routing new work to the replica (best-effort notify)."""
+
+    def in_flight(self, model: str, handle: Any) -> int:
+        """Requests still being served — 0 means safe to destroy."""
+        return 0
+
+    def destroy(self, model: str, handle: Any) -> None:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class ReplicaState:
+    handle: Any
+    slice_id: str
+    phase: str                  # WARMING | READY | DRAINING
+    created_at: float
+    warmed_at: Optional[float] = None
+
+
+class _ModelLoop:
+    def __init__(self, policy: AutoscalePolicy, model: str) -> None:
+        self.policy = policy
+        self.recommender = Recommender(policy, model)
+        self.planner = CapacityPlanner(policy)
+        self.replicas: List[ReplicaState] = []
+        self.events: Deque[Tuple[float, str]] = collections.deque(maxlen=64)
+        self.last_decision: Optional[Decision] = None
+        self.last_plan: Optional[Plan] = None
+        self.persisted_scale: Optional[int] = None
+
+
+class Autoscaler:
+    """One control loop over every served model.
+
+    ``inventory`` is a zero-arg callable returning the scheduler's
+    current free-slice scan (``GangScheduler.inventory(shape)`` bound on
+    a cluster, a plain list in tests). ``registry`` (optional) is a
+    :class:`~kubeflow_tpu.serving.registry.ModelRegistry`-shaped object
+    whose ``set_scale`` persists the granted count, so the serving tier
+    and dashboard read replica state from the same document the model's
+    lifecycle stage lives in.
+    """
+
+    def __init__(self, policy: AutoscalePolicy, driver: ReplicaDriver,
+                 aggregator: Optional[MetricsAggregator] = None, *,
+                 inventory: Optional[
+                     Callable[[], Sequence[SliceInfo]]] = None,
+                 registry: Any = None,
+                 clock: Optional[Callable[[], float]] = None) -> None:
+        self.policy = policy.validate()
+        self.driver = driver
+        self.clock = clock if clock is not None else time.monotonic
+        self.aggregator = (aggregator if aggregator is not None
+                           else MetricsAggregator(clock=self.clock))
+        self.inventory = inventory if inventory is not None else (lambda: [])
+        self.registry = registry
+        self._loops: Dict[str, _ModelLoop] = {}
+        self._lock = threading.Lock()
+
+    def _loop(self, model: str) -> _ModelLoop:
+        lp = self._loops.get(model)
+        if lp is None:
+            lp = self._loops[model] = _ModelLoop(self.policy, model)
+        return lp
+
+    # -- admission gate ------------------------------------------------------
+
+    def can_admit(self, model: str) -> bool:
+        """True when a warmed replica is accepting traffic. The proxy
+        holds (503 + retry) requests for models where this is False —
+        the activator role: a request against a scaled-to-zero model
+        triggers scale-up via its telemetry and is admitted only once
+        warmup finished."""
+        with self._lock:
+            lp = self._loops.get(model)
+            if lp is None:
+                return True  # model not autoscaled: never block traffic
+            return any(r.phase == READY for r in lp.replicas)
+
+    def watch(self, model: str) -> None:
+        """Register a model with zero replicas (scale-from-zero start)."""
+        with self._lock:
+            self._loop(model)
+
+    # -- the loop ------------------------------------------------------------
+
+    def reconcile(self, model: str, now: Optional[float] = None) -> Decision:
+        """One tick for one model. Returns the decision for observability."""
+        now = self.clock() if now is None else now
+        # sample current telemetry so idle seconds enter the windows
+        self.aggregator.tick(model, now)
+        stable, panic = self.aggregator.stats(model, self.policy, now)
+        with self._lock:
+            lp = self._loop(model)
+            self._promote_and_retire(model, lp, now)
+            active = [r for r in lp.replicas if r.phase != DRAINING]
+            decision = lp.recommender.recommend(
+                stable, panic, len(active), now)
+            plan = lp.planner.plan(
+                decision.desired,
+                [r.slice_id for r in active],
+                list(self.inventory()),
+                busy=[r.slice_id for r in lp.replicas
+                      if r.phase == DRAINING])
+            self._apply(model, lp, plan, now)
+            # a synchronous warmup (dev drivers, pre-warmed checkpoints)
+            # may already be warm: promote in the same tick so the first
+            # request isn't held a full reconcile interval for nothing.
+            # Promotion only — a replica marked draining above must keep
+            # a full tick between drain and destroy.
+            self._promote(model, lp, now)
+            lp.last_decision, lp.last_plan = decision, plan
+            for msg in plan.events:
+                lp.events.append((now, msg))
+            self._export(model, lp)
+        if self.registry is not None and lp.persisted_scale != plan.granted:
+            try:
+                self.registry.set_scale(model, plan.granted,
+                                        reason=decision.reason)
+                lp.persisted_scale = plan.granted
+            except Exception:  # noqa: BLE001 — registry is observability,
+                pass           # never fail the control loop on it
+        return decision
+
+    def reconcile_all(self, now: Optional[float] = None) -> None:
+        for model in sorted(set(self.aggregator.models())
+                            | set(self._loops)):
+            self.reconcile(model, now)
+
+    def _promote(self, model: str, lp: _ModelLoop, now: float) -> None:
+        for r in lp.replicas:
+            if r.phase == WARMING and self.driver.is_warm(model, r.handle):
+                r.phase = READY
+                r.warmed_at = now
+                lp.events.append(
+                    (now, f"replica on {r.slice_id} warmed "
+                          f"({now - r.created_at:.1f}s)"))
+
+    def _promote_and_retire(self, model: str, lp: _ModelLoop,
+                            now: float) -> None:
+        self._promote(model, lp, now)
+        done = [r for r in lp.replicas
+                if r.phase == DRAINING
+                and self.driver.in_flight(model, r.handle) == 0]
+        for r in done:
+            self.driver.destroy(model, r.handle)
+            lp.replicas.remove(r)
+            lp.events.append((now, f"replica on {r.slice_id} drained "
+                                   "and destroyed"))
+
+    def _apply(self, model: str, lp: _ModelLoop, plan: Plan,
+               now: float) -> None:
+        for slice_id in plan.grow:
+            handle = self.driver.create(model, slice_id)
+            self.driver.warmup(model, handle)
+            lp.replicas.append(ReplicaState(
+                handle=handle, slice_id=slice_id, phase=WARMING,
+                created_at=now))
+            lp.events.append((now, f"replica created on {slice_id}; "
+                                   "warming"))
+        shrink = set(plan.shrink)
+        for r in lp.replicas:
+            if r.slice_id in shrink and r.phase != DRAINING:
+                r.phase = DRAINING
+                self.driver.drain(model, r.handle)
+                lp.events.append((now, f"replica on {r.slice_id} "
+                                       "draining"))
+
+    def _export(self, model: str, lp: _ModelLoop) -> None:
+        counts = collections.Counter(r.phase for r in lp.replicas)
+        _ready_g.set(counts[READY], model=model)
+        _warming_g.set(counts[WARMING], model=model)
+        _draining_g.set(counts[DRAINING], model=model)
+
+    # -- observability -------------------------------------------------------
+
+    def status(self) -> Dict[str, Any]:
+        """The dashboard's ``GET /api/metrics/autoscale`` payload."""
+        out: Dict[str, Any] = {"policy": dataclasses.asdict(self.policy),
+                               "models": {}}
+        with self._lock:
+            for model, lp in sorted(self._loops.items()):
+                counts = collections.Counter(
+                    r.phase for r in lp.replicas)
+                d, p = lp.last_decision, lp.last_plan
+                out["models"][model] = {
+                    "replicas": {
+                        "ready": counts[READY],
+                        "warming": counts[WARMING],
+                        "draining": counts[DRAINING],
+                    },
+                    "slices": [
+                        {"slice": r.slice_id, "phase": r.phase}
+                        for r in lp.replicas],
+                    "desired": d.desired if d else None,
+                    "panic": d.panic if d else False,
+                    "reason": d.reason if d else "",
+                    "capped": p.capped if p else False,
+                    "inflight": self.aggregator.inflight(model),
+                    "events": [
+                        {"t": round(t, 3), "message": m}
+                        for t, m in list(lp.events)[-16:]],
+                }
+        return out

@@ -1,0 +1,172 @@
+"""Autoscaler service: REST surface + the periodic reconcile thread.
+
+The deployable face of the subsystem (``manifests/components/
+autoscaler.py`` runs this module). Routes:
+
+- ``GET  /healthz``
+- ``GET  /api/autoscale/status``          — full loop state (dashboard view)
+- ``GET  /api/autoscale/can_admit?model=m`` — the remote activator
+  gate: True when a warmed replica is admitting (the proxy's
+  ``RemoteAdmitGate`` polls this, cached, failing open);
+- ``POST /api/autoscale/report``          — remote telemetry: the proxy
+  (or any frontend) posts ``{"model": m, "event": "start"|"finish"}``
+  per request, engines post ``{"model": m, "event": "observe",
+  "queueDepth": q, "activeSlots": a}`` — the cross-pod equivalent of
+  handing the in-process aggregator to the proxy constructor;
+- ``POST /api/autoscale/watch``           — register a model at zero
+  replicas so scale-from-zero has a loop to wake.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from kubeflow_tpu.autoscale.reconciler import Autoscaler
+
+log = logging.getLogger(__name__)
+
+
+class AutoscaleService:
+    def __init__(self, autoscaler: Autoscaler) -> None:
+        self.autoscaler = autoscaler
+
+    def handle(self, method: str, path: str, body: Optional[Dict[str, Any]],
+               user: str = "") -> Tuple[int, Any]:
+        if method == "GET" and path == "/healthz":
+            return 200, {"ok": True}
+        if method == "GET" and path == "/api/autoscale/status":
+            return 200, self.autoscaler.status()
+        if method == "GET" and path.startswith("/api/autoscale/can_admit"):
+            from urllib.parse import parse_qsl, urlsplit
+
+            q = dict(parse_qsl(urlsplit(path).query))
+            model = q.get("model", "")
+            if not model:
+                return 400, {"error": "can_admit needs ?model="}
+            return 200, {"model": model,
+                         "canAdmit": self.autoscaler.can_admit(model)}
+        if method == "POST" and path == "/api/autoscale/watch":
+            model = (body or {}).get("model", "")
+            if not model:
+                return 400, {"error": "body needs 'model'"}
+            self.autoscaler.watch(model)
+            return 200, {"watching": model}
+        if method == "POST" and path == "/api/autoscale/report":
+            return self._report(body or {})
+        return 404, {"error": "unknown endpoint"}
+
+    def _report(self, body: Dict[str, Any]) -> Tuple[int, Any]:
+        model = body.get("model", "")
+        event = body.get("event", "")
+        if not model:
+            return 400, {"error": "body needs 'model'"}
+        agg = self.autoscaler.aggregator
+        if event == "start":
+            agg.request_start(model)
+        elif event == "finish":
+            agg.request_finish(model)
+        elif event == "observe":
+            agg.observe(model,
+                        queue_depth=float(body.get("queueDepth", 0.0)),
+                        active_slots=(
+                            float(body["activeSlots"])
+                            if "activeSlots" in body else None))
+        else:
+            return 400, {"error": f"unknown event {event!r}; valid: "
+                                  "start, finish, observe"}
+        return 200, {"ok": True}
+
+
+def run_loop(autoscaler: Autoscaler, interval_s: float,
+             stop: Optional[threading.Event] = None) -> threading.Thread:
+    """Reconcile every model each ``interval_s`` until ``stop`` is set."""
+    stop = stop if stop is not None else threading.Event()
+
+    def loop() -> None:
+        while not stop.wait(interval_s):
+            try:
+                autoscaler.reconcile_all()
+            except Exception:  # noqa: BLE001 — a bad tick must not kill
+                log.exception("autoscale reconcile tick failed")
+
+    t = threading.Thread(target=loop, daemon=True, name="autoscale-loop")
+    t.stop = stop  # type: ignore[attr-defined] — handle for callers
+    t.start()
+    return t
+
+
+def main() -> None:  # pragma: no cover - container entrypoint
+    import os
+
+    from kubeflow_tpu.autoscale.policy import policy_from_env
+    from kubeflow_tpu.autoscale.reconciler import ReplicaDriver
+    from kubeflow_tpu.k8s.client import HttpKubeClient
+    from kubeflow_tpu.scheduler.inventory import GangScheduler
+    from kubeflow_tpu.serving.registry import ENV_REGISTRY_DIR, ModelRegistry
+    from kubeflow_tpu.utils.jsonhttp import serve_json
+
+    policy = policy_from_env()
+    client = HttpKubeClient()
+    scheduler = GangScheduler(client)
+
+    class DeploymentDriver(ReplicaDriver):
+        """Scales ONE serving Deployment by patching spec.replicas; a
+        replica is warm once the Deployment's ready count covers every
+        live handle (the server's own startup warmup gates readiness).
+        One Deployment per driver: point KFTPU_AUTOSCALE_MODELS at the
+        single model this Deployment serves."""
+
+        def __init__(self) -> None:
+            self.ns = os.environ.get("KFTPU_NAMESPACE", "kubeflow")
+            self.deploy = os.environ.get("KFTPU_AUTOSCALE_TARGET",
+                                         "model-server-v1")
+            # live handles, not a monotonic counter: readiness compares
+            # against the CURRENT fleet size, so a grow overlapping a
+            # drain can't demand more ready pods than spec.replicas
+            self._handles: set = set()
+            self._seq = 0
+
+        def _patch(self) -> None:
+            obj = client.get("apps/v1", "Deployment", self.ns, self.deploy)
+            obj["spec"]["replicas"] = len(self._handles)
+            client.update(obj)
+
+        def create(self, model: str, slice_id: str) -> int:
+            self._seq += 1
+            self._handles.add(self._seq)
+            self._patch()
+            return self._seq
+
+        def warmup(self, model: str, handle: int) -> None:
+            pass  # pod startup runs the server's compile warmup
+
+        def is_warm(self, model: str, handle: int) -> bool:
+            obj = client.get("apps/v1", "Deployment", self.ns, self.deploy)
+            ready = (obj.get("status", {}) or {}).get("readyReplicas", 0)
+            return int(ready or 0) >= len(self._handles)
+
+        def destroy(self, model: str, handle: int) -> None:
+            self._handles.discard(handle)
+            self._patch()
+
+    registry = None
+    reg_dir = os.environ.get(ENV_REGISTRY_DIR)
+    if reg_dir:
+        registry = ModelRegistry(reg_dir)
+    autoscaler = Autoscaler(
+        policy, DeploymentDriver(),
+        inventory=lambda: scheduler.inventory(policy.slice_shape),
+        registry=registry)
+    for model in os.environ.get("KFTPU_AUTOSCALE_MODELS", "").split(","):
+        if model.strip():
+            autoscaler.watch(model.strip())
+    run_loop(autoscaler,
+             float(os.environ.get("KFTPU_AUTOSCALE_INTERVAL_S", "2.0")))
+    serve_json(AutoscaleService(autoscaler).handle,
+               int(os.environ.get("KFTPU_AUTOSCALE_PORT", "8090")))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

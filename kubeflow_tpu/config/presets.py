@@ -32,8 +32,16 @@ def _standard(name: str) -> DeploymentConfig:
         platform="existing",
         components=[
             ComponentSpec("tpujob-operator"),
-            ComponentSpec("serving"),
-            ComponentSpec("dashboard"),
+            # serving autoscaler (Knative-KPA parity): proxy telemetry →
+            # slice-aware replica control. The proxy sidecar + its
+            # autoscale_url ARE the telemetry source — an autoscaler
+            # without them would idle with cluster RBAC for nothing
+            ComponentSpec("serving", params={
+                "proxy": True,
+                "autoscale_url": "http://serving-autoscaler:8090"}),
+            ComponentSpec("autoscaler"),
+            ComponentSpec("dashboard", params={
+                "autoscale_url": "http://serving-autoscaler:8090"}),
             ComponentSpec("notebooks"),
             ComponentSpec("tenancy"),
             ComponentSpec("auth"),
@@ -59,6 +67,9 @@ def _gcp_tpu(name: str) -> DeploymentConfig:
     cfg = _standard(name)
     cfg.platform = "gcp-tpu"
     cfg.components.append(ComponentSpec("credentials"))
+    # on real slices the autoscaler plans against the cluster's
+    # accelerator shape, and serving replicas occupy whole slices
+    cfg.component("autoscaler").params.update(slice_shape="v5e-8")
     cfg.platform_params = {
         "project": "",
         "zone": "us-central2-b",
@@ -68,10 +79,31 @@ def _gcp_tpu(name: str) -> DeploymentConfig:
     return cfg
 
 
+def _serving_burst(name: str) -> DeploymentConfig:
+    """Serving-first deployment: model server + proxy + autoscaler +
+    dashboard only — the smallest stack that rides out bursty predict
+    traffic (scale-to-zero dev pools use the 'dev' policy)."""
+    return DeploymentConfig(
+        name=name,
+        platform="existing",
+        components=[
+            ComponentSpec("serving", params={
+                "proxy": True,
+                "autoscale_url": "http://serving-autoscaler:8090"}),
+            ComponentSpec("autoscaler"),
+            ComponentSpec("model-registry"),
+            ComponentSpec("dashboard", params={
+                "autoscale_url": "http://serving-autoscaler:8090"}),
+            ComponentSpec("monitoring"),
+        ],
+    )
+
+
 PRESETS: Dict[str, Callable[[str], DeploymentConfig]] = {
     "minimal": _minimal,
     "standard": _standard,
     "gcp-tpu": _gcp_tpu,
+    "serving-burst": _serving_burst,
 }
 
 

@@ -9,6 +9,10 @@ Route parity with the reference's Express server
 - ``GET /api/metrics/<type>``      — behind a swappable MetricsService
   (``metrics_service_factory.ts``; Stackdriver impl swapped for one
   reading the framework's own Prometheus registry)
+- ``GET /api/metrics/autoscale``   — the serving autoscaler's loop state
+  (per-model ready/warming/draining replicas, panic flag, events); fed
+  by an in-process :class:`~kubeflow_tpu.autoscale.reconciler.Autoscaler`
+  or proxied from the autoscaler service (``KFTPU_AUTOSCALE_URL``)
 - ``GET /api/workgroup/exists``    — profile/workgroup flow via kfam
   (``api_workgroup.ts``)
 - ``GET /api/dashboard-links``     — component cards for the UI shell
@@ -145,7 +149,8 @@ class DashboardApi:
                  platform: str = "gcp-tpu",
                  run_archive=None,
                  artifact_store=None,
-                 authorize=None) -> None:
+                 authorize=None,
+                 autoscaler=None) -> None:
         from kubeflow_tpu.tenancy.authz import default_authorizer
 
         self.client = client
@@ -159,6 +164,9 @@ class DashboardApi:
         # behind the explicit dev flag
         self.authorize = (authorize if authorize is not None
                           else default_authorizer(client))
+        # anything with .status() (an Autoscaler, or a URL-backed shim);
+        # None = proxy to KFTPU_AUTOSCALE_URL, else registry gauges only
+        self.autoscaler = autoscaler
 
     def _authz(self, user: str, ns: str, resource: str) -> None:
         if not self.authorize(user, "get", ns, resource):
@@ -184,6 +192,8 @@ class DashboardApi:
                 # namespace-scoped tenant data, same guard as studies/runs
                 self._authz(user, ns, "events")
                 return 200, self.activities(ns)
+            if path == "/api/metrics/autoscale":
+                return 200, self.autoscale_view()
             if path.startswith("/api/metrics/"):
                 return 200, self.metrics.query(path.rsplit("/", 1)[1])
             if path == "/api/workgroup/exists":
@@ -277,6 +287,33 @@ class DashboardApi:
             "message": e.get("message", ""),
             "object": (e.get("involvedObject", {}) or {}).get("name", ""),
         } for e in events]
+
+    def autoscale_view(self) -> Dict[str, Any]:
+        """The autoscaler's loop state for the serving panel.
+
+        Resolution order: an in-process autoscaler handed to the
+        constructor, else the autoscaler service named by
+        ``KFTPU_AUTOSCALE_URL``, else the local registry's
+        ``kftpu_autoscale_*`` gauges (enough for "is it scaling" even
+        when the dashboard can't reach the loop)."""
+        if self.autoscaler is not None:
+            return self.autoscaler.status()
+        url = os.environ.get("KFTPU_AUTOSCALE_URL", "")
+        if url:
+            import json as _json
+            import urllib.request
+
+            try:
+                with urllib.request.urlopen(
+                        f"{url.rstrip('/')}/api/autoscale/status",
+                        timeout=5.0) as resp:
+                    return _json.loads(resp.read())
+            except (OSError, ValueError):
+                return {"error": f"autoscaler at {url} unreachable",
+                        "metrics": _parse_prom(DEFAULT_REGISTRY.expose(),
+                                               "kftpu_autoscale_")}
+        return {"metrics": _parse_prom(DEFAULT_REGISTRY.expose(),
+                                       "kftpu_autoscale_")}
 
     def workgroup_exists(self, user: str) -> Dict[str, Any]:
         profiles = self.client.list(PROFILE_API_VERSION, PROFILE_KIND)

@@ -3,6 +3,7 @@
 from kubeflow_tpu.manifests.components import (  # noqa: F401
     application,
     auth,
+    autoscaler,
     credentials,
     dashboard,
     dataprep,

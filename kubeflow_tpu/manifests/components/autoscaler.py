@@ -1,0 +1,97 @@
+"""Serving autoscaler component: the KPA/activator role as a Deployment.
+
+The reference gets serving autoscale from Knative's KPA via KFServing;
+here it is the framework's own control loop
+(:mod:`kubeflow_tpu.autoscale`) deployed next to the model server. The
+pod runs ``kubeflow_tpu.autoscale.service``: it watches the configured
+models, scales the target serving Deployment by patching
+``spec.replicas``, reads slice inventory from node labels (the gang
+scheduler's scan), and serves loop status + the remote-report endpoint
+the proxy posts request telemetry to (``KFTPU_AUTOSCALE_URL``).
+
+RBAC mirrors what the loop touches: Deployments (scale target), Nodes +
+Pods (slice inventory), Events (degradation notices).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from kubeflow_tpu.config.deployment import DeploymentConfig
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.manifests.registry import register
+
+DEFAULTS: Dict[str, Any] = {
+    "name": "serving-autoscaler",
+    # same image as the serving tier — the autoscaler is framework code
+    "image": "kubeflow-tpu/serving:v1alpha1",
+    "port": 8090,
+    # policy preset (kubeflow_tpu/autoscale/policy.py POLICY_PRESETS)
+    # plus the per-field overrides most deployments touch
+    "policy": "serving",
+    "target_concurrency": 0.0,   # 0 = preset value
+    "max_replicas": 0,           # 0 = preset value
+    "slice_shape": "",           # "" = preset value, e.g. "v5e-8"
+    # serving Deployment whose spec.replicas the loop drives
+    "target_deployment": "model-server-v1",
+    # comma-separated model names to watch from zero replicas
+    "models": "",
+    "interval_s": 2.0,
+}
+
+
+@register("autoscaler", DEFAULTS,
+          "TPU-slice-aware serving autoscaler (Knative-KPA parity)")
+def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
+    ns = config.namespace
+    name = params["name"]
+
+    env = {
+        "KFTPU_AUTOSCALE_POLICY": params["policy"],
+        "KFTPU_AUTOSCALE_TARGET": params["target_deployment"],
+        "KFTPU_AUTOSCALE_MODELS": params["models"],
+        "KFTPU_AUTOSCALE_INTERVAL_S": str(params["interval_s"]),
+        "KFTPU_AUTOSCALE_PORT": str(params["port"]),
+        "KFTPU_NAMESPACE": ns,
+    }
+    # 0/"" = keep the preset's value; only real overrides render
+    if params["target_concurrency"]:
+        env["KFTPU_AUTOSCALE_TARGET_CONCURRENCY"] = str(
+            params["target_concurrency"])
+    if params["max_replicas"]:
+        env["KFTPU_AUTOSCALE_MAX_REPLICAS"] = str(params["max_replicas"])
+    if params["slice_shape"]:
+        env["KFTPU_AUTOSCALE_SLICE_SHAPE"] = params["slice_shape"]
+
+    pod = o.pod_spec([
+        o.container(
+            "autoscaler",
+            params["image"],
+            command=["python", "-m", "kubeflow_tpu.autoscale.service"],
+            env=env,
+            ports=[params["port"]],
+        )
+    ], service_account_name=name)
+    return [
+        o.service_account(name, ns),
+        o.cluster_role(name, [
+            {"apiGroups": ["apps"], "resources": ["deployments"],
+             "verbs": ["get", "list", "update", "patch"]},
+            {"apiGroups": [""], "resources": ["nodes", "pods"],
+             "verbs": ["get", "list", "watch"]},
+            {"apiGroups": [""], "resources": ["events"],
+             "verbs": ["create"]},
+        ]),
+        o.cluster_role_binding(name, name, name, ns),
+        o.deployment(name, ns, pod, labels={"app": name}),
+        o.service(
+            name, ns, {"app": name},
+            [{"name": "http", "port": params["port"],
+              "targetPort": params["port"]}],
+            labels={"app": name},
+            annotations={
+                "prometheus.io/scrape": "true",
+                "prometheus.io/path": "/metrics",
+                "prometheus.io/port": str(params["port"]),
+            }),
+    ]

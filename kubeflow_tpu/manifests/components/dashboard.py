@@ -14,6 +14,9 @@ DEFAULTS: Dict[str, Any] = {
     "image": "kubeflow-tpu/dashboard:v1alpha1",
     "port": 8082,
     "replicas": 1,
+    # autoscaler service URL for the /api/metrics/autoscale panel; ""
+    # falls back to the dashboard's own (empty) local gauges
+    "autoscale_url": "",
 }
 
 
@@ -26,7 +29,9 @@ def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
             name,
             params["image"],
             command=["python", "-m", "kubeflow_tpu.dashboard.server"],
-            env={"KFTPU_DASHBOARD_PORT": str(params["port"])},
+            env={"KFTPU_DASHBOARD_PORT": str(params["port"]),
+                 **({"KFTPU_AUTOSCALE_URL": params["autoscale_url"]}
+                    if params["autoscale_url"] else {})},
             ports=[params["port"]],
         )],
         service_account_name=name,

@@ -41,6 +41,10 @@ DEFAULTS: Dict[str, Any] = {
     # request-logging http proxy sidecar service (k8s-model-server/http-proxy)
     "proxy": False,
     "proxy_port": 8008,
+    # autoscaler service URL; non-empty wires the proxy's per-request
+    # start/finish telemetry to it (kubeflow_tpu/autoscale), e.g.
+    # "http://serving-autoscaler:8090"
+    "autoscale_url": "",
 }
 
 
@@ -175,7 +179,9 @@ def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
                 command=["python", "-m", "kubeflow_tpu.serving.proxy"],
                 env={"KFTPU_PROXY_PORT": str(params["proxy_port"]),
                      "KFTPU_BACKEND_URL":
-                         f"http://{name}:{params['rest_port']}"},
+                         f"http://{name}:{params['rest_port']}",
+                     **({"KFTPU_AUTOSCALE_URL": params["autoscale_url"]}
+                        if params["autoscale_url"] else {})},
                 ports=[params["proxy_port"]],
             )
         ])

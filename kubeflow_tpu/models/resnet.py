@@ -33,6 +33,11 @@ class ResNetConfig:
     # in f32 inside flax. bf16 halves the activation traffic of every
     # norm+relu — on TPU the model is HBM-bound, not FLOP-bound, there.
     bn_dtype: Any = jnp.bfloat16
+    # shared BN constants — every norm in the model (stem, blocks, and
+    # the fused bn2conv3 path) reads these, so a fused/unfused A/B can
+    # never diverge on a hardcoded momentum or epsilon
+    bn_momentum: float = 0.9
+    bn_epsilon: float = 1e-5
     # "conv": plain 7x7/2 stem. "space_to_depth": rearrange 224²×3 images
     # into 56²×48 blocks first (MLPerf-style): the 7x7 conv over 3 channels
     # wastes almost the whole 128-lane MXU contraction; over 48 channels it
@@ -69,6 +74,10 @@ class FusedBnReluConv(nn.Module):
     use_running_average: bool
     dtype: Any
     param_dtype: Any
+    # the dtype the unfused path would materialize the BN output in;
+    # threaded into the fused op's act_dtype so bn_dtype != f32 rounds
+    # identically on both sides of an A/B
+    bn_dtype: Any = jnp.float32
     momentum: float = 0.9
     epsilon: float = 1e-5
 
@@ -103,7 +112,8 @@ class FusedBnReluConv(nn.Module):
         lead = x.shape[:-1]
         out = fused_scale_relu_matmul(
             x.reshape(-1, C).astype(self.dtype), a, b,
-            kernel.reshape(C, self.features).astype(self.dtype))
+            kernel.reshape(C, self.features).astype(self.dtype),
+            None, self.bn_dtype)
         return out.reshape(*lead, self.features)
 
 
@@ -113,6 +123,8 @@ class BottleneckBlock(nn.Module):
     dtype: Any
     param_dtype: Any
     bn_dtype: Any = jnp.float32
+    bn_momentum: float = 0.9
+    bn_epsilon: float = 1e-5
     act_compress: bool = False
     fused_bn_conv: bool = False
 
@@ -132,8 +144,8 @@ class BottleneckBlock(nn.Module):
         norm = partial(
             nn.BatchNorm,
             use_running_average=not train,
-            momentum=0.9,
-            epsilon=1e-5,
+            momentum=self.bn_momentum,
+            epsilon=self.bn_epsilon,
             # statistics are always reduced in f32 inside flax; bn_dtype only
             # sets the normalized output's dtype
             dtype=self.bn_dtype,
@@ -144,11 +156,14 @@ class BottleneckBlock(nn.Module):
         y = nn.relu(norm(name="bn1")(y))
         y = conv(self.filters, (3, 3), strides=(self.strides, self.strides), name="conv2")(y)
         if self.fused_bn_conv:
-            # bn2 -> relu -> conv3 in one pass over the conv2 output
+            # bn2 -> relu -> conv3 in one pass over the conv2 output;
+            # same bn_dtype/momentum/epsilon as the norm partial — the
+            # constants come from ResNetConfig so they cannot drift
             y = FusedBnReluConv(
                 self.filters * 4, use_running_average=not train,
                 dtype=self.dtype, param_dtype=self.param_dtype,
-                momentum=0.9, epsilon=1e-5,  # keep == the norm partial
+                bn_dtype=self.bn_dtype,
+                momentum=self.bn_momentum, epsilon=self.bn_epsilon,
                 name="bn2conv3")(y)
         else:
             y = nn.relu(norm(name="bn2")(y))
@@ -200,8 +215,9 @@ class ResNet(nn.Module):
                 name="stem_conv_s2d",
             )(x)
             x = nn.BatchNorm(
-                use_running_average=not train, momentum=0.9, epsilon=1e-5,
-                dtype=c.bn_dtype, param_dtype=c.param_dtype, name="stem_bn",
+                use_running_average=not train, momentum=c.bn_momentum,
+                epsilon=c.bn_epsilon, dtype=c.bn_dtype,
+                param_dtype=c.param_dtype, name="stem_bn",
             )(x)
             x = nn.relu(x)  # already 56²; the maxpool's downsample is folded
         else:
@@ -211,8 +227,9 @@ class ResNet(nn.Module):
                 name="stem_conv",
             )(x)
             x = nn.BatchNorm(
-                use_running_average=not train, momentum=0.9, epsilon=1e-5,
-                dtype=c.bn_dtype, param_dtype=c.param_dtype, name="stem_bn",
+                use_running_average=not train, momentum=c.bn_momentum,
+                epsilon=c.bn_epsilon, dtype=c.bn_dtype,
+                param_dtype=c.param_dtype, name="stem_bn",
             )(x)
             x = nn.relu(x)
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
@@ -224,6 +241,8 @@ class ResNet(nn.Module):
                     dtype=c.dtype,
                     param_dtype=c.param_dtype,
                     bn_dtype=c.bn_dtype,
+                    bn_momentum=c.bn_momentum,
+                    bn_epsilon=c.bn_epsilon,
                     act_compress=c.act_compress,
                     fused_bn_conv=c.fused_bn_conv,
                     name=f"stage{i}_block{j}",

@@ -32,10 +32,19 @@ import jax.numpy as jnp
 from kubeflow_tpu.ops.attention import _resolve_interpret
 
 
-def _pick_block(dim: int, want: int) -> int:
-    """Largest power-of-two block <= want that divides dim (>= 8)."""
+def _pick_block(dim: int, want: int, floor: int = 8) -> int:
+    """Largest power-of-two block <= want that divides dim (>= floor).
+
+    ``floor`` encodes the TPU block-layout rule (ops/attention.py): a
+    dimension that appears as a *lane* (last) axis of any kernel block
+    needs tiles that are multiples of 128 — Mosaic rejects smaller lane
+    tiles in compiled mode even though interpret-mode CPU tests accept
+    them. K and N are lane axes here (x/a/b and w/o blocks), so their
+    floor is 128; M only ever appears as a sublane axis (floor 8).
+    Shapes with no legal block fall back to the XLA composition.
+    """
     b = want
-    while b >= 8:
+    while b >= floor:
         if dim % b == 0:
             return b
         b //= 2
@@ -43,19 +52,28 @@ def _pick_block(dim: int, want: int) -> int:
 
 
 def _tileable(M: int, K: int, N: int) -> bool:
-    return bool(_pick_block(M, 512) and _pick_block(K, 256)
-                and _pick_block(N, 256))
+    return bool(_pick_block(M, 512) and _pick_block(K, 256, floor=128)
+                and _pick_block(N, 256, floor=128))
 
 
-def _reference(x, a, b, w):
-    """The unfused composition (also the fallback for untileable shapes)."""
-    y = jnp.maximum(x.astype(jnp.float32) * a + b, 0.0).astype(x.dtype)
-    return jax.lax.dot_general(y, w, (((1,), (0,)), ((), ())),
+def _reference(x, a, b, w, act_dtype=None):
+    """The unfused composition (also the fallback for untileable shapes).
+
+    ``act_dtype`` reproduces the unfused model's normalize rounding: the
+    BN output is materialized in ``bn_dtype`` there, so the fused path
+    must round the activation through the same dtype before the GEMM or
+    an A/B against the unfused model diverges whenever bn_dtype differs
+    from the compute dtype."""
+    y = jnp.maximum(x.astype(jnp.float32) * a + b, 0.0)
+    y = y.astype(act_dtype if act_dtype is not None else x.dtype)
+    return jax.lax.dot_general(y.astype(x.dtype), w,
+                               (((1,), (0,)), ((), ())),
                                preferred_element_type=jnp.float32
                                ).astype(x.dtype)
 
 
-def _fwd_kernel(x_ref, a_ref, b_ref, w_ref, o_ref, acc_ref, *, nk: int):
+def _fwd_kernel(x_ref, a_ref, b_ref, w_ref, o_ref, acc_ref, *, nk: int,
+                act_dtype):
     import jax.experimental.pallas as pl
 
     kidx = pl.program_id(2)
@@ -65,7 +83,7 @@ def _fwd_kernel(x_ref, a_ref, b_ref, w_ref, o_ref, acc_ref, *, nk: int):
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     xb = x_ref[...].astype(jnp.float32)
-    y = jnp.maximum(xb * a_ref[...] + b_ref[...], 0.0)
+    y = jnp.maximum(xb * a_ref[...] + b_ref[...], 0.0).astype(act_dtype)
     acc_ref[...] += jax.lax.dot_general(
         y.astype(x_ref.dtype), w_ref[...], (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
@@ -75,7 +93,8 @@ def _fwd_kernel(x_ref, a_ref, b_ref, w_ref, o_ref, acc_ref, *, nk: int):
         o_ref[...] = acc_ref[...].astype(o_ref.dtype)
 
 
-def _dw_kernel(x_ref, a_ref, b_ref, g_ref, dw_ref, acc_ref, *, nm: int):
+def _dw_kernel(x_ref, a_ref, b_ref, g_ref, dw_ref, acc_ref, *, nm: int,
+               act_dtype):
     """dW = relu(x*a+b)^T @ dz, recomputing the activation inline while
     streaming x — the backward never materializes y either."""
     import jax.experimental.pallas as pl
@@ -87,7 +106,7 @@ def _dw_kernel(x_ref, a_ref, b_ref, g_ref, dw_ref, acc_ref, *, nm: int):
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     xb = x_ref[...].astype(jnp.float32)
-    y = jnp.maximum(xb * a_ref[...] + b_ref[...], 0.0)
+    y = jnp.maximum(xb * a_ref[...] + b_ref[...], 0.0).astype(act_dtype)
     acc_ref[...] += jax.lax.dot_general(
         y.astype(x_ref.dtype), g_ref[...], (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
@@ -97,31 +116,38 @@ def _dw_kernel(x_ref, a_ref, b_ref, g_ref, dw_ref, acc_ref, *, nm: int):
         dw_ref[...] = acc_ref[...].astype(dw_ref.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
-def fused_scale_relu_matmul(x, a, b, w, interpret: Optional[bool] = None):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def fused_scale_relu_matmul(x, a, b, w, interpret: Optional[bool] = None,
+                            act_dtype: Optional[Any] = None):
     """``relu(x * a + b) @ w`` in one pass over ``x``.
 
     x: (M, K) activations (bf16/f32); a, b: (K,) f32 per-channel affine;
     w: (K, N) weights. Returns (M, N) in x.dtype. Shapes that don't
     tile (tiny test models) fall back to the XLA composition.
+    ``act_dtype`` (default: x.dtype) is the dtype the normalized
+    activation is rounded through before the GEMM — thread the model's
+    ``bn_dtype`` here so the fused path matches the unfused BN's
+    materialization numerics.
     """
-    return _fused_fwd_impl(x, a, b, w, interpret)
+    return _fused_fwd_impl(x, a, b, w, interpret, act_dtype)
 
 
-def _fused_fwd_impl(x, a, b, w, interpret):
+def _fused_fwd_impl(x, a, b, w, interpret, act_dtype=None):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     M, K = x.shape
     N = w.shape[1]
+    if act_dtype is None:
+        act_dtype = x.dtype
     if not _tileable(M, K, N):
-        return _reference(x, a, b, w)
+        return _reference(x, a, b, w, act_dtype)
     bm = _pick_block(M, 512)
-    bk = _pick_block(K, 256)
-    bn = _pick_block(N, 256)
+    bk = _pick_block(K, 256, floor=128)
+    bn = _pick_block(N, 256, floor=128)
     nk = K // bk
     return pl.pallas_call(
-        functools.partial(_fwd_kernel, nk=nk),
+        functools.partial(_fwd_kernel, nk=nk, act_dtype=act_dtype),
         grid=(M // bm, N // bn, nk),
         in_specs=[
             pl.BlockSpec((bm, bk), lambda m, n, k: (m, k)),
@@ -137,17 +163,19 @@ def _fused_fwd_impl(x, a, b, w, interpret):
       w)
 
 
-def _fused_vjp_fwd(x, a, b, w, interpret):
-    return _fused_fwd_impl(x, a, b, w, interpret), (x, a, b, w)
+def _fused_vjp_fwd(x, a, b, w, interpret, act_dtype):
+    return _fused_fwd_impl(x, a, b, w, interpret, act_dtype), (x, a, b, w)
 
 
-def _fused_vjp_bwd(interpret, res, dz):
+def _fused_vjp_bwd(interpret, act_dtype, res, dz):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     x, a, b, w = res
     M, K = x.shape
     N = w.shape[1]
+    if act_dtype is None:
+        act_dtype = x.dtype
     # chain through the activation: one elementwise recompute of xhat
     # (XLA fuses mask/dx/da/db into a single pass over x and dz@w.T)
     xf = x.astype(jnp.float32)
@@ -161,11 +189,11 @@ def _fused_vjp_bwd(interpret, res, dz):
 
     if _tileable(M, K, N):
         bm = _pick_block(M, 512)
-        bk = _pick_block(K, 256)
-        bn = _pick_block(N, 256)
+        bk = _pick_block(K, 256, floor=128)
+        bn = _pick_block(N, 256, floor=128)
         nm = M // bm
         dw = pl.pallas_call(
-            functools.partial(_dw_kernel, nm=nm),
+            functools.partial(_dw_kernel, nm=nm, act_dtype=act_dtype),
             grid=(K // bk, N // bn, nm),
             in_specs=[
                 pl.BlockSpec((bm, bk), lambda k, n, m: (m, k)),
@@ -181,7 +209,7 @@ def _fused_vjp_bwd(interpret, res, dz):
           b.astype(jnp.float32)[None, :], dz)
         dw = dw.astype(w.dtype)
     else:
-        y = jnp.maximum(xhat, 0.0).astype(x.dtype)
+        y = jnp.maximum(xhat, 0.0).astype(act_dtype).astype(x.dtype)
         dw = jax.lax.dot_general(y, dz, (((0,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32
                                  ).astype(w.dtype)

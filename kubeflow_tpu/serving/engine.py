@@ -539,6 +539,22 @@ class DecodeEngine:
         with self._lock:
             return sum(s is not None for s in self._active)
 
+    @property
+    def pending_count(self) -> int:
+        """Requests admitted to submit() but not yet holding a slot."""
+        return self._pending.qsize()
+
+    def snapshot(self) -> dict:
+        """Occupancy snapshot for the autoscaler's engine poll
+        (:meth:`kubeflow_tpu.autoscale.metrics.MetricsAggregator
+        .observe_engine`): active slots are the concurrency the proxy
+        can't see (one HTTP generate call hides a whole decode stream),
+        pending is the admission-queue depth."""
+        return {"active_slots": self.active_count,
+                "pending": self.pending_count,
+                "slots": self.slots,
+                "closed": self.closed}
+
     # -- engine internals --------------------------------------------------
 
     def _prefix_cache_row(self, prefix: np.ndarray):

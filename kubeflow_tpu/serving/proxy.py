@@ -8,6 +8,20 @@ forwards ``POST /model/<name>:predict`` to the backend's
 ``/v1/models/<name>:predict`` and emits one structured JSONL log line per
 request (latency, status, model, batch size) — the stream a log shipper
 tails instead of a fluentd sidecar.
+
+Autoscale wiring: the proxy is the request-telemetry source of the
+serving autoscaler (:mod:`kubeflow_tpu.autoscale`). Hand the
+constructor a ``reporter`` (anything with ``request_start(model)`` /
+``request_finish(model)`` — the in-process
+:class:`~kubeflow_tpu.autoscale.metrics.MetricsAggregator`, or a small
+shim POSTing to the autoscaler service's ``/api/autoscale/report``) and
+every predict call is counted in-flight for the window math. With an
+``admit_gate`` (``can_admit(model) -> bool``, the
+:class:`~kubeflow_tpu.autoscale.reconciler.Autoscaler`), the proxy also
+plays the Knative-activator role: requests against a model with no
+warmed replica are answered 503 + ``Retry-After`` instead of being
+forwarded into a cold backend — their telemetry is exactly what wakes
+the scale-from-zero loop.
 """
 
 from __future__ import annotations
@@ -16,6 +30,7 @@ import json
 import sys
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Any, Dict, Optional, Tuple
 
@@ -28,10 +43,14 @@ _proxied = DEFAULT_REGISTRY.counter(
 
 class PredictProxy:
     def __init__(self, backend_url: str, *, log_stream=None,
-                 timeout_s: float = 30.0) -> None:
+                 timeout_s: float = 30.0, reporter=None,
+                 admit_gate=None, retry_after_s: int = 1) -> None:
         self.backend_url = backend_url.rstrip("/")
         self.log_stream = log_stream if log_stream is not None else sys.stdout
         self.timeout_s = timeout_s
+        self.reporter = reporter
+        self.admit_gate = admit_gate
+        self.retry_after_s = retry_after_s
 
     def handle(self, method: str, path: str, body: Optional[Dict[str, Any]],
                user: str = "") -> Tuple[int, Any]:
@@ -42,7 +61,23 @@ class PredictProxy:
             return 404, {"error": "use POST /model/<name>:predict"}
         model = path[len("/model/"):-len(":predict")]
         t0 = time.perf_counter()
-        code, payload = self._forward(model, body or {})
+        # start/finish bracket EVERY outcome (including the 503 hold):
+        # the held request's in-flight blip is the demand signal that
+        # wakes the scale-from-zero loop
+        if self.reporter is not None:
+            self.reporter.request_start(model)
+        try:
+            if (self.admit_gate is not None
+                    and not self.admit_gate.can_admit(model)):
+                code, payload = 503, {
+                    "error": f"no ready replica for {model!r}; scaling up",
+                    "retryAfterSeconds": self.retry_after_s,
+                }
+            else:
+                code, payload = self._forward(model, body or {})
+        finally:
+            if self.reporter is not None:
+                self.reporter.request_finish(model)
         latency_ms = (time.perf_counter() - t0) * 1000.0
         _proxied.inc(model=model)
         self._log({
@@ -77,11 +112,104 @@ class PredictProxy:
         self.log_stream.flush()
 
 
+class RemoteReporter:
+    """Cross-pod telemetry: POSTs start/finish events to the autoscaler
+    service's ``/api/autoscale/report``. Best-effort AND off the hot
+    path — events go through a bounded queue drained by a background
+    thread, so a slow or dead autoscaler costs dropped telemetry (the
+    loop degrades to static replicas), never predict latency."""
+
+    def __init__(self, base_url: str, timeout_s: float = 2.0,
+                 queue_size: int = 1024) -> None:
+        import queue as _queue
+        import threading
+
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+        self.dropped = 0
+        self._q: "_queue.Queue" = _queue.Queue(maxsize=queue_size)
+        self._thread = threading.Thread(target=self._drain, daemon=True,
+                                        name="autoscale-reporter")
+        self._thread.start()
+
+    def _drain(self) -> None:
+        while True:
+            event, model = self._q.get()
+            req = urllib.request.Request(
+                f"{self.base_url}/api/autoscale/report",
+                data=json.dumps({"model": model,
+                                 "event": event}).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout_s):
+                    pass
+            except (urllib.error.URLError, OSError):
+                pass
+
+    def _enqueue(self, event: str, model: str) -> None:
+        import queue as _queue
+
+        try:
+            self._q.put_nowait((event, model))
+        except _queue.Full:
+            # drop rather than block a predict; a start/finish pair lost
+            # here skews one window sample, nothing more
+            self.dropped += 1
+
+    def request_start(self, model: str) -> None:
+        self._enqueue("start", model)
+
+    def request_finish(self, model: str) -> None:
+        self._enqueue("finish", model)
+
+
+class RemoteAdmitGate:
+    """Cross-pod activator gate: asks the autoscaler service whether a
+    model has a warmed replica, with a short per-model cache so the
+    predict path pays at most one status GET per TTL — and FAILS OPEN
+    (admit) when the autoscaler is unreachable: a broken control plane
+    must degrade to static serving, not to a 503 wall."""
+
+    def __init__(self, base_url: str, timeout_s: float = 1.0,
+                 ttl_s: float = 1.0) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+        self.ttl_s = ttl_s
+        self._cache: Dict[str, Tuple[float, bool]] = {}
+
+    def can_admit(self, model: str) -> bool:
+        now = time.monotonic()
+        hit = self._cache.get(model)
+        if hit is not None and now - hit[0] < self.ttl_s:
+            return hit[1]
+        ok = True
+        try:
+            with urllib.request.urlopen(
+                    f"{self.base_url}/api/autoscale/can_admit?"
+                    + urllib.parse.urlencode({"model": model}),
+                    timeout=self.timeout_s) as resp:
+                ok = bool(json.loads(resp.read()).get("canAdmit", True))
+        except (urllib.error.URLError, OSError, ValueError):
+            ok = True
+        self._cache[model] = (now, ok)
+        return ok
+
+
 def main() -> None:
     import os
 
+    reporter = admit_gate = None
+    autoscale_url = os.environ.get("KFTPU_AUTOSCALE_URL", "")
+    if autoscale_url:
+        reporter = RemoteReporter(autoscale_url)
+        # the activator role end-to-end: scale-from-zero requests are
+        # held (503 + Retry-After) instead of forwarded into a
+        # zero-endpoint backend Service
+        admit_gate = RemoteAdmitGate(autoscale_url)
     proxy = PredictProxy(
-        os.environ.get("KFTPU_BACKEND_URL", "http://localhost:8500"))
+        os.environ.get("KFTPU_BACKEND_URL", "http://localhost:8500"),
+        reporter=reporter, admit_gate=admit_gate)
     serve_json(proxy.handle, int(os.environ.get("KFTPU_PROXY_PORT", "8008")))
 
 

@@ -147,6 +147,37 @@ class ModelRegistry:
             self._store(doc)
             return doc["versions"][str(int(version))]
 
+    def set_scale(self, model: str, replicas: int, *,
+                  reason: str = "") -> Dict[str, Any]:
+        """Record the autoscaler's granted replica count on the model
+        document. The autoscale reconciler writes this every tick it
+        changes the fleet; the dashboard and CI read replica state from
+        the same file the lifecycle stage lives in (one source of truth
+        per model). Unknown models get a versionless document — a model
+        can be watched before its first version registers."""
+        replicas = int(replicas)
+        if replicas < 0:
+            raise RegistryError(f"replicas must be >= 0, got {replicas}")
+        with self._lock:
+            doc = self._load(model) or {"name": _check_name(model),
+                                        "versions": {}}
+            doc["scale"] = {
+                "replicas": replicas,
+                "reason": reason,
+                "updated_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                            time.gmtime()),
+            }
+            self._store(doc)
+            return doc["scale"]
+
+    def scale(self, model: str) -> Dict[str, Any]:
+        """The recorded replica state (zero replicas when never set)."""
+        doc = self._load(model)
+        if doc is None:
+            raise NotFoundError(f"unknown model {model!r}")
+        return doc.get("scale", {"replicas": 0, "reason": "",
+                                 "updated_at": ""})
+
     def log_metrics(self, model: str, version: int,
                     metrics: Dict[str, float]) -> Dict[str, Any]:
         with self._lock:
@@ -247,6 +278,8 @@ class RegistryService:
     - ``GET  /api/registry/models``
     - ``GET  /api/registry/models/<m>/versions``
     - ``GET  /api/registry/models/<m>/production``
+    - ``GET  /api/registry/models/<m>/scale``              (autoscaler state)
+    - ``POST /api/registry/models/<m>/scale``              (set replicas)
     - ``POST /api/registry/models/<m>/versions``           (register)
     - ``POST /api/registry/models/<m>/versions/<v>:metrics``
     - ``POST /api/registry/models/<m>/versions/<v>:transition``
@@ -300,6 +333,14 @@ class RegistryService:
                     lineage=body.get("lineage"),
                     base_path=body.get("basePath", ""))
                 return 200, entry
+            if rest == ["scale"] and method == "GET":
+                return 200, self.registry.scale(model)
+            if rest == ["scale"] and method == "POST":
+                if "replicas" not in body:
+                    return 400, {"error": "body needs 'replicas'"}
+                return 200, self.registry.set_scale(
+                    model, int(body["replicas"]),
+                    reason=body.get("reason", ""))
             if rest == ["production"] and method == "GET":
                 prod = self.registry.production(model)
                 if prod is None:

@@ -1,0 +1,560 @@
+"""TPU-slice-aware serving autoscaler (kubeflow_tpu/autoscale/).
+
+Everything runs on a fake clock — the aggregator, recommender and
+reconciler take explicit ``now`` values, so window math, panic
+transitions and warmup/drain ordering are asserted deterministically.
+The simulated load test at the bottom is the subsystem's acceptance
+gate: burst → panic scale-up within one panic window, idle → drain +
+scale-to-zero, re-arrival → held until a warmed replica admits.
+"""
+
+from typing import Dict, List
+
+import pytest
+
+from kubeflow_tpu.autoscale import (
+    Autoscaler,
+    AutoscalePolicy,
+    CapacityPlanner,
+    MetricsAggregator,
+    Recommender,
+    ReplicaDriver,
+    policy_preset,
+)
+from kubeflow_tpu.autoscale.metrics import WindowStats
+from kubeflow_tpu.scheduler.inventory import SliceInfo
+
+
+def _stats(load: float, queue: float = 0.0) -> WindowStats:
+    return WindowStats(concurrency=load, queue_depth=queue, rps=0.0,
+                       samples=1)
+
+
+def _inventory(n: int, shape: str = "v5e-4", hosts: int = 1,
+               busy: int = 0) -> List[SliceInfo]:
+    return [SliceInfo(slice_id=f"{shape}_{i}", shape=shape, hosts=hosts,
+                      free_hosts=0 if i < busy else hosts)
+            for i in range(n)]
+
+
+class StubDriver(ReplicaDriver):
+    """In-memory replicas with controllable warmup and drain."""
+
+    def __init__(self) -> None:
+        self.seq = 0
+        self.warm: Dict[int, bool] = {}
+        self.inflight: Dict[int, int] = {}
+        self.log: List[str] = []
+        self.instant_warm = False
+
+    def create(self, model: str, slice_id: str) -> int:
+        self.seq += 1
+        self.warm[self.seq] = False
+        self.inflight[self.seq] = 0
+        self.log.append(f"create:{slice_id}")
+        return self.seq
+
+    def warmup(self, model: str, handle: int) -> None:
+        self.log.append(f"warmup:{handle}")
+        if self.instant_warm:
+            self.warm[handle] = True
+
+    def finish_warmup(self, handle: int) -> None:
+        self.warm[handle] = True
+
+    def is_warm(self, model: str, handle: int) -> bool:
+        return self.warm[handle]
+
+    def drain(self, model: str, handle: int) -> None:
+        self.log.append(f"drain:{handle}")
+
+    def in_flight(self, model: str, handle: int) -> int:
+        return self.inflight[handle]
+
+    def destroy(self, model: str, handle: int) -> None:
+        self.log.append(f"destroy:{handle}")
+        del self.warm[handle]
+
+
+# -- metrics aggregator -----------------------------------------------------
+
+
+def test_aggregator_windows_are_deterministic():
+    agg = MetricsAggregator(clock=lambda: 0.0)
+    # 4 requests land in second 0..3 and stay in flight
+    for t in range(4):
+        agg.request_start("m", now=float(t))
+    assert agg.inflight("m") == 4
+    # panic window (4s @ now=4) sees the ramp 1,2,3,4 → avg 2.5
+    w = agg.window("m", 4.0, now=4.0)
+    assert w.concurrency == pytest.approx(2.5)
+    assert w.rps == pytest.approx(1.0)
+    for _ in range(4):
+        agg.request_finish("m", now=5.0)
+    assert agg.inflight("m") == 0
+    # empty window after the horizon rolls: falls back to the gauge
+    w = agg.window("m", 2.0, now=60.0)
+    assert w.concurrency == 0.0 and w.samples == 0
+
+
+def test_aggregator_engine_occupancy_counts_as_concurrency():
+    class FakeEngine:
+        def snapshot(self):
+            return {"active_slots": 6, "pending": 3, "slots": 8,
+                    "closed": False}
+
+    agg = MetricsAggregator(clock=lambda: 0.0)
+    agg.observe_engine("m", FakeEngine(), now=1.0)
+    w = agg.window("m", 10.0, now=1.0)
+    assert w.concurrency == pytest.approx(6.0)
+    assert w.queue_depth == pytest.approx(3.0)
+    assert w.load == pytest.approx(9.0)
+
+
+# -- recommender ------------------------------------------------------------
+
+
+def test_recommender_stable_tracks_target():
+    p = AutoscalePolicy(target_concurrency=4.0, min_replicas=1,
+                        pow2_packing=False)
+    r = Recommender(p, "m")
+    d = r.recommend(_stats(12.0), _stats(12.0), current=3, now=0.0)
+    assert d.desired == 3 and not d.panic
+    # small growth, no panic: 16/4 = 4 replicas (< 2x current 3)
+    d = r.recommend(_stats(16.0), _stats(16.0), current=3, now=1.0)
+    assert d.desired == 4 and not d.panic
+
+
+def test_recommender_panic_entry_and_exit():
+    p = AutoscalePolicy(target_concurrency=4.0, stable_window_s=60.0,
+                        panic_window_s=6.0, panic_threshold=2.0)
+    r = Recommender(p, "m")
+    # burst: panic window sees 40 in flight, stable still remembers calm
+    d = r.recommend(_stats(4.0), _stats(40.0), current=1, now=0.0)
+    assert d.panic and d.desired == 10
+    # burst sags mid-panic: desired must NOT drop (panic floor)
+    d = r.recommend(_stats(4.0), _stats(8.0), current=10, now=10.0)
+    assert d.panic and d.desired == 10
+    # panic exits only after a full stable window of quiet
+    d = r.recommend(_stats(4.0), _stats(4.0), current=10, now=30.0)
+    assert d.panic, "still inside the quiet window"
+    d = r.recommend(_stats(4.0), _stats(4.0), current=10, now=61.0)
+    assert not d.panic
+    # post-panic scale-down passes through hysteresis, not a cliff
+    assert d.desired == 10  # held by scale_down_delay_s
+    d = r.recommend(_stats(4.0), _stats(4.0), current=10, now=95.0)
+    assert d.desired == 5  # max_scale_down_rate=2 bounds the step
+
+
+def test_recommender_scale_up_rate_limit():
+    p = AutoscalePolicy(target_concurrency=1.0, max_scale_up_rate=3.0,
+                        max_replicas=100)
+    r = Recommender(p, "m")
+    d = r.recommend(_stats(50.0), _stats(50.0), current=2, now=0.0)
+    assert d.desired == 6  # 2 * max_scale_up_rate
+
+
+def test_recommender_scale_to_zero_needs_grace():
+    p = AutoscalePolicy(target_concurrency=4.0, scale_to_zero_grace_s=30.0,
+                        scale_down_delay_s=5.0)
+    r = Recommender(p, "m")
+    idle = _stats(0.0)
+    d = r.recommend(idle, idle, current=2, now=0.0)
+    assert d.desired >= 1, "grace pending: the last replica stays"
+    d = r.recommend(idle, idle, current=1, now=10.0)
+    assert d.desired == 1
+    d = r.recommend(idle, idle, current=1, now=31.0)
+    assert d.desired == 0, "grace elapsed: scale to zero"
+    # min_replicas > 0 never goes to zero
+    r2 = Recommender(AutoscalePolicy(min_replicas=1), "m")
+    d = r2.recommend(idle, idle, current=1, now=0.0)
+    d = r2.recommend(idle, idle, current=1, now=1000.0)
+    assert d.desired == 1
+
+
+# -- planner ----------------------------------------------------------------
+
+
+def test_planner_grants_concrete_free_slices():
+    p = AutoscalePolicy(slice_shape="v5e-4", pow2_packing=False)
+    plan = CapacityPlanner(p).plan(2, [], _inventory(4))
+    assert plan.granted == 2 and len(plan.grow) == 2
+    assert not plan.capped and plan.shrink == []
+    assert all(s.startswith("v5e-4_") for s in plan.grow)
+
+
+def test_planner_pow2_packing_rounds_up_when_room():
+    p = AutoscalePolicy(slice_shape="v5e-4", pow2_packing=True,
+                        max_replicas=16)
+    plan = CapacityPlanner(p).plan(3, [], _inventory(8))
+    assert plan.granted == 4  # 3 → 4
+    assert any("pow2" in e for e in plan.events)
+    # no room for 4: falls back to the raw ask of 3
+    plan = CapacityPlanner(p).plan(3, [], _inventory(3))
+    assert plan.granted == 3 and not plan.capped
+
+
+def test_planner_degrades_when_inventory_exhausted():
+    p = AutoscalePolicy(slice_shape="v5e-4", pow2_packing=False)
+    inv = _inventory(6, busy=4)  # only 2 fully-free slices
+    plan = CapacityPlanner(p).plan(5, [], inv)
+    assert plan.granted == 2 and plan.capped
+    assert any("exhausted" in e for e in plan.events)
+    # nothing free at all: granted stays at current, still no throw
+    plan = CapacityPlanner(p).plan(5, ["v5e-4_9"], _inventory(2, busy=2))
+    assert plan.granted == 1 and plan.capped
+
+
+def test_planner_ignores_partially_busy_and_assigned_slices():
+    p = AutoscalePolicy(slice_shape="v5e-8", pow2_packing=False)
+    inv = [SliceInfo("v5e-8_0", "v5e-8", 2, 2),
+           SliceInfo("v5e-8_1", "v5e-8", 2, 1),   # partially busy
+           SliceInfo("v5e-8_2", "v5e-8", 2, 2)]
+    plan = CapacityPlanner(p).plan(3, ["v5e-8_0"], inv)
+    assert plan.grow == ["v5e-8_2"]
+    assert plan.capped
+
+
+def test_planner_never_grants_draining_slices():
+    """A draining replica still owns its slice: even when the inventory
+    scan (racing the teardown) reports it free, the planner must not
+    double-book it."""
+    p = AutoscalePolicy(slice_shape="v5e-4", pow2_packing=False)
+    plan = CapacityPlanner(p).plan(2, ["v5e-4_0"], _inventory(3),
+                                   busy=["v5e-4_1"])
+    assert "v5e-4_1" not in plan.grow
+    assert plan.grow == ["v5e-4_2"]
+
+
+def test_reconciler_excludes_draining_from_regrant():
+    p = AutoscalePolicy(target_concurrency=1.0, pow2_packing=False,
+                        scale_down_delay_s=1.0, scale_to_zero_grace_s=2.0)
+    driver = StubDriver()
+    driver.instant_warm = True
+    asc = Autoscaler(p, driver, inventory=lambda: _inventory(2),
+                     clock=lambda: 0.0)
+    asc.watch("m")
+    asc.aggregator.request_start("m", now=0.0)
+    asc.reconcile("m", now=0.0)          # replica on slice 0
+    asc.aggregator.request_finish("m", now=1.0)
+    driver.inflight[1] = 1               # straggler: drain will linger
+    asc.reconcile("m", now=200.0)
+    asc.reconcile("m", now=203.0)        # grace elapsed → draining
+    assert asc.status()["models"]["m"]["replicas"]["draining"] == 1
+    # demand returns while slice 0 is still draining
+    asc.aggregator.request_start("m", now=204.0)
+    asc.reconcile("m", now=205.0)
+    slices = asc.status()["models"]["m"]["slices"]
+    fresh = [s["slice"] for s in slices if s["phase"] != "draining"]
+    draining = [s["slice"] for s in slices if s["phase"] == "draining"]
+    assert draining == ["v5e-4_0"]
+    assert fresh == ["v5e-4_1"], "must not re-grant the draining slice"
+
+
+def test_planner_shrinks_newest_first():
+    p = AutoscalePolicy(slice_shape="v5e-4")
+    plan = CapacityPlanner(p).plan(
+        1, ["v5e-4_0", "v5e-4_1", "v5e-4_2"], _inventory(4, busy=3))
+    assert plan.granted == 1
+    assert plan.shrink == ["v5e-4_1", "v5e-4_2"]
+
+
+# -- reconciler -------------------------------------------------------------
+
+
+def _autoscaler(policy, driver, free_slices=8, clock=None):
+    inv = {"n": free_slices}
+    return Autoscaler(
+        policy, driver,
+        inventory=lambda: _inventory(inv["n"]),
+        clock=clock if clock is not None else (lambda: 0.0)), inv
+
+
+def test_reconciler_warm_before_admit():
+    p = AutoscalePolicy(target_concurrency=1.0, pow2_packing=False,
+                        min_replicas=0)
+    driver = StubDriver()
+    asc, _ = _autoscaler(p, driver)
+    asc.watch("m")
+    assert asc.can_admit("unwatched-model"), "never block static models"
+    assert not asc.can_admit("m")
+    asc.aggregator.request_start("m", now=0.0)
+    asc.reconcile("m", now=1.0)
+    # replica created + warmup started, but NOT admitting yet
+    assert driver.log[:2] == ["create:v5e-4_0", "warmup:1"]
+    assert not asc.can_admit("m")
+    asc.reconcile("m", now=2.0)
+    assert not asc.can_admit("m"), "still cold after another tick"
+    driver.finish_warmup(1)
+    asc.reconcile("m", now=3.0)
+    assert asc.can_admit("m")
+
+
+def test_reconciler_drain_before_destroy():
+    p = AutoscalePolicy(target_concurrency=1.0, pow2_packing=False,
+                        scale_down_delay_s=5.0, scale_to_zero_grace_s=10.0)
+    driver = StubDriver()
+    driver.instant_warm = True
+    clock = {"t": 0.0}
+    asc, _ = _autoscaler(p, driver, clock=lambda: clock["t"])
+    asc.watch("m")
+    asc.aggregator.request_start("m", now=0.0)
+    asc.reconcile("m", now=0.0)
+    asc.reconcile("m", now=1.0)
+    assert asc.can_admit("m")
+    # request completes; replica still serving one straggler
+    asc.aggregator.request_finish("m", now=2.0)
+    driver.inflight[1] = 1
+    # idle long enough for grace (windows only remember the horizon)
+    t = 130.0
+    asc.reconcile("m", now=t)
+    asc.reconcile("m", now=t + 11.0)
+    assert "drain:1" in driver.log
+    assert "destroy:1" not in driver.log, "straggler still in flight"
+    assert not asc.can_admit("m"), "draining replica admits nothing"
+    driver.inflight[1] = 0
+    asc.reconcile("m", now=t + 12.0)
+    assert "destroy:1" in driver.log
+    st = asc.status()["models"]["m"]
+    assert st["replicas"] == {"ready": 0, "warming": 0, "draining": 0}
+
+
+def test_reconciler_persists_scale_to_registry(tmp_path):
+    from kubeflow_tpu.serving.registry import ModelRegistry
+
+    reg = ModelRegistry(str(tmp_path))
+    p = AutoscalePolicy(target_concurrency=1.0, pow2_packing=False)
+    driver = StubDriver()
+    driver.instant_warm = True
+    asc = Autoscaler(p, driver, inventory=lambda: _inventory(4),
+                     registry=reg, clock=lambda: 0.0)
+    asc.watch("m")
+    asc.aggregator.request_start("m", now=0.0)
+    asc.reconcile("m", now=1.0)
+    assert reg.scale("m")["replicas"] == 1
+    # the registry REST surface serves the same document
+    from kubeflow_tpu.serving.registry import RegistryService
+
+    svc = RegistryService(reg)
+    code, body = svc.handle("GET", "/api/registry/models/m/scale", None)
+    assert code == 200 and body["replicas"] == 1
+
+
+def test_registry_scale_roundtrip(tmp_path):
+    from kubeflow_tpu.serving.registry import ModelRegistry, RegistryService
+
+    svc = RegistryService(ModelRegistry(str(tmp_path)))
+    code, body = svc.handle("POST", "/api/registry/models/m/scale",
+                            {"replicas": 3, "reason": "manual"})
+    assert code == 200 and body["replicas"] == 3
+    code, body = svc.handle("GET", "/api/registry/models/m/scale", None)
+    assert code == 200
+    assert body["replicas"] == 3 and body["reason"] == "manual"
+    code, _ = svc.handle("POST", "/api/registry/models/m/scale",
+                         {"replicas": -1})
+    assert code == 400
+    code, _ = svc.handle("GET", "/api/registry/models/nope/scale", None)
+    assert code == 404
+
+
+# -- proxy + dashboard wiring ----------------------------------------------
+
+
+def test_proxy_reports_and_holds():
+    import io
+
+    from kubeflow_tpu.serving.proxy import PredictProxy
+
+    agg = MetricsAggregator(clock=lambda: 0.0)
+    p = AutoscalePolicy(target_concurrency=1.0, pow2_packing=False)
+    driver = StubDriver()
+    asc = Autoscaler(p, driver, aggregator=agg,
+                     inventory=lambda: _inventory(2), clock=lambda: 0.0)
+    asc.watch("m")
+    proxy = PredictProxy("http://127.0.0.1:1", log_stream=io.StringIO(),
+                         reporter=agg, admit_gate=asc)
+    code, body = proxy.handle("POST", "/model/m:predict",
+                              {"instances": [1]})
+    assert code == 503 and "no ready replica" in body["error"]
+    # the held request still counted: its telemetry wakes the loop
+    assert agg.window("m", 10.0, now=1.0).rps > 0
+    assert agg.inflight("m") == 0, "finish reported after the 503"
+    # unwatched model: gate passes, forward fails (no backend) → 502
+    code, _ = proxy.handle("POST", "/model/other:predict", {})
+    assert code == 502
+
+
+def test_dashboard_autoscale_view():
+    from kubeflow_tpu.dashboard.server import DashboardApi
+    from kubeflow_tpu.k8s.client import FakeKubeClient
+
+    p = AutoscalePolicy(target_concurrency=1.0, pow2_packing=False)
+    driver = StubDriver()
+    driver.instant_warm = True
+    asc = Autoscaler(p, driver, inventory=lambda: _inventory(2),
+                     clock=lambda: 0.0)
+    asc.watch("m")
+    asc.aggregator.request_start("m", now=0.0)
+    asc.reconcile("m", now=1.0)
+    api = DashboardApi(FakeKubeClient(), autoscaler=asc,
+                       authorize=lambda *a: True)
+    code, body = api.handle("GET", "/api/metrics/autoscale", None)
+    assert code == 200
+    assert body["models"]["m"]["replicas"]["ready"] == 1
+    assert body["policy"]["target_concurrency"] == 1.0
+
+
+def test_autoscale_service_routes():
+    from kubeflow_tpu.autoscale.service import AutoscaleService
+
+    p = AutoscalePolicy(target_concurrency=1.0, pow2_packing=False)
+    asc = Autoscaler(p, StubDriver(), inventory=lambda: _inventory(2),
+                     clock=lambda: 0.0)
+    svc = AutoscaleService(asc)
+    assert svc.handle("GET", "/healthz", None)[0] == 200
+    code, _ = svc.handle("POST", "/api/autoscale/watch", {"model": "m"})
+    assert code == 200
+    code, _ = svc.handle("POST", "/api/autoscale/report",
+                         {"model": "m", "event": "start"})
+    assert code == 200
+    assert asc.aggregator.inflight("m") == 1
+    code, _ = svc.handle("POST", "/api/autoscale/report",
+                         {"model": "m", "event": "observe",
+                          "queueDepth": 2, "activeSlots": 4})
+    assert code == 200
+    code, body = svc.handle("GET", "/api/autoscale/status", None)
+    assert code == 200 and "m" in body["models"]
+    assert svc.handle("POST", "/api/autoscale/report",
+                      {"model": "m", "event": "bogus"})[0] == 400
+    # the remote activator gate endpoint
+    code, body = svc.handle("GET", "/api/autoscale/can_admit?model=m",
+                            None)
+    assert code == 200 and body["canAdmit"] is False  # zero replicas
+    code, body = svc.handle(
+        "GET", "/api/autoscale/can_admit?model=unwatched", None)
+    assert code == 200 and body["canAdmit"] is True
+    assert svc.handle("GET", "/api/autoscale/can_admit", None)[0] == 400
+
+
+def test_remote_admit_gate_fails_open():
+    """A dead autoscaler must degrade to static serving, not a 503
+    wall — the gate admits when its status GET can't be answered."""
+    from kubeflow_tpu.serving.proxy import RemoteAdmitGate
+
+    gate = RemoteAdmitGate("http://127.0.0.1:1", timeout_s=0.2)
+    assert gate.can_admit("m") is True
+    # and the verdict is cached (no second blocking call inside the TTL)
+    assert gate._cache["m"][1] is True
+
+
+def test_engine_snapshot_shape():
+    """The aggregator's engine poll contract, without building a real
+    engine: snapshot() exists on DecodeEngine and returns these keys."""
+    import inspect
+
+    from kubeflow_tpu.serving.engine import DecodeEngine
+
+    src = inspect.getsource(DecodeEngine.snapshot)
+    for key in ("active_slots", "pending", "slots", "closed"):
+        assert key in src
+
+
+# -- the simulated load test (acceptance gate) ------------------------------
+
+
+def test_simulated_burst_drain_and_rearrival():
+    """End-to-end on stubs + fake clock:
+
+    1. steady trickle keeps one replica;
+    2. a burst pushes the panic window past threshold → panic scale-up
+       lands within ONE panic window of the burst;
+    3. inventory caps the panic ask → partial grant + event;
+    4. idle drains everything to zero;
+    5. a re-arriving request is held (503-style gate) until the warmed
+       replica flips ready, then admits.
+    """
+    policy = AutoscalePolicy(
+        target_concurrency=4.0,
+        stable_window_s=60.0,
+        panic_window_s=6.0,
+        panic_threshold=2.0,
+        max_scale_up_rate=100.0,
+        scale_down_delay_s=10.0,
+        scale_to_zero_grace_s=20.0,
+        slice_shape="v5e-4",
+        pow2_packing=False,
+        max_replicas=16,
+    )
+    driver = StubDriver()
+    inv = {"n": 4}
+    asc = Autoscaler(policy, driver,
+                     inventory=lambda: _inventory(inv["n"]),
+                     clock=lambda: 0.0)
+    agg = asc.aggregator
+    asc.watch("m")
+
+    # -- phase 1: trickle → one replica, warmed, admitting ------------------
+    agg.request_start("m", now=0.0)
+    asc.reconcile("m", now=1.0)
+    assert len(driver.warm) == 1
+    driver.finish_warmup(1)
+    asc.reconcile("m", now=2.0)
+    assert asc.can_admit("m")
+    agg.request_finish("m", now=3.0)
+
+    # -- phase 2: burst of 40 concurrent requests at t=10 -------------------
+    for i in range(40):
+        agg.request_start("m", now=10.0 + i * 0.01)
+    # one reconcile tick INSIDE the panic window after the burst:
+    d = asc.reconcile("m", now=11.0)
+    assert d.panic, "burst must flip panic within one panic window"
+    assert d.desired > 4, "window demand must exceed the inventory"
+    status = asc.status()["models"]["m"]
+    total = (status["replicas"]["ready"] + status["replicas"]["warming"])
+    # window-averaged demand is ~21 concurrency → 6 replicas, but only
+    # 4 slices exist → partial grant + degradation event, no throw
+    assert total == 4, f"want all 4 slices granted, got {total}"
+    assert status["capped"]
+    assert any("exhausted" in e["message"] for e in status["events"])
+
+    # warm the burst replicas; they admit
+    for h in list(driver.warm):
+        driver.finish_warmup(h)
+    asc.reconcile("m", now=12.0)
+    assert asc.status()["models"]["m"]["replicas"]["ready"] == 4
+
+    # -- phase 3: burst ends; idle → drain + scale-to-zero ------------------
+    for _ in range(40):
+        agg.request_finish("m", now=20.0)
+    # windows roll past the horizon, grace elapses, hysteresis expires
+    t0 = 200.0
+    asc.reconcile("m", now=t0)          # idle timer starts
+    asc.reconcile("m", now=t0 + 21.0)   # grace elapsed → drain all
+    st = asc.status()["models"]["m"]
+    assert st["replicas"]["draining"] == 4 and st["replicas"]["ready"] == 0
+    assert not asc.can_admit("m")
+    asc.reconcile("m", now=t0 + 22.0)   # in_flight 0 → destroyed
+    st = asc.status()["models"]["m"]
+    assert st["replicas"] == {"ready": 0, "warming": 0, "draining": 0}
+    assert st["desired"] == 0
+    destroys = [x for x in driver.log if x.startswith("destroy:")]
+    assert len(destroys) == 4
+
+    # -- phase 4: re-arrival against zero replicas --------------------------
+    t1 = t0 + 400.0  # far past the horizon: windows are clean
+    agg.request_start("m", now=t1)
+    asc.reconcile("m", now=t1 + 1.0)
+    st = asc.status()["models"]["m"]
+    assert st["replicas"]["warming"] == 1
+    assert not asc.can_admit("m"), \
+        "request must be HELD until the replica warms"
+    asc.reconcile("m", now=t1 + 2.0)
+    assert not asc.can_admit("m"), "still cold, still held"
+    new_handle = max(driver.warm)
+    driver.finish_warmup(new_handle)
+    asc.reconcile("m", now=t1 + 3.0)
+    assert asc.can_admit("m"), "warmed replica admits the held request"
+    # warmup strictly precedes admission in the driver's event order
+    warm_idx = driver.log.index(f"warmup:{new_handle}")
+    assert all(not e.startswith("destroy") for e in
+               driver.log[warm_idx:]), "no churn during the re-arrival"

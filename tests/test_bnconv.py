@@ -8,12 +8,36 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from kubeflow_tpu.ops.bnconv import _reference, fused_scale_relu_matmul
+from kubeflow_tpu.ops.bnconv import (
+    _reference,
+    _tileable,
+    fused_scale_relu_matmul,
+)
+
+
+def test_lane_dims_without_128_block_are_untileable():
+    """The TPU block-layout rule (ADVICE r5): K and N are lane axes of
+    the kernel's blocks, so a shape whose lane dim has no power-of-two
+    block that is a multiple of 128 must NOT tile — compiled Mosaic
+    would reject the tiny tiles interpret-mode CPU tests accept."""
+    assert _tileable(256, 128, 128)
+    assert _tileable(512, 256, 1024)
+    # lane dims divisible by 8 but with no 128-multiple block: fallback
+    assert not _tileable(64, 24, 40)
+    assert not _tileable(64, 128, 40)
+    assert not _tileable(64, 24, 128)
+    # no power-of-two >= 8 divides 20 at all
+    assert not _tileable(64, 20, 128)
+    # sublane (M) keeps the 8 floor
+    assert not _tileable(4, 128, 128)
 
 
 @pytest.mark.parametrize("M,K,N", [(256, 128, 128),   # tiled pallas path
-                                   (64, 24, 40)])      # fallback path
+                                   (64, 20, 40)])      # fallback path
 def test_op_matches_reference_fwd_and_grads(M, K, N):
+    assert _tileable(M, K, N) == (M == 256), (
+        "parametrization drifted: the second case must exercise the "
+        "XLA fallback branch")
     keys = jax.random.split(jax.random.key(0), 5)
     x = jax.random.normal(keys[0], (M, K), jnp.float32)
     a = jax.random.normal(keys[1], (K,), jnp.float32) * 0.5 + 1.0
@@ -34,6 +58,41 @@ def test_op_matches_reference_fwd_and_grads(M, K, N):
     for got, want, name in zip(gf, gr, "xabw"):
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=2e-3, rtol=1e-4,
+                                   err_msg=f"d{name}")
+
+
+def test_act_dtype_rounds_like_the_unfused_bn():
+    """Threading bn_dtype=bf16 must reproduce the unfused path's
+    materialize-in-bf16 rounding, forward and gradients."""
+    keys = jax.random.split(jax.random.key(3), 5)
+    M, K, N = 64, 20, 40  # fallback shape: pure-XLA on CPU
+    x = jax.random.normal(keys[0], (M, K), jnp.float32)
+    a = jax.random.normal(keys[1], (K,), jnp.float32) * 0.5 + 1.0
+    b = jax.random.normal(keys[2], (K,), jnp.float32) * 0.1
+    w = jax.random.normal(keys[3], (K, N), jnp.float32) * 0.05
+    g = jax.random.normal(keys[4], (M, N), jnp.float32)
+
+    def unfused(x, a, b, w):
+        y = jnp.maximum(x * a + b, 0.0).astype(jnp.bfloat16)
+        return jnp.dot(y.astype(jnp.float32), w)
+
+    def fused(x, a, b, w):
+        return fused_scale_relu_matmul(x, a, b, w, None, jnp.bfloat16)
+
+    np.testing.assert_allclose(np.asarray(fused(x, a, b, w)),
+                               np.asarray(unfused(x, a, b, w)),
+                               atol=1e-5)
+    # bf16 rounding actually happened (differs from the f32 op)
+    assert not np.allclose(np.asarray(fused(x, a, b, w)),
+                           np.asarray(fused_scale_relu_matmul(x, a, b, w)),
+                           atol=1e-6)
+    gf = jax.grad(lambda *args: jnp.sum(fused(*args) * g),
+                  argnums=(0, 1, 2, 3))(x, a, b, w)
+    gu = jax.grad(lambda *args: jnp.sum(unfused(*args) * g),
+                  argnums=(0, 1, 2, 3))(x, a, b, w)
+    for got, want, name in zip(gf, gu, "xabw"):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=5e-3, rtol=2e-2,
                                    err_msg=f"d{name}")
 
 
