@@ -1,0 +1,53 @@
+"""tpulint — AST-based static analysis for TPU kernels and platform wiring.
+
+A platform that schedules whole TPU slices cannot afford to discover
+tile-illegality or nondeterministic control loops at runtime. PR 1
+fixed a Mosaic tile-legality bug in ``ops/bnconv.py`` by hand (lane-dim
+blocks below 128 emit illegal tiles in compiled mode) and threaded an
+injectable clock through the autoscaler; tpulint turns both classes of
+bug into machine-checked rules so they stay fixed as the codebase grows
+— the ``kfctl check`` role from the reference, pointed at kernels.
+
+Layout:
+
+- :mod:`findings`  — the structured :class:`Finding` record
+- :mod:`walker`    — per-file parse (:class:`ModuleInfo`) + repo walk
+- :mod:`pragmas`   — inline ``# tpulint: disable=TPU00x`` suppression
+- :mod:`registry`  — pluggable checker registry (``@register_checker``)
+- :mod:`baseline`  — committed grandfather file for pre-existing debt
+- :mod:`runner`    — orchestration: walk → check → suppress → diff
+- :mod:`checkers`  — the shipped rules TPU001–TPU005
+
+Rule catalog (details in ``docs/ANALYSIS.md``):
+
+==========  ==================================================
+TPU001      tile-legality: BlockSpec lane/sublane tile floors
+TPU002      host calls reachable inside jit/Pallas bodies
+TPU003      raw wall clock in controllers (inject a Clock)
+TPU004      wiring drift: component URLs/ports/RBAC vs presets
+TPU005      retry/poll loops with no deadline or max-attempts
+==========  ==================================================
+"""
+
+from kubeflow_tpu.analysis.findings import Finding, SEVERITIES
+from kubeflow_tpu.analysis.registry import (
+    Checker,
+    all_checkers,
+    create_checkers,
+    register_checker,
+)
+from kubeflow_tpu.analysis.runner import LintReport, run_lint
+from kubeflow_tpu.analysis.walker import ModuleInfo, walk_paths
+
+__all__ = [
+    "Checker",
+    "Finding",
+    "LintReport",
+    "ModuleInfo",
+    "SEVERITIES",
+    "all_checkers",
+    "create_checkers",
+    "register_checker",
+    "run_lint",
+    "walk_paths",
+]

@@ -1,0 +1,101 @@
+"""Shared AST helpers for checkers: dotted names, constant resolution.
+
+Everything here is conservative: a helper that cannot prove a fact
+returns None rather than guessing, so checkers err toward silence on
+code they cannot resolve (false negatives over false positives — the
+baseline workflow only works if a clean run stays clean).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Union
+
+FunctionNode = Union[ast.FunctionDef, ast.AsyncFunctionDef]
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``ast.Attribute``/``ast.Name`` chain → "a.b.c" (None if the
+    chain includes calls/subscripts that have no static name)."""
+    parts = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+def const_int(node: ast.AST) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    return None
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def is_const_true(node: ast.AST) -> bool:
+    """``while True:`` / ``while 1:`` style constant-truthy tests."""
+    return isinstance(node, ast.Constant) and bool(node.value)
+
+
+def assignments_to(scope: ast.AST, name: str) -> Iterator[ast.AST]:
+    """Yield the value expressions assigned to ``name`` anywhere in
+    ``scope`` (plain and annotated assigns; ignores augmented)."""
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == name:
+                    yield node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            if isinstance(node.target, ast.Name) and node.target.id == name:
+                yield node.value
+
+
+def resolve_int(scope: ast.AST, node: ast.AST) -> Optional[int]:
+    """Resolve ``node`` to an int: a literal, or a name with exactly one
+    literal assignment in ``scope`` (ambiguous names stay None)."""
+    v = const_int(node)
+    if v is not None:
+        return v
+    if isinstance(node, ast.Name) and scope is not None:
+        values = [const_int(a) for a in assignments_to(scope, node.id)]
+        ints = [v for v in values if v is not None]
+        if len(values) == 1 and len(ints) == 1:
+            return ints[0]
+    return None
+
+
+def functions(tree: ast.AST) -> Iterator[FunctionNode]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def decorator_names(fn: FunctionNode) -> Iterator[str]:
+    """Dotted names of decorators, looking through ``functools.partial``
+    and bare calls: ``@functools.partial(jax.jit, ...)`` yields both
+    "functools.partial" and "jax.jit"."""
+    for dec in fn.decorator_list:
+        name = dotted_name(dec)
+        if name:
+            yield name
+        elif isinstance(dec, ast.Call):
+            name = dotted_name(dec.func)
+            if name:
+                yield name
+            if name in ("functools.partial", "partial") and dec.args:
+                inner = dotted_name(dec.args[0])
+                if inner:
+                    yield inner

@@ -1,0 +1,79 @@
+"""Baseline file: grandfathered findings that do not gate CI.
+
+The baseline maps finding fingerprints (rule + file + normalized source
+line, see :mod:`findings`) to occurrence counts. A run is clean when,
+for every fingerprint, the current count is <= the baselined count —
+moving a grandfathered line or editing unrelated code nearby does not
+trip the gate, but *adding* a new violation (even one textually
+identical to a baselined one elsewhere in the same file... a new
+occurrence) does.
+
+The file is committed (``tpulint_baseline.json``) and shrunk over time:
+``scripts/run_tpulint.py --baseline-update`` rewrites it from the
+current findings, so fixing debt and updating is one command.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, Tuple
+
+from kubeflow_tpu.analysis.findings import Finding
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE = "tpulint_baseline.json"
+
+
+def fingerprint_counts(
+        findings: Iterable[Tuple[Finding, str]]) -> Dict[str, dict]:
+    """(finding, line_text) pairs → {fingerprint: {meta..., count}}."""
+    out: Dict[str, dict] = {}
+    for f, line_text in findings:
+        fp = f.fingerprint(line_text)
+        if fp in out:
+            out[fp]["count"] += 1
+        else:
+            out[fp] = {"rule": f.rule, "path": f.path,
+                       "message": f.message, "count": 1}
+    return out
+
+
+def load(path: str) -> Dict[str, dict]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path} has version {data.get('version')!r}, "
+            f"expected {BASELINE_VERSION}")
+    return data.get("findings", {})
+
+
+def save(path: str, findings: Iterable[Tuple[Finding, str]]) -> None:
+    payload = {
+        "version": BASELINE_VERSION,
+        "comment": "tpulint grandfathered findings; regenerate with "
+                   "scripts/run_tpulint.py --baseline-update",
+        "findings": dict(sorted(fingerprint_counts(findings).items())),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1, sort_keys=False)
+        f.write("\n")
+
+
+def new_findings(findings: List[Tuple[Finding, str]],
+                 baseline: Dict[str, dict]) -> List[Finding]:
+    """Findings beyond the baselined occurrence counts. Within one
+    fingerprint the *earliest* occurrences are treated as grandfathered
+    and the overflow is reported (deterministic, if arbitrary)."""
+    remaining = {fp: meta.get("count", 1) for fp, meta in baseline.items()}
+    out: List[Finding] = []
+    for f, line_text in findings:
+        fp = f.fingerprint(line_text)
+        if remaining.get(fp, 0) > 0:
+            remaining[fp] -= 1
+        else:
+            out.append(f)
+    return out

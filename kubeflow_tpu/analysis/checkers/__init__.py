@@ -1,0 +1,9 @@
+"""Shipped tpulint checkers — importing this package registers them."""
+
+from kubeflow_tpu.analysis.checkers import (  # noqa: F401
+    host_call_in_jit,
+    raw_clock,
+    tile_legality,
+    unbounded_retry,
+    wiring,
+)

@@ -1,0 +1,128 @@
+"""TPU002 — host calls reachable inside jit/pjit/Pallas bodies.
+
+A traced function runs at *trace* time, once per compilation: a
+``time.time()`` inside it bakes one timestamp into the compiled
+program, ``np.random`` silently produces one constant sample forever,
+``print`` fires during tracing rather than per step, and file I/O
+happens at an unpredictable moment on an unpredictable host. All four
+are bugs that pass a single-run eyeball test and corrupt every run
+after the first.
+
+Jit contexts recognized:
+
+- functions decorated ``@jax.jit`` / ``@jit`` / ``@pjit`` or
+  ``@functools.partial(jax.jit, ...)``;
+- functions passed by name to ``jax.jit(fn, ...)`` / ``pjit(fn)``
+  anywhere in the module;
+- Pallas kernel bodies: the first argument of a ``pl.pallas_call``
+  (optionally wrapped in ``functools.partial``).
+
+Nested defs inside a jit context are traced too and are walked; calls
+under ``jax.debug.*`` / ``pl.debug_print`` / ``io_callback`` /
+``host_callback`` are the sanctioned escape hatches and are ignored.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Set
+
+from kubeflow_tpu.analysis import astutil
+from kubeflow_tpu.analysis.findings import Finding
+from kubeflow_tpu.analysis.registry import Checker, register_checker
+from kubeflow_tpu.analysis.walker import ModuleInfo
+
+JIT_NAMES = {"jit", "jax.jit", "pjit", "jax.experimental.pjit.pjit"}
+PALLAS_CALL_SUFFIX = "pallas_call"
+
+# dotted-name prefixes that mean "the host is doing work at trace time"
+# ("os." covers all of it: filesystem, environ reads, getpid, ...)
+BANNED_PREFIXES = ("time.", "np.random.", "numpy.random.", "random.",
+                   "os.")
+BANNED_EXACT = {"print", "open", "input", "breakpoint"}
+# sanctioned escape hatches — anything routed through these is fine
+ALLOWED_PREFIXES = ("jax.debug.", "pl.debug_", "pltpu.debug_")
+ALLOWED_SUFFIXES = ("io_callback", "host_callback", "debug_print",
+                    "debug_callback", "pure_callback")
+
+
+def _first_arg_fn_name(call: ast.Call) -> str:
+    """Name of the function a jit()/pallas_call() wraps: a bare Name or
+    the first arg of a functools.partial."""
+    if not call.args:
+        return ""
+    arg = call.args[0]
+    if isinstance(arg, ast.Name):
+        return arg.id
+    if isinstance(arg, ast.Call):
+        name = astutil.call_name(arg) or ""
+        if name in ("functools.partial", "partial") and arg.args:
+            inner = arg.args[0]
+            if isinstance(inner, ast.Name):
+                return inner.id
+    return ""
+
+
+def _jit_context_functions(module: ModuleInfo) -> Dict[str, ast.AST]:
+    """qualified-ish name → FunctionDef for every jit/Pallas context."""
+    defs: Dict[str, list] = {}
+    for fn in astutil.functions(module.tree):
+        defs.setdefault(fn.name, []).append(fn)
+
+    contexts: Dict[str, ast.AST] = {}
+    # decorated form
+    for fn in astutil.functions(module.tree):
+        if set(astutil.decorator_names(fn)) & JIT_NAMES:
+            contexts[fn.name] = fn
+    # call form: jax.jit(step) / pl.pallas_call(kernel) /
+    # pl.pallas_call(functools.partial(kernel, ...))
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = astutil.call_name(node) or ""
+        is_jit = name in JIT_NAMES
+        is_pallas = name.split(".")[-1] == PALLAS_CALL_SUFFIX
+        if not (is_jit or is_pallas):
+            continue
+        target = _first_arg_fn_name(node)
+        for fn in defs.get(target, []):
+            contexts[fn.name] = fn
+    return contexts
+
+
+def _is_banned(name: str) -> bool:
+    if name in BANNED_EXACT:
+        return True
+    return any(name.startswith(p) for p in BANNED_PREFIXES)
+
+
+def _is_allowed(name: str) -> bool:
+    if any(name.startswith(p) for p in ALLOWED_PREFIXES):
+        return True
+    return name.split(".")[-1] in ALLOWED_SUFFIXES
+
+
+@register_checker
+class HostCallInJitChecker(Checker):
+    rule = "TPU002"
+    name = "host-call-in-jit"
+    severity = "error"
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        seen: Set[int] = set()
+        for ctx_name, fn in _jit_context_functions(module).items():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call) or id(node) in seen:
+                    continue
+                name = astutil.call_name(node) or ""
+                if not name or _is_allowed(name) or not _is_banned(name):
+                    continue
+                seen.add(id(node))
+                yield self.finding(
+                    module, node,
+                    f"host call {name}() reachable inside jit/Pallas "
+                    f"context {ctx_name!r}; it runs at trace time, not "
+                    "per step",
+                    hint="move the call outside the traced function, pass "
+                         "its result as an argument, or use jax.debug.* / "
+                         "io_callback for intentional host round-trips")

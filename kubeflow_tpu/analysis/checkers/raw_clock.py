@@ -1,0 +1,76 @@
+"""TPU003 — raw wall clock in control-loop code.
+
+Controllers, reconcilers, and pollers that call ``time.time()`` /
+``time.sleep()`` / ``datetime.now()`` directly cannot be tested without
+real elapsed time, and their behavior differs run to run. The platform
+convention (set by :mod:`kubeflow_tpu.autoscale`) is an injectable
+clock: components take ``clock: Clock = None`` and default it to the
+real clock **by reference** (``self.clock = clock or time.monotonic``)
+— references are fine, *calls* are not.
+
+Recognized injectable patterns that are NOT flagged:
+
+- the conditional-default idiom ``now if now is not None else
+  time.time()`` (an explicit ``now=`` parameter IS the injection);
+- bare references (``time.monotonic`` without calling it).
+
+Intentional sleep-forever entrypoints (``while True: time.sleep(3600)``
+serve loops) carry a line pragma; historical debt lives in the
+baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from kubeflow_tpu.analysis import astutil
+from kubeflow_tpu.analysis.findings import Finding
+from kubeflow_tpu.analysis.registry import Checker, register_checker
+from kubeflow_tpu.analysis.walker import ModuleInfo
+
+RAW_CLOCK_CALLS = {
+    "time.time", "time.sleep",
+    "datetime.now", "datetime.utcnow",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.date.today", "date.today",
+}
+
+# workload example scripts log wall timestamps by design; the platform
+# layers are where determinism matters
+SKIP_PREFIXES = ("kubeflow_tpu/examples/",)
+
+
+def _is_injectable_default(module: ModuleInfo, call: ast.Call) -> bool:
+    """True when the call is the fallback arm of the conditional-default
+    idiom: ``<x> if <cond> else time.time()``."""
+    parent = module.parents.get(call)
+    return isinstance(parent, ast.IfExp) and parent.orelse is call
+
+
+@register_checker
+class RawClockChecker(Checker):
+    rule = "TPU003"
+    name = "raw-clock"
+    severity = "error"
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        if module.rel.startswith(SKIP_PREFIXES):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = astutil.call_name(node) or ""
+            if name not in RAW_CLOCK_CALLS:
+                continue
+            if _is_injectable_default(module, node):
+                continue
+            yield self.finding(
+                module, node,
+                f"raw {name}() in platform code; control flow that "
+                "depends on the wall clock is untestable and "
+                "nondeterministic",
+                hint="accept an injectable clock (see "
+                     "kubeflow_tpu.autoscale.policy.Clock) defaulting to "
+                     "the real clock by reference, or pragma an "
+                     "intentional serve-forever loop")

@@ -1,0 +1,143 @@
+"""TPU001 — Mosaic tile legality for Pallas BlockSpec shapes.
+
+The TPU vector layout tiles the last two axes of every kernel block:
+the last (*lane*) axis in units of 128, the second-to-last (*sublane*)
+axis in units of 8 for f32 (16 for bf16, 32 for int8/fp8 — 8 is the
+weakest legal floor, so that is what a static checker can enforce
+without dtype inference). A block whose lane dim is not a multiple of
+128 (and not a full/broadcast dim of size 1) compiles in interpret mode
+— where CPU tests run — and then fails Mosaic lowering on real
+hardware. That is exactly the PR 1 ``ops/bnconv.py`` bug: block sizes
+came from ``_pick_block(dim, want)`` whose default ``floor=8`` happily
+returns lane tiles of 8.
+
+Two detections:
+
+1. a **literal** lane/sublane dim in a ``BlockSpec((...), ...)`` tuple
+   that violates the floor — suppressed when the enclosing function
+   guards untileable shapes with an XLA fallback branch (a call to a
+   ``*tileable*`` predicate), because then the literal is only reached
+   for shapes the guard admitted;
+2. a dim that resolves to a ``_pick_block(..., floor=F)`` helper call
+   with a lane-position ``F < 128`` — flagged even under a fallback
+   guard, because the guard itself is typically computed with the same
+   wrong floor (the PR 1 failure mode: ``_tileable`` said yes, Mosaic
+   said no).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from kubeflow_tpu.analysis import astutil
+from kubeflow_tpu.analysis.findings import Finding
+from kubeflow_tpu.analysis.registry import Checker, register_checker
+from kubeflow_tpu.analysis.walker import ModuleInfo
+
+LANE_MULTIPLE = 128
+SUBLANE_MULTIPLE = 8  # f32 floor; bf16/int8 need 16/32 (see docstring)
+PICK_BLOCK_DEFAULT_FLOOR = 8
+
+
+def _pick_block_floor(scope: ast.AST, node: ast.AST) -> Optional[int]:
+    """If ``node`` is a Name assigned from a ``*_pick_block(...)`` call
+    in ``scope``, return that call's ``floor`` (3rd positional or
+    keyword; helper default 8 when the argument is absent). None = not
+    a pick-block value, OR a floor expression that is not a literal —
+    an unprovable floor stays silent (astutil contract), it does not
+    get assumed to be the default."""
+    if not isinstance(node, ast.Name) or scope is None:
+        return None
+    values = list(astutil.assignments_to(scope, node.id))
+    if len(values) != 1 or not isinstance(values[0], ast.Call):
+        return None
+    call = values[0]
+    name = astutil.call_name(call) or ""
+    if not name.split(".")[-1].endswith("pick_block"):
+        return None
+    if len(call.args) >= 3:
+        return astutil.const_int(call.args[2])
+    for kw in call.keywords:
+        if kw.arg == "floor":
+            return astutil.const_int(kw.value)
+    return PICK_BLOCK_DEFAULT_FLOOR
+
+
+def _has_fallback_guard(fn: Optional[ast.AST]) -> bool:
+    """Heuristic: the function consults a ``*tileable*`` predicate
+    somewhere (the canonical shape-guard spelling in ops/)."""
+    if fn is None:
+        return False
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = astutil.call_name(node) or ""
+            if "tileable" in name.split(".")[-1]:
+                return True
+    return False
+
+
+@register_checker
+class TileLegalityChecker(Checker):
+    rule = "TPU001"
+    name = "tile-legality"
+    severity = "error"
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = astutil.call_name(node) or ""
+            if name.split(".")[-1] != "BlockSpec" or not node.args:
+                continue
+            shape = node.args[0]
+            if not isinstance(shape, (ast.Tuple, ast.List)):
+                continue
+            dims = shape.elts
+            if not dims:
+                continue
+            fn = module.enclosing_function(node)
+            guarded = _has_fallback_guard(fn)
+            yield from self._check_dim(
+                module, node, fn, dims[-1], guarded, lane=True)
+            if len(dims) >= 2:
+                yield from self._check_dim(
+                    module, node, fn, dims[-2], guarded, lane=False)
+
+    def _check_dim(self, module: ModuleInfo, call: ast.Call,
+                   fn: Optional[ast.AST], dim: ast.AST, guarded: bool,
+                   lane: bool) -> Iterable[Finding]:
+        axis = "lane" if lane else "sublane"
+        multiple = LANE_MULTIPLE if lane else SUBLANE_MULTIPLE
+
+        floor = _pick_block_floor(fn, dim)
+        if floor is not None:
+            # detection 2: wrong pick-block floor; fallback guard does
+            # not excuse this (the guard shares the floor)
+            if floor % multiple != 0:
+                src = getattr(dim, "id", "?")
+                yield self.finding(
+                    module, call,
+                    f"{axis} block dim {src!r} comes from a pick-block "
+                    f"helper with floor {floor}; Mosaic requires {axis} "
+                    f"tiles in multiples of {multiple}",
+                    hint=f"pass floor={multiple} (or larger) when picking "
+                         f"a {axis}-axis block size, and use the same "
+                         f"floor in the tileable-shape guard")
+            return
+
+        value = astutil.resolve_int(fn, dim)
+        if value is None or value == 1:
+            # unresolvable (dynamic) or full/broadcast dim — Mosaic
+            # relayouts size-1 trailing dims (see ops/attention.py's
+            # (1, block_q, 1) lse blocks)
+            return
+        if value % multiple != 0 and not guarded:
+            yield self.finding(
+                module, call,
+                f"{axis} block dim {value} is not a multiple of "
+                f"{multiple}; Mosaic rejects this tile in compiled mode "
+                f"(interpret-mode CPU tests will not catch it)",
+                hint=f"use {axis} tiles in multiples of {multiple}, or "
+                     "guard the kernel with an XLA fallback for "
+                     "untileable shapes")

@@ -1,0 +1,92 @@
+"""TPU005 — retry/poll loops with no max-attempts or deadline.
+
+A ``while True:`` loop that sleeps and can never ``break``, ``return``,
+or ``raise`` retries forever: a wedged dependency turns into a silently
+hung controller instead of a failed, restartable one. Bounded shapes —
+``for attempt in range(n)``, ``while clock() - t0 < timeout`` — are the
+platform convention (see ``k8s/apply.py``, ``platform/gcp.py``).
+
+Flagged: a constant-truthy ``while`` whose body contains a sleep-like
+call and no loop exit (``break`` in this loop, or ``return``/``raise``
+anywhere in the body outside nested defs). Intentional serve-forever
+loops (container entrypoints parked on ``time.sleep(3600)``) carry a
+line pragma — the pragma is the documentation that forever is a
+decision, not an accident.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from kubeflow_tpu.analysis import astutil
+from kubeflow_tpu.analysis.findings import Finding
+from kubeflow_tpu.analysis.registry import Checker, register_checker
+from kubeflow_tpu.analysis.walker import ModuleInfo
+
+
+def _body_nodes(loop: ast.While):
+    """Walk the loop body, not descending into nested function defs
+    (their control flow does not exit this loop)."""
+    stack = list(loop.body) + list(loop.orelse)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _breaks_this_loop(nodes) -> bool:
+    # a break only exits THIS loop when not inside a nested loop or def
+    for n in nodes:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.While, ast.For)):
+            continue  # nested defs/loops own their own breaks
+        if isinstance(n, ast.Break):
+            return True
+        if _breaks_this_loop(ast.iter_child_nodes(n)):
+            return True
+    return False
+
+
+def _has_exit(loop: ast.While) -> bool:
+    for node in _body_nodes(loop):
+        if isinstance(node, (ast.Return, ast.Raise)):
+            return True
+    return _breaks_this_loop(loop.body + loop.orelse)
+
+
+def _sleeps(loop: ast.While) -> bool:
+    for node in _body_nodes(loop):
+        if isinstance(node, ast.Call):
+            name = astutil.call_name(node) or ""
+            if name.split(".")[-1] == "sleep":
+                return True
+    return False
+
+
+@register_checker
+class UnboundedRetryChecker(Checker):
+    rule = "TPU005"
+    name = "unbounded-retry"
+    severity = "error"
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.While):
+                continue
+            if not astutil.is_const_true(node.test):
+                continue
+            if not _sleeps(node) or _has_exit(node):
+                continue
+            yield self.finding(
+                module, node,
+                "unbounded retry/poll loop: `while True` sleeps with no "
+                "break/return/raise — a wedged dependency hangs here "
+                "forever instead of failing",
+                hint="bound it with max-attempts or a deadline (see "
+                     "k8s/apply.py backoff), or add "
+                     "`# tpulint: disable=TPU005` if serving forever is "
+                     "the point")

@@ -1,0 +1,232 @@
+"""TPU004 — wiring consistency across manifests, presets, and routes.
+
+PR 1 wired the serving proxy, the autoscaler, and the dashboard to each
+other *by URL string* (``http://serving-autoscaler:8090``), duplicated
+across ``config/presets.py``, component DEFAULTS, and route tables.
+Nothing type-checks a URL: rename the Service or change its port in
+``manifests/components/autoscaler.py`` and every copy elsewhere drifts
+silently until a pod can't reach its peer. Same story for RBAC — a
+ClusterRole without its binding renders fine and fails at runtime.
+
+This is a cross-file checker: :meth:`check` collects facts per module,
+:meth:`finalize` cross-references them.
+
+Sub-rules:
+
+- **url-port**: any ``http(s)://<host>:<port>`` string literal whose
+  host equals a component's Service name (the ``DEFAULTS["name"]`` of a
+  ``manifests/components/*`` module) must use one of that component's
+  declared ports (any int-valued ``*port*`` key in DEFAULTS). Hosts
+  that match no component (127.0.0.1, external DNS) are ignored.
+- **preset-component**: every ``ComponentSpec("x")`` in ``config/``
+  must name a component registered via ``@register("x", ...)``.
+- **rbac-pairing**: a component module that renders ``cluster_role``
+  must also render ``cluster_role_binding`` and ``service_account``
+  (and the namespaced ``role``/``role_binding`` pair likewise).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from kubeflow_tpu.analysis import astutil
+from kubeflow_tpu.analysis.findings import Finding
+from kubeflow_tpu.analysis.registry import Checker, register_checker
+from kubeflow_tpu.analysis.walker import ModuleInfo
+
+COMPONENTS_DIR = "manifests/components/"
+CONFIG_DIR = "config/"
+
+# dotted hosts (IPs, FQDNs) never match a bare Service name, so the
+# hostname charset is deliberately dot-free
+_URL_RE = re.compile(r"https?://([A-Za-z0-9-]+):(\d+)")
+
+
+@dataclasses.dataclass
+class _Component:
+    component_id: str            # @register("id", ...)
+    service_name: str            # DEFAULTS["name"]
+    ports: Set[int]              # int values of *port* DEFAULTS keys
+    rel: str
+    lineno: int
+
+
+@dataclasses.dataclass
+class _UrlRef:
+    host: str
+    port: int
+    rel: str
+    lineno: int
+    span: Tuple[int, int]
+
+
+def _defaults_dict(module: ModuleInfo) -> Optional[ast.Dict]:
+    for node in module.tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "DEFAULTS" \
+                        and isinstance(node.value, ast.Dict):
+                    return node.value
+        elif isinstance(node, ast.AnnAssign):
+            if isinstance(node.target, ast.Name) \
+                    and node.target.id == "DEFAULTS" \
+                    and isinstance(node.value, ast.Dict):
+                return node.value
+    return None
+
+
+def _register_id(module: ModuleInfo) -> Optional[Tuple[str, int]]:
+    for fn in astutil.functions(module.tree):
+        for dec in fn.decorator_list:
+            if isinstance(dec, ast.Call) \
+                    and (astutil.call_name(dec) or "").endswith("register") \
+                    and dec.args:
+                cid = astutil.const_str(dec.args[0])
+                if cid:
+                    return cid, dec.lineno
+    return None
+
+
+def _rendered_rbac_calls(module: ModuleInfo) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call):
+            name = (astutil.call_name(node) or "").split(".")[-1]
+            if name in ("cluster_role", "cluster_role_binding", "role",
+                        "role_binding", "service_account"):
+                out.add(name)
+    return out
+
+
+@register_checker
+class WiringChecker(Checker):
+    rule = "TPU004"
+    name = "wiring-consistency"
+    severity = "error"
+
+    def __init__(self) -> None:
+        self.components: Dict[str, _Component] = {}   # by service name
+        self.component_ids: Set[str] = set()
+        self.urls: List[_UrlRef] = []
+        self.specs: List[Tuple[str, str, int, Tuple[int, int]]] = []
+        self.rbac: List[Tuple[str, int, Set[str]]] = []
+
+    # -- collection --------------------------------------------------------
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        if "analysis/" in module.rel:
+            # don't lint the linter: rule docstrings quote example URLs
+            return ()
+        if COMPONENTS_DIR in module.rel:
+            self._collect_component(module)
+        self._collect_urls(module)
+        if CONFIG_DIR in module.rel or COMPONENTS_DIR in module.rel:
+            self._collect_component_specs(module)
+        return ()
+
+    def _collect_component(self, module: ModuleInfo) -> None:
+        reg = _register_id(module)
+        if reg:
+            self.component_ids.add(reg[0])
+        # RBAC pairing applies to every component module, including the
+        # ones with no DEFAULTS dict (e.g. param-less renderers)
+        rbac = _rendered_rbac_calls(module)
+        if rbac:
+            self.rbac.append((module.rel, 1, rbac))
+        defaults = _defaults_dict(module)
+        if defaults is None:
+            return
+        service_name = ""
+        ports: Set[int] = set()
+        lineno = defaults.lineno
+        for key, value in zip(defaults.keys, defaults.values):
+            k = astutil.const_str(key) if key is not None else None
+            if k is None:
+                continue
+            if k == "name":
+                service_name = astutil.const_str(value) or ""
+            elif "port" in k:
+                v = astutil.const_int(value)
+                if v is not None:
+                    ports.add(v)
+        if service_name:
+            self.components[service_name] = _Component(
+                component_id=reg[0] if reg else "",
+                service_name=service_name, ports=ports,
+                rel=module.rel, lineno=lineno)
+
+    def _collect_urls(self, module: ModuleInfo) -> None:
+        for node in ast.walk(module.tree):
+            s = astutil.const_str(node) if isinstance(node, ast.Constant) \
+                else None
+            if not s or "://" not in s:
+                continue
+            for m in _URL_RE.finditer(s):
+                self.urls.append(_UrlRef(
+                    host=m.group(1), port=int(m.group(2)),
+                    rel=module.rel, lineno=node.lineno,
+                    span=module.node_span(node)))
+
+    def _collect_component_specs(self, module: ModuleInfo) -> None:
+        for node in ast.walk(module.tree):
+            if (isinstance(node, ast.Call) and node.args
+                    and (astutil.call_name(node) or "").split(".")[-1]
+                    == "ComponentSpec"):
+                cid = astutil.const_str(node.args[0])
+                if cid:
+                    self.specs.append((cid, module.rel, node.lineno,
+                                       module.node_span(node)))
+
+    # -- cross-reference ---------------------------------------------------
+
+    def finalize(self) -> Iterable[Finding]:
+        for ref in self.urls:
+            comp = self.components.get(ref.host)
+            if comp is None or not comp.ports:
+                continue
+            if ref.port not in comp.ports:
+                want = ", ".join(str(p) for p in sorted(comp.ports))
+                yield Finding(
+                    rule=self.rule, severity=self.severity, path=ref.rel,
+                    line=ref.lineno, span=ref.span,
+                    message=f"URL http://{ref.host}:{ref.port} does not "
+                            f"match component {comp.service_name!r} "
+                            f"({comp.rel}), which serves on port(s) "
+                            f"{want}",
+                    hint="update the URL or the component DEFAULTS — "
+                         "by-URL wiring drifts silently")
+        if self.component_ids:
+            for cid, rel, lineno, span in self.specs:
+                if cid not in self.component_ids:
+                    known = ", ".join(sorted(self.component_ids))
+                    yield Finding(
+                        rule=self.rule, severity=self.severity, path=rel,
+                        line=lineno, span=span,
+                        message=f"ComponentSpec({cid!r}) names no "
+                                "registered manifest component",
+                        hint=f"known components: {known}")
+        for rel, lineno, calls in self.rbac:
+            for role, binding in (("cluster_role", "cluster_role_binding"),
+                                  ("role", "role_binding")):
+                if role in calls and binding not in calls:
+                    yield Finding(
+                        rule=self.rule, severity=self.severity, path=rel,
+                        line=lineno,
+                        message=f"component renders {role} but no "
+                                f"{binding}; the role grants nothing "
+                                "without its binding",
+                        hint=f"render o.{binding}(...) (and the "
+                             "service_account it binds) next to the role")
+            if ("cluster_role_binding" in calls or "role_binding" in calls) \
+                    and "service_account" not in calls:
+                yield Finding(
+                    rule=self.rule, severity=self.severity, path=rel,
+                    line=lineno,
+                    message="component renders a role binding but no "
+                            "service_account; the binding points at a "
+                            "subject that is never created",
+                    hint="render o.service_account(name, ns) alongside "
+                         "the binding")

@@ -1,0 +1,63 @@
+"""Structured findings: what a checker emits, and how it is fingerprinted.
+
+A finding carries everything the CLI, the baseline, and CI need: rule
+id, severity, location, message, and a fix hint. The fingerprint
+deliberately ignores line *numbers* — it hashes the rule, the file, and
+the normalized source text of the flagged line — so unrelated edits
+above a grandfathered finding do not invalidate the baseline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Optional, Tuple
+
+# severity ladder; "error" findings gate CI, "warning" findings are
+# reported but (by default) still gate — the split exists so a checker
+# can express confidence, not so warnings can be ignored
+SEVERITIES = ("error", "warning")
+
+
+def normalize_line(text: str) -> str:
+    """Source line → fingerprint form: strip indentation, trailing
+    comments (so adding a pragma or annotation next to a line does not
+    change its identity), and whitespace runs."""
+    code = text.split("#", 1)[0]
+    return " ".join(code.split())
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str                 # "TPU001"
+    severity: str             # one of SEVERITIES
+    path: str                 # repo-relative posix path
+    line: int                 # 1-based line of the offending node
+    message: str              # what is wrong
+    hint: str = ""            # how to fix it
+    # statement span (start, end) — pragma suppression accepts a pragma
+    # on any line of the span, so a multi-line construct (a while loop,
+    # a BlockSpec call) can carry its pragma where it reads best
+    span: Optional[Tuple[int, int]] = None
+
+    def __post_init__(self) -> None:
+        if self.severity not in SEVERITIES:
+            raise ValueError(
+                f"severity {self.severity!r} not in {SEVERITIES}")
+
+    @property
+    def span_lines(self) -> Tuple[int, int]:
+        return self.span if self.span is not None else (self.line, self.line)
+
+    def fingerprint(self, line_text: str) -> str:
+        """Stable identity for baselining; ``line_text`` is the source
+        of ``self.line`` (the caller owns file access)."""
+        key = f"{self.rule}|{self.path}|{normalize_line(line_text)}"
+        return hashlib.sha1(key.encode()).hexdigest()[:16]
+
+    def format(self) -> str:
+        loc = f"{self.path}:{self.line}"
+        out = f"{loc}: {self.rule} [{self.severity}] {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out

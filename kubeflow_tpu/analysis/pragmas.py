@@ -1,0 +1,64 @@
+"""Inline suppression: ``# tpulint: disable=TPU001[,TPU002]`` pragmas.
+
+Two scopes:
+
+- **line**: a pragma suppresses findings of the named rules whose
+  statement *span* covers the pragma's line — so a pragma inside a
+  flagged ``while`` loop or on the closing paren of a multi-line call
+  still applies to the finding anchored at the construct's first line;
+- **file**: ``# tpulint: disable-file=TPU003`` anywhere in the file
+  suppresses the named rules for the whole file (conventionally placed
+  in the module docstring area).
+
+``disable=all`` / ``disable-file=all`` suppress every rule. Pragmas are
+matched by regex over raw source lines (not the token stream), so a
+pragma-shaped string literal would also suppress — acceptable for a
+lint tool, and it keeps the scanner immune to tokenize errors.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, Set
+
+from kubeflow_tpu.analysis.findings import Finding
+
+# the rules group is comma-separated bare tokens; trailing prose after
+# the list ("# tpulint: disable=TPU005 serving forever is the point")
+# must NOT be absorbed into a rule token and silently void the pragma
+_PRAGMA_RE = re.compile(
+    r"#\s*tpulint:\s*(?P<scope>disable|disable-file)\s*=\s*"
+    r"(?P<rules>[A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+
+
+class PragmaIndex:
+    """Parsed pragmas for one file: line → rules, plus file-wide rules."""
+
+    def __init__(self, source: str) -> None:
+        self.line_rules: Dict[int, Set[str]] = {}
+        self.file_rules: Set[str] = set()
+        for lineno, text in enumerate(source.splitlines(), start=1):
+            m = _PRAGMA_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip().upper() for r in m.group("rules").split(",")
+                     if r.strip()}
+            if m.group("scope") == "disable-file":
+                self.file_rules |= rules
+            else:
+                self.line_rules.setdefault(lineno, set()).update(rules)
+
+    def _matches(self, rules: Set[str], rule: str) -> bool:
+        return "ALL" in rules or rule.upper() in rules
+
+    def suppresses(self, finding: Finding) -> bool:
+        if self._matches(self.file_rules, finding.rule):
+            return True
+        lo, hi = finding.span_lines
+        return any(
+            self._matches(rules, finding.rule)
+            for lineno, rules in self.line_rules.items()
+            if lo <= lineno <= hi)
+
+    def filter(self, findings: Iterable[Finding]) -> list[Finding]:
+        return [f for f in findings if not self.suppresses(f)]

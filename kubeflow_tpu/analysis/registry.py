@@ -1,0 +1,65 @@
+"""Checker registry — the pluggable part of tpulint.
+
+A checker is a class with a ``rule`` id, a ``severity``, and a
+``check(module)`` generator; registering it is one decorator. Cross-file
+rules (TPU004) additionally implement ``finalize()``, called once after
+every module has been seen, so they can collect facts per file and
+cross-reference at the end.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Type
+
+from kubeflow_tpu.analysis.findings import Finding
+from kubeflow_tpu.analysis.walker import ModuleInfo
+
+
+class Checker:
+    """Base class: subclass, set ``rule``/``name``/``severity``,
+    implement :meth:`check`. One instance lives for one lint run, so
+    per-run state (for :meth:`finalize`) goes on ``self``."""
+
+    rule: str = "TPU000"
+    name: str = "base"
+    severity: str = "error"
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finalize(self) -> Iterable[Finding]:
+        """Cross-file findings, after all modules were checked."""
+        return ()
+
+    def finding(self, module: ModuleInfo, node, message: str,
+                hint: str = "", severity: Optional[str] = None) -> Finding:
+        return Finding(
+            rule=self.rule, severity=severity or self.severity,
+            path=module.rel, line=node.lineno, message=message, hint=hint,
+            span=module.node_span(node))
+
+
+_REGISTRY: Dict[str, Type[Checker]] = {}
+
+
+def register_checker(cls: Type[Checker]) -> Type[Checker]:
+    if cls.rule in _REGISTRY:
+        raise ValueError(f"duplicate checker rule {cls.rule}")
+    _REGISTRY[cls.rule] = cls
+    return cls
+
+
+def all_checkers() -> Dict[str, Type[Checker]]:
+    # import for side effect: shipped checkers self-register on import
+    import kubeflow_tpu.analysis.checkers  # noqa: F401
+    return dict(_REGISTRY)
+
+
+def create_checkers(rules: Optional[Sequence[str]] = None) -> List[Checker]:
+    known = all_checkers()
+    if rules is None:
+        return [cls() for _, cls in sorted(known.items())]
+    bad = [r for r in rules if r not in known]
+    if bad:
+        raise KeyError(f"unknown rules {bad}; known: {sorted(known)}")
+    return [known[r]() for r in sorted(rules)]

@@ -1,0 +1,108 @@
+"""Lint orchestration: walk files, run checkers, suppress, diff baseline.
+
+The pipeline per run:
+
+1. :func:`walk_paths` parses every target file into a ModuleInfo;
+2. every registered checker sees every module (then ``finalize()``);
+3. pragma suppression drops findings the code explicitly allowlists;
+4. the committed baseline splits the rest into grandfathered vs *new* —
+   only new findings gate (exit nonzero in the CLI, assert in tier-1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from kubeflow_tpu.analysis import baseline as baseline_mod
+from kubeflow_tpu.analysis.findings import Finding
+from kubeflow_tpu.analysis.registry import create_checkers
+from kubeflow_tpu.analysis.walker import ModuleInfo, walk_paths
+
+DEFAULT_PATHS = ("kubeflow_tpu",)
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+@dataclasses.dataclass
+class LintReport:
+    # (finding, source line text) for everything checkers emitted and
+    # pragmas did not suppress; line text rides along for fingerprints
+    findings: List[Tuple[Finding, str]]
+    new: List[Finding]            # findings not covered by the baseline
+    suppressed: int               # pragma-suppressed count
+    files: int                    # modules scanned
+
+    @property
+    def baselined(self) -> int:
+        return len(self.findings) - len(self.new)
+
+    def format(self, show_baselined: bool = False) -> str:
+        lines: List[str] = []
+        if show_baselined:
+            lines += [f.format() for f, _ in self.findings]
+        else:
+            lines += [f.format() for f in self.new]
+        lines.append(
+            f"tpulint: {self.files} files, {len(self.new)} new finding(s), "
+            f"{self.baselined} baselined, {self.suppressed} suppressed")
+        return "\n".join(lines)
+
+
+def lint_modules(modules: Sequence[ModuleInfo],
+                 rules: Optional[Sequence[str]] = None,
+                 ) -> Tuple[List[Tuple[Finding, str]], int]:
+    """Run checkers over already-parsed modules; returns the surviving
+    (finding, line_text) pairs and the pragma-suppressed count."""
+    checkers = create_checkers(rules)
+    by_rel: Dict[str, ModuleInfo] = {m.rel: m for m in modules}
+    raw: List[Finding] = []
+    for module in modules:
+        for checker in checkers:
+            raw.extend(checker.check(module))
+    for checker in checkers:
+        raw.extend(checker.finalize())
+
+    kept: List[Tuple[Finding, str]] = []
+    suppressed = 0
+    for f in raw:
+        module = by_rel.get(f.path)
+        if module is not None and module.pragmas.suppresses(f):
+            suppressed += 1
+            continue
+        line_text = module.line_text(f.line) if module is not None else ""
+        kept.append((f, line_text))
+    # stable order: path, line, rule — checker iteration order must not
+    # leak into baselines or CI output
+    kept.sort(key=lambda p: (p[0].path, p[0].line, p[0].rule))
+    return kept, suppressed
+
+
+def run_lint(paths: Optional[Sequence[str]] = None,
+             root: Optional[str] = None,
+             rules: Optional[Sequence[str]] = None,
+             baseline_path: Optional[str] = None) -> LintReport:
+    """Lint ``paths`` (default: the kubeflow_tpu package) against the
+    committed baseline. ``baseline_path=''`` disables baselining."""
+    root = root or repo_root()
+    modules = list(walk_paths(paths or DEFAULT_PATHS, root))
+    kept, suppressed = lint_modules(modules, rules)
+
+    if baseline_path is None:
+        baseline_path = os.path.join(root, baseline_mod.DEFAULT_BASELINE)
+    base = baseline_mod.load(baseline_path) if baseline_path else {}
+    new = baseline_mod.new_findings(kept, base)
+    return LintReport(findings=kept, new=new, suppressed=suppressed,
+                      files=len(modules))
+
+
+def update_baseline(report: LintReport, root: Optional[str] = None,
+                    baseline_path: Optional[str] = None) -> str:
+    root = root or repo_root()
+    path = baseline_path or os.path.join(root, baseline_mod.DEFAULT_BASELINE)
+    baseline_mod.save(path, report.findings)
+    return path

@@ -1,0 +1,104 @@
+"""File walker and per-module parse state.
+
+:class:`ModuleInfo` is the unit every checker sees: path, source,
+parsed AST, and lazily-built indices (parent links, pragma index).
+Checkers never open files themselves — tests feed fixture snippets
+through :meth:`ModuleInfo.from_source` with a fake repo-relative path,
+so rule scoping by path works identically for fixtures and real files.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterator, List, Optional, Sequence
+
+from kubeflow_tpu.analysis.pragmas import PragmaIndex
+
+# directories never worth linting (generated, vendored, caches)
+EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules", "static"}
+
+
+class ModuleInfo:
+    """One parsed source file plus the indices checkers share."""
+
+    def __init__(self, rel: str, source: str, tree: ast.Module) -> None:
+        self.rel = rel.replace(os.sep, "/")
+        self.source = source
+        self.tree = tree
+        self.lines: List[str] = source.splitlines()
+        self._parents: Optional[Dict[ast.AST, ast.AST]] = None
+        self._pragmas: Optional[PragmaIndex] = None
+
+    @classmethod
+    def from_source(cls, rel: str, source: str) -> "ModuleInfo":
+        return cls(rel, source, ast.parse(source))
+
+    @classmethod
+    def from_file(cls, path: str, root: str) -> Optional["ModuleInfo"]:
+        """Parse ``path``; returns None on syntax errors (a broken file
+        is a CI failure in its own right, not a lint crash)."""
+        with open(path, encoding="utf-8", errors="replace") as f:
+            source = f.read()
+        rel = os.path.relpath(path, root)
+        try:
+            return cls(rel, source, ast.parse(source))
+        except SyntaxError:
+            return None
+
+    # -- indices -----------------------------------------------------------
+
+    @property
+    def parents(self) -> Dict[ast.AST, ast.AST]:
+        """child node → parent node, for scope walks."""
+        if self._parents is None:
+            self._parents = {}
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    self._parents[child] = node
+        return self._parents
+
+    @property
+    def pragmas(self) -> PragmaIndex:
+        if self._pragmas is None:
+            self._pragmas = PragmaIndex(self.source)
+        return self._pragmas
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def node_span(self, node: ast.AST) -> tuple:
+        end = getattr(node, "end_lineno", None) or node.lineno
+        return (node.lineno, end)
+
+
+def walk_paths(paths: Sequence[str], root: str) -> Iterator[ModuleInfo]:
+    """Yield :class:`ModuleInfo` for every parseable ``.py`` under
+    ``paths`` (files or directories), relative to ``root``, sorted so
+    runs are deterministic."""
+    files: List[str] = []
+    for p in paths:
+        p = os.path.join(root, p) if not os.path.isabs(p) else p
+        if os.path.isfile(p):
+            files.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in EXCLUDE_DIRS
+                                 and not d.startswith("."))
+            files.extend(os.path.join(dirpath, f)
+                         for f in sorted(filenames) if f.endswith(".py"))
+    for path in sorted(set(files)):
+        mi = ModuleInfo.from_file(path, root)
+        if mi is not None:
+            yield mi

@@ -21,6 +21,8 @@ from kubeflow_tpu.autoscale.planner import (  # noqa: F401
 from kubeflow_tpu.autoscale.policy import (  # noqa: F401
     POLICY_PRESETS,
     AutoscalePolicy,
+    Clock,
+    Sleep,
     policy_preset,
 )
 from kubeflow_tpu.autoscale.recommender import (  # noqa: F401

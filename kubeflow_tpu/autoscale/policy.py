@@ -15,6 +15,11 @@ import dataclasses
 import os
 from typing import Dict, Mapping, Optional
 
+# the injectable-clock contract (re-exported from its neutral home so
+# autoscale callers keep importing it from the subsystem that set the
+# convention; tpulint TPU003 enforces it repo-wide)
+from kubeflow_tpu.utils.clock import Clock, Sleep  # noqa: F401
+
 
 @dataclasses.dataclass(frozen=True)
 class AutoscalePolicy:

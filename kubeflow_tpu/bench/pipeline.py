@@ -27,6 +27,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from kubeflow_tpu.k8s.client import KubeClient
+from kubeflow_tpu.utils.clock import Clock, Sleep
 from kubeflow_tpu.manifests.components.tpujob_operator import (
     API_VERSION,
     TPUJOB_KIND,
@@ -115,10 +116,16 @@ class ClusterRunner:
 
     def __init__(self, client: KubeClient, *,
                  results_dir: Optional[str] = None,
-                 poll_interval_s: float = 5.0) -> None:
+                 poll_interval_s: float = 5.0,
+                 clock: Optional[Clock] = None,
+                 sleep: Optional[Sleep] = None) -> None:
         self.client = client
         self.results_dir = results_dir
         self.poll_interval_s = poll_interval_s
+        # injectable monitor timing (autoscale.policy.Clock contract):
+        # tests drive the poll loop without real elapsed time
+        self.clock: Clock = clock if clock is not None else time.perf_counter
+        self.sleep: Sleep = sleep if sleep is not None else time.sleep
 
     def run(self, spec: BenchmarkSpec) -> BenchmarkResult:
         job = tpujob(spec.name, spec.namespace, {
@@ -130,17 +137,17 @@ class ClusterRunner:
             "env": {"KFTPU_RESULTS_DIR": self.results_dir or ""},
         })
         self.client.apply(job)
-        t0 = time.perf_counter()
+        t0 = self.clock()
         status = "Timeout"
-        while time.perf_counter() - t0 < spec.timeout_s:
+        while self.clock() - t0 < spec.timeout_s:
             cur = self.client.get_or_none(API_VERSION, TPUJOB_KIND,
                                           spec.namespace, spec.name)
             phase = (cur or {}).get("status", {}).get("phase", "")
             if phase in ("Succeeded", "Failed"):
                 status = phase
                 break
-            time.sleep(self.poll_interval_s)
-        wall = time.perf_counter() - t0
+            self.sleep(self.poll_interval_s)
+        wall = self.clock() - t0
         metrics = self._collect_metrics(spec)
         return BenchmarkResult(spec.name, status, wall, metrics)
 

@@ -795,8 +795,10 @@ def bench_serving(requests: int = 200, batch: int = 8,
             grpc_server, grpc_port = serve_grpc(server.repo, port=0,
                                                 max_batch_size=batch)
             client = PredictClient(f"127.0.0.1:{grpc_port}")
-            images = np.random.rand(
-                batch, image_size, image_size, 3).astype(np.float32)
+            # seeded: bench inputs must be identical run to run, or
+            # latency deltas between rounds also carry a data delta
+            images = np.random.default_rng(0).random(
+                (batch, image_size, image_size, 3), dtype=np.float32)
 
             client.predict("resnet", images)  # compile
             grpc_p50, grpc_p99, grpc_wall = timed(

@@ -35,7 +35,9 @@ def _standard(name: str) -> DeploymentConfig:
             # serving autoscaler (Knative-KPA parity): proxy telemetry →
             # slice-aware replica control. The proxy sidecar + its
             # autoscale_url ARE the telemetry source — an autoscaler
-            # without them would idle with cluster RBAC for nothing
+            # without them would idle with cluster RBAC for nothing.
+            # (by-URL wiring: tpulint TPU004 cross-checks host:port
+            # against the autoscaler component's DEFAULTS)
             ComponentSpec("serving", params={
                 "proxy": True,
                 "autoscale_url": "http://serving-autoscaler:8090"}),

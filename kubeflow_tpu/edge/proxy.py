@@ -433,8 +433,8 @@ def main() -> None:
                                       "http://gatekeeper:8085/verify")
             or None)
     proxy.start(int(os.environ.get("KFTPU_EDGE_PORT", "8080")))
-    while True:
-        time.sleep(3600)
+    while True:  # serve forever; the pod's lifecycle ends the process
+        time.sleep(3600)  # tpulint: disable=TPU003,TPU005
 
 
 if __name__ == "__main__":

@@ -395,7 +395,9 @@ class HttpKubeClient(KubeClient):
                 except Exception:  # noqa: BLE001 — reconnect forever
                     import time
 
-                    time.sleep(2)
+                    # a watch must outlive API-server outages: reconnect
+                    # forever (daemon thread; dies with the process)
+                    time.sleep(2)  # tpulint: disable=TPU003,TPU005
 
         threading.Thread(target=pump, daemon=True).start()
         return q

@@ -25,6 +25,8 @@ DEFAULTS: Dict[str, Any] = {
     "name": "serving-autoscaler",
     # same image as the serving tier — the autoscaler is framework code
     "image": "kubeflow-tpu/serving:v1alpha1",
+    # every http://serving-autoscaler:<port> literal elsewhere (presets,
+    # proxy/dashboard wiring) must match — enforced by tpulint TPU004
     "port": 8090,
     # policy preset (kubeflow_tpu/autoscale/policy.py POLICY_PRESETS)
     # plus the per-field overrides most deployments touch

@@ -257,7 +257,7 @@ class Controller:
     def run_forever(self) -> None:
         self.start()
         try:
-            while True:
-                time.sleep(3600)
+            while True:  # park the main thread; workers do the work
+                time.sleep(3600)  # tpulint: disable=TPU003,TPU005
         except KeyboardInterrupt:
             self.stop()

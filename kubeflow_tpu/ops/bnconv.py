@@ -42,6 +42,10 @@ def _pick_block(dim: int, want: int, floor: int = 8) -> int:
     them. K and N are lane axes here (x/a/b and w/o blocks), so their
     floor is 128; M only ever appears as a sublane axis (floor 8).
     Shapes with no legal block fall back to the XLA composition.
+
+    tpulint rule TPU001 (docs/ANALYSIS.md) enforces the lane floor
+    statically: dropping a ``floor=128`` from a lane-axis pick is a
+    lint error, not a latent Mosaic crash.
     """
     b = want
     while b >= floor:

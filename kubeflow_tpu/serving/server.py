@@ -1058,8 +1058,8 @@ def main() -> None:
             log.warning("gRPC disabled (grpc not importable: %s); "
                         "serving REST only", e)
     try:
-        while True:
-            time.sleep(3600)
+        while True:  # serve forever; Ctrl-C / SIGTERM end the pod
+            time.sleep(3600)  # tpulint: disable=TPU003,TPU005
     except KeyboardInterrupt:
         server.stop()
         if grpc_server is not None:

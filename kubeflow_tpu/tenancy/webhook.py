@@ -224,8 +224,8 @@ def main() -> None:
     cert_pem, key_pem = bootstrap_certs(client, ns)
     WebhookServer(client, cert_pem=cert_pem, key_pem=key_pem).start(
         int(os.environ.get("KFTPU_WEBHOOK_PORT", str(WEBHOOK_PORT))))
-    while True:
-        time.sleep(3600)
+    while True:  # serve forever; the pod's lifecycle ends the process
+        time.sleep(3600)  # tpulint: disable=TPU003,TPU005
 
 
 if __name__ == "__main__":

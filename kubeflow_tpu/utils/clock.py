@@ -1,0 +1,20 @@
+"""The platform-wide injectable-clock contract.
+
+Every control loop takes ``clock: Optional[Clock] = None`` and defaults
+it to the real clock BY REFERENCE (``self.clock = clock if clock is not
+None else time.monotonic``) — never call time.time()/time.sleep()
+inline. The convention was set by :mod:`kubeflow_tpu.autoscale` and is
+enforced repo-wide by tpulint rule TPU003 (docs/ANALYSIS.md).
+
+Lives in utils so bench/workflows/operators can type against it without
+importing the autoscale subsystem; :mod:`kubeflow_tpu.autoscale.policy`
+re-exports both names.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+Clock = Callable[[], float]
+# its companion for poll loops: an injectable sleep(seconds)
+Sleep = Callable[[float], None]

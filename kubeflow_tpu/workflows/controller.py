@@ -23,6 +23,7 @@ from kubeflow_tpu.k8s.helpers import (
 )
 from kubeflow_tpu.operators.controller import Controller
 from kubeflow_tpu.utils import DEFAULT_REGISTRY
+from kubeflow_tpu.utils.clock import Clock
 from kubeflow_tpu.workflows.workflow import (
     NODE_FAILED,
     NODE_PENDING,
@@ -51,23 +52,33 @@ _steps_run = DEFAULT_REGISTRY.counter(
     "kftpu_workflow_steps_total", "workflow steps launched")
 
 
-def _now() -> str:
-    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-
-
 class WorkflowController:
     """Reconciles Workflow CRs on any :class:`KubeClient`.
 
     ``archive`` (a :class:`kubeflow_tpu.workflows.archive.RunArchive`)
     persists every status transition, so run history survives controller
-    restarts and CR deletion — the KFP persistence-agent role."""
+    restarts and CR deletion — the KFP persistence-agent role.
+
+    ``clock`` is the injectable epoch-seconds source used for resource
+    step timeouts (wall clock, not monotonic: deadlines are compared
+    against ``startedAt`` timestamps persisted in CR status, which must
+    survive controller restarts); tests drive a fake clock."""
 
     def __init__(self, client: KubeClient,
                  namespace: Optional[str] = None,
-                 archive=None) -> None:
+                 archive=None,
+                 clock: Optional[Clock] = None) -> None:
         self.client = client
         self.namespace = namespace
         self.archive = archive
+        self.clock: Clock = clock if clock is not None else time.time
+
+    def _now(self) -> str:
+        """Status timestamps (startedAt/finishedAt) derive from the SAME
+        injected clock the deadline check reads — a half-threaded clock
+        would make timeouts compare fake time against real timestamps
+        and never (or always) fire."""
+        return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(self.clock()))
 
     # -- reconcile ---------------------------------------------------------
 
@@ -127,14 +138,14 @@ class WorkflowController:
         status: Dict[str, Any] = {"nodes": nodes, "phase": PHASE_RUNNING}
         if all(p == NODE_SUCCEEDED for p in phases.values()):
             status["phase"] = PHASE_SUCCEEDED
-            status["finishedAt"] = _now()
+            status["finishedAt"] = self._now()
         elif (any(p in (NODE_FAILED, NODE_SKIPPED) for p in phases.values())
               and not any(p in (NODE_PENDING, NODE_RUNNING)
                           for p in phases.values())):
             status["phase"] = PHASE_FAILED
-            status["finishedAt"] = _now()
+            status["finishedAt"] = self._now()
         if "startedAt" not in wf.get("status", {}):
-            status["startedAt"] = _now()
+            status["startedAt"] = self._now()
         else:
             status["startedAt"] = wf["status"]["startedAt"]
         self._set_status(wf, status)
@@ -151,7 +162,7 @@ class WorkflowController:
                 node: Dict[str, Any]) -> None:
         _steps_run.inc()
         wf_name = wf["metadata"]["name"]
-        node["startedAt"] = _now()
+        node["startedAt"] = self._now()
         if step["type"] == STEP_CONTAINER:
             attempt = int(node.get("attempt", 0))
             env = dict(step.get("env") or {})
@@ -192,7 +203,7 @@ class WorkflowController:
                                       manifest["kind"],
                                       md.get("namespace", ns), md["name"])
                 node["phase"] = NODE_SUCCEEDED
-                node["finishedAt"] = _now()
+                node["finishedAt"] = self._now()
                 return
             manifest = dict(manifest)
             manifest.setdefault("metadata", {}).setdefault("namespace", ns)
@@ -201,7 +212,7 @@ class WorkflowController:
             if not step.get("successCondition"):
                 # fire-and-forget create
                 node["phase"] = NODE_SUCCEEDED
-                node["finishedAt"] = _now()
+                node["finishedAt"] = self._now()
 
     def _advance(self, ns: str, wf_name: str, step: Dict[str, Any],
                  node: Dict[str, Any]) -> None:
@@ -211,7 +222,7 @@ class WorkflowController:
             phase = (pod or {}).get("status", {}).get("phase")
             if phase == "Succeeded":
                 node["phase"] = NODE_SUCCEEDED
-                node["finishedAt"] = _now()
+                node["finishedAt"] = self._now()
             elif phase == "Failed" or pod is None:
                 attempt = int(node.get("attempt", 0))
                 if attempt < int(step.get("retries", 0)):
@@ -220,7 +231,7 @@ class WorkflowController:
                     node["message"] = f"retry {attempt + 1}"
                 else:
                     node["phase"] = NODE_FAILED
-                    node["finishedAt"] = _now()
+                    node["finishedAt"] = self._now()
                     node["message"] = "pod failed"
             return
         # resource step: poll conditions against the live object
@@ -231,21 +242,21 @@ class WorkflowController:
             md.get("namespace", ns), md["name"])
         if eval_condition(target, step.get("failureCondition", "")):
             node["phase"] = NODE_FAILED
-            node["finishedAt"] = _now()
+            node["finishedAt"] = self._now()
             node["message"] = f"failureCondition {step['failureCondition']!r}"
         elif eval_condition(target, step.get("successCondition", "")):
             node["phase"] = NODE_SUCCEEDED
-            node["finishedAt"] = _now()
+            node["finishedAt"] = self._now()
         else:
             import calendar
 
             # startedAt was written with gmtime; compare in the same frame
             started = calendar.timegm(time.strptime(
-                node.get("startedAt", _now()), "%Y-%m-%dT%H:%M:%SZ"))
-            if time.time() - started > float(
+                node.get("startedAt", self._now()), "%Y-%m-%dT%H:%M:%SZ"))
+            if self.clock() - started > float(
                     step.get("timeoutSeconds", 3600.0)):
                 node["phase"] = NODE_FAILED
-                node["finishedAt"] = _now()
+                node["finishedAt"] = self._now()
                 node["message"] = "timeout"
 
     # -- helpers -----------------------------------------------------------

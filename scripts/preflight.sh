@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Preflight gate: every check a PR must pass before review, one command.
+#
+#   scripts/preflight.sh            # tpulint + staged-blob check
+#   scripts/preflight.sh --ref HEAD~1   # blob check over a commit range
+#
+# Checks:
+#   1. tpulint (scripts/run_tpulint.py): AST rules TPU001-TPU005 over
+#      kubeflow_tpu/, gated on tpulint_baseline.json (docs/ANALYSIS.md)
+#   2. binary-blob guard (scripts/check_binary_blobs.py): no large
+#      binaries staged for commit (PERF.md trace-artifact policy)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+rc=0
+
+echo "== preflight: tpulint =="
+python scripts/run_tpulint.py || rc=1
+
+echo "== preflight: binary blobs =="
+python scripts/check_binary_blobs.py "$@" || rc=1
+
+if [ "$rc" -ne 0 ]; then
+    echo "preflight: FAILED" >&2
+else
+    echo "preflight: ok"
+fi
+exit "$rc"

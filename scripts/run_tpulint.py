@@ -1,0 +1,86 @@
+#!/usr/bin/env python3
+"""Run tpulint over the repo; exit nonzero on NEW findings.
+
+Usage:
+
+    python scripts/run_tpulint.py                       # lint kubeflow_tpu/
+    python scripts/run_tpulint.py kubeflow_tpu/ops      # lint a subtree
+    python scripts/run_tpulint.py --rules TPU001,TPU003
+    python scripts/run_tpulint.py --baseline-update     # re-grandfather
+    python scripts/run_tpulint.py --show-baselined      # full debt view
+    python scripts/run_tpulint.py --format json         # machine output
+
+Pre-existing findings live in ``tpulint_baseline.json`` (committed);
+only findings beyond the baseline fail the run. After fixing debt, run
+``--baseline-update`` so the baseline shrinks with the fix. The rule
+catalog and pragma syntax are documented in ``docs/ANALYSIS.md``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from kubeflow_tpu.analysis import runner  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: kubeflow_tpu)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids (default: all)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline path ('' disables; default: "
+                         "tpulint_baseline.json at the repo root)")
+    ap.add_argument("--baseline-update", action="store_true",
+                    help="rewrite the baseline from current findings "
+                         "and exit 0")
+    ap.add_argument("--show-baselined", action="store_true",
+                    help="print grandfathered findings too")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    args = ap.parse_args(argv)
+
+    rules = ([r.strip().upper() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+    if args.baseline_update and (args.paths or rules):
+        # a scoped run sees only a subset of findings; rewriting the
+        # baseline from it would silently drop every grandfathered
+        # entry outside the scope and break the next full run
+        print("error: --baseline-update requires a full, unfiltered run "
+              "(no paths, no --rules)", file=sys.stderr)
+        return 2
+    report = runner.run_lint(paths=args.paths or None, rules=rules,
+                             baseline_path=args.baseline)
+
+    if args.baseline_update:
+        path = runner.update_baseline(report, baseline_path=args.baseline
+                                      or None)
+        print(f"tpulint: baseline updated with "
+              f"{len(report.findings)} finding(s) → {path}")
+        return 0
+
+    if args.format == "json":
+        print(json.dumps({
+            "files": report.files,
+            "suppressed": report.suppressed,
+            "baselined": report.baselined,
+            "new": [
+                {"rule": f.rule, "severity": f.severity, "path": f.path,
+                 "line": f.line, "message": f.message, "hint": f.hint}
+                for f in report.new],
+        }, indent=1))
+    else:
+        print(report.format(show_baselined=args.show_baselined))
+    return 1 if report.new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,501 @@
+"""tpulint unit tests: per-checker fixtures (positive / negative /
+pragma / baseline) plus the whole-repo gate that makes the analyzers a
+tier-1 CI check."""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from kubeflow_tpu.analysis import baseline as baseline_mod
+from kubeflow_tpu.analysis import runner
+from kubeflow_tpu.analysis.checkers.host_call_in_jit import (
+    HostCallInJitChecker,
+)
+from kubeflow_tpu.analysis.checkers.raw_clock import RawClockChecker
+from kubeflow_tpu.analysis.checkers.tile_legality import TileLegalityChecker
+from kubeflow_tpu.analysis.checkers.unbounded_retry import (
+    UnboundedRetryChecker,
+)
+from kubeflow_tpu.analysis.checkers.wiring import WiringChecker
+from kubeflow_tpu.analysis.registry import all_checkers, create_checkers
+from kubeflow_tpu.analysis.runner import lint_modules, run_lint
+from kubeflow_tpu.analysis.walker import ModuleInfo
+
+REPO = runner.repo_root()
+
+
+def mod(src, rel="kubeflow_tpu/fixture.py"):
+    return ModuleInfo.from_source(rel, textwrap.dedent(src))
+
+
+def check(checker, *modules):
+    out = []
+    for m in modules:
+        out.extend(checker.check(m))
+    out.extend(checker.finalize())
+    return out
+
+
+# -- registry / framework ---------------------------------------------------
+
+def test_registry_has_all_five_rules():
+    assert set(all_checkers()) == {
+        "TPU001", "TPU002", "TPU003", "TPU004", "TPU005"}
+
+
+def test_create_checkers_rejects_unknown_rule():
+    with pytest.raises(KeyError):
+        create_checkers(["TPU999"])
+
+
+# -- TPU001 tile legality ---------------------------------------------------
+
+def test_tpu001_literal_lane_violation():
+    m = mod("""
+        import jax.experimental.pallas as pl
+        def f():
+            return pl.pallas_call(
+                k, in_specs=[pl.BlockSpec((256, 64), lambda i: (i, 0))])
+    """)
+    f = check(TileLegalityChecker(), m)
+    assert len(f) == 1 and f[0].rule == "TPU001"
+    assert "lane block dim 64" in f[0].message
+
+
+def test_tpu001_literal_ok_and_broadcast_dim():
+    m = mod("""
+        import jax.experimental.pallas as pl
+        def f():
+            specs = [pl.BlockSpec((8, 128), lambda i: (i, 0)),
+                     pl.BlockSpec((1, 256), lambda i: (0, i)),
+                     pl.BlockSpec((1, 512, 1), lambda i: (0, i, 0))]
+    """)
+    assert check(TileLegalityChecker(), m) == []
+
+
+def test_tpu001_sublane_violation():
+    m = mod("""
+        import jax.experimental.pallas as pl
+        def f():
+            s = pl.BlockSpec((4, 128), lambda i: (i, 0))
+    """)
+    f = check(TileLegalityChecker(), m)
+    assert len(f) == 1 and "sublane block dim 4" in f[0].message
+
+
+def test_tpu001_fallback_guard_suppresses_literals():
+    m = mod("""
+        import jax.experimental.pallas as pl
+        def f(x):
+            if not _tileable(x.shape):
+                return reference(x)
+            return pl.pallas_call(
+                k, in_specs=[pl.BlockSpec((256, 64), lambda i: (i, 0))])
+    """)
+    assert check(TileLegalityChecker(), m) == []
+
+
+def test_tpu001_pick_block_bad_floor_even_with_guard():
+    # the PR 1 failure mode: guard + picker share the wrong floor, so
+    # the fallback guard must NOT excuse a pick-block lane floor < 128
+    m = mod("""
+        import jax.experimental.pallas as pl
+        def f(x, K):
+            if not _tileable(x.shape):
+                return reference(x)
+            bk = _pick_block(K, 256)
+            return pl.pallas_call(
+                k, in_specs=[pl.BlockSpec((8, bk), lambda i: (i, 0))])
+    """)
+    f = check(TileLegalityChecker(), m)
+    assert len(f) == 1 and "floor 8" in f[0].message
+
+
+def test_tpu001_nonconstant_floor_stays_silent():
+    # an unprovable floor must not be assumed to be the bad default —
+    # `floor=LANE` where LANE is a named constant is valid code
+    m = mod("""
+        import jax.experimental.pallas as pl
+        LANE = 128
+        def f(x, K):
+            bk = _pick_block(K, 256, floor=LANE)
+            return pl.pallas_call(
+                k, in_specs=[pl.BlockSpec((8, bk), lambda i: (i, 0))])
+    """)
+    assert check(TileLegalityChecker(), m) == []
+
+
+def test_tpu001_pick_block_good_floor():
+    m = mod("""
+        import jax.experimental.pallas as pl
+        def f(x, K):
+            bk = _pick_block(K, 256, floor=128)
+            return pl.pallas_call(
+                k, in_specs=[pl.BlockSpec((8, bk), lambda i: (i, 0))])
+    """)
+    assert check(TileLegalityChecker(), m) == []
+
+
+def test_tpu001_flags_reintroduced_bnconv_bug():
+    """Re-introduce the PR 1 bnconv lane-dim bug (drop the floor=128 on
+    the lane-axis _pick_block calls) and TPU001 must light up; the
+    committed file must stay clean."""
+    path = os.path.join(REPO, "kubeflow_tpu", "ops", "bnconv.py")
+    with open(path) as fh:
+        src = fh.read()
+    buggy = src.replace(", floor=128)", ")")
+    assert buggy != src, "bnconv no longer uses floor=128 lane picks"
+    rel = "kubeflow_tpu/ops/bnconv.py"
+    bad = check(TileLegalityChecker(), ModuleInfo.from_source(rel, buggy))
+    assert bad and all(f.rule == "TPU001" for f in bad)
+    assert any("floor 8" in f.message for f in bad)
+    good = check(TileLegalityChecker(), ModuleInfo.from_source(rel, src))
+    assert good == []
+
+
+# -- TPU002 host call in jit ------------------------------------------------
+
+def test_tpu002_decorated_jit():
+    m = mod("""
+        import jax, time
+        @jax.jit
+        def step(x):
+            t = time.time()
+            return x + t
+    """)
+    f = check(HostCallInJitChecker(), m)
+    assert len(f) == 1 and "time.time" in f[0].message
+
+
+def test_tpu002_pallas_kernel_via_partial():
+    m = mod("""
+        import functools
+        import numpy as np
+        import jax.experimental.pallas as pl
+        def _kern(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * np.random.rand()
+        def run(x):
+            return pl.pallas_call(functools.partial(_kern))(x)
+    """)
+    f = check(HostCallInJitChecker(), m)
+    assert len(f) == 1 and "np.random.rand" in f[0].message
+
+
+def test_tpu002_jit_call_form_and_print():
+    m = mod("""
+        import jax
+        def step(x):
+            print("tracing", x)
+            return x
+        fast = jax.jit(step)
+    """)
+    f = check(HostCallInJitChecker(), m)
+    assert len(f) == 1 and "print" in f[0].message
+
+
+def test_tpu002_host_call_outside_jit_ok():
+    m = mod("""
+        import time
+        def loop(x):
+            return time.time() + x
+    """)
+    assert check(HostCallInJitChecker(), m) == []
+
+
+def test_tpu002_debug_escape_hatch_ok():
+    m = mod("""
+        import jax
+        @jax.jit
+        def step(x):
+            jax.debug.print("x={}", x)
+            return x
+    """)
+    assert check(HostCallInJitChecker(), m) == []
+
+
+# -- TPU003 raw clock -------------------------------------------------------
+
+def test_tpu003_raw_calls_flagged():
+    m = mod("""
+        import time
+        def reconcile():
+            t0 = time.time()
+            time.sleep(1)
+    """)
+    f = check(RawClockChecker(), m)
+    assert [x.rule for x in f] == ["TPU003", "TPU003"]
+
+
+def test_tpu003_injectable_default_idiom_ok():
+    m = mod("""
+        import time
+        def window(self, now=None):
+            now = now if now is not None else time.time()
+            return now
+    """)
+    assert check(RawClockChecker(), m) == []
+
+
+def test_tpu003_clock_reference_ok():
+    m = mod("""
+        import time
+        class C:
+            def __init__(self, clock=None):
+                self.clock = clock if clock is not None else time.monotonic
+    """)
+    assert check(RawClockChecker(), m) == []
+
+
+def test_tpu003_examples_skipped():
+    m = mod("import time\nts = time.time()\n",
+            rel="kubeflow_tpu/examples/mnist.py")
+    assert check(RawClockChecker(), m) == []
+
+
+def test_tpu003_pragma_suppresses():
+    m = mod("""
+        import time
+        def main():
+            while True:  # serve forever
+                time.sleep(3600)  # tpulint: disable=TPU003
+    """)
+    findings, suppressed = lint_modules([m], rules=["TPU003"])
+    assert findings == [] and suppressed == 1
+
+
+# -- TPU004 wiring ----------------------------------------------------------
+
+COMPONENT_SRC = """
+    DEFAULTS = {"name": "serving-autoscaler", "port": 8090}
+    @register("autoscaler", DEFAULTS, "desc")
+    def render(config, params):
+        return [o.service_account("a", "ns"),
+                o.cluster_role("a", []),
+                o.cluster_role_binding("a", "a", "a", "ns")]
+"""
+
+
+def test_tpu004_url_port_drift():
+    comp = mod(COMPONENT_SRC,
+               rel="kubeflow_tpu/manifests/components/autoscaler.py")
+    presets = mod("""
+        URL = "http://serving-autoscaler:9999"
+    """, rel="kubeflow_tpu/config/presets.py")
+    f = check(WiringChecker(), comp, presets)
+    assert len(f) == 1 and "9999" in f[0].message
+    assert f[0].path == "kubeflow_tpu/config/presets.py"
+
+
+def test_tpu004_url_port_match_and_foreign_hosts_ok():
+    comp = mod(COMPONENT_SRC,
+               rel="kubeflow_tpu/manifests/components/autoscaler.py")
+    presets = mod("""
+        URL = "http://serving-autoscaler:8090"
+        OTHER = "http://127.0.0.1:9999"
+        EXT = "https://example.com:443/x"
+    """, rel="kubeflow_tpu/config/presets.py")
+    assert check(WiringChecker(), comp, presets) == []
+
+
+def test_tpu004_unknown_component_spec():
+    comp = mod(COMPONENT_SRC,
+               rel="kubeflow_tpu/manifests/components/autoscaler.py")
+    presets = mod("""
+        parts = [ComponentSpec("autoscaler"), ComponentSpec("no-such")]
+    """, rel="kubeflow_tpu/config/presets.py")
+    f = check(WiringChecker(), comp, presets)
+    assert len(f) == 1 and "no-such" in f[0].message
+
+
+def test_tpu004_role_without_binding():
+    comp = mod("""
+        DEFAULTS = {"name": "thing", "port": 80}
+        @register("thing", DEFAULTS, "d")
+        def render(config, params):
+            return [o.cluster_role("t", [])]
+    """, rel="kubeflow_tpu/manifests/components/thing.py")
+    f = check(WiringChecker(), comp)
+    assert len(f) == 1 and "cluster_role_binding" in f[0].message
+
+
+def test_tpu004_role_without_binding_no_defaults_dict():
+    # rbac pairing must not depend on the module declaring DEFAULTS
+    comp = mod("""
+        @register("thing", None, "d")
+        def render(config, params):
+            return [o.cluster_role("t", [])]
+    """, rel="kubeflow_tpu/manifests/components/thing.py")
+    f = check(WiringChecker(), comp)
+    assert len(f) == 1 and "cluster_role_binding" in f[0].message
+
+
+# -- TPU005 unbounded retry -------------------------------------------------
+
+def test_tpu005_while_true_sleep_no_exit():
+    m = mod("""
+        import time
+        def pump():
+            while True:
+                try:
+                    connect()
+                except Exception:
+                    time.sleep(2)
+    """)
+    f = check(UnboundedRetryChecker(), m)
+    assert len(f) == 1 and f[0].rule == "TPU005"
+
+
+def test_tpu005_break_return_deadline_ok():
+    m = mod("""
+        import time
+        def a():
+            while True:
+                if done():
+                    break
+                time.sleep(1)
+        def b(clock, timeout):
+            t0 = clock()
+            while clock() - t0 < timeout:
+                time.sleep(1)
+        def c():
+            for attempt in range(3):
+                time.sleep(2 ** attempt)
+    """)
+    assert check(UnboundedRetryChecker(), m) == []
+
+
+def test_tpu005_nested_loop_break_does_not_count():
+    m = mod("""
+        import time
+        def pump():
+            while True:
+                for x in items():
+                    if x:
+                        break
+                time.sleep(2)
+    """)
+    assert len(check(UnboundedRetryChecker(), m)) == 1
+
+
+def test_tpu005_pragma_inside_span_suppresses():
+    m = mod("""
+        import time
+        def main():
+            while True:
+                time.sleep(3600)  # tpulint: disable=TPU005
+    """)
+    findings, suppressed = lint_modules([m], rules=["TPU005"])
+    assert findings == [] and suppressed == 1
+
+
+# -- pragmas / baseline workflow --------------------------------------------
+
+def test_line_pragma_with_trailing_justification_prose():
+    # the documented style encourages a human-readable reason after the
+    # rule list; prose must not be absorbed into the rule token
+    m = mod("""
+        import time
+        def main():
+            while True:
+                time.sleep(3600)  # tpulint: disable=TPU003,TPU005 serving forever is the point
+    """)
+    findings, suppressed = lint_modules([m], rules=["TPU003", "TPU005"])
+    assert findings == [] and suppressed == 2
+
+
+def test_file_pragma_disables_rule_for_whole_file():
+    m = mod("""
+        # tpulint: disable-file=TPU003
+        import time
+        a = time.time()
+        b = time.sleep(1)
+    """)
+    findings, suppressed = lint_modules([m], rules=["TPU003"])
+    assert findings == [] and suppressed == 2
+
+
+def test_baseline_roundtrip(tmp_path):
+    m = mod("""
+        import time
+        def f():
+            time.sleep(1)
+    """)
+    findings, _ = lint_modules([m], rules=["TPU003"])
+    assert len(findings) == 1
+    path = str(tmp_path / "base.json")
+    baseline_mod.save(path, findings)
+    # same findings → fully grandfathered
+    assert baseline_mod.new_findings(findings, baseline_mod.load(path)) == []
+    # a second occurrence beyond the baselined count is new
+    m2 = mod("""
+        import time
+        def f():
+            time.sleep(1)
+        def g():
+            time.sleep(1)
+    """)
+    findings2, _ = lint_modules([m2], rules=["TPU003"])
+    new = baseline_mod.new_findings(findings2, baseline_mod.load(path))
+    assert len(new) == 1
+
+
+def test_baseline_survives_line_drift(tmp_path):
+    m = mod("import time\nts = time.sleep(5)\n")
+    findings, _ = lint_modules([m], rules=["TPU003"])
+    path = str(tmp_path / "base.json")
+    baseline_mod.save(path, findings)
+    # same offending line, shifted down and re-indented: still baselined
+    m2 = mod("import time\n\n\nif True:\n    ts = time.sleep(5)\n")
+    findings2, _ = lint_modules([m2], rules=["TPU003"])
+    assert len(findings2) == 1
+    assert baseline_mod.new_findings(
+        findings2, baseline_mod.load(path)) == []
+
+
+def test_baseline_version_mismatch(tmp_path):
+    path = tmp_path / "base.json"
+    path.write_text(json.dumps({"version": 99, "findings": {}}))
+    with pytest.raises(ValueError):
+        baseline_mod.load(str(path))
+
+
+# -- whole-repo gate --------------------------------------------------------
+
+def test_repo_is_clean_under_committed_baseline():
+    """The tier-1 enforcement point: the analyzers run in-process over
+    the real package and must report zero non-baselined findings."""
+    report = run_lint()
+    msgs = "\n".join(f.format() for f in report.new)
+    assert report.new == [], f"new tpulint findings:\n{msgs}"
+    assert report.files > 100  # sanity: the walk actually saw the repo
+
+
+def test_cli_exits_zero_on_clean_repo(tmp_path):
+    import subprocess
+    import sys
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "run_tpulint.py"),
+         "--format", "json"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["new"] == []
+
+
+def test_cli_refuses_scoped_baseline_update(tmp_path):
+    """A path- or rule-scoped --baseline-update would rewrite the
+    baseline from a subset of findings, wiping grandfathered entries
+    outside the scope — the CLI must refuse, loudly."""
+    import subprocess
+    import sys
+    script = os.path.join(REPO, "scripts", "run_tpulint.py")
+    before = open(os.path.join(REPO, "tpulint_baseline.json")).read()
+    for extra in (["kubeflow_tpu/ops"], ["--rules", "TPU001"]):
+        proc = subprocess.run(
+            [sys.executable, script, "--baseline-update", *extra],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 2, (extra, proc.stdout, proc.stderr)
+        assert "full, unfiltered run" in proc.stderr
+    assert open(os.path.join(REPO, "tpulint_baseline.json")).read() == before

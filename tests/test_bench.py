@@ -103,6 +103,28 @@ def test_cluster_runner_monitors_job(tmp_path):
         ctrl.stop()
 
 
+def test_cluster_runner_timeout_with_fake_clock():
+    """The monitor loop runs off injectable clock/sleep (tpulint TPU003
+    fix): a job that never completes times out without real waiting."""
+    client = FakeKubeClient()
+    now = {"t": 0.0}
+
+    def clock():
+        return now["t"]
+
+    def sleep(s):
+        now["t"] += s
+
+    runner = ClusterRunner(client, poll_interval_s=5.0,
+                           clock=clock, sleep=sleep)
+    spec = BenchmarkSpec(name="stuck", workload="resnet", timeout_s=60)
+    result = runner.run(spec)  # nobody reconciles: phase never set
+    assert result.status == "Timeout"
+    # the loop advanced fake time past the deadline via injected sleep
+    assert now["t"] >= 60
+    assert result.wall_time_s >= 60
+
+
 def test_cluster_runner_collects_workload_results(tmp_path, monkeypatch):
     """log_metrics appends to KFTPU_RESULTS_DIR/<job>.jsonl (contract check)."""
     monkeypatch.setenv("KFTPU_RESULTS_DIR", str(tmp_path))

@@ -256,6 +256,42 @@ def test_resource_step_waits_for_condition(client, ctrl):
     assert get_wf(client, "w")["status"]["phase"] == "Succeeded"
 
 
+def test_resource_step_timeout_uses_injectable_clock(client):
+    """The resource-step deadline runs off the controller's injectable
+    clock (autoscale.policy.Clock contract; tpulint TPU003), so the
+    timeout path is testable without real elapsed time."""
+    import calendar
+    import time as _time
+
+    now = {"t": _time.time()}
+    ctrl = WorkflowController(client, clock=lambda: now["t"])
+    target = {"apiVersion": "kubeflow-tpu.org/v1alpha1", "kind": "TpuJob",
+              "metadata": {"name": "job", "namespace": "default"},
+              "spec": {"image": "x"}}
+    client.create(workflow("w", "default", [
+        resource_step("launch", "create", target,
+                      success_condition="status.startTime",
+                      timeout_seconds=30.0),
+    ]))
+    ctrl.reconcile("default", "w")
+    wf = get_wf(client, "w")
+    node = wf["status"]["nodes"]["launch"]
+    assert node["phase"] == "Running"
+    # anchor the fake clock to the persisted startedAt, then step past
+    # the deadline: gmtime-frame comparison per controller._advance
+    started = calendar.timegm(_time.strptime(
+        node["startedAt"], "%Y-%m-%dT%H:%M:%SZ"))
+    now["t"] = started + 29.0
+    ctrl.reconcile("default", "w")
+    assert get_wf(client, "w")["status"]["nodes"]["launch"][
+        "phase"] == "Running"
+    now["t"] = started + 31.0
+    ctrl.reconcile("default", "w")
+    wf = get_wf(client, "w")
+    assert wf["status"]["nodes"]["launch"]["phase"] == "Failed"
+    assert wf["status"]["nodes"]["launch"]["message"] == "timeout"
+
+
 # -- kubebench DAG ---------------------------------------------------------
 
 def test_benchmark_workflow_end_to_end(client, ctrl):
