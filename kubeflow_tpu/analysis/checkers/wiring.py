@@ -23,6 +23,14 @@ Sub-rules:
 - **rbac-pairing**: a component module that renders ``cluster_role``
   must also render ``cluster_role_binding`` and ``service_account``
   (and the namespaced ``role``/``role_binding`` pair likewise).
+- **api-route**: a full-URL literal targeting a route-providing service
+  (``http://trace-collector:8095/api/traces:ingest``,
+  ``http://centraldashboard:80/api/traces/...``) must name a path the
+  provider module actually serves — the provider's ``/api/...`` string
+  constants are its route table (``dashboard/server.py``,
+  ``obs/service.py``, ``autoscale/service.py``). Renaming a trace
+  endpoint without updating its callers is exactly the drift class the
+  PR-3 observability wiring added.
 """
 
 from __future__ import annotations
@@ -41,8 +49,19 @@ COMPONENTS_DIR = "manifests/components/"
 CONFIG_DIR = "config/"
 
 # dotted hosts (IPs, FQDNs) never match a bare Service name, so the
-# hostname charset is deliberately dot-free
-_URL_RE = re.compile(r"https?://([A-Za-z0-9-]+):(\d+)")
+# hostname charset is deliberately dot-free; the optional path group
+# feeds the api-route sub-rule (path charset excludes quote/markup
+# punctuation so docstring samples like ``http://x:1/api/y`` parse clean)
+_URL_RE = re.compile(r"https?://([A-Za-z0-9-]+):(\d+)(/[\w\-./:%~]*)?")
+
+# route-providing services: the module whose "/api/..." string constants
+# ARE the service's route table. A full-URL literal elsewhere naming one
+# of these hosts must use a path the provider serves.
+_ROUTE_PROVIDERS: Dict[str, str] = {
+    "centraldashboard": "dashboard/server.py",
+    "trace-collector": "obs/service.py",
+    "serving-autoscaler": "autoscale/service.py",
+}
 
 
 @dataclasses.dataclass
@@ -61,6 +80,7 @@ class _UrlRef:
     rel: str
     lineno: int
     span: Tuple[int, int]
+    path: str = ""
 
 
 def _defaults_dict(module: ModuleInfo) -> Optional[ast.Dict]:
@@ -113,6 +133,7 @@ class WiringChecker(Checker):
         self.urls: List[_UrlRef] = []
         self.specs: List[Tuple[str, str, int, Tuple[int, int]]] = []
         self.rbac: List[Tuple[str, int, Set[str]]] = []
+        self.routes: Dict[str, Set[str]] = {}  # provider host -> paths
 
     # -- collection --------------------------------------------------------
 
@@ -123,9 +144,21 @@ class WiringChecker(Checker):
         if COMPONENTS_DIR in module.rel:
             self._collect_component(module)
         self._collect_urls(module)
+        self._collect_routes(module)
         if CONFIG_DIR in module.rel or COMPONENTS_DIR in module.rel:
             self._collect_component_specs(module)
         return ()
+
+    def _collect_routes(self, module: ModuleInfo) -> None:
+        for host, rel in _ROUTE_PROVIDERS.items():
+            if not module.rel.endswith(rel):
+                continue
+            routes = self.routes.setdefault(host, set())
+            for node in ast.walk(module.tree):
+                s = astutil.const_str(node) \
+                    if isinstance(node, ast.Constant) else None
+                if s and s.startswith("/api/"):
+                    routes.add(s)
 
     def _collect_component(self, module: ModuleInfo) -> None:
         reg = _register_id(module)
@@ -168,7 +201,9 @@ class WiringChecker(Checker):
                 self.urls.append(_UrlRef(
                     host=m.group(1), port=int(m.group(2)),
                     rel=module.rel, lineno=node.lineno,
-                    span=module.node_span(node)))
+                    span=module.node_span(node),
+                    # strip sentence punctuation from prose-embedded URLs
+                    path=(m.group(3) or "").rstrip(".,")))
 
     def _collect_component_specs(self, module: ModuleInfo) -> None:
         for node in ast.walk(module.tree):
@@ -198,6 +233,28 @@ class WiringChecker(Checker):
                             f"{want}",
                     hint="update the URL or the component DEFAULTS — "
                          "by-URL wiring drifts silently")
+        for ref in self.urls:
+            if not ref.path.startswith("/api/"):
+                continue
+            routes = self.routes.get(ref.host)
+            if not routes:
+                # provider module absent from this walk (partial runs)
+                continue
+            if ref.rel.endswith(_ROUTE_PROVIDERS.get(ref.host, "\0")):
+                continue  # the provider's own docstring/examples
+            ok = ref.path in routes or any(
+                ref.path.startswith(r) for r in routes if r.endswith("/"))
+            if not ok:
+                provider = _ROUTE_PROVIDERS[ref.host]
+                yield Finding(
+                    rule=self.rule, severity=self.severity, path=ref.rel,
+                    line=ref.lineno, span=ref.span,
+                    message=f"URL path {ref.path!r} on service "
+                            f"{ref.host!r} matches no route served by "
+                            f"{provider}",
+                    hint="update the caller or the provider's route "
+                         "table — endpoint renames drift silently "
+                         "behind by-URL wiring")
         if self.component_ids:
             for cid, rel, lineno, span in self.specs:
                 if cid not in self.component_ids:

@@ -30,6 +30,7 @@ from kubeflow_tpu.autoscale.metrics import MetricsAggregator
 from kubeflow_tpu.autoscale.planner import CapacityPlanner, Plan
 from kubeflow_tpu.autoscale.policy import AutoscalePolicy
 from kubeflow_tpu.autoscale.recommender import Decision, Recommender
+from kubeflow_tpu.obs import Tracer
 from kubeflow_tpu.scheduler.inventory import SliceInfo
 from kubeflow_tpu.utils import DEFAULT_REGISTRY
 
@@ -119,6 +120,9 @@ class Autoscaler:
                            else MetricsAggregator(clock=self.clock))
         self.inventory = inventory if inventory is not None else (lambda: [])
         self.registry = registry
+        # decision spans share the loop's clock: deterministic under the
+        # fake clocks the autoscale tests drive
+        self.tracer = Tracer(clock=self.clock)
         self._loops: Dict[str, _ModelLoop] = {}
         self._lock = threading.Lock()
 
@@ -185,6 +189,13 @@ class Autoscaler:
                 lp.persisted_scale = plan.granted
             except Exception:  # noqa: BLE001 — registry is observability,
                 pass           # never fail the control loop on it
+        # decision marker span: why the fleet changed (or didn't) at
+        # this tick — the "p99 regressed, did we scale?" correlation
+        self.tracer.record(
+            "autoscale.reconcile", start=now, end=now,
+            attrs={"model": model, "desired": decision.desired,
+                   "granted": plan.granted, "panic": decision.panic,
+                   "reason": decision.reason, "capped": plan.capped})
         return decision
 
     def reconcile_all(self, now: Optional[float] = None) -> None:

@@ -16,6 +16,9 @@ Route parity with the reference's Express server
 - ``GET /api/workgroup/exists``    — profile/workgroup flow via kfam
   (``api_workgroup.ts``)
 - ``GET /api/dashboard-links``     — component cards for the UI shell
+- ``GET /api/traces``              — recent root spans from the platform's
+  span collector (``kubeflow_tpu/obs``); ``GET /api/traces/<trace_id>``
+  returns one full span tree (docs/OBSERVABILITY.md)
 """
 
 from __future__ import annotations
@@ -26,6 +29,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import kubeflow_tpu
 from kubeflow_tpu.k8s.client import ApiError, KubeClient
+from kubeflow_tpu.obs import DEFAULT_COLLECTOR, SpanCollector
 from kubeflow_tpu.tenancy.kfam import AccessManagementApi
 from kubeflow_tpu.tenancy.profiles import PROFILE_API_VERSION, PROFILE_KIND
 from kubeflow_tpu.utils import DEFAULT_REGISTRY
@@ -150,7 +154,8 @@ class DashboardApi:
                  run_archive=None,
                  artifact_store=None,
                  authorize=None,
-                 autoscaler=None) -> None:
+                 autoscaler=None,
+                 collector: Optional[SpanCollector] = None) -> None:
         from kubeflow_tpu.tenancy.authz import default_authorizer
 
         self.client = client
@@ -167,6 +172,11 @@ class DashboardApi:
         # anything with .status() (an Autoscaler, or a URL-backed shim);
         # None = proxy to KFTPU_AUTOSCALE_URL, else registry gauges only
         self.autoscaler = autoscaler
+        # span source for /api/traces — the process-local collector by
+        # default (dev/in-process), a remote-backed shim when the fleet
+        # ships spans to the trace-collector service instead
+        self.collector = (collector if collector is not None
+                          else DEFAULT_COLLECTOR)
 
     def _authz(self, user: str, ns: str, resource: str) -> None:
         if not self.authorize(user, "get", ns, resource):
@@ -194,6 +204,13 @@ class DashboardApi:
                 return 200, self.activities(ns)
             if path == "/api/metrics/autoscale":
                 return 200, self.autoscale_view()
+            if path == "/api/traces":
+                return 200, self.traces()
+            if path.startswith("/api/traces/"):
+                tid = path[len("/api/traces/"):]
+                if not tid or "/" in tid:
+                    return 404, {"error": f"no route {path}"}
+                return self.trace_detail(tid)
             if path.startswith("/api/metrics/"):
                 return 200, self.metrics.query(path.rsplit("/", 1)[1])
             if path == "/api/workgroup/exists":
@@ -314,6 +331,18 @@ class DashboardApi:
                                                "kftpu_autoscale_")}
         return {"metrics": _parse_prom(DEFAULT_REGISTRY.expose(),
                                        "kftpu_autoscale_")}
+
+    def traces(self) -> List[Dict[str, Any]]:
+        """Recent root spans (+ per-trace span counts), newest first —
+        the incident entry point: find the slow request, open its tree."""
+        return self.collector.summary()
+
+    def trace_detail(self, trace_id: str) -> Tuple[int, Any]:
+        # the trace-collector service's handler, over this collector —
+        # one API shape everywhere (docs/OBSERVABILITY.md)
+        from kubeflow_tpu.obs.service import trace_detail
+
+        return trace_detail(self.collector, trace_id)
 
     def workgroup_exists(self, user: str) -> Dict[str, Any]:
         profiles = self.client.list(PROFILE_API_VERSION, PROFILE_KIND)

@@ -37,6 +37,13 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 from urllib.parse import urlsplit
 
+from kubeflow_tpu.obs import (
+    REQUEST_ID_HEADER,
+    TRACEPARENT_HEADER,
+    TRACER,
+    TRACESTATE_HEADER,
+    format_traceparent,
+)
 from kubeflow_tpu.utils.jsonhttp import USER_HEADER
 from kubeflow_tpu.utils.metrics import DEFAULT_REGISTRY
 
@@ -46,6 +53,9 @@ _proxied = DEFAULT_REGISTRY.counter(
     "kftpu_edge_requests_total", "requests routed by the edge proxy")
 _denied = DEFAULT_REGISTRY.counter(
     "kftpu_edge_denied_total", "requests denied at the edge")
+_latency_h = DEFAULT_REGISTRY.histogram(
+    "request_latency_seconds",
+    "end-to-end request latency observed at the edge proxy")
 
 # request paths that must work without a session (the login flow)
 PUBLIC_PATHS = ("/login", "/login.html", "/style.css", "/logout", "/healthz")
@@ -54,6 +64,14 @@ PUBLIC_PATHS = ("/login", "/login.html", "/style.css", "/logout", "/healthz")
 _HOP_BY_HOP = {"connection", "keep-alive", "proxy-authenticate",
                "proxy-authorization", "te", "trailers",
                "transfer-encoding", "upgrade", "host"}
+
+# headers only the mesh may assert: identity (any casing) and trace
+# context — a client-forged traceparent would graft its request onto an
+# arbitrary trace, and a forged X-Request-Id would poison log
+# correlation. Stripped exactly like X-Kubeflow-Userid, then re-stamped
+# with verified values.
+_STRIP_INBOUND = {USER_HEADER.lower(), REQUEST_ID_HEADER.lower(),
+                  TRACEPARENT_HEADER, TRACESTATE_HEADER}
 
 
 @dataclass(frozen=True)
@@ -169,18 +187,58 @@ class EdgeProxy:
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
 
+            def send_response(self, code, message=None):  # noqa: N802
+                # remember the status for the root span / latency
+                # histogram, and stamp the verified request id on every
+                # response so a client error report names its trace
+                self._last_status = code
+                super().send_response(code, message)
+                rid = getattr(self, "_request_id", None)
+                if rid:
+                    self.send_header(REQUEST_ID_HEADER, rid)
+
             def _forward(self) -> None:
+                # keep-alive: no stale id/status leaks between requests
+                self._request_id = None
+                self._last_status = 0
+                self._tunneled = False
                 path = self.path
                 clean = path.split("?")[0]
                 route = proxy.route_for(clean)
                 if route is None:
                     self._send(404, b'{"error": "no route"}')
                     return
-                # drop hop-by-hop headers and — never trust identity from
-                # outside the mesh — any casing of the identity header
+                # the edge is the trace root: every request gets a fresh
+                # span here (client-supplied trace context was stripped —
+                # the mesh trusts only its own ids)
+                with TRACER.span("edge.request", attrs={
+                        "http.method": self.command,
+                        "http.path": clean,
+                        "route": route.prefix}) as sp:
+                    self._request_id = sp.trace_id
+                    try:
+                        self._forward_routed(route, path, clean, sp)
+                    finally:
+                        code = getattr(self, "_last_status", 0)
+                        sp.attrs["http.status"] = code
+                        if self._tunneled:
+                            # a WebSocket splice lives for hours — its
+                            # lifetime is not request latency and would
+                            # wreck the histogram's _sum/p99
+                            sp.attrs["websocket"] = True
+                        else:
+                            _latency_h.observe(TRACER.clock() - sp.start,
+                                               route=route.prefix,
+                                               code=str(code))
+
+            def _forward_routed(self, route: Route, path: str, clean: str,
+                                span) -> None:
+                # drop hop-by-hop headers and — never trust identity or
+                # trace context from outside the mesh — any casing of
+                # the identity/request-id/traceparent headers
                 headers = {k: v for k, v in self.headers.items()
                            if k.lower() not in _HOP_BY_HOP
-                           and k.lower() != USER_HEADER.lower()}
+                           and k.lower() not in _STRIP_INBOUND}
                 public = clean in PUBLIC_PATHS or clean.rstrip("/") in (
                     p.rstrip("/") for p in PUBLIC_PATHS)
                 if not public and (proxy.verify_url or proxy.authenticator):
@@ -199,7 +257,13 @@ class EdgeProxy:
                         self._send(401, b'{"log": "authentication required"}')
                         return
                     headers[USER_HEADER] = user
+                # stamp VERIFIED trace context (the values forged copies
+                # were stripped for): backends continue this span
+                headers[TRACEPARENT_HEADER] = format_traceparent(
+                    span.context())
+                headers[REQUEST_ID_HEADER] = span.trace_id
                 if self._is_upgrade():
+                    self._tunneled = True
                     self._tunnel(route, route.rewrite(path), headers)
                     return
                 length = int(self.headers.get("Content-Length", "0") or 0)
@@ -391,6 +455,7 @@ class EdgeProxy:
 
             def do_GET(self):  # noqa: N802
                 if self.path.split("?")[0] == "/healthz":
+                    self._request_id = None
                     self._send(200, b'{"ok": true}')
                     return
                 self._forward()

@@ -1,0 +1,73 @@
+"""Trace-collector component: the fleet-wide span sink.
+
+No reference equivalent — the reference platform's observability stops
+at Prometheus scrape annotations (``tf-job-operator.libsonnet:180-184``)
+with no request-level tracing at all. This deploys
+``kubeflow_tpu.obs.service`` (ingest + trace query API) next to the
+``monitoring`` Prometheus: components push span batches to
+``http://trace-collector:8095/api/traces:ingest`` (the default wired in
+:mod:`kubeflow_tpu.obs.export`; tpulint TPU004 cross-checks host, port,
+and path), and the dashboard's traces panel reads the same
+``/api/traces`` shape it serves locally.
+
+RBAC mirrors what trace correlation touches (resolving a span's
+``service``/``pod`` attrs against live objects): read-only pods,
+services, endpoints — the same read surface the Prometheus scraper has.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from kubeflow_tpu.config.deployment import DeploymentConfig
+from kubeflow_tpu.k8s import objects as o
+from kubeflow_tpu.manifests.registry import register
+
+DEFAULTS: Dict[str, Any] = {
+    "name": "trace-collector",
+    # framework code — same image as the serving tier
+    "image": "kubeflow-tpu/serving:v1alpha1",
+    # every http://trace-collector:<port> literal elsewhere (the
+    # push_spans default, dashboard wiring) must match — tpulint TPU004
+    "port": 8095,
+    # ring-buffer capacity: the retained incident window, not an archive
+    "capacity": 65536,
+}
+
+
+@register("trace-collector", DEFAULTS,
+          "Distributed-trace span sink + query API (docs/OBSERVABILITY.md)")
+def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
+    ns = config.namespace
+    name = params["name"]
+    pod = o.pod_spec([
+        o.container(
+            "collector",
+            params["image"],
+            command=["python", "-m", "kubeflow_tpu.obs.service"],
+            env={"KFTPU_TRACE_PORT": str(params["port"]),
+                 "KFTPU_TRACE_CAPACITY": str(params["capacity"])},
+            ports=[params["port"]],
+        )
+    ], service_account_name=name)
+    return [
+        o.service_account(name, ns),
+        o.cluster_role(name, [
+            {"apiGroups": [""],
+             "resources": ["pods", "services", "endpoints"],
+             "verbs": ["get", "list", "watch"]},
+        ]),
+        o.cluster_role_binding(name, name, name, ns),
+        o.deployment(name, ns, pod, labels={"app": name}),
+        o.service(
+            name, ns, {"app": name},
+            [{"name": "http", "port": params["port"],
+              "targetPort": params["port"]}],
+            labels={"app": name},
+            annotations={
+                # the collector exposes its own ingest/eviction counters
+                "prometheus.io/scrape": "true",
+                "prometheus.io/path": "/metrics",
+                "prometheus.io/port": str(params["port"]),
+            }),
+    ]

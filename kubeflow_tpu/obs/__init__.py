@@ -1,0 +1,27 @@
+"""Observability tier: distributed tracing + exporters (docs/OBSERVABILITY.md)."""
+
+from kubeflow_tpu.obs.trace import (  # noqa: F401
+    DEFAULT_COLLECTOR,
+    REQUEST_ID_HEADER,
+    TRACEPARENT_HEADER,
+    TRACER,
+    TRACESTATE_HEADER,
+    Span,
+    SpanCollector,
+    SpanContext,
+    Tracer,
+    current_context,
+    current_span,
+    extract,
+    format_traceparent,
+    grpc_metadata,
+    inject,
+    parse_traceparent,
+    profiler_annotator,
+)
+from kubeflow_tpu.obs.export import (  # noqa: F401
+    chrome_trace,
+    otlp_lines,
+    parse_otlp_lines,
+    push_spans,
+)

@@ -1,0 +1,108 @@
+"""Trace-collector service: the fleet's span sink + trace query API.
+
+Deployed by the ``trace-collector`` manifest component. Components push
+span batches (:func:`kubeflow_tpu.obs.export.push_spans`) or operators
+query a pod's own in-process collector through the identical routes the
+dashboard serves — one API shape everywhere:
+
+- ``GET  /api/traces``               recent root spans (+ span counts)
+- ``GET  /api/traces/<trace_id>``    the full span tree, start-ordered
+- ``GET  /api/traces/<trace_id>:chrome``  Chrome trace_event JSON
+- ``POST /api/traces:ingest``        ``{"spans": [otlp-ish records]}``
+- ``GET  /metrics`` / ``GET /healthz``
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional, Tuple
+
+from kubeflow_tpu.obs.export import chrome_trace, span_from_record
+from kubeflow_tpu.obs.trace import DEFAULT_COLLECTOR, SpanCollector
+from kubeflow_tpu.utils import DEFAULT_REGISTRY
+from kubeflow_tpu.utils.jsonhttp import RawResponse, serve_json
+
+log = logging.getLogger(__name__)
+
+_ingested = DEFAULT_REGISTRY.counter(
+    "kftpu_trace_spans_ingested_total", "spans accepted by the collector")
+
+
+def trace_detail(collector: SpanCollector,
+                 trace_id: str) -> Tuple[int, Any]:
+    """The one ``GET /api/traces/<id>`` handler — shared by this
+    service and the dashboard so the API shape can never drift."""
+    spans = collector.trace(trace_id)
+    if not spans:
+        return 404, {"error": f"trace {trace_id!r} not found"}
+    return 200, {"trace_id": trace_id,
+                 "spans": [s.to_dict() for s in spans]}
+
+
+class TraceCollectorService:
+    """Route table over a :class:`SpanCollector` (shared JSON scaffold)."""
+
+    def __init__(self, collector: Optional[SpanCollector] = None,
+                 registry=DEFAULT_REGISTRY) -> None:
+        self.collector = (collector if collector is not None
+                          else DEFAULT_COLLECTOR)
+        self.registry = registry
+
+    def handle(self, method: str, path: str, body: Optional[Dict[str, Any]],
+               user: str = "") -> Tuple[int, Any]:
+        if method == "GET" and path == "/healthz":
+            return 200, {"ok": True}
+        if method == "GET" and path == "/metrics":
+            return 200, RawResponse("text/plain; version=0.0.4",
+                                    self.registry.expose().encode())
+        if method == "GET" and path == "/api/traces":
+            return 200, self.collector.summary()
+        if method == "POST" and path == "/api/traces:ingest":
+            return self.ingest(body)
+        if method == "GET" and path.startswith("/api/traces/"):
+            tid = path[len("/api/traces/"):]
+            if tid.endswith(":chrome"):
+                return self.trace_chrome(tid[:-len(":chrome")])
+            return self.trace_detail(tid)
+        return 404, {"error": f"no route {path}"}
+
+    # -- handlers ----------------------------------------------------------
+
+    def trace_detail(self, trace_id: str) -> Tuple[int, Any]:
+        return trace_detail(self.collector, trace_id)
+
+    def trace_chrome(self, trace_id: str) -> Tuple[int, Any]:
+        spans = self.collector.trace(trace_id)
+        if not spans:
+            return 404, {"error": f"trace {trace_id!r} not found"}
+        return 200, chrome_trace(spans)
+
+    def ingest(self, body: Optional[Dict[str, Any]]) -> Tuple[int, Any]:
+        records = (body or {}).get("spans")
+        if not isinstance(records, list):
+            return 400, {"error": "body must carry 'spans' (a list of "
+                                  "otlp-ish span records)"}
+        accepted = 0
+        for rec in records:
+            try:
+                self.collector.record(span_from_record(rec))
+                accepted += 1
+            except (KeyError, TypeError, ValueError):
+                continue  # one bad record must not drop the batch
+        _ingested.inc(accepted)
+        return 200, {"accepted": accepted,
+                     "rejected": len(records) - accepted}
+
+
+def main() -> None:
+    import os
+
+    logging.basicConfig(level=logging.INFO)
+    capacity = int(os.environ.get("KFTPU_TRACE_CAPACITY", "65536"))
+    service = TraceCollectorService(SpanCollector(capacity=capacity))
+    serve_json(service.handle,
+               int(os.environ.get("KFTPU_TRACE_PORT", "8095")))
+
+
+if __name__ == "__main__":
+    main()

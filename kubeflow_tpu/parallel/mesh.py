@@ -226,7 +226,17 @@ def shard_constraint(x, logical_axes, rules: AxisRules = DEFAULT_RULES):
         mesh = jax.sharding.get_abstract_mesh()
         no_mesh = mesh.empty
     except AttributeError:
-        mesh, no_mesh = None, False
+        # jax<0.5 has no get_abstract_mesh; the ambient mesh entered
+        # via ``with mesh:`` lives in the thread resources. Without
+        # this fallback every eager/no-mesh call crashed in
+        # with_sharding_constraint instead of no-opping.
+        try:
+            from jax.interpreters import pxla
+
+            mesh = pxla.thread_resources.env.physical_mesh
+            no_mesh = mesh.empty
+        except (AttributeError, ImportError):
+            mesh, no_mesh = None, False
     if no_mesh:
         return x
     if mesh is not None:

@@ -18,6 +18,8 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Dict, List, Tuple
 
+from kubeflow_tpu.obs import TRACER
+
 # accelerator type -> (chips, hosts, physical topology string), derived
 # from the platform's provisioning inventory so placement and node pools
 # can never disagree about slice geometry
@@ -84,14 +86,20 @@ def place_gang(
         raise ValueError(
             f"{accelerator} has {max_hosts} hosts; requested {hosts_per_slice}"
         )
-    order = ring_order(hosts_per_slice, topology)
-    out: List[SlicePlacement] = []
-    for s in range(slices):
-        for i in range(hosts_per_slice):
-            out.append(SlicePlacement(
-                slice_index=s,
-                host=order[i],
-                topology=topology,
-                accelerator=accelerator,
-            ))
+    # decision span: which gang got which slices/hosts, correlatable
+    # with the job's trace when a caller has one active
+    with TRACER.span("scheduler.place_gang", attrs={
+            "accelerator": accelerator, "slices": slices,
+            "hosts_per_slice": hosts_per_slice,
+            "workers": slices * hosts_per_slice}):
+        order = ring_order(hosts_per_slice, topology)
+        out: List[SlicePlacement] = []
+        for s in range(slices):
+            for i in range(hosts_per_slice):
+                out.append(SlicePlacement(
+                    slice_index=s,
+                    host=order[i],
+                    topology=topology,
+                    accelerator=accelerator,
+                ))
     return out

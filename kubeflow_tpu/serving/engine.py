@@ -50,11 +50,13 @@ program per prompt bucket if that matters more than burst TTFT).
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 import logging
 import os
 import queue
 import threading
+import time
 from typing import Any, List, Optional
 
 import jax
@@ -67,7 +69,14 @@ from kubeflow_tpu.models.decode import (
     prefill_continue,
     sample_logits,
 )
+from kubeflow_tpu.obs import (
+    SpanContext,
+    Tracer,
+    current_context,
+    profiler_annotator,
+)
 from kubeflow_tpu.utils import DEFAULT_REGISTRY
+from kubeflow_tpu.utils.clock import Clock
 
 log = logging.getLogger(__name__)
 
@@ -89,6 +98,9 @@ _prefix_bytes_g = DEFAULT_REGISTRY.gauge(
 _prefix_budget_g = DEFAULT_REGISTRY.gauge(
     "kftpu_engine_prefix_cache_budget_bytes",
     "prefix-cache byte budget (entries evict LRU to stay under it)")
+_queue_wait_h = DEFAULT_REGISTRY.histogram(
+    "engine_queue_wait_seconds",
+    "time a generate request waits for a decode slot")
 
 _END = object()  # per-request stream sentinel
 
@@ -134,6 +146,13 @@ class _Request:
     # first N prompt tokens are a reusable prefix (shared system
     # prompt): its prefill is served from the engine's prefix cache
     prefix_len: int = 0
+    # trace context captured at submit() — the engine thread parents its
+    # queue-wait/admit/decode spans onto the submitting request's span
+    ctx: Optional[SpanContext] = None
+    t_submit: float = 0.0
+    # queue-wait recorded once: a failed batch admission retries members
+    # through the row path, which must not observe the wait twice
+    _wait_noted: bool = False
     out: "queue.Queue[Any]" = dataclasses.field(
         default_factory=queue.Queue)
     error: Optional[Exception] = None
@@ -168,6 +187,7 @@ class _Slot:
     produced: int = 0  # tokens emitted so far (1 after the prefill sample);
     # the device-facing step/token state lives in the engine's host-side
     # arrays (_stepidx/_tokens) — the slot only tracks delivery
+    t_decode0: float = 0.0  # decode-phase start (the decode span's start)
 
 
 class DecodeEngine:
@@ -185,9 +205,19 @@ class DecodeEngine:
                  sampler_bound: Optional[int] = None,
                  admit_batch_max: Optional[int] = None,
                  precompile: bool = False,
-                 autostart: bool = True, name: str = "") -> None:
+                 autostart: bool = True, name: str = "",
+                 clock: Optional[Clock] = None,
+                 tracer: Optional[Tracer] = None) -> None:
         self.config = config
         self.slots = slots
+        # host-side timing source for queue-wait/admit/decode spans; a
+        # fake clock makes engine span trees deterministic in tests
+        self.clock: Clock = clock if clock is not None else time.monotonic
+        # spans land in the shared collector; the profiler annotator
+        # mirrors live admit/prefill spans onto the XLA host timeline
+        # during a capture (docs/OBSERVABILITY.md)
+        self.tracer = tracer if tracer is not None else Tracer(
+            clock=self.clock, annotator=profiler_annotator())
         # lax.top_k-bounded sampler (models/decode.py:sample_logits
         # ``bound``): avoids the per-token full-vocab sort the exact
         # sampler pays at every sampled step — 0 selects the exact sort
@@ -490,7 +520,10 @@ class DecodeEngine:
         req = _Request(prompt=prompt, max_new=max_new,
                        temperature=float(temperature), top_k=int(top_k),
                        top_p=float(top_p), seed=int(seed), eos_id=eos_id,
-                       prefix_len=prefix_len)
+                       prefix_len=prefix_len,
+                       # the submitting thread's active span (serving
+                       # handler) — engine spans parent onto it
+                       ctx=current_context(), t_submit=self.clock())
         # the lock orders this against close()'s drain: a submit must
         # either land before the drain (and be failed by it) or see the
         # stop flag and raise — never sit in a queue nobody reads
@@ -590,10 +623,30 @@ class DecodeEngine:
         _prefix_bytes_g.set(self.prefix_cache_bytes, model=self.name)
         return pcache
 
+    def _note_queue_wait(self, req: _Request) -> float:
+        """Close out the request's queue phase: one span + the
+        ``engine_queue_wait_seconds`` histogram. Returns now. Idempotent
+        per request — the row-path retry after a failed batch admission
+        must not observe the wait twice."""
+        now = self.clock()
+        if req._wait_noted:
+            return now
+        req._wait_noted = True
+        wait = max(0.0, now - req.t_submit)
+        _queue_wait_h.observe(wait, model=self.name)
+        self.tracer.record("engine.queue_wait", start=req.t_submit,
+                           end=now, parent=req.ctx,
+                           attrs={"model": self.name})
+        return now
+
     def _admit_one(self, req: _Request, slot: int) -> None:
         """Prefill the request's prompt and write it into ``slot``."""
+        self._note_queue_wait(req)
         S = req.prompt.size
-        with self._mesh_ctx():
+        with self.tracer.span("engine.admit", parent=req.ctx, attrs={
+                "model": self.name, "slot": slot,
+                "prompt_tokens": int(S), "batched": False}), \
+                self._mesh_ctx():
             if req.prefix_len:
                 N = req.prefix_len
                 pcache = self._prefix_cache_row(req.prompt[:N])
@@ -606,22 +659,28 @@ class DecodeEngine:
                     sbucket = suf
                 padded = np.zeros((1, sbucket), np.int32)
                 padded[0, :suf] = req.prompt[N:]
-                tok, row_cache = self._continue(
-                    self._params, pcache, jnp.asarray(padded),
-                    jnp.asarray([suf], jnp.int32),
-                    jnp.asarray([S], jnp.int32),
-                    jnp.float32(req.temperature), jnp.int32(req.top_k),
-                    jnp.float32(req.top_p), jnp.int32(req.seed))
+                with self.tracer.span("engine.prefill", attrs={
+                        "prompt_tokens": int(S),
+                        "prefix_len": int(N)}):
+                    tok, row_cache = self._continue(
+                        self._params, pcache, jnp.asarray(padded),
+                        jnp.asarray([suf], jnp.int32),
+                        jnp.asarray([S], jnp.int32),
+                        jnp.float32(req.temperature),
+                        jnp.int32(req.top_k),
+                        jnp.float32(req.top_p), jnp.int32(req.seed))
             else:
                 bucket = pow2_bucket(S, self.config.max_seq_len)
                 padded = np.zeros((1, bucket), np.int32)
                 padded[0, :S] = req.prompt
-                tok, row_cache = self._prefill(
-                    self._params, jnp.asarray(padded),
-                    jnp.asarray([S], jnp.int32),
-                    jnp.float32(req.temperature),
-                    jnp.int32(req.top_k), jnp.float32(req.top_p),
-                    jnp.int32(req.seed))
+                with self.tracer.span("engine.prefill", attrs={
+                        "prompt_tokens": int(S), "bucket": bucket}):
+                    tok, row_cache = self._prefill(
+                        self._params, jnp.asarray(padded),
+                        jnp.asarray([S], jnp.int32),
+                        jnp.float32(req.temperature),
+                        jnp.int32(req.top_k), jnp.float32(req.top_p),
+                        jnp.int32(req.seed))
             self._cache = self._insert(self._cache, row_cache,
                                        jnp.int32(slot))
         self._finalize_admission(req, slot, int(tok))
@@ -631,7 +690,7 @@ class DecodeEngine:
         """Emit the prefill-sampled first token and arm the slot's
         host-side step state — shared by the row and batch admission
         paths so their slot initialization can never diverge."""
-        st = _Slot(req=req)
+        st = _Slot(req=req, t_decode0=self.clock())
         self._emit(st, first)
         if not self._finished(st, first):
             with self._lock:
@@ -696,6 +755,13 @@ class DecodeEngine:
                     # tokens past EOS/budget in this chunk are discarded
                     with self._lock:
                         self._active[i] = None
+                    # the request's decode phase is over: one span with
+                    # the token count — the per-request cost record
+                    self.tracer.record(
+                        "engine.decode", start=slot.t_decode0,
+                        end=self.clock(), parent=slot.req.ctx,
+                        attrs={"model": self.name,
+                               "tokens": slot.produced})
                     break
         _occupancy.set(self.active_count, model=self.name)
         return True
@@ -771,6 +837,9 @@ class DecodeEngine:
         Token-identical to the row path: same ragged per-row lengths,
         same ``fold_in(key(seed), 0)`` sampling."""
         k = len(members)
+        t0 = self.clock()
+        for req, _slot in members:
+            self._note_queue_wait(req)
         bb = pow2_bucket(k, min(self.slots, self.admit_batch_max))
         prompts = np.zeros((bb, bucket), np.int32)
         lens = np.ones((bb,), np.int32)
@@ -791,16 +860,28 @@ class DecodeEngine:
             slot_ids[i] = slot
             valid[i] = True
         with self._mesh_ctx():
-            toks, bcache = self._prefill_batch(
-                self._params, jnp.asarray(prompts), jnp.asarray(lens),
-                jnp.asarray(temps), jnp.asarray(tks), jnp.asarray(tps),
-                jnp.asarray(seeds))
+            # annotate the shared device call on the profiler timeline;
+            # span-wise it is recorded below as a per-member child of
+            # each admit span (a context-managed span here would be an
+            # orphan root — the engine thread has no active span — and
+            # would crowd the dashboard's trace list)
+            ann = (self.tracer.annotator("engine.prefill")
+                   if self.tracer.annotator is not None
+                   else contextlib.nullcontext())
+            p0 = self.clock()
+            with ann:
+                toks, bcache = self._prefill_batch(
+                    self._params, jnp.asarray(prompts),
+                    jnp.asarray(lens),
+                    jnp.asarray(temps), jnp.asarray(tks),
+                    jnp.asarray(tps), jnp.asarray(seeds))
             # force completion (host transfer — block_until_ready is not
             # enough on every transport) BEFORE the donating inserts: a
             # device-side prefill failure must surface while self._cache
             # is still intact, so _admit's row-path fallback retries
             # against a live engine instead of a consumed cache
             toks = np.asarray(toks)
+            p1 = self.clock()
             try:
                 self._cache = self._insert_rows(
                     self._cache, bcache, jnp.asarray(slot_ids),
@@ -815,7 +896,19 @@ class DecodeEngine:
                     req.out.put(_END)
                 raise _CacheInvalidated(str(e)) from e
         self.batch_prefills += 1
+        t1 = self.clock()
         for i, (req, slot) in enumerate(members):
+            adm = self.tracer.record(
+                "engine.admit", start=t0, end=t1, parent=req.ctx,
+                attrs={"model": self.name, "slot": slot,
+                       "prompt_tokens": int(lens[i]),
+                       "batched": True, "batch": k})
+            # the shared prefill's time range, nested in THIS member's
+            # trace (same shape as the row path's admit→prefill)
+            self.tracer.record(
+                "engine.prefill", start=p0, end=p1, parent=adm,
+                attrs={"prompt_tokens": int(lens[i]), "bucket": bucket,
+                       "batched": True, "batch": k})
             self._finalize_admission(req, slot, int(toks[i]))
 
     def _loop(self) -> None:

@@ -21,6 +21,7 @@ import json
 import os
 from typing import Any, Dict, Optional, Tuple
 
+from kubeflow_tpu.obs import TRACER, extract
 from kubeflow_tpu.serving.graph import (
     GraphError,
     GraphExecutor,
@@ -39,7 +40,8 @@ class GraphService:
         self.executor = executor
 
     def handle(self, method: str, path: str, body: Optional[Dict[str, Any]],
-               user: str = "") -> Tuple[int, Any]:
+               user: str = "",
+               headers: Optional[Dict[str, str]] = None) -> Tuple[int, Any]:
         if method == "GET" and path == "/healthz":
             return 200, {"ok": True}
         if method == "GET" and path == "/v1/graph":
@@ -48,10 +50,17 @@ class GraphService:
         if method == "POST" and path == "/v1/graph:predict":
             if not body or "instances" not in body:
                 return 400, {"error": "body must contain 'instances'"}
-            try:
-                out = self.executor.predict({"instances": body["instances"]})
-            except GraphError as e:
-                return 502, {"error": str(e)}
+            # continue the edge's trace through the graph walk; node
+            # calls made inside inherit via the context-local span
+            with TRACER.span("graph.predict",
+                             remote=extract(headers)) as sp:
+                try:
+                    out = self.executor.predict(
+                        {"instances": body["instances"]})
+                except GraphError as e:
+                    sp.attrs["http.status"] = 502
+                    return 502, {"error": str(e)}
+                sp.attrs["route"] = out.get("route", [])
             _requests.inc()
             return 200, out
         if method == "POST" and path == "/v1/graph:feedback":

@@ -19,6 +19,7 @@ import grpc
 import jax.numpy as jnp
 import numpy as np
 
+from kubeflow_tpu.obs import TRACER, extract, grpc_metadata
 from kubeflow_tpu.serving import predict_pb2 as pb
 from kubeflow_tpu.serving.engine import EngineClosed
 from kubeflow_tpu.serving.server import (
@@ -80,6 +81,15 @@ class PredictionServicer:
 
     def Predict(self, request: pb.PredictRequest,
                 context: grpc.ServicerContext) -> pb.PredictResponse:
+        # traceparent rides invocation metadata (the gRPC twin of the
+        # HTTP header); the same W3C extract handles both carriers
+        with TRACER.span("serving.grpc.predict",
+                         remote=extract(context.invocation_metadata()),
+                         attrs={"model": request.model_name}):
+            return self._predict(request, context)
+
+    def _predict(self, request: pb.PredictRequest,
+                 context: grpc.ServicerContext) -> pb.PredictResponse:
         model = self.repo.get(request.model_name,
                               request.version or None)
         if model is None:
@@ -154,11 +164,14 @@ class PredictionServicer:
         """Autoregressive generation over binary prompt tensors — the
         fast-path twin of the REST ``:generate`` endpoint (shared core:
         ``kubeflow_tpu.serving.server.run_generate``)."""
-        model, body = self._generate_inputs(request, context)
-        code, payload = run_generate(
-            model, body, self.max_batch_size,
-            model_name=request.model_name,
-            engine=self.repo.engine_for(request.model_name, model))
+        with TRACER.span("serving.grpc.generate",
+                         remote=extract(context.invocation_metadata()),
+                         attrs={"model": request.model_name}):
+            model, body = self._generate_inputs(request, context)
+            code, payload = run_generate(
+                model, body, self.max_batch_size,
+                model_name=request.model_name,
+                engine=self.repo.engine_for(request.model_name, model))
         if code != 200:
             context.abort(_status_for(code),
                           payload.get("error", "generate failed"))
@@ -183,11 +196,16 @@ class PredictionServicer:
         decode position (a row of tokens across the batch), then a
         final ``done`` chunk. Chunks arrive as the generation core
         yields them."""
-        model, body = self._generate_inputs(request, context)
-        code, payload = run_generate(
-            model, body, self.max_batch_size,
-            model_name=request.model_name, stream=True,
-            engine=self.repo.engine_for(request.model_name, model))
+        # span covers setup + engine submit (where the request's trace
+        # context is captured); the stream itself outlives it
+        with TRACER.span("serving.grpc.generate_stream",
+                         remote=extract(context.invocation_metadata()),
+                         attrs={"model": request.model_name}):
+            model, body = self._generate_inputs(request, context)
+            code, payload = run_generate(
+                model, body, self.max_batch_size,
+                model_name=request.model_name, stream=True,
+                engine=self.repo.engine_for(request.model_name, model))
         if code != 200:
             context.abort(_status_for(code),
                           payload.get("error", "generate failed"))
@@ -315,7 +333,8 @@ class PredictClient:
                 timeout: float = 120.0) -> Tuple[np.ndarray, int]:
         resp = self._predict(pb.PredictRequest(
             model_name=model_name, version=version or 0,
-            inputs=array_to_tensor(np.asarray(inputs))), timeout=timeout)
+            inputs=array_to_tensor(np.asarray(inputs))), timeout=timeout,
+            metadata=grpc_metadata())
         return tensor_to_array(resp.outputs), resp.model_version
 
     def _generate_request(self, model_name, prompt, *, max_new_tokens,
@@ -345,7 +364,7 @@ class PredictClient:
             true_len=true_len, temperature=temperature, seed=seed,
             top_k=top_k, top_p=top_p, eos_id=eos_id, version=version,
             prefix_len=prefix_len),
-            timeout=timeout)
+            timeout=timeout, metadata=grpc_metadata())
         return tensor_to_array(resp.tokens), resp.model_version
 
     def generate_speculative(self, model_name: str, prompt: np.ndarray,
@@ -365,7 +384,8 @@ class PredictClient:
         req.speculative = True
         if draft_len:
             req.draft_len = draft_len
-        resp = self._generate(req, timeout=timeout)
+        resp = self._generate(req, timeout=timeout,
+                              metadata=grpc_metadata())
         stats: dict = {}
         if resp.HasField("speculative"):
             s = resp.speculative
@@ -390,7 +410,7 @@ class PredictClient:
                 true_len=true_len, temperature=temperature, seed=seed,
                 top_k=top_k, top_p=top_p, eos_id=eos_id,
                 version=version, prefix_len=prefix_len),
-                timeout=timeout):
+                timeout=timeout, metadata=grpc_metadata()):
             if chunk.done:
                 return
             yield np.asarray(chunk.tokens, np.int32)

@@ -34,6 +34,7 @@ import urllib.parse
 import urllib.request
 from typing import Any, Dict, Optional, Tuple
 
+from kubeflow_tpu.obs import TRACER, current_context, extract, inject
 from kubeflow_tpu.utils import DEFAULT_REGISTRY
 from kubeflow_tpu.utils.jsonhttp import serve_json
 
@@ -53,7 +54,8 @@ class PredictProxy:
         self.retry_after_s = retry_after_s
 
     def handle(self, method: str, path: str, body: Optional[Dict[str, Any]],
-               user: str = "") -> Tuple[int, Any]:
+               user: str = "",
+               headers: Optional[Dict[str, str]] = None) -> Tuple[int, Any]:
         if method == "GET" and path == "/healthz":
             return 200, {"ok": True, "backend": self.backend_url}
         if method != "POST" or not (path.startswith("/model/")
@@ -66,18 +68,23 @@ class PredictProxy:
         # wakes the scale-from-zero loop
         if self.reporter is not None:
             self.reporter.request_start(model)
-        try:
-            if (self.admit_gate is not None
-                    and not self.admit_gate.can_admit(model)):
-                code, payload = 503, {
-                    "error": f"no ready replica for {model!r}; scaling up",
-                    "retryAfterSeconds": self.retry_after_s,
-                }
-            else:
-                code, payload = self._forward(model, body or {})
-        finally:
-            if self.reporter is not None:
-                self.reporter.request_finish(model)
+        with TRACER.span("serving.proxy", remote=extract(headers),
+                         attrs={"model": model}) as sp:
+            try:
+                if (self.admit_gate is not None
+                        and not self.admit_gate.can_admit(model)):
+                    code, payload = 503, {
+                        "error": f"no ready replica for {model!r}; "
+                                 "scaling up",
+                        "retryAfterSeconds": self.retry_after_s,
+                    }
+                else:
+                    code, payload = self._forward(model, body or {})
+            finally:
+                if self.reporter is not None:
+                    self.reporter.request_finish(model)
+            sp.attrs["http.status"] = code
+            trace_id = sp.trace_id
         latency_ms = (time.perf_counter() - t0) * 1000.0
         _proxied.inc(model=model)
         self._log({
@@ -87,15 +94,21 @@ class PredictProxy:
             "latency_ms": round(latency_ms, 2),
             "instances": len((body or {}).get("instances", []) or []),
             "user": user or None,
+            # the prediction log joins the trace tree on this key
+            "trace_id": trace_id,
         })
         return code, payload
 
     def _forward(self, model: str,
                  body: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
         url = f"{self.backend_url}/v1/models/{model}:predict"
+        fwd_headers = {"Content-Type": "application/json"}
+        ctx = current_context()
+        if ctx is not None:
+            inject(fwd_headers, ctx)
         req = urllib.request.Request(
             url, data=json.dumps(body).encode(), method="POST",
-            headers={"Content-Type": "application/json"})
+            headers=fwd_headers)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
                 return resp.status, json.loads(resp.read() or b"{}")

@@ -28,6 +28,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from kubeflow_tpu.obs import TRACER, extract
 from kubeflow_tpu.serving.engine import EngineClosed, pow2_bucket
 from kubeflow_tpu.serving.model_store import (
     LoadedModel,
@@ -926,9 +927,18 @@ class ModelServer:
                         version = int(v)
                     else:
                         name = target
+                    # continue the edge proxy's trace (or start one for
+                    # direct in-mesh callers); engine submits made inside
+                    # inherit this span via the context-local current span
+                    remote = extract(dict(self.headers))
+                    span_name = "serving" + verb.replace(":", ".")
                     if verb == ":generate" and body.get("stream"):
-                        code, payload = server.handle_generate(
-                            name, version, body, stream=True)
+                        with TRACER.span(span_name, remote=remote,
+                                         attrs={"model": name,
+                                                "stream": True}) as sp:
+                            code, payload = server.handle_generate(
+                                name, version, body, stream=True)
+                            sp.attrs["http.status"] = code
                         if code != 200:
                             self._send(code, payload)
                             return
@@ -960,7 +970,10 @@ class ModelServer:
                             chunk({"error": f"{type(e).__name__}: {e}"})
                         self.wfile.write(b"0\r\n\r\n")
                         return
-                    code, payload = handlers[verb](name, version, body)
+                    with TRACER.span(span_name, remote=remote,
+                                     attrs={"model": name}) as sp:
+                        code, payload = handlers[verb](name, version, body)
+                        sp.attrs["http.status"] = code
                     self._send(code, payload)
                 else:
                     self._send(404, {"error": "not found"})

@@ -2,6 +2,7 @@
 
 from kubeflow_tpu.utils.metrics import (  # noqa: F401
     DEFAULT_REGISTRY,
+    Histogram,
     Metric,
     Registry,
     serve_metrics,

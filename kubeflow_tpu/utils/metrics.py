@@ -8,9 +8,10 @@ registry serves the same exposition format from stdlib HTTP.
 
 from __future__ import annotations
 
+import bisect
 import http.server
 import threading
-from typing import Dict, Mapping, Optional, Tuple
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
 _Label = Tuple[Tuple[str, str], ...]
 
@@ -52,6 +53,100 @@ class Metric:
         return "\n".join(lines)
 
 
+# Prometheus client-library default bounds: right for request latencies
+# in seconds, overridable per histogram
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class Histogram(Metric):
+    """Cumulative histogram: ``_bucket{le=...}``/``_sum``/``_count``
+    exposition with configurable bounds. Buckets are stored per label
+    set; exposition emits cumulative counts (each ``le`` bucket includes
+    everything below it, ``+Inf`` equals ``_count``), the shape every
+    Prometheus quantile function expects."""
+
+    def __init__(self, name: str, help_: str,
+                 buckets: Sequence[float] = DEFAULT_BUCKETS) -> None:
+        super().__init__(name, help_, "histogram")
+        bounds = sorted(set(float(b) for b in buckets))
+        if not bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        if bounds[-1] == float("inf"):
+            bounds.pop()  # +Inf is implicit
+        if not bounds:
+            raise ValueError("histogram needs a finite bucket bound")
+        self.bounds: Tuple[float, ...] = tuple(bounds)
+        # per label set: per-bucket (non-cumulative) counts + [+Inf]
+        self._counts: Dict[_Label, List[int]] = {}
+        self._sums: Dict[_Label, float] = {}
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = self._key(labels)
+        idx = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            counts = self._counts.get(key)
+            if counts is None:
+                counts = self._counts[key] = [0] * (len(self.bounds) + 1)
+            counts[idx] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        raise TypeError(f"histogram {self.name!r}: use observe(), not inc()")
+
+    def set(self, value: float, **labels: str) -> None:
+        raise TypeError(f"histogram {self.name!r}: use observe(), not set()")
+
+    def get(self, **labels: str) -> float:
+        """Observation count for the label set (the ``_count`` series)."""
+        with self._lock:
+            return float(sum(self._counts.get(self._key(labels), ())))
+
+    def bucket_counts(self, **labels: str) -> Dict[str, int]:
+        """Cumulative counts keyed by ``le`` string (tests/debugging)."""
+        with self._lock:
+            counts = list(self._counts.get(self._key(labels),
+                                           [0] * (len(self.bounds) + 1)))
+        out: Dict[str, int] = {}
+        acc = 0
+        for bound, n in zip(self.bounds, counts):
+            acc += n
+            out[_fmt_bound(bound)] = acc
+        out["+Inf"] = acc + counts[-1]
+        return out
+
+    def sum(self, **labels: str) -> float:
+        with self._lock:
+            return self._sums.get(self._key(labels), 0.0)
+
+    def expose(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            items = sorted((k, list(v), self._sums.get(k, 0.0))
+                           for k, v in self._counts.items())
+        for key, counts, total in items:
+            base = ",".join(f'{k}="{v}"' for k, v in key)
+            acc = 0
+            for bound, n in zip(self.bounds, counts):
+                acc += n
+                lbl = (base + "," if base else "") + \
+                    f'le="{_fmt_bound(bound)}"'
+                lines.append(f"{self.name}_bucket{{{lbl}}} {acc}")
+            acc += counts[-1]
+            lbl = (base + "," if base else "") + 'le="+Inf"'
+            lines.append(f"{self.name}_bucket{{{lbl}}} {acc}")
+            suffix = f"{{{base}}}" if base else ""
+            lines.append(f"{self.name}_sum{suffix} {total}")
+            lines.append(f"{self.name}_count{suffix} {acc}")
+        return "\n".join(lines)
+
+
+def _fmt_bound(b: float) -> str:
+    """``0.005``/``1``/``2.5`` — no float noise in the ``le`` label."""
+    return format(b, "g")
+
+
 class Registry:
     def __init__(self) -> None:
         self._metrics: Dict[str, Metric] = {}
@@ -63,10 +158,29 @@ class Registry:
     def gauge(self, name: str, help_: str = "") -> Metric:
         return self._register(name, help_, "gauge")
 
-    def _register(self, name: str, help_: str, kind: str) -> Metric:
+    def histogram(self, name: str, help_: str = "",
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        return self._register(  # type: ignore[return-value]
+            name, help_, "histogram",
+            factory=lambda: Histogram(name, help_,
+                                      buckets if buckets is not None
+                                      else DEFAULT_BUCKETS))
+
+    def _register(self, name: str, help_: str, kind: str,
+                  factory=None) -> Metric:
         with self._lock:
-            if name not in self._metrics:
-                self._metrics[name] = Metric(name, help_, kind)
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if existing.kind != kind:
+                    # returning the existing metric under the wrong type
+                    # would silently cross counter/gauge semantics (and
+                    # histogram observe() would be missing entirely)
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.kind}, not {kind}")
+                return existing
+            self._metrics[name] = (factory() if factory is not None
+                                   else Metric(name, help_, kind))
             return self._metrics[name]
 
     def expose(self) -> str:
@@ -83,16 +197,26 @@ def serve_metrics(port: int, registry: Registry = DEFAULT_REGISTRY) -> threading
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802
-            if self.path.rstrip("/") in ("", "/metrics", "/healthz"):
-                body = (registry.expose() if "metrics" in self.path else "ok\n"
-                        ).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "text/plain; version=0.0.4")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+            # exact-path routing: the old '"metrics" in path' substring
+            # test served the exposition for /healthz?x=metrics and any
+            # path merely containing "metrics"
+            path = self.path.split("?")[0].rstrip("/") or "/"
+            if path == "/metrics":
+                body = registry.expose().encode()
+                ctype = "text/plain; version=0.0.4"
+            elif path in ("/", "/healthz"):
+                body = b"ok\n"
+                # a health probe is not a Prometheus exposition — no
+                # exposition version suffix
+                ctype = "text/plain"
             else:
                 self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
 
         def log_message(self, *a):  # quiet
             pass

@@ -10,9 +10,11 @@ Whole-step retries mirror Argo's retryStrategy.
 
 from __future__ import annotations
 
+import calendar
+import hashlib
 import logging
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from kubeflow_tpu.k8s import objects as o
 from kubeflow_tpu.k8s.client import ApiError, KubeClient
@@ -21,6 +23,7 @@ from kubeflow_tpu.k8s.helpers import (
     delete_ignore_missing,
     update_status_ignore_missing,
 )
+from kubeflow_tpu.obs import SpanContext, Tracer
 from kubeflow_tpu.operators.controller import Controller
 from kubeflow_tpu.utils import DEFAULT_REGISTRY
 from kubeflow_tpu.utils.clock import Clock
@@ -52,6 +55,25 @@ _steps_run = DEFAULT_REGISTRY.counter(
     "kftpu_workflow_steps_total", "workflow steps launched")
 
 
+def workflow_trace_ids(ns: str, name: str, uid: str) -> Tuple[str, str]:
+    """Deterministic ``(trace_id, root span_id)`` for a Workflow CR.
+
+    Derived from object identity (not stored in status) so every
+    reconcile pass — across controller restarts — lands its step spans
+    in the SAME trace, and an operator can compute the trace id from
+    ``kubectl get`` output alone."""
+    h = hashlib.sha256(f"wf/{ns}/{name}/{uid}".encode()).hexdigest()
+    return h[:32], h[32:48]
+
+
+def _parse_ts(stamp: str) -> Optional[float]:
+    try:
+        return float(calendar.timegm(
+            time.strptime(stamp, "%Y-%m-%dT%H:%M:%SZ")))
+    except (TypeError, ValueError):
+        return None
+
+
 class WorkflowController:
     """Reconciles Workflow CRs on any :class:`KubeClient`.
 
@@ -67,11 +89,16 @@ class WorkflowController:
     def __init__(self, client: KubeClient,
                  namespace: Optional[str] = None,
                  archive=None,
-                 clock: Optional[Clock] = None) -> None:
+                 clock: Optional[Clock] = None,
+                 tracer: Optional[Tracer] = None) -> None:
         self.client = client
         self.namespace = namespace
         self.archive = archive
         self.clock: Clock = clock if clock is not None else time.time
+        # step/workflow spans share the controller's (possibly fake)
+        # clock, so traces stay deterministic wherever timeouts are
+        self.tracer = tracer if tracer is not None else Tracer(
+            clock=self.clock)
 
     def _now(self) -> str:
         """Status timestamps (startedAt/finishedAt) derive from the SAME
@@ -111,7 +138,7 @@ class WorkflowController:
         for s in spec.steps:
             node = nodes.get(s["name"])
             if node and node.get("phase") == NODE_RUNNING:
-                self._advance(ns, name, s, node)
+                self._advance(ns, wf, s, node)
 
         # 2. propagate skips from failed/skipped dependencies
         changed = True
@@ -149,6 +176,8 @@ class WorkflowController:
         else:
             status["startedAt"] = wf["status"]["startedAt"]
         self._set_status(wf, status)
+        if status["phase"] in (PHASE_SUCCEEDED, PHASE_FAILED):
+            self._record_workflow_span(ns, wf, status)
         return None if status["phase"] != PHASE_RUNNING else 1.0
 
     # -- step execution ----------------------------------------------------
@@ -204,6 +233,7 @@ class WorkflowController:
                                       md.get("namespace", ns), md["name"])
                 node["phase"] = NODE_SUCCEEDED
                 node["finishedAt"] = self._now()
+                self._record_step_span(ns, wf, step, node)
                 return
             manifest = dict(manifest)
             manifest.setdefault("metadata", {}).setdefault("namespace", ns)
@@ -213,8 +243,9 @@ class WorkflowController:
                 # fire-and-forget create
                 node["phase"] = NODE_SUCCEEDED
                 node["finishedAt"] = self._now()
+                self._record_step_span(ns, wf, step, node)
 
-    def _advance(self, ns: str, wf_name: str, step: Dict[str, Any],
+    def _advance(self, ns: str, wf: o.Obj, step: Dict[str, Any],
                  node: Dict[str, Any]) -> None:
         if step["type"] == STEP_CONTAINER:
             pod = self.client.get_or_none("v1", "Pod", ns,
@@ -223,6 +254,7 @@ class WorkflowController:
             if phase == "Succeeded":
                 node["phase"] = NODE_SUCCEEDED
                 node["finishedAt"] = self._now()
+                self._record_step_span(ns, wf, step, node)
             elif phase == "Failed" or pod is None:
                 attempt = int(node.get("attempt", 0))
                 if attempt < int(step.get("retries", 0)):
@@ -233,6 +265,7 @@ class WorkflowController:
                     node["phase"] = NODE_FAILED
                     node["finishedAt"] = self._now()
                     node["message"] = "pod failed"
+                    self._record_step_span(ns, wf, step, node)
             return
         # resource step: poll conditions against the live object
         manifest = step["manifest"]
@@ -244,20 +277,82 @@ class WorkflowController:
             node["phase"] = NODE_FAILED
             node["finishedAt"] = self._now()
             node["message"] = f"failureCondition {step['failureCondition']!r}"
+            self._record_step_span(ns, wf, step, node)
         elif eval_condition(target, step.get("successCondition", "")):
             node["phase"] = NODE_SUCCEEDED
             node["finishedAt"] = self._now()
+            self._record_step_span(ns, wf, step, node)
         else:
-            import calendar
-
-            # startedAt was written with gmtime; compare in the same frame
-            started = calendar.timegm(time.strptime(
-                node.get("startedAt", self._now()), "%Y-%m-%dT%H:%M:%SZ"))
+            # startedAt was written with gmtime; compare in the same
+            # frame. A malformed stamp anchors the deadline at "now"
+            # (restarting the timeout) rather than failing reconcile.
+            started = _parse_ts(node.get("startedAt", ""))
+            if started is None:
+                started = self.clock()
             if self.clock() - started > float(
                     step.get("timeoutSeconds", 3600.0)):
                 node["phase"] = NODE_FAILED
                 node["finishedAt"] = self._now()
                 node["message"] = "timeout"
+                self._record_step_span(ns, wf, step, node)
+
+    # -- tracing -----------------------------------------------------------
+
+    def _wf_trace(self, ns: str, wf: o.Obj) -> Tuple[str, str]:
+        md = wf.get("metadata", {})
+        return workflow_trace_ids(ns, md.get("name", ""),
+                                  md.get("uid", ""))
+
+    def _record_step_span(self, ns: str, wf: o.Obj, step: Dict[str, Any],
+                          node: Dict[str, Any]) -> None:
+        """One span per completed step, in the workflow's trace.
+
+        Every reconcile pass derives the SAME trace_id from object
+        identity, so a workflow's steps — observed seconds or days
+        apart, possibly by different controller processes — assemble
+        into one tree. Span ids derive from (step, attempt): a restart
+        replaying a transition re-records the identical span instead of
+        forking the tree."""
+        start = _parse_ts(node.get("startedAt", ""))
+        end = _parse_ts(node.get("finishedAt", ""))
+        if start is None or end is None:
+            return
+        trace_id, root_id = self._wf_trace(ns, wf)
+        attempt = int(node.get("attempt", 0))
+        span_id = hashlib.sha256(
+            f"{trace_id}/{step['name']}/{attempt}".encode()
+        ).hexdigest()[:16]
+        phase = node.get("phase", "")
+        self.tracer.record(
+            f"workflow.step/{step['name']}",
+            start=start, end=end,
+            parent=SpanContext(trace_id, root_id), span_id=span_id,
+            attrs={"workflow": wf["metadata"]["name"],
+                   "step": step["name"], "type": step["type"],
+                   "attempt": attempt, "phase": phase,
+                   "message": node.get("message", "")},
+            status="OK" if phase == NODE_SUCCEEDED else f"ERROR: {phase}")
+
+    def _record_workflow_span(self, ns: str, wf: o.Obj,
+                              status: Dict[str, Any]) -> None:
+        """The root span, recorded once when the workflow reaches a
+        terminal phase (reconcile early-returns on terminal CRs, so
+        this transition happens exactly once per run)."""
+        start = _parse_ts(status.get("startedAt", ""))
+        end = _parse_ts(status.get("finishedAt", ""))
+        if start is None or end is None:
+            return
+        trace_id, root_id = self._wf_trace(ns, wf)
+        nodes = status.get("nodes", {})
+        phase = status.get("phase", "")
+        self.tracer.record(
+            f"workflow/{wf['metadata']['name']}",
+            start=start, end=end, trace_id=trace_id, span_id=root_id,
+            attrs={"workflow": wf["metadata"]["name"],
+                   "namespace": ns, "phase": phase,
+                   "steps": len(nodes)},
+            status="OK" if phase == PHASE_SUCCEEDED
+            else f"ERROR: {phase}")
 
     # -- helpers -----------------------------------------------------------
 

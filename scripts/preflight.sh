@@ -9,6 +9,9 @@
 #      kubeflow_tpu/, gated on tpulint_baseline.json (docs/ANALYSIS.md)
 #   2. binary-blob guard (scripts/check_binary_blobs.py): no large
 #      binaries staged for commit (PERF.md trace-artifact policy)
+#   3. obs smoke test (tests/test_obs.py): traceparent round-trip, span
+#      propagation proxy->server->engine, /api/traces, histograms
+#      (docs/OBSERVABILITY.md)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -19,6 +22,10 @@ python scripts/run_tpulint.py || rc=1
 
 echo "== preflight: binary blobs =="
 python scripts/check_binary_blobs.py "$@" || rc=1
+
+echo "== preflight: obs smoke test =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py -q -m 'not slow' \
+    -p no:cacheprovider || rc=1
 
 if [ "$rc" -ne 0 ]; then
     echo "preflight: FAILED" >&2

@@ -331,6 +331,55 @@ def test_tpu004_role_without_binding_no_defaults_dict():
     assert len(f) == 1 and "cluster_role_binding" in f[0].message
 
 
+TRACE_COMPONENT_SRC = """
+    DEFAULTS = {"name": "trace-collector", "port": 8095}
+    @register("trace-collector", DEFAULTS, "desc")
+    def render(config, params):
+        return [o.service_account("t", "ns"),
+                o.cluster_role("t", []),
+                o.cluster_role_binding("t", "t", "t", "ns")]
+"""
+
+TRACE_SERVICE_SRC = """
+    class Svc:
+        def handle(self, method, path, body, user=""):
+            if path == "/api/traces":
+                return 200, []
+            if path == "/api/traces:ingest":
+                return 200, {}
+            if path.startswith("/api/traces/"):
+                return 200, {}
+            return 404, {}
+"""
+
+
+def test_tpu004_api_route_drift():
+    comp = mod(TRACE_COMPONENT_SRC,
+               rel="kubeflow_tpu/manifests/components/trace_collector.py")
+    svc = mod(TRACE_SERVICE_SRC, rel="kubeflow_tpu/obs/service.py")
+    caller = mod("""
+        URL = "http://trace-collector:8095/api/spans:push"
+    """, rel="kubeflow_tpu/obs/export.py")
+    f = check(WiringChecker(), comp, svc, caller)
+    assert len(f) == 1 and "/api/spans:push" in f[0].message
+    assert f[0].path == "kubeflow_tpu/obs/export.py"
+    assert "obs/service.py" in f[0].message
+
+
+def test_tpu004_api_route_exact_and_prefix_match_ok():
+    comp = mod(TRACE_COMPONENT_SRC,
+               rel="kubeflow_tpu/manifests/components/trace_collector.py")
+    svc = mod(TRACE_SERVICE_SRC, rel="kubeflow_tpu/obs/service.py")
+    caller = mod("""
+        INGEST = "http://trace-collector:8095/api/traces:ingest"
+        ONE = "http://trace-collector:8095/api/traces/abc123"
+        # unknown host / no path: not this sub-rule's business
+        OTHER = "http://somewhere-else:1234/api/nope"
+        BARE = "http://trace-collector:8095"
+    """, rel="kubeflow_tpu/obs/export.py")
+    assert check(WiringChecker(), comp, svc, caller) == []
+
+
 # -- TPU005 unbounded retry -------------------------------------------------
 
 def test_tpu005_while_true_sleep_no_exit():

@@ -3,6 +3,7 @@ configs end-to-end on the virtual CPU mesh (the heavy resnet/bert configs
 run on the real chip via bench.py)."""
 
 import jax
+import pytest
 
 from kubeflow_tpu.bench import suite
 
@@ -72,6 +73,7 @@ def test_mfu_math():
     assert out == {}  # CPU: no peak → no MFU claimed
 
 
+@pytest.mark.slow  # multi-second XLA compiles; tier-1 runs the fast twin paths
 def test_decode_engine_config_tiny():
     # tiny model: the CPU tier checks the continuous-batching path end to
     # end (prefill/insert/chunked step/drain); the chip checks the speed
@@ -84,6 +86,7 @@ def test_decode_engine_config_tiny():
     assert out["engine_steps"] > 0
 
 
+@pytest.mark.slow  # multi-second XLA compiles; tier-1 runs the fast twin paths
 def test_longcontext_config_on_virtual_mesh():
     # tiny model: the CPU tier checks the path, the chip checks the speed
     out = suite.bench_longcontext(seq_len=512, batch_per_chip=1, steps=2,

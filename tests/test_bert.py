@@ -103,6 +103,7 @@ def test_mask_tokens_and_loss():
                - np.log(V)) < 1e-3
 
 
+@pytest.mark.slow  # multi-second XLA compiles; tier-1 runs the fast twin paths
 def test_mlm_training_reduces_loss_on_fixed_batch():
     config = bert_tiny()
     model = Bert(config)

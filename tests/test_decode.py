@@ -53,6 +53,7 @@ def full_forward_greedy(model, params, prompt, n):
     return jnp.stack(out, axis=1)
 
 
+@pytest.mark.slow  # multi-second XLA compiles; tier-1 runs the fast twin paths
 def test_greedy_matches_full_forward(setup):
     config, model, params, prompt = setup
     want = full_forward_greedy(model, params, prompt, 6)
@@ -79,6 +80,7 @@ def test_padded_prompt_matches_unpadded(setup):
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.slow  # multi-second XLA compiles; tier-1 runs the fast twin paths
 def test_ragged_batch_matches_per_row_oracle(setup):
     """Per-row true lengths: each row of a ragged batch must generate
     exactly what it would generate alone (physical slot == logical
@@ -464,6 +466,7 @@ def test_decode_on_sharded_mesh(setup):
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.slow  # multi-second XLA compiles; tier-1 runs the fast twin paths
 def test_lm_example_train_generate_export(tmp_path, capsys):
     """The flagship loop end to end: train → greedy sample → export →
     reload with a generate-capable LoadedModel."""

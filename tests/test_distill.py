@@ -62,6 +62,7 @@ def test_truncate_validates(target):
         truncate_draft(config, params, 9)
 
 
+@pytest.mark.slow  # multi-second XLA compiles; tier-1 runs the fast twin paths
 def test_distill_reduces_kl_and_raises_acceptance(target):
     """The recipe's whole point: distillation must move the draft toward
     the target — KL falls, and the speculative acceptance rate on the
@@ -87,6 +88,7 @@ def test_distill_reduces_kl_and_raises_acceptance(target):
     assert after > 0.2, after
 
 
+@pytest.mark.slow  # multi-second XLA compiles; tier-1 runs the fast twin paths
 def test_make_draft_one_call(target):
     config, params = target
     # corpus_len beyond the target context must clamp, not raise

@@ -5,6 +5,7 @@ numeric delta, transparent at load (the serving dtype is unchanged).
 import os
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -77,6 +78,7 @@ def test_bfloat16_params_quantize_and_restore_dtype(tmp_path):
     assert lm.predict(x).shape == (1, 10)
 
 
+@pytest.mark.slow  # multi-second XLA compiles; tier-1 runs the fast twin paths
 def test_quantized_transformer_generates(tmp_path):
     """The decode path works from a quantized artifact (params dequantize
     at load; generation still runs greedily end to end)."""

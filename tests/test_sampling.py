@@ -203,6 +203,7 @@ def test_temperature_sharpens():
     assert (hot[:, 0] == am).mean() < 0.7
 
 
+@pytest.mark.slow  # multi-second XLA compiles; tier-1 runs the fast twin paths
 def test_generate_accepts_filters_and_validates():
     from kubeflow_tpu.models.decode import generate
     from kubeflow_tpu.models import Transformer, TransformerConfig

@@ -29,6 +29,7 @@ def models():
     return target, draft
 
 
+@pytest.mark.slow  # multi-second XLA compiles; tier-1 runs the fast twin paths
 def test_matches_target_greedy_exactly(models):
     (tc, tp), (dc, dp) = models
     prompt = jnp.asarray([[5, 11, 17, 3]], jnp.int32)
@@ -41,6 +42,7 @@ def test_matches_target_greedy_exactly(models):
         assert 0 <= stats["accepted"] <= stats["draft_tokens"]
 
 
+@pytest.mark.slow  # multi-second XLA compiles; tier-1 runs the fast twin paths
 def test_ragged_batch_matches_per_row(models):
     """Per-row acceptance: each batch row must equal its solo greedy
     decode even though rows accept different proposal counts."""
@@ -86,6 +88,7 @@ def test_validates_slack_and_vocab(models):
                              max_new_tokens=4)
 
 
+@pytest.mark.slow  # multi-second XLA compiles; tier-1 runs the fast twin paths
 def test_fused_matches_host_loop_and_greedy(models):
     """speculative_generate_fused (one lax.while_loop program) must
     produce the target's exact greedy stream and the same round/accept
@@ -113,6 +116,7 @@ def test_fused_matches_host_loop_and_greedy(models):
                           "accepted": hstats["accepted"]}
 
 
+@pytest.mark.slow  # multi-second XLA compiles; tier-1 runs the fast twin paths
 def test_fused_ragged_batch_matches_per_row(models):
     """Fused per-row acceptance + scatter-drop overshoot: every ragged
     row equals its solo greedy decode."""
@@ -152,6 +156,7 @@ def test_fused_perfect_draft_and_validation(models):
                                  max_new_tokens=12, draft_len=4)
 
 
+@pytest.mark.slow  # multi-second XLA compiles; tier-1 runs the fast twin paths
 def test_fused_speculative_on_sharded_mesh(models):
     """Fused speculation with tensor-parallel-sharded target AND draft
     on the virtual mesh (the multi-chip serving layout): tokens must

@@ -1,6 +1,7 @@
 """End-to-end sharded training-step tests: loss must go down on the mesh."""
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -16,6 +17,7 @@ from kubeflow_tpu.train import (
 )
 
 
+@pytest.mark.slow  # multi-second XLA compiles; tier-1 runs the fast twin paths
 def test_lm_train_loss_decreases():
     config = tiny_config()
     model = Transformer(config)
@@ -37,6 +39,7 @@ def test_lm_train_loss_decreases():
     assert int(state.step) == 5
 
 
+@pytest.mark.slow  # multi-second XLA compiles; tier-1 runs the fast twin paths
 def test_lm_train_step_moe():
     config = tiny_config(n_experts=4, experts_per_token=2)
     model = Transformer(config)
@@ -105,6 +108,7 @@ def test_mnist_train_no_batchstats():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow  # multi-second XLA compiles; tier-1 runs the fast twin paths
 def test_chunked_loss_matches_full_logits_path():
     """chunked_next_token_loss from hidden states must equal
     next_token_loss on the model's logits — value AND parameter
@@ -142,6 +146,7 @@ def test_chunked_loss_matches_full_logits_path():
                                    atol=1e-5, err_msg=str(pa))
 
 
+@pytest.mark.slow  # multi-second XLA compiles; tier-1 runs the fast twin paths
 def test_lm_train_step_loss_chunk_mode():
     """make_lm_train_step(loss_chunk=): same loss trajectory as the
     full-logits step on the virtual mesh."""

@@ -52,6 +52,7 @@ def test_moe_forward():
     assert aux and np.isfinite(np.asarray(aux[0])).all()
 
 
+@pytest.mark.slow  # multi-second XLA compiles; tier-1 runs the fast twin paths
 def test_moe_matches_dense_dispatch_semantics():
     """With E experts and k=E, MoE output is a convex combination: finite + grad-safe."""
     config = tiny_config(n_experts=2, experts_per_token=2)

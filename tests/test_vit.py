@@ -2,6 +2,7 @@
 
 import jax
 import jax.numpy as jnp
+import pytest
 
 from kubeflow_tpu.models import ViT, vit_tiny
 from kubeflow_tpu.parallel import MeshConfig, create_mesh
@@ -31,6 +32,7 @@ def test_vit_rejects_wrong_image_size():
         model.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)))
 
 
+@pytest.mark.slow  # multi-second XLA compiles; tier-1 runs the fast twin paths
 def test_vit_trains_sharded_on_mesh():
     """Shared image train step (ResNet path, batch_stats=None) over dp×tp;
     the synthetic brightest-quadrant task must be learnable."""
