@@ -2,8 +2,12 @@
 
 from kubeflow_tpu.analysis.checkers import (  # noqa: F401
     host_call_in_jit,
+    mesh_axes,
     raw_clock,
+    spec_legality,
     tile_legality,
+    unbound_collective,
     unbounded_retry,
+    version_gate,
     wiring,
 )

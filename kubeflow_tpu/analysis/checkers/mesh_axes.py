@@ -1,0 +1,191 @@
+"""TPU007 — mesh-axis-consistency (cross-file).
+
+SPMD axis/sharding mistakes dominate TPU-scale debugging cost: a typo'd
+axis name in a ``PartitionSpec`` or a ``psum`` doesn't fail until the
+program traces inside a mesh on the real runtime — and on a reduced
+test mesh ``spec_for_mesh`` silently *drops* unknown axes, so the typo
+can ship. The mesh axis vocabulary is declared centrally
+(``parallel/mesh.py:MESH_AXES`` plus any explicit ``Mesh(devs,
+("dp",...))`` constructions); every axis-name literal used in a
+sharding/collective position must resolve against it.
+
+Follows the wiring-checker (TPU004) finalize pattern: :meth:`check`
+collects declarations and usages per module, :meth:`finalize`
+cross-references once every module has been seen. Usage positions
+collected (string literals only — names/variables are runtime-checked
+by the mesh rules table and stay out of scope):
+
+- ``PartitionSpec(...)`` / ``P(...)`` entries (names or tuples of
+  names);
+- the axis argument of the named collectives (``lax.psum``,
+  ``ppermute``, ``all_gather``, ``all_to_all``, ``psum_scatter``,
+  ``pmean``/``pmax``/``pmin``, ``axis_index``) — second positional or
+  ``axis_name=``;
+- ``shard_map(..., axis_names={...})`` manual-axis sets;
+- string/tuple defaults of parameters literally named ``axis``,
+  ``axis_name``, ``seq_axis``, or ``batch_axis`` (the wrapper-API
+  convention in ``ops/``).
+
+If the walk saw no declaration at all (scoped run), the rule stays
+silent — same partial-run guard as TPU004.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterable, List, Set, Tuple
+
+from kubeflow_tpu.analysis import astutil
+from kubeflow_tpu.analysis.findings import Finding
+from kubeflow_tpu.analysis.registry import Checker, register_checker
+from kubeflow_tpu.analysis.walker import ModuleInfo
+
+SPEC_CALLS = {"PartitionSpec", "P"}
+COLLECTIVE_CALLS = {"psum", "pmean", "pmax", "pmin", "ppermute",
+                    "all_gather", "all_to_all", "psum_scatter",
+                    "axis_index", "axis_size", "pbroadcast", "pvary"}
+AXIS_PARAM_NAMES = {"axis", "axis_name", "seq_axis", "batch_axis"}
+# calls whose axis is the FIRST positional arg (no array operand):
+# axis_index(axis_name) / axis_size(axis_name); everything else takes
+# (operand, axis_name, ...)
+AXIS_FIRST_CALLS = {"axis_index", "axis_size"}
+DECL_TUPLE_NAME = "MESH_AXES"
+
+
+@dataclasses.dataclass
+class _AxisUse:
+    axis: str
+    context: str                 # "PartitionSpec(...)", "lax.psum", ...
+    rel: str
+    lineno: int
+    span: Tuple[int, int]
+
+
+def _str_elements(node: ast.AST) -> List[str]:
+    """String constants in a (possibly nested) literal: "a",
+    ("a", "b"), {"a"}, ["a"]. Non-literal elements are skipped."""
+    s = astutil.const_str(node)
+    if s is not None:
+        return [s]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out: List[str] = []
+        for el in node.elts:
+            out.extend(_str_elements(el))
+        return out
+    return []
+
+
+def _is_all_str_tuple(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Tuple) and node.elts
+            and all(astutil.const_str(e) is not None for e in node.elts))
+
+
+@register_checker
+class MeshAxesChecker(Checker):
+    rule = "TPU007"
+    name = "mesh-axis-consistency"
+    severity = "error"
+
+    def __init__(self) -> None:
+        self.declared: Set[str] = set()
+        self.decl_sites: List[str] = []
+        self.uses: List[_AxisUse] = []
+
+    # -- collection --------------------------------------------------------
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        self._collect_declarations(module)
+        self._collect_uses(module)
+        return ()
+
+    def _collect_declarations(self, module: ModuleInfo) -> None:
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) \
+                            and tgt.id == DECL_TUPLE_NAME \
+                            and _is_all_str_tuple(node.value):
+                        self._declare(node.value, module)
+            elif isinstance(node, ast.Call):
+                name = (astutil.call_name(node) or "").split(".")[-1]
+                if name == "Mesh" and len(node.args) >= 2 \
+                        and _is_all_str_tuple(node.args[1]):
+                    self._declare(node.args[1], module)
+
+    def _declare(self, tup: ast.AST, module: ModuleInfo) -> None:
+        self.declared.update(_str_elements(tup))
+        if module.rel not in self.decl_sites:
+            self.decl_sites.append(module.rel)
+
+    def _collect_uses(self, module: ModuleInfo) -> None:
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                self._collect_call(node, module)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._collect_defaults(node, module)
+
+    def _use(self, axis: str, context: str, node: ast.AST,
+             module: ModuleInfo) -> None:
+        self.uses.append(_AxisUse(
+            axis=axis, context=context, rel=module.rel,
+            lineno=node.lineno, span=module.node_span(node)))
+
+    def _collect_call(self, node: ast.Call, module: ModuleInfo) -> None:
+        dotted = astutil.call_name(node) or ""
+        name = dotted.split(".")[-1]
+        if name in SPEC_CALLS:
+            for arg in node.args:
+                for axis in _str_elements(arg):
+                    self._use(axis, f"{name}(...)", node, module)
+            return
+        if name in COLLECTIVE_CALLS:
+            pos = 0 if name in AXIS_FIRST_CALLS else 1
+            if len(node.args) > pos:
+                for axis in _str_elements(node.args[pos]):
+                    self._use(axis, dotted, node, module)
+            for kw in node.keywords:
+                if kw.arg == "axis_name":
+                    for axis in _str_elements(kw.value):
+                        self._use(axis, dotted, node, module)
+            return
+        if name == "shard_map":
+            for kw in node.keywords:
+                if kw.arg == "axis_names":
+                    for axis in _str_elements(kw.value):
+                        self._use(axis, "shard_map(axis_names=...)",
+                                  node, module)
+
+    def _collect_defaults(self, fn, module: ModuleInfo) -> None:
+        args = fn.args
+        pos = args.posonlyargs + args.args
+        for arg, default in zip(pos[len(pos) - len(args.defaults):],
+                                args.defaults):
+            if arg.arg in AXIS_PARAM_NAMES:
+                for axis in _str_elements(default):
+                    self._use(axis, f"default of {arg.arg}=",
+                              default, module)
+        for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+            if default is not None and arg.arg in AXIS_PARAM_NAMES:
+                for axis in _str_elements(default):
+                    self._use(axis, f"default of {arg.arg}=",
+                              default, module)
+
+    # -- cross-reference ---------------------------------------------------
+
+    def finalize(self) -> Iterable[Finding]:
+        if not self.declared:
+            return  # scoped run never saw a declaration: stay silent
+        known = ", ".join(sorted(self.declared))
+        where = ", ".join(self.decl_sites)
+        for use in self.uses:
+            if use.axis in self.declared:
+                continue
+            yield Finding(
+                rule=self.rule, severity=self.severity, path=use.rel,
+                line=use.lineno, span=use.span,
+                message=f"axis name {use.axis!r} in {use.context} "
+                        f"matches no declared mesh axis ({known})",
+                hint=f"mesh axes are declared in {where}; on a reduced "
+                     "mesh spec_for_mesh silently drops unknown axes, "
+                     "so this typo only fails at TPU scale")

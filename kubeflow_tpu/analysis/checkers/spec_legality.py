@@ -1,0 +1,123 @@
+"""TPU008 — PartitionSpec legality.
+
+Two statically-decidable ways to write an illegal ``PartitionSpec``:
+
+- **duplicate axis**: one mesh axis name appearing in two entries (or
+  twice inside one tuple entry) — ``P("tp", "tp")`` or
+  ``P(("dp", "dp"), None)``. jax rejects this at trace time, but only
+  on the path that actually builds the sharding, which on a CPU test
+  mesh may never run.
+- **rank overflow** (where inferable): a spec with more entries than
+  the array it constrains has dimensions. Sharding is positional, so
+  the spec's rank must be <= the array's rank. Inference is
+  deliberately conservative (false negatives over false positives):
+  only flagged when the constrained value resolves — directly or
+  through a single same-scope assignment — to a literal-shaped
+  ``jnp.zeros/ones/full/empty`` and the spec is a literal
+  ``P(...)``/``PartitionSpec(...)`` call in the same
+  ``with_sharding_constraint``/``shard_constraint``-style call.
+
+Per-module rule (no finalize): a spec is illegal by its own shape, not
+by cross-file facts.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from kubeflow_tpu.analysis import astutil
+from kubeflow_tpu.analysis.findings import Finding
+from kubeflow_tpu.analysis.registry import Checker, register_checker
+from kubeflow_tpu.analysis.walker import ModuleInfo
+
+SPEC_CALLS = {"PartitionSpec", "P"}
+SHAPED_CTORS = {"zeros", "ones", "full", "empty"}
+CONSTRAINT_CALLS = {"with_sharding_constraint"}
+
+
+def _spec_entry_axes(arg: ast.AST) -> List[str]:
+    """Axis names of one spec entry: "a" -> [a]; ("a","b") -> [a,b]."""
+    s = astutil.const_str(arg)
+    if s is not None:
+        return [s]
+    if isinstance(arg, ast.Tuple):
+        return [s for e in arg.elts
+                if (s := astutil.const_str(e)) is not None]
+    return []
+
+
+def _literal_shape_rank(node: ast.AST) -> Optional[int]:
+    """Rank of a ``jnp.zeros((2, 3))``-style call with a literal
+    tuple/list shape (scalar int shape = rank 1); None if unprovable."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = (astutil.call_name(node) or "").split(".")[-1]
+    if name not in SHAPED_CTORS:
+        return None
+    if not node.args:
+        return None
+    shape = node.args[0]
+    if isinstance(shape, (ast.Tuple, ast.List)):
+        return len(shape.elts)
+    if astutil.const_int(shape) is not None:
+        return 1
+    return None
+
+
+@register_checker
+class SpecLegalityChecker(Checker):
+    rule = "TPU008"
+    name = "partitionspec-legality"
+    severity = "error"
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = (astutil.call_name(node) or "").split(".")[-1]
+            if name in SPEC_CALLS:
+                yield from self._check_duplicates(module, node)
+            if name in CONSTRAINT_CALLS:
+                yield from self._check_rank(module, node)
+
+    def _check_duplicates(self, module: ModuleInfo, node: ast.Call):
+        seen = {}
+        for arg in node.args:
+            for axis in _spec_entry_axes(arg):
+                if axis in seen:
+                    yield self.finding(
+                        module, node,
+                        f"axis {axis!r} appears twice in one "
+                        "PartitionSpec — an array dim cannot shard "
+                        "over the same mesh axis twice",
+                        hint="drop one occurrence, or shard the second "
+                             "dim over a different axis")
+                    return  # one finding per spec call is enough
+                seen[axis] = True
+
+    def _check_rank(self, module: ModuleInfo, node: ast.Call):
+        if len(node.args) < 2:
+            return
+        value, spec = node.args[0], node.args[1]
+        if not (isinstance(spec, ast.Call)
+                and (astutil.call_name(spec) or "").split(".")[-1]
+                in SPEC_CALLS):
+            return
+        rank = _literal_shape_rank(value)
+        if rank is None and isinstance(value, ast.Name):
+            scope = module.enclosing_function(node) or module.tree
+            ranks = [_literal_shape_rank(a)
+                     for a in astutil.assignments_to(scope, value.id)]
+            known = [r for r in ranks if r is not None]
+            if len(ranks) == 1 and len(known) == 1:
+                rank = known[0]
+        if rank is not None and len(spec.args) > rank:
+            yield self.finding(
+                module, node,
+                f"PartitionSpec has {len(spec.args)} entries but the "
+                f"constrained array has rank {rank} — sharding is "
+                "positional, so the spec cannot be longer than the "
+                "shape",
+                hint="trim the spec (trailing None entries are "
+                     "implicit) or fix the array shape")

@@ -1,0 +1,176 @@
+"""TPU009 — collective over an axis no enclosing region binds.
+
+``lax.psum(x, "tp")`` is only legal while ``"tp"`` is bound as a named
+axis — inside a ``shard_map``/``pmap`` region over it. Called outside
+one, it raises ``NameError: unbound axis name`` at trace time, which on
+the serving path means the first real request, not the test suite.
+
+The rule resolves *literal* axis names only (variables flow through
+wrapper APIs whose values are runtime-checked; chasing them would
+guess). A literal axis ``a`` used in a collective inside function ``f``
+counts as bound when any function on the lexical chain around the call
+(``f`` or an enclosing def) either
+
+- is shard-wrapped in the same module — its name (or, for an inline
+  lambda body, the lambda itself) appears as the mapped function of a
+  ``shard_map(...)`` call (directly or through ``functools.partial``)
+  whose ``axis_names={...}`` contains ``a``, or which passes no
+  ``axis_names`` at all (full-manual: every mesh axis is bound); or
+- is pmap/vmap/xmap-wrapped with ``axis_name="a"`` /
+  ``axis_name=<non-literal>`` (a non-literal binder may bind anything:
+  stay silent rather than guess).
+
+Cross-module callers are invisible to a single-module AST, so exported
+helpers meant to run inside someone else's region (the
+``ops/attention.py`` cores take ``axis_name`` as a *parameter*, the
+convention that sidesteps this rule entirely) should take the axis as
+an argument rather than hard-coding it; intentional hard-coded cases
+carry a pragma.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Set
+
+from kubeflow_tpu.analysis import astutil
+from kubeflow_tpu.analysis.findings import Finding
+from kubeflow_tpu.analysis.registry import Checker, register_checker
+from kubeflow_tpu.analysis.walker import ModuleInfo
+
+COLLECTIVE_CALLS = {"psum", "pmean", "pmax", "pmin", "ppermute",
+                    "all_gather", "all_to_all", "psum_scatter",
+                    "axis_index", "axis_size"}
+# axis_index/axis_size take the axis FIRST (no array operand)
+AXIS_FIRST_CALLS = {"axis_index", "axis_size"}
+BINDER_CALLS = {"shard_map", "pmap", "xmap", "vmap"}
+
+ALL_AXES = "*"
+
+
+@dataclasses.dataclass
+class _Binding:
+    axes: Set[str]            # bound axis literals; ALL_AXES = everything
+    unknown: bool = False     # non-literal binder: could bind anything
+
+
+def _mapped_fn(call: ast.Call):
+    """What a binder call wraps: the function *name* for
+    ``shard_map(core, ...)`` / ``shard_map(functools.partial(core,
+    ...), ...)``, or the ``ast.Lambda`` node itself for an inline
+    ``shard_map(lambda v: ..., ...)`` body."""
+    if not call.args:
+        return None
+    arg = call.args[0]
+    if isinstance(arg, ast.Name):
+        return arg.id
+    if isinstance(arg, ast.Lambda):
+        return arg
+    if isinstance(arg, ast.Call):
+        inner = astutil.call_name(arg) or ""
+        if inner.split(".")[-1] == "partial" and arg.args:
+            if isinstance(arg.args[0], ast.Name):
+                return arg.args[0].id
+            if isinstance(arg.args[0], ast.Lambda):
+                return arg.args[0]
+            name = astutil.dotted_name(arg.args[0])
+            if name:
+                return name.split(".")[-1]
+    return None
+
+
+def _binder_axes(call: ast.Call, binder: str) -> _Binding:
+    if binder == "shard_map":
+        for kw in call.keywords:
+            if kw.arg == "axis_names":
+                if isinstance(kw.value, (ast.Set, ast.Tuple, ast.List)):
+                    axes = {astutil.const_str(e) for e in kw.value.elts}
+                    if None in axes:
+                        return _Binding(set(), unknown=True)
+                    return _Binding({a for a in axes if a})
+                return _Binding(set(), unknown=True)
+        return _Binding({ALL_AXES})  # full-manual: all mesh axes bound
+    for kw in call.keywords:   # pmap / vmap / xmap
+        if kw.arg == "axis_name":
+            s = astutil.const_str(kw.value)
+            if s is None:
+                return _Binding(set(), unknown=True)
+            return _Binding({s})
+    return _Binding(set())
+
+
+@register_checker
+class UnboundCollectiveChecker(Checker):
+    rule = "TPU009"
+    name = "unbound-collective"
+    severity = "error"
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        bindings: Dict[str, List[_Binding]] = {}       # by function name
+        lambda_bindings: Dict[int, _Binding] = {}      # by Lambda node id
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            binder = (astutil.call_name(node) or "").split(".")[-1]
+            if binder in BINDER_CALLS:
+                target = _mapped_fn(node)
+                if isinstance(target, ast.Lambda):
+                    lambda_bindings[id(target)] = _binder_axes(node, binder)
+                elif target:
+                    bindings.setdefault(target, []).append(
+                        _binder_axes(node, binder))
+
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = astutil.call_name(node) or ""
+            short = dotted.split(".")[-1]
+            if short not in COLLECTIVE_CALLS:
+                continue
+            axis = self._literal_axis(node, short)
+            if axis is None:
+                continue
+            if self._is_bound(module, node, axis, bindings,
+                              lambda_bindings):
+                continue
+            yield self.finding(
+                module, node,
+                f"{dotted}(..., {axis!r}) but no enclosing shard_map/"
+                f"pmap region binds axis {axis!r} — this raises "
+                "'unbound axis name' at trace time",
+                hint="wrap the caller in shard_map over the axis, or "
+                     "take the axis name as a parameter like the "
+                     "ops/attention.py cores do")
+
+    def _literal_axis(self, node: ast.Call,
+                      short_name: str) -> Optional[str]:
+        pos = 0 if short_name in AXIS_FIRST_CALLS else 1
+        if len(node.args) > pos:
+            s = astutil.const_str(node.args[pos])
+            if s is not None:
+                return s
+        for kw in node.keywords:
+            if kw.arg == "axis_name":
+                return astutil.const_str(kw.value)
+        return None
+
+    def _is_bound(self, module: ModuleInfo, node: ast.AST, axis: str,
+                  bindings: Dict[str, List[_Binding]],
+                  lambda_bindings: Dict[int, _Binding]) -> bool:
+        def matches(b: _Binding) -> bool:
+            return b.unknown or ALL_AXES in b.axes or axis in b.axes
+
+        # walk the full lexical chain (named defs AND inline lambdas
+        # handed straight to a binder call)
+        cur = module.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(matches(b) for b in bindings.get(cur.name, ())):
+                    return True
+            elif isinstance(cur, ast.Lambda):
+                b = lambda_bindings.get(id(cur))
+                if b is not None and matches(b):
+                    return True
+            cur = module.parents.get(cur)
+        return False

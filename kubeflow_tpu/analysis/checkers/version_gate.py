@@ -1,0 +1,123 @@
+"""TPU006 — version-gated jax APIs outside ``compat/``.
+
+The exact bug class the TPU rebuild warns about: code that only fails
+on the real runtime. The platform targets the current jax surface, but
+the pinned container jax (0.4.37) predates part of it — 4 direct
+``jax.shard_map`` call sites sailed through every CPU-side check and
+killed 22 tier-1 tests with an AttributeError at run time. The repo
+policy (docs/COMPAT.md) is that ``kubeflow_tpu/compat/`` is the single
+sanctioned call site for version-sensitive jax APIs; this rule makes
+the policy mechanical.
+
+Table-driven: :data:`GATED_APIS` maps a dotted jax name to the version
+window where it exists and the compat shim to call instead. Flagged,
+anywhere outside ``compat/``:
+
+- attribute chains (``jax.shard_map(...)``, a bare
+  ``jax.sharding.get_abstract_mesh`` reference);
+- ``from jax import shard_map`` / ``from jax.sharding import use_mesh``
+  style imports of a gated name;
+- any import touching ``jax.experimental.shard_map`` — present on the
+  pinned jax but *removed* on current jax, so it is just as
+  version-gated in the other direction.
+
+``hasattr(jax, "shard_map")`` / ``getattr(..., None)`` probes pass the
+name as a string and are deliberately not flagged — that is how the
+compat shims themselves resolve the surface, and a probe cannot crash.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Tuple
+
+from kubeflow_tpu.analysis import astutil
+from kubeflow_tpu.analysis.findings import Finding
+from kubeflow_tpu.analysis.registry import Checker, register_checker
+from kubeflow_tpu.analysis.walker import ModuleInfo
+
+SANCTIONED_DIR = "kubeflow_tpu/compat/"
+
+# dotted api -> (availability window, sanctioned replacement)
+GATED_APIS: Dict[str, Tuple[str, str]] = {
+    "jax.shard_map":
+        ("jax>=0.6 (absent from the pinned 0.4.37)",
+         "kubeflow_tpu.compat.shard_map"),
+    "jax.experimental.shard_map.shard_map":
+        ("jax<0.8 only (removed upstream)",
+         "kubeflow_tpu.compat.shard_map"),
+    "jax.sharding.get_abstract_mesh":
+        ("jax>=0.5", "kubeflow_tpu.compat.current_mesh"),
+    "jax.sharding.use_mesh":
+        ("jax>=0.8 window of the use_mesh/set_mesh rename",
+         "kubeflow_tpu.compat.mesh_context"),
+    "jax.sharding.set_mesh":
+        ("jax>=0.9 side of the use_mesh/set_mesh rename",
+         "kubeflow_tpu.compat.mesh_context"),
+    "jax.lax.pvary":
+        ("jax>=0.6", "kubeflow_tpu.compat.pvary"),
+    "jax.lax.pcast":
+        ("jax>=0.7", "kubeflow_tpu.compat.pvary"),
+    "jax.lax.axis_size":
+        ("jax>=0.5", "kubeflow_tpu.compat.axis_size"),
+}
+
+# gated import roots: importing the module at all is version-sensitive
+GATED_MODULES: Dict[str, Tuple[str, str]] = {
+    "jax.experimental.shard_map":
+        ("jax<0.8 only (removed upstream)",
+         "kubeflow_tpu.compat.shard_map"),
+}
+
+
+@register_checker
+class VersionGateChecker(Checker):
+    rule = "TPU006"
+    name = "version-gated-api"
+    severity = "error"
+
+    def _emit(self, module: ModuleInfo, node: ast.AST, api: str,
+              window: str, use: str) -> Finding:
+        return self.finding(
+            module, node,
+            f"{api} is version-gated ({window}); only compat/ may "
+            "touch version-sensitive jax APIs",
+            hint=f"call {use} instead — the shim spans the versions "
+                 "this direct use does not")
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        # exact path-component prefix, not a substring: a sibling
+        # "netcompat/" or a nested "*/compat/" must NOT be exempt
+        if module.rel.startswith(SANCTIONED_DIR):
+            return
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Attribute):
+                name = astutil.dotted_name(node)
+                if name in GATED_APIS:
+                    window, use = GATED_APIS[name]
+                    yield self._emit(module, node, name, window, use)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                mod = node.module
+                if node.level:  # relative import: not a jax module
+                    continue
+                if mod in GATED_MODULES:
+                    window, use = GATED_MODULES[mod]
+                    yield self._emit(module, node, mod, window, use)
+                    continue
+                for alias in node.names:
+                    full = f"{mod}.{alias.name}"
+                    if full in GATED_APIS:
+                        window, use = GATED_APIS[full]
+                        yield self._emit(module, node, full, window, use)
+                    elif full in GATED_MODULES:
+                        # `from jax.experimental import shard_map`: the
+                        # gated module pulled in via its parent package
+                        window, use = GATED_MODULES[full]
+                        yield self._emit(module, node, full, window, use)
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    for root, (window, use) in GATED_MODULES.items():
+                        if alias.name == root \
+                                or alias.name.startswith(root + "."):
+                            yield self._emit(module, node, alias.name,
+                                             window, use)

@@ -1,0 +1,40 @@
+"""jax version-compat shims — the ONLY sanctioned call site for
+version-gated jax APIs.
+
+The platform targets the current jax surface (``jax.shard_map``,
+``jax.sharding.get_abstract_mesh``, ``jax.lax.pvary`` /
+``jax.lax.axis_size``) while the pinned runtime may ship an older jax
+(the container pins 0.4.37, where ``shard_map`` still lives at
+``jax.experimental.shard_map.shard_map`` with a different signature).
+Code that touches such an API directly only fails on the real runtime —
+exactly the bug class the TPU rebuild warns about, and exactly what bit
+this repo: 4 direct ``jax.shard_map`` call sites killed 22 tier-1 tests
+with an AttributeError the CPU-side type checkers never saw.
+
+Policy (enforced by tpulint rule **TPU006**, see ``docs/COMPAT.md``):
+version-sensitive jax APIs are imported/attributed ONLY inside this
+package; everything else calls the shims re-exported here. Each shim
+resolves the new API lazily (so tests can monkeypatch the new surface
+onto an old jax) and falls back to the semantically-validated old-jax
+translation.
+"""
+
+from kubeflow_tpu.compat.jaxshim import (  # noqa: F401
+    axis_size,
+    bound_axes,
+    current_mesh,
+    has_new_shard_map,
+    mesh_context,
+    pvary,
+    shard_map,
+)
+
+__all__ = [
+    "axis_size",
+    "bound_axes",
+    "current_mesh",
+    "has_new_shard_map",
+    "mesh_context",
+    "pvary",
+    "shard_map",
+]

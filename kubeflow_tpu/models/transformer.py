@@ -293,7 +293,9 @@ class Attention(nn.Module):
             return att.flash_attention(q, k, v, c.causal, block, block)
         # ring / ulysses: sequence-parallel over the seq mesh axis;
         # partial-manual shard_map (batch/other axes stay auto)
-        mesh = jax.sharding.get_abstract_mesh()
+        from kubeflow_tpu import compat
+
+        mesh = compat.current_mesh()
         if mesh.empty or c.seq_axis not in mesh.axis_names:
             k, v = att.gqa_repeat(q, k, v)  # ulysses deferred the repeat
             return att.blockwise_attention(
@@ -311,7 +313,7 @@ class Attention(nn.Module):
             core = functools.partial(
                 att.ring_attention, axis_name=c.seq_axis, causal=c.causal)
         spec = P(None, c.seq_axis, None, None)
-        fn = jax.shard_map(
+        fn = compat.shard_map(
             core,
             mesh=mesh,
             in_specs=(spec, spec, spec),

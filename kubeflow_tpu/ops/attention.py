@@ -32,6 +32,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from kubeflow_tpu import compat
+
 NEG_INF = -1e30
 
 
@@ -537,7 +539,7 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = True,
     Gradients flow through ``lax.scan`` + ``ppermute`` + ``cond`` (all
     differentiable), so the same code path trains.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, Sq, H, D = q.shape
     scale = _scale(q, sm_scale)
@@ -593,7 +595,7 @@ def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = True,
     only the distinct KV heads. Requires ``H % n == 0`` and
     ``KH % n == 0``.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     H, KH = q.shape[2], k.shape[2]
     if H % n or KH % n:
         raise ValueError(
@@ -628,8 +630,8 @@ def _sharded_seq_attention(core, q, k, v, mesh, seq_axis, batch_axis):
         axes = tuple(a for a in axes if a in mesh.axis_names)
         batch_axis = (axes[0] if len(axes) == 1 else axes) if axes else None
     spec = P(batch_axis, seq_axis, None, None)
-    fn = jax.shard_map(core, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec)
+    fn = compat.shard_map(core, mesh=mesh, in_specs=(spec, spec, spec),
+                          out_specs=spec)
     return fn(q, k, v)
 
 

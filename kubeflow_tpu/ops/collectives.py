@@ -23,6 +23,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from kubeflow_tpu import compat
+
 
 @functools.lru_cache(maxsize=None)
 def _mapped(op_key: str, mesh: Mesh, axis: str, shift: int = 0):
@@ -56,7 +58,7 @@ def _mapped(op_key: str, mesh: Mesh, axis: str, shift: int = 0):
     else:
         raise ValueError(f"unknown collective {op_key!r}")
     return jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             op, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec,
             check_vma=False,
         )

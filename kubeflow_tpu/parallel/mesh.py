@@ -39,6 +39,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from kubeflow_tpu import compat
+
 MESH_AXES = ("dcn", "dp", "pp", "tp")
 
 # logical axis -> mesh axis (or None = replicated). Order matters only for
@@ -177,6 +179,27 @@ def data_parallel_size(mesh: Mesh) -> int:
     return sizes.get("dcn", 1) * sizes.get("dp", 1)
 
 
+def _filter_spec(spec: PartitionSpec, keep) -> PartitionSpec:
+    """Rebuild ``spec`` keeping only axis names where ``keep(name)``,
+    collapsing emptied entries to None and trimming trailing Nones."""
+    out = []
+    for entry in spec:
+        if entry is None or entry is PartitionSpec.UNCONSTRAINED:
+            out.append(entry)
+            continue
+        axes = (entry,) if isinstance(entry, str) else tuple(entry)
+        axes = tuple(a for a in axes if keep(a))
+        if not axes:
+            out.append(None)
+        elif len(axes) == 1:
+            out.append(axes[0])
+        else:
+            out.append(axes)
+    while out and out[-1] is None:
+        out.pop()
+    return PartitionSpec(*out)
+
+
 def spec_for_mesh(spec: PartitionSpec, mesh) -> PartitionSpec:
     """Drop axis names ``mesh`` does not have.
 
@@ -187,22 +210,7 @@ def spec_for_mesh(spec: PartitionSpec, mesh) -> PartitionSpec:
     is exact: an axis the mesh lacks has size 1, and sharding over a
     size-1 axis is replication."""
     names = set(mesh.axis_names)
-    out = []
-    for entry in spec:
-        if entry is None or entry is PartitionSpec.UNCONSTRAINED:
-            out.append(entry)
-            continue
-        axes = (entry,) if isinstance(entry, str) else tuple(entry)
-        axes = tuple(a for a in axes if a in names)
-        if not axes:
-            out.append(None)
-        elif len(axes) == 1:
-            out.append(axes[0])
-        else:
-            out.append(axes)
-    while out and out[-1] is None:
-        out.pop()
-    return PartitionSpec(*out)
+    return _filter_spec(spec, names.__contains__)
 
 
 def named_sharding(
@@ -219,39 +227,31 @@ def shard_constraint(x, logical_axes, rules: AxisRules = DEFAULT_RULES):
     No-op only when no mesh is current (plain eager/test use); inside a mesh
     a malformed spec raises rather than silently dropping the constraint.
     Axis names the current mesh lacks are dropped (see
-    :func:`spec_for_mesh`).
+    :func:`spec_for_mesh`), as are axes that are *manual* at the current
+    trace point: inside a shard_map region a manual axis is already a
+    per-device dim, so a constraint over it is meaningless — and on
+    jax<0.5 it aborts the XLA partitioner outright. A fully-manual
+    region (every mesh axis bound, the legacy-shard_map shape) skips
+    the constraint entirely.
     """
     spec = logical_to_mesh_axes(logical_axes, rules)
-    try:
-        mesh = jax.sharding.get_abstract_mesh()
-        no_mesh = mesh.empty
-    except AttributeError:
-        # jax<0.5 has no get_abstract_mesh; the ambient mesh entered
-        # via ``with mesh:`` lives in the thread resources. Without
-        # this fallback every eager/no-mesh call crashed in
-        # with_sharding_constraint instead of no-opping.
-        try:
-            from jax.interpreters import pxla
-
-            mesh = pxla.thread_resources.env.physical_mesh
-            no_mesh = mesh.empty
-        except (AttributeError, ImportError):
-            mesh, no_mesh = None, False
-    if no_mesh:
+    mesh = compat.current_mesh()
+    if getattr(mesh, "empty", True):
         return x
-    if mesh is not None:
-        spec = spec_for_mesh(spec, mesh)
+    spec = spec_for_mesh(spec, mesh)
+    manual = compat.bound_axes(mesh.axis_names)
+    if manual:
+        if manual >= set(mesh.axis_names):
+            return x
+        spec = _filter_spec(spec, lambda a: a not in manual)
     return jax.lax.with_sharding_constraint(x, spec)
 
 
 def mesh_context(mesh: Mesh):
     """Context manager making ``mesh`` current for bare-PartitionSpec
-    sharding constraints; spans the jax 0.8/0.9 use_mesh→set_mesh rename."""
-    if hasattr(jax.sharding, "use_mesh"):
-        return jax.sharding.use_mesh(mesh)
-    if hasattr(jax.sharding, "set_mesh"):
-        return jax.sharding.set_mesh(mesh)
-    return mesh
+    sharding constraints; spans the jax 0.8/0.9 use_mesh→set_mesh rename
+    and the jax<0.5 ``with mesh:`` form (see ``kubeflow_tpu/compat``)."""
+    return compat.mesh_context(mesh)
 
 
 def shape_aware_spec(

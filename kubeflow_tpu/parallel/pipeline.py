@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from kubeflow_tpu import compat
+
 # stage_fn(stage_params, x) -> y; applies one stage's layers to a microbatch
 StageFn = Callable[[Any, jnp.ndarray], jnp.ndarray]
 
@@ -85,7 +87,7 @@ def pipeline_apply(
         # pvary: carries become rank-dependent after the first tick, so their
         # init must already be typed varying-over-pp for the scan carry
         def _vary(x):
-            return jax.lax.pcast(x, (axis,), to="varying")
+            return compat.pvary(x, (axis,))
 
         state = _vary(jnp.zeros(mb_local.shape[1:], mb_local.dtype))
         out = _vary(jnp.zeros_like(mb_local))
@@ -115,7 +117,7 @@ def pipeline_apply(
         mask = (rank == n_stages - 1).astype(out.dtype)
         return jax.lax.psum(out * mask, axis)
 
-    fn = jax.shard_map(
+    fn = compat.shard_map(
         per_device,
         mesh=mesh,
         in_specs=(P(axis), P()),

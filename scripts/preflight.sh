@@ -5,8 +5,11 @@
 #   scripts/preflight.sh --ref HEAD~1   # blob check over a commit range
 #
 # Checks:
-#   1. tpulint (scripts/run_tpulint.py): AST rules TPU001-TPU005 over
-#      kubeflow_tpu/, gated on tpulint_baseline.json (docs/ANALYSIS.md)
+#   1. tpulint (scripts/run_tpulint.py): AST rules TPU001-TPU009 over
+#      kubeflow_tpu/ — incl. the SPMD plane TPU006 version-gated-api,
+#      TPU007 mesh-axis-consistency, TPU008 partitionspec-legality,
+#      TPU009 unbound-collective — gated on tpulint_baseline.json
+#      (docs/ANALYSIS.md; --format sarif for CI PR annotations)
 #   2. binary-blob guard (scripts/check_binary_blobs.py): no large
 #      binaries staged for commit (PERF.md trace-artifact policy)
 #   3. obs smoke test (tests/test_obs.py): traceparent round-trip, span

@@ -9,6 +9,7 @@ Usage:
     python scripts/run_tpulint.py --baseline-update     # re-grandfather
     python scripts/run_tpulint.py --show-baselined      # full debt view
     python scripts/run_tpulint.py --format json         # machine output
+    python scripts/run_tpulint.py --format sarif        # CI PR annotations
 
 Pre-existing findings live in ``tpulint_baseline.json`` (committed);
 only findings beyond the baseline fail the run. After fixing debt, run
@@ -27,6 +28,53 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from kubeflow_tpu.analysis import runner  # noqa: E402
+from kubeflow_tpu.analysis.registry import all_checkers  # noqa: E402
+
+
+def sarif_payload(report) -> dict:
+    """SARIF 2.1.0 for the *new* (gating) findings — the shape CI
+    uploaders expect for inline PR-line annotations. Baselined debt is
+    deliberately absent: annotating grandfathered lines on every PR
+    would train reviewers to ignore the bot."""
+    rules = [
+        {"id": rule_id,
+         "name": cls.name,
+         "shortDescription": {"text": cls.name},
+         "defaultConfiguration": {"level": cls.severity}}
+        for rule_id, cls in sorted(all_checkers().items())
+    ]
+    results = []
+    for f in report.new:
+        text = f.message if not f.hint else f"{f.message} (hint: {f.hint})"
+        results.append({
+            "ruleId": f.rule,
+            "level": f.severity,
+            "message": {"text": text},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": f.line},
+                },
+            }],
+        })
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "tpulint",
+                "informationUri": "docs/ANALYSIS.md",
+                "rules": rules,
+            }},
+            # SRCROOT is deliberately left undefined (no
+            # originalUriBaseIds): per SARIF §3.14.14 the consumer —
+            # the CI uploader, which knows the checkout root — resolves
+            # it; baking in a wrong absolute root would break PR-line
+            # annotation placement on every machine but this one
+            "results": results,
+        }],
+    }
 
 
 def main(argv=None) -> int:
@@ -45,7 +93,8 @@ def main(argv=None) -> int:
                          "and exit 0")
     ap.add_argument("--show-baselined", action="store_true",
                     help="print grandfathered findings too")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text")
     args = ap.parse_args(argv)
 
     rules = ([r.strip().upper() for r in args.rules.split(",") if r.strip()]
@@ -67,7 +116,9 @@ def main(argv=None) -> int:
               f"{len(report.findings)} finding(s) → {path}")
         return 0
 
-    if args.format == "json":
+    if args.format == "sarif":
+        print(json.dumps(sarif_payload(report), indent=1))
+    elif args.format == "json":
         print(json.dumps({
             "files": report.files,
             "suppressed": report.suppressed,

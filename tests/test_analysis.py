@@ -13,11 +13,17 @@ from kubeflow_tpu.analysis import runner
 from kubeflow_tpu.analysis.checkers.host_call_in_jit import (
     HostCallInJitChecker,
 )
+from kubeflow_tpu.analysis.checkers.mesh_axes import MeshAxesChecker
 from kubeflow_tpu.analysis.checkers.raw_clock import RawClockChecker
+from kubeflow_tpu.analysis.checkers.spec_legality import SpecLegalityChecker
 from kubeflow_tpu.analysis.checkers.tile_legality import TileLegalityChecker
+from kubeflow_tpu.analysis.checkers.unbound_collective import (
+    UnboundCollectiveChecker,
+)
 from kubeflow_tpu.analysis.checkers.unbounded_retry import (
     UnboundedRetryChecker,
 )
+from kubeflow_tpu.analysis.checkers.version_gate import VersionGateChecker
 from kubeflow_tpu.analysis.checkers.wiring import WiringChecker
 from kubeflow_tpu.analysis.registry import all_checkers, create_checkers
 from kubeflow_tpu.analysis.runner import lint_modules, run_lint
@@ -40,9 +46,10 @@ def check(checker, *modules):
 
 # -- registry / framework ---------------------------------------------------
 
-def test_registry_has_all_five_rules():
+def test_registry_has_all_nine_rules():
     assert set(all_checkers()) == {
-        "TPU001", "TPU002", "TPU003", "TPU004", "TPU005"}
+        "TPU001", "TPU002", "TPU003", "TPU004", "TPU005",
+        "TPU006", "TPU007", "TPU008", "TPU009"}
 
 
 def test_create_checkers_rejects_unknown_rule():
@@ -439,6 +446,412 @@ def test_tpu005_pragma_inside_span_suppresses():
     assert findings == [] and suppressed == 1
 
 
+# -- TPU006 version-gated api -----------------------------------------------
+
+def test_tpu006_direct_jax_shard_map():
+    m = mod("""
+        import jax
+        def wrap(core, mesh, spec):
+            return jax.shard_map(core, mesh=mesh, in_specs=(spec,),
+                                 out_specs=spec)
+    """)
+    f = check(VersionGateChecker(), m)
+    assert len(f) == 1 and f[0].rule == "TPU006"
+    assert "jax.shard_map" in f[0].message
+    assert "compat" in f[0].hint
+
+
+def test_tpu006_from_imports_and_experimental_module():
+    m = mod("""
+        from jax import shard_map
+        from jax.sharding import get_abstract_mesh
+        from jax.experimental.shard_map import shard_map as legacy
+        from jax.experimental import shard_map as sm2
+        import jax.experimental.shard_map as sm
+    """)
+    f = check(VersionGateChecker(), m)
+    assert len(f) == 5 and all(x.rule == "TPU006" for x in f)
+
+
+def test_tpu006_other_gated_apis():
+    m = mod("""
+        import jax
+        def f(x, mesh):
+            n = jax.lax.axis_size("tp")
+            x = jax.lax.pvary(x, ("tp",))
+            with jax.sharding.use_mesh(mesh):
+                m = jax.sharding.get_abstract_mesh()
+            return x, n, m
+    """)
+    f = check(VersionGateChecker(), m)
+    assert {x.message.split(" ")[0] for x in f} == {
+        "jax.lax.axis_size", "jax.lax.pvary",
+        "jax.sharding.use_mesh", "jax.sharding.get_abstract_mesh"}
+
+
+def test_tpu006_compat_is_sanctioned():
+    m = mod("""
+        import jax
+        from jax.experimental.shard_map import shard_map
+        def shim(f, **kw):
+            return jax.shard_map(f, **kw)
+    """, rel="kubeflow_tpu/compat/jaxshim.py")
+    assert check(VersionGateChecker(), m) == []
+
+
+def test_tpu006_string_probes_not_flagged():
+    # getattr/hasattr feature probes are how compat itself resolves
+    # the surface — a string cannot crash at import/attribute time
+    m = mod("""
+        import jax
+        HAS = hasattr(jax, "shard_map")
+        fn = getattr(jax.lax, "axis_size", None)
+    """)
+    assert check(VersionGateChecker(), m) == []
+
+
+def test_tpu006_exemption_is_exact_path_not_substring():
+    # a sibling "netcompat/" (or a nested */compat/) must not inherit
+    # the sanctioned-directory exemption
+    src = """
+        import jax
+        def wrap(core, mesh, spec):
+            return jax.shard_map(core, mesh=mesh, in_specs=(spec,),
+                                 out_specs=spec)
+    """
+    for rel in ("kubeflow_tpu/netcompat/x.py",
+                "kubeflow_tpu/serving/compat/x.py"):
+        f = check(VersionGateChecker(), mod(src, rel=rel))
+        assert len(f) == 1, rel
+    assert check(VersionGateChecker(),
+                 mod(src, rel="kubeflow_tpu/compat/x.py")) == []
+
+
+def test_tpu006_committed_callsites_stay_on_compat():
+    """Re-introduce the bug that killed the 22 tier-1 tests — swap a
+    consumer's compat.shard_map back to jax.shard_map — and TPU006
+    must light up; the committed files must stay clean."""
+    for rel in ("kubeflow_tpu/parallel/pipeline.py",
+                "kubeflow_tpu/models/transformer.py",
+                "kubeflow_tpu/ops/collectives.py",
+                "kubeflow_tpu/ops/attention.py"):
+        with open(os.path.join(REPO, rel)) as fh:
+            src = fh.read()
+        assert check(VersionGateChecker(),
+                     ModuleInfo.from_source(rel, src)) == []
+        buggy = src.replace("compat.shard_map(", "jax.shard_map(")
+        assert buggy != src, f"{rel} no longer routes through compat"
+        bad = check(VersionGateChecker(),
+                    ModuleInfo.from_source(rel, buggy))
+        assert bad and all(f.rule == "TPU006" for f in bad), rel
+
+
+# -- TPU007 mesh-axis consistency --------------------------------------------
+
+MESH_DECL_SRC = """
+    MESH_AXES = ("dcn", "dp", "pp", "tp")
+"""
+
+
+def test_tpu007_collective_axis_typo():
+    decl = mod(MESH_DECL_SRC, rel="kubeflow_tpu/parallel/mesh.py")
+    use = mod("""
+        import jax
+        def f(x):
+            return jax.lax.psum(x, "tpp")
+    """, rel="kubeflow_tpu/ops/thing.py")
+    f = [x for x in check(MeshAxesChecker(), decl, use)]
+    assert len(f) == 1 and f[0].rule == "TPU007"
+    assert "'tpp'" in f[0].message and "dcn, dp, pp, tp" in f[0].message
+
+
+def test_tpu007_spec_and_axis_names_and_defaults():
+    decl = mod(MESH_DECL_SRC, rel="kubeflow_tpu/parallel/mesh.py")
+    use = mod("""
+        from jax.sharding import PartitionSpec as P
+        def wrap(core, mesh, seq_axis="tq"):
+            spec = P(("dcn", "dq"), "tp")
+            return shard_map(core, mesh=mesh, in_specs=(spec,),
+                             out_specs=spec, axis_names={"qq"})
+    """, rel="kubeflow_tpu/ops/thing.py")
+    f = check(MeshAxesChecker(), decl, use)
+    assert sorted(x.message.split("'")[1] for x in f) == [
+        "dq", "qq", "tq"]
+
+
+def test_tpu007_known_axes_and_mesh_ctor_declarations_ok():
+    decl = mod(MESH_DECL_SRC, rel="kubeflow_tpu/parallel/mesh.py")
+    extra = mod("""
+        from jax.sharding import Mesh
+        mesh = Mesh(devices, ("rows",))
+    """, rel="kubeflow_tpu/testing/grid.py")
+    use = mod("""
+        import jax
+        from jax.sharding import PartitionSpec as P
+        def f(x, axis="dp"):
+            spec = P(("dcn", "dp"), "rows", None)
+            return jax.lax.psum(x, axis_name="tp")
+    """, rel="kubeflow_tpu/ops/thing.py")
+    assert check(MeshAxesChecker(), decl, extra, use) == []
+
+
+def test_tpu007_axis_first_positional_calls():
+    # axis_index/axis_size take the axis as their FIRST positional arg
+    decl = mod(MESH_DECL_SRC, rel="kubeflow_tpu/parallel/mesh.py")
+    use = mod("""
+        import jax
+        from kubeflow_tpu import compat
+        def f():
+            i = jax.lax.axis_index("tppp")
+            n = compat.axis_size("tp")
+            return i, n
+    """, rel="kubeflow_tpu/ops/thing.py")
+    f = check(MeshAxesChecker(), decl, use)
+    assert len(f) == 1 and "'tppp'" in f[0].message
+
+
+def test_tpu007_silent_without_declarations():
+    # scoped run: no declaration in the walked subset -> no guessing
+    use = mod("""
+        import jax
+        def f(x):
+            return jax.lax.psum(x, "anything")
+    """)
+    assert check(MeshAxesChecker(), use) == []
+
+
+def test_tpu007_variable_axes_not_chased():
+    decl = mod(MESH_DECL_SRC, rel="kubeflow_tpu/parallel/mesh.py")
+    use = mod("""
+        import jax
+        def f(x, axis):
+            return jax.lax.psum(x, axis)
+    """)
+    assert check(MeshAxesChecker(), decl, use) == []
+
+
+# -- TPU008 partitionspec legality -------------------------------------------
+
+def test_tpu008_duplicate_axis_across_entries():
+    m = mod("""
+        from jax.sharding import PartitionSpec as P
+        spec = P("tp", "tp")
+    """)
+    f = check(SpecLegalityChecker(), m)
+    assert len(f) == 1 and f[0].rule == "TPU008"
+    assert "'tp' appears twice" in f[0].message
+
+
+def test_tpu008_duplicate_axis_inside_tuple_entry():
+    m = mod("""
+        from jax.sharding import PartitionSpec as P
+        spec = P(("dp", "dp"), None)
+    """)
+    assert len(check(SpecLegalityChecker(), m)) == 1
+
+
+def test_tpu008_legal_specs_ok():
+    m = mod("""
+        from jax.sharding import PartitionSpec as P
+        a = P(("dcn", "dp"), "tp")
+        b = P(None, "tp", None, None)
+        c = P()
+    """)
+    assert check(SpecLegalityChecker(), m) == []
+
+
+def test_tpu008_rank_overflow_inferable():
+    m = mod("""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        def f():
+            x = jnp.zeros((4, 8))
+            return jax.lax.with_sharding_constraint(
+                x, P("dp", "tp", "pp"))
+    """)
+    f = check(SpecLegalityChecker(), m)
+    assert len(f) == 1 and "rank 2" in f[0].message
+
+
+def test_tpu008_rank_unprovable_stays_silent():
+    m = mod("""
+        import jax
+        from jax.sharding import PartitionSpec as P
+        def f(x):
+            return jax.lax.with_sharding_constraint(
+                x, P("dp", "tp", "pp"))
+    """)
+    assert check(SpecLegalityChecker(), m) == []
+
+
+# -- TPU009 unbound collective -----------------------------------------------
+
+def test_tpu009_bare_literal_collective():
+    m = mod("""
+        import jax
+        def helper(x):
+            return jax.lax.ppermute(x, "dp", [(0, 1)])
+    """)
+    f = check(UnboundCollectiveChecker(), m)
+    assert len(f) == 1 and f[0].rule == "TPU009"
+    assert "'dp'" in f[0].message
+
+
+def test_tpu009_shard_wrapped_by_name_ok():
+    m = mod("""
+        import jax
+        def core(x):
+            return jax.lax.psum(x, "tp")
+        def run(mesh, spec, x):
+            fn = shard_map(core, mesh=mesh, in_specs=(spec,),
+                           out_specs=spec, axis_names={"tp"})
+            return fn(x)
+    """)
+    assert check(UnboundCollectiveChecker(), m) == []
+
+
+def test_tpu009_full_manual_binds_everything():
+    m = mod("""
+        import functools
+        import jax
+        def core(x):
+            return jax.lax.all_to_all(x, "tp", split_axis=2,
+                                      concat_axis=1, tiled=True)
+        def run(mesh, spec, x):
+            fn = shard_map(functools.partial(core), mesh=mesh,
+                           in_specs=(spec,), out_specs=spec)
+            return fn(x)
+    """)
+    assert check(UnboundCollectiveChecker(), m) == []
+
+
+def test_tpu009_wrong_axis_still_flagged():
+    m = mod("""
+        import jax
+        def core(x):
+            return jax.lax.psum(x, "dp")
+        def run(mesh, spec, x):
+            return shard_map(core, mesh=mesh, in_specs=(spec,),
+                             out_specs=spec, axis_names={"tp"})(x)
+    """)
+    f = check(UnboundCollectiveChecker(), m)
+    assert len(f) == 1 and "'dp'" in f[0].message
+
+
+def test_tpu009_nested_def_inherits_binding():
+    m = mod("""
+        import jax
+        def run(mesh, spec, x):
+            def core(v):
+                def inner(u):
+                    return jax.lax.psum(u, "pp")
+                return inner(v)
+            return shard_map(core, mesh=mesh, in_specs=(spec,),
+                             out_specs=spec, axis_names={"pp"})(x)
+    """)
+    assert check(UnboundCollectiveChecker(), m) == []
+
+
+def test_tpu009_inline_lambda_body_is_bound():
+    # an inline lambda handed straight to shard_map IS the region body;
+    # flagging it would violate false-negatives-over-false-positives
+    m = mod("""
+        import jax
+        def run(mesh, spec, x):
+            fn = shard_map(lambda v: jax.lax.psum(v, "tp"), mesh=mesh,
+                           in_specs=(spec,), out_specs=spec,
+                           axis_names={"tp"})
+            return fn(x)
+    """)
+    assert check(UnboundCollectiveChecker(), m) == []
+    wrong_axis = mod("""
+        import jax
+        def run(mesh, spec, x):
+            return shard_map(lambda v: jax.lax.psum(v, "dp"), mesh=mesh,
+                             in_specs=(spec,), out_specs=spec,
+                             axis_names={"tp"})(x)
+    """)
+    f = check(UnboundCollectiveChecker(), wrong_axis)
+    assert len(f) == 1 and "'dp'" in f[0].message
+
+
+def test_tpu009_pmap_axis_name_binds():
+    m = mod("""
+        import jax
+        def step(x):
+            return jax.lax.pmean(x, "batch")
+        run = jax.pmap(step, axis_name="batch")
+    """)
+    assert check(UnboundCollectiveChecker(), m) == []
+
+
+def test_tpu009_parameter_axis_not_flagged():
+    # the ops/attention.py convention: axis flows in as a parameter
+    m = mod("""
+        import jax
+        def core(x, axis_name):
+            return jax.lax.psum(x, axis_name)
+    """)
+    assert check(UnboundCollectiveChecker(), m) == []
+
+
+def test_tpu009_axis_index_first_positional():
+    # axis_index's axis is its first positional arg — an unbound one
+    # raises at trace time exactly like psum's second positional
+    m = mod("""
+        import jax
+        def helper():
+            return jax.lax.axis_index("dp")
+    """)
+    f = check(UnboundCollectiveChecker(), m)
+    assert len(f) == 1 and "'dp'" in f[0].message
+    bound = mod("""
+        import jax
+        def core(x):
+            return x + jax.lax.axis_index("pp")
+        def run(mesh, spec, x):
+            return shard_map(core, mesh=mesh, in_specs=(spec,),
+                             out_specs=spec, axis_names={"pp"})(x)
+    """)
+    assert check(UnboundCollectiveChecker(), bound) == []
+
+
+def test_tpu009_pragma_suppresses():
+    m = mod("""
+        import jax
+        def helper(x):
+            return jax.lax.psum(x, "dp")  # tpulint: disable=TPU009 doc example
+    """)
+    findings, suppressed = lint_modules([m], rules=["TPU009"])
+    assert findings == [] and suppressed == 1
+
+
+# -- acceptance fixture: the three SPMD bug classes, one finding each --------
+
+def test_spmd_fixture_yields_exactly_tpu006_007_008():
+    """ISSUE acceptance: a synthetic module with a direct
+    ``jax.shard_map`` call, a mesh-axis typo, and a duplicated
+    PartitionSpec axis yields exactly one TPU006, one TPU007, and one
+    TPU008 finding."""
+    decl = mod(MESH_DECL_SRC, rel="kubeflow_tpu/parallel/mesh.py")
+    fixture = mod("""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        def run(core, mesh, x):
+            fn = jax.shard_map(core, mesh=mesh,
+                               in_specs=(P("dp", "dp"),),
+                               out_specs=P(None, "ttp"))
+            return fn(x)
+    """, rel="kubeflow_tpu/ops/fixture.py")
+    findings, _ = lint_modules([decl, fixture])
+    by_rule = sorted(f.rule for f, _ in findings
+                     if f.path.endswith("fixture.py"))
+    assert by_rule == ["TPU006", "TPU007", "TPU008"], [
+        f.format() for f, _ in findings]
+
+
 # -- pragmas / baseline workflow --------------------------------------------
 
 def test_line_pragma_with_trailing_justification_prose():
@@ -531,6 +944,51 @@ def test_cli_exits_zero_on_clean_repo(tmp_path):
     assert proc.returncode == 0, proc.stdout + proc.stderr
     payload = json.loads(proc.stdout)
     assert payload["new"] == []
+
+
+def test_cli_sarif_output_shape(tmp_path):
+    """--format sarif must emit valid SARIF 2.1.0: driver + full rule
+    catalog always, results only for NEW findings (a clean repo run
+    annotates nothing — baselined debt must not spam PR lines)."""
+    import subprocess
+    import sys
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "run_tpulint.py"),
+         "--format", "sarif"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["version"] == "2.1.0"
+    run = payload["runs"][0]
+    assert run["tool"]["driver"]["name"] == "tpulint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"TPU001", "TPU006", "TPU007", "TPU008", "TPU009"} <= rule_ids
+    assert run["results"] == []
+
+
+def test_cli_sarif_reports_new_findings(tmp_path):
+    """SARIF results carry ruleId/level/message/region for each new
+    finding, against a bad file and an empty baseline."""
+    import subprocess
+    import sys
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax\n"
+        "def wrap(core, mesh, spec):\n"
+        "    return jax.shard_map(core, mesh=mesh, in_specs=(spec,),\n"
+        "                         out_specs=spec)\n")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "run_tpulint.py"),
+         "--format", "sarif", "--baseline", "", str(bad)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    results = json.loads(proc.stdout)["runs"][0]["results"]
+    assert len(results) == 1
+    r = results[0]
+    assert r["ruleId"] == "TPU006" and r["level"] == "error"
+    loc = r["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("bad.py")
+    assert loc["region"]["startLine"] == 3
 
 
 def test_cli_refuses_scoped_baseline_update(tmp_path):
