@@ -49,6 +49,12 @@ def main() -> None:
     if "mfu" in headline:
         line["mfu"] = headline["mfu"]
         line["tflops_per_chip"] = headline["tflops_per_chip"]
+    if "step_telemetry" in headline:
+        # step-regularity evidence (p50/p99 step time, recompile count,
+        # MFU from the instrumented pass) rides with the artifact so the
+        # perf trajectory shows tails and recompiles, not just means
+        # (kubeflow_tpu/obs/steps.py, docs/OBSERVABILITY.md)
+        line["step_telemetry"] = headline["step_telemetry"]
     line["extras"] = results
     # the always-on CPU smoke tier (tier:"cpu" rows, tiny shapes): an
     # accelerator outage degrades the artifact to labeled correctness

@@ -19,6 +19,10 @@ Route parity with the reference's Express server
 - ``GET /api/traces``              — recent root spans from the platform's
   span collector (``kubeflow_tpu/obs``); ``GET /api/traces/<trace_id>``
   returns one full span tree (docs/OBSERVABILITY.md)
+- ``GET /api/jobs/<ns>/<name>/telemetry`` — training-plane telemetry for
+  one TpuJob: step rate, MFU, recompiles, per-worker lag + stragglers,
+  aggregated live from the workers' beacon ConfigMaps
+  (``kubeflow_tpu/obs/steps.py``; docs/OBSERVABILITY.md)
 """
 
 from __future__ import annotations
@@ -217,6 +221,16 @@ class DashboardApi:
                 return 200, self.workgroup_exists(user)
             if path == "/api/dashboard-links":
                 return 200, self.dashboard_links()
+            if path.startswith("/api/jobs/"):
+                # the training-plane telemetry surface
+                # (docs/OBSERVABILITY.md); the literal "/api/jobs/" is
+                # this route's entry in the tpulint TPU004 route table
+                parts = path[len("/api/jobs/"):].split("/")
+                if len(parts) == 3 and parts[0] and parts[1] \
+                        and parts[2] == "telemetry":
+                    self._authz(user, parts[0], "tpujobs")
+                    return self.job_telemetry(parts[0], parts[1])
+                return 404, {"error": f"no route {path}"}
             if path.startswith("/api/tpujobs/"):
                 parts = path[len("/api/tpujobs/"):].split("/")
                 if not parts[0]:
@@ -417,6 +431,65 @@ class DashboardApi:
             "spec": job.get("spec", {}),
             "status": job.get("status", {}),
             "workers": workers,
+        }
+
+    def job_telemetry(self, ns: str, name: str) -> Tuple[int, Any]:
+        """Training-plane telemetry for one TpuJob: step rate, MFU,
+        recompile count, per-worker lag, straggler list, and the
+        identity-derived trace id (docs/OBSERVABILITY.md).
+
+        Live-first: the workers' beacon ConfigMaps are re-aggregated on
+        every GET (fresher than the operator's last reconcile pass);
+        the CR's ``status.telemetry`` is the fallback when the beacons
+        are unreadable — same builder (`obs.steps.telemetry_view`) both
+        places, so the shapes cannot drift."""
+        from kubeflow_tpu.manifests.components.tpujob_operator import (
+            API_VERSION,
+            TPUJOB_KIND,
+        )
+        from kubeflow_tpu.obs.steps import (
+            read_beacons,
+            telemetry_view,
+            tpujob_trace_ids,
+        )
+        from kubeflow_tpu.operators.tpujob import TpuJobSpec
+
+        job = self.client.get_or_none(API_VERSION, TPUJOB_KIND, ns, name)
+        if job is None:
+            return 404, {"error": f"tpujob {name!r} not found"}
+        status = job.get("status", {}) or {}
+        try:
+            spec = TpuJobSpec.from_dict(job.get("spec", {}))
+            straggler_k = spec.straggler_steps
+            max_workers: Optional[int] = spec.num_workers
+        except ValueError:
+            from kubeflow_tpu.obs.steps import DEFAULT_STRAGGLER_STEPS
+
+            straggler_k = DEFAULT_STRAGGLER_STEPS
+            max_workers = None
+        try:
+            # world-size filter: an elastic downsize leaves departed
+            # workers' last beacons behind until the operator GCs them
+            beacons = read_beacons(self.client, ns, name,
+                                   max_workers=max_workers)
+        except ApiError:
+            beacons = {}
+        if beacons:
+            view = telemetry_view(beacons, straggler_k)
+        else:
+            # no beacons visible: the operator's last aggregation, else
+            # the empty view (keys always present for UI/consumers)
+            view = (dict(status.get("telemetry") or {})
+                    or telemetry_view({}, straggler_k))
+        trace_id, _ = tpujob_trace_ids(
+            ns, name, job.get("metadata", {}).get("uid", ""))
+        return 200, {
+            "name": name,
+            "namespace": ns,
+            "phase": status.get("phase", "Pending"),
+            "restarts": status.get("restarts", 0),
+            "traceId": trace_id,
+            **view,
         }
 
     # -- studies (katib-ui parity) ----------------------------------------

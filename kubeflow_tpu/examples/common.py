@@ -88,15 +88,64 @@ def checkpoint_dir(default: str = "") -> str:
     return os.environ.get("KFTPU_CHECKPOINT_DIR", default)
 
 
+def make_step_telemetry(*, tokens_per_step: int = 0,
+                        examples_per_step: int = 0,
+                        client=None, **kwargs):
+    """A :class:`~kubeflow_tpu.obs.steps.StepTelemetry` wired from the
+    operator's env contract: job/namespace/uid identity (so the step
+    spans join the operator's trace), worker index, and — when running
+    inside a TpuJob gang — a beacon sink publishing this host's health
+    ConfigMap for the operator's straggler aggregation. Outside a gang
+    (no ``KFTPU_JOB_NAME``) telemetry stays local: metrics + flight
+    recorder, no cluster traffic."""
+    from kubeflow_tpu.obs.steps import (
+        ENV_JOB_UID,
+        StepTelemetry,
+        kube_beacon_sink,
+    )
+
+    penv = dist.from_env()
+    job_uid = os.environ.get(ENV_JOB_UID, "")
+    sink = None
+    if penv.job_name and os.environ.get("KFTPU_BEACONS", "1") != "0":
+        if client is None:
+            try:
+                from kubeflow_tpu.k8s.client import HttpKubeClient
+
+                client = HttpKubeClient()
+            except Exception:  # noqa: BLE001 — no cluster: local-only
+                client = None
+        if client is not None:
+            # job_uid stamps the ownerReference: beacons GC with the CR
+            sink = kube_beacon_sink(client, penv.namespace, penv.job_name,
+                                    penv.process_id, job_uid=job_uid)
+    kwargs.setdefault("beacon_every", 10)
+    kwargs.setdefault("span_every", 10)
+    kwargs.setdefault("n_chips", jax.device_count())
+    return StepTelemetry(
+        job=penv.job_name, namespace=penv.namespace,
+        uid=job_uid, worker=penv.process_id,
+        tokens_per_step=tokens_per_step,
+        examples_per_step=examples_per_step,
+        beacon_sink=sink, **kwargs)
+
+
 def report_tuning_metrics(step: int, metrics: Dict[str, Any],
-                          *, final: bool = False, client=None) -> None:
+                          *, final: bool = False, client=None,
+                          telemetry=None) -> None:
     """Publish trial metrics when running inside a study (no-op outside).
 
     The study controller injects ``KFTPU_TRIAL_NAME`` and
     ``KFTPU_OBJECTIVE_METRIC``; this appends the objective's step series
     (what median early stopping reads) and, on ``final``, the metrics the
-    controller harvests on success. Failures only log — a metrics hiccup
-    must never kill a training step."""
+    controller harvests on success. With ``telemetry`` (a
+    :class:`~kubeflow_tpu.obs.steps.StepTelemetry`), the objective series
+    comes from the telemetry's per-step records
+    (:func:`kubeflow_tpu.tuning.study.append_history_from_telemetry`) —
+    the same measurement stream the operator beacons and the flight
+    recorder see — and the final report carries its p50/p99/recompile
+    summary. Failures only log — a metrics hiccup must never kill a
+    training step."""
     trial = os.environ.get("KFTPU_TRIAL_NAME")
     if not trial:
         return
@@ -109,6 +158,7 @@ def report_tuning_metrics(step: int, metrics: Dict[str, Any],
     objective = os.environ.get("KFTPU_OBJECTIVE_METRIC", "")
     try:
         from kubeflow_tpu.tuning.study import (
+            append_history_points,
             append_trial_history,
             report_trial_metrics,
         )
@@ -121,12 +171,26 @@ def report_tuning_metrics(step: int, metrics: Dict[str, Any],
             if client is None:
                 client = HttpKubeClient()
                 report_tuning_metrics._client = client
-        if objective and objective in metrics:
+        series = (telemetry.objective_series(objective)
+                  if objective and telemetry is not None else [])
+        if series:
+            # the telemetry series IS the objective history; 0 appended
+            # from a NON-EMPTY series means the points are already
+            # persisted — never an ad-hoc append that would duplicate a
+            # step. An empty series (metric unresolvable from step
+            # records, e.g. "accuracy" under sync=False) falls through
+            # to the explicit-value path below.
+            append_history_points(client, ns, trial, series)
+        elif objective and objective in metrics:
             append_trial_history(client, ns, trial, step,
                                  float(metrics[objective]))
         if final:
-            report_trial_metrics(client, ns, trial, {
-                k: float(v) for k, v in metrics.items()
-                if hasattr(v, "__float__")})
+            harvest = {k: float(v) for k, v in metrics.items()
+                       if hasattr(v, "__float__")}
+            if telemetry is not None:
+                harvest.update({k: float(v)
+                                for k, v in telemetry.summary().items()
+                                if isinstance(v, (int, float))})
+            report_trial_metrics(client, ns, trial, harvest)
     except Exception:  # noqa: BLE001
         logging.exception("trial metrics report failed (continuing)")

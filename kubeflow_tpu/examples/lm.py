@@ -14,7 +14,12 @@ import time
 import jax
 import jax.numpy as jnp
 
-from kubeflow_tpu.examples.common import checkpoint_dir, launcher_init, log_metrics
+from kubeflow_tpu.examples.common import (
+    checkpoint_dir,
+    launcher_init,
+    log_metrics,
+    make_step_telemetry,
+)
 from kubeflow_tpu.parallel.mesh import data_parallel_size
 from kubeflow_tpu.models import Transformer, TransformerConfig
 from kubeflow_tpu.train import (
@@ -95,7 +100,12 @@ def main(argv=None) -> float:
             ckpt.close()
         return 0.0
 
-    step_fn = make_lm_train_step(mesh)
+    # step telemetry (docs/OBSERVABILITY.md training plane): wall time,
+    # tokens/s, MFU + recompiles into the metrics registry, per-host
+    # beacons to the operator when inside a gang, flight-recorder dump
+    # on step failure / slow step
+    telem = make_step_telemetry(tokens_per_step=batch * args.seq_len)
+    step_fn = telem.wrap(make_lm_train_step(mesh))
     prof = StepProfiler.from_env()
     data_rng = jax.random.key(1234)
     t0 = time.perf_counter()
@@ -112,7 +122,9 @@ def main(argv=None) -> float:
             log_metrics(step, loss=metrics["loss"],
                         grad_norm=metrics["grad_norm"],
                         tokens_per_sec=tps,
-                        tokens_per_sec_per_chip=tps / jax.device_count())
+                        tokens_per_sec_per_chip=tps / jax.device_count(),
+                        **{f"step_{k}": v
+                           for k, v in telem.summary().items()})
         if ckpt and (step % args.checkpoint_every == 0 or step == args.steps):
             ckpt.save(step, state)
     prof.close()

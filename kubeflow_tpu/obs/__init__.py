@@ -25,3 +25,15 @@ from kubeflow_tpu.obs.export import (  # noqa: F401
     parse_otlp_lines,
     push_spans,
 )
+from kubeflow_tpu.obs.steps import (  # noqa: F401
+    FlightRecorder,
+    StepRecord,
+    StepTelemetry,
+    flag_stragglers,
+    kube_beacon_sink,
+    publish_beacon,
+    read_beacons,
+    step_span_id,
+    telemetry_view,
+    tpujob_trace_ids,
+)

@@ -20,9 +20,11 @@ pytorch-operator, mpi-operator, …; CRDs in ``/root/reference/kubeflow/
 
 from __future__ import annotations
 
+import calendar
 import logging
 import os
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -34,6 +36,15 @@ from kubeflow_tpu.manifests.components.tpujob_operator import (
     TPUJOB_KIND,
     TPUJOB_PLURAL,
 )
+from kubeflow_tpu.obs.steps import (
+    DEFAULT_STRAGGLER_STEPS,
+    ENV_JOB_UID,
+    beacon_configmap_name,
+    read_beacons,
+    telemetry_view,
+    tpujob_trace_ids,
+)
+from kubeflow_tpu.obs.trace import Tracer
 from kubeflow_tpu.operators.controller import (
     Controller,
     make_condition as _condition,
@@ -46,6 +57,7 @@ from kubeflow_tpu.scheduler.inventory import (
 )
 from kubeflow_tpu.scheduler.placement import SlicePlacement, place_gang
 from kubeflow_tpu.utils import DEFAULT_REGISTRY
+from kubeflow_tpu.utils.clock import Clock
 
 log = logging.getLogger(__name__)
 
@@ -73,6 +85,12 @@ _restarts = DEFAULT_REGISTRY.counter(
     "kftpu_operator_gang_restarts_total", "whole-gang restarts")
 _jobs_by_phase = DEFAULT_REGISTRY.gauge(
     "kftpu_operator_jobs", "jobs by phase")
+_job_last_step = DEFAULT_REGISTRY.gauge(
+    "kftpu_job_last_step", "max worker step observed per job")
+_job_steps_per_sec = DEFAULT_REGISTRY.gauge(
+    "kftpu_job_steps_per_sec", "median worker steps/sec per job")
+_job_stragglers = DEFAULT_REGISTRY.gauge(
+    "kftpu_job_stragglers", "workers >= K steps behind the gang median")
 
 
 @dataclass
@@ -102,6 +120,9 @@ class TpuJobSpec:
     # openmpi/ sidecar data staging), TPU-style. The downloader image
     # defaults per scheme (cloud-sdk for gs://, aws-cli for s3://).
     data_staging: List[Dict[str, str]] = field(default_factory=list)
+    # straggler policy (docs/OBSERVABILITY.md): a worker this many steps
+    # behind the gang's median beacon step is flagged in status
+    straggler_steps: int = DEFAULT_STRAGGLER_STEPS
 
     @property
     def num_workers(self) -> int:
@@ -125,6 +146,8 @@ class TpuJobSpec:
             volumes=list(spec.get("volumes", []) or []),
             volume_mounts=list(spec.get("volumeMounts", []) or []),
             data_staging=list(spec.get("dataStaging", []) or []),
+            straggler_steps=int(spec.get("stragglerSteps",
+                                         DEFAULT_STRAGGLER_STEPS)),
         )
         out.validate()
         return out
@@ -136,6 +159,8 @@ class TpuJobSpec:
             raise ValueError("slices and hostsPerSlice must be >= 1")
         if self.restart_policy not in ("Never", "OnFailure"):
             raise ValueError(f"invalid restartPolicy {self.restart_policy!r}")
+        if self.straggler_steps < 1:
+            raise ValueError("stragglerSteps must be >= 1")
         for d in self.data_staging:
             if not d.get("source", "").startswith(("gs://", "s3://")):
                 raise ValueError(
@@ -212,6 +237,9 @@ def build_worker_pod(job: o.Obj, index: int, placement: SlicePlacement,
         dist.ENV_PROCESS_ID: str(index),
         dist.ENV_JOB_NAME: name,
         dist.ENV_NAMESPACE: ns,
+        # CR identity for telemetry: workers derive the SAME training
+        # trace id the operator does (obs.steps.tpujob_trace_ids)
+        ENV_JOB_UID: job["metadata"].get("uid", ""),
         # TPU runtime topology hints (consumed by the TPU container runtime)
         "TPU_WORKER_ID": str(placement.host),
         "MEGASCALE_SLICE_ID": str(placement.slice_index),
@@ -288,16 +316,34 @@ def _pod_phase(pod: o.Obj) -> str:
     return pod.get("status", {}).get("phase", "Pending")
 
 
+def _parse_ts(stamp: str) -> Optional[float]:
+    """Status timestamp -> epoch seconds (None on absent/garbage)."""
+    try:
+        return float(calendar.timegm(
+            time.strptime(stamp, "%Y-%m-%dT%H:%M:%SZ")))
+    except (TypeError, ValueError):
+        return None
+
+
 
 
 class TpuJobOperator:
     """Reconciles TpuJob CRs into gangs of worker pods + a headless Service."""
 
     def __init__(self, client: KubeClient, namespace: Optional[str] = None,
-                 gang_scheduling: bool = True) -> None:
+                 gang_scheduling: bool = True,
+                 clock: Optional[Clock] = None,
+                 tracer: Optional[Tracer] = None) -> None:
         self.client = client
         self.namespace = namespace
         self.gang_scheduling = gang_scheduling
+        # epoch-seconds clock (wall, not monotonic: the terminal job span
+        # closes against startTime timestamps persisted in CR status) +
+        # a tracer sharing it, so the training-job root span stays
+        # deterministic under a fake clock (the workflow-controller shape)
+        self.clock: Clock = clock if clock is not None else time.time
+        self.tracer = tracer if tracer is not None else Tracer(
+            clock=self.clock)
         # placement is read-inventory-then-create: without serialization,
         # two workers reconciling DIFFERENT jobs concurrently both see the
         # same slice free and double-book it (kube-scheduler likewise runs
@@ -310,6 +356,7 @@ class TpuJobOperator:
         _reconciles.inc()
         job = self.client.get_or_none(API_VERSION, TPUJOB_KIND, ns, name)
         if job is None:
+            self._clear_job_gauges(ns, name)
             return None  # deleted; cascade GC cleans children
         try:
             spec = TpuJobSpec.from_dict(job["spec"])
@@ -353,6 +400,9 @@ class TpuJobOperator:
             counts[_pod_phase(pod)] = counts.get(_pod_phase(pod), 0) + 1
 
         status_update: Dict[str, Any] = {"workers": counts}
+        telemetry = self._job_telemetry(ns, name, spec)
+        if telemetry is not None:
+            status_update["telemetry"] = telemetry
 
         # elastic resize: spec.slices / hostsPerSlice edited under a live
         # gang. Every worker bakes the world size + slice count into its
@@ -374,7 +424,8 @@ class TpuJobOperator:
             return 1.0
 
         if counts["Failed"] > 0:
-            return self._handle_failure(job, spec, pods)
+            return self._handle_failure(job, spec, pods,
+                                        telemetry=telemetry)
 
         if len(pods) < spec.num_workers:
             # a worker went missing (eviction, manual delete): the SPMD mesh
@@ -390,13 +441,26 @@ class TpuJobOperator:
             self._set_status(job, PHASE_SUCCEEDED,
                              completion=True, **status_update,
                              conditions=[_condition("Succeeded", "AllWorkersDone")])
+            self._record_job_span(job, PHASE_SUCCEEDED,
+                                  telemetry=telemetry)
+            self._clear_job_gauges(ns, name)
             return None
         if counts["Running"] == spec.num_workers:
-            if phase != PHASE_RUNNING:
-                self._set_status(job, PHASE_RUNNING, start=True, **status_update,
-                                 conditions=[_condition("Running", "GangRunning")])
-            else:
-                self._set_status(job, PHASE_RUNNING, **status_update)
+            conds = ([_condition("Running", "GangRunning")]
+                     if phase != PHASE_RUNNING else [])
+            if telemetry and telemetry.get("stragglers"):
+                # health, not failure: the SPMD gang still runs, but its
+                # throughput is gated by these workers — surface them
+                # (condition dedup keeps the list from growing per poll)
+                conds.append(_condition(
+                    "Straggling", "WorkerBehindMedian",
+                    f"worker(s) {', '.join(telemetry['stragglers'])} >= "
+                    f"{spec.straggler_steps} steps behind median step "
+                    f"{telemetry.get('medianStep')}"))
+            self._set_status(job, PHASE_RUNNING,
+                             start=(phase != PHASE_RUNNING),
+                             **status_update,
+                             conditions=conds or None)
             return 10.0
         # partially scheduled/running: keep current phase, poll again
         self._set_status(job, phase if phase != PHASE_RESTARTING else PHASE_PENDING,
@@ -407,6 +471,74 @@ class TpuJobOperator:
 
     def _restarts(self, job: o.Obj) -> int:
         return int(job.get("status", {}).get("restarts", 0))
+
+    def _job_telemetry(self, ns: str, name: str,
+                       spec: TpuJobSpec) -> Optional[Dict[str, Any]]:
+        """Aggregate the workers' beacon ConfigMaps into the CR-status
+        telemetry shape (None when no worker has beaconed yet — a job
+        that never emits telemetry keeps a telemetry-free status).
+        Beacons beyond the CURRENT world size (an elastic downsize left
+        them behind) are excluded from aggregation and deleted
+        best-effort, or the departed workers' frozen step counters would
+        drag the gang median and flag every live worker a straggler."""
+        try:
+            beacons = read_beacons(self.client, ns, name)
+        except ApiError:
+            return None
+        for w in [w for w in beacons if w >= spec.num_workers]:
+            beacons.pop(w)
+            try:
+                self.client.delete("v1", "ConfigMap", ns,
+                                   beacon_configmap_name(name, w))
+            except ApiError:
+                pass  # cleanup is best-effort; the filter is the guard
+        if not beacons:
+            return None
+        view = telemetry_view(beacons, spec.straggler_steps)
+        _job_last_step.set(view["lastStep"], namespace=ns, job=name)
+        _job_steps_per_sec.set(view["stepsPerSec"], namespace=ns, job=name)
+        _job_stragglers.set(len(view["stragglers"]), namespace=ns, job=name)
+        return view
+
+    def _clear_job_gauges(self, ns: str, name: str) -> None:
+        """Terminal/deleted jobs must not export their last telemetry
+        forever (the _update_phase_gauge staleness rule, applied to the
+        per-job label rows)."""
+        for g in (_job_last_step, _job_steps_per_sec, _job_stragglers):
+            g.remove(namespace=ns, job=name)
+
+    def _record_job_span(self, job: o.Obj, phase: str, *,
+                         telemetry: Optional[Dict[str, Any]] = None
+                         ) -> None:
+        """Terminal training-job root span, identity-derived like the
+        workflow controller's: trace/span ids from (ns, name, uid), so
+        the workers' per-N-step child spans (same derivation, via
+        KFTPU_JOB_UID) land under it in one tree. Terminal-only: the
+        reconcile loop returns early on terminal phases, so the span
+        records exactly once. ``telemetry`` is THIS pass's aggregation
+        (the CR copy in hand predates the final status write)."""
+        md = job.get("metadata", {})
+        ns = md.get("namespace", "")
+        name = md.get("name", "")
+        trace_id, root_id = tpujob_trace_ids(ns, name, md.get("uid", ""))
+        end = self.clock()
+        start = _parse_ts(job.get("status", {}).get("startTime", ""))
+        if start is None or start > end:
+            # startTime is stamped by make_condition's REAL wall clock;
+            # under an injected fake clock (or skew) it can land after
+            # ``end`` — clamp to a zero-duration span rather than
+            # recording a negative one
+            start = end
+        status = job.get("status", {})
+        if telemetry is None:
+            telemetry = status.get("telemetry") or {}
+        self.tracer.record(
+            f"tpujob/{name}", start=start if start is not None else end,
+            end=end, trace_id=trace_id, span_id=root_id,
+            attrs={"namespace": ns, "phase": phase,
+                   "restarts": int(status.get("restarts", 0)),
+                   "lastStep": telemetry.get("lastStep", 0)},
+            status="OK" if phase == PHASE_SUCCEEDED else f"ERROR: {phase}")
 
     def _create_gang(self, job: o.Obj, spec: TpuJobSpec) -> bool:
         """Create the whole gang atomically. Returns False (creating
@@ -493,7 +625,9 @@ class TpuJobOperator:
         helpers.create_if_absent(self.client, obj)
 
     def _handle_failure(self, job: o.Obj, spec: TpuJobSpec,
-                        pods: List[o.Obj]) -> Optional[float]:
+                        pods: List[o.Obj],
+                        telemetry: Optional[Dict[str, Any]] = None
+                        ) -> Optional[float]:
         name = job["metadata"]["name"]
         ns = job["metadata"]["namespace"]
         restarts = self._restarts(job)
@@ -503,6 +637,9 @@ class TpuJobOperator:
                 conditions=[_condition(
                     "Failed", "WorkerFailed",
                     f"gang failed after {restarts} restart(s)")])
+            self._record_job_span(job, PHASE_FAILED, telemetry=telemetry)
+            self._clear_job_gauges(job["metadata"].get("namespace", ""),
+                                   job["metadata"].get("name", ""))
             return None
         # SPMD all-or-nothing: tear the whole gang down and re-place it
         _restarts.inc()
@@ -518,7 +655,8 @@ class TpuJobOperator:
     def _set_status(self, job: o.Obj, phase: str, *, restarts: Optional[int] = None,
                     start: bool = False, completion: bool = False,
                     conditions: Optional[List[Dict[str, Any]]] = None,
-                    workers: Optional[Dict[str, int]] = None) -> None:
+                    workers: Optional[Dict[str, int]] = None,
+                    telemetry: Optional[Dict[str, Any]] = None) -> None:
         status = dict(job.get("status", {}))
         changed = status.get("phase") != phase
         status["phase"] = phase
@@ -526,6 +664,9 @@ class TpuJobOperator:
             status["restarts"] = restarts
         if workers is not None:
             status["workers"] = workers
+        if telemetry is not None:
+            changed = changed or status.get("telemetry") != telemetry
+            status["telemetry"] = telemetry
         if start and "startTime" not in status:
             status["startTime"] = _condition("", "")["lastTransitionTime"]
         if completion and "completionTime" not in status:

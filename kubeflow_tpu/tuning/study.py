@@ -218,3 +218,55 @@ def read_trial_history(client: KubeClient, ns: str,
         return []
     raw = (cm.get("data") or {}).get(HISTORY_KEY, "[]")
     return [(int(s), float(v)) for s, v in json.loads(raw)]
+
+
+def append_history_from_telemetry(client: KubeClient, ns: str,
+                                  trial_name: str, telemetry: Any,
+                                  metric: str) -> int:
+    """Publish the trial's objective series FROM STEP TELEMETRY.
+
+    ``telemetry`` is a :class:`kubeflow_tpu.obs.steps.StepTelemetry`
+    (anything with ``objective_series(metric)``); the series the median
+    early-stopping rule reads is then the same per-step record stream
+    the flight recorder and the operator beacons see — one measurement,
+    three consumers — instead of ad-hoc values the workload computed on
+    the side. Resolves recorded step metrics (``loss`` under sync mode)
+    and the derived throughput series (``steps_per_sec`` /
+    ``tokens_per_sec`` / ``examples_per_sec`` / ``mfu`` /
+    ``step_seconds``). Returns the number appended."""
+    return append_history_points(client, ns, trial_name,
+                                 telemetry.objective_series(metric))
+
+
+def append_history_points(client: KubeClient, ns: str, trial_name: str,
+                          series: List[Tuple[int, float]]) -> int:
+    """Batch-append ``(step, value)`` points to a trial's history.
+    Idempotent per step: only points newer than the last persisted step
+    are appended (one read-modify-write for the whole batch, not one
+    per point — and a caller that already computed the series doesn't
+    pay for it twice). Returns the number appended."""
+    if not series:
+        return 0
+    name = metrics_configmap_name(trial_name)
+    cm = client.get_or_none("v1", "ConfigMap", ns, name)
+    if cm is None:
+        cm = o.config_map(name, ns, {})
+        cm["metadata"]["labels"] = {TRIAL_LABEL: trial_name}
+        try:
+            client.create(cm)
+        except ApiError as e:
+            if e.code != 409:
+                raise
+            cm = client.get("v1", "ConfigMap", ns, name)
+    data = dict(cm.get("data") or {})
+    history = json.loads(data.get(HISTORY_KEY, "[]"))
+    last_step = max((int(s) for s, _ in history), default=-1)
+    fresh = [[int(s), float(v)] for s, v in series if int(s) > last_step]
+    if not fresh:
+        return 0
+    history.extend(fresh)
+    data[HISTORY_KEY] = json.dumps(history)
+    cm = dict(cm)
+    cm["data"] = data
+    client.update(cm)
+    return len(fresh)

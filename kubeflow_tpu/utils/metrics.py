@@ -11,7 +11,10 @@ from __future__ import annotations
 import bisect
 import http.server
 import threading
+import time
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+from kubeflow_tpu.utils.clock import Clock
 
 _Label = Tuple[Tuple[str, str], ...]
 
@@ -40,6 +43,13 @@ class Metric:
         with self._lock:
             return self._values.get(self._key(labels), 0.0)
 
+    def remove(self, **labels: str) -> None:
+        """Drop one label row (no-op when absent). For per-object gauge
+        series (per-job, per-model): the object is gone, so exporting
+        its last value forever is a lie AND unbounded cardinality."""
+        with self._lock:
+            self._values.pop(self._key(labels), None)
+
     def expose(self) -> str:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} {self.kind}"]
@@ -57,6 +67,14 @@ class Metric:
 # in seconds, overridable per histogram
 DEFAULT_BUCKETS: Tuple[float, ...] = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+# Train-step wall times: sub-10ms micro-steps through minutes-long
+# recompile stalls — the request-latency bounds above top out at 10s and
+# would fold every recompile into +Inf, exactly the tail a step-time
+# histogram exists to resolve
+STEP_TIME_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+    2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
 
 
 class Histogram(Metric):
@@ -102,6 +120,13 @@ class Histogram(Metric):
         with self._lock:
             return float(sum(self._counts.get(self._key(labels), ())))
 
+    def remove(self, **labels: str) -> None:
+        """Drop one label row's buckets and sum (histogram storage)."""
+        with self._lock:
+            key = self._key(labels)
+            self._counts.pop(key, None)
+            self._sums.pop(key, None)
+
     def bucket_counts(self, **labels: str) -> Dict[str, int]:
         """Cumulative counts keyed by ``le`` string (tests/debugging)."""
         with self._lock:
@@ -118,6 +143,16 @@ class Histogram(Metric):
     def sum(self, **labels: str) -> float:
         with self._lock:
             return self._sums.get(self._key(labels), 0.0)
+
+    def time(self, clock: Optional[Clock] = None,
+             **labels: str) -> "_HistogramTimer":
+        """Context manager observing the enclosed block's wall time:
+        ``with h.time(route="/x"): ...``. The clock is injectable (the
+        TPU003 contract) and defaults to the real clock by reference;
+        the elapsed value is observed on exit even when the block
+        raises — failures are exactly the latencies worth keeping."""
+        return _HistogramTimer(
+            self, clock if clock is not None else time.monotonic, labels)
 
     def expose(self) -> str:
         lines = [f"# HELP {self.name} {self.help}",
@@ -145,6 +180,27 @@ class Histogram(Metric):
 def _fmt_bound(b: float) -> str:
     """``0.005``/``1``/``2.5`` — no float noise in the ``le`` label."""
     return format(b, "g")
+
+
+class _HistogramTimer:
+    """The :meth:`Histogram.time` helper: one observation per ``with``
+    block. ``elapsed`` stays readable after exit (tests/debugging)."""
+
+    def __init__(self, hist: Histogram, clock: Clock,
+                 labels: Mapping[str, str]) -> None:
+        self._hist = hist
+        self._clock = clock
+        self._labels = dict(labels)
+        self.elapsed: Optional[float] = None
+
+    def __enter__(self) -> "_HistogramTimer":
+        self._t0 = self._clock()
+        return self
+
+    def __exit__(self, *exc: object) -> bool:
+        self.elapsed = self._clock() - self._t0
+        self._hist.observe(self.elapsed, **self._labels)
+        return False
 
 
 class Registry:

@@ -26,7 +26,10 @@ from __future__ import annotations
 import contextlib
 import logging
 import os
+import time
 from typing import Iterator, Optional
+
+from kubeflow_tpu.utils.clock import Clock
 
 log = logging.getLogger(__name__)
 
@@ -68,22 +71,32 @@ class StepProfiler:
     ...     prof.step(step)
     ...     state, m = train_step(state, batch)
     >>> prof.close()                            # safety stop at loop exit
+
+    ``clock`` follows the platform's injectable-Clock contract
+    (:mod:`kubeflow_tpu.utils.clock`): the capture-window wall time it
+    measures (``last_capture_s``) is what the step-telemetry layer
+    subtracts so profiler overhead never reads as a straggling step.
     """
 
     def __init__(self, logdir: Optional[str], start: int = 10,
-                 n_steps: int = 3) -> None:
+                 n_steps: int = 3, clock: Optional[Clock] = None) -> None:
         self.logdir = logdir
         self.start = start
         self.stop = start + n_steps
+        self.clock: Clock = clock if clock is not None else time.monotonic
+        self.last_capture_s: Optional[float] = None
         self._tracing = False
+        self._t_start = 0.0
 
     @classmethod
-    def from_env(cls, environ=None) -> "StepProfiler":
+    def from_env(cls, environ=None,
+                 clock: Optional[Clock] = None) -> "StepProfiler":
         env = os.environ if environ is None else environ
         return cls(
             env.get(ENV_PROFILE_DIR) or None,
             start=int(env.get(ENV_PROFILE_START, "10")),
             n_steps=int(env.get(ENV_PROFILE_STEPS, "3")),
+            clock=clock,
         )
 
     @property
@@ -99,11 +112,14 @@ class StepProfiler:
             os.makedirs(self.logdir, exist_ok=True)
             jax.profiler.start_trace(self.logdir)
             self._tracing = True
+            self._t_start = self.clock()
         elif self._tracing and step >= self.stop:
             jax.profiler.stop_trace()
             self._tracing = False
-            log.info("profiler trace (steps %d..%d) written to %s",
-                     self.start, self.stop - 1, self.logdir)
+            self.last_capture_s = self.clock() - self._t_start
+            log.info("profiler trace (steps %d..%d, %.3fs) written to %s",
+                     self.start, self.stop - 1, self.last_capture_s,
+                     self.logdir)
 
     def close(self) -> None:
         if self._tracing:
@@ -111,4 +127,6 @@ class StepProfiler:
 
             jax.profiler.stop_trace()
             self._tracing = False
-            log.info("profiler trace written to %s", self.logdir)
+            self.last_capture_s = self.clock() - self._t_start
+            log.info("profiler trace (%.3fs) written to %s",
+                     self.last_capture_s, self.logdir)

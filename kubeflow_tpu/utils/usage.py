@@ -29,8 +29,12 @@ ENV_COLLECTOR_URL = "KFTPU_USAGE_COLLECTOR_URL"
 ENV_CLUSTER_ID = "KFTPU_USAGE_CLUSTER_ID"
 
 
-def build_report(client: KubeClient, cluster_id: str) -> Dict[str, Any]:
-    """The spartakus report shape: anonymous id + coarse cluster facts."""
+def build_report(client: KubeClient, cluster_id: str,
+                 now: Optional[float] = None) -> Dict[str, Any]:
+    """The spartakus report shape: anonymous id + coarse cluster facts.
+
+    ``now`` is the injectable epoch-seconds source (TPU003 contract;
+    this was the baseline's last utils-layer raw clock)."""
     try:
         nodes = client.list("v1", "Node")
     except ApiError:
@@ -46,7 +50,7 @@ def build_report(client: KubeClient, cluster_id: str) -> Dict[str, Any]:
         "version": kubeflow_tpu.__version__,
         "nodes": len(nodes),
         "tpuAccelerators": accelerators,
-        "timestamp": int(time.time()),
+        "timestamp": int(now if now is not None else time.time()),
     }
 
 

@@ -15,6 +15,11 @@
 #   3. obs smoke test (tests/test_obs.py): traceparent round-trip, span
 #      propagation proxy->server->engine, /api/traces, histograms
 #      (docs/OBSERVABILITY.md)
+#   4. training-telemetry smoke test (tests/test_step_telemetry.py):
+#      step clock + MFU/recompile accounting, flight-recorder dumps,
+#      beacons -> operator straggler status -> dashboard
+#      /api/jobs/<ns>/<name>/telemetry (docs/OBSERVABILITY.md
+#      training-plane section)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -29,6 +34,10 @@ python scripts/check_binary_blobs.py "$@" || rc=1
 echo "== preflight: obs smoke test =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py -q -m 'not slow' \
     -p no:cacheprovider || rc=1
+
+echo "== preflight: training-telemetry smoke test =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_step_telemetry.py -q \
+    -m 'not slow' -p no:cacheprovider || rc=1
 
 if [ "$rc" -ne 0 ]; then
     echo "preflight: FAILED" >&2

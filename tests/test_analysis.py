@@ -387,6 +387,33 @@ def test_tpu004_api_route_exact_and_prefix_match_ok():
     assert check(WiringChecker(), comp, svc, caller) == []
 
 
+def test_tpu004_jobs_telemetry_route_registered():
+    """The training-telemetry surface is in the dashboard's TPU004 route
+    table: the REAL dashboard/server.py (whose "/api/..." constants ARE
+    the table) accepts a caller URL under /api/jobs/, and a typo'd
+    variant is the drift the sub-rule exists to catch."""
+    rel = "kubeflow_tpu/dashboard/server.py"
+    with open(os.path.join(REPO, rel)) as f:
+        dash = ModuleInfo.from_source(rel, f.read())
+    comp = mod("""
+        DEFAULTS = {"name": "centraldashboard", "port": 80}
+        @register("centraldashboard", DEFAULTS, "d")
+        def render(config, params):
+            return [o.service_account("d", "ns"),
+                    o.cluster_role("d", []),
+                    o.cluster_role_binding("d", "d", "d", "ns")]
+    """, rel="kubeflow_tpu/manifests/components/dashboard.py")
+    good = mod("""
+        URL = "http://centraldashboard:80/api/jobs/ns/train/telemetry"
+    """, rel="kubeflow_tpu/operators/tpujob.py")
+    assert check(WiringChecker(), comp, dash, good) == []
+    bad = mod("""
+        URL = "http://centraldashboard:80/api/job-telemetry/ns/train"
+    """, rel="kubeflow_tpu/operators/tpujob.py")
+    f = check(WiringChecker(), comp, dash, bad)
+    assert len(f) == 1 and "/api/job-telemetry/ns/train" in f[0].message
+
+
 # -- TPU005 unbounded retry -------------------------------------------------
 
 def test_tpu005_while_true_sleep_no_exit():
