@@ -166,10 +166,27 @@ class MetricsAggregator:
 
     def observe_engine(self, model: str, engine,
                        now: Optional[float] = None) -> None:
-        """Poll a :class:`~kubeflow_tpu.serving.engine.DecodeEngine`."""
+        """Poll a :class:`~kubeflow_tpu.serving.engine.DecodeEngine`.
+
+        Paged engines report their page pool (``pages_total`` /
+        ``pages_free``): token-level occupancy. A few long-context
+        streams can exhaust KV pages while most slots sit free, so the
+        concurrency signal is the WORSE of slot occupancy and page
+        occupancy scaled to slot units — scale decisions then track
+        tokens, not just row count."""
         snap = engine.snapshot()
+        active = float(snap["active_slots"])
+        pages_total = float(snap.get("pages_total") or 0.0)
+        if pages_total > 0:
+            # evictable prefix-store pins are reclaimable cache, not
+            # load — an idle engine with a warm prefix cache must read
+            # as idle or it can never scale in
+            held = (pages_total - float(snap.get("pages_free", 0.0))
+                    - float(snap.get("pages_evictable", 0.0)))
+            util = max(0.0, held) / pages_total
+            active = max(active, util * float(snap.get("slots", 0.0)))
         self.observe(model, queue_depth=snap["pending"],
-                     active_slots=snap["active_slots"], now=now)
+                     active_slots=active, now=now)
 
     def tick(self, model: str, now: Optional[float] = None) -> None:
         """Record a no-event sample so idle seconds read as zero load

@@ -624,7 +624,7 @@ def engine_bench_setup(concurrency: int = 48, prompt_len: int = 128,
 
 
 def engine_drain(eng) -> None:
-    while eng.active_count or not eng._pending.empty():
+    while eng.active_count or eng.pending_count:
         eng.run_once(timeout=0.01)
 
 
@@ -632,7 +632,8 @@ def engine_throughput(config, params, prompts, *, slots: int,
                       steps_per_sync: int, new_tokens: int,
                       sampler_bound: Optional[int], sampled: bool,
                       sample_kw: Optional[Dict[str, Any]] = None,
-                      name: str = "bench"):
+                      sampler_impl: Optional[str] = None,
+                      paged: bool = False, name: str = "bench"):
     """tokens/sec through a fresh engine (params shared in HBM).
     Returns (tok/s/chip, engine steps, burst TTFT ms, batch prefills)."""
     import jax
@@ -643,6 +644,7 @@ def engine_throughput(config, params, prompts, *, slots: int,
     eng = DecodeEngine(config, params, slots=slots,
                        steps_per_sync=steps_per_sync,
                        sampler_bound=sampler_bound,
+                       sampler_impl=sampler_impl, paged=paged,
                        autostart=False, name=name)
 
     # warm the compiled programs: the row prefill, insert, step —
@@ -666,12 +668,21 @@ def engine_throughput(config, params, prompts, *, slots: int,
     t0 = time.perf_counter()
     reqs = [eng.submit(p, max_new=new_tokens, seed=i, **kw)
             for i, p in enumerate(prompts)]
-    # burst TTFT: admit the first wave explicitly (one _admit pass
-    # fills every free slot, and each request's first token is
-    # emitted during its prefill sample) and stamp BEFORE any
-    # decode step runs — the number batched admission improves
-    eng._admit(0.01)
     wave = reqs[:slots]
+    if paged:
+        # chunked prefill interleaves admissions with decode: burst
+        # TTFT is the wall time until EVERY wave member has its first
+        # token (decode of earlier admits proceeds meanwhile)
+        for _ in range(10000):
+            eng.run_once(timeout=0.01)
+            if all(r._seen or r.out.qsize() for r in wave):
+                break
+    else:
+        # burst TTFT: admit the first wave explicitly (one _admit pass
+        # fills every free slot, and each request's first token is
+        # emitted during its prefill sample) and stamp BEFORE any
+        # decode step runs — the number batched admission improves
+        eng._admit(0.01)
     first_all = (time.perf_counter() - t0
                  if all(r._seen or r.out.qsize() for r in wave)
                  else None)
@@ -717,23 +728,33 @@ def bench_decode_engine(concurrency: int = 48, slots: int = 32,
 
     sample_kw = {"temperature": 0.8, "top_k": 40, "top_p": 0.95}
 
-    def run_engine(sampler_bound: Optional[int], sampled: bool):
+    def run_engine(sampler_bound: Optional[int], sampled: bool,
+                   sampler_impl: Optional[str] = None,
+                   paged: bool = False):
         return engine_throughput(
             config, params, prompts, slots=slots,
             steps_per_sync=steps_per_sync, new_tokens=new_tokens,
             sampler_bound=sampler_bound, sampled=sampled,
-            sample_kw=sample_kw)
+            sample_kw=sample_kw, sampler_impl=sampler_impl, paged=paged)
 
-    # three sampler modes at the same effective batch: greedy rides the
-    # argmax fast-path step; "sampled" pays the per-row sampler — the
-    # lax.top_k-bounded sampler vs the exact full-vocab-sort sampler is
-    # the PERF.md kept/rejected lever (32 vocab sorts per token at
-    # slots=32 on the exact path)
+    # sampler modes at the same effective batch: greedy rides the
+    # argmax fast-path step; "sampled" pays the per-row sampler. The
+    # BENCH_r05 lever was bounded-vs-exact-sort (~2.4× tax for correct
+    # sampling at slots=32); the fused Pallas kernel
+    # (ops/sampling.py) is the exact path that must close that gap.
     bound = int(os.environ.get("KFTPU_SAMPLER_BOUND", "64"))
     greedy_tps, engine_steps, ttft_ms, batch_prefills = run_engine(
         bound, sampled=False)
     sampled_bounded_tps, _, _, _ = run_engine(bound, sampled=True)
-    sampled_exact_tps, _, _, _ = run_engine(0, sampled=True)
+    sampled_exact_tps, _, _, _ = run_engine(
+        0, sampled=True, sampler_impl="exact_sort")
+    sampled_fused_tps, _, _, _ = run_engine(
+        0, sampled=True, sampler_impl="fused")
+    # paged-vs-dense: same greedy workload through the paged KV cache
+    # + chunked-prefill admission (burst TTFT is the headline there —
+    # whole-prompt prefills no longer block the decode loop)
+    paged_tps, _, paged_ttft_ms, _ = run_engine(
+        bound, sampled=False, paged=True)
     if profile_dir:
         # trace a short greedy engine run. jit caches are per engine
         # instance, so this engine precompiles its step programs and
@@ -757,6 +778,9 @@ def bench_decode_engine(concurrency: int = 48, slots: int = 32,
         "tokens_per_sec_per_chip": greedy_tps,
         "sampled_bounded_tokens_per_sec_per_chip": sampled_bounded_tps,
         "sampled_exact_sort_tokens_per_sec_per_chip": sampled_exact_tps,
+        "sampled_exact_fused_tokens_per_sec_per_chip": sampled_fused_tps,
+        "paged_tokens_per_sec_per_chip": paged_tps,
+        "paged_burst_first_tokens_ms": paged_ttft_ms,
         "burst_first_tokens_ms": ttft_ms,
         "batch_prefills": batch_prefills,
         "sampler_bound": bound,
@@ -978,9 +1002,13 @@ def run_all_isolated(only: Optional[list] = None,
             if attempt + 1 < probe_retries:
                 time.sleep(probe_wait_s)
         if not alive:
+            # error_kind is the STRUCTURED classification bench.py keys
+            # its exit code on — the free-text error is for humans and
+            # may be reworded freely
             return {name: {"error": "skipped: device transport "
                                     "unreachable at bench start "
-                                    f"({probe_retries} probes)"}
+                                    f"({probe_retries} probes)",
+                           "error_kind": "transport_unreachable"}
                     for name in names}
     for i, name in enumerate(names):
         args = [name]
@@ -994,14 +1022,16 @@ def run_all_isolated(only: Optional[list] = None,
                     os.path.dirname(os.path.abspath(__file__)))))
         except subprocess.TimeoutExpired:
             out[name] = {"error": f"timeout after {timeout_s:.0f}s "
-                                  "(device transport hung?)"}
+                                  "(device transport hung?)",
+                         "error_kind": "transport_timeout"}
             # killing a client mid-device-op can wedge the transport for
             # everyone after (see .claude/skills/verify gotchas): probe
             # before burning the full timeout on each remaining config
             if not _device_alive():
                 for rest in names[i + 1:]:
                     out[rest] = {"error": "skipped: device transport "
-                                          "wedged after timeout"}
+                                          "wedged after timeout",
+                                 "error_kind": "transport_wedged"}
                 break
             continue
         except OSError as e:

@@ -13,6 +13,10 @@ Route parity with the reference's Express server
   (per-model ready/warming/draining replicas, panic flag, events); fed
   by an in-process :class:`~kubeflow_tpu.autoscale.reconciler.Autoscaler`
   or proxied from the autoscaler service (``KFTPU_AUTOSCALE_URL``)
+- ``GET /api/metrics/engine``      — the decode-engine series for the
+  serving panel: slot occupancy, queue depth, prefix-cache bytes, and
+  the paged-cache gauges ``kftpu_engine_kv_pages_in_use`` /
+  ``kftpu_engine_prefill_chunks_total`` (docs/SERVING.md)
 - ``GET /api/workgroup/exists``    — profile/workgroup flow via kfam
   (``api_workgroup.ts``)
 - ``GET /api/dashboard-links``     — component cards for the UI shell
@@ -54,6 +58,10 @@ class RegistryMetricsService(MetricsService):
         "podcpu": "kftpu_",          # closest equivalents by prefix
         "podmem": "kftpu_",
         "cluster": "kftpu_",
+        # the serving panel's decode-engine series: occupancy, queue
+        # depth, and the paged-cache gauges (kv_pages_in_use,
+        # prefill_chunks_total — docs/SERVING.md)
+        "engine": "kftpu_engine_",
     }
 
     def __init__(self, registry=DEFAULT_REGISTRY) -> None:

@@ -106,6 +106,117 @@ def prefill_continue(config: TransformerConfig, params, cache,
     return last, new_cache
 
 
+def _is_key(path, name: str) -> bool:
+    return getattr(path[-1], "key", None) == name
+
+
+def _slot_view(cache, slot, start):
+    """A batch-1 view of one engine slot against the SHARED paged pool.
+
+    ``positions``/``pages`` leaves narrow to the slot's row; pool
+    ``k``/``v`` leaves pass through whole (every slot writes the same
+    pool, disjoint pages). The view's position is OVERRIDDEN with the
+    host-authoritative ``start``: between two chunks of the same slot
+    the engine's decode step advances the device-side position of every
+    row (idle rows decode garbage by design), so the device value for a
+    mid-prefill slot is drift, not truth.
+    """
+    def narrow(path, leaf):
+        if _is_key(path, "positions"):
+            return jnp.full(leaf.shape[:-1] + (1,),
+                            start).astype(leaf.dtype)
+        if _is_key(path, "pages"):
+            return jax.lax.dynamic_slice_in_dim(
+                leaf, slot, 1, axis=leaf.ndim - 2)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(narrow, cache)
+
+
+def _slot_merge(cache, view, slot, new_pos):
+    """Write a :func:`_slot_view` back: the slot's position becomes
+    ``new_pos`` (true tokens, not the padded width the apply advanced
+    by), its page row round-trips, and the pool leaves are taken from
+    the view (the apply mutated them in place)."""
+    def widen(path, big, small):
+        if _is_key(path, "positions"):
+            row = jnp.full(big.shape[:-1] + (1,),
+                           new_pos).astype(big.dtype)
+            return jax.lax.dynamic_update_slice_in_dim(
+                big, row, slot, axis=big.ndim - 1)
+        if _is_key(path, "pages"):
+            return jax.lax.dynamic_update_slice_in_dim(
+                big, small, slot, axis=big.ndim - 2)
+        return small
+
+    return jax.tree_util.tree_map_with_path(widen, cache, view)
+
+
+def arm_slot(cache, slot, start, page_row):
+    """Point one slot's device-side position/page-table rows at host
+    truth — the paged engine's admission, page growth, and retirement
+    are this one tiny program (page-map surgery), never a KV copy.
+
+    Lives beside :func:`_slot_view`/:func:`_slot_merge` because the
+    three share the paged-cache leaf contract ("positions" rows on the
+    last axis, "pages" rows on the second-to-last); pool leaves pass
+    through untouched. Jit with ``donate_argnums=(0,)``.
+    """
+    slot = jnp.asarray(slot, jnp.int32)
+
+    def upd(path, leaf):
+        if _is_key(path, "positions"):
+            row = jnp.full(leaf.shape[:-1] + (1,),
+                           start).astype(leaf.dtype)
+            return jax.lax.dynamic_update_slice_in_dim(
+                leaf, row, slot, axis=leaf.ndim - 1)
+        if _is_key(path, "pages"):
+            row = jnp.broadcast_to(
+                page_row,
+                leaf.shape[:-2] + (1,) + page_row.shape).astype(
+                    leaf.dtype)
+            return jax.lax.dynamic_update_slice_in_dim(
+                leaf, row, slot, axis=leaf.ndim - 2)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(upd, cache)
+
+
+def prefill_chunk(config: TransformerConfig, params, cache,
+                  tokens: jnp.ndarray, slot, start, true_n):
+    """One prompt chunk for ONE slot of a PAGED decode cache.
+
+    The chunked-prefill primitive (``config.kv_page_size > 0``): the
+    engine splits prompts into fixed-width chunks and runs one chunk
+    per scheduler cycle, so a long admission never stalls co-tenant
+    decode for more than one chunk's compute — and the whole prompt
+    path needs ONE compiled program (one chunk shape), not one per
+    prompt bucket.
+
+    ``tokens``: (1, C) right-padded chunk; ``slot``: engine row the
+    chunk belongs to; ``start``: the slot's true position before this
+    chunk (0 for a fresh prompt, the shared-page boundary on a prefix
+    hit, mid-prompt for every later chunk); ``true_n``: real tokens in
+    this chunk (< C only on the final, padded chunk — the pad tail's
+    garbage KV lands inside the slot's own pages, stays causally masked
+    while the position sits at ``start + true_n``, and is overwritten
+    by decode before it can be unmasked, exactly like prefill()'s pad
+    tail). Returns ``(logits of the last real token (1, V), cache)``.
+    """
+    model = _decode_model(config)
+    slot = jnp.asarray(slot, jnp.int32)
+    start = jnp.asarray(start, jnp.int32)
+    true_n = jnp.asarray(true_n, jnp.int32)
+    view = _slot_view(cache, slot, start)
+    logits, variables = model.apply({"params": params, "cache": view},
+                                    tokens, mutable=["cache"])
+    new_cache = _slot_merge(cache, variables["cache"], slot,
+                            start + true_n)
+    last = jnp.take_along_axis(
+        logits, (true_n - 1).reshape(1, 1, 1), axis=1)[:, 0]
+    return last, new_cache
+
+
 def decode_step(config: TransformerConfig, params, cache,
                 token: jnp.ndarray):
     """One token in, one token's logits out; cache advances by one."""

@@ -74,6 +74,17 @@ class TransformerConfig:
     # positions (speculative verification, ragged continuation) instead
     # of the contiguous shared-start prefill fast path
     ragged_decode: bool = False
+    # decode mode only: paged KV cache. 0 => dense per-row cache
+    # (B, max_seq_len, KH, Dh). >0 => the cache is a POOL of
+    # ``kv_pages`` HBM blocks of ``kv_page_size`` tokens each, shared
+    # by the batch through a per-row page table ("pages" cache var,
+    # (B, max_seq_len/kv_page_size) int32 of physical page ids; the
+    # sentinel value ``kv_pages`` marks an unmapped logical page —
+    # writes through it scatter-drop). The serving engine owns page
+    # allocation (kubeflow_tpu/serving/kvpool.py); the model only
+    # reads/writes through the table.
+    kv_page_size: int = 0
+    kv_pages: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -88,6 +99,13 @@ class TransformerConfig:
         if self.attention_impl not in ("dense", "blockwise", "flash",
                                        "ring", "ulysses"):
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
+        if self.kv_page_size:
+            if self.max_seq_len % self.kv_page_size:
+                raise ValueError(
+                    f"kv_page_size {self.kv_page_size} must divide "
+                    f"max_seq_len {self.max_seq_len}")
+            if self.kv_pages < 1:
+                raise ValueError("paged decode needs kv_pages >= 1")
 
 
 def _constrain(x, rules: AxisRules, *names):
@@ -201,6 +219,8 @@ class Attention(nn.Module):
         - step (S == 1): per-row scatter write + per-row rope position.
         """
         c = self.config
+        if c.kv_page_size:
+            return self._paged_decode_attend(q, k, v, sin_full, cos_full)
         B, S, KH, Dh = k.shape
         Smax = c.max_seq_len
 
@@ -262,6 +282,87 @@ class Attention(nn.Module):
         kv_pos = jnp.arange(Smax)
         # (B or 1, S, Smax): per-row causal bound
         mask = kv_pos[None, None, :] <= q_pos[:, :, None]
+        logits = jnp.where(mask[:, None], logits, NEG_INF)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhst,bthd->bshd", probs, vc)
+
+    def _paged_decode_attend(self, q, k, v, sin_full, cos_full):
+        """Autoregressive attention over a PAGED KV pool.
+
+        The cache is a pool of ``kv_pages`` HBM blocks of
+        ``kv_page_size`` tokens shared by the whole batch; each row maps
+        logical pages to physical pages through its "pages" table row.
+        One code path serves every S (step, prefill chunk, ragged
+        continuation): writes scatter each token to
+        ``(pages[b, pos // ps], pos % ps)`` and reads gather the row's
+        logical view back to ``(B, max_seq_len, KH, Dh)`` before the
+        exact attention math of the dense path — live positions carry
+        identical values, garbage positions are masked to NEG_INF
+        exactly as dense masks its unwritten tail, so greedy decode is
+        token-identical to the dense cache.
+
+        Safety contract with the allocator (serving/kvpool.py):
+
+        - a logical page mapped to the sentinel id ``kv_pages`` (or a
+          position past ``max_seq_len``) writes out of bounds, which
+          scatter DROPS — idle/disarmed rows can step forever without
+          touching live pages;
+        - reads through the sentinel clamp to an arbitrary real page;
+          those positions are causally masked, and the exactly-zero
+          masked probabilities keep garbage out of the output bitwise;
+        - two rows never map the same WRITABLE page; prefix pages are
+          shared read-only (rows only write at positions >= their own
+          start, which the engine keeps past the shared region).
+        """
+        c = self.config
+        B, S, KH, Dh = k.shape
+        Smax = c.max_seq_len
+        ps = c.kv_page_size
+        n_log = Smax // ps
+        P = c.kv_pages
+
+        pos_var = self.variable("cache", "positions",
+                                lambda: jnp.zeros((B,), jnp.int32))
+        pages_var = self.variable(
+            "cache", "pages", lambda: jnp.full((B, n_log), P, jnp.int32))
+        ck = self.variable("cache", "k", jnp.zeros, (P, ps, KH, Dh),
+                           c.dtype)
+        cv = self.variable("cache", "v", jnp.zeros, (P, ps, KH, Dh),
+                           c.dtype)
+        pos = pos_var.value        # (B,)
+        pages = pages_var.value    # (B, n_log)
+
+        from kubeflow_tpu.ops.attention import NEG_INF, gqa_repeat
+
+        q_pos = pos[:, None] + jnp.arange(S)[None, :]       # (B, S)
+        safe_pos = jnp.minimum(q_pos, Smax - 1)
+        sin = jnp.take(sin_full, safe_pos, axis=0)[:, :, None, :].astype(
+            q.dtype)
+        cos = jnp.take(cos_full, safe_pos, axis=0)[:, :, None, :].astype(
+            q.dtype)
+        q = _rotate(q, sin, cos)
+        k = _rotate(k, sin, cos)
+        # physical write targets; overruns and unmapped pages resolve to
+        # pool index P, which the scatter drops
+        pg = jnp.take_along_axis(pages, safe_pos // ps, axis=1)  # (B, S)
+        pg = jnp.where(q_pos < Smax, pg, P)
+        off = q_pos % ps
+        ck.value = ck.value.at[pg, off].set(k, mode="drop")
+        cv.value = cv.value.at[pg, off].set(v, mode="drop")
+        pos_var.value = pos + S
+
+        # gather each row's logical view: (B, n_log, ps, KH, Dh) ->
+        # (B, Smax, KH, Dh); sentinel entries clamp to a real page and
+        # are masked below
+        kc = jnp.take(ck.value, pages, axis=0,
+                      mode="clip").reshape(B, Smax, KH, Dh)
+        vc = jnp.take(cv.value, pages, axis=0,
+                      mode="clip").reshape(B, Smax, KH, Dh)
+        kc, vc = gqa_repeat(q, kc, vc)
+        logits = jnp.einsum("bshd,bthd->bhst", q, kc).astype(jnp.float32)
+        logits = logits * (Dh ** -0.5)
+        kv_pos = jnp.arange(Smax)
+        mask = kv_pos[None, None, :] <= q_pos[:, :, None]   # (B, S, Smax)
         logits = jnp.where(mask[:, None], logits, NEG_INF)
         probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
         return jnp.einsum("bhst,bthd->bshd", probs, vc)

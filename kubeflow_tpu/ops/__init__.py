@@ -1,4 +1,5 @@
-"""TPU compute ops: attention kernels, collectives, MoE dispatch."""
+"""TPU compute ops: attention kernels, collectives, MoE dispatch,
+fused sampling."""
 
 from kubeflow_tpu.ops.attention import (  # noqa: F401
     blockwise_attention,
@@ -24,3 +25,4 @@ from kubeflow_tpu.ops.moe import (  # noqa: F401
     capacity_moe,
     expert_capacity,
 )
+from kubeflow_tpu.ops.sampling import fused_sample  # noqa: F401

@@ -64,10 +64,17 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubeflow_tpu.models.decode import (
+    arm_slot,
     decode_step,
     prefill,
+    prefill_chunk,
     prefill_continue,
     sample_logits,
+)
+from kubeflow_tpu.serving.kvpool import (
+    OutOfPages,
+    PagePool,
+    PrefixPageStore,
 )
 from kubeflow_tpu.obs import (
     SpanContext,
@@ -101,6 +108,12 @@ _prefix_budget_g = DEFAULT_REGISTRY.gauge(
 _queue_wait_h = DEFAULT_REGISTRY.histogram(
     "engine_queue_wait_seconds",
     "time a generate request waits for a decode slot")
+_kv_pages_g = DEFAULT_REGISTRY.gauge(
+    "kftpu_engine_kv_pages_in_use",
+    "physical KV pages allocated out of the paged engine's pool")
+_prefill_chunks_c = DEFAULT_REGISTRY.counter(
+    "kftpu_engine_prefill_chunks_total",
+    "prompt chunks prefilled by the paged engine's interleaved scheduler")
 
 _END = object()  # per-request stream sentinel
 
@@ -120,7 +133,17 @@ class _CacheInvalidated(RuntimeError):
 def pow2_bucket(n: int, cap: int) -> int:
     """Round ``n`` up to a power of two, capped at ``cap`` — the shared
     compiled-program bucketing rule for prompts (one compiled prefill
-    per bucket, in both the unary path and engine admission)."""
+    per bucket, in both the unary path and engine admission).
+
+    Total on its edges (chunked prefill makes bucket selection hot, so
+    callers no longer pre-clamp): ``n <= 0`` buckets to the smallest
+    program (1), ``n >= cap`` to exactly ``cap`` — even a non-power-of-
+    two cap, which is its own terminal bucket (the max_seq_len program).
+    """
+    if cap < 1:
+        raise ValueError(f"pow2_bucket cap must be >= 1, got {cap}")
+    if n >= cap:
+        return cap
     b = 1
     while b < n:
         b *= 2
@@ -188,6 +211,30 @@ class _Slot:
     # the device-facing step/token state lives in the engine's host-side
     # arrays (_stepidx/_tokens) — the slot only tracks delivery
     t_decode0: float = 0.0  # decode-phase start (the decode span's start)
+    # every token emitted, in order — the cache-recovery replay prompt
+    # is (request prompt + emitted); delivery itself rides req.out
+    emitted: List[int] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class _PrefillJob:
+    """A slot mid-chunked-prefill (paged engine): the prompt feeds the
+    pool one fixed-width chunk per scheduler cycle, interleaved with
+    co-tenant decode steps."""
+
+    req: _Request
+    slot: int
+    tokens: np.ndarray        # full token sequence to prefill
+    next: int                 # next position to feed (== start after arm)
+    t_admit: float = 0.0
+    chunks: int = 0
+    # replay (cache-recovery) jobs resume a live stream: the first
+    # sampled token continues at the preserved fold index and the
+    # delivery counter, instead of starting a fresh request at fold 0
+    fold0: int = 0
+    produced0: int = 0
+    store_prefix: int = 0     # aligned prefix tokens to pin after prefill
+    last_tok: int = 0         # sampled next token, set by the final chunk
 
 
 class DecodeEngine:
@@ -203,13 +250,33 @@ class DecodeEngine:
                  prefix_cache_entries: int = 4,
                  prefix_cache_bytes: Optional[int] = None,
                  sampler_bound: Optional[int] = None,
+                 sampler_impl: Optional[str] = None,
                  admit_batch_max: Optional[int] = None,
+                 paged: Optional[bool] = None,
+                 kv_page_size: Optional[int] = None,
+                 kv_pages: Optional[int] = None,
+                 prefill_chunk_tokens: Optional[int] = None,
+                 prefill_chunks_per_cycle: int = 1,
+                 recoveries: Optional[int] = None,
                  precompile: bool = False,
                  autostart: bool = True, name: str = "",
                  clock: Optional[Clock] = None,
                  tracer: Optional[Tracer] = None) -> None:
         self.config = config
         self.slots = slots
+        # paged KV cache + chunked prefill (docs/SERVING.md). Dense mode
+        # remains the parity oracle and the default; KFTPU_PAGED=1 flips
+        # a deployment fleet-wide without code changes.
+        if paged is None:
+            paged = os.environ.get("KFTPU_PAGED", "0") not in ("0", "")
+        self.paged = bool(paged)
+        # cache-recovery budget: a donated-cache failure rebuilds the
+        # pool and replays in-flight slots this many times before the
+        # engine gives up and self-closes (the old, always-close path)
+        if recoveries is None:
+            recoveries = int(os.environ.get("KFTPU_ENGINE_RECOVERIES",
+                                            "2"))
+        self._recoveries_left = max(0, int(recoveries))
         # host-side timing source for queue-wait/admit/decode spans; a
         # fake clock makes engine span trees deterministic in tests
         self.clock: Clock = clock if clock is not None else time.monotonic
@@ -226,6 +293,57 @@ class DecodeEngine:
             sampler_bound = int(os.environ.get("KFTPU_SAMPLER_BOUND",
                                                "64"))
         self.sampler_bound = int(sampler_bound)
+        # sampler implementation: "bounded" (lax.top_k, truncating —
+        # the historical fast path), "exact_sort" (full-vocab sort —
+        # the historical exact path), "fused" (ops/sampling.py Pallas
+        # kernel: exact support at bounded cost). "auto" keeps the
+        # bounded path when a bound is set and upgrades the exact path
+        # (bound 0) to the fused kernel, so sampler_bound stops being a
+        # correctness/perf tradeoff.
+        if sampler_impl is None:
+            sampler_impl = os.environ.get("KFTPU_SAMPLER_IMPL", "auto")
+        if sampler_impl == "auto":
+            sampler_impl = ("bounded" if self.sampler_bound > 0
+                            else "fused")
+        if sampler_impl not in ("bounded", "exact_sort", "fused"):
+            raise ValueError(
+                f"unknown sampler_impl {sampler_impl!r}; valid: auto, "
+                "bounded, exact_sort, fused")
+        self.sampler_impl = sampler_impl
+        # paged-cache geometry: page size defaults to the largest
+        # power-of-two divisor of max_seq_len up to 64; the pool
+        # defaults to full provisioning (slots × pages-per-row), and a
+        # smaller kv_pages sizes HBM by LIVE tokens instead of
+        # slots × max_len (admission then gates on free pages)
+        Smax = config.max_seq_len
+        if self.paged:
+            if kv_page_size is None:
+                env = os.environ.get("KFTPU_KV_PAGE_SIZE")
+                kv_page_size = int(env) if env else 0
+            if not kv_page_size:
+                kv_page_size = 1
+                while (kv_page_size < 64
+                       and Smax % (kv_page_size * 2) == 0):
+                    kv_page_size *= 2
+            self.kv_page_size = int(kv_page_size)
+            self._n_logical = Smax // self.kv_page_size
+            if kv_pages is None:
+                env = os.environ.get("KFTPU_KV_PAGES")
+                kv_pages = int(env) if env else slots * self._n_logical
+            self.kv_pages = int(kv_pages)
+            if prefill_chunk_tokens is None:
+                env = os.environ.get("KFTPU_PREFILL_CHUNK")
+                prefill_chunk_tokens = int(env) if env else min(256, Smax)
+            self.prefill_chunk_tokens = max(1, int(prefill_chunk_tokens))
+            self.prefill_chunks_per_cycle = max(
+                1, int(prefill_chunks_per_cycle))
+            self._cfg = dataclasses.replace(
+                config, kv_page_size=self.kv_page_size,
+                kv_pages=self.kv_pages)
+        else:
+            self.kv_page_size = 0
+            self.kv_pages = 0
+            self._cfg = config
         # burst admission: same-bucket pending requests prefill as ONE
         # batch of up to this many rows. The cap bounds the transient
         # HBM spike (a batch prefill materializes that many extra
@@ -265,16 +383,48 @@ class DecodeEngine:
             self._mesh_ctx = contextlib.nullcontext
 
         Smax = config.max_seq_len
-        bnd = self.sampler_bound if self.sampler_bound > 0 else None
+        impl = self.sampler_impl
+        bnd = (self.sampler_bound
+               if impl == "bounded" and self.sampler_bound > 0 else None)
+
+        def sample_rows(logits, seeds, idx, temps, tks, tps):
+            """Per-row sampling under the engine's fold_in(key(seed),
+            step) reproducibility contract, dispatched to the
+            configured sampler implementation. (B, V) logits in, (B,)
+            int32 tokens out; every parameter is per-row."""
+            if impl == "fused":
+                from kubeflow_tpu.ops.sampling import fused_sample
+
+                keys = jax.vmap(lambda s, i: jax.random.fold_in(
+                    jax.random.key(s), i))(seeds, idx)
+                return fused_sample(logits, keys, temperature=temps,
+                                    top_k=tks, top_p=tps)
+
+            def one(row_logits, seed, i, t, k, p):
+                key = jax.random.fold_in(jax.random.key(seed), i)
+                return sample_logits(row_logits[None], key,
+                                     temperature=t, top_k=k, top_p=p,
+                                     bound=bnd)[0]
+
+            return jax.vmap(one)(logits, seeds, idx, temps, tks, tps)
+
+        self._sample_rows = sample_rows
+
+        def _sample1(logits, seed, fold, temperature, top_k, top_p):
+            """One row through the shared sampler (prefill's first
+            token; the paged path's post-chunk sample, where ``fold``
+            continues a replayed stream's step index)."""
+            return sample_rows(
+                logits, jnp.reshape(seed, (1,)), jnp.reshape(fold, (1,)),
+                jnp.reshape(temperature, (1,)), jnp.reshape(top_k, (1,)),
+                jnp.reshape(top_p, (1,)))[0]
 
         @jax.jit
         def _prefill_and_sample(params, prompt, true_len, temperature,
-                                top_k, top_p, seed):
+                                top_k, top_p, seed, fold):
             logits, cache = prefill(config, params, prompt, true_len)
-            key = jax.random.fold_in(jax.random.key(seed), 0)
-            tok = sample_logits(logits, key, temperature=temperature,
-                                top_k=top_k, top_p=top_p, bound=bnd)
-            return tok[0], cache
+            tok = _sample1(logits, seed, fold, temperature, top_k, top_p)
+            return tok, cache
 
         @jax.jit
         def _continue_and_sample(params, cache, suffix, suffix_len,
@@ -282,10 +432,9 @@ class DecodeEngine:
                                  seed):
             logits, cache = prefill_continue(
                 config, params, cache, suffix, suffix_len, total_len)
-            key = jax.random.fold_in(jax.random.key(seed), 0)
-            tok = sample_logits(logits, key, temperature=temperature,
-                                top_k=top_k, top_p=top_p, bound=bnd)
-            return tok[0], cache
+            tok = _sample1(logits, seed, jnp.int32(0), temperature,
+                           top_k, top_p)
+            return tok, cache
 
         @jax.jit
         def _prefill_batch_and_sample(params, prompts, true_lens, temps,
@@ -296,17 +445,30 @@ class DecodeEngine:
             (the decode core's contract). Burst time-to-first-token
             drops from B×prefill to ~one batched prefill."""
             logits, cache = prefill(config, params, prompts, true_lens)
-
-            def one(row_logits, seed, t, k, p):
-                key = jax.random.fold_in(jax.random.key(seed), 0)
-                return sample_logits(row_logits[None], key,
-                                     temperature=t, top_k=k, top_p=p,
-                                     bound=bnd)[0]
-
-            toks = jax.vmap(one)(logits, seeds, temps, top_ks, top_ps)
+            toks = sample_rows(logits, seeds,
+                               jnp.zeros_like(seeds), temps, top_ks,
+                               top_ps)
             return toks, cache
 
         self._prefill_batch = _prefill_batch_and_sample
+
+        def _chunk_and_sample(params, cache, tokens, slot, start, true_n,
+                              seed, fold, temperature, top_k, top_p):
+            """One paged prefill chunk + the post-chunk sample. The
+            sample is only consumed on a job's FINAL chunk (the logits
+            feed the stream's next token); earlier chunks pay the one
+            extra row-sample so the whole prompt path stays a single
+            compiled program."""
+            logits, cache = prefill_chunk(self._cfg, params, cache,
+                                          tokens, slot, start, true_n)
+            tok = _sample1(logits, seed, fold, temperature, top_k, top_p)
+            return tok, cache
+
+        self._chunk = jax.jit(_chunk_and_sample, donate_argnums=(1,))
+
+        # page-map surgery program (models/decode.py:arm_slot — the
+        # paged-cache leaf contract lives in ONE module)
+        self._arm = jax.jit(arm_slot, donate_argnums=(0,))
 
         def _insert_rows(engine_cache, batch_cache, slot_ids, valid):
             """Insert every valid batch-prefill row into its engine slot
@@ -371,16 +533,12 @@ class DecodeEngine:
                   top_p):
             """K decode steps under one jit; returns (cache, (K, B))."""
 
-            def one(row_logits, seed, idx, t, k, p):
-                key = jax.random.fold_in(jax.random.key(seed), idx)
-                return sample_logits(row_logits[None], key, temperature=t,
-                                     top_k=k, top_p=p, bound=bnd)[0]
-
             def body(carry, t):
                 cache, tokens = carry
-                logits, cache = decode_step(config, params, cache, tokens)
-                nxt = jax.vmap(one)(logits, seeds, step_idx + t, temps,
-                                    top_k, top_p)
+                logits, cache = decode_step(self._cfg, params, cache,
+                                            tokens)
+                nxt = sample_rows(logits, seeds, step_idx + t, temps,
+                                  top_k, top_p)
                 return (cache, nxt), nxt
 
             (cache, _), toks = jax.lax.scan(
@@ -395,7 +553,8 @@ class DecodeEngine:
 
             def body(carry, _):
                 cache, tokens = carry
-                logits, cache = decode_step(config, params, cache, tokens)
+                logits, cache = decode_step(self._cfg, params, cache,
+                                            tokens)
                 nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 return (cache, nxt), nxt
 
@@ -407,16 +566,59 @@ class DecodeEngine:
         self._step_greedy = jax.jit(_step_greedy, donate_argnums=(1,))
         self._prefill = _prefill_and_sample
 
-        # engine cache: the decode cache shape at batch = slots, zeroed.
-        # eval_shape on prefill gives the layout without running it.
+        # engine cache: the decode cache shape at batch = slots. eval_
+        # shape on prefill gives the layout without running it. Paged
+        # mode: only positions/pages carry the batch axis — the k/v POOL
+        # is batch-free (kv_pages blocks shared by every slot), which is
+        # exactly how cache HBM decouples from slots × max_len.
         probe = jnp.zeros((1, 1), jnp.int32)
         shapes = jax.eval_shape(
-            lambda p: prefill(config, p, probe)[1], params)
-        # a stored prefix row IS this batch-1 full-context cache — its
-        # byte size anchors the prefix-cache budget
-        self._prefix_row_bytes = int(sum(
-            int(np.prod(s.shape)) * jnp.dtype(s.dtype).itemsize
-            for s in jax.tree_util.tree_leaves(shapes)))
+            lambda p: prefill(self._cfg, p, probe)[1], params)
+
+        def _leaf_kind(path) -> str:
+            key = getattr(path[-1], "key", None)
+            return key if key in ("positions", "pages") else "kv"
+
+        def _engine_shape(path, s):
+            if self.paged:
+                kind = _leaf_kind(path)
+                if kind == "positions":
+                    return s.shape[:-1] + (slots,)
+                if kind == "pages":
+                    return s.shape[:-2] + (slots,) + s.shape[-1:]
+                return s.shape
+            return tuple(slots if a == _batch_axis(s) else d
+                         for a, d in enumerate(s.shape))
+
+        def _init_leaf(path, s):
+            shape = _engine_shape(path, s)
+            if self.paged:
+                kind = _leaf_kind(path)
+                if kind == "positions":
+                    # disarmed: writes past max_seq_len scatter-drop
+                    return jnp.full(shape, Smax, s.dtype)
+                if kind == "pages":
+                    return jnp.full(shape, self.kv_pages, s.dtype)
+            return jnp.zeros(shape, s.dtype)
+
+        def _zeros_tree():
+            return jax.tree_util.tree_map_with_path(_init_leaf, shapes)
+
+        if self.paged:
+            # one physical page's bytes across the stacked k/v pool
+            # leaves — the paged prefix store budgets in PAGES
+            self._page_bytes = int(sum(
+                int(np.prod(s.shape)) // self.kv_pages
+                * jnp.dtype(s.dtype).itemsize
+                for p, s in jax.tree_util.tree_leaves_with_path(shapes)
+                if _leaf_kind(p) == "kv"))
+            self._prefix_row_bytes = self._page_bytes * self._n_logical
+        else:
+            # a stored prefix row IS this batch-1 full-context cache —
+            # its byte size anchors the prefix-cache budget
+            self._prefix_row_bytes = int(sum(
+                int(np.prod(s.shape)) * jnp.dtype(s.dtype).itemsize
+                for s in jax.tree_util.tree_leaves(shapes)))
         if prefix_cache_bytes is None:
             env = os.environ.get("KFTPU_PREFIX_CACHE_BYTES")
             prefix_cache_bytes = int(env) if env else None
@@ -426,15 +628,8 @@ class DecodeEngine:
         self._prefix_budget_bytes = max(0, int(prefix_cache_bytes))
         _prefix_budget_g.set(self._prefix_budget_bytes, model=self.name)
 
-        def _engine_shape(s):
-            return tuple(slots if a == _batch_axis(s) else d
-                         for a, d in enumerate(s.shape))
-
-        def _zeros_tree():
-            return jax.tree_util.tree_map(
-                lambda s: jnp.zeros(_engine_shape(s), s.dtype), shapes)
-
         if mesh is None:
+            self._fresh_cache = _zeros_tree
             self._cache = _zeros_tree()
         else:
             # k/v leaves shard their kv-heads axis (rank-2 from the end)
@@ -448,8 +643,8 @@ class DecodeEngine:
                 shape_aware_spec,
             )
 
-            def _sharding(s):
-                shape = _engine_shape(s)
+            def _sharding(path, s):
+                shape = _engine_shape(path, s)
                 names = [None] * len(shape)
                 if len(shape) >= 4:
                     names[-2] = "heads"
@@ -458,11 +653,17 @@ class DecodeEngine:
                     mesh)
                 return NamedSharding(mesh, spec)
 
-            with self._mesh_ctx():
-                self._cache = jax.jit(
-                    _zeros_tree,
-                    out_shardings=jax.tree_util.tree_map(
-                        _sharding, shapes))()
+            sharded_zeros = jax.jit(
+                _zeros_tree,
+                out_shardings=jax.tree_util.tree_map_with_path(
+                    _sharding, shapes))
+
+            def _fresh_sharded():
+                with self._mesh_ctx():
+                    return sharded_zeros()
+
+            self._fresh_cache = _fresh_sharded
+            self._cache = _fresh_sharded()
         # host-side per-slot sampling state, padded to the batch
         self._tokens = np.zeros((slots,), np.int32)
         self._seeds = np.zeros((slots,), np.int32)
@@ -474,6 +675,25 @@ class DecodeEngine:
         self.tokens_total = 0
         self.greedy_steps = 0  # steps served by the argmax fast path
         self.batch_prefills = 0  # burst admissions served batched
+        self.prefill_chunks = 0  # chunk programs run (paged scheduler)
+        self.recoveries = 0      # cache rebuild-and-replay events
+        if self.paged:
+            self._pool = PagePool(self.kv_pages, self.kv_page_size,
+                                  slots, self._n_logical)
+            budget_pages = self._prefix_budget_bytes // max(
+                1, self._page_bytes)
+            self._prefix_pages = PrefixPageStore(self._pool, budget_pages)
+            # slots mid-chunked-prefill, oldest first (insertion order)
+            self._prefilling: "collections.OrderedDict[int, _PrefillJob]" \
+                = collections.OrderedDict()
+            # head-of-line requests admission popped but could not place
+            # (no free slot pages yet) — FIFO order is preserved
+            self._waiting: "collections.deque[_Request]" = \
+                collections.deque()
+            # host-authoritative per-slot position (the device value
+            # drifts for idle/prefilling rows by design)
+            self._pos_host = np.zeros((slots,), np.int64)
+            self._slot_budget = np.zeros((slots,), np.int64)
         if precompile:
             self._precompile_steps()
         if autostart:
@@ -508,14 +728,29 @@ class DecodeEngine:
             raise ValueError(
                 f"prompt {prompt.size} + max_new {max_new} exceeds "
                 f"context {self.config.max_seq_len}")
+        if self.paged:
+            # a request whose worst case exceeds the whole pool can
+            # NEVER reserve (even with every prefix entry evicted) —
+            # admitting it would wedge the strict-FIFO head of line
+            # forever, so reject it here instead
+            need = self._pool.pages_needed(prompt.size + max_new)
+            if need > self._pool.pages_total:
+                raise ValueError(
+                    f"prompt {prompt.size} + max_new {max_new} needs "
+                    f"{need} KV pages but the pool holds only "
+                    f"{self._pool.pages_total} — raise kv_pages or "
+                    f"shrink the request")
         prefix_len = int(prefix_len)
         if prefix_len and not 0 < prefix_len < prompt.size:
             raise ValueError(
                 f"prefix_len {prefix_len} must be in (0, prompt length "
                 f"{prompt.size}) — the suffix may not be empty")
-        if self._prefix_budget_bytes < self._prefix_row_bytes:
+        if (not self.paged
+                and self._prefix_budget_bytes < self._prefix_row_bytes):
             # cache disabled, or one full-context row alone would bust
-            # the byte budget: honor it by serving the full prefill
+            # the byte budget: honor it by serving the full prefill.
+            # (Paged mode shares at PAGE granularity — its store
+            # enforces the page budget per entry itself.)
             prefix_len = 0
         req = _Request(prompt=prompt, max_new=max_new,
                        temperature=float(temperature), top_k=int(top_k),
@@ -552,6 +787,11 @@ class DecodeEngine:
         with self._lock:
             active = [s.req for s in self._active if s is not None]
             self._active = [None] * self.slots
+            if self.paged:
+                active.extend(j.req for j in self._prefilling.values())
+                self._prefilling.clear()
+                active.extend(self._waiting)
+                self._waiting.clear()
             while True:
                 try:
                     active.append(self._pending.get_nowait())
@@ -569,24 +809,50 @@ class DecodeEngine:
 
     @property
     def active_count(self) -> int:
+        """Slots serving a stream: decoding, plus (paged) slots whose
+        prompt is still chunk-prefilling — they hold pages and a slot
+        either way."""
         with self._lock:
-            return sum(s is not None for s in self._active)
+            n = sum(s is not None for s in self._active)
+        if self.paged:
+            n += len(self._prefilling)
+        return n
 
     @property
     def pending_count(self) -> int:
         """Requests admitted to submit() but not yet holding a slot."""
-        return self._pending.qsize()
+        n = self._pending.qsize()
+        if self.paged:
+            n += len(self._waiting)
+        return n
 
     def snapshot(self) -> dict:
         """Occupancy snapshot for the autoscaler's engine poll
         (:meth:`kubeflow_tpu.autoscale.metrics.MetricsAggregator
         .observe_engine`): active slots are the concurrency the proxy
         can't see (one HTTP generate call hides a whole decode stream),
-        pending is the admission-queue depth."""
-        return {"active_slots": self.active_count,
+        pending is the admission-queue depth. Paged engines add the
+        page-pool fields the capacity planner reads — token-level
+        occupancy, which saturates long before slot count when contexts
+        run long."""
+        snap = {"active_slots": self.active_count,
                 "pending": self.pending_count,
                 "slots": self.slots,
                 "closed": self.closed}
+        if self.paged:
+            snap.update({
+                "paged": True,
+                "page_size": self.kv_page_size,
+                "pages_total": self._pool.pages_total,
+                "pages_free": self._pool.pages_free,
+                "pages_in_use": self._pool.pages_in_use,
+                "pages_reserved": self._pool.reserved_total,
+                # reclaimable prefix-store pins: occupancy consumers
+                # (autoscaler) subtract these — cache is not load
+                "pages_evictable": self._prefix_pages.pages_evictable,
+                "prefill_slots": len(self._prefilling),
+            })
+        return snap
 
     # -- engine internals --------------------------------------------------
 
@@ -609,7 +875,7 @@ class DecodeEngine:
         _, pcache = self._prefill(
             self._params, jnp.asarray(padded),
             jnp.asarray([N], jnp.int32), jnp.float32(0.0),
-            jnp.int32(0), jnp.float32(1.0), jnp.int32(0))
+            jnp.int32(0), jnp.float32(1.0), jnp.int32(0), jnp.int32(0))
         # byte-budget admission: evict LRU until the new row fits
         # (submit() already routed away callers that can never fit)
         while (self._prefix_store and self.prefix_cache_bytes
@@ -680,7 +946,7 @@ class DecodeEngine:
                         jnp.asarray([S], jnp.int32),
                         jnp.float32(req.temperature),
                         jnp.int32(req.top_k), jnp.float32(req.top_p),
-                        jnp.int32(req.seed))
+                        jnp.int32(req.seed), jnp.int32(0))
             self._cache = self._insert(self._cache, row_cache,
                                        jnp.int32(slot))
         self._finalize_admission(req, slot, int(tok))
@@ -704,6 +970,7 @@ class DecodeEngine:
 
     def _emit(self, slot: _Slot, token: int) -> None:
         slot.produced += 1
+        slot.emitted.append(token)
         self.tokens_total += 1
         _tokens_total.inc(model=self.name)
         slot.req.out.put(token)
@@ -716,10 +983,29 @@ class DecodeEngine:
         return done
 
     def run_once(self, timeout: float = 0.1) -> bool:
-        """One admit + step cycle; returns True if any work happened.
-        The background loop calls this forever; tests call it directly
-        (``autostart=False``) for deterministic schedules."""
-        worked = self._admit(timeout)
+        """One admit + prefill-chunk + step cycle; returns True if any
+        work happened. The background loop calls this forever; tests
+        call it directly (``autostart=False``) for deterministic
+        schedules. A donating device call that fails mid-decode is
+        recovered in place (cache rebuild + slot replay) while the
+        recovery budget lasts."""
+        if self.paged:
+            # admission arms slots (donating) and chunks donate the
+            # cache: every paged device call recovers under the same
+            # budget. Dense admission keeps its own per-request error
+            # handling (and _CacheInvalidated keeps the close protocol).
+            try:
+                worked = self._admit(timeout)
+                worked = self._prefill_tick() or worked
+            except _CacheInvalidated:
+                raise
+            except Exception:  # noqa: BLE001 — donated cache consumed
+                log.exception("paged admission/prefill failed")
+                if self._maybe_recover("paged admission/prefill"):
+                    return True
+                raise
+        else:
+            worked = self._admit(timeout)
         with self._lock:
             active = [(i, s) for i, s in enumerate(self._active)
                       if s is not None]
@@ -729,17 +1015,32 @@ class DecodeEngine:
         # active slot is greedy the cheap argmax step is bit-identical
         # — and skips the per-row sampler (vocab sort) each token
         all_greedy = all(s.req.temperature <= 0.0 for _, s in active)
-        with self._mesh_ctx():
-            if all_greedy:
-                self._cache, toks = self._step_greedy(
-                    self._params, self._cache, jnp.asarray(self._tokens))
-            else:
-                self._cache, toks = self._step(
-                    self._params, self._cache, jnp.asarray(self._tokens),
-                    jnp.asarray(self._seeds), jnp.asarray(self._stepidx),
-                    jnp.asarray(self._temps), jnp.asarray(self._topk),
-                    jnp.asarray(self._topp))
-        toks = np.asarray(toks)  # (K, B)
+        t_step0 = self.clock()
+        try:
+            if self.paged:
+                # page growth arms device rows (donating) — same
+                # recovery scope as the step itself
+                self._ensure_pages(i for i, _ in active)
+            with self._mesh_ctx():
+                if all_greedy:
+                    self._cache, toks = self._step_greedy(
+                        self._params, self._cache,
+                        jnp.asarray(self._tokens))
+                else:
+                    self._cache, toks = self._step(
+                        self._params, self._cache,
+                        jnp.asarray(self._tokens),
+                        jnp.asarray(self._seeds),
+                        jnp.asarray(self._stepidx),
+                        jnp.asarray(self._temps), jnp.asarray(self._topk),
+                        jnp.asarray(self._topp))
+            toks = np.asarray(toks)  # (K, B); the transfer surfaces
+            # device-side failures HERE, while recovery can still replay
+        except Exception:  # noqa: BLE001 — donated cache consumed
+            log.exception("decode step failed")
+            if self._maybe_recover("decode step"):
+                return True
+            raise
         K = toks.shape[0]
         self.steps_total += K
         if all_greedy:
@@ -747,6 +1048,14 @@ class DecodeEngine:
         _steps_total.inc(K, model=self.name)
         self._stepidx += K
         self._tokens = toks[-1].copy()
+        if self.paged:
+            self._pos_host[[i for i, _ in active]] += K
+            # one span per shared step: the burst-interleave evidence
+            # (chunk spans between step spans bound any decode stall)
+            self.tracer.record(
+                "engine.step", start=t_step0, end=self.clock(),
+                attrs={"model": self.name, "rows": len(active), "k": K})
+        retired: List[int] = []
         for i, slot in active:
             for t in range(K):
                 tok = int(toks[t, i])
@@ -755,6 +1064,8 @@ class DecodeEngine:
                     # tokens past EOS/budget in this chunk are discarded
                     with self._lock:
                         self._active[i] = None
+                    if self.paged:
+                        retired.append(i)
                     # the request's decode phase is over: one span with
                     # the token count — the per-request cost record
                     self.tracer.record(
@@ -763,10 +1074,359 @@ class DecodeEngine:
                         attrs={"model": self.name,
                                "tokens": slot.produced})
                     break
+        if retired:
+            # retirement disarms rows with a donating _arm call: run
+            # the batch's retirements AFTER the emit loop so a device
+            # failure lands with emitted/fold accounting already
+            # complete — recovery replays the surviving streams instead
+            # of the close protocol failing them all
+            try:
+                for i in retired:
+                    self._retire_paged(i)
+            except Exception:  # noqa: BLE001 — donated cache consumed
+                log.exception("paged retirement failed")
+                if not self._maybe_recover("paged retirement"):
+                    raise
         _occupancy.set(self.active_count, model=self.name)
         return True
 
     def _admit(self, timeout: float) -> bool:
+        if self.paged:
+            return self._admit_paged(timeout)
+        return self._admit_dense(timeout)
+
+    # -- paged engine internals --------------------------------------------
+
+    def _admit_paged(self, timeout: float) -> bool:
+        """Paged admission: placing a request is page-map surgery (a
+        reservation + one tiny arm program), then the prompt streams
+        into the pool through the chunked-prefill scheduler — there is
+        no whole-row insert and no per-prompt-bucket program. FIFO is
+        strict: a request that cannot reserve pages yet holds the line
+        (head-of-line wait) rather than being overtaken."""
+        admitted = False
+        with self._lock:
+            busy = {i for i, s in enumerate(self._active)
+                    if s is not None}
+        busy |= set(self._prefilling)
+        free = [i for i in range(self.slots) if i not in busy]
+        block = not busy and not self._waiting
+        for slot in free:
+            if not self._waiting:
+                try:
+                    self._waiting.append(self._pending.get(
+                        block=block and not admitted, timeout=timeout))
+                except queue.Empty:
+                    break
+            if not self._place_paged(self._waiting[0], slot):
+                break  # no pages yet: keep FIFO, retry next cycle
+            self._waiting.popleft()
+            admitted = True
+        _queue_depth.set(self.pending_count, model=self.name)
+        _occupancy.set(self.active_count, model=self.name)
+        return admitted
+
+    def _place_paged(self, req: _Request, slot: int) -> bool:
+        """Reserve + map pages for a request and arm its slot; False
+        when the pool cannot cover it yet (caller retries)."""
+        S = req.prompt.size
+        pool = self._pool
+        store = self._prefix_pages
+        aligned = (store.aligned_len(req.prefix_len)
+                   if req.prefix_len else 0)
+        key = store.key(req.prompt[:aligned]) if aligned else None
+        shared = store.get(key) if aligned else None
+        n_res = pool.pages_needed(S + req.max_new) - len(shared or ())
+        # idle prefix pages are reclaimable capacity: evict LRU entries
+        # (never the one this request just hit) before refusing
+        while not pool.can_reserve(n_res) and store.evict_lru(
+                except_key=key):
+            pass
+        if not pool.can_reserve(n_res):
+            return False
+        pool.reserve(slot, n_res)
+        if aligned:
+            # count on the admission that LANDS (placement may retry
+            # the same head-of-line request across cycles)
+            if shared is not None:
+                self.prefix_hits += 1
+                _prefix_hits.inc(model=self.name)
+            else:
+                self.prefix_misses += 1
+                _prefix_misses.inc(model=self.name)
+        if shared:
+            for logical, page in enumerate(shared):
+                pool.map_shared(slot, logical, page)
+        start = aligned if shared else 0
+        pool.ensure(slot, S)  # prompt pages; decode pages grow lazily
+        now = self._note_queue_wait(req)
+        with self._mesh_ctx():
+            self._cache = self._arm(
+                self._cache, jnp.int32(slot), jnp.int32(start),
+                jnp.asarray(pool.table_row(slot)))
+        job = _PrefillJob(
+            req=req, slot=slot, tokens=req.prompt, next=start,
+            t_admit=now,
+            store_prefix=(aligned if aligned and shared is None else 0))
+        self._prefilling[slot] = job
+        self._pos_host[slot] = start
+        self._slot_budget[slot] = S + req.max_new
+        _kv_pages_g.set(pool.pages_in_use, model=self.name)
+        _prefix_bytes_g.set(store.pages_held * self._page_bytes,
+                            model=self.name)
+        return True
+
+    def _prefill_tick(self) -> bool:
+        """Run chunked-prefill work for this cycle.
+
+        With co-tenant decode in flight, at most ``prefill_chunks_per_
+        cycle`` chunk programs run before the next shared decode step —
+        the scheduling policy that bounds any decode stall to one chunk
+        during a burst admit. On an idle engine the oldest job runs to
+        completion (nobody to stall, and its stream's TTFT wins), then
+        decode starts while later jobs interleave."""
+        if not self._prefilling:
+            return False
+        with self._lock:
+            has_active = any(s is not None for s in self._active)
+        budget = self.prefill_chunks_per_cycle if has_active else None
+        for slot in list(self._prefilling):
+            job = self._prefilling[slot]
+            while True:
+                done = self._run_chunk(job)
+                if budget is not None:
+                    budget -= 1
+                if done:
+                    del self._prefilling[slot]
+                    self._finalize_paged(job)
+                    break
+                if budget is not None and budget <= 0:
+                    return True
+            if budget is None:
+                # idle-engine fast path: first stream is live; decode
+                # now interleaves with the remaining jobs
+                return True
+            if budget <= 0:
+                return True
+        return True
+
+    def _run_chunk(self, job: _PrefillJob) -> bool:
+        """One chunk program for one slot; True when the job's token
+        stream is fully prefilled (``job.last_tok`` then holds the
+        sampled next token)."""
+        req = job.req
+        C = self.prefill_chunk_tokens
+        total = int(job.tokens.size)
+        n = min(C, total - job.next)
+        padded = np.zeros((1, C), np.int32)
+        padded[0, :n] = job.tokens[job.next:job.next + n]
+        final = job.next + n >= total
+        t0 = self.clock()
+        with self._mesh_ctx():
+            tok, self._cache = self._chunk(
+                self._params, self._cache, jnp.asarray(padded),
+                jnp.int32(job.slot), jnp.int32(job.next), jnp.int32(n),
+                jnp.int32(req.seed), jnp.int32(job.fold0),
+                jnp.float32(req.temperature), jnp.int32(req.top_k),
+                jnp.float32(req.top_p))
+            if final:
+                # host transfer forces completion while the failure is
+                # still recoverable in this cycle
+                job.last_tok = int(tok)
+        job.next += n
+        job.chunks += 1
+        self.prefill_chunks += 1
+        _prefill_chunks_c.inc(model=self.name)
+        self.tracer.record(
+            "engine.prefill_chunk", start=t0, end=self.clock(),
+            parent=req.ctx,
+            attrs={"model": self.name, "slot": job.slot,
+                   "tokens": int(n), "final": final})
+        return final
+
+    def _finalize_paged(self, job: _PrefillJob) -> None:
+        """Prompt fully in the pool: emit the sampled token, arm the
+        slot's host-side decode state, pin shareable prefix pages."""
+        req, slot = job.req, job.slot
+        now = self.clock()
+        if job.store_prefix:
+            self._prefix_pages.store(req.prompt[:job.store_prefix], slot)
+            _prefix_bytes_g.set(
+                self._prefix_pages.pages_held * self._page_bytes,
+                model=self.name)
+        self.tracer.record(
+            "engine.admit", start=job.t_admit, end=now, parent=req.ctx,
+            attrs={"model": self.name, "slot": slot,
+                   "prompt_tokens": int(req.prompt.size),
+                   "chunked": True, "chunks": job.chunks})
+        st = _Slot(req=req, produced=job.produced0, t_decode0=now,
+                   emitted=[int(t) for t in
+                            job.tokens[req.prompt.size:]])
+        self._emit(st, job.last_tok)
+        self._tokens[slot] = job.last_tok
+        self._seeds[slot] = req.seed
+        self._stepidx[slot] = job.fold0 + 1
+        self._temps[slot] = req.temperature
+        self._topk[slot] = req.top_k
+        self._topp[slot] = req.top_p
+        self._pos_host[slot] = job.tokens.size
+        if self._finished(st, job.last_tok):
+            self._retire_paged(slot)
+        else:
+            with self._lock:
+                self._active[slot] = st
+
+    def _ensure_pages(self, slots) -> None:
+        """Map pages covering the next K decode writes for each active
+        slot (drawing down its admission reservation) and re-arm rows
+        whose tables changed — page growth tracks LIVE tokens."""
+        K = self.steps_per_sync
+        Smax = self.config.max_seq_len
+        for i in slots:
+            need = min(int(self._pos_host[i]) + K,
+                       int(self._slot_budget[i]), Smax)
+            if self._pool.ensure(i, need):
+                with self._mesh_ctx():
+                    self._cache = self._arm(
+                        self._cache, jnp.int32(i),
+                        jnp.int32(self._pos_host[i]),
+                        jnp.asarray(self._pool.table_row(i)))
+                _kv_pages_g.set(self._pool.pages_in_use, model=self.name)
+
+    def _retire_paged(self, slot: int) -> None:
+        """Free the slot's pages (shared prefix pages drop one ref) and
+        disarm its device row so post-retirement garbage decode writes
+        scatter-drop instead of landing in reallocated pages."""
+        self._pool.release_slot(slot)
+        with self._mesh_ctx():
+            self._cache = self._arm(
+                self._cache, jnp.int32(slot),
+                jnp.int32(self.config.max_seq_len),
+                jnp.asarray(self._pool.table_row(slot)))
+        self._pos_host[slot] = 0
+        self._slot_budget[slot] = 0
+        _kv_pages_g.set(self._pool.pages_in_use, model=self.name)
+
+    # -- cache recovery ----------------------------------------------------
+
+    def _maybe_recover(self, where: str) -> bool:
+        """A donating device call failed: the engine cache is consumed.
+        While the recovery budget lasts, rebuild the cache/pool from
+        scratch and REPLAY every in-flight stream (prompt + emitted
+        tokens re-prefill; sampling resumes at the preserved fold
+        index) — the engine keeps serving instead of failing every
+        subsequent call against a corpse."""
+        if self._recoveries_left <= 0:
+            return False
+        self._recoveries_left -= 1
+        try:
+            self._rebuild_and_replay()
+        except Exception:  # noqa: BLE001 — recovery itself failed
+            log.exception("cache recovery after %s failure failed; "
+                          "closing engine", where)
+            return False
+        self.recoveries += 1
+        log.warning("recovered engine cache after %s failure "
+                    "(%d recover(s) left)", where, self._recoveries_left)
+        return True
+
+    def _rebuild_and_replay(self) -> None:
+        with self._lock:
+            live = [(i, s) for i, s in enumerate(self._active)
+                    if s is not None]
+            self._active = [None] * self.slots
+        self._cache = self._fresh_cache()
+        replays: List[tuple] = []
+        for i, st in live:
+            replays.append((i, st.req,
+                            np.concatenate([st.req.prompt,
+                                            np.asarray(st.emitted,
+                                                       np.int32)]),
+                            st.produced, int(self._stepidx[i])))
+        if self.paged:
+            # the old pool maps a consumed cache; prefix pages died with
+            # it. Interrupted prefill jobs restart from token 0.
+            jobs = list(self._prefilling.values())
+            self._prefilling = collections.OrderedDict()
+            self._pool = PagePool(self.kv_pages, self.kv_page_size,
+                                  self.slots, self._n_logical)
+            self._prefix_pages = PrefixPageStore(
+                self._pool, self._prefix_pages.budget_pages)
+            self._pos_host[:] = 0
+            self._slot_budget[:] = 0
+            _kv_pages_g.set(0, model=self.name)
+            # replays reserve WITHOUT prefix sharing (the store died
+            # with the old pool), so a load that only fit shared may
+            # not fully fit the fresh pool: fail just those streams
+            # retryably instead of giving up the whole recovery
+            for args in (replays
+                         + [(j.slot, j.req, j.tokens, j.produced0,
+                             j.fold0) for j in jobs]):
+                i, req = args[0], args[1]
+                try:
+                    self._replay_paged(*args)
+                except OutOfPages:
+                    log.warning(
+                        "slot %d replay does not fit the rebuilt pool "
+                        "(prefix sharing lost); failing it retryably", i)
+                    req.error = EngineClosed(
+                        "engine cache recovered; stream evicted — retry")
+                    req.out.put(_END)
+        else:
+            for i, req, tokens, produced, fold in replays:
+                self._replay_dense(i, req, tokens, produced, fold)
+
+    def _replay_paged(self, slot: int, req: _Request,
+                      tokens: np.ndarray, produced: int,
+                      fold: int) -> None:
+        pool = self._pool
+        budget = req.prompt.size + req.max_new
+        pool.reserve(slot, pool.pages_needed(budget))
+        pool.ensure(slot, int(tokens.size))
+        with self._mesh_ctx():
+            self._cache = self._arm(
+                self._cache, jnp.int32(slot), jnp.int32(0),
+                jnp.asarray(pool.table_row(slot)))
+        self._prefilling[slot] = _PrefillJob(
+            req=req, slot=slot, tokens=tokens, next=0,
+            t_admit=self.clock(), fold0=fold, produced0=produced)
+        self._pos_host[slot] = 0
+        self._slot_budget[slot] = budget
+        _kv_pages_g.set(pool.pages_in_use, model=self.name)
+
+    def _replay_dense(self, slot: int, req: _Request,
+                      tokens: np.ndarray, produced: int,
+                      fold: int) -> None:
+        """Dense replay: one bucketed prefill of (prompt + emitted)
+        re-fills the row, sampling the stream's NEXT token at the
+        preserved fold index."""
+        L = int(tokens.size)
+        bucket = pow2_bucket(L, self.config.max_seq_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :L] = tokens
+        with self._mesh_ctx():
+            tok, row_cache = self._prefill(
+                self._params, jnp.asarray(padded),
+                jnp.asarray([L], jnp.int32),
+                jnp.float32(req.temperature), jnp.int32(req.top_k),
+                jnp.float32(req.top_p), jnp.int32(req.seed),
+                jnp.int32(fold))
+            self._cache = self._insert(self._cache, row_cache,
+                                       jnp.int32(slot))
+        st = _Slot(req=req, produced=produced, t_decode0=self.clock(),
+                   emitted=[int(t) for t in tokens[req.prompt.size:]])
+        self._emit(st, int(tok))
+        self._tokens[slot] = int(tok)
+        self._seeds[slot] = req.seed
+        self._stepidx[slot] = fold + 1
+        self._temps[slot] = req.temperature
+        self._topk[slot] = req.top_k
+        self._topp[slot] = req.top_p
+        if not self._finished(st, int(tok)):
+            with self._lock:
+                self._active[slot] = st
+
+    def _admit_dense(self, timeout: float) -> bool:
         """Move pending requests into free slots.
 
         A BURST of pending requests sharing a prompt bucket admits
@@ -928,6 +1588,15 @@ class DecodeEngine:
                     failed = [s.req for s in self._active
                               if s is not None]
                     self._active = [None] * self.slots
+                    if self.paged:
+                        # mid-chunked-prefill and head-of-line requests
+                        # must fail too — a stream nobody ends hangs its
+                        # client forever in result()
+                        failed.extend(j.req
+                                      for j in self._prefilling.values())
+                        self._prefilling.clear()
+                        failed.extend(self._waiting)
+                        self._waiting.clear()
                     while True:
                         try:
                             failed.append(self._pending.get_nowait())

@@ -1,0 +1,279 @@
+"""Host-side page allocator for the paged decode KV cache.
+
+The device side is a pool of ``pages_total`` HBM blocks of
+``page_size`` tokens (``models/transformer.py:_paged_decode_attend``);
+everything about WHO owns a page lives here, on the host, as plain
+integers — slot admission/retirement is page-map surgery on a table
+the engine ships to the device as one tiny int32 array, never a
+whole-row KV copy.
+
+Ownership model:
+
+- every physical page has a refcount; 0 = free (on the free list);
+- a slot's page-table row maps logical pages (position // page_size)
+  to physical ids, ``SENTINEL`` (== pages_total) for unmapped entries
+  — the model drops writes through the sentinel;
+- prefix sharing is refcounting: a stored prompt prefix pins its pages
+  (one ref for the store), and every slot serving that prefix adds a
+  ref to each shared page. Pages are writable only while exactly one
+  slot maps them ABOVE its own start position; shared prefix pages sit
+  below every sharer's start, so they are read-only by construction;
+- admission RESERVES the slot's worst case up front
+  (``ceil((prompt + max_new)/page_size)`` minus shared pages) and
+  allocation draws the reservation down as the sequence actually grows
+  — ``pages_in_use`` tracks live tokens, while the reservation
+  guarantees a slot admitted can always finish (no mid-decode
+  out-of-pages deadlock to preempt around).
+
+Deterministic by design: the free list hands out ascending ids from a
+fixed initial order, so tests can assert exact page maps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class OutOfPages(RuntimeError):
+    """An allocation exceeded the slot's reservation or the pool —
+    an engine accounting bug, never a load condition (admission gates
+    on :meth:`PagePool.can_reserve`)."""
+
+
+@dataclasses.dataclass
+class _SlotState:
+    reserved: int = 0        # pages promised but not yet allocated
+    mapped: List[int] = dataclasses.field(default_factory=list)
+
+
+class PagePool:
+    """Refcounted page allocator + per-slot page tables."""
+
+    def __init__(self, pages_total: int, page_size: int, slots: int,
+                 pages_per_slot: int) -> None:
+        if pages_total < 1:
+            raise ValueError("pages_total must be >= 1")
+        self.pages_total = int(pages_total)
+        self.page_size = int(page_size)
+        self.slots = int(slots)
+        self.pages_per_slot = int(pages_per_slot)
+        self.sentinel = self.pages_total
+        # pop() hands out ascending ids: 0, 1, 2, ...
+        self._free: List[int] = list(range(self.pages_total - 1, -1, -1))
+        self.ref = np.zeros((self.pages_total,), np.int32)
+        self.tables = np.full((self.slots, self.pages_per_slot),
+                              self.sentinel, np.int32)
+        self._slot = [_SlotState() for _ in range(self.slots)]
+        self.reserved_total = 0
+
+    # -- capacity ----------------------------------------------------------
+
+    @property
+    def pages_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.pages_total - len(self._free)
+
+    def pages_needed(self, tokens: int) -> int:
+        return -(-max(0, int(tokens)) // self.page_size)
+
+    def can_reserve(self, n: int) -> bool:
+        """True when ``n`` more pages can be promised without risking a
+        mid-decode allocation failure for any already-admitted slot."""
+        return len(self._free) - self.reserved_total >= n
+
+    # -- slot lifecycle ----------------------------------------------------
+
+    def reserve(self, slot: int, n: int) -> None:
+        if not self.can_reserve(n):
+            raise OutOfPages(
+                f"reserve({n}) with {len(self._free)} free / "
+                f"{self.reserved_total} already promised")
+        self._slot[slot].reserved += n
+        self.reserved_total += n
+
+    def map_shared(self, slot: int, logical: int, page_id: int) -> None:
+        """Point a slot's logical page at an existing (prefix) page."""
+        assert self.tables[slot, logical] == self.sentinel
+        self.ref[page_id] += 1
+        self.tables[slot, logical] = page_id
+        self._slot[slot].mapped.append(page_id)
+
+    def alloc(self, slot: int, logical: int) -> int:
+        """Allocate a fresh writable page for a slot's logical page,
+        drawing down its reservation."""
+        st = self._slot[slot]
+        if st.reserved <= 0:
+            raise OutOfPages(f"slot {slot} exhausted its reservation")
+        if not self._free:
+            raise OutOfPages("free list empty despite reservation")
+        page = self._free.pop()
+        st.reserved -= 1
+        self.reserved_total -= 1
+        self.ref[page] = 1
+        self.tables[slot, logical] = page
+        st.mapped.append(page)
+        return page
+
+    def ensure(self, slot: int, tokens: int) -> bool:
+        """Map every logical page covering positions [0, tokens);
+        returns True when the table row changed (the engine must re-arm
+        the device copy)."""
+        changed = False
+        for logical in range(self.pages_needed(tokens)):
+            if self.tables[slot, logical] == self.sentinel:
+                self.alloc(slot, logical)
+                changed = True
+        return changed
+
+    def release_slot(self, slot: int) -> None:
+        """Retire a slot: unref every mapped page (pages reaching 0 go
+        back on the free list) and return its unused reservation."""
+        st = self._slot[slot]
+        for page in st.mapped:
+            self._unref(page)
+        st.mapped = []
+        self.reserved_total -= st.reserved
+        st.reserved = 0
+        self.tables[slot, :] = self.sentinel
+
+    def table_row(self, slot: int) -> np.ndarray:
+        return self.tables[slot].copy()
+
+    # -- prefix sharing ----------------------------------------------------
+
+    def pin(self, slot: int, n_logical: int) -> List[int]:
+        """Take a store-side reference on a slot's first ``n_logical``
+        pages (they must all be mapped) — the prefix store's claim,
+        which outlives the slot."""
+        pages = [int(p) for p in self.tables[slot, :n_logical]]
+        assert all(p != self.sentinel for p in pages)
+        for p in pages:
+            self.ref[p] += 1
+        return pages
+
+    def unpin(self, pages: List[int]) -> None:
+        for p in pages:
+            self._unref(p)
+
+    def _unref(self, page: int) -> None:
+        assert self.ref[page] > 0, f"double free of page {page}"
+        self.ref[page] -= 1
+        if self.ref[page] == 0:
+            self._free.append(page)
+
+    def check_idle(self) -> None:
+        """Assert the pool is fully reclaimed (smoke-gate invariant)."""
+        if self.pages_in_use or self.reserved_total:
+            raise AssertionError(
+                f"pool not idle: {self.pages_in_use} pages in use, "
+                f"{self.reserved_total} reserved; refs "
+                f"{np.flatnonzero(self.ref).tolist()}")
+
+
+class PrefixPageStore:
+    """LRU store of shared prompt-prefix pages, budgeted in PAGES.
+
+    Only FULL pages are shared (``aligned_len = prefix_len // page_size
+    * page_size`` tokens): the page straddling the prefix/suffix
+    boundary also holds per-request tokens and can never be shared, so
+    a hit re-prefills at most ``page_size - 1`` boundary tokens instead
+    of copying a row. Entries hold store-side refs on their pages
+    (``PagePool.pin``); eviction unpins, and pages free once the last
+    sharing slot retires.
+    """
+
+    def __init__(self, pool: PagePool, budget_pages: int) -> None:
+        self.pool = pool
+        self.budget_pages = max(0, int(budget_pages))
+        self._entries: "Dict[Tuple[int, bytes], List[int]]" = {}
+        self._order: List[Tuple[int, bytes]] = []
+
+    @property
+    def pages_held(self) -> int:
+        return sum(len(v) for v in self._entries.values())
+
+    @property
+    def pages_evictable(self) -> int:
+        """Store-held pages no live slot shares (refcount 1 = only the
+        store's pin): reclaimable cache, not load — the autoscaler must
+        not hold replicas for them.
+
+        Read from the autoscaler's snapshot() poll thread while the
+        engine thread inserts/evicts entries, so take a GIL-atomic copy
+        of the values first (``list()`` on the view runs in C with no
+        interleaved bytecode; the page lists themselves are never
+        mutated in place) — a bare generator over ``_entries`` can die
+        with "dictionary changed size during iteration"."""
+        return sum(1 for pages in list(self._entries.values())
+                   for p in pages if self.pool.ref[p] == 1)
+
+    def aligned_len(self, prefix_len: int) -> int:
+        return (int(prefix_len) // self.pool.page_size
+                ) * self.pool.page_size
+
+    @staticmethod
+    def key(tokens: np.ndarray) -> Tuple[int, bytes]:
+        return (int(tokens.size), tokens.tobytes())
+
+    def lookup(self, tokens: np.ndarray) -> Optional[List[int]]:
+        """Page ids for an aligned prefix, or None (LRU-touches hits).
+        Hit/miss accounting is the caller's: placement can retry the
+        same request several cycles while pages free up, and only the
+        admission that LANDS should count."""
+        return self.get(self.key(tokens))
+
+    def get(self, k: Tuple[int, bytes]) -> Optional[List[int]]:
+        """:meth:`lookup` by precomputed key — placement retries the
+        same head-of-line request across cycles and already holds the
+        key for eviction exemption; serializing the prefix once per
+        attempt instead of twice keeps the scheduler loop cheap."""
+        pages = self._entries.get(k)
+        if pages is None:
+            return None
+        self._order.remove(k)
+        self._order.append(k)
+        return pages
+
+    def store(self, tokens: np.ndarray, slot: int) -> None:
+        """Pin a slot's pages covering ``tokens`` (page-aligned) as a
+        shared prefix entry, evicting LRU entries to stay in budget."""
+        n_logical = tokens.size // self.pool.page_size
+        if n_logical == 0 or n_logical > self.budget_pages:
+            return
+        k = self.key(tokens)
+        if k in self._entries:
+            return
+        while self.pages_held + n_logical > self.budget_pages:
+            self._evict_one()
+        self._entries[k] = self.pool.pin(slot, n_logical)
+        self._order.append(k)
+
+    def _evict_one(self) -> None:
+        k = self._order.pop(0)
+        self.pool.unpin(self._entries.pop(k))
+
+    def evict_lru(self, except_key: Optional[Tuple[int, bytes]] = None
+                  ) -> bool:
+        """Evict the least-recently-used entry other than
+        ``except_key`` (the entry an in-flight admission is about to
+        share — evicting it would free pages out from under the slot
+        being placed). Returns False when nothing is evictable."""
+        for k in self._order:
+            if k != except_key:
+                self._order.remove(k)
+                self.pool.unpin(self._entries.pop(k))
+                return True
+        return False
+
+    def clear(self) -> None:
+        while self._order:
+            self._evict_one()
+
+    def __len__(self) -> int:
+        return len(self._entries)

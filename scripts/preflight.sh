@@ -20,6 +20,9 @@
 #      beacons -> operator straggler status -> dashboard
 #      /api/jobs/<ns>/<name>/telemetry (docs/OBSERVABILITY.md
 #      training-plane section)
+#   5. paged-engine smoke (scripts/paged_smoke.py): admit -> chunked
+#      prefill -> decode -> retire on CPU, prefix pages shared by
+#      refcount and every refcount back to zero (docs/SERVING.md)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -38,6 +41,9 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py -q -m 'not slow' \
 echo "== preflight: training-telemetry smoke test =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_step_telemetry.py -q \
     -m 'not slow' -p no:cacheprovider || rc=1
+
+echo "== preflight: paged decode engine smoke =="
+JAX_PLATFORMS=cpu python scripts/paged_smoke.py || rc=1
 
 if [ "$rc" -ne 0 ]; then
     echo "preflight: FAILED" >&2

@@ -111,6 +111,48 @@ def test_aggregator_engine_occupancy_counts_as_concurrency():
     assert w.load == pytest.approx(9.0)
 
 
+def test_aggregator_engine_page_pool_scales_concurrency():
+    """Paged engines report token-level occupancy: 2 long-context slots
+    holding 75% of the KV pool must read as 0.75 × slots concurrency
+    (pages are the binding resource), while a page-idle engine keeps the
+    plain slot signal — deterministic fake-clock windows both ways."""
+
+    class LongContext:
+        def snapshot(self):
+            return {"active_slots": 2, "pending": 0, "slots": 8,
+                    "closed": False, "paged": True,
+                    "pages_total": 64, "pages_free": 16,
+                    "pages_in_use": 48}
+
+    class PageIdle:
+        def snapshot(self):
+            return {"active_slots": 5, "pending": 1, "slots": 8,
+                    "closed": False, "paged": True,
+                    "pages_total": 64, "pages_free": 60,
+                    "pages_in_use": 4}
+
+    class WarmCacheIdle:
+        # no streams; 32 pages pinned ONLY by the prefix store —
+        # reclaimable cache must read as idle, not load
+        def snapshot(self):
+            return {"active_slots": 0, "pending": 0, "slots": 8,
+                    "closed": False, "paged": True,
+                    "pages_total": 64, "pages_free": 32,
+                    "pages_in_use": 32, "pages_evictable": 32}
+
+    agg = MetricsAggregator(clock=lambda: 0.0)
+    agg.observe_engine("long", LongContext(), now=1.0)
+    w = agg.window("long", 10.0, now=1.0)
+    assert w.concurrency == pytest.approx(0.75 * 8)  # pages dominate
+    agg.observe_engine("idle", PageIdle(), now=1.0)
+    w = agg.window("idle", 10.0, now=1.0)
+    assert w.concurrency == pytest.approx(5.0)       # slots dominate
+    assert w.queue_depth == pytest.approx(1.0)
+    agg.observe_engine("warm", WarmCacheIdle(), now=1.0)
+    w = agg.window("warm", 10.0, now=1.0)
+    assert w.concurrency == pytest.approx(0.0)       # cache != load
+
+
 # -- recommender ------------------------------------------------------------
 
 
@@ -455,7 +497,8 @@ def test_engine_snapshot_shape():
     from kubeflow_tpu.serving.engine import DecodeEngine
 
     src = inspect.getsource(DecodeEngine.snapshot)
-    for key in ("active_slots", "pending", "slots", "closed"):
+    for key in ("active_slots", "pending", "slots", "closed",
+                "pages_total", "pages_free"):
         assert key in src
 
 

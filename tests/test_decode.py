@@ -320,6 +320,7 @@ def test_serving_generate_validation(tmp_path, setup):
         srv.stop()
 
 
+@pytest.mark.slow  # multi-second XLA compiles; tier-1 runs the flagship twin
 def test_lm_example_generate_small_context(tmp_path, capsys):
     """--generate with a tiny --seq-len must sample (or skip cleanly),
     never crash in the scan."""

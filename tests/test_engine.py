@@ -40,6 +40,7 @@ def _oracle(config, params, prompt, n, **kw):
     return np.asarray(out)[0].tolist()
 
 
+@pytest.mark.slow  # multi-second XLA compiles; tier-1 runs the ragged twin
 def test_single_request_matches_unary_greedy(lm):
     config, params = lm
     eng = DecodeEngine(config, params, slots=4, autostart=False)
@@ -119,6 +120,7 @@ def test_more_requests_than_slots_queue(lm):
         assert r.result() == _oracle(config, params, [3 + i, 7], 4), i
 
 
+@pytest.mark.slow  # two engine builds; tier-1 runs the lighter seed-repro twins
 def test_sampling_reproducible_regardless_of_cotenants(lm):
     """Same seed -> same tokens whether the request runs alone or
     shares the batch: the fold_in(key(seed), step) contract."""
@@ -782,6 +784,7 @@ def test_greedy_fast_path_dispatch(lm):
     assert eng2.greedy_steps < eng2.steps_total  # sampler path was used
 
 
+@pytest.mark.slow  # multi-second XLA compiles; warmup also covered in serving
 def test_precompile_steps_then_serve(lm):
     """precompile=True warms both step programs on the empty batch and
     serving afterwards is still oracle-exact (the junk rows are fully
