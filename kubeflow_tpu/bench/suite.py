@@ -633,7 +633,9 @@ def engine_throughput(config, params, prompts, *, slots: int,
                       sampler_bound: Optional[int], sampled: bool,
                       sample_kw: Optional[Dict[str, Any]] = None,
                       sampler_impl: Optional[str] = None,
-                      paged: bool = False, name: str = "bench"):
+                      paged: bool = False,
+                      paged_attention_impl: Optional[str] = None,
+                      name: str = "bench"):
     """tokens/sec through a fresh engine (params shared in HBM).
     Returns (tok/s/chip, engine steps, burst TTFT ms, batch prefills)."""
     import jax
@@ -645,6 +647,7 @@ def engine_throughput(config, params, prompts, *, slots: int,
                        steps_per_sync=steps_per_sync,
                        sampler_bound=sampler_bound,
                        sampler_impl=sampler_impl, paged=paged,
+                       paged_attention_impl=paged_attention_impl,
                        autostart=False, name=name)
 
     # warm the compiled programs: the row prefill, insert, step —
@@ -699,6 +702,54 @@ def engine_throughput(config, params, prompts, *, slots: int,
             eng.batch_prefills - bp0)
 
 
+def engine_prefix_counters(config, params, prompts, *, slots: int,
+                           steps_per_sync: int, new_tokens: int,
+                           name: str = "bench-prefix") -> Dict[str, Any]:
+    """Prefix-trie / copy-on-write effectiveness under a shared-system-
+    prompt workload: every request carries the same prefix, chosen one
+    token PAST a page boundary so full pages trie-share and the partial
+    boundary page exercises a COW split per hit. Returns the counters
+    ``engine.snapshot()`` surfaces (docs/OBSERVABILITY.md) plus the
+    derived hit rate — the numbers that adjudicate page-granular
+    matching against the old exact-prefix store."""
+    from kubeflow_tpu.serving.engine import DecodeEngine
+
+    eng = DecodeEngine(config, params, slots=slots,
+                       steps_per_sync=steps_per_sync, paged=True,
+                       autostart=False, name=name)
+    prompt_len = prompts.shape[1]
+    # one full page + one boundary token when prompt_len = 2 pages
+    prefix_len = min(eng.kv_page_size + 1, prompt_len - 1)
+    shared = np.concatenate(
+        [np.broadcast_to(prompts[0, :prefix_len],
+                         (len(prompts), prefix_len)),
+         prompts[:, prefix_len:]], axis=1)
+    # warm the trie with the first request alone (a burst placed before
+    # the first prefill completes would miss by timing, not by policy —
+    # the store pins pages at prefill completion), then burst the rest:
+    # every follower should page-share and COW-split
+    first = eng.submit(shared[0], max_new=new_tokens,
+                       prefix_len=prefix_len)
+    engine_drain(eng)
+    first.result()
+    reqs = [eng.submit(p, max_new=new_tokens, prefix_len=prefix_len)
+            for p in shared[1:]]
+    engine_drain(eng)
+    for r in reqs:
+        r.result()
+    total = max(1, eng.prefix_hits + eng.prefix_misses)
+    counters = {
+        "paged_prefix_hits": eng.prefix_hits,
+        "paged_prefix_misses": eng.prefix_misses,
+        "paged_prefix_hit_rate": round(eng.prefix_hits / total, 3),
+        "paged_prefix_pages_shared": eng.prefix_pages_shared,
+        "paged_cow_splits": eng.cow_splits,
+        "paged_prefix_len": prefix_len,
+    }
+    eng.close()
+    return counters
+
+
 def bench_decode_engine(concurrency: int = 48, slots: int = 32,
                         prompt_len: int = 128, new_tokens: int = 128,
                         steps_per_sync: int = 64, d_model: int = 1024,
@@ -730,12 +781,14 @@ def bench_decode_engine(concurrency: int = 48, slots: int = 32,
 
     def run_engine(sampler_bound: Optional[int], sampled: bool,
                    sampler_impl: Optional[str] = None,
-                   paged: bool = False):
+                   paged: bool = False,
+                   paged_attention_impl: Optional[str] = None):
         return engine_throughput(
             config, params, prompts, slots=slots,
             steps_per_sync=steps_per_sync, new_tokens=new_tokens,
             sampler_bound=sampler_bound, sampled=sampled,
-            sample_kw=sample_kw, sampler_impl=sampler_impl, paged=paged)
+            sample_kw=sample_kw, sampler_impl=sampler_impl, paged=paged,
+            paged_attention_impl=paged_attention_impl)
 
     # sampler modes at the same effective batch: greedy rides the
     # argmax fast-path step; "sampled" pays the per-row sampler. The
@@ -752,9 +805,27 @@ def bench_decode_engine(concurrency: int = 48, slots: int = 32,
         0, sampled=True, sampler_impl="fused")
     # paged-vs-dense: same greedy workload through the paged KV cache
     # + chunked-prefill admission (burst TTFT is the headline there —
-    # whole-prompt prefills no longer block the decode loop)
-    paged_tps, _, paged_ttft_ms, _ = run_engine(
-        bound, sampled=False, paged=True)
+    # whole-prompt prefills no longer block the decode loop). The
+    # gather-vs-kernel A/B adjudicates the Pallas paged-attention
+    # kernel (ops/paged_attention.py): same workload, decode-step
+    # attention reads the dense logical view vs streaming live pages
+    # through the page table. On the CPU tier the kernel runs in the
+    # Pallas interpreter — its wall-clock there proves the path
+    # executes, never a perf claim; the TPU-attached round reads it.
+    paged_gather_tps, _, paged_gather_ttft, _ = run_engine(
+        bound, sampled=False, paged=True, paged_attention_impl="gather")
+    paged_kernel_tps, _, paged_kernel_ttft, _ = run_engine(
+        bound, sampled=False, paged=True, paged_attention_impl="kernel")
+    # "auto" resolves to the kernel on the TPU backend and the gather
+    # elsewhere — the headline paged rows reuse the matching A/B run
+    # instead of paying a third paged engine pass
+    auto_kernel = jax.default_backend() == "tpu"
+    paged_tps = paged_kernel_tps if auto_kernel else paged_gather_tps
+    paged_ttft_ms = (paged_kernel_ttft if auto_kernel
+                     else paged_gather_ttft)
+    prefix_counters = engine_prefix_counters(
+        config, params, prompts, slots=slots,
+        steps_per_sync=steps_per_sync, new_tokens=new_tokens)
     if profile_dir:
         # trace a short greedy engine run. jit caches are per engine
         # instance, so this engine precompiles its step programs and
@@ -781,6 +852,12 @@ def bench_decode_engine(concurrency: int = 48, slots: int = 32,
         "sampled_exact_fused_tokens_per_sec_per_chip": sampled_fused_tps,
         "paged_tokens_per_sec_per_chip": paged_tps,
         "paged_burst_first_tokens_ms": paged_ttft_ms,
+        "paged_attn_gather_tokens_per_sec_per_chip": paged_gather_tps,
+        "paged_attn_kernel_tokens_per_sec_per_chip": paged_kernel_tps,
+        "paged_attn_kernel_vs_gather": (
+            round(paged_kernel_tps / paged_gather_tps, 3)
+            if paged_gather_tps else None),
+        **prefix_counters,
         "burst_first_tokens_ms": ttft_ms,
         "batch_prefills": batch_prefills,
         "sampler_bound": bound,

@@ -14,9 +14,14 @@ Route parity with the reference's Express server
   by an in-process :class:`~kubeflow_tpu.autoscale.reconciler.Autoscaler`
   or proxied from the autoscaler service (``KFTPU_AUTOSCALE_URL``)
 - ``GET /api/metrics/engine``      — the decode-engine series for the
-  serving panel: slot occupancy, queue depth, prefix-cache bytes, and
-  the paged-cache gauges ``kftpu_engine_kv_pages_in_use`` /
-  ``kftpu_engine_prefill_chunks_total`` (docs/SERVING.md)
+  serving panel: slot occupancy, queue depth, prefix-cache bytes, the
+  paged-cache gauges ``kftpu_engine_kv_pages_in_use`` /
+  ``kftpu_engine_prefill_chunks_total``, and the prefix-trie /
+  copy-on-write effectiveness counters
+  ``kftpu_engine_prefix_pages_shared_total`` /
+  ``kftpu_engine_cow_splits_total`` (docs/SERVING.md; the paged
+  ``engine.snapshot()`` mirrors them as ``prefix_hits`` /
+  ``prefix_misses`` / ``prefix_pages_shared`` / ``cow_splits``)
 - ``GET /api/workgroup/exists``    — profile/workgroup flow via kfam
   (``api_workgroup.ts``)
 - ``GET /api/dashboard-links``     — component cards for the UI shell

@@ -182,6 +182,31 @@ def arm_slot(cache, slot, start, page_row):
     return jax.tree_util.tree_map_with_path(upd, cache)
 
 
+def copy_page(cache, src, dst):
+    """Copy ONE physical pool page (k and v, every layer) ``src`` →
+    ``dst`` — the copy-on-write split primitive: sharing a partial
+    boundary page costs one page-sized device copy instead of
+    re-prefilling up to ``page_size − 1`` tokens through the model.
+
+    Lives beside :func:`arm_slot` because it shares the paged-cache
+    leaf contract: pool ``k``/``v`` leaves are ``(…, P, ps, KH, Dh)``
+    (page axis at ``ndim − 4``); ``positions``/``pages`` rows pass
+    through untouched. Jit with ``donate_argnums=(0,)``.
+    """
+    src = jnp.asarray(src, jnp.int32)
+    dst = jnp.asarray(dst, jnp.int32)
+
+    def upd(path, leaf):
+        if _is_key(path, "positions") or _is_key(path, "pages"):
+            return leaf
+        ax = leaf.ndim - 4
+        page = jax.lax.dynamic_slice_in_dim(leaf, src, 1, axis=ax)
+        return jax.lax.dynamic_update_slice_in_dim(leaf, page, dst,
+                                                   axis=ax)
+
+    return jax.tree_util.tree_map_with_path(upd, cache)
+
+
 def prefill_chunk(config: TransformerConfig, params, cache,
                   tokens: jnp.ndarray, slot, start, true_n):
     """One prompt chunk for ONE slot of a PAGED decode cache.

@@ -85,6 +85,17 @@ class TransformerConfig:
     # reads/writes through the table.
     kv_page_size: int = 0
     kv_pages: int = 0
+    # paged decode attention core (kv_page_size > 0, single-token
+    # steps): "gather" materializes each row's logical KV view back to
+    # a dense (B, max_seq_len, KH, Dh) tensor (the interpret-mode
+    # fallback and the bit-parity oracle), "kernel" reads K/V straight
+    # through the page table inside a Pallas kernel
+    # (ops/paged_attention.py — HBM reads proportional to live pages),
+    # "auto" picks the kernel in compiled mode (TPU backend) and the
+    # gather elsewhere. Multi-token applies (prefill chunks, ragged
+    # continuation) always take the gather path — the kernel is the
+    # decode-step hot loop.
+    paged_attention_impl: str = "auto"
 
     @property
     def head_dim(self) -> int:
@@ -106,6 +117,11 @@ class TransformerConfig:
                     f"max_seq_len {self.max_seq_len}")
             if self.kv_pages < 1:
                 raise ValueError("paged decode needs kv_pages >= 1")
+        if self.paged_attention_impl not in ("auto", "gather", "kernel"):
+            raise ValueError(
+                f"unknown paged_attention_impl "
+                f"{self.paged_attention_impl!r}; valid: auto, gather, "
+                "kernel")
 
 
 def _constrain(x, rules: AxisRules, *names):
@@ -350,6 +366,25 @@ class Attention(nn.Module):
         ck.value = ck.value.at[pg, off].set(k, mode="drop")
         cv.value = cv.value.at[pg, off].set(v, mode="drop")
         pos_var.value = pos + S
+
+        impl = c.paged_attention_impl
+        if S == 1 and (impl == "kernel" or (impl == "auto"
+                                            and jax.default_backend()
+                                            == "tpu")):
+            # decode-step hot loop: read K/V straight through the page
+            # table inside the Pallas kernel — HBM traffic proportional
+            # to live pages, no dense view, no QH-wide GQA copy. The
+            # gather below remains the bit-parity oracle (greedy streams
+            # are asserted token-identical, tests/test_engine_paged.py)
+            # and the multi-token (chunk/ragged) path.
+            from kubeflow_tpu.ops.paged_attention import (
+                paged_decode_attention,
+            )
+
+            out = paged_decode_attention(
+                q[:, 0], ck.value, cv.value, pages, pos,
+                sm_scale=Dh ** -0.5)
+            return out[:, None]
 
         # gather each row's logical view: (B, n_log, ps, KH, Dh) ->
         # (B, Smax, KH, Dh); sentinel entries clamp to a real page and

@@ -1,5 +1,5 @@
-"""TPU compute ops: attention kernels, collectives, MoE dispatch,
-fused sampling."""
+"""TPU compute ops: attention kernels (dense, flash, ring/ulysses,
+paged decode), collectives, MoE dispatch, fused sampling."""
 
 from kubeflow_tpu.ops.attention import (  # noqa: F401
     blockwise_attention,
@@ -24,5 +24,8 @@ from kubeflow_tpu.ops.moe import (  # noqa: F401
     capacity_dispatch,
     capacity_moe,
     expert_capacity,
+)
+from kubeflow_tpu.ops.paged_attention import (  # noqa: F401
+    paged_decode_attention,
 )
 from kubeflow_tpu.ops.sampling import fused_sample  # noqa: F401

@@ -1,0 +1,194 @@
+"""Pallas paged decode attention: page-table-native KV reads.
+
+The paged engine's gather path
+(``models/transformer.py:_paged_decode_attend``) materializes each
+row's logical KV view back to a dense ``(B, max_seq_len, KH, Dh)``
+tensor with ``jnp.take(pool, pages)`` — per layer, per decode step —
+and ``gqa_repeat`` then widens it to QH heads, so a row with 100 live
+tokens still reads and rewrites the full ``Smax`` footprint. On a part
+where decode is bandwidth-read-bound, bytes per step is the number to
+attack: this kernel reads K/V **directly through the per-row page
+table**, so HBM traffic per step is proportional to live pages only
+and nothing QH-wide is ever materialized.
+
+Kernel shape (the flash kernels' streamed-grid pattern,
+``ops/attention.py``):
+
+- grid ``(B, n_logical_pages)`` with the page stream innermost; the
+  page table and per-row positions ride ``PrefetchScalarGridSpec``
+  scalar prefetch, so the K/V **index maps themselves** translate
+  logical page ``j`` to its physical pool block — the gather never
+  happens;
+- causally-dead pages (``j·page_size > pos``) and sentinel/unmapped
+  entries clamp the index map to an already-fetched block (a repeat
+  fetch the pipeline elides) and gate compute with ``pl.when`` — they
+  move and compute nothing, exactly the flash kernels' clamp trick;
+- online-softmax ``(QH, Dh)``/``(QH, 1)`` f32 scratch accumulators:
+  per-step VMEM holds one q row, one K/V page and the accumulators —
+  independent of context length;
+- GQA is handled in-kernel by slicing the q-head groups against their
+  KV head (a static loop over ``KH``) — no ``gqa_repeat``, no QH-wide
+  K/V copy.
+
+Numerics: identical masking and scaling to the gather path (scores in
+f32, scale applied post-dot, ``kv_pos <= pos`` causal bound); the
+online softmax reorders the same f32 math, so greedy token streams
+stay token-identical (the engine parity gate,
+``tests/test_engine_paged.py``). ``interpret=None`` auto-selects the
+Pallas interpreter off-TPU so CPU tests run the real kernel.
+
+Safety contract (shared with the gather path and
+``serving/kvpool.py``): a row's sentinel entries only occur at or
+beyond its causal frontier (idle/disarmed rows are all-sentinel and
+produce zeros nothing reads), and live pages below the frontier are
+always mapped — the engine arms tables before any step that reads
+them.
+
+Tile legality (TPU001): every block dim is either 1 or a
+shape-derived symbol (``page_size``/``KH``/``Dh``/``QH``) — the lane
+axis is ``Dh``, the same lane layout the flash kernels run on chip.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.ops.attention import NEG_INF
+
+
+def _resolve_interpret(interpret: Optional[bool]) -> bool:
+    return (jax.default_backend() != "tpu") if interpret is None else bool(
+        interpret)
+
+
+def _paged_decode_kernel(pages_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                         acc_ref, m_ref, l_ref, *, page_size: int,
+                         n_log: int, scale: float, n_kv_heads: int,
+                         group: int, sentinel: int):
+    """One (row, logical-page) grid step of online-softmax attention.
+
+    ``acc``/``m``/``l`` are the f32 running accumulators over the
+    row's page stream; the emit at the final page normalizes. Each KV
+    head attends its own q-head group (``group = QH // KH``) via
+    static scratch slices — GQA without widening K/V.
+    """
+    import jax.experimental.pallas as pl  # deferred: envs without pallas
+
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    pos = pos_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # skip causally-dead pages AND sentinel (unmapped) entries: the
+    # index map clamped their fetch; the compute gate must agree
+    live = (j * page_size <= pos) & (pages_ref[b, j] != sentinel)
+
+    @pl.when(live)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)       # (QH, Dh)
+        kb = k_ref[0].astype(jnp.float32)      # (page_size, KH, Dh)
+        vb = v_ref[0]
+        kv_pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        dead = kv_pos > pos                    # per-position causal bound
+        for h in range(n_kv_heads):
+            sl = slice(h * group, (h + 1) * group)
+            s = jax.lax.dot_general(
+                q[sl], kb[:, h, :], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale                          # (group, page_size)
+            s = jnp.where(dead, NEG_INF, s)
+            m = m_ref[sl]
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m - m_new)
+            l_ref[sl] = l_ref[sl] * alpha + jnp.sum(p, axis=-1,
+                                                    keepdims=True)
+            acc_ref[sl] = acc_ref[sl] * alpha + jax.lax.dot_general(
+                p.astype(vb.dtype), vb[:, h, :], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_ref[sl] = m_new
+
+    @pl.when(j == n_log - 1)
+    def _emit():
+        # all-sentinel (idle/disarmed) rows never accumulate: l stays
+        # 0 and the clamp emits finite zeros nothing reads
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, k_pages, v_pages, pages, positions, *,
+                           sm_scale: Optional[float] = None,
+                           interpret: Optional[bool] = None):
+    """Single-token decode attention straight off a paged KV pool.
+
+    - ``q``: ``(B, QH, Dh)`` — one rotated query token per row;
+    - ``k_pages``/``v_pages``: the shared pool,
+      ``(pages_total, page_size, KH, Dh)``;
+    - ``pages``: ``(B, n_logical)`` int32 per-row page table; the
+      sentinel id ``pages_total`` marks unmapped entries;
+    - ``positions``: ``(B,)`` int32 — each row's query position (KV
+      positions ``<= positions[b]`` attend; the row's token for this
+      step must already be written at that position).
+
+    Returns ``(B, QH, Dh)`` in ``q.dtype``. HBM reads touch each
+    row's live pages once — never the dense ``(B, Smax, ...)`` view,
+    never a QH-wide GQA copy.
+    """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, QH, Dh = q.shape
+    P, page_size, KH, _ = k_pages.shape
+    n_log = pages.shape[1]
+    if QH % KH:
+        raise ValueError(f"q heads {QH} must be a multiple of kv heads "
+                         f"{KH}")
+    scale = sm_scale if sm_scale is not None else Dh ** -0.5
+    pages = pages.astype(jnp.int32)
+    positions = positions.astype(jnp.int32)
+
+    def q_map(b, j, pages_ref, pos_ref):
+        return (b, 0, 0)
+
+    def kv_map(b, j, pages_ref, pos_ref):
+        # causal clamp: pages past the row's last live one re-fetch
+        # the last live block (elided); sentinel entries clamp into
+        # the pool — both are compute-gated off in the kernel
+        jj = jnp.minimum(j, pos_ref[b] // page_size)
+        return (jnp.minimum(pages_ref[b, jj], P - 1), 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, n_log),
+        in_specs=[
+            pl.BlockSpec((1, QH, Dh), q_map),
+            pl.BlockSpec((1, page_size, KH, Dh), kv_map),
+            pl.BlockSpec((1, page_size, KH, Dh), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, QH, Dh), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((QH, Dh), jnp.float32),
+            pltpu.VMEM((QH, 1), jnp.float32),
+            pltpu.VMEM((QH, 1), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _paged_decode_kernel, page_size=page_size, n_log=n_log,
+        scale=scale, n_kv_heads=KH, group=QH // KH, sentinel=P)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, QH, Dh), q.dtype),
+        interpret=_resolve_interpret(interpret),
+    )(pages, positions, q, k_pages, v_pages)

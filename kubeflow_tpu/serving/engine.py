@@ -65,6 +65,7 @@ import numpy as np
 
 from kubeflow_tpu.models.decode import (
     arm_slot,
+    copy_page,
     decode_step,
     prefill,
     prefill_chunk,
@@ -114,6 +115,14 @@ _kv_pages_g = DEFAULT_REGISTRY.gauge(
 _prefill_chunks_c = DEFAULT_REGISTRY.counter(
     "kftpu_engine_prefill_chunks_total",
     "prompt chunks prefilled by the paged engine's interleaved scheduler")
+_prefix_pages_shared_c = DEFAULT_REGISTRY.counter(
+    "kftpu_engine_prefix_pages_shared_total",
+    "KV pages mapped from the prefix trie into admitted slots "
+    "(full shared pages + COW boundary pages)")
+_cow_splits_c = DEFAULT_REGISTRY.counter(
+    "kftpu_engine_cow_splits_total",
+    "copy-on-write splits of shared boundary pages (one device-side "
+    "page copy each, in place of a boundary re-prefill)")
 
 _END = object()  # per-request stream sentinel
 
@@ -233,7 +242,7 @@ class _PrefillJob:
     # delivery counter, instead of starting a fresh request at fold 0
     fold0: int = 0
     produced0: int = 0
-    store_prefix: int = 0     # aligned prefix tokens to pin after prefill
+    store_prefix: int = 0     # prefix tokens to trie-pin after prefill
     last_tok: int = 0         # sampled next token, set by the final chunk
 
 
@@ -255,6 +264,7 @@ class DecodeEngine:
                  paged: Optional[bool] = None,
                  kv_page_size: Optional[int] = None,
                  kv_pages: Optional[int] = None,
+                 paged_attention_impl: Optional[str] = None,
                  prefill_chunk_tokens: Optional[int] = None,
                  prefill_chunks_per_cycle: int = 1,
                  recoveries: Optional[int] = None,
@@ -337,12 +347,27 @@ class DecodeEngine:
             self.prefill_chunk_tokens = max(1, int(prefill_chunk_tokens))
             self.prefill_chunks_per_cycle = max(
                 1, int(prefill_chunks_per_cycle))
+            # device-side attention core for the paged decode STEP:
+            # "kernel" streams K/V through the page table inside a
+            # Pallas kernel (ops/paged_attention.py — HBM reads
+            # proportional to live pages), "gather" materializes the
+            # dense logical view (the bit-parity oracle and the
+            # interpret-mode fallback), "auto" picks the kernel on the
+            # TPU backend and the gather elsewhere. Greedy streams are
+            # token-identical either way (test-gated).
+            if paged_attention_impl is None:
+                paged_attention_impl = os.environ.get(
+                    "KFTPU_PAGED_ATTN", "auto")
+            self.paged_attention_impl = paged_attention_impl
             self._cfg = dataclasses.replace(
                 config, kv_page_size=self.kv_page_size,
-                kv_pages=self.kv_pages)
+                kv_pages=self.kv_pages,
+                paged_attention_impl=paged_attention_impl)
+            self._cfg.validate()
         else:
             self.kv_page_size = 0
             self.kv_pages = 0
+            self.paged_attention_impl = "gather"
             self._cfg = config
         # burst admission: same-bucket pending requests prefill as ONE
         # batch of up to this many rows. The cap bounds the transient
@@ -469,6 +494,9 @@ class DecodeEngine:
         # page-map surgery program (models/decode.py:arm_slot — the
         # paged-cache leaf contract lives in ONE module)
         self._arm = jax.jit(arm_slot, donate_argnums=(0,))
+        # COW-split page copy (models/decode.py:copy_page, same leaf
+        # contract): one physical page duplicated device-side
+        self._copy_page = jax.jit(copy_page, donate_argnums=(0,))
 
         def _insert_rows(engine_cache, batch_cache, slot_ids, valid):
             """Insert every valid batch-prefill row into its engine slot
@@ -677,6 +705,8 @@ class DecodeEngine:
         self.batch_prefills = 0  # burst admissions served batched
         self.prefill_chunks = 0  # chunk programs run (paged scheduler)
         self.recoveries = 0      # cache rebuild-and-replay events
+        self.prefix_pages_shared = 0  # pages mapped from the trie
+        self.cow_splits = 0      # boundary-page copy-on-write splits
         if self.paged:
             self._pool = PagePool(self.kv_pages, self.kv_page_size,
                                   slots, self._n_logical)
@@ -851,6 +881,13 @@ class DecodeEngine:
                 # (autoscaler) subtract these — cache is not load
                 "pages_evictable": self._prefix_pages.pages_evictable,
                 "prefill_slots": len(self._prefilling),
+                "paged_attention_impl": self.paged_attention_impl,
+                # prefix-trie + copy-on-write effectiveness counters
+                # (docs/OBSERVABILITY.md; served by /api/metrics/engine)
+                "prefix_hits": self.prefix_hits,
+                "prefix_misses": self.prefix_misses,
+                "prefix_pages_shared": self.prefix_pages_shared,
+                "cow_splits": self.cow_splits,
             })
         return snap
 
@@ -1128,36 +1165,67 @@ class DecodeEngine:
 
     def _place_paged(self, req: _Request, slot: int) -> bool:
         """Reserve + map pages for a request and arm its slot; False
-        when the pool cannot cover it yet (caller retries)."""
+        when the pool cannot cover it yet (caller retries).
+
+        Prefix sharing is trie-matched per PAGE: the longest stored
+        chain of full pages maps in read-only, and when the WHOLE
+        aligned prefix matched, the partial boundary page maps in
+        copy-on-write. The COW split (one device page copy) runs HERE,
+        before the slot is armed: the shared decode step advances and
+        writes through EVERY armed row (a mid-prefill row's device
+        position drifts by design), so a slot may never sit armed while
+        its table points a writable logical page at KV someone else
+        reads."""
         S = req.prompt.size
         pool = self._pool
         store = self._prefix_pages
-        aligned = (store.aligned_len(req.prefix_len)
-                   if req.prefix_len else 0)
-        key = store.key(req.prompt[:aligned]) if aligned else None
-        shared = store.get(key) if aligned else None
-        n_res = pool.pages_needed(S + req.max_new) - len(shared or ())
-        # idle prefix pages are reclaimable capacity: evict LRU entries
-        # (never the one this request just hit) before refusing
+        match = (store.match(req.prompt, req.prefix_len)
+                 if req.prefix_len else None)
+        shared = match.pages if match else []
+        # the COW boundary page is NOT subtracted: its split draws a
+        # fresh page from this very reservation
+        n_res = pool.pages_needed(S + req.max_new) - len(shared)
+        # idle prefix pages are reclaimable capacity: evict LRU leaves
+        # (never a page this request is about to share) before refusing
+        protect = set(shared)
+        if match is not None and match.tail_page is not None:
+            protect.add(match.tail_page)
         while not pool.can_reserve(n_res) and store.evict_lru(
-                except_key=key):
+                protect=protect):
             pass
         if not pool.can_reserve(n_res):
             return False
         pool.reserve(slot, n_res)
-        if aligned:
+        if req.prefix_len:
             # count on the admission that LANDS (placement may retry
             # the same head-of-line request across cycles)
-            if shared is not None:
+            if match.hit:
                 self.prefix_hits += 1
                 _prefix_hits.inc(model=self.name)
+                n_shared = len(shared) + (match.tail_page is not None)
+                self.prefix_pages_shared += n_shared
+                _prefix_pages_shared_c.inc(n_shared, model=self.name)
             else:
                 self.prefix_misses += 1
                 _prefix_misses.inc(model=self.name)
-        if shared:
-            for logical, page in enumerate(shared):
-                pool.map_shared(slot, logical, page)
-        start = aligned if shared else 0
+        for logical, page in enumerate(shared):
+            pool.map_shared(slot, logical, page)
+        start = len(shared) * self.kv_page_size
+        if match is not None and match.tail_page is not None:
+            # map_cow FIRST: the slot's ref keeps the boundary page
+            # alive even if store eviction (racing this placement for
+            # pages) unpins the entry; then split immediately — the
+            # split is the "first write" boundary, since arming makes
+            # the row writable by the very next shared step
+            logical = len(shared)
+            pool.map_cow(slot, logical, match.tail_page)
+            src, dst = pool.cow_split(slot, logical)
+            with self._mesh_ctx():
+                self._cache = self._copy_page(
+                    self._cache, jnp.int32(src), jnp.int32(dst))
+            self.cow_splits += 1
+            _cow_splits_c.inc(model=self.name)
+            start += match.tail_len
         pool.ensure(slot, S)  # prompt pages; decode pages grow lazily
         now = self._note_queue_wait(req)
         with self._mesh_ctx():
@@ -1166,8 +1234,7 @@ class DecodeEngine:
                 jnp.asarray(pool.table_row(slot)))
         job = _PrefillJob(
             req=req, slot=slot, tokens=req.prompt, next=start,
-            t_admit=now,
-            store_prefix=(aligned if aligned and shared is None else 0))
+            t_admit=now, store_prefix=req.prefix_len)
         self._prefilling[slot] = job
         self._pos_host[slot] = start
         self._slot_budget[slot] = S + req.max_new
@@ -1250,7 +1317,10 @@ class DecodeEngine:
         req, slot = job.req, job.slot
         now = self.clock()
         if job.store_prefix:
-            self._prefix_pages.store(req.prompt[:job.store_prefix], slot)
+            # idempotent trie insert: already-stored chain pages are
+            # only LRU-touched; a partial-chain hit pins the NEW pages
+            # extending the chain, plus the COW boundary tail
+            self._prefix_pages.store(req.prompt, job.store_prefix, slot)
             _prefix_bytes_g.set(
                 self._prefix_pages.pages_held * self._page_bytes,
                 model=self.name)

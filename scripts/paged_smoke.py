@@ -6,6 +6,13 @@ then asserts every page refcount returns to zero — a leaked or copied
 page fails the gate. Greedy output is checked against the unary
 ``generate`` oracle so the lifecycle proof is also a correctness proof.
 
+Two passes: the gather path (the bit-parity oracle), then the Pallas
+paged-attention KERNEL path (``paged_attention_impl="kernel"``, running
+through the Pallas interpreter on CPU) with a copy-on-write boundary
+split in play — the non-aligned shared prefix forces exactly one
+device-side page copy per sharing admission, and the pool must still
+reclaim every page.
+
 Run: JAX_PLATFORMS=cpu python scripts/paged_smoke.py
 """
 
@@ -64,9 +71,35 @@ def main() -> None:
     eng._prefix_pages.clear()
     eng._pool.check_idle()                     # every refcount at zero
     assert (eng._pool.ref == 0).all()
+
+    # kernel-path pass with a COW split in play: a NON-aligned shared
+    # prefix (page + 3 boundary tokens) trie-shares the full page,
+    # maps the boundary page copy-on-write, and splits it exactly once
+    keng = DecodeEngine(config, params, slots=2, paged=True,
+                        kv_page_size=8, prefill_chunk_tokens=8,
+                        paged_attention_impl="kernel", autostart=False)
+    cpfx = list(range(20, 31))                 # 11 tokens: 1 page + 3
+    c1, c2 = cpfx + [5, 2], cpfx + [7, 9]
+    k1 = keng.submit(c1, max_new=4, prefix_len=11)
+    for _ in range(40):
+        keng.run_once(timeout=0.01)
+    assert k1.result() == oracle(c1, 4), "kernel-path stream diverged"
+    k2 = keng.submit(c2, max_new=4, prefix_len=11)
+    for _ in range(40):
+        keng.run_once(timeout=0.01)
+    assert k2.result() == oracle(c2, 4), (
+        "kernel-path COW-shared stream diverged")
+    assert keng.prefix_hits == 1 and keng.cow_splits == 1, (
+        f"expected one COW split on the boundary page, got "
+        f"{keng.cow_splits} (hits={keng.prefix_hits})")
+    keng._pool.check_invariants()
+    keng._prefix_pages.clear()
+    keng._pool.check_idle()                    # every refcount at zero
+    assert (keng._pool.ref == 0).all()
     print("paged engine smoke: ok "
           f"(chunks={eng.prefill_chunks}, "
-          f"pages_total={eng._pool.pages_total})")
+          f"pages_total={eng._pool.pages_total}, "
+          f"kernel cow_splits={keng.cow_splits})")
 
 
 if __name__ == "__main__":

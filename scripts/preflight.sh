@@ -22,7 +22,10 @@
 #      training-plane section)
 #   5. paged-engine smoke (scripts/paged_smoke.py): admit -> chunked
 #      prefill -> decode -> retire on CPU, prefix pages shared by
-#      refcount and every refcount back to zero (docs/SERVING.md)
+#      refcount and every refcount back to zero, in TWO passes — the
+#      gather (bit-parity oracle) path, then the Pallas paged-attention
+#      kernel path (interpret mode) with a copy-on-write boundary-page
+#      split asserted to copy exactly once (docs/SERVING.md)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 

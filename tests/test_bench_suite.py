@@ -94,6 +94,17 @@ def test_decode_engine_config_tiny():
     assert out["paged_tokens_per_sec_per_chip"] > 0
     assert out["sampled_exact_fused_tokens_per_sec_per_chip"] > 0
     assert out["sampled_exact_sort_tokens_per_sec_per_chip"] > 0
+    # ISSUE 7: the gather-vs-kernel A/B and the prefix-trie/COW
+    # counters land in the same artifact (CPU tier proves the paths;
+    # the TPU round adjudicates the kernel)
+    assert out["paged_attn_gather_tokens_per_sec_per_chip"] > 0
+    assert out["paged_attn_kernel_tokens_per_sec_per_chip"] > 0
+    assert out["paged_attn_kernel_vs_gather"] > 0
+    assert (out["paged_prefix_hits"] + out["paged_prefix_misses"]
+            == out["concurrency"])
+    assert out["paged_prefix_hits"] >= 1
+    assert out["paged_cow_splits"] >= 1
+    assert out["paged_prefix_pages_shared"] >= out["paged_prefix_hits"]
 
 
 @pytest.mark.slow  # multi-second XLA compiles; tier-1 runs the fast twin paths

@@ -163,6 +163,115 @@ def test_paged_snapshot_reports_page_pool(lm):
     assert end["pages_in_use"] == 0 and end["active_slots"] == 0
 
 
+# -- paged-attention kernel: the bit-parity gate ----------------------------
+
+
+def test_parity_three_way_dense_gather_kernel(lm):
+    """THE acceptance gate: greedy token streams are identical across
+    the dense engine, the paged-GATHER path, and the paged Pallas
+    KERNEL path (interpret mode on CPU) — same prompts, chunked prefill
+    (3 chunks for the long prompt) and shared decode steps, GQA shapes
+    (the fixture is 4 q-heads over 2 kv-heads)."""
+    config, params = lm
+    p_short, p_long = [5, 11, 17], [3, 2, 9, 23, 41, 8, 1, 30, 12]
+    streams = {}
+    for mode in ("dense", "gather", "kernel"):
+        if mode == "dense":
+            eng = DecodeEngine(config, params, slots=4, autostart=False)
+        else:
+            eng = _paged(config, params, slots=4,
+                         prefill_chunk_tokens=4,
+                         paged_attention_impl=mode)
+        rs = [eng.submit(p_short, max_new=10),
+              eng.submit(p_long, max_new=6)]
+        _drain(eng)
+        streams[mode] = [r.result() for r in rs]
+        if mode != "dense":
+            eng._pool.check_idle()
+    want = [_oracle(config, params, p_short, 10),
+            _oracle(config, params, p_long, 6)]
+    assert streams["dense"] == streams["gather"] == streams["kernel"] \
+        == want
+
+
+def test_parity_kernel_non_gqa():
+    """Non-GQA (n_kv_heads == n_heads): the kernel's in-kernel head
+    grouping degenerates to group size 1 and must stay token-identical
+    to gather and dense."""
+    config = TransformerConfig(vocab_size=61, d_model=32, n_layers=2,
+                               n_heads=2, n_kv_heads=2, d_ff=64,
+                               max_seq_len=32, dtype=jnp.float32,
+                               remat=False)
+    params = Transformer(config).init(
+        jax.random.key(1), np.zeros((1, 8), np.int32))["params"]
+    prompt = [7, 3, 2, 9, 23]
+    want = _oracle(config, params, prompt, 8)
+    for mode in ("gather", "kernel"):
+        eng = _paged(config, params, slots=2, paged_attention_impl=mode)
+        r = eng.submit(prompt, max_new=8)
+        _drain(eng, 30)
+        assert r.result() == want, f"{mode} diverged"
+        eng._pool.check_idle()
+
+
+def test_parity_kernel_ragged_continuation_and_cow(lm):
+    """Ragged continuation through the kernel path: a prefix hit with a
+    NON-page-aligned boundary admits mid-page (chunks run from a ragged
+    start, decode steps read through the COW-split copy) — streams stay
+    identical to the gather engine and the unary oracle, and the
+    boundary page is copied EXACTLY once per sharing admission."""
+    config, params = lm
+    pfx = list(range(1, 13))                    # 1 full page + 4 tokens
+    p1, p2 = pfx + [5, 11], pfx + [9, 3, 7]
+    for mode in ("gather", "kernel"):
+        eng = _paged(config, params, slots=4, paged_attention_impl=mode)
+        copies = []
+        real = eng._copy_page
+
+        def counted(cache, s, d, _real=real, _c=copies):
+            _c.append((int(s), int(d)))
+            return _real(cache, s, d)
+
+        eng._copy_page = counted
+        r1 = eng.submit(p1, max_new=4, prefix_len=12)
+        _drain(eng, 25)
+        r2 = eng.submit(p2, max_new=4, prefix_len=12)
+        _drain(eng, 25)
+        assert r1.result() == _oracle(config, params, p1, 4)
+        assert r2.result() == _oracle(config, params, p2, 4)
+        # r1 misses (stores 1 node + 1 COW tail); r2 shares both and
+        # splits the boundary page exactly once — ONE device page copy
+        # instead of a 4-token boundary re-prefill
+        assert eng.prefix_hits == 1 and eng.prefix_misses == 1
+        assert eng.prefix_pages_shared == 2
+        assert eng.cow_splits == 1 and len(copies) == 1
+        assert eng._pool.cow_splits == 1
+        snap = eng.snapshot()
+        assert snap["cow_splits"] == 1 and snap["prefix_hits"] == 1
+        assert snap["prefix_pages_shared"] == 2
+        eng._prefix_pages.clear()
+        eng._pool.check_idle()
+
+
+def test_parity_kernel_fused_sampler(lm):
+    """Fused-sampler interaction: sampled streams through the kernel
+    path reproduce the gather path's (same fold_in(key(seed), step)
+    draws over logits that agree to f32 round-off) and are seed-stable
+    across engines."""
+    config, params = lm
+    kw = dict(max_new=6, temperature=0.8, top_k=12, top_p=0.9, seed=11)
+    outs = {}
+    for mode in ("gather", "kernel"):
+        eng = _paged(config, params, slots=2, sampler_impl="fused",
+                     paged_attention_impl=mode)
+        r = eng.submit([5, 11, 17, 2], **kw)
+        _drain(eng, 25)
+        outs[mode] = r.result()
+        eng._pool.check_idle()
+    assert outs["gather"] == outs["kernel"]
+    assert len(outs["kernel"]) == 6
+
+
 # -- prefix pages: shared by refcount, never copied -------------------------
 
 
@@ -178,10 +287,9 @@ def test_prefix_pages_shared_by_refcount(lm):
     r1 = eng.submit(p1, max_new=4, prefix_len=16)
     _drain(eng, 20)
     assert r1.result() == _oracle(config, params, p1, 4)
-    assert eng.prefix_misses == 1 and len(eng._prefix_pages) == 1
-    assert eng._prefix_pages.pages_held == 2
-    stored = set(eng._prefix_pages._entries[next(
-        iter(eng._prefix_pages._entries))])
+    # the trie stores one node per page: 2 full pages pinned
+    assert eng.prefix_misses == 1 and eng._prefix_pages.pages_held == 2
+    stored = set(eng._prefix_pages._held)
     r2 = eng.submit(p2, max_new=4, prefix_len=16)
     shared_seen = False
     for _ in range(40):
@@ -194,6 +302,35 @@ def test_prefix_pages_shared_by_refcount(lm):
     assert r2.result() == _oracle(config, params, p2, 4)
     assert eng.prefix_hits == 1
     assert eng._pool.pages_in_use == 2        # only the store's pin left
+    eng._prefix_pages.clear()
+    eng._pool.check_idle()
+
+
+def test_trie_hit_on_prefix_the_exact_store_missed(lm):
+    """A request sharing only the FIRST page of a stored two-page
+    prefix still hits: the pre-trie store keyed on the ENTIRE aligned
+    prefix, so this exact workload shared nothing — page-granular
+    matching is the point of the trie."""
+    config, params = lm
+    sys_prompt = list(range(1, 17))            # 16 tokens = 2 pages
+    eng = _paged(config, params, slots=4)
+    r1 = eng.submit(sys_prompt + [5], max_new=3, prefix_len=16)
+    _drain(eng, 25)
+    assert r1.result() == _oracle(config, params, sys_prompt + [5], 3)
+    assert eng.prefix_misses == 1 and eng._prefix_pages.pages_held == 2
+    # only the first page in common — old key (8, tokens[:8]) ∉ store
+    p2 = sys_prompt[:8] + [40, 41, 42]
+    first_page = eng._prefix_pages._held[0]    # insertion order: page 0
+    r2 = eng.submit(p2, max_new=3, prefix_len=8)
+    shared_seen = False
+    for _ in range(30):
+        eng.run_once(timeout=0.01)
+        if eng._pool.ref[first_page] >= 2:
+            shared_seen = True
+    assert r2.result() == _oracle(config, params, p2, 3)
+    assert eng.prefix_hits == 1 and eng.prefix_pages_shared == 1
+    assert shared_seen, "the common first page was never mapped shared"
+    assert eng.cow_splits == 0                 # aligned hit: no COW
     eng._prefix_pages.clear()
     eng._pool.check_idle()
 

@@ -1,0 +1,137 @@
+"""Pallas paged decode-attention kernel (ops/paged_attention.py) vs
+the gather oracle — the page-table-native read path must reproduce the
+dense-logical-view math on every page-table shape the engine can
+produce: ragged per-row positions, sentinel (unmapped) entries,
+causally-dead pages, idle rows, GQA and non-GQA head layouts.
+
+These run the REAL kernel through the Pallas interpreter on CPU
+(``interpret=None`` auto-selects it off-TPU); the engine-level greedy
+bit-parity gate lives in tests/test_engine_paged.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.ops.attention import NEG_INF, gqa_repeat
+from kubeflow_tpu.ops.paged_attention import paged_decode_attention
+
+
+def _gather_oracle(q, k_pages, v_pages, pages, positions):
+    """The transformer gather path's math at S == 1 (bit-for-bit the
+    masking/scale/softmax of ``_paged_decode_attend``)."""
+    B, QH, Dh = q.shape
+    P, ps, KH, _ = k_pages.shape
+    Smax = pages.shape[1] * ps
+    kc = jnp.take(k_pages, pages, axis=0,
+                  mode="clip").reshape(B, Smax, KH, Dh)
+    vc = jnp.take(v_pages, pages, axis=0,
+                  mode="clip").reshape(B, Smax, KH, Dh)
+    q4 = q[:, None]
+    kc, vc = gqa_repeat(q4, kc, vc)
+    logits = jnp.einsum("bshd,bthd->bhst", q4, kc).astype(jnp.float32)
+    logits = logits * (Dh ** -0.5)
+    mask = jnp.arange(Smax)[None, None, :] <= positions[:, None, None]
+    logits = jnp.where(mask[:, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhst,bthd->bshd", probs, vc)[:, 0]
+
+
+def _setup(B=3, QH=4, KH=2, Dh=16, ps=8, P=10, n_log=6, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(B, QH, Dh)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(P, ps, KH, Dh)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(P, ps, KH, Dh)), jnp.float32)
+    return q, kp, vp, P, ps, n_log
+
+
+@pytest.mark.parametrize("QH,KH", [(4, 2), (4, 4)])  # GQA and non-GQA
+def test_kernel_matches_gather_ragged_rows(QH, KH):
+    q, kp, vp, P, ps, n_log = _setup(B=4, QH=QH, KH=KH)
+    pages = np.full((4, n_log), P, np.int32)
+    pages[0, :3] = [2, 5, 7]          # 2 full pages + a partial third
+    pages[1, 0] = 1                   # single token
+    pages[2, :n_log] = range(3, 3 + n_log)  # full context
+    # row 3: idle/disarmed (all sentinel)
+    positions = np.asarray([19, 0, n_log * ps - 1, n_log * ps],
+                           np.int32)
+    out = paged_decode_attention(q, kp, vp, jnp.asarray(pages),
+                                 jnp.asarray(positions))
+    ref = _gather_oracle(q, kp, vp, jnp.asarray(pages),
+                         jnp.asarray(positions))
+    np.testing.assert_allclose(np.asarray(out[:3]), np.asarray(ref[:3]),
+                               atol=2e-6)
+    # idle rows accumulate nothing and emit exact zeros (the engine
+    # never reads them; the kernel must still not NaN on l == 0)
+    assert (np.asarray(out[3]) == 0).all()
+
+
+def test_kernel_skips_sentinel_and_dead_pages():
+    """A sentinel entry BELOW a live page contributes nothing. The
+    engine never produces this shape (its sentinels only occur at or
+    beyond the causal frontier), and here the kernel is strictly SAFER
+    than the gather path: gather clamp-aliases a sentinel onto page
+    P−1 and relies on the causal mask, the kernel's page gate skips
+    the entry outright — so the oracle masks the hole explicitly."""
+    q, kp, vp, P, ps, n_log = _setup(B=1, seed=1)
+    pages = np.full((1, n_log), P, np.int32)
+    pages[0, 0] = 4
+    pages[0, 2] = 6            # logical 1 left sentinel on purpose
+    positions = np.asarray([2 * ps + 3], np.int32)
+    out = paged_decode_attention(q, kp, vp, jnp.asarray(pages),
+                                 jnp.asarray(positions))
+    # oracle: mask the sentinel logical page explicitly (jnp.take clip
+    # would alias it onto page P-1, which is NOT what the kernel reads)
+    kc = jnp.take(kp, jnp.asarray(pages), axis=0,
+                  mode="clip").reshape(1, n_log * ps, 2, 16)
+    vc = jnp.take(vp, jnp.asarray(pages), axis=0,
+                  mode="clip").reshape(1, n_log * ps, 2, 16)
+    q4 = q[:, None]
+    kc, vc = gqa_repeat(q4, kc, vc)
+    logits = jnp.einsum("bshd,bthd->bhst", q4, kc).astype(jnp.float32)
+    logits = logits * (16 ** -0.5)
+    kv_pos = jnp.arange(n_log * ps)
+    live = (kv_pos <= positions[0]) & ~((kv_pos >= ps)
+                                        & (kv_pos < 2 * ps))
+    logits = jnp.where(live[None, None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    ref = jnp.einsum("bhst,bthd->bshd", probs, vc)[:, 0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-6)
+
+
+def test_kernel_argmax_parity_random_tables():
+    """Greedy parity's kernel-level proxy: over many random page maps
+    the kernel's output argmax (the next-token decision surface) equals
+    the gather's."""
+    rng = np.random.default_rng(7)
+    q, kp, vp, P, ps, n_log = _setup(B=8, seed=7)
+    q = jnp.asarray(rng.normal(size=(8, 4, 16)), jnp.float32)
+    pages = np.full((8, n_log), P, np.int32)
+    positions = np.zeros((8,), np.int32)
+    perm = rng.permutation(P)
+    used = 0
+    for b in range(8):
+        n_live = int(rng.integers(1, n_log * ps))
+        positions[b] = n_live - 1
+        need = -(-n_live // ps)
+        for logical in range(need):
+            pages[b, logical] = perm[used % P]
+            used += 1
+    out = paged_decode_attention(q, kp, vp, jnp.asarray(pages),
+                                 jnp.asarray(positions))
+    ref = _gather_oracle(q, kp, vp, jnp.asarray(pages),
+                         jnp.asarray(positions))
+    np.testing.assert_array_equal(np.asarray(jnp.argmax(out, -1)),
+                                  np.asarray(jnp.argmax(ref, -1)))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-6)
+
+
+def test_kernel_rejects_bad_gqa():
+    q, kp, vp, P, ps, n_log = _setup(QH=3, KH=2)
+    with pytest.raises(ValueError, match="multiple"):
+        paged_decode_attention(q, kp, vp,
+                               jnp.zeros((3, n_log), jnp.int32),
+                               jnp.zeros((3,), jnp.int32))
