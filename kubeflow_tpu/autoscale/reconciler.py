@@ -248,6 +248,25 @@ class Autoscaler:
         _warming_g.set(counts[WARMING], model=model)
         _draining_g.set(counts[DRAINING], model=model)
 
+    # -- runtime -------------------------------------------------------------
+
+    def build_controller(self, interval_s: float = 2.0):
+        """The autoscale tick on the shared workqueue runtime
+        (:meth:`kubeflow_tpu.operators.controller.Controller.periodic`):
+        one uniformly-traced ``controller.reconcile`` per tick instead
+        of the hand-rolled ``while/sleep`` thread, so autoscaling shows
+        up on the same trace/metric surface as the operators and the
+        scheduler queue."""
+
+        def tick(_ns: str, _name: str) -> float:
+            self.reconcile_all()
+            return interval_s
+
+        from kubeflow_tpu.operators.controller import Controller
+
+        return Controller.periodic(tick, name="autoscaler",
+                                   tracer=self.tracer)
+
     # -- observability -------------------------------------------------------
 
     def status(self) -> Dict[str, Any]:

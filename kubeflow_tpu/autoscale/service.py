@@ -79,22 +79,68 @@ class AutoscaleService:
         return 200, {"ok": True}
 
 
+class _StopEvent(threading.Event):
+    """An Event whose ``set()`` also stops the controller and releases
+    ``join`` waiters — keeps the old ``handle.stop.set()`` thread
+    contract over the runtime lift."""
+
+    def __init__(self, controller, done: threading.Event) -> None:
+        super().__init__()
+        self._controller = controller
+        self._done = done
+
+    def set(self) -> None:  # noqa: A003 — threading.Event API
+        super().set()
+        self._controller.stop()
+        self._done.set()
+
+
+class _LoopHandle:
+    """:func:`run_loop`'s return: looks enough like the old Thread
+    (``.stop`` event, ``.join(timeout)`` that *waits*, not kills) that
+    callers keep working, but the loop underneath is a periodic
+    Controller on the shared runtime."""
+
+    def __init__(self, controller) -> None:
+        self.controller = controller
+        self._done = threading.Event()
+        self.stop = _StopEvent(controller, self._done)
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        """Wait (bounded) for the loop to be stopped — the old daemon-
+        Thread semantics: joining never terminates the loop itself."""
+        self._done.wait(timeout)
+
+
 def run_loop(autoscaler: Autoscaler, interval_s: float,
-             stop: Optional[threading.Event] = None) -> threading.Thread:
-    """Reconcile every model each ``interval_s`` until ``stop`` is set."""
-    stop = stop if stop is not None else threading.Event()
+             stop: Optional[threading.Event] = None) -> _LoopHandle:
+    """Reconcile every model each ``interval_s`` until stopped.
 
-    def loop() -> None:
-        while not stop.wait(interval_s):
-            try:
-                autoscaler.reconcile_all()
-            except Exception:  # noqa: BLE001 — a bad tick must not kill
-                log.exception("autoscale reconcile tick failed")
+    Runs on the shared workqueue runtime
+    (:meth:`~kubeflow_tpu.operators.controller.Controller.periodic`)
+    rather than a hand-rolled sleep thread: ticks are deduplicated,
+    single-flight, uniformly traced reconciles — and a tick that throws
+    is logged by the runtime while the loop lives on, exactly the old
+    contract. Stop via the returned handle's ``.stop.set()`` (or pass
+    your own ``stop`` Event and set it)."""
+    ctrl = autoscaler.build_controller(interval_s=interval_s)
+    handle = _LoopHandle(ctrl)
+    if stop is not None:
+        if stop.is_set():
+            # the old `while not stop.wait(...)` loop exited before its
+            # first tick on a pre-set Event; never start the controller
+            handle._done.set()
+            return handle
+        # honor a caller-owned Event: chain its set() to the controller
+        orig_set = stop.set
 
-    t = threading.Thread(target=loop, daemon=True, name="autoscale-loop")
-    t.stop = stop  # type: ignore[attr-defined] — handle for callers
-    t.start()
-    return t
+        def chained() -> None:
+            orig_set()
+            handle.stop.set()
+
+        stop.set = chained  # type: ignore[method-assign]
+    ctrl.start()
+    return handle
 
 
 def main() -> None:  # pragma: no cover - container entrypoint

@@ -22,6 +22,11 @@ Route parity with the reference's Express server
   ``kftpu_engine_cow_splits_total`` (docs/SERVING.md; the paged
   ``engine.snapshot()`` mirrors them as ``prefix_hits`` /
   ``prefix_misses`` / ``prefix_pages_shared`` / ``cow_splits``)
+- ``GET /api/metrics/scheduler``   — the cluster gang queue's state
+  (``kubeflow_tpu/scheduler/queue.py``; docs/SCHEDULER.md): per-gang
+  queue states, priorities, waits, preemption counts, plus the
+  ``kftpu_queue_depth`` / ``kftpu_queue_wait_seconds`` /
+  ``kftpu_preemptions_total`` series when no queue is in-process
 - ``GET /api/workgroup/exists``    — profile/workgroup flow via kfam
   (``api_workgroup.ts``)
 - ``GET /api/dashboard-links``     — component cards for the UI shell
@@ -172,7 +177,8 @@ class DashboardApi:
                  artifact_store=None,
                  authorize=None,
                  autoscaler=None,
-                 collector: Optional[SpanCollector] = None) -> None:
+                 collector: Optional[SpanCollector] = None,
+                 scheduler_queue=None) -> None:
         from kubeflow_tpu.tenancy.authz import default_authorizer
 
         self.client = client
@@ -194,6 +200,9 @@ class DashboardApi:
         # ships spans to the trace-collector service instead
         self.collector = (collector if collector is not None
                           else DEFAULT_COLLECTOR)
+        # anything with .status() (a scheduler GangQueue); None = the
+        # registry's kftpu_queue_* gauges only
+        self.scheduler_queue = scheduler_queue
 
     def _authz(self, user: str, ns: str, resource: str) -> None:
         if not self.authorize(user, "get", ns, resource):
@@ -221,6 +230,8 @@ class DashboardApi:
                 return 200, self.activities(ns)
             if path == "/api/metrics/autoscale":
                 return 200, self.autoscale_view()
+            if path == "/api/metrics/scheduler":
+                return 200, self.scheduler_view()
             if path == "/api/traces":
                 return 200, self.traces()
             if path.startswith("/api/traces/"):
@@ -358,6 +369,20 @@ class DashboardApi:
                                                "kftpu_autoscale_")}
         return {"metrics": _parse_prom(DEFAULT_REGISTRY.expose(),
                                        "kftpu_autoscale_")}
+
+    def scheduler_view(self) -> Dict[str, Any]:
+        """The cluster gang queue's state for the scheduler panel
+        (docs/SCHEDULER.md): per-gang queue states, waits, priorities,
+        preemption counts from an in-process
+        :class:`~kubeflow_tpu.scheduler.queue.GangQueue`; with no queue
+        attached, the registry's ``kftpu_queue_*`` /
+        ``kftpu_preemptions_total`` series still answer "is the queue
+        moving"."""
+        if self.scheduler_queue is not None:
+            return self.scheduler_queue.status()
+        exposition = DEFAULT_REGISTRY.expose()
+        return {"metrics": _parse_prom(exposition, "kftpu_queue_")
+                + _parse_prom(exposition, "kftpu_preemptions_total")}
 
     def traces(self) -> List[Dict[str, Any]]:
         """Recent root spans (+ per-trace span counts), newest first —

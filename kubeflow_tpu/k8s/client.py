@@ -61,7 +61,10 @@ class KubeClient(abc.ABC):
 
     @abc.abstractmethod
     def list(self, api_version: str, kind: str, namespace: Optional[str] = None,
-             label_selector: Optional[Mapping[str, str]] = None) -> List[Obj]: ...
+             label_selector: Optional[Mapping[str, Optional[str]]] = None,
+             ) -> List[Obj]: ...
+    # a selector value of None selects on label EXISTENCE (k8s bare-key
+    # form ``labelSelector=key``); a string selects on equality
 
     @abc.abstractmethod
     def update(self, obj: Obj) -> Obj: ...
@@ -102,11 +105,17 @@ class KubeClient(abc.ABC):
         return self.update(merged)
 
 
-def _match_labels(obj: Obj, selector: Optional[Mapping[str, str]]) -> bool:
+def _match_labels(obj: Obj, selector: Optional[Mapping[str, Optional[str]]]
+                  ) -> bool:
+    """Equality selector; a ``None`` value means *existence* (the k8s
+    bare-key selector form) — the scheduler's occupancy scan filters on
+    "has an assigned-slice label at all" so it reads O(assigned pods),
+    not O(cluster)."""
     if not selector:
         return True
     labels = obj.get("metadata", {}).get("labels", {}) or {}
-    return all(labels.get(k) == v for k, v in selector.items())
+    return all(k in labels if v is None else labels.get(k) == v
+               for k, v in selector.items())
 
 
 class FakeKubeClient(KubeClient):
@@ -152,7 +161,8 @@ class FakeKubeClient(KubeClient):
             return copy.deepcopy(self._store[key])
 
     def list(self, api_version: str, kind: str, namespace: Optional[str] = None,
-             label_selector: Optional[Mapping[str, str]] = None) -> List[Obj]:
+             label_selector: Optional[Mapping[str, Optional[str]]] = None,
+             ) -> List[Obj]:
         with self._lock:
             out = []
             for (av, k, ns, _), obj in self._store.items():
@@ -335,10 +345,13 @@ class HttpKubeClient(KubeClient):
         return self._request("GET", self._path(api_version, kind, namespace, name))
 
     def list(self, api_version: str, kind: str, namespace: Optional[str] = None,
-             label_selector: Optional[Mapping[str, str]] = None) -> List[Obj]:
+             label_selector: Optional[Mapping[str, Optional[str]]] = None,
+             ) -> List[Obj]:
         query = ""
         if label_selector:
-            sel = ",".join(f"{k}={v}" for k, v in label_selector.items())
+            # None value -> bare-key existence selector (k8s grammar)
+            sel = ",".join(k if v is None else f"{k}={v}"
+                           for k, v in label_selector.items())
             query = f"labelSelector={urllib.request.quote(sel)}"
         body = self._request(
             "GET", self._path(api_version, kind, namespace or ""), query=query

@@ -21,11 +21,17 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
 from kubeflow_tpu.k8s.client import KubeClient, WatchEvent
+from kubeflow_tpu.obs.trace import TRACER, Tracer
+from kubeflow_tpu.utils import DEFAULT_REGISTRY
 
 log = logging.getLogger(__name__)
 
 # reconcile returns None (done) or a delay in seconds to requeue
 ReconcileFn = Callable[[str, str], Optional[float]]
+
+_reconciles_total = DEFAULT_REGISTRY.counter(
+    "kftpu_controller_reconciles_total",
+    "reconciles per controller on the shared workqueue runtime")
 
 
 def make_condition(ctype: str, reason: str, message: str = "") -> dict:
@@ -153,18 +159,33 @@ class WorkQueue:
 
 
 class Controller:
-    """Watches primary (and owned) kinds, reconciles keys from a workqueue."""
+    """Watches primary (and owned) kinds, reconciles keys from a workqueue.
+
+    This is the ONE reconcile runtime every control loop in the
+    platform runs on — the tpujob operator, the workflow controller,
+    the serving autoscaler's tick, and the scheduler queue's cycle —
+    so every reconcile is uniformly traced (a ``controller.reconcile``
+    span per invocation) and counted
+    (``kftpu_controller_reconciles_total{controller=}``), whichever
+    subsystem it belongs to.
+
+    ``kind=None`` selects *periodic* mode (:meth:`periodic`): no watch,
+    no resync — the controller seeds one synthetic key at start and the
+    reconcile's returned delay drives the tick, through the same
+    dedup/single-flight workqueue watch-driven controllers use.
+    """
 
     def __init__(
         self,
         client: KubeClient,
         api_version: str,
-        kind: str,
+        kind: Optional[str],
         reconcile: ReconcileFn,
         *,
         namespace: Optional[str] = None,
         name: str = "controller",
         resync_period_s: float = 300.0,
+        tracer: Optional[Tracer] = None,
     ) -> None:
         self.client = client
         self.api_version = api_version
@@ -173,10 +194,29 @@ class Controller:
         self.namespace = namespace or None
         self.name = name
         self.resync_period_s = resync_period_s
+        self.tracer = tracer if tracer is not None else TRACER
         self.queue = WorkQueue()
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
         self._owned: List[Tuple[str, str, Callable[[dict], Optional[Tuple[str, str]]]]] = []
+
+    @classmethod
+    def periodic(
+        cls,
+        reconcile: ReconcileFn,
+        *,
+        name: str = "periodic",
+        tracer: Optional[Tracer] = None,
+        client: Optional[KubeClient] = None,
+    ) -> "Controller":
+        """A watchless controller whose reconcile schedules itself by
+        returning its next delay — the lift for loops that used to be
+        hand-rolled ``while/sleep`` threads (autoscaler tick, scheduler
+        queue cycle). The synthetic key is ``("", name)``; an external
+        event can still ``queue.add`` it to force an immediate pass.
+        ``client`` is optional: periodic mode never watches or lists."""
+        return cls(client, "", None, reconcile, name=name,  # type: ignore[arg-type]
+                   resync_period_s=0.0, tracer=tracer)
 
     def watch_owned(
         self,
@@ -205,11 +245,23 @@ class Controller:
             if key is None:
                 continue
             ns, name = key
-            try:
-                requeue = self.reconcile(ns, name)
-            except Exception:  # noqa: BLE001 — a controller never dies
-                log.exception("%s: reconcile %s/%s failed", self.name, ns, name)
-                requeue = 5.0
+            # uniform reconcile tracing: one span per invocation, same
+            # shape for every controller on this runtime, so scheduler
+            # decisions, autoscaling ticks, and job status all read from
+            # one trace surface
+            with self.tracer.span(
+                    "controller.reconcile",
+                    attrs={"controller": self.name, "namespace": ns,
+                           "name": name}) as sp:
+                try:
+                    requeue = self.reconcile(ns, name)
+                except Exception:  # noqa: BLE001 — a controller never dies
+                    log.exception("%s: reconcile %s/%s failed",
+                                  self.name, ns, name)
+                    sp.status = "ERROR: ReconcileException"
+                    requeue = 5.0
+                sp.attrs["requeueSeconds"] = requeue
+            _reconciles_total.inc(controller=self.name)
             if requeue is not None:
                 self.queue.add(key, delay=requeue)
             self.queue.done(key)
@@ -219,20 +271,27 @@ class Controller:
             md = obj.get("metadata", {})
             return (md.get("namespace", ""), md["name"])
 
-        q = self.client.watch(self.api_version, self.kind, self.namespace)
-        t = threading.Thread(target=self._pump, args=(q, primary_key), daemon=True)
-        t.start()
-        self._threads.append(t)
-        for (av, kind, key_fn) in self._owned:
-            oq = self.client.watch(av, kind, self.namespace)
-            t = threading.Thread(target=self._pump, args=(oq, key_fn), daemon=True)
+        if self.kind:
+            q = self.client.watch(self.api_version, self.kind,
+                                  self.namespace)
+            t = threading.Thread(target=self._pump, args=(q, primary_key),
+                                 daemon=True)
             t.start()
             self._threads.append(t)
+            for (av, kind, key_fn) in self._owned:
+                oq = self.client.watch(av, kind, self.namespace)
+                t = threading.Thread(target=self._pump, args=(oq, key_fn),
+                                     daemon=True)
+                t.start()
+                self._threads.append(t)
+        else:
+            # periodic mode: the reconcile's returned delay is the tick
+            self.queue.add(("", self.name))
         for _ in range(workers):
             t = threading.Thread(target=self._worker, daemon=True)
             t.start()
             self._threads.append(t)
-        if self.resync_period_s:
+        if self.resync_period_s and self.kind:
             t = threading.Thread(target=self._resync_loop, daemon=True)
             t.start()
             self._threads.append(t)

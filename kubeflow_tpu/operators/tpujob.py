@@ -123,6 +123,16 @@ class TpuJobSpec:
     # straggler policy (docs/OBSERVABILITY.md): a worker this many steps
     # behind the gang's median beacon step is flagged in status
     straggler_steps: int = DEFAULT_STRAGGLER_STEPS
+    # cluster scheduler plane (docs/SCHEDULER.md): priority classes
+    # strictly dominate queue order; a preemptible job may be
+    # checkpoint-preempted for a higher class when capacity is short.
+    # totalSteps feeds the predictor's remaining-duration estimate
+    # (0 = unknown — the queue keeps FIFO order, never guesses);
+    # checkpointDir is where workers save/resume (restore_or_init).
+    priority: int = 0
+    preemptible: bool = True
+    total_steps: int = 0
+    checkpoint_dir: str = ""
 
     @property
     def num_workers(self) -> int:
@@ -148,6 +158,10 @@ class TpuJobSpec:
             data_staging=list(spec.get("dataStaging", []) or []),
             straggler_steps=int(spec.get("stragglerSteps",
                                          DEFAULT_STRAGGLER_STEPS)),
+            priority=int(spec.get("priority", 0)),
+            preemptible=bool(spec.get("preemptible", True)),
+            total_steps=int(spec.get("totalSteps", 0)),
+            checkpoint_dir=str(spec.get("checkpointDir", "") or ""),
         )
         out.validate()
         return out
@@ -161,6 +175,8 @@ class TpuJobSpec:
             raise ValueError(f"invalid restartPolicy {self.restart_policy!r}")
         if self.straggler_steps < 1:
             raise ValueError("stragglerSteps must be >= 1")
+        if self.total_steps < 0:
+            raise ValueError("totalSteps must be >= 0")
         for d in self.data_staging:
             if not d.get("source", "").startswith(("gs://", "s3://")):
                 raise ValueError(
@@ -245,6 +261,11 @@ def build_worker_pod(job: o.Obj, index: int, placement: SlicePlacement,
         "MEGASCALE_SLICE_ID": str(placement.slice_index),
         "MEGASCALE_NUM_SLICES": str(spec.slices),
     })
+    if spec.checkpoint_dir:
+        # the preemption contract: workers checkpoint here and resume
+        # via CheckpointManager.restore_or_init, so a preempted gang
+        # comes back with its step clock intact (docs/SCHEDULER.md)
+        env.setdefault("KFTPU_CHECKPOINT_DIR", spec.checkpoint_dir)
 
     volumes = list(spec.volumes)
     mounts = list(spec.volume_mounts)
@@ -327,16 +348,48 @@ def _parse_ts(stamp: str) -> Optional[float]:
 
 
 
+class PreemptionCheckpointer:
+    """How the operator persists a victim's step clock at preemption.
+
+    Production binds this to the job's ``spec.checkpointDir`` through
+    :class:`kubeflow_tpu.train.checkpoint.CheckpointManager` (workers
+    save on teardown, ``latest_step`` reads what landed); tests inject
+    a fake and count ``save`` calls. Both methods return the persisted
+    step, or ``None`` when nothing is known — the queue's victim-cost
+    model treats ``None`` as maximal lost work.
+    """
+
+    def save(self, job: o.Obj) -> Optional[int]:
+        """Ensure a checkpoint exists for this job; return its step."""
+        raise NotImplementedError
+
+    def latest_step(self, ns: str, name: str) -> Optional[int]:
+        raise NotImplementedError
+
+
 class TpuJobOperator:
-    """Reconciles TpuJob CRs into gangs of worker pods + a headless Service."""
+    """Reconciles TpuJob CRs into gangs of worker pods + a headless Service.
+
+    With ``queue`` (a :class:`kubeflow_tpu.scheduler.queue.GangQueue`)
+    attached, gang creation flows through the cluster scheduler plane:
+    jobs submit to the queue (tenancy-quota admission), wait for a
+    priority/predicted-ordering placement grant, and honor preemption
+    signals by checkpointing (``checkpointer``), tearing the gang down,
+    and confirming the requeue (docs/SCHEDULER.md). Without a queue the
+    operator keeps its original first-come placement."""
 
     def __init__(self, client: KubeClient, namespace: Optional[str] = None,
                  gang_scheduling: bool = True,
                  clock: Optional[Clock] = None,
-                 tracer: Optional[Tracer] = None) -> None:
+                 tracer: Optional[Tracer] = None,
+                 queue: Optional[Any] = None,
+                 checkpointer: Optional[PreemptionCheckpointer] = None
+                 ) -> None:
         self.client = client
         self.namespace = namespace
         self.gang_scheduling = gang_scheduling
+        self.queue = queue
+        self.checkpointer = checkpointer
         # epoch-seconds clock (wall, not monotonic: the terminal job span
         # closes against startTime timestamps persisted in CR status) +
         # a tracer sharing it, so the training-job root span stays
@@ -357,16 +410,19 @@ class TpuJobOperator:
         job = self.client.get_or_none(API_VERSION, TPUJOB_KIND, ns, name)
         if job is None:
             self._clear_job_gauges(ns, name)
+            self._queue_release(ns, name)
             return None  # deleted; cascade GC cleans children
         try:
             spec = TpuJobSpec.from_dict(job["spec"])
         except ValueError as e:
             self._set_status(job, PHASE_FAILED,
                              conditions=[_condition("Failed", "InvalidSpec", str(e))])
+            self._queue_release(ns, name)
             return None
 
         phase = job.get("status", {}).get("phase", PHASE_PENDING)
         if phase in (PHASE_SUCCEEDED, PHASE_FAILED):
+            self._queue_release(ns, name)
             return None
 
         pods = self.client.list("v1", "Pod", ns, label_selector={JOB_LABEL: name})
@@ -381,7 +437,16 @@ class TpuJobOperator:
                 self._delete_pods(ns, pods)
             return 1.0
 
+        # scheduler-plane preemption: the queue picked this gang as the
+        # minimum-cost victim for a higher-priority gang — checkpoint,
+        # tear down, confirm the head-of-queue requeue
+        if self.queue is not None and self.queue.preemption_requested(
+                ns, name):
+            return self._handle_preemption(job, spec, pods)
+
         if not pods:
+            if self.queue is not None:
+                return self._reconcile_queued_create(job, spec)
             if not self._create_gang(job, spec):
                 # concrete inventory exists but no free slice window: hold
                 # the whole gang (never partial pods) and retry
@@ -430,7 +495,11 @@ class TpuJobOperator:
         if len(pods) < spec.num_workers:
             # a worker went missing (eviction, manual delete): the SPMD mesh
             # cannot proceed without it — recreate absent members in place
-            if not self._create_gang(job, spec):
+            # (under a queue, on the slices the queue already granted)
+            granted = (self.queue.placement_for(ns, name)
+                       if self.queue is not None else None)
+            if not self._create_gang(job, spec,
+                                     forced_concrete=granted or None):
                 self._set_status(
                     job, PHASE_PENDING,
                     conditions=[_condition("Unschedulable", "NoFreeSlices",
@@ -444,6 +513,7 @@ class TpuJobOperator:
             self._record_job_span(job, PHASE_SUCCEEDED,
                                   telemetry=telemetry)
             self._clear_job_gauges(ns, name)
+            self._queue_release(ns, name)
             return None
         if counts["Running"] == spec.num_workers:
             conds = ([_condition("Running", "GangRunning")]
@@ -466,6 +536,91 @@ class TpuJobOperator:
         self._set_status(job, phase if phase != PHASE_RESTARTING else PHASE_PENDING,
                          **status_update)
         return 2.0
+
+    # -- scheduler-plane integration ---------------------------------------
+
+    def _queue_release(self, ns: str, name: str) -> None:
+        if self.queue is not None:
+            self.queue.release(ns, name)
+
+    def _reconcile_queued_create(self, job: o.Obj,
+                                 spec: TpuJobSpec) -> Optional[float]:
+        """Gang creation through the cluster queue: submit (quota
+        admission), run a scheduling cycle, and create pods only on a
+        placement grant — whole gangs wait, never partial pods."""
+        from kubeflow_tpu.scheduler.queue import BLOCKED, request_from_spec
+
+        ns = job["metadata"]["namespace"]
+        name = job["metadata"]["name"]
+        self.queue.submit(request_from_spec(
+            ns, name, spec, uid=job["metadata"].get("uid", "")))
+        self.queue.schedule()
+        granted = self.queue.placement_for(ns, name)
+        if granted is None:
+            if self.queue.state_of(ns, name) == BLOCKED:
+                reason = "QuotaExceeded"
+                message = self.queue.blocked_reason(ns, name)
+            else:
+                reason = "AwaitingCapacity"
+                message = (f"queued at priority {spec.priority} for "
+                           f"{spec.slices} {spec.accelerator} slice(s)")
+            self._set_status(job, PHASE_PENDING,
+                             conditions=[_condition("Queued", reason,
+                                                    message)])
+            return 5.0
+        if not self._create_gang(job, spec,
+                                 forced_concrete=granted or None):
+            # the grant went stale (an actor outside the queue claimed
+            # the slices between cycles): hand it back and re-place
+            self.queue.invalidate_placement(ns, name)
+            self._set_status(
+                job, PHASE_PENDING,
+                conditions=[_condition("Unschedulable", "PlacementStale",
+                                       "granted slices no longer free; "
+                                       "requeued")])
+            return 5.0
+        self._set_status(job, PHASE_PENDING, restarts=self._restarts(job),
+                         conditions=[_condition("Created", "GangCreated")])
+        return 1.0
+
+    def _handle_preemption(self, job: o.Obj, spec: TpuJobSpec,
+                           pods: List[o.Obj]) -> Optional[float]:
+        """Checkpoint-preempt-requeue: persist the step clock, tear the
+        gang down, mark the CR, confirm the head-of-queue re-admission.
+        The checkpoint save happens exactly once per preemption — the
+        queue flips the victim out of ``Preempting`` on confirm, so
+        this path cannot re-enter for the same eviction."""
+        ns = job["metadata"]["namespace"]
+        name = job["metadata"]["name"]
+        step: Optional[int] = None
+        if self.checkpointer is not None:
+            try:
+                step = self.checkpointer.save(job)
+            except Exception:  # noqa: BLE001 — a broken checkpoint sink
+                # must not wedge the preemption; capacity is owed to a
+                # higher priority NOW, the victim just loses more work
+                log.exception("preemption checkpoint for %s/%s failed",
+                              ns, name)
+        if step is None:
+            telemetry = job.get("status", {}).get("telemetry") or {}
+            step = telemetry.get("lastStep")
+        if pods:
+            self._delete_pods(ns, pods)
+        preemption = dict(job.get("status", {}).get("preemption") or {})
+        by = preemption.get("by", "")
+        preemption.update({"requested": False,
+                           "lastCheckpointStep": step,
+                           "count": int(preemption.get("count", 0)) or 1})
+        self._set_status(
+            job, PHASE_PENDING, preemption=preemption,
+            conditions=[_condition(
+                "Preempted", "RequeuedForPriority",
+                f"preempted for {by or 'a higher-priority gang'}; "
+                f"checkpointed at step {step}; requeued at queue head")])
+        log.info("preempted %s/%s for %s (checkpoint step %s)",
+                 ns, name, by, step)
+        self.queue.confirm_preempted(ns, name, step)
+        return 1.0
 
     # -- helpers -----------------------------------------------------------
 
@@ -498,6 +653,13 @@ class TpuJobOperator:
         _job_last_step.set(view["lastStep"], namespace=ns, job=name)
         _job_steps_per_sec.set(view["stepsPerSec"], namespace=ns, job=name)
         _job_stragglers.set(len(view["stragglers"]), namespace=ns, job=name)
+        if self.queue is not None:
+            # the scheduling loop PR 5 built this telemetry for: every
+            # aggregation feeds the queue's throughput predictor
+            self.queue.predictor.observe(
+                ns, name, steps_per_sec=view["stepsPerSec"],
+                last_step=view["lastStep"],
+                accelerator=spec.accelerator, slices=spec.slices)
         return view
 
     def _clear_job_gauges(self, ns: str, name: str) -> None:
@@ -540,14 +702,19 @@ class TpuJobOperator:
                    "lastStep": telemetry.get("lastStep", 0)},
             status="OK" if phase == PHASE_SUCCEEDED else f"ERROR: {phase}")
 
-    def _create_gang(self, job: o.Obj, spec: TpuJobSpec) -> bool:
+    def _create_gang(self, job: o.Obj, spec: TpuJobSpec,
+                     forced_concrete: Optional[List[str]] = None) -> bool:
         """Create the whole gang atomically. Returns False (creating
         nothing) when a concrete slice inventory exists but has no
-        feasible free window — partial gangs would deadlock the mesh."""
+        feasible free window — partial gangs would deadlock the mesh.
+        ``forced_concrete`` pins the gang to slices the scheduler queue
+        granted instead of running first-come assignment."""
         with self._placement_lock:
-            return self._create_gang_locked(job, spec)
+            return self._create_gang_locked(job, spec, forced_concrete)
 
-    def _create_gang_locked(self, job: o.Obj, spec: TpuJobSpec) -> bool:
+    def _create_gang_locked(self, job: o.Obj, spec: TpuJobSpec,
+                            forced_concrete: Optional[List[str]] = None
+                            ) -> bool:
         name = job["metadata"]["name"]
         ns = job["metadata"]["namespace"]
         placements = place_gang(
@@ -557,22 +724,29 @@ class TpuJobOperator:
         )
         concrete: Optional[List[str]] = None
         scheduler = GangScheduler(self.client)
-        inv = scheduler.inventory(spec.accelerator)
-        if inv:
-            # adopt slices already claimed by this job's surviving pods so
-            # recreate-absent-members keeps siblings on their slice; a
-            # logical slice whose pods ALL died is fully free again and
-            # assignable fresh
-            claimed = self._existing_assignment(ns, name)
-            missing = [k for k in range(spec.slices) if k not in claimed]
-            if missing:
-                fresh = scheduler.assign(
-                    spec.accelerator, len(missing), spec.hosts_per_slice,
-                    inventory=inv)
-                if fresh is None:
-                    return False
-                claimed.update(zip(missing, fresh))
-            concrete = [claimed[k] for k in range(spec.slices)]
+        if forced_concrete is not None:
+            concrete = self._verify_grant(ns, name, spec, scheduler,
+                                          forced_concrete)
+            if concrete is None:
+                return False
+        else:
+            inv = scheduler.inventory(spec.accelerator)
+            if inv:
+                # adopt slices already claimed by this job's surviving
+                # pods so recreate-absent-members keeps siblings on their
+                # slice; a logical slice whose pods ALL died is fully
+                # free again and assignable fresh
+                claimed = self._existing_assignment(ns, name)
+                missing = [k for k in range(spec.slices)
+                           if k not in claimed]
+                if missing:
+                    fresh = scheduler.assign(
+                        spec.accelerator, len(missing),
+                        spec.hosts_per_slice, inventory=inv)
+                    if fresh is None:
+                        return False
+                    claimed.update(zip(missing, fresh))
+                concrete = [claimed[k] for k in range(spec.slices)]
         self._create_if_absent(build_service(job))
         if spec.gang_scheduling and self.gang_scheduling:
             pg = build_podgroup(job)
@@ -596,6 +770,29 @@ class TpuJobOperator:
                  ns, name, spec.num_workers, spec.slices,
                  f" on {concrete}" if concrete else "")
         return True
+
+    def _verify_grant(self, ns: str, name: str, spec: TpuJobSpec,
+                      scheduler: GangScheduler,
+                      granted: List[str]) -> Optional[List[str]]:
+        """Map the queue's slice grant onto logical slice ordinals,
+        keeping surviving pods' claims, and verify every freshly-used
+        slice is still fully free (the grant can go stale if an actor
+        outside the queue claimed it). None = stale, re-place."""
+        inv = {s.slice_id: s
+               for s in scheduler.inventory(spec.accelerator)}
+        claimed = self._existing_assignment(ns, name)
+        fresh = [sid for sid in granted if sid not in claimed.values()]
+        for k in range(spec.slices):
+            if k in claimed:
+                continue
+            if not fresh:
+                return None
+            sid = fresh.pop(0)
+            info = inv.get(sid)
+            if info is None or info.free_hosts != info.hosts:
+                return None
+            claimed[k] = sid
+        return [claimed[k] for k in range(spec.slices)]
 
     def _existing_assignment(self, ns: str, name: str) -> Dict[int, str]:
         """logical slice ordinal -> concrete slice id already claimed by
@@ -640,6 +837,7 @@ class TpuJobOperator:
             self._record_job_span(job, PHASE_FAILED, telemetry=telemetry)
             self._clear_job_gauges(job["metadata"].get("namespace", ""),
                                    job["metadata"].get("name", ""))
+            self._queue_release(ns, name)
             return None
         # SPMD all-or-nothing: tear the whole gang down and re-place it
         _restarts.inc()
@@ -656,7 +854,8 @@ class TpuJobOperator:
                     start: bool = False, completion: bool = False,
                     conditions: Optional[List[Dict[str, Any]]] = None,
                     workers: Optional[Dict[str, int]] = None,
-                    telemetry: Optional[Dict[str, Any]] = None) -> None:
+                    telemetry: Optional[Dict[str, Any]] = None,
+                    preemption: Optional[Dict[str, Any]] = None) -> None:
         status = dict(job.get("status", {}))
         changed = status.get("phase") != phase
         status["phase"] = phase
@@ -667,6 +866,9 @@ class TpuJobOperator:
         if telemetry is not None:
             changed = changed or status.get("telemetry") != telemetry
             status["telemetry"] = telemetry
+        if preemption is not None:
+            changed = changed or status.get("preemption") != preemption
+            status["preemption"] = preemption
         if start and "startTime" not in status:
             status["startTime"] = _condition("", "")["lastTransitionTime"]
         if completion and "completionTime" not in status:
@@ -714,6 +916,7 @@ class TpuJobOperator:
         ctrl = Controller(
             self.client, API_VERSION, TPUJOB_KIND, self.reconcile,
             namespace=self.namespace, name="tpujob-operator",
+            tracer=self.tracer,
         )
 
         def pod_to_job(pod: o.Obj):

@@ -1,4 +1,13 @@
-"""Slice-aware gang placement for TPU pod slices."""
+"""Slice-aware gang placement + the cluster scheduler plane.
+
+Bottom-up: :mod:`placement` (worker index → slice/host, ICI ring
+order), :mod:`inventory` (concrete free slices + best-fit assignment,
+native/Python twins), :mod:`contention` (shared-DCN-link window
+scoring), :mod:`predictor` (telemetry-driven remaining-duration
+estimates), :mod:`queue` (the cluster-level brain: quota admission,
+priority/predicted ordering, contention-aware placement,
+checkpoint-preempt-requeue). docs/SCHEDULER.md has the protocol.
+"""
 
 from kubeflow_tpu.scheduler.placement import (  # noqa: F401
     ACCELERATORS,

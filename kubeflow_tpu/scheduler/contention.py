@@ -1,0 +1,84 @@
+"""Contention-aware slice-window scoring for gang placement.
+
+"On Scheduling Ring-All-Reduce Learning Jobs in Multi-Tenant GPU
+Clusters with Communication Contention" (PAPERS.md, arXiv 2207.07817)
+makes the case this module implements for TPU pods: two concurrent
+ring-all-reduce jobs whose rings share a physical link slow each other
+superlinearly, so the scheduler should pay a *packing* cost (waste) to
+buy an *uncontended* window when one exists.
+
+The link model is the platform's inter-slice DCN fabric as a linear
+chain: slices are ordered by their inventory ordinal and one DCN link
+sits between each adjacent pair. A multi-slice gang placed on slice
+ordinals ``lo..hi`` (its chosen window, inclusive) rides every link in
+``[lo, hi)`` — including links over intermediate slices it does not
+occupy, because cross-slice all-reduce traffic transits them. A
+single-slice gang stays on in-slice ICI and loads no DCN link.
+
+:func:`choose_slices_contended` extends the
+:func:`~kubeflow_tpu.scheduler.inventory.choose_slices_py` scoring with
+a leading contention term: candidate windows are ranked by
+``(contention, waste, span, position)``. When every link is unloaded
+the ranking degenerates to exactly the native core's ``(waste, span,
+position)`` — the twin-parity contract is preserved by *delegating* to
+:func:`~kubeflow_tpu.scheduler.inventory.choose_slices` (native when
+loaded) in that case, and tests pin the equality.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from kubeflow_tpu.scheduler.inventory import choose_slices, choose_slices_py
+
+
+def link_load(placed_windows: Sequence[Tuple[int, int]],
+              n_slices: int) -> List[int]:
+    """Per-DCN-link load from already-placed gangs.
+
+    ``placed_windows`` holds each placed gang's ``(lo, hi)`` slice
+    ordinals (inclusive); the result has ``n_slices - 1`` entries where
+    entry ``i`` is the number of gangs riding the link between slice
+    ``i`` and ``i + 1``.
+    """
+    load = [0] * max(n_slices - 1, 0)
+    for lo, hi in placed_windows:
+        if lo > hi:
+            lo, hi = hi, lo
+        for link in range(max(lo, 0), min(hi, len(load))):
+            load[link] += 1
+    return load
+
+
+def window_contention(load: Sequence[int], lo: int, hi: int) -> int:
+    """Total shared-link load a gang spanning ``lo..hi`` would ride."""
+    if lo > hi:
+        lo, hi = hi, lo
+    return sum(load[max(lo, 0):min(hi, len(load))])
+
+
+def choose_slices_contended(
+    slice_hosts: Sequence[int],
+    free_hosts: Sequence[int],
+    want: int,
+    need_hosts: int,
+    load: Optional[Sequence[int]] = None,
+) -> Optional[List[int]]:
+    """Contention-aware window selection over the free-slice inventory.
+
+    Same feasibility rules as ``choose_slices_py`` (a slice is usable
+    only when fully free and large enough), but windows are ranked by
+    ``(contention, waste, span, position)`` so an uncontended window is
+    always preferred over a contended one, however tightly the
+    contended one packs. The contention term rides ``choose_slices_py``'s
+    own window enumeration (its ``score`` hook) — one scoring body, not
+    a fork to keep in sync. With no load anywhere the result is
+    *exactly* the native/Python twin's: that path delegates to
+    :func:`choose_slices` so the parity contract (and the native core's
+    speed) is kept.
+    """
+    if load is None or not any(load):
+        return choose_slices(slice_hosts, free_hosts, want, need_hosts)
+    return choose_slices_py(
+        slice_hosts, free_hosts, want, need_hosts,
+        score=lambda w: (window_contention(load, w[0], w[-1]),))

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import ctypes
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 from kubeflow_tpu.k8s.client import KubeClient
 from kubeflow_tpu.native import load_library
@@ -36,8 +36,17 @@ class SliceInfo:
 
 
 def choose_slices_py(slice_hosts: Sequence[int], free_hosts: Sequence[int],
-                     want: int, need_hosts: int) -> Optional[List[int]]:
-    """Python twin of ``kftpu_place_slices`` (same scoring, same result)."""
+                     want: int, need_hosts: int,
+                     score: Optional[Callable[[Sequence[int]], tuple]] = None,
+                     ) -> Optional[List[int]]:
+    """Python twin of ``kftpu_place_slices`` (same scoring, same result).
+
+    ``score(window) -> tuple`` optionally PREPENDS ranking terms to the
+    native ``(waste, span, position)`` key — the contention plane's hook
+    (:mod:`kubeflow_tpu.scheduler.contention`) — so extended scorers
+    reuse this one window enumeration instead of forking it; with no
+    ``score`` the ranking is exactly the native core's.
+    """
     n = len(slice_hosts)
     if want <= 0 or n <= 0 or want > n:
         return None
@@ -46,14 +55,16 @@ def choose_slices_py(slice_hosts: Sequence[int], free_hosts: Sequence[int],
             and slice_hosts[i] >= need_hosts]
     if len(feas) < want:
         return None
-    best = None  # (waste, span, start)
+    best = None  # (*score, waste, span, start)
     for s in range(len(feas) - want + 1):
         window = feas[s:s + want]
         waste = sum(slice_hosts[i] - need_hosts for i in window)
         span = window[-1] - window[0]
-        if best is None or (waste, span) < best[:2]:
-            best = (waste, span, s)
-    s = best[2]
+        key = (tuple(score(window)) if score is not None else ()) \
+            + (waste, span)
+        if best is None or key < best[:-1]:
+            best = key + (s,)
+    s = best[-1]
     return feas[s:s + want]
 
 
@@ -94,9 +105,15 @@ class GangScheduler:
             idx = labels.get(SLICE_INDEX_LABEL, "0")
             hosts_per_slice[idx] = hosts_per_slice.get(idx, 0) + 1
 
-        # occupied hosts: running/pending worker pods pinned to a slice
+        # occupied hosts: running/pending worker pods pinned to a slice.
+        # The existence selector ({label: None}) makes the scan
+        # O(assigned pods), not O(cluster) — a serving fleet's thousands
+        # of unpinned pods never cross the wire; the shape prefix is
+        # then filtered here (k8s selectors have no prefix operator).
         busy: Dict[str, int] = {}
-        for pod in self.client.list("v1", "Pod"):
+        for pod in self.client.list("v1", "Pod",
+                                    label_selector={ASSIGNED_SLICE_LABEL:
+                                                    None}):
             labels = pod.get("metadata", {}).get("labels", {}) or {}
             assigned = labels.get(ASSIGNED_SLICE_LABEL, "")
             phase = pod.get("status", {}).get("phase", "Pending")

@@ -82,6 +82,14 @@ def place_gang(
     between blocks of ``hosts_per_slice`` ids.
     """
     chips, max_hosts, topology = accelerator_info(accelerator)
+    # a non-positive gang is a caller bug, never an empty placement: the
+    # scheduler queue trusts placement errors to be loud (silently
+    # returning [] here let a slices<=0 spec "place" a zero-worker gang)
+    if slices < 1:
+        raise ValueError(f"slices must be >= 1, got {slices}")
+    if hosts_per_slice < 1:
+        raise ValueError(
+            f"hosts_per_slice must be >= 1, got {hosts_per_slice}")
     if hosts_per_slice > max_hosts:
         raise ValueError(
             f"{accelerator} has {max_hosts} hosts; requested {hosts_per_slice}"

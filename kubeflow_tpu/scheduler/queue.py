@@ -1,0 +1,680 @@
+"""The cluster-level gang queue: admission, ordering, placement, preemption.
+
+PR 5 gave every TpuJob a telemetry stream (steps/sec, MFU, straggler
+lag) and the placement layer below already maps a gang onto concrete
+slices — but jobs were still placed first-come with no queue, no
+priorities, and no preemption. This module is the brain above
+:mod:`kubeflow_tpu.scheduler.placement` / ``inventory`` that the
+scheduling literature assumes exists:
+
+- **tenancy-quota admission** — a gang enters the queue only while its
+  namespace's ``google.com/tpu`` chip quota (the tenancy plane's
+  ResourceQuota, :func:`kubeflow_tpu.tenancy.profiles.tpu_chip_quota`)
+  covers it; over-quota gangs wait in ``BLOCKED`` and re-admit the
+  moment a sibling finishes. Whole gangs only: a gang is placed
+  atomically or not at all, never partially.
+- **priority/FIFO-hybrid ordering** — priority classes strictly
+  dominate; *within* a class, gangs with a predicted remaining
+  duration (:class:`~kubeflow_tpu.scheduler.predictor.
+  ThroughputPredictor`, fed from PR 5 telemetry) run
+  shortest-remaining-first, and unpredicted gangs keep FIFO order
+  behind them (absent-never-wrong: the queue never fabricates an
+  estimate to reorder by). Preemption victims re-enter at the head of
+  their class.
+- **contention-aware placement** — candidate slice windows are scored
+  by shared-DCN-link overlap with already-placed gangs
+  (:mod:`kubeflow_tpu.scheduler.contention`), so two concurrent
+  all-reduce rings never ride the same links when an uncontended
+  window exists.
+- **checkpoint-preempt-requeue** — when a higher-priority gang cannot
+  fit, the queue picks minimum-cost victims (fewest chips freed,
+  most-recent checkpoint by the ``checkpoint_step`` lookup —
+  ``CheckpointManager.latest_step`` in production) and signals
+  checkpoint-and-requeue through the TpuJob CR
+  (``status.preemption.requested``); the operator checkpoints, tears
+  the gang down, confirms via :meth:`GangQueue.confirm_preempted`, and
+  the victim resumes later with its step clock intact
+  (``CheckpointManager.restore_or_init`` on the worker side).
+
+Every decision is traced (``scheduler.queue.admit`` / ``.predict`` /
+``.place`` / ``.preempt`` / ``.requeue`` spans on the gang's
+identity-derived TpuJob trace) and metered (``kftpu_queue_depth``,
+``kftpu_queue_wait_seconds``, ``kftpu_preemptions_total``); the whole
+plane runs deterministically under a fake clock + fake KubeClient.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from kubeflow_tpu.k8s.client import ApiError, KubeClient
+from kubeflow_tpu.obs.steps import tpujob_trace_ids
+from kubeflow_tpu.obs.trace import SpanContext, Tracer
+from kubeflow_tpu.scheduler.contention import (
+    choose_slices_contended,
+    link_load,
+    window_contention,
+)
+from kubeflow_tpu.scheduler.inventory import GangScheduler, SliceInfo
+from kubeflow_tpu.scheduler.predictor import ThroughputPredictor
+from kubeflow_tpu.tenancy.profiles import tpu_chip_quota
+from kubeflow_tpu.utils import DEFAULT_REGISTRY
+from kubeflow_tpu.utils.clock import Clock
+
+log = logging.getLogger(__name__)
+
+# gang lifecycle inside the queue
+QUEUED = "Queued"            # admitted, waiting for capacity
+BLOCKED = "QuotaBlocked"     # over tenant quota; re-admitted when it fits
+PLACED = "Placed"            # holds concrete slices (or unpinned fallback)
+PREEMPTING = "Preempting"    # victim signalled; awaiting checkpoint+teardown
+
+_QUEUE_WAIT_BUCKETS = (0.5, 1, 5, 15, 60, 300, 900, 3600, 4 * 3600.0)
+
+_depth = DEFAULT_REGISTRY.gauge(
+    "kftpu_queue_depth", "gangs in the scheduler queue by state")
+_wait_h = DEFAULT_REGISTRY.histogram(
+    "kftpu_queue_wait_seconds", "submit-to-placement wait per gang",
+    buckets=_QUEUE_WAIT_BUCKETS)
+_preemptions = DEFAULT_REGISTRY.counter(
+    "kftpu_preemptions_total", "gangs preempted for a higher priority gang")
+
+
+@dataclass(frozen=True)
+class GangRequest:
+    """What the queue needs to know about one gang."""
+
+    namespace: str
+    name: str
+    slices: int
+    hosts_per_slice: int
+    chips_per_host: int = 4
+    accelerator: str = "v5e-8"
+    priority: int = 0
+    preemptible: bool = True
+    total_steps: Optional[int] = None   # predictor hint (spec.totalSteps)
+    uid: str = ""                       # CR uid: identity-derived trace
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.namespace, self.name)
+
+    @property
+    def chips(self) -> int:
+        return self.slices * self.hosts_per_slice * self.chips_per_host
+
+
+@dataclass
+class _Entry:
+    req: GangRequest
+    state: str
+    submitted_at: float
+    seq: int
+    admitted_at: Optional[float] = None
+    placed_at: Optional[float] = None
+    slice_ids: Optional[List[str]] = None
+    window: Optional[Tuple[int, int]] = None   # slice ordinals, inclusive
+    head: bool = False                         # requeue-at-class-head flag
+    head_seq: int = 0
+    blocked_reason: str = ""
+    preemptions: int = 0
+    last_checkpoint_step: Optional[int] = None
+    # set on victims while PREEMPTING: who evicted them + that gang's
+    # trace ids, so the requeue span lands in the preemptor's trace
+    preempted_by: Optional[Tuple[str, str]] = None
+    preemptor_trace: Optional[Tuple[str, str]] = None
+    waiting_victims: List[Tuple[str, str]] = field(default_factory=list)
+    # set on the preemptor: slices its confirmed victims must actually
+    # free (on a real cluster pods drain through a grace period after
+    # confirm) — no further preemption until they read fully free
+    pending_free: List[str] = field(default_factory=list)
+    # last predict-span signature, so the per-cycle ordering pass only
+    # records a span when the estimate changes (not every 5s forever)
+    last_predicted: Optional[Tuple] = None
+
+
+def _slice_ordinal(slice_id: str) -> int:
+    return int(slice_id.rsplit("_", 1)[1])
+
+
+class GangQueue:
+    """Priority/FIFO-hybrid gang queue with quota admission + preemption.
+
+    ``checkpoint_step(ns, name)`` is the victim-cost input — the most
+    recent persisted checkpoint step (``CheckpointManager.latest_step``
+    bound per job in production, a fake in tests); ``None`` means "no
+    checkpoint known", the costliest victim to lose. ``quota_fn(ns)``
+    overrides the tenant chip-quota source (defaults to the tenancy
+    plane's ResourceQuota scan).
+    """
+
+    def __init__(self, client: KubeClient, *,
+                 clock: Optional[Clock] = None,
+                 tracer: Optional[Tracer] = None,
+                 predictor: Optional[ThroughputPredictor] = None,
+                 checkpoint_step: Optional[
+                     Callable[[str, str], Optional[int]]] = None,
+                 quota_fn: Optional[
+                     Callable[[str], Optional[int]]] = None) -> None:
+        self.client = client
+        self.clock: Clock = clock if clock is not None else time.monotonic
+        self.tracer = tracer if tracer is not None else Tracer(
+            clock=self.clock)
+        self.predictor = (predictor if predictor is not None
+                          else ThroughputPredictor(clock=self.clock))
+        self.checkpoint_step = checkpoint_step or (lambda ns, name: None)
+        self.quota_fn = (quota_fn if quota_fn is not None
+                         else lambda ns: tpu_chip_quota(self.client, ns))
+        self.scheduler = GangScheduler(client)
+        self._entries: Dict[Tuple[str, str], _Entry] = {}
+        self._seq = 0
+        self._head_seq = 0
+        self._preempt_count = 0
+        self._lock = threading.RLock()
+
+    # -- identity/trace helpers -------------------------------------------
+
+    def _trace(self, req: GangRequest) -> SpanContext:
+        trace_id, root_id = tpujob_trace_ids(req.namespace, req.name,
+                                             req.uid)
+        return SpanContext(trace_id, root_id)
+
+    def _span(self, name: str, req: GangRequest,
+              attrs: Dict[str, Any],
+              parent: Optional[SpanContext] = None) -> None:
+        now = self.clock()
+        base = {"namespace": req.namespace, "gang": req.name,
+                "priority": req.priority}
+        base.update(attrs)
+        self.tracer.record(name, start=now, end=now,
+                           parent=parent if parent is not None
+                           else self._trace(req), attrs=base)
+
+    # -- submission / admission -------------------------------------------
+
+    def submit(self, req: GangRequest) -> str:
+        """Idempotently enter a gang; returns its queue state. A
+        re-submit with a changed spec (priority edit, resize) updates
+        the request and re-runs admission for waiting gangs."""
+        with self._lock:
+            entry = self._entries.get(req.key)
+            if entry is None:
+                self._seq += 1
+                entry = _Entry(req=req, state=BLOCKED,
+                               submitted_at=self.clock(), seq=self._seq)
+                self._entries[req.key] = entry
+                self._admit(entry)
+            elif entry.req != req:
+                entry.req = req
+                if entry.state in (QUEUED, BLOCKED, PLACED):
+                    # a changed spec (priority edit, elastic resize)
+                    # invalidates any grant — it was sized for the old
+                    # shape — and re-runs admission; a PREEMPTING victim
+                    # finishes its teardown first
+                    entry.slice_ids = None
+                    entry.window = None
+                    entry.state = BLOCKED
+                    self._admit(entry)
+            self._export()
+            return entry.state
+
+    def _quota_used(self, ns: str, exclude: Tuple[str, str]) -> int:
+        return sum(e.req.chips for k, e in self._entries.items()
+                   if e.req.namespace == ns and k != exclude
+                   and e.state in (QUEUED, PLACED, PREEMPTING))
+
+    def _admit(self, entry: _Entry) -> None:
+        """Quota gate: BLOCKED -> QUEUED when the tenant's chip quota
+        covers the gang next to its already-admitted siblings."""
+        req = entry.req
+        quota = self.quota_fn(req.namespace)
+        if quota is not None:
+            used = self._quota_used(req.namespace, req.key)
+            if used + req.chips > quota:
+                entry.state = BLOCKED
+                entry.blocked_reason = (
+                    f"namespace {req.namespace!r} chip quota {quota} "
+                    f"exceeded: {used} in use + {req.chips} requested")
+                return
+        entry.state = QUEUED
+        entry.blocked_reason = ""
+        entry.admitted_at = self.clock()
+        self._span("scheduler.queue.admit", req,
+                   {"chips": req.chips, "quota": quota})
+
+    # -- ordering ----------------------------------------------------------
+
+    def _order_key(self, entry: _Entry) -> Tuple:
+        req = entry.req
+        remaining = self.predictor.remaining_seconds(
+            req.namespace, req.name, total_steps=req.total_steps,
+            accelerator=req.accelerator, slices=req.slices)
+        signature = (remaining is not None,
+                     round(remaining, 3) if remaining is not None else None)
+        if signature != entry.last_predicted:
+            # span only when the estimate changes — a steady queue must
+            # not evict the span ring's incident-debugging window
+            entry.last_predicted = signature
+            self._span("scheduler.queue.predict", req,
+                       {"remainingSeconds": signature[1],
+                        "known": signature[0]})
+        # priority class desc; requeued victims at the class head (in
+        # requeue order); predicted shortest-remaining-first; FIFO tail
+        return (-req.priority,
+                (0, entry.head_seq) if entry.head else (1, 0),
+                (0, remaining) if remaining is not None else (1, 0),
+                entry.seq)
+
+    # -- the scheduling cycle ----------------------------------------------
+
+    def schedule(self) -> None:
+        """One cycle: re-admit, order, place what fits, preempt for the
+        highest-priority gang that does not. Idempotent and cheap when
+        nothing changed; callers run it per reconcile or per tick."""
+        with self._lock:
+            for entry in sorted(self._entries.values(),
+                                key=lambda e: e.seq):
+                if entry.state == BLOCKED:
+                    self._admit(entry)
+            inv_cache: Dict[str, List[SliceInfo]] = {}
+            waiting = sorted(
+                (e for e in self._entries.values() if e.state == QUEUED),
+                key=self._order_key)
+            preempt_tried = False
+            reserved: set = set()   # accelerators a preempting gang owns
+            for entry in waiting:
+                if entry.req.accelerator in reserved:
+                    continue
+                if self._try_place(entry, inv_cache):
+                    continue
+                if entry.waiting_victims or entry.pending_free:
+                    # this gang paid an eviction for the next free
+                    # window on its accelerator: lower-ordered gangs
+                    # must not backfill onto it, or the eviction is
+                    # wasted and the queue preempts in a loop
+                    reserved.add(entry.req.accelerator)
+                    continue
+                if not preempt_tried:
+                    # only the frontmost unplaced gang may evict — a
+                    # lower-ordered gang preempting past it would
+                    # invert the queue's own ordering
+                    preempt_tried = True
+                    self._try_preempt(entry, inv_cache)
+                    if entry.waiting_victims:
+                        reserved.add(entry.req.accelerator)
+            self._export()
+
+    def _inventory(self, inv_cache: Dict[str, List[SliceInfo]],
+                   accelerator: str) -> List[SliceInfo]:
+        inv = inv_cache.get(accelerator)
+        if inv is None:
+            # a granted slice is committed the moment the queue places a
+            # gang — before the operator creates its pods — so the pod
+            # scan alone undercounts; overlay the grants or a later
+            # cycle would double-book the window
+            granted = {sid for e in self._entries.values()
+                       if e.state in (PLACED, PREEMPTING)
+                       and e.req.accelerator == accelerator
+                       for sid in (e.slice_ids or [])}
+            inv = [SliceInfo(slice_id=s.slice_id, shape=s.shape,
+                             hosts=s.hosts,
+                             free_hosts=0 if s.slice_id in granted
+                             else s.free_hosts)
+                   for s in self.scheduler.inventory(accelerator)]
+            inv_cache[accelerator] = inv
+        return inv
+
+    def _placed_windows(self, accelerator: str) -> List[Tuple[int, int]]:
+        return [e.window for e in self._entries.values()
+                if e.state in (PLACED, PREEMPTING)
+                and e.req.accelerator == accelerator
+                and e.window is not None]
+
+    def _position_load(self, inv: List[SliceInfo],
+                       accelerator: str) -> List[int]:
+        """Ordinal-space link load re-indexed to inventory positions
+        (identity for the contiguous-ordinal common case)."""
+        ordinals = [_slice_ordinal(s.slice_id) for s in inv]
+        if not ordinals:
+            return []
+        load = link_load(self._placed_windows(accelerator),
+                         max(ordinals) + 1)
+        return [window_contention(load, ordinals[i], ordinals[i + 1])
+                for i in range(len(ordinals) - 1)]
+
+    def _try_place(self, entry: _Entry,
+                   inv_cache: Dict[str, List[SliceInfo]]) -> bool:
+        req = entry.req
+        inv = self._inventory(inv_cache, req.accelerator)
+        if not inv:
+            # no concrete slice inventory (real GKE placement policy
+            # owns packing): the queue still orders/gates, placement is
+            # unpinned — an empty slice list the operator passes through
+            chosen_ids: List[str] = []
+            window = None
+            contention = 0
+        else:
+            load = self._position_load(inv, req.accelerator)
+            chosen = choose_slices_contended(
+                [s.hosts for s in inv], [s.free_hosts for s in inv],
+                req.slices, req.hosts_per_slice, load)
+            if chosen is None:
+                return False
+            chosen_ids = [inv[i].slice_id for i in chosen]
+            ordinals = [_slice_ordinal(s) for s in chosen_ids]
+            window = (min(ordinals), max(ordinals))
+            contention = window_contention(
+                link_load(self._placed_windows(req.accelerator),
+                          max(ordinals) + 1), window[0], window[1])
+            for i in chosen:  # claim within this cycle's cached scan
+                inv[i] = SliceInfo(slice_id=inv[i].slice_id,
+                                   shape=inv[i].shape, hosts=inv[i].hosts,
+                                   free_hosts=0)
+        now = self.clock()
+        entry.state = PLACED
+        entry.placed_at = now
+        entry.slice_ids = chosen_ids
+        entry.window = window
+        entry.head = False
+        entry.pending_free = []   # the eviction (if any) paid off
+        wait = max(now - entry.submitted_at, 0.0)
+        _wait_h.observe(wait)
+        self._span("scheduler.queue.place", req,
+                   {"slices": ",".join(chosen_ids) or "unpinned",
+                    "contention": contention,
+                    "waitSeconds": round(wait, 3)})
+        return True
+
+    # -- preemption --------------------------------------------------------
+
+    # lost-work sentinel for victims whose progress is unobserved: the
+    # absent-never-wrong stance applied to eviction — never treat an
+    # unknown run as cheap to kill
+    _UNKNOWN_LOST = 1 << 30
+
+    def _victim_cost(self, victim: _Entry) -> Tuple:
+        """(chips freed, steps of work lost) — fewest chips first, then
+        the most recent checkpoint (least lost work). No checkpoint
+        costs the whole observed run; no *telemetry* means the lost
+        work is unknowable and sorts as maximal, so a silent job is
+        never mistaken for a cheap victim."""
+        req = victim.req
+        est = self.predictor.estimate(
+            req.namespace, req.name, accelerator=req.accelerator,
+            slices=req.slices)
+        if est is None or est.source != "job":
+            # a class-baseline estimate says nothing about THIS job's
+            # progress either
+            lost = self._UNKNOWN_LOST
+        else:
+            ckpt = self.checkpoint_step(req.namespace, req.name)
+            lost = max(est.last_step - (ckpt if ckpt is not None else 0),
+                       0)
+        return (req.chips, lost, -victim.seq)
+
+    def _try_preempt(self, entry: _Entry,
+                     inv_cache: Dict[str, List[SliceInfo]]) -> None:
+        req = entry.req
+        if entry.waiting_victims:
+            # a previous preemption for this gang is still tearing
+            # down; never widen the blast radius while it settles
+            return
+        inv = self._inventory(inv_cache, req.accelerator)
+        if not inv:
+            return
+        if entry.pending_free:
+            # confirmed victims' pods may still be draining (a real
+            # cluster's grace period): until every evicted slice reads
+            # fully free, the earlier eviction has not settled — do
+            # not pick more victims on its account
+            by_id = {s.slice_id: s for s in inv}
+            for sid in entry.pending_free:
+                info = by_id.get(sid)
+                if info is not None and info.free_hosts != info.hosts:
+                    return
+            entry.pending_free = []
+        candidates = sorted(
+            (e for e in self._entries.values()
+             if e.state == PLACED and e.req.preemptible
+             and e.req.priority < req.priority
+             and e.req.accelerator == req.accelerator
+             and e.slice_ids),
+            key=self._victim_cost)
+        if not candidates:
+            return
+        chosen = self._victim_set(inv, req, candidates)
+        if not chosen:
+            return
+        for victim in chosen:
+            self._signal_preemption(entry, victim)
+        entry.waiting_victims = [v.req.key for v in chosen]
+
+    def _victim_set(self, inv: List[SliceInfo], req: GangRequest,
+                    candidates: List[_Entry]) -> List[_Entry]:
+        """Minimum-cost victim set that actually makes the gang fit:
+        the cheapest single sufficient victim, else cheapest-first
+        accumulation; empty when even evicting everyone would not."""
+
+        def feasible(victims: List[_Entry]) -> bool:
+            freed = {sid for v in victims for sid in (v.slice_ids or [])}
+            trial = [SliceInfo(slice_id=s.slice_id, shape=s.shape,
+                               hosts=s.hosts,
+                               free_hosts=s.hosts if s.slice_id in freed
+                               else s.free_hosts)
+                     for s in inv]
+            return choose_slices_contended(
+                [s.hosts for s in trial], [s.free_hosts for s in trial],
+                req.slices, req.hosts_per_slice) is not None
+
+        for victim in candidates:           # cheapest sufficient single
+            if feasible([victim]):
+                return [victim]
+        acc: List[_Entry] = []
+        for victim in candidates:           # else accumulate by cost
+            acc.append(victim)
+            if feasible(acc):
+                return acc
+        return []
+
+    def _signal_preemption(self, entry: _Entry, victim: _Entry) -> None:
+        """Mark the victim and write ``status.preemption.requested``
+        on its CR — the operator's cue to checkpoint, tear down, and
+        confirm. The CR write doubles as the watch-event nudge when the
+        operator runs on the controller runtime."""
+        vreq = victim.req
+        victim.state = PREEMPTING
+        victim.preempted_by = entry.req.key
+        ptrace = self._trace(entry.req)
+        victim.preemptor_trace = (ptrace.trace_id, ptrace.span_id)
+        self._preempt_count += 1
+        _preemptions.inc()
+        self._span("scheduler.queue.preempt", entry.req,
+                   {"victim": f"{vreq.namespace}/{vreq.name}",
+                    "victimChips": vreq.chips,
+                    "victimPriority": vreq.priority})
+        log.info("preempting %s/%s (priority %d) for %s/%s (priority %d)",
+                 vreq.namespace, vreq.name, vreq.priority,
+                 entry.req.namespace, entry.req.name, entry.req.priority)
+        from kubeflow_tpu.manifests.components.tpujob_operator import (
+            API_VERSION,
+            TPUJOB_KIND,
+        )
+
+        job = self.client.get_or_none(API_VERSION, TPUJOB_KIND,
+                                      vreq.namespace, vreq.name)
+        if job is None:
+            return
+        status = dict(job.get("status", {}))
+        status["preemption"] = {
+            "requested": True,
+            "by": f"{entry.req.namespace}/{entry.req.name}",
+            "count": victim.preemptions + 1,
+        }
+        job = dict(job)
+        job["status"] = status
+        try:
+            self.client.update_status(job)
+        except ApiError as e:
+            if e.code != 404:
+                raise
+
+    def preemption_requested(self, ns: str, name: str) -> bool:
+        with self._lock:
+            entry = self._entries.get((ns, name))
+            return entry is not None and entry.state == PREEMPTING
+
+    def confirm_preempted(self, ns: str, name: str,
+                          checkpoint_step: Optional[int] = None) -> None:
+        """The operator checkpointed and tore the victim down: free its
+        slices and re-admit it at the head of its priority class with
+        its queue position (and the checkpoint's step clock) intact."""
+        with self._lock:
+            entry = self._entries.get((ns, name))
+            if entry is None or entry.state != PREEMPTING:
+                return
+            preemptor = (self._entries.get(entry.preempted_by)
+                         if entry.preempted_by else None)
+            if preemptor is not None and entry.slice_ids:
+                # the preemptor must watch these slices actually drain
+                # (grace periods) before it may evict anyone else
+                preemptor.pending_free.extend(entry.slice_ids)
+            self._head_seq += 1
+            entry.state = QUEUED
+            entry.head = True
+            entry.head_seq = self._head_seq
+            entry.slice_ids = None
+            entry.window = None
+            entry.preemptions += 1
+            entry.last_checkpoint_step = checkpoint_step
+            parent = (SpanContext(*entry.preemptor_trace)
+                      if entry.preemptor_trace else None)
+            self._span("scheduler.queue.requeue", entry.req,
+                       {"victim": f"{ns}/{name}",
+                        "checkpointStep": checkpoint_step,
+                        "atHead": True}, parent=parent)
+            if preemptor is not None:
+                preemptor.waiting_victims = [
+                    k for k in preemptor.waiting_victims if k != (ns, name)]
+            entry.preempted_by = None
+            entry.preemptor_trace = None
+            self._export()
+
+    # -- placement hand-off ------------------------------------------------
+
+    def placement_for(self, ns: str, name: str) -> Optional[List[str]]:
+        """Concrete slice ids once placed (``[]`` = placed unpinned),
+        ``None`` while the gang still waits."""
+        with self._lock:
+            entry = self._entries.get((ns, name))
+            if entry is None or entry.state != PLACED:
+                return None
+            return list(entry.slice_ids or [])
+
+    def invalidate_placement(self, ns: str, name: str) -> None:
+        """The operator found the granted slices no longer free (an
+        actor outside the queue claimed them): back to the queue."""
+        with self._lock:
+            entry = self._entries.get((ns, name))
+            if entry is not None and entry.state == PLACED:
+                entry.state = QUEUED
+                entry.slice_ids = None
+                entry.window = None
+                self._export()
+
+    def state_of(self, ns: str, name: str) -> Optional[str]:
+        with self._lock:
+            entry = self._entries.get((ns, name))
+            return entry.state if entry is not None else None
+
+    def blocked_reason(self, ns: str, name: str) -> str:
+        with self._lock:
+            entry = self._entries.get((ns, name))
+            return entry.blocked_reason if entry is not None else ""
+
+    def last_checkpoint_step(self, ns: str, name: str) -> Optional[int]:
+        with self._lock:
+            entry = self._entries.get((ns, name))
+            return entry.last_checkpoint_step if entry is not None else None
+
+    def release(self, ns: str, name: str) -> None:
+        """Terminal/deleted gang: drop it, freeing quota and slices."""
+        with self._lock:
+            entry = self._entries.pop((ns, name), None)
+            if entry is None:
+                return
+            self.predictor.forget(ns, name)
+            for e in self._entries.values():
+                e.waiting_victims = [k for k in e.waiting_victims
+                                     if k != (ns, name)]
+            self._export()
+
+    # -- observability -----------------------------------------------------
+
+    def _export(self) -> None:
+        counts = {QUEUED: 0, BLOCKED: 0, PLACED: 0, PREEMPTING: 0}
+        for e in self._entries.values():
+            counts[e.state] = counts.get(e.state, 0) + 1
+        for state, n in counts.items():
+            _depth.set(n, state=state)
+
+    def status(self) -> Dict[str, Any]:
+        """The dashboard's ``GET /api/metrics/scheduler`` payload."""
+        now = self.clock()
+        with self._lock:
+            gangs = []
+            counts: Dict[str, int] = {QUEUED: 0, BLOCKED: 0, PLACED: 0,
+                                      PREEMPTING: 0}
+            for e in sorted(self._entries.values(), key=lambda e: e.seq):
+                counts[e.state] = counts.get(e.state, 0) + 1
+                req = e.req
+                gangs.append({
+                    "namespace": req.namespace,
+                    "name": req.name,
+                    "state": e.state,
+                    "priority": req.priority,
+                    "preemptible": req.preemptible,
+                    "chips": req.chips,
+                    "accelerator": req.accelerator,
+                    "slices": list(e.slice_ids or []),
+                    "waitSeconds": round(
+                        max((e.placed_at if e.placed_at is not None
+                             else now) - e.submitted_at, 0.0), 3),
+                    "preemptions": e.preemptions,
+                    "blockedReason": e.blocked_reason,
+                })
+            return {"depth": counts,
+                    "preemptionsTotal": self._preempt_count,
+                    "gangs": gangs}
+
+    # -- runtime -----------------------------------------------------------
+
+    def build_controller(self, interval_s: float = 5.0):
+        """Periodic scheduling on the shared workqueue runtime
+        (:mod:`kubeflow_tpu.operators.controller` tick mode): cycles run
+        as uniformly-traced reconciles next to the operators'."""
+        from kubeflow_tpu.operators.controller import Controller
+
+        def tick(_ns: str, _name: str) -> float:
+            self.schedule()
+            return interval_s
+
+        return Controller.periodic(tick, name="scheduler-queue",
+                                   tracer=self.tracer)
+
+
+def request_from_spec(ns: str, name: str, spec: Any,
+                      uid: str = "") -> GangRequest:
+    """Build a :class:`GangRequest` from a parsed
+    :class:`~kubeflow_tpu.operators.tpujob.TpuJobSpec` (kept here so the
+    queue's view of a spec lives next to the queue)."""
+    return GangRequest(
+        namespace=ns, name=name, slices=spec.slices,
+        hosts_per_slice=spec.hosts_per_slice,
+        chips_per_host=spec.chips_per_host,
+        accelerator=spec.accelerator, priority=spec.priority,
+        preemptible=spec.preemptible,
+        total_steps=spec.total_steps or None, uid=uid)

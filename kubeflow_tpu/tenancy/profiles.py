@@ -39,6 +39,37 @@ PART_OF_LABEL = "app.kubernetes.io/part-of"
 
 register_plural(PROFILE_KIND, PROFILE_PLURAL, cluster_scoped=True)
 
+# the TPU chip resource the tenant quota caps (build_quota's hard key)
+TPU_RESOURCE = "google.com/tpu"
+
+
+def tpu_chip_quota(client: KubeClient, namespace: str) -> Optional[int]:
+    """The namespace's TPU chip cap from its ResourceQuota objects, or
+    ``None`` when no quota mentions ``google.com/tpu`` (unlimited).
+
+    This is the tenancy plane's admission input to the cluster gang
+    queue (:mod:`kubeflow_tpu.scheduler.queue`): profiles write the
+    quota, the queue holds gangs whose chips would exceed it. Multiple
+    quotas intersect (the k8s semantics: every quota must pass), so the
+    minimum wins; ``requests.``/``limits.`` prefixed forms count too.
+    """
+    cap: Optional[int] = None
+    try:
+        quotas = client.list("v1", "ResourceQuota", namespace)
+    except ApiError:
+        return None
+    for rq in quotas:
+        hard = (rq.get("spec") or {}).get("hard") or {}
+        for key in (TPU_RESOURCE, f"requests.{TPU_RESOURCE}",
+                    f"limits.{TPU_RESOURCE}"):
+            if key in hard:
+                try:
+                    val = int(str(hard[key]))
+                except (TypeError, ValueError):
+                    continue
+                cap = val if cap is None else min(cap, val)
+    return cap
+
 
 @dataclass
 class ProfileSpec:

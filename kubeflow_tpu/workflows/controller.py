@@ -372,7 +372,7 @@ class WorkflowController:
         ctrl = Controller(
             self.client, WORKFLOW_API_VERSION, WORKFLOW_KIND, self.reconcile,
             namespace=self.namespace, name="workflow-controller",
-            resync_period_s=5.0,
+            resync_period_s=5.0, tracer=self.tracer,
         )
 
         def pod_to_wf(pod: o.Obj):

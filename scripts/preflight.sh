@@ -26,6 +26,12 @@
 #      gather (bit-parity oracle) path, then the Pallas paged-attention
 #      kernel path (interpret mode) with a copy-on-write boundary-page
 #      split asserted to copy exactly once (docs/SERVING.md)
+#   6. scheduler-plane smoke (scripts/scheduler_smoke.py): fake 4-slice
+#      inventory, two gangs admit under tenant quota, a high-priority
+#      gang preempts the minimum-cost victim (checkpointed exactly
+#      once, Preempted condition, head-of-queue requeue, resume with
+#      the step clock intact) and every chip stays accounted for
+#      (docs/SCHEDULER.md)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -47,6 +53,9 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_step_telemetry.py -q \
 
 echo "== preflight: paged decode engine smoke =="
 JAX_PLATFORMS=cpu python scripts/paged_smoke.py || rc=1
+
+echo "== preflight: scheduler plane smoke =="
+JAX_PLATFORMS=cpu python scripts/scheduler_smoke.py || rc=1
 
 if [ "$rc" -ne 0 ]; then
     echo "preflight: FAILED" >&2

@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Scheduler-plane smoke gate (scripts/preflight.sh stage).
+
+Drives the cluster gang queue end-to-end on a fake 4-slice inventory
+under a fake clock: two low-priority gangs saturate their tenant's chip
+quota, a high-priority gang arrives, the queue preempts the
+minimum-cost victim through the operator (checkpoint observed exactly
+once, ``Preempted/RequeuedForPriority`` condition set, head-of-queue
+requeue), the preemptor places, and at every step the chip ledger must
+balance: chips(placed gangs) + chips(free slices) == chips(cluster).
+Exits nonzero on any violated invariant (docs/SCHEDULER.md).
+"""
+
+import sys
+import threading
+
+sys.path.insert(0, ".")
+
+from kubeflow_tpu.k8s import FakeKubeClient  # noqa: E402
+from kubeflow_tpu.manifests.components.tpujob_operator import (  # noqa: E402
+    API_VERSION,
+    TPUJOB_KIND,
+)
+from kubeflow_tpu.obs.trace import SpanCollector, Tracer  # noqa: E402
+from kubeflow_tpu.operators.tpujob import (  # noqa: E402
+    JOB_LABEL,
+    PreemptionCheckpointer,
+    TpuJobOperator,
+    tpujob,
+)
+from kubeflow_tpu.platform.local import fake_slice_nodes  # noqa: E402
+from kubeflow_tpu.scheduler.inventory import GangScheduler  # noqa: E402
+from kubeflow_tpu.scheduler.queue import (  # noqa: E402
+    PLACED,
+    PREEMPTING,
+    QUEUED,
+    GangQueue,
+)
+
+CHIPS_PER_HOST = 4
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+        self._lock = threading.Lock()
+
+    def __call__(self):
+        with self._lock:
+            self.t += 0.25
+            return self.t
+
+
+class Checkpointer(PreemptionCheckpointer):
+    def __init__(self, steps):
+        self.steps = steps
+        self.save_calls = []
+
+    def save(self, job):
+        name = job["metadata"]["name"]
+        self.save_calls.append(name)
+        return self.steps.get(name)
+
+    def latest_step(self, ns, name):
+        return self.steps.get(name)
+
+
+def check(ok, what):
+    if not ok:
+        print(f"scheduler smoke: FAIL — {what}", file=sys.stderr)
+        sys.exit(1)
+    print(f"  ok: {what}")
+
+
+def chips_ledger(client, queue, shape="v5e-8"):
+    """(chips held by placed gangs, free chips, cluster chips)."""
+    inv = GangScheduler(client).inventory(shape)
+    total = sum(s.hosts for s in inv) * CHIPS_PER_HOST
+    free = sum(s.free_hosts for s in inv) * CHIPS_PER_HOST
+    placed = sum(g["chips"] for g in queue.status()["gangs"]
+                 if g["state"] in (PLACED, PREEMPTING))
+    return placed, free, total
+
+
+def main():
+    client = FakeKubeClient()
+    for node in fake_slice_nodes("v5e-8", count=4):
+        client.create(node)
+    client.create({"apiVersion": "v1", "kind": "ResourceQuota",
+                   "metadata": {"name": "profile-quota",
+                                "namespace": "tenant"},
+                   "spec": {"hard": {"google.com/tpu": "16"}}})
+    clock = Clock()
+    ckpt = Checkpointer({"low-a": 40, "low-b": 90})
+    queue = GangQueue(client, clock=clock,
+                      tracer=Tracer(SpanCollector(), clock=clock),
+                      checkpoint_step=ckpt.latest_step)
+    op = TpuJobOperator(client, clock=clock, queue=queue,
+                        checkpointer=ckpt)
+
+    def pods(ns, name):
+        return client.list("v1", "Pod", ns,
+                           label_selector={JOB_LABEL: name})
+
+    # 1. two low-priority gangs admit under the 16-chip tenant quota
+    for name in ("low-a", "low-b"):
+        client.create(tpujob(name, "tenant", {"image": "smoke",
+                                              "hostsPerSlice": 2}))
+        op.reconcile("tenant", name)
+        check(len(pods("tenant", name)) == 2, f"{name} placed (2 workers)")
+    queue.predictor.observe("tenant", "low-a", steps_per_sec=1.0,
+                            last_step=100)
+    queue.predictor.observe("tenant", "low-b", steps_per_sec=1.0,
+                            last_step=100)
+    placed, free, total = chips_ledger(client, queue)
+    check(placed + free == total,
+          f"chip ledger balances after admits ({placed}+{free}=={total})")
+
+    # 2. a high-priority 3-slice gang cannot fit the 2 free slices
+    client.create(tpujob("urgent", "prod", {
+        "image": "smoke", "slices": 3, "hostsPerSlice": 2,
+        "priority": 10}))
+    op.reconcile("prod", "urgent")
+    check(queue.state_of("tenant", "low-b") == PREEMPTING,
+          "min-cost victim (freshest checkpoint) marked Preempting")
+
+    # 3. the victim checkpoints exactly once and requeues at the head
+    op.reconcile("tenant", "low-b")
+    check(ckpt.save_calls == ["low-b"], "exactly one checkpoint save")
+    check(pods("tenant", "low-b") == [], "victim gang torn down")
+    job = client.get(API_VERSION, TPUJOB_KIND, "tenant", "low-b")
+    conds = {(c["type"], c["reason"]) for c in job["status"]["conditions"]}
+    check(("Preempted", "RequeuedForPriority") in conds,
+          "Preempted/RequeuedForPriority condition set")
+    check(queue.state_of("tenant", "low-b") == QUEUED,
+          "victim requeued (head of its class)")
+
+    # 4. the preemptor lands on the freed capacity; ledger still balances
+    op.reconcile("prod", "urgent")
+    check(len(pods("prod", "urgent")) == 6, "preemptor placed (6 workers)")
+    placed, free, total = chips_ledger(client, queue)
+    check(placed + free == total and free == 0,
+          f"every chip accounted for ({placed} placed + {free} free "
+          f"== {total})")
+
+    # 5. capacity frees; the victim resumes with its step clock intact
+    for pod in pods("prod", "urgent"):
+        pod.setdefault("status", {})["phase"] = "Succeeded"
+        client.update_status(pod)
+    op.reconcile("prod", "urgent")
+    op.reconcile("tenant", "low-b")
+    check(len(pods("tenant", "low-b")) == 2, "victim resumed")
+    check(queue.last_checkpoint_step("tenant", "low-b") == 90,
+          "step clock intact through preempt-requeue (checkpoint 90)")
+    placed, free, total = chips_ledger(client, queue)
+    check(placed + free == total,
+          f"final chip ledger balances ({placed}+{free}=={total})")
+    print("scheduler smoke: ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -9,7 +9,12 @@ import urllib.request
 import pytest
 
 from kubeflow_tpu.auth.gatekeeper import AuthServer, hash_password
-from kubeflow_tpu.edge.certs import webhook_certs
+
+# the webhook-TLS tests generate real certs; the container image does
+# not ship `cryptography`, and an unguarded module-level
+# `edge.certs` import left a permanent collection error in every
+# tier-1 run — those three tests importorskip it individually so the
+# rest of the edge suite (routing, auth, streaming) still runs
 from kubeflow_tpu.edge.proxy import EdgeProxy, Route, default_routes
 from kubeflow_tpu.k8s import FakeKubeClient
 from kubeflow_tpu.utils.jsonhttp import USER_HEADER, serve_json
@@ -259,6 +264,7 @@ def test_default_routes_catch_all_last():
 
 
 def test_webhook_tls_end_to_end():
+    pytest.importorskip("cryptography")
     from kubeflow_tpu.tenancy.poddefault import pod_default
     from kubeflow_tpu.tenancy.webhook import (
         WEBHOOK_NAME,
@@ -314,6 +320,7 @@ def test_webhook_tls_end_to_end():
 
 
 def test_webhook_bootstrap_reuses_existing_secret():
+    pytest.importorskip("cryptography")
     from kubeflow_tpu.tenancy.webhook import bootstrap_certs
 
     client = FakeKubeClient()
@@ -323,6 +330,9 @@ def test_webhook_bootstrap_reuses_existing_secret():
 
 
 def test_webhook_cert_sans():
+    pytest.importorskip("cryptography")
+    from kubeflow_tpu.edge.certs import webhook_certs
+
     ca, server = webhook_certs("poddefault-webhook", "kubeflow")
     from cryptography import x509
 

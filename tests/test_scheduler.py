@@ -28,6 +28,103 @@ def test_place_gang_rejects_oversubscription():
         place_gang(slices=1, hosts_per_slice=4, accelerator="v5e-8")
 
 
+def test_place_gang_rejects_nonpositive_shape():
+    # the scheduler queue trusts placement errors to be loud: slices<=0
+    # used to silently return an empty placement (a zero-worker "gang")
+    with pytest.raises(ValueError, match="slices must be >= 1"):
+        place_gang(slices=0, hosts_per_slice=2, accelerator="v5e-8")
+    with pytest.raises(ValueError, match="slices must be >= 1"):
+        place_gang(slices=-1, hosts_per_slice=2, accelerator="v5e-8")
+    with pytest.raises(ValueError, match="hosts_per_slice must be >= 1"):
+        place_gang(slices=1, hosts_per_slice=0, accelerator="v5e-8")
+
+
+def test_choose_slices_tie_break_and_infeasibility_edges():
+    """choose_slices_py tie-breaking + infeasibility edges, pinned
+    identical against the native core when the library loads."""
+    from kubeflow_tpu.native import load_library
+    from kubeflow_tpu.scheduler.inventory import (
+        choose_slices,
+        choose_slices_py,
+    )
+
+    cases = [
+        # equal-waste windows: the smaller span must win ([4,5] spans 1
+        # vs [2,4] spanning a busy slice)
+        (([2, 2, 2, 2, 2, 2], [0, 0, 2, 0, 2, 2], 2, 2), [4, 5]),
+        # equal waste AND equal span: first window wins (stable)
+        (([2, 2, 2, 2], [2, 2, 2, 2], 2, 2), [0, 1]),
+        # need_hosts larger than every slice: infeasible
+        (([2, 2, 2], [2, 2, 2], 1, 4), None),
+        # want == n: the only window is everything (all must be free)
+        (([2, 2, 2], [2, 2, 2], 3, 2), [0, 1, 2]),
+        (([2, 2, 2], [2, 0, 2], 3, 2), None),
+        # want > n / want <= 0: infeasible by contract
+        (([2, 2], [2, 2], 3, 2), None),
+        (([2, 2], [2, 2], 0, 2), None),
+    ]
+    native = load_library() is not None
+    for (hosts, free, want, need), expect in cases:
+        got = choose_slices_py(hosts, free, want, need)
+        assert got == expect, (hosts, free, want, need)
+        if native:
+            assert choose_slices(hosts, free, want, need) == expect, \
+                ("native twin disagrees", hosts, free, want, need)
+
+
+def test_inventory_occupancy_scan_uses_existence_selector():
+    """The busy-pod scan must pass the assigned-slice existence
+    selector (O(assigned pods), not O(cluster)) — pinned by recording
+    the selector and by seeding unlabeled pods that must never be
+    listed."""
+    from kubeflow_tpu.k8s.client import FakeKubeClient
+    from kubeflow_tpu.scheduler.inventory import (
+        ASSIGNED_SLICE_LABEL,
+        SHAPE_LABEL,
+        SLICE_INDEX_LABEL,
+        GangScheduler,
+    )
+
+    class RecordingClient(FakeKubeClient):
+        def __init__(self):
+            super().__init__()
+            self.pod_list_selectors = []
+
+        def list(self, api_version, kind, namespace=None,
+                 label_selector=None):
+            if kind == "Pod":
+                self.pod_list_selectors.append(label_selector)
+            return super().list(api_version, kind, namespace,
+                                label_selector)
+
+    client = RecordingClient()
+    for h in range(2):
+        client.create({
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": f"n-{h}", "namespace": "",
+                         "labels": {SHAPE_LABEL: "v5e-8",
+                                    SLICE_INDEX_LABEL: "0"}}})
+    # cluster noise: a thousand-pod serving fleet, none slice-assigned
+    for i in range(3):
+        client.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"serve-{i}", "namespace": "d",
+                         "labels": {"app": "model-server"}},
+            "status": {"phase": "Running"}})
+    client.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "worker", "namespace": "d",
+                     "labels": {ASSIGNED_SLICE_LABEL: "v5e-8_0"}},
+        "status": {"phase": "Running"}})
+    inv = GangScheduler(client).inventory("v5e-8")
+    assert [(s.slice_id, s.free_hosts) for s in inv] == [("v5e-8_0", 1)]
+    assert client.pod_list_selectors == [{ASSIGNED_SLICE_LABEL: None}]
+    # and the fake honors existence semantics: only the labeled pod
+    assert [p["metadata"]["name"] for p in client.list(
+        "v1", "Pod", label_selector={ASSIGNED_SLICE_LABEL: None})] == [
+        "worker"]
+
+
 def test_ring_order_snake_is_adjacent():
     # v5e-64: 16 hosts as a 4x4 host grid; consecutive entries must be
     # grid-adjacent (the boustrophedon walk)

@@ -1,0 +1,796 @@
+"""Cluster scheduler plane: predictive gang queue, contention-aware
+placement, checkpoint-preempt-requeue — all deterministic on FakeClock +
+FakeKubeClient (docs/SCHEDULER.md)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.k8s import FakeKubeClient
+from kubeflow_tpu.manifests.components.tpujob_operator import (
+    API_VERSION,
+    TPUJOB_KIND,
+)
+from kubeflow_tpu.obs.steps import tpujob_trace_ids
+from kubeflow_tpu.obs.trace import SpanCollector, Tracer
+from kubeflow_tpu.operators.tpujob import (
+    JOB_LABEL,
+    PHASE_PENDING,
+    PHASE_SUCCEEDED,
+    PreemptionCheckpointer,
+    TpuJobOperator,
+    tpujob,
+)
+from kubeflow_tpu.platform.local import fake_slice_nodes
+from kubeflow_tpu.scheduler.contention import (
+    choose_slices_contended,
+    link_load,
+    window_contention,
+)
+from kubeflow_tpu.scheduler.inventory import (
+    ASSIGNED_SLICE_LABEL,
+    SHAPE_LABEL,
+    SLICE_INDEX_LABEL,
+    choose_slices,
+    choose_slices_py,
+)
+from kubeflow_tpu.scheduler.predictor import ThroughputPredictor, shape_factor
+from kubeflow_tpu.scheduler.queue import (
+    BLOCKED,
+    PLACED,
+    PREEMPTING,
+    QUEUED,
+    GangQueue,
+    GangRequest,
+)
+from kubeflow_tpu.utils import DEFAULT_REGISTRY
+
+
+class FakeClock:
+    """Thread-safe tick clock: every read advances ``step``."""
+
+    def __init__(self, start: float = 1000.0, step: float = 0.5):
+        self.t = start
+        self.step = step
+        self._lock = threading.Lock()
+
+    def __call__(self) -> float:
+        with self._lock:
+            self.t += self.step
+            return self.t
+
+
+def _gang(ns, name, *, slices=1, hosts=2, priority=0, preemptible=True,
+          total_steps=None, accelerator="v5e-8", uid=""):
+    return GangRequest(namespace=ns, name=name, slices=slices,
+                       hosts_per_slice=hosts, chips_per_host=4,
+                       accelerator=accelerator, priority=priority,
+                       preemptible=preemptible, total_steps=total_steps,
+                       uid=uid)
+
+
+def _quota(client, ns, chips):
+    client.create({"apiVersion": "v1", "kind": "ResourceQuota",
+                   "metadata": {"name": "profile-quota", "namespace": ns},
+                   "spec": {"hard": {"google.com/tpu": str(chips)}}})
+
+
+def _seed(client, shape="v5e-8", count=4):
+    for node in fake_slice_nodes(shape, count=count):
+        client.create(node)
+
+
+def make_queue(client, **kw):
+    kw.setdefault("clock", FakeClock())
+    kw.setdefault("tracer", Tracer(SpanCollector(), clock=kw["clock"]))
+    return GangQueue(client, **kw)
+
+
+# -- contention scoring ------------------------------------------------------
+
+
+def test_link_load_and_window_contention():
+    # gangs on [0,3] and [2,4]: links 0-2 loaded once, links 2-3 shared
+    load = link_load([(0, 3), (2, 4)], 6)
+    assert load == [1, 1, 2, 1, 0]
+    assert window_contention(load, 1, 2) == 1
+    assert window_contention(load, 4, 5) == 0
+    assert window_contention(load, 0, 0) == 0      # single-slice: ICI only
+    assert window_contention(load, 3, 1) == 3      # reversed bounds ok
+
+
+def test_contended_choice_prefers_uncontended_window():
+    # slices: [0]=4h, [1]=2h, [2]=2h, [3..5]=4h; a 2-slice gang already
+    # rides links 0..2 (window [0,3]); the tight [1,2] window would
+    # share its links — the scorer must pay waste to take [4,5]
+    hosts = [4, 2, 2, 4, 4, 4]
+    free = [0, 2, 2, 0, 4, 4]
+    load = link_load([(0, 3)], 6)
+    baseline = choose_slices_py(hosts, free, 2, 2)
+    assert baseline == [1, 2]                       # waste-first ranking
+    contended = choose_slices_contended(hosts, free, 2, 2, load)
+    assert contended == [4, 5]                      # uncontended wins
+
+
+def test_contended_zero_load_delegates_to_twin():
+    import random
+
+    rng = random.Random(7)
+    for _ in range(100):
+        n = rng.randint(1, 12)
+        hosts = [rng.choice([1, 2, 4]) for _ in range(n)]
+        free = [rng.choice([0, h]) for h in hosts]
+        want, need = rng.randint(1, 3), rng.choice([1, 2, 4])
+        expect = choose_slices(hosts, free, want, need)
+        assert choose_slices_contended(hosts, free, want, need) == expect
+        assert choose_slices_contended(hosts, free, want, need,
+                                       [0] * (n - 1)) == expect
+
+
+# -- predictor ---------------------------------------------------------------
+
+
+def test_predictor_absent_never_wrong():
+    p = ThroughputPredictor(clock=FakeClock())
+    assert p.estimate("d", "j") is None
+    assert p.remaining_seconds("d", "j", total_steps=100) is None
+    # zero-rate telemetry carries no signal and must not create one
+    p.observe("d", "j", steps_per_sec=0.0, last_step=5)
+    assert p.estimate("d", "j") is None
+
+
+def test_predictor_rate_and_remaining():
+    p = ThroughputPredictor(clock=FakeClock())
+    p.observe("d", "j", steps_per_sec=2.0, last_step=100)
+    est = p.estimate("d", "j", total_steps=300)
+    assert est.source == "job"
+    assert est.steps_per_sec == pytest.approx(2.0)
+    assert est.remaining_steps == 200
+    assert est.remaining_seconds == pytest.approx(100.0)
+    # online correction: the EWMA folds a faster reading in
+    p.observe("d", "j", steps_per_sec=4.0, last_step=120)
+    est = p.estimate("d", "j", total_steps=300)
+    assert 2.0 < est.steps_per_sec < 4.0
+    # total_steps unknown -> rate known, remaining honestly absent
+    est = p.estimate("d", "j")
+    assert est.remaining_seconds is None
+
+
+def test_predictor_class_baseline_for_new_jobs():
+    p = ThroughputPredictor(clock=FakeClock())
+    p.observe("d", "seen", steps_per_sec=3.0, last_step=50,
+              accelerator="v5e-8", slices=1)
+    est = p.estimate("d", "new", total_steps=60, accelerator="v5e-8",
+                     slices=2)
+    assert est is not None and est.source == "class"
+    assert est.steps_per_sec == pytest.approx(
+        3.0 * shape_factor(1) / shape_factor(2))
+    # a different accelerator class learned nothing
+    assert p.estimate("d", "other", accelerator="v5p-8") is None
+
+
+def test_predictor_stale_observation_ignored():
+    clock = FakeClock(step=0.0)
+    p = ThroughputPredictor(clock=clock, ttl_s=60.0)
+    p.observe("d", "j", steps_per_sec=2.0, last_step=10)
+    clock.t += 3600.0
+    assert p.estimate("d", "j", total_steps=100) is None
+
+
+# -- queue: admission, ordering, placement -----------------------------------
+
+
+def test_quota_admission_blocks_and_readmits():
+    client = FakeKubeClient()
+    _seed(client, count=4)
+    _quota(client, "tenant", 16)            # two 8-chip gangs
+    q = make_queue(client)
+    assert q.submit(_gang("tenant", "a")) == QUEUED
+    assert q.submit(_gang("tenant", "b")) == QUEUED
+    assert q.submit(_gang("tenant", "c")) == BLOCKED
+    assert "quota 16 exceeded" in q.blocked_reason("tenant", "c")
+    # another namespace is not gated by this tenant's quota
+    assert q.submit(_gang("prod", "p")) == QUEUED
+    q.schedule()
+    assert q.state_of("tenant", "c") == BLOCKED
+    # a sibling finishing frees quota; the next cycle re-admits
+    q.release("tenant", "a")
+    q.schedule()
+    assert q.state_of("tenant", "c") == PLACED
+
+
+def test_priority_then_predicted_then_fifo_ordering():
+    client = FakeKubeClient()
+    _seed(client, count=1)                  # one slice: strict ordering
+    q = make_queue(client)
+    q.predictor.observe("d", "long", steps_per_sec=1.0, last_step=0)
+    q.predictor.observe("d", "short", steps_per_sec=1.0, last_step=900)
+    q.submit(_gang("d", "unknown", total_steps=None))   # FIFO tail
+    q.submit(_gang("d", "long", total_steps=1000))
+    q.submit(_gang("d", "short", total_steps=1000))
+    q.submit(_gang("d", "vip", priority=5))
+    q.schedule()
+    placed = [g["name"] for g in q.status()["gangs"]
+              if g["state"] == PLACED]
+    assert placed == ["vip"]                # priority class dominates
+    q.release("d", "vip")
+    q.schedule()
+    assert q.state_of("d", "short") == PLACED   # SRF within the class
+    q.release("d", "short")
+    q.schedule()
+    assert q.state_of("d", "long") == PLACED    # predicted before unknown
+    q.release("d", "long")
+    q.schedule()
+    assert q.state_of("d", "unknown") == PLACED
+
+
+def test_queue_wait_and_depth_metrics_move():
+    client = FakeKubeClient()
+    _seed(client, count=1)
+    depth = DEFAULT_REGISTRY.gauge("kftpu_queue_depth")
+    wait_h = DEFAULT_REGISTRY.histogram("kftpu_queue_wait_seconds")
+    waits_before = wait_h.get()
+    q = make_queue(client)
+    q.submit(_gang("d", "a"))
+    q.submit(_gang("d", "b"))
+    q.schedule()
+    assert depth.get(state=PLACED) == 1
+    assert depth.get(state=QUEUED) == 1
+    assert wait_h.get() == waits_before + 1
+
+
+def test_placement_atomic_or_not_at_all():
+    client = FakeKubeClient()
+    _seed(client, count=2)
+    q = make_queue(client)
+    q.submit(_gang("d", "big", slices=3))   # needs 3, cluster has 2
+    q.schedule()
+    assert q.state_of("d", "big") == QUEUED
+    assert q.placement_for("d", "big") is None
+
+
+def test_empty_inventory_places_unpinned():
+    q = make_queue(FakeKubeClient())        # no nodes at all
+    q.submit(_gang("d", "j"))
+    q.schedule()
+    assert q.placement_for("d", "j") == []  # placed, selector-only
+
+
+# -- queue: preemption -------------------------------------------------------
+
+
+def _preemption_cluster():
+    client = FakeKubeClient()
+    _seed(client, count=4)
+    clock = FakeClock()
+    collector = SpanCollector()
+    q = make_queue(client, clock=clock,
+                   tracer=Tracer(collector, clock=clock),
+                   checkpoint_step=lambda ns, name: {"low1": 50,
+                                                     "low2": 90}.get(name))
+    return client, q, collector
+
+
+def test_preemption_picks_min_cost_victim():
+    client, q, _ = _preemption_cluster()
+    # equal chips; low2's checkpoint (step 90 of 100) loses least work
+    q.predictor.observe("d", "low1", steps_per_sec=1.0, last_step=100)
+    q.predictor.observe("d", "low2", steps_per_sec=1.0, last_step=100)
+    for name in ("low1", "low2"):
+        client.create(tpujob(name, "d", {"image": "x", "hostsPerSlice": 2}))
+        q.submit(_gang("d", name))
+    q.schedule()
+    assert q.state_of("d", "low1") == PLACED
+    assert q.state_of("d", "low2") == PLACED
+    before = DEFAULT_REGISTRY.counter("kftpu_preemptions_total").get()
+    q.submit(_gang("prod", "urgent", slices=3, priority=10))
+    q.schedule()
+    assert q.state_of("d", "low2") == PREEMPTING
+    assert q.state_of("d", "low1") == PLACED
+    assert q.preemption_requested("d", "low2")
+    assert DEFAULT_REGISTRY.counter(
+        "kftpu_preemptions_total").get() == before + 1
+    # the signal landed on the victim's CR
+    job = client.get(API_VERSION, TPUJOB_KIND, "d", "low2")
+    assert job["status"]["preemption"]["requested"] is True
+    assert job["status"]["preemption"]["by"] == "prod/urgent"
+    # a second cycle must not widen the blast radius while it settles
+    q.schedule()
+    assert q.state_of("d", "low1") == PLACED
+
+
+def test_nonpreemptible_and_equal_priority_are_safe():
+    client, q, _ = _preemption_cluster()
+    client.create(tpujob("low1", "d", {"image": "x", "hostsPerSlice": 2,
+                                       "preemptible": False}))
+    q.submit(_gang("d", "low1", slices=4, preemptible=False))
+    q.schedule()
+    q.submit(_gang("prod", "peer", slices=1, priority=0))     # same class
+    q.submit(_gang("prod", "urgent", slices=1, priority=10))  # higher
+    q.schedule()
+    # nothing preemptible: both waits hold, nobody is evicted
+    assert q.state_of("d", "low1") == PLACED
+    assert q.state_of("prod", "peer") == QUEUED
+    assert q.state_of("prod", "urgent") == QUEUED
+
+
+def test_confirm_preempted_requeues_at_class_head():
+    client, q, _ = _preemption_cluster()
+    q.predictor.observe("d", "low1", steps_per_sec=1.0, last_step=100)
+    q.predictor.observe("d", "low2", steps_per_sec=1.0, last_step=100)
+    for name in ("low1", "low2"):
+        client.create(tpujob(name, "d", {"image": "x", "hostsPerSlice": 2}))
+        q.submit(_gang("d", name))
+    q.schedule()
+    q.submit(_gang("prod", "urgent", slices=3, priority=10))
+    q.schedule()
+    assert q.state_of("d", "low2") == PREEMPTING
+    q.confirm_preempted("d", "low2", 90)
+    assert q.state_of("d", "low2") == QUEUED
+    assert q.last_checkpoint_step("d", "low2") == 90
+    # ahead of every other class-0 gang, even a predicted-short one
+    q.predictor.observe("d", "newcomer", steps_per_sec=100.0, last_step=999)
+    q.submit(_gang("d", "newcomer", total_steps=1000))
+    names = [g["name"] for g in q.status()["gangs"]]
+    assert set(names) >= {"low1", "low2", "urgent", "newcomer"}
+    # urgent places first (higher class) onto the freed capacity
+    q.schedule()
+    assert q.state_of("prod", "urgent") == PLACED
+    assert q.state_of("d", "low2") == QUEUED  # waits for capacity again
+
+
+def test_no_backfill_onto_a_preempting_gangs_accelerator():
+    """The eviction must pay off: once a gang preempts for the next
+    free window, lower-ordered gangs may not backfill onto the freed
+    (or about-to-free) slices — that would waste the eviction and loop
+    the queue into preempting forever."""
+    client = FakeKubeClient()
+    _seed(client, count=2)
+    q = make_queue(client)
+    client.create(tpujob("low1", "d", {"image": "x", "hostsPerSlice": 2}))
+    q.predictor.observe("d", "low1", steps_per_sec=1.0, last_step=100)
+    q.submit(_gang("d", "low1"))
+    q.schedule()
+    assert q.state_of("d", "low1") == PLACED        # 1 slice free
+    q.submit(_gang("prod", "urgent", slices=2, priority=10))
+    q.submit(_gang("d", "tiny", slices=1))
+    q.schedule()
+    assert q.state_of("d", "low1") == PREEMPTING
+    # tiny would fit the free slice, but urgent paid for that window
+    assert q.state_of("d", "tiny") == QUEUED
+    q.confirm_preempted("d", "low1", 90)
+    q.schedule()
+    assert q.state_of("prod", "urgent") == PLACED
+    assert q.state_of("d", "tiny") == QUEUED        # still no capacity
+
+
+def test_unknown_progress_victim_never_reads_cheap():
+    """A victim with no telemetry has unknowable lost work: it must
+    sort as maximal cost, not zero — the observed victim with a fresh
+    checkpoint is the honest minimum-cost choice."""
+    client = FakeKubeClient()
+    _seed(client, count=4)
+    q = make_queue(client,
+                   checkpoint_step=lambda ns, name: {"seen": 90}.get(name))
+    q.predictor.observe("d", "seen", steps_per_sec=1.0, last_step=100)
+    for name in ("seen", "silent"):
+        client.create(tpujob(name, "d", {"image": "x", "hostsPerSlice": 2}))
+        q.submit(_gang("d", name))
+    q.schedule()
+    q.submit(_gang("prod", "urgent", slices=3, priority=10))
+    q.schedule()
+    assert q.state_of("d", "seen") == PREEMPTING    # lost 10 steps
+    assert q.state_of("d", "silent") == PLACED      # unknown ≠ cheap
+
+
+# -- contention separation through the queue ---------------------------------
+
+
+def test_contention_separates_concurrent_gangs():
+    client = FakeKubeClient()
+    # heterogeneous inventory: slice 0 = 4 hosts, 1-2 = 2 hosts,
+    # 3-5 = 4 hosts (hosts == node count per slice index)
+    for s, hosts in enumerate([4, 2, 2, 4, 4, 4]):
+        for h in range(hosts):
+            client.create({
+                "apiVersion": "v1", "kind": "Node",
+                "metadata": {"name": f"n-{s}-{h}",
+                             "labels": {SHAPE_LABEL: "v5e-16",
+                                        SLICE_INDEX_LABEL: str(s)}}})
+    # slices 4,5 temporarily busy so gang A lands on the spread window
+    # [0,3] (riding links 0..2), the shape real fragmentation produces
+    pads = []
+    for s in (4, 5):
+        for h in range(4):
+            name = f"pad-{s}-{h}"
+            client.create({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": name, "namespace": "pad",
+                             "labels": {ASSIGNED_SLICE_LABEL:
+                                        f"v5e-16_{s}"}},
+                "status": {"phase": "Running"}})
+            pads.append(name)
+    q = make_queue(client)
+    q.submit(_gang("d", "ring-a", slices=2, hosts=4, accelerator="v5e-16"))
+    q.schedule()
+    assert q.placement_for("d", "ring-a") == ["v5e-16_0", "v5e-16_3"]
+    for name in pads:
+        client.delete("v1", "Pod", "pad", name)
+    # gang B (2 small slices): the tight window [1,2] sits INSIDE A's
+    # span; an uncontended [4,5] exists and must win despite its waste
+    q.submit(_gang("d", "ring-b", slices=2, hosts=2, accelerator="v5e-16"))
+    q.schedule()
+    assert q.placement_for("d", "ring-b") == ["v5e-16_4", "v5e-16_5"]
+    # and the waste-first twin would have collided:
+    assert choose_slices_py([4, 2, 2, 4, 4, 4], [0, 2, 2, 0, 4, 4],
+                            2, 2) == [1, 2]
+
+
+# -- operator integration ----------------------------------------------------
+
+
+class CountingCheckpointer(PreemptionCheckpointer):
+    """Counts saves; optionally writes through a real CheckpointManager
+    so the resume half of the protocol is the production code path."""
+
+    def __init__(self, steps=None, manager=None, state=None):
+        self.steps = dict(steps or {})
+        self.manager = manager
+        self.state = state
+        self.save_calls = []
+
+    def save(self, job):
+        ns = job["metadata"]["namespace"]
+        name = job["metadata"]["name"]
+        self.save_calls.append((ns, name))
+        step = self.steps.get(name)
+        if self.manager is not None and step is not None:
+            self.manager.save(step, self.state, wait=True)
+        return step
+
+    def latest_step(self, ns, name):
+        return self.steps.get(name)
+
+
+def _operator_cluster(tmp_path=None, quota_chips=None):
+    client = FakeKubeClient()
+    _seed(client, count=4)
+    if quota_chips is not None:
+        _quota(client, "tenant", quota_chips)
+    clock = FakeClock()
+    collector = SpanCollector()
+    tracer = Tracer(collector, clock=clock)
+    ckpt = CountingCheckpointer(steps={"low1": 50, "low2": 90})
+    q = GangQueue(client, clock=clock, tracer=tracer,
+                  checkpoint_step=ckpt.latest_step)
+    op = TpuJobOperator(client, clock=clock, tracer=tracer, queue=q,
+                        checkpointer=ckpt)
+    return client, q, op, ckpt, collector
+
+
+def _pods(client, ns, job):
+    return client.list("v1", "Pod", ns, label_selector={JOB_LABEL: job})
+
+
+def _set_phase(client, ns, job, phase):
+    for pod in _pods(client, ns, job):
+        pod.setdefault("status", {})["phase"] = phase
+        client.update_status(pod)
+
+
+def test_operator_quota_blocked_job_holds_with_condition():
+    client, q, op, _, _ = _operator_cluster(quota_chips=8)
+    client.create(tpujob("a", "tenant", {"image": "x", "hostsPerSlice": 2}))
+    client.create(tpujob("b", "tenant", {"image": "x", "hostsPerSlice": 2}))
+    assert op.reconcile("tenant", "a") == 1.0
+    assert len(_pods(client, "tenant", "a")) == 2
+    assert op.reconcile("tenant", "b") == 5.0
+    assert _pods(client, "tenant", "b") == []
+    job = client.get(API_VERSION, TPUJOB_KIND, "tenant", "b")
+    conds = {c["reason"] for c in job["status"]["conditions"]}
+    assert "QuotaExceeded" in conds
+    # tenant a finishing frees the quota; b admits and places
+    _set_phase(client, "tenant", "a", "Succeeded")
+    op.reconcile("tenant", "a")
+    op.reconcile("tenant", "b")
+    assert len(_pods(client, "tenant", "b")) == 2
+
+
+def test_operator_capacity_starved_job_queues():
+    client, q, op, _, _ = _operator_cluster()
+    client.create(tpujob("big", "d", {"image": "x", "slices": 5,
+                                      "hostsPerSlice": 2}))
+    assert op.reconcile("d", "big") == 5.0
+    job = client.get(API_VERSION, TPUJOB_KIND, "d", "big")
+    assert job["status"]["phase"] == PHASE_PENDING
+    assert any(c["reason"] == "AwaitingCapacity"
+               for c in job["status"]["conditions"])
+
+
+def test_preempt_requeue_resume_end_to_end(tmp_path):
+    """The acceptance scenario (ISSUE 8): a saturating low-priority
+    workload admits under quota, a high-priority gang preempts the
+    minimum-cost victim (one checkpoint save, Preempted condition,
+    head-of-queue requeue), the victim resumes once capacity frees with
+    its step clock intact via CheckpointManager.restore_or_init, and
+    one trace carries admit→predict→place→preempt→requeue while
+    kftpu_queue_depth / kftpu_preemptions_total move accordingly."""
+    from kubeflow_tpu.train.checkpoint import CheckpointManager
+
+    client, q, op, ckpt, collector = _operator_cluster(quota_chips=16)
+    state = {"w": np.arange(4.0), "step": np.asarray(90)}
+    ckpt.manager = CheckpointManager(str(tmp_path / "low2"), keep=2)
+    ckpt.state = state
+    depth = DEFAULT_REGISTRY.gauge("kftpu_queue_depth")
+    preemptions = DEFAULT_REGISTRY.counter("kftpu_preemptions_total")
+    preempt_before = preemptions.get()
+
+    # 1. the low-priority workload saturates its 16-chip quota
+    for name in ("low1", "low2"):
+        client.create(tpujob(name, "tenant", {
+            "image": "x", "hostsPerSlice": 2, "totalSteps": 1000,
+            "checkpointDir": str(tmp_path / name)}))
+        op.reconcile("tenant", name)
+        assert len(_pods(client, "tenant", name)) == 2
+    client.create(tpujob("low3", "tenant", {"image": "x",
+                                            "hostsPerSlice": 2}))
+    op.reconcile("tenant", "low3")
+    assert q.state_of("tenant", "low3") == BLOCKED  # quota admission
+    assert depth.get(state=PLACED) == 2
+    # telemetry feeds the predictor (the PR 5 loop closed)
+    q.predictor.observe("tenant", "low1", steps_per_sec=1.0, last_step=100)
+    q.predictor.observe("tenant", "low2", steps_per_sec=1.0, last_step=100)
+
+    # 2. a high-priority gang arrives; 2 free slices < the 3 it needs
+    client.create(tpujob("urgent", "prod", {
+        "image": "x", "slices": 3, "hostsPerSlice": 2, "priority": 10}))
+    op.reconcile("prod", "urgent")
+    assert _pods(client, "prod", "urgent") == []
+    # minimum-cost victim: equal chips, low2's checkpoint is freshest
+    assert q.state_of("tenant", "low2") == PREEMPTING
+
+    # 3. the victim checkpoints exactly once, tears down, requeues
+    op.reconcile("tenant", "low2")
+    assert ckpt.save_calls == [("tenant", "low2")]
+    assert _pods(client, "tenant", "low2") == []
+    job = client.get(API_VERSION, TPUJOB_KIND, "tenant", "low2")
+    conds = {(c["type"], c["reason"])
+             for c in job["status"]["conditions"]}
+    assert ("Preempted", "RequeuedForPriority") in conds
+    assert job["status"]["preemption"] == {
+        "requested": False, "lastCheckpointStep": 90, "count": 1,
+        "by": "prod/urgent"}
+    assert q.state_of("tenant", "low2") == QUEUED
+    assert preemptions.get() == preempt_before + 1
+
+    # 4. the preemptor places on the freed capacity
+    op.reconcile("prod", "urgent")
+    assert len(_pods(client, "prod", "urgent")) == 6
+    assert {p["metadata"]["labels"][ASSIGNED_SLICE_LABEL]
+            for p in _pods(client, "prod", "urgent")} \
+        .isdisjoint({p["metadata"]["labels"][ASSIGNED_SLICE_LABEL]
+                     for p in _pods(client, "tenant", "low1")})
+    op.reconcile("tenant", "low2")
+    assert _pods(client, "tenant", "low2") == []  # still waiting
+
+    # 5. the preemptor finishes; the victim resumes, step clock intact
+    _set_phase(client, "prod", "urgent", "Succeeded")
+    op.reconcile("prod", "urgent")
+    op.reconcile("tenant", "low2")
+    assert len(_pods(client, "tenant", "low2")) == 2
+    restored, start_step = ckpt.manager.restore_or_init(state)
+    assert start_step == 90                       # the production path
+    np.testing.assert_array_equal(restored["w"], state["w"])
+    assert q.last_checkpoint_step("tenant", "low2") == 90
+    # low3 admits too now that low2's quota share briefly freed? no —
+    # low2 is back; low3 stays blocked until a sibling truly finishes
+    assert q.state_of("tenant", "low3") == BLOCKED
+    _set_phase(client, "tenant", "low1", "Succeeded")
+    op.reconcile("tenant", "low1")
+    op.reconcile("tenant", "low3")
+    assert len(_pods(client, "tenant", "low3")) == 2
+
+    # 6. one trace tells the whole story: the preemptor's identity-
+    # derived trace holds admit→predict→place→preempt→requeue
+    uid = client.get(API_VERSION, TPUJOB_KIND, "prod",
+                     "urgent")["metadata"]["uid"]
+    trace_id, _ = tpujob_trace_ids("prod", "urgent", uid)
+    names = [s.name for s in collector.spans() if s.trace_id == trace_id]
+    for expected in ("scheduler.queue.admit", "scheduler.queue.predict",
+                     "scheduler.queue.place", "scheduler.queue.preempt",
+                     "scheduler.queue.requeue"):
+        assert expected in names, (expected, names)
+    order = [names.index(n) for n in
+             ("scheduler.queue.admit", "scheduler.queue.preempt",
+              "scheduler.queue.requeue", "scheduler.queue.place")]
+    assert order == sorted(order)  # admit → preempt → requeue → place
+    ckpt.manager.close()
+
+
+def test_elastic_resize_reflows_through_queue():
+    client, q, op, _, _ = _operator_cluster()
+    client.create(tpujob("j", "d", {"image": "x", "slices": 1,
+                                    "hostsPerSlice": 2}))
+    op.reconcile("d", "j")
+    _set_phase(client, "d", "j", "Running")
+    op.reconcile("d", "j")
+    job = client.get(API_VERSION, TPUJOB_KIND, "d", "j")
+    job["spec"]["slices"] = 2
+    client.update(job)
+    op.reconcile("d", "j")          # detects stale shape, tears down
+    op.reconcile("d", "j")          # re-places at the new shape
+    assert len(_pods(client, "d", "j")) == 4
+    assert len(q.placement_for("d", "j")) == 2
+
+
+def test_lost_worker_recreated_on_granted_slices():
+    client, q, op, _, _ = _operator_cluster()
+    client.create(tpujob("j", "d", {"image": "x", "hostsPerSlice": 2}))
+    op.reconcile("d", "j")
+    granted = q.placement_for("d", "j")
+    victim = _pods(client, "d", "j")[0]
+    client.delete("v1", "Pod", "d", victim["metadata"]["name"])
+    op.reconcile("d", "j")
+    pods = _pods(client, "d", "j")
+    assert len(pods) == 2
+    assert all(p["metadata"]["labels"][ASSIGNED_SLICE_LABEL] == granted[0]
+               for p in pods)
+
+
+def test_stale_grant_is_invalidated_not_double_booked():
+    client, q, op, _, _ = _operator_cluster()
+    created = client.create(tpujob("j", "d",
+                                   {"image": "x", "hostsPerSlice": 2}))
+    q.submit(_gang("d", "j", uid=created["metadata"]["uid"]))
+    q.schedule()
+    granted = q.placement_for("d", "j")
+    # an out-of-band actor claims the granted slice before pods exist
+    client.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "squatter", "namespace": "x",
+                     "labels": {ASSIGNED_SLICE_LABEL: granted[0]}},
+        "status": {"phase": "Running"}})
+    assert op.reconcile("d", "j") == 5.0
+    assert _pods(client, "d", "j") == []
+    job = client.get(API_VERSION, TPUJOB_KIND, "d", "j")
+    assert any(c["reason"] == "PlacementStale"
+               for c in job["status"]["conditions"])
+    # next pass re-places on a different slice
+    op.reconcile("d", "j")
+    pods = _pods(client, "d", "j")
+    assert pods and all(
+        p["metadata"]["labels"][ASSIGNED_SLICE_LABEL] != granted[0]
+        for p in pods)
+
+
+# -- shared reconciler runtime ----------------------------------------------
+
+
+def wait_until(fn, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_autoscaler_tick_runs_on_shared_runtime():
+    from kubeflow_tpu.autoscale.policy import AutoscalePolicy
+    from kubeflow_tpu.autoscale.reconciler import Autoscaler, ReplicaDriver
+
+    class NullDriver(ReplicaDriver):
+        def create(self, model, slice_id):
+            return object()
+
+        def warmup(self, model, handle):
+            pass
+
+        def is_warm(self, model, handle):
+            return True
+
+        def destroy(self, model, handle):
+            pass
+
+    collector = SpanCollector()
+    autoscaler = Autoscaler(AutoscalePolicy(), NullDriver())
+    autoscaler.tracer = Tracer(collector, clock=autoscaler.clock)
+    autoscaler.watch("m")
+    ctrl = autoscaler.build_controller(interval_s=0.02)
+    ctrl.start()
+    try:
+        assert wait_until(lambda: any(
+            s.name == "controller.reconcile"
+            and s.attrs.get("controller") == "autoscaler"
+            for s in collector.spans()))
+        # the tick actually reconciled the watched model
+        assert wait_until(
+            lambda: autoscaler.status()["models"]["m"]["desired"]
+            is not None)
+    finally:
+        ctrl.stop()
+
+
+def test_scheduler_queue_controller_cycles():
+    client = FakeKubeClient()
+    _seed(client, count=2)
+    collector = SpanCollector()
+    q = make_queue(client, tracer=Tracer(collector))
+    q.submit(_gang("d", "j"))
+    ctrl = q.build_controller(interval_s=0.02)
+    ctrl.start()
+    try:
+        assert wait_until(lambda: q.state_of("d", "j") == PLACED)
+        assert wait_until(lambda: any(
+            s.name == "controller.reconcile"
+            and s.attrs.get("controller") == "scheduler-queue"
+            for s in collector.spans()))
+    finally:
+        ctrl.stop()
+
+
+def test_watch_controllers_emit_uniform_reconcile_spans():
+    client = FakeKubeClient()
+    collector = SpanCollector()
+    op = TpuJobOperator(client, tracer=Tracer(collector))
+    ctrl = op.build_controller()
+    ctrl.start()
+    try:
+        client.create(tpujob("job1", "default", {
+            "image": "img", "slices": 1, "hostsPerSlice": 2}))
+        assert wait_until(lambda: any(
+            s.name == "controller.reconcile"
+            and s.attrs.get("controller") == "tpujob-operator"
+            and s.attrs.get("name") == "job1"
+            for s in collector.spans()))
+        reconciles = DEFAULT_REGISTRY.counter(
+            "kftpu_controller_reconciles_total")
+        assert reconciles.get(controller="tpujob-operator") >= 1
+    finally:
+        ctrl.stop()
+
+
+def test_run_loop_rides_the_controller_runtime():
+    from kubeflow_tpu.autoscale.policy import AutoscalePolicy
+    from kubeflow_tpu.autoscale.reconciler import Autoscaler, ReplicaDriver
+    from kubeflow_tpu.autoscale.service import run_loop
+
+    ticks = []
+
+    class Probe(Autoscaler):
+        def reconcile_all(self, now=None):
+            ticks.append(1)
+
+    handle = run_loop(Probe(AutoscalePolicy(), ReplicaDriver()), 0.02)
+    try:
+        assert wait_until(lambda: len(ticks) >= 2)
+    finally:
+        handle.stop.set()
+    n = len(ticks)
+    assert not wait_until(lambda: len(ticks) > n + 1, timeout=0.3)
+
+
+# -- dashboard surface -------------------------------------------------------
+
+
+def test_dashboard_scheduler_route():
+    from kubeflow_tpu.dashboard.server import DashboardApi
+
+    client = FakeKubeClient()
+    _seed(client, count=2)
+    q = make_queue(client)
+    q.submit(_gang("d", "j", priority=3))
+    q.schedule()
+    api = DashboardApi(client, scheduler_queue=q,
+                       authorize=lambda *a: True)
+    code, body = api.handle("GET", "/api/metrics/scheduler", None)
+    assert code == 200
+    assert body["depth"][PLACED] == 1
+    gang = body["gangs"][0]
+    assert (gang["name"], gang["priority"]) == ("j", 3)
+    # no queue attached: registry series still answer
+    bare = DashboardApi(client, authorize=lambda *a: True)
+    code, body = bare.handle("GET", "/api/metrics/scheduler", None)
+    assert code == 200 and "metrics" in body
