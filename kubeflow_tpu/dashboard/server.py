@@ -27,6 +27,20 @@ Route parity with the reference's Express server
   queue states, priorities, waits, preemption counts, plus the
   ``kftpu_queue_depth`` / ``kftpu_queue_wait_seconds`` /
   ``kftpu_preemptions_total`` series when no queue is in-process
+- ``GET /api/metrics/query``       — the monitoring tier's query API
+  over the in-process time-series store (``kubeflow_tpu/obs/tsdb.py``):
+  instant and range evaluation of ``instant``/``rate``/``delta``/
+  ``avg``/``quantile`` over any stored series, exemplar trace ids
+  included (docs/OBSERVABILITY.md Monitoring section). Query params:
+  ``metric`` (required), ``func``, ``window`` (seconds), ``q``
+  (quantile), ``start``/``end``/``step`` (range mode), and repeated
+  ``label=k:v`` matchers (``v`` may end in ``*`` for prefix match)
+- ``GET /api/alerts``              — the alert engine's rule states
+  (``kubeflow_tpu/obs/alerts.py``): pending/firing alerts, values,
+  exemplar trace ids; with no in-process
+  :class:`~kubeflow_tpu.obs.alerts.AlertManager` attached, the
+  registry's ``kftpu_alerts_*`` series still answer "is anything
+  firing"
 - ``GET /api/workgroup/exists``    — profile/workgroup flow via kfam
   (``api_workgroup.ts``)
 - ``GET /api/dashboard-links``     — component cards for the UI shell
@@ -83,16 +97,22 @@ class RegistryMetricsService(MetricsService):
 
 
 def _parse_prom(text: str, prefix: str) -> List[Dict[str, Any]]:
+    """Prefix-filtered series list over the shared escape-aware parser
+    (``obs/scrape.parse_exposition``) — the old line-splitting here
+    mis-read exactly what this PR made representable: escaped label
+    values and OpenMetrics exemplar suffixes."""
+    from kubeflow_tpu.obs.scrape import parse_exposition
+    from kubeflow_tpu.utils.metrics import format_labels
+
     out = []
-    for line in text.splitlines():
-        if line.startswith("#") or not line.strip():
+    for s in parse_exposition(text):
+        if not s.name.startswith(prefix):
             continue
-        name, _, value = line.rpartition(" ")
-        if name.startswith(prefix):
-            try:
-                out.append({"metric": name, "value": float(value)})
-            except ValueError:
-                continue
+        metric = s.name
+        if s.labels:
+            metric += "{" + format_labels(tuple(sorted(
+                s.labels.items()))) + "}"
+        out.append({"metric": metric, "value": s.value})
     return out
 
 
@@ -178,7 +198,9 @@ class DashboardApi:
                  authorize=None,
                  autoscaler=None,
                  collector: Optional[SpanCollector] = None,
-                 scheduler_queue=None) -> None:
+                 scheduler_queue=None,
+                 tsdb=None,
+                 alerts=None) -> None:
         from kubeflow_tpu.tenancy.authz import default_authorizer
 
         self.client = client
@@ -203,6 +225,12 @@ class DashboardApi:
         # anything with .status() (a scheduler GangQueue); None = the
         # registry's kftpu_queue_* gauges only
         self.scheduler_queue = scheduler_queue
+        # the monitoring tier (docs/OBSERVABILITY.md): a TimeSeriesStore
+        # for /api/metrics/query and an AlertManager for /api/alerts;
+        # None degrades each route (410 for queries — there is no store
+        # to ask — and the registry's kftpu_alerts_* series for alerts)
+        self.tsdb = tsdb
+        self.alerts = alerts
 
     def _authz(self, user: str, ns: str, resource: str) -> None:
         if not self.authorize(user, "get", ns, resource):
@@ -211,6 +239,9 @@ class DashboardApi:
 
     def handle(self, method: str, path: str, body: Optional[Dict[str, Any]],
                user: str = "") -> Tuple[int, Any]:
+        # route on the bare path; the query string (the /api/metrics/query
+        # parameters) is parsed by the handler that wants it
+        path, _, query = path.partition("?")
         try:
             if method != "GET":
                 return 405, {"error": "dashboard API is read-only"}
@@ -232,6 +263,10 @@ class DashboardApi:
                 return 200, self.autoscale_view()
             if path == "/api/metrics/scheduler":
                 return 200, self.scheduler_view()
+            if path == "/api/metrics/query":
+                return self.metrics_query(query)
+            if path == "/api/alerts":
+                return 200, self.alerts_view()
             if path == "/api/traces":
                 return 200, self.traces()
             if path.startswith("/api/traces/"):
@@ -383,6 +418,129 @@ class DashboardApi:
         exposition = DEFAULT_REGISTRY.expose()
         return {"metrics": _parse_prom(exposition, "kftpu_queue_")
                 + _parse_prom(exposition, "kftpu_preemptions_total")}
+
+    def metrics_query(self, query: str) -> Tuple[int, Any]:
+        """The monitoring query API over the in-process tsdb
+        (docs/OBSERVABILITY.md): instant evaluation by default, range
+        evaluation when ``start``/``end`` are given (``func`` applied
+        at each ``step``). One evaluation path with the alert engine
+        (:func:`kubeflow_tpu.obs.tsdb.evaluate`), so a panel and the
+        rule watching the same expression cannot disagree."""
+        from urllib.parse import parse_qs
+
+        if self.tsdb is None:
+            return 410, {"error": "no time-series store attached "
+                                  "(run the monitoring tier)"}
+        params = parse_qs(query or "")
+
+        def one(key: str, default: Optional[str] = None) -> Optional[str]:
+            vals = params.get(key)
+            return vals[-1] if vals else default
+
+        metric = one("metric")
+        if not metric:
+            return 400, {"error": "missing required param 'metric'"}
+        func = one("func", "instant")
+        match: Dict[str, str] = {}
+        for pair in params.get("label", []):
+            k, sep, v = pair.partition(":")
+            if not sep or not k:
+                return 400, {"error": f"bad label matcher {pair!r}; "
+                                      "use label=key:value"}
+            match[k] = v
+        try:
+            window_s = float(one("window", "300"))
+            q = float(one("q", "0.99"))
+            start = one("start")
+            end = one("end")
+            step = float(one("step", "0") or 0)
+        except ValueError as e:
+            return 400, {"error": f"bad numeric param: {e}"}
+        import math as _math
+
+        if not 0.0 <= q <= 1.0:
+            # histogram_quantile raises on this; a bad param must be a
+            # 400 like every other one, not a 500 (NaN fails the
+            # comparison chain and lands here too)
+            return 400, {"error": f"q must be in [0, 1], got {q}"}
+        if not _math.isfinite(window_s) or window_s <= 0:
+            return 400, {"error": f"window must be a positive finite "
+                                  f"number of seconds, got {window_s}"}
+        from kubeflow_tpu.obs.tsdb import QUERY_FUNCS, evaluate
+
+        if func not in QUERY_FUNCS:
+            return 400, {"error": f"unknown func {func!r}; known: "
+                                  f"{', '.join(QUERY_FUNCS)}"}
+        base = {"metric": metric, "func": func, "labels": match}
+        if func in ("rate", "delta", "avg", "quantile"):
+            base["window"] = window_s
+        if func == "quantile":
+            base["q"] = q
+
+        def exemplars_for(at: float) -> List[Dict[str, Any]]:
+            if func != "quantile":
+                return []
+            return [e.to_dict() for e in self.tsdb.exemplars(
+                f"{metric}_bucket", match, since=at - window_s)]
+
+        if (start is None) != (end is None):
+            # a half-specified range is a user error, not instant mode
+            return 400, {"error": "range mode needs both start and end"}
+        if start is not None and end is not None:
+            try:
+                t0, t1 = float(start), float(end)
+            except ValueError as e:
+                return 400, {"error": f"bad range param: {e}"}
+            if not (_math.isfinite(t0) and _math.isfinite(t1)):
+                # NaN compares false everywhere and inf overflows the
+                # step arithmetic — both must be a 400, not a 500
+                return 400, {"error": "start/end must be finite"}
+            if t1 < t0:
+                return 400, {"error": "end must be >= start"}
+            if step <= 0:
+                step = max((t1 - t0) / 60.0, 1e-9)
+            # fixed evaluation count: start==end is one point, the
+            # boundary is never double-counted, and a user-supplied
+            # tiny step over a wide range cannot spin this handler
+            # (the Prometheus point-cap stance). The ratio is checked
+            # finite BEFORE int() — 1e300/1e-300 overflows to inf and a
+            # NaN step slips every comparison
+            ratio = (t1 - t0) / step
+            if not _math.isfinite(ratio) or ratio > 10000:
+                return 400, {"error": "range too dense: more than "
+                                      "10000 evaluation steps"}
+            n_steps = int(ratio + 1e-9)
+            by_series: Dict[Tuple[Tuple[str, str], ...], Dict[str, Any]] \
+                = {}
+            for i in range(n_steps + 1):
+                t = t0 + i * step
+                for labels, value in evaluate(
+                        self.tsdb, func, metric, match=match,
+                        window_s=window_s, q=q, at=t):
+                    key = tuple(sorted(labels.items()))
+                    row = by_series.setdefault(
+                        key, {"labels": labels, "points": []})
+                    row["points"].append([round(t, 6), value])
+            return 200, {**base, "start": t0, "end": t1, "step": step,
+                         "result": list(by_series.values())}
+        at = self.tsdb.clock()
+        result = [{"labels": labels, "value": value}
+                  for labels, value in evaluate(
+                      self.tsdb, func, metric, match=match,
+                      window_s=window_s, q=q, at=at)]
+        return 200, {**base, "at": at, "result": result,
+                     "exemplars": exemplars_for(at)}
+
+    def alerts_view(self) -> Dict[str, Any]:
+        """The alert engine's rule states for the monitoring panel;
+        with no in-process :class:`~kubeflow_tpu.obs.alerts.
+        AlertManager`, the registry's ``kftpu_alerts_*`` series still
+        answer "is anything firing" (the scheduler_view fallback
+        stance)."""
+        if self.alerts is not None:
+            return self.alerts.status()
+        return {"metrics": _parse_prom(DEFAULT_REGISTRY.expose(),
+                                       "kftpu_alerts_")}
 
     def traces(self) -> List[Dict[str, Any]]:
         """Recent root spans (+ per-trace span counts), newest first —

@@ -227,7 +227,11 @@ class EdgeProxy:
                             # wreck the histogram's _sum/p99
                             sp.attrs["websocket"] = True
                         else:
+                            # exemplar: the request's own trace id, so a
+                            # latency bucket links straight to the trace
+                            # of a request that landed in it
                             _latency_h.observe(TRACER.clock() - sp.start,
+                                               exemplar_trace_id=sp.trace_id,
                                                route=route.prefix,
                                                code=str(code))
 

@@ -15,6 +15,7 @@ from typing import Iterable, List, Optional, Sequence
 
 from kubeflow_tpu.k8s.client import ApiError, KubeClient
 from kubeflow_tpu.k8s.objects import Obj, obj_key
+from kubeflow_tpu.utils.clock import Sleep
 
 log = logging.getLogger(__name__)
 
@@ -56,8 +57,14 @@ def apply_all(
     *,
     retries: int = 3,
     backoff_s: float = 2.0,
+    sleep: Optional[Sleep] = None,
 ) -> List[Obj]:
-    """Apply objects in dependency order; per-object retry with backoff."""
+    """Apply objects in dependency order; per-object retry with backoff.
+
+    ``sleep`` is injectable (the TPU003 contract, defaulted to the real
+    sleep by reference) so the retry/backoff path runs deterministically
+    under test instead of burning real seconds."""
+    do_sleep: Sleep = sleep if sleep is not None else time.sleep
     applied = []
     for obj in sort_for_apply(objs):
         last: Optional[Exception] = None
@@ -73,7 +80,7 @@ def apply_all(
                     "apply %s failed (attempt %d): %s", obj_key(obj), attempt + 1, e
                 )
                 if attempt < retries - 1:  # no sleep after the final attempt
-                    time.sleep(backoff_s * (2 ** attempt))
+                    do_sleep(backoff_s * (2 ** attempt))
         if last is not None:
             raise last
     return applied

@@ -11,13 +11,16 @@ project is configured.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List
+import logging
+from typing import Any, Dict, List, Optional
 
 import yaml
 
-from kubeflow_tpu.config.deployment import DeploymentConfig
+from kubeflow_tpu.config.deployment import ComponentSpec, DeploymentConfig
 from kubeflow_tpu.k8s import objects as o
 from kubeflow_tpu.manifests.registry import register
+
+log = logging.getLogger(__name__)
 
 DEFAULTS: Dict[str, Any] = {
     "image": "prom/prometheus:v2.45.0",
@@ -32,8 +35,92 @@ DEFAULTS: Dict[str, Any] = {
 }
 
 
-def scrape_config(interval: str) -> str:
-    """Pod-annotation service discovery, the libsonnet scrape shape."""
+def scrape_targets(config: Optional[DeploymentConfig] = None
+                   ) -> Dict[str, str]:
+    """Static scrape-target map (target name → metrics URL), derived by
+    rendering components and reading the ``prometheus.io/*``
+    annotations off their Services.
+
+    This is the ONE source of scrape wiring: :func:`scrape_config`
+    renders it into the deployed prometheus ConfigMap as a static job,
+    and the in-process :class:`kubeflow_tpu.obs.scrape.Scraper`
+    defaults its target list to it — the manifest and the scraper
+    cannot drift (the TPU004 consistency stance, applied at runtime
+    because these URLs are constructed, not literal).
+
+    With a ``config`` that enables components, exactly the DEPLOYED
+    component set is rendered, with its per-component param overrides —
+    a port override reaches the target URL, and a disabled component
+    never becomes a dead target. Without one (the dev/in-process
+    default), every registered component renders with defaults;
+    components whose defaults cannot render standalone are skipped
+    (they cannot be scraped by default either)."""
+    from kubeflow_tpu.manifests.registry import (
+        list_components,
+        render_component,
+    )
+
+    cfg = config if config is not None else DeploymentConfig(
+        name="scrape-discovery")
+    specs = (list(cfg.components) if cfg.components
+             else [ComponentSpec(c.name) for c in list_components()])
+    out: Dict[str, str] = {}
+    for spec in specs:
+        if spec.name == "monitoring":
+            # never render ourselves: render() calls scrape_config()
+            # calls scrape_targets() — recursing here would nest to the
+            # stack limit (and prometheus does not scrape itself anyway)
+            continue
+        try:
+            objs = render_component(cfg, spec)
+        except Exception as e:  # noqa: BLE001 — default-unrenderable
+            log.debug("scrape_targets: skipping %s: %s", spec.name, e)
+            continue
+        for obj in objs:
+            if obj.get("kind") != "Service":
+                continue
+            ann = (obj.get("metadata", {}).get("annotations") or {})
+            if ann.get("prometheus.io/scrape") != "true":
+                continue
+            svc = obj["metadata"]["name"]
+            port = ann.get("prometheus.io/port")
+            if not port:
+                ports = obj.get("spec", {}).get("ports") or [{}]
+                port = str(ports[0].get("port", 80))
+            path = ann.get("prometheus.io/path", "/metrics")
+            out[svc] = f"http://{svc}:{port}{path}"
+    return out
+
+
+def scrape_config(interval: str,
+                  targets: Optional[Dict[str, str]] = None) -> str:
+    """Pod-annotation service discovery, the libsonnet scrape shape —
+    plus the framework's own static target job (:func:`scrape_targets`)
+    so the deployed prometheus and the in-process scraper share one
+    target list."""
+    if targets is None:
+        targets = scrape_targets()
+    # group by metrics path: a prometheus job has ONE metrics_path, and
+    # flattening every target onto /metrics would silently diverge from
+    # the per-annotation paths the in-process Scraper honors — exactly
+    # the drift the shared target list exists to rule out
+    by_path: Dict[str, List[str]] = {}
+    for url in targets.values():
+        rest = url.split("://", 1)[-1]   # tolerate scheme-less targets
+        hostport, slash, path = rest.partition("/")
+        # a URL with an explicit path keeps it VERBATIM (including a
+        # bare trailing "/"); only a pathless target defaults — the
+        # in-process Scraper fetches the same URL, so any rewrite here
+        # is exactly the manifest/scraper drift this list rules out
+        by_path.setdefault(("/" + path) if slash else "/metrics",
+                           []).append(hostport)
+    static_jobs = [{
+        "job_name": ("kftpu-components-static" if path == "/metrics"
+                     else "kftpu-components-static-"
+                     + (path.strip("/").replace("/", "-") or "root")),
+        "metrics_path": path,
+        "static_configs": [{"targets": sorted(hosts)}],
+    } for path, hosts in sorted(by_path.items())]
     return yaml.safe_dump({
         "global": {"scrape_interval": interval},
         "scrape_configs": [{
@@ -62,7 +149,10 @@ def scrape_config(interval: str) -> str:
                 {"source_labels": ["__meta_kubernetes_service_name"],
                  "action": "replace", "target_label": "service"},
             ],
-        }],
+        }] + static_jobs,
+        # the same component endpoints as SD-free static jobs (one per
+        # metrics path): scrape keeps working before RBAC/SD converges,
+        # and the target list is pinned to the components' annotations
     }, sort_keys=False)
 
 
@@ -113,7 +203,12 @@ def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
         o.cluster_role_binding(name, name, name, ns),
         o.config_map(name, ns,
                      {"prometheus.yaml":
-                      scrape_config(params["scrape_interval"])}),
+                      # the LIVE deployment's component set + params
+                      # flow into the static job (not the registry-wide
+                      # defaults), so a disabled component never becomes
+                      # a dead target and a port override is honored
+                      scrape_config(params["scrape_interval"],
+                                    scrape_targets(config))}),
         o.deployment(name, ns, pod),
         o.service(name, ns, {"app": name},
                   [{"name": "http", "port": int(params["port"]),

@@ -25,6 +25,25 @@ from kubeflow_tpu.obs.export import (  # noqa: F401
     parse_otlp_lines,
     push_spans,
 )
+from kubeflow_tpu.obs.tsdb import (  # noqa: F401
+    Exemplar,
+    Point,
+    TimeSeriesStore,
+)
+from kubeflow_tpu.obs.scrape import (  # noqa: F401
+    ParsedSample,
+    Scraper,
+    parse_exposition,
+)
+from kubeflow_tpu.obs.alerts import (  # noqa: F401
+    AbsenceRule,
+    AlertManager,
+    BurnRateRule,
+    BurnWindow,
+    ThresholdRule,
+    default_rules,
+    rule_from_dict,
+)
 from kubeflow_tpu.obs.steps import (  # noqa: F401
     FlightRecorder,
     StepRecord,

@@ -1,0 +1,549 @@
+"""Declarative alert engine over the in-process tsdb.
+
+The reference's alerting lived in the prometheus/stackdriver pair the
+``monitoring`` component deploys; nothing in-framework could say "the
+error budget is burning" or "queue depth has been high for 10 minutes".
+This module is that engine, evaluated against
+:class:`~kubeflow_tpu.obs.tsdb.TimeSeriesStore` through the same
+:func:`~kubeflow_tpu.obs.tsdb.evaluate` path the dashboard's query API
+uses — the alert and the panel can never disagree.
+
+Rule kinds (all declarative dataclasses, serializable via
+``to_dict``/``rule_from_dict`` — docs/OBSERVABILITY.md has the syntax):
+
+- :class:`ThresholdRule` — a tsdb expression (instant / rate / delta /
+  avg / histogram quantile) compared against a bound, with a ``for:``
+  duration before firing (Prometheus ``for:`` semantics: the condition
+  must hold continuously).
+- :class:`AbsenceRule` — fires when a series that should exist has no
+  fresh point for ``for_s`` (the dead-exporter alarm ``up`` alone
+  can't express for in-process registries).
+- :class:`BurnRateRule` — multi-window multi-burn-rate SLO alerting
+  (the SRE-workbook shape): the error ratio
+  ``rate(numerator)/rate(denominator)`` must exceed
+  ``factor × (1 - objective)`` over BOTH the long and the short window
+  of any configured pair. The long window makes it meaningful (a real
+  budget bite), the short window makes it current (stops firing as
+  soon as the bleeding stops).
+
+State machine per rule: ``Inactive → Pending → Firing → Resolved``
+(→ ``Inactive``). Every *transition* — never a steady state — emits one
+deduplicated k8s Event, one ``alerts.transition`` span, and updates the
+``kftpu_alerts_firing{rule=}`` gauge. The engine runs as a
+``Controller.periodic`` on the shared workqueue runtime
+(:meth:`AlertManager.build_controller`), clock-injectable end to end
+(TPU003): the smoke gates walk pending→firing→resolved on a fake clock.
+
+Latency-shaped rules carry trace exemplars: when a quantile rule
+fires, the alert state records a recent exemplar trace id from the
+offending ``_bucket`` series, so the alert links straight to a trace
+of a request that actually landed in the slow bucket.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple, Union
+
+from kubeflow_tpu.k8s.client import ApiError, KubeClient
+from kubeflow_tpu.obs.tsdb import TimeSeriesStore, evaluate
+from kubeflow_tpu.obs.trace import TRACER, Tracer
+from kubeflow_tpu.utils import DEFAULT_REGISTRY
+from kubeflow_tpu.utils.clock import Clock
+
+log = logging.getLogger(__name__)
+
+# alert states
+INACTIVE = "Inactive"
+PENDING = "Pending"
+FIRING = "Firing"
+RESOLVED = "Resolved"   # transient: one tick, then Inactive
+
+_firing_g = DEFAULT_REGISTRY.gauge(
+    "kftpu_alerts_firing", "1 while the named alert rule is firing")
+_transitions_c = DEFAULT_REGISTRY.counter(
+    "kftpu_alert_transitions_total", "alert state transitions by rule")
+
+
+_THRESHOLD_OPS: Dict[str, Any] = {
+    ">": lambda v, t: v > t,
+    ">=": lambda v, t: v >= t,
+    "<": lambda v, t: v < t,
+    "<=": lambda v, t: v <= t,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ThresholdRule:
+    """``<func>(metric[window]) <op> threshold`` held for ``for_s``."""
+
+    name: str
+    metric: str
+    op: str = ">"                       # one of > >= < <=
+    threshold: float = 0.0
+    for_s: float = 0.0
+    func: str = "instant"               # instant|rate|delta|avg|quantile
+    window_s: float = 300.0
+    quantile: float = 0.99              # func == "quantile" only
+    labels: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    severity: str = "warning"
+    summary: str = ""
+
+    def __post_init__(self) -> None:
+        # rules load from data (rule_from_dict): a typo'd op must fail
+        # loudly at construction, never evaluate with inverted semantics
+        if self.op not in _THRESHOLD_OPS:
+            raise ValueError(
+                f"rule {self.name!r}: unknown op {self.op!r}; "
+                f"known: {', '.join(sorted(_THRESHOLD_OPS))}")
+
+    def evaluate(self, store: TimeSeriesStore, at: float
+                 ) -> Tuple[bool, Optional[float], Optional[str]]:
+        results = evaluate(store, self.func, self.metric,
+                           match=dict(self.labels),
+                           window_s=self.window_s, q=self.quantile,
+                           at=at)
+        breach = _THRESHOLD_OPS[self.op]
+        upward = self.op in (">", ">=")
+        worst: Optional[float] = None
+        for _labels, value in results:
+            if breach(value, self.threshold) and (
+                    worst is None
+                    or (value > worst if upward else value < worst)):
+                worst = value
+        if worst is None:
+            return False, (results[0][1] if results else None), None
+        exemplar = None
+        if self.func == "quantile":
+            recent = store.exemplars(f"{self.metric}_bucket",
+                                     dict(self.labels),
+                                     since=at - self.window_s)
+            if recent:
+                # the worst in-window offender, not merely the latest:
+                # a latency alert should link to a trace that actually
+                # sat in the slow bucket
+                exemplar = max(recent, key=lambda e: e.value).trace_id
+        return True, worst, exemplar
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"kind": "threshold", **dataclasses.asdict(self),
+                "labels": dict(self.labels)}
+
+
+@dataclasses.dataclass(frozen=True)
+class AbsenceRule:
+    """Fires when the series has no point younger than ``for_s``."""
+
+    name: str
+    metric: str
+    for_s: float = 300.0
+    labels: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    severity: str = "warning"
+    summary: str = ""
+
+    def evaluate(self, store: TimeSeriesStore, at: float
+                 ) -> Tuple[bool, Optional[float], Optional[str]]:
+        pts = store.window(self.metric, dict(self.labels),
+                           at - self.for_s, at)
+        present = any(p for _labels, p in pts)
+        return (not present), None, None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"kind": "absence", **dataclasses.asdict(self),
+                "labels": dict(self.labels)}
+
+
+@dataclasses.dataclass(frozen=True)
+class BurnWindow:
+    """One (long, short, factor) burn-rate window pair."""
+
+    long_s: float
+    short_s: float
+    factor: float
+
+
+# the SRE-workbook default ladder, scaled to in-process retention:
+# page on a fast burn (14.4x over 1h&5m), ticket on a slow one
+# (6x over 6h&30m)
+DEFAULT_BURN_WINDOWS: Tuple[BurnWindow, ...] = (
+    BurnWindow(3600.0, 300.0, 14.4),
+    BurnWindow(6 * 3600.0, 1800.0, 6.0),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class BurnRateRule:
+    """Multi-window multi-burn-rate SLO rule over two counter series.
+
+    ``error_ratio(w) = sum(rate(numerator[w])) / sum(rate(denominator
+    [w]))``; the rule is active when, for ANY window pair, the ratio
+    over BOTH the long and short window is ``>= factor × (1 -
+    objective)``. No denominator traffic in a window means no verdict
+    from that window (absent-never-wrong — an idle service is not
+    meeting nor missing its SLO)."""
+
+    name: str
+    numerator: str                       # e.g. request count, 5xx only
+    denominator: str                     # e.g. request count, all
+    objective: float = 0.999             # SLO success target
+    numerator_labels: Mapping[str, str] = dataclasses.field(
+        default_factory=dict)
+    denominator_labels: Mapping[str, str] = dataclasses.field(
+        default_factory=dict)
+    windows: Sequence[BurnWindow] = DEFAULT_BURN_WINDOWS
+    for_s: float = 0.0                   # the short window already gates
+    severity: str = "critical"
+    summary: str = ""
+
+    def _ratio(self, store: TimeSeriesStore, window_s: float,
+               at: float) -> Optional[float]:
+        num = sum(v for _l, v in store.rate(
+            self.numerator, dict(self.numerator_labels), window_s, at))
+        den_rates = store.rate(self.denominator,
+                               dict(self.denominator_labels), window_s, at)
+        den = sum(v for _l, v in den_rates)
+        if not den_rates or den <= 0:
+            return None
+        return num / den
+
+    def evaluate(self, store: TimeSeriesStore, at: float
+                 ) -> Tuple[bool, Optional[float], Optional[str]]:
+        budget = 1.0 - self.objective
+        worst: Optional[float] = None
+        active = False
+        for w in self.windows:
+            long_r = self._ratio(store, w.long_s, at)
+            short_r = self._ratio(store, w.short_s, at)
+            if long_r is None or short_r is None:
+                continue
+            worst = max(worst if worst is not None else 0.0,
+                        long_r, short_r)
+            if long_r >= w.factor * budget and short_r >= w.factor * budget:
+                active = True
+        return active, worst, None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["numerator_labels"] = dict(self.numerator_labels)
+        d["denominator_labels"] = dict(self.denominator_labels)
+        d["windows"] = [dataclasses.asdict(w) for w in self.windows]
+        return {"kind": "burn_rate", **d}
+
+
+Rule = Union[ThresholdRule, AbsenceRule, BurnRateRule]
+
+_RULE_KINDS = {"threshold": ThresholdRule, "absence": AbsenceRule,
+               "burn_rate": BurnRateRule}
+
+
+def rule_from_dict(d: Mapping[str, Any]) -> Rule:
+    """Inverse of ``Rule.to_dict`` — the declarative load path (rule
+    packs shipped as data, e.g. a ConfigMap)."""
+    spec = dict(d)
+    kind = spec.pop("kind", "threshold")
+    cls = _RULE_KINDS.get(kind)
+    if cls is None:
+        raise ValueError(f"unknown rule kind {kind!r}; "
+                         f"known: {sorted(_RULE_KINDS)}")
+    if cls is BurnRateRule and "windows" in spec:
+        spec["windows"] = tuple(
+            w if isinstance(w, BurnWindow) else BurnWindow(**w)
+            for w in spec["windows"])
+    return cls(**spec)
+
+
+@dataclasses.dataclass
+class AlertState:
+    """One rule's live state + the last evaluation's evidence."""
+
+    rule: Rule
+    state: str = INACTIVE
+    since: Optional[float] = None        # entered current state at
+    active_since: Optional[float] = None  # condition first true at
+    value: Optional[float] = None
+    exemplar_trace_id: Optional[str] = None
+    transitions: int = 0
+    last_resolved_at: Optional[float] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "rule": self.rule.name,
+            "severity": self.rule.severity,
+            "state": self.state,
+            "since": self.since,
+            "value": self.value,
+            "exemplarTraceId": self.exemplar_trace_id,
+            "transitions": self.transitions,
+            "summary": getattr(self.rule, "summary", ""),
+            "spec": self.rule.to_dict(),
+        }
+
+
+class AlertManager:
+    """Evaluates rules each tick; owns the FSM + Events + gauge + spans.
+
+    ``client`` is optional: without one, transitions still trace and
+    meter (the dev/in-process shape); with one, each transition emits
+    exactly one k8s Event in ``namespace`` (deduped by construction —
+    Events are created only inside the transition branch, and a steady
+    state is not a transition)."""
+
+    def __init__(self, store: TimeSeriesStore,
+                 rules: Optional[Sequence[Rule]] = None, *,
+                 client: Optional[KubeClient] = None,
+                 namespace: str = "kubeflow",
+                 clock: Optional[Clock] = None,
+                 tracer: Optional[Tracer] = None,
+                 interval_s: float = 15.0) -> None:
+        self.store = store
+        self.client = client
+        self.namespace = namespace
+        self.clock: Clock = clock if clock is not None else store.clock
+        self.tracer = tracer if tracer is not None else TRACER
+        self.interval_s = float(interval_s)
+        self._states: Dict[str, AlertState] = {}
+        self._event_seq = 0
+        self._lock = threading.Lock()
+        for rule in (rules if rules is not None else default_rules()):
+            self.add_rule(rule)
+
+    def add_rule(self, rule: Rule) -> None:
+        with self._lock:
+            if rule.name in self._states:
+                raise ValueError(f"alert rule {rule.name!r} already exists")
+            self._states[rule.name] = AlertState(rule=rule)
+            _firing_g.set(0.0, rule=rule.name)
+
+    def remove_rule(self, name: str) -> None:
+        with self._lock:
+            self._states.pop(name, None)
+            _firing_g.remove(rule=name)
+
+    # -- evaluation --------------------------------------------------------
+
+    def evaluate(self, at: Optional[float] = None) -> List[AlertState]:
+        """One evaluation pass over every rule; returns the states that
+        transitioned this pass (the smoke gates assert on it)."""
+        now = at if at is not None else self.clock()
+        with self._lock:
+            states = list(self._states.values())
+        transitioned: List[AlertState] = []
+        for st in states:
+            if self._step(st, now):
+                transitioned.append(st)
+        return transitioned
+
+    def _step(self, st: AlertState, now: float) -> bool:
+        rule = st.rule
+        try:
+            active, value, exemplar = rule.evaluate(self.store, now)
+        except Exception:  # noqa: BLE001 — one bad rule never kills the loop
+            log.exception("alert rule %s evaluation failed", rule.name)
+            return False
+        st.value = value
+        if active:
+            # fresh evidence only: THIS activation's exemplar (possibly
+            # none), never a previous incident's trace id
+            st.exemplar_trace_id = exemplar
+        # an AbsenceRule's for_s IS the silence window its evaluate()
+        # already waited out — applying it again as a pending duration
+        # would double the time-to-fire
+        for_s = (0.0 if isinstance(rule, AbsenceRule)
+                 else getattr(rule, "for_s", 0.0))
+        if st.state in (INACTIVE, RESOLVED):
+            if active:
+                st.active_since = now
+                if for_s > 0:
+                    self._transition(st, PENDING, now)
+                else:
+                    self._transition(st, FIRING, now)
+                return True
+            if st.state == RESOLVED:
+                # Resolved is transient: visible for one tick, then
+                # idle — and the incident's exemplar goes with it (an
+                # Inactive rule must not link to an old incident)
+                st.state = INACTIVE
+                st.since = now
+                st.exemplar_trace_id = None
+            return False
+        if st.state == PENDING:
+            if not active:
+                st.active_since = None
+                self._transition(st, INACTIVE, now)
+                st.exemplar_trace_id = None  # the near-incident is over
+                return True
+            if now - (st.active_since if st.active_since is not None
+                      else now) >= for_s:
+                self._transition(st, FIRING, now)
+                return True
+            return False
+        if st.state == FIRING:
+            if not active:
+                st.active_since = None
+                st.last_resolved_at = now
+                self._transition(st, RESOLVED, now)
+                return True
+            return False
+        return False
+
+    def _transition(self, st: AlertState, to: str, now: float) -> None:
+        frm = st.state
+        st.state = to
+        st.since = now
+        st.transitions += 1
+        _transitions_c.inc(rule=st.rule.name, to=to)
+        _firing_g.set(1.0 if to == FIRING else 0.0, rule=st.rule.name)
+        # the alert-evaluation span: one per transition, so an incident
+        # trace shows exactly when the rule walked its states
+        with self.tracer.span("alerts.transition", attrs={
+                "rule": st.rule.name, "from": frm, "to": to,
+                "value": st.value, "severity": st.rule.severity,
+                **({"exemplarTraceId": st.exemplar_trace_id}
+                   if st.exemplar_trace_id else {})}):
+            pass
+        self._emit_event(st, frm, to, now)
+        log.info("alert %s: %s -> %s (value=%s)",
+                 st.rule.name, frm, to, st.value)
+
+    def _emit_event(self, st: AlertState, frm: str, to: str,
+                    now: float) -> None:
+        if self.client is None:
+            return
+        with self._lock:
+            self._event_seq += 1
+            seq = self._event_seq
+        summary = getattr(st.rule, "summary", "") or st.rule.name
+        value = ("" if st.value is None
+                 else f" (value={round(st.value, 6)})")
+        event = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {
+                # seq-suffixed name: every transition is its OWN Event
+                # (create, never patch), and re-evaluations of a steady
+                # state create nothing — exactly one Event per transition
+                "name": f"alert-{st.rule.name}-{seq}",
+                "namespace": self.namespace,
+            },
+            "type": ("Warning" if to in (PENDING, FIRING) else "Normal"),
+            "reason": f"Alert{to}",
+            "message": f"alert {st.rule.name}: {frm} -> {to}: "
+                       f"{summary}{value}",
+            "involvedObject": {"kind": "AlertRule", "name": st.rule.name,
+                               "namespace": self.namespace},
+        }
+        if st.exemplar_trace_id:
+            event["message"] += f" traceId={st.exemplar_trace_id}"
+        try:
+            self.client.create(event)
+        except ApiError as e:
+            log.warning("alert event for %s not recorded: %s",
+                        st.rule.name, e)
+
+    # -- views -------------------------------------------------------------
+
+    def status(self) -> Dict[str, Any]:
+        """The dashboard's ``GET /api/alerts`` payload."""
+        with self._lock:
+            states = [st.to_dict() for st in self._states.values()]
+        states.sort(key=lambda s: (s["state"] == INACTIVE, s["rule"]))
+        return {"rules": states,
+                "firing": sum(1 for s in states if s["state"] == FIRING)}
+
+    def firing(self) -> List[str]:
+        with self._lock:
+            return sorted(name for name, st in self._states.items()
+                          if st.state == FIRING)
+
+    # -- runtime -----------------------------------------------------------
+
+    def build_controller(self, interval_s: Optional[float] = None):
+        """Run evaluation on the shared reconciler runtime
+        (``Controller.periodic``) — uniform ``controller.reconcile``
+        spans + counter, like every other control loop."""
+        from kubeflow_tpu.operators.controller import Controller
+
+        interval = interval_s if interval_s is not None else self.interval_s
+
+        def reconcile(_ns: str, _name: str) -> float:
+            self.evaluate()
+            return interval
+
+        return Controller.periodic(reconcile, name="alerts",
+                                   tracer=self.tracer)
+
+
+# -- the starter rule pack ---------------------------------------------------
+
+
+def default_rules() -> List[Rule]:
+    """Rules over series the platform actually emits (names are pinned
+    by tests against their emitting modules — docs/OBSERVABILITY.md):
+
+    - **proxy-5xx-burn-rate** — the serving SLO: 5xx ratio of the edge
+      proxy's ``request_latency_seconds_count`` (PR 3) burning the
+      99.9% error budget at the SRE-workbook window ladder.
+    - **proxy-p99-latency** — p99 over the same histogram's buckets;
+      carries an exemplar trace id when it fires.
+    - **engine-pages-exhausted** — the paged decode engine is about to
+      stall admissions: ``kftpu_engine_kv_pages_free`` (PR 6) pinned
+      near zero for a minute.
+    - **queue-depth-sustained** — gangs waiting in the scheduler queue
+      (``kftpu_queue_depth{state="Queued"}``, PR 8) for 10 minutes.
+    - **recompile-storm** — ``train_recompiles_total`` (PR 5) climbing
+      at runtime: compilation-cache misses are eating step time.
+    - **straggler-flagged** — a TpuJob has had a flagged straggler
+      (``kftpu_job_stragglers``, PR 5) for 5 minutes.
+    """
+    return [
+        BurnRateRule(
+            name="proxy-5xx-burn-rate",
+            numerator="request_latency_seconds_count",
+            numerator_labels={"code": "5*"},
+            denominator="request_latency_seconds_count",
+            objective=0.999,
+            # a short for: makes the Pending state visible (one tick of
+            # "about to page") without delaying the page meaningfully
+            for_s=60.0,
+            severity="critical",
+            summary="edge proxy 5xx ratio is burning the 99.9% SLO "
+                    "error budget"),
+        ThresholdRule(
+            name="proxy-p99-latency",
+            metric="request_latency_seconds",
+            func="quantile", quantile=0.99, window_s=300.0,
+            op=">", threshold=2.0, for_s=60.0,
+            severity="warning",
+            summary="edge proxy p99 latency above 2s over 5m"),
+        ThresholdRule(
+            name="engine-pages-exhausted",
+            metric="kftpu_engine_kv_pages_free",
+            func="instant", op="<", threshold=2.0, for_s=60.0,
+            severity="critical",
+            summary="decode engine KV page pool nearly exhausted — "
+                    "admissions will stall"),
+        ThresholdRule(
+            name="queue-depth-sustained",
+            metric="kftpu_queue_depth",
+            labels={"state": "Queued"},
+            func="instant", op=">", threshold=4.0, for_s=600.0,
+            severity="warning",
+            summary="scheduler gang queue depth high for 10m"),
+        ThresholdRule(
+            name="recompile-storm",
+            metric="train_recompiles_total",
+            func="rate", window_s=300.0,
+            op=">", threshold=0.02, for_s=120.0,
+            severity="warning",
+            summary="training jobs recompiling at runtime (jit cache "
+                    "churn eating step time)"),
+        ThresholdRule(
+            name="straggler-flagged",
+            metric="kftpu_job_stragglers",
+            func="instant", op=">", threshold=0.0, for_s=300.0,
+            severity="warning",
+            summary="a TpuJob gang has a straggling worker flagged "
+                    "for 5m"),
+    ]

@@ -1,0 +1,311 @@
+"""Metrics scraper: pull ``/metrics`` expositions into the tsdb.
+
+The reference runs a Prometheus Deployment whose kubernetes service
+discovery scrapes every component Service annotated
+``prometheus.io/scrape`` (``gcp/prometheus.libsonnet``). This module is
+the in-process half of that loop: a :class:`Scraper` pulls the same
+component endpoints' text expositions into one
+:class:`~kubeflow_tpu.obs.tsdb.TimeSeriesStore` — plus any in-process
+:class:`~kubeflow_tpu.utils.metrics.Registry` (the component's own
+metrics, sampled without HTTP).
+
+Design points:
+
+- **one parser for everything** — :func:`parse_exposition` reads back
+  exactly the text format :mod:`kubeflow_tpu.utils.metrics` emits,
+  including escaped label values (``\\``, ``\"``, ``\\n``) and the
+  OpenMetrics exemplar suffix (``# {trace_id="..."} v``); local
+  registry sampling goes through it too, so an exposition that can't
+  round-trip is a test failure, not silent data loss.
+- **targets from the manifest** — the default target set is
+  :func:`kubeflow_tpu.manifests.components.monitoring.scrape_targets`,
+  derived by rendering the registered components and reading the
+  ``prometheus.io/*`` annotations off their Services. The deployed
+  prometheus config and this scraper consume the same source, so they
+  cannot drift (the TPU004 stance applied to scrape wiring).
+- **per-target ``up`` + staleness** — every tick writes
+  ``up{target=}`` 1/0 into the store; a failing target's other series
+  simply stop getting points and age out of the store's staleness
+  window, so instant queries go silent instead of reporting a dead
+  pod's frozen gauges.
+- **injectable everything** — ``clock`` (TPU003), ``fetch`` (url →
+  text) for tests; ticks run on the shared reconciler runtime via
+  :meth:`Scraper.build_controller` (``Controller.periodic``).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Mapping, Optional, Tuple
+
+from kubeflow_tpu.obs.tsdb import Exemplar, TimeSeriesStore
+from kubeflow_tpu.utils import DEFAULT_REGISTRY
+from kubeflow_tpu.utils.clock import Clock
+from kubeflow_tpu.utils.metrics import Registry
+
+log = logging.getLogger(__name__)
+
+# url -> exposition text; raises on unreachable/garbled
+Fetch = Callable[[str], str]
+
+_scrapes_total = DEFAULT_REGISTRY.counter(
+    "kftpu_scrape_attempts_total", "scrape attempts per target by outcome")
+
+
+@dataclass(frozen=True)
+class ParsedSample:
+    """One exposition line: series + value + optional exemplar."""
+
+    name: str
+    labels: Dict[str, str]
+    value: float
+    exemplar_trace_id: Optional[str] = None
+    exemplar_value: Optional[float] = None
+
+
+def _unescape(value: str) -> str:
+    """Invert the text-format label-value escaping."""
+    out: List[str] = []
+    i, n = 0, len(value)
+    while i < n:
+        c = value[i]
+        if c == "\\" and i + 1 < n:
+            nxt = value[i + 1]
+            if nxt == "n":
+                out.append("\n")
+            elif nxt in ("\\", '"'):
+                out.append(nxt)
+            else:  # unknown escape: keep verbatim (lenient read side)
+                out.append(c)
+                out.append(nxt)
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _parse_labels(text: str, start: int) -> Tuple[Dict[str, str], int]:
+    """Parse ``{k="v",...}`` starting at ``text[start] == '{'``;
+    returns (labels, index just past the closing brace). Escape-aware:
+    a ``"`` or ``}`` inside a quoted value never terminates it."""
+    labels: Dict[str, str] = {}
+    i = start + 1
+    n = len(text)
+    while i < n:
+        while i < n and text[i] in ", ":
+            i += 1
+        if i < n and text[i] == "}":
+            return labels, i + 1
+        eq = text.find("=", i)
+        if eq < 0:
+            raise ValueError(f"label without '=' at {i}")
+        key = text[i:eq].strip()
+        i = eq + 1
+        if i >= n or text[i] != '"':
+            raise ValueError(f"unquoted label value for {key!r}")
+        i += 1
+        buf: List[str] = []
+        while i < n:
+            c = text[i]
+            if c == "\\" and i + 1 < n:
+                buf.append(c)
+                buf.append(text[i + 1])
+                i += 2
+                continue
+            if c == '"':
+                break
+            buf.append(c)
+            i += 1
+        if i >= n:
+            raise ValueError(f"unterminated label value for {key!r}")
+        labels[key] = _unescape("".join(buf))
+        i += 1  # past the closing quote
+    raise ValueError("unterminated label set")
+
+
+def parse_exposition(text: str) -> List[ParsedSample]:
+    """Parse a Prometheus text exposition (the format
+    :meth:`Registry.expose` emits). Comment/blank lines are skipped;
+    a malformed line is dropped (logged at debug), never fatal — one
+    bad series must not lose a target's whole scrape."""
+    out: List[ParsedSample] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            out.append(_parse_line(line))
+        except (ValueError, IndexError) as e:
+            log.debug("dropped exposition line %r: %s", line, e)
+    return out
+
+
+def _parse_line(line: str) -> ParsedSample:
+    i = 0
+    n = len(line)
+    while i < n and line[i] not in "{ ":
+        i += 1
+    name = line[:i]
+    if not name:
+        raise ValueError("empty metric name")
+    labels: Dict[str, str] = {}
+    if i < n and line[i] == "{":
+        labels, i = _parse_labels(line, i)
+    rest = line[i:].strip()
+    # optional OpenMetrics exemplar suffix: `value # {labels} exemplar`
+    value_part, _, exemplar_part = rest.partition(" # ")
+    tokens = value_part.split()
+    if not tokens:
+        raise ValueError("missing sample value")
+    value = float(tokens[0])  # a trailing timestamp token is ignored
+    trace_id: Optional[str] = None
+    ex_value: Optional[float] = None
+    exemplar_part = exemplar_part.strip()
+    if exemplar_part.startswith("{"):
+        ex_labels, j = _parse_labels(exemplar_part, 0)
+        trace_id = ex_labels.get("trace_id")
+        ex_tokens = exemplar_part[j:].split()
+        if ex_tokens:
+            ex_value = float(ex_tokens[0])
+    return ParsedSample(name=name, labels=labels, value=value,
+                        exemplar_trace_id=trace_id, exemplar_value=ex_value)
+
+
+def _default_fetch(timeout_s: float) -> Fetch:
+    def fetch(url: str) -> str:
+        import urllib.request
+
+        from kubeflow_tpu.utils.metrics import EXEMPLARS_HEADER
+
+        # request the exemplar extension: exposition endpoints suffix
+        # bucket lines with exemplars only for a scraper that opted in
+        # (a classic 0.0.4 parser would choke on them; ours round-trips
+        # them into the store)
+        req = urllib.request.Request(
+            url, headers={EXEMPLARS_HEADER: "1"})
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return resp.read().decode("utf-8", "replace")
+
+    return fetch
+
+
+class Scraper:
+    """Pulls remote expositions + samples local registries each tick.
+
+    ``targets`` maps target name → metrics URL (default: the manifest's
+    :func:`scrape_targets`); ``registries`` maps target name → an
+    in-process :class:`Registry` sampled without HTTP (the common
+    dev/test shape, and how a component monitors itself). Every sample
+    is stamped with a ``target`` label — same-named series from two
+    components stay distinguishable — and every tick writes the
+    per-target ``up`` series."""
+
+    def __init__(self, store: TimeSeriesStore, *,
+                 targets: Optional[Mapping[str, str]] = None,
+                 registries: Optional[Mapping[str, Registry]] = None,
+                 clock: Optional[Clock] = None,
+                 fetch: Optional[Fetch] = None,
+                 timeout_s: float = 5.0,
+                 interval_s: float = 30.0) -> None:
+        if targets is None:
+            from kubeflow_tpu.manifests.components.monitoring import (
+                scrape_targets,
+            )
+
+            targets = scrape_targets()
+        self.store = store
+        self.targets: Dict[str, str] = dict(targets)
+        self.registries: Dict[str, Registry] = dict(registries or {})
+        self.clock: Clock = clock if clock is not None else store.clock
+        self.fetch: Fetch = (fetch if fetch is not None
+                             else _default_fetch(timeout_s))
+        self.interval_s = float(interval_s)
+        self.last_success: Dict[str, float] = {}
+
+    # -- one tick ----------------------------------------------------------
+
+    def tick(self) -> Dict[str, bool]:
+        """Scrape every target + sample every registry once; returns
+        per-target up/down (the smoke gates assert on it)."""
+        results: Dict[str, bool] = {}
+        now = self.clock()
+        for name, registry in sorted(self.registries.items()):
+            try:
+                self.store.sample_registry(registry,
+                                           labels={"target": name},
+                                           ts=now)
+            except Exception:  # noqa: BLE001 — one bad registry must
+                # not starve every remote target of scrapes forever;
+                # it reads as down (and loudly, unlike a dead pod)
+                log.exception("sampling in-process registry %r failed",
+                              name)
+                self._mark(name, False, now)
+                results[name] = False
+                continue
+            self._mark(name, True, now)
+            results[name] = True
+        for name, url in sorted(self.targets.items()):
+            try:
+                text = self.fetch(url)
+            except Exception as e:  # noqa: BLE001 — any failure = down
+                log.debug("scrape %s (%s) failed: %s", name, url, e)
+                self._mark(name, False, now)
+                results[name] = False
+                continue
+            self._ingest(name, text, now)
+            self._mark(name, True, now)
+            results[name] = True
+        return results
+
+    def _ingest(self, target: str, text: str, now: float) -> None:
+        for s in parse_exposition(text):
+            labels = dict(s.labels)
+            labels["target"] = target
+            ex = None
+            if s.exemplar_trace_id is not None:
+                ex = Exemplar(s.exemplar_trace_id,
+                              s.exemplar_value if s.exemplar_value
+                              is not None else s.value, now)
+            self.store.ingest(s.name, s.value, labels=labels, ts=now,
+                              exemplar=ex)
+
+    def _mark(self, target: str, up: bool, now: float) -> None:
+        self.store.ingest("up", 1.0 if up else 0.0,
+                          labels={"target": target}, ts=now)
+        _scrapes_total.inc(target=target, outcome="ok" if up else "fail")
+        if up:
+            self.last_success[target] = now
+
+    def stale_targets(self, staleness_s: Optional[float] = None
+                      ) -> List[str]:
+        """Targets with no successful scrape inside the staleness
+        window (never-scraped targets included) — the scrape-health
+        view the dashboard's query API surfaces via ``up``."""
+        limit = (staleness_s if staleness_s is not None
+                 else self.store.staleness_s)
+        now = self.clock()
+        names = sorted(set(self.targets) | set(self.registries))
+        out = []
+        for t in names:
+            last = self.last_success.get(t)
+            if last is None or now - last > limit:
+                out.append(t)
+        return out
+
+    # -- runtime -----------------------------------------------------------
+
+    def build_controller(self, interval_s: Optional[float] = None):
+        """Run the scrape tick on the shared reconciler runtime
+        (``Controller.periodic`` — uniform ``controller.reconcile``
+        spans + counter, like the autoscaler tick and queue cycle)."""
+        from kubeflow_tpu.operators.controller import Controller
+
+        interval = interval_s if interval_s is not None else self.interval_s
+
+        def reconcile(_ns: str, _name: str) -> float:
+            self.tick()
+            return interval
+
+        return Controller.periodic(reconcile, name="metrics-scraper")

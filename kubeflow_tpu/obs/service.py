@@ -49,12 +49,17 @@ class TraceCollectorService:
         self.registry = registry
 
     def handle(self, method: str, path: str, body: Optional[Dict[str, Any]],
-               user: str = "") -> Tuple[int, Any]:
+               user: str = "",
+               headers: Optional[Dict[str, str]] = None) -> Tuple[int, Any]:
         if method == "GET" and path == "/healthz":
             return 200, {"ok": True}
         if method == "GET" and path == "/metrics":
-            return 200, RawResponse("text/plain; version=0.0.4",
-                                    self.registry.expose().encode())
+            from kubeflow_tpu.utils.metrics import exposition
+
+            # exemplar suffixes only for a scraper that requested the
+            # extension; a classic prometheus gets a clean 0.0.4 body
+            payload, ctype = exposition(self.registry, headers or {})
+            return 200, RawResponse(ctype, payload)
         if method == "GET" and path == "/api/traces":
             return 200, self.collector.summary()
         if method == "POST" and path == "/api/traces:ingest":

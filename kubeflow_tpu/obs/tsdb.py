@@ -1,0 +1,460 @@
+"""In-process time-series store: the monitoring tier's memory.
+
+Every metric the platform emits so far is a point-in-time snapshot in a
+per-process :class:`~kubeflow_tpu.utils.metrics.Registry` — nothing can
+answer "what was the p99 over the last 5 minutes" or "has queue depth
+stayed high for 10 minutes", which is exactly what the reference's
+prometheus deployment (``gcp/prometheus.libsonnet``) provided and what
+the SLO alerting in :mod:`kubeflow_tpu.obs.alerts` needs. This module
+is that store, in the platform's house style:
+
+- **bounded rings** — every series holds at most ``max_points`` raw
+  points inside ``retention_s``; points aging out of the raw window
+  fold into a coarser downsampled ring (block-last at
+  ``downsample_resolution_s``) kept for ``downsample_retention_s``.
+  Memory is bounded hard; an idle series costs nothing.
+- **injectable clock** (TPU003): sampling ticks, staleness, and every
+  window query run off ``clock``; tests drive a fake clock and get
+  bit-stable results.
+- **counter functions** — :meth:`rate` / :meth:`delta` over a window
+  with counter-reset detection (a restarted process's counter drops to
+  zero; the reset is absorbed, never a negative rate), and
+  :meth:`histogram_quantile` over the cumulative ``_bucket`` series our
+  own :class:`~kubeflow_tpu.utils.metrics.Histogram` exposes — the
+  Prometheus estimation algorithm (linear interpolation within the
+  bucket that crosses the rank; ``+Inf``-resident mass clamps to the
+  highest finite bound).
+- **staleness** — :meth:`latest` refuses points older than
+  ``staleness_s`` (the Prometheus 5-minute rule), so a dead target's
+  frozen gauges stop answering instant queries; the scraper's
+  per-target ``up`` series says *why*.
+- **exemplars** — ingested samples may carry a trace id
+  (:class:`Exemplar`); the store keeps a small ring per series so a
+  quantile answer can hand back "and here is a trace that landed in
+  that bucket" (docs/OBSERVABILITY.md, exemplar format).
+
+Ingestion parses the Prometheus text format the registries already
+emit (one path for local sampling and remote scrapes — what round-trips
+is what is stored), via :func:`kubeflow_tpu.obs.scrape.parse_exposition`.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import (
+    Any,
+    Deque,
+    Dict,
+    List,
+    Mapping,
+    Optional,
+    Sequence,
+    Tuple,
+)
+
+from kubeflow_tpu.utils.clock import Clock
+from kubeflow_tpu.utils.metrics import Registry
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+@dataclass(frozen=True)
+class Exemplar:
+    """A trace reference attached to one observed sample."""
+
+    trace_id: str
+    value: float
+    ts: float
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"traceId": self.trace_id, "value": self.value,
+                "ts": self.ts}
+
+
+@dataclass(frozen=True)
+class Point:
+    ts: float
+    value: float
+
+
+class _Series:
+    """One (name, labels) series: raw ring + downsampled tier."""
+
+    __slots__ = ("labels", "points", "down", "exemplars", "_down_block")
+
+    def __init__(self, labels: _LabelKey, max_points: int,
+                 max_down: int, max_exemplars: int) -> None:
+        self.labels = labels
+        self.points: Deque[Point] = deque(maxlen=max_points)
+        self.down: Deque[Point] = deque(maxlen=max_down)
+        self.exemplars: Deque[Exemplar] = deque(maxlen=max_exemplars)
+        self._down_block: Optional[int] = None  # last folded block id
+
+
+def _label_key(labels: Optional[Mapping[str, str]]) -> _LabelKey:
+    return tuple(sorted((str(k), str(v))
+                        for k, v in (labels or {}).items()))
+
+
+def match_labels(labels: Mapping[str, str],
+                 match: Optional[Mapping[str, str]]) -> bool:
+    """Subset equality match; a match value ending in ``*`` is a prefix
+    match (``code="5*"`` selects every 5xx row — the alert rules' only
+    concession to regexes)."""
+    if not match:
+        return True
+    for k, want in match.items():
+        got = labels.get(k)
+        if got is None:
+            return False
+        if want.endswith("*"):
+            if not got.startswith(want[:-1]):
+                return False
+        elif got != want:
+            return False
+    return True
+
+
+class TimeSeriesStore:
+    """Bounded in-process TSDB over (metric name, label set) series."""
+
+    def __init__(self, *, clock: Optional[Clock] = None,
+                 retention_s: float = 3600.0,
+                 max_points: int = 2048,
+                 downsample_resolution_s: float = 60.0,
+                 downsample_retention_s: float = 6 * 3600.0,
+                 staleness_s: float = 300.0,
+                 max_series: int = 8192,
+                 max_exemplars_per_series: int = 8) -> None:
+        self.clock: Clock = clock if clock is not None else time.monotonic
+        self.retention_s = float(retention_s)
+        self.staleness_s = float(staleness_s)
+        self.downsample_resolution_s = float(downsample_resolution_s)
+        self.downsample_retention_s = float(downsample_retention_s)
+        self.max_points = int(max_points)
+        self.max_series = int(max_series)
+        self.max_exemplars = int(max_exemplars_per_series)
+        self._max_down = max(
+            int(downsample_retention_s / downsample_resolution_s), 1)
+        self._series: Dict[str, Dict[_LabelKey, _Series]] = {}
+        self._series_count = 0   # O(1) cap check (series never removed)
+        self._dropped_series = 0
+        self._lock = threading.Lock()
+
+    # -- ingestion ---------------------------------------------------------
+
+    def ingest(self, name: str, value: float, *,
+               labels: Optional[Mapping[str, str]] = None,
+               ts: Optional[float] = None,
+               exemplar: Optional[Exemplar] = None) -> None:
+        """Append one sample. NaN values are dropped (the text format's
+        staleness marker shape); the series ring is created on first
+        touch, up to ``max_series`` (over budget, new series are counted
+        and dropped — bounded memory beats completeness)."""
+        if value is None or (isinstance(value, float) and math.isnan(value)):
+            return
+        at = ts if ts is not None else self.clock()
+        key = _label_key(labels)
+        with self._lock:
+            by_label = self._series.setdefault(name, {})
+            series = by_label.get(key)
+            if series is None:
+                if self._series_count >= self.max_series:
+                    self._dropped_series += 1
+                    return
+                series = by_label[key] = _Series(
+                    key, self.max_points, self._max_down,
+                    self.max_exemplars)
+                self._series_count += 1
+            if len(series.points) == series.points.maxlen:
+                # count overflow inside the retention window: the
+                # evicted head still folds into the downsampled tier
+                self._fold_point(series, series.points.popleft())
+            # out-of-order within a scrape tick is fine; strictly older
+            # than the ring tail is not worth reordering for
+            series.points.append(Point(at, float(value)))
+            if exemplar is not None:
+                series.exemplars.append(exemplar)
+            self._fold(series, at)
+
+    def _fold(self, series: _Series, now: float) -> None:
+        """Move raw points older than the retention window into the
+        downsampled tier (block-last at ``downsample_resolution_s`` —
+        right for counters, whose increase across blocks survives, and
+        honest for gauges: the freshest value of the block)."""
+        cutoff = now - self.retention_s
+        while series.points and series.points[0].ts < cutoff:
+            self._fold_point(series, series.points.popleft())
+
+    def _fold_point(self, series: _Series, p: Point) -> None:
+        block = int(p.ts // self.downsample_resolution_s)
+        if series._down_block == block and series.down:
+            series.down[-1] = Point(series.down[-1].ts, p.value)
+        else:
+            series.down.append(Point(p.ts, p.value))
+            series._down_block = block
+
+    def sample_registry(self, registry: Registry, *,
+                        labels: Optional[Mapping[str, str]] = None,
+                        ts: Optional[float] = None) -> int:
+        """Sample every series a :class:`Registry` exposes, through the
+        same text-format parser the remote scraper uses (one ingestion
+        path; what round-trips is what is stored). Returns the number of
+        samples ingested. ``labels`` (e.g. ``target=local``) merge into
+        every sample's labels, sample-side values winning."""
+        from kubeflow_tpu.obs.scrape import parse_exposition
+
+        at = ts if ts is not None else self.clock()
+        n = 0
+        for s in parse_exposition(registry.expose()):
+            merged = dict(labels or {})
+            merged.update(s.labels)
+            ex = None
+            if s.exemplar_trace_id is not None:
+                ex = Exemplar(s.exemplar_trace_id,
+                              s.exemplar_value if s.exemplar_value
+                              is not None else s.value, at)
+            self.ingest(s.name, s.value, labels=merged, ts=at, exemplar=ex)
+            n += 1
+        return n
+
+    # -- raw reads ---------------------------------------------------------
+
+    def series_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def series(self, name: str,
+               match: Optional[Mapping[str, str]] = None
+               ) -> List[Tuple[Dict[str, str], List[Point]]]:
+        """Every matching series: (labels, raw+downsampled points oldest
+        first). Snapshot copies — callers can't race the rings."""
+        with self._lock:
+            by_label = self._series.get(name, {})
+            out = []
+            for key, s in sorted(by_label.items()):
+                labels = dict(key)
+                if not match_labels(labels, match):
+                    continue
+                out.append((labels, list(s.down) + list(s.points)))
+            return out
+
+    def window(self, name: str, match: Optional[Mapping[str, str]],
+               start: float, end: float
+               ) -> List[Tuple[Dict[str, str], List[Point]]]:
+        """Matching series restricted to ``start <= ts <= end``."""
+        return [(labels, [p for p in pts if start <= p.ts <= end])
+                for labels, pts in self.series(name, match)]
+
+    def exemplars(self, name: str,
+                  match: Optional[Mapping[str, str]] = None,
+                  since: Optional[float] = None) -> List[Exemplar]:
+        """Recent exemplars across matching series, newest last."""
+        with self._lock:
+            out: List[Exemplar] = []
+            for key, s in sorted(self._series.get(name, {}).items()):
+                if not match_labels(dict(key), match):
+                    continue
+                out.extend(e for e in s.exemplars
+                           if since is None or e.ts >= since)
+        out.sort(key=lambda e: e.ts)
+        return out
+
+    # -- instant functions -------------------------------------------------
+
+    def latest(self, name: str,
+               match: Optional[Mapping[str, str]] = None,
+               at: Optional[float] = None
+               ) -> List[Tuple[Dict[str, str], Point]]:
+        """Per-series newest point no newer than ``at`` and no older
+        than the staleness window (dead targets go silent, not frozen)."""
+        now = at if at is not None else self.clock()
+        out = []
+        for labels, pts in self.series(name, match):
+            last = None
+            for p in pts:
+                if p.ts <= now:
+                    last = p
+            if last is not None and now - last.ts <= self.staleness_s:
+                out.append((labels, last))
+        return out
+
+    def _windowed(self, name: str, match: Optional[Mapping[str, str]],
+                  window_s: float, at: Optional[float]
+                  ) -> List[Tuple[Dict[str, str], List[Point]]]:
+        now = at if at is not None else self.clock()
+        return [(labels, pts) for labels, pts
+                in self.window(name, match, now - float(window_s), now)]
+
+    def rate(self, name: str,
+             match: Optional[Mapping[str, str]] = None,
+             window_s: float = 300.0,
+             at: Optional[float] = None
+             ) -> List[Tuple[Dict[str, str], float]]:
+        """Per-series counter rate (increase/elapsed) over the trailing
+        window, reset-aware: a drop between adjacent points is a counter
+        restart, and the post-reset value is the increase since it (the
+        Prometheus convention). Series with fewer than two in-window
+        points yield nothing — absent, never fabricated."""
+        out = []
+        for labels, pts in self._windowed(name, match, window_s, at):
+            if len(pts) < 2:
+                continue
+            elapsed = pts[-1].ts - pts[0].ts
+            if elapsed <= 0:
+                continue
+            out.append((labels, _increase(pts) / elapsed))
+        return out
+
+    def delta(self, name: str,
+              match: Optional[Mapping[str, str]] = None,
+              window_s: float = 300.0,
+              at: Optional[float] = None
+              ) -> List[Tuple[Dict[str, str], float]]:
+        """Gauge difference last-first over the window (no reset logic:
+        a gauge going down means exactly that)."""
+        out = []
+        for labels, pts in self._windowed(name, match, window_s, at):
+            if len(pts) < 2:
+                continue
+            out.append((labels, pts[-1].value - pts[0].value))
+        return out
+
+    def avg(self, name: str,
+            match: Optional[Mapping[str, str]] = None,
+            window_s: float = 300.0,
+            at: Optional[float] = None
+            ) -> List[Tuple[Dict[str, str], float]]:
+        """Per-series mean over the window (``avg_over_time``) — the
+        smoothing read the scheduler predictor feeds from."""
+        out = []
+        for labels, pts in self._windowed(name, match, window_s, at):
+            if not pts:
+                continue
+            out.append((labels, sum(p.value for p in pts) / len(pts)))
+        return out
+
+    # -- histogram quantile ------------------------------------------------
+
+    def histogram_quantile(self, q: float, base_name: str,
+                           match: Optional[Mapping[str, str]] = None,
+                           window_s: float = 300.0,
+                           at: Optional[float] = None
+                           ) -> List[Tuple[Dict[str, str], float]]:
+        """Quantile estimate from the cumulative ``<base>_bucket``
+        series over the trailing window, grouped by the non-``le``
+        labels. Per group: the *increase* of each cumulative bucket over
+        the window (reset-aware), then the Prometheus interpolation —
+        find the bucket the rank falls in, interpolate linearly inside
+        it (from 0 at the first finite bucket); rank in ``+Inf`` clamps
+        to the highest finite bound. Groups with zero in-window
+        observations yield nothing (absent-never-wrong; the
+        single-point case has no increase and stays silent too)."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        # group bucket series by their non-le identity
+        groups: Dict[_LabelKey, List[Tuple[float, List[Point]]]] = {}
+        for labels, pts in self._windowed(f"{base_name}_bucket", match,
+                                          window_s, at):
+            le = labels.pop("le", None)
+            if le is None:
+                continue
+            bound = float("inf") if le == "+Inf" else float(le)
+            groups.setdefault(_label_key(labels), []).append((bound, pts))
+        out = []
+        for key, buckets in sorted(groups.items()):
+            buckets.sort(key=lambda b: b[0])
+            increases = []
+            for bound, pts in buckets:
+                if len(pts) < 2:
+                    continue
+                increases.append((bound, max(_increase(pts), 0.0)))
+            value = _bucket_quantile(q, increases)
+            if value is not None:
+                out.append((dict(key), value))
+        return out
+
+
+def _increase(pts: Sequence[Point]) -> float:
+    """Counter increase across points with reset absorption."""
+    total = 0.0
+    prev = pts[0].value
+    for p in pts[1:]:
+        total += p.value if p.value < prev else p.value - prev
+        prev = p.value
+    return total
+
+
+def _bucket_quantile(q: float,
+                     increases: Sequence[Tuple[float, float]]
+                     ) -> Optional[float]:
+    """Prometheus ``histogram_quantile`` over (upper bound, in-window
+    count) pairs sorted by bound (``+Inf`` last)."""
+    if not increases:
+        return None
+    # cumulative counts are monotone by construction upstream, but each
+    # bucket's increase was computed independently — enforce monotone
+    cum: List[Tuple[float, float]] = []
+    running = 0.0
+    for bound, inc in increases:
+        running = max(running, inc)
+        cum.append((bound, running))
+    total = cum[-1][1]
+    if total <= 0:
+        return None
+    if not math.isinf(cum[-1][0]):
+        # a histogram exposition always carries +Inf; partial windows
+        # may have dropped it — treat the last bound as the ceiling
+        cum.append((float("inf"), total))
+    rank = q * total
+    highest_finite = None
+    for bound, _c in cum:
+        if not math.isinf(bound):
+            highest_finite = bound
+    if highest_finite is None:
+        return None  # only +Inf observed: no finite estimate exists
+    prev_bound, prev_cum = 0.0, 0.0
+    for bound, c in cum:
+        if c >= rank:
+            if math.isinf(bound):
+                # the rank lives in +Inf: the estimate clamps to the
+                # highest finite bound (Prometheus behavior)
+                return highest_finite
+            if c == prev_cum:
+                return bound
+            return prev_bound + (bound - prev_bound) * \
+                (rank - prev_cum) / (c - prev_cum)
+        prev_bound, prev_cum = bound, c
+    return highest_finite
+
+
+# -- the one query surface ---------------------------------------------------
+
+QUERY_FUNCS = ("instant", "rate", "delta", "avg", "quantile")
+
+
+def evaluate(store: TimeSeriesStore, func: str, metric: str, *,
+             match: Optional[Mapping[str, str]] = None,
+             window_s: float = 300.0, q: float = 0.99,
+             at: Optional[float] = None
+             ) -> List[Tuple[Dict[str, str], float]]:
+    """One evaluation path for the alert engine and the dashboard's
+    ``/api/metrics/query`` — an alert firing and the panel drawing it
+    can never disagree about what the expression means."""
+    if func == "instant":
+        return [(labels, p.value)
+                for labels, p in store.latest(metric, match, at)]
+    if func == "rate":
+        return store.rate(metric, match, window_s, at)
+    if func == "delta":
+        return store.delta(metric, match, window_s, at)
+    if func == "avg":
+        return store.avg(metric, match, window_s, at)
+    if func == "quantile":
+        return store.histogram_quantile(q, metric, match, window_s, at)
+    raise ValueError(f"unknown query func {func!r}; "
+                     f"known: {', '.join(QUERY_FUNCS)}")

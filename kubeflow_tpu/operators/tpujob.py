@@ -383,13 +383,23 @@ class TpuJobOperator:
                  clock: Optional[Clock] = None,
                  tracer: Optional[Tracer] = None,
                  queue: Optional[Any] = None,
-                 checkpointer: Optional[PreemptionCheckpointer] = None
+                 checkpointer: Optional[PreemptionCheckpointer] = None,
+                 tsdb: Optional[Any] = None,
+                 tsdb_window_s: float = 300.0
                  ) -> None:
         self.client = client
         self.namespace = namespace
         self.gang_scheduling = gang_scheduling
         self.queue = queue
         self.checkpointer = checkpointer
+        # a monitoring-tier TimeSeriesStore (kubeflow_tpu/obs/tsdb.py):
+        # when attached, the scheduler predictor is fed the job's
+        # stepsPerSec series averaged over tsdb_window_s instead of the
+        # instantaneous CR-status view, so prediction quality no longer
+        # depends on reconcile timing; absent (or series missing) the
+        # CR-status path is unchanged
+        self.tsdb = tsdb
+        self.tsdb_window_s = float(tsdb_window_s)
         # epoch-seconds clock (wall, not monotonic: the terminal job span
         # closes against startTime timestamps persisted in CR status) +
         # a tracer sharing it, so the training-job root span stays
@@ -657,10 +667,38 @@ class TpuJobOperator:
             # the scheduling loop PR 5 built this telemetry for: every
             # aggregation feeds the queue's throughput predictor
             self.queue.predictor.observe(
-                ns, name, steps_per_sec=view["stepsPerSec"],
+                ns, name,
+                steps_per_sec=self._predictor_rate(
+                    ns, name, view["stepsPerSec"]),
                 last_step=view["lastStep"],
                 accelerator=spec.accelerator, slices=spec.slices)
         return view
+
+    def _predictor_rate(self, ns: str, name: str,
+                        instant_rate: float) -> float:
+        """The rate the throughput predictor learns from: the tsdb's
+        ``kftpu_job_steps_per_sec`` series averaged over the monitoring
+        window when a store is attached and the series has in-window
+        points, else the instantaneous CR-status view unchanged
+        (absent-never-wrong: a missing series can only fall back, never
+        fabricate — and a non-positive windowed average falls back too,
+        since ``observe`` discards non-positive rates)."""
+        if self.tsdb is None:
+            return instant_rate
+        try:
+            averaged = self.tsdb.avg("kftpu_job_steps_per_sec",
+                                     {"namespace": ns, "job": name},
+                                     window_s=self.tsdb_window_s)
+        except Exception:  # noqa: BLE001 — monitoring must not fail jobs
+            log.exception("tsdb stepsPerSec read failed for %s/%s",
+                          ns, name)
+            return instant_rate
+        rates = [v for _labels, v in averaged if v > 0]
+        if not rates:
+            return instant_rate
+        # multiple matching series (e.g. scraped from several targets)
+        # agree on one number the same way the beacon view does: mean
+        return sum(rates) / len(rates)
 
     def _clear_job_gauges(self, ns: str, name: str) -> None:
         """Terminal/deleted jobs must not export their last telemetry

@@ -382,7 +382,10 @@ class GangQueue:
         entry.head = False
         entry.pending_free = []   # the eviction (if any) paid off
         wait = max(now - entry.submitted_at, 0.0)
-        _wait_h.observe(wait)
+        # exemplar: the gang's identity-derived trace, so a long-wait
+        # bucket opens the admit->place span tree that waited
+        _wait_h.observe(wait,
+                        exemplar_trace_id=self._trace(req).trace_id)
         self._span("scheduler.queue.place", req,
                    {"slices": ",".join(chosen_ids) or "unpinned",
                     "contention": contention,

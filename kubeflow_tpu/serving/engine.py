@@ -112,6 +112,10 @@ _queue_wait_h = DEFAULT_REGISTRY.histogram(
 _kv_pages_g = DEFAULT_REGISTRY.gauge(
     "kftpu_engine_kv_pages_in_use",
     "physical KV pages allocated out of the paged engine's pool")
+_kv_pages_free_g = DEFAULT_REGISTRY.gauge(
+    "kftpu_engine_kv_pages_free",
+    "unallocated KV pages left in the paged engine's pool (the "
+    "engine-pages-exhausted alert rule watches this)")
 _prefill_chunks_c = DEFAULT_REGISTRY.counter(
     "kftpu_engine_prefill_chunks_total",
     "prompt chunks prefilled by the paged engine's interleaved scheduler")
@@ -936,7 +940,13 @@ class DecodeEngine:
             return now
         req._wait_noted = True
         wait = max(0.0, now - req.t_submit)
-        _queue_wait_h.observe(wait, model=self.name)
+        # exemplar: the request's propagated trace, so a slow queue-wait
+        # bucket opens the trace that actually waited
+        _queue_wait_h.observe(
+            wait,
+            exemplar_trace_id=(req.ctx.trace_id
+                               if req.ctx is not None else None),
+            model=self.name)
         self.tracer.record("engine.queue_wait", start=req.t_submit,
                            end=now, parent=req.ctx,
                            attrs={"model": self.name})
@@ -1239,6 +1249,7 @@ class DecodeEngine:
         self._pos_host[slot] = start
         self._slot_budget[slot] = S + req.max_new
         _kv_pages_g.set(pool.pages_in_use, model=self.name)
+        _kv_pages_free_g.set(pool.pages_free, model=self.name)
         _prefix_bytes_g.set(store.pages_held * self._page_bytes,
                             model=self.name)
         return True
@@ -1362,6 +1373,8 @@ class DecodeEngine:
                         jnp.int32(self._pos_host[i]),
                         jnp.asarray(self._pool.table_row(i)))
                 _kv_pages_g.set(self._pool.pages_in_use, model=self.name)
+                _kv_pages_free_g.set(self._pool.pages_free,
+                                     model=self.name)
 
     def _retire_paged(self, slot: int) -> None:
         """Free the slot's pages (shared prefix pages drop one ref) and
@@ -1376,6 +1389,7 @@ class DecodeEngine:
         self._pos_host[slot] = 0
         self._slot_budget[slot] = 0
         _kv_pages_g.set(self._pool.pages_in_use, model=self.name)
+        _kv_pages_free_g.set(self._pool.pages_free, model=self.name)
 
     # -- cache recovery ----------------------------------------------------
 
@@ -1425,6 +1439,7 @@ class DecodeEngine:
             self._pos_host[:] = 0
             self._slot_budget[:] = 0
             _kv_pages_g.set(0, model=self.name)
+            _kv_pages_free_g.set(self._pool.pages_free, model=self.name)
             # replays reserve WITHOUT prefix sharing (the store died
             # with the old pool), so a load that only fit shared may
             # not fully fit the fresh pool: fail just those streams
@@ -1463,6 +1478,7 @@ class DecodeEngine:
         self._pos_host[slot] = 0
         self._slot_budget[slot] = budget
         _kv_pages_g.set(pool.pages_in_use, model=self.name)
+        _kv_pages_free_g.set(pool.pages_free, model=self.name)
 
     def _replay_dense(self, slot: int, req: _Request,
                       tokens: np.ndarray, produced: int,

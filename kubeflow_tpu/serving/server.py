@@ -886,10 +886,15 @@ class ModelServer:
                 if path == "/healthz":
                     self._send(200, {"status": "ok"})
                 elif path == "/metrics":
-                    body = DEFAULT_REGISTRY.expose().encode()
+                    from kubeflow_tpu.utils.metrics import exposition
+
+                    # the one exposition policy: exemplar suffixes only
+                    # for a scraper that requested the extension — a
+                    # classic prometheus must get a clean 0.0.4 body
+                    body, ctype = exposition(DEFAULT_REGISTRY,
+                                             dict(self.headers))
                     self.send_response(200)
-                    self.send_header("Content-Type",
-                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Type", ctype)
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)

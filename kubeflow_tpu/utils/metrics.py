@@ -19,6 +19,22 @@ from kubeflow_tpu.utils.clock import Clock
 _Label = Tuple[Tuple[str, str], ...]
 
 
+def escape_label_value(value: str) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote, and line feed (in that order — escaping the escapes first).
+    An unescaped ``"`` truncates the value mid-line and a raw newline
+    splits one sample into two garbage lines, so a label value like a
+    model path or an error message used to produce an exposition no
+    parser (including our own scraper) could read back."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def format_labels(key: _Label) -> str:
+    """``k1="v1",k2="v2"`` with values escaped per the text format."""
+    return ",".join(f'{k}="{escape_label_value(v)}"' for k, v in key)
+
+
 class Metric:
     def __init__(self, name: str, help_: str, kind: str) -> None:
         self.name = name
@@ -50,14 +66,16 @@ class Metric:
         with self._lock:
             self._values.pop(self._key(labels), None)
 
-    def expose(self) -> str:
+    def expose(self, exemplars: bool = True) -> str:
+        # ``exemplars`` is meaningful only for Histogram (exemplar
+        # suffixes); accepted here so Registry can pass it uniformly
+        del exemplars
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} {self.kind}"]
         with self._lock:
             for key, val in sorted(self._values.items()):
                 if key:
-                    lbl = ",".join(f'{k}="{v}"' for k, v in key)
-                    lines.append(f"{self.name}{{{lbl}}} {val}")
+                    lines.append(f"{self.name}{{{format_labels(key)}}} {val}")
                 else:
                     lines.append(f"{self.name} {val}")
         return "\n".join(lines)
@@ -82,7 +100,14 @@ class Histogram(Metric):
     exposition with configurable bounds. Buckets are stored per label
     set; exposition emits cumulative counts (each ``le`` bucket includes
     everything below it, ``+Inf`` equals ``_count``), the shape every
-    Prometheus quantile function expects."""
+    Prometheus quantile function expects.
+
+    ``observe(..., exemplar_trace_id=)`` keeps the *latest* observed
+    (trace_id, value) per bucket — OpenMetrics exemplars — and
+    exposition suffixes the bucket line with ``# {trace_id="..."} v``,
+    so a latency bucket links straight to a trace of a request that
+    landed in it (docs/OBSERVABILITY.md; the tsdb scraper round-trips
+    the suffix)."""
 
     def __init__(self, name: str, help_: str,
                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> None:
@@ -98,8 +123,12 @@ class Histogram(Metric):
         # per label set: per-bucket (non-cumulative) counts + [+Inf]
         self._counts: Dict[_Label, List[int]] = {}
         self._sums: Dict[_Label, float] = {}
+        # per label set: bucket index -> latest (trace_id, value)
+        self._exemplars: Dict[_Label, Dict[int, Tuple[str, float]]] = {}
 
-    def observe(self, value: float, **labels: str) -> None:
+    def observe(self, value: float,
+                exemplar_trace_id: Optional[str] = None,
+                **labels: str) -> None:
         key = self._key(labels)
         idx = bisect.bisect_left(self.bounds, value)
         with self._lock:
@@ -108,6 +137,9 @@ class Histogram(Metric):
                 counts = self._counts[key] = [0] * (len(self.bounds) + 1)
             counts[idx] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
+            if exemplar_trace_id:
+                self._exemplars.setdefault(key, {})[idx] = (
+                    str(exemplar_trace_id), float(value))
 
     def inc(self, amount: float = 1.0, **labels: str) -> None:
         raise TypeError(f"histogram {self.name!r}: use observe(), not inc()")
@@ -126,6 +158,15 @@ class Histogram(Metric):
             key = self._key(labels)
             self._counts.pop(key, None)
             self._sums.pop(key, None)
+            self._exemplars.pop(key, None)
+
+    def exemplars(self, **labels: str) -> Dict[str, Tuple[str, float]]:
+        """Latest exemplar per bucket, keyed by ``le`` string."""
+        with self._lock:
+            ex = dict(self._exemplars.get(self._key(labels), {}))
+        bounds = list(self.bounds) + [float("inf")]
+        return {("+Inf" if i == len(self.bounds) else _fmt_bound(bounds[i])):
+                v for i, v in ex.items()}
 
     def bucket_counts(self, **labels: str) -> Dict[str, int]:
         """Cumulative counts keyed by ``le`` string (tests/debugging)."""
@@ -154,23 +195,40 @@ class Histogram(Metric):
         return _HistogramTimer(
             self, clock if clock is not None else time.monotonic, labels)
 
-    def expose(self) -> str:
+    def expose(self, exemplars: bool = True) -> str:
+        """``exemplars=False`` omits the exemplar suffixes: they are a
+        private extension of the 0.0.4 text format (OpenMetrics-style
+        syntax, but this exposition is NOT spec-valid OpenMetrics — no
+        ``# EOF``, counter families keep their ``_total`` name), and
+        the classic Prometheus text parser errors on tokens after the
+        value — one exemplar would make the whole target unscrapeable.
+        HTTP endpoints emit them only to a scraper that explicitly
+        requests the extension (:data:`EXEMPLARS_HEADER`)."""
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} {self.kind}"]
         with self._lock:
-            items = sorted((k, list(v), self._sums.get(k, 0.0))
+            items = sorted((k, list(v), self._sums.get(k, 0.0),
+                            dict(self._exemplars.get(k, {})))
                            for k, v in self._counts.items())
-        for key, counts, total in items:
-            base = ",".join(f'{k}="{v}"' for k, v in key)
+        for key, counts, total, bucket_exemplars in items:
+            base = format_labels(key)
+
+            def bucket_line(idx: int, le: str, acc: int) -> str:
+                lbl = (base + "," if base else "") + f'le="{le}"'
+                line = f"{self.name}_bucket{{{lbl}}} {acc}"
+                ex = bucket_exemplars.get(idx) if exemplars else None
+                if ex is not None:
+                    # OpenMetrics-style exemplar: `# {labels} v` suffix
+                    line += (f' # {{trace_id="'
+                             f'{escape_label_value(ex[0])}"}} {ex[1]}')
+                return line
+
             acc = 0
-            for bound, n in zip(self.bounds, counts):
+            for i, (bound, n) in enumerate(zip(self.bounds, counts)):
                 acc += n
-                lbl = (base + "," if base else "") + \
-                    f'le="{_fmt_bound(bound)}"'
-                lines.append(f"{self.name}_bucket{{{lbl}}} {acc}")
+                lines.append(bucket_line(i, _fmt_bound(bound), acc))
             acc += counts[-1]
-            lbl = (base + "," if base else "") + 'le="+Inf"'
-            lines.append(f"{self.name}_bucket{{{lbl}}} {acc}")
+            lines.append(bucket_line(len(self.bounds), "+Inf", acc))
             suffix = f"{{{base}}}" if base else ""
             lines.append(f"{self.name}_sum{suffix} {total}")
             lines.append(f"{self.name}_count{suffix} {acc}")
@@ -239,13 +297,50 @@ class Registry:
                                    else Metric(name, help_, kind))
             return self._metrics[name]
 
-    def expose(self) -> str:
+    def expose(self, exemplars: bool = True) -> str:
+        """In-process consumers (the tsdb sampler, tests) default to the
+        exemplar-carrying shape; pass ``exemplars=False`` for a
+        classic-0.0.4-safe exposition (what HTTP endpoints serve unless
+        the scraper requests the extension)."""
         with self._lock:
             metrics = list(self._metrics.values())
-        return "\n".join(m.expose() for m in metrics) + "\n"
+        return "\n".join(m.expose(exemplars=exemplars)
+                         for m in metrics) + "\n"
 
 
 DEFAULT_REGISTRY = Registry()
+
+# the exemplar-extension request header: exemplar suffixes are NOT valid
+# in either the classic 0.0.4 text format or (as emitted here) strict
+# OpenMetrics, so HTTP endpoints send them only to a scraper explicitly
+# asking for the extension — the in-process obs/scrape.Scraper does; a
+# real Prometheus never does and always gets a clean 0.0.4 body. Accept
+# negotiation is deliberately NOT used: Prometheus v2.x advertises
+# application/openmetrics-text on every scrape, and answering with a
+# not-quite-OpenMetrics body (no ``# EOF``) would fail its strict parser.
+EXEMPLARS_HEADER = "X-Kftpu-Exemplars"
+
+EXPOSITION_CONTENT_TYPE = "text/plain; version=0.0.4"
+
+
+def wants_exemplars(headers: Mapping[str, str]) -> bool:
+    """True when the request opts into the exemplar extension."""
+    for k, v in headers.items():
+        if str(k).lower() == EXEMPLARS_HEADER.lower():
+            return str(v).strip().lower() in ("1", "true", "yes")
+    return False
+
+
+def exposition(registry: Registry,
+               headers: Optional[Mapping[str, str]] = None
+               ) -> Tuple[bytes, str]:
+    """(body, content type) for an HTTP ``/metrics`` response — the ONE
+    policy for every exposition endpoint (serve_metrics, the serving
+    server, the trace collector): classic 0.0.4 unless the scraper
+    requested the exemplar extension."""
+    body = registry.expose(
+        exemplars=wants_exemplars(headers or {})).encode()
+    return body, EXPOSITION_CONTENT_TYPE
 
 
 def serve_metrics(port: int, registry: Registry = DEFAULT_REGISTRY) -> threading.Thread:
@@ -258,8 +353,7 @@ def serve_metrics(port: int, registry: Registry = DEFAULT_REGISTRY) -> threading
             # path merely containing "metrics"
             path = self.path.split("?")[0].rstrip("/") or "/"
             if path == "/metrics":
-                body = registry.expose().encode()
-                ctype = "text/plain; version=0.0.4"
+                body, ctype = exposition(registry, dict(self.headers))
             elif path in ("/", "/healthz"):
                 body = b"ok\n"
                 # a health probe is not a Prometheus exposition — no

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Monitoring-plane smoke gate (scripts/preflight.sh stage).
+
+Drives the monitoring core end-to-end on a fake clock: a scraper pulls
+two fake component targets (an edge-proxy-shaped registry and an
+engine-shaped one) into the in-process time-series store, a 5xx burst
+is injected into the edge traffic, and the burn-rate SLO rule must walk
+``Pending -> Firing -> Resolved`` with exactly one k8s Event per
+transition and the ``kftpu_alerts_firing`` gauge back at 0 when the
+bleeding stops (docs/OBSERVABILITY.md, Monitoring section). Exits
+nonzero on any violated invariant.
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+from kubeflow_tpu.k8s import FakeKubeClient  # noqa: E402
+from kubeflow_tpu.obs import alerts as alerts_mod  # noqa: E402
+from kubeflow_tpu.obs.alerts import (  # noqa: E402
+    FIRING,
+    INACTIVE,
+    PENDING,
+    RESOLVED,
+    AlertManager,
+    BurnRateRule,
+    BurnWindow,
+)
+from kubeflow_tpu.obs.scrape import Scraper  # noqa: E402
+from kubeflow_tpu.obs.trace import SpanCollector, Tracer  # noqa: E402
+from kubeflow_tpu.obs.tsdb import TimeSeriesStore  # noqa: E402
+from kubeflow_tpu.utils.metrics import Registry  # noqa: E402
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def check(ok, what):
+    if not ok:
+        print(f"FAIL: {what}")
+        sys.exit(1)
+    print(f"ok: {what}")
+
+
+def main():
+    clock = Clock()
+    collector = SpanCollector()
+
+    edge = Registry()
+    lat = edge.histogram("request_latency_seconds", "edge latency",
+                         buckets=(0.1, 0.5, 2.0))
+    engine = Registry()
+    engine.gauge("kftpu_engine_kv_pages_free", "free").set(64.0, model="m")
+
+    store = TimeSeriesStore(clock=clock)
+    scraper = Scraper(
+        store,
+        targets={"edge": "http://edge:1/metrics",
+                 "engine": "http://engine:2/metrics"},
+        clock=clock,
+        fetch=lambda url: (edge if "edge" in url else engine).expose())
+
+    kube = FakeKubeClient()
+    rule = BurnRateRule(
+        name="smoke-slo-burn",
+        numerator="request_latency_seconds_count",
+        numerator_labels={"code": "5*"},
+        denominator="request_latency_seconds_count",
+        objective=0.99,
+        windows=(BurnWindow(60.0, 20.0, 2.0),),
+        for_s=20.0,
+        summary="edge 5xx burn")
+    mgr = AlertManager(store, [rule], client=kube, namespace="monitoring",
+                       clock=clock, tracer=Tracer(collector, clock=clock))
+
+    def state():
+        return mgr.status()["rules"][0]["state"]
+
+    def tick(t, n_ok=10, n_5xx=0):
+        clock.t = t
+        for _ in range(n_ok):
+            lat.observe(0.05, route="/predict", code="200")
+        for _ in range(n_5xx):
+            lat.observe(0.02, route="/predict", code="503")
+        scraper.tick()
+        mgr.evaluate()
+
+    # healthy traffic
+    for i in range(11):
+        tick(float(i * 10))
+    check(state() == INACTIVE, "healthy traffic leaves the rule inactive")
+    ups = dict((labels["target"], p.value)
+               for labels, p in store.latest("up"))
+    check(ups == {"edge": 1.0, "engine": 1.0},
+          "both fake targets scraped up=1")
+
+    # inject the 5xx burst
+    tick(110.0, n_ok=5, n_5xx=5)
+    tick(120.0, n_ok=5, n_5xx=5)
+    check(state() == PENDING, "burst trips the rule into Pending")
+    tick(130.0, n_ok=5, n_5xx=5)
+    tick(140.0, n_ok=5, n_5xx=5)
+    check(state() == FIRING, "for: elapsed -> Firing")
+    check(alerts_mod._firing_g.get(rule="smoke-slo-burn") == 1.0,
+          "kftpu_alerts_firing gauge at 1 while firing")
+
+    # bleeding stops: the short window clears the rule
+    for t in (150.0, 160.0, 170.0):
+        tick(t)
+    check(state() in (RESOLVED, INACTIVE),
+          "healthy traffic resolves the rule")
+    check(alerts_mod._firing_g.get(rule="smoke-slo-burn") == 0.0,
+          "firing gauge back at 0")
+
+    events = {}
+    for e in kube.list("v1", "Event", "monitoring"):
+        events.setdefault(e["reason"], []).append(e)
+    for reason in ("AlertPending", "AlertFiring", "AlertResolved"):
+        check(len(events.get(reason, [])) == 1,
+              f"exactly one {reason} Event")
+    spans = [s for s in collector.spans() if s.name == "alerts.transition"]
+    check([(s.attrs["from"], s.attrs["to"]) for s in spans] == [
+        (INACTIVE, PENDING), (PENDING, FIRING), (FIRING, RESOLVED)],
+        "one alerts.transition span per transition, in order")
+
+    print("alerts smoke: PASS")
+
+
+if __name__ == "__main__":
+    main()

@@ -32,6 +32,12 @@
 #      once, Preempted condition, head-of-queue requeue, resume with
 #      the step clock intact) and every chip stays accounted for
 #      (docs/SCHEDULER.md)
+#   7. monitoring/alerts smoke (scripts/alerts_smoke.py): fake-clock
+#      end-to-end — scrape two fake targets into the tsdb, inject a
+#      5xx burst, assert the burn-rate SLO rule walks
+#      Pending -> Firing -> Resolved with exactly one Event per
+#      transition and the firing gauge back at 0
+#      (docs/OBSERVABILITY.md, Monitoring section)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -56,6 +62,9 @@ JAX_PLATFORMS=cpu python scripts/paged_smoke.py || rc=1
 
 echo "== preflight: scheduler plane smoke =="
 JAX_PLATFORMS=cpu python scripts/scheduler_smoke.py || rc=1
+
+echo "== preflight: monitoring/alerts smoke =="
+JAX_PLATFORMS=cpu python scripts/alerts_smoke.py || rc=1
 
 if [ "$rc" -ne 0 ]; then
     echo "preflight: FAILED" >&2

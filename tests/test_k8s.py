@@ -105,6 +105,42 @@ def test_apply_all_is_idempotent(client):
     assert len(client.list("v1", "ConfigMap", "ns1")) == 1
 
 
+def test_apply_all_retries_with_injected_sleep(client):
+    """The retry/backoff path on the injectable Sleep contract (TPU003):
+    two transient failures then success — deterministic, no real sleep,
+    exponential delays observed."""
+    cm = o.config_map("cfg", "ns1", {"a": "1"})
+    fails = {"n": 2}
+    real_apply = client.apply
+
+    def flaky_apply(obj):
+        if fails["n"] > 0:
+            fails["n"] -= 1
+            raise ApiError(500, "transient")
+        return real_apply(obj)
+
+    client.apply = flaky_apply
+    slept = []
+    applied = apply_all(client, [cm], retries=3, backoff_s=2.0,
+                        sleep=slept.append)
+    assert [a["metadata"]["name"] for a in applied] == ["cfg"]
+    assert slept == [2.0, 4.0]  # backoff_s * 2**attempt, no final sleep
+
+
+def test_apply_all_raises_after_exhausted_retries_without_final_sleep(client):
+    cm = o.config_map("cfg", "ns1", {"a": "1"})
+
+    def always_fails(obj):
+        raise ApiError(500, "down")
+
+    client.apply = always_fails
+    slept = []
+    with pytest.raises(ApiError):
+        apply_all(client, [cm], retries=3, backoff_s=1.0,
+                  sleep=slept.append)
+    assert slept == [1.0, 2.0]  # no sleep after the final attempt
+
+
 def test_delete_all_ignores_missing(client):
     objs = [o.config_map("cfg", "ns1", {})]
     apply_all(client, objs)

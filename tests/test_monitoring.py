@@ -1,0 +1,1075 @@
+"""Monitoring core: tsdb + scraper + alert engine + dashboard query API.
+
+Covers the PR-9 monitoring tier (docs/OBSERVABILITY.md "Monitoring"):
+
+- exposition label-value escaping round-trips through the scraper's
+  parser (the text-format spec satellite);
+- :class:`TimeSeriesStore` rings, retention/downsampling, staleness,
+  ``rate``/``delta``/``avg`` with counter-reset absorption, and the
+  ``histogram_quantile`` edge cases pinned to hand-computed values;
+- :class:`Scraper` target scraping, per-target ``up``, target-label
+  stamping, and target-list consistency with the monitoring manifest;
+- the alert FSM (pending → firing → resolved), Events-per-transition,
+  the firing gauge, absence + burn-rate rules, declarative round-trip;
+- ``GET /api/metrics/query`` / ``GET /api/alerts`` on the dashboard;
+- the fake-clock acceptance test: registries sampled + a second
+  component scraped → correct ``rate()`` / ``histogram_quantile()``
+  over the window → an injected 5xx burst walks the burn-rate rule
+  through its states with exactly one Event per transition → a fired
+  latency alert's exemplar trace id resolves via ``GET
+  /api/traces/<id>`` to the span that observed it.
+"""
+
+import threading
+
+from kubeflow_tpu.dashboard.server import DashboardApi, RegistryMetricsService
+from kubeflow_tpu.k8s import FakeKubeClient
+from kubeflow_tpu.obs.alerts import (
+    FIRING,
+    INACTIVE,
+    PENDING,
+    RESOLVED,
+    AbsenceRule,
+    AlertManager,
+    BurnRateRule,
+    BurnWindow,
+    ThresholdRule,
+    default_rules,
+    rule_from_dict,
+)
+from kubeflow_tpu.obs.scrape import Scraper, parse_exposition
+from kubeflow_tpu.obs.trace import SpanCollector, Tracer
+from kubeflow_tpu.obs.tsdb import Exemplar, TimeSeriesStore
+from kubeflow_tpu.utils.metrics import Histogram, Metric, Registry
+
+
+class SetClock:
+    """Settable fake clock: reads return exactly ``t`` (no auto-tick —
+    window math in these tests is pinned to exact timestamps)."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+        self._lock = threading.Lock()
+
+    def __call__(self) -> float:
+        with self._lock:
+            return self.t
+
+
+# -- exposition escaping (satellite) -----------------------------------------
+
+
+def test_label_value_escaping_round_trips_through_parser():
+    nasty = 'quote:" backslash:\\ newline:\nend'
+    m = Metric("m_total", "h", "counter")
+    m.inc(3.0, path=nasty)
+    text = m.expose()
+    # the exposition itself stays one-sample-per-line
+    assert len([ln for ln in text.splitlines()
+                if not ln.startswith("#")]) == 1
+    samples = parse_exposition(text)
+    assert len(samples) == 1
+    assert samples[0].labels == {"path": nasty}
+    assert samples[0].value == 3.0
+
+
+def test_histogram_label_escaping_and_exemplar_round_trip():
+    h = Histogram("lat_seconds", "h", buckets=[0.1, 1.0])
+    nasty = 'a"b\\c\nd'
+    h.observe(0.05, exemplar_trace_id="cafe1234", route=nasty)
+    h.observe(5.0, route=nasty)
+    samples = parse_exposition(h.expose())
+    by_name = {}
+    for s in samples:
+        by_name.setdefault(s.name, []).append(s)
+    buckets = by_name["lat_seconds_bucket"]
+    assert all(s.labels["route"] == nasty for s in buckets)
+    first = [s for s in buckets if s.labels["le"] == "0.1"][0]
+    assert first.exemplar_trace_id == "cafe1234"
+    assert first.exemplar_value == 0.05
+    assert by_name["lat_seconds_count"][0].value == 2.0
+    assert by_name["lat_seconds_sum"][0].value == 5.05
+
+
+def test_parser_drops_garbage_lines_not_the_scrape():
+    text = ("ok_total 1.0\n"
+            "garbage{unterminated=\"...\n"
+            "also_ok 2.0\n"
+            "no_value{a=\"b\"}\n")
+    samples = parse_exposition(text)
+    assert [(s.name, s.value) for s in samples] == [
+        ("ok_total", 1.0), ("also_ok", 2.0)]
+
+
+# -- time-series store -------------------------------------------------------
+
+
+def test_store_rate_absorbs_counter_reset():
+    clock = SetClock(140.0)
+    s = TimeSeriesStore(clock=clock)
+    for ts, v in [(100, 0), (110, 50), (120, 100), (130, 20), (140, 70)]:
+        s.ingest("c_total", v, ts=float(ts))
+    # increases: 50 + 50 + (reset: 20) + 50 = 170 over 40s
+    [(labels, rate)] = s.rate("c_total", window_s=40)
+    assert labels == {}
+    assert rate == 170.0 / 40.0
+    [(_, d)] = s.delta("c_total", window_s=40)
+    assert d == 70.0
+    [(_, a)] = s.avg("c_total", window_s=40)
+    assert a == (0 + 50 + 100 + 20 + 70) / 5.0
+
+
+def test_store_rate_needs_two_points():
+    clock = SetClock(100.0)
+    s = TimeSeriesStore(clock=clock)
+    s.ingest("c_total", 5.0, ts=100.0)
+    assert s.rate("c_total", window_s=60) == []
+
+
+def test_store_staleness_silences_dead_series():
+    clock = SetClock(0.0)
+    s = TimeSeriesStore(clock=clock, staleness_s=300.0)
+    s.ingest("g", 7.0, ts=0.0)
+    clock.t = 100.0
+    assert s.latest("g") == [({}, s.latest("g")[0][1])]
+    assert s.latest("g")[0][1].value == 7.0
+    clock.t = 400.0  # beyond staleness: the gauge goes silent
+    assert s.latest("g") == []
+
+
+def test_store_retention_folds_into_downsampled_tier():
+    clock = SetClock(0.0)
+    s = TimeSeriesStore(clock=clock, retention_s=100.0,
+                        downsample_resolution_s=50.0)
+    for i in range(30):
+        s.ingest("g", float(i), ts=float(i * 10))  # t=0..290
+    [(_, pts)] = s.series("g")
+    # everything survives, raw tail + downsampled head
+    assert pts[-1].value == 29.0
+    raw = [p for p in pts if p.ts >= 290 - 100]
+    down = [p for p in pts if p.ts < 290 - 100]
+    assert raw and down
+    # the downsampled tier holds block-LAST values at 50s resolution:
+    # strictly fewer points than the raw samples it absorbed
+    absorbed = 30 - len(raw)
+    assert 0 < len(down) < absorbed
+
+
+def test_store_bounds_series_cardinality():
+    clock = SetClock(0.0)
+    s = TimeSeriesStore(clock=clock, max_series=3)
+    for i in range(5):
+        s.ingest("g", 1.0, labels={"i": str(i)}, ts=0.0)
+    assert len(s.series("g")) == 3
+
+
+# -- histogram_quantile edges (satellite, hand-computed) ---------------------
+
+
+def _ingest_buckets(store, ts, cum, labels=None):
+    """Ingest one scrape's cumulative bucket counts {le: count}."""
+    for le, c in cum.items():
+        lab = dict(labels or {})
+        lab["le"] = le
+        store.ingest("lat_bucket", float(c), labels=lab, ts=ts)
+
+
+def test_quantile_empty_series_is_absent():
+    s = TimeSeriesStore(clock=SetClock(100.0))
+    assert s.histogram_quantile(0.99, "lat", window_s=60) == []
+
+
+def test_quantile_zero_increase_is_absent():
+    s = TimeSeriesStore(clock=SetClock(100.0))
+    cum = {"0.1": 4, "1": 4, "+Inf": 4}
+    _ingest_buckets(s, 50.0, cum)
+    _ingest_buckets(s, 100.0, cum)  # no new observations in the window
+    assert s.histogram_quantile(0.5, "lat", window_s=60) == []
+
+
+def test_quantile_all_observations_in_inf_clamps_to_highest_bound():
+    s = TimeSeriesStore(clock=SetClock(100.0))
+    _ingest_buckets(s, 50.0, {"0.1": 0, "1": 0, "+Inf": 0})
+    _ingest_buckets(s, 100.0, {"0.1": 0, "1": 0, "+Inf": 8})
+    [(_, v)] = s.histogram_quantile(0.5, "lat", window_s=60)
+    assert v == 1.0  # the highest finite bound, never +Inf
+
+
+def test_quantile_single_bucket_interpolates_from_zero():
+    s = TimeSeriesStore(clock=SetClock(100.0))
+    _ingest_buckets(s, 50.0, {"1": 0, "+Inf": 0})
+    _ingest_buckets(s, 100.0, {"1": 4, "+Inf": 4})
+    # rank 2 of 4 inside [0, 1] -> 0.5
+    [(_, v)] = s.histogram_quantile(0.5, "lat", window_s=60)
+    assert v == 0.5
+    # q=1.0 -> the bucket's upper bound exactly
+    [(_, v1)] = s.histogram_quantile(1.0, "lat", window_s=60)
+    assert v1 == 1.0
+
+
+def test_quantile_exact_boundary_values():
+    # Histogram puts an observation equal to a bound in that bound's
+    # bucket (le is inclusive); the estimator must return the bound at
+    # q=1.0 and interpolate below it for smaller q
+    h = Histogram("lat", "h", buckets=[0.25, 1.0])
+    for _ in range(4):
+        h.observe(0.25)
+    clock = SetClock(50.0)
+    s = TimeSeriesStore(clock=clock)
+    _ingest_buckets(s, 50.0, {"0.25": 0, "1": 0, "+Inf": 0})
+    clock.t = 100.0
+    for samp in parse_exposition(h.expose()):
+        if samp.name == "lat_bucket":
+            s.ingest("lat_bucket", samp.value, labels=samp.labels,
+                     ts=100.0)
+    [(_, v_top)] = s.histogram_quantile(1.0, "lat", window_s=60)
+    assert v_top == 0.25
+    [(_, v_mid)] = s.histogram_quantile(0.5, "lat", window_s=60)
+    assert v_mid == 0.125  # linear within [0, 0.25]: rank 2 of 4
+
+
+def test_quantile_groups_by_non_le_labels():
+    s = TimeSeriesStore(clock=SetClock(100.0))
+    _ingest_buckets(s, 50.0, {"1": 0, "+Inf": 0}, {"route": "/a"})
+    _ingest_buckets(s, 100.0, {"1": 4, "+Inf": 4}, {"route": "/a"})
+    _ingest_buckets(s, 50.0, {"1": 0, "+Inf": 0}, {"route": "/b"})
+    _ingest_buckets(s, 100.0, {"1": 0, "+Inf": 4}, {"route": "/b"})
+    got = dict((labels["route"], v) for labels, v
+               in s.histogram_quantile(0.5, "lat", window_s=60))
+    assert got == {"/a": 0.5, "/b": 1.0}
+
+
+# -- scraper -----------------------------------------------------------------
+
+
+def test_scraper_marks_up_and_stamps_target_label():
+    clock = SetClock(0.0)
+    store = TimeSeriesStore(clock=clock)
+    good = Registry()
+    good.gauge("g", "h").set(5.0)
+
+    def fetch(url):
+        if "good" in url:
+            return good.expose()
+        raise OSError("connection refused")
+
+    local = Registry()
+    local.counter("c_total", "h").inc(2.0)
+    s = Scraper(store, targets={"good": "http://good:1/metrics",
+                                "bad": "http://bad:1/metrics"},
+                registries={"local": local}, clock=clock, fetch=fetch)
+    results = s.tick()
+    assert results == {"good": True, "bad": False, "local": True}
+    ups = dict((labels["target"], p.value)
+               for labels, p in store.latest("up"))
+    assert ups == {"good": 1.0, "bad": 0.0, "local": 1.0}
+    [(labels, p)] = store.latest("g")
+    assert labels == {"target": "good"} and p.value == 5.0
+    [(labels, p)] = store.latest("c_total")
+    assert labels == {"target": "local"} and p.value == 2.0
+    clock.t = 1000.0  # no scrapes since: everything stale
+    assert set(s.stale_targets()) == {"good", "bad", "local"}
+    assert store.latest("g") == []
+
+
+def test_scraper_default_targets_match_monitoring_manifest():
+    """The scraper's default target list and the rendered prometheus
+    static job both come from scrape_targets() — and scrape_targets()
+    itself must agree with the prometheus.io annotations the component
+    manifests render (the TPU004 can't-drift stance)."""
+    import yaml
+
+    from kubeflow_tpu.config.deployment import ComponentSpec, DeploymentConfig
+    from kubeflow_tpu.manifests.components.monitoring import (
+        scrape_config,
+        scrape_targets,
+    )
+    from kubeflow_tpu.manifests.registry import (
+        list_components,
+        render_component,
+    )
+
+    targets = scrape_targets()
+    cfg = DeploymentConfig(name="pin")
+    annotated = {}
+    for comp in list_components():
+        try:
+            objs = render_component(cfg, ComponentSpec(comp.name))
+        except Exception:
+            continue
+        for obj in objs:
+            if obj.get("kind") != "Service":
+                continue
+            ann = obj.get("metadata", {}).get("annotations") or {}
+            if ann.get("prometheus.io/scrape") == "true":
+                annotated[obj["metadata"]["name"]] = (
+                    ann.get("prometheus.io/port"),
+                    ann.get("prometheus.io/path", "/metrics"))
+    assert annotated, "no scrape-annotated components rendered"
+    assert set(targets) == set(annotated)
+    for svc, (port, path) in annotated.items():
+        assert targets[svc] == f"http://{svc}:{port}{path}"
+    # the rendered prometheus config's static job carries the same list
+    rendered = yaml.safe_load(scrape_config("30s", targets))
+    static = [j for j in rendered["scrape_configs"]
+              if j.get("static_configs")][0]
+    assert sorted(static["static_configs"][0]["targets"]) == sorted(
+        f"{svc}:{port}" for svc, (port, _path) in annotated.items())
+
+
+# -- alert engine ------------------------------------------------------------
+
+
+def _events(client, ns="kubeflow"):
+    out = {}
+    for e in client.list("v1", "Event", ns):
+        out.setdefault(e["reason"], []).append(e)
+    return out
+
+
+def test_threshold_rule_walks_pending_firing_resolved():
+    clock = SetClock(0.0)
+    store = TimeSeriesStore(clock=clock)
+    client = FakeKubeClient()
+    collector = SpanCollector()
+    rule = ThresholdRule(name="t-depth", metric="depth", op=">",
+                         threshold=3.0, for_s=20.0, summary="deep")
+    mgr = AlertManager(store, [rule], client=client, clock=clock,
+                       tracer=Tracer(collector, clock=clock))
+    store.ingest("depth", 1.0, ts=0.0)
+    assert mgr.evaluate() == []
+    st = mgr.status()["rules"][0]
+    assert st["state"] == INACTIVE
+
+    clock.t = 10.0
+    store.ingest("depth", 9.0, ts=10.0)
+    [t1] = mgr.evaluate()
+    assert t1.state == PENDING
+    assert mgr.firing() == []
+
+    clock.t = 20.0  # for: not yet elapsed (10s of 20s)
+    store.ingest("depth", 9.0, ts=20.0)
+    assert mgr.evaluate() == []
+
+    clock.t = 31.0  # held > for_s
+    store.ingest("depth", 9.0, ts=31.0)
+    [t2] = mgr.evaluate()
+    assert t2.state == FIRING
+    assert mgr.firing() == ["t-depth"]
+    from kubeflow_tpu.obs import alerts as alerts_mod
+
+    assert alerts_mod._firing_g.get(rule="t-depth") == 1.0
+
+    clock.t = 40.0
+    store.ingest("depth", 0.0, ts=40.0)
+    [t3] = mgr.evaluate()
+    assert t3.state == RESOLVED
+    assert alerts_mod._firing_g.get(rule="t-depth") == 0.0
+    clock.t = 50.0
+    store.ingest("depth", 0.0, ts=50.0)
+    assert mgr.evaluate() == []  # Resolved -> Inactive is not a transition
+    assert mgr.status()["rules"][0]["state"] == INACTIVE
+
+    # exactly one Event per transition, deduped across re-evaluations
+    ev = _events(client)
+    assert len(ev["AlertPending"]) == 1
+    assert len(ev["AlertFiring"]) == 1
+    assert len(ev["AlertResolved"]) == 1
+    # one alerts.transition span per transition, same dedup
+    spans = [s for s in collector.spans() if s.name == "alerts.transition"]
+    assert [(s.attrs["from"], s.attrs["to"]) for s in spans] == [
+        (INACTIVE, PENDING), (PENDING, FIRING), (FIRING, RESOLVED)]
+
+
+def test_pending_cancels_when_condition_clears():
+    clock = SetClock(0.0)
+    store = TimeSeriesStore(clock=clock)
+    rule = ThresholdRule(name="t-cancel", metric="m", op=">",
+                         threshold=1.0, for_s=60.0)
+    mgr = AlertManager(store, [rule], clock=clock)
+    store.ingest("m", 5.0, ts=0.0)
+    [t] = mgr.evaluate()
+    assert t.state == PENDING
+    clock.t = 10.0
+    store.ingest("m", 0.0, ts=10.0)
+    [t] = mgr.evaluate()
+    assert t.state == INACTIVE
+    assert mgr.firing() == []
+
+
+def test_absence_rule_fires_on_silence():
+    clock = SetClock(0.0)
+    store = TimeSeriesStore(clock=clock)
+    rule = AbsenceRule(name="t-absent", metric="heartbeat", for_s=30.0)
+    mgr = AlertManager(store, [rule], clock=clock)
+    store.ingest("heartbeat", 1.0, ts=0.0)
+    clock.t = 10.0
+    assert mgr.evaluate() == []  # fresh point inside the window
+    clock.t = 100.0  # silent for 100s > 30s
+    [t] = mgr.evaluate()
+    assert t.state == FIRING
+    store.ingest("heartbeat", 1.0, ts=100.0)
+    clock.t = 110.0
+    [t] = mgr.evaluate()
+    assert t.state == RESOLVED
+
+
+def test_burn_rate_needs_both_windows():
+    clock = SetClock(0.0)
+    store = TimeSeriesStore(clock=clock)
+    rule = BurnRateRule(name="t-burn2", numerator="err_total",
+                        denominator="req_total", objective=0.99,
+                        windows=(BurnWindow(100.0, 20.0, 2.0),))
+    mgr = AlertManager(store, [rule], clock=clock)
+    # errors climbed long ago, quiet now: long window sees the burn,
+    # the short window does not -> no alert (the bleeding stopped)
+    for ts in (0, 10, 20, 30):
+        store.ingest("req_total", 100.0 + ts, ts=float(ts))
+        store.ingest("err_total", 1.0 * ts, ts=float(ts))
+    for ts in (80, 90, 100):
+        store.ingest("req_total", 200.0 + ts, ts=float(ts))
+        store.ingest("err_total", 30.0, ts=float(ts))
+    clock.t = 100.0
+    assert mgr.evaluate() == []
+    assert mgr.firing() == []
+
+
+def test_threshold_rule_validates_op_and_supports_ge_le():
+    import pytest
+
+    clock = SetClock(0.0)
+    store = TimeSeriesStore(clock=clock)
+    store.ingest("m", 5.0, ts=0.0)
+    # a typo'd op must fail at construction (rule packs load from
+    # data), never evaluate with inverted semantics
+    with pytest.raises(ValueError):
+        ThresholdRule(name="t-bad-op", metric="m", op="=>")
+    with pytest.raises(ValueError):
+        rule_from_dict({"kind": "threshold", "name": "t-bad-op2",
+                        "metric": "m", "op": ">>"})
+    ge = ThresholdRule(name="t-ge", metric="m", op=">=", threshold=5.0)
+    active, value, _ = ge.evaluate(store, 0.0)
+    assert active and value == 5.0
+    le = ThresholdRule(name="t-le", metric="m", op="<=", threshold=5.0)
+    active, _, _ = le.evaluate(store, 0.0)
+    assert active
+
+
+def test_metrics_query_rejects_non_finite_range_params():
+    clock = SetClock(100.0)
+    store = TimeSeriesStore(clock=clock)
+    store.ingest("m", 1.0, ts=100.0)
+    api = _api(store=store)
+    for qs in ("start=0&end=1e300&step=1e-300",   # ratio overflows
+               "start=nan&end=nan",               # NaN slips comparisons
+               "start=0&end=inf"):
+        code, _ = api.handle(
+            f"GET", f"/api/metrics/query?metric=m&func=instant&{qs}",
+            None)
+        assert code == 400, qs
+    code, _ = api.handle(
+        "GET", "/api/metrics/query?metric=m&func=rate&window=inf", None)
+    assert code == 400
+
+
+def test_alert_exemplar_never_survives_the_incident():
+    """A later firing (or an Inactive rule) must not link to a previous
+    incident's trace id."""
+    clock = SetClock(0.0)
+    store = TimeSeriesStore(clock=clock, staleness_s=10 ** 6)
+    rule = ThresholdRule(name="t-ex-stale", metric="lat",
+                         func="quantile", quantile=0.99, window_s=30.0,
+                         op=">", threshold=0.5)
+    mgr = AlertManager(store, [rule], clock=clock)
+    # incident 1: slow bucket increase with an exemplar
+    _ingest_buckets(store, 0.0, {"1": 0, "+Inf": 0})
+    store.ingest("lat_bucket", 4.0, labels={"le": "1"}, ts=10.0)
+    store.ingest("lat_bucket", 4.0, labels={"le": "+Inf"}, ts=10.0,
+                 exemplar=Exemplar("incident-one", 0.9, 10.0))
+    clock.t = 10.0
+    mgr.evaluate()
+    assert mgr.status()["rules"][0]["exemplarTraceId"] == "incident-one"
+    # resolve (window slides past the increase), then idle
+    clock.t = 100.0
+    mgr.evaluate()           # Firing -> Resolved
+    clock.t = 110.0
+    mgr.evaluate()           # Resolved -> Inactive housekeeping
+    assert mgr.status()["rules"][0]["state"] == INACTIVE
+    assert mgr.status()["rules"][0]["exemplarTraceId"] is None
+    # incident 2 fires with NO exemplar available: no stale link
+    store.ingest("lat_bucket", 4.0, labels={"le": "1"}, ts=190.0)
+    store.ingest("lat_bucket", 8.0, labels={"le": "1"}, ts=200.0)
+    store.ingest("lat_bucket", 4.0, labels={"le": "+Inf"}, ts=190.0)
+    store.ingest("lat_bucket", 8.0, labels={"le": "+Inf"}, ts=200.0)
+    clock.t = 200.0
+    mgr.evaluate()
+    st = mgr.status()["rules"][0]
+    assert st["state"] == FIRING
+    assert st["exemplarTraceId"] is None
+
+
+def test_scraper_survives_raising_registry():
+    clock = SetClock(0.0)
+    store = TimeSeriesStore(clock=clock)
+
+    class BadRegistry:
+        def expose(self, exemplars=True):
+            raise RuntimeError("boom")
+
+    good = Registry()
+    good.gauge("g", "h").set(1.0)
+    s = Scraper(store, targets={"remote": "http://r:1/metrics"},
+                registries={"bad": BadRegistry(), "local": good},
+                clock=clock, fetch=lambda url: good.expose())
+    results = s.tick()
+    # the bad registry reads as down; everything else still scrapes
+    assert results == {"bad": False, "local": True, "remote": True}
+    ups = dict((labels["target"], p.value)
+               for labels, p in store.latest("up"))
+    assert ups == {"bad": 0.0, "local": 1.0, "remote": 1.0}
+
+
+def test_scrape_targets_honors_deployment_component_set():
+    """With a config that enables components, exactly the deployed set
+    is rendered — with its param overrides (a port override reaches the
+    target URL; a disabled component never becomes a dead target)."""
+    from kubeflow_tpu.config.deployment import ComponentSpec, DeploymentConfig
+    from kubeflow_tpu.manifests.components.monitoring import scrape_targets
+
+    cfg = DeploymentConfig(name="pin", components=[
+        ComponentSpec("trace-collector", params={"port": 9999}),
+        ComponentSpec("monitoring"),
+    ])
+    targets = scrape_targets(cfg)
+    assert targets == {
+        "trace-collector": "http://trace-collector:9999/metrics"}
+
+
+def test_rule_from_dict_round_trip():
+    rules = default_rules()
+    for rule in rules:
+        clone = rule_from_dict(rule.to_dict())
+        assert clone == rule
+
+
+def test_default_rules_reference_real_series():
+    """The starter pack's metric names must match what the emitting
+    modules actually register — a renamed gauge must fail here, not
+    fire never."""
+    import kubeflow_tpu.edge.proxy  # noqa: F401
+    import kubeflow_tpu.scheduler.queue  # noqa: F401
+    import kubeflow_tpu.serving.engine  # noqa: F401
+    import kubeflow_tpu.operators.tpujob  # noqa: F401
+    from kubeflow_tpu.obs.steps import StepTelemetry
+    from kubeflow_tpu.utils import DEFAULT_REGISTRY
+
+    step_reg = Registry()
+    StepTelemetry(registry=step_reg, use_cost_analysis=False)
+    known = set(DEFAULT_REGISTRY._metrics) | set(step_reg._metrics)
+    for rule in default_rules():
+        if isinstance(rule, ThresholdRule):
+            assert rule.metric in known, rule.name
+        elif isinstance(rule, BurnRateRule):
+            # _count series come from a histogram of the base name
+            for m in (rule.numerator, rule.denominator):
+                base = m[:-len("_count")] if m.endswith("_count") else m
+                assert base in known, rule.name
+
+
+def test_alert_controller_runs_on_shared_runtime():
+    import time as _time
+
+    clock = SetClock(0.0)
+    store = TimeSeriesStore(clock=clock)
+    collector = SpanCollector()
+    rule = ThresholdRule(name="t-ctl", metric="m", op=">", threshold=0.5)
+    mgr = AlertManager(store, [rule], clock=clock,
+                       tracer=Tracer(collector, clock=clock))
+    store.ingest("m", 2.0, ts=0.0)
+    ctrl = mgr.build_controller(interval_s=0.01)
+    ctrl.start()
+    try:
+        deadline = _time.monotonic() + 5.0
+        while _time.monotonic() < deadline and not mgr.firing():
+            _time.sleep(0.01)
+        assert mgr.firing() == ["t-ctl"]
+        assert any(s.name == "controller.reconcile"
+                   and s.attrs.get("controller") == "alerts"
+                   for s in collector.spans())
+    finally:
+        ctrl.stop()
+
+
+def test_scraper_controller_runs_on_shared_runtime():
+    import time as _time
+
+    clock = SetClock(0.0)
+    store = TimeSeriesStore(clock=clock)
+    reg = Registry()
+    reg.gauge("g", "h").set(1.0)
+    s = Scraper(store, targets={}, registries={"local": reg}, clock=clock)
+    ctrl = s.build_controller(interval_s=0.01)
+    ctrl.start()
+    try:
+        deadline = _time.monotonic() + 5.0
+        while _time.monotonic() < deadline and not store.latest("g"):
+            _time.sleep(0.01)
+        assert store.latest("g")[0][1].value == 1.0
+    finally:
+        ctrl.stop()
+
+
+# -- dashboard routes --------------------------------------------------------
+
+
+def _api(store=None, alerts=None, collector=None):
+    return DashboardApi(FakeKubeClient(),
+                        metrics=RegistryMetricsService(Registry()),
+                        collector=collector or SpanCollector(),
+                        tsdb=store, alerts=alerts)
+
+
+def test_metrics_query_requires_store_and_metric():
+    api = _api()
+    code, body = api.handle("GET", "/api/metrics/query?metric=x", None)
+    assert code == 410
+    clock = SetClock(0.0)
+    api = _api(store=TimeSeriesStore(clock=clock))
+    code, body = api.handle("GET", "/api/metrics/query", None)
+    assert code == 400
+    code, body = api.handle(
+        "GET", "/api/metrics/query?metric=x&func=nope", None)
+    assert code == 400
+
+
+def test_metrics_query_instant_rate_and_labels():
+    clock = SetClock(100.0)
+    store = TimeSeriesStore(clock=clock)
+    for ts in (40, 70, 100):
+        store.ingest("c_total", float(ts), ts=float(ts),
+                     labels={"code": "200"})
+        store.ingest("c_total", 2.0 * ts, ts=float(ts),
+                     labels={"code": "503"})
+    api = _api(store=store)
+    code, body = api.handle(
+        "GET", "/api/metrics/query?metric=c_total&func=rate&window=60"
+               "&label=code:5*", None)
+    assert code == 200
+    assert body["func"] == "rate"
+    [row] = body["result"]
+    assert row["labels"] == {"code": "503"}
+    assert row["value"] == (200.0 - 80.0) / 60.0
+    code, body = api.handle(
+        "GET", "/api/metrics/query?metric=c_total&func=instant", None)
+    assert code == 200
+    assert {tuple(r["labels"].items()): r["value"]
+            for r in body["result"]} == {
+        (("code", "200"),): 100.0, (("code", "503"),): 200.0}
+
+
+def test_metrics_query_range_mode():
+    clock = SetClock(100.0)
+    store = TimeSeriesStore(clock=clock)
+    for ts in range(0, 101, 10):
+        store.ingest("c_total", float(ts), ts=float(ts))
+    api = _api(store=store)
+    code, body = api.handle(
+        "GET", "/api/metrics/query?metric=c_total&func=rate&window=30"
+               "&start=40&end=100&step=20", None)
+    assert code == 200
+    [row] = body["result"]
+    # rate is 1.0 unit/s throughout; four evaluation steps
+    assert [p[0] for p in row["points"]] == [40.0, 60.0, 80.0, 100.0]
+    assert all(abs(p[1] - 1.0) < 1e-9 for p in row["points"])
+
+
+def test_metrics_query_rejects_bad_quantile_and_dense_ranges():
+    clock = SetClock(100.0)
+    store = TimeSeriesStore(clock=clock)
+    store.ingest("m", 1.0, ts=100.0)
+    api = _api(store=store)
+    # out-of-range q is a 400 like every other bad param, never a 500
+    code, body = api.handle(
+        "GET", "/api/metrics/query?metric=m&func=quantile&q=1.5", None)
+    assert code == 400
+    # a tiny step over a wide range must not spin the handler
+    code, body = api.handle(
+        "GET", "/api/metrics/query?metric=m&func=instant"
+               "&start=0&end=1000000&step=0.001", None)
+    assert code == 400
+    assert "dense" in body["error"]
+
+
+def test_parse_prom_handles_exemplars_and_nasty_labels():
+    from kubeflow_tpu.dashboard.server import _parse_prom
+
+    h = Histogram("kftpu_x_seconds", "h", buckets=[0.5])
+    h.observe(0.1, exemplar_trace_id="abc", route='a # b "q" \\ c')
+    rows = {r["metric"]: r["value"]
+            for r in _parse_prom(h.expose(), "kftpu_x_")}
+    # the exemplar-suffixed bucket line and the escaped label value
+    # both survive (the old line splitter dropped/mangled them)
+    assert any(m.startswith("kftpu_x_seconds_bucket{") and v == 1.0
+               for m, v in rows.items())
+    assert any('le="+Inf"' in m for m in rows)
+    assert any(m.startswith("kftpu_x_seconds_count{") and v == 1.0
+               for m, v in rows.items())
+
+
+def test_monitoring_component_renders_without_recursion():
+    """render() -> scrape_config() -> scrape_targets() must not render
+    the monitoring component again (the recursion the review caught)."""
+    from kubeflow_tpu.config.deployment import ComponentSpec, DeploymentConfig
+    from kubeflow_tpu.manifests.registry import render_component
+
+    objs = render_component(DeploymentConfig(name="x"),
+                            ComponentSpec("monitoring"))
+    assert any(o.get("kind") == "ConfigMap" for o in objs)
+
+
+def test_scrape_config_keeps_per_path_static_jobs():
+    """A non-default prometheus.io/path must reach the static job too —
+    the manifest and the in-process scraper share one path per target."""
+    import yaml
+
+    from kubeflow_tpu.manifests.components.monitoring import scrape_config
+
+    cfg = yaml.safe_load(scrape_config("30s", {
+        "a": "http://a:1/metrics", "b": "http://b:2/custom/metrics"}))
+    static = {j["metrics_path"]: j["static_configs"][0]["targets"]
+              for j in cfg["scrape_configs"] if "static_configs" in j}
+    assert static == {"/metrics": ["a:1"],
+                      "/custom/metrics": ["b:2"]}
+
+
+def test_metrics_query_range_param_edges():
+    clock = SetClock(100.0)
+    store = TimeSeriesStore(clock=clock)
+    for ts in (40, 100):
+        store.ingest("g", float(ts), ts=float(ts))
+    api = _api(store=store)
+    # half-specified range is a 400, never silently instant mode
+    code, _ = api.handle(
+        "GET", "/api/metrics/query?metric=g&func=instant&start=40", None)
+    assert code == 400
+    code, _ = api.handle(
+        "GET", "/api/metrics/query?metric=g&func=instant&end=40", None)
+    assert code == 400
+    # start == end is exactly one evaluation point, not a doubled one
+    code, body = api.handle(
+        "GET", "/api/metrics/query?metric=g&func=instant"
+               "&start=100&end=100", None)
+    assert code == 200
+    [row] = body["result"]
+    assert row["points"] == [[100.0, 100.0]]
+    # reversed range is a 400
+    code, _ = api.handle(
+        "GET", "/api/metrics/query?metric=g&func=instant"
+               "&start=100&end=40", None)
+    assert code == 400
+
+
+def test_exposition_exemplar_opt_out():
+    """Exemplar suffixes are a private extension: the classic 0.0.4
+    parser (the deployed prometheus) errors on tokens after the value,
+    so one exemplar must never poison a standard scrape."""
+    r = Registry()
+    h = r.histogram("lat_seconds", "h", buckets=[0.5])
+    h.observe(0.1, exemplar_trace_id="abc")
+    assert " # {" in r.expose()                     # default: in-process
+    plain = r.expose(exemplars=False)
+    assert " # {" not in plain                      # 0.0.4-safe
+    # and the plain shape still parses identically minus exemplars
+    assert [(s.name, s.value) for s in parse_exposition(plain)] == [
+        (s.name, s.value) for s in parse_exposition(r.expose())]
+
+
+def test_metrics_endpoints_gate_exemplars_on_extension_header():
+    """Every exposition endpoint: clean 0.0.4 for a standard scraper
+    (incl. a real prometheus sending its OpenMetrics Accept header —
+    our exposition is NOT spec-valid OpenMetrics, so claiming that
+    content type would fail its strict parser), exemplars only for a
+    scraper sending the extension header (ours does by default)."""
+    import urllib.request
+
+    from kubeflow_tpu.utils.metrics import EXEMPLARS_HEADER, serve_metrics
+
+    r = Registry()
+    h = r.histogram("lat_seconds", "h", buckets=[0.5])
+    h.observe(0.1, exemplar_trace_id="abc")
+    t = serve_metrics(0, r)
+    try:
+        port = t.server.server_address[1]
+        url = f"http://127.0.0.1:{port}/metrics"
+        # a real prometheus scrape: OM Accept header, no extension
+        req = urllib.request.Request(url, headers={
+            "Accept": "application/openmetrics-text;version=1.0.0,"
+                      "text/plain;version=0.0.4;q=0.5"})
+        with urllib.request.urlopen(req) as resp:
+            assert "0.0.4" in resp.headers["Content-Type"]
+            assert " # {" not in resp.read().decode()
+        req = urllib.request.Request(url,
+                                     headers={EXEMPLARS_HEADER: "1"})
+        with urllib.request.urlopen(req) as resp:   # our scraper
+            assert " # {" in resp.read().decode()
+        # the in-process Scraper's default fetch sends the header
+        store = TimeSeriesStore(clock=SetClock(0.0))
+        Scraper(store, targets={"t": url}, clock=SetClock(0.0)).tick()
+        assert store.exemplars("lat_seconds_bucket")
+    finally:
+        t.server.shutdown()
+
+    # the trace-collector service's /metrics applies the same policy
+    from kubeflow_tpu.obs.service import TraceCollectorService
+
+    svc = TraceCollectorService(SpanCollector(), registry=r)
+    code, raw = svc.handle("GET", "/metrics", None, "")
+    assert code == 200 and b" # {" not in raw.data
+    code, raw = svc.handle("GET", "/metrics", None, "",
+                           {EXEMPLARS_HEADER: "1"})
+    assert code == 200 and b" # {" in raw.data
+
+
+def test_alerts_route_with_and_without_manager():
+    api = _api()
+    code, body = api.handle("GET", "/api/alerts", None)
+    assert code == 200
+    assert "metrics" in body  # registry fallback shape
+    clock = SetClock(0.0)
+    store = TimeSeriesStore(clock=clock)
+    mgr = AlertManager(store, [ThresholdRule(
+        name="t-route", metric="m", op=">", threshold=0.0)], clock=clock)
+    store.ingest("m", 1.0, ts=0.0)
+    mgr.evaluate()
+    api = _api(store=store, alerts=mgr)
+    code, body = api.handle("GET", "/api/alerts", None)
+    assert code == 200
+    assert body["firing"] == 1
+    assert body["rules"][0]["rule"] == "t-route"
+    assert body["rules"][0]["state"] == FIRING
+
+
+# -- predictor-from-tsdb satellite -------------------------------------------
+
+
+def test_operator_feeds_predictor_from_tsdb_series():
+    from kubeflow_tpu.operators.tpujob import TpuJobOperator
+
+    clock = SetClock(100.0)
+    store = TimeSeriesStore(clock=clock)
+    client = FakeKubeClient()
+    op = TpuJobOperator(client, tsdb=store, tsdb_window_s=60.0)
+    # no series yet: the CR-status value passes through unchanged
+    assert op._predictor_rate("ns", "job", 5.0) == 5.0
+    for ts, v in [(50, 2.0), (80, 4.0), (100, 6.0)]:
+        store.ingest("kftpu_job_steps_per_sec", v, ts=float(ts),
+                     labels={"namespace": "ns", "job": "job"})
+    # windowed average smooths reconcile-timing jitter
+    assert op._predictor_rate("ns", "job", 5.0) == (2.0 + 4.0 + 6.0) / 3.0
+    # other jobs' series never leak in
+    assert op._predictor_rate("ns", "other", 7.0) == 7.0
+    # a store without positive in-window points falls back too
+    store.ingest("kftpu_job_steps_per_sec", 0.0, ts=100.0,
+                 labels={"namespace": "ns", "job": "idle"})
+    assert op._predictor_rate("ns", "idle", 3.0) == 3.0
+
+
+def test_operator_predictor_rate_reaches_queue_observe():
+    from kubeflow_tpu.obs.steps import publish_beacon
+    from kubeflow_tpu.operators.tpujob import TpuJobOperator, TpuJobSpec
+
+    class RecordingPredictor:
+        def __init__(self):
+            self.seen = []
+
+        def observe(self, ns, name, **kw):
+            self.seen.append((ns, name, kw))
+
+    class StubQueue:
+        def __init__(self):
+            self.predictor = RecordingPredictor()
+
+    clock = SetClock(100.0)
+    store = TimeSeriesStore(clock=clock)
+    client = FakeKubeClient()
+    queue = StubQueue()
+    op = TpuJobOperator(client, queue=queue, tsdb=store,
+                        tsdb_window_s=60.0)
+    publish_beacon(client, "ns", "tr", 0,
+                   {"step": 50, "stepsPerSec": 9.0})
+    for ts, v in [(60, 2.0), (100, 4.0)]:
+        store.ingest("kftpu_job_steps_per_sec", v, ts=float(ts),
+                     labels={"namespace": "ns", "job": "tr"})
+    spec = TpuJobSpec.from_dict({"image": "img"})
+    view = op._job_telemetry("ns", "tr", spec)
+    assert view["stepsPerSec"] == 9.0  # the status view stays live
+    [(ns, name, kw)] = queue.predictor.seen
+    assert (ns, name) == ("ns", "tr")
+    assert kw["steps_per_sec"] == 3.0  # but the predictor eats the series
+
+
+# -- the acceptance test -----------------------------------------------------
+
+
+def test_monitoring_acceptance_end_to_end():
+    """ISSUE 9 acceptance: one fake clock drives sampling, scraping,
+    querying, burn-rate alerting, and exemplar->trace resolution."""
+    clock = SetClock(0.0)
+    collector = SpanCollector()
+    tracer = Tracer(collector, clock=clock)
+
+    # the "local" component: an edge-proxy-shaped registry
+    edge_reg = Registry()
+    lat = edge_reg.histogram("request_latency_seconds", "edge latency",
+                             buckets=(0.1, 0.5, 2.0))
+    # the second component, reachable only over HTTP (faked)
+    engine_reg = Registry()
+    engine_reg.gauge("kftpu_engine_kv_pages_free", "free pages").set(
+        64.0, model="m")
+
+    store = TimeSeriesStore(clock=clock)
+    scraper = Scraper(store,
+                      targets={"engine": "http://engine:8500/metrics"},
+                      registries={"edge": edge_reg},
+                      clock=clock,
+                      fetch=lambda url: engine_reg.expose())
+
+    kube = FakeKubeClient()
+    burn = BurnRateRule(
+        name="acc-slo-burn",
+        numerator="request_latency_seconds_count",
+        numerator_labels={"code": "5*"},
+        denominator="request_latency_seconds_count",
+        objective=0.99,
+        windows=(BurnWindow(60.0, 20.0, 2.0),),
+        for_s=20.0,
+        summary="edge 5xx burn")
+    p99 = ThresholdRule(
+        name="acc-p99-latency",
+        metric="request_latency_seconds",
+        func="quantile", quantile=0.99, window_s=60.0,
+        op=">", threshold=0.5, for_s=0.0,
+        summary="edge p99 high")
+    mgr = AlertManager(store, [burn, p99], client=kube,
+                       namespace="monitoring", clock=clock,
+                       tracer=Tracer(collector, clock=clock))
+    api = DashboardApi(kube, metrics=RegistryMetricsService(Registry()),
+                       collector=collector, tsdb=store, alerts=mgr)
+
+    def serve(n_ok=10, n_5xx=0, slow=False):
+        slow_tid = None
+        for _ in range(n_ok):
+            with tracer.span("edge.request",
+                             attrs={"route": "/predict"}) as sp:
+                lat.observe(0.05, exemplar_trace_id=sp.trace_id,
+                            route="/predict", code="200")
+        for _ in range(n_5xx):
+            with tracer.span("edge.request",
+                             attrs={"route": "/predict"}) as sp:
+                lat.observe(0.02, exemplar_trace_id=sp.trace_id,
+                            route="/predict", code="503")
+        if slow:
+            with tracer.span("edge.request",
+                             attrs={"route": "/predict"}) as sp:
+                slow_tid = sp.trace_id
+                lat.observe(1.2, exemplar_trace_id=sp.trace_id,
+                            route="/predict", code="200")
+        return slow_tid
+
+    def tick(t, **kw):
+        clock.t = t
+        tid = serve(**kw)
+        scraper.tick()
+        mgr.evaluate()
+        return tid
+
+    # phase 1: healthy traffic, t=0..100, scrape every 10s
+    for i in range(11):
+        tick(float(i * 10))
+    assert mgr.firing() == []
+
+    # rate() over the window, through the dashboard query API:
+    # 10 requests per 10s tick -> exactly 1.0/s
+    code, body = api.handle(
+        "GET", "/api/metrics/query?metric=request_latency_seconds_count"
+               "&func=rate&window=60&label=target:edge", None)
+    assert code == 200
+    [row] = body["result"]
+    assert row["labels"] == {"code": "200", "route": "/predict",
+                             "target": "edge"}
+    assert abs(row["value"] - 1.0) < 1e-9
+
+    # histogram_quantile() over the window: every observation is 0.05,
+    # all mass in the first bucket [0, 0.1] -> q=0.5 lands at 0.05
+    code, body = api.handle(
+        "GET", "/api/metrics/query?metric=request_latency_seconds"
+               "&func=quantile&q=0.5&window=60&label=target:edge", None)
+    assert code == 200
+    [row] = body["result"]
+    assert abs(row["value"] - 0.05) < 1e-9
+    assert body["exemplars"]  # buckets carried trace ids
+
+    # the scraped second component answers instant queries
+    code, body = api.handle(
+        "GET", "/api/metrics/query?metric=kftpu_engine_kv_pages_free"
+               "&func=instant&label=target:engine", None)
+    assert code == 200
+    [row] = body["result"]
+    assert row["value"] == 64.0
+    assert row["labels"]["model"] == "m"
+
+    # phase 2: 5xx burst + one slow request
+    tick(110.0, n_ok=5, n_5xx=5)
+    states = {r["rule"]: r["state"] for r in mgr.status()["rules"]}
+    assert states["acc-slo-burn"] == INACTIVE  # one 5xx point: no rate yet
+    tick(120.0, n_ok=5, n_5xx=5)
+    states = {r["rule"]: r["state"] for r in mgr.status()["rules"]}
+    assert states["acc-slo-burn"] == PENDING
+    slow_tid = tick(130.0, n_ok=5, n_5xx=5, slow=True)
+    assert slow_tid is not None
+    tick(140.0, n_ok=5, n_5xx=5)
+    states = {r["rule"]: r["state"] for r in mgr.status()["rules"]}
+    assert states["acc-slo-burn"] == FIRING
+    assert states["acc-p99-latency"] == FIRING
+    from kubeflow_tpu.obs import alerts as alerts_mod
+
+    assert alerts_mod._firing_g.get(rule="acc-slo-burn") == 1.0
+
+    # the fired latency alert carries the slow request's exemplar...
+    p99_state = {r["rule"]: r for r in mgr.status()["rules"]}[
+        "acc-p99-latency"]
+    assert p99_state["exemplarTraceId"] == slow_tid
+    # ...and GET /api/alerts serves it
+    code, body = api.handle("GET", "/api/alerts", None)
+    assert code == 200
+    served = {r["rule"]: r for r in body["rules"]}
+    assert served["acc-p99-latency"]["exemplarTraceId"] == slow_tid
+
+    # ...which resolves via GET /api/traces/<id> to the span that
+    # observed the slow request
+    code, body = api.handle("GET", f"/api/traces/{slow_tid}", None)
+    assert code == 200
+    assert body["trace_id"] == slow_tid
+    assert any(s["name"] == "edge.request" for s in body["spans"])
+
+    # phase 3: the bleeding stops; the short window clears first and
+    # the burn rule resolves even while the long window still remembers
+    for t in (150.0, 160.0, 170.0):
+        tick(t)
+    states = {r["rule"]: r["state"] for r in mgr.status()["rules"]}
+    assert states["acc-slo-burn"] in (RESOLVED, INACTIVE)
+    assert alerts_mod._firing_g.get(rule="acc-slo-burn") == 0.0
+
+    # exactly one Event per burn-rule transition
+    ev = _events(kube, "monitoring")
+    burn_events = {reason: [e for e in evs
+                            if "acc-slo-burn" in e["message"]]
+                   for reason, evs in ev.items()}
+    assert len(burn_events.get("AlertPending", [])) == 1
+    assert len(burn_events.get("AlertFiring", [])) == 1
+    assert len(burn_events.get("AlertResolved", [])) == 1
+
+    # the up series covered both scrape modes the whole run
+    ups = dict((labels["target"], p.value)
+               for labels, p in store.latest("up"))
+    assert ups == {"edge": 1.0, "engine": 1.0}
