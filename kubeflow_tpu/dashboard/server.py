@@ -679,11 +679,22 @@ class DashboardApi:
                     or telemetry_view({}, straggler_k))
         trace_id, _ = tpujob_trace_ids(
             ns, name, job.get("metadata", {}).get("uid", ""))
+        resize = dict(status.get("resize") or {})
         return 200, {
             "name": name,
             "namespace": ns,
             "phase": status.get("phase", "Pending"),
             "restarts": status.get("restarts", 0),
+            # elastic-resize visibility (docs/ELASTIC.md): how many
+            # resizes this run survived, whether one is in flight, and
+            # the step it resumed from (kftpu_job_resizes_total is the
+            # fleet-level twin in the metrics registry/tsdb)
+            "resizes": {
+                "count": int(resize.get("count", 0) or 0),
+                "inProgress": bool(resize.get("requested")),
+                "direction": resize.get("direction"),
+                "lastCheckpointStep": resize.get("lastCheckpointStep"),
+            },
             "traceId": trace_id,
             **view,
         }

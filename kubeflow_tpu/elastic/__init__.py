@@ -1,0 +1,37 @@
+"""Elastic training: checkpoint-reshard-resume on gang resize.
+
+The resize engine that takes a live sharded run from topology A to
+topology B with the step clock intact (docs/ELASTIC.md):
+
+- :mod:`kubeflow_tpu.elastic.snapshot` — exactly-once resize snapshot
+  of the sharded TrainState (the PR-8 preemption-checkpoint discipline)
+  and the production :class:`~kubeflow_tpu.operators.tpujob.
+  PreemptionCheckpointer` binding over ``spec.checkpointDir``.
+- :mod:`kubeflow_tpu.elastic.reshard` — recompute the mesh for the new
+  slice count, re-derive shardings from the topology-independent
+  logical-axis rules, and restore the checkpoint directly into the new
+  shardings (no full host-RAM gather).
+- :mod:`kubeflow_tpu.elastic.coordinator` — the worker-side protocol:
+  catch the resize signal, barrier, save, re-init the distributed
+  runtime at the new world size, reshard, resume at ``step+1``.
+"""
+
+from kubeflow_tpu.elastic.coordinator import (  # noqa: F401
+    ElasticCoordinator,
+    ResizeSignal,
+    cr_resize_target,
+    install_sigterm,
+)
+from kubeflow_tpu.elastic.reshard import (  # noqa: F401
+    ReshardMismatchError,
+    abstract_target,
+    mesh_for_slices,
+    restore_resharded,
+    shard_put,
+    shardings_for,
+    validate_global_shapes,
+)
+from kubeflow_tpu.elastic.snapshot import (  # noqa: F401
+    DirCheckpointer,
+    ElasticSnapshotter,
+)

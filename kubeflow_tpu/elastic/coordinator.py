@@ -1,0 +1,330 @@
+"""Worker-side elastic protocol: signal → barrier → save → re-init → reshard.
+
+The trainer's half of an elastic resize (docs/ELASTIC.md). The operator
+edits the world (``spec.slices``), nudges (``status.resize.requested``
+on the CR, SIGTERM when it tears the gang down), and re-gangs; each
+worker runs this coordinator around its train loop:
+
+1. **catch** — :class:`ResizeSignal` latches the resize from any source:
+   :func:`install_sigterm` (the pod-deletion grace window),
+   :func:`cr_resize_target` (the status nudge, polled between steps), or
+   a direct call (tests, the in-process smoke).
+2. **barrier** — every worker must reach the same step before the
+   snapshot, or the saved state is torn (injectable; the production
+   default is a device-level sync, a single-process run no-ops).
+3. **save** — exactly one synchronous snapshot at the current step
+   (:class:`~kubeflow_tpu.elastic.snapshot.ElasticSnapshotter`).
+4. **re-init** — tear down and re-enter ``jax.distributed`` at the new
+   world size (injectable; in production the process usually *exits*
+   here instead and the re-ganged pod runs step 5 on boot — both paths
+   land in :meth:`ElasticCoordinator.resume`).
+5. **reshard + resume** — rebuild the mesh for the new slice count,
+   restore the snapshot into the new shardings
+   (:func:`~kubeflow_tpu.elastic.reshard.restore_resharded`), and
+   continue at ``step+1`` with the step clock intact.
+
+Every resize records ``elastic.snapshot`` → ``elastic.reshard`` →
+``elastic.resume`` spans under the job's identity-derived trace
+(:func:`~kubeflow_tpu.obs.steps.tpujob_trace_ids`), so the resize shows
+up in the same tree as the operator's root span and the workers'
+step spans.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+
+from kubeflow_tpu.elastic.reshard import restore_resharded
+from kubeflow_tpu.elastic.snapshot import ElasticSnapshotter
+from kubeflow_tpu.obs.steps import tpujob_trace_ids
+from kubeflow_tpu.obs.trace import SpanContext, Tracer
+from kubeflow_tpu.parallel.mesh import AxisRules, DEFAULT_RULES
+from kubeflow_tpu.utils.clock import Clock
+
+log = logging.getLogger(__name__)
+
+# SIGTERM carries no target topology — the re-ganged process learns its
+# new world from the operator's refreshed env contract. The sentinel
+# means "snapshot and stop; do not reshard in-process".
+SHUTDOWN = 0
+
+
+class ResizeSignal:
+    """Thread-safe latch for one pending resize.
+
+    ``request(n)`` arms it with the target slice count (or
+    :data:`SHUTDOWN`); the train loop polls :meth:`pending` between
+    steps and :meth:`clear`s after the reshard. Latest request wins —
+    a grow nudge arriving while a shrink is still latched supersedes
+    it (the operator's spec is the single source of truth)."""
+
+    def __init__(self) -> None:
+        self._target: Optional[int] = None
+        self._lock = threading.Lock()
+
+    def request(self, n_slices: int) -> None:
+        with self._lock:
+            self._target = int(n_slices)
+
+    def pending(self) -> Optional[int]:
+        with self._lock:
+            return self._target
+
+    def clear(self, if_target: Optional[int] = None) -> None:
+        """Unlatch. With ``if_target``, clear only if the latched value
+        is still that target (compare-and-clear): a NEWER request — a
+        SIGTERM landing while the handled resize was mid-reshard — must
+        survive to be handled on the next poll, not be wiped by the
+        completion of the one it superseded."""
+        with self._lock:
+            if if_target is None or self._target == if_target:
+                self._target = None
+
+
+def install_sigterm(signal_obj: ResizeSignal) -> None:
+    """Latch :data:`SHUTDOWN` on SIGTERM — the operator's teardown sends
+    it to every pod, and the grace period is the snapshot window."""
+    import signal as _signal
+
+    def handler(_signum, _frame):  # noqa: ANN001
+        log.info("SIGTERM: latching elastic shutdown snapshot")
+        signal_obj.request(SHUTDOWN)
+
+    _signal.signal(_signal.SIGTERM, handler)
+
+
+def cr_resize_target(client: Any, ns: str, name: str) -> Optional[int]:
+    """The ``status.resize.requested`` nudge, resolved to a target slice
+    count from the (already-edited) ``spec.slices``. None = no resize
+    pending. This is the poll a worker runs between steps when it wants
+    to resize in-place instead of waiting for SIGTERM."""
+    from kubeflow_tpu.manifests.components.tpujob_operator import (
+        API_VERSION,
+        TPUJOB_KIND,
+    )
+
+    job = client.get_or_none(API_VERSION, TPUJOB_KIND, ns, name)
+    if job is None:
+        return None
+    resize = (job.get("status", {}) or {}).get("resize") or {}
+    if not resize.get("requested"):
+        return None
+    try:
+        return int((job.get("spec", {}) or {}).get("slices", 0)) or None
+    except (TypeError, ValueError):
+        return None
+
+
+def _default_barrier() -> None:
+    """Device-level sync: everything dispatched is done on every host
+    before the snapshot reads the state. Single-process (tests, CPU)
+    this is effectively free."""
+    try:
+        (jax.device_put(0) + 0).block_until_ready()
+    except Exception:  # noqa: BLE001 — a barrier miss degrades, the
+        log.debug("barrier degraded", exc_info=True)  # save still runs
+
+
+def _default_reinit(n_slices: int) -> None:
+    """Re-enter ``jax.distributed`` at the new world size from the
+    refreshed env contract. Outside a distributed run (no client
+    initialized) this is a no-op — the CPU tier reshards in-process."""
+    try:
+        from jax._src import distributed as _dist_state
+
+        if getattr(_dist_state.global_state, "client", None) is None:
+            return
+    except Exception:  # noqa: BLE001 — probe only
+        return
+    from kubeflow_tpu.parallel import distributed as dist
+
+    try:
+        jax.distributed.shutdown()
+    except Exception:  # noqa: BLE001 — half-down is re-initializable
+        log.debug("jax.distributed shutdown raced", exc_info=True)
+    dist.initialize()
+
+
+class ElasticCoordinator:
+    """Drives one worker's train loop through resizes.
+
+    Everything is injectable (clock, tracer, barrier, distributed
+    re-init, mesh factory) so the whole protocol runs deterministically
+    on the CPU tier; production wiring is the defaults.
+
+    - ``manager``: :class:`~kubeflow_tpu.train.checkpoint.
+      CheckpointManager` over the job's ``spec.checkpointDir``.
+    - ``init_fn(rng)``: builds the fresh TrainState (the trainer
+      contract) — used abstractly to derive shapes/shardings.
+    - ``make_step(mesh)``: builds the jitted step for a mesh (a
+      :mod:`kubeflow_tpu.train.trainer` factory).
+    - ``mesh_factory(n_slices)``: the topology map — defaults to
+      :func:`~kubeflow_tpu.elastic.reshard.mesh_for_slices` over all
+      visible devices; the CPU tier passes a factory slicing the
+      virtual device list.
+    """
+
+    def __init__(
+        self,
+        *,
+        manager: Any,
+        init_fn: Callable[[Any], Any],
+        make_step: Callable[[Any], Callable[..., Any]],
+        mesh_factory: Optional[Callable[[int], Any]] = None,
+        rules: AxisRules = DEFAULT_RULES,
+        axes_fn: Any = None,
+        signal: Optional[ResizeSignal] = None,
+        barrier: Optional[Callable[[], None]] = None,
+        reinit: Optional[Callable[[int], None]] = None,
+        clock: Optional[Clock] = None,
+        tracer: Optional[Tracer] = None,
+        job: str = "",
+        namespace: str = "default",
+        uid: str = "",
+        rng: Optional[Any] = None,
+    ) -> None:
+        if mesh_factory is None:
+            from kubeflow_tpu.elastic.reshard import mesh_for_slices
+
+            mesh_factory = lambda n: mesh_for_slices(n)  # noqa: E731
+        self.manager = manager
+        self.init_fn = init_fn
+        self.make_step = make_step
+        self.mesh_factory = mesh_factory
+        self.rules = rules
+        self.axes_fn = axes_fn
+        self.signal = signal if signal is not None else ResizeSignal()
+        self.barrier = barrier if barrier is not None else _default_barrier
+        self.reinit = reinit if reinit is not None else _default_reinit
+        # wall clock (StepTelemetry's reasoning): the resize spans join
+        # the operator's epoch-domain root span in one tree
+        self.clock: Clock = clock if clock is not None else time.time
+        self.tracer = tracer if tracer is not None else Tracer(
+            clock=self.clock)
+        self.trace_id, self.root_span_id = tpujob_trace_ids(
+            namespace, job, uid)
+        self._rng = rng if rng is not None else jax.random.key(0)
+        self.snapshotter = ElasticSnapshotter(manager)
+        self.resizes = 0
+        self.n_slices: Optional[int] = None
+        self.mesh: Optional[Any] = None
+        self.step_fn: Optional[Callable[..., Any]] = None
+        self.step: int = 0
+
+    # -- spans -------------------------------------------------------------
+
+    def _span(self, name: str, start: float,
+              attrs: Dict[str, Any]) -> None:
+        self.tracer.record(
+            name, start=start, end=self.clock(),
+            parent=SpanContext(self.trace_id, self.root_span_id),
+            attrs=attrs)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, n_slices: int) -> Tuple[Any, int]:
+        """Boot at ``n_slices``: restore-or-init INTO the topology's
+        shardings and return ``(state, start_step)``. This is both the
+        first boot and the re-ganged resume — one code path, exactly the
+        ``restore_or_init`` restart contract, but the restore target
+        carries the new mesh's shardings so a checkpoint written on a
+        different topology reshards on the way in."""
+        self.n_slices = n_slices
+        self.mesh = self.mesh_factory(n_slices)
+        self.step_fn = self.make_step(self.mesh)
+        abstract = jax.eval_shape(self.init_fn, self._rng)
+        latest = self.manager.latest_step()
+        if latest is None:
+            from kubeflow_tpu.train.trainer import create_sharded_state
+
+            state, _ = create_sharded_state(
+                self.init_fn, self._rng, self.mesh, self.rules)
+            self.step = 0
+            return state, 0
+        t0 = self.clock()
+        state = restore_resharded(self.manager, abstract, self.mesh,
+                                  self.rules, step=latest,
+                                  axes_fn=self.axes_fn)
+        self.step = latest
+        self._span("elastic.resume", t0,
+                   {"step": latest + 1, "slices": n_slices})
+        log.info("elastic resume at step %d on %d slice(s)", latest + 1,
+                 n_slices)
+        return state, latest
+
+    def maybe_resize(self, state: Any) -> Tuple[Any, bool]:
+        """Between-steps check: no signal → ``(state, False)``.
+
+        On a latched resize: barrier, snapshot at the current step,
+        re-init the distributed runtime, rebuild mesh + step fn, restore
+        into the new shardings. Returns ``(resharded_state, True)`` —
+        the loop continues at ``self.step + 1``. A :data:`SHUTDOWN`
+        signal snapshots and raises :class:`SystemExit` (the re-ganged
+        pod resumes via :meth:`start`)."""
+        target = self.signal.pending()
+        if target is None:
+            return state, False
+        if target == self.n_slices:
+            # already at the target (the CR nudge keeps reporting the
+            # resize until the operator closes it; an in-place reshard
+            # satisfied it already): a no-op, NOT another
+            # snapshot-restore cycle per poll
+            self.signal.clear(if_target=target)
+            return state, False
+        from_slices = self.n_slices
+        t0 = self.clock()
+        self.barrier()
+        self.snapshotter.snapshot(self.step, state)
+        self._span("elastic.snapshot", t0,
+                   {"step": self.step, "fromSlices": from_slices,
+                    "toSlices": target})
+        if target == SHUTDOWN:
+            log.info("elastic shutdown: snapshot landed at step %d, "
+                     "exiting for re-gang", self.step)
+            raise SystemExit(0)
+        t1 = self.clock()
+        self.reinit(target)
+        self.mesh = self.mesh_factory(target)
+        self.step_fn = self.make_step(self.mesh)
+        abstract = jax.eval_shape(self.init_fn, self._rng)
+        state = restore_resharded(self.manager, abstract, self.mesh,
+                                  self.rules, step=self.step,
+                                  axes_fn=self.axes_fn)
+        self._span("elastic.reshard", t1,
+                   {"step": self.step, "fromSlices": from_slices,
+                    "toSlices": target})
+        self.n_slices = target
+        self.resizes += 1
+        # compare-and-clear: a newer signal (a SHUTDOWN racing this
+        # reshard) stays latched for the next between-steps poll
+        self.signal.clear(if_target=target)
+        t2 = self.clock()
+        self._span("elastic.resume", t2,
+                   {"step": self.step + 1, "slices": target})
+        log.info("elastic resize %s -> %s slices at step %d",
+                 from_slices, target, self.step)
+        return state, True
+
+    def run(self, *, total_steps: int, n_slices: int,
+            data_fn: Callable[[int], Tuple[Any, ...]],
+            on_metrics: Optional[Callable[[int, Any], None]] = None
+            ) -> Any:
+        """The whole elastic train loop (the smoke/test harness shape):
+        boot at ``n_slices``, train to ``total_steps`` checking the
+        resize signal between steps, return the final state.
+        ``data_fn(step)`` yields the step's batch args — host-side and
+        step-keyed, so the stream is identical across topologies."""
+        state, _start = self.start(n_slices)
+        while self.step < total_steps:
+            state, _resized = self.maybe_resize(state)
+            step = self.step + 1
+            state, metrics = self.step_fn(state, *data_fn(step))
+            self.step = step
+            if on_metrics is not None:
+                on_metrics(step, metrics)
+        return state

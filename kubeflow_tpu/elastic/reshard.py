@@ -1,0 +1,177 @@
+"""Topology remap: rebuild the mesh, re-derive shardings, restore into them.
+
+The whole trick of elastic resize is that the sharding rules are
+*logical*: :func:`kubeflow_tpu.train.trainer.state_partition_specs` maps
+every leaf of a train state to a PartitionSpec by logical axis names
+(T5X-style rules tables, ``parallel/mesh.py:DEFAULT_RULES``) — a pure
+function of the leaf's role, never of the device count. So going from
+topology A to topology B is mechanical:
+
+1. rebuild the mesh for the new slice count (:func:`mesh_for_slices` —
+   the same ``MeshConfig(dcn=slices, ...)`` factoring the launcher
+   uses);
+2. re-apply the SAME specs on the new mesh (:func:`shardings_for` —
+   axes the smaller mesh cannot divide degrade to replication via
+   ``shape_aware_spec``, exactly as at first creation);
+3. restore the checkpoint with the new shardings as the orbax restore
+   target (:func:`restore_resharded`): every host reads only the array
+   shards it now owns — no full host-RAM gather, no resave.
+
+Global (logical) shapes are invariant across the remap; only the
+per-device tiling changes. :func:`validate_global_shapes` pins that —
+a checkpoint whose global param/opt shapes disagree with the model
+being resumed is a wrong-model restore, not a resize, and must fail
+loudly before a single step runs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+
+from kubeflow_tpu.parallel.mesh import (
+    AxisRules,
+    DEFAULT_RULES,
+    MeshConfig,
+    create_mesh,
+    logical_to_mesh_axes,
+    shape_aware_spec,
+    spec_for_mesh,
+)
+
+
+class ReshardMismatchError(ValueError):
+    """Global shapes/dtypes disagree across the topology remap."""
+
+
+def mesh_for_slices(
+    n_slices: int,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+    pp: int = 1,
+    tp: int = 1,
+) -> jax.sharding.Mesh:
+    """The training mesh for ``n_slices`` TPU slices over ``devices``.
+
+    Mirrors :func:`kubeflow_tpu.parallel.distributed.multislice_mesh`'s
+    factoring (``dcn = slices``, per-slice chips into dp × pp × tp) but
+    takes the slice count as an argument instead of the env contract —
+    this is the reshard path, where the NEW topology is decided by a
+    spec edit, not by what this process booted with. Raises
+    ``ValueError`` on a slice count the device set cannot realize
+    (non-divisible — e.g. a non-pow2 shrink on a pow2 fleet)."""
+    if n_slices < 1:
+        raise ValueError(f"n_slices must be >= 1, got {n_slices}")
+    devs = list(devices) if devices is not None else jax.devices()
+    if len(devs) % n_slices:
+        raise ValueError(
+            f"{len(devs)} devices do not divide into {n_slices} slices")
+    per_slice = len(devs) // n_slices
+    if per_slice % (pp * tp):
+        raise ValueError(
+            f"pp*tp={pp * tp} does not divide slice size {per_slice}")
+    config = MeshConfig(dcn=n_slices, dp=per_slice // (pp * tp), pp=pp,
+                        tp=tp)
+    return create_mesh(config, devices=devs)
+
+
+def shardings_for(abstract_state: Any, mesh: jax.sharding.Mesh,
+                  rules: AxisRules = DEFAULT_RULES, *,
+                  axes_fn: Any = None, pipelined: bool = False) -> Any:
+    """Per-leaf :class:`NamedSharding` for ``abstract_state`` on ``mesh``.
+
+    The topology-independent half of the remap: logical axes come from
+    ``axes_fn(path, leaf)`` (default: the trainer's transformer-aware
+    :func:`~kubeflow_tpu.train.trainer._leaf_axes` lookup), specs from
+    the rules table, and only the final ``NamedSharding`` binds a mesh.
+    Any workload with its own parameter naming (the Podracer example's
+    policy net) passes its own ``axes_fn`` and rides the same path."""
+    from jax.sharding import NamedSharding
+
+    if axes_fn is None:
+        from kubeflow_tpu.train.trainer import _leaf_axes
+
+        def axes_fn(path, leaf, _p=pipelined):  # noqa: ANN001
+            return _leaf_axes(path, leaf, _p)
+
+    def shard(path, leaf):
+        spec = spec_for_mesh(
+            logical_to_mesh_axes(axes_fn(path, leaf), rules), mesh)
+        shape = getattr(leaf, "shape", ())
+        return NamedSharding(mesh, shape_aware_spec(spec, shape, mesh))
+
+    return jax.tree_util.tree_map_with_path(shard, abstract_state)
+
+
+def abstract_target(abstract_state: Any, shardings: Any) -> Any:
+    """Sharded ``ShapeDtypeStruct`` tree — the orbax restore target.
+
+    Every leaf carries its new sharding (scalars too, replicated), so
+    the restore reads straight into the new layout instead of falling
+    back to the checkpoint's recorded — old-topology — sharding file."""
+
+    def leaf(a, s):
+        shape = getattr(a, "shape", ())
+        dtype = getattr(a, "dtype", None)
+        if dtype is None:  # non-array leaf (python int step): keep as-is
+            return a
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=s)
+
+    return jax.tree_util.tree_map(leaf, abstract_state, shardings)
+
+
+def _leaf_sig(leaf: Any) -> tuple:
+    """``(global shape, dtype name)`` — the remap-invariant view."""
+    return (tuple(getattr(leaf, "shape", ())),
+            str(getattr(leaf, "dtype", type(leaf).__name__)))
+
+
+def validate_global_shapes(expected: Any, actual: Any) -> None:
+    """Raise :class:`ReshardMismatchError` unless every leaf's global
+    shape+dtype is byte-identical across the remap (``expected`` from
+    the model being resumed, ``actual`` the restored state)."""
+    flat_w, treedef_w = jax.tree_util.tree_flatten_with_path(expected)
+    flat_g, treedef_g = jax.tree_util.tree_flatten_with_path(actual)
+    if treedef_w != treedef_g:
+        raise ReshardMismatchError(
+            f"state structure changed across reshard: {treedef_w} vs "
+            f"{treedef_g}")
+    for (path, w), (_, g) in zip(flat_w, flat_g):
+        if _leaf_sig(w) != _leaf_sig(g):
+            raise ReshardMismatchError(
+                f"global shape changed across reshard at "
+                f"{jax.tree_util.keystr(path)}: expected {_leaf_sig(w)}, "
+                f"got {_leaf_sig(g)}")
+
+
+def restore_resharded(manager: Any, abstract_state: Any,
+                      mesh: jax.sharding.Mesh,
+                      rules: AxisRules = DEFAULT_RULES, *,
+                      step: Optional[int] = None,
+                      axes_fn: Any = None,
+                      pipelined: bool = False) -> Any:
+    """Restore a checkpoint directly into the NEW topology's shardings.
+
+    ``manager`` is a :class:`~kubeflow_tpu.train.checkpoint.
+    CheckpointManager`; ``abstract_state`` the resumed model's abstract
+    train state (``jax.eval_shape(init_fn, ...)``) — its global shapes
+    are the validation oracle. Returns the restored state, every leaf
+    already living in its new per-device layout."""
+    shardings = shardings_for(abstract_state, mesh, rules,
+                              axes_fn=axes_fn, pipelined=pipelined)
+    target = abstract_target(abstract_state, shardings)
+    restored = manager.restore(target, step=step)
+    validate_global_shapes(abstract_state, restored)
+    return restored
+
+
+def shard_put(tree: Any, mesh: jax.sharding.Mesh,
+              rules: AxisRules = DEFAULT_RULES, *,
+              axes_fn: Any = None) -> Any:
+    """Place a LIVE tree onto ``mesh`` through the same spec derivation
+    the checkpoint restore uses — the no-checkpoint reshard (the
+    Podracer actors re-place the learner's current params this way when
+    the actor slice count changes)."""
+    shardings = shardings_for(tree, mesh, rules, axes_fn=axes_fn)
+    return jax.tree_util.tree_map(jax.device_put, tree, shardings)

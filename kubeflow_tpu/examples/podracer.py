@@ -1,0 +1,283 @@
+"""Podracer-style decoupled actor/learner RL — the elastic scenario proof.
+
+The Podracer architectures paper (PAPERS.md: "Podracer architectures
+for scalable Reinforcement Learning") splits an RL workload into a
+*learner* gang that owns the optimizer state and a fleet of *actor*
+slices that only hold a read-only copy of the policy — so the two scale
+independently: actors come and go with cluster weather (preemptible
+capacity, shrink offers) while the learner never restarts.
+
+That is exactly the shape the elastic plane (docs/ELASTIC.md) exists
+for, and this example proves the scenario end to end on the CPU tier:
+
+- the **learner** trains a policy net on a fixed mesh; its TrainState
+  is created once and only ever advanced by ``apply_gradients`` — the
+  acceptance assertion is that its step clock is strictly monotone and
+  its mesh is never rebuilt;
+- the **actors** run batched rollouts of a synthetic vectorized
+  environment, each actor slice on its own small mesh; when the actor
+  slice count changes (2 → 1 → 2 here — a shrink offer followed by the
+  capacity coming back), the pool rebuilds the actor meshes with
+  :func:`~kubeflow_tpu.elastic.reshard.mesh_for_slices` and re-places
+  the current policy through the SAME logical-axis reshard path the
+  checkpoint-resume uses (:func:`~kubeflow_tpu.elastic.reshard.
+  shard_put`) — no checkpoint needed, the params are live;
+- learner → actor publication is the same ``shard_put`` each time the
+  policy updates, so an actor joining after a resize sees the newest
+  weights immediately.
+
+Run: ``python -m kubeflow_tpu.examples.podracer --iterations 9``.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+
+from kubeflow_tpu.elastic.reshard import mesh_for_slices, shard_put
+from kubeflow_tpu.examples.common import log_metrics, setup_logging
+from kubeflow_tpu.parallel.mesh import mesh_context
+from kubeflow_tpu.train import TrainState
+
+OBS_DIM = 8
+N_ACTIONS = 4
+HORIZON = 16
+
+
+class Policy(nn.Module):
+    """Tiny policy net: obs -> action logits."""
+
+    hidden: int = 32
+
+    @nn.compact
+    def __call__(self, obs: jnp.ndarray) -> jnp.ndarray:
+        x = jnp.tanh(nn.Dense(self.hidden, name="body")(obs))
+        return nn.Dense(N_ACTIONS, name="head")(x)
+
+
+def policy_axes(path: Any, leaf: Any) -> tuple:
+    """Logical axes for the policy's leaves — the workload-owned half
+    of the reshard contract: 2-D kernels are ("embed", "mlp") (mlp
+    rides tp when an actor mesh has one; replicated otherwise via
+    ``shape_aware_spec``), everything else replicates."""
+    ndim = getattr(leaf, "ndim", 0)
+    if ndim == 2:
+        return ("embed", "mlp")
+    return (None,) * ndim
+
+
+def _env_params(seed: int = 7) -> Dict[str, jnp.ndarray]:
+    """A fixed synthetic MDP: linear-tanh dynamics, quadratic cost.
+    Deterministic from the seed so every actor slice (and every test
+    run) steps the identical world."""
+    k1, k2 = jax.random.split(jax.random.key(seed))
+    return {
+        "A": jax.random.normal(k1, (OBS_DIM, OBS_DIM)) * 0.3,
+        "B": jax.random.normal(k2, (N_ACTIONS, OBS_DIM)) * 0.5,
+    }
+
+
+def make_rollout(mesh: Any, apply_fn: Callable[..., Any],
+                 env: Dict[str, jnp.ndarray]) -> Callable[..., Any]:
+    """One actor slice's jitted rollout: (params, rng, s0) ->
+    (obs, actions, rewards), each ``(HORIZON, batch, ...)``."""
+
+    def rollout(params, rng, s0):
+        def step(carry, _):
+            s, r = carry
+            r, k = jax.random.split(r)
+            logits = apply_fn({"params": params}, s)
+            a = jax.random.categorical(k, logits)
+            s2 = jnp.tanh(s @ env["A"]
+                          + jax.nn.one_hot(a, N_ACTIONS) @ env["B"])
+            reward = -jnp.sum(s2 * s2, axis=-1)
+            return (s2, r), (s, a, reward)
+
+        (_, _), (obs, acts, rews) = jax.lax.scan(
+            step, (s0, rng), None, length=HORIZON)
+        return obs, acts, rews
+
+    jitted = jax.jit(rollout)
+
+    def run(params, rng, s0):
+        with mesh_context(mesh):
+            return jitted(params, rng, s0)
+
+    return run
+
+
+def make_update(mesh: Any) -> Callable[..., Any]:
+    """The learner's jitted policy-gradient step (REINFORCE with
+    reward-to-go): (state, obs, acts, rews) -> (state, metrics)."""
+
+    def update(state: TrainState, obs, acts, rews):
+        rtg = jnp.cumsum(rews[::-1], axis=0)[::-1]
+        rtg = rtg - jnp.mean(rtg)
+
+        def loss_fn(params):
+            logits = state.apply_fn({"params": params}, obs)
+            logp = jax.nn.log_softmax(logits)
+            lp = jnp.take_along_axis(logp, acts[..., None], -1)[..., 0]
+            return -jnp.mean(lp * rtg)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        new_state = state.apply_gradients(grads=grads)
+        return new_state, {"loss": loss,
+                           "reward": jnp.mean(rews),
+                           "step": new_state.step}
+
+    jitted = jax.jit(update)
+
+    def run(state, obs, acts, rews):
+        with mesh_context(mesh):
+            return jitted(state, obs, acts, rews)
+
+    return run
+
+
+class ActorPool:
+    """The elastically-scaled half: N independent actor slices, each on
+    its own mesh over a fixed per-slice device budget. ``scale(n)``
+    IS the resize path — rebuild meshes via ``mesh_for_slices``,
+    re-place the live policy via ``shard_put`` — and never touches the
+    learner."""
+
+    def __init__(self, devices: Sequence[jax.Device], apply_fn: Any,
+                 env: Dict[str, jnp.ndarray], *,
+                 devices_per_slice: int = 2) -> None:
+        self.devices = list(devices)
+        self.apply_fn = apply_fn
+        self.env = env
+        self.devices_per_slice = devices_per_slice
+        self.max_slices = len(self.devices) // devices_per_slice
+        self.meshes: List[Any] = []
+        self.rollouts: List[Callable[..., Any]] = []
+        self.params: List[Any] = []
+        self.resizes = 0
+
+    @property
+    def n_slices(self) -> int:
+        return len(self.meshes)
+
+    def scale(self, n: int) -> None:
+        """Resize the actor fleet to ``n`` slices (the elastic event).
+        Each slice's mesh is rebuilt and the CURRENT policy re-placed
+        through the logical-axis reshard path."""
+        if not 1 <= n <= self.max_slices:
+            raise ValueError(
+                f"actor slices must be in [1, {self.max_slices}], got {n}")
+        live = self.params[0] if self.params else None
+        per = self.devices_per_slice
+        self.meshes = [
+            mesh_for_slices(1, devices=self.devices[i * per:(i + 1) * per])
+            for i in range(n)]
+        self.rollouts = [make_rollout(m, self.apply_fn, self.env)
+                         for m in self.meshes]
+        self.params = ([] if live is None else
+                       [shard_put(live, m, axes_fn=policy_axes)
+                        for m in self.meshes])
+        self.resizes += 1
+
+    def publish(self, params: Any) -> None:
+        """Learner -> actors weight push, through the same reshard
+        placement (a fresh actor slice and a long-lived one get
+        byte-identical copies)."""
+        self.params = [shard_put(params, m, axes_fn=policy_axes)
+                       for m in self.meshes]
+
+    def collect(self, rng: Any, envs_per_actor: int) -> tuple:
+        """One round of rollouts across every live actor slice;
+        trajectories concatenate on the batch axis for the learner."""
+        obs, acts, rews = [], [], []
+        for i, run in enumerate(self.rollouts):
+            k = jax.random.fold_in(rng, i)
+            s0 = jax.random.normal(
+                jax.random.fold_in(k, 1), (envs_per_actor, OBS_DIM))
+            o, a, r = run(self.params[i], jax.random.fold_in(k, 2), s0)
+            obs.append(jax.device_get(o))
+            acts.append(jax.device_get(a))
+            rews.append(jax.device_get(r))
+        cat = lambda xs: jnp.concatenate(  # noqa: E731
+            [jnp.asarray(x) for x in xs], axis=1)
+        return cat(obs), cat(acts), cat(rews)
+
+
+def main(argv: Optional[List[str]] = None) -> Dict[str, Any]:
+    p = argparse.ArgumentParser()
+    p.add_argument("--iterations", type=int, default=9)
+    p.add_argument("--envs-per-actor", type=int, default=4)
+    p.add_argument("--hidden", type=int, default=32)
+    p.add_argument("--learning-rate", type=float, default=1e-2)
+    p.add_argument("--learner-devices", type=int, default=None,
+                   help="devices for the learner mesh (default: half)")
+    args = p.parse_args(argv)
+
+    setup_logging()
+    devs = jax.devices()
+    n_learner = (args.learner_devices if args.learner_devices
+                 else max(len(devs) // 2, 1))
+    learner_devs = devs[:n_learner]
+    actor_devs = devs[n_learner:] or devs[:1]
+
+    model = Policy(hidden=args.hidden)
+    env = _env_params()
+    learner_mesh = mesh_for_slices(1, devices=learner_devs)
+
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, OBS_DIM)))["params"]
+    params = shard_put(params, learner_mesh, axes_fn=policy_axes)
+    state = TrainState.create(
+        apply_fn=model.apply, params=params,
+        tx=optax.adam(args.learning_rate))
+    update = make_update(learner_mesh)
+
+    pool = ActorPool(actor_devs, model.apply, env)
+    pool.scale(min(2, pool.max_slices))
+    pool.publish(state.params)
+    initial_resizes = pool.resizes
+
+    # the elastic schedule: shrink the actor fleet mid-run (a scheduler
+    # shrink offer), then grow it back (capacity returned) — 2 -> 1 -> 2
+    third = max(args.iterations // 3, 1)
+    schedule = {third: 1, 2 * third: min(2, pool.max_slices)}
+
+    steps_seen: List[int] = []
+    last_reward = 0.0
+    for it in range(1, args.iterations + 1):
+        target = schedule.get(it)
+        if target is not None and target != pool.n_slices:
+            pool.scale(target)
+            pool.publish(state.params)
+            log_metrics(it, actor_slices=pool.n_slices,
+                        event="actor_resize")
+        obs, acts, rews = pool.collect(
+            jax.random.fold_in(jax.random.key(42), it),
+            args.envs_per_actor)
+        state, metrics = update(state, obs, acts, rews)
+        pool.publish(state.params)
+        steps_seen.append(int(metrics["step"]))
+        last_reward = float(metrics["reward"])
+        log_metrics(it, loss=metrics["loss"], reward=last_reward,
+                    actor_slices=pool.n_slices,
+                    learner_step=int(metrics["step"]))
+
+    # the Podracer acceptance: the learner gang never restarted — its
+    # step clock advanced exactly once per iteration, monotone, while
+    # the actor fleet resized around it
+    monotone = all(b == a + 1 for a, b in zip(steps_seen, steps_seen[1:]))
+    return {
+        "learner_steps": steps_seen[-1] if steps_seen else 0,
+        "learner_monotone": monotone,
+        "actor_resizes": pool.resizes - initial_resizes,
+        "actor_slices": pool.n_slices,
+        "last_reward": last_reward,
+    }
+
+
+if __name__ == "__main__":
+    main()

@@ -91,6 +91,9 @@ _job_steps_per_sec = DEFAULT_REGISTRY.gauge(
     "kftpu_job_steps_per_sec", "median worker steps/sec per job")
 _job_stragglers = DEFAULT_REGISTRY.gauge(
     "kftpu_job_stragglers", "workers >= K steps behind the gang median")
+_job_resizes = DEFAULT_REGISTRY.counter(
+    "kftpu_job_resizes_total",
+    "elastic gang resizes completed, by direction (shrink|grow)")
 
 
 @dataclass
@@ -133,6 +136,25 @@ class TpuJobSpec:
     preemptible: bool = True
     total_steps: int = 0
     checkpoint_dir: str = ""
+    # elastic training (docs/ELASTIC.md): {"minSlices": a, "maxSlices": b}
+    # declares the gang survives a live spec.slices edit within [a, b] —
+    # the operator routes such resizes through snapshot→teardown→
+    # re-gang→resume instead of the blind re-gang, and the scheduler
+    # queue may OFFER a shrink-to-minSlices instead of preempting the
+    # gang outright. None = fixed-shape job (the old behavior).
+    elastic: Optional[Dict[str, int]] = None
+
+    @property
+    def is_elastic(self) -> bool:
+        return self.elastic is not None
+
+    @property
+    def min_slices(self) -> Optional[int]:
+        return self.elastic["minSlices"] if self.elastic else None
+
+    @property
+    def max_slices(self) -> Optional[int]:
+        return self.elastic["maxSlices"] if self.elastic else None
 
     @property
     def num_workers(self) -> int:
@@ -162,9 +184,25 @@ class TpuJobSpec:
             preemptible=bool(spec.get("preemptible", True)),
             total_steps=int(spec.get("totalSteps", 0)),
             checkpoint_dir=str(spec.get("checkpointDir", "") or ""),
+            elastic=cls._parse_elastic(spec.get("elastic")),
         )
         out.validate()
         return out
+
+    @staticmethod
+    def _parse_elastic(raw: Any) -> Optional[Dict[str, int]]:
+        if raw is None:
+            return None
+        if not isinstance(raw, dict):
+            raise ValueError("spec.elastic must be an object with "
+                             "minSlices/maxSlices")
+        try:
+            return {"minSlices": int(raw.get("minSlices", 1)),
+                    "maxSlices": int(raw.get("maxSlices", raw.get(
+                        "minSlices", 1)))}
+        except (TypeError, ValueError) as e:
+            raise ValueError(
+                f"spec.elastic bounds must be integers: {raw!r}") from e
 
     def validate(self) -> None:
         if not self.image:
@@ -177,6 +215,17 @@ class TpuJobSpec:
             raise ValueError("stragglerSteps must be >= 1")
         if self.total_steps < 0:
             raise ValueError("totalSteps must be >= 0")
+        if self.elastic is not None:
+            mn, mx = self.elastic["minSlices"], self.elastic["maxSlices"]
+            if mn < 1:
+                raise ValueError("elastic.minSlices must be >= 1")
+            if mx < mn:
+                raise ValueError(
+                    f"elastic.maxSlices {mx} < minSlices {mn}")
+            if not mn <= self.slices <= mx:
+                raise ValueError(
+                    f"slices {self.slices} outside elastic bounds "
+                    f"[{mn}, {mx}]")
         for d in self.data_staging:
             if not d.get("source", "").startswith(("gs://", "s3://")):
                 raise ValueError(
@@ -454,6 +503,17 @@ class TpuJobOperator:
                 ns, name):
             return self._handle_preemption(job, spec, pods)
 
+        # scheduler-plane shrink offer: the queue asked this elastic
+        # gang to release slices instead of evicting it (cheaper than
+        # preemption — the run keeps making progress at minSlices).
+        # spec.elastic is the consent; the operator applies the spec
+        # edit and the normal elastic-resize path does the rest.
+        if (self.queue is not None and spec.is_elastic
+                and getattr(self.queue, "shrink_requested", None)):
+            target = self.queue.shrink_requested(ns, name)
+            if target is not None and target < spec.slices:
+                return self._apply_shrink_offer(job, spec, target)
+
         if not pods:
             if self.queue is not None:
                 return self._reconcile_queued_create(job, spec)
@@ -466,8 +526,11 @@ class TpuJobOperator:
                                            f"need {spec.slices} free "
                                            f"{spec.accelerator} slice(s)")])
                 return 15.0
+            resize, resize_conds = self._resize_completion(job, spec)
             self._set_status(job, PHASE_PENDING, restarts=self._restarts(job),
-                             conditions=[_condition("Created", "GangCreated")])
+                             resize=resize,
+                             conditions=[_condition("Created", "GangCreated")]
+                             + resize_conds)
             return 1.0
 
         counts = {"Pending": 0, "Running": 0, "Succeeded": 0, "Failed": 0}
@@ -483,12 +546,17 @@ class TpuJobOperator:
         # gang. Every worker bakes the world size + slice count into its
         # env, so the whole gang re-places at the new shape; this does NOT
         # consume a failure restart. Pods predating the shape label are
-        # left alone (their shape is unknowable).
+        # left alone (their shape is unknowable). Jobs declaring
+        # spec.elastic route through snapshot→teardown→re-gang→resume
+        # (docs/ELASTIC.md) so the run survives; fixed-shape jobs keep
+        # the original blind re-gang.
         shape = gang_shape(spec)
         stale = [p for p in pods
                  if (p.get("metadata", {}).get("labels", {}) or {})
                  .get(GANG_SHAPE_LABEL, shape) != shape]
         if stale:
+            if spec.is_elastic:
+                return self._handle_resize(job, spec, pods, stale)
             self._delete_pods(ns, pods)
             self._set_status(
                 job, PHASE_RESTARTING,
@@ -589,8 +657,11 @@ class TpuJobOperator:
                                        "granted slices no longer free; "
                                        "requeued")])
             return 5.0
+        resize, resize_conds = self._resize_completion(job, spec)
         self._set_status(job, PHASE_PENDING, restarts=self._restarts(job),
-                         conditions=[_condition("Created", "GangCreated")])
+                         resize=resize,
+                         conditions=[_condition("Created", "GangCreated")]
+                         + resize_conds)
         return 1.0
 
     def _handle_preemption(self, job: o.Obj, spec: TpuJobSpec,
@@ -631,6 +702,133 @@ class TpuJobOperator:
                  ns, name, by, step)
         self.queue.confirm_preempted(ns, name, step)
         return 1.0
+
+    # -- elastic resize (docs/ELASTIC.md) ----------------------------------
+
+    def _apply_shrink_offer(self, job: o.Obj, spec: TpuJobSpec,
+                            target: int) -> Optional[float]:
+        """Accept the queue's shrink offer by editing ``spec.slices``
+        down to ``target`` — the resize then flows through the same
+        snapshot→teardown→re-gang→resume path a user edit takes. The
+        condition records WHY the shape changed (nobody edited the CR)."""
+        ns = job["metadata"]["namespace"]
+        name = job["metadata"]["name"]
+        updated = dict(job)
+        updated["spec"] = {**dict(job.get("spec", {})), "slices": target}
+        try:
+            self.client.update(updated)
+        except ApiError as e:
+            if e.code != 404:
+                raise
+            return None
+        self._set_status(
+            updated, job.get("status", {}).get("phase", PHASE_PENDING),
+            conditions=[_condition(
+                "Resizing", "ShrinkOffered",
+                f"scheduler offered shrink to {target} slice(s) in "
+                f"place of preemption")])
+        log.info("shrink offer accepted for %s/%s: slices -> %d",
+                 ns, name, target)
+        return 1.0
+
+    def _handle_resize(self, job: o.Obj, spec: TpuJobSpec,
+                       pods: List[o.Obj],
+                       stale: List[o.Obj]) -> Optional[float]:
+        """Checkpoint-reshard-resume, operator side. Two passes:
+
+        1. **nudge** — write ``status.resize.requested`` (the workers'
+           cue to barrier + snapshot, mirroring the preemption nudge)
+           and hold one reconcile so a live gang can save before its
+           pods die;
+        2. **snapshot + teardown** — ensure a checkpoint step is known
+           (``checkpointer.save``, exactly once per resize — the
+           ``checkpointed`` flag survives re-entry), tear the gang
+           down, and let the normal create path re-gang at the new
+           shape. The re-gang completion (:meth:`_resize_completion`)
+           emits the ``Resized`` condition and counts the resize.
+        """
+        ns = job["metadata"]["namespace"]
+        name = job["metadata"]["name"]
+        shape = gang_shape(spec)
+        old_shape = (stale[0].get("metadata", {}).get("labels", {})
+                     or {}).get(GANG_SHAPE_LABEL, "")
+        resize = dict(job.get("status", {}).get("resize") or {})
+        if not resize.get("requested"):
+            try:
+                old_workers = int(old_shape.split("x")[0]) * int(
+                    old_shape.split("x")[1])
+            except (ValueError, IndexError):
+                old_workers = spec.num_workers
+            resize = {
+                # keep the queue's offer provenance (who asked, to
+                # what) next to the resize it caused
+                **{k: v for k, v in resize.items()
+                   if k in ("offered", "by")},
+                "requested": True,
+                "from": old_shape,
+                "to": shape,
+                "direction": ("shrink"
+                              if spec.num_workers < old_workers
+                              else "grow"),
+                "count": int(resize.get("count", 0)) + 1,
+            }
+            self._set_status(
+                job, job.get("status", {}).get("phase", PHASE_PENDING),
+                resize=resize,
+                conditions=[_condition(
+                    "Resizing", "ElasticResize",
+                    f"resize {old_shape or '?'} -> {shape}: snapshot "
+                    f"requested")])
+            log.info("elastic resize for %s/%s: %s -> %s (nudged)",
+                     ns, name, old_shape, shape)
+            return 1.0
+        step: Optional[int] = None
+        if not resize.get("checkpointed"):
+            if self.checkpointer is not None:
+                try:
+                    step = self.checkpointer.save(job)
+                except Exception:  # noqa: BLE001 — a broken checkpoint
+                    # sink must not wedge the resize; the gang just
+                    # resumes from an older step (or step 0)
+                    log.exception("resize checkpoint for %s/%s failed",
+                                  ns, name)
+            if step is None:
+                telemetry = job.get("status", {}).get("telemetry") or {}
+                step = telemetry.get("lastStep")
+            resize = {**resize, "checkpointed": True,
+                      "lastCheckpointStep": step}
+        self._delete_pods(ns, pods)
+        self._set_status(
+            job, PHASE_RESTARTING, resize=resize,
+            conditions=[_condition(
+                "Resizing", "ElasticResize",
+                f"re-gang {resize.get('from') or '?'} -> {shape}; "
+                f"checkpointed at step "
+                f"{resize.get('lastCheckpointStep')}")])
+        log.info("elastic resize for %s/%s: torn down for re-gang to %s "
+                 "(checkpoint step %s)", ns, name, shape,
+                 resize.get("lastCheckpointStep"))
+        return 1.0
+
+    def _resize_completion(self, job: o.Obj, spec: TpuJobSpec
+                           ) -> tuple:
+        """On gang (re-)creation: if a resize was in flight, close it —
+        flip ``requested`` off, count it by direction, and emit the
+        ``Resized`` condition exactly once (the flag flips exactly once
+        per resize, the ``Preempted`` dedup discipline)."""
+        resize = dict(job.get("status", {}).get("resize") or {})
+        if not resize.get("requested"):
+            return None, []
+        resize["requested"] = False
+        resize.pop("checkpointed", None)
+        direction = resize.get("direction", "shrink")
+        _job_resizes.inc(direction=direction)
+        cond = _condition(
+            "Resized", "ElasticResize",
+            f"resized {resize.get('from') or '?'} -> "
+            f"{resize.get('to') or gang_shape(spec)} ({direction}); "
+            f"resuming from step {resize.get('lastCheckpointStep')}")
+        return resize, [cond]
 
     # -- helpers -----------------------------------------------------------
 
@@ -893,7 +1091,8 @@ class TpuJobOperator:
                     conditions: Optional[List[Dict[str, Any]]] = None,
                     workers: Optional[Dict[str, int]] = None,
                     telemetry: Optional[Dict[str, Any]] = None,
-                    preemption: Optional[Dict[str, Any]] = None) -> None:
+                    preemption: Optional[Dict[str, Any]] = None,
+                    resize: Optional[Dict[str, Any]] = None) -> None:
         status = dict(job.get("status", {}))
         changed = status.get("phase") != phase
         status["phase"] = phase
@@ -907,6 +1106,9 @@ class TpuJobOperator:
         if preemption is not None:
             changed = changed or status.get("preemption") != preemption
             status["preemption"] = preemption
+        if resize is not None:
+            changed = changed or status.get("resize") != resize
+            status["resize"] = resize
         if start and "startTime" not in status:
             status["startTime"] = _condition("", "")["lastTransitionTime"]
         if completion and "completionTime" not in status:

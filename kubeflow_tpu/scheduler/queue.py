@@ -13,14 +13,17 @@ scheduling literature assumes exists:
   covers it; over-quota gangs wait in ``BLOCKED`` and re-admit the
   moment a sibling finishes. Whole gangs only: a gang is placed
   atomically or not at all, never partially.
-- **priority/FIFO-hybrid ordering** — priority classes strictly
-  dominate; *within* a class, gangs with a predicted remaining
-  duration (:class:`~kubeflow_tpu.scheduler.predictor.
+- **priority/FIFO-hybrid ordering with bounded-wait aging** — priority
+  classes strictly dominate; *within* a class, gangs with a predicted
+  remaining duration (:class:`~kubeflow_tpu.scheduler.predictor.
   ThroughputPredictor`, fed from PR 5 telemetry) run
-  shortest-remaining-first, and unpredicted gangs keep FIFO order
-  behind them (absent-never-wrong: the queue never fabricates an
-  estimate to reorder by). Preemption victims re-enter at the head of
-  their class.
+  shortest-remaining-first, and unpredicted gangs rank as if their
+  remaining time were ``aging_max_wait_s`` minus the time they have
+  already waited (absent-never-wrong: the queue never fabricates an
+  estimate, it only *ages* the unknown toward the front) — so a
+  stream of predicted-short gangs can overtake an unpredicted gang
+  for at most ``aging_max_wait_s``, never starve it. Preemption
+  victims re-enter at the head of their class.
 - **contention-aware placement** — candidate slice windows are scored
   by shared-DCN-link overlap with already-placed gangs
   (:mod:`kubeflow_tpu.scheduler.contention`), so two concurrent
@@ -35,6 +38,13 @@ scheduling literature assumes exists:
   the gang down, confirms via :meth:`GangQueue.confirm_preempted`, and
   the victim resumes later with its step clock intact
   (``CheckpointManager.restore_or_init`` on the worker side).
+- **shrink offers to elastic gangs** — before evicting anyone, a gang
+  that declared ``spec.elastic`` (a ``minSlices`` floor) is OFFERED a
+  shrink (:meth:`GangQueue.shrink_requested`, ``scheduler.queue.
+  shrink`` span, ``status.resize.offered`` nudge): the operator edits
+  ``spec.slices`` down, the run checkpoint-reshards onto fewer slices
+  and KEEPS MAKING PROGRESS while the preemptor takes the freed
+  window — strictly cheaper than eviction (docs/ELASTIC.md).
 
 Every decision is traced (``scheduler.queue.admit`` / ``.predict`` /
 ``.place`` / ``.preempt`` / ``.requeue`` spans on the gang's
@@ -82,6 +92,9 @@ _wait_h = DEFAULT_REGISTRY.histogram(
     buckets=_QUEUE_WAIT_BUCKETS)
 _preemptions = DEFAULT_REGISTRY.counter(
     "kftpu_preemptions_total", "gangs preempted for a higher priority gang")
+_shrink_offers = DEFAULT_REGISTRY.counter(
+    "kftpu_shrink_offers_total",
+    "elastic gangs offered a shrink in place of preemption")
 
 
 @dataclass(frozen=True)
@@ -98,6 +111,10 @@ class GangRequest:
     preemptible: bool = True
     total_steps: Optional[int] = None   # predictor hint (spec.totalSteps)
     uid: str = ""                       # CR uid: identity-derived trace
+    # elastic floor (spec.elastic.minSlices): the gang consents to run
+    # at this many slices, so the queue may OFFER a shrink instead of
+    # preempting it outright. None = fixed shape, never shrinkable.
+    min_slices: Optional[int] = None
 
     @property
     def key(self) -> Tuple[str, str]:
@@ -128,6 +145,12 @@ class _Entry:
     preempted_by: Optional[Tuple[str, str]] = None
     preemptor_trace: Optional[Tuple[str, str]] = None
     waiting_victims: List[Tuple[str, str]] = field(default_factory=list)
+    # shrink offers (docs/ELASTIC.md): on the VICTIM, the slice count
+    # the queue asked it to shrink to (the operator applies the spec
+    # edit); on the PREEMPTOR, the victims whose shrink it awaits —
+    # same no-backfill reservation discipline as waiting_victims
+    shrink_to: Optional[int] = None
+    waiting_shrinks: List[Tuple[str, str]] = field(default_factory=list)
     # set on the preemptor: slices its confirmed victims must actually
     # free (on a real cluster pods drain through a grace period after
     # confirm) — no further preemption until they read fully free
@@ -159,7 +182,8 @@ class GangQueue:
                  checkpoint_step: Optional[
                      Callable[[str, str], Optional[int]]] = None,
                  quota_fn: Optional[
-                     Callable[[str], Optional[int]]] = None) -> None:
+                     Callable[[str], Optional[int]]] = None,
+                 aging_max_wait_s: float = 3600.0) -> None:
         self.client = client
         self.clock: Clock = clock if clock is not None else time.monotonic
         self.tracer = tracer if tracer is not None else Tracer(
@@ -169,6 +193,12 @@ class GangQueue:
         self.checkpoint_step = checkpoint_step or (lambda ns, name: None)
         self.quota_fn = (quota_fn if quota_fn is not None
                          else lambda ns: tpu_chip_quota(self.client, ns))
+        # fairness aging (bounded wait): an unpredicted gang ranks as if
+        # it were a predicted gang whose remaining time shrinks linearly
+        # from aging_max_wait_s to 0 as it waits — so a stream of
+        # predicted-short gangs can overtake it at most aging_max_wait_s
+        # seconds, never forever
+        self.aging_max_wait_s = float(aging_max_wait_s)
         self.scheduler = GangScheduler(client)
         self._entries: Dict[Tuple[str, str], _Entry] = {}
         self._seq = 0
@@ -209,6 +239,7 @@ class GangQueue:
                 self._entries[req.key] = entry
                 self._admit(entry)
             elif entry.req != req:
+                old = entry.req
                 entry.req = req
                 if entry.state in (QUEUED, BLOCKED, PLACED):
                     # a changed spec (priority edit, elastic resize)
@@ -219,6 +250,15 @@ class GangQueue:
                     entry.window = None
                     entry.state = BLOCKED
                     self._admit(entry)
+                if old.slices != req.slices:
+                    # the resize a shrink offer asked for (or any other
+                    # reshape) arrived: the offer is settled — release
+                    # the preemptor waiting on it so this cycle can
+                    # place onto the freed capacity
+                    entry.shrink_to = None
+                    for e in self._entries.values():
+                        e.waiting_shrinks = [
+                            k for k in e.waiting_shrinks if k != req.key]
             self._export()
             return entry.state
 
@@ -263,10 +303,22 @@ class GangQueue:
                        {"remainingSeconds": signature[1],
                         "known": signature[0]})
         # priority class desc; requeued victims at the class head (in
-        # requeue order); predicted shortest-remaining-first; FIFO tail
+        # requeue order); then one merged shortest-remaining scale:
+        # predicted gangs rank by remaining seconds, unpredicted gangs
+        # by (aging_max_wait_s - waited) — starting as the longest
+        # plausible job and AGING toward rank 0, so predicted-short
+        # gangs win early but can never starve the unpredicted tail
+        # beyond the bound; FIFO (seq) breaks ties
+        if remaining is not None:
+            rank = remaining
+        else:
+            since = (entry.admitted_at if entry.admitted_at is not None
+                     else entry.submitted_at)
+            rank = max(self.aging_max_wait_s
+                       - max(self.clock() - since, 0.0), 0.0)
         return (-req.priority,
                 (0, entry.head_seq) if entry.head else (1, 0),
-                (0, remaining) if remaining is not None else (1, 0),
+                rank,
                 entry.seq)
 
     # -- the scheduling cycle ----------------------------------------------
@@ -291,11 +343,13 @@ class GangQueue:
                     continue
                 if self._try_place(entry, inv_cache):
                     continue
-                if entry.waiting_victims or entry.pending_free:
-                    # this gang paid an eviction for the next free
-                    # window on its accelerator: lower-ordered gangs
-                    # must not backfill onto it, or the eviction is
-                    # wasted and the queue preempts in a loop
+                if (entry.waiting_victims or entry.waiting_shrinks
+                        or entry.pending_free):
+                    # this gang paid an eviction (or a shrink offer)
+                    # for the next free window on its accelerator:
+                    # lower-ordered gangs must not backfill onto it, or
+                    # the eviction is wasted and the queue preempts in
+                    # a loop
                     reserved.add(entry.req.accelerator)
                     continue
                 if not preempt_tried:
@@ -304,7 +358,7 @@ class GangQueue:
                     # invert the queue's own ordering
                     preempt_tried = True
                     self._try_preempt(entry, inv_cache)
-                    if entry.waiting_victims:
+                    if entry.waiting_victims or entry.waiting_shrinks:
                         reserved.add(entry.req.accelerator)
             self._export()
 
@@ -380,7 +434,11 @@ class GangQueue:
         entry.slice_ids = chosen_ids
         entry.window = window
         entry.head = False
-        entry.pending_free = []   # the eviction (if any) paid off
+        entry.pending_free = []     # the eviction (if any) paid off
+        # capacity arrived without the shrink (a sibling finished):
+        # revoke the offer so the victim does not needlessly
+        # checkpoint-teardown-reshard for nobody
+        self._revoke_shrinks(entry)
         wait = max(now - entry.submitted_at, 0.0)
         # exemplar: the gang's identity-derived trace, so a long-wait
         # bucket opens the admit->place span tree that waited
@@ -422,9 +480,9 @@ class GangQueue:
     def _try_preempt(self, entry: _Entry,
                      inv_cache: Dict[str, List[SliceInfo]]) -> None:
         req = entry.req
-        if entry.waiting_victims:
-            # a previous preemption for this gang is still tearing
-            # down; never widen the blast radius while it settles
+        if entry.waiting_victims or entry.waiting_shrinks:
+            # a previous preemption/shrink for this gang is still
+            # settling; never widen the blast radius while it does
             return
         inv = self._inventory(inv_cache, req.accelerator)
         if not inv:
@@ -440,6 +498,27 @@ class GangQueue:
                 if info is not None and info.free_hosts != info.hosts:
                     return
             entry.pending_free = []
+        # shrink offers first (docs/ELASTIC.md): an elastic gang that
+        # declared a minSlices floor can FREE the needed window without
+        # losing its run — strictly cheaper than eviction, so it is
+        # tried before any victim is picked. One offer at a time (the
+        # no-widened-blast-radius rule applied to shrinks).
+        shrinkables = sorted(
+            (e for e in self._entries.values()
+             if e.state == PLACED
+             and e.req.priority < req.priority
+             and e.req.accelerator == req.accelerator
+             and e.slice_ids
+             and e.req.min_slices is not None
+             and e.req.min_slices < e.req.slices
+             and e.shrink_to is None),
+            key=self._victim_cost)
+        for victim in shrinkables:
+            target = victim.req.min_slices
+            if self._shrink_feasible(inv, req, victim, target):
+                self._signal_shrink(entry, victim, target)
+                entry.waiting_shrinks = [victim.req.key]
+                return
         candidates = sorted(
             (e for e in self._entries.values()
              if e.state == PLACED and e.req.preemptible
@@ -482,6 +561,116 @@ class GangQueue:
             if feasible(acc):
                 return acc
         return []
+
+    def _shrink_feasible(self, inv: List[SliceInfo], req: GangRequest,
+                         victim: _Entry, target: int) -> bool:
+        """True when, with the victim's slices transiently freed (the
+        resize re-places the whole gang), BOTH the preemptor at its
+        full size AND the victim at its shrunk ``target`` fit — a
+        shrink that leaves the shrunk gang homeless is an eviction
+        with extra steps, not an offer."""
+        freed = set(victim.slice_ids or [])
+        hosts = [s.hosts for s in inv]
+        free = [s.hosts if s.slice_id in freed else s.free_hosts
+                for s in inv]
+        chosen = choose_slices_contended(hosts, free, req.slices,
+                                         req.hosts_per_slice)
+        if chosen is None:
+            return False
+        for i in chosen:
+            free[i] = 0
+        return choose_slices_contended(
+            hosts, free, target, victim.req.hosts_per_slice) is not None
+
+    def _signal_shrink(self, entry: _Entry, victim: _Entry,
+                       target: int) -> None:
+        """Mark the elastic victim and nudge its CR
+        (``status.resize.offered``) — the operator's cue to apply the
+        ``spec.slices`` edit; the resize then flows through the normal
+        snapshot→teardown→re-gang path and :meth:`submit` (seeing the
+        new shape) settles the offer."""
+        vreq = victim.req
+        victim.shrink_to = target
+        _shrink_offers.inc()
+        self._span("scheduler.queue.shrink", entry.req,
+                   {"victim": f"{vreq.namespace}/{vreq.name}",
+                    "fromSlices": vreq.slices,
+                    "toSlices": target})
+        log.info("offering %s/%s (priority %d) a shrink %d -> %d "
+                 "slice(s) for %s/%s (priority %d)",
+                 vreq.namespace, vreq.name, vreq.priority, vreq.slices,
+                 target, entry.req.namespace, entry.req.name,
+                 entry.req.priority)
+        from kubeflow_tpu.manifests.components.tpujob_operator import (
+            API_VERSION,
+            TPUJOB_KIND,
+        )
+
+        job = self.client.get_or_none(API_VERSION, TPUJOB_KIND,
+                                      vreq.namespace, vreq.name)
+        if job is None:
+            return
+        status = dict(job.get("status", {}))
+        resize = dict(status.get("resize") or {})
+        resize.update({
+            "offered": target,
+            "by": f"{entry.req.namespace}/{entry.req.name}",
+        })
+        status["resize"] = resize
+        job = dict(job)
+        job["status"] = status
+        try:
+            self.client.update_status(job)
+        except ApiError as e:
+            if e.code != 404:
+                raise
+
+    def _revoke_shrinks(self, entry: _Entry) -> None:
+        """Withdraw every shrink offer ``entry`` (the preemptor) was
+        waiting on: clear the victims' ``shrink_to`` and best-effort
+        erase the ``status.resize.offered`` nudge, so an offer whose
+        beneficiary went away (released, or placed elsewhere) never
+        costs the victim a checkpoint-teardown-reshard for nothing."""
+        for key in entry.waiting_shrinks:
+            victim = self._entries.get(key)
+            if victim is None or victim.shrink_to is None:
+                continue
+            victim.shrink_to = None
+            self._clear_shrink_nudge(victim.req)
+        entry.waiting_shrinks = []
+
+    def _clear_shrink_nudge(self, vreq: GangRequest) -> None:
+        from kubeflow_tpu.manifests.components.tpujob_operator import (
+            API_VERSION,
+            TPUJOB_KIND,
+        )
+
+        job = self.client.get_or_none(API_VERSION, TPUJOB_KIND,
+                                      vreq.namespace, vreq.name)
+        if job is None:
+            return
+        status = dict(job.get("status", {}))
+        resize = dict(status.get("resize") or {})
+        if "offered" not in resize:
+            return
+        resize.pop("offered", None)
+        resize.pop("by", None)
+        status["resize"] = resize
+        job = dict(job)
+        job["status"] = status
+        try:
+            self.client.update_status(job)
+        except ApiError as e:
+            if e.code != 404:
+                raise
+
+    def shrink_requested(self, ns: str, name: str) -> Optional[int]:
+        """The slice count this elastic gang was asked to shrink to
+        (None = no offer pending) — the operator polls this each
+        reconcile and applies the spec edit."""
+        with self._lock:
+            entry = self._entries.get((ns, name))
+            return entry.shrink_to if entry is not None else None
 
     def _signal_preemption(self, entry: _Entry, victim: _Entry) -> None:
         """Mark the victim and write ``status.preemption.requested``
@@ -604,14 +793,19 @@ class GangQueue:
             return entry.last_checkpoint_step if entry is not None else None
 
     def release(self, ns: str, name: str) -> None:
-        """Terminal/deleted gang: drop it, freeing quota and slices."""
+        """Terminal/deleted gang: drop it, freeing quota and slices.
+        Shrink offers it was waiting on are withdrawn — the would-be
+        beneficiary is gone, nobody needs the victim's capacity."""
         with self._lock:
             entry = self._entries.pop((ns, name), None)
             if entry is None:
                 return
+            self._revoke_shrinks(entry)
             self.predictor.forget(ns, name)
             for e in self._entries.values():
                 e.waiting_victims = [k for k in e.waiting_victims
+                                     if k != (ns, name)]
+                e.waiting_shrinks = [k for k in e.waiting_shrinks
                                      if k != (ns, name)]
             self._export()
 
@@ -680,4 +874,5 @@ def request_from_spec(ns: str, name: str, spec: Any,
         chips_per_host=spec.chips_per_host,
         accelerator=spec.accelerator, priority=spec.priority,
         preemptible=spec.preemptible,
-        total_steps=spec.total_steps or None, uid=uid)
+        total_steps=spec.total_steps or None, uid=uid,
+        min_slices=getattr(spec, "min_slices", None))

@@ -43,11 +43,27 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def all_steps(self) -> list:
+        """Every step with a persisted checkpoint, ascending."""
+        return sorted(self._mgr.all_steps())
+
     def restore(self, state: Any, step: Optional[int] = None) -> Any:
-        """Restore into the sharding/structure of ``state`` (abstract ok)."""
-        step = self._mgr.latest_step() if step is None else step
+        """Restore into the sharding/structure of ``state`` (abstract ok).
+
+        An explicit ``step`` that has no checkpoint raises
+        ``FileNotFoundError`` loudly — the elastic reshard path resumes
+        at an exact step, and silently restoring some OTHER step (or
+        none) would fork the step clock instead of surviving the
+        resize."""
         if step is None:
-            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+            step = self._mgr.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoint under {self.directory}")
+        elif step not in set(self._mgr.all_steps()):
+            raise FileNotFoundError(
+                f"no checkpoint for step {step} under {self.directory} "
+                f"(have {self.all_steps()})")
         return self._mgr.restore(step, args=self._ocp.args.StandardRestore(state))
 
     def restore_or_init(self, state: Any) -> tuple[Any, int]:

@@ -38,6 +38,11 @@
 #      Pending -> Firing -> Resolved with exactly one Event per
 #      transition and the firing gauge back at 0
 #      (docs/OBSERVABILITY.md, Monitoring section)
+#   8. elastic-training smoke (scripts/elastic_smoke.py): a fake
+#      4-slice gang trains to step 50, shrinks to 2 slices through
+#      snapshot-reshard-resume (exactly one save, spans in order),
+#      trains to 100, and the loss stream matches a never-resized
+#      oracle after the resync step (docs/ELASTIC.md)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -65,6 +70,10 @@ JAX_PLATFORMS=cpu python scripts/scheduler_smoke.py || rc=1
 
 echo "== preflight: monitoring/alerts smoke =="
 JAX_PLATFORMS=cpu python scripts/alerts_smoke.py || rc=1
+
+echo "== preflight: elastic training smoke =="
+JAX_PLATFORMS=cpu XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+    python scripts/elastic_smoke.py || rc=1
 
 if [ "$rc" -ne 0 ]; then
     echo "preflight: FAILED" >&2

@@ -130,3 +130,37 @@ def test_restore_across_mesh_topologies(tmp_path):
     assert dict(zip(leaf.sharding.mesh.axis_names,
                     leaf.sharding.mesh.devices.shape)) == {
         "dcn": 2, "dp": 2, "pp": 1, "tp": 2}
+
+
+def test_restore_nonexistent_step_raises_loudly(tmp_path):
+    """restore(state, step=N) with no checkpoint at N must raise, never
+    silently fall through to another step — the elastic reshard path
+    resumes at an EXACT step and a silent substitute forks the step
+    clock (docs/ELASTIC.md reshard invariants)."""
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    state = {"w": np.arange(4.0)}
+    mgr.save(2, state, wait=True)
+    with pytest.raises(FileNotFoundError, match="no checkpoint for step 5"):
+        mgr.restore(state, step=5)
+    assert mgr.all_steps() == [2]
+    # the happy path still restores the exact step
+    restored = mgr.restore(state, step=2)
+    np.testing.assert_array_equal(restored["w"], state["w"])
+    mgr.close()
+
+
+def test_restore_or_init_on_empty_but_existing_directory(tmp_path):
+    """An empty-but-existing checkpoint directory is a FRESH start (the
+    operator pre-creates the dir; first boot must not crash) — while a
+    bare restore() against it still raises."""
+    empty = tmp_path / "ckpt"
+    empty.mkdir()
+    mgr = CheckpointManager(str(empty))
+    state = {"w": np.arange(4.0)}
+    out, start = mgr.restore_or_init(state)
+    assert start == 0
+    np.testing.assert_array_equal(out["w"], state["w"])
+    assert mgr.latest_step() is None
+    with pytest.raises(FileNotFoundError, match="no checkpoint under"):
+        mgr.restore(state)
+    mgr.close()

@@ -1,0 +1,593 @@
+"""Elastic training: checkpoint-reshard-resume on gang resize
+(docs/ELASTIC.md) — reshard math, worker protocol, operator wiring,
+scheduler shrink offers, and the Podracer actor/learner scenario, all
+deterministic on the 8-device CPU mesh + FakeKubeClient."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.elastic import (
+    DirCheckpointer,
+    ElasticCoordinator,
+    ElasticSnapshotter,
+    ReshardMismatchError,
+    ResizeSignal,
+    cr_resize_target,
+    mesh_for_slices,
+    restore_resharded,
+    shardings_for,
+    validate_global_shapes,
+)
+from kubeflow_tpu.elastic.coordinator import SHUTDOWN
+from kubeflow_tpu.k8s import FakeKubeClient
+from kubeflow_tpu.manifests.components.tpujob_operator import (
+    API_VERSION,
+    TPUJOB_KIND,
+)
+from kubeflow_tpu.models import Transformer, TransformerConfig
+from kubeflow_tpu.obs.steps import publish_beacon, tpujob_trace_ids
+from kubeflow_tpu.obs.trace import SpanCollector, Tracer
+from kubeflow_tpu.operators.tpujob import (
+    JOB_LABEL,
+    PreemptionCheckpointer,
+    TpuJobOperator,
+    TpuJobSpec,
+    tpujob,
+)
+from kubeflow_tpu.platform.local import fake_slice_nodes
+from kubeflow_tpu.scheduler.queue import GangQueue, PLACED
+from kubeflow_tpu.train import (
+    TrainState,
+    make_lm_train_step,
+    make_optimizer,
+)
+from kubeflow_tpu.train.checkpoint import CheckpointManager
+from kubeflow_tpu.utils import DEFAULT_REGISTRY
+
+DEVICES_PER_SLICE = 2
+
+
+class FakeClock:
+    def __init__(self, start=1000.0, step=0.5):
+        self.t = start
+        self.step = step
+        self._lock = threading.Lock()
+
+    def __call__(self):
+        with self._lock:
+            self.t += self.step
+            return self.t
+
+
+def tiny_model():
+    config = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_ff=64, max_seq_len=16, dtype=jnp.float32, remat=False)
+    return Transformer(config)
+
+
+def make_init_fn(model, steps=20):
+    tx = make_optimizer(1e-3, warmup_steps=2, decay_steps=steps)
+    sample = jnp.zeros((8, 8), jnp.int32)
+
+    def init_fn(rng):
+        params = model.init(rng, sample)["params"]
+        return TrainState.create(apply_fn=model.apply, params=params,
+                                 tx=tx)
+
+    return init_fn
+
+
+def mesh_factory(n):
+    return mesh_for_slices(n, devices=jax.devices()[:n * DEVICES_PER_SLICE])
+
+
+def data_fn(step):
+    rng = jax.random.fold_in(jax.random.key(1234), step)
+    return (jax.random.randint(rng, (8, 8), 0, 64),)
+
+
+def make_coordinator(tmp_path, **kw):
+    model = tiny_model()
+    kw.setdefault("manager", CheckpointManager(str(tmp_path / "ckpt")))
+    kw.setdefault("init_fn", make_init_fn(model))
+    kw.setdefault("make_step", lambda m: make_lm_train_step(m))
+    kw.setdefault("mesh_factory", mesh_factory)
+    kw.setdefault("reinit", lambda n: None)
+    return ElasticCoordinator(**kw)
+
+
+def leaves_equal(a, b):
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree_util.tree_leaves(a),
+                        jax.tree_util.tree_leaves(b)))
+
+
+# -- reshard: the topology remap itself --------------------------------------
+
+
+def test_restore_resharded_bit_identical_across_shrink(tmp_path):
+    """A checkpoint saved on the 4-slice mesh restores DIRECTLY into the
+    2-slice mesh's shardings — values bit-identical, every leaf living
+    on the new mesh."""
+    model = tiny_model()
+    init_fn = make_init_fn(model)
+    mesh_a = mesh_factory(4)
+    from kubeflow_tpu.train import create_sharded_state
+
+    state, _ = create_sharded_state(init_fn, jax.random.key(0), mesh_a)
+    state, _ = make_lm_train_step(mesh_a)(state, *data_fn(1))
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(1, state, wait=True)
+
+    mesh_b = mesh_factory(2)
+    abstract = jax.eval_shape(init_fn, jax.random.key(0))
+    restored = restore_resharded(mgr, abstract, mesh_b, step=1)
+    assert leaves_equal(state, restored)
+    for leaf in jax.tree_util.tree_leaves(restored):
+        if hasattr(leaf, "sharding"):
+            assert leaf.sharding.mesh.devices.shape[0] == 2  # dcn axis
+    mgr.close()
+
+
+def test_validate_global_shapes_raises_on_mismatch():
+    good = {"w": jnp.zeros((4, 2)), "b": jnp.zeros((2,))}
+    validate_global_shapes(good, {"w": jnp.zeros((4, 2)),
+                                  "b": jnp.zeros((2,))})
+    with pytest.raises(ReshardMismatchError, match="global shape"):
+        validate_global_shapes(good, {"w": jnp.zeros((4, 3)),
+                                      "b": jnp.zeros((2,))})
+    with pytest.raises(ReshardMismatchError, match="structure"):
+        validate_global_shapes(good, {"w": jnp.zeros((4, 2))})
+
+
+def test_shardings_follow_logical_axes_on_both_topologies():
+    """The specs are a pure function of the logical axes — the same
+    PartitionSpec lands on every topology; only the mesh underneath
+    changes (the whole trick of the reshard path)."""
+    model = tiny_model()
+    init_fn = make_init_fn(model)
+    abstract = jax.eval_shape(init_fn, jax.random.key(0))
+    sh4 = shardings_for(abstract, mesh_factory(4))
+    sh2 = shardings_for(abstract, mesh_factory(2))
+    specs4 = jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(lambda s: s.spec, sh4,
+                               is_leaf=lambda x: hasattr(x, "spec")))
+    specs2 = jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(lambda s: s.spec, sh2,
+                               is_leaf=lambda x: hasattr(x, "spec")))
+    assert specs4 == specs2
+
+
+# -- snapshot discipline ------------------------------------------------------
+
+
+def test_snapshotter_exactly_once_per_step():
+    class Recorder:
+        def __init__(self):
+            self.saves = []
+
+        def save(self, step, state, wait=False):
+            assert wait, "resize snapshots must be synchronous"
+            self.saves.append(step)
+
+    rec = Recorder()
+    snap = ElasticSnapshotter(rec)
+    assert snap.snapshot(7, {"w": 1}) == 7
+    assert snap.snapshot(7, {"w": 1}) == 7   # signal raced the loop
+    assert rec.saves == [7]
+    assert snap.snapshot(9, {"w": 2}) == 9   # a later resize saves again
+    assert rec.saves == [7, 9]
+
+
+def test_dir_checkpointer_reads_spec_checkpoint_dir(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "job"))
+    mgr.save(12, {"w": np.arange(4.0)}, wait=True)
+    mgr.close()
+    ckpt = DirCheckpointer()
+    job = {"metadata": {"namespace": "d", "name": "j"},
+           "spec": {"checkpointDir": str(tmp_path / "job")}}
+    assert ckpt.save(job) == 12
+    # the queue's victim-cost read resolves through the learned dir
+    assert ckpt.latest_step("d", "j") == 12
+    assert ckpt.latest_step("d", "unknown") is None
+    assert ckpt.save({"metadata": {}, "spec": {}}) is None
+    ckpt.close()
+
+
+# -- the worker-side coordinator ---------------------------------------------
+
+
+def test_coordinator_shrink_resume_and_spans(tmp_path):
+    """The in-process resize: signal → one snapshot → reshard onto the
+    smaller mesh → resume at step+1, with the snapshot/reshard/resume
+    spans in the job's identity-derived trace."""
+    collector = SpanCollector()
+    signal = ResizeSignal()
+    coord = make_coordinator(
+        tmp_path, signal=signal, tracer=Tracer(collector),
+        job="j", namespace="d", uid="u")
+    state, start = coord.start(4)
+    assert start == 0 and coord.n_slices == 4
+    for step in (1, 2):
+        state, _ = coord.step_fn(state, *data_fn(step))
+        coord.step = step
+    pre = jax.device_get(state.params)
+    signal.request(2)
+    state, resized = coord.maybe_resize(state)
+    assert resized and coord.n_slices == 2
+    assert coord.snapshotter.saves == 1
+    assert signal.pending() is None
+    assert leaves_equal(pre, state.params)   # restore is bit-identical
+    state, _ = coord.step_fn(state, *data_fn(3))
+    coord.step = 3
+    assert int(state.step) == 3              # step clock intact
+
+    trace_id, root = tpujob_trace_ids("d", "j", "u")
+    spans = [s for s in collector.spans() if s.trace_id == trace_id]
+    assert [s.name for s in spans] == [
+        "elastic.snapshot", "elastic.reshard", "elastic.resume"]
+    assert all(s.parent_id == root for s in spans)  # one tree
+
+
+def test_coordinator_shutdown_signal_saves_then_regang_resumes(tmp_path):
+    """SIGTERM shape: the target topology is unknown — snapshot, exit;
+    the re-ganged process resumes through start() on the new world."""
+    signal = ResizeSignal()
+    coord = make_coordinator(tmp_path, signal=signal)
+    state, _ = coord.start(4)
+    state, _ = coord.step_fn(state, *data_fn(1))
+    coord.step = 1
+    signal.request(SHUTDOWN)
+    with pytest.raises(SystemExit):
+        coord.maybe_resize(state)
+    assert coord.snapshotter.saves == 1
+
+    # "fresh pod at the new shape": same checkpoint dir, 2 slices
+    coord2 = make_coordinator(
+        tmp_path, manager=CheckpointManager(str(tmp_path / "ckpt")))
+    state2, start2 = coord2.start(2)
+    assert start2 == 1                       # resume, not re-init
+    assert leaves_equal(state.params, state2.params)
+    state2, _ = coord2.step_fn(state2, *data_fn(2))
+    assert int(state2.step) == 2
+
+
+def test_maybe_resize_noop_when_already_at_target(tmp_path):
+    """The CR nudge keeps reporting the resize until the operator
+    closes it — a polling worker that already resharded in-place must
+    see a NO-OP, not a snapshot-restore cycle per step."""
+    signal = ResizeSignal()
+    coord = make_coordinator(tmp_path, signal=signal)
+    state, _ = coord.start(2)
+    state, _ = coord.step_fn(state, *data_fn(1))
+    coord.step = 1
+    signal.request(2)                        # target == current
+    state, resized = coord.maybe_resize(state)
+    assert resized is False
+    assert coord.snapshotter.saves == 0      # no needless checkpoint
+    assert signal.pending() is None          # consumed, not re-latched
+
+
+def test_newer_signal_survives_a_completing_resize(tmp_path):
+    """Latest-request-wins: a SHUTDOWN latched while the handled
+    resize is mid-flight (the teardown SIGTERM racing the reshard) is
+    NOT wiped by the completion's clear — the next poll handles it."""
+    signal = ResizeSignal()
+    # the barrier runs inside maybe_resize, before the reshard: latch
+    # the racing SHUTDOWN there
+    coord = make_coordinator(
+        tmp_path, signal=signal,
+        barrier=lambda: signal.request(SHUTDOWN))
+    state, _ = coord.start(4)
+    state, _ = coord.step_fn(state, *data_fn(1))
+    coord.step = 1
+    signal.request(2)
+    state, resized = coord.maybe_resize(state)
+    assert resized and coord.n_slices == 2
+    assert signal.pending() == SHUTDOWN      # survived the clear
+    with pytest.raises(SystemExit):
+        coord.maybe_resize(state)            # and is honored next poll
+
+
+def test_cr_resize_target_reads_the_nudge():
+    client = FakeKubeClient()
+    client.create(tpujob("j", "d", {"image": "x", "slices": 2,
+                                    "elastic": {"minSlices": 1,
+                                                "maxSlices": 4}}))
+    assert cr_resize_target(client, "d", "j") is None   # no nudge yet
+    job = client.get(API_VERSION, TPUJOB_KIND, "d", "j")
+    job = dict(job)
+    job["status"] = {"resize": {"requested": True}}
+    client.update_status(job)
+    assert cr_resize_target(client, "d", "j") == 2
+    assert cr_resize_target(client, "d", "missing") is None
+
+
+# -- spec surface -------------------------------------------------------------
+
+
+def test_spec_elastic_validation():
+    ok = TpuJobSpec.from_dict({"image": "x", "slices": 2,
+                               "elastic": {"minSlices": 1,
+                                           "maxSlices": 4}})
+    assert ok.is_elastic and ok.min_slices == 1 and ok.max_slices == 4
+    assert not TpuJobSpec.from_dict({"image": "x"}).is_elastic
+    with pytest.raises(ValueError, match="outside elastic bounds"):
+        TpuJobSpec.from_dict({"image": "x", "slices": 8,
+                              "elastic": {"minSlices": 1,
+                                          "maxSlices": 4}})
+    with pytest.raises(ValueError, match="minSlices"):
+        TpuJobSpec.from_dict({"image": "x",
+                              "elastic": {"minSlices": 0,
+                                          "maxSlices": 2}})
+    with pytest.raises(ValueError, match="maxSlices"):
+        TpuJobSpec.from_dict({"image": "x", "slices": 3,
+                              "elastic": {"minSlices": 3,
+                                          "maxSlices": 2}})
+    with pytest.raises(ValueError, match="must be an object"):
+        TpuJobSpec.from_dict({"image": "x", "elastic": 3})
+
+
+# -- operator + queue control plane ------------------------------------------
+
+
+def _cluster(checkpointer=None):
+    client = FakeKubeClient()
+    for node in fake_slice_nodes("v5e-8", count=4):
+        client.create(node)
+    clock = FakeClock()
+    collector = SpanCollector()
+    tracer = Tracer(collector, clock=clock)
+    ckpt = checkpointer
+    q = GangQueue(client, clock=clock, tracer=tracer,
+                  checkpoint_step=(ckpt.latest_step if ckpt else
+                                   lambda ns, name: None))
+    op = TpuJobOperator(client, clock=clock, tracer=tracer, queue=q,
+                        checkpointer=ckpt)
+    return client, q, op, collector
+
+
+def _pods(client, ns, name):
+    return client.list("v1", "Pod", ns, label_selector={JOB_LABEL: name})
+
+
+def _set_phase(client, ns, name, phase):
+    for pod in _pods(client, ns, name):
+        pod.setdefault("status", {})["phase"] = phase
+        client.update_status(pod)
+
+
+def test_operator_shrink_offer_resizes_instead_of_preempting():
+    """The scheduler's shrink offer flows through the operator as a
+    spec edit + elastic resize: the elastic gang keeps running at
+    minSlices, the preemptor places, and nobody was Preempted."""
+
+    class Ckpt(PreemptionCheckpointer):
+        def save(self, job):
+            return 42
+
+        def latest_step(self, ns, name):
+            return 42
+
+    client, q, op, collector = _cluster(Ckpt())
+    resizes = DEFAULT_REGISTRY.counter("kftpu_job_resizes_total")
+    offers = DEFAULT_REGISTRY.counter("kftpu_shrink_offers_total")
+    r0 = resizes.get(direction="shrink")
+    o0 = offers.get()
+    client.create(tpujob("flex", "d", {
+        "image": "x", "slices": 3, "hostsPerSlice": 2,
+        "elastic": {"minSlices": 1, "maxSlices": 4}}))
+    op.reconcile("d", "flex")
+    _set_phase(client, "d", "flex", "Running")
+    op.reconcile("d", "flex")
+    assert len(_pods(client, "d", "flex")) == 6
+
+    client.create(tpujob("urgent", "prod", {
+        "image": "x", "slices": 2, "hostsPerSlice": 2, "priority": 10}))
+    op.reconcile("prod", "urgent")
+    # offered, not evicted
+    assert q.state_of("d", "flex") == PLACED
+    assert q.shrink_requested("d", "flex") == 1
+    assert offers.get() == o0 + 1
+    job = client.get(API_VERSION, TPUJOB_KIND, "d", "flex")
+    assert job["status"]["resize"]["offered"] == 1
+    assert job["status"]["resize"]["by"] == "prod/urgent"
+
+    # operator applies the offer; the resize runs its three passes
+    op.reconcile("d", "flex")     # spec edit
+    job = client.get(API_VERSION, TPUJOB_KIND, "d", "flex")
+    assert job["spec"]["slices"] == 1
+    op.reconcile("d", "flex")     # nudge
+    op.reconcile("d", "flex")     # snapshot + teardown
+    op.reconcile("d", "flex")     # re-gang at 1 slice
+    op.reconcile("prod", "urgent")
+    assert len(_pods(client, "d", "flex")) == 2
+    assert len(_pods(client, "prod", "urgent")) == 4
+    assert q.state_of("d", "flex") == PLACED
+    assert q.state_of("prod", "urgent") == PLACED
+    assert resizes.get(direction="shrink") == r0 + 1
+    job = client.get(API_VERSION, TPUJOB_KIND, "d", "flex")
+    conds = {(c["type"], c["reason"])
+             for c in job["status"]["conditions"]}
+    assert ("Resizing", "ShrinkOffered") in conds
+    assert ("Resized", "ElasticResize") in conds
+    assert ("Preempted", "RequeuedForPriority") not in conds
+    # the offer decision is in the preemptor's trace
+    uid = client.get(API_VERSION, TPUJOB_KIND, "prod",
+                     "urgent")["metadata"]["uid"]
+    trace_id, _ = tpujob_trace_ids("prod", "urgent", uid)
+    names = [s.name for s in collector.spans()
+             if s.trace_id == trace_id]
+    assert "scheduler.queue.shrink" in names
+    assert "scheduler.queue.preempt" not in names
+
+
+def test_fixed_shape_job_keeps_blind_regang():
+    """No spec.elastic → the original resize behavior is untouched
+    (no nudge pass, no snapshot, no Resized condition)."""
+    client, q, op, _ = _cluster()
+    client.create(tpujob("j", "d", {"image": "x", "slices": 1,
+                                    "hostsPerSlice": 2}))
+    op.reconcile("d", "j")
+    _set_phase(client, "d", "j", "Running")
+    op.reconcile("d", "j")
+    job = client.get(API_VERSION, TPUJOB_KIND, "d", "j")
+    job["spec"]["slices"] = 2
+    client.update(job)
+    op.reconcile("d", "j")          # tears down immediately (one pass)
+    assert _pods(client, "d", "j") == []
+    job = client.get(API_VERSION, TPUJOB_KIND, "d", "j")
+    assert "resize" not in job["status"]
+    op.reconcile("d", "j")
+    assert len(_pods(client, "d", "j")) == 4
+
+
+# -- the end-to-end acceptance ------------------------------------------------
+
+
+def test_elastic_shrink_end_to_end(tmp_path):
+    """ISSUE 11 acceptance: a live elastic TpuJob shrinks 4→2 slices
+    mid-run via a spec.slices edit; the operator drives snapshot →
+    teardown → re-gang; the worker-side coordinator catches the nudge,
+    snapshots once, reshards, resumes at saved_step+1; restored global
+    params are bit-identical to the pre-resize checkpoint;
+    status.telemetry.lastStep stays monotone; the Resized condition +
+    kftpu_job_resizes_total land (and are queryable through the tsdb +
+    the dashboard telemetry route); and the job's trace shows
+    elastic.snapshot → elastic.reshard → elastic.resume in one tree."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    client, q, op, collector = _cluster(DirCheckpointer())
+    resizes = DEFAULT_REGISTRY.counter("kftpu_job_resizes_total")
+    r0 = resizes.get(direction="shrink")
+
+    # 1. control plane: a 4-slice elastic gang goes Running
+    client.create(tpujob("train", "d", {
+        "image": "x", "slices": 4, "hostsPerSlice": 1,
+        "checkpointDir": ckpt_dir,
+        "elastic": {"minSlices": 2, "maxSlices": 4}}))
+    op.reconcile("d", "train")
+    assert len(_pods(client, "d", "train")) == 4
+    _set_phase(client, "d", "train", "Running")
+    uid = client.get(API_VERSION, TPUJOB_KIND, "d",
+                     "train")["metadata"]["uid"]
+
+    # 2. data plane: the gang trains to step 3 on the 4-slice mesh
+    signal = ResizeSignal()
+    coord = make_coordinator(
+        tmp_path, manager=CheckpointManager(ckpt_dir), signal=signal,
+        tracer=Tracer(collector), job="train", namespace="d", uid=uid)
+    state, _ = coord.start(4)
+    losses = {}
+    for step in (1, 2, 3):
+        state, m = coord.step_fn(state, *data_fn(step))
+        coord.step = step
+        losses[step] = float(m["loss"])
+    for w in range(4):
+        publish_beacon(client, "d", "train", w,
+                       {"step": 3, "stepsPerSec": 1.0}, job_uid=uid)
+    op.reconcile("d", "train")
+    job = client.get(API_VERSION, TPUJOB_KIND, "d", "train")
+    assert job["status"]["telemetry"]["lastStep"] == 3
+
+    # 3. the elastic event: spec.slices 4 -> 2
+    job = dict(job)
+    job["spec"] = {**job["spec"], "slices": 2}
+    client.update(job)
+    op.reconcile("d", "train")            # nudge pass: pods still alive
+    job = client.get(API_VERSION, TPUJOB_KIND, "d", "train")
+    assert job["status"]["resize"]["requested"] is True
+    assert len(_pods(client, "d", "train")) == 4
+
+    # 4. worker side: catch the nudge, snapshot, reshard, ready at 2
+    target = cr_resize_target(client, "d", "train")
+    assert target == 2
+    pre_resize_params = jax.device_get(state.params)
+    signal.request(target)
+    state, resized = coord.maybe_resize(state)
+    assert resized and coord.n_slices == 2
+    assert coord.snapshotter.saves == 1
+
+    # 5. operator: snapshot known, teardown, re-gang at the new shape
+    op.reconcile("d", "train")            # checkpoint + teardown
+    assert _pods(client, "d", "train") == []
+    job = client.get(API_VERSION, TPUJOB_KIND, "d", "train")
+    assert job["status"]["resize"]["lastCheckpointStep"] == 3
+    op.reconcile("d", "train")            # re-gang
+    pods = _pods(client, "d", "train")
+    assert len(pods) == 2
+    env = {e["name"]: e["value"]
+           for e in pods[0]["spec"]["containers"][0]["env"]}
+    assert env["KFTPU_NUM_PROCESSES"] == "2"
+    assert env["MEGASCALE_NUM_SLICES"] == "2"
+    job = client.get(API_VERSION, TPUJOB_KIND, "d", "train")
+    conds = {(c["type"], c["reason"])
+             for c in job["status"]["conditions"]}
+    assert ("Resized", "ElasticResize") in conds
+    assert job["status"]["resize"]["requested"] is False
+    assert resizes.get(direction="shrink") == r0 + 1
+
+    # 6. restored params bit-identical to the pre-resize checkpoint;
+    # the step clock survives: resume at saved_step+1
+    assert leaves_equal(pre_resize_params, state.params)
+    state, m = coord.step_fn(state, *data_fn(4))
+    coord.step = 4
+    assert int(state.step) == 4
+
+    # 7. telemetry stays monotone across the shrink; departed workers'
+    # beacons are filtered and GC'd
+    _set_phase(client, "d", "train", "Running")
+    for w in range(2):
+        publish_beacon(client, "d", "train", w,
+                       {"step": 4, "stepsPerSec": 1.0}, job_uid=uid)
+    op.reconcile("d", "train")
+    job = client.get(API_VERSION, TPUJOB_KIND, "d", "train")
+    assert job["status"]["telemetry"]["lastStep"] == 4
+    assert job["status"]["telemetry"]["stragglers"] == []
+
+    # 8. one trace tells the story: snapshot -> reshard -> resume
+    trace_id, root = tpujob_trace_ids("d", "train", uid)
+    spans = [s for s in collector.spans()
+             if s.trace_id == trace_id and s.name.startswith("elastic.")]
+    assert [s.name for s in spans] == [
+        "elastic.snapshot", "elastic.reshard", "elastic.resume"]
+    assert all(s.parent_id == root for s in spans)
+
+    # 9. surfaced: the dashboard telemetry route + the monitoring tsdb
+    from kubeflow_tpu.dashboard.server import DashboardApi
+    from kubeflow_tpu.obs.tsdb import TimeSeriesStore
+
+    api = DashboardApi(client, authorize=lambda *a: True)
+    code, body = api.handle("GET", "/api/jobs/d/train/telemetry", None)
+    assert code == 200
+    assert body["resizes"]["count"] == 1
+    assert body["resizes"]["inProgress"] is False
+    assert body["resizes"]["direction"] == "shrink"
+    assert body["resizes"]["lastCheckpointStep"] == 3
+    store = TimeSeriesStore(clock=FakeClock())
+    store.sample_registry(DEFAULT_REGISTRY)
+    latest = store.latest("kftpu_job_resizes_total",
+                          {"direction": "shrink"})
+    assert latest and latest[0][1].value >= 1.0
+
+
+# -- the Podracer scenario ----------------------------------------------------
+
+
+def test_podracer_scales_actors_learner_never_restarts():
+    """PAPERS.md Podracer shape: actor slices scale 2→1→2 through the
+    reshard path while the learner gang never restarts — its step clock
+    advances once per iteration, strictly monotone."""
+    from kubeflow_tpu.examples import podracer
+
+    out = podracer.main(["--iterations", "6", "--envs-per-actor", "2",
+                         "--hidden", "8"])
+    assert out["learner_steps"] == 6
+    assert out["learner_monotone"] is True
+    assert out["actor_resizes"] == 2          # 2 -> 1 -> 2
+    assert out["actor_slices"] == 2

@@ -75,3 +75,74 @@ def test_validate_mesh_for_model():
     validate_mesh_for_model(MeshConfig(dp=2, tp=4), n_heads=8, d_ff=256)
     with pytest.raises(ValueError):
         validate_mesh_for_model(MeshConfig(tp=3), n_heads=8, d_ff=256)
+
+
+def test_mesh_for_slices_shrink_grow_and_reject():
+    """Elastic mesh recompute (kubeflow_tpu/elastic/reshard.py): the
+    4->2 shrink and 2->4 grow rebuild cleanly over the surviving device
+    set; a slice count the devices cannot realize (non-pow2 on a pow2
+    fleet) is rejected loudly."""
+    from kubeflow_tpu.elastic.reshard import mesh_for_slices
+
+    devs = jax.devices()
+    m4 = mesh_for_slices(4, devices=devs)            # 4 slices x 2 chips
+    assert dict(zip(m4.axis_names, m4.devices.shape)) == {
+        "dcn": 4, "dp": 2, "pp": 1, "tp": 1}
+    m2 = mesh_for_slices(2, devices=devs[:4])        # shrink: 2 x 2
+    assert dict(zip(m2.axis_names, m2.devices.shape)) == {
+        "dcn": 2, "dp": 2, "pp": 1, "tp": 1}
+    grown = mesh_for_slices(4, devices=devs)         # grow back
+    assert grown.devices.shape == m4.devices.shape
+    with pytest.raises(ValueError, match="do not divide"):
+        mesh_for_slices(3, devices=devs)             # non-pow2 reject
+    with pytest.raises(ValueError, match=">= 1"):
+        mesh_for_slices(0, devices=devs)
+    with pytest.raises(ValueError, match="does not divide slice size"):
+        mesh_for_slices(4, devices=devs, tp=4)       # 2 chips/slice
+
+
+def test_state_partition_specs_pure_function_of_logical_axes():
+    """The reshard invariant: state_partition_specs is a pure function
+    of the logical axes — byte-equal spec trees no matter which
+    topology is current, so a checkpoint reshards by swapping ONLY the
+    mesh under the same specs."""
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.elastic.reshard import mesh_for_slices
+    from kubeflow_tpu.models import Transformer, TransformerConfig
+    from kubeflow_tpu.train import TrainState, make_optimizer
+    from kubeflow_tpu.train.trainer import state_partition_specs
+
+    config = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_ff=64, max_seq_len=16, dtype=jnp.float32, remat=False)
+    model = Transformer(config)
+    tx = make_optimizer(1e-3, warmup_steps=1, decay_steps=10)
+    sample = jnp.zeros((8, 8), jnp.int32)
+
+    def init_fn(rng):
+        params = model.init(rng, sample)["params"]
+        return TrainState.create(apply_fn=model.apply, params=params,
+                                 tx=tx)
+
+    abstract = jax.eval_shape(init_fn, jax.random.key(0))
+    # specs never see a mesh: identical trees across any recompute
+    specs_a = state_partition_specs(abstract)
+    specs_b = state_partition_specs(abstract)
+    assert jax.tree_util.tree_all(jax.tree_util.tree_map(
+        lambda a, b: a == b, specs_a, specs_b,
+        is_leaf=lambda x: isinstance(x, P)))
+    # and the mesh-bound shardings agree on the SPEC for both
+    # topologies (4 slices vs 2) — only the mesh differs
+    from kubeflow_tpu.elastic.reshard import shardings_for
+
+    devs = jax.devices()
+    sh4 = shardings_for(abstract, mesh_for_slices(4, devices=devs))
+    sh2 = shardings_for(abstract, mesh_for_slices(2, devices=devs[:4]))
+    flat4 = jax.tree_util.tree_leaves(
+        sh4, is_leaf=lambda x: hasattr(x, "spec"))
+    flat2 = jax.tree_util.tree_leaves(
+        sh2, is_leaf=lambda x: hasattr(x, "spec"))
+    assert [s.spec for s in flat4] == [s.spec for s in flat2]
+    assert {s.mesh.devices.shape[0] for s in flat4} == {4}
+    assert {s.mesh.devices.shape[0] for s in flat2} == {2}

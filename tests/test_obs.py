@@ -396,6 +396,13 @@ def test_request_latency_histogram_in_exposition(serving_stack):
                          {"prompt_tokens": prompt.tolist(),
                           "max_new_tokens": 2})
     assert status == 200
+    # the proxy observes AFTER writing the response (the span's finally
+    # block), so the client can get here before the handler thread has
+    # ticked the histogram — wait for the observation, bounded
+    deadline = time.monotonic() + 5.0
+    while (_latency_h.get(route="/serving/", code="200") < before + 1
+           and time.monotonic() < deadline):
+        time.sleep(0.01)
     after = _latency_h.get(route="/serving/", code="200")
     assert after == before + 1
     counts = _latency_h.bucket_counts(route="/serving/", code="200")

@@ -63,12 +63,12 @@ class FakeClock:
 
 
 def _gang(ns, name, *, slices=1, hosts=2, priority=0, preemptible=True,
-          total_steps=None, accelerator="v5e-8", uid=""):
+          total_steps=None, accelerator="v5e-8", uid="", min_slices=None):
     return GangRequest(namespace=ns, name=name, slices=slices,
                        hosts_per_slice=hosts, chips_per_host=4,
                        accelerator=accelerator, priority=priority,
                        preemptible=preemptible, total_steps=total_steps,
-                       uid=uid)
+                       uid=uid, min_slices=min_slices)
 
 
 def _quota(client, ns, chips):
@@ -256,6 +256,141 @@ def test_empty_inventory_places_unpinned():
     q.submit(_gang("d", "j"))
     q.schedule()
     assert q.placement_for("d", "j") == []  # placed, selector-only
+
+
+def test_aging_bounds_unpredicted_wait():
+    """Fairness aging (bounded wait): a stream of predicted-short gangs
+    beats a fresh unpredicted gang, but once the unpredicted gang has
+    waited past aging_max_wait_s minus their remaining estimate, it
+    ranks ahead — starvation is bounded, not open-ended."""
+    client = FakeKubeClient()
+    _seed(client, count=1)                  # one slice: strict ordering
+    clock = FakeClock(step=0.0)             # advance manually
+    q = make_queue(client, clock=clock, aging_max_wait_s=10.0)
+    q.submit(_gang("d", "patient"))         # unpredicted: rank ~10
+    q.predictor.observe("d", "quick1", steps_per_sec=1.0, last_step=998)
+    q.submit(_gang("d", "quick1", total_steps=1000))   # remaining 2s
+    q.schedule()
+    assert q.state_of("d", "quick1") == PLACED   # short wins early
+    assert q.state_of("d", "patient") == QUEUED
+    q.release("d", "quick1")
+    clock.t += 9.0                          # patient aged: rank ~1 < 2
+    q.predictor.observe("d", "quick2", steps_per_sec=1.0, last_step=998)
+    q.submit(_gang("d", "quick2", total_steps=1000))
+    q.schedule()
+    assert q.state_of("d", "patient") == PLACED  # bounded-wait kept
+    assert q.state_of("d", "quick2") == QUEUED
+
+
+def test_unpredicted_fifo_order_kept_under_aging():
+    """Two unpredicted gangs age identically: FIFO order between them
+    is preserved (the earlier submit has waited longer, ranks first)."""
+    client = FakeKubeClient()
+    _seed(client, count=1)
+    q = make_queue(client)
+    q.submit(_gang("d", "first"))
+    q.submit(_gang("d", "second"))
+    q.schedule()
+    assert q.state_of("d", "first") == PLACED
+    assert q.state_of("d", "second") == QUEUED
+
+
+# -- queue: shrink offers to elastic gangs ------------------------------------
+
+
+def test_shrink_offer_instead_of_preemption():
+    """An elastic gang (min_slices floor) is OFFERED a shrink before
+    anyone is evicted: the victim stays PLACED (the run keeps making
+    progress), the CR carries the status.resize.offered nudge, and the
+    preemptor's accelerator is reserved while the shrink settles."""
+    client = FakeKubeClient()
+    _seed(client, count=4)
+    q = make_queue(client)
+    client.create(tpujob("flex", "d", {
+        "image": "x", "slices": 3, "hostsPerSlice": 2,
+        "elastic": {"minSlices": 1, "maxSlices": 4}}))
+    q.submit(_gang("d", "flex", slices=3, hosts=2, min_slices=1))
+    q.schedule()
+    assert q.state_of("d", "flex") == PLACED
+    offers_before = DEFAULT_REGISTRY.counter(
+        "kftpu_shrink_offers_total").get()
+    q.submit(_gang("prod", "urgent", slices=2, hosts=2, priority=10))
+    q.schedule()
+    # offered, never Preempting
+    assert q.state_of("d", "flex") == PLACED
+    assert q.shrink_requested("d", "flex") == 1
+    assert DEFAULT_REGISTRY.counter(
+        "kftpu_shrink_offers_total").get() == offers_before + 1
+    job = client.get(API_VERSION, TPUJOB_KIND, "d", "flex")
+    assert job["status"]["resize"]["offered"] == 1
+    assert job["status"]["resize"]["by"] == "prod/urgent"
+    # nobody backfills the accelerator while the shrink settles, and
+    # the offer is not widened to a second victim
+    q.submit(_gang("d", "tiny", slices=1))
+    q.schedule()
+    assert q.state_of("d", "tiny") == QUEUED
+    assert q.shrink_requested("d", "tiny") is None
+    # the resize arrives (operator applied the spec edit): the offer
+    # settles, the preemptor and the shrunk gang both place
+    q.submit(_gang("d", "flex", slices=1, hosts=2, min_slices=1))
+    q.schedule()
+    assert q.shrink_requested("d", "flex") is None
+    assert q.state_of("prod", "urgent") == PLACED
+    assert q.state_of("d", "flex") == PLACED
+
+
+def test_shrink_offer_revoked_when_preemptor_goes_away():
+    """An offer whose beneficiary vanishes (released) or places
+    elsewhere is WITHDRAWN: the victim's shrink_to clears and the CR
+    nudge is erased — the elastic gang never pays a
+    checkpoint-teardown-reshard for nobody."""
+    client = FakeKubeClient()
+    _seed(client, count=4)
+    q = make_queue(client)
+    client.create(tpujob("flex", "d", {
+        "image": "x", "slices": 3, "hostsPerSlice": 2,
+        "elastic": {"minSlices": 1, "maxSlices": 4}}))
+    q.submit(_gang("d", "flex", slices=3, hosts=2, min_slices=1))
+    q.schedule()
+    q.submit(_gang("prod", "urgent", slices=2, hosts=2, priority=10))
+    q.schedule()
+    assert q.shrink_requested("d", "flex") == 1
+    # the preemptor is deleted before the operator applies the offer
+    q.release("prod", "urgent")
+    assert q.shrink_requested("d", "flex") is None
+    job = client.get(API_VERSION, TPUJOB_KIND, "d", "flex")
+    assert "offered" not in (job["status"].get("resize") or {})
+    # and the next cycle does not re-offer (nothing is waiting)
+    q.schedule()
+    assert q.shrink_requested("d", "flex") is None
+
+    # placed-elsewhere variant: capacity frees while the offer pends
+    q.submit(_gang("prod", "urgent2", slices=2, hosts=2, priority=10))
+    q.schedule()
+    assert q.shrink_requested("d", "flex") == 1
+    q.release("d", "flex")          # flex finishes on its own
+    q.schedule()                    # urgent2 places on the freed slices
+    assert q.state_of("prod", "urgent2") == PLACED
+
+
+def test_shrink_infeasible_falls_back_to_eviction():
+    """A floor that cannot free enough capacity is no offer at all —
+    the queue falls back to the normal minimum-cost eviction."""
+    client = FakeKubeClient()
+    _seed(client, count=2)
+    q = make_queue(client)
+    client.create(tpujob("flex", "d", {"image": "x", "slices": 2,
+                                       "hostsPerSlice": 2,
+                                       "elastic": {"minSlices": 1,
+                                                   "maxSlices": 2}}))
+    q.submit(_gang("d", "flex", slices=2, hosts=2, min_slices=1))
+    q.schedule()
+    assert q.state_of("d", "flex") == PLACED
+    # urgent needs BOTH slices: shrinking flex to 1 still blocks it
+    q.submit(_gang("prod", "urgent", slices=2, hosts=2, priority=10))
+    q.schedule()
+    assert q.shrink_requested("d", "flex") is None
+    assert q.state_of("d", "flex") == PREEMPTING
 
 
 # -- queue: preemption -------------------------------------------------------
