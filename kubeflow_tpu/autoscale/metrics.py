@@ -166,14 +166,24 @@ class MetricsAggregator:
 
     def observe_engine(self, model: str, engine,
                        now: Optional[float] = None) -> None:
-        """Poll a :class:`~kubeflow_tpu.serving.engine.DecodeEngine`.
+        """Poll a :class:`~kubeflow_tpu.serving.engine.DecodeEngine`
+        (or a :class:`~kubeflow_tpu.serving.multiplex.ModelMultiplexer`
+        wrapping one — its snapshot is an engine-snapshot superset).
 
         Paged engines report their page pool (``pages_total`` /
         ``pages_free``): token-level occupancy. A few long-context
         streams can exhaust KV pages while most slots sit free, so the
         concurrency signal is the WORSE of slot occupancy and page
         occupancy scaled to slot units — scale decisions then track
-        tokens, not just row count."""
+        tokens, not just row count.
+
+        Multiplexed backends additionally report model-occupancy
+        (``models_resident`` / ``models_max``): resident-weight
+        pressure. A backend whose weight pager is thrashing needs
+        capacity even with KV pages free, so the same worse-of fold
+        applies — idle resident models (``models_evictable``) are
+        reclaimable cache, not load, exactly like evictable prefix
+        pages."""
         snap = engine.snapshot()
         active = float(snap["active_slots"])
         pages_total = float(snap.get("pages_total") or 0.0)
@@ -185,6 +195,17 @@ class MetricsAggregator:
                     - float(snap.get("pages_evictable", 0.0)))
             util = max(0.0, held) / pages_total
             active = max(active, util * float(snap.get("slots", 0.0)))
+        models_max = float(snap.get("models_max") or 0.0)
+        if models_max > 0:
+            held_m = (float(snap.get("models_resident", 0.0))
+                      + float(snap.get("models_loading", 0.0))
+                      - float(snap.get("models_evictable", 0.0)))
+            util_m = max(0.0, held_m) / models_max
+            # slot units when an engine is attached; the pager's own
+            # capacity otherwise (a standalone multiplexer still has to
+            # produce a non-zero signal)
+            unit = float(snap.get("slots") or 0.0) or models_max
+            active = max(active, util_m * unit)
         self.observe(model, queue_depth=snap["pending"],
                      active_slots=active, now=now)
 

@@ -995,6 +995,115 @@ def bench_serving(requests: int = 200, batch: int = 8,
 
 # -- runner ------------------------------------------------------------------
 
+def bench_edge_fleet(replicas: int = 3, prefixes: int = 4,
+                     repeats: int = 16, page_size: int = 16,
+                     burst: int = 48) -> Dict[str, Any]:
+    """Fleet-edge routing quality + multiplex cold start (docs/EDGE.md).
+
+    Host-side control-plane numbers (routing, shedding, weight paging
+    are CPU work wherever the chips are), adjudicable every round:
+
+    - ``edge_affinity_hit_rate`` vs ``edge_round_robin_hit_rate``:
+      fleet prefix-trie hit rate for the SAME repeated-prefix stream
+      under both policies — the routing win as one number;
+    - ``edge_shed_fraction``: fraction of a 2x-capacity burst shed at
+      overload pressure (the shed-before-collapse knee);
+    - ``multiplex_cold_start_ms``: wall time to fault a real exported
+      model's weights from a versioned store (the "cold-start ms, not
+      s" ROADMAP bar).
+    """
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.edge.fleet import (
+        FleetEdge,
+        FleetRequest,
+        FleetRouter,
+        ReplicaSim,
+        SloAdmissionGate,
+        fleet_prefix_hits,
+        sim_dispatch,
+    )
+    from kubeflow_tpu.models import MnistCnn
+    from kubeflow_tpu.serving.model_store import export_model
+    from kubeflow_tpu.serving.multiplex import ModelMultiplexer
+
+    rng = np.random.default_rng(11)
+    stream = []
+    for p in range(prefixes):
+        prefix = np.arange(1000 * p, 1000 * p + 3 * page_size,
+                           dtype=np.int32)
+        for _ in range(repeats):
+            suffix = rng.integers(50000, 60000, size=page_size // 2)
+            stream.append((np.concatenate([prefix, suffix])
+                           .astype(np.int32), int(prefix.size)))
+
+    def hit_rate(policy: str) -> float:
+        sims = {f"r{i}": ReplicaSim(f"r{i}", page_size=page_size)
+                for i in range(replicas)}
+        router = FleetRouter(page_size=page_size, policy=policy)
+        router.sync({n: f"http://{n}" for n in sims})
+        edge = FleetEdge(router, SloAdmissionGate(),
+                         dispatch=sim_dispatch(sims))
+        for prompt, prefix_len in stream:
+            code, _ = edge.handle(FleetRequest(prompt=prompt,
+                                               prefix_len=prefix_len))
+            assert code == 200
+        return fleet_prefix_hits(sims) / len(stream)
+
+    affinity_rate = hit_rate("affinity")
+    rr_rate = hit_rate("round_robin")
+
+    # overload burst: every replica at near-exhausted pages
+    sims = {f"r{i}": ReplicaSim(f"r{i}", page_size=page_size)
+            for i in range(replicas)}
+    router = FleetRouter(page_size=page_size)
+    router.sync({n: f"http://{n}" for n in sims})
+    gate = SloAdmissionGate()
+    edge = FleetEdge(router, gate, dispatch=sim_dispatch(sims))
+    for n in sims:
+        gate.observe_snapshot(n, {"pages_total": 100, "pages_free": 5,
+                                  "slots": 4, "pending": 0})
+    classes = ("interactive", "standard", "batch")
+    shed = 0
+    for i in range(burst):
+        code, _ = edge.handle(FleetRequest(
+            prompt=np.arange(2 * page_size),
+            headers={"X-Kftpu-Slo-Class": classes[i % len(classes)]}))
+        shed += code == 503
+
+    # multiplex cold start against a real store artifact
+    model = MnistCnn()
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 28, 28, 1)))["params"]
+    with tempfile.TemporaryDirectory() as store_root:
+        export_model(os.path.join(store_root, "m0"), "mnist", params,
+                     version=1)
+        export_model(os.path.join(store_root, "m1"), "mnist", params,
+                     version=1)
+        mux = ModelMultiplexer(store_root, max_resident=1)
+        mux.get("m0")
+        mux.get("m1")            # pages m0 out
+        cold = mux.get("m0")     # a real re-fault from disk
+        assert cold.kind == "mnist"
+        snap = mux.snapshot()
+        cold_ms = snap["models"]["m0"]["cold_start_ms"]
+
+    return {
+        "edge_affinity_hit_rate": round(affinity_rate, 4),
+        "edge_round_robin_hit_rate": round(rr_rate, 4),
+        "edge_shed_fraction": round(shed / burst, 4),
+        "multiplex_cold_start_ms": round(cold_ms, 3),
+        "multiplex_loads": snap["multiplex_loads"],
+        "replicas": replicas,
+        "requests": len(stream),
+        "burst": burst,
+    }
+
+
 CONFIGS: Dict[str, Callable[[], Dict[str, Any]]] = {
     "mnist": bench_mnist,
     "resnet50": bench_resnet50,
@@ -1004,6 +1113,7 @@ CONFIGS: Dict[str, Callable[[], Dict[str, Any]]] = {
     "serving": bench_serving,
     "decode": bench_decode,
     "decode_engine": bench_decode_engine,
+    "edge_fleet": bench_edge_fleet,
 }
 
 
@@ -1145,6 +1255,8 @@ _CPU_SMOKE_ARGS: Dict[str, Dict[str, Any]] = {
                       "new_tokens": 8, "steps_per_sync": 2,
                       "d_model": 128, "n_layers": 2, "n_heads": 4,
                       "d_ff": 256},
+    "edge_fleet": {"replicas": 3, "prefixes": 2, "repeats": 4,
+                   "page_size": 4, "burst": 12},
 }
 
 

@@ -200,7 +200,8 @@ class DashboardApi:
                  collector: Optional[SpanCollector] = None,
                  scheduler_queue=None,
                  tsdb=None,
-                 alerts=None) -> None:
+                 alerts=None,
+                 edge=None) -> None:
         from kubeflow_tpu.tenancy.authz import default_authorizer
 
         self.client = client
@@ -231,6 +232,9 @@ class DashboardApi:
         # to ask — and the registry's kftpu_alerts_* series for alerts)
         self.tsdb = tsdb
         self.alerts = alerts
+        # anything with .status() (a fleet FleetEdge); None = the
+        # registry's kftpu_edge_* / kftpu_multiplex_* series only
+        self.edge = edge
 
     def _authz(self, user: str, ns: str, resource: str) -> None:
         if not self.authorize(user, "get", ns, resource):
@@ -263,6 +267,8 @@ class DashboardApi:
                 return 200, self.autoscale_view()
             if path == "/api/metrics/scheduler":
                 return 200, self.scheduler_view()
+            if path == "/api/metrics/edge":
+                return 200, self.edge_view()
             if path == "/api/metrics/query":
                 return self.metrics_query(query)
             if path == "/api/alerts":
@@ -418,6 +424,21 @@ class DashboardApi:
         exposition = DEFAULT_REGISTRY.expose()
         return {"metrics": _parse_prom(exposition, "kftpu_queue_")
                 + _parse_prom(exposition, "kftpu_preemptions_total")}
+
+    def edge_view(self) -> Dict[str, Any]:
+        """The fleet serving edge's state for the serving panel
+        (docs/EDGE.md): replica ring membership, per-replica in-flight
+        and pressure, SLO-class table and shed counts, multiplex
+        residency from an in-process
+        :class:`~kubeflow_tpu.edge.fleet.FleetEdge`; with none
+        attached, the registry's ``kftpu_edge_*`` /
+        ``kftpu_multiplex_*`` series still answer "is the edge
+        shedding"."""
+        if self.edge is not None:
+            return self.edge.status()
+        exposition = DEFAULT_REGISTRY.expose()
+        return {"metrics": _parse_prom(exposition, "kftpu_edge_")
+                + _parse_prom(exposition, "kftpu_multiplex_")}
 
     def metrics_query(self, query: str) -> Tuple[int, Any]:
         """The monitoring query API over the in-process tsdb

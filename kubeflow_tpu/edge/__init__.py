@@ -6,7 +6,10 @@ or the IAP/Envoy ingress (``/root/reference/kubeflow/gcp/iap.libsonnet``),
 with basic-auth via gatekeeper + kflogin. Here the gateway is in-framework:
 :mod:`kubeflow_tpu.edge.proxy` terminates the session cookie, stamps the
 verified identity header, and routes path prefixes to the platform's
-services.
+services. Behind it, :mod:`kubeflow_tpu.edge.fleet` composes the
+serving fleet — prefix-affinity routing over a bounded-load
+consistent-hash ring (:mod:`kubeflow_tpu.edge.affinity`) plus
+SLO-class load shedding (docs/EDGE.md).
 """
 
 from kubeflow_tpu.edge.proxy import EdgeProxy, Route  # noqa: F401

@@ -1,0 +1,212 @@
+"""Prefix-affinity keys + bounded-load consistent-hash ring.
+
+The fleet edge (:mod:`kubeflow_tpu.edge.fleet`) routes a request by
+its page-aligned prompt prefix so repeated/shared-prefix prompts land
+on the replica whose prefix trie already holds those KV pages
+(docs/EDGE.md). Two pieces live here:
+
+**Chain keys.** :class:`~kubeflow_tpu.serving.kvpool.PrefixPageStore`
+keys each trie node on ONE full page of prompt tokens
+(``tokens[i*ps:(i+1)*ps].tobytes()`` of the int32 prompt) chained under
+its predecessor page. :func:`page_chain_hashes` builds a digest chain
+over exactly those byte slices — ``h_i = blake2b(h_{i-1} || page_i)``
+— so two prompts produce the same depth-``k`` router key **iff** their
+first ``k`` pages would share the same trie chain on a backend. Router
+keys and trie keys agree by construction, not by convention: there is
+no second tokenizer-ish normalization step to drift.
+
+**Bounded-load ring.** Replicas hash onto a consistent-hash ring of
+virtual nodes; a key routes to the first ring position clockwise of its
+hash whose replica is under its load bound (the classic
+consistent-hashing-with-bounded-loads shape: capacity per replica is
+``ceil(load_factor * (total_inflight + 1) / n)``). Replica add/remove
+remaps only the arcs adjacent to the changed virtual nodes, and a hot
+prefix spills to the NEXT ring position once its home replica hits the
+bound — affinity never melts one backend.
+
+Deterministic by design: blake2b digests, no process-seeded hashing —
+the same fleet membership routes the same keys everywhere (every edge
+pod computes the same ring).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+# digest size: 16 bytes is plenty for ring placement and collision
+# resistance at fleet scale, and keeps keys printable in span attrs
+_DIGEST_BYTES = 16
+
+
+def _page_bytes(tokens: np.ndarray, i: int, page_size: int) -> bytes:
+    return tokens[i * page_size:(i + 1) * page_size].tobytes()
+
+
+def page_chain_hashes(tokens, prefix_len: int, page_size: int, *,
+                      max_pages: int = 0) -> List[str]:
+    """Digest chain over the FULL pages of ``tokens[:prefix_len]``.
+
+    ``out[k]`` keys the chain of pages ``0..k`` — the same chain a
+    backend's :class:`~kubeflow_tpu.serving.kvpool.PrefixPageStore`
+    walks, built from the same int32 page byte slices. The partial
+    boundary page is deliberately excluded: the trie shares it
+    copy-on-write under the last FULL node, so the full-page chain is
+    the unit of cross-request affinity. ``max_pages`` (> 0) stops the
+    chain at that depth — the capped router key costs O(max_pages)
+    hashing however long the prompt runs (this sits on the dispatch
+    hot path)."""
+    toks = np.asarray(tokens, np.int32).reshape(-1)
+    prefix_len = min(int(prefix_len), int(toks.size))
+    n_full = max(0, prefix_len) // int(page_size)
+    if max_pages > 0:
+        n_full = min(n_full, int(max_pages))
+    out: List[str] = []
+    h = b""
+    for i in range(n_full):
+        h = hashlib.blake2b(h + _page_bytes(toks, i, page_size),
+                            digest_size=_DIGEST_BYTES).digest()
+        out.append(h.hex())
+    return out
+
+
+def affinity_key(tokens, prefix_len: int, page_size: int, *,
+                 max_pages: int = 0) -> Optional[str]:
+    """The routing key for a request: the deepest chain digest of its
+    page-aligned prefix, or None when the prefix holds no full page
+    (nothing a backend trie could share — the router falls back to
+    load-based placement).
+
+    ``max_pages`` caps the chain depth (0 = uncapped): keying on the
+    first few pages groups prompts that share a long system prefix but
+    diverge later onto the SAME replica, which is where the shared
+    pages live."""
+    chain = page_chain_hashes(tokens, prefix_len, page_size,
+                              max_pages=max_pages)
+    return chain[-1] if chain else None
+
+
+def _point(s: str) -> int:
+    """A ring position in [0, 2^64): deterministic across processes."""
+    return int.from_bytes(
+        hashlib.blake2b(s.encode(), digest_size=8).digest(), "big")
+
+
+class HashRing:
+    """Bounded-load consistent-hash ring over named replicas.
+
+    ``vnodes`` virtual nodes per replica smooth the arc distribution;
+    ``load_factor`` (> 1.0) bounds how far any replica may run above
+    the fleet mean before keys spill to the next position.
+    """
+
+    def __init__(self, replicas: Iterable[str] = (), *,
+                 vnodes: int = 64, load_factor: float = 1.25) -> None:
+        if vnodes < 1:
+            raise ValueError("vnodes must be >= 1")
+        if load_factor <= 1.0:
+            raise ValueError("load_factor must be > 1.0 (1.0 leaves no "
+                             "headroom and every hot key would spill)")
+        self.vnodes = int(vnodes)
+        self.load_factor = float(load_factor)
+        self._points: List[Tuple[int, str]] = []  # sorted (position, replica)
+        self._replicas: Dict[str, List[int]] = {}
+        for r in replicas:
+            self.add(r)
+
+    # -- membership --------------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._replicas)
+
+    def __contains__(self, replica: str) -> bool:
+        return replica in self._replicas
+
+    @property
+    def replicas(self) -> Tuple[str, ...]:
+        return tuple(sorted(self._replicas))
+
+    def add(self, replica: str) -> None:
+        if replica in self._replicas:
+            return
+        points = [_point(f"{replica}#{i}") for i in range(self.vnodes)]
+        self._replicas[replica] = points
+        for p in points:
+            bisect.insort(self._points, (p, replica))
+
+    def remove(self, replica: str) -> None:
+        points = self._replicas.pop(replica, None)
+        if points is None:
+            return
+        self._points = [(p, r) for p, r in self._points if r != replica]
+
+    def sync(self, replicas: Iterable[str]) -> Tuple[List[str], List[str]]:
+        """Make membership match ``replicas`` (the autoscaler's current
+        ready set); returns ``(added, removed)``. Only the changed
+        replicas' arcs remap — surviving assignments are untouched, so
+        a scale event never cold-starts the whole fleet's prefix
+        locality."""
+        want = set(replicas)
+        added = sorted(want - set(self._replicas))
+        removed = sorted(set(self._replicas) - want)
+        for r in added:
+            self.add(r)
+        for r in removed:
+            self.remove(r)
+        return added, removed
+
+    # -- routing -----------------------------------------------------------
+
+    def _walk(self, key: str):
+        """Replicas in ring order from the key's hash point, each
+        yielded once (distinct-replica walk)."""
+        if not self._points:
+            return
+        # chr(0x10FFFF) sorts after any replica name sharing the exact
+        # hash point, so the walk starts strictly clockwise of the key
+        start = bisect.bisect_right(self._points,
+                                    (_point(key), chr(0x10FFFF)))
+        seen = set()
+        n = len(self._points)
+        for i in range(n):
+            _, replica = self._points[(start + i) % n]
+            if replica not in seen:
+                seen.add(replica)
+                yield replica
+
+    def owner(self, key: str) -> Optional[str]:
+        """The key's home replica, ignoring load (the arc assignment —
+        what bounded-load routing degrades to at low load)."""
+        for replica in self._walk(key):
+            return replica
+        return None
+
+    def route(self, key: str,
+              load_of: Callable[[str], float]) -> Optional[Tuple[str, bool]]:
+        """``(replica, spilled)`` for a key under the load bound, or
+        None on an empty ring. ``spilled`` is True when the home
+        replica was at capacity and the key moved down-ring."""
+        if not self._replicas:
+            return None
+        loads = {r: float(load_of(r)) for r in self._replicas}
+        total = sum(loads.values())
+        # the request being placed counts toward the mean (total + 1),
+        # keeping the bound strictly positive: an idle home replica
+        # (load 0) always takes the first request for its arc. Note
+        # the idle bound is load_factor/n, NOT >= 1 — on an otherwise
+        # idle fleet the second concurrent request for one key already
+        # spills, by design (the bound prices the fleet mean)
+        bound = self.load_factor * (total + 1.0) / len(self._replicas)
+        first = None
+        for replica in self._walk(key):
+            if first is None:
+                first = replica
+            if loads[replica] < bound:
+                return replica, replica is not first
+        # every replica at the bound simultaneously can only happen on
+        # adversarial load_of readings; degrade to least-loaded
+        least = min(self._replicas, key=lambda r: (loads[r], r))
+        return least, least is not first

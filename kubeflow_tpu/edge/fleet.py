@@ -1,0 +1,912 @@
+"""Fleet serving edge: prefix-affinity routing + SLO-class load shedding.
+
+The composition layer over pieces that already exist (docs/EDGE.md):
+the edge proxy fronts the fleet, the autoscaler owns the replica set,
+each replica runs the paged decode engine with its prefix trie
+(PR 6/7), and every hop is traced (PR 3). This module makes them one
+edge that serves millions of users fast:
+
+- **Prefix-affinity routing** (:class:`FleetRouter`): a request's
+  page-aligned prompt prefix hashes — same content-hash-chain scheme
+  as the backend trie, :mod:`kubeflow_tpu.edge.affinity` — onto a
+  bounded-load consistent-hash ring of replicas. Repeated and
+  shared-prefix prompts land on the replica whose trie already holds
+  those pages, turning per-replica ``prefix_hits`` into a fleet
+  property; scale events remap only the affected arcs, and a hot
+  prefix spills down-ring before it melts one backend.
+- **SLO-class admission** (:class:`SloAdmissionGate`): requests carry
+  a class (``X-Kftpu-Slo-Class`` header against a table), and under
+  overload the edge sheds lowest-class-first BEFORE queue collapse —
+  the gate watches the backend queue-wait / free-page telemetry the
+  edge already scrapes, every shed increments
+  ``kftpu_edge_shed_total{class}`` and records an ``edge.shed`` span
+  in the request's trace. Shedding gates ADMISSION only: an in-flight
+  streamed response is never cut.
+- **Model multiplexing** rides along per backend
+  (:mod:`kubeflow_tpu.serving.multiplex`): the router is
+  model-agnostic, the multiplexer's snapshot feeds the same autoscaler
+  poll, and the fleet view surfaces cold-start ms per model.
+
+Everything here is host-side control plane: deterministic, injectable
+clock/dispatch, adjudicable on CPU (hit-rate and shed counters, not
+chip clocks).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import threading
+from typing import Any, Callable, Dict, Iterator, List, Mapping, Optional, Tuple
+
+import numpy as np
+
+from kubeflow_tpu.edge.affinity import HashRing, affinity_key
+from kubeflow_tpu.obs import TRACER
+from kubeflow_tpu.utils import DEFAULT_REGISTRY
+
+log = logging.getLogger(__name__)
+
+_shed_c = DEFAULT_REGISTRY.counter(
+    "kftpu_edge_shed_total", "requests shed by SLO class under overload")
+_fleet_requests_c = DEFAULT_REGISTRY.counter(
+    "kftpu_edge_fleet_requests_total", "requests dispatched per replica")
+_spills_c = DEFAULT_REGISTRY.counter(
+    "kftpu_edge_affinity_spills_total",
+    "affinity keys routed past their home replica by the load bound")
+_pressure_g = DEFAULT_REGISTRY.gauge(
+    "kftpu_edge_fleet_pressure", "fleet overload pressure [0, 1]")
+
+SLO_HEADER = "X-Kftpu-Slo-Class"
+
+# class -> (rank, shed_at): rank orders criticality (higher survives
+# longer), shed_at is the fleet pressure at which the class sheds.
+# Lowest-class-first by construction: shed_at grows with rank, and
+# "interactive" holds until actual collapse territory.
+DEFAULT_SLO_CLASSES: Dict[str, Tuple[int, float]] = {
+    "batch": (0, 0.70),
+    "standard": (1, 0.90),
+    "interactive": (2, 0.98),
+}
+DEFAULT_SLO_CLASS = "standard"
+
+
+def slo_classes_from_env() -> Dict[str, Tuple[int, float]]:
+    """``KFTPU_SLO_CLASSES`` JSON (``{"name": [rank, shed_at], ...}``)
+    or the default table."""
+    raw = os.environ.get("KFTPU_SLO_CLASSES", "")
+    if not raw:
+        return dict(DEFAULT_SLO_CLASSES)
+    table = {}
+    for name, spec in json.loads(raw).items():
+        rank, shed_at = spec
+        table[str(name)] = (int(rank), float(shed_at))
+    return table
+
+
+class DispatchError(RuntimeError):
+    """A dispatch that failed WITH a meaningful status: the edge
+    relays ``code``/``payload`` to the client (the status-relay
+    convention the other proxies follow — a backend 400 must reach the
+    client as a 400, a dead replica as a 502, never a generic edge
+    500)."""
+
+    def __init__(self, code: int, payload: Any) -> None:
+        super().__init__(f"dispatch failed with {code}")
+        self.code = int(code)
+        self.payload = payload
+
+
+# pages of prefix the router keys on by default: deep enough to cover
+# typical shared system prompts, bounded so the dispatch hot path never
+# hashes O(prompt) and late-diverging prompts still share their key
+# (and their warm replica). 0 opts into exact full-prefix keying.
+DEFAULT_AFFINITY_PAGES = 16
+
+
+@dataclasses.dataclass
+class FleetRequest:
+    """One request at the fleet edge. ``prompt``/``prefix_len`` drive
+    affinity; ``body``/``path`` are what dispatch forwards; headers
+    carry the SLO class."""
+
+    prompt: Any = None
+    prefix_len: int = 0
+    path: str = ""
+    body: Optional[Dict[str, Any]] = None
+    headers: Optional[Dict[str, str]] = None
+
+
+class SloAdmissionGate:
+    """Shed-before-collapse admission by SLO class.
+
+    Pressure comes from the backend telemetry the edge already
+    scrapes (:meth:`observe_snapshot` per replica): queue wait against
+    its SLO bound, KV-page exhaustion, and admission-queue depth per
+    slot — the max of whichever signals the snapshot carries, averaged
+    across replicas. A class sheds while fleet pressure >= its
+    ``shed_at``; admission is the ONLY gate (in-flight work, streamed
+    or not, always completes).
+    """
+
+    def __init__(self, classes: Optional[Mapping[str, Tuple[int, float]]]
+                 = None, *, default_class: Optional[str] = None,
+                 queue_wait_slo_s: float = 1.0) -> None:
+        # class names are case-insensitive end to end: the header value
+        # lowercases at classify(), so table keys must too or an
+        # env-configured "Gold" class would be unselectable by any
+        # client (it would silently fall to the default)
+        self.classes = {str(name).lower(): spec for name, spec in
+                        (classes if classes is not None
+                         else DEFAULT_SLO_CLASSES).items()}
+        if not self.classes:
+            raise ValueError("SLO class table may not be empty")
+        if default_class is None:
+            # a custom table need not contain "standard": unnamed
+            # traffic defaults to the LOWEST-rank (most sheddable)
+            # class — unknown clients must never inherit the most
+            # protected budget
+            default_class = (DEFAULT_SLO_CLASS
+                             if DEFAULT_SLO_CLASS in self.classes
+                             else min(self.classes,
+                                      key=lambda n: self.classes[n][0]))
+        default_class = default_class.lower()
+        if default_class not in self.classes:
+            raise ValueError(f"default class {default_class!r} not in "
+                             f"table {sorted(self.classes)}")
+        self.default_class = default_class
+        self.queue_wait_slo_s = float(queue_wait_slo_s)
+        self._pressure: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    # -- classification ----------------------------------------------------
+
+    def classify(self, headers: Optional[Mapping[str, str]]) -> str:
+        """Header -> class name; unknown or absent values take the
+        default (a client cannot invent a class the table doesn't
+        price)."""
+        if headers:
+            for k, v in headers.items():
+                if k.lower() == SLO_HEADER.lower():
+                    name = v.strip().lower()
+                    if name in self.classes:
+                        return name
+        return self.default_class
+
+    # -- pressure ----------------------------------------------------------
+
+    def observe_snapshot(self, replica: str, snap: Mapping[str, Any],
+                         *, queue_wait_s: Optional[float] = None) -> float:
+        """Fold one replica's engine/multiplex snapshot (plus an
+        optional scraped ``engine_queue_wait_seconds`` reading) into
+        its pressure; returns the replica's new pressure.
+
+        Pressure is clamped to [0, 1]: it is the fraction-of-collapse
+        the class thresholds price, and the fleet AVERAGE must not let
+        one wedged replica (queue wait 25x its SLO) read as pressure 25
+        and shed traffic nine healthy replicas could serve — a sick
+        replica contributes at most 1/n to the fleet mean while the
+        bounded-load ring routes around it."""
+        signals = [0.0]
+        if queue_wait_s is not None and self.queue_wait_slo_s > 0:
+            signals.append(float(queue_wait_s) / self.queue_wait_slo_s)
+        pages_total = float(snap.get("pages_total") or 0.0)
+        if pages_total > 0:
+            # evictable prefix-store pages are reclaimable cache, not
+            # load (the observe_engine stance): affinity deliberately
+            # builds deep tries, and a warm IDLE replica must not read
+            # as overloaded or good warm-up would shed traffic
+            held = (pages_total - float(snap.get("pages_free", 0.0))
+                    - float(snap.get("pages_evictable", 0.0)))
+            signals.append(max(0.0, held) / pages_total)
+        slots = float(snap.get("slots") or 0.0)
+        if slots > 0:
+            # queue depth in slot units: pending == slots reads as
+            # pressure 1.0 (a full extra fleet's worth of waiting work)
+            signals.append(float(snap.get("pending", 0.0)) / slots)
+        pressure = min(1.0, max(signals))
+        with self._lock:
+            self._pressure[replica] = pressure
+        # the kftpu_edge_fleet_pressure gauge is refreshed once per
+        # poll round by the caller (poll_backends / BackendPoller), not
+        # per fold — n folds re-summing n entries made a round O(n^2)
+        return pressure
+
+    def forget(self, replica: str) -> None:
+        with self._lock:
+            self._pressure.pop(replica, None)
+
+    def prune(self, keep) -> None:
+        """Drop pressure entries for replicas no longer in ``keep`` —
+        a scaled-away replica's last reading must not skew the fleet
+        mean forever (an overloaded one would shed traffic the healthy
+        fleet could serve; an idle one would dilute real pressure)."""
+        keep = set(keep)
+        with self._lock:
+            for name in [n for n in self._pressure if n not in keep]:
+                del self._pressure[name]
+
+    def fleet_pressure(self) -> float:
+        with self._lock:
+            if not self._pressure:
+                return 0.0
+            return sum(self._pressure.values()) / len(self._pressure)
+
+    def pressure_of(self, replica: str) -> float:
+        with self._lock:
+            return self._pressure.get(replica, 0.0)
+
+    # -- admission ---------------------------------------------------------
+
+    def admit(self, slo_class: str) -> Tuple[bool, float]:
+        """``(admit, fleet_pressure)`` for a request of ``slo_class``."""
+        _, shed_at = self.classes.get(slo_class,
+                                      self.classes[self.default_class])
+        pressure = self.fleet_pressure()
+        return pressure < shed_at, pressure
+
+
+class FleetRouter:
+    """Replica picker: prefix-affinity over the bounded-load ring, or
+    the round-robin twin (``policy="round_robin"``) the A/B acceptance
+    test pins affinity against."""
+
+    def __init__(self, *, page_size: int, vnodes: int = 64,
+                 load_factor: float = 1.25,
+                 affinity_pages: int = DEFAULT_AFFINITY_PAGES,
+                 policy: str = "affinity") -> None:
+        if policy not in ("affinity", "round_robin"):
+            raise ValueError(f"unknown routing policy {policy!r}")
+        self.page_size = int(page_size)
+        self.affinity_pages = int(affinity_pages)
+        self.policy = policy
+        self.ring = HashRing(vnodes=vnodes, load_factor=load_factor)
+        self.targets: Dict[str, str] = {}
+        self.inflight: Dict[str, int] = {}
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    # -- membership (autoscaler scale events) ------------------------------
+
+    def sync(self, replicas: Mapping[str, str]
+             ) -> Tuple[List[str], List[str]]:
+        """Adopt the current replica set (``name -> target URL``);
+        returns ``(added, removed)``. Wire this to the autoscaler's
+        ready set — every reconcile tick is cheap (no-op when nothing
+        changed) and only changed arcs remap."""
+        with self._lock:
+            added, removed = self.ring.sync(replicas.keys())
+            self.targets = dict(replicas)
+            for r in added:
+                self.inflight.setdefault(r, 0)
+            for r in removed:
+                self.inflight.pop(r, None)
+        if added or removed:
+            log.info("fleet router: +%s -%s (%d replicas)",
+                     added, removed, len(replicas))
+        return added, removed
+
+    # -- picking -----------------------------------------------------------
+
+    def key_for(self, prompt, prefix_len: int) -> Optional[str]:
+        if prompt is None:
+            return None
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        n = int(prefix_len) if prefix_len else int(prompt.size)
+        return affinity_key(prompt, n, self.page_size,
+                            max_pages=self.affinity_pages)
+
+    def pick(self, prompt=None, prefix_len: int = 0
+             ) -> Optional[Tuple[str, Optional[str], bool]]:
+        """``(replica, affinity_key, spilled)`` or None on an empty
+        fleet. Keyless requests (no full prefix page, or the
+        round-robin twin) rotate for plain load spreading.
+
+        Picking ACQUIRES one unit of the replica's in-flight load
+        under the same lock the bound was evaluated with — a separate
+        read-then-increment would let M concurrent requests for one
+        hot key all see the home replica idle and overshoot the spill
+        bound by M. The caller must :meth:`finish` the pick."""
+        key = (self.key_for(prompt, prefix_len)
+               if self.policy == "affinity" else None)
+        with self._lock:
+            if not self.targets:
+                return None
+            if key is None:
+                names = sorted(self.targets)
+                replica = names[self._rr % len(names)]
+                self._rr += 1
+                spilled = False
+            else:
+                routed = self.ring.route(
+                    key, lambda r: self.inflight.get(r, 0))
+                if routed is None:
+                    return None
+                replica, spilled = routed
+            self.inflight[replica] = self.inflight.get(replica, 0) + 1
+        if spilled:
+            _spills_c.inc()
+        return replica, key, spilled
+
+    def start(self, replica: str) -> None:
+        """Manual load accounting for callers dispatching outside
+        :meth:`pick` (pick itself already acquires)."""
+        with self._lock:
+            # same guard as finish(): a sync() racing the caller may
+            # have popped the replica — re-inserting it would leak one
+            # entry per scaled-away pod name forever
+            if replica in self.inflight:
+                self.inflight[replica] += 1
+
+    def finish(self, replica: str) -> None:
+        with self._lock:
+            # a replica scaled away mid-request: its late finish must
+            # not resurrect the popped entry (unique pod names would
+            # grow the dict — and the panel's replica list — forever)
+            if replica in self.inflight:
+                self.inflight[replica] = max(0, self.inflight[replica] - 1)
+
+    def target_of(self, replica: str) -> Optional[str]:
+        with self._lock:
+            return self.targets.get(replica)
+
+    def view(self) -> Tuple[Dict[str, str], Dict[str, int]]:
+        """(targets, inflight) under one lock read."""
+        with self._lock:
+            return dict(self.targets), dict(self.inflight)
+
+
+class FleetEdge:
+    """The composed edge: classify -> admission gate -> affinity route
+    -> dispatch, with one span tree per request.
+
+    ``dispatch(replica, target, request) -> payload`` is injectable
+    (tests and the smoke drive fakes; production binds an HTTP
+    forwarder). A dispatch returning an *iterator* streams: the edge
+    holds the replica's in-flight count until the stream is exhausted,
+    and — because the gate runs at admission only — a later shed
+    decision can never cut it.
+    """
+
+    def __init__(self, router: FleetRouter, gate: SloAdmissionGate, *,
+                 dispatch: Callable[[str, Optional[str], FleetRequest], Any],
+                 multiplex: Any = None,
+                 tracer=None, retry_after_s: int = 1) -> None:
+        self.router = router
+        self.gate = gate
+        self.dispatch = dispatch
+        self.multiplex = multiplex
+        self.tracer = tracer if tracer is not None else TRACER
+        self.retry_after_s = int(retry_after_s)
+        self.served = 0
+        self.shed: Dict[str, int] = {}
+        # handle() runs on ThreadingHTTPServer worker threads: the
+        # panel counters must not lose increments the (locked) registry
+        # counters keep, or the two sources disagree under exactly the
+        # bursts the panel explains
+        self._count_lock = threading.Lock()
+
+    # -- request path ------------------------------------------------------
+
+    def handle(self, request: FleetRequest) -> Tuple[int, Any]:
+        """``(code, payload)``; payload is an iterator for streamed
+        dispatches. 503 + Retry-After on shed (the class's budget says
+        try later, not never) and on an empty fleet."""
+        slo = self.gate.classify(request.headers)
+        with self.tracer.span("edge.fleet.request",
+                              attrs={"slo.class": slo}) as sp:
+            ok, pressure = self.gate.admit(slo)
+            if not ok:
+                with self._count_lock:
+                    self.shed[slo] = self.shed.get(slo, 0) + 1
+                _shed_c.inc(**{"class": slo})
+                # the shed decision IS a span in the request trace: the
+                # overload burst's trace artifact shows the shed/served
+                # split without joining logs
+                with self.tracer.span("edge.shed", attrs={
+                        "slo.class": slo,
+                        "pressure": round(pressure, 4)}):
+                    pass
+                sp.attrs["http.status"] = 503
+                return 503, {
+                    "error": f"overloaded; class {slo!r} shed at "
+                             f"pressure {pressure:.2f}",
+                    "sloClass": slo,
+                    "retryAfterSeconds": self.retry_after_s,
+                }
+            picked = self.router.pick(request.prompt, request.prefix_len)
+            if picked is None:
+                sp.attrs["http.status"] = 503
+                return 503, {"error": "no replicas in the fleet",
+                             "retryAfterSeconds": self.retry_after_s}
+            replica, key, spilled = picked
+            sp.attrs.update({"replica": replica,
+                             "affinity": key is not None,
+                             "spilled": spilled})
+            if key is not None:
+                sp.attrs["affinity.key"] = key[:16]
+            target = self.router.target_of(replica)
+            # pick() already acquired the in-flight unit (atomically
+            # with the bound check); this block only releases it
+            streaming = False
+            try:
+                payload = self.dispatch(replica, target, request)
+                if _is_stream(payload):
+                    streaming = True
+                    sp.attrs["streamed"] = True
+                    payload = self._guard_stream(replica, payload)
+            except DispatchError as e:
+                sp.attrs["http.status"] = e.code
+                return e.code, e.payload
+            finally:
+                if not streaming:
+                    self.router.finish(replica)
+            with self._count_lock:
+                self.served += 1
+            _fleet_requests_c.inc(replica=replica)
+            sp.attrs["http.status"] = 200
+            return 200, payload
+
+    def _guard_stream(self, replica: str, it: Iterator) -> Iterator:
+        """Hold the replica's in-flight count for the stream's whole
+        life; release exactly once however it ends — including a
+        stream the caller DROPS without ever starting (a generator's
+        ``finally`` never runs if no frame was entered, which would
+        leak the in-flight count and spill the replica's affinity arc
+        for the life of the process; the guard object releases on
+        exhaustion, error, close() and GC)."""
+        return _StreamGuard(self.router, replica, iter(it))
+
+    # -- membership + telemetry poll ---------------------------------------
+
+    def sync_replicas(self, replicas: Mapping[str, str]
+                      ) -> Tuple[List[str], List[str]]:
+        """`FleetRouter.sync` plus gate hygiene: removed replicas'
+        pressure entries drop with their ring arcs. Wire THIS (not the
+        router directly) to the autoscaler's ready set."""
+        added, removed = self.router.sync(replicas)
+        for name in removed:
+            self.gate.forget(name)
+        return added, removed
+
+    def poll_backends(self, snapshots: Mapping[str, Mapping[str, Any]],
+                      queue_waits: Optional[Mapping[str, float]] = None
+                      ) -> float:
+        """Fold one scrape round of per-replica snapshots (and optional
+        queue-wait readings) into the gate; returns fleet pressure."""
+        for replica, snap in snapshots.items():
+            qw = (queue_waits or {}).get(replica)
+            self.gate.observe_snapshot(replica, snap, queue_wait_s=qw)
+        pressure = self.gate.fleet_pressure()
+        _pressure_g.set(round(pressure, 4))
+        return pressure
+
+    # -- dashboard ---------------------------------------------------------
+
+    def status(self) -> Dict[str, Any]:
+        """The fleet panel (dashboard ``GET /api/metrics/edge``)."""
+        targets, inflight = self.router.view()
+        with self._count_lock:
+            served = self.served
+            shed = dict(sorted(self.shed.items()))
+        out: Dict[str, Any] = {
+            "policy": self.router.policy,
+            "pageSize": self.router.page_size,
+            "replicas": [
+                {"name": name, "target": targets[name],
+                 "inflight": inflight.get(name, 0),
+                 "pressure": round(self.gate.pressure_of(name), 4)}
+                for name in sorted(targets)],
+            "fleetPressure": round(self.gate.fleet_pressure(), 4),
+            "sloClasses": {
+                name: {"rank": rank, "shedAt": shed_at}
+                for name, (rank, shed_at) in
+                sorted(self.gate.classes.items())},
+            "served": served,
+            "shed": shed,
+        }
+        if self.multiplex is not None:
+            snap = self.multiplex.snapshot()
+            out["multiplex"] = {
+                k: snap[k] for k in
+                ("models_resident", "models_max", "models_evictable",
+                 "models_pinned", "multiplex_loads",
+                 "multiplex_evictions", "models") if k in snap}
+        return out
+
+
+class _StreamGuard:
+    """Iterator wrapper releasing a replica's in-flight count exactly
+    once, however the stream ends (see ``FleetEdge._guard_stream``)."""
+
+    def __init__(self, router: FleetRouter, replica: str,
+                 it: Iterator) -> None:
+        self._router = router
+        self._replica = replica
+        self._it = it
+        self._released = False
+
+    def _release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._router.finish(self._replica)
+
+    def __iter__(self) -> "_StreamGuard":
+        return self
+
+    def __next__(self):
+        try:
+            return next(self._it)
+        except BaseException:
+            # StopIteration included: exhaustion IS the happy release
+            self._release()
+            raise
+
+    def close(self) -> None:
+        close = getattr(self._it, "close", None)
+        if close is not None:
+            close()
+        self._release()
+
+    def __del__(self) -> None:
+        self._release()
+
+
+def _is_stream(payload: Any) -> bool:
+    """Streamed dispatch = any non-materialized iterable (generators,
+    iterators); dict/list/str/bytes payloads are unary."""
+    return (hasattr(payload, "__next__")
+            or (hasattr(payload, "__iter__")
+                and not isinstance(payload, (dict, list, tuple, str,
+                                             bytes))))
+
+
+def http_dispatch(timeout_s: float = 120.0
+                  ) -> Callable[[str, Optional[str], FleetRequest], Any]:
+    """Production dispatch: POST the request body to the replica's
+    target, propagating the current trace context. Unary (the serving
+    server's streamed :generate path stays behind the edge proxy's
+    chunked relay; the fleet edge fronts the unary plane)."""
+    import urllib.error
+    import urllib.request
+
+    from kubeflow_tpu.obs import current_context, inject
+
+    def dispatch(replica: str, target: Optional[str],
+                 request: FleetRequest) -> Any:
+        if not target:
+            raise DispatchError(502, {"error": f"replica {replica} "
+                                               "has no target"})
+        headers = {"Content-Type": "application/json"}
+        ctx = current_context()
+        if ctx is not None:
+            inject(headers, ctx)
+        req = urllib.request.Request(
+            target.rstrip("/") + (request.path or "/"),
+            data=json.dumps(request.body or {}).encode(),
+            headers=headers, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            # relay the backend's own verdict (a client's 400 is a
+            # 400, not an edge 500) — the serving/edge proxy stance
+            try:
+                payload = json.loads(e.read() or b"{}")
+            except json.JSONDecodeError:
+                payload = {"error": f"backend returned {e.code}"}
+            raise DispatchError(e.code, payload)
+        except (urllib.error.URLError, OSError) as e:
+            raise DispatchError(502, {"error": f"replica {replica} "
+                                               f"unreachable: {e}"})
+
+    return dispatch
+
+
+def scrape_snapshot(text: str, *,
+                    slots_hint: int = 0) -> Optional[Dict[str, float]]:
+    """A backend's ``/metrics`` exposition reduced to the snapshot
+    fields the admission gate folds: the paged engine's
+    ``kftpu_engine_kv_pages_{free,in_use}`` gauges (summed across its
+    per-model label rows) and ``kftpu_engine_pending_requests``.
+    Slot capacity comes from the exposition's own
+    ``kftpu_engine_slots`` gauge; ``slots_hint`` (env
+    ``KFTPU_FLEET_SLOTS``) is only the fallback for backends predating
+    that gauge — without either, the queue-depth signal is off. None
+    when the target exposes no engine series at all (not a serving
+    backend; the gate must not read it as pressure 0)."""
+    from kubeflow_tpu.obs.scrape import parse_exposition
+
+    free = in_use = evictable = pending = slots = 0.0
+    qw_sum = qw_count = 0.0
+    seen = False
+    for s in parse_exposition(text):
+        if s.name == "kftpu_engine_slots":
+            slots += s.value
+            seen = True
+        elif s.name == "kftpu_engine_kv_pages_free":
+            free += s.value
+            seen = True
+        elif s.name == "kftpu_engine_kv_pages_in_use":
+            in_use += s.value
+            seen = True
+        elif s.name == "kftpu_engine_kv_pages_evictable":
+            evictable += s.value
+            seen = True
+        elif s.name == "kftpu_engine_pending_requests":
+            pending += s.value
+            seen = True
+        elif s.name == "engine_queue_wait_seconds_sum":
+            qw_sum += s.value
+            seen = True
+        elif s.name == "engine_queue_wait_seconds_count":
+            qw_count += s.value
+            seen = True
+    if not seen:
+        return None
+    return {"pages_total": free + in_use, "pages_free": free,
+            "pages_evictable": evictable, "pending": pending,
+            "slots": slots if slots > 0 else float(slots_hint),
+            # cumulative histogram totals: the POLLER differences
+            # consecutive scrapes into a windowed average queue wait
+            # (a lifetime average would bury a fresh latency spike)
+            "queue_wait_sum": qw_sum, "queue_wait_count": qw_count}
+
+
+class BackendPoller:
+    """Feeds the admission gate from every replica's ``/metrics`` —
+    the telemetry loop that makes shedding LIVE in the deployed
+    container (without it fleet pressure sits at 0 forever and the
+    gate is inert). Runs on the shared reconciler runtime
+    (:meth:`build_controller` — uniform ``controller.reconcile`` spans
+    + counter like every other periodic loop, so a stalled shed gate
+    shows its poll ticks where an operator looks for them); injectable
+    ``fetch`` for tests. An unreachable or engine-less target FORGETS
+    its pressure entry so a dead replica cannot drag the fleet
+    average."""
+
+    def __init__(self, edge: FleetEdge, *, interval_s: float = 2.0,
+                 slots_hint: int = 0, metrics_path: str = "/metrics",
+                 timeout_s: float = 2.0, fetch=None) -> None:
+        self.edge = edge
+        self.interval_s = float(interval_s)
+        self.slots_hint = int(slots_hint)
+        self.metrics_path = metrics_path
+        if fetch is None:
+            import urllib.request
+
+            def fetch(url: str) -> str:
+                with urllib.request.urlopen(url,
+                                            timeout=timeout_s) as resp:
+                    return resp.read().decode("utf-8", "replace")
+
+        self.fetch = fetch
+        self._pool = None  # lazy ThreadPoolExecutor, reused per tick
+        # last (queue_wait_sum, queue_wait_count) per replica: the
+        # increase between scrapes is the in-window average wait — the
+        # engine_queue_wait_seconds signal the gate prices against its
+        # SLO (a single scrape only sees lifetime cumulative totals)
+        self._qw_last: Dict[str, Tuple[float, float]] = {}
+
+    def _queue_wait(self, name: str,
+                    snap: Mapping[str, float]) -> Optional[float]:
+        cur = (float(snap.get("queue_wait_sum", 0.0)),
+               float(snap.get("queue_wait_count", 0.0)))
+        prev = self._qw_last.get(name)
+        self._qw_last[name] = cur
+        if prev is None or cur[1] <= prev[1] or cur[0] < prev[0]:
+            # first scrape, idle window, or counter reset (engine
+            # restart): no windowed reading this tick
+            return None
+        return (cur[0] - prev[0]) / (cur[1] - prev[1])
+
+    def _scrape_one(self, name: str, target: str):
+        try:
+            return name, scrape_snapshot(
+                self.fetch(target.rstrip("/") + self.metrics_path),
+                slots_hint=self.slots_hint)
+        except Exception as e:  # noqa: BLE001 — any failure = down,
+            # the Scraper.tick stance: a garbled backend (BadStatusLine
+            # is an HTTPException, not an OSError) must cost ITS
+            # reading, never abort the whole round out of pool.map and
+            # freeze the fleet's pressure map
+            log.warning("fleet poll: %s (%s) unreachable: %s",
+                        name, target, e)
+            return name, None
+
+    def poll_once(self) -> float:
+        targets, _ = self.edge.router.view()
+        self.edge.gate.prune(targets)       # scaled-away replicas out
+        for name in [n for n in self._qw_last if n not in targets]:
+            # the queue-wait baseline goes with the replica: churned
+            # pod names must not accumulate, and a re-added replica
+            # must not difference its first scrape against a baseline
+            # from before its absence (a window spanning the gap)
+            del self._qw_last[name]
+        if not targets:
+            return self.edge.gate.fleet_pressure()
+        # fetch CONCURRENTLY: a serial walk blocks timeout_s on each
+        # dead target, staling every healthy replica's pressure by a
+        # full round exactly when overload/churn makes the gate
+        # matter. ONE executor for the poller's lifetime — spinning up
+        # and joining a fresh pool every 2 s tick is pure thread churn
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(
+                max_workers=16, thread_name_prefix="fleet-poll")
+        results = list(self._pool.map(lambda kv: self._scrape_one(*kv),
+                                      sorted(targets.items())))
+        for name, snap in results:
+            if snap is None:
+                self.edge.gate.forget(name)
+                self._qw_last.pop(name, None)
+            else:
+                self.edge.gate.observe_snapshot(
+                    name, snap, queue_wait_s=self._queue_wait(name, snap))
+        pressure = self.edge.gate.fleet_pressure()
+        _pressure_g.set(round(pressure, 4))
+        return pressure
+
+    def build_controller(self, interval_s: Optional[float] = None):
+        """Run the poll on the shared reconciler runtime (the
+        ``Controller.periodic`` lift every hand-rolled while/sleep loop
+        moved to — autoscaler tick, queue cycle, scraper tick)."""
+        from kubeflow_tpu.operators.controller import Controller
+
+        interval = (interval_s if interval_s is not None
+                    else self.interval_s)
+
+        def reconcile(_ns: str, _name: str) -> float:
+            self.poll_once()
+            return interval
+
+        return Controller.periodic(reconcile, name="fleet-edge-poller")
+
+
+# -- deterministic fleet harness ---------------------------------------------
+
+
+class ReplicaSim:
+    """A backend replica reduced to what routing quality measures: a
+    REAL page pool + prefix trie (the exact structures the decode
+    engine places against) and the hit/miss counters. Used by the A/B
+    acceptance test, ``scripts/edge_smoke.py`` and the
+    ``edge_fleet`` bench config — no device, fully deterministic.
+
+    ``serve`` mirrors the engine's paged placement accounting: trie
+    match -> hit/miss -> admit a slot -> store the prefix chain ->
+    retire. Serving WARMS the replica, so a router that concentrates a
+    shared prefix builds one deep trie while a router that spreads it
+    re-prefills everywhere — the effect under test.
+    """
+
+    def __init__(self, name: str, *, page_size: int = 4,
+                 pages_total: int = 256, trie_budget_pages: int = 64,
+                 slots: int = 8) -> None:
+        from kubeflow_tpu.serving.kvpool import PagePool, PrefixPageStore
+
+        self.name = name
+        self.page_size = page_size
+        self.pool = PagePool(pages_total, page_size, slots=slots,
+                             pages_per_slot=pages_total)
+        self.store = PrefixPageStore(self.pool, trie_budget_pages)
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.requests = 0
+
+    def serve(self, prompt, prefix_len: int = 0) -> Dict[str, Any]:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        prefix_len = int(prefix_len) or int(prompt.size)
+        self.requests += 1
+        hit = False
+        if prefix_len >= self.page_size:
+            match = self.store.match(prompt, prefix_len)
+            hit = match.hit
+            if hit:
+                self.prefix_hits += 1
+            else:
+                self.prefix_misses += 1
+            slot = 0
+            need = self.pool.pages_needed(prefix_len)
+            self.pool.reserve(slot, need)
+            self.pool.ensure(slot, prefix_len)
+            self.store.store(prompt, self.store.aligned_len(prefix_len),
+                             slot)
+            self.pool.release_slot(slot)
+        return {"replica": self.name, "prefix_hit": hit,
+                "tokens": int(prompt.size)}
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"active_slots": 0, "pending": 0,
+                "slots": self.pool.slots,
+                "pages_total": self.pool.pages_total,
+                "pages_free": self.pool.pages_free,
+                "prefix_hits": self.prefix_hits,
+                "prefix_misses": self.prefix_misses,
+                "closed": False}
+
+
+def sim_dispatch(sims: Mapping[str, ReplicaSim]
+                 ) -> Callable[[str, Optional[str], FleetRequest], Any]:
+    """Dispatch into :class:`ReplicaSim` backends by name."""
+    def dispatch(replica: str, target: Optional[str],
+                 request: FleetRequest) -> Any:
+        return sims[replica].serve(request.prompt, request.prefix_len)
+
+    return dispatch
+
+
+def fleet_prefix_hits(sims: Mapping[str, ReplicaSim]) -> int:
+    """The fleet-level number the A/B acceptance compares."""
+    return sum(s.prefix_hits for s in sims.values())
+
+
+def main() -> None:  # pragma: no cover - container entrypoint
+    logging.basicConfig(level=logging.INFO)
+    from kubeflow_tpu.utils.jsonhttp import serve_json
+
+    replicas = json.loads(os.environ.get("KFTPU_FLEET_REPLICAS", "{}"))
+    router = FleetRouter(
+        page_size=int(os.environ.get("KFTPU_FLEET_PAGE_SIZE", "16")),
+        vnodes=int(os.environ.get("KFTPU_RING_VNODES", "64")),
+        load_factor=float(os.environ.get("KFTPU_RING_LOAD_FACTOR",
+                                         "1.25")),
+        affinity_pages=int(os.environ.get(
+            "KFTPU_AFFINITY_PAGES", str(DEFAULT_AFFINITY_PAGES))))
+    router.sync(replicas)
+    gate = SloAdmissionGate(
+        slo_classes_from_env(),
+        default_class=os.environ.get("KFTPU_SLO_DEFAULT_CLASS") or None,
+        queue_wait_slo_s=float(os.environ.get("KFTPU_QUEUE_WAIT_SLO_S",
+                                              "1.0")))
+    edge = FleetEdge(router, gate, dispatch=http_dispatch())
+    # the gate is only as live as its telemetry: scrape every replica's
+    # /metrics on the shared reconciler runtime (docs/EDGE.md)
+    BackendPoller(
+        edge,
+        interval_s=float(os.environ.get("KFTPU_FLEET_POLL_S", "2.0")),
+        slots_hint=int(os.environ.get("KFTPU_FLEET_SLOTS", "0")),
+    ).build_controller().start()
+
+    def handler(method: str, path: str, body, user: str = "",
+                headers=None):
+        # route on the bare path: /healthz?probe=1 is still the probe
+        bare = path.partition("?")[0]
+        if method == "GET" and bare == "/healthz":
+            return 200, {"ok": True, "replicas": len(replicas)}
+        if method != "POST":
+            # kubelet/LB probes of "/" and stray GETs must not be
+            # admitted against an SLO budget, counted served, or
+            # POSTed into a backend as an empty generate
+            return 405, {"error": "the fleet edge serves POST "
+                                  "generate/predict requests"}
+        body = body or {}
+        try:
+            request = FleetRequest(
+                prompt=body.get("prompt"),
+                prefix_len=int(body.get("prefix_len", 0) or 0),
+                path=path, body=body, headers=headers or {})
+            return edge.handle(request)
+        except (ValueError, TypeError) as e:
+            # a malformed body (non-integer prompt tokens, bad
+            # prefix_len) is the CLIENT's error: 400, never the
+            # generic 500 serve_json answers for handler crashes
+            return 400, {"error": f"bad request: {e}"}
+
+    # the edge's own kftpu_edge_*/kftpu_multiplex_* series must be
+    # scrapable where they matter (the deployed monitoring tier), not
+    # only in-process: exposition on its own port, annotated on the
+    # gateway-rendered Service
+    from kubeflow_tpu.utils.metrics import serve_metrics
+
+    serve_metrics(int(os.environ.get("KFTPU_FLEET_METRICS_PORT",
+                                     "8089")))
+    # serve_json blocks in serve_forever; the pod's lifecycle ends it
+    serve_json(handler, int(os.environ.get("KFTPU_FLEET_PORT", "8088")))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -35,7 +35,31 @@ DEFAULTS: Dict[str, Any] = {
     "managed_cert_domain": "",           # e.g. kubeflow.example.com
     # prefix -> {service, port, stripPrefix}; merged over the built-ins
     "extra_routes": {},
+    # fleet serving edge (docs/EDGE.md): prefix-affinity routing +
+    # SLO-class shedding in front of the serving replicas. Off by
+    # default — single-replica serving needs no ring.
+    "fleet_edge": False,
+    "fleet_port": 8088,
+    "fleet_metrics_port": 8089,     # kftpu_edge_* exposition (scraped)
+    "fleet_page_size": 16,          # MUST match the engines' kv_page_size
+    "fleet_ring_vnodes": 64,
+    "fleet_ring_load_factor": 1.25,
+    # pages of prefix the router keys on: bounded hashing per request,
+    # late-diverging shared-prefix prompts share a key; 0 = exact
+    # whole-aligned-prefix keying (O(prompt) hashing, opt-in)
+    "fleet_affinity_pages": 16,
+    "fleet_queue_wait_slo_s": 1.0,
+    "fleet_poll_s": 2.0,            # backend /metrics scrape interval
+    # replicas' engine slot count: the exposition carries no slot
+    # capacity, so without this the gate's queue-depth pressure signal
+    # is off and only page exhaustion sheds
+    "fleet_slots": 0,
+    "fleet_slo_classes": {},        # name -> [rank, shed_at]; {} = built-ins
+    "fleet_default_class": "",      # "" = standard, else lowest rank
+    "fleet_replicas": {},           # replica name -> target URL
 }
+
+FLEET_EDGE_NAME = "kftpu-fleet-edge"
 
 GATEWAY_NAME = "kftpu-ingressgateway"
 
@@ -53,6 +77,12 @@ def _routes(params: Dict[str, Any]) -> List[Dict[str, Any]]:
         {"prefix": "/deploy/", "target": "http://bootstrap:8086",
          "stripPrefix": True},
     ]
+    if params.get("fleet_edge"):
+        # the authenticated path into the fleet serving edge
+        routes.append({"prefix": "/fleet/",
+                       "target": f"http://{FLEET_EDGE_NAME}:"
+                                 f"{params.get('fleet_port', 8088)}",
+                       "stripPrefix": True})
     for prefix, spec in sorted((params.get("extra_routes") or {}).items()):
         routes.append({"prefix": prefix,
                        "target": f"http://{spec['service']}:"
@@ -201,6 +231,57 @@ def render(config: DeploymentConfig, params: Dict[str, Any]) -> List[o.Obj]:
                   labels=dict(INGRESS_POD_LABELS),
                   annotations=svc_annotations or None),
     ]
+    if params["fleet_edge"]:
+        # the fleet serving edge rides the gateway component: same
+        # trust domain (behind the auth edge), its own Deployment so
+        # routing capacity scales apart from the auth proxy
+        fleet_env = {
+            "KFTPU_FLEET_PORT": str(params["fleet_port"]),
+            "KFTPU_FLEET_METRICS_PORT": str(params["fleet_metrics_port"]),
+            "KFTPU_FLEET_PAGE_SIZE": str(params["fleet_page_size"]),
+            "KFTPU_RING_VNODES": str(params["fleet_ring_vnodes"]),
+            "KFTPU_RING_LOAD_FACTOR":
+                str(params["fleet_ring_load_factor"]),
+            "KFTPU_AFFINITY_PAGES": str(params["fleet_affinity_pages"]),
+            "KFTPU_QUEUE_WAIT_SLO_S":
+                str(params["fleet_queue_wait_slo_s"]),
+            "KFTPU_FLEET_POLL_S": str(params["fleet_poll_s"]),
+            "KFTPU_FLEET_SLOTS": str(params["fleet_slots"]),
+            "KFTPU_FLEET_REPLICAS": json.dumps(params["fleet_replicas"]),
+        }
+        if params["fleet_slo_classes"]:
+            fleet_env["KFTPU_SLO_CLASSES"] = json.dumps(
+                params["fleet_slo_classes"])
+        if params["fleet_default_class"]:
+            fleet_env["KFTPU_SLO_DEFAULT_CLASS"] = \
+                params["fleet_default_class"]
+        fleet_pod = o.pod_spec([
+            o.container(
+                FLEET_EDGE_NAME,
+                params["image"],
+                command=["python", "-m", "kubeflow_tpu.edge.fleet"],
+                env=fleet_env,
+                ports=[params["fleet_port"],
+                       params["fleet_metrics_port"]],
+            )
+        ])
+        out.append(o.deployment(FLEET_EDGE_NAME, ns, fleet_pod,
+                                labels={"app": FLEET_EDGE_NAME}))
+        # prometheus.io annotations: the monitoring component derives
+        # its scrape targets from these, so the shed/pressure series
+        # reach the tsdb in a real deployment, not only in-process
+        out.append(o.service(
+            FLEET_EDGE_NAME, ns, {"app": FLEET_EDGE_NAME},
+            [{"name": "http", "port": params["fleet_port"],
+              "targetPort": params["fleet_port"]},
+             {"name": "metrics", "port": params["fleet_metrics_port"],
+              "targetPort": params["fleet_metrics_port"]}],
+            labels={"app": FLEET_EDGE_NAME},
+            annotations={
+                "prometheus.io/scrape": "true",
+                "prometheus.io/path": "/metrics",
+                "prometheus.io/port": str(params["fleet_metrics_port"]),
+            }))
     if params["use_iap"]:
         out.append(iap_backend_config(ns, params["iap_oauth_secret"]))
         out.extend(iap_ingress(ns, params["managed_cert_domain"]))

@@ -514,8 +514,8 @@ class GangQueue:
              and e.shrink_to is None),
             key=self._victim_cost)
         for victim in shrinkables:
-            target = victim.req.min_slices
-            if self._shrink_feasible(inv, req, victim, target):
+            target = self._best_shrink_target(inv, req, victim)
+            if target is not None:
                 self._signal_shrink(entry, victim, target)
                 entry.waiting_shrinks = [victim.req.key]
                 return
@@ -561,6 +561,26 @@ class GangQueue:
             if feasible(acc):
                 return acc
         return []
+
+    def _best_shrink_target(self, inv: List[SliceInfo], req: GangRequest,
+                            victim: _Entry) -> Optional[int]:
+        """The LARGEST feasible shrink count in
+        ``[min_slices, slices)`` — the victim gives up only what the
+        preemptor's window actually needs. Shrinking straight to the
+        floor (the pre-ISSUE-12 behavior) threw away slices nobody
+        asked for: a 4-slice gang shrank to 1 so a 1-slice preemptor
+        could land, losing 2 slices of throughput for nothing. None
+        when even the floor doesn't free enough — checked FIRST:
+        feasibility is monotone in target (fewer victim slices only
+        ever free more), so an infeasible floor rejects in one check
+        instead of O(slices) scans on every schedule() tick."""
+        floor = victim.req.min_slices
+        if not self._shrink_feasible(inv, req, victim, floor):
+            return None
+        for target in range(victim.req.slices - 1, floor, -1):
+            if self._shrink_feasible(inv, req, victim, target):
+                return target
+        return floor
 
     def _shrink_feasible(self, inv: List[SliceInfo], req: GangRequest,
                          victim: _Entry, target: int) -> bool:

@@ -94,6 +94,10 @@ _tokens_total = DEFAULT_REGISTRY.counter(
     "kftpu_engine_tokens_total", "tokens produced by the decode engine")
 _occupancy = DEFAULT_REGISTRY.gauge(
     "kftpu_engine_active_slots", "active slots in the decode batch")
+_slots_g = DEFAULT_REGISTRY.gauge(
+    "kftpu_engine_slots",
+    "decode-slot capacity of the engine (static; scrapers read it so "
+    "queue depth can be priced in slot units without a config hint)")
 _queue_depth = DEFAULT_REGISTRY.gauge(
     "kftpu_engine_pending_requests", "requests waiting for a slot")
 _prefix_hits = DEFAULT_REGISTRY.counter(
@@ -116,6 +120,11 @@ _kv_pages_free_g = DEFAULT_REGISTRY.gauge(
     "kftpu_engine_kv_pages_free",
     "unallocated KV pages left in the paged engine's pool (the "
     "engine-pages-exhausted alert rule watches this)")
+_kv_pages_evictable_g = DEFAULT_REGISTRY.gauge(
+    "kftpu_engine_kv_pages_evictable",
+    "prefix-store pages no live slot shares: reclaimable cache, not "
+    "load — occupancy/pressure consumers (autoscaler, fleet-edge "
+    "admission gate) subtract these from the in-use count")
 _prefill_chunks_c = DEFAULT_REGISTRY.counter(
     "kftpu_engine_prefill_chunks_total",
     "prompt chunks prefilled by the paged engine's interleaved scheduler")
@@ -395,6 +404,9 @@ class DecodeEngine:
         # budget are computed and discarded
         self.steps_per_sync = max(1, int(steps_per_sync))
         self.name = name or "model"
+        # the NORMALIZED name: every engine series must share one model
+        # label value or per-model joins (slots vs pages) find no row
+        _slots_g.set(self.slots, model=self.name)
         self._params = params
         self._pending: "queue.Queue[_Request]" = queue.Queue()
         self._active: List[Optional[_Slot]] = [None] * slots
@@ -1248,8 +1260,7 @@ class DecodeEngine:
         self._prefilling[slot] = job
         self._pos_host[slot] = start
         self._slot_budget[slot] = S + req.max_new
-        _kv_pages_g.set(pool.pages_in_use, model=self.name)
-        _kv_pages_free_g.set(pool.pages_free, model=self.name)
+        self._export_page_gauges()
         _prefix_bytes_g.set(store.pages_held * self._page_bytes,
                             model=self.name)
         return True
@@ -1372,9 +1383,15 @@ class DecodeEngine:
                         self._cache, jnp.int32(i),
                         jnp.int32(self._pos_host[i]),
                         jnp.asarray(self._pool.table_row(i)))
-                _kv_pages_g.set(self._pool.pages_in_use, model=self.name)
-                _kv_pages_free_g.set(self._pool.pages_free,
-                                     model=self.name)
+                self._export_page_gauges()
+
+    def _export_page_gauges(self) -> None:
+        """One write site for the pool-occupancy gauges, so in_use /
+        free / evictable can never drift apart between call sites."""
+        _kv_pages_g.set(self._pool.pages_in_use, model=self.name)
+        _kv_pages_free_g.set(self._pool.pages_free, model=self.name)
+        _kv_pages_evictable_g.set(self._prefix_pages.pages_evictable,
+                                  model=self.name)
 
     def _retire_paged(self, slot: int) -> None:
         """Free the slot's pages (shared prefix pages drop one ref) and
@@ -1388,8 +1405,7 @@ class DecodeEngine:
                 jnp.asarray(self._pool.table_row(slot)))
         self._pos_host[slot] = 0
         self._slot_budget[slot] = 0
-        _kv_pages_g.set(self._pool.pages_in_use, model=self.name)
-        _kv_pages_free_g.set(self._pool.pages_free, model=self.name)
+        self._export_page_gauges()
 
     # -- cache recovery ----------------------------------------------------
 
@@ -1438,8 +1454,9 @@ class DecodeEngine:
                 self._pool, self._prefix_pages.budget_pages)
             self._pos_host[:] = 0
             self._slot_budget[:] = 0
-            _kv_pages_g.set(0, model=self.name)
-            _kv_pages_free_g.set(self._pool.pages_free, model=self.name)
+            # fresh pool: in_use is 0 and the rebuilt store holds
+            # nothing yet
+            self._export_page_gauges()
             # replays reserve WITHOUT prefix sharing (the store died
             # with the old pool), so a load that only fit shared may
             # not fully fit the fresh pool: fail just those streams
@@ -1477,8 +1494,7 @@ class DecodeEngine:
             t_admit=self.clock(), fold0=fold, produced0=produced)
         self._pos_host[slot] = 0
         self._slot_budget[slot] = budget
-        _kv_pages_g.set(pool.pages_in_use, model=self.name)
-        _kv_pages_free_g.set(pool.pages_free, model=self.name)
+        self._export_page_gauges()
 
     def _replay_dense(self, slot: int, req: _Request,
                       tokens: np.ndarray, produced: int,

@@ -360,8 +360,15 @@ class PrefixPageStore:
     def pages_evictable(self) -> int:
         """Store-held pages no live slot shares (refcount 1 = only the
         store's pin): reclaimable cache, not load — the autoscaler must
-        not hold replicas for them."""
-        return sum(1 for p in list(self._held) if self.pool.ref[p] == 1)
+        not hold replicas for them. One vectorized probe over the held
+        ids: this also runs from the engine's per-admit/retire gauge
+        export now, not just the autoscaler's snapshot() poll, so a
+        Python-loop scan of a thousand-page trie would tax the decode
+        host thread."""
+        held = list(self._held)  # GIL-atomic copy (cross-thread read)
+        if not held:
+            return 0
+        return int((self.pool.ref[np.asarray(held)] == 1).sum())
 
     def aligned_len(self, prefix_len: int) -> int:
         return (int(prefix_len) // self.pool.page_size

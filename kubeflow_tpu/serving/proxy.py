@@ -27,6 +27,7 @@ the scale-from-zero loop.
 from __future__ import annotations
 
 import json
+import logging
 import sys
 import time
 import urllib.error
@@ -38,8 +39,13 @@ from kubeflow_tpu.obs import TRACER, current_context, extract, inject
 from kubeflow_tpu.utils import DEFAULT_REGISTRY
 from kubeflow_tpu.utils.jsonhttp import serve_json
 
+log = logging.getLogger(__name__)
+
 _proxied = DEFAULT_REGISTRY.counter(
     "kftpu_proxy_requests_total", "proxied predict requests")
+_gate_degraded = DEFAULT_REGISTRY.counter(
+    "kftpu_proxy_admit_gate_degraded_total",
+    "admit-gate checks that failed open (autoscaler unreachable)")
 
 
 class PredictProxy:
@@ -203,8 +209,15 @@ class RemoteAdmitGate:
                     + urllib.parse.urlencode({"model": model}),
                     timeout=self.timeout_s) as resp:
                 ok = bool(json.loads(resp.read()).get("canAdmit", True))
-        except (urllib.error.URLError, OSError, ValueError):
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            # fail OPEN, but never SILENTLY: the degraded-gate counter
+            # is what tells on-call the activator is flying blind
+            # (scale-from-zero holds stop working) while traffic still
+            # flows
             ok = True
+            _gate_degraded.inc()
+            log.warning("admit gate degraded (autoscaler at %s "
+                        "unreachable: %s); failing open", self.base_url, e)
         self._cache[model] = (now, ok)
         return ok
 

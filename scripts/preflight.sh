@@ -43,6 +43,12 @@
 #      snapshot-reshard-resume (exactly one save, spans in order),
 #      trains to 100, and the loss stream matches a never-resized
 #      oracle after the resync step (docs/ELASTIC.md)
+#   9. fleet-edge smoke (scripts/edge_smoke.py): fake 3-replica fleet —
+#      prefix-affinity routing concentrates a warmed prefix (warm
+#      replica hit-rate > cold), an overload burst at 2x capacity
+#      sheds lowest-SLO-class-first with the shed/served split in ONE
+#      trace, and kftpu_edge_shed_total{class} reads back through the
+#      tsdb + /api/metrics/query (docs/EDGE.md)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -74,6 +80,9 @@ JAX_PLATFORMS=cpu python scripts/alerts_smoke.py || rc=1
 echo "== preflight: elastic training smoke =="
 JAX_PLATFORMS=cpu XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     python scripts/elastic_smoke.py || rc=1
+
+echo "== preflight: fleet serving edge smoke =="
+JAX_PLATFORMS=cpu python scripts/edge_smoke.py || rc=1
 
 if [ "$rc" -ne 0 ]; then
     echo "preflight: FAILED" >&2

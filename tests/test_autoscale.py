@@ -480,13 +480,23 @@ def test_autoscale_service_routes():
 
 def test_remote_admit_gate_fails_open():
     """A dead autoscaler must degrade to static serving, not a 503
-    wall — the gate admits when its status GET can't be answered."""
+    wall — the gate admits when its status GET can't be answered, and
+    the fail-open is COUNTED (``kftpu_proxy_admit_gate_degraded_
+    total``), never a silent pass: traffic flows, on-call learns the
+    activator is blind."""
     from kubeflow_tpu.serving.proxy import RemoteAdmitGate
+    from kubeflow_tpu.utils import DEFAULT_REGISTRY
 
+    degraded = DEFAULT_REGISTRY.counter(
+        "kftpu_proxy_admit_gate_degraded_total")
+    before = degraded.get()
     gate = RemoteAdmitGate("http://127.0.0.1:1", timeout_s=0.2)
     assert gate.can_admit("m") is True
+    assert degraded.get() == before + 1
     # and the verdict is cached (no second blocking call inside the TTL)
     assert gate._cache["m"][1] is True
+    assert gate.can_admit("m") is True
+    assert degraded.get() == before + 1  # cache hit: no second probe
 
 
 def test_engine_snapshot_shape():

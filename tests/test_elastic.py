@@ -365,8 +365,10 @@ def _set_phase(client, ns, name, phase):
 
 def test_operator_shrink_offer_resizes_instead_of_preempting():
     """The scheduler's shrink offer flows through the operator as a
-    spec edit + elastic resize: the elastic gang keeps running at
-    minSlices, the preemptor places, and nobody was Preempted."""
+    spec edit + elastic resize: the elastic gang keeps running at the
+    offered count (the LARGEST feasible in [minSlices, slices) since
+    ISSUE 12 — here 2, not the floor of 1), the preemptor places, and
+    nobody was Preempted."""
 
     class Ckpt(PreemptionCheckpointer):
         def save(self, job):
@@ -393,21 +395,21 @@ def test_operator_shrink_offer_resizes_instead_of_preempting():
     op.reconcile("prod", "urgent")
     # offered, not evicted
     assert q.state_of("d", "flex") == PLACED
-    assert q.shrink_requested("d", "flex") == 1
+    assert q.shrink_requested("d", "flex") == 2
     assert offers.get() == o0 + 1
     job = client.get(API_VERSION, TPUJOB_KIND, "d", "flex")
-    assert job["status"]["resize"]["offered"] == 1
+    assert job["status"]["resize"]["offered"] == 2
     assert job["status"]["resize"]["by"] == "prod/urgent"
 
     # operator applies the offer; the resize runs its three passes
     op.reconcile("d", "flex")     # spec edit
     job = client.get(API_VERSION, TPUJOB_KIND, "d", "flex")
-    assert job["spec"]["slices"] == 1
+    assert job["spec"]["slices"] == 2
     op.reconcile("d", "flex")     # nudge
     op.reconcile("d", "flex")     # snapshot + teardown
-    op.reconcile("d", "flex")     # re-gang at 1 slice
+    op.reconcile("d", "flex")     # re-gang at 2 slices
     op.reconcile("prod", "urgent")
-    assert len(_pods(client, "d", "flex")) == 2
+    assert len(_pods(client, "d", "flex")) == 4
     assert len(_pods(client, "prod", "urgent")) == 4
     assert q.state_of("d", "flex") == PLACED
     assert q.state_of("prod", "urgent") == PLACED

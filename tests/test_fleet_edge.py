@@ -1,0 +1,907 @@
+"""Fleet serving edge (docs/EDGE.md): prefix-affinity routing over the
+bounded-load ring, SLO-class load shedding, model multiplexing — all
+deterministic on the host (hit-rate and shed counters, no device)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.edge.affinity import (
+    HashRing,
+    affinity_key,
+    page_chain_hashes,
+)
+from kubeflow_tpu.edge.fleet import (
+    DEFAULT_SLO_CLASSES,
+    FleetEdge,
+    FleetRequest,
+    FleetRouter,
+    ReplicaSim,
+    SloAdmissionGate,
+    fleet_prefix_hits,
+    sim_dispatch,
+)
+from kubeflow_tpu.obs.trace import SpanCollector, Tracer
+from kubeflow_tpu.serving.kvpool import PagePool, PrefixPageStore
+from kubeflow_tpu.serving.multiplex import ModelMultiplexer, MultiplexFull
+from kubeflow_tpu.utils import DEFAULT_REGISTRY
+
+PAGE = 4
+
+
+def _tracer():
+    col = SpanCollector()
+    t = [1000.0]
+
+    def clock():
+        t[0] += 0.25
+        return t[0]
+
+    return Tracer(col, clock=clock), col
+
+
+# -- affinity keys agree with the trie by construction -----------------------
+
+
+def test_chain_keys_match_trie_sharing():
+    """Two prompts share a depth-k router key exactly when a backend
+    trie would share their first k pages: the keys are built from the
+    same int32 page byte slices the PrefixPageStore chains on."""
+    a = np.arange(3 * PAGE, dtype=np.int32)
+    b = np.concatenate([a[:PAGE], np.arange(100, 100 + 2 * PAGE)]
+                       ).astype(np.int32)
+    ca = page_chain_hashes(a, a.size, PAGE)
+    cb = page_chain_hashes(b, b.size, PAGE)
+    assert ca[0] == cb[0]              # first page identical -> same key
+    assert ca[1] != cb[1]              # diverged from page 2 on
+    # ...and the trie agrees: storing a then matching b shares exactly
+    # one page
+    pool = PagePool(32, PAGE, slots=2, pages_per_slot=32)
+    store = PrefixPageStore(pool, 16)
+    pool.reserve(0, pool.pages_needed(a.size))
+    pool.ensure(0, int(a.size))
+    store.store(a, store.aligned_len(a.size), 0)
+    pool.release_slot(0)
+    assert len(store.match(b, int(b.size)).pages) == 1
+    assert len(store.match(a, int(a.size)).pages) == 3
+
+
+def test_affinity_key_needs_a_full_page():
+    assert affinity_key(np.arange(PAGE - 1), PAGE - 1, PAGE) is None
+    assert affinity_key(np.arange(PAGE), PAGE, PAGE) is not None
+    # max_pages groups long shared-system-prefix prompts onto one key
+    a = np.arange(4 * PAGE)
+    b = np.concatenate([np.arange(2 * PAGE), np.arange(50, 50 + 2 * PAGE)])
+    assert affinity_key(a, a.size, PAGE) != affinity_key(b, b.size, PAGE)
+    assert (affinity_key(a, a.size, PAGE, max_pages=2)
+            == affinity_key(b, b.size, PAGE, max_pages=2))
+
+
+# -- ring: remap stability + bounded load ------------------------------------
+
+
+def test_ring_remap_stability_3_4_3():
+    """Scale 3 -> 4 -> 3 moves only the expected arcs: every key that
+    moved on the add lands on the NEW replica, and the remove restores
+    the original assignment exactly (the satellite's pin)."""
+    ring = HashRing(["r0", "r1", "r2"])
+    keys = [f"prefix-{i}" for i in range(500)]
+    before = {k: ring.owner(k) for k in keys}
+    ring.add("r3")
+    after = {k: ring.owner(k) for k in keys}
+    moved = {k for k in keys if after[k] != before[k]}
+    assert moved, "adding a replica must claim some arc"
+    assert all(after[k] == "r3" for k in moved), \
+        "only arcs adjacent to the new replica's vnodes may remap"
+    # roughly its fair share moves (vnode smoothing), never the world
+    assert len(moved) < len(keys) * 0.45
+    ring.remove("r3")
+    assert {k: ring.owner(k) for k in keys} == before
+
+
+def test_ring_bounded_load_spills_hot_prefix():
+    """One hot key: once its home replica hits the load bound the NEXT
+    requests spill down-ring instead of melting the backend."""
+    ring = HashRing(["r0", "r1", "r2"], load_factor=1.5)
+    loads = {"r0": 0, "r1": 0, "r2": 0}
+    homes = set()
+    for _ in range(30):
+        replica, spilled = ring.route("hot-prefix", loads.get)
+        loads[replica] += 1
+        homes.add(replica)
+    home = ring.owner("hot-prefix")
+    assert len(homes) >= 2, "a hot key must spill past its home"
+    assert loads[home] == max(loads.values())
+    # with no load at all, the home takes the key (no gratuitous spill)
+    assert ring.route("hot-prefix", lambda r: 0)[0] == home
+
+
+def test_ring_rejects_degenerate_knobs():
+    with pytest.raises(ValueError):
+        HashRing(vnodes=0)
+    with pytest.raises(ValueError):
+        HashRing(load_factor=1.0)
+
+
+def test_router_sync_is_delta_only():
+    router = FleetRouter(page_size=PAGE)
+    added, removed = router.sync({"a": "http://a", "b": "http://b"})
+    assert (added, removed) == (["a", "b"], [])
+    router.start("a")                     # a request in flight on "a"
+    added, removed = router.sync({"b": "http://b", "c": "http://c"})
+    assert (added, removed) == (["c"], ["a"])
+    assert router.sync({"b": "http://b", "c": "http://c"}) == ([], [])
+    # the removed replica's late finish must not resurrect its entry
+    # (unique pod names under autoscaler churn would grow it forever)
+    router.finish("a")
+    assert "a" not in router.view()[1]
+
+
+# -- the A/B acceptance: affinity beats round-robin --------------------------
+
+
+def _fleet(policy, n=3):
+    sims = {f"r{i}": ReplicaSim(f"r{i}", page_size=PAGE)
+            for i in range(n)}
+    router = FleetRouter(page_size=PAGE, policy=policy)
+    router.sync({name: f"http://{name}" for name in sims})
+    tracer, col = _tracer()
+    edge = FleetEdge(router, SloAdmissionGate(),
+                     dispatch=sim_dispatch(sims), tracer=tracer)
+    return edge, sims, col
+
+
+def _request_stream():
+    """Three distinct shared prefixes, each repeating in a burst with
+    varying suffixes — the traffic shape affinity exists for (repeated
+    prompts, shared system prefixes)."""
+    rng = np.random.default_rng(7)
+    prefixes = [np.arange(100 * p, 100 * p + 3 * PAGE, dtype=np.int32)
+                for p in range(3)]
+    stream = []
+    for p in prefixes:
+        for _ in range(8):
+            suffix = rng.integers(1000, 2000, size=PAGE // 2)
+            stream.append((np.concatenate([p, suffix]).astype(np.int32),
+                           int(p.size)))
+    return stream
+
+
+def test_affinity_routing_beats_round_robin_on_prefix_hits():
+    """The ISSUE's deterministic A/B: with a warmed prefix on one
+    replica, the affinity fleet's prefix_hits strictly exceed the
+    round-robin twin's on the SAME request stream."""
+    stream = _request_stream()
+    results = {}
+    for policy in ("affinity", "round_robin"):
+        edge, sims, _ = _fleet(policy)
+        # warm the first prefix where the policy puts it
+        warm = stream[0]
+        code, payload = edge.handle(
+            FleetRequest(prompt=warm[0], prefix_len=warm[1]))
+        assert code == 200
+        for prompt, prefix_len in stream:
+            code, payload = edge.handle(
+                FleetRequest(prompt=prompt, prefix_len=prefix_len))
+            assert code == 200, payload
+        results[policy] = fleet_prefix_hits(sims)
+    assert results["affinity"] > results["round_robin"], results
+    # affinity is not merely "one replica": every repeat of a given
+    # prefix rode the SAME replica, so the fleet hit rate approaches 1
+    assert results["affinity"] >= len(stream) - 3
+
+
+def test_affinity_repeat_prompt_sticks_to_one_replica():
+    edge, sims, _ = _fleet("affinity")
+    prompt = np.arange(2 * PAGE, dtype=np.int32)
+    for _ in range(8):
+        code, payload = edge.handle(FleetRequest(prompt=prompt,
+                                                 prefix_len=prompt.size))
+        assert code == 200
+    served = [s for s in sims.values() if s.requests]
+    assert len(served) == 1
+    assert served[0].prefix_hits == 7      # all but the first
+
+
+def test_keyless_requests_round_robin():
+    """No full prefix page -> no affinity key -> plain load spreading
+    (the router must not hash tiny prompts onto one arc)."""
+    edge, sims, _ = _fleet("affinity")
+    for i in range(6):
+        code, _ = edge.handle(
+            FleetRequest(prompt=np.arange(PAGE - 1), prefix_len=PAGE - 1))
+        assert code == 200
+    assert sorted(s.requests for s in sims.values()) == [2, 2, 2]
+
+
+# -- SLO-class shedding ------------------------------------------------------
+
+
+def _pressured_gate(pressure_free_frac):
+    gate = SloAdmissionGate()
+    gate.observe_snapshot("r0", {"pages_total": 100,
+                                 "pages_free": pressure_free_frac * 100,
+                                 "slots": 8, "pending": 0})
+    return gate
+
+
+def test_shed_lowest_class_first():
+    """Pressure between batch's and standard's thresholds sheds batch
+    only; past standard's, interactive still serves — lowest-class-
+    first by construction, pinned across the ramp."""
+    for free, expect_admitted in [
+        (60, {"batch", "standard", "interactive"}),   # pressure .40
+        (25, {"standard", "interactive"}),            # pressure .75
+        (5, {"interactive"}),                         # pressure .95
+        (1, set()),                                   # pressure .99
+    ]:
+        gate = _pressured_gate(free / 100)
+        admitted = {cls for cls in DEFAULT_SLO_CLASSES
+                    if gate.admit(cls)[0]}
+        assert admitted == expect_admitted, (free, admitted)
+
+
+def test_shed_counts_spans_and_headers():
+    """A shed increments kftpu_edge_shed_total{class} and records an
+    edge.shed span INSIDE the request's trace; the class comes from the
+    X-Kftpu-Slo-Class header, unknown values take the default."""
+    sims = {"r0": ReplicaSim("r0", page_size=PAGE)}
+    router = FleetRouter(page_size=PAGE)
+    router.sync({"r0": "http://r0"})
+    tracer, col = _tracer()
+    gate = SloAdmissionGate()
+    gate.observe_snapshot("r0", {"pages_total": 10, "pages_free": 2,
+                                 "slots": 4, "pending": 1})
+    edge = FleetEdge(router, gate, dispatch=sim_dispatch(sims),
+                     tracer=tracer)
+    shed_c = DEFAULT_REGISTRY.counter("kftpu_edge_shed_total")
+    before = shed_c.get(**{"class": "batch"})
+    code, payload = edge.handle(FleetRequest(
+        prompt=np.arange(2 * PAGE),
+        headers={"x-kftpu-slo-class": "batch"}))   # any header casing
+    assert code == 503 and payload["sloClass"] == "batch"
+    assert payload["retryAfterSeconds"] >= 1
+    assert shed_c.get(**{"class": "batch"}) == before + 1
+    shed_spans = [s for s in col.spans() if s.name == "edge.shed"]
+    assert len(shed_spans) == 1
+    root = [s for s in col.spans() if s.name == "edge.fleet.request"][0]
+    assert shed_spans[0].trace_id == root.trace_id
+    assert shed_spans[0].attrs["slo.class"] == "batch"
+    # unknown class name -> default table entry, not a client-invented
+    # free pass
+    code, payload = edge.handle(FleetRequest(
+        prompt=np.arange(2 * PAGE),
+        headers={"X-Kftpu-Slo-Class": "vip-please"}))
+    assert payload.get("sloClass", "standard") == "standard"
+
+
+def test_overload_burst_trace_shows_shed_served_split():
+    """The ROADMAP acceptance in miniature: a burst at 2x capacity
+    under ONE root span yields a single trace holding BOTH served
+    requests and shed decisions, lowest class first."""
+    sims = {f"r{i}": ReplicaSim(f"r{i}", page_size=PAGE)
+            for i in range(3)}
+    router = FleetRouter(page_size=PAGE)
+    router.sync({name: "http://x" for name in sims})
+    tracer, col = _tracer()
+    gate = SloAdmissionGate()
+    edge = FleetEdge(router, gate, dispatch=sim_dispatch(sims),
+                     tracer=tracer)
+    # overload: the burst nearly exhausted every replica's KV pages
+    # (pressure 0.95 — between standard's 0.90 and interactive's 0.98)
+    for name in sims:
+        edge.poll_backends({name: {"pages_total": 100, "pages_free": 5,
+                                   "slots": 4, "pending": 0}})
+    classes = ["interactive", "standard", "batch"]
+    with tracer.span("edge.burst") as burst:
+        outcomes = {}
+        for i in range(24):
+            cls = classes[i % 3]
+            code, _ = edge.handle(FleetRequest(
+                prompt=np.arange(2 * PAGE),
+                headers={"X-Kftpu-Slo-Class": cls}))
+            outcomes.setdefault(cls, []).append(code)
+    trace = col.trace(burst.trace_id)
+    sheds = [s for s in trace if s.name == "edge.shed"]
+    served = [s for s in trace if s.name == "edge.fleet.request"
+              and s.attrs.get("http.status") == 200]
+    assert sheds and served, "one trace must show the shed/served split"
+    assert set(outcomes["interactive"]) == {200}
+    assert set(outcomes["batch"]) == {503}
+    assert set(outcomes["standard"]) == {503}  # pressure 1.0 >= 0.90
+    assert all(s.attrs["slo.class"] in ("batch", "standard")
+               for s in sheds)
+
+
+def test_stream_never_cut_by_shed():
+    """Shedding gates ADMISSION only: a response streaming when the
+    fleet goes overloaded completes to the last chunk, while new
+    requests of the same class shed."""
+    router = FleetRouter(page_size=PAGE)
+    router.sync({"r0": "http://r0"})
+    gate = SloAdmissionGate()
+    chunks = ["a", "b", "c", "d"]
+
+    def dispatch(replica, target, request):
+        def stream():
+            for i, c in enumerate(chunks):
+                if i == 1:
+                    # overload lands mid-stream
+                    gate.observe_snapshot(
+                        "r0", {"pages_total": 10, "pages_free": 0,
+                               "slots": 2, "pending": 6})
+                yield c
+        return stream()
+
+    edge = FleetEdge(router, gate, dispatch=dispatch)
+    code, stream = edge.handle(FleetRequest(
+        prompt=np.arange(PAGE), headers={"X-Kftpu-Slo-Class": "batch"}))
+    assert code == 200
+    _, inflight = router.view()
+    assert inflight["r0"] == 1          # held for the stream's life
+    got = list(stream)                   # overload hits after chunk 0
+    assert got == chunks                 # never cut
+    assert router.view()[1]["r0"] == 0   # released exactly once
+    # but a NEW batch request now sheds
+    code, _ = edge.handle(FleetRequest(
+        prompt=np.arange(PAGE), headers={"X-Kftpu-Slo-Class": "batch"}))
+    assert code == 503
+
+
+def test_shed_counter_reads_back_through_tsdb_query_api():
+    """kftpu_edge_shed_total{class} is readable through the PR 9
+    monitoring tier: registry -> TimeSeriesStore -> dashboard
+    GET /api/metrics/query (the ISSUE's acceptance wiring)."""
+    from kubeflow_tpu.dashboard.server import DashboardApi
+    from kubeflow_tpu.k8s import FakeKubeClient
+    from kubeflow_tpu.obs.tsdb import TimeSeriesStore
+
+    router = FleetRouter(page_size=PAGE)
+    router.sync({"r0": "http://r0"})
+    gate = SloAdmissionGate()
+    gate.observe_snapshot("r0", {"pages_total": 10, "pages_free": 0,
+                                 "slots": 2, "pending": 4})
+    edge = FleetEdge(router, gate,
+                     dispatch=lambda r, t, q: {"ok": True})
+    code, _ = edge.handle(FleetRequest(
+        prompt=np.arange(PAGE), headers={"X-Kftpu-Slo-Class": "batch"}))
+    assert code == 503
+    t = [5000.0]
+    store = TimeSeriesStore(clock=lambda: t[0])
+    store.sample_registry(DEFAULT_REGISTRY)
+    api = DashboardApi(FakeKubeClient(), tsdb=store, edge=edge)
+    code, body = api.handle(
+        "GET", "/api/metrics/query?metric=kftpu_edge_shed_total"
+               "&label=class:batch", None)
+    assert code == 200
+    assert body["result"], body
+    assert body["result"][0]["value"] >= 1.0
+    # and the fleet panel route serves the in-process status
+    code, view = api.handle("GET", "/api/metrics/edge", None)
+    assert code == 200
+    assert view["shed"].get("batch", 0) >= 1
+    assert view["replicas"][0]["name"] == "r0"
+    assert view["sloClasses"]["batch"]["rank"] == 0
+
+
+def test_dashboard_edge_view_registry_fallback():
+    from kubeflow_tpu.dashboard.server import DashboardApi
+    from kubeflow_tpu.k8s import FakeKubeClient
+
+    api = DashboardApi(FakeKubeClient())
+    code, view = api.handle("GET", "/api/metrics/edge", None)
+    assert code == 200
+    assert "metrics" in view
+
+
+def test_gate_pressure_ignores_evictable_pages_and_clamps():
+    """Review pins: (1) a warm IDLE replica — pool full of evictable
+    prefix-trie pages — reads as pressure ~0, or good affinity warm-up
+    would shed traffic; (2) per-replica pressure clamps to 1.0, so one
+    wedged replica contributes at most 1/n to the fleet mean instead
+    of shedding a fleet that is mostly idle."""
+    gate = SloAdmissionGate()
+    # 90 of 100 pages in use, but 85 of those are idle trie pages
+    p = gate.observe_snapshot("warm", {"pages_total": 100,
+                                       "pages_free": 10,
+                                       "pages_evictable": 85,
+                                       "slots": 8, "pending": 0})
+    assert p == pytest.approx(0.05)
+    assert gate.admit("batch")[0]
+    # a wedged replica (queue 25x slots) cannot exceed 1.0...
+    gate2 = SloAdmissionGate()
+    for i in range(9):
+        gate2.observe_snapshot(f"idle{i}", {"pages_total": 100,
+                                            "pages_free": 100,
+                                            "slots": 4, "pending": 0})
+    assert gate2.observe_snapshot(
+        "wedged", {"pages_total": 100, "pages_free": 50,
+                   "slots": 4, "pending": 100}) == 1.0
+    # ...so nine idle replicas keep the fleet admitting every class
+    assert gate2.fleet_pressure() == pytest.approx(0.1)
+    assert all(gate2.admit(c)[0] for c in DEFAULT_SLO_CLASSES)
+
+
+def test_dropped_stream_releases_inflight():
+    """Review pin: a streamed response the caller drops WITHOUT ever
+    starting it (client gone before the first chunk) still releases
+    the replica's in-flight count — a leaked count would spill the
+    replica's affinity arc for the life of the process."""
+    router = FleetRouter(page_size=PAGE)
+    router.sync({"r0": "http://r0"})
+    edge = FleetEdge(router, SloAdmissionGate(),
+                     dispatch=lambda r, t, q: iter(["a", "b"]))
+    code, stream = edge.handle(FleetRequest(prompt=np.arange(PAGE)))
+    assert code == 200 and router.view()[1]["r0"] == 1
+    stream.close()                       # never started
+    assert router.view()[1]["r0"] == 0
+    # and release is exactly-once across close/exhaust/GC
+    code, stream = edge.handle(FleetRequest(prompt=np.arange(PAGE)))
+    assert list(stream) == ["a", "b"]
+    stream.close()
+    del stream
+    assert router.view()[1]["r0"] == 0
+
+
+def test_dispatch_errors_relay_backend_status():
+    """Review pin: a backend's own verdict reaches the client — its
+    400 is a 400, a dead replica a 502 — never a generic edge 500
+    (the status-relay stance of the other proxies)."""
+    from kubeflow_tpu.edge.fleet import DispatchError
+
+    router = FleetRouter(page_size=PAGE)
+    router.sync({"r0": "http://r0"})
+
+    def bad_dispatch(replica, target, request):
+        raise DispatchError(429, {"error": "backend queue full"})
+
+    edge = FleetEdge(router, SloAdmissionGate(), dispatch=bad_dispatch)
+    code, payload = edge.handle(FleetRequest(prompt=np.arange(PAGE)))
+    assert code == 429 and payload["error"] == "backend queue full"
+    assert router.view()[1]["r0"] == 0      # in-flight released
+    # http_dispatch maps a real upstream HTTPError / dead socket
+    from kubeflow_tpu.utils.jsonhttp import serve_json
+
+    def backend(method, path, body, user="", headers=None):
+        return 404, {"error": "no such model"}
+
+    srv = serve_json(backend, 0, background=True)
+    try:
+        from kubeflow_tpu.edge.fleet import http_dispatch
+
+        dispatch = http_dispatch(timeout_s=5)
+        with pytest.raises(DispatchError) as exc:
+            dispatch("r0", f"http://127.0.0.1:{srv.server_address[1]}",
+                     FleetRequest(path="/model/x:generate", body={}))
+        assert exc.value.code == 404
+        with pytest.raises(DispatchError) as exc:
+            dispatch("r0", "http://127.0.0.1:1",
+                     FleetRequest(path="/x", body={}))
+        assert exc.value.code == 502
+    finally:
+        srv.shutdown()
+
+
+def test_default_affinity_cap_groups_late_diverging_prompts():
+    """Review pin: the DEFAULT router caps the chain depth — bounded
+    hashing on the hot path, and prompts sharing a long system prefix
+    but diverging late land on the SAME replica (where the shared
+    pages live)."""
+    from kubeflow_tpu.edge.fleet import DEFAULT_AFFINITY_PAGES
+
+    router = FleetRouter(page_size=1)   # 1 token per page: depth = len
+    shared = np.arange(DEFAULT_AFFINITY_PAGES + 4)
+    a = np.concatenate([shared[:DEFAULT_AFFINITY_PAGES + 2], [991]])
+    b = np.concatenate([shared[:DEFAULT_AFFINITY_PAGES + 2], [992]])
+    assert router.key_for(a, a.size) == router.key_for(b, b.size)
+    exact = FleetRouter(page_size=1, affinity_pages=0)  # opt-out
+    assert exact.key_for(a, a.size) != exact.key_for(b, b.size)
+
+
+def test_pick_acquires_load_atomically():
+    """Review pin: pick() increments the in-flight count under the
+    SAME lock the bound was evaluated with — M concurrent picks of one
+    hot key cannot all see the home replica idle and overshoot the
+    spill bound by M (the read-then-start window)."""
+    router = FleetRouter(page_size=PAGE, load_factor=1.5)
+    router.sync({f"r{i}": "http://x" for i in range(3)})
+    prompt = np.arange(2 * PAGE)
+    picks = [router.pick(prompt, prompt.size) for _ in range(3)]
+    replicas = [p[0] for p in picks]
+    # bound = 1.5*(total+1)/3: the first pick takes the home replica,
+    # the immediate next (nothing finished yet) must spill
+    assert len(set(replicas)) >= 2, replicas
+    assert picks[0][2] is False and picks[1][2] is True
+    for r in replicas:
+        router.finish(r)
+    assert all(v == 0 for v in router.view()[1].values())
+
+
+def test_backend_poller_scrapes_concurrently():
+    """Review pin: one dead replica must not stall the whole fleet's
+    telemetry round — targets are fetched concurrently, so the gate's
+    pressure stays live exactly when overload makes it matter."""
+    import threading as _threading
+
+    from kubeflow_tpu.edge.fleet import BackendPoller
+
+    n = 4
+    barrier = _threading.Barrier(n, timeout=5.0)
+
+    def fetch(url):
+        # passes only if all n fetches are in flight at once; a serial
+        # walk would park on the first wait until the barrier breaks
+        barrier.wait()
+        return ("kftpu_engine_kv_pages_free 50\n"
+                "kftpu_engine_kv_pages_in_use 50\n")
+
+    router = FleetRouter(page_size=PAGE)
+    router.sync({f"r{i}": f"http://r{i}" for i in range(n)})
+    gate = SloAdmissionGate()
+    edge = FleetEdge(router, gate, dispatch=lambda r, t, q: {})
+    poller = BackendPoller(edge, fetch=fetch)
+    assert poller.poll_once() == pytest.approx(0.5)
+    assert all(gate.pressure_of(f"r{i}") == 0.5 for i in range(n))
+
+
+def test_backend_poller_survives_garbled_backend():
+    """Review pin: a garbled target (BadStatusLine is an
+    HTTPException, not an OSError) costs ITS reading only — it must
+    not escape the concurrent map, abort the round, and freeze the
+    fleet's pressure map while that pod stays half-dead."""
+    import http.client
+
+    from kubeflow_tpu.edge.fleet import BackendPoller
+
+    router = FleetRouter(page_size=PAGE)
+    router.sync({"good": "http://good", "bad": "http://bad"})
+    gate = SloAdmissionGate()
+    edge = FleetEdge(router, gate, dispatch=lambda r, t, q: {})
+
+    def fetch(url):
+        if "bad" in url:
+            raise http.client.BadStatusLine("garbage")
+        return ("kftpu_engine_kv_pages_free 5\n"
+                "kftpu_engine_kv_pages_in_use 95\n")
+
+    poller = BackendPoller(edge, fetch=fetch)
+    assert poller.poll_once() == pytest.approx(0.95)
+    assert gate.pressure_of("good") == pytest.approx(0.95)
+    assert gate.pressure_of("bad") == 0.0  # forgotten, not frozen
+
+
+def test_backend_poller_rides_shared_runtime():
+    """The poll loop is a Controller.periodic like every other
+    periodic loop (autoscaler tick, queue cycle, scraper) — visible
+    poll ticks, no bespoke while/sleep thread."""
+    import time as _time
+
+    from kubeflow_tpu.edge.fleet import BackendPoller
+
+    router = FleetRouter(page_size=PAGE)
+    router.sync({"r0": "http://r0"})
+    gate = SloAdmissionGate()
+    edge = FleetEdge(router, gate, dispatch=lambda r, t, q: {})
+    poller = BackendPoller(
+        edge, fetch=lambda url: ("kftpu_engine_kv_pages_free 5\n"
+                                 "kftpu_engine_kv_pages_in_use 95\n"))
+    ctrl = poller.build_controller(interval_s=0.01)
+    ctrl.start()
+    try:
+        deadline = _time.monotonic() + 5.0
+        while _time.monotonic() < deadline and gate.fleet_pressure() == 0:
+            _time.sleep(0.01)
+        assert gate.fleet_pressure() == pytest.approx(0.95)
+    finally:
+        ctrl.stop()
+
+
+def test_custom_slo_table_without_standard_boots():
+    """Review pin: a custom table need not contain 'standard' — the
+    default falls to the LOWEST-rank (most sheddable) class, and class
+    names are case-insensitive end to end (an env-configured 'Gold'
+    must be selectable by a 'gold' header)."""
+    gate = SloAdmissionGate({"Gold": (2, 0.98), "bronze": (0, 0.70)})
+    assert gate.default_class == "bronze"
+    assert gate.classify({"X-Kftpu-Slo-Class": "Gold"}) == "gold"
+    assert gate.classify({"X-Kftpu-Slo-Class": "gold"}) == "gold"
+    assert gate.classify(None) == "bronze"
+    with pytest.raises(ValueError):
+        SloAdmissionGate({})
+    with pytest.raises(ValueError):
+        SloAdmissionGate({"a": (0, 0.5)}, default_class="nope")
+
+
+def test_backend_poller_feeds_the_gate():
+    """Review pin: the deployed edge's gate is fed by a scrape loop
+    over each replica's /metrics — pressure rises from real engine
+    series, an engine-less target is forgotten (never pressure 0), and
+    an unreachable one drops out of the fleet average."""
+    from kubeflow_tpu.edge.fleet import BackendPoller, scrape_snapshot
+
+    expositions = {
+        "http://r0/metrics": (
+            'kftpu_engine_kv_pages_free{model="m"} 5\n'
+            'kftpu_engine_kv_pages_in_use{model="m"} 95\n'
+            'kftpu_engine_pending_requests{model="m"} 0\n'),
+        "http://r1/metrics": "some_other_series 1\n",
+    }
+
+    def fetch(url):
+        if url not in expositions:
+            raise OSError("unreachable")
+        return expositions[url]
+
+    router = FleetRouter(page_size=PAGE)
+    router.sync({"r0": "http://r0", "r1": "http://r1",
+                 "r2": "http://r2"})
+    gate = SloAdmissionGate()
+    edge = FleetEdge(router, gate, dispatch=lambda r, t, q: {})
+    poller = BackendPoller(edge, fetch=fetch)
+    pressure = poller.poll_once()
+    # only r0 carries engine telemetry: fleet pressure IS its 0.95
+    assert pressure == pytest.approx(0.95)
+    assert gate.pressure_of("r0") == pytest.approx(0.95)
+    assert gate.pressure_of("r1") == 0.0   # forgotten, not zero-counted
+    assert not gate.admit("batch")[0]
+    # the exposition's own kftpu_engine_slots gauge carries capacity;
+    # slots_hint is only the fallback for backends predating it
+    snap = scrape_snapshot(
+        'kftpu_engine_slots{model="m"} 16\n'
+        "kftpu_engine_kv_pages_free 90\n"
+        "kftpu_engine_kv_pages_in_use 10\n"
+        "kftpu_engine_pending_requests 8\n", slots_hint=4)
+    assert snap["pending"] == 8.0 and snap["slots"] == 16.0
+    snap = scrape_snapshot(
+        "kftpu_engine_kv_pages_free 90\n"
+        "kftpu_engine_kv_pages_in_use 10\n"
+        "kftpu_engine_pending_requests 8\n", slots_hint=4)
+    assert snap["slots"] == 4.0
+    assert scrape_snapshot("unrelated 1\n") is None
+
+
+def test_backend_poller_queue_wait_window_and_prune():
+    """Review pins: (1) the queue-wait SLO signal is LIVE in the
+    scraped path — the poller differences engine_queue_wait_seconds
+    _sum/_count between scrapes into a windowed average the gate
+    prices (a lifetime average would bury a fresh spike); (2) a
+    scaled-away replica's pressure entry is pruned, not averaged into
+    the fleet forever."""
+    from kubeflow_tpu.edge.fleet import BackendPoller
+
+    state = {"sum": 0.0, "count": 0.0}
+
+    def exposition(url):
+        return (f'engine_queue_wait_seconds_sum {state["sum"]}\n'
+                f'engine_queue_wait_seconds_count {state["count"]}\n'
+                'kftpu_engine_kv_pages_free 100\n'
+                'kftpu_engine_kv_pages_in_use 0\n')
+
+    router = FleetRouter(page_size=PAGE)
+    router.sync({"r0": "http://r0"})
+    gate = SloAdmissionGate(queue_wait_slo_s=1.0)
+    edge = FleetEdge(router, gate, dispatch=lambda r, t, q: {})
+    poller = BackendPoller(edge, fetch=exposition)
+    assert poller.poll_once() == 0.0          # first scrape: baseline
+    # 10 requests waited 0.5s each since the last scrape: pressure 0.5
+    state["sum"], state["count"] = 5.0, 10.0
+    assert poller.poll_once() == pytest.approx(0.5)
+    # idle window: no new observations -> queue-wait signal silent
+    assert poller.poll_once() == 0.0
+    # waits blow the SLO: 2s avg clamps into full pressure
+    state["sum"], state["count"] = 45.0, 30.0
+    assert poller.poll_once() == 1.0
+    assert not gate.admit("interactive")[0]
+    # the replica scales away: its 1.0 must not haunt the fleet mean
+    edge.sync_replicas({"r1": "http://r1"})
+    assert gate.pressure_of("r0") == 0.0
+    router.sync({"r1": "http://r1", "r2": "http://r2"})  # raw sync...
+    gate.observe_snapshot("gone", {"pages_total": 10, "pages_free": 0})
+    poller.fetch = lambda url: "kftpu_engine_kv_pages_free 10\n" \
+                               "kftpu_engine_kv_pages_in_use 0\n"
+    poller.poll_once()                         # ...poll prunes strays
+    assert gate.pressure_of("gone") == 0.0
+    assert gate.fleet_pressure() == 0.0
+    # the queue-wait baseline goes with the replica: r0 scaled away,
+    # so its (sum, count) entry must not linger (pod-name churn) nor
+    # serve as the diff baseline if a same-named replica returns
+    assert "r0" not in poller._qw_last
+
+
+def test_gateway_component_renders_fleet_edge():
+    """fleet_edge: true adds the kftpu-fleet-edge Deployment + Service
+    and a /fleet/ route on the auth proxy, with EVERY gate/router knob
+    plumbed to env — in particular KFTPU_FLEET_SLOTS, without which the
+    queue-depth pressure signal is silently off in the deployed edge."""
+    import json as _json
+
+    from kubeflow_tpu.config.deployment import (
+        ComponentSpec,
+        DeploymentConfig,
+    )
+    from kubeflow_tpu.manifests import components  # noqa: F401
+    from kubeflow_tpu.manifests.registry import render_component
+
+    config = DeploymentConfig(name="d", namespace="kf")
+    objs = render_component(config, ComponentSpec(
+        name="gateway", params={
+            "fleet_edge": True, "fleet_slots": 8,
+            "fleet_slo_classes": {"gold": [2, 0.98], "bronze": [0, 0.7]},
+            "fleet_default_class": "bronze",
+            "fleet_replicas": {"r0": "http://model-server-0:8500"}}))
+    deploys = {o["metadata"]["name"]: o for o in objs
+               if o["kind"] == "Deployment"}
+    assert "kftpu-fleet-edge" in deploys
+    env = {e["name"]: e["value"] for e in
+           deploys["kftpu-fleet-edge"]["spec"]["template"]["spec"]
+           ["containers"][0]["env"]}
+    assert env["KFTPU_FLEET_SLOTS"] == "8"
+    assert env["KFTPU_FLEET_POLL_S"] == "2.0"
+    assert env["KFTPU_SLO_DEFAULT_CLASS"] == "bronze"
+    assert _json.loads(env["KFTPU_SLO_CLASSES"])["gold"] == [2, 0.98]
+    assert _json.loads(env["KFTPU_FLEET_REPLICAS"])["r0"]
+    svcs = {o["metadata"]["name"]: o for o in objs
+            if o["kind"] == "Service"}
+    assert "kftpu-fleet-edge" in svcs
+    # the edge's own series must be scrapable in a deployment: the
+    # monitoring component derives targets from these annotations
+    ann = svcs["kftpu-fleet-edge"]["metadata"]["annotations"]
+    assert ann["prometheus.io/scrape"] == "true"
+    assert ann["prometheus.io/port"] == "8089"
+    assert env["KFTPU_FLEET_METRICS_PORT"] == "8089"
+    gw_env = {e["name"]: e["value"] for e in
+              deploys["kftpu-ingressgateway"]["spec"]["template"]["spec"]
+              ["containers"][0]["env"]}
+    routes = _json.loads(gw_env["KFTPU_ROUTES"])
+    assert any(r["prefix"] == "/fleet/" for r in routes)
+    assert routes[-1]["prefix"] == "/"    # catch-all stays last
+
+
+# -- model multiplexing ------------------------------------------------------
+
+
+def test_multiplex_single_flight():
+    """The ISSUE acceptance: N concurrent requests for one cold model
+    trigger exactly ONE model_store load; everyone gets the handle and
+    the cold-start ms surfaces in snapshot()."""
+    loads = []
+    gate = threading.Event()
+
+    def loader(name):
+        loads.append(name)
+        gate.wait(2.0)
+        return f"<{name}>"
+
+    t = [0.0]
+
+    def clock():
+        t[0] += 0.005
+        return t[0]
+
+    mux = ModelMultiplexer(loader=loader, max_resident=2, clock=clock)
+    got = []
+    threads = [threading.Thread(target=lambda: got.append(mux.get("m")))
+               for _ in range(8)]
+    for th in threads:
+        th.start()
+    gate.set()
+    for th in threads:
+        th.join(5.0)
+    assert got == ["<m>"] * 8
+    assert loads == ["m"], "single-flight: exactly one store load"
+    snap = mux.snapshot()
+    assert snap["multiplex_loads"] == 1
+    assert snap["models"]["m"]["cold_start_ms"] > 0
+
+
+def test_multiplex_lru_pages_out_cold_models_never_pinned():
+    loads = []
+    mux = ModelMultiplexer(loader=lambda n: (loads.append(n) or n),
+                           max_resident=2, pinned=("hot",))
+    assert mux.resident_models() == ["hot"]
+    mux.get("a")
+    mux.get("b")                      # pages out a (LRU), never hot
+    assert mux.resident_models() == ["b", "hot"]
+    assert mux.evictions == 1
+    mux.get("a")                      # re-fault = a second load
+    assert loads.count("a") == 2
+    snap = mux.snapshot()
+    assert snap["models_resident"] == 2
+    assert snap["models_pinned"] == 1
+    assert snap["models"]["hot"]["pinned"] is True
+    # review pin: a pinned idle model is NOT evictable — a pager
+    # saturated by its pinned hot set must read as resident-weight
+    # pressure (nothing else can fault in), not as reclaimable cache
+    assert snap["models_evictable"] == 1   # only "a"/"b", never "hot"
+
+
+def test_multiplex_leased_models_are_not_evictable():
+    mux = ModelMultiplexer(loader=lambda n: n, max_resident=1)
+    with mux.lease("a") as h:
+        assert h == "a"
+        with pytest.raises(MultiplexFull):
+            mux.get("b")
+    mux.get("b")                      # lease released -> a pages out
+    assert mux.resident_models() == ["b"]
+
+
+def test_multiplex_failed_load_fails_the_herd_then_recovers():
+    calls = []
+
+    def loader(name):
+        calls.append(name)
+        if len(calls) == 1:
+            raise RuntimeError("store unreachable")
+        return name
+
+    mux = ModelMultiplexer(loader=loader, max_resident=1)
+    with pytest.raises(RuntimeError):
+        mux.get("m")
+    assert mux.get("m") == "m"        # the error is not sticky
+    # review pin: failed faults leave NOTHING behind — clients probing
+    # unique bogus names must not grow server-side state (each stored
+    # exception would pin its traceback frames too)
+    for i in range(5):
+        with pytest.raises(RuntimeError):
+            ModelMultiplexer(loader=lambda n: (_ for _ in ()).throw(
+                RuntimeError("x")), max_resident=1).get(f"bogus{i}")
+    assert mux._loading == {}
+    assert not hasattr(mux, "_load_error")
+
+
+def test_multiplex_real_store_roundtrip(tmp_path):
+    """Weights actually page from a versioned model_store export: the
+    default loader binds load_version on the newest version."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import MnistCnn
+    from kubeflow_tpu.serving.model_store import export_model
+
+    model = MnistCnn()
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 28, 28, 1)))["params"]
+    export_model(str(tmp_path / "mnist"), "mnist", params, version=1)
+    mux = ModelMultiplexer(str(tmp_path), max_resident=1)
+    loaded = mux.get("mnist")
+    assert loaded.kind == "mnist" and loaded.version == 1
+    assert mux.snapshot()["models"]["mnist"]["cold_start_ms"] > 0
+    with pytest.raises(FileNotFoundError):
+        mux.get("nope")
+
+
+def test_observe_engine_gains_model_occupancy():
+    """The autoscaler's engine poll reads resident-weight pressure from
+    a multiplexed backend: a pager thrashing at full residency raises
+    the concurrency signal even with zero active slots; idle resident
+    models (evictable) read as cache, not load."""
+    from kubeflow_tpu.autoscale.metrics import MetricsAggregator
+
+    class Snap:
+        def __init__(self, snap):
+            self._s = snap
+
+        def snapshot(self):
+            return self._s
+
+    t = [100.0]
+    agg = MetricsAggregator(clock=lambda: t[0])
+    # full residency, every model leased: pressure = slots
+    agg.observe_engine("m", Snap({
+        "active_slots": 0, "pending": 0, "slots": 8,
+        "models_resident": 4, "models_max": 4, "models_evictable": 0}))
+    assert agg.window("m", 10.0).concurrency == pytest.approx(8.0)
+    # all resident models idle -> reclaimable cache -> no load
+    t[0] += 30.0
+    agg2 = MetricsAggregator(clock=lambda: t[0])
+    agg2.observe_engine("m", Snap({
+        "active_slots": 0, "pending": 0, "slots": 8,
+        "models_resident": 4, "models_max": 4, "models_evictable": 4}))
+    assert agg2.window("m", 10.0).concurrency == 0.0
+    # standalone pager (no engine slots): models_max is the unit
+    agg3 = MetricsAggregator(clock=lambda: t[0])
+    agg3.observe_engine("m", Snap({
+        "active_slots": 0, "pending": 0, "slots": 0,
+        "models_resident": 3, "models_max": 4, "models_evictable": 1}))
+    assert agg3.window("m", 10.0).concurrency == pytest.approx(2.0)

@@ -588,14 +588,23 @@ def test_alert_controller_runs_on_shared_runtime():
     store.ingest("m", 2.0, ts=0.0)
     ctrl = mgr.build_controller(interval_s=0.01)
     ctrl.start()
-    try:
-        deadline = _time.monotonic() + 5.0
-        while _time.monotonic() < deadline and not mgr.firing():
-            _time.sleep(0.01)
-        assert mgr.firing() == ["t-ctl"]
-        assert any(s.name == "controller.reconcile"
+
+    def reconcile_span_recorded():
+        return any(s.name == "controller.reconcile"
                    and s.attrs.get("controller") == "alerts"
                    for s in collector.spans())
+
+    try:
+        # wait for the SPAN too: firing() flips inside the reconcile,
+        # but the controller.reconcile span records only after the
+        # reconcile returns — exiting on firing() alone raced the span
+        # write under CPU contention
+        deadline = _time.monotonic() + 5.0
+        while _time.monotonic() < deadline and not (
+                mgr.firing() and reconcile_span_recorded()):
+            _time.sleep(0.01)
+        assert mgr.firing() == ["t-ctl"]
+        assert reconcile_span_recorded()
     finally:
         ctrl.stop()
 

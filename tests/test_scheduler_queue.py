@@ -316,13 +316,16 @@ def test_shrink_offer_instead_of_preemption():
         "kftpu_shrink_offers_total").get()
     q.submit(_gang("prod", "urgent", slices=2, hosts=2, priority=10))
     q.schedule()
-    # offered, never Preempting
+    # offered, never Preempting — and the offer targets the LARGEST
+    # feasible count, not the floor (ISSUE 12): urgent takes 2 of the
+    # 4 slices, so flex keeps 2; the old floor-only behavior shrank it
+    # to 1 and threw a slice away
     assert q.state_of("d", "flex") == PLACED
-    assert q.shrink_requested("d", "flex") == 1
+    assert q.shrink_requested("d", "flex") == 2
     assert DEFAULT_REGISTRY.counter(
         "kftpu_shrink_offers_total").get() == offers_before + 1
     job = client.get(API_VERSION, TPUJOB_KIND, "d", "flex")
-    assert job["status"]["resize"]["offered"] == 1
+    assert job["status"]["resize"]["offered"] == 2
     assert job["status"]["resize"]["by"] == "prod/urgent"
     # nobody backfills the accelerator while the shrink settles, and
     # the offer is not widened to a second victim
@@ -330,9 +333,12 @@ def test_shrink_offer_instead_of_preemption():
     q.schedule()
     assert q.state_of("d", "tiny") == QUEUED
     assert q.shrink_requested("d", "tiny") is None
+    # (retract the probe gang: with the larger offer the settled fleet
+    # is capacity-exact — urgent 2 + flex 2 fill all 4 slices)
+    q.release("d", "tiny")
     # the resize arrives (operator applied the spec edit): the offer
     # settles, the preemptor and the shrunk gang both place
-    q.submit(_gang("d", "flex", slices=1, hosts=2, min_slices=1))
+    q.submit(_gang("d", "flex", slices=2, hosts=2, min_slices=1))
     q.schedule()
     assert q.shrink_requested("d", "flex") is None
     assert q.state_of("prod", "urgent") == PLACED
@@ -354,7 +360,7 @@ def test_shrink_offer_revoked_when_preemptor_goes_away():
     q.schedule()
     q.submit(_gang("prod", "urgent", slices=2, hosts=2, priority=10))
     q.schedule()
-    assert q.shrink_requested("d", "flex") == 1
+    assert q.shrink_requested("d", "flex") == 2  # largest feasible
     # the preemptor is deleted before the operator applies the offer
     q.release("prod", "urgent")
     assert q.shrink_requested("d", "flex") is None
@@ -367,10 +373,41 @@ def test_shrink_offer_revoked_when_preemptor_goes_away():
     # placed-elsewhere variant: capacity frees while the offer pends
     q.submit(_gang("prod", "urgent2", slices=2, hosts=2, priority=10))
     q.schedule()
-    assert q.shrink_requested("d", "flex") == 1
+    assert q.shrink_requested("d", "flex") == 2
     q.release("d", "flex")          # flex finishes on its own
     q.schedule()                    # urgent2 places on the freed slices
     assert q.state_of("prod", "urgent2") == PLACED
+
+
+def test_shrink_offer_targets_largest_feasible_count():
+    """ISSUE 12 satellite: the offer targets the LARGEST count in
+    ``[minSlices, slices)`` the freed window accommodates — a 4-slice
+    gang yielding to a 1-slice preemptor shrinks to 3, not to its
+    floor of 1 (floor-only shrank 4→1 and idled two slices)."""
+    client = FakeKubeClient()
+    _seed(client, count=6)
+    q = make_queue(client)
+    client.create(tpujob("flex", "d", {
+        "image": "x", "slices": 4, "hostsPerSlice": 2,
+        "elastic": {"minSlices": 1, "maxSlices": 4}}))
+    q.submit(_gang("d", "flex", slices=4, hosts=2, min_slices=1))
+    q.submit(_gang("d", "filler", slices=2, hosts=2))
+    q.schedule()
+    assert q.state_of("d", "flex") == PLACED
+    assert q.state_of("d", "filler") == PLACED     # all 6 slices busy
+    q.submit(_gang("prod", "urgent", slices=1, hosts=2, priority=10))
+    q.schedule()
+    # urgent needs 1 of flex's 4 transiently-freed slices: 3 remain,
+    # so the offer is 3 — the floor (1) would have been feasible too,
+    # but strictly worse for the victim
+    assert q.shrink_requested("d", "flex") == 3
+    job = client.get(API_VERSION, TPUJOB_KIND, "d", "flex")
+    assert job["status"]["resize"]["offered"] == 3
+    # settle: both land, flex at 3 slices
+    q.submit(_gang("d", "flex", slices=3, hosts=2, min_slices=1))
+    q.schedule()
+    assert q.state_of("prod", "urgent") == PLACED
+    assert q.state_of("d", "flex") == PLACED
 
 
 def test_shrink_infeasible_falls_back_to_eviction():
