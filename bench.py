@@ -88,6 +88,11 @@ def main() -> None:
         # perf trajectory shows tails and recompiles, not just means
         # (kubeflow_tpu/obs/steps.py, docs/OBSERVABILITY.md)
         line["step_telemetry"] = headline["step_telemetry"]
+    if "goodput" in headline:
+        # productive-fraction next to img/s (the goodput ledger's bench
+        # twin, docs/OBSERVABILITY.md "Goodput"): wall time the pass
+        # spent stepping vs recompiling vs unattributed host gaps
+        line["goodput"] = headline["goodput"]
     line["extras"] = results
     # the always-on CPU smoke tier (tier:"cpu" rows, tiny shapes): an
     # accelerator outage degrades the artifact to labeled correctness

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import logging
 import threading
 import time
 from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Tuple
@@ -42,6 +43,8 @@ _draining_g = DEFAULT_REGISTRY.gauge(
     "kftpu_autoscale_draining_replicas", "replicas draining before stop")
 
 WARMING, READY, DRAINING = "warming", "ready", "draining"
+
+log = logging.getLogger(__name__)
 
 
 class ReplicaDriver:
@@ -125,6 +128,10 @@ class Autoscaler:
         self.tracer = Tracer(clock=self.clock)
         self._loops: Dict[str, _ModelLoop] = {}
         self._lock = threading.Lock()
+        # fleet-edge wiring (docs/EDGE.md): model -> (edge, url_for),
+        # per model like _loops — every reconcile tick adopts that
+        # model's READY replica set into its edge's hash ring
+        self._fleet: Dict[str, Tuple[Any, Any]] = {}
 
     def _loop(self, model: str) -> _ModelLoop:
         lp = self._loops.get(model)
@@ -196,12 +203,60 @@ class Autoscaler:
             attrs={"model": model, "desired": decision.desired,
                    "granted": plan.granted, "panic": decision.panic,
                    "reason": decision.reason, "capped": plan.capped})
+        self._sync_fleet(model)
         return decision
 
     def reconcile_all(self, now: Optional[float] = None) -> None:
         for model in sorted(set(self.aggregator.models())
                             | set(self._loops)):
             self.reconcile(model, now)
+
+    # -- fleet-edge wiring (docs/EDGE.md) ------------------------------------
+
+    def wire_fleet(self, edge: Any, model: str,
+                   url_for: Optional[Callable[[str, str], str]] = None
+                   ) -> None:
+        """Adopt scale events into the fleet edge's hash ring on every
+        reconcile tick — ROADMAP open item 5's missing wire: the
+        ``FleetRouter.sync`` hook existed, nothing called it
+        periodically. ``edge`` is anything with ``sync_replicas``
+        (:class:`~kubeflow_tpu.edge.fleet.FleetEdge` — preferred, it
+        also drops removed replicas' gate pressure) or a bare
+        ``sync`` (:class:`~kubeflow_tpu.edge.fleet.FleetRouter`);
+        ``url_for(model, slice_id)`` builds each replica's dispatch
+        target (default: the replica name as a bare http host, the
+        headless-Service DNS shape). Per-model, like the scaling loops
+        themselves — wiring a second model never unwires the first;
+        re-wiring the same model replaces its edge. Runs inside
+        :meth:`reconcile`, so the ``build_controller`` periodic tick
+        carries it — a scale event reaches the ring without any
+        manual call."""
+        with self._lock:
+            self._fleet[model] = (edge, url_for)
+
+    def _sync_fleet(self, model: str) -> None:
+        with self._lock:
+            wired = self._fleet.get(model)
+            if wired is None:
+                return
+            edge, url_for = wired
+            lp = self._loops.get(model)
+            ready = [r.slice_id for r in (lp.replicas if lp else [])
+                     if r.phase == READY]
+        try:
+            replicas = {}
+            for slice_id in ready:
+                name = f"{model}-{slice_id}"
+                replicas[name] = (url_for(model, slice_id) if url_for
+                                  else f"http://{name}")
+            sync = getattr(edge, "sync_replicas", None)
+            if sync is None:
+                sync = edge.sync
+            sync(replicas)
+        except Exception:  # noqa: BLE001 — routing hygiene (including a
+            # raising user url_for or a mis-shaped edge) must never fail
+            # the scaling loop; the next tick retries
+            log.exception("fleet ring sync failed for %s", model)
 
     def _promote(self, model: str, lp: _ModelLoop, now: float) -> None:
         for r in lp.replicas:

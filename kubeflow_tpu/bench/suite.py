@@ -187,7 +187,17 @@ def _step_telemetry_pass(step: Callable, sync: Callable[[], None],
         wrapped = telem.wrap(one_synced)
         for _ in range(n_steps):
             wrapped()
-        return {"step_telemetry": telem.summary()}
+        out: Dict[str, Any] = {"step_telemetry": telem.summary()}
+        # the goodput block (docs/OBSERVABILITY.md "Goodput"): the
+        # productive fraction of the pass's wall clock next to img/s,
+        # so a round that recompiles or stalls between steps reads as
+        # the badput it is, not as a flat throughput number
+        from kubeflow_tpu.obs.goodput import from_step_records
+
+        block = from_step_records(telem.recorder.records())
+        if block:
+            out["goodput"] = block
+        return out
     except Exception:  # noqa: BLE001 — evidence, never a bench failure
         return {}
 

@@ -27,6 +27,10 @@ Route parity with the reference's Express server
   queue states, priorities, waits, preemption counts, plus the
   ``kftpu_queue_depth`` / ``kftpu_queue_wait_seconds`` /
   ``kftpu_preemptions_total`` series when no queue is in-process
+- ``GET /api/metrics/goodput``     — the fleet goodput/badput rollup
+  (``kubeflow_tpu/obs/goodput.py``; docs/OBSERVABILITY.md "Goodput"):
+  every TpuJob's ``status.goodput`` ledger weighted by chips × seconds,
+  per-state fractions + per-job rows
 - ``GET /api/metrics/query``       — the monitoring tier's query API
   over the in-process time-series store (``kubeflow_tpu/obs/tsdb.py``):
   instant and range evaluation of ``instant``/``rate``/``delta``/
@@ -50,7 +54,11 @@ Route parity with the reference's Express server
 - ``GET /api/jobs/<ns>/<name>/telemetry`` — training-plane telemetry for
   one TpuJob: step rate, MFU, recompiles, per-worker lag + stragglers,
   aggregated live from the workers' beacon ConfigMaps
-  (``kubeflow_tpu/obs/steps.py``; docs/OBSERVABILITY.md)
+  (``kubeflow_tpu/obs/steps.py``; docs/OBSERVABILITY.md), plus the
+  ``goodput.fraction`` efficiency summary
+- ``GET /api/jobs/<ns>/<name>/goodput`` — one job's goodput ledger:
+  interval timeline, per-state fractions, and the worst badput
+  interval's trace exemplar (resolves via ``GET /api/traces/<id>``)
 """
 
 from __future__ import annotations
@@ -269,6 +277,8 @@ class DashboardApi:
                 return 200, self.scheduler_view()
             if path == "/api/metrics/edge":
                 return 200, self.edge_view()
+            if path == "/api/metrics/goodput":
+                return 200, self.goodput_view()
             if path == "/api/metrics/query":
                 return self.metrics_query(query)
             if path == "/api/alerts":
@@ -295,6 +305,10 @@ class DashboardApi:
                         and parts[2] == "telemetry":
                     self._authz(user, parts[0], "tpujobs")
                     return self.job_telemetry(parts[0], parts[1])
+                if len(parts) == 3 and parts[0] and parts[1] \
+                        and parts[2] == "goodput":
+                    self._authz(user, parts[0], "tpujobs")
+                    return self.job_goodput(parts[0], parts[1])
                 return 404, {"error": f"no route {path}"}
             if path.startswith("/api/tpujobs/"):
                 parts = path[len("/api/tpujobs/"):].split("/")
@@ -439,6 +453,108 @@ class DashboardApi:
         exposition = DEFAULT_REGISTRY.expose()
         return {"metrics": _parse_prom(exposition, "kftpu_edge_")
                 + _parse_prom(exposition, "kftpu_multiplex_")}
+
+    def goodput_view(self) -> Dict[str, Any]:
+        """The fleet goodput rollup (docs/OBSERVABILITY.md "Goodput"):
+        every TpuJob's ``status.goodput`` ledger weighted by
+        chips × seconds, so one idle 256-chip gang outweighs fifty
+        busy singles. Per-job rows carry the fraction the tuning/
+        scheduling planes rank by."""
+        from kubeflow_tpu.manifests.components.tpujob_operator import (
+            API_VERSION,
+            TPUJOB_KIND,
+        )
+        from kubeflow_tpu.obs import goodput as gp
+        from kubeflow_tpu.operators.tpujob import TpuJobSpec
+
+        rows = []
+        jobs = []
+        for j in self.client.list(API_VERSION, TPUJOB_KIND):
+            md = j.get("metadata", {}) or {}
+            spec = j.get("spec", {}) or {}
+            status = j.get("status", {}) or {}
+            g = status.get("goodput")
+            if not g:
+                continue
+            try:
+                # the SAME chips definition the operator weights the
+                # fleet counters with — the rollup and the
+                # job-badput-burn alert must not diverge
+                chips = TpuJobSpec.from_dict(spec).chips
+            except (TypeError, ValueError):
+                # from_dict raises TypeError on null numerics, not
+                # just ValueError — one bad spec must not 500 the
+                # whole fleet rollup
+                # a spec that went invalid after running still has a
+                # ledger; fall back to the schema defaults
+                chips = (int(spec.get("slices", 1) or 1)
+                         * int(spec.get("hostsPerSlice", 1) or 1)
+                         * int(spec.get("chipsPerHost", 4) or 4))
+            rows.append((chips, g))
+            jobs.append({
+                "namespace": md.get("namespace", ""),
+                "name": md.get("name", ""),
+                "phase": status.get("phase", "Pending"),
+                "chips": chips,
+                "wallSeconds": round(
+                    float(g.get("asOf", 0.0) or 0.0)
+                    - float(g.get("start", 0.0) or 0.0), 6),
+                "goodputFraction": round(gp.goodput_fraction(g), 6),
+            })
+        jobs.sort(key=lambda r: (r["namespace"], r["name"]))
+        return {**gp.fleet_rollup(rows), "perJob": jobs}
+
+    def job_goodput(self, ns: str, name: str) -> Tuple[int, Any]:
+        """One job's goodput ledger: the interval timeline, per-state
+        fractions, and a trace-linked exemplar for the single WORST
+        badput interval — the span tree that explains where the wall
+        clock went (``GET /api/traces/<traceId>`` opens it)."""
+        from kubeflow_tpu.manifests.components.tpujob_operator import (
+            API_VERSION,
+            TPUJOB_KIND,
+        )
+        from kubeflow_tpu.obs import goodput as gp
+        from kubeflow_tpu.obs.steps import tpujob_trace_ids
+
+        job = self.client.get_or_none(API_VERSION, TPUJOB_KIND, ns, name)
+        if job is None:
+            return 404, {"error": f"tpujob {name!r} not found"}
+        status = job.get("status", {}) or {}
+        g = status.get("goodput") or {}
+        trace_id, _ = tpujob_trace_ids(
+            ns, name, job.get("metadata", {}).get("uid", ""))
+        worst = gp.worst_badput_interval(g)
+        exemplar = None
+        if worst is not None:
+            exemplar = {**worst,
+                        "seconds": round(worst["end"] - worst["start"],
+                                         6),
+                        "traceId": trace_id}
+            # the span that caused it: the job-trace span overlapping
+            # the interval the most (instantaneous decision spans —
+            # queue place/preempt/requeue — touch it at a boundary)
+            best, best_key = None, None
+            for s in self.collector.spans():
+                if s.trace_id != trace_id:
+                    continue
+                if s.start > worst["end"] or s.end < worst["start"]:
+                    continue
+                overlap = (min(s.end, worst["end"])
+                           - max(s.start, worst["start"]))
+                key = (overlap, s.end - s.start)
+                if best_key is None or key > best_key:
+                    best, best_key = s, key
+            if best is not None:
+                exemplar["spanId"] = best.span_id
+                exemplar["span"] = best.name
+        return 200, {
+            "name": name,
+            "namespace": ns,
+            "phase": status.get("phase", "Pending"),
+            "traceId": trace_id,
+            **gp.view(g),
+            "worstBadput": exemplar,
+        }
 
     def metrics_query(self, query: str) -> Tuple[int, Any]:
         """The monitoring query API over the in-process tsdb
@@ -701,11 +817,20 @@ class DashboardApi:
         trace_id, _ = tpujob_trace_ids(
             ns, name, job.get("metadata", {}).get("uid", ""))
         resize = dict(status.get("resize") or {})
+        from kubeflow_tpu.obs import goodput as gp
+
         return 200, {
             "name": name,
             "namespace": ns,
             "phase": status.get("phase", "Pending"),
             "restarts": status.get("restarts", 0),
+            # efficiency summary (docs/OBSERVABILITY.md "Goodput"): the
+            # productive fraction of the job's wall clock, inline so
+            # the tuning objective harvester can prefer efficient
+            # trials without a second endpoint (the full timeline lives
+            # at /api/jobs/<ns>/<name>/goodput)
+            "goodput": {"fraction": round(gp.goodput_fraction(
+                status.get("goodput")), 6)},
             # elastic-resize visibility (docs/ELASTIC.md): how many
             # resizes this run survived, whether one is in flight, and
             # the step it resumed from (kftpu_job_resizes_total is the

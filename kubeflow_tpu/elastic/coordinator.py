@@ -209,7 +209,14 @@ class ElasticCoordinator:
         self.trace_id, self.root_span_id = tpujob_trace_ids(
             namespace, job, uid)
         self._rng = rng if rng is not None else jax.random.key(0)
-        self.snapshotter = ElasticSnapshotter(manager)
+        # the snapshotter carries the job identity so save wall times
+        # land in kftpu_checkpoint_save_seconds under THIS job's
+        # labels — the goodput ledger's checkpoint_save source
+        # (docs/OBSERVABILITY.md). It keeps its OWN monotonic duration
+        # clock: the coordinator's clock is wall time (span/epoch
+        # alignment) and would count an NTP step as save time
+        self.snapshotter = ElasticSnapshotter(
+            manager, job=job, namespace=namespace)
         self.resizes = 0
         self.n_slices: Optional[int] = None
         self.mesh: Optional[Any] = None

@@ -44,6 +44,17 @@ from kubeflow_tpu.obs.alerts import (  # noqa: F401
     default_rules,
     rule_from_dict,
 )
+from kubeflow_tpu.obs.goodput import (  # noqa: F401
+    BADPUT_STATES,
+    GoodputExporter,
+    GoodputSignals,
+    STATES as GOODPUT_STATES,
+    fleet_rollup,
+    fold as fold_goodput,
+    goodput_fraction,
+    observe_checkpoint_save,
+    worst_badput_interval,
+)
 from kubeflow_tpu.obs.steps import (  # noqa: F401
     FlightRecorder,
     StepRecord,

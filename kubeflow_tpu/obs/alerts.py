@@ -496,6 +496,14 @@ def default_rules() -> List[Rule]:
       at runtime: compilation-cache misses are eating step time.
     - **straggler-flagged** — a TpuJob has had a flagged straggler
       (``kftpu_job_stragglers``, PR 5) for 5 minutes.
+    - **job-badput-burn** — the goodput ledger's chips-weighted badput
+      ratio (``kftpu_fleet_badput_chip_seconds_total`` over
+      ``kftpu_fleet_chip_seconds_total``, docs/OBSERVABILITY.md
+      "Goodput") burning the fleet's 10% non-productive budget —
+      badput IS an error budget, so this reuses ``BurnRateRule``
+      unchanged; the window factors are scaled down from the 5xx
+      ladder because a 10% budget caps the expressible burn ratio at
+      10× (a 14.4× factor could never fire).
     """
     return [
         BurnRateRule(
@@ -546,4 +554,19 @@ def default_rules() -> List[Rule]:
             severity="warning",
             summary="a TpuJob gang has a straggling worker flagged "
                     "for 5m"),
+        BurnRateRule(
+            name="job-badput-burn",
+            numerator="kftpu_fleet_badput_chip_seconds_total",
+            denominator="kftpu_fleet_chip_seconds_total",
+            # 90% of fleet chip-time productive; page when badput
+            # burns ≥6× the 10% budget (≥60% of chip-time wasted) over
+            # 1h&5m, ticket at 3× over 6h&30m
+            objective=0.90,
+            windows=(BurnWindow(3600.0, 300.0, 6.0),
+                     BurnWindow(6 * 3600.0, 1800.0, 3.0)),
+            for_s=60.0,
+            severity="warning",
+            summary="fleet badput (non-productive chip-seconds from "
+                    "the goodput ledger) is burning the 10% "
+                    "efficiency budget"),
     ]

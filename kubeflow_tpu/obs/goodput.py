@@ -1,0 +1,455 @@
+"""Goodput/badput accounting: the per-job wall-clock ledger.
+
+Twelve PRs of instrumentation can say *what happened* (spans, step
+telemetry, a tsdb, alerting) and two of them *cause* downtime on
+purpose (checkpoint-preempt-requeue, elastic snapshot→teardown→reshard)
+— but nothing measured whether those trades pay. This module is the
+denominator the ROADMAP's north star ("as fast as the hardware allows")
+needs: every second of a TpuJob's life attributed to exactly one state
+of an exclusive, exhaustive set, derived ONLY from signals the platform
+already emits — never from new worker-side clocks.
+
+The state set (:data:`STATES`):
+
+========================  ====================================================
+``queue_wait``            no pods; admitted/blocked in the scheduler queue or
+                          held Unschedulable (the queue's admit→place spans
+                          are the trace-side twin of these intervals)
+``startup_compile``       pods up, no step completed yet on a fresh run (the
+                          first-program XLA compile window)
+``productive_step``       the gang's beacon step advanced — the ONLY goodput
+                          state; everything else is badput
+``checkpoint_save``       worker snapshot wall time, carved from the
+                          ``kftpu_checkpoint_save_seconds`` histogram the
+                          :class:`~kubeflow_tpu.elastic.snapshot.
+                          ElasticSnapshotter` observes
+``restore``               pods up after a preemption/resize re-gang, beacon
+                          step still at/behind the checkpoint it resumes from
+``preempted``             torn down for a higher-priority gang; covers the
+                          whole teardown→requeue→re-place gap
+``resizing``              elastic resize in flight (nudge, teardown, re-gang)
+``straggler_stall``       gang running but a straggler is flagged — throughput
+                          is gated by the lagging worker
+``recompile``             the gang's recompile count grew during the window
+``unattributed``          running, steps not advancing, no better explanation
+========================  ====================================================
+
+The TpuJob operator folds one observation per reconcile into CR
+``status.goodput`` (:func:`fold`). The fold is **idempotent under
+reconcile replay**: an observation at or before ``asOf`` is a no-op, so
+replaying the same fake-clock reconcile sequence — or crash-restarting
+the operator mid-resize (all ledger state lives in the CR) — produces
+byte-identical status. Intervals tile ``[start, asOf]`` exactly: no
+overlaps, no gaps, and ``sum(seconds) == asOf - start`` by
+construction. Attribution is observation-lagged by at most one
+reconcile (the window since the last fold is attributed to the state
+observed *now*); reconciles are seconds apart, the intervals that
+matter are minutes.
+
+Exported series (docs/OBSERVABILITY.md "Goodput"):
+
+- ``kftpu_job_goodput_seconds_total{namespace,job,state}`` — per-job
+  counter, so the PR 9 tsdb answers ``goodput_fraction =
+  rate(productive)/rate(all)`` over any window;
+- ``kftpu_fleet_chip_seconds_total`` / ``kftpu_fleet_badput_chip_
+  seconds_total`` — chips-weighted fleet counters (one idle 256-chip
+  gang outweighs fifty busy singles), the ``job-badput-burn``
+  :class:`~kubeflow_tpu.obs.alerts.BurnRateRule`'s numerator and
+  denominator — badput *is* an error budget;
+- ``kftpu_checkpoint_save_seconds{source,...}`` — save wall-time
+  histogram (``source="worker"`` = the actual snapshot,
+  ``source="operator"`` = the ensure/read on the control-plane side);
+  the measurement ROADMAP item 4's snapshot-deadline question needs.
+
+Surfaces: dashboard ``GET /api/jobs/<ns>/<name>/goodput`` (interval
+timeline + fractions + the worst badput interval's trace exemplar) and
+``GET /api/metrics/goodput`` (the chips×seconds fleet rollup), the
+``goodput.fraction`` summary on the job-telemetry route, and the bench
+artifact's ``goodput`` block (:func:`from_step_records`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Tuple
+
+from kubeflow_tpu.utils import DEFAULT_REGISTRY
+
+# -- the state set -----------------------------------------------------------
+
+QUEUE_WAIT = "queue_wait"
+STARTUP_COMPILE = "startup_compile"
+PRODUCTIVE = "productive_step"
+CHECKPOINT_SAVE = "checkpoint_save"
+RESTORE = "restore"
+PREEMPTED = "preempted"
+RESIZING = "resizing"
+STRAGGLER_STALL = "straggler_stall"
+RECOMPILE = "recompile"
+UNATTRIBUTED = "unattributed"
+
+STATES: Tuple[str, ...] = (
+    QUEUE_WAIT, STARTUP_COMPILE, PRODUCTIVE, CHECKPOINT_SAVE, RESTORE,
+    PREEMPTED, RESIZING, STRAGGLER_STALL, RECOMPILE, UNATTRIBUTED,
+)
+BADPUT_STATES: Tuple[str, ...] = tuple(s for s in STATES
+                                       if s != PRODUCTIVE)
+
+# the interval timeline is display/debugging; totals live in "seconds"
+# and survive trimming, so a week-long job cannot grow its CR unbounded
+MAX_INTERVALS = 256
+
+# -- exported series ---------------------------------------------------------
+
+_job_seconds_c = DEFAULT_REGISTRY.counter(
+    "kftpu_job_goodput_seconds_total",
+    "per-job wall-clock seconds attributed by the goodput ledger, "
+    "by state")
+_fleet_chip_seconds_c = DEFAULT_REGISTRY.counter(
+    "kftpu_fleet_chip_seconds_total",
+    "chip-weighted wall-clock seconds across every ledgered TpuJob")
+_fleet_badput_c = DEFAULT_REGISTRY.counter(
+    "kftpu_fleet_badput_chip_seconds_total",
+    "chip-weighted NON-productive seconds across every ledgered TpuJob")
+
+CKPT_SAVE_BUCKETS = (0.05, 0.25, 1.0, 5.0, 15.0, 60.0, 300.0, 900.0)
+_ckpt_save_h = DEFAULT_REGISTRY.histogram(
+    "kftpu_checkpoint_save_seconds",
+    "checkpoint save wall time (source=worker: the snapshot itself; "
+    "source=operator: the control-plane ensure/read)",
+    buckets=CKPT_SAVE_BUCKETS)
+
+
+def observe_checkpoint_save(seconds: float, *, namespace: str = "",
+                            job: str = "",
+                            source: str = "worker") -> None:
+    """Record one save's wall time. Job identity labels the series the
+    ledger carves ``checkpoint_save`` from; an unlabeled observation
+    (no job context) still lands in the fleet histogram."""
+    labels = {"source": source}
+    if job:
+        labels.update({"namespace": namespace, "job": job})
+    _ckpt_save_h.observe(max(float(seconds), 0.0), **labels)
+
+
+def checkpoint_save_seconds(namespace: str, job: str,
+                            source: str = "worker") -> float:
+    """Cumulative worker save seconds for one job, from the in-process
+    registry (the all-in-one-process tier; a deployed operator reads
+    the scraped ``_sum`` series through the tsdb instead)."""
+    return _ckpt_save_h.sum(namespace=namespace, job=job, source=source)
+
+
+# -- observation signals -----------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class GoodputSignals:
+    """One reconcile's observation — everything already emitted
+    elsewhere (CR conditions, queue state, beacon telemetry, the save
+    histogram); the ledger adds no clock of its own."""
+
+    now: float
+    has_pods: bool = False
+    resize_requested: bool = False      # status.resize.requested
+    preemption_requested: bool = False  # status.preemption.requested
+    preemptions: int = 0                # status.preemption.count
+    last_step: int = 0                  # telemetry lastStep (gang max)
+    recompiles: int = 0                 # telemetry gang total
+    stragglers: bool = False            # telemetry straggler flags
+    restore_step: Optional[int] = None  # most recent lastCheckpointStep
+    ckpt_save_seconds: float = 0.0      # cumulative worker save seconds
+
+
+def _coarse(markers: Mapping[str, Any], s: GoodputSignals) -> str:
+    """The window's exclusive state, before the checkpoint-save carve."""
+    if not s.has_pods:
+        if s.resize_requested:
+            return RESIZING             # snapshot→teardown→re-gang gap
+        if int(s.preemptions) > int(markers.get("preemptions", 0)):
+            # evicted and not yet re-placed: the whole requeue wait is
+            # the preemption's cost, not generic queue time
+            return PREEMPTED
+        return QUEUE_WAIT
+    if s.resize_requested:
+        return RESIZING                 # nudge window: live gang saving
+    return _running(markers, s)
+
+
+def _running(markers: Mapping[str, Any], s: GoodputSignals) -> str:
+    if int(s.last_step) <= 0:
+        return STARTUP_COMPILE
+    if (s.restore_step is not None
+            and int(s.last_step) <= int(s.restore_step)):
+        # re-ganged after a preemption/resize and the beacons have not
+        # passed the checkpoint step yet: restoring into the new
+        # topology (telemetry.lastStep survives the teardown, so this
+        # reads the STALE pre-teardown step until the resume beacons)
+        return RESTORE
+    if int(s.recompiles) > int(markers.get("recompiles", 0)):
+        return RECOMPILE
+    if s.stragglers:
+        return STRAGGLER_STALL
+    if int(s.last_step) > int(markers.get("lastStep", 0)):
+        return PRODUCTIVE
+    return UNATTRIBUTED
+
+
+# -- the fold ----------------------------------------------------------------
+
+
+def fold(prev: Optional[Mapping[str, Any]],
+         s: GoodputSignals) -> Dict[str, Any]:
+    """Fold one observation into the ledger; returns the new
+    ``status.goodput`` value (or ``prev`` unchanged on replay).
+
+    The first fold only opens the ledger (``start == asOf``, no
+    intervals) and baselines the markers — notably
+    ``ckptSaveSeconds``, so a pre-existing histogram sum (operator
+    restart, shared-process tests) is never mis-attributed as a save
+    that happened inside the first window. Every later fold attributes
+    ``(asOf, now]`` exactly once: replays (``now <= asOf``) are
+    no-ops, which is the whole idempotence story — all state lives in
+    the CR, none in the operator process."""
+    now = float(s.now)
+    if not prev:
+        return {
+            "start": now,
+            "asOf": now,
+            "intervals": [],
+            "seconds": {},
+            "markers": {
+                "lastStep": int(s.last_step),
+                "recompiles": int(s.recompiles),
+                "preemptions": int(s.preemptions),
+                "ckptSaveSeconds": float(s.ckpt_save_seconds),
+                "hadPods": bool(s.has_pods),
+            },
+        }
+    if now <= float(prev.get("asOf", now)):
+        return dict(prev)               # replay: byte-identical
+    g: Dict[str, Any] = {
+        "start": float(prev["start"]),
+        "asOf": float(prev["asOf"]),
+        "intervals": [dict(i) for i in prev.get("intervals", [])],
+        "seconds": dict(prev.get("seconds", {})),
+        "markers": dict(prev.get("markers", {})),
+    }
+    m = g["markers"]
+    window = now - g["asOf"]
+
+    # carve: worker checkpoint-save seconds first (the histogram is the
+    # source of truth for how much of the window the snapshot ate; a
+    # save longer than one window spills its remainder into the next),
+    # then the coarse state for the rest
+    carve: List[Tuple[str, float]] = []
+    save = 0.0
+    save_seen = float(m.get("ckptSaveSeconds", 0.0))
+    if s.has_pods:
+        observed = float(s.ckpt_save_seconds)
+        if observed < save_seen:
+            # counter reset: a re-ganged gang's worker processes start
+            # fresh histograms, so the scraped _sum drops below the
+            # marker — re-baseline (the prometheus rate() stance) or
+            # every future save would hide under the old cumulative
+            save_seen = observed
+        delta = max(observed - save_seen, 0.0)
+        save = min(delta, window)
+    state = _coarse(m, s)
+    if save > 0:
+        carve.append((CHECKPOINT_SAVE, save))
+    rest = window - save
+    if rest > 0:
+        if carve and carve[-1][0] == state:
+            carve[-1] = (state, carve[-1][1] + rest)
+        else:
+            carve.append((state, rest))
+
+    t = g["asOf"]
+    for st, dur in carve:
+        last = g["intervals"][-1] if g["intervals"] else None
+        if last is not None and last["state"] == st:
+            last["end"] = t + dur       # contiguous same-state: extend
+        else:
+            g["intervals"].append({"state": st, "start": t,
+                                   "end": t + dur})
+        g["seconds"][st] = g["seconds"].get(st, 0.0) + dur
+        t += dur
+    if len(g["intervals"]) > MAX_INTERVALS:
+        g["intervals"] = g["intervals"][-MAX_INTERVALS:]
+    g["asOf"] = now
+
+    # markers AFTER attribution: every window compares against the
+    # PREVIOUS observation
+    if s.has_pods and not bool(m.get("hadPods")):
+        # a (re-)ganged observation stream starts fresh: beacon
+        # counters may legitimately sit BELOW the historical max — a
+        # rollback restore re-does steps, and restarted worker
+        # processes reset their recompile counters — so tracking the
+        # old max here would misattribute all redone progress and
+        # mask every post-re-gang recompile
+        m["lastStep"] = int(s.last_step)
+        m["recompiles"] = int(s.recompiles)
+    else:
+        m["lastStep"] = max(int(m.get("lastStep", 0)),
+                            int(s.last_step))
+        m["recompiles"] = max(int(m.get("recompiles", 0)),
+                              int(s.recompiles))
+    m["hadPods"] = bool(s.has_pods)
+    m["ckptSaveSeconds"] = save_seen + save
+    if s.has_pods and not s.preemption_requested:
+        # re-placed (and no eviction being signaled right now): future
+        # no-pod gaps are fresh queue waits, not this preemption's
+        # tail — but while the signal is pending, the count must stay
+        # ahead of the marker so the coming teardown gap reads
+        # ``preempted``
+        m["preemptions"] = max(int(m.get("preemptions", 0)),
+                               int(s.preemptions))
+    return g
+
+
+# -- derived views -----------------------------------------------------------
+
+
+def fractions(g: Optional[Mapping[str, Any]]) -> Dict[str, float]:
+    """Per-state fraction of attributed wall time; all states present,
+    sums to 1.0 whenever any time is attributed (the denominator is
+    the attributed total itself, which tiles ``asOf - start``)."""
+    secs = (g or {}).get("seconds") or {}
+    total = sum(secs.values())
+    if total <= 0:
+        return {st: 0.0 for st in STATES}
+    return {st: secs.get(st, 0.0) / total for st in STATES}
+
+
+def goodput_fraction(g: Optional[Mapping[str, Any]]) -> float:
+    return fractions(g)[PRODUCTIVE]
+
+
+def worst_badput_interval(g: Optional[Mapping[str, Any]]
+                          ) -> Optional[Dict[str, Any]]:
+    """The single longest non-productive interval (ties: earliest) —
+    the one the dashboard links to a trace exemplar."""
+    worst: Optional[Dict[str, Any]] = None
+    for iv in (g or {}).get("intervals") or []:
+        if iv.get("state") == PRODUCTIVE:
+            continue
+        dur = float(iv.get("end", 0.0)) - float(iv.get("start", 0.0))
+        if dur <= 0:
+            continue
+        if worst is None or dur > (worst["end"] - worst["start"]):
+            worst = {"state": iv["state"], "start": float(iv["start"]),
+                     "end": float(iv["end"])}
+    return worst
+
+
+def view(g: Optional[Mapping[str, Any]]) -> Dict[str, Any]:
+    """The dashboard/job-route payload: timeline + fractions."""
+    g = g or {}
+    fr = fractions(g)
+    secs = dict(g.get("seconds") or {})
+    return {
+        "start": g.get("start"),
+        "asOf": g.get("asOf"),
+        "wallSeconds": round(float(g.get("asOf", 0.0) or 0.0)
+                             - float(g.get("start", 0.0) or 0.0), 6),
+        "seconds": {st: round(secs[st], 6)
+                    for st in STATES if st in secs},
+        "fractions": {st: round(fr[st], 6) for st in STATES},
+        "goodputFraction": round(fr[PRODUCTIVE], 6),
+        "badputFraction": round(sum(fr[st] for st in BADPUT_STATES), 6),
+        "intervals": [dict(i) for i in g.get("intervals") or []],
+    }
+
+
+def fleet_rollup(rows: Iterable[Tuple[int, Mapping[str, Any]]]
+                 ) -> Dict[str, Any]:
+    """chips × seconds weighted rollup across jobs: one idle 256-chip
+    gang outweighs fifty busy singles. ``rows`` is ``(chips,
+    status.goodput)`` per job."""
+    weighted: Dict[str, float] = {}
+    n = 0
+    for chips, g in rows:
+        secs = (g or {}).get("seconds") or {}
+        if not secs:
+            continue
+        n += 1
+        for st, v in secs.items():
+            weighted[st] = weighted.get(st, 0.0) + float(chips) * v
+    total = sum(weighted.values())
+    fr = ({st: weighted.get(st, 0.0) / total for st in STATES}
+          if total > 0 else {st: 0.0 for st in STATES})
+    return {
+        "jobs": n,
+        "chipSeconds": round(total, 6),
+        "chipSecondsByState": {st: round(weighted[st], 6)
+                               for st in STATES if st in weighted},
+        "fractions": {st: round(fr[st], 6) for st in STATES},
+        "goodputFraction": round(fr[PRODUCTIVE], 6),
+        "badputFraction": round(sum(fr[st] for st in BADPUT_STATES), 6),
+    }
+
+
+# -- metric export -----------------------------------------------------------
+
+
+class GoodputExporter:
+    """Turns ledger totals into monotone counters.
+
+    Process-local delta cache: a replayed fold changes no totals, so a
+    replay exports nothing; a fresh process restarts the counters,
+    which the tsdb's reset-aware ``rate()`` absorbs like any other
+    counter restart."""
+
+    def __init__(self) -> None:
+        self._exported: Dict[Tuple[str, str], Dict[str, float]] = {}
+
+    def export(self, namespace: str, job: str, chips: int,
+               g: Optional[Mapping[str, Any]]) -> None:
+        secs = (g or {}).get("seconds") or {}
+        prev = self._exported.setdefault((namespace, job), {})
+        for st, total in secs.items():
+            delta = float(total) - prev.get(st, 0.0)
+            if delta <= 0:
+                continue
+            _job_seconds_c.inc(delta, namespace=namespace, job=job,
+                               state=st)
+            _fleet_chip_seconds_c.inc(delta * max(int(chips), 1))
+            if st != PRODUCTIVE:
+                _fleet_badput_c.inc(delta * max(int(chips), 1))
+            prev[st] = float(total)
+
+    def clear(self, namespace: str, job: str) -> None:
+        """Deleted job: its per-job counter rows go with it (the
+        per-job gauge staleness discipline); the fleet totals — plain
+        unlabeled counters — stay monotone."""
+        self._exported.pop((namespace, job), None)
+        for st in STATES:
+            _job_seconds_c.remove(namespace=namespace, job=job, state=st)
+
+
+# -- the bench-artifact block ------------------------------------------------
+
+
+def from_step_records(records: Iterable[Any]) -> Dict[str, Any]:
+    """The BENCH artifact's ``goodput`` block, from a
+    :class:`~kubeflow_tpu.obs.steps.FlightRecorder` ring: productive
+    fraction (OK non-recompile step time over pass wall time) next to
+    img/s, so a round that *looks* fast but recompiles or stalls
+    between steps reads as the badput it is."""
+    recs = list(records)
+    if not recs:
+        return {}
+    wall = max(r.end for r in recs) - min(r.start for r in recs)
+    if wall <= 0:
+        return {}
+    productive = sum(r.duration for r in recs
+                     if r.status == "OK" and not r.recompile)
+    recompile = sum(r.duration for r in recs if r.recompile)
+    unattributed = max(wall - productive - recompile, 0.0)
+    return {
+        "wall_s": round(wall, 6),
+        "productive_fraction": round(productive / wall, 4),
+        "recompile_fraction": round(recompile / wall, 4),
+        "unattributed_fraction": round(unattributed / wall, 4),
+    }

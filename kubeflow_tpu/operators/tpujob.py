@@ -36,6 +36,7 @@ from kubeflow_tpu.manifests.components.tpujob_operator import (
     TPUJOB_KIND,
     TPUJOB_PLURAL,
 )
+from kubeflow_tpu.obs import goodput as goodput_mod
 from kubeflow_tpu.obs.steps import (
     DEFAULT_STRAGGLER_STEPS,
     ENV_JOB_UID,
@@ -159,6 +160,12 @@ class TpuJobSpec:
     @property
     def num_workers(self) -> int:
         return self.slices * self.hosts_per_slice
+
+    @property
+    def chips(self) -> int:
+        """The gang's chip footprint — the goodput rollup's weight and
+        the queue's quota unit share this one definition."""
+        return self.slices * self.hosts_per_slice * self.chips_per_host
 
     @classmethod
     def from_dict(cls, spec: Dict[str, Any]) -> "TpuJobSpec":
@@ -461,6 +468,10 @@ class TpuJobOperator:
         # same slice free and double-book it (kube-scheduler likewise runs
         # one scheduling cycle at a time)
         self._placement_lock = threading.Lock()
+        # goodput ledger export (docs/OBSERVABILITY.md "Goodput"):
+        # ledger state itself lives in CR status.goodput — the exporter
+        # only turns totals into monotone counters
+        self._goodput = goodput_mod.GoodputExporter()
 
     # -- reconcile ---------------------------------------------------------
 
@@ -469,6 +480,7 @@ class TpuJobOperator:
         job = self.client.get_or_none(API_VERSION, TPUJOB_KIND, ns, name)
         if job is None:
             self._clear_job_gauges(ns, name)
+            self._goodput.clear(ns, name)
             self._queue_release(ns, name)
             return None  # deleted; cascade GC cleans children
         try:
@@ -481,6 +493,11 @@ class TpuJobOperator:
 
         phase = job.get("status", {}).get("phase", PHASE_PENDING)
         if phase in (PHASE_SUCCEEDED, PHASE_FAILED):
+            # the export lags one pass behind the persisted ledger by
+            # design; a terminal job never folds again, so catch the
+            # final persisted state up here
+            self._goodput.export(ns, name, spec.chips,
+                                 job.get("status", {}).get("goodput"))
             self._queue_release(ns, name)
             return None
 
@@ -489,6 +506,16 @@ class TpuJobOperator:
                        if p.get("metadata", {}).get("deletionTimestamp")]
         pods = [p for p in pods
                 if not p.get("metadata", {}).get("deletionTimestamp")]
+
+        # one beacon aggregation per reconcile, hoisted so the goodput
+        # fold and the status update read the SAME observation
+        telemetry = (self._job_telemetry(ns, name, spec) if pods
+                     else None)
+        # the goodput ledger (docs/OBSERVABILITY.md): fold the window
+        # since the last reconcile into status.goodput BEFORE any
+        # branch acts, so teardown/requeue passes are attributed too;
+        # the fold is a replay-safe no-op when the clock has not moved
+        self._fold_goodput(job, spec, pods, telemetry)
 
         if phase == PHASE_RESTARTING and (pods or terminating):
             # old gang still tearing down: wait, do NOT burn another restart
@@ -501,7 +528,8 @@ class TpuJobOperator:
         # tear down, confirm the head-of-queue requeue
         if self.queue is not None and self.queue.preemption_requested(
                 ns, name):
-            return self._handle_preemption(job, spec, pods)
+            return self._handle_preemption(job, spec, pods,
+                                           telemetry=telemetry)
 
         # scheduler-plane shrink offer: the queue asked this elastic
         # gang to release slices instead of evicting it (cheaper than
@@ -538,7 +566,6 @@ class TpuJobOperator:
             counts[_pod_phase(pod)] = counts.get(_pod_phase(pod), 0) + 1
 
         status_update: Dict[str, Any] = {"workers": counts}
-        telemetry = self._job_telemetry(ns, name, spec)
         if telemetry is not None:
             status_update["telemetry"] = telemetry
 
@@ -556,7 +583,8 @@ class TpuJobOperator:
                  .get(GANG_SHAPE_LABEL, shape) != shape]
         if stale:
             if spec.is_elastic:
-                return self._handle_resize(job, spec, pods, stale)
+                return self._handle_resize(job, spec, pods, stale,
+                                           telemetry=telemetry)
             self._delete_pods(ns, pods)
             self._set_status(
                 job, PHASE_RESTARTING,
@@ -665,7 +693,9 @@ class TpuJobOperator:
         return 1.0
 
     def _handle_preemption(self, job: o.Obj, spec: TpuJobSpec,
-                           pods: List[o.Obj]) -> Optional[float]:
+                           pods: List[o.Obj], *,
+                           telemetry: Optional[Dict[str, Any]] = None
+                           ) -> Optional[float]:
         """Checkpoint-preempt-requeue: persist the step clock, tear the
         gang down, mark the CR, confirm the head-of-queue re-admission.
         The checkpoint save happens exactly once per preemption — the
@@ -683,8 +713,11 @@ class TpuJobOperator:
                 log.exception("preemption checkpoint for %s/%s failed",
                               ns, name)
         if step is None:
-            telemetry = job.get("status", {}).get("telemetry") or {}
-            step = telemetry.get("lastStep")
+            # fall back to THIS pass's beacon aggregation (fresher than
+            # the last status write), then the persisted status copy
+            tel = (telemetry if telemetry is not None
+                   else job.get("status", {}).get("telemetry") or {})
+            step = tel.get("lastStep")
         if pods:
             self._delete_pods(ns, pods)
         preemption = dict(job.get("status", {}).get("preemption") or {})
@@ -733,7 +766,9 @@ class TpuJobOperator:
 
     def _handle_resize(self, job: o.Obj, spec: TpuJobSpec,
                        pods: List[o.Obj],
-                       stale: List[o.Obj]) -> Optional[float]:
+                       stale: List[o.Obj], *,
+                       telemetry: Optional[Dict[str, Any]] = None
+                       ) -> Optional[float]:
         """Checkpoint-reshard-resume, operator side. Two passes:
 
         1. **nudge** — write ``status.resize.requested`` (the workers'
@@ -793,8 +828,9 @@ class TpuJobOperator:
                     log.exception("resize checkpoint for %s/%s failed",
                                   ns, name)
             if step is None:
-                telemetry = job.get("status", {}).get("telemetry") or {}
-                step = telemetry.get("lastStep")
+                tel = (telemetry if telemetry is not None
+                       else job.get("status", {}).get("telemetry") or {})
+                step = tel.get("lastStep")
             resize = {**resize, "checkpointed": True,
                       "lastCheckpointStep": step}
         self._delete_pods(ns, pods)
@@ -897,6 +933,126 @@ class TpuJobOperator:
         # multiple matching series (e.g. scraped from several targets)
         # agree on one number the same way the beacon view does: mean
         return sum(rates) / len(rates)
+
+    # -- goodput ledger (docs/OBSERVABILITY.md "Goodput") ------------------
+
+    def _fold_goodput(self, job: o.Obj, spec: TpuJobSpec,
+                      pods: List[o.Obj],
+                      telemetry: Optional[Dict[str, Any]]
+                      ) -> Dict[str, Any]:
+        """Fold this reconcile's observation into ``status.goodput``
+        and export the counters. All ledger state lives in the CR, so
+        a crash-restarted operator continues exactly where the status
+        says — and a replayed reconcile (clock not advanced past
+        ``asOf``) changes nothing, writes nothing."""
+        ns = job["metadata"]["namespace"]
+        name = job["metadata"]["name"]
+        status = job.get("status", {}) or {}
+        prev = status.get("goodput")
+        # export the PERSISTED ledger only (the counters lag the CR by
+        # at most one pass; the terminal branch catches the last state
+        # up): exporting an unpersisted fold would let a skipped write
+        # re-derive the window differently than what was counted, and
+        # a monotone counter cannot take it back — the CR fractions
+        # and the exported series must never disagree
+        self._goodput.export(ns, name, spec.chips, prev)
+        new = goodput_mod.fold(
+            prev, self._goodput_signals(job, ns, name, pods, telemetry))
+        if new != prev:
+            # mutate the in-hand CR copy so every later _set_status in
+            # this pass carries the folded ledger forward for free
+            job["status"] = {**status, "goodput": new}
+            # write-through ONLY on an attribution-state change or a
+            # 60s staleness cap: the operator's own status write emits
+            # a MODIFIED watch event that re-enqueues this job, so an
+            # unconditional per-pass write would turn every quiet hold
+            # loop (queued, preempted, restarting) into a hot one. A
+            # skipped write loses nothing — the next fold re-derives
+            # the identical merged interval from the persisted asOf
+            # (the fold is a deterministic function of CR + clock)
+            if self._goodput_flush_due(prev, new):
+                try:
+                    self.client.update_status(job)
+                except ApiError as e:
+                    if e.code != 404:
+                        raise
+        return new
+
+    _GOODPUT_FLUSH_S = 60.0
+
+    @staticmethod
+    def _goodput_flush_due(prev: Optional[Dict[str, Any]],
+                           new: Dict[str, Any]) -> bool:
+        if not prev:
+            return True
+        p_ivs = prev.get("intervals") or []
+        n_ivs = new.get("intervals") or []
+        p_last = p_ivs[-1]["state"] if p_ivs else None
+        n_last = n_ivs[-1]["state"] if n_ivs else None
+        if p_last != n_last:
+            return True
+        return (float(new.get("asOf", 0.0))
+                - float(prev.get("asOf", 0.0))
+                >= TpuJobOperator._GOODPUT_FLUSH_S)
+
+    def _goodput_signals(self, job: o.Obj, ns: str, name: str,
+                         pods: List[o.Obj],
+                         telemetry: Optional[Dict[str, Any]]
+                         ) -> goodput_mod.GoodputSignals:
+        """This reconcile's observation, from signals that already
+        exist: CR conditions/status, the queue's state, the beacon
+        aggregation, and the worker-side checkpoint-save histogram."""
+        status = job.get("status", {}) or {}
+        tel = (telemetry if telemetry is not None
+               else (status.get("telemetry") or {}))
+        resize = status.get("resize") or {}
+        preemption = status.get("preemption") or {}
+        restore_step: Optional[int] = None
+        for raw in (resize.get("lastCheckpointStep"),
+                    preemption.get("lastCheckpointStep")):
+            try:
+                step = int(raw)
+            except (TypeError, ValueError):
+                continue
+            restore_step = (step if restore_step is None
+                            else max(restore_step, step))
+        return goodput_mod.GoodputSignals(
+            now=self.clock(),
+            has_pods=bool(pods),
+            resize_requested=bool(resize.get("requested")),
+            preemption_requested=bool(preemption.get("requested")),
+            preemptions=int(preemption.get("count", 0) or 0),
+            last_step=int(tel.get("lastStep", 0) or 0),
+            recompiles=int(tel.get("recompiles", 0) or 0),
+            stragglers=bool(tel.get("stragglers")),
+            restore_step=restore_step,
+            ckpt_save_seconds=self._ckpt_save_seconds(ns, name),
+        )
+
+    def _ckpt_save_seconds(self, ns: str, name: str) -> float:
+        """Cumulative worker snapshot seconds for one job — the
+        ledger's ``checkpoint_save`` source. A deployed operator reads
+        the scraped ``kftpu_checkpoint_save_seconds_sum`` through the
+        tsdb (the workers run in other processes); without a store —
+        or without the series — the in-process registry covers the
+        all-in-one-process tier."""
+        if self.tsdb is not None:
+            try:
+                pts = self.tsdb.latest(
+                    "kftpu_checkpoint_save_seconds_sum",
+                    {"namespace": ns, "job": name, "source": "worker"})
+            except Exception:  # noqa: BLE001 — monitoring never fails jobs
+                log.exception("tsdb checkpoint-save read failed for "
+                              "%s/%s", ns, name)
+                pts = []
+            if pts:
+                # MAX across series, never sum: a gang-synchronized
+                # snapshot is observed by every worker (one scraped
+                # series per target) at ~the same wall time — the
+                # job's wall-clock cost is its slowest worker, and
+                # summing would carve N× phantom save seconds
+                return max(p.value for _labels, p in pts)
+        return goodput_mod.checkpoint_save_seconds(ns, name)
 
     def _clear_job_gauges(self, ns: str, name: str) -> None:
         """Terminal/deleted jobs must not export their last telemetry

@@ -43,6 +43,21 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def reload(self) -> None:
+        """Refresh the cached step list from disk. The operator-side
+        ``DirCheckpointer`` reads a directory the WORKERS write from
+        another process; orbax caches the step scan, so without this
+        the preemption/resize victim-cost reads (and the goodput
+        ledger's restore attribution) see only the steps that existed
+        when the manager was built. Best-effort: an orbax without
+        ``reload()`` keeps its cache."""
+        reload_fn = getattr(self._mgr, "reload", None)
+        if callable(reload_fn):
+            try:
+                reload_fn()
+            except Exception:  # noqa: BLE001 — stale read beats a crash
+                log.debug("orbax reload failed", exc_info=True)
+
     def all_steps(self) -> list:
         """Every step with a persisted checkpoint, ascending."""
         return sorted(self._mgr.all_steps())

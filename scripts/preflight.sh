@@ -49,6 +49,13 @@
 #      sheds lowest-SLO-class-first with the shed/served split in ONE
 #      trace, and kftpu_edge_shed_total{class} reads back through the
 #      tsdb + /api/metrics/query (docs/EDGE.md)
+#  10. goodput-ledger smoke (scripts/goodput_smoke.py): a fake 2-slice
+#      elastic job queues, trains, gets preempted, resumes, and
+#      shrinks; status.goodput shows queue_wait/preempted/resizing/
+#      checkpoint_save/restore, fractions sum to 1.0, intervals tile
+#      the wall clock, the counter reads back through the tsdb, and
+#      job-badput-burn walks Pending -> Firing -> Resolved on an
+#      injected checkpoint stall (docs/OBSERVABILITY.md "Goodput")
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -83,6 +90,9 @@ JAX_PLATFORMS=cpu XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_cou
 
 echo "== preflight: fleet serving edge smoke =="
 JAX_PLATFORMS=cpu python scripts/edge_smoke.py || rc=1
+
+echo "== preflight: goodput ledger smoke =="
+JAX_PLATFORMS=cpu python scripts/goodput_smoke.py || rc=1
 
 if [ "$rc" -ne 0 ]; then
     echo "preflight: FAILED" >&2

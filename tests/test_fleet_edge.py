@@ -905,3 +905,83 @@ def test_observe_engine_gains_model_occupancy():
         "active_slots": 0, "pending": 0, "slots": 0,
         "models_resident": 3, "models_max": 4, "models_evictable": 1}))
     assert agg3.window("m", 10.0).concurrency == pytest.approx(2.0)
+
+
+# -- ROADMAP item 5: scale events reach the ring without a manual call -------
+
+
+def test_autoscaler_tick_syncs_fleet_ring():
+    """ISSUE 13 satellite: ``Autoscaler.wire_fleet`` adopts the READY
+    replica set into the fleet edge's hash ring inside the reconcile
+    tick itself (the same call the ``Controller.periodic`` runtime
+    drives) — the test never calls ``sync``/``sync_replicas``; the
+    scale event alone must reach the ring, and scale-in must remove
+    the arc AND the gate's pressure entry."""
+    from kubeflow_tpu.autoscale import Autoscaler, policy_preset
+    from kubeflow_tpu.autoscale.metrics import MetricsAggregator
+    from kubeflow_tpu.scheduler.inventory import SliceInfo
+
+    class InstantDriver:
+        def __init__(self):
+            self.seq = 0
+
+        def create(self, model, slice_id):
+            self.seq += 1
+            return self.seq
+
+        def warmup(self, model, handle):
+            pass
+
+        def is_warm(self, model, handle):
+            return True                  # warm in the same tick
+
+        def drain(self, model, handle):
+            pass
+
+        def in_flight(self, model, handle):
+            return 0
+
+        def destroy(self, model, handle):
+            pass
+
+    inv = [SliceInfo(slice_id=f"v5e-4_{i}", shape="v5e-4", hosts=1,
+                     free_hosts=1) for i in range(4)]
+    t = [0.0]
+    agg = MetricsAggregator(clock=lambda: t[0])
+    policy = policy_preset("serving")
+    asc = Autoscaler(policy, InstantDriver(), agg,
+                     inventory=lambda: inv, clock=lambda: t[0])
+
+    router = FleetRouter(page_size=PAGE)
+    gate = SloAdmissionGate(DEFAULT_SLO_CLASSES)
+    edge = FleetEdge(router, gate, dispatch=lambda *a: {"ok": True})
+    asc.wire_fleet(edge, "m",
+                   url_for=lambda model, sid: f"http://{model}-{sid}")
+
+    # load arrives → the reconcile tick scales up AND syncs the ring
+    for _ in range(8):
+        agg.observe("m", active_slots=8.0, now=t[0])
+        t[0] += 0.5
+    asc.reconcile("m", now=t[0])
+    targets, _inflight = router.view()
+    assert targets, "scale-up never reached the ring"
+    for name, url in targets.items():
+        assert name.startswith("m-v5e-4_")
+        assert url == f"http://{name}"
+    n_up = len(targets)
+
+    # feed gate pressure for one replica, then idle → scale-in must
+    # prune both the arc and the pressure entry
+    first = sorted(targets)[0]
+    gate.observe_snapshot(first, {"active_slots": 4, "pending": 0,
+                                  "slots": 4, "pages_total": 8,
+                                  "pages_free": 0})
+    assert gate.pressure_of(first) > 0
+    for _ in range(600):
+        agg.observe("m", active_slots=0.0, now=t[0])
+        t[0] += 1.0
+        asc.reconcile("m", now=t[0])
+    targets, _inflight = router.view()
+    assert len(targets) < n_up
+    for gone in set(f"m-v5e-4_{i}" for i in range(4)) - set(targets):
+        assert gate.pressure_of(gone) == 0.0

@@ -1,0 +1,739 @@
+"""Goodput/badput accounting (kubeflow_tpu/obs/goodput.py;
+docs/OBSERVABILITY.md "Goodput").
+
+One manual fake clock drives everything: the acceptance test walks a
+job through queue-wait → compile → steps → preemption → requeue →
+resume → elastic shrink → completion and pins ``status.goodput``
+fractions against hand-computed values EXACTLY; the replay tests pin
+fold idempotence (same reconcile sequence twice, and a crash-restart
+mid-resize) to byte-identical status; the property test pins interval
+exclusivity/exhaustiveness; the burn-rate test walks
+``job-badput-burn`` through Pending→Firing→Resolved on an injected
+checkpoint stall with one Event per transition.
+"""
+
+import json
+import math
+import random
+
+import pytest
+
+from kubeflow_tpu.dashboard.server import DashboardApi
+from kubeflow_tpu.elastic import DirCheckpointer, ElasticSnapshotter
+from kubeflow_tpu.k8s import FakeKubeClient
+from kubeflow_tpu.manifests.components.tpujob_operator import (
+    API_VERSION,
+    TPUJOB_KIND,
+)
+from kubeflow_tpu.obs import goodput as gp
+from kubeflow_tpu.obs.alerts import AlertManager, default_rules
+from kubeflow_tpu.obs.steps import publish_beacon, tpujob_trace_ids
+from kubeflow_tpu.obs.trace import SpanCollector, Tracer
+from kubeflow_tpu.obs.tsdb import TimeSeriesStore
+from kubeflow_tpu.operators.tpujob import (
+    JOB_LABEL,
+    PreemptionCheckpointer,
+    TpuJobOperator,
+    tpujob,
+)
+from kubeflow_tpu.platform.local import fake_slice_nodes
+from kubeflow_tpu.scheduler.queue import GangQueue
+from kubeflow_tpu.utils import DEFAULT_REGISTRY
+
+
+class SetClock:
+    """Manually-set clock: reconciles see EXACTLY the time the test
+    chose, so every ledger window is hand-computable."""
+
+    def __init__(self, now=1000.0):
+        self.now = float(now)
+
+    def __call__(self):
+        return self.now
+
+
+class TelemetryCkpt(PreemptionCheckpointer):
+    """save() knows nothing (no disk in the fake) — the operator falls
+    back to this pass's fresh beacon aggregation for the step record,
+    which is what the ledger's restore attribution keys on."""
+
+    def __init__(self):
+        self.saves = 0
+
+    def save(self, job):
+        self.saves += 1
+        return None
+
+    def latest_step(self, ns, name):
+        return None
+
+
+def _cluster(ns, clock=None, slices=2):
+    client = FakeKubeClient()
+    for node in fake_slice_nodes("v5e-8", count=slices):
+        client.create(node)
+    clock = clock or SetClock()
+    collector = SpanCollector()
+    tracer = Tracer(collector, clock=clock)
+    ckpt = TelemetryCkpt()
+    q = GangQueue(client, clock=clock, tracer=tracer,
+                  checkpoint_step=lambda ns, name: None)
+    op = TpuJobOperator(client, clock=clock, tracer=tracer, queue=q,
+                        checkpointer=ckpt)
+    return client, q, op, collector, clock
+
+
+def _pods(client, ns, name):
+    return client.list("v1", "Pod", ns, label_selector={JOB_LABEL: name})
+
+
+def _set_phase(client, ns, name, phase):
+    for pod in _pods(client, ns, name):
+        pod.setdefault("status", {})["phase"] = phase
+        client.update_status(pod)
+
+
+def _beacon(client, ns, name, uid, worker, step, recompiles=0):
+    publish_beacon(client, ns, name, worker,
+                   {"step": step, "stepsPerSec": 1.0,
+                    "recompiles": recompiles}, job_uid=uid)
+
+
+# -- the end-to-end acceptance ------------------------------------------------
+
+
+def test_goodput_acceptance_end_to_end():
+    """ISSUE 13 acceptance: one fake clock drives queue-wait → compile
+    → steps → preemption → requeue → resume → elastic shrink →
+    completion; fractions match hand-computed values exactly; the
+    counter reads back through the tsdb + /api/metrics/query; the
+    dashboard timeline's worst-interval exemplar resolves via
+    /api/traces/<id> to the span that caused it."""
+    ns = "gpacc"
+    client, q, op, collector, clock = _cluster(ns)
+
+    # t=1000: a blocker owns both slices; the target job queues
+    client.create(tpujob("block", ns, {
+        "image": "x", "slices": 2, "hostsPerSlice": 1, "priority": 5}))
+    op.reconcile(ns, "block")
+    _set_phase(client, ns, "block", "Running")
+    client.create(tpujob("train", ns, {
+        "image": "x", "slices": 2, "hostsPerSlice": 1,
+        "elastic": {"minSlices": 1, "maxSlices": 2}}))
+    op.reconcile(ns, "train")
+    uid = client.get(API_VERSION, TPUJOB_KIND, ns,
+                     "train")["metadata"]["uid"]
+    assert _pods(client, ns, "train") == []
+
+    clock.now = 1010.0                      # [1000,1010] queue_wait
+    op.reconcile(ns, "train")
+
+    client.delete(API_VERSION, TPUJOB_KIND, ns, "block")
+    op.reconcile(ns, "block")               # release the blocker's slices
+    clock.now = 1020.0                      # [1010,1020] queue_wait
+    op.reconcile(ns, "train")               # fold, then place + create
+    assert len(_pods(client, ns, "train")) == 2
+    _set_phase(client, ns, "train", "Running")
+
+    clock.now = 1030.0                      # [1020,1030] startup_compile
+    op.reconcile(ns, "train")
+
+    for w in range(2):
+        _beacon(client, ns, "train", uid, w, 5)
+    clock.now = 1040.0                      # [1030,1040] productive
+    op.reconcile(ns, "train")
+
+    # worker snapshot wall time → the checkpoint_save carve source
+    gp.observe_checkpoint_save(4.0, namespace=ns, job="train",
+                               source="worker")
+    for w in range(2):
+        _beacon(client, ns, "train", uid, w, 8)
+    clock.now = 1050.0                      # [1040,1050] save 4 + productive 6
+    op.reconcile(ns, "train")
+
+    _beacon(client, ns, "train", uid, 0, 30)   # w1 stuck at 8: straggler
+    clock.now = 1060.0                      # [1050,1060] straggler_stall
+    op.reconcile(ns, "train")
+
+    _beacon(client, ns, "train", uid, 0, 31, recompiles=2)
+    _beacon(client, ns, "train", uid, 1, 30)
+    clock.now = 1070.0                      # [1060,1070] recompile
+    op.reconcile(ns, "train")
+
+    # a higher-priority gang evicts the target (shrink infeasible:
+    # the preemptor needs BOTH slices)
+    client.create(tpujob("urgent", "prod", {
+        "image": "x", "slices": 2, "hostsPerSlice": 1, "priority": 10}))
+    clock.now = 1080.0                      # [1070,1080] unattributed
+    op.reconcile("prod", "urgent")          # queue signals the victim
+    op.reconcile(ns, "train")               # checkpoint + teardown
+    assert _pods(client, ns, "train") == []
+    job = client.get(API_VERSION, TPUJOB_KIND, ns, "train")
+    assert job["status"]["preemption"]["lastCheckpointStep"] == 31
+
+    clock.now = 1090.0                      # [1080,1090] preempted
+    op.reconcile(ns, "train")
+    op.reconcile("prod", "urgent")          # preemptor lands on the slices
+    assert len(_pods(client, "prod", "urgent")) == 2
+    clock.now = 1100.0                      # [1090,1100] preempted
+    op.reconcile(ns, "train")
+
+    client.delete(API_VERSION, TPUJOB_KIND, "prod", "urgent")
+    op.reconcile("prod", "urgent")
+    clock.now = 1110.0                      # [1100,1110] preempted
+    op.reconcile(ns, "train")               # fold, then re-place
+    assert len(_pods(client, ns, "train")) == 2
+    _set_phase(client, ns, "train", "Running")
+
+    clock.now = 1120.0                      # [1110,1120] restore (step 31)
+    op.reconcile(ns, "train")
+
+    for w in range(2):
+        _beacon(client, ns, "train", uid, w, 32)
+    clock.now = 1130.0                      # [1120,1130] productive
+    op.reconcile(ns, "train")
+
+    for w in range(2):
+        _beacon(client, ns, "train", uid, w, 33)
+    job = client.get(API_VERSION, TPUJOB_KIND, ns, "train")
+    job["spec"] = {**job["spec"], "slices": 1}
+    client.update(job)
+    clock.now = 1140.0                      # [1130,1140] productive
+    op.reconcile(ns, "train")               # resize nudge pass
+    assert client.get(API_VERSION, TPUJOB_KIND, ns,
+                      "train")["status"]["resize"]["requested"] is True
+
+    gp.observe_checkpoint_save(3.0, namespace=ns, job="train",
+                               source="worker")
+    clock.now = 1150.0                      # [1140,1150] save 3 + resizing 7
+    op.reconcile(ns, "train")               # snapshot + teardown
+    assert _pods(client, ns, "train") == []
+
+    clock.now = 1160.0                      # [1150,1160] resizing
+    op.reconcile(ns, "train")               # re-gang at 1 slice
+    assert len(_pods(client, ns, "train")) == 1
+    _set_phase(client, ns, "train", "Running")
+
+    clock.now = 1170.0                      # [1160,1170] restore (step 33)
+    op.reconcile(ns, "train")
+
+    _beacon(client, ns, "train", uid, 0, 40)
+    clock.now = 1180.0                      # [1170,1180] productive
+    op.reconcile(ns, "train")
+
+    _beacon(client, ns, "train", uid, 0, 41)
+    _set_phase(client, ns, "train", "Succeeded")
+    clock.now = 1190.0                      # [1180,1190] productive
+    op.reconcile(ns, "train")
+    job = client.get(API_VERSION, TPUJOB_KIND, ns, "train")
+    assert job["status"]["phase"] == "Succeeded"
+    # the counter export lags the persisted ledger by one pass; the
+    # terminal reconcile catches the final state up
+    op.reconcile(ns, "train")
+
+    # the hand-computed ledger: 190 s of wall clock, every second
+    # attributed exactly once
+    expected = {
+        "queue_wait": 20.0,
+        "startup_compile": 10.0,
+        "productive_step": 56.0,
+        "checkpoint_save": 7.0,
+        "restore": 20.0,
+        "preempted": 30.0,
+        "resizing": 17.0,
+        "straggler_stall": 10.0,
+        "recompile": 10.0,
+        "unattributed": 10.0,
+    }
+    g = job["status"]["goodput"]
+    assert g["seconds"] == expected
+    assert g["start"] == 1000.0 and g["asOf"] == 1190.0
+    fr = gp.fractions(g)
+    assert fr["productive_step"] == pytest.approx(56.0 / 190.0)
+    assert math.isclose(sum(fr.values()), 1.0, abs_tol=1e-9)
+    # intervals tile the whole wall clock, no overlap
+    ivs = g["intervals"]
+    assert ivs[0]["start"] == 1000.0 and ivs[-1]["end"] == 1190.0
+    for a, b in zip(ivs, ivs[1:]):
+        assert a["end"] == b["start"]
+
+    # counter → tsdb → /api/metrics/query
+    store = TimeSeriesStore(clock=clock)
+    store.sample_registry(DEFAULT_REGISTRY)
+    api = DashboardApi(client, authorize=lambda *a: True, tsdb=store,
+                       collector=collector)
+    code, body = api.handle(
+        "GET",
+        "/api/metrics/query?metric=kftpu_job_goodput_seconds_total"
+        f"&label=namespace:{ns}&label=job:train"
+        "&label=state:productive_step", None)
+    assert code == 200
+    assert body["result"] and body["result"][0]["value"] == 56.0
+    got_states = {
+        r["labels"]["state"]
+        for r in api.handle(
+            "GET",
+            "/api/metrics/query?metric=kftpu_job_goodput_seconds_total"
+            f"&label=namespace:{ns}&label=job:train", None)[1]["result"]}
+    assert got_states == set(expected)
+
+    # per-job dashboard view: timeline + worst-badput trace exemplar
+    code, body = api.handle("GET", f"/api/jobs/{ns}/train/goodput", None)
+    assert code == 200
+    assert body["goodputFraction"] == round(56.0 / 190.0, 6)
+    assert body["badputFraction"] == round(134.0 / 190.0, 6)
+    worst = body["worstBadput"]
+    assert worst["state"] == "preempted"
+    assert worst["seconds"] == 30.0
+    trace_id, _ = tpujob_trace_ids(ns, "train", uid)
+    assert worst["traceId"] == trace_id
+    # the exemplar resolves to the span that caused it: the queue's
+    # re-place decision closing the preempted gap
+    assert worst["span"] == "scheduler.queue.place"
+    code, tree = api.handle("GET", f"/api/traces/{trace_id}", None)
+    assert code == 200
+    assert worst["spanId"] in {s["span_id"] for s in tree["spans"]}
+
+    # fleet rollup weights by chips x seconds
+    code, body = api.handle("GET", "/api/metrics/goodput", None)
+    assert code == 200
+    assert body["jobs"] == 1
+    assert body["goodputFraction"] == round(56.0 / 190.0, 6)
+    assert body["perJob"][0]["name"] == "train"
+
+    # satellite: the telemetry route's goodput.fraction summary
+    code, body = api.handle("GET", f"/api/jobs/{ns}/train/telemetry",
+                            None)
+    assert code == 200
+    assert body["goodput"]["fraction"] == round(56.0 / 190.0, 6)
+
+
+# -- replay idempotence -------------------------------------------------------
+
+
+def _drive_simple(ns, restart_mid_resize=False):
+    """A compact create→run→shrink→run sequence; optionally swap in a
+    BRAND NEW operator mid-resize (the crash-restart shape — all
+    ledger state must live in the CR, none in the process)."""
+    client, q, op, _collector, clock = _cluster(ns)
+    client.create(tpujob("j", ns, {
+        "image": "x", "slices": 2, "hostsPerSlice": 1,
+        "elastic": {"minSlices": 1, "maxSlices": 2}}))
+    times = []
+
+    def rec(t):
+        clock.now = t
+        times.append(t)
+        op.reconcile(ns, "j")
+
+    rec(1000.0)
+    _set_phase(client, ns, "j", "Running")
+    uid = client.get(API_VERSION, TPUJOB_KIND, ns,
+                     "j")["metadata"]["uid"]
+    rec(1010.0)
+    for w in range(2):
+        _beacon(client, ns, "j", uid, w, 5)
+    rec(1020.0)
+    job = client.get(API_VERSION, TPUJOB_KIND, ns, "j")
+    job["spec"] = {**job["spec"], "slices": 1}
+    client.update(job)
+    rec(1030.0)                             # nudge pass
+    if restart_mid_resize:
+        # the operator dies mid-resize; a fresh one (fresh exporter,
+        # fresh everything) picks the CR up where the status says
+        op = TpuJobOperator(client, clock=clock, tracer=op.tracer,
+                            queue=q, checkpointer=op.checkpointer)
+    rec(1040.0)                             # snapshot + teardown
+    rec(1050.0)                             # re-gang at 1 slice
+    _set_phase(client, ns, "j", "Running")
+    rec(1060.0)
+    _beacon(client, ns, "j", uid, 0, 9)
+    rec(1070.0)
+    g = client.get(API_VERSION, TPUJOB_KIND, ns,
+                   "j")["status"]["goodput"]
+    return client, op, clock, times, json.dumps(g, sort_keys=True)
+
+
+def test_ledger_replay_is_byte_identical():
+    """Driving the same fake-clock reconcile sequence twice changes
+    nothing: every fold at-or-before asOf is a no-op, and the exported
+    counters do not move either."""
+    ns = "gprep"
+    client, op, clock, times, first = _drive_simple(ns)
+    # one catch-up pass first: the export intentionally lags the
+    # persisted ledger by one reconcile
+    op.reconcile(ns, "j")
+    c = DEFAULT_REGISTRY.counter("kftpu_job_goodput_seconds_total")
+    before = {st: c.get(namespace=ns, job="j", state=st)
+              for st in gp.STATES}
+    for t in times:                          # the replay
+        clock.now = t
+        op.reconcile(ns, "j")
+    g = client.get(API_VERSION, TPUJOB_KIND, ns,
+                   "j")["status"]["goodput"]
+    assert json.dumps(g, sort_keys=True) == first
+    after = {st: c.get(namespace=ns, job="j", state=st)
+             for st in gp.STATES}
+    assert after == before
+
+
+def test_ledger_survives_crash_restart_mid_resize():
+    """A fresh operator taking over mid-resize continues the ledger
+    exactly: byte-identical status.goodput vs the uninterrupted run."""
+    *_rest, uninterrupted = _drive_simple("gpc1")
+    *_rest, restarted = _drive_simple("gpc2", restart_mid_resize=True)
+    assert restarted == uninterrupted
+
+
+# -- state exclusivity / exhaustiveness property ------------------------------
+
+
+def test_interval_exclusivity_property():
+    """Random signal walks: intervals never overlap, always tile
+    [start, asOf] exactly, and fractions always sum to 1."""
+    rng = random.Random(13)
+    for _trial in range(20):
+        t = rng.uniform(0, 1e6)
+        g = gp.fold(None, gp.GoodputSignals(now=t))
+        last_step = recompiles = preemptions = 0
+        save = 0.0
+        for _i in range(60):
+            t += rng.choice([0.0, 0.1, 1.0, 7.5, 30.0])
+            if rng.random() < 0.3:
+                last_step += rng.randrange(0, 5)
+            if rng.random() < 0.1:
+                recompiles += 1
+            if rng.random() < 0.05:
+                preemptions += 1
+            if rng.random() < 0.2:
+                save += rng.uniform(0, 20.0)
+            g = gp.fold(g, gp.GoodputSignals(
+                now=t,
+                has_pods=rng.random() < 0.7,
+                resize_requested=rng.random() < 0.1,
+                preemptions=preemptions,
+                last_step=last_step,
+                recompiles=recompiles,
+                stragglers=rng.random() < 0.2,
+                restore_step=(rng.randrange(0, last_step + 1)
+                              if rng.random() < 0.3 else None),
+                ckpt_save_seconds=save,
+            ))
+        ivs = g["intervals"]
+        assert set(g["seconds"]) <= set(gp.STATES)
+        if ivs:
+            assert ivs[0]["start"] == g["start"]
+            assert ivs[-1]["end"] == g["asOf"]
+            for iv in ivs:
+                assert iv["end"] > iv["start"]
+            for a, b in zip(ivs, ivs[1:]):
+                assert a["end"] == b["start"]       # no gap, no overlap
+        total = sum(g["seconds"].values())
+        assert total == pytest.approx(g["asOf"] - g["start"])
+        if total > 0:
+            assert sum(gp.fractions(g).values()) == pytest.approx(1.0)
+
+
+def test_fold_replay_and_empty_views():
+    g = gp.fold(None, gp.GoodputSignals(now=50.0))
+    same = gp.fold(g, gp.GoodputSignals(now=50.0))
+    assert same == g
+    earlier = gp.fold(g, gp.GoodputSignals(now=40.0))
+    assert earlier == g
+    assert gp.goodput_fraction(None) == 0.0
+    assert gp.worst_badput_interval(None) is None
+    assert gp.view(None)["goodputFraction"] == 0.0
+    assert gp.fleet_rollup([])["jobs"] == 0
+
+
+# -- the badput burn-rate rule ------------------------------------------------
+
+
+def test_badput_burn_rule_walks_states_on_checkpoint_stall():
+    """An injected checkpoint stall drives the REAL ledger → exporter
+    → registry → tsdb path; job-badput-burn walks Pending → Firing →
+    Resolved with exactly one k8s Event per transition."""
+    clock = SetClock(5000.0)
+    store = TimeSeriesStore(clock=clock)
+    client = FakeKubeClient()
+    rule = next(r for r in default_rules()
+                if r.name == "job-badput-burn")
+    mgr = AlertManager(store, [rule], client=client, namespace="mon",
+                       clock=clock, tracer=Tracer(SpanCollector(),
+                                                  clock=clock))
+    exporter = gp.GoodputExporter()
+    g = None
+    step = 0
+    save = 0.0
+    transitions = []
+
+    def tick(stalled):
+        nonlocal g, step, save
+        clock.now += 10.0
+        if stalled:
+            save += 10.0        # the snapshot ate the whole window
+        else:
+            step += 1
+        g = gp.fold(g, gp.GoodputSignals(
+            now=clock.now, has_pods=True, last_step=step,
+            ckpt_save_seconds=save))
+        exporter.export("gpburn", "stall", 8, g)
+        store.sample_registry(DEFAULT_REGISTRY)
+        for st in mgr.evaluate():
+            transitions.append(st.state)
+
+    g = gp.fold(None, gp.GoodputSignals(now=clock.now, has_pods=True))
+    for _ in range(6):
+        tick(stalled=False)     # healthy: ratio 0, rule Inactive
+    assert mgr.firing() == []
+    for _ in range(30):
+        tick(stalled=True)      # the stall: badput ratio → ~0.8
+    assert "job-badput-burn" in mgr.firing()
+    for _ in range(75):
+        tick(stalled=False)     # recovery: the stall slides out of
+    assert mgr.firing() == []   # every short window
+    assert transitions == ["Pending", "Firing", "Resolved"]
+    events = client.list("v1", "Event", "mon")
+    assert len(events) == 3     # exactly one per transition
+    reasons = sorted(e["reason"] for e in events)
+    assert reasons == ["AlertFiring", "AlertPending", "AlertResolved"]
+
+
+# -- satellite: the checkpoint-save histogram ---------------------------------
+
+
+class _Mgr:
+    def __init__(self, clock, cost=2.5):
+        self.clock, self.cost = clock, cost
+        self.saves = 0
+
+    def save(self, step, state, wait=False):
+        self.saves += 1
+        self.clock.now += self.cost        # the save takes wall time
+
+
+def test_snapshotter_records_save_walltime_histogram():
+    clock = SetClock(0.0)
+    before = gp.checkpoint_save_seconds("gph", "job1")
+    snap = ElasticSnapshotter(_Mgr(clock), clock=clock, job="job1",
+                              namespace="gph")
+    snap.snapshot(7, {"w": 1})
+    assert gp.checkpoint_save_seconds("gph", "job1") == before + 2.5
+    # exactly-once discipline: a replayed snapshot observes nothing
+    snap.snapshot(7, {"w": 1})
+    assert gp.checkpoint_save_seconds("gph", "job1") == before + 2.5
+    h = DEFAULT_REGISTRY.histogram("kftpu_checkpoint_save_seconds")
+    counts = h.bucket_counts(source="worker", namespace="gph",
+                             job="job1")
+    assert counts["+Inf"] == 1
+
+
+def test_dir_checkpointer_records_operator_read_time(tmp_path):
+    class _FakeMgr:
+        def __init__(self, directory):
+            self.directory = directory
+
+        def latest_step(self):
+            return 12
+
+    clock = SetClock(0.0)
+    ckpt = DirCheckpointer(_FakeMgr, clock=clock)
+    before = DEFAULT_REGISTRY.histogram(
+        "kftpu_checkpoint_save_seconds").sum(
+        source="operator", namespace="gph", job="j2")
+    step = ckpt.save({"metadata": {"namespace": "gph", "name": "j2"},
+                      "spec": {"checkpointDir": str(tmp_path)}})
+    assert step == 12
+    after = DEFAULT_REGISTRY.histogram(
+        "kftpu_checkpoint_save_seconds").sum(
+        source="operator", namespace="gph", job="j2")
+    assert after >= before  # wall time observed (0.0 on a still clock)
+
+
+# -- review-regression pins ---------------------------------------------------
+
+
+def test_steady_hold_does_not_write_status_every_pass():
+    """The ledger's own status write is throttled (state change or 60s
+    cap): a quiet queued hold must stay quiet — an unconditional
+    per-pass write would re-enqueue the job off its own MODIFIED watch
+    event and turn every hold loop hot."""
+    ns = "gpthr"
+    client = FakeKubeClient()          # NO slice nodes: queued forever
+    clock = SetClock()
+    q = GangQueue(client, clock=clock,
+                  tracer=Tracer(SpanCollector(), clock=clock),
+                  checkpoint_step=lambda ns, name: None,
+                  quota_fn=lambda ns: 0)   # quota 0: blocked, no place
+    op = TpuJobOperator(client, clock=clock, queue=q)
+    client.create(tpujob("j", ns, {"image": "x", "slices": 1}))
+    op.reconcile(ns, "j")
+    clock.now += 10.0
+    op.reconcile(ns, "j")              # opens the queue_wait interval
+    rv0 = client.get(API_VERSION, TPUJOB_KIND, ns,
+                     "j")["metadata"]["resourceVersion"]
+    for _ in range(3):                 # steady same-state holds < 60s
+        clock.now += 10.0
+        op.reconcile(ns, "j")
+    rv1 = client.get(API_VERSION, TPUJOB_KIND, ns,
+                     "j")["metadata"]["resourceVersion"]
+    assert rv1 == rv0, "steady hold wrote status"
+    clock.now += 60.0                  # the staleness cap flushes
+    op.reconcile(ns, "j")
+    job = client.get(API_VERSION, TPUJOB_KIND, ns, "j")
+    assert job["metadata"]["resourceVersion"] != rv0
+    assert job["status"]["goodput"]["asOf"] == clock.now
+    # nothing was lost to the skipped writes: one merged interval
+    assert job["status"]["goodput"]["seconds"]["queue_wait"] == (
+        clock.now - 1000.0)
+
+
+def test_markers_reset_when_a_regang_restarts_beacon_counters():
+    """A re-ganged gang's worker processes restart their recompile
+    counters (and a rollback restore re-does steps): the fold must
+    compare against the NEW stream, not the old run's historical max,
+    or every post-re-gang recompile is masked and redone progress
+    reads 'unattributed'."""
+    g = gp.fold(None, gp.GoodputSignals(now=0.0, has_pods=True))
+    g = gp.fold(g, gp.GoodputSignals(now=10.0, has_pods=True,
+                                     last_step=100, recompiles=5))
+    g = gp.fold(g, gp.GoodputSignals(now=20.0, has_pods=False,
+                                     preemptions=1, restore_step=40))
+    # re-gang: fresh processes — counters restart from the rollback
+    g = gp.fold(g, gp.GoodputSignals(now=30.0, has_pods=True,
+                                     last_step=41, recompiles=0,
+                                     restore_step=40))
+    # a recompile in the NEW run (1 < the old max of 5) must count
+    g = gp.fold(g, gp.GoodputSignals(now=40.0, has_pods=True,
+                                     last_step=42, recompiles=1,
+                                     restore_step=40))
+    assert g["intervals"][-1]["state"] == "recompile"
+    # and redone steps after it are productive, not unattributed
+    g = gp.fold(g, gp.GoodputSignals(now=50.0, has_pods=True,
+                                     last_step=43, recompiles=1,
+                                     restore_step=40))
+    assert g["intervals"][-1]["state"] == "productive_step"
+
+
+def test_ckpt_save_seconds_takes_max_across_scraped_series():
+    """A gang-synchronized snapshot is observed once per worker (one
+    scraped series per target): the job's wall-clock cost is its
+    slowest worker — summing would carve N x phantom save seconds."""
+    clock = SetClock(100.0)
+    store = TimeSeriesStore(clock=clock)
+    for target, v in (("w0", 30.0), ("w1", 31.5), ("w2", 29.0)):
+        store.ingest("kftpu_checkpoint_save_seconds_sum", v,
+                     labels={"namespace": "gpmax", "job": "j",
+                             "source": "worker", "target": target},
+                     ts=99.0)
+    op = TpuJobOperator(FakeKubeClient(), clock=clock, tsdb=store)
+    assert op._ckpt_save_seconds("gpmax", "j") == 31.5
+
+
+def test_exported_counters_never_exceed_the_persisted_ledger():
+    """The export follows the PERSISTED ledger chain only (lagging one
+    pass, caught up on the terminal reconcile): a fold whose status
+    write was skipped must not be counted, or a later re-derivation of
+    the same window under a different state would over-count — the
+    monotone counter could never take it back."""
+    ns = "gpexp"
+    client, q, op, collector, clock = _cluster(ns, slices=1)
+    client.create(tpujob("j", ns, {"image": "x", "slices": 1,
+                                   "hostsPerSlice": 1}))
+    op.reconcile(ns, "j")
+    _set_phase(client, ns, "j", "Running")
+    for t in (1010.0, 1020.0, 1030.0, 1040.0):   # quiet steady holds
+        clock.now = t
+        op.reconcile(ns, "j")
+    _set_phase(client, ns, "j", "Succeeded")
+    clock.now = 1050.0
+    op.reconcile(ns, "j")                        # terminal write
+    op.reconcile(ns, "j")                        # terminal export catch-up
+    g = client.get(API_VERSION, TPUJOB_KIND, ns,
+                   "j")["status"]["goodput"]
+    c = DEFAULT_REGISTRY.counter("kftpu_job_goodput_seconds_total")
+    exported = {st: c.get(namespace=ns, job="j", state=st)
+                for st in gp.STATES}
+    assert sum(exported.values()) == pytest.approx(
+        g["asOf"] - g["start"])
+    for st, v in g["seconds"].items():
+        assert exported[st] == pytest.approx(v)
+
+
+def test_ckpt_save_counter_reset_rebaselines_not_swallows():
+    """A re-ganged gang's restarted worker processes reset the scraped
+    kftpu_checkpoint_save_seconds _sum: the fold must re-baseline
+    downward (the rate() counter-reset stance), or every post-restart
+    save hides under the old cumulative."""
+    g = gp.fold(None, gp.GoodputSignals(now=0.0, has_pods=True,
+                                        ckpt_save_seconds=120.0))
+    g = gp.fold(g, gp.GoodputSignals(now=10.0, has_pods=True,
+                                     last_step=5,
+                                     ckpt_save_seconds=120.0))
+    # restart: the observed cumulative drops to 0, then a 4s save lands
+    g = gp.fold(g, gp.GoodputSignals(now=20.0, has_pods=True,
+                                     last_step=6,
+                                     ckpt_save_seconds=0.0))
+    g = gp.fold(g, gp.GoodputSignals(now=30.0, has_pods=True,
+                                     last_step=7,
+                                     ckpt_save_seconds=4.0))
+    assert g["seconds"].get("checkpoint_save") == 4.0
+
+
+def test_wire_fleet_is_per_model():
+    """Wiring a second model must not silently unwire the first."""
+    from kubeflow_tpu.autoscale import Autoscaler, policy_preset
+    from kubeflow_tpu.autoscale.metrics import MetricsAggregator
+
+    class Edge:
+        def __init__(self):
+            self.synced = {}
+
+        def sync_replicas(self, replicas):
+            self.synced = dict(replicas)
+            return [], []
+
+    asc = Autoscaler(policy_preset("serving"), None,
+                     MetricsAggregator(clock=lambda: 0.0),
+                     clock=lambda: 0.0)
+    e1, e2 = Edge(), Edge()
+    asc.wire_fleet(e1, "m1")
+    asc.wire_fleet(e2, "m2")
+    asc._sync_fleet("m1")
+    asc._sync_fleet("m2")
+    assert e1.synced == {} and e2.synced == {}   # both still wired
+    assert set(asc._fleet) == {"m1", "m2"}
+
+
+def test_goodput_view_tolerates_null_spec_numerics():
+    """One job whose spec went bad (slices: null) must not 500 the
+    whole fleet rollup — its ledger still counts via the defaults."""
+    client = FakeKubeClient()
+    job = tpujob("ok", "gpnull", {"image": "x", "slices": 1})
+    client.create(job)
+    bad = client.get(API_VERSION, TPUJOB_KIND, "gpnull", "ok")
+    bad["spec"] = {**bad["spec"], "slices": None}
+    bad["status"] = {"goodput": gp.fold(None, gp.GoodputSignals(
+        now=0.0))}
+    bad["status"]["goodput"] = gp.fold(
+        bad["status"]["goodput"], gp.GoodputSignals(now=10.0))
+    client.update(bad)
+    client.update_status(bad)
+    api = DashboardApi(client, authorize=lambda *a: True)
+    code, body = api.handle("GET", "/api/metrics/goodput", None)
+    assert code == 200
+    assert body["jobs"] == 1
+
+
+def test_sync_fleet_survives_a_raising_url_for():
+    """A user url_for that raises must cost only this tick's ring
+    sync, never the scaling decision (or the other models' ticks)."""
+    from kubeflow_tpu.autoscale import Autoscaler, policy_preset
+    from kubeflow_tpu.autoscale.metrics import MetricsAggregator
+
+    asc = Autoscaler(policy_preset("serving"), None,
+                     MetricsAggregator(clock=lambda: 0.0),
+                     clock=lambda: 0.0)
+    asc.wire_fleet(object(), "m",           # no sync_replicas/sync
+                   url_for=lambda m, s: 1 / 0)
+    asc._sync_fleet("m")                    # must not raise
