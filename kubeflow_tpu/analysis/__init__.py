@@ -16,7 +16,10 @@ Layout:
 - :mod:`registry`  — pluggable checker registry (``@register_checker``)
 - :mod:`baseline`  — committed grandfather file for pre-existing debt
 - :mod:`runner`    — orchestration: walk → check → suppress → diff
-- :mod:`checkers`  — the shipped rules TPU001–TPU005
+- :mod:`cfg`       — per-function statement-level control-flow graphs
+- :mod:`callgraph` — class-scoped ``self._foo()`` call resolution
+- :mod:`locksets`  — must-hold lock-set dataflow + guard inference
+- :mod:`checkers`  — the shipped rules TPU001–TPU013
 
 Rule catalog (details in ``docs/ANALYSIS.md``):
 
@@ -26,6 +29,14 @@ TPU002      host calls reachable inside jit/Pallas bodies
 TPU003      raw wall clock in controllers (inject a Clock)
 TPU004      wiring drift: component URLs/ports/RBAC vs presets
 TPU005      retry/poll loops with no deadline or max-attempts
+TPU006      version-gated jax APIs outside ``compat/``
+TPU007      mesh-axis names vs the declared vocabulary
+TPU008      PartitionSpecs illegal by their own shape
+TPU009      collectives over axes no shard_map region binds
+TPU010      unguarded writes to lock-guarded shared state
+TPU011      blocking I/O / foreign callbacks under a held lock
+TPU012      re-entrant acquisition of a non-reentrant Lock
+TPU013      kftpu_* metric help/label-key contract drift
 ==========  ==================================================
 """
 

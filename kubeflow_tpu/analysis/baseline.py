@@ -19,7 +19,7 @@ import json
 import os
 from typing import Dict, Iterable, List, Tuple
 
-from kubeflow_tpu.analysis.findings import Finding
+from kubeflow_tpu.analysis.findings import Finding, normalize_path
 
 BASELINE_VERSION = 1
 DEFAULT_BASELINE = "tpulint_baseline.json"
@@ -34,7 +34,7 @@ def fingerprint_counts(
         if fp in out:
             out[fp]["count"] += 1
         else:
-            out[fp] = {"rule": f.rule, "path": f.path,
+            out[fp] = {"rule": f.rule, "path": normalize_path(f.path),
                        "message": f.message, "count": 1}
     return out
 
@@ -52,11 +52,18 @@ def load(path: str) -> Dict[str, dict]:
 
 
 def save(path: str, findings: Iterable[Tuple[Finding, str]]) -> None:
+    # deterministic, review-friendly order: by path, then rule, then
+    # occurrence key (the fingerprint) — a refresh after fixing one
+    # file touches that file's block only, never reshuffles the rest
+    counts = fingerprint_counts(findings)
+    ordered = dict(sorted(
+        counts.items(),
+        key=lambda kv: (kv[1]["path"], kv[1]["rule"], kv[0])))
     payload = {
         "version": BASELINE_VERSION,
         "comment": "tpulint grandfathered findings; regenerate with "
                    "scripts/run_tpulint.py --baseline-update",
-        "findings": dict(sorted(fingerprint_counts(findings).items())),
+        "findings": ordered,
     }
     with open(path, "w", encoding="utf-8") as f:
         json.dump(payload, f, indent=1, sort_keys=False)

@@ -1,0 +1,176 @@
+"""Module-local, class-scoped call resolution for the dataflow rules.
+
+``self._foo()`` inside a method resolves to the method body defined on
+the same class — nothing more. No inheritance walk (a base class in
+another module is invisible to a single-module analysis and guessing
+would manufacture false positives), no module-level function chasing,
+no attribute-value tracking. That scope is deliberate: the
+lock-discipline bugs this supports (TPU012's recursing ``lease()``)
+live inside one class by construction, because the lock attribute
+itself is class state.
+
+Also resolved, for the checkers that need "what does this class look
+like" facts:
+
+- method name → :class:`ast.FunctionDef` (properties included; nested
+  defs excluded);
+- constructor-injected callables: ``self._x = param`` in ``__init__``
+  where ``param`` is a bare constructor parameter — the
+  caller-supplied-callback set TPU011 prices as blocking;
+- the transitive closure helper :func:`transitive` for per-method
+  summaries over the call graph.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Set, Tuple, Union
+
+FunctionNode = Union[ast.FunctionDef, ast.AsyncFunctionDef]
+
+
+@dataclasses.dataclass
+class ClassGraph:
+    node: ast.ClassDef
+    methods: Dict[str, FunctionNode]
+    # method name -> [(call node, resolved method name), ...] — walked
+    # once at construction; consumers (the lockset propagation rounds,
+    # TPU012's reachability scan) iterate this instead of re-walking
+    # method ASTs
+    call_sites: Dict[str, List[Tuple[ast.Call, str]]]
+    # same, restricted to calls NOT inside a nested def/lambda: a call
+    # in a closure runs later, usually on another thread — it must not
+    # feed a same-thread deadlock verdict (a threading.Lock deadlocks
+    # only against its own thread)
+    direct_call_sites: Dict[str, List[Tuple[ast.Call, str]]]
+    # method name -> set of same-class method names it may call
+    calls: Dict[str, Set[str]]
+    # edge set over direct_call_sites only — the lock-reachability
+    # closure (TPU012) walks these
+    direct_calls: Dict[str, Set[str]]
+    # attr name -> __init__ parameter name it was assigned from
+    injected_callables: Dict[str, str]
+
+
+def methods_of(cls: ast.ClassDef) -> Dict[str, FunctionNode]:
+    """Direct methods only — nested defs belong to their method."""
+    out: Dict[str, FunctionNode] = {}
+    for stmt in cls.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[stmt.name] = stmt
+    return out
+
+
+def self_calls(fn: FunctionNode, methods: Dict[str, FunctionNode],
+               include_nested: bool = True,
+               ) -> Iterator[Tuple[ast.Call, str]]:
+    """Yield (call node, method name) for every ``self.<m>(...)`` call
+    in ``fn`` that resolves to a method of the same class. With
+    ``include_nested`` (the default) nested defs are descended — a
+    closure calling ``self._foo()`` runs with the same ``self``;
+    without it, only calls the method's own control flow executes are
+    yielded (a deferred closure runs later, usually on another thread,
+    so same-thread facts like deadlock must not walk through it)."""
+    if include_nested:
+        nodes = ast.walk(fn)
+    else:
+        def _direct(root):
+            stack = list(ast.iter_child_nodes(root))
+            while stack:
+                node = stack.pop()
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef,
+                                     ast.Lambda, ast.ClassDef)):
+                    continue
+                yield node
+                stack.extend(ast.iter_child_nodes(node))
+        nodes = _direct(fn)
+    for node in nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "self"
+                and func.attr in methods):
+            yield node, func.attr
+
+
+def _init_params(init: Optional[FunctionNode]) -> Set[str]:
+    if init is None:
+        return set()
+    args = init.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    return {n for n in names if n != "self"}
+
+
+def injected_callables(cls: ast.ClassDef,
+                       methods: Dict[str, FunctionNode]) -> Dict[str, str]:
+    """``self._x = param`` assignments in ``__init__`` from a bare
+    constructor parameter. Only the plain-Name form counts: the
+    conditional-default clock idiom (``clock if clock is not None else
+    time.monotonic``) is an expression, not a bare name, so injectable
+    clocks never land in this set by construction. Names that *say*
+    they are clocks are additionally excluded — calling a clock under
+    a lock is cheap and everywhere."""
+    init = methods.get("__init__")
+    params = _init_params(init)
+    out: Dict[str, str] = {}
+    if init is None:
+        return out
+    for node in ast.walk(init):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if (isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+                and isinstance(node.value, ast.Name)
+                and node.value.id in params
+                and "clock" not in tgt.attr.lower()):
+            out[tgt.attr] = node.value.id
+    return out
+
+
+def class_graph(cls: ast.ClassDef) -> ClassGraph:
+    methods = methods_of(cls)
+    call_sites = {name: list(self_calls(fn, methods))
+                  for name, fn in methods.items()}
+    direct_call_sites = {
+        name: list(self_calls(fn, methods, include_nested=False))
+        for name, fn in methods.items()}
+    calls = {name: {m for _, m in sites}
+             for name, sites in call_sites.items()}
+    direct_calls = {name: {m for _, m in sites}
+                    for name, sites in direct_call_sites.items()}
+    return ClassGraph(node=cls, methods=methods, call_sites=call_sites,
+                      direct_call_sites=direct_call_sites,
+                      calls=calls, direct_calls=direct_calls,
+                      injected_callables=injected_callables(cls, methods))
+
+
+def transitive(graph: Dict[str, Set[str]],
+               local: Dict[str, Set[str]]) -> Dict[str, Set[str]]:
+    """Close per-method summaries over the call graph:
+    ``result[m] = local[m] ∪ ⋃ result[callee]``. Plain fixpoint — the
+    lattice is finite (sets of lock names) and classes are small."""
+    out = {m: set(s) for m, s in local.items()}
+    changed = True
+    while changed:
+        changed = False
+        for m, callees in graph.items():
+            cur = out.setdefault(m, set())
+            for c in callees:
+                extra = out.get(c, set()) - cur
+                if extra:
+                    cur |= extra
+                    changed = True
+    return out
+
+
+def classes_in(tree: ast.Module) -> List[ast.ClassDef]:
+    """Top-level classes (and classes nested one level in functions are
+    skipped — a class built inside a factory closure is rare and its
+    lock discipline is the closure's business)."""
+    return [n for n in tree.body if isinstance(n, ast.ClassDef)]

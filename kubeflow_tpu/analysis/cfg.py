@@ -1,0 +1,203 @@
+"""Per-function control-flow graphs over the existing AST walker.
+
+The lock-discipline rules (TPU010–TPU012) need to answer "which locks
+are held *here*" — a property of paths, not of syntax — so pattern
+matching stops being enough at exactly this rule family. This module
+builds a small statement-level CFG for one function:
+
+- one node per simple statement;
+- ``if``/``match`` fork and re-join;
+- ``while``/``for`` get a back edge to the header and an exit edge
+  (the ``else:`` clause hangs off the exit like CPython's semantics);
+- ``with`` is modeled as an **enter** node (the acquisition point)
+  plus a synthetic **exit** node that normal fall-through flows
+  through. ``raise``/``return`` inside the body edge straight to the
+  function exit, NOT through the with-exit node — release-on-unwind
+  is instead achieved indirectly: an enclosing ``try``'s handler
+  fans in from the with-ENTER node's pre-acquisition state among its
+  predecessors, so a must-analysis never sees the lock held in a
+  handler unless the whole ``try`` sat inside the ``with``. A rule
+  that needs explicit release events on unwind paths (e.g.
+  acquire/release pairing) would have to add those edges first;
+- ``try`` adds an edge from every node of the body to each handler
+  (an exception can surface anywhere), ``finally`` joins all of it.
+
+Nested ``def``/``lambda``/``class`` bodies are opaque single
+statements: their code runs at some later call, on some other path —
+a different function's CFG.
+
+The graph is deliberately tiny — no expression-level nodes, no
+interprocedural edges — because the consumer is an abstract
+interpreter over a finite lattice (:mod:`locksets`), and statements
+are the granularity findings anchor to.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Union
+
+FunctionNode = Union[ast.FunctionDef, ast.AsyncFunctionDef]
+
+# node kinds
+ENTRY = "entry"           # synthetic function entry
+EXIT = "exit"             # synthetic function exit
+STMT = "stmt"             # one simple statement / branch header
+WITH_ENTER = "with_enter"  # the `with` header — acquisition point
+WITH_EXIT = "with_exit"   # synthetic release point after a with body
+
+
+@dataclasses.dataclass
+class CfgNode:
+    nid: int
+    kind: str
+    node: Optional[ast.AST] = None      # the AST statement (None: synthetic)
+    succs: List[int] = dataclasses.field(default_factory=list)
+    # for WITH_EXIT: the matching With node (so the interpreter knows
+    # which context managers this node releases)
+    with_node: Optional[ast.With] = None
+
+
+class Cfg:
+    """CFG for one function. ``stmt_node`` maps a statement AST object
+    (by identity) to its CfgNode, so analyses can attach facts back to
+    source locations."""
+
+    def __init__(self) -> None:
+        self.nodes: List[CfgNode] = []
+        self.entry = self._new(ENTRY)
+        self.exit = self._new(EXIT)
+        self.stmt_node: Dict[ast.AST, CfgNode] = {}
+
+    def _new(self, kind: str, node: Optional[ast.AST] = None,
+             with_node: Optional[ast.AST] = None) -> CfgNode:
+        cn = CfgNode(nid=len(self.nodes), kind=kind, node=node,
+                     with_node=with_node)
+        self.nodes.append(cn)
+        return cn
+
+    def link(self, frm: Sequence[int], to: int) -> None:
+        for f in frm:
+            if to not in self.nodes[f].succs:
+                self.nodes[f].succs.append(to)
+
+    def preds(self) -> Dict[int, List[int]]:
+        out: Dict[int, List[int]] = {n.nid: [] for n in self.nodes}
+        for n in self.nodes:
+            for s in n.succs:
+                out[s].append(n.nid)
+        return out
+
+
+# statements that terminate the current path outright
+_JUMP = (ast.Return, ast.Raise)
+# opaque one-node statements (never descended into)
+_OPAQUE = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+
+
+class _Builder:
+    def __init__(self, fn: FunctionNode) -> None:
+        self.cfg = Cfg()
+        # (break_targets, continue_target) stack for loop bodies
+        self.loops: List[List[int]] = []
+        self.continue_targets: List[int] = []
+        frontier = self._body(fn.body, [self.cfg.entry.nid])
+        self.cfg.link(frontier, self.cfg.exit.nid)
+
+    # every helper takes/returns a *frontier*: the node ids whose
+    # successor is the next thing sequenced after the construct
+
+    def _stmt_node(self, stmt: ast.AST, kind: str = STMT,
+                   with_node: Optional[ast.AST] = None) -> CfgNode:
+        cn = self.cfg._new(kind, stmt, with_node=with_node)
+        if kind != WITH_EXIT:
+            self.cfg.stmt_node[stmt] = cn
+        return cn
+
+    def _body(self, body: Sequence[ast.stmt],
+              frontier: List[int]) -> List[int]:
+        # an empty frontier (after return/raise/break) still flows on:
+        # unreachable statements get nodes with no predecessors, so a
+        # finding there has somewhere to anchor
+        for stmt in body:
+            frontier = self._stmt(stmt, frontier)
+        return frontier
+
+    def _stmt(self, stmt: ast.stmt, frontier: List[int]) -> List[int]:
+        if isinstance(stmt, ast.If):
+            head = self._stmt_node(stmt)
+            self.cfg.link(frontier, head.nid)
+            then = self._body(stmt.body, [head.nid])
+            if stmt.orelse:
+                other = self._body(stmt.orelse, [head.nid])
+            else:
+                other = [head.nid]
+            return then + other
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            head = self._stmt_node(stmt)
+            self.cfg.link(frontier, head.nid)
+            self.loops.append([])
+            self.continue_targets.append(head.nid)
+            body_out = self._body(stmt.body, [head.nid])
+            self.cfg.link(body_out, head.nid)     # back edge
+            breaks = self.loops.pop()
+            self.continue_targets.pop()
+            exits = [head.nid] + breaks
+            if stmt.orelse:
+                # else: runs on normal loop exhaustion (not on break)
+                exits = self._body(stmt.orelse, [head.nid]) + breaks
+            return exits
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            enter = self._stmt_node(stmt, kind=WITH_ENTER)
+            self.cfg.link(frontier, enter.nid)
+            body_out = self._body(stmt.body, [enter.nid])
+            leave = self._stmt_node(stmt, kind=WITH_EXIT, with_node=stmt)
+            self.cfg.link(body_out, leave.nid)
+            return [leave.nid]
+        if isinstance(stmt, ast.Try):
+            first = len(self.cfg.nodes)
+            body_out = self._body(stmt.body, frontier)
+            body_ids = [n.nid for n in self.cfg.nodes[first:]]
+            outs: List[int] = []
+            for handler in stmt.handlers:
+                # the exception may surface before any body statement
+                # ran, or after any of them — conservative fan-in
+                outs += self._body(handler.body, frontier + body_ids)
+            if stmt.orelse:
+                body_out = self._body(stmt.orelse, body_out)
+            outs += body_out
+            if stmt.finalbody:
+                outs = self._body(stmt.finalbody, outs)
+            return outs
+        if isinstance(stmt, ast.Break):
+            n = self._stmt_node(stmt)
+            self.cfg.link(frontier, n.nid)
+            if self.loops:
+                self.loops[-1].append(n.nid)
+            return []
+        if isinstance(stmt, ast.Continue):
+            n = self._stmt_node(stmt)
+            self.cfg.link(frontier, n.nid)
+            if self.continue_targets:
+                self.cfg.link([n.nid], self.continue_targets[-1])
+            return []
+        if hasattr(ast, "Match") and isinstance(stmt, ast.Match):
+            head = self._stmt_node(stmt)
+            self.cfg.link(frontier, head.nid)
+            outs = [head.nid]  # no case may match
+            for case in stmt.cases:
+                outs += self._body(case.body, [head.nid])
+            return outs
+        # simple statement (incl. opaque nested defs)
+        n = self._stmt_node(stmt)
+        self.cfg.link(frontier, n.nid)
+        if isinstance(stmt, _JUMP):
+            self.cfg.link([n.nid], self.cfg.exit.nid)
+            return []
+        return [n.nid]
+
+
+def build_cfg(fn: FunctionNode) -> Cfg:
+    """Build the statement-level CFG for one function body."""
+    return _Builder(fn).cfg

@@ -2,7 +2,11 @@
 
 from kubeflow_tpu.analysis.checkers import (  # noqa: F401
     host_call_in_jit,
+    lock_blocking,
+    lock_reentrant,
+    lock_unguarded_state,
     mesh_axes,
+    metric_contract,
     raw_clock,
     spec_legality,
     tile_legality,

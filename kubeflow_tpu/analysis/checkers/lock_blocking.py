@@ -1,0 +1,130 @@
+"""TPU011 — blocking call / foreign code invoked while holding a lock.
+
+The lease-deadlock and serial-poller-staleness class: a lock is cheap
+only while its critical sections are short and *closed* — the moment
+a section does network I/O, sleeps, shells out, or calls code the
+class does not own (a caller-supplied callback), every other thread
+needing that lock inherits the latency, and a callback that re-enters
+or raises under the guard wedges or corrupts the class (the fleet
+edge's raising ``url_for`` aborted every remaining model's scaling
+tick; the multiplexer's store load under the pager lock serialized
+every cold fault behind one RPC).
+
+Flagged, at any statement where the lock-set analysis proves a lock is
+held:
+
+- sleep-shaped calls: ``time.sleep`` and the injectable-``Sleep``
+  contract (any ``*sleep`` callable — ``self._sleep(...)``);
+- network fetches: ``urlopen``, ``requests.get/post/...``,
+  ``socket.create_connection``, ``getresponse``;
+- subprocess spawns: ``subprocess.run/Popen/call/check_*``,
+  ``os.system``/``os.popen``;
+- caller-supplied callbacks: invoking ``self._x`` where ``__init__``
+  assigned it from a bare constructor parameter, or invoking a bare
+  parameter of the enclosing method. Clock-named injectables are
+  exempt (calling a clock under a lock is cheap and everywhere — the
+  TPU003 idiom must not collide with this rule).
+
+The fix shape is always the same and the codebase is full of worked
+examples: snapshot state under the lock, drop the lock, do the slow
+thing, re-take the lock to publish (``serving/multiplex.py`` fault
+protocol, ``edge/fleet.py`` poller).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional, Set
+
+from kubeflow_tpu.analysis import cfg as cfg_mod
+from kubeflow_tpu.analysis.findings import Finding
+from kubeflow_tpu.analysis.locksets import (
+    _dotted,
+    _stmt_exprs,
+    lock_analysis,
+)
+from kubeflow_tpu.analysis.registry import Checker, register_checker
+from kubeflow_tpu.analysis.walker import ModuleInfo
+
+_SUBPROCESS = {"subprocess.run", "subprocess.Popen", "subprocess.call",
+               "subprocess.check_call", "subprocess.check_output",
+               "os.system", "os.popen"}
+_NET_SEGMENTS = {"urlopen", "getresponse", "create_connection"}
+_REQUESTS_VERBS = {"get", "post", "put", "patch", "delete", "head",
+                   "request"}
+
+
+def _method_params(fn) -> Set[str]:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    return {n for n in names if n != "self" and "clock" not in n.lower()}
+
+
+def classify_blocking(call: ast.Call, injected: Set[str],
+                      params: Set[str]) -> Optional[str]:
+    """What kind of blocking call this is, or None."""
+    func = call.func
+    name = _dotted(func) or ""
+    seg = name.split(".")[-1].lower() if name else ""
+    if seg == "sleep" or seg.endswith("_sleep"):
+        return "sleep"
+    if name in _SUBPROCESS:
+        return "subprocess"
+    if seg in _NET_SEGMENTS:
+        return "network fetch"
+    if name.startswith("requests.") and seg in _REQUESTS_VERBS:
+        return "network fetch"
+    if (isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "self"
+            and func.attr in injected):
+        return "caller-supplied callback"
+    if isinstance(func, ast.Name) and func.id in params:
+        return "caller-supplied callback"
+    return None
+
+
+@register_checker
+class BlockingUnderLockChecker(Checker):
+    rule = "TPU011"
+    name = "blocking-under-lock"
+    severity = "error"
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        for cla in lock_analysis(module):
+            if not cla.locks:
+                continue
+            injected = set(cla.graph.injected_callables)
+            for mname, ml in sorted(cla.methods.items()):
+                params = _method_params(ml.fn)
+                for cn in ml.cfg.nodes:
+                    if cn.kind not in (cfg_mod.STMT, cfg_mod.WITH_ENTER):
+                        continue
+                    held = ml.held_in.get(cn.nid)
+                    if not held:
+                        continue
+                    for node in _stmt_exprs(cn):
+                        if not isinstance(node, ast.Call):
+                            continue
+                        kind = classify_blocking(node, injected, params)
+                        if kind is None:
+                            continue
+                        locks = ", ".join(f"self.{n}"
+                                          for n in sorted(held))
+                        what = _dotted(node.func) or "<call>"
+                        yield Finding(
+                            rule=self.rule, severity=self.severity,
+                            path=module.rel, line=node.lineno,
+                            span=module.node_span(
+                                cn.node if cn.node is not None else node),
+                            message=(
+                                f"{kind} `{what}(...)` in "
+                                f"{cla.cls.name}.{mname}() while "
+                                f"holding {locks} — every thread "
+                                f"needing the lock inherits this "
+                                f"latency, and foreign code under a "
+                                f"guard can re-enter or raise"),
+                            hint=("snapshot under the lock, release, "
+                                  "do the slow call, re-lock to "
+                                  "publish (see serving/multiplex.py "
+                                  "fault protocol)"))

@@ -1,0 +1,127 @@
+"""TPU012 — re-entrant acquisition of a non-reentrant lock.
+
+The repro-tested PR 11 deadlock: ``ModelMultiplexer.lease()`` held
+``self._lock`` and called ``self.get()``, which opens with
+``with self._lock:`` — a ``threading.Lock`` is not re-entrant, so the
+thread blocked on itself and the whole weight pager wedged. The bug is
+invisible to pattern matching because the two acquisitions live in
+different methods; it is one call-graph hop plus one lock-set fact.
+
+Flagged, for locks discovered as plain ``threading.Lock`` (``RLock``
+attributes are re-entrant by contract and never flagged):
+
+- **direct**: an acquisition (``with self._lock:`` or
+  ``self._lock.acquire()``) at a statement where the must-analysis
+  already proves the same lock held;
+- **via the class call graph**: a ``self._foo()`` call at a statement
+  holding lock L, where ``_foo`` — or anything transitively reachable
+  from it through same-class ``self.*()`` calls — may acquire L. The
+  message names the chain so the fix site is obvious.
+
+The fix is the multiplexer's own post-fix shape: hoist the work out
+from under the lock, or split a ``_locked`` variant that the guarded
+caller uses (the ``*_locked`` naming convention is how the analysis
+knows the caller holds it).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Set
+
+from kubeflow_tpu.analysis import callgraph as cg
+from kubeflow_tpu.analysis.findings import Finding
+from kubeflow_tpu.analysis.locksets import lock_analysis
+from kubeflow_tpu.analysis.registry import Checker, register_checker
+from kubeflow_tpu.analysis.walker import ModuleInfo
+
+
+def _chain_to_acquirer(calls: Dict[str, Set[str]],
+                       local: Dict[str, Set[str]], start: str,
+                       lock: str) -> List[str]:
+    """Shortest call chain from ``start`` to a method that locally
+    acquires ``lock`` (BFS; ``start`` itself may be the acquirer)."""
+    frontier = [[start]]
+    seen = {start}
+    while frontier:
+        path = frontier.pop(0)
+        if lock in local.get(path[-1], set()):
+            return path
+        for callee in sorted(calls.get(path[-1], ())):
+            if callee not in seen:
+                seen.add(callee)
+                frontier.append(path + [callee])
+    return [start]
+
+
+@register_checker
+class ReentrantLockChecker(Checker):
+    rule = "TPU012"
+    name = "reentrant-lock-acquire"
+    severity = "error"
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        for cla in lock_analysis(module):
+            plain = {n for n, d in cla.locks.items() if d.kind == "lock"}
+            if not plain:
+                continue
+            per_method = {name: ml.may_acquire
+                          for name, ml in cla.methods.items()}
+            # the deadlock verdict reads the LOCAL lock states — what
+            # the method body itself proves, plus the *_locked
+            # convention only in single-lock classes where the suffix
+            # is unambiguous. An assumption may excuse a write under
+            # TPU010/011 but never convicts a deadlock, and a
+            # context-dependent deadlock (callee acquires under a
+            # caller's lock) is reported exactly ONCE, at the call
+            # site that establishes the context — not again inside
+            # the callee off propagated entry state
+            for mname, ml in sorted(cla.local.items()):
+                for acq in ml.acquires:
+                    if acq.lock in plain and acq.lock in acq.held_before:
+                        yield Finding(
+                            rule=self.rule, severity=self.severity,
+                            path=module.rel, line=acq.node.lineno,
+                            span=module.node_span(acq.node),
+                            message=(
+                                f"{cla.cls.name}.{mname}() re-acquires "
+                                f"non-reentrant self.{acq.lock} while "
+                                f"already holding it — threading.Lock "
+                                f"deadlocks against itself"),
+                            hint=("use the *_locked-helper split or an "
+                                  "RLock if re-entry is the design"))
+            # re-acquisition reachable through the class call graph —
+            # DIRECT call sites only: a call inside a nested def runs
+            # later, usually on another thread, and a threading.Lock
+            # deadlocks only against its own thread
+            for mname in sorted(cla.graph.direct_call_sites):
+                for call, target in cla.graph.direct_call_sites[mname]:
+                    held = cla.held_at(mname, call, mode="local")
+                    if not held:
+                        continue
+                    overlap = sorted(
+                        held & plain & cla.may_acquire.get(target, set()))
+                    for lock in overlap:
+                        chain = _chain_to_acquirer(
+                            cla.graph.direct_calls, per_method, target,
+                            lock)
+                        via = " -> ".join(f"{c}()" for c in chain)
+                        yield Finding(
+                            rule=self.rule, severity=self.severity,
+                            path=module.rel, line=call.lineno,
+                            span=self._call_span(module, cla, mname,
+                                                 call),
+                            message=(
+                                f"{cla.cls.name}.{mname}() calls "
+                                f"self.{target}() while holding "
+                                f"non-reentrant self.{lock}, and "
+                                f"{via} acquires it again — the "
+                                f"recursing-under-lock deadlock "
+                                f"(PR 11 lease() class)"),
+                            hint=("re-fault outside the lock or call "
+                                  "a *_locked variant that assumes "
+                                  "the guard"))
+
+    @staticmethod
+    def _call_span(module: ModuleInfo, cla, method: str, call):
+        stmt = cla.enclosing_stmt(method, call)
+        return module.node_span(stmt if stmt is not None else call)

@@ -1,0 +1,64 @@
+"""TPU010 — unguarded shared state (lock-set dataflow).
+
+The review ledger's most common concurrency class: a class protects an
+instance attribute with ``self._lock`` *almost* everywhere, and the
+one bare site is the bug — the ThreadingHTTPServer panel counters
+raced exactly this way, and the fleet edge's inflight map was
+resurrected by an unlocked ``finish()`` write after its replica was
+pruned. Single-pass AST matching cannot see "which locks are held
+here"; the :mod:`kubeflow_tpu.analysis.locksets` core can.
+
+Flagged: a **write** (assignment, augmented assignment, subscript
+store, or mutating container call like ``.append``/``.update``) to an
+attribute the guard inference marked as lock-guarded — the majority of
+its access sites across the class hold the same lock — at a site
+holding **no lock at all**. Reads stay unflagged (a racy read is
+sometimes a deliberate fast-path peek; a racy write corrupts), writes
+under a *different* lock stay unflagged (lock splitting is a design,
+not an accident), and ``__init__`` writes never count (construction
+happens-before publication). The limits of the intraprocedural scope
+— ``*_locked`` naming convention, private-helper call-site context —
+are documented in docs/ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from kubeflow_tpu.analysis.findings import Finding
+from kubeflow_tpu.analysis.locksets import lock_analysis
+from kubeflow_tpu.analysis.registry import Checker, register_checker
+from kubeflow_tpu.analysis.walker import ModuleInfo
+
+
+@register_checker
+class UnguardedSharedStateChecker(Checker):
+    rule = "TPU010"
+    name = "unguarded-shared-state"
+    severity = "error"
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        for cla in lock_analysis(module):
+            if not cla.locks:
+                continue
+            cls_name = cla.cls.name
+            for attr in sorted(cla.guards):
+                guard = cla.guards[attr]
+                for site in cla.attr_sites.get(attr, ()):
+                    if not site.is_write or site.held:
+                        continue
+                    yield Finding(
+                        rule=self.rule, severity=self.severity,
+                        path=module.rel, line=site.node.lineno,
+                        span=module.node_span(site.stmt),
+                        message=(
+                            f"write to self.{attr} in "
+                            f"{cls_name}.{site.method}() holds no lock, "
+                            f"but the attribute is guarded by "
+                            f"self.{guard} at its other access sites — "
+                            f"a cross-thread read-then-act/lost-update "
+                            f"race"),
+                        hint=(f"take `with self.{guard}:` around the "
+                              f"write (or rename the method *_locked "
+                              f"if the caller holds it; pragma a "
+                              f"deliberate benign race with why)"))

@@ -1,0 +1,191 @@
+"""TPU013 — metric-contract consistency for ``kftpu_*`` series.
+
+Two shipped bugs define the class: ``kftpu_engine_slots`` split its
+series across ``model=""``/``model="x"`` because one emission site
+labeled and another did not, and the five ``kftpu_engine_kv_pages_*``
+gauge write sites drifted until PR 11 unified them. The registry
+dedups metrics **by name, first registration wins** — so a second
+registration with a different help string silently loses, and an
+emission site with a different label-key set silently forks the
+series into rows no query joins back together.
+
+Walker-level (no dataflow): per module, collect
+
+- **registration sites**: ``<registry>.counter/gauge/histogram(
+  "kftpu_...", "help")`` calls — the name and help literals;
+- **emission sites**: ``.inc/.set/.observe/.get/.remove(...)`` calls
+  on a module variable bound to a registered metric — the label-key
+  set is the call's keyword names (``**{"k": v}`` dict-literal splats
+  are resolved; a non-literal splat makes the site unknowable and it
+  is skipped, per the prove-it-or-stay-silent contract).
+
+Then cross-reference at :meth:`finalize`: every ``kftpu_*`` name must
+have exactly one help string across all registrations and exactly one
+label-key set across all resolvable emission sites, repo-wide. The
+majority contract wins; minority sites are flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from collections import Counter
+from typing import Dict, FrozenSet, Iterable, List, Optional, Tuple
+
+from kubeflow_tpu.analysis import astutil
+from kubeflow_tpu.analysis.findings import Finding
+from kubeflow_tpu.analysis.registry import Checker, register_checker
+from kubeflow_tpu.analysis.walker import ModuleInfo
+
+_REG_METHODS = {"counter", "gauge", "histogram"}
+_EMIT_METHODS = {"inc", "set", "observe", "get", "remove"}
+# value-position keywords that are not label keys
+_NON_LABEL_KWARGS = {"amount", "value", "exemplar_trace_id"}
+
+
+@dataclasses.dataclass
+class _RegSite:
+    name: str
+    help: Optional[str]          # None: non-literal (unknowable)
+    rel: str
+    lineno: int
+    span: Tuple[int, int]
+
+
+@dataclasses.dataclass
+class _EmitSite:
+    name: str
+    labels: FrozenSet[str]
+    rel: str
+    lineno: int
+    span: Tuple[int, int]
+
+
+def _label_keys(call: ast.Call) -> Optional[FrozenSet[str]]:
+    """Keyword names of an emission call, or None when a non-literal
+    ``**splat`` makes the set unknowable."""
+    keys = []
+    for kw in call.keywords:
+        if kw.arg is None:
+            if isinstance(kw.value, ast.Dict) and all(
+                    astutil.const_str(k) is not None
+                    for k in kw.value.keys):
+                keys.extend(astutil.const_str(k) for k in kw.value.keys)
+            else:
+                return None
+        elif kw.arg not in _NON_LABEL_KWARGS:
+            keys.append(kw.arg)
+    return frozenset(keys)
+
+
+@register_checker
+class MetricContractChecker(Checker):
+    rule = "TPU013"
+    name = "metric-contract"
+    severity = "error"
+
+    def __init__(self) -> None:
+        self.regs: List[_RegSite] = []
+        self.emits: List[_EmitSite] = []
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        if "analysis/" in module.rel:
+            return ()  # rule docstrings quote example series
+        var_to_metric: Dict[str, str] = {}
+        calls: List[ast.Call] = [
+            n for n in ast.walk(module.tree) if isinstance(n, ast.Call)]
+        for call in calls:
+            func = call.func
+            if not isinstance(func, ast.Attribute) \
+                    or func.attr not in _REG_METHODS or not call.args:
+                continue
+            name = astutil.const_str(call.args[0])
+            if not name or not name.startswith("kftpu_"):
+                continue
+            help_ = None
+            if len(call.args) > 1:
+                help_ = astutil.const_str(call.args[1])
+            for kw in call.keywords:
+                if kw.arg in ("help_", "help"):
+                    help_ = astutil.const_str(kw.value)
+            self.regs.append(_RegSite(
+                name=name, help=help_, rel=module.rel,
+                lineno=call.lineno, span=module.node_span(call)))
+            parent = module.parents.get(call)
+            if isinstance(parent, ast.Assign) \
+                    and len(parent.targets) == 1 \
+                    and isinstance(parent.targets[0], ast.Name):
+                var_to_metric[parent.targets[0].id] = name
+        for call in calls:
+            func = call.func
+            if not isinstance(func, ast.Attribute) \
+                    or func.attr not in _EMIT_METHODS:
+                continue
+            if not isinstance(func.value, ast.Name):
+                continue
+            metric = var_to_metric.get(func.value.id)
+            if metric is None:
+                continue
+            labels = _label_keys(call)
+            if labels is None:
+                continue  # non-literal splat: unknowable
+            self.emits.append(_EmitSite(
+                name=metric, labels=labels, rel=module.rel,
+                lineno=call.lineno, span=module.node_span(call)))
+        return ()
+
+    def finalize(self) -> Iterable[Finding]:
+        by_name: Dict[str, List[_RegSite]] = {}
+        for r in self.regs:
+            by_name.setdefault(r.name, []).append(r)
+        for name in sorted(by_name):
+            regs = sorted(by_name[name], key=lambda r: (r.rel, r.lineno))
+            helps = [r.help for r in regs if r.help is not None]
+            variants = sorted(set(helps))
+            if len(variants) > 1:
+                canon = Counter(helps).most_common(1)[0][0]
+                for r in regs:
+                    if r.help is not None and r.help != canon:
+                        yield Finding(
+                            rule=self.rule, severity=self.severity,
+                            path=r.rel, line=r.lineno, span=r.span,
+                            message=(
+                                f"metric {name!r} registered with "
+                                f"help {r.help!r} but the majority of "
+                                f"registration sites say {canon!r} — "
+                                f"the registry keeps whichever loads "
+                                f"first, so one of them silently "
+                                f"loses"),
+                            hint="hoist the registration next to the "
+                                 "canonical help string (one "
+                                 "registration site per metric)")
+        by_emit: Dict[str, List[_EmitSite]] = {}
+        for e in self.emits:
+            by_emit.setdefault(e.name, []).append(e)
+        for name in sorted(by_emit):
+            emits = sorted(by_emit[name],
+                           key=lambda e: (e.rel, e.lineno))
+            sets = Counter(e.labels for e in emits)
+            if len(sets) <= 1:
+                continue
+            # the majority label-key set is the contract; ties break
+            # toward the lexicographically smallest so runs are stable
+            canon = sorted(sets.items(),
+                           key=lambda kv: (-kv[1], sorted(kv[0])))[0][0]
+            want = "{" + ", ".join(sorted(canon)) + "}"
+            for e in emits:
+                if e.labels == canon:
+                    continue
+                got = "{" + ", ".join(sorted(e.labels)) + "}"
+                yield Finding(
+                    rule=self.rule, severity=self.severity,
+                    path=e.rel, line=e.lineno, span=e.span,
+                    message=(
+                        f"metric {name!r} emitted with label keys "
+                        f"{got} but its other sites use {want} — "
+                        f"mismatched key sets fork the series into "
+                        f"rows no query joins back (the "
+                        f"kftpu_engine_slots model=\"\" split)"),
+                    hint="emit every site with the same label-key "
+                         "set (label an 'unknown' value explicitly "
+                         "rather than omitting the key)")

@@ -11,12 +11,23 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import os
 from typing import Optional, Tuple
 
 # severity ladder; "error" findings gate CI, "warning" findings are
 # reported but (by default) still gate — the split exists so a checker
 # can express confidence, not so warnings can be ignored
 SEVERITIES = ("error", "warning")
+
+
+def normalize_path(path: str) -> str:
+    """Repo-relative posix form: forward slashes, no leading ``./`` —
+    the same finding must fingerprint identically on every platform,
+    or a baseline refresh from another machine shuffles every entry."""
+    p = path.replace(os.sep, "/").replace("\\", "/")
+    while p.startswith("./"):
+        p = p[2:]
+    return p
 
 
 def normalize_line(text: str) -> str:
@@ -52,7 +63,8 @@ class Finding:
     def fingerprint(self, line_text: str) -> str:
         """Stable identity for baselining; ``line_text`` is the source
         of ``self.line`` (the caller owns file access)."""
-        key = f"{self.rule}|{self.path}|{normalize_line(line_text)}"
+        key = (f"{self.rule}|{normalize_path(self.path)}|"
+               f"{normalize_line(line_text)}")
         return hashlib.sha1(key.encode()).hexdigest()[:16]
 
     def format(self) -> str:

@@ -1,0 +1,484 @@
+"""Lock-set abstract interpretation + guard inference (RacerD-style).
+
+The dataflow core under the TPU010–TPU012 concurrency rules. Per
+class, per method, a forward **must-hold** analysis over the
+:mod:`cfg` graph computes which ``threading.Lock``/``RLock`` instance
+attributes are held at every statement:
+
+- ``with self._lock:`` acquires at the WITH_ENTER node and releases at
+  the synthetic WITH_EXIT node on fall-through; on exception paths the
+  release is modeled indirectly — a ``try`` handler's fan-in includes
+  the pre-acquisition state, so the must-intersection never carries a
+  with-held lock into a handler that can be reached without it;
+- bare ``self._lock.acquire()`` / ``.release()`` calls move the state
+  at their statement; an ``acquire(...)`` *with arguments* (timeout /
+  blocking=False) may fail, so it never enters the must-held set — it
+  still counts as a may-acquire for the re-entrancy rule;
+- joins intersect (must-analysis): a lock is "held here" only when
+  every path to here holds it — the direction that starves false
+  positives, per the analysis plane's contract.
+
+**Entry-state conventions** (the documented intraprocedural limits):
+
+- a method named ``*_locked`` (the repo's caller-holds-the-lock naming
+  convention, e.g. ``_evict_for_one_locked``) starts with every class
+  lock held;
+- a private method (leading ``_``) whose every same-class call site
+  holds lock L starts with L held — one bounded round of call-site
+  context propagation over :mod:`callgraph`, so helpers extracted out
+  of a ``with`` block do not read as unlocked code.
+
+**Guard inference**: an instance attribute is *guarded* by lock L when
+the majority (> ``GUARD_THRESHOLD`` = 0.5, at least
+``GUARD_MIN_LOCKED_SITES`` = 2 locked sites) of its access sites
+across the class — reads and writes, ``__init__`` excluded
+(construction happens-before publication) — hold L. TPU010 flags the
+minority: a write at a site holding nothing.
+
+Everything is memoized per :class:`ModuleInfo` via
+:func:`lock_analysis`, so the three consuming checkers share one
+analysis pass per file and lint wall time stays flat as the rule count
+grows.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from kubeflow_tpu.analysis import callgraph as cg
+from kubeflow_tpu.analysis import cfg as cfg_mod
+from kubeflow_tpu.analysis.walker import ModuleInfo
+
+GUARD_THRESHOLD = 0.5          # strict majority of access sites
+GUARD_MIN_LOCKED_SITES = 2     # one locked site proves nothing
+_PROPAGATION_ROUNDS = 3        # call-site entry-state fixpoint bound
+
+# lock constructors we track; Condition/Semaphore/Event have different
+# semantics and are deliberately out of scope
+_LOCK_CTORS = {"Lock": "lock", "threading.Lock": "lock",
+               "RLock": "rlock", "threading.RLock": "rlock"}
+
+# container methods that mutate their receiver: ``self._d.update(...)``
+# is a write to ``_d`` for guard purposes
+_MUTATORS = {"append", "extend", "insert", "add", "update", "setdefault",
+             "pop", "popitem", "popleft", "appendleft", "remove",
+             "discard", "clear", "sort", "reverse"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.X`` → "X" (only the direct two-level form)."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def iter_exprs(root: ast.AST) -> Iterator[ast.AST]:
+    """Walk ``root`` without descending into nested function/class
+    bodies or lambdas — their code runs on some other path, later."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            stack.append(child)
+
+
+def _stmt_exprs(cn: cfg_mod.CfgNode) -> Iterator[ast.AST]:
+    """The expressions evaluated *at* a CFG node — a branch header
+    evaluates only its test, not its body (the body has its own
+    nodes)."""
+    stmt = cn.node
+    if stmt is None:
+        return
+    if cn.kind == cfg_mod.WITH_ENTER:
+        for item in stmt.items:
+            yield from iter_exprs(item.context_expr)
+        return
+    if cn.kind == cfg_mod.WITH_EXIT:
+        return
+    if isinstance(stmt, (ast.If, ast.While)):
+        yield from iter_exprs(stmt.test)
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        yield from iter_exprs(stmt.iter)
+    elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef, ast.Try)):
+        return
+    elif hasattr(ast, "Match") and isinstance(stmt, ast.Match):
+        yield from iter_exprs(stmt.subject)
+    else:
+        yield from iter_exprs(stmt)
+
+
+@dataclasses.dataclass
+class LockDecl:
+    name: str            # attribute name ("_lock")
+    kind: str            # "lock" | "rlock"
+    lineno: int
+
+
+@dataclasses.dataclass
+class AcquireSite:
+    lock: str
+    node: ast.AST        # the with statement / acquire call
+    held_before: FrozenSet[str]
+    must: bool           # False for acquire(timeout=...) forms
+
+
+@dataclasses.dataclass
+class AccessSite:
+    attr: str
+    method: str
+    node: ast.AST        # the self.<attr> Attribute node
+    stmt: ast.AST        # enclosing statement (finding anchor/span)
+    is_write: bool
+    held: FrozenSet[str]
+
+
+class MethodLocks:
+    """Lock-set results for one method."""
+
+    def __init__(self, fn, graph: cfg_mod.Cfg,
+                 held_in: Dict[int, Optional[FrozenSet[str]]],
+                 acquires: List[AcquireSite]) -> None:
+        self.fn = fn
+        self.cfg = graph
+        self.held_in = held_in
+        self.acquires = acquires
+        self.may_acquire: Set[str] = {a.lock for a in acquires}
+
+    def held_for_stmt(self, stmt: ast.AST) -> Optional[FrozenSet[str]]:
+        cn = self.cfg.stmt_node.get(stmt)
+        if cn is None:
+            return None
+        return self.held_in.get(cn.nid)
+
+
+def _with_locks(stmt, locks: Dict[str, LockDecl]) -> Set[str]:
+    out: Set[str] = set()
+    for item in stmt.items:
+        attr = _self_attr(item.context_expr)
+        if attr is not None and attr in locks:
+            out.add(attr)
+    return out
+
+
+def _acquire_release_in(cn: cfg_mod.CfgNode, locks: Dict[str, LockDecl],
+                        ) -> List[Tuple[str, str, bool, ast.AST]]:
+    """(op, lock, must, node) for acquire()/release() calls evaluated
+    at this CFG node, in source order."""
+    out = []
+    for node in _stmt_exprs(cn):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not isinstance(func, ast.Attribute) \
+                or func.attr not in ("acquire", "release"):
+            continue
+        attr = _self_attr(func.value)
+        if attr is None or attr not in locks:
+            continue
+        must = not (node.args or node.keywords)
+        out.append((func.attr, attr, must, node))
+    return sorted(out, key=lambda t: (t[3].lineno, t[3].col_offset))
+
+
+def analyze_method(fn, locks: Dict[str, LockDecl],
+                   entry: FrozenSet[str],
+                   graph: Optional[cfg_mod.Cfg] = None) -> MethodLocks:
+    if graph is None:
+        graph = cfg_mod.build_cfg(fn)
+    held_in: Dict[int, Optional[FrozenSet[str]]] = {
+        n.nid: None for n in graph.nodes}
+    held_in[graph.entry.nid] = entry
+
+    def transfer(cn: cfg_mod.CfgNode,
+                 state: FrozenSet[str]) -> FrozenSet[str]:
+        if cn.kind == cfg_mod.WITH_ENTER:
+            return state | _with_locks(cn.node, locks)
+        if cn.kind == cfg_mod.WITH_EXIT:
+            return state - _with_locks(cn.with_node, locks)
+        out = state
+        for op, lk, must, _node in _acquire_release_in(cn, locks):
+            if op == "acquire" and must:
+                out = out | {lk}
+            elif op == "release":
+                out = out - {lk}
+        return out
+
+    worklist = [graph.entry.nid]
+    while worklist:
+        nid = worklist.pop()
+        state = held_in[nid]
+        if state is None:
+            continue
+        out = transfer(graph.nodes[nid], state)
+        for s in graph.nodes[nid].succs:
+            cur = held_in[s]
+            new = out if cur is None else (cur & out)
+            if cur is None or new != cur:
+                held_in[s] = frozenset(new)
+                worklist.append(s)
+
+    # acquire sites read the *fixpoint* in-states (a first-visit state
+    # is an over-approximation that would manufacture re-entry FPs);
+    # textual acquires at unreachable nodes still count for may-acquire
+    acquires: List[AcquireSite] = []
+    for cn in graph.nodes:
+        before = held_in.get(cn.nid)
+        if cn.kind == cfg_mod.WITH_ENTER:
+            for lk in sorted(_with_locks(cn.node, locks)):
+                acquires.append(AcquireSite(
+                    lock=lk, node=cn.node,
+                    held_before=before if before is not None
+                    else frozenset(), must=True))
+        elif cn.kind == cfg_mod.STMT:
+            for op, lk, must, node in _acquire_release_in(cn, locks):
+                if op == "acquire":
+                    acquires.append(AcquireSite(
+                        lock=lk, node=node,
+                        held_before=before if before is not None
+                        else frozenset(), must=must))
+    return MethodLocks(fn, graph, held_in, acquires)
+
+
+class ClassLockAnalysis:
+    """Everything the lock rules need to know about one class."""
+
+    def __init__(self, module: ModuleInfo, cls: ast.ClassDef) -> None:
+        self.module = module
+        self.cls = cls
+        self.locks = self._find_locks(cg.methods_of(cls))
+        self.graph: Optional[cg.ClassGraph] = None
+        self.methods: Dict[str, MethodLocks] = {}
+        # three views of each method's lock states over one shared CFG:
+        # - ``methods`` (FULL): convention + propagated context — what
+        #   suppression rules (TPU010/011) read; an assumption may
+        #   excuse a write;
+        # - ``proven``: call-site-propagated context only (plus the
+        #   *_locked convention when the class has exactly ONE lock,
+        #   where the suffix is unambiguous) — what propagation itself
+        #   reads, so an assumption never launders into proof;
+        # - ``local``: what the method body itself proves (plus the
+        #   single-lock convention) — what the deadlock verdict
+        #   (TPU012) reads; context-dependent deadlocks are reported
+        #   ONCE, at the outermost call site that establishes the
+        #   context, via the may-acquire closure
+        self.proven: Dict[str, MethodLocks] = {}
+        self.local: Dict[str, MethodLocks] = {}
+        self.attr_sites: Dict[str, List[AccessSite]] = {}
+        self.guards: Dict[str, str] = {}
+        self.may_acquire: Dict[str, Set[str]] = {}
+        if self.locks:
+            # the (costlier) call graph only exists for classes that
+            # actually own a lock — most classes skip the whole pass
+            self.graph = cg.class_graph(cls)
+            self._analyze()
+
+    # -- lock discovery ----------------------------------------------------
+
+    def _find_locks(self, methods) -> Dict[str, LockDecl]:
+        out: Dict[str, LockDecl] = {}
+        for fn in methods.values():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not isinstance(node.value, ast.Call):
+                    continue
+                kind = _LOCK_CTORS.get(_dotted(node.value.func) or "")
+                if kind is None:
+                    continue
+                for tgt in node.targets:
+                    attr = _self_attr(tgt)
+                    if attr is not None:
+                        out[attr] = LockDecl(attr, kind, node.lineno)
+        return out
+
+    # -- per-method analysis with bounded context propagation --------------
+
+    def _entry_for(self, name: str) -> FrozenSet[str]:
+        if name.endswith("_locked"):
+            # the caller-holds-the-lock naming convention
+            return frozenset(self.locks)
+        return frozenset()
+
+    def _analyze(self) -> None:
+        # convention-seeded entry locks are assumption-grade; in a
+        # multi-lock class the *_locked suffix cannot say WHICH lock
+        # the caller holds, so the proven twin drops them there
+        multi = len(self.locks) > 1
+        convention: Dict[str, FrozenSet[str]] = {
+            name: self._entry_for(name) for name in self.graph.methods}
+        ctxs: Dict[str, FrozenSet[str]] = {
+            name: frozenset() for name in self.graph.methods}
+        stale = set(self.graph.methods)
+        for _ in range(_PROPAGATION_ROUNDS):
+            for name in stale:
+                fn = self.graph.methods[name]
+                full = analyze_method(
+                    fn, self.locks, convention[name] | ctxs[name])
+                proven_entry = ctxs[name] if multi \
+                    else convention[name] | ctxs[name]
+                self.methods[name] = full
+                self.proven[name] = analyze_method(
+                    fn, self.locks, proven_entry, graph=full.cfg)
+            stale = set()
+            for name in self.graph.methods:
+                if not name.startswith("_") or name.startswith("__"):
+                    continue  # public/dunder: callable from anywhere
+                site_holds = [
+                    held for held in self._call_site_holds(name)
+                    if held is not None]
+                if not site_holds:
+                    continue
+                # only PROVEN holds propagate — an assumption must not
+                # launder into proof one call-hop down
+                ctx = ctxs[name] | frozenset.intersection(*site_holds)
+                if ctx != ctxs[name]:
+                    ctxs[name] = ctx
+                    stale.add(name)
+            if not stale:
+                break
+        for name, fn in self.graph.methods.items():
+            self.local[name] = analyze_method(
+                fn, self.locks,
+                frozenset() if multi else convention[name],
+                graph=self.methods[name].cfg)
+        self._collect_access_sites()
+        self._infer_guards()
+        per_method = {name: m.may_acquire
+                      for name, m in self.methods.items()}
+        # close over DIRECT call edges only: a call inside a nested
+        # def runs later (usually on another thread) and a Lock only
+        # deadlocks against its own thread
+        self.may_acquire = cg.transitive(self.graph.direct_calls,
+                                         per_method)
+
+    def _call_site_holds(self, callee: str,
+                         ) -> Iterator[Optional[FrozenSet[str]]]:
+        for name, sites in self.graph.call_sites.items():
+            if name not in self.proven:
+                continue
+            for call, target in sites:
+                if target == callee:
+                    yield self.held_at(name, call, mode="proven")
+
+    # -- locating facts ----------------------------------------------------
+
+    def enclosing_stmt(self, method: str,
+                       node: ast.AST) -> Optional[ast.AST]:
+        """Walk parents up to a CFG statement of ``method``; None when
+        the node sits inside a nested def (whose execution context is
+        unknown)."""
+        ml = self.methods.get(method)
+        if ml is None:
+            return None
+        cur: Optional[ast.AST] = node
+        while cur is not None and cur is not ml.fn:
+            if cur in ml.cfg.stmt_node:
+                if isinstance(cur, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)) \
+                        and cur is not node:
+                    return None  # inside a nested def's body
+                return cur
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return None  # crossed into a nested def
+            cur = self.module.parents.get(cur)
+        return None
+
+    def held_at(self, method: str, node: ast.AST,
+                mode: str = "full") -> Optional[FrozenSet[str]]:
+        stmt = self.enclosing_stmt(method, node)
+        if stmt is None:
+            return None
+        table = {"full": self.methods, "proven": self.proven,
+                 "local": self.local}[mode]
+        return table[method].held_for_stmt(stmt)
+
+    # -- access sites + guard inference ------------------------------------
+
+    def _classify_access(self, attr_node: ast.Attribute,
+                         ) -> Optional[bool]:
+        """None = not a data access (callback invocation / lock);
+        True = write, False = read."""
+        parent = self.module.parents.get(attr_node)
+        if isinstance(parent, ast.Call) and parent.func is attr_node:
+            return None  # the attr itself is being called
+        if isinstance(attr_node.ctx, (ast.Store, ast.Del)):
+            return True
+        if isinstance(parent, ast.Subscript) and parent.value is attr_node \
+                and isinstance(parent.ctx, (ast.Store, ast.Del)):
+            return True
+        if isinstance(parent, ast.Attribute) and parent.attr in _MUTATORS:
+            gp = self.module.parents.get(parent)
+            if isinstance(gp, ast.Call) and gp.func is parent:
+                return True
+        return False
+
+    def _collect_access_sites(self) -> None:
+        for name, fn in self.graph.methods.items():
+            if name == "__init__":
+                continue  # construction happens-before publication
+            for node in ast.walk(fn):
+                attr = _self_attr(node) if isinstance(node, ast.Attribute) \
+                    else None
+                if attr is None or attr in self.locks \
+                        or attr in self.graph.methods:
+                    continue
+                is_write = self._classify_access(node)
+                if is_write is None:
+                    continue
+                stmt = self.enclosing_stmt(name, node)
+                if stmt is None:
+                    continue  # nested def / unlocatable
+                held = self.methods[name].held_for_stmt(stmt)
+                if held is None:
+                    continue  # unreachable statement
+                self.attr_sites.setdefault(attr, []).append(AccessSite(
+                    attr=attr, method=name, node=node, stmt=stmt,
+                    is_write=is_write, held=held))
+
+    def _infer_guards(self) -> None:
+        for attr, sites in self.attr_sites.items():
+            total = len(sites)
+            if total == 0:
+                continue
+            best_lock, best_count = None, 0
+            for lock in self.locks:
+                count = sum(1 for s in sites if lock in s.held)
+                if count > best_count:
+                    best_lock, best_count = lock, count
+            if (best_lock is not None
+                    and best_count >= GUARD_MIN_LOCKED_SITES
+                    and best_count / total > GUARD_THRESHOLD):
+                self.guards[attr] = best_lock
+
+
+def lock_analysis(module: ModuleInfo) -> List[ClassLockAnalysis]:
+    """All per-class lock analyses for ``module``, computed once and
+    memoized on the ModuleInfo — TPU010/011/012 share one pass."""
+    cached = getattr(module, "_lock_analysis", None)
+    if cached is None:
+        cached = [ClassLockAnalysis(module, cls)
+                  for cls in cg.classes_in(module.tree)]
+        module._lock_analysis = cached
+    return cached

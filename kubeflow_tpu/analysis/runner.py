@@ -41,6 +41,39 @@ class LintReport:
     def baselined(self) -> int:
         return len(self.findings) - len(self.new)
 
+    def rule_counts(self) -> Dict[str, Tuple[int, int]]:
+        """rule -> (total findings, new findings), rules with any."""
+        out: Dict[str, List[int]] = {}
+        for f, _ in self.findings:
+            out.setdefault(f.rule, [0, 0])[0] += 1
+        for f in self.new:
+            out.setdefault(f.rule, [0, 0])[1] += 1
+        return {r: (t, n) for r, (t, n) in sorted(out.items())}
+
+    def rule_table(self) -> str:
+        """Per-rule finding-count summary (total/baselined/new)."""
+        rows = [("rule", "findings", "baselined", "new")]
+        for rule, (total, new) in self.rule_counts().items():
+            rows.append((rule, str(total), str(total - new), str(new)))
+        if len(rows) == 1:
+            return "tpulint: no findings by any rule"
+        widths = [max(len(r[i]) for r in rows) for i in range(4)]
+        return "\n".join(
+            "  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip()
+            for row in rows)
+
+    def diff_table(self) -> str:
+        """NEW-findings-vs-baseline table: one row per (rule, file),
+        so a CI regression names the rule and the file in the failure
+        output instead of just exiting nonzero."""
+        by: Dict[Tuple[str, str], int] = {}
+        for f in self.new:
+            by[(f.rule, f.path)] = by.get((f.rule, f.path), 0) + 1
+        lines = ["new findings vs baseline (rule, file, count):"]
+        for (rule, path), n in sorted(by.items()):
+            lines.append(f"  {rule}  {path}  +{n}")
+        return "\n".join(lines)
+
     def format(self, show_baselined: bool = False) -> str:
         lines: List[str] = []
         if show_baselined:

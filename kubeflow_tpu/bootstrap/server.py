@@ -45,6 +45,16 @@ PHASE_SUCCEEDED = "Succeeded"
 PHASE_FAILED = "Failed"
 
 
+class _SpawnPending:
+    """Placeholder in ``_procs`` while the worker process is spawned
+    outside the state lock: answers ``poll()`` as alive so the accept
+    gate and concurrent spawners treat the slot as taken while
+    ``Popen`` runs unlocked."""
+
+    def poll(self) -> None:
+        return None
+
+
 class DeployServer:
     """Holds deployment state; serves the kfctl REST surface.
 
@@ -224,28 +234,44 @@ class DeployServer:
         # reporting, this file is the diagnosis (DEVNULL would make the
         # exact failures the isolation exists for undiagnosable)
         wlog_path = os.path.join(self.app_root, name, "worker.log")
+        # reserve the slot under the lock, spawn OUTSIDE it (TPU011:
+        # fork/exec latency must not stall every status reader), then
+        # re-lock to publish the real process
         with self._state_lock:
             prior = self._procs.get(name)
             if prior is not None and prior.poll() is None:
                 log.warning("worker for %s still alive; not spawning "
                             "(raced past the accept gate?)", name)
                 return False
-            env = dict(os.environ)
+            pending = _SpawnPending()
+            self._procs[name] = pending
             # the fake-cluster state file (tests/local): the worker must
             # apply into the SAME cluster the server reads
             state_path = getattr(self.client, "path", None)
+        wlog = None
+        try:
+            env = dict(os.environ)
             if state_path:
                 env["KFTPU_FAKE_STATE"] = str(state_path)
             os.makedirs(os.path.dirname(wlog_path), exist_ok=True)
             wlog = open(wlog_path, "w")
-            try:
-                proc = subprocess.Popen(
-                    [sys.executable, "-m", "kubeflow_tpu.bootstrap.worker",
-                     self.app_root, name, flow],
-                    stdin=subprocess.PIPE, stdout=subprocess.DEVNULL,
-                    stderr=wlog, env=env, text=True)
-            finally:
-                wlog.close()  # the child holds its own descriptor
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "kubeflow_tpu.bootstrap.worker",
+                 self.app_root, name, flow],
+                stdin=subprocess.PIPE, stdout=subprocess.DEVNULL,
+                stderr=wlog, env=env, text=True)
+        except BaseException:
+            # ANY failure on the unlocked stretch (unwritable app_root,
+            # full disk, fork failure) must release the reservation, or
+            # the always-alive placeholder wedges the slot forever
+            with self._state_lock:
+                if self._procs.get(name) is pending:
+                    del self._procs[name]
+            if wlog is not None:
+                wlog.close()
+            raise
+        wlog.close()  # the child holds its own descriptor
+        with self._state_lock:
             self._procs[name] = proc
         try:
             proc.stdin.write(json.dumps(body or {}))

@@ -107,10 +107,15 @@ class DirCheckpointer(PreemptionCheckpointer):
     def _manager_for(self, directory: str) -> Any:
         with self._lock:
             mgr = self._managers.get(directory)
-            if mgr is None:
-                mgr = self._factory(directory)
-                self._managers[directory] = mgr
+        if mgr is not None:
             return mgr
+        # construct OUTSIDE the lock (TPU011: the factory stats/creates
+        # the checkpoint directory — orbax construction is I/O) and
+        # publish first-wins: a racing duplicate is a throwaway reader
+        # of the same directory, not an exclusive resource
+        mgr = self._factory(directory)
+        with self._lock:
+            return self._managers.setdefault(directory, mgr)
 
     def _latest(self, directory: str) -> Optional[int]:
         mgr = self._manager_for(directory)

@@ -5,11 +5,15 @@
 #   scripts/preflight.sh --ref HEAD~1   # blob check over a commit range
 #
 # Checks:
-#   1. tpulint (scripts/run_tpulint.py): AST rules TPU001-TPU009 over
-#      kubeflow_tpu/ — incl. the SPMD plane TPU006 version-gated-api,
-#      TPU007 mesh-axis-consistency, TPU008 partitionspec-legality,
-#      TPU009 unbound-collective — gated on tpulint_baseline.json
-#      (docs/ANALYSIS.md; --format sarif for CI PR annotations)
+#   1. tpulint (scripts/run_tpulint.py): rules TPU001-TPU013 over
+#      kubeflow_tpu/ — the AST rules, the SPMD shardlint plane
+#      (TPU006-TPU009), and the lock-discipline dataflow plane:
+#      TPU010 unguarded-shared-state, TPU011 blocking-under-lock,
+#      TPU012 re-entrant lock acquisition, TPU013 metric-contract —
+#      gated on tpulint_baseline.json (docs/ANALYSIS.md). Writes the
+#      SARIF artifact to traces/tpulint.sarif on every run; a failing
+#      run prints the per-rule new-vs-baseline diff table and the
+#      measured wall time (the <= +25%/4-rules budget is read here)
 #   2. binary-blob guard (scripts/check_binary_blobs.py): no large
 #      binaries staged for commit (PERF.md trace-artifact policy)
 #   3. obs smoke test (tests/test_obs.py): traceparent round-trip, span
@@ -62,7 +66,7 @@ cd "$(dirname "$0")/.."
 rc=0
 
 echo "== preflight: tpulint =="
-python scripts/run_tpulint.py || rc=1
+python scripts/run_tpulint.py --sarif-out traces/tpulint.sarif || rc=1
 
 echo "== preflight: binary blobs =="
 python scripts/check_binary_blobs.py "$@" || rc=1

@@ -5,16 +5,24 @@ Usage:
 
     python scripts/run_tpulint.py                       # lint kubeflow_tpu/
     python scripts/run_tpulint.py kubeflow_tpu/ops      # lint a subtree
-    python scripts/run_tpulint.py --rules TPU001,TPU003
+    python scripts/run_tpulint.py --rule TPU010,TPU012  # rule filter
+    python scripts/run_tpulint.py --changed-only        # git-diff scope
     python scripts/run_tpulint.py --baseline-update     # re-grandfather
     python scripts/run_tpulint.py --show-baselined      # full debt view
     python scripts/run_tpulint.py --format json         # machine output
     python scripts/run_tpulint.py --format sarif        # CI PR annotations
+    python scripts/run_tpulint.py --sarif-out traces/tpulint.sarif
 
 Pre-existing findings live in ``tpulint_baseline.json`` (committed);
 only findings beyond the baseline fail the run. After fixing debt, run
 ``--baseline-update`` so the baseline shrinks with the fix. The rule
 catalog and pragma syntax are documented in ``docs/ANALYSIS.md``.
+
+Every file parses ONCE per run — all checkers share the ModuleInfo
+(AST + indices + the memoized lock-set analysis), so wall time stays
+flat as rules accrue; the text output prints the measured wall time
+and a per-rule finding-count table, and a failing run prints a
+new-vs-baseline diff table naming the rule and file.
 """
 
 from __future__ import annotations
@@ -22,7 +30,9 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -77,14 +87,40 @@ def sarif_payload(report) -> dict:
     }
 
 
+def changed_python_files(root: str) -> list:
+    """Git-diff-derived lint scope: tracked files changed vs HEAD plus
+    untracked files, filtered to ``.py`` under the default lint paths
+    (the baseline only covers those — linting a never-linted tree from
+    a --changed-only run would manufacture 'new' findings)."""
+    seen = set()
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              cwd=root)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                proc.stderr.strip() or f"{' '.join(cmd)} failed")
+        seen.update(ln.strip() for ln in proc.stdout.splitlines()
+                    if ln.strip())
+    return sorted(
+        p for p in seen
+        if p.endswith(".py")
+        and any(p.startswith(d.rstrip("/") + "/")
+                for d in runner.DEFAULT_PATHS)
+        and os.path.exists(os.path.join(root, p)))
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("paths", nargs="*", default=None,
                     help="files/dirs to lint (default: kubeflow_tpu)")
-    ap.add_argument("--rules", default=None,
+    ap.add_argument("--rules", "--rule", dest="rules", default=None,
                     help="comma-separated rule ids (default: all)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="lint only git-changed .py files (vs HEAD, "
+                         "plus untracked) under the default lint paths")
     ap.add_argument("--baseline", default=None,
                     help="baseline path ('' disables; default: "
                          "tpulint_baseline.json at the repo root)")
@@ -95,19 +131,44 @@ def main(argv=None) -> int:
                     help="print grandfathered findings too")
     ap.add_argument("--format", choices=("text", "json", "sarif"),
                     default="text")
+    ap.add_argument("--sarif-out", default=None, metavar="PATH",
+                    help="additionally write the SARIF artifact to "
+                         "PATH regardless of --format (CI artifact)")
     args = ap.parse_args(argv)
 
     rules = ([r.strip().upper() for r in args.rules.split(",") if r.strip()]
              if args.rules else None)
-    if args.baseline_update and (args.paths or rules):
+    if args.baseline_update and (args.paths or rules or args.changed_only):
         # a scoped run sees only a subset of findings; rewriting the
         # baseline from it would silently drop every grandfathered
         # entry outside the scope and break the next full run
         print("error: --baseline-update requires a full, unfiltered run "
-              "(no paths, no --rules)", file=sys.stderr)
+              "(no paths, no --rules, no --changed-only)",
+              file=sys.stderr)
         return 2
-    report = runner.run_lint(paths=args.paths or None, rules=rules,
+    if args.changed_only and args.paths:
+        print("error: --changed-only and explicit paths are mutually "
+              "exclusive", file=sys.stderr)
+        return 2
+
+    paths = args.paths or None
+    if args.changed_only:
+        try:
+            # OSError covers git missing from PATH (FileNotFoundError)
+            paths = changed_python_files(runner.repo_root())
+        except (RuntimeError, OSError) as e:
+            print(f"error: --changed-only needs git: {e}",
+                  file=sys.stderr)
+            return 2
+        if not paths:
+            print("tpulint: no changed files under "
+                  f"{', '.join(runner.DEFAULT_PATHS)}; nothing to lint")
+            return 0
+
+    t0 = time.monotonic()
+    report = runner.run_lint(paths=paths, rules=rules,
                              baseline_path=args.baseline)
+    wall = time.monotonic() - t0
 
     if args.baseline_update:
         path = runner.update_baseline(report, baseline_path=args.baseline
@@ -116,6 +177,13 @@ def main(argv=None) -> int:
               f"{len(report.findings)} finding(s) → {path}")
         return 0
 
+    if args.sarif_out:
+        parent = os.path.dirname(os.path.abspath(args.sarif_out))
+        os.makedirs(parent, exist_ok=True)
+        with open(args.sarif_out, "w", encoding="utf-8") as f:
+            json.dump(sarif_payload(report), f, indent=1)
+            f.write("\n")
+
     if args.format == "sarif":
         print(json.dumps(sarif_payload(report), indent=1))
     elif args.format == "json":
@@ -123,6 +191,9 @@ def main(argv=None) -> int:
             "files": report.files,
             "suppressed": report.suppressed,
             "baselined": report.baselined,
+            "wall_s": round(wall, 3),
+            "rules": {r: {"findings": t, "new": n}
+                      for r, (t, n) in report.rule_counts().items()},
             "new": [
                 {"rule": f.rule, "severity": f.severity, "path": f.path,
                  "line": f.line, "message": f.message, "hint": f.hint}
@@ -130,6 +201,13 @@ def main(argv=None) -> int:
         }, indent=1))
     else:
         print(report.format(show_baselined=args.show_baselined))
+        print(report.rule_table())
+        print(f"tpulint: wall {wall:.2f}s (single shared parse per "
+              f"file across all checkers)")
+        if args.sarif_out:
+            print(f"tpulint: sarif artifact → {args.sarif_out}")
+        if report.new:
+            print(report.diff_table())
     return 1 if report.new else 0
 
 

@@ -1,0 +1,50 @@
+"""TPU010 near-miss corpus: the fixed twins of tpu010_pos.py.
+
+Same classes, same attributes, same traffic pattern — but every write
+holds the guard, and the bound check and the unit-take share one
+critical section (the PR 11 fix shape). TPU010 must stay silent here:
+the rule's value is zero if the fixed code still lights up.
+"""
+
+import threading
+
+
+class Panel:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._served = 0
+
+    def serve(self):
+        with self._lock:
+            self._served += 1
+
+    def snapshot(self):
+        with self._lock:
+            return self._served
+
+    def record_background(self):
+        with self._lock:
+            self._served += 1
+
+
+class Router:
+    def __init__(self, bound):
+        self._lock = threading.Lock()
+        self._inflight = {}
+        self._bound = bound
+
+    def finish(self, replica):
+        with self._lock:
+            self._inflight[replica] -= 1
+
+    def load(self, replica):
+        with self._lock:
+            return self._inflight.get(replica, 0)
+
+    def pick(self, replica):
+        # the fix: check and take under the SAME lock acquisition
+        with self._lock:
+            if self._inflight.get(replica, 0) >= self._bound:
+                return False
+            self._inflight[replica] = self._inflight.get(replica, 0) + 1
+            return True

@@ -1,0 +1,55 @@
+"""TPU010 true-positive corpus: the two historical unguarded-write bugs.
+
+Parsed (never imported) by tests/test_locklint.py. ``Panel`` re-creates
+the PR 11 ThreadingHTTPServer counter race: the dashboard handler
+bumped per-class counters from concurrent request threads while every
+other access site held the panel lock. ``Router`` re-creates the PR 11
+read-then-act bound overshoot: the spill bound was *evaluated* under
+the lock but the in-flight unit was *taken* after releasing it, so M
+concurrent picks of a hot key overshot the bound by M.
+"""
+
+import threading
+
+
+class Panel:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._served = 0
+
+    def serve(self):
+        with self._lock:
+            self._served += 1
+
+    def snapshot(self):
+        with self._lock:
+            return self._served
+
+    def record_background(self):
+        # BUG: concurrent handler threads race this bare increment
+        self._served += 1
+
+
+class Router:
+    def __init__(self, bound):
+        self._lock = threading.Lock()
+        self._inflight = {}
+        self._bound = bound
+
+    def finish(self, replica):
+        with self._lock:
+            self._inflight[replica] -= 1
+
+    def load(self, replica):
+        with self._lock:
+            return self._inflight.get(replica, 0)
+
+    def pick(self, replica):
+        with self._lock:
+            ok = self._inflight.get(replica, 0) < self._bound
+        if not ok:
+            return False
+        # BUG: the bound was checked under the lock, the unit is taken
+        # outside it — M concurrent picks overshoot the bound by M
+        self._inflight[replica] = self._inflight.get(replica, 0) + 1
+        return True

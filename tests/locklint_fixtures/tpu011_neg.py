@@ -1,0 +1,49 @@
+"""TPU011 near-miss corpus: the fixed twins of tpu011_pos.py.
+
+The snapshot-under-the-lock / release / do-the-slow-thing / re-lock-
+to-publish shape (the PR 11 poller and wire_fleet fixes), plus an
+injectable *clock* called under the lock — the TPU003 idiom TPU011
+must not collide with (a clock read is cheap; pricing it as blocking
+would put a pragma on half the platform).
+"""
+
+import threading
+import time
+from urllib.request import urlopen
+
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pressure = {}
+
+    def poll(self, replica, url):
+        # the fix: fetch OUTSIDE the lock, re-lock only to publish
+        body = urlopen(url).read()
+        with self._lock:
+            self._pressure[replica] = len(body)
+
+
+class Scaler:
+    def __init__(self, url_for):
+        self._url_for = url_for
+        self._lock = threading.Lock()
+        self._targets = {}
+
+    def adopt(self, name):
+        # foreign code runs unguarded; only the publish takes the lock
+        url = self._url_for(name)
+        with self._lock:
+            self._targets[name] = url
+
+
+class Windower:
+    def __init__(self, clock=None):
+        self.clock = clock if clock is not None else time.monotonic
+        self._lock = threading.Lock()
+        self._events = []
+
+    def observe(self, value):
+        with self._lock:
+            # clock call under the lock: cheap, idiomatic, not flagged
+            self._events.append((self.clock(), value))

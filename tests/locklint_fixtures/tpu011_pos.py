@@ -1,0 +1,52 @@
+"""TPU011 true-positive corpus: blocking work under a held lock.
+
+``Poller`` re-creates the PR 11 serial-poller-staleness bug: each
+replica's /metrics fetch ran under the poller lock, so one dead
+replica's timeout staled every healthy pressure reading. ``Scaler``
+re-creates the raising-``url_for``-under-guard bug: a caller-supplied
+callback invoked inside the critical section aborted every remaining
+model's scaling tick when it raised. ``Retrier`` sleeps under the
+lock — the injectable-Sleep form of the same latency inheritance.
+"""
+
+import threading
+import time
+from urllib.request import urlopen
+
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pressure = {}
+
+    def poll(self, replica, url):
+        with self._lock:
+            # BUG: one dead replica's timeout stalls every reader
+            body = urlopen(url).read()
+            self._pressure[replica] = len(body)
+
+
+class Scaler:
+    def __init__(self, url_for):
+        self._url_for = url_for
+        self._lock = threading.Lock()
+        self._targets = {}
+
+    def adopt(self, name):
+        with self._lock:
+            # BUG: foreign code under the guard — a raising url_for
+            # wedges the tick with the lock held
+            self._targets[name] = self._url_for(name)
+
+
+class Retrier:
+    def __init__(self, sleep=None):
+        self._sleep = sleep if sleep is not None else time.sleep
+        self._lock = threading.Lock()
+        self._attempts = 0
+
+    def retry(self):
+        with self._lock:
+            self._attempts += 1
+            # BUG: every other thread inherits the backoff
+            self._sleep(2 ** self._attempts)

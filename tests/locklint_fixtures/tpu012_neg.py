@@ -1,0 +1,47 @@
+"""TPU012 near-miss corpus: the two legitimate shapes next door.
+
+``RlockPager`` is byte-identical traffic over an ``RLock`` — re-entry
+is the contract, not a deadlock. ``SplitPager`` is the PR 11 fix
+shape: the guarded caller uses a ``*_locked`` helper that *assumes*
+the lock (the naming convention the analysis honors) and the re-fault
+happens outside the critical section.
+"""
+
+import threading
+
+
+class RlockPager:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._resident = {}
+
+    def get(self, name):
+        with self._lock:
+            return self._resident.get(name)
+
+    def lease(self, name):
+        with self._lock:
+            return self.get(name)
+
+
+class SplitPager:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._resident = {}
+        self._leases = {}
+
+    def _get_locked(self, name):
+        return self._resident.get(name)
+
+    def get(self, name):
+        with self._lock:
+            return self._get_locked(name)
+
+    def lease(self, name):
+        with self._lock:
+            model = self._get_locked(name)
+            self._leases[name] = self._leases.get(name, 0) + 1
+        if model is None:
+            # the eviction-race retry re-faults OUTSIDE the lock
+            model = self.get(name)
+        return model

@@ -1,0 +1,40 @@
+"""TPU012 true-positive corpus: the PR 11 recursing-``lease()`` deadlock.
+
+``Pager.lease()`` holds the non-reentrant pager lock and calls
+``self.get()``, which opens with ``with self._lock:`` — the thread
+blocks on itself and the whole weight pager wedges (repro-tested in
+tests/test_serving.py before the fix). ``Nested`` is the direct form:
+one method re-entering its own ``with``.
+"""
+
+import threading
+
+
+class Pager:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._resident = {}
+        self._leases = {}
+
+    def get(self, name):
+        with self._lock:
+            return self._resident.get(name)
+
+    def lease(self, name):
+        with self._lock:
+            # BUG: get() re-acquires self._lock — deadlock
+            model = self.get(name)
+            self._leases[name] = self._leases.get(name, 0) + 1
+            return model
+
+
+class Nested:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = 0
+
+    def poke(self):
+        with self._lock:
+            # BUG: direct re-acquisition of a plain threading.Lock
+            with self._lock:
+                self._state += 1

@@ -46,10 +46,8 @@ def check(checker, *modules):
 
 # -- registry / framework ---------------------------------------------------
 
-def test_registry_has_all_nine_rules():
-    assert set(all_checkers()) == {
-        "TPU001", "TPU002", "TPU003", "TPU004", "TPU005",
-        "TPU006", "TPU007", "TPU008", "TPU009"}
+def test_registry_has_all_thirteen_rules():
+    assert set(all_checkers()) == {f"TPU{i:03d}" for i in range(1, 14)}
 
 
 def test_create_checkers_rejects_unknown_rule():

@@ -1,0 +1,896 @@
+"""Lock-discipline dataflow lints (TPU010–TPU012), the metric-contract
+lint (TPU013), and the CFG/lock-set core they ride on.
+
+The fixture corpus in tests/locklint_fixtures/ re-creates the three
+historical review-found bugs (recursing ``lease()``, read-then-act
+bound overshoot, blocking fetch under lock) as minimal true positives,
+each paired with its fixed near-miss twin that must stay silent — the
+rules are worthless if the *fixed* code still lights up. A
+parametrized property test then proves every registered rule is line-
+pragma-suppressible, file-pragma-suppressible, and baseline-countable.
+"""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from kubeflow_tpu.analysis import baseline as baseline_mod
+from kubeflow_tpu.analysis import cfg as cfg_mod
+from kubeflow_tpu.analysis import callgraph as cg
+from kubeflow_tpu.analysis import locksets, runner
+from kubeflow_tpu.analysis.registry import all_checkers
+from kubeflow_tpu.analysis.runner import lint_modules
+from kubeflow_tpu.analysis.walker import ModuleInfo
+
+REPO = runner.repo_root()
+FIXTURES = os.path.join(REPO, "tests", "locklint_fixtures")
+
+
+def mod(src, rel="kubeflow_tpu/fixture.py"):
+    return ModuleInfo.from_source(rel, textwrap.dedent(src))
+
+
+def fixture(name):
+    m = ModuleInfo.from_file(os.path.join(FIXTURES, name + ".py"), REPO)
+    assert m is not None, name
+    return m
+
+
+def findings(module_or_list, rules):
+    mods = module_or_list if isinstance(module_or_list, list) \
+        else [module_or_list]
+    out, _ = lint_modules(mods, rules=rules)
+    return [f for f, _ in out]
+
+
+# -- CFG core ----------------------------------------------------------------
+
+
+def _cfg_for(src):
+    tree = ast.parse(textwrap.dedent(src).lstrip("\n"))
+    fn = tree.body[0]
+    return cfg_mod.build_cfg(fn)
+
+
+def test_cfg_linear_chain():
+    g = _cfg_for("""
+        def f():
+            a = 1
+            b = 2
+            return a + b
+    """)
+    stmts = [n for n in g.nodes if n.kind == cfg_mod.STMT]
+    assert len(stmts) == 3
+    # entry -> a -> b -> return -> exit
+    assert g.nodes[g.entry.nid].succs == [stmts[0].nid]
+    assert stmts[0].succs == [stmts[1].nid]
+    assert g.exit.nid in stmts[2].succs
+
+
+def test_cfg_if_forks_and_rejoins():
+    g = _cfg_for("""
+        def f(x):
+            if x:
+                a = 1
+            b = 2
+    """)
+    by_line = {n.node.lineno: n for n in g.nodes if n.node is not None}
+    head, a, b = by_line[2], by_line[3], by_line[4]
+    assert set(head.succs) == {a.nid, b.nid}   # then-branch and fall-through
+    assert b.nid in a.succs
+
+
+def test_cfg_while_has_back_edge_and_exit():
+    g = _cfg_for("""
+        def f(x):
+            while x:
+                x -= 1
+            return x
+    """)
+    by_line = {n.node.lineno: n for n in g.nodes if n.node is not None}
+    head, body, ret = by_line[2], by_line[3], by_line[4]
+    assert head.nid in body.succs           # back edge
+    assert ret.nid in head.succs            # loop exit
+
+
+def test_cfg_with_release_node_covers_every_path_out():
+    g = _cfg_for("""
+        def f(self):
+            with self._lock:
+                if bad():
+                    raise RuntimeError()
+                x = 1
+            return x
+    """)
+    exits = [n for n in g.nodes if n.kind == cfg_mod.WITH_EXIT]
+    assert len(exits) == 1
+
+
+def test_cfg_try_handler_reachable_from_body():
+    g = _cfg_for("""
+        def f():
+            try:
+                risky()
+            except Exception:
+                cleanup()
+            done()
+    """)
+    by_line = {n.node.lineno: n for n in g.nodes if n.node is not None}
+    risky, handler, done = by_line[3], by_line[5], by_line[6]
+    assert handler.nid in risky.succs
+    assert done.nid in risky.succs or done.nid in handler.succs
+
+
+# -- callgraph core ----------------------------------------------------------
+
+
+CLS_SRC = """
+    class C:
+        def __init__(self, loader, clock=None):
+            self._loader = loader
+            self.clock = clock if clock is not None else time.monotonic
+        def a(self):
+            return self.b() + self._other()
+        def b(self):
+            return 1
+        def _other(self):
+            return self.b()
+"""
+
+
+def test_class_graph_resolves_self_calls():
+    cls = ast.parse(textwrap.dedent(CLS_SRC)).body[0]
+    g = cg.class_graph(cls)
+    assert set(g.methods) == {"__init__", "a", "b", "_other"}
+    assert g.calls["a"] == {"b", "_other"}
+    assert g.calls["_other"] == {"b"}
+
+
+def test_injected_callables_bare_param_only_and_clock_exempt():
+    cls = ast.parse(textwrap.dedent(CLS_SRC)).body[0]
+    g = cg.class_graph(cls)
+    # _loader: bare-Name ctor assignment -> injected; clock: the
+    # conditional-default idiom (and the name) keeps it out
+    assert g.injected_callables == {"_loader": "loader"}
+
+
+def test_transitive_closure():
+    closed = cg.transitive(
+        {"a": {"b"}, "b": {"c"}, "c": set()},
+        {"a": set(), "b": set(), "c": {"L"}})
+    assert closed["a"] == {"L"} and closed["b"] == {"L"}
+
+
+# -- lockset core ------------------------------------------------------------
+
+
+def _cla(src, which=0):
+    m = mod(src)
+    return locksets.lock_analysis(m)[which]
+
+
+def test_locksets_with_acquire_release_and_branch_intersection():
+    cla = _cla("""
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._x = 0
+            def f(self, cond):
+                if cond:
+                    self._lock.acquire()
+                self._x = 1      # held on ONE path only: not must-held
+                if cond:
+                    self._lock.release()
+            def g(self):
+                with self._lock:
+                    self._x = 2
+                self._x = 3      # after the with: released
+    """)
+    fn = cla.graph.methods["f"]
+    writes = [n for n in ast.walk(fn) if isinstance(n, ast.Assign)]
+    assert cla.held_at("f", writes[0]) == frozenset()
+    g = cla.graph.methods["g"]
+    w_in, w_after = sorted(
+        (n for n in ast.walk(g) if isinstance(n, ast.Assign)),
+        key=lambda n: n.lineno)
+    assert cla.held_at("g", w_in) == frozenset({"_lock"})
+    assert cla.held_at("g", w_after) == frozenset()
+
+
+def test_locked_suffix_convention_and_private_propagation():
+    cla = _cla("""
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._d = {}
+            def _evict_locked(self):
+                self._d.clear()
+            def _helper(self):
+                self._d["k"] = 1
+            def run(self):
+                with self._lock:
+                    self._helper()
+    """)
+    # *_locked: entry state assumes the guard
+    clear = next(n for n in ast.walk(cla.graph.methods["_evict_locked"])
+                 if isinstance(n, ast.Call))
+    assert cla.held_at("_evict_locked", clear) == frozenset({"_lock"})
+    # _helper: every call site holds the lock -> context propagated
+    store = next(n for n in ast.walk(cla.graph.methods["_helper"])
+                 if isinstance(n, ast.Assign))
+    assert cla.held_at("_helper", store) == frozenset({"_lock"})
+
+
+def test_guard_inference_majority_and_min_sites():
+    cla = _cla("""
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._hot = 0
+                self._solo = 0
+            def a(self):
+                with self._lock:
+                    self._hot += 1
+            def b(self):
+                with self._lock:
+                    return self._hot
+            def c(self):
+                return self._hot
+            def d(self):
+                self._solo = 1   # one site total: below min-sites
+    """)
+    assert cla.guards.get("_hot") == "_lock"
+    assert "_solo" not in cla.guards
+
+
+def test_nested_def_accesses_do_not_poison_guard_stats():
+    cla = _cla("""
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = []
+            def put(self, x):
+                with self._lock:
+                    self._q.append(x)
+            def drain(self):
+                with self._lock:
+                    items = list(self._q)
+                def emit():
+                    self._q.clear()   # runs later, context unknown
+                return emit
+    """)
+    sites = cla.attr_sites["_q"]
+    assert all(s.held == frozenset({"_lock"}) for s in sites)
+
+
+def test_lock_analysis_memoized_per_module():
+    m = fixture("tpu012_pos")
+    assert locksets.lock_analysis(m) is locksets.lock_analysis(m)
+
+
+# -- TPU010 unguarded shared state -------------------------------------------
+
+
+def test_tpu010_flags_counter_race_and_bound_overshoot():
+    f = findings(fixture("tpu010_pos"), ["TPU010"])
+    assert [x.rule for x in f] == ["TPU010", "TPU010"]
+    msgs = " | ".join(x.message for x in f)
+    assert "Panel.record_background" in msgs
+    assert "Router.pick" in msgs and "_inflight" in msgs
+
+
+def test_tpu010_near_miss_twin_stays_silent():
+    assert findings(fixture("tpu010_neg"), ["TPU010"]) == []
+
+
+def test_tpu010_write_under_a_different_lock_not_flagged():
+    m = mod("""
+        import threading
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self._x = 0
+            def f(self):
+                with self._a:
+                    self._x += 1
+            def g(self):
+                with self._a:
+                    return self._x
+            def h(self):
+                with self._b:       # lock splitting is a design
+                    self._x += 1
+    """)
+    assert findings(m, ["TPU010"]) == []
+
+
+def test_tpu010_init_writes_never_count():
+    m = mod("""
+        import threading
+        class C:
+            def __init__(self, n):
+                self._lock = threading.Lock()
+                self._x = n          # pre-publication: fine
+            def bump(self):
+                with self._lock:
+                    self._x += 1
+            def read(self):
+                with self._lock:
+                    return self._x
+    """)
+    assert findings(m, ["TPU010"]) == []
+
+
+# -- TPU011 blocking under lock ----------------------------------------------
+
+
+def test_tpu011_flags_fetch_callback_and_sleep_under_lock():
+    f = findings(fixture("tpu011_pos"), ["TPU011"])
+    kinds = sorted(x.message.split(" `")[0] for x in f)
+    assert kinds == ["caller-supplied callback", "network fetch", "sleep"]
+
+
+def test_tpu011_near_miss_twin_stays_silent():
+    assert findings(fixture("tpu011_neg"), ["TPU011"]) == []
+
+
+def test_tpu011_subprocess_and_method_param_callback():
+    m = mod("""
+        import subprocess
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def run(self, on_done):
+                with self._lock:
+                    subprocess.run(["true"])
+                    on_done()
+    """)
+    f = findings(m, ["TPU011"])
+    assert sorted(x.message.split(" `")[0] for x in f) == [
+        "caller-supplied callback", "subprocess"]
+
+
+def test_tpu011_blocking_outside_lock_ok():
+    m = mod("""
+        import time
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._x = 0
+            def f(self):
+                time.sleep(1)
+                with self._lock:
+                    self._x += 1
+    """)
+    assert findings(m, ["TPU011"]) == []
+
+
+# -- TPU012 re-entrant acquisition -------------------------------------------
+
+
+def test_tpu012_flags_recursing_lease_with_chain():
+    f = findings(fixture("tpu012_pos"), ["TPU012"])
+    assert len(f) == 2
+    lease = next(x for x in f if "lease" in x.message)
+    assert "get()" in lease.message
+    direct = next(x for x in f if "Nested.poke" in x.message)
+    assert "already holding" in direct.message
+
+
+def test_tpu012_rlock_and_locked_split_stay_silent():
+    assert findings(fixture("tpu012_neg"), ["TPU012"]) == []
+
+
+def test_tpu012_transitive_chain_through_two_hops():
+    m = mod("""
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def outer(self):
+                with self._lock:
+                    self.mid()
+            def mid(self):
+                self.inner()
+            def inner(self):
+                with self._lock:
+                    pass
+    """)
+    f = findings(m, ["TPU012"])
+    assert len(f) == 1
+    assert "mid() -> inner()" in f[0].message
+
+
+def test_tpu012_locked_suffix_taking_other_lock_in_multilock_class():
+    # PR 14 review: in a TWO-lock class the *_locked suffix is
+    # ambiguous about which lock the caller holds — a helper
+    # legitimately taking the OTHER lock must not read as a deadlock
+    m = mod("""
+        import threading
+        class TwoLocks:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self._n = 0
+            def bump(self):
+                with self._a:
+                    self._flush_a_locked()
+            def _flush_a_locked(self):
+                with self._b:
+                    self._n += 1
+    """)
+    assert findings(m, ["TPU012"]) == []
+
+
+def test_tpu012_locked_suffix_reacquire_in_single_lock_class_flagged():
+    # ...but with exactly ONE lock the convention is unambiguous: a
+    # *_locked method re-taking that lock deadlocks its guarded caller
+    m = mod("""
+        import threading
+        class OneLock:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+            def _flush_locked(self):
+                with self._lock:
+                    self._n += 1
+    """)
+    f = findings(m, ["TPU012"])
+    assert len(f) == 1 and "already holding" in f[0].message
+
+
+def test_tpu012_proven_nested_acquire_inside_locked_method_flagged():
+    # PR 14 review, round 2: an assumption must never MASK a deadlock
+    # the method itself proves — nested `with self._b:` inside a
+    # *_locked method of a two-lock class is a guaranteed deadlock
+    m = mod("""
+        import threading
+        class TwoLocks:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self._n = 0
+            def _flush_locked(self):
+                with self._b:
+                    with self._b:
+                        self._n += 1
+    """)
+    f = findings(m, ["TPU012"])
+    assert len(f) == 1 and "self._b" in f[0].message
+
+
+def test_tpu012_assumption_not_laundered_one_hop_down():
+    # PR 14 review, round 2: call-site propagation must carry only
+    # PROVEN holds — a helper below a *_locked method legitimately
+    # taking the other lock is not re-entry
+    m = mod("""
+        import threading
+        class TwoLocks:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self._n = 0
+            def flush(self):
+                with self._a:
+                    self._flush_a_locked()
+            def _flush_a_locked(self):
+                self._take_b()
+            def _take_b(self):
+                with self._b:
+                    self._n += 1
+    """)
+    assert findings(m, ["TPU012"]) == []
+
+
+def test_tpu012_deferred_closure_call_is_not_same_thread_deadlock():
+    # PR 14 review, round 3: a self-call inside a nested def runs
+    # later, usually on another thread — a threading.Lock deadlocks
+    # only against its own thread, so the closure edge must not feed
+    # the reachability closure
+    m = mod("""
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._jobs = []
+            def foo(self):
+                with self._lock:
+                    self._spawn()
+            def _spawn(self):
+                def worker():
+                    self._baz()
+                self._jobs.append(threading.Thread(target=worker))
+            def _baz(self):
+                with self._lock:
+                    return len(self._jobs)
+    """)
+    assert findings(m, ["TPU012"]) == []
+
+
+def test_tpu012_private_helper_deadlock_reported_exactly_once():
+    # PR 14 review, round 3: one defect, one finding — at the call
+    # site that establishes the context, not again inside the callee
+    # off propagated entry state
+    m = mod("""
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+            def foo(self):
+                with self._lock:
+                    self._bar()
+            def _bar(self):
+                with self._lock:
+                    self._n += 1
+    """)
+    f = findings(m, ["TPU012"])
+    assert len(f) == 1
+    assert "foo" in f[0].message and "_bar" in f[0].message
+
+
+def test_tpu012_call_after_release_ok():
+    m = mod("""
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._x = 0
+            def get(self):
+                with self._lock:
+                    return self._x
+            def lease(self):
+                with self._lock:
+                    self._x += 1
+                return self.get()   # outside the critical section
+    """)
+    assert findings(m, ["TPU012"]) == []
+
+
+# -- TPU013 metric contract --------------------------------------------------
+
+
+def test_tpu013_help_drift_across_modules():
+    a = mod("""
+        from kubeflow_tpu.utils import DEFAULT_REGISTRY
+        _c = DEFAULT_REGISTRY.counter("kftpu_x_total", "things done")
+        _d = DEFAULT_REGISTRY.counter("kftpu_x_total", "things done")
+    """, rel="kubeflow_tpu/a.py")
+    b = mod("""
+        from kubeflow_tpu.utils import DEFAULT_REGISTRY
+        _c2 = DEFAULT_REGISTRY.counter("kftpu_x_total", "other help")
+    """, rel="kubeflow_tpu/b.py")
+    f = findings([a, b], ["TPU013"])
+    assert len(f) == 1
+    assert f[0].path == "kubeflow_tpu/b.py"
+    assert "other help" in f[0].message
+
+
+def test_tpu013_label_key_set_split():
+    a = mod("""
+        from kubeflow_tpu.utils import DEFAULT_REGISTRY
+        _g = DEFAULT_REGISTRY.gauge("kftpu_slots", "engine slots")
+        def one(m):
+            _g.set(1.0, model=m)
+        def two(m):
+            _g.set(2.0, model=m)
+        def three():
+            _g.set(0.0)          # the model="" series split
+    """, rel="kubeflow_tpu/a.py")
+    f = findings(a, ["TPU013"])
+    assert len(f) == 1 and "{model}" in f[0].message
+    assert f[0].line == 9
+
+
+def test_tpu013_consistent_sites_and_dict_splat_ok():
+    a = mod("""
+        from kubeflow_tpu.utils import DEFAULT_REGISTRY
+        _c = DEFAULT_REGISTRY.counter("kftpu_y_total", "ys")
+        def one(cls):
+            _c.inc(**{"class": cls})
+        def two(cls):
+            _c.inc(**{"class": cls})
+        def also(cls):
+            _c.inc(1.0, **{"class": cls})
+    """, rel="kubeflow_tpu/a.py")
+    assert findings(a, ["TPU013"]) == []
+
+
+def test_tpu013_unknowable_splat_stays_silent():
+    a = mod("""
+        from kubeflow_tpu.utils import DEFAULT_REGISTRY
+        _c = DEFAULT_REGISTRY.counter("kftpu_z_total", "zs")
+        def one(labels):
+            _c.inc(**labels)     # unknowable: prove-it-or-silence
+        def two(j):
+            _c.inc(job=j)
+    """, rel="kubeflow_tpu/a.py")
+    assert findings(a, ["TPU013"]) == []
+
+
+def test_tpu013_non_kftpu_metrics_ignored():
+    a = mod("""
+        from kubeflow_tpu.utils import DEFAULT_REGISTRY
+        _c = DEFAULT_REGISTRY.counter("request_latency_seconds", "a")
+        _d = DEFAULT_REGISTRY.counter("request_latency_seconds", "b")
+    """, rel="kubeflow_tpu/a.py")
+    assert findings(a, ["TPU013"]) == []
+
+
+# -- every-rule property: pragma- and baseline-suppressible ------------------
+
+# one canonical trigger per rule; the finding lands in the LAST module
+RULE_FIXTURES = {
+    "TPU001": [("kubeflow_tpu/ops/fx.py", """
+        import jax.experimental.pallas as pl
+        def f():
+            return pl.pallas_call(k, in_specs=[pl.BlockSpec((256, 64), lambda i: (i, 0))])
+    """)],
+    "TPU002": [("kubeflow_tpu/ops/fx.py", """
+        import jax, time
+        @jax.jit
+        def step(x):
+            return x + time.time()
+    """)],
+    "TPU003": [("kubeflow_tpu/fx.py", """
+        import time
+        def f():
+            time.sleep(1)
+    """)],
+    "TPU004": [("kubeflow_tpu/manifests/components/thing.py", """
+        DEFAULTS = {"name": "thing-svc", "port": 8080}
+        @register("thing", DEFAULTS, "d")
+        def render(config, params):
+            return [o.service_account("t", "ns")]
+    """), ("kubeflow_tpu/config/presets.py", """
+        URL = "http://thing-svc:9999"
+    """)],
+    "TPU005": [("kubeflow_tpu/fx.py", """
+        import time
+        def pump():
+            while True:
+                time.sleep(2)
+    """)],
+    "TPU006": [("kubeflow_tpu/fx.py", """
+        import jax
+        def wrap(core, mesh, spec):
+            return jax.shard_map(core, mesh=mesh, in_specs=(spec,), out_specs=spec)
+    """)],
+    "TPU007": [("kubeflow_tpu/parallel/mesh.py", """
+        MESH_AXES = ("dcn", "dp", "pp", "tp")
+    """), ("kubeflow_tpu/ops/fx.py", """
+        import jax
+        def f(x):
+            return jax.lax.psum(x, "tpp")
+    """)],
+    "TPU008": [("kubeflow_tpu/fx.py", """
+        from jax.sharding import PartitionSpec as P
+        spec = P("tp", "tp")
+    """)],
+    "TPU009": [("kubeflow_tpu/fx.py", """
+        import jax
+        def helper(x):
+            return jax.lax.psum(x, "dp")
+    """)],
+    "TPU010": [("kubeflow_tpu/fx.py", """
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+            def a(self):
+                with self._lock:
+                    self._n += 1
+            def b(self):
+                with self._lock:
+                    return self._n
+            def c(self):
+                self._n += 1
+    """)],
+    "TPU011": [("kubeflow_tpu/fx.py", """
+        import threading
+        from urllib.request import urlopen
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def f(self, url):
+                with self._lock:
+                    return urlopen(url).read()
+    """)],
+    "TPU012": [("kubeflow_tpu/fx.py", """
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def get(self):
+                with self._lock:
+                    return 1
+            def lease(self):
+                with self._lock:
+                    return self.get()
+    """)],
+    "TPU013": [("kubeflow_tpu/fxa.py", """
+        from kubeflow_tpu.utils import DEFAULT_REGISTRY
+        _c = DEFAULT_REGISTRY.counter("kftpu_p_total", "canonical")
+        _d = DEFAULT_REGISTRY.counter("kftpu_p_total", "canonical")
+    """), ("kubeflow_tpu/fxb.py", """
+        from kubeflow_tpu.utils import DEFAULT_REGISTRY
+        _e = DEFAULT_REGISTRY.counter("kftpu_p_total", "drifted")
+    """)],
+}
+
+
+def _rule_modules(rule):
+    return [mod(src, rel=rel) for rel, src in RULE_FIXTURES[rule]]
+
+
+def test_every_registered_rule_has_a_property_fixture():
+    # a new rule must add its canonical trigger here, or the pragma /
+    # baseline property tests below silently skip it
+    assert set(all_checkers()) == set(RULE_FIXTURES)
+
+
+@pytest.mark.parametrize("rule", sorted(RULE_FIXTURES))
+def test_rule_fires_on_its_fixture(rule):
+    f = findings(_rule_modules(rule), [rule])
+    assert f and all(x.rule == rule for x in f), rule
+
+
+@pytest.mark.parametrize("rule", sorted(RULE_FIXTURES))
+def test_rule_is_line_pragma_suppressible(rule):
+    mods = _rule_modules(rule)
+    f = findings(mods, [rule])[0]
+    target = next(m for m in mods if m.rel == f.path)
+    lines = target.source.splitlines()
+    lines[f.line - 1] += f"  # tpulint: disable={rule}"
+    patched = [ModuleInfo.from_source(m.rel, "\n".join(lines))
+               if m.rel == f.path else m for m in mods]
+    got, suppressed = lint_modules(patched, rules=[rule])
+    assert len(got) < len(findings(mods, [rule]))
+    assert suppressed >= 1, rule
+
+
+@pytest.mark.parametrize("rule", sorted(RULE_FIXTURES))
+def test_rule_is_file_pragma_suppressible(rule):
+    mods = _rule_modules(rule)
+    f = findings(mods, [rule])[0]
+    patched = [ModuleInfo.from_source(
+        m.rel, f"# tpulint: disable-file={rule}\n" + m.source)
+        if m.rel == f.path else m for m in mods]
+    got = [x for x, _ in lint_modules(patched, rules=[rule])[0]
+           if x.path == f.path]
+    assert got == [], rule
+
+
+@pytest.mark.parametrize("rule", sorted(RULE_FIXTURES))
+def test_rule_is_baseline_countable(rule, tmp_path):
+    mods = _rule_modules(rule)
+    pairs, _ = lint_modules(mods, rules=[rule])
+    assert pairs
+    path = str(tmp_path / "base.json")
+    baseline_mod.save(path, pairs)
+    assert baseline_mod.new_findings(pairs, baseline_mod.load(path)) == []
+
+
+# -- baseline determinism ----------------------------------------------------
+
+
+def test_baseline_order_is_path_rule_fingerprint(tmp_path):
+    mods = [
+        mod("import time\nb = time.sleep(2)\n", rel="kubeflow_tpu/b.py"),
+        mod("import time\na = time.sleep(1)\nz = time.time()\n",
+            rel="kubeflow_tpu/a.py"),
+    ]
+    pairs, _ = lint_modules(mods, rules=["TPU003"])
+    path = str(tmp_path / "base.json")
+    baseline_mod.save(path, pairs)
+    data = json.loads(open(path).read())["findings"]
+    metas = [(m["path"], m["rule"]) for m in data.values()]
+    assert metas == sorted(metas)
+    # identical content saved from shuffled input -> identical bytes
+    baseline_mod.save(str(tmp_path / "again.json"), list(reversed(pairs)))
+    assert open(path).read() == open(str(tmp_path / "again.json")).read()
+
+
+def test_baseline_paths_normalized(tmp_path):
+    from kubeflow_tpu.analysis.findings import normalize_path
+    assert normalize_path("./a/b.py") == "a/b.py"
+    assert normalize_path("a\\b.py") == "a/b.py"
+
+
+# -- CLI surface -------------------------------------------------------------
+
+SCRIPT = os.path.join(REPO, "scripts", "run_tpulint.py")
+
+
+def _run_cli(*args, cwd=REPO):
+    return subprocess.run([sys.executable, SCRIPT, *args],
+                          capture_output=True, text=True, cwd=cwd)
+
+
+def test_cli_rule_alias_and_summary_table():
+    proc = _run_cli("--rule", "TPU010,TPU012")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "wall" in proc.stdout  # measured wall time printed
+
+
+def test_cli_sarif_out_writes_artifact(tmp_path):
+    out = str(tmp_path / "artifacts" / "tpulint.sarif")
+    proc = _run_cli("--sarif-out", out)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(open(out).read())
+    assert payload["version"] == "2.1.0"
+    rule_ids = {r["id"] for r in
+                payload["runs"][0]["tool"]["driver"]["rules"]}
+    assert {"TPU010", "TPU011", "TPU012", "TPU013"} <= rule_ids
+
+
+def test_cli_failure_prints_per_rule_diff_table(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def get(self):
+                with self._lock:
+                    return 1
+            def lease(self):
+                with self._lock:
+                    return self.get()
+    """))
+    proc = _run_cli("--baseline", "", str(bad))
+    assert proc.returncode == 1
+    assert "new findings vs baseline" in proc.stdout
+    assert "TPU012" in proc.stdout and "bad.py" in proc.stdout
+
+
+def test_cli_changed_only_conflicts_with_paths():
+    proc = _run_cli("--changed-only", "kubeflow_tpu/ops")
+    assert proc.returncode == 2
+    assert "mutually exclusive" in proc.stderr
+
+
+def test_cli_refuses_changed_only_baseline_update():
+    proc = _run_cli("--baseline-update", "--changed-only")
+    assert proc.returncode == 2
+    assert "full, unfiltered run" in proc.stderr
+
+
+def test_cli_changed_only_derives_git_scope(tmp_path):
+    # a scratch repo: one committed-clean file, one dirty tracked file,
+    # one untracked file, one changed non-py file — the derived scope
+    # is exactly the changed .py files under the lint roots
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("run_tpulint", SCRIPT)
+    cli = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cli)
+
+    repo = tmp_path / "r"
+    pkg = repo / "kubeflow_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "clean.py").write_text("x = 1\n")
+    (pkg / "dirty.py").write_text("x = 1\n")
+    (pkg / "notes.md").write_text("hi\n")
+    env = dict(os.environ, GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+               GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t")
+    for cmd in (["git", "init", "-q"], ["git", "add", "."],
+                ["git", "commit", "-qm", "seed"]):
+        subprocess.run(cmd, cwd=repo, check=True, env=env,
+                       capture_output=True)
+    (pkg / "dirty.py").write_text("import time\ntime.sleep(1)\n")
+    (pkg / "fresh.py").write_text("y = 2\n")
+    (pkg / "notes.md").write_text("changed\n")
+    files = cli.changed_python_files(str(repo))
+    assert files == ["kubeflow_tpu/dirty.py", "kubeflow_tpu/fresh.py"]

@@ -100,3 +100,24 @@ def test_phase_gauge_recomputed():
     op.reconcile("d", "b")
     assert gauge.get(phase="Succeeded") == 2
     assert gauge.get(phase="Pending") == 0  # stale label cleared
+
+
+def test_spawn_failure_releases_reservation(tmp_path, monkeypatch):
+    """PR 14 review: a failure on the unlocked spawn stretch (here: an
+    unwritable worker.log dir) must remove the _SpawnPending
+    reservation — otherwise the always-alive placeholder wedges the
+    deploy slot forever and every retry 409s."""
+    import os
+    import pytest
+    from kubeflow_tpu.bootstrap.server import DeployServer
+
+    server = DeployServer(FakeKubeClient(), app_root=str(tmp_path))
+    blocker = tmp_path / "app"
+    blocker.write_text("not a directory")  # makedirs() will raise
+    with pytest.raises(OSError):
+        server._spawn_worker("app", "apply")
+    assert server._procs == {}  # reservation released
+    os.remove(str(blocker))
+    # and the slot is retryable: a real spawn now goes through
+    assert server._spawn_worker("app", "apply") is True
+    server._procs["app"].wait()
