@@ -14,6 +14,12 @@ baseline config emits numbers each round — plus the three TPU-first configs
 the reference has no counterpart for: ``longcontext`` (seq-8192 flash
 training), ``decode`` (KV-cache generation), and ``decode_engine``
 (continuous-batching serving throughput at effective batch 32).
+
+Rows that run a tuned Pallas kernel (longcontext, bert, and the
+decode_engine paged-kernel A/B) carry ``tile_config`` — the resolved
+tile blocks plus their resolution source (``table|fallback|override``,
+kubeflow_tpu/ops/autotune.py) — so an A/B across rounds can attribute
+a throughput move to a tile-table change (PERF.md "Tile autotune").
 """
 
 from __future__ import annotations

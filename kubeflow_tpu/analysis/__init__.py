@@ -24,7 +24,8 @@ Layout:
 Rule catalog (details in ``docs/ANALYSIS.md``):
 
 ==========  ==================================================
-TPU001      tile-legality: BlockSpec lane/sublane tile floors
+TPU001      tile-legality: BlockSpec lane/sublane floors + the
+            committed tile table's entries (ops/tile_table.json)
 TPU002      host calls reachable inside jit/Pallas bodies
 TPU003      raw wall clock in controllers (inject a Clock)
 TPU004      wiring drift: component URLs/ports/RBAC vs presets

@@ -23,11 +23,25 @@ Two detections:
    guard, because the guard itself is typically computed with the same
    wrong floor (the PR 1 failure mode: ``_tileable`` said yes, Mosaic
    said no).
+
+**Table-resolved tiles** (the autotune plane): the flash/paged kernels'
+block dims are now dynamic values resolved from
+``kubeflow_tpu/ops/tile_table.json`` — unresolvable at the call site,
+so detections 1/2 correctly stay silent there. The legality obligation
+moves to the TABLE: when this checker reaches the plane's owner module
+(``ops/autotune.py``) it lints every committed entry with the plane's
+own ``validate_entry`` (divisibility, analytic VMEM estimate,
+dtype-lane/sublane legality) and reports illegal rows against the JSON
+file. The autotune module is loaded standalone (stdlib-only top level)
+so the lint run never pays the ``kubeflow_tpu.ops`` jax import.
 """
 
 from __future__ import annotations
 
 import ast
+import importlib.util
+import os
+import sys
 from typing import Iterable, Optional
 
 from kubeflow_tpu.analysis import astutil
@@ -38,6 +52,46 @@ from kubeflow_tpu.analysis.walker import ModuleInfo
 LANE_MULTIPLE = 128
 SUBLANE_MULTIPLE = 8  # f32 floor; bf16/int8 need 16/32 (see docstring)
 PICK_BLOCK_DEFAULT_FLOOR = 8
+
+# the autotune plane's owner module (triggers the table lint) and the
+# committed table the findings anchor to
+TABLE_OWNER = "kubeflow_tpu/ops/autotune.py"
+TABLE_REL = "kubeflow_tpu/ops/tile_table.json"
+
+
+def _ops_dir() -> str:
+    return os.path.normpath(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), os.pardir, os.pardir,
+        "ops"))
+
+
+def _table_path() -> str:
+    """Monkeypatch point for tests; the real table sits beside the
+    autotune module."""
+    return os.path.join(_ops_dir(), "tile_table.json")
+
+
+def _autotune_module():
+    """The validation logic lives in ONE place — the autotune plane.
+    Reuse an already-imported module when present; otherwise load it
+    standalone from file, skipping ``kubeflow_tpu.ops.__init__`` (whose
+    attention import pulls jax — a multi-second tax per lint run the
+    +25%-wall budget cannot afford)."""
+    mod = sys.modules.get("kubeflow_tpu.ops.autotune")
+    if mod is not None:
+        return mod
+    path = os.path.join(_ops_dir(), "autotune.py")
+    spec = importlib.util.spec_from_file_location("_tpulint_autotune", path)
+    mod = importlib.util.module_from_spec(spec)
+    # register BEFORE exec: the module's dataclasses resolve their
+    # (string) annotations through sys.modules[cls.__module__]
+    sys.modules[spec.name] = mod
+    try:
+        spec.loader.exec_module(mod)
+    except BaseException:
+        sys.modules.pop(spec.name, None)
+        raise
+    return mod
 
 
 def _pick_block_floor(scope: ast.AST, node: ast.AST) -> Optional[int]:
@@ -103,6 +157,38 @@ class TileLegalityChecker(Checker):
             if len(dims) >= 2:
                 yield from self._check_dim(
                     module, node, fn, dims[-2], guarded, lane=False)
+        if module.rel.replace("\\", "/") == TABLE_OWNER:
+            yield from self._check_table()
+
+    def _check_table(self) -> Iterable[Finding]:
+        """Lint the committed tile table with the autotune plane's own
+        legality check — the table is where the kernels' now-dynamic
+        block values actually come from, so it carries the tile-
+        legality obligation the silent call sites shed."""
+        path = _table_path()
+        if not os.path.exists(path):
+            yield Finding(
+                rule=self.rule, severity=self.severity, path=TABLE_REL,
+                line=1,
+                message="committed tile table is missing (every tuned "
+                        "kernel silently degrades to the analytic "
+                        "fallback)",
+                hint="restore kubeflow_tpu/ops/tile_table.json or "
+                     "regenerate it with scripts/tile_sweep.py "
+                     "--update-table")
+            return
+        at = _autotune_module()
+        table = at.load_table(path, warn=False)
+        for entry, errs in table.rejected:
+            for err in errs:
+                yield Finding(
+                    rule=self.rule, severity=self.severity,
+                    path=TABLE_REL, line=1,
+                    message=f"tile table entry {at.entry_key(entry)}: "
+                            f"{err}",
+                    hint="fix the entry (or drop it — the analytic "
+                         "fallback covers the shape class) and rerun "
+                         "scripts/tile_sweep.py --validate")
 
     def _check_dim(self, module: ModuleInfo, call: ast.Call,
                    fn: Optional[ast.AST], dim: ast.AST, guarded: bool,

@@ -363,8 +363,14 @@ def bench_bert(batch_per_chip: int = 16, seq_len: int = 512,
         holder["state"], holder["m"] = step(holder["state"], tokens, labels,
                                             weights)
 
-    sec = _timed_steps(one, steps, warmup,
-                       sync=lambda: float(holder["m"]["loss"]))
+    # record every tile resolution the compile makes (attention_impl
+    # "auto": flash + table on TPU, dense oracle elsewhere) so the
+    # artifact row attributes a BERT MFU move to a table change
+    from kubeflow_tpu.ops import autotune
+
+    with autotune.record_resolutions() as tile_rec:
+        sec = _timed_steps(one, steps, warmup,
+                           sync=lambda: float(holder["m"]["loss"]))
     if profile_dir:
         _capture_trace(one, lambda: float(holder["m"]["loss"]), profile_dir)
     # analytic transformer train FLOPs: 6·N·D (N params, D tokens) plus the
@@ -380,6 +386,11 @@ def bench_bert(batch_per_chip: int = 16, seq_len: int = 512,
         "n_chips": n_chips,
         "batch_per_chip": batch_per_chip,
         "seq_len": seq_len,
+        # resolved tile configs + resolution source (table|fallback|
+        # override); empty when the run took the dense XLA path (the
+        # off-TPU "auto" oracle)
+        "attention_impl": cfg.attention_impl,
+        "tile_config": autotune.summarize_resolutions(tile_rec),
         **_mfu(flops_per_step, sec, n_chips),
         **_step_telemetry_pass(
             one, lambda: float(holder["m"]["loss"]), step.jitted,
@@ -444,8 +455,14 @@ def bench_longcontext(seq_len: int = 8192, batch_per_chip: int = 2,
     def one():
         holder["state"], holder["m"] = step(holder["state"], tokens)
 
-    sec = _timed_steps(one, steps, warmup,
-                       sync=lambda: float(holder["m"]["loss"]))
+    # the flash tiles this run compiled with, and where they resolved
+    # from (tile_config in the row): an A/B round can attribute a
+    # tok/s move to a tile_table.json change instead of guessing
+    from kubeflow_tpu.ops import autotune
+
+    with autotune.record_resolutions() as tile_rec:
+        sec = _timed_steps(one, steps, warmup,
+                           sync=lambda: float(holder["m"]["loss"]))
     if profile_dir:
         _capture_trace(one, lambda: float(holder["m"]["loss"]), profile_dir)
     n_params = sum(int(np.prod(p.shape))
@@ -461,6 +478,7 @@ def bench_longcontext(seq_len: int = 8192, batch_per_chip: int = 2,
         "seq_len": seq_len,
         "batch_per_chip": batch_per_chip,
         "attention": "flash(pallas)+remat",
+        "tile_config": autotune.summarize_resolutions(tile_rec),
         "loss": f"chunked({loss_chunk})" if loss_chunk else "full_logits",
         "n_chips": n_chips,
         **_mfu(flops_per_step, sec, n_chips),
@@ -824,8 +842,15 @@ def bench_decode_engine(concurrency: int = 48, slots: int = 32,
     # executes, never a perf claim; the TPU-attached round reads it.
     paged_gather_tps, _, paged_gather_ttft, _ = run_engine(
         bound, sampled=False, paged=True, paged_attention_impl="gather")
-    paged_kernel_tps, _, paged_kernel_ttft, _ = run_engine(
-        bound, sampled=False, paged=True, paged_attention_impl="kernel")
+    # the kernel run is the tuned one: record its tile resolution
+    # (paged_attn head_block + source) so the artifact attributes a
+    # kernel-row move to a tile-table change
+    from kubeflow_tpu.ops import autotune
+
+    with autotune.record_resolutions() as paged_tile_rec:
+        paged_kernel_tps, _, paged_kernel_ttft, _ = run_engine(
+            bound, sampled=False, paged=True,
+            paged_attention_impl="kernel")
     # "auto" resolves to the kernel on the TPU backend and the gather
     # elsewhere — the headline paged rows reuse the matching A/B run
     # instead of paying a third paged engine pass
@@ -867,6 +892,7 @@ def bench_decode_engine(concurrency: int = 48, slots: int = 32,
         "paged_attn_kernel_vs_gather": (
             round(paged_kernel_tps / paged_gather_tps, 3)
             if paged_gather_tps else None),
+        "tile_config": autotune.summarize_resolutions(paged_tile_rec),
         **prefix_counters,
         "burst_first_tokens_ms": ttft_ms,
         "batch_prefills": batch_prefills,

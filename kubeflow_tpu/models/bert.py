@@ -43,6 +43,17 @@ class BertConfig:
     param_dtype: Any = jnp.float32
     remat: bool = True
     scan_layers: bool = True
+    # "auto" = the Pallas flash kernels on the TPU backend (the
+    # longcontext blocking treatment applied to seq-512 bidirectional,
+    # ROADMAP item 3's BERT-MFU lever), the dense XLA path elsewhere —
+    # dense is the parity oracle the flash route is gated against
+    # (tests/test_bert.py). Force "flash" to run the kernels in the
+    # interpreter off-TPU.
+    attention_impl: str = "auto"
+    # flash tile overrides; None = the shape-keyed tile table
+    # (kubeflow_tpu/ops/autotune.py)
+    attention_block_q: Any = None
+    attention_block_k: Any = None
 
     def encoder_config(self) -> TransformerConfig:
         return TransformerConfig(
@@ -58,6 +69,9 @@ class BertConfig:
             remat=self.remat,
             scan_layers=self.scan_layers,
             causal=False,  # the defining difference from the LM flagship
+            attention_impl=self.attention_impl,
+            attention_block_q=self.attention_block_q,
+            attention_block_k=self.attention_block_k,
         )
 
 
@@ -81,8 +95,16 @@ class Bert(nn.Module):
 
     @nn.compact
     def __call__(self, tokens: jnp.ndarray,
-                 token_types: jnp.ndarray = None) -> jnp.ndarray:
-        """tokens: (B, S) int32 -> MLM logits (B, S, V) float32."""
+                 token_types: jnp.ndarray = None,
+                 seq_lengths: jnp.ndarray = None) -> jnp.ndarray:
+        """tokens: (B, S) int32 -> MLM logits (B, S, V) float32.
+
+        ``seq_lengths`` is an optional per-row valid-length ``(B,)``
+        int32 padding mask: positions at/past a row's length are
+        excluded from every attention (dense and flash paths alike);
+        logits AT padded positions are unspecified — mask them with the
+        MLM loss weights, which real padding already zeroes.
+        """
         c = self.config
         ec = c.encoder_config()
         B, S = tokens.shape
@@ -107,6 +129,7 @@ class Bert(nn.Module):
         x = _constrain(x, ec.rules, "batch", "seq", None)
         sin, cos = rope_tables(S, ec.head_dim, ec.rope_theta)
 
+        aux = (sin, cos, seq_lengths)
         block_cls = Block
         if c.remat:
             block_cls = nn.remat(Block, prevent_cse=False)
@@ -118,10 +141,10 @@ class Bert(nn.Module):
                 in_axes=nn.broadcast,
                 length=c.n_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(ec, name="blocks")(x, (sin, cos))
+            )(ec, name="blocks")(x, aux)
         else:
             for i in range(c.n_layers):
-                x, _ = block_cls(ec, name=f"block_{i}")(x, (sin, cos))
+                x, _ = block_cls(ec, name=f"block_{i}")(x, aux)
 
         x = RMSNorm(param_dtype=c.param_dtype, name="final_norm")(x)
         # MLM head: dense transform + tied-embedding decode (BERT's

@@ -61,12 +61,22 @@ class TransformerConfig:
     # attention core: "dense" O(S²) (XLA-fused, fine to moderate S),
     # "blockwise" O(S·block) scan, "flash" Pallas kernel, "ring"/"ulysses"
     # sequence-parallel attention over the seq mesh axis (ppermute KV
-    # rotation vs all_to_all seq↔heads re-shard; both long-context)
+    # rotation vs all_to_all seq↔heads re-shard; both long-context),
+    # "auto" = flash on the TPU backend and dense (the XLA parity
+    # oracle) elsewhere — the BERT/bidirectional route
     attention_impl: str = "dense"
-    # flash/blockwise tile edge. 1024 is the r5 chip-measured optimum
-    # for the seq-independent-VMEM flash kernels (1.8x the 512 tiles'
-    # fwd+bwd rate at seq 8192; 2048 exceeds scoped VMEM)
-    attention_block_k: int = 1024
+    # flash KV tile edge. None (the default) resolves per kernel key +
+    # shape class from the committed tile table
+    # (kubeflow_tpu/ops/autotune.py + ops/tile_table.json — seeded with
+    # the r5 chip-measured winners: 1024-edge tiles ran fwd+bwd 1.8x
+    # the 512 rate at seq 8192; 2048 exceeds scoped VMEM) with an
+    # analytic VMEM-budget fallback; an int pins an explicit override
+    # for every flash kernel (the pre-PR behavior). Also the blockwise/
+    # ring/ulysses KV tile (those cores default to 1024 when None).
+    attention_block_k: Optional[int] = None
+    # flash q-tile edge, independent of block_k since the autotune
+    # plane split the square knob; None = table/auto, int = override
+    attention_block_q: Optional[int] = None
     causal: bool = True           # False => bidirectional (encoder/BERT)
     seq_axis: str = "tp"          # mesh axis ring attention shards sequence over
     rules: AxisRules = DEFAULT_RULES  # logical-axis -> mesh-axis sharding rules
@@ -96,6 +106,10 @@ class TransformerConfig:
     # continuation) always take the gather path — the kernel is the
     # decode-step hot loop.
     paged_attention_impl: str = "auto"
+    # paged kernel KV head-group compute block (ops/paged_attention.py
+    # head_block): None = tile-table/auto (safe fallback: the per-head
+    # loop, 1); an int overrides and must divide n_kv_heads
+    paged_head_block: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
@@ -108,8 +122,16 @@ class TransformerConfig:
         if self.n_experts and self.experts_per_token > self.n_experts:
             raise ValueError("experts_per_token > n_experts")
         if self.attention_impl not in ("dense", "blockwise", "flash",
-                                       "ring", "ulysses"):
+                                       "ring", "ulysses", "auto"):
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
+        for knob in ("attention_block_q", "attention_block_k",
+                     "paged_head_block"):
+            v = getattr(self, knob)
+            if v is not None and (not isinstance(v, int)
+                                  or isinstance(v, bool) or v < 1):
+                raise ValueError(
+                    f"{knob} must be None (tile-table/auto) or a "
+                    f"positive int, got {v!r}")
         if self.kv_page_size:
             if self.max_seq_len % self.kv_page_size:
                 raise ValueError(
@@ -127,6 +149,12 @@ class TransformerConfig:
 def _constrain(x, rules: AxisRules, *names):
     """Logical sharding constraint; silently a no-op outside a mesh context."""
     return shard_constraint(x, names, rules)
+
+
+# KV tile for the non-Pallas cores (blockwise scan, ring/ulysses inner
+# loop) when attention_block_k is None: those cores have no tile table —
+# 1024 is simply the pre-autotune default, kept so old behavior holds
+_UNTUNED_BLOCK_K = 1024
 
 
 class RMSNorm(nn.Module):
@@ -174,7 +202,7 @@ class Attention(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x, sin, cos):
+    def __call__(self, x, sin, cos, kv_len=None):
         c = self.config
         B, S, D = x.shape
         H, KH, Dh = c.n_heads, c.n_kv_heads, c.head_dim
@@ -211,7 +239,7 @@ class Attention(nn.Module):
 
             k, v = gqa_repeat(q, k, v)
 
-        out = self._attend(q, k, v)
+        out = self._attend(q, k, v, kv_len=kv_len)
         out = jnp.einsum("bshk,hkd->bsd", out, wo.astype(c.dtype))
         return _constrain(out, c.rules, "batch", "seq", None)
 
@@ -383,7 +411,7 @@ class Attention(nn.Module):
 
             out = paged_decode_attention(
                 q[:, 0], ck.value, cv.value, pages, pos,
-                sm_scale=Dh ** -0.5)
+                sm_scale=Dh ** -0.5, head_block=c.paged_head_block)
             return out[:, None]
 
         # gather each row's logical view: (B, n_log, ps, KH, Dh) ->
@@ -402,31 +430,59 @@ class Attention(nn.Module):
         probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
         return jnp.einsum("bhst,bthd->bshd", probs, vc)
 
-    def _attend(self, q, k, v):
-        """Dispatch to the configured attention core (causal per config)."""
+    def _attend(self, q, k, v, kv_len=None):
+        """Dispatch to the configured attention core (causal per config).
+
+        ``attention_impl="auto"`` routes through the flash kernels on
+        the TPU backend and the dense XLA path elsewhere — dense is the
+        parity oracle the flash path is gated against (the BERT
+        bidirectional route, tests/test_bert.py). ``kv_len`` is the
+        per-row valid-length padding mask; only the dense and flash
+        cores implement it, so any other impl refuses it loudly.
+        """
         c = self.config
         from kubeflow_tpu.ops import attention as att  # local: no cycle
 
-        if c.attention_impl == "dense":
-            return att.reference_attention(q, k, v, causal=c.causal)
-        if c.attention_impl == "blockwise":
+        impl = c.attention_impl
+        if impl == "auto":
+            impl = "flash" if jax.default_backend() == "tpu" else "dense"
+        if kv_len is not None and impl not in ("dense", "flash"):
+            raise ValueError(
+                f"kv_len padding mask is not supported by "
+                f"attention_impl={impl!r} (dense and flash only)")
+        block_k = c.attention_block_k or _UNTUNED_BLOCK_K
+        if impl == "dense":
+            return att.reference_attention(q, k, v, causal=c.causal,
+                                           kv_len=kv_len)
+        if impl == "blockwise":
             return att.blockwise_attention(
-                q, k, v, causal=c.causal, block_k=c.attention_block_k
+                q, k, v, causal=c.causal, block_k=block_k
             )
-        if c.attention_impl == "flash":
-            # largest divisor of S within the block budget (flash requires
-            # block | seq); degenerate divisors fall back to blockwise
+        if impl == "flash":
+            from kubeflow_tpu.ops import autotune
+
+            # flash requires block | seq: explicit overrides are fitted
+            # to the largest divisor within their budget (the pre-split
+            # behavior, now per knob); None stays None so the kernels
+            # resolve each kernel key from the tile table. Degenerate
+            # divisors fall back to blockwise, as before.
             S = q.shape[1]
-            block = next(
-                (b for b in range(min(c.attention_block_k, S), 0, -1)
-                 if S % b == 0),
-                1,
-            )
-            if block < 16:
+            if autotune.fit_block(
+                    S, block_k if c.attention_block_k else
+                    autotune.MAX_TILE_EDGE) < 16:
+                if kv_len is not None:
+                    raise ValueError(
+                        f"kv_len padding mask needs a flash-tileable "
+                        f"seq len, got {S}")
                 return att.blockwise_attention(
-                    q, k, v, causal=c.causal, block_k=c.attention_block_k
+                    q, k, v, causal=c.causal, block_k=block_k
                 )
-            return att.flash_attention(q, k, v, c.causal, block, block)
+            bq = (autotune.fit_block(S, c.attention_block_q)
+                  if c.attention_block_q else None)
+            bk = (autotune.fit_block(S, c.attention_block_k)
+                  if c.attention_block_k else None)
+            return att.flash_attention(q, k, v, c.causal, bq, bk, None,
+                                       None, kv_len)
         # ring / ulysses: sequence-parallel over the seq mesh axis;
         # partial-manual shard_map (batch/other axes stay auto)
         from kubeflow_tpu import compat
@@ -435,7 +491,7 @@ class Attention(nn.Module):
         if mesh.empty or c.seq_axis not in mesh.axis_names:
             k, v = att.gqa_repeat(q, k, v)  # ulysses deferred the repeat
             return att.blockwise_attention(
-                q, k, v, causal=c.causal, block_k=c.attention_block_k
+                q, k, v, causal=c.causal, block_k=block_k
             )
         import functools
 
@@ -444,7 +500,7 @@ class Attention(nn.Module):
         if c.attention_impl == "ulysses":
             core = functools.partial(
                 att.ulysses_attention, axis_name=c.seq_axis,
-                causal=c.causal, block_k=c.attention_block_k)
+                causal=c.causal, block_k=block_k)
         else:
             core = functools.partial(
                 att.ring_attention, axis_name=c.seq_axis, causal=c.causal)
@@ -553,10 +609,15 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, aux):
-        sin, cos = aux
+        # aux is (sin, cos) or (sin, cos, kv_len) — the optional third
+        # element is the per-row valid-length padding mask the BERT
+        # encoder threads through every block (models/bert.py)
+        sin, cos = aux[0], aux[1]
+        kv_len = aux[2] if len(aux) > 2 else None
         c = self.config
         h = RMSNorm(param_dtype=c.param_dtype, name="attn_norm")(x)
-        x = x + Attention(c, decode=self.decode, name="attn")(h, sin, cos)
+        x = x + Attention(c, decode=self.decode, name="attn")(h, sin, cos,
+                                                              kv_len)
         h = RMSNorm(param_dtype=c.param_dtype, name="mlp_norm")(x)
         mlp = MoeMlp(c, name="moe") if c.n_experts else Mlp(c, name="mlp")
         x = x + mlp(h)

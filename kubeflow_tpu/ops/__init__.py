@@ -1,6 +1,9 @@
 """TPU compute ops: attention kernels (dense, flash, ring/ulysses,
-paged decode), collectives, MoE dispatch, fused sampling."""
+paged decode), collectives, MoE dispatch, fused sampling, and the
+kernel autotune plane (``ops/autotune.py``: shape-keyed tile tables
+every tuned kernel resolves its blocks from)."""
 
+from kubeflow_tpu.ops import autotune  # noqa: F401
 from kubeflow_tpu.ops.attention import (  # noqa: F401
     blockwise_attention,
     flash_attention,

@@ -31,8 +31,10 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from kubeflow_tpu import compat
+from kubeflow_tpu.ops.autotune import resolve_flash
 
 NEG_INF = -1e30
 
@@ -51,14 +53,23 @@ def gqa_repeat(q, k, v):
 
 
 def reference_attention(q, k, v, *, causal: bool = True,
-                        sm_scale: Optional[float] = None):
-    """Plain O(S²)-memory attention; the numerics oracle for the others."""
+                        sm_scale: Optional[float] = None, kv_len=None):
+    """Plain O(S²)-memory attention; the numerics oracle for the others.
+
+    ``kv_len`` is an optional per-row valid-length ``(B,)`` int32 —
+    KV positions at or past a row's length are masked out (the padding
+    mask of the bidirectional/BERT path). The XLA parity oracle for the
+    flash kernels' masked variant.
+    """
     scale = _scale(q, sm_scale)
     logits = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) * scale
+    S, T = q.shape[1], k.shape[1]
     if causal:
-        S, T = q.shape[1], k.shape[1]
         mask = jnp.arange(T)[None, :] <= jnp.arange(S)[:, None] + (T - S)
         logits = jnp.where(mask[None, None], logits, NEG_INF)
+    if kv_len is not None:
+        valid = jnp.arange(T)[None, :] < kv_len[:, None]        # (B, T)
+        logits = jnp.where(valid[:, None, None, :], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhst,bthd->bshd", probs, v)
 
@@ -163,9 +174,20 @@ def _causal_block_mask(s, i, j, block_q: int, block_k: int):
     return jnp.where(kv_pos <= q_pos, s, NEG_INF)
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
-                      m_ref, l_ref, *, block_q: int, block_k: int,
-                      scale: float, causal: bool, n_kv: int):
+def _pad_mask(s, limit, j, block_k: int):
+    """Mask KV positions at/past the row's valid length ``limit`` in
+    one (block_q, block_k) score tile at kv block ``j`` — the padding
+    mask of the bidirectional/BERT flash path. The SAME expression in
+    the forward and both backward kernels, or the backward's
+    recomputed P diverges from the forward's."""
+    kv_pos = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block_k), 1)
+    return jnp.where(kv_pos < limit, s, NEG_INF)
+
+
+def _flash_fwd_kernel(*refs, block_q: int, block_k: int,
+                      scale: float, causal: bool, n_kv: int,
+                      masked: bool = False):
     """One (batch·head, q-block, kv-block) grid step.
 
     The KV stream is a GRID dimension (innermost), not an in-kernel
@@ -179,8 +201,20 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
     the per-row logsumexp at the final kv step — the backward kernels
     recompute probabilities from it without a second online-softmax
     pass.
+
+    ``masked`` (static) adds a per-row valid-length input (SMEM scalar
+    per fused batch·head row) whose padding mask composes with the
+    causal one; the unmasked argument list is byte-identical to the
+    pre-mask kernel.
     """
     import jax.experimental.pallas as pl  # deferred: test envs without pallas
+
+    if masked:
+        q_ref, k_ref, v_ref, len_ref, o_ref, lse_ref, acc_ref, m_ref, \
+            l_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+        len_ref = None
 
     i = pl.program_id(1)  # q-block index
     j = pl.program_id(2)  # kv-block index
@@ -205,6 +239,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
         )  # (block_q, block_k)
         if causal:
             s = _causal_block_mask(s, i, j, block_q, block_k)
+        if masked:
+            s = _pad_mask(s, len_ref[0, 0], j, block_k)
         m = m_ref[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -254,17 +290,35 @@ def _causal_clamp_q(block_q: int, block_k: int, causal: bool):
         b, jnp.maximum(i, _first_live_q(j, block_q, block_k)), 0)
 
 
-def _flash_fwd(q, k, v, *, causal: bool, block_q: int, block_k: int,
-               sm_scale: Optional[float], interpret: bool):
+def _fused_lens(kv_len, H: int):
+    """(B,) per-row valid lengths → (B·H, 1) int32 aligned with the
+    kernels' fused batch·head grid axis."""
+    return jnp.repeat(kv_len.astype(jnp.int32), H)[:, None]
+
+
+def _len_spec(pl, pltpu):
+    """One per-row length scalar per grid step, SMEM-resident (control
+    values, not vector data)."""
+    return pl.BlockSpec((1, 1), lambda b, i, j: (b, 0),
+                        memory_space=pltpu.SMEM)
+
+
+def _flash_fwd(q, k, v, *, causal: bool, block_q: Optional[int],
+               block_k: Optional[int], sm_scale: Optional[float],
+               interpret: bool, kv_len=None):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, S, H, D = q.shape
-    block_q = min(block_q, S)
-    block_k = min(block_k, S)
+    cfg = resolve_flash(
+        "flash_fwd", seq=S, head_dim=D, n_heads=H, n_kv_heads=k.shape[2],
+        dtype=q.dtype, causal=causal, block_q=block_q, block_k=block_k)
+    block_q = min(cfg.block_q, S)
+    block_k = min(cfg.block_k, S)
     if S % block_q or S % block_k:
         raise ValueError(f"seq_len {S} must divide by blocks {block_q}/{block_k}")
     scale = _scale(q, sm_scale)
+    masked = kv_len is not None
 
     # fuse batch and heads into the grid's first axis; q blocks second,
     # kv stream innermost
@@ -273,20 +327,25 @@ def _flash_fwd(q, k, v, *, causal: bool, block_q: int, block_k: int,
 
     kernel = functools.partial(
         _flash_fwd_kernel, block_q=block_q, block_k=block_k, scale=scale,
-        causal=causal, n_kv=n_kv,
+        causal=causal, n_kv=n_kv, masked=masked,
     )
     kv_map = _causal_clamp_kv(block_q, block_k, causal)
+    inputs = [qf, kf, vf]
+    in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, D), kv_map,
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, D), kv_map,
+                     memory_space=pltpu.VMEM),
+    ]
+    if masked:
+        inputs.append(_fused_lens(kv_len, H))
+        in_specs.append(_len_spec(pl, pltpu))
     out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, S // block_q, n_kv),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, D), kv_map,
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, D), kv_map,
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
@@ -303,18 +362,26 @@ def _flash_fwd(q, k, v, *, causal: bool, block_q: int, block_k: int,
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf)
+    )(*inputs)
     return out.reshape(B, H, S, D).transpose(0, 2, 1, 3), lse
 
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                         dq_ref, acc_ref, *, block_q: int, block_k: int,
-                         scale: float, causal: bool, n_kv: int):
+def _flash_bwd_dq_kernel(*refs, block_q: int, block_k: int,
+                         scale: float, causal: bool, n_kv: int,
+                         masked: bool = False):
     """dQ for one (batch·head, q-block, kv-block) grid step: the KV
     stream rides the innermost grid dimension (seq-independent VMEM,
     like the forward), recompute P from the saved logsumexp,
     accumulate dS·K in f32 scratch, emit at the last kv step."""
     import jax.experimental.pallas as pl
+
+    if masked:
+        q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, len_ref, \
+            dq_ref, acc_ref = refs
+    else:
+        q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref, \
+            acc_ref = refs
+        len_ref = None
 
     i = pl.program_id(1)
     j = pl.program_id(2)
@@ -337,6 +404,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                                 preferred_element_type=jnp.float32)
         if causal:
             s = _causal_block_mask(s, i, j, block_q, block_k)
+        if masked:
+            s = _pad_mask(s, len_ref[0, 0], j, block_k)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(g, vb, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -350,16 +419,23 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         dq_ref[0] = (acc_ref[...] * scale).astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int,
+def _flash_bwd_dkv_kernel(*refs, block_q: int,
                           block_k: int, scale: float, causal: bool,
-                          n_q: int):
+                          n_q: int, masked: bool = False):
     """dK/dV for one (batch·head, kv-block, q-block) grid step: the Q
     stream rides the innermost grid dimension; causal steps before this
     kv block's first contributing q block move and compute nothing.
     Recompute P, accumulate Pᵀ·dO and dSᵀ·Q in f32 scratch, emit at
     the last q step (which causality never skips)."""
     import jax.experimental.pallas as pl
+
+    if masked:
+        q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, len_ref, \
+            dk_ref, dv_ref, dk_acc, dv_acc = refs
+    else:
+        q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dk_ref, dv_ref, \
+            dk_acc, dv_acc = refs
+        len_ref = None
 
     j = pl.program_id(1)  # kv-block index
     i = pl.program_id(2)  # q-block index
@@ -383,6 +459,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                                 preferred_element_type=jnp.float32)
         if causal:
             s = _causal_block_mask(s, i, j, block_q, block_k)
+        if masked:
+            s = _pad_mask(s, len_ref[0, 0], j, block_k)
         p = jnp.exp(s - lse)  # (block_q, block_k)
         dv_acc[...] = dv_acc[...] + jax.lax.dot_general(
             p, g, (((0,), (0,)), ((), ())),
@@ -401,15 +479,29 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, g, *, causal: bool, block_q: int,
-               block_k: int, sm_scale: Optional[float], interpret: bool):
+def _flash_bwd(q, k, v, o, lse, g, *, causal: bool,
+               block_q: Optional[int], block_k: Optional[int],
+               sm_scale: Optional[float], interpret: bool, kv_len=None):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, S, H, D = q.shape
-    block_q = min(block_q, S)
-    block_k = min(block_k, S)
+    # the dQ and dK/dV kernels stream opposite axes, so their optima
+    # are INDEPENDENT shape classes — each resolves its own tile pair
+    # (an explicit override pins both, the pre-PR behavior)
+    shape_kw = dict(seq=S, head_dim=D, n_heads=H, n_kv_heads=k.shape[2],
+                    dtype=q.dtype, causal=causal, block_q=block_q,
+                    block_k=block_k)
+    cfg_dq = resolve_flash("flash_bwd_dq", **shape_kw)
+    cfg_kv = resolve_flash("flash_bwd_dkv", **shape_kw)
+    bq_dq, bk_dq = min(cfg_dq.block_q, S), min(cfg_dq.block_k, S)
+    bq_kv, bk_kv = min(cfg_kv.block_q, S), min(cfg_kv.block_k, S)
+    for bq, bk in ((bq_dq, bk_dq), (bq_kv, bk_kv)):
+        if S % bq or S % bk:
+            raise ValueError(
+                f"seq_len {S} must divide by blocks {bq}/{bk}")
     scale = _scale(q, sm_scale)
+    masked = kv_len is not None
 
     qf, kf, vf = _fuse_heads(q), _fuse_heads(k), _fuse_heads(v)
     gf, of = _fuse_heads(g), _fuse_heads(o)
@@ -417,59 +509,72 @@ def _flash_bwd(q, k, v, o, lse, g, *, causal: bool, block_q: int,
     # trailing singleton for a legal TPU block layout (see lse)
     delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32),
                     axis=-1, keepdims=True)
+    lens = _fused_lens(kv_len, H) if masked else None
 
-    n_q, n_kv = S // block_q, S // block_k
+    n_q, n_kv = S // bq_dq, S // bk_dq
     blk_q = lambda b, i, j: (b, i, 0)  # noqa: E731
-    kv_map = _causal_clamp_kv(block_q, block_k, causal)
+    kv_map = _causal_clamp_kv(bq_dq, bk_dq, causal)
 
+    inputs = [qf, kf, vf, gf, lse, delta]
+    in_specs = [
+        pl.BlockSpec((1, bq_dq, D), blk_q, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk_dq, D), kv_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk_dq, D), kv_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bq_dq, D), blk_q, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bq_dq, 1), blk_q, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bq_dq, 1), blk_q, memory_space=pltpu.VMEM),
+    ]
+    if masked:
+        inputs.append(lens)
+        in_specs.append(_len_spec(pl, pltpu))
     dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
-                          block_k=block_k, scale=scale, causal=causal,
-                          n_kv=n_kv),
+        functools.partial(_flash_bwd_dq_kernel, block_q=bq_dq,
+                          block_k=bk_dq, scale=scale, causal=causal,
+                          n_kv=n_kv, masked=masked),
         grid=(B * H, n_q, n_kv),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), blk_q, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, D), kv_map, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, D), kv_map, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, D), blk_q, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, 1), blk_q, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, 1), blk_q, memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, D), blk_q,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, bq_dq, D), blk_q,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bq_dq, D), jnp.float32)],
         interpret=interpret,
-    )(qf, kf, vf, gf, lse, delta)
+    )(*inputs)
 
-    q_map = _causal_clamp_q(block_q, block_k, causal)
+    n_q, n_kv = S // bq_kv, S // bk_kv
+    q_map = _causal_clamp_q(bq_kv, bk_kv, causal)
     blk_kv = lambda b, j, i: (b, j, 0)  # noqa: E731
 
+    inputs = [qf, kf, vf, gf, lse, delta]
+    in_specs = [
+        pl.BlockSpec((1, bq_kv, D), q_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk_kv, D), blk_kv, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk_kv, D), blk_kv, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bq_kv, D), q_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bq_kv, 1), q_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bq_kv, 1), q_map, memory_space=pltpu.VMEM),
+    ]
+    if masked:
+        inputs.append(lens)
+        in_specs.append(pl.BlockSpec((1, 1), lambda b, j, i: (b, 0),
+                                     memory_space=pltpu.SMEM))
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
-                          block_k=block_k, scale=scale, causal=causal,
-                          n_q=n_q),
+        functools.partial(_flash_bwd_dkv_kernel, block_q=bq_kv,
+                          block_k=bk_kv, scale=scale, causal=causal,
+                          n_q=n_q, masked=masked),
         grid=(B * H, n_kv, n_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), q_map, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, D), blk_kv, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, D), blk_kv, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, D), q_map, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, 1), q_map, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, 1), q_map, memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, block_k, D), blk_kv, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, D), blk_kv, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk_kv, D), blk_kv, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk_kv, D), blk_kv, memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, S, D), k.dtype),
             jax.ShapeDtypeStruct((B * H, S, D), v.dtype),
         ],
-        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
-                        pltpu.VMEM((block_k, D), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bk_kv, D), jnp.float32),
+                        pltpu.VMEM((bk_kv, D), jnp.float32)],
         interpret=interpret,
-    )(qf, kf, vf, gf, lse, delta)
+    )(*inputs)
 
     unfuse = lambda x: x.reshape(B, H, S, D).transpose(0, 2, 1, 3)  # noqa: E731
     return unfuse(dq), unfuse(dk), unfuse(dv)
@@ -482,9 +587,11 @@ def _resolve_interpret(interpret: Optional[bool]) -> bool:
 @functools.partial(
     jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
 )
-def flash_attention(q, k, v, causal: bool = True, block_q: int = 256,
-                    block_k: int = 256, sm_scale: Optional[float] = None,
-                    interpret: Optional[bool] = None):
+def flash_attention(q, k, v, causal: bool = True,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
+                    sm_scale: Optional[float] = None,
+                    interpret: Optional[bool] = None, kv_len=None):
     """Pallas flash attention: fwd AND bwd kernels (saved-LSE backward).
 
     The backward is the standard flash split — a dQ kernel streaming KV
@@ -492,27 +599,52 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 256,
     forward's saved logsumexp, so training never materializes (S, S) and
     both passes run on the MXU from VMEM tiles.
 
+    ``block_q``/``block_k`` are INDEPENDENT tile knobs. ``None`` (the
+    default) resolves each kernel's tiles from the committed shape-keyed
+    tile table — ``flash_fwd``, ``flash_bwd_dq`` and ``flash_bwd_dkv``
+    are separate kernel keys, so the chip sweep can tune each pass —
+    with an analytic VMEM-budget fallback when the shape class has no
+    entry (``kubeflow_tpu/ops/autotune.py``). Explicit values override
+    the table for every kernel (the pre-PR behavior).
+
+    ``kv_len`` is an optional per-row valid-length ``(B,)`` int32: KV
+    positions at/past a row's length are masked out in the forward AND
+    both backward kernels — the padding mask of the bidirectional/BERT
+    path (``reference_attention(kv_len=...)`` is the parity oracle).
+    Rows whose cotangent is zero at padded positions get exact
+    gradients; outputs AT padded q positions are unspecified (mask them
+    downstream, as the MLM loss weights do).
+
     ``interpret=None`` auto-selects: compiled on TPU, interpreter elsewhere
     (so CPU tests execute the real kernels).
     """
     out, _ = _flash_fwd(q, k, v, causal=causal, block_q=block_q,
                         block_k=block_k, sm_scale=sm_scale,
-                        interpret=_resolve_interpret(interpret))
+                        interpret=_resolve_interpret(interpret),
+                        kv_len=kv_len)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, sm_scale, interpret):
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, sm_scale, interpret,
+                   kv_len=None):
     out, lse = _flash_fwd(q, k, v, causal=causal, block_q=block_q,
                           block_k=block_k, sm_scale=sm_scale,
-                          interpret=_resolve_interpret(interpret))
-    return out, (q, k, v, out, lse)
+                          interpret=_resolve_interpret(interpret),
+                          kv_len=kv_len)
+    return out, (q, k, v, out, lse, kv_len)
 
 
 def _flash_vjp_bwd(causal, block_q, block_k, sm_scale, interpret, res, g):
-    q, k, v, out, lse = res
-    return _flash_bwd(q, k, v, out, lse, g, causal=causal, block_q=block_q,
-                      block_k=block_k, sm_scale=sm_scale,
-                      interpret=_resolve_interpret(interpret))
+    q, k, v, out, lse, kv_len = res
+    dq, dk, dv = _flash_bwd(q, k, v, out, lse, g, causal=causal,
+                            block_q=block_q, block_k=block_k,
+                            sm_scale=sm_scale,
+                            interpret=_resolve_interpret(interpret),
+                            kv_len=kv_len)
+    if kv_len is None:
+        return dq, dk, dv, None
+    # integer primal → float0 cotangent (the custom_vjp contract)
+    return dq, dk, dv, np.zeros(kv_len.shape, dtype=jax.dtypes.float0)
 
 
 flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

@@ -58,6 +58,7 @@ import jax
 import jax.numpy as jnp
 
 from kubeflow_tpu.ops.attention import NEG_INF
+from kubeflow_tpu.ops.autotune import resolve_paged
 
 
 def _resolve_interpret(interpret: Optional[bool]) -> bool:
@@ -68,13 +69,22 @@ def _resolve_interpret(interpret: Optional[bool]) -> bool:
 def _paged_decode_kernel(pages_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
                          acc_ref, m_ref, l_ref, *, page_size: int,
                          n_log: int, scale: float, n_kv_heads: int,
-                         group: int, sentinel: int):
+                         group: int, sentinel: int, head_block: int = 1):
     """One (row, logical-page) grid step of online-softmax attention.
 
     ``acc``/``m``/``l`` are the f32 running accumulators over the
     row's page stream; the emit at the final page normalizes. Each KV
     head attends its own q-head group (``group = QH // KH``) via
     static scratch slices — GQA without widening K/V.
+
+    ``head_block`` (static, table-resolved — the "head-group blocking"
+    knob of ROADMAP item 1's sweep) batches that many KV heads per
+    compute step: at 1 the original per-head loop runs byte-identically
+    (the parity oracle's path); above 1 the dots batch over the head
+    axis so the MXU sees ``head_block·group × page_size`` work per
+    issue instead of ``group × page_size``. VMEM residency is
+    unchanged either way — the whole K/V page block is fetched
+    regardless; the knob trades loop trips for batched-dot width.
     """
     import jax.experimental.pallas as pl  # deferred: envs without pallas
 
@@ -100,24 +110,13 @@ def _paged_decode_kernel(pages_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
         kv_pos = j * page_size + jax.lax.broadcasted_iota(
             jnp.int32, (1, page_size), 1)
         dead = kv_pos > pos                    # per-position causal bound
-        for h in range(n_kv_heads):
-            sl = slice(h * group, (h + 1) * group)
-            s = jax.lax.dot_general(
-                q[sl], kb[:, h, :], (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * scale                          # (group, page_size)
-            s = jnp.where(dead, NEG_INF, s)
-            m = m_ref[sl]
-            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-            p = jnp.exp(s - m_new)
-            alpha = jnp.exp(m - m_new)
-            l_ref[sl] = l_ref[sl] * alpha + jnp.sum(p, axis=-1,
-                                                    keepdims=True)
-            acc_ref[sl] = acc_ref[sl] * alpha + jax.lax.dot_general(
-                p.astype(vb.dtype), vb[:, h, :], (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            m_ref[sl] = m_new
+        for h0 in range(0, n_kv_heads, head_block):
+            if head_block == 1:
+                _attend_one_head(q, kb, vb, dead, h0, group, scale,
+                                 acc_ref, m_ref, l_ref)
+            else:
+                _attend_head_group(q, kb, vb, dead, h0, head_block,
+                                   group, scale, acc_ref, m_ref, l_ref)
 
     @pl.when(j == n_log - 1)
     def _emit():
@@ -127,9 +126,66 @@ def _paged_decode_kernel(pages_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
 
 
+def _attend_one_head(q, kb, vb, dead, h, group, scale,
+                     acc_ref, m_ref, l_ref):
+    """The original per-KV-head online-softmax step (head_block=1) —
+    kept verbatim as the bit-parity baseline the batched path and the
+    gather oracle are gated against."""
+    sl = slice(h * group, (h + 1) * group)
+    s = jax.lax.dot_general(
+        q[sl], kb[:, h, :], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale                          # (group, page_size)
+    s = jnp.where(dead, NEG_INF, s)
+    m = m_ref[sl]
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m - m_new)
+    l_ref[sl] = l_ref[sl] * alpha + jnp.sum(p, axis=-1,
+                                            keepdims=True)
+    acc_ref[sl] = acc_ref[sl] * alpha + jax.lax.dot_general(
+        p.astype(vb.dtype), vb[:, h, :], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[sl] = m_new
+
+
+def _attend_head_group(q, kb, vb, dead, h0, hb, group, scale,
+                       acc_ref, m_ref, l_ref):
+    """``hb`` KV heads per step: the score and value dots batch over
+    the head axis (dot_general batch dims), so one issue carries
+    ``hb·group`` q rows. Same f32 math per element as the per-head
+    loop — only the batching changes."""
+    sl = slice(h0 * group, (h0 + hb) * group)
+    qh = q[sl].reshape(hb, group, q.shape[-1])
+    # scores: batch hb, contract Dh → (hb, group, page_size)
+    s = jax.lax.dot_general(
+        qh, kb[:, h0:h0 + hb, :], (((2,), (2,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    s = jnp.where(dead[None], NEG_INF, s)
+    m = m_ref[sl].reshape(hb, group, 1)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m - m_new)
+    l_new = l_ref[sl].reshape(hb, group, 1) * alpha + jnp.sum(
+        p, axis=-1, keepdims=True)
+    l_ref[sl] = l_new.reshape(hb * group, 1)
+    # values: batch hb, contract page_size → (hb, group, Dh)
+    pv = jax.lax.dot_general(
+        p.astype(vb.dtype), vb[:, h0:h0 + hb, :],
+        (((2,), (0,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32,
+    )
+    acc_ref[sl] = (acc_ref[sl] * alpha.reshape(hb * group, 1)
+                   + pv.reshape(hb * group, q.shape[-1]))
+    m_ref[sl] = m_new.reshape(hb * group, 1)
+
+
 def paged_decode_attention(q, k_pages, v_pages, pages, positions, *,
                            sm_scale: Optional[float] = None,
-                           interpret: Optional[bool] = None):
+                           interpret: Optional[bool] = None,
+                           head_block: Optional[int] = None):
     """Single-token decode attention straight off a paged KV pool.
 
     - ``q``: ``(B, QH, Dh)`` — one rotated query token per row;
@@ -144,6 +200,12 @@ def paged_decode_attention(q, k_pages, v_pages, pages, positions, *,
     Returns ``(B, QH, Dh)`` in ``q.dtype``. HBM reads touch each
     row's live pages once — never the dense ``(B, Smax, ...)`` view,
     never a QH-wide GQA copy.
+
+    ``head_block`` is the KV head-group compute knob: ``None`` resolves
+    it from the committed tile table (kernel key ``paged_attn``,
+    ``kubeflow_tpu/ops/autotune.py``; the safe fallback is the
+    per-head loop, 1); an explicit value overrides and must divide the
+    pool's KV head count.
     """
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -153,6 +215,13 @@ def paged_decode_attention(q, k_pages, v_pages, pages, positions, *,
     n_log = pages.shape[1]
     if QH % KH:
         raise ValueError(f"q heads {QH} must be a multiple of kv heads "
+                         f"{KH}")
+    cfg = resolve_paged(
+        max_seq_len=n_log * page_size, page_size=page_size, n_heads=QH,
+        n_kv_heads=KH, head_dim=Dh, dtype=q.dtype, head_block=head_block)
+    head_block = cfg.head_block
+    if head_block < 1 or KH % head_block:
+        raise ValueError(f"head_block {head_block} must divide kv heads "
                          f"{KH}")
     scale = sm_scale if sm_scale is not None else Dh ** -0.5
     pages = pages.astype(jnp.int32)
@@ -185,7 +254,8 @@ def paged_decode_attention(q, k_pages, v_pages, pages, positions, *,
     )
     kernel = functools.partial(
         _paged_decode_kernel, page_size=page_size, n_log=n_log,
-        scale=scale, n_kv_heads=KH, group=QH // KH, sentinel=P)
+        scale=scale, n_kv_heads=KH, group=QH // KH, sentinel=P,
+        head_block=head_block)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,

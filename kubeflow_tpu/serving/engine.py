@@ -372,10 +372,18 @@ class DecodeEngine:
                 paged_attention_impl = os.environ.get(
                     "KFTPU_PAGED_ATTN", "auto")
             self.paged_attention_impl = paged_attention_impl
+            # paged-kernel head-group compute block: default None =
+            # the shape-keyed tile table (ops/autotune.py; safe
+            # fallback is the per-head loop); KFTPU_PAGED_HEAD_BLOCK
+            # pins an explicit override for a chip experiment
+            head_block_env = os.environ.get("KFTPU_PAGED_HEAD_BLOCK")
+            paged_head_block = (int(head_block_env) if head_block_env
+                                else config.paged_head_block)
             self._cfg = dataclasses.replace(
                 config, kv_page_size=self.kv_page_size,
                 kv_pages=self.kv_pages,
-                paged_attention_impl=paged_attention_impl)
+                paged_attention_impl=paged_attention_impl,
+                paged_head_block=paged_head_block)
             self._cfg.validate()
         else:
             self.kv_page_size = 0

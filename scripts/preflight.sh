@@ -60,6 +60,13 @@
 #      the wall clock, the counter reads back through the tsdb, and
 #      job-badput-burn walks Pending -> Firing -> Resolved on an
 #      injected checkpoint stall (docs/OBSERVABILITY.md "Goodput")
+#  11. tile-table validate (scripts/tile_sweep.py --validate): strict
+#      legality over every committed kubeflow_tpu/ops/tile_table.json
+#      entry (divisibility, analytic VMEM estimate, dtype-lane
+#      legality) plus a CPU-tier parity smoke running the three flash
+#      kernels and the paged kernel with every committed tile config
+#      against the default-tile oracle — a bad table edit fails here
+#      before a bench round burns chip time (PERF.md "Tile autotune")
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -97,6 +104,9 @@ JAX_PLATFORMS=cpu python scripts/edge_smoke.py || rc=1
 
 echo "== preflight: goodput ledger smoke =="
 JAX_PLATFORMS=cpu python scripts/goodput_smoke.py || rc=1
+
+echo "== preflight: tile table validate =="
+JAX_PLATFORMS=cpu python scripts/tile_sweep.py --validate || rc=1
 
 if [ "$rc" -ne 0 ]; then
     echo "preflight: FAILED" >&2

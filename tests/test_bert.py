@@ -128,3 +128,103 @@ def test_mlm_training_reduces_loss_on_fixed_batch():
     last = float(metrics["loss"])
     assert last < first * 0.7, (first, last)
     assert int(metrics["step"]) == 20
+
+
+# ---------------------------------------------------------------------------
+# BERT flash path: attention_impl="auto" + flash-vs-XLA parity gate
+# (the longcontext blocking treatment applied to seq-512 bidirectional,
+# ROADMAP item 3 — dense XLA is the parity oracle on the CPU tier)
+# ---------------------------------------------------------------------------
+
+
+def _parity_pair(**overrides):
+    kw = dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+              max_seq_len=64, remat=False, scan_layers=False,
+              dtype=jnp.float32)
+    kw.update(overrides)
+    dense = Bert(BertConfig(attention_impl="dense", **kw))
+    flash = Bert(BertConfig(attention_impl="flash", **kw))
+    tokens = jax.random.randint(jax.random.key(0), (2, 64), 0, 256,
+                                jnp.int32)
+    lengths = jnp.array([48, 64], jnp.int32)
+    params = dense.init(jax.random.key(1), tokens)["params"]
+    return dense, flash, tokens, lengths, params
+
+
+def test_auto_impl_is_dense_oracle_off_tpu():
+    """attention_impl="auto" (the BertConfig default) routes to the XLA
+    dense path off-TPU — bit-identical to dense, so the oracle IS what
+    serves when no chip is attached."""
+    dense, _, tokens, lengths, params = _parity_pair()
+    auto = Bert(BertConfig(vocab_size=256, d_model=64, n_layers=2,
+                           n_heads=4, d_ff=128, max_seq_len=64,
+                           remat=False, scan_layers=False,
+                           dtype=jnp.float32))
+    assert auto.config.attention_impl == "auto"
+    la = auto.apply({"params": params}, tokens, seq_lengths=lengths)
+    ld = dense.apply({"params": params}, tokens, seq_lengths=lengths)
+    assert np.array_equal(np.asarray(la), np.asarray(ld))
+
+
+def test_flash_matches_dense_forward_with_padding_mask():
+    """The parity gate: non-causal flash kernels (interpret mode on
+    CPU) vs the XLA path, padding mask in play — valid positions agree
+    within the longcontext gate tolerances; positions at/past a row's
+    length are unspecified by contract."""
+    dense, flash, tokens, lengths, params = _parity_pair()
+    ld = dense.apply({"params": params}, tokens, seq_lengths=lengths)
+    lf = flash.apply({"params": params}, tokens, seq_lengths=lengths)
+    np.testing.assert_allclose(np.asarray(lf[0, :48]),
+                               np.asarray(ld[0, :48]),
+                               atol=2e-4, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(lf[1]), np.asarray(ld[1]),
+                               atol=2e-4, rtol=1e-4)
+
+
+def test_padding_mask_blocks_pad_token_influence():
+    """A token past a row's seq_length must not change any valid
+    position's logits — on BOTH paths (the mask is real, not
+    decorative)."""
+    dense, flash, tokens, lengths, params = _parity_pair()
+    poisoned = tokens.at[0, 60].set(7)
+    for model in (dense, flash):
+        a = model.apply({"params": params}, tokens, seq_lengths=lengths)
+        b = model.apply({"params": params}, poisoned, seq_lengths=lengths)
+        np.testing.assert_allclose(np.asarray(a[0, :48]),
+                                   np.asarray(b[0, :48]), atol=1e-6)
+
+
+def test_flash_matches_dense_grads_with_padding_mask():
+    """Gradient half of the parity gate: masked-MLM loss (weights zero
+    at padded positions, as real padding always is) — every parameter
+    gradient agrees across the two attention paths."""
+    dense, flash, tokens, lengths, params = _parity_pair()
+    labels = jax.random.randint(jax.random.key(2), (2, 64), 0, 256,
+                                jnp.int32)
+    w = (jnp.arange(64)[None, :] < lengths[:, None]).astype(jnp.float32)
+
+    def loss(model):
+        def f(p):
+            logits = model.apply({"params": p}, tokens,
+                                 seq_lengths=lengths)
+            return masked_lm_loss(logits, labels, w)
+        return f
+
+    gd = jax.grad(loss(dense))(params)
+    gf = jax.grad(loss(flash))(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=5e-4, rtol=5e-3), gd, gf)
+
+
+def test_flash_resolves_bert_tiles_from_table():
+    """The bert-base shape class (seq 512, head_dim 64, bf16,
+    non-causal) hits the seeded table rows, so the chip round's MFU
+    claim is attributable to a table entry."""
+    from kubeflow_tpu.ops import autotune
+
+    cfg = autotune.resolve_flash("flash_fwd", seq=512, head_dim=64,
+                                 n_heads=12, n_kv_heads=12,
+                                 dtype=jnp.bfloat16, causal=False)
+    assert cfg.source == "table"
+    assert (cfg.block_q, cfg.block_k) == (512, 512)

@@ -284,3 +284,84 @@ class TestMoeDispatch:
         y, aux = capacity_moe(x, logits, lambda e: e, k=2, capacity=G)
         np.testing.assert_allclose(y, x, atol=1e-5)
         assert float(aux) > 0
+
+
+class TestFlashAutotuneAndPadding:
+    """The autotune-plane surface of flash_attention: None blocks
+    resolve from the tile table/fallback, and the kv_len padding mask
+    (the BERT bidirectional route) is exact against the dense oracle
+    in forward AND both backward kernels."""
+
+    def test_default_none_blocks_match_reference(self):
+        q, k, v = qkv()
+        out = flash_attention(q, k, v)  # table/fallback resolution
+        np.testing.assert_allclose(out, reference_attention(q, k, v),
+                                   atol=1e-5)
+
+    def test_padding_mask_forward_matches_reference(self):
+        q, k, v = qkv()
+        kv_len = jnp.array([40, 64], jnp.int32)
+        for causal in (False, True):
+            ref = reference_attention(q, k, v, causal=causal,
+                                      kv_len=kv_len)
+            out = flash_attention(q, k, v, causal, 16, 16, None, None,
+                                  kv_len)
+            # valid positions only: outputs AT padded q rows are
+            # unspecified by contract (masked downstream)
+            np.testing.assert_allclose(
+                np.asarray(out[0, :40]), np.asarray(ref[0, :40]),
+                atol=1e-5, err_msg=f"causal={causal}")
+            np.testing.assert_allclose(
+                np.asarray(out[1]), np.asarray(ref[1]), atol=1e-5)
+
+    def test_padding_mask_is_real(self):
+        """Perturbing a padded KV position must not change any valid
+        output — the kernel mask, not numerics, is in charge."""
+        q, k, v = qkv()
+        kv_len = jnp.array([40, 64], jnp.int32)
+        k2 = k.at[0, 50].set(99.0)
+        v2 = v.at[0, 50].set(-99.0)
+        a = flash_attention(q, k, v, False, 16, 16, None, None, kv_len)
+        b = flash_attention(q, k2, v2, False, 16, 16, None, None, kv_len)
+        assert np.array_equal(np.asarray(a[0, :40]),
+                              np.asarray(b[0, :40]))
+
+    def test_padding_mask_gradients_match_reference(self):
+        """Both backward kernels must apply the SAME mask when
+        recomputing P, or valid-position gradients absorb garbage from
+        padded columns. Cotangent zeroed at padded q rows, as the MLM
+        loss weights guarantee."""
+        q, k, v = qkv()
+        kv_len = jnp.array([40, 64], jnp.int32)
+        w = (jnp.arange(64)[None, :] < kv_len[:, None]).astype(
+            jnp.float32)[..., None, None]
+        for causal in (False, True):
+            refs = jax.grad(
+                lambda q, k, v: jnp.sum((reference_attention(
+                    q, k, v, causal=causal, kv_len=kv_len) * w) ** 2),
+                argnums=(0, 1, 2))(q, k, v)
+            fls = jax.grad(
+                lambda q, k, v: jnp.sum((flash_attention(
+                    q, k, v, causal, 16, 16, None, None,
+                    kv_len) * w) ** 2),
+                argnums=(0, 1, 2))(q, k, v)
+            for g_ref, g_fl, name in zip(refs, fls, "qkv"):
+                np.testing.assert_allclose(
+                    g_fl, g_ref, atol=1e-4,
+                    err_msg=f"d{name} causal={causal}")
+
+    def test_padding_mask_with_uneven_blocks(self):
+        """Mask correctness must not depend on the tile shape — a
+        length landing mid-block masks the partial block exactly."""
+        q, k, v = qkv()
+        kv_len = jnp.array([23, 57], jnp.int32)
+        ref = reference_attention(q, k, v, causal=False, kv_len=kv_len)
+        for bq, bk in ((32, 8), (8, 32), (64, 16)):
+            out = flash_attention(q, k, v, False, bq, bk, None, None,
+                                  kv_len)
+            np.testing.assert_allclose(
+                np.asarray(out[0, :23]), np.asarray(ref[0, :23]),
+                atol=1e-5, err_msg=f"bq={bq} bk={bk}")
+            np.testing.assert_allclose(
+                np.asarray(out[1, :57]), np.asarray(ref[1, :57]),
+                atol=1e-5, err_msg=f"bq={bq} bk={bk}")

@@ -135,3 +135,63 @@ def test_kernel_rejects_bad_gqa():
         paged_decode_attention(q, kp, vp,
                                jnp.zeros((3, n_log), jnp.int32),
                                jnp.zeros((3,), jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# head_block: the KV head-group compute knob (autotune-resolved)
+# ---------------------------------------------------------------------------
+
+
+def _ragged_setup(QH=4, KH=2):
+    q, kp, vp, P, ps, n_log = _setup(B=4, QH=QH, KH=KH)
+    pages = np.full((4, n_log), P, np.int32)
+    pages[0, :3] = [2, 5, 7]
+    pages[1, 0] = 1
+    pages[2, :n_log] = range(3, 3 + n_log)
+    positions = np.asarray([19, 0, n_log * ps - 1, n_log * ps], np.int32)
+    return q, kp, vp, jnp.asarray(pages), jnp.asarray(positions)
+
+
+@pytest.mark.parametrize("hb", [2, 4])
+def test_head_block_matches_per_head_loop(hb):
+    """The batched head-group path must agree with the per-head loop
+    (the bit-parity baseline) — same f32 math, only dot batching
+    changes."""
+    q, kp, vp, pages, pos = _ragged_setup(QH=8, KH=4)
+    base = paged_decode_attention(q, kp, vp, pages, pos, head_block=1)
+    out = paged_decode_attention(q, kp, vp, pages, pos, head_block=hb)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(base),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_head_block_default_resolves_to_safe_loop():
+    """head_block=None resolves from the tile table — the committed
+    seed is the per-head loop (1), so the default path stays
+    bit-identical to the oracle-gated baseline."""
+    from kubeflow_tpu.ops import autotune
+
+    q, kp, vp, pages, pos = _ragged_setup()
+    with autotune.record_resolutions() as rec:
+        out = paged_decode_attention(q, kp, vp, pages, pos)
+    base = paged_decode_attention(q, kp, vp, pages, pos, head_block=1)
+    assert np.array_equal(np.asarray(out), np.asarray(base))
+    summary = autotune.summarize_resolutions(rec)
+    assert summary and summary[0]["kernel"] == "paged_attn"
+    assert summary[0]["head_block"] == 1
+    assert summary[0]["source"] == "table"
+
+
+def test_head_block_override_must_divide_kv_heads():
+    q, kp, vp, pages, pos = _ragged_setup(QH=8, KH=4)
+    with pytest.raises(ValueError, match="head_block"):
+        paged_decode_attention(q, kp, vp, pages, pos, head_block=3)
+
+
+def test_head_block_matches_gather_oracle():
+    """End-to-end: the batched path agrees with the dense gather
+    oracle, sentinels and ragged rows in play."""
+    q, kp, vp, pages, pos = _ragged_setup(QH=8, KH=4)
+    ref = _gather_oracle(q, kp, vp, pages, pos)
+    out = paged_decode_attention(q, kp, vp, pages, pos, head_block=2)
+    np.testing.assert_allclose(np.asarray(out[:3]), np.asarray(ref[:3]),
+                               atol=1e-5, rtol=1e-5)

@@ -144,3 +144,71 @@ def test_sequence_parallel_impls_match_dense():
                 params, tokens)
             np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                        atol=2e-4, err_msg=impl)
+
+
+# ---------------------------------------------------------------------------
+# Tile-knob plumbing: attention_block_q/attention_block_k split + the
+# autotune resolution path (kubeflow_tpu/ops/autotune.py)
+# ---------------------------------------------------------------------------
+
+
+def test_attention_block_q_and_k_thread_as_independent_overrides():
+    """The split knobs reach the flash kernels as an override (recorded
+    with source="override"), fitted to divisors of the sequence."""
+    from kubeflow_tpu.ops import autotune
+
+    config = tiny_config(attention_impl="flash", attention_block_q=16,
+                         attention_block_k=32)
+    model, params, tokens = _init(config, seq=32)
+    with autotune.record_resolutions() as rec:
+        model.apply({"params": params}, tokens)
+    summary = autotune.summarize_resolutions(rec)
+    assert summary, "flash path must resolve tiles"
+    for d in summary:
+        assert d["source"] == "override"
+        assert (d["block_q"], d["block_k"]) == (16, 32)
+
+
+def test_default_none_blocks_resolve_per_kernel_key():
+    """attention_block_k=None (the new default) resolves each flash
+    kernel key independently instead of pinning one square edge."""
+    from kubeflow_tpu.ops import autotune
+
+    config = tiny_config(attention_impl="flash")
+    assert config.attention_block_k is None
+    model, params, tokens = _init(config, seq=32)
+    with autotune.record_resolutions() as rec:
+        jax.grad(lambda p: jnp.sum(
+            model.apply({"params": p}, tokens)))(params)
+    kernels = {d["kernel"] for d in autotune.summarize_resolutions(rec)}
+    assert {"flash_fwd", "flash_bwd_dq", "flash_bwd_dkv"} <= kernels
+
+
+def test_old_square_config_matches_new_default_numerically():
+    """Parity pin for the knob split: an old-style config (explicit
+    square attention_block_k=1024, the pre-PR default) and the new
+    None default produce identical logits at CPU-tier shapes (both fit
+    to the same full-sequence tile)."""
+    old = tiny_config(attention_impl="flash", attention_block_k=1024)
+    new = tiny_config(attention_impl="flash")
+    model_old, params, tokens = _init(old, seq=32)
+    model_new = Transformer(new)
+    lo = model_old.apply({"params": params}, tokens)
+    ln = model_new.apply({"params": params}, tokens)
+    assert np.array_equal(np.asarray(lo), np.asarray(ln))
+
+
+def test_auto_impl_selects_dense_oracle_off_tpu():
+    config = tiny_config(attention_impl="auto")
+    dense = tiny_config(attention_impl="dense")
+    model, params, tokens = _init(config, seq=16)
+    la = model.apply({"params": params}, tokens)
+    ld = Transformer(dense).apply({"params": params}, tokens)
+    assert np.array_equal(np.asarray(la), np.asarray(ld))
+
+
+def test_bad_tile_knob_rejected():
+    with pytest.raises(ValueError, match="attention_block_q"):
+        tiny_config(attention_block_q=0).validate()
+    with pytest.raises(ValueError, match="paged_head_block"):
+        tiny_config(paged_head_block=-1).validate()
