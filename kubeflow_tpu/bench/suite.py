@@ -185,8 +185,20 @@ def _step_telemetry_pass(step: Callable, sync: Callable[[], None],
 
         one_synced.jitted = jitted  # real recompile accounting (cache delta)
         wrapped = telem.wrap(one_synced)
-        for _ in range(n_steps):
-            wrapped()
+        # compile & memory evidence beside the goodput block
+        # (docs/OBSERVABILITY.md "Compile & memory"): a private ledger
+        # subscribed for the pass's duration records any backend
+        # compiles the pass triggers, and the AOT fingerprint/budget
+        # read prices the program's predicted footprint
+        from kubeflow_tpu.obs.xprof import CompileLedger, HbmSampler
+
+        ledger = CompileLedger()
+        ledger.install()
+        try:
+            for _ in range(n_steps):
+                wrapped()
+        finally:
+            ledger.uninstall()
         out: Dict[str, Any] = {"step_telemetry": telem.summary()}
         # the goodput block (docs/OBSERVABILITY.md "Goodput"): the
         # productive fraction of the pass's wall clock next to img/s,
@@ -197,6 +209,32 @@ def _step_telemetry_pass(step: Callable, sync: Callable[[], None],
         block = from_step_records(telem.recorder.records())
         if block:
             out["goodput"] = block
+        compile_block = ledger.summary()
+        if compile_block.get("count"):
+            out["compile"] = compile_block
+        memory: Dict[str, Any] = {}
+        try:
+            from kubeflow_tpu.obs.xprof import (
+                hlo_fingerprint,
+                memory_budget,
+            )
+
+            lower = getattr(jitted, "lower", None)
+            if lower is not None:
+                lowered = lower()
+                compiled = lowered.compile()
+                budget = memory_budget(compiled)
+                if budget:
+                    memory["budget_bytes"] = budget
+                    memory["fingerprint"] = hlo_fingerprint(lowered)
+        except Exception:  # noqa: BLE001 — evidence, never a failure
+            pass
+        watermark = HbmSampler().sample()
+        if watermark:
+            memory["hbm_bytes"] = {k: int(v)
+                                   for k, v in watermark.items()}
+        if memory:
+            out["memory"] = memory
         return out
     except Exception:  # noqa: BLE001 — evidence, never a bench failure
         return {}

@@ -59,11 +59,17 @@ Route parity with the reference's Express server
 - ``GET /api/jobs/<ns>/<name>/goodput`` — one job's goodput ledger:
   interval timeline, per-state fractions, and the worst badput
   interval's trace exemplar (resolves via ``GET /api/traces/<id>``)
+- ``GET /api/jobs/<ns>/<name>/profile`` — one job's compile & memory
+  profile (docs/OBSERVABILITY.md "Compile & memory"): event-sourced
+  compile count/seconds with per-module breakdown, static
+  ``memory_analysis`` budgets per HLO fingerprint, and the gang's live
+  HBM watermark from the beacon ``hbm`` blocks
 """
 
 from __future__ import annotations
 
 import abc
+import logging
 import os
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -74,6 +80,8 @@ from kubeflow_tpu.tenancy.kfam import AccessManagementApi
 from kubeflow_tpu.tenancy.profiles import PROFILE_API_VERSION, PROFILE_KIND
 from kubeflow_tpu.utils import DEFAULT_REGISTRY
 from kubeflow_tpu.utils.jsonhttp import serve_json
+
+log = logging.getLogger(__name__)
 
 
 class MetricsService(abc.ABC):
@@ -309,6 +317,10 @@ class DashboardApi:
                         and parts[2] == "goodput":
                     self._authz(user, parts[0], "tpujobs")
                     return self.job_goodput(parts[0], parts[1])
+                if len(parts) == 3 and parts[0] and parts[1] \
+                        and parts[2] == "profile":
+                    self._authz(user, parts[0], "tpujobs")
+                    return self.job_profile(parts[0], parts[1])
                 return 404, {"error": f"no route {path}"}
             if path.startswith("/api/tpujobs/"):
                 parts = path[len("/api/tpujobs/"):].split("/")
@@ -816,6 +828,13 @@ class DashboardApi:
                     or telemetry_view({}, straggler_k))
         trace_id, _ = tpujob_trace_ids(
             ns, name, job.get("metadata", {}).get("uid", ""))
+        # the hbm block rides the shared view builder; a CR status
+        # aggregated by a pre-watermark operator lacks the key, so the
+        # fallback path backfills the empty shape (keys always present)
+        if "hbm" not in view:
+            from kubeflow_tpu.obs.steps import _hbm_view
+
+            view["hbm"] = _hbm_view({})
         resize = dict(status.get("resize") or {})
         from kubeflow_tpu.obs import goodput as gp
 
@@ -824,6 +843,12 @@ class DashboardApi:
             "namespace": ns,
             "phase": status.get("phase", "Pending"),
             "restarts": status.get("restarts", 0),
+            # compile summary (docs/OBSERVABILITY.md "Compile &
+            # memory"): event-sourced count/seconds so the tuning
+            # harvester and autoscaler read the startup tax without a
+            # second endpoint (the full breakdown lives at
+            # /api/jobs/<ns>/<name>/profile)
+            "compile": self._compile_summary(ns, name),
             # efficiency summary (docs/OBSERVABILITY.md "Goodput"): the
             # productive fraction of the job's wall clock, inline so
             # the tuning objective harvester can prefer efficient
@@ -843,6 +868,110 @@ class DashboardApi:
             },
             "traceId": trace_id,
             **view,
+        }
+
+    def _compile_summary(self, ns: str, name: str) -> Dict[str, Any]:
+        """``compile.{count,seconds}`` for one job: the scraped
+        ``kftpu_compile_seconds`` histogram through the tsdb (sum
+        across its per-module series), else the in-process xprof
+        totals — the all-in-one-process tier."""
+        count = 0.0
+        seconds = 0.0
+        found = False
+        if self.tsdb is not None:
+            try:
+                for _labels, p in self.tsdb.latest(
+                        "kftpu_compile_seconds_count",
+                        {"namespace": ns, "job": name}):
+                    count += p.value
+                    found = True
+                for _labels, p in self.tsdb.latest(
+                        "kftpu_compile_seconds_sum",
+                        {"namespace": ns, "job": name}):
+                    seconds += p.value
+            except Exception:  # noqa: BLE001 — telemetry view never 500s
+                log.debug("tsdb compile read failed", exc_info=True)
+        if not found:
+            from kubeflow_tpu.obs import xprof
+
+            totals = xprof.job_compile_totals(ns, name)
+            count = float(totals.get("count", 0) or 0)
+            seconds = float(totals.get("seconds", 0.0) or 0.0)
+        return {"count": int(count), "seconds": round(seconds, 6)}
+
+    def job_profile(self, ns: str, name: str) -> Tuple[int, Any]:
+        """The compile & memory profile of one TpuJob
+        (docs/OBSERVABILITY.md "Compile & memory"): the event-sourced
+        compile summary with its per-module/shape-class breakdown,
+        the static ``memory_analysis`` budgets recorded beside each
+        HLO fingerprint, the gang's live HBM watermark, and the
+        goodput ledger's measured compile states — the price tag the
+        ROADMAP's compile-cache item is adjudicated against."""
+        from kubeflow_tpu.manifests.components.tpujob_operator import (
+            API_VERSION,
+            TPUJOB_KIND,
+        )
+        from kubeflow_tpu.obs import xprof
+        from kubeflow_tpu.obs.steps import (
+            _hbm_view,
+            read_beacons,
+            tpujob_trace_ids,
+        )
+
+        job = self.client.get_or_none(API_VERSION, TPUJOB_KIND, ns, name)
+        if job is None:
+            return 404, {"error": f"tpujob {name!r} not found"}
+        status = job.get("status", {}) or {}
+        trace_id, _ = tpujob_trace_ids(
+            ns, name, job.get("metadata", {}).get("uid", ""))
+
+        compile_block = self._compile_summary(ns, name)
+        series: List[Dict[str, Any]] = []
+        hbm_series: List[Dict[str, Any]] = []
+        if self.tsdb is not None:
+            try:
+                for labels, p in self.tsdb.latest(
+                        "kftpu_compile_seconds_sum",
+                        {"namespace": ns, "job": name}):
+                    series.append({"labels": dict(labels),
+                                   "seconds": round(p.value, 6)})
+                for labels, p in self.tsdb.latest(
+                        "kftpu_hbm_bytes",
+                        {"namespace": ns, "job": name}):
+                    hbm_series.append({"labels": dict(labels),
+                                       "bytes": p.value})
+            except Exception:  # noqa: BLE001
+                log.debug("tsdb profile read failed", exc_info=True)
+        series.sort(key=lambda r: sorted(r["labels"].items()))
+        hbm_series.sort(key=lambda r: sorted(r["labels"].items()))
+        if series:
+            compile_block["series"] = series
+
+        # the gang's live watermark, beacon-first (fresher than any
+        # scrape), the scraped gauge series as the fallback shape
+        try:
+            beacons = read_beacons(self.client, ns, name)
+        except ApiError:
+            beacons = {}
+        hbm = _hbm_view(beacons)
+        g = status.get("goodput") or {}
+        secs = g.get("seconds") or {}
+        return 200, {
+            "name": name,
+            "namespace": ns,
+            "phase": status.get("phase", "Pending"),
+            "traceId": trace_id,
+            "compile": compile_block,
+            "hbm": {**hbm, "series": hbm_series},
+            # every fingerprint's predicted footprint (in-process; a
+            # deployed fleet reads kftpu_hbm_budget_bytes instead)
+            "budgets": xprof.budgets(),
+            "goodput": {
+                "startupCompileSeconds": round(
+                    float(secs.get("startup_compile", 0.0) or 0.0), 6),
+                "recompileSeconds": round(
+                    float(secs.get("recompile", 0.0) or 0.0), 6),
+            },
         }
 
     # -- studies (katib-ui parity) ----------------------------------------

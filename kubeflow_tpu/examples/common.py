@@ -122,12 +122,42 @@ def make_step_telemetry(*, tokens_per_step: int = 0,
     kwargs.setdefault("beacon_every", 10)
     kwargs.setdefault("span_every", 10)
     kwargs.setdefault("n_chips", jax.device_count())
+    if "hbm_sampler" not in kwargs:
+        # live HBM watermarks on every beacon (docs/OBSERVABILITY.md
+        # "Compile & memory"); CPU backends (memory_stats() is None)
+        # degrade to no hbm block at zero cost
+        from kubeflow_tpu.obs.xprof import HbmSampler
+
+        kwargs["hbm_sampler"] = HbmSampler(
+            namespace=penv.namespace, job=penv.job_name,
+            worker=penv.process_id)
     return StepTelemetry(
         job=penv.job_name, namespace=penv.namespace,
         uid=job_uid, worker=penv.process_id,
         tokens_per_step=tokens_per_step,
         examples_per_step=examples_per_step,
         beacon_sink=sink, **kwargs)
+
+
+def make_compile_ledger(*, install: bool = True):
+    """A :class:`~kubeflow_tpu.obs.xprof.CompileLedger` wired from the
+    operator's env contract (job/namespace/uid identity so compile
+    spans join the job's trace tree) and, by default, subscribed to
+    ``jax.monitoring`` — from here on every backend compile this
+    worker pays becomes a ``kftpu_compile_seconds`` observation and a
+    ground-truth ``startup_compile`` second in the goodput ledger.
+    Call ``.uninstall()`` at shutdown (or use it as a context
+    manager)."""
+    from kubeflow_tpu.obs.steps import ENV_JOB_UID
+    from kubeflow_tpu.obs.xprof import CompileLedger
+
+    penv = dist.from_env()
+    ledger = CompileLedger(
+        namespace=penv.namespace, job=penv.job_name,
+        uid=os.environ.get(ENV_JOB_UID, ""), worker=penv.process_id)
+    if install:
+        ledger.install()
+    return ledger
 
 
 def report_tuning_metrics(step: int, metrics: Dict[str, Any],

@@ -55,6 +55,17 @@ from kubeflow_tpu.obs.goodput import (  # noqa: F401
     observe_checkpoint_save,
     worst_badput_interval,
 )
+from kubeflow_tpu.obs.xprof import (  # noqa: F401
+    CompileEvent,
+    CompileLedger,
+    HbmSampler,
+    hlo_fingerprint,
+    job_compile_seconds,
+    memory_budget,
+    observe_compile,
+    record_memory_budget,
+    shape_class_of,
+)
 from kubeflow_tpu.obs.steps import (  # noqa: F401
     FlightRecorder,
     StepRecord,

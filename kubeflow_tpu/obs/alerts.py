@@ -492,10 +492,18 @@ def default_rules() -> List[Rule]:
       near zero for a minute.
     - **queue-depth-sustained** — gangs waiting in the scheduler queue
       (``kftpu_queue_depth{state="Queued"}``, PR 8) for 10 minutes.
-    - **recompile-storm** — ``train_recompiles_total`` (PR 5) climbing
-      at runtime: compilation-cache misses are eating step time.
+    - **recompile-storm** — real XLA compile events
+      (``kftpu_compile_seconds_count``, the xprof ledger) arriving at
+      a sustained rate: startup compiles age out of the 5m window, so
+      an elevated rate two minutes running IS cache churn — rebased
+      from the old ``train_recompiles_total`` inference now that the
+      ledger records actual backend compiles.
     - **straggler-flagged** — a TpuJob has had a flagged straggler
       (``kftpu_job_stragglers``, PR 5) for 5 minutes.
+    - **hbm-headroom** — ``kftpu_hbm_utilization`` (the xprof
+      watermark sampler's in_use/limit) above 92% for 2 minutes: the
+      job or engine is about to OOM or fragment; shed, shrink, or
+      repack before the allocator does it for you.
     - **job-badput-burn** — the goodput ledger's chips-weighted badput
       ratio (``kftpu_fleet_badput_chip_seconds_total`` over
       ``kftpu_fleet_chip_seconds_total``, docs/OBSERVABILITY.md
@@ -541,12 +549,12 @@ def default_rules() -> List[Rule]:
             summary="scheduler gang queue depth high for 10m"),
         ThresholdRule(
             name="recompile-storm",
-            metric="train_recompiles_total",
+            metric="kftpu_compile_seconds_count",
             func="rate", window_s=300.0,
             op=">", threshold=0.02, for_s=120.0,
             severity="warning",
-            summary="training jobs recompiling at runtime (jit cache "
-                    "churn eating step time)"),
+            summary="XLA compile events arriving at a sustained rate "
+                    "(jit cache churn eating step time)"),
         ThresholdRule(
             name="straggler-flagged",
             metric="kftpu_job_stragglers",
@@ -554,6 +562,14 @@ def default_rules() -> List[Rule]:
             severity="warning",
             summary="a TpuJob gang has a straggling worker flagged "
                     "for 5m"),
+        ThresholdRule(
+            name="hbm-headroom",
+            metric="kftpu_hbm_utilization",
+            func="instant", op=">", threshold=0.92, for_s=120.0,
+            severity="critical",
+            summary="device HBM in_use above 92% of limit for 2m — "
+                    "headroom nearly exhausted (OOM/fragmentation "
+                    "imminent)"),
         BurnRateRule(
             name="job-badput-burn",
             numerator="kftpu_fleet_badput_chip_seconds_total",

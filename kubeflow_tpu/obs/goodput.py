@@ -159,6 +159,11 @@ class GoodputSignals:
     stragglers: bool = False            # telemetry straggler flags
     restore_step: Optional[int] = None  # most recent lastCheckpointStep
     ckpt_save_seconds: float = 0.0      # cumulative worker save seconds
+    # cumulative event-sourced XLA compile seconds (the xprof ledger's
+    # kftpu_compile_seconds sum). None = no ground-truth source for
+    # this job — compile states stay beacon-INFERRED; a float (even
+    # 0.0) means measured, and inference is suppressed in its favor
+    compile_seconds: Optional[float] = None
 
 
 def _coarse(markers: Mapping[str, Any], s: GoodputSignals) -> str:
@@ -177,8 +182,14 @@ def _coarse(markers: Mapping[str, Any], s: GoodputSignals) -> str:
 
 
 def _running(markers: Mapping[str, Any], s: GoodputSignals) -> str:
+    # a ground-truth compile source (the xprof ledger) means compile
+    # seconds were already carved EXACTLY from the window before this
+    # coarse attribution runs — inferring STARTUP_COMPILE/RECOMPILE
+    # here on top would double-bill the same seconds, so both
+    # inferences yield when measurement exists
+    measured = s.compile_seconds is not None
     if int(s.last_step) <= 0:
-        return STARTUP_COMPILE
+        return UNATTRIBUTED if measured else STARTUP_COMPILE
     if (s.restore_step is not None
             and int(s.last_step) <= int(s.restore_step)):
         # re-ganged after a preemption/resize and the beacons have not
@@ -186,7 +197,8 @@ def _running(markers: Mapping[str, Any], s: GoodputSignals) -> str:
         # topology (telemetry.lastStep survives the teardown, so this
         # reads the STALE pre-teardown step until the resume beacons)
         return RESTORE
-    if int(s.recompiles) > int(markers.get("recompiles", 0)):
+    if (not measured
+            and int(s.recompiles) > int(markers.get("recompiles", 0))):
         return RECOMPILE
     if s.stragglers:
         return STRAGGLER_STALL
@@ -223,6 +235,7 @@ def fold(prev: Optional[Mapping[str, Any]],
                 "recompiles": int(s.recompiles),
                 "preemptions": int(s.preemptions),
                 "ckptSaveSeconds": float(s.ckpt_save_seconds),
+                "compileSeconds": float(s.compile_seconds or 0.0),
                 "hadPods": bool(s.has_pods),
             },
         }
@@ -255,10 +268,38 @@ def fold(prev: Optional[Mapping[str, Any]],
             save_seen = observed
         delta = max(observed - save_seen, 0.0)
         save = min(delta, window)
+
+    # carve second: event-sourced compile seconds (the xprof ledger's
+    # cumulative total). This is MEASUREMENT, not inference — when the
+    # signal is present it is attributed exactly and _running's
+    # beacon-gap inference of the compile states stands down
+    comp = 0.0
+    comp_seen = float(m.get("compileSeconds", 0.0))
+    measured = s.compile_seconds is not None
+    if s.has_pods and measured:
+        observed_c = float(s.compile_seconds)
+        if "compileSeconds" not in m:
+            # the source appeared mid-life (operator upgrade, ledger
+            # attach): baseline without attributing its history —
+            # those compiles happened in windows already closed
+            comp_seen = observed_c
+        if observed_c < comp_seen:
+            comp_seen = observed_c  # counter reset: re-ganged workers
+        delta_c = max(observed_c - comp_seen, 0.0)
+        comp = min(delta_c, window - save)
     state = _coarse(m, s)
     if save > 0:
         carve.append((CHECKPOINT_SAVE, save))
-    rest = window - save
+    if comp > 0:
+        # before any step the compile IS the startup tax; afterwards
+        # it is a recompile eating into productive time
+        comp_state = (STARTUP_COMPILE if int(s.last_step) <= 0
+                      else RECOMPILE)
+        if carve and carve[-1][0] == comp_state:
+            carve[-1] = (comp_state, carve[-1][1] + comp)
+        else:
+            carve.append((comp_state, comp))
+    rest = window - save - comp
     if rest > 0:
         if carve and carve[-1][0] == state:
             carve[-1] = (state, carve[-1][1] + rest)
@@ -297,6 +338,11 @@ def fold(prev: Optional[Mapping[str, Any]],
                               int(s.recompiles))
     m["hadPods"] = bool(s.has_pods)
     m["ckptSaveSeconds"] = save_seen + save
+    if measured:
+        # advance only by what was attributed: a compile longer than
+        # one window spills its remainder into the next (the
+        # checkpoint-save stance)
+        m["compileSeconds"] = comp_seen + comp
     if s.has_pods and not s.preemption_requested:
         # re-placed (and no eviction being signaled right now): future
         # no-pod gaps are fresh queue waits, not this preemption's

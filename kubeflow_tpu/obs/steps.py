@@ -296,6 +296,7 @@ class StepTelemetry:
         beacon_every: int = 1,
         dump_dir: Optional[str] = None,
         rate_window: int = 20,
+        hbm_sampler: Optional[Any] = None,
     ) -> None:
         self.job = job
         self.namespace = namespace
@@ -330,6 +331,12 @@ class StepTelemetry:
         self.beacon_every = max(1, beacon_every)
         self.dump_dir = (dump_dir if dump_dir is not None
                          else os.environ.get(ENV_FLIGHT_DIR) or None)
+        # an obs.xprof.HbmSampler (or anything with sample()/
+        # beacon_fields()); sampled once per step so the beacon
+        # carries live device-memory watermarks. None — and every
+        # CPU backend, whose sampler returns None — degrades to no
+        # hbm block at all (telemetry contract: never fails a step)
+        self.hbm_sampler = hbm_sampler
 
         self.step = 0
         self.recompiles = 0
@@ -437,6 +444,11 @@ class StepTelemetry:
         if mfu is not None:
             self._g_mfu.set(mfu, **self._labels)
 
+        if self.hbm_sampler is not None:
+            try:
+                self.hbm_sampler.sample()
+            except Exception:  # noqa: BLE001 — watermarks never fail a step
+                log.debug("hbm sample failed (continuing)", exc_info=True)
         if self.span_every and (self.step % self.span_every == 0
                                 or status != "OK"):
             self._record_step_span(rec)
@@ -516,6 +528,12 @@ class StepTelemetry:
         """The per-host health beacon the operator aggregates."""
         rates = self._rates()
         mfu = self.mfu()
+        hbm: Dict[str, Any] = {}
+        if self.hbm_sampler is not None:
+            try:
+                hbm = self.hbm_sampler.beacon_fields() or {}
+            except Exception:  # noqa: BLE001
+                hbm = {}
         return {
             "worker": self.worker,
             "job": self.job,
@@ -527,6 +545,7 @@ class StepTelemetry:
             "recompiles": self.recompiles,
             "lastStepSeconds": round(self._durations[-1], 6)
             if self._durations else None,
+            "hbm": hbm,
             "ts": self.clock(),
         }
 
@@ -739,6 +758,26 @@ def flag_stragglers(
     return median, lags, stragglers
 
 
+def _hbm_view(beacons: Mapping[int, Mapping[str, Any]]) -> Dict[str, Any]:
+    """Gang-level HBM watermark from the per-worker beacon ``hbm``
+    blocks: MAX across workers (the fullest device gates the gang —
+    it OOMs first), same shape whether zero or all workers report."""
+    blocks = [b.get("hbm") for b in beacons.values()
+              if isinstance(b.get("hbm"), Mapping) and b.get("hbm")]
+    if not blocks:
+        return {"inUseBytes": 0, "peakBytes": 0, "limitBytes": 0,
+                "workersReporting": 0}
+    return {
+        "inUseBytes": max(int(b.get("inUseBytes", 0) or 0)
+                          for b in blocks),
+        "peakBytes": max(int(b.get("peakBytes", 0) or 0)
+                         for b in blocks),
+        "limitBytes": max(int(b.get("limitBytes", 0) or 0)
+                          for b in blocks),
+        "workersReporting": len(blocks),
+    }
+
+
 def telemetry_view(beacons: Mapping[int, Mapping[str, Any]],
                    straggler_k: int = DEFAULT_STRAGGLER_STEPS
                    ) -> Dict[str, Any]:
@@ -755,6 +794,7 @@ def telemetry_view(beacons: Mapping[int, Mapping[str, Any]],
         # to guess which shape they got
         return {"lastStep": 0, "medianStep": 0.0, "stepsPerSec": 0.0,
                 "tokensPerSec": 0.0, "mfu": None, "recompiles": 0,
+                "hbm": _hbm_view(beacons),
                 "workers": {}, "stragglers": [],
                 "stragglerThreshold": max(1, int(straggler_k))}
     steps_by = {w: int(b.get("step", 0)) for w, b in beacons.items()}
@@ -781,6 +821,7 @@ def telemetry_view(beacons: Mapping[int, Mapping[str, Any]],
         "mfu": round(_median(mfus), 4) if mfus else None,
         "recompiles": sum(int(b.get("recompiles") or 0)
                           for b in beacons.values()),
+        "hbm": _hbm_view(beacons),
         "workers": workers,
         "stragglers": [str(w) for w in stragglers],
         "stragglerThreshold": max(1, int(straggler_k)),

@@ -1027,6 +1027,7 @@ class TpuJobOperator:
             stragglers=bool(tel.get("stragglers")),
             restore_step=restore_step,
             ckpt_save_seconds=self._ckpt_save_seconds(ns, name),
+            compile_seconds=self._compile_seconds(ns, name),
         )
 
     def _ckpt_save_seconds(self, ns: str, name: str) -> float:
@@ -1053,6 +1054,31 @@ class TpuJobOperator:
                 # summing would carve N× phantom save seconds
                 return max(p.value for _labels, p in pts)
         return goodput_mod.checkpoint_save_seconds(ns, name)
+
+    def _compile_seconds(self, ns: str, name: str) -> Optional[float]:
+        """Cumulative event-sourced XLA compile seconds for one job —
+        the ledger's ground-truth ``startup_compile``/``recompile``
+        source. Reads the scraped ``kftpu_compile_seconds_sum``
+        through the tsdb (SUM across series: each is one module ×
+        shape class, disjoint wall time; a gang's workers emit
+        identical label sets so cross-worker samples merge instead of
+        multiplying), else the in-process xprof totals. None — no
+        ledger anywhere for this job — keeps the fold on beacon
+        inference: absence of evidence is not zero compile seconds."""
+        if self.tsdb is not None:
+            try:
+                pts = self.tsdb.latest(
+                    "kftpu_compile_seconds_sum",
+                    {"namespace": ns, "job": name})
+            except Exception:  # noqa: BLE001 — monitoring never fails jobs
+                log.exception("tsdb compile-seconds read failed for "
+                              "%s/%s", ns, name)
+                pts = []
+            if pts:
+                return sum(p.value for _labels, p in pts)
+        from kubeflow_tpu.obs import xprof
+
+        return xprof.job_compile_seconds(ns, name)
 
     def _clear_job_gauges(self, ns: str, name: str) -> None:
         """Terminal/deleted jobs must not export their last telemetry

@@ -284,7 +284,8 @@ class DecodeEngine:
                  precompile: bool = False,
                  autostart: bool = True, name: str = "",
                  clock: Optional[Clock] = None,
-                 tracer: Optional[Tracer] = None) -> None:
+                 tracer: Optional[Tracer] = None,
+                 hbm_sampler=None) -> None:
         self.config = config
         self.slots = slots
         # paged KV cache + chunked prefill (docs/SERVING.md). Dense mode
@@ -415,6 +416,14 @@ class DecodeEngine:
         # the NORMALIZED name: every engine series must share one model
         # label value or per-model joins (slots vs pages) find no row
         _slots_g.set(self.slots, model=self.name)
+        # an obs.xprof.HbmSampler sampled once per admit cycle, so the
+        # admission decision's watermark (weights + KV + transient
+        # prefill spike) is what kftpu_hbm_bytes{model=...} shows; CPU
+        # backends (memory_stats() is None) degrade to no series
+        if hbm_sampler is not None and not getattr(
+                hbm_sampler, "model", ""):
+            hbm_sampler.model = self.name
+        self.hbm_sampler = hbm_sampler
         self._params = params
         self._pending: "queue.Queue[_Request]" = queue.Queue()
         self._active: List[Optional[_Slot]] = [None] * slots
@@ -1158,6 +1167,11 @@ class DecodeEngine:
         return True
 
     def _admit(self, timeout: float) -> bool:
+        if self.hbm_sampler is not None:
+            try:
+                self.hbm_sampler.sample()
+            except Exception:  # noqa: BLE001 — watermarks never gate admits
+                log.debug("hbm sample failed (continuing)", exc_info=True)
         if self.paged:
             return self._admit_paged(timeout)
         return self._admit_dense(timeout)

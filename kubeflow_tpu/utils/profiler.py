@@ -91,11 +91,30 @@ class StepProfiler:
     @classmethod
     def from_env(cls, environ=None,
                  clock: Optional[Clock] = None) -> "StepProfiler":
+        """Build from the operator's env contract.
+
+        A malformed window int must never kill the worker at boot — a
+        typo'd annotation would crash every pod in the gang before the
+        first step. Warn and come up with profiling disabled instead.
+        """
         env = os.environ if environ is None else environ
+        logdir = env.get(ENV_PROFILE_DIR) or None
+        window = {ENV_PROFILE_START: 10, ENV_PROFILE_STEPS: 3}
+        for key, default in list(window.items()):
+            raw = env.get(key)
+            if raw is None or raw == "":
+                continue
+            try:
+                window[key] = int(raw)
+            except (TypeError, ValueError):
+                log.warning(
+                    "%s=%r is not an integer; profiling disabled for "
+                    "this run", key, raw)
+                logdir = None
         return cls(
-            env.get(ENV_PROFILE_DIR) or None,
-            start=int(env.get(ENV_PROFILE_START, "10")),
-            n_steps=int(env.get(ENV_PROFILE_STEPS, "3")),
+            logdir,
+            start=window[ENV_PROFILE_START],
+            n_steps=window[ENV_PROFILE_STEPS],
             clock=clock,
         )
 

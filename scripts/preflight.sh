@@ -67,6 +67,17 @@
 #      kernels and the paged kernel with every committed tile config
 #      against the default-tile oracle — a bad table edit fails here
 #      before a bench round burns chip time (PERF.md "Tile autotune")
+#  12. compile/HBM profile smoke (scripts/profile_smoke.py): a live
+#      jax.jit compile lands in the CompileLedger via jax.monitoring
+#      exactly once, timed_compile fingerprints the HLO + records the
+#      memory_analysis budget, the CPU HbmSampler degrades silently,
+#      and on a fake clock injected compile events become the goodput
+#      ledger's ground truth (startup_compile == event-sourced seconds
+#      exactly), kftpu_compile_seconds reads back through the tsdb +
+#      /api/metrics/query, /api/jobs/<ns>/<name>/profile serves the
+#      summary, and an injected HBM climb walks hbm-headroom
+#      Pending -> Firing -> Resolved with one Event per transition
+#      (docs/OBSERVABILITY.md "Compile & memory")
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -107,6 +118,9 @@ JAX_PLATFORMS=cpu python scripts/goodput_smoke.py || rc=1
 
 echo "== preflight: tile table validate =="
 JAX_PLATFORMS=cpu python scripts/tile_sweep.py --validate || rc=1
+
+echo "== preflight: compile/HBM profile smoke =="
+JAX_PLATFORMS=cpu python scripts/profile_smoke.py || rc=1
 
 if [ "$rc" -ne 0 ]; then
     echo "preflight: FAILED" >&2

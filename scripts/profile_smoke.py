@@ -1,0 +1,289 @@
+#!/usr/bin/env python
+"""Compile/HBM profile smoke gate (scripts/preflight.sh stage 12).
+
+Two planes, both on the CPU tier (docs/OBSERVABILITY.md "Compile &
+memory"):
+
+Real-jax plane — a live ``jax.jit`` compile must land in the
+:class:`~kubeflow_tpu.obs.xprof.CompileLedger` through the
+``jax.monitoring`` subscription, exactly once per compilation (jax
+emits three duration events per compile; the jaxpr-trace and
+MLIR-lowering ones must not count); ``timed_compile`` must fingerprint
+the HLO and record a ``memory_analysis`` budget beside it; and the
+:class:`~kubeflow_tpu.obs.xprof.HbmSampler` must degrade silently on
+CPU (``memory_stats() is None``).
+
+Fake-clock operator plane — injected compile events with job identity
+become the goodput ledger's ground truth: ``startup_compile`` matches
+the event-sourced seconds exactly (no beacon inference), the
+histogram reads back through the tsdb and ``GET /api/metrics/query``,
+``GET /api/jobs/<ns>/<name>/profile`` serves the compile summary +
+budgets + beacon watermark, and an injected HBM climb walks the
+``hbm-headroom`` rule ``Pending -> Firing -> Resolved`` with exactly
+one k8s Event per transition.
+
+Exits nonzero on any violated invariant.
+"""
+
+import math
+import sys
+
+sys.path.insert(0, ".")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from kubeflow_tpu.dashboard.server import DashboardApi  # noqa: E402
+from kubeflow_tpu.k8s import FakeKubeClient  # noqa: E402
+from kubeflow_tpu.obs import xprof  # noqa: E402
+from kubeflow_tpu.obs.alerts import (  # noqa: E402
+    FIRING,
+    INACTIVE,
+    PENDING,
+    RESOLVED,
+    AlertManager,
+    default_rules,
+)
+from kubeflow_tpu.obs.steps import publish_beacon  # noqa: E402
+from kubeflow_tpu.obs.trace import SpanCollector, Tracer  # noqa: E402
+from kubeflow_tpu.obs.tsdb import TimeSeriesStore  # noqa: E402
+from kubeflow_tpu.obs.xprof import CompileLedger, HbmSampler  # noqa: E402
+from kubeflow_tpu.operators.tpujob import (  # noqa: E402
+    JOB_LABEL,
+    PreemptionCheckpointer,
+    TpuJobOperator,
+    tpujob,
+)
+from kubeflow_tpu.manifests.components.tpujob_operator import (  # noqa: E402
+    API_VERSION,
+    TPUJOB_KIND,
+)
+from kubeflow_tpu.platform.local import fake_slice_nodes  # noqa: E402
+from kubeflow_tpu.scheduler.queue import GangQueue  # noqa: E402
+from kubeflow_tpu.utils import DEFAULT_REGISTRY  # noqa: E402
+
+
+class Clock:
+    def __init__(self, now=1000.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+
+class NoDiskCkpt(PreemptionCheckpointer):
+    def save(self, job):
+        return None
+
+    def latest_step(self, ns, name):
+        return None
+
+
+def check(ok, what):
+    if not ok:
+        print(f"FAIL: {what}")
+        sys.exit(1)
+    print(f"ok: {what}")
+
+
+def real_jax_plane():
+    """Live compiles on the CPU backend: monitoring subscription,
+    one-event-per-compile filter, AOT fingerprint + budget, silent
+    HBM degrade. Returns the recorded fingerprint."""
+    ledger = CompileLedger(namespace="smoke", job="lab", worker=0)
+    check(ledger.install() is True, "monitoring listener registered")
+    check(ledger.install() is False,
+          "second install is a no-op (no double subscription)")
+
+    x = jnp.arange(8, dtype=jnp.float32)  # eager compile BEFORE count
+    before = len(ledger.events)
+
+    def fresh(v):
+        return (v * 2.0 + 1.0).sum()
+
+    jax.jit(fresh)(x).block_until_ready()
+    got = len(ledger.events) - before
+    check(got == 1,
+          f"one jit compile -> exactly one ledger event (got {got}; "
+          "jaxpr/MLIR duration events filtered out)")
+    ev = ledger.events[-1]
+    check(ev.seconds >= 0.0 and ev.generation == "cpu",
+          "event carries wall seconds + backend generation")
+
+    check(ledger.uninstall() is True, "explicit teardown unregisters")
+    check(ledger.uninstall() is False, "second uninstall is a no-op")
+    before = len(ledger.events)
+
+    def after_teardown(v):
+        return (v - 3.0) * v
+
+    jax.jit(after_teardown)(x).block_until_ready()
+    check(len(ledger.events) == before,
+          "no events recorded after uninstall")
+
+    # AOT wrapper fallback: fingerprint + memory_analysis budget
+    y = jnp.ones((8, 8), dtype=jnp.float32)
+
+    def mat(v):
+        return v @ v
+
+    compiled = ledger.timed_compile(jax.jit(mat), y, module="mat")
+    ev = ledger.events[-1]
+    check(ev.module == "mat" and ev.shape_class == "seq128_float32"
+          and len(ev.fingerprint) == 16,
+          "timed_compile records module/shape-class/fingerprint")
+    budget = xprof.budget_for(ev.fingerprint)
+    check(budget is not None
+          and budget["bytes"].get("argument", 0) >= y.nbytes,
+          "memory_analysis budget recorded beside the fingerprint")
+    z = compiled(y)
+    check(z.shape == (8, 8), "timed_compile returns the executable")
+
+    # CPU silent degrade: real memory_stats() is None
+    s = HbmSampler(namespace="smoke", job="lab", worker=0)
+    check(s.sample() is None and s.beacon_fields() == {},
+          "CPU memory_stats() is None -> sampler degrades silently")
+    return ev.fingerprint
+
+
+def main():
+    fingerprint = real_jax_plane()
+    xprof._reset_job_totals()  # isolate the operator plane
+
+    ns = "smoke"
+    client = FakeKubeClient()
+    for node in fake_slice_nodes("v5e-8", count=1):
+        client.create(node)
+    clock = Clock()
+    collector = SpanCollector()
+    tracer = Tracer(collector, clock=clock)
+    q = GangQueue(client, clock=clock, tracer=tracer,
+                  checkpoint_step=lambda ns, name: None)
+    op = TpuJobOperator(client, clock=clock, tracer=tracer, queue=q,
+                        checkpointer=NoDiskCkpt())
+    store = TimeSeriesStore(clock=clock)
+    rule = next(r for r in default_rules() if r.name == "hbm-headroom")
+    mgr = AlertManager(store, [rule], client=client, namespace=ns,
+                       clock=clock, tracer=tracer)
+    transitions = []
+
+    def tick(dt=10.0):
+        clock.now += dt
+        op.reconcile(ns, "train")
+        store.sample_registry(DEFAULT_REGISTRY)
+        for st in mgr.evaluate():
+            transitions.append((st.rule.name, st.state))
+
+    client.create(tpujob("train", ns, {
+        "image": "x", "slices": 1, "hostsPerSlice": 1}))
+    op.reconcile(ns, "train")
+    uid = client.get(API_VERSION, TPUJOB_KIND, ns,
+                     "train")["metadata"]["uid"]
+    pods = client.list("v1", "Pod", ns,
+                       label_selector={JOB_LABEL: "train"})
+    check(len(pods) == 1, "gang placed")
+    for pod in pods:
+        pod.setdefault("status", {})["phase"] = "Running"
+        client.update_status(pod)
+    # the worker's ledger boots WITH the gang: constructing it
+    # announces the ground-truth source, so beacon inference never
+    # attributes a compile second on this job
+    led = CompileLedger(namespace=ns, job="train", uid=uid, worker=0,
+                        clock=clock, tracer=tracer)
+    tick()  # first fold: measured source present, zero seconds so far
+
+    led.record("train_step", 4.5, shape_class="seq128_float32")
+    led.record("train_step", 3.0, shape_class="seq128_float32")
+    tick(dt=60.0)  # the fold carves exactly the event-sourced seconds
+
+    g = client.get(API_VERSION, TPUJOB_KIND, ns,
+                   "train")["status"]["goodput"]
+    check(math.isclose(g["seconds"].get("startup_compile", 0.0), 7.5,
+                       abs_tol=1e-9),
+          "startup_compile == event-sourced compile seconds, exactly")
+    check(g["seconds"].get("recompile", 0.0) == 0.0,
+          "no recompile attributed before the first step")
+    check(g["seconds"].get("unattributed", 0.0) > 0.0,
+          "measured source -> beacon inference stood down "
+          "(rest of the window is unattributed, not startup_compile)")
+
+    # the histogram reads back through the tsdb + query API
+    api = DashboardApi(client, authorize=lambda *a: True, tsdb=store,
+                       collector=collector)
+    code, body = api.handle(
+        "GET",
+        "/api/metrics/query?metric=kftpu_compile_seconds_sum"
+        f"&label=namespace:{ns}&label=job:train", None)
+    check(code == 200 and body["result"]
+          and math.isclose(sum(r["value"] for r in body["result"]),
+                           7.5, abs_tol=1e-9),
+          "kftpu_compile_seconds reads back through /api/metrics/query")
+
+    # beacon carries the watermark the profile route serves
+    mem = {"bytes_in_use": 10 << 30, "peak_bytes_in_use": 11 << 30,
+           "bytes_limit": 16 << 30}
+    sampler = HbmSampler(namespace=ns, job="train", worker=0,
+                         source=lambda: dict(mem))
+    check(sampler.sample() is not None, "injected source samples")
+    publish_beacon(client, ns, "train", 0,
+                   {"step": 0, "hbm": sampler.beacon_fields()},
+                   job_uid=uid)
+
+    code, prof = api.handle("GET", f"/api/jobs/{ns}/train/profile",
+                            None)
+    check(code == 200 and prof["compile"]["count"] == 2
+          and math.isclose(prof["compile"]["seconds"], 7.5,
+                           abs_tol=1e-6),
+          "profile route serves the event-sourced compile summary")
+    check(math.isclose(prof["goodput"]["startupCompileSeconds"], 7.5,
+                       abs_tol=1e-6),
+          "profile route mirrors the ledger's measured compile state")
+    check(fingerprint in prof["budgets"],
+          "profile route serves the memory_analysis budgets")
+    check(prof["hbm"]["inUseBytes"] == 10 << 30
+          and prof["hbm"]["limitBytes"] == 16 << 30,
+          "profile route serves the beacon HBM watermark")
+
+    code, tel = api.handle("GET", f"/api/jobs/{ns}/train/telemetry",
+                           None)
+    check(code == 200 and tel["compile"]["count"] == 2
+          and "hbm" in tel,
+          "/telemetry gained the compile + hbm summaries")
+
+    # injected HBM climb: hbm-headroom walks the FSM
+    for _ in range(3):
+        sampler.sample()
+        tick()
+    check(mgr._states["hbm-headroom"].state == INACTIVE,
+          "rule inactive at 62% utilization")
+    mem["bytes_in_use"] = int(15.2 * (1 << 30))  # 95% of limit
+    for _ in range(15):
+        sampler.sample()
+        tick()
+    mem["bytes_in_use"] = 8 << 30  # back to 50%
+    for _ in range(15):
+        sampler.sample()
+        tick()
+    names = [s for (r, s) in transitions if r == "hbm-headroom"]
+    check(names == [PENDING, FIRING, RESOLVED],
+          "hbm-headroom walked exactly Pending -> Firing -> Resolved")
+    events = [e for e in client.list("v1", "Event", ns)
+              if e["reason"].startswith("Alert")]
+    check(sorted(e["reason"] for e in events)
+          == ["AlertFiring", "AlertPending", "AlertResolved"],
+          "exactly one Event per transition")
+    check(sampler.peak_seen >= int(15.2 * (1 << 30)),
+          "peak watermark is max-seen across samples")
+
+    # the measured startup_compile never drifted during the climb
+    g = client.get(API_VERSION, TPUJOB_KIND, ns,
+                   "train")["status"]["goodput"]
+    check(math.isclose(g["seconds"]["startup_compile"], 7.5,
+                       abs_tol=1e-9),
+          "compile attribution stable across later windows")
+
+    print("profile smoke: PASS")
+
+
+if __name__ == "__main__":
+    main()

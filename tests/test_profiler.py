@@ -51,6 +51,45 @@ def test_step_profiler_from_env(monkeypatch, tmp_path):
     assert prof.enabled and prof.start == 0 and prof.stop == 1
 
 
+def test_step_profiler_from_env_malformed_window(monkeypatch, tmp_path,
+                                                 caplog):
+    """A typo'd window env var must not crash worker 0 at boot — the
+    profiler warns and comes up disabled (the training job matters
+    more than its trace)."""
+    import logging
+
+    monkeypatch.setenv("KFTPU_PROFILE_DIR", str(tmp_path / "t"))
+    monkeypatch.setenv("KFTPU_PROFILE_START", "ten")
+    monkeypatch.setenv("KFTPU_PROFILE_STEPS", "3")
+    with caplog.at_level(logging.WARNING):
+        prof = StepProfiler.from_env()
+    assert not prof.enabled
+    assert any("KFTPU_PROFILE_START" in r.message for r in caplog.records)
+    for step in range(3):
+        prof.step(step)  # still a safe no-op
+    prof.close()
+
+    monkeypatch.setenv("KFTPU_PROFILE_START", "2")
+    monkeypatch.setenv("KFTPU_PROFILE_STEPS", "2.5")  # int() rejects
+    with caplog.at_level(logging.WARNING):
+        prof = StepProfiler.from_env()
+    assert not prof.enabled
+
+
+def test_step_profiler_from_env_malformed_without_dir(monkeypatch,
+                                                      caplog):
+    """Malformed window vars with no profile dir at all: still no
+    crash, still disabled."""
+    import logging
+
+    monkeypatch.delenv("KFTPU_PROFILE_DIR", raising=False)
+    monkeypatch.setenv("KFTPU_PROFILE_START", "")
+    monkeypatch.setenv("KFTPU_PROFILE_STEPS", "-")
+    with caplog.at_level(logging.WARNING):
+        prof = StepProfiler.from_env()
+    assert not prof.enabled
+
+
 def _write_fake_trace(d, run="run1"):
     """Synthesize the profiler's trace.json.gz layout: one device pid
     with an 'XLA Ops' lane plus a host pid that must be ignored."""

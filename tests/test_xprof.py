@@ -1,0 +1,479 @@
+"""Compile-event ledger + HBM watermarks (docs/OBSERVABILITY.md
+"Compile & memory"): jax.monitoring subscription, fingerprints +
+memory_analysis budgets, the goodput ground-truth carve, and the
+end-to-end acceptance pin (compile event -> tsdb -> /api/metrics/query;
+startup_compile == event-sourced seconds exactly; hbm-headroom FSM)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubeflow_tpu.dashboard.server import DashboardApi
+from kubeflow_tpu.k8s import FakeKubeClient
+from kubeflow_tpu.obs import goodput as gp
+from kubeflow_tpu.obs import xprof
+from kubeflow_tpu.obs.alerts import (
+    FIRING,
+    PENDING,
+    RESOLVED,
+    AlertManager,
+    default_rules,
+)
+from kubeflow_tpu.obs.steps import (
+    StepTelemetry,
+    _hbm_view,
+    telemetry_view,
+    tpujob_trace_ids,
+)
+from kubeflow_tpu.obs.trace import SpanCollector, Tracer
+from kubeflow_tpu.obs.tsdb import TimeSeriesStore
+from kubeflow_tpu.obs.xprof import (
+    CompileLedger,
+    HbmSampler,
+    compile_span_id,
+    hlo_fingerprint,
+    memory_budget,
+    shape_class_of,
+)
+from kubeflow_tpu.utils import DEFAULT_REGISTRY
+
+
+class SetClock:
+    def __init__(self, now=1000.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+
+GiB = 1 << 30
+
+
+# -- vocabulary ---------------------------------------------------------------
+
+
+def test_shape_class_of():
+    x = jnp.ones((8, 200), dtype=jnp.bfloat16)
+    assert shape_class_of(x) == "seq256_bfloat16"  # pow2 bucket of 200
+    assert shape_class_of((x, {"y": jnp.ones((8,), jnp.float32)})) \
+        == "seq256_bfloat16"  # nested pytrees walked, max dim wins
+    assert shape_class_of(1.0, 2) == "scalar"
+    assert shape_class_of() == "scalar"
+
+
+def test_hlo_fingerprint_stable_and_best_effort():
+    lowered = jax.jit(lambda v: v + 1).lower(jnp.ones((4,)))
+    fp = hlo_fingerprint(lowered)
+    assert len(fp) == 16 and fp == hlo_fingerprint(lowered)
+
+    class Broken:
+        def as_text(self):
+            raise RuntimeError("no text")
+
+    assert hlo_fingerprint(Broken()) == ""
+
+
+# -- the ledger: record -> metric + span + job totals -------------------------
+
+
+def test_ledger_record_metric_span_totals():
+    clock = SetClock(500.0)
+    collector = SpanCollector()
+    ledger = CompileLedger(namespace="t", job="rec", uid="u1", worker=2,
+                           clock=clock, tracer=Tracer(collector,
+                                                      clock=clock),
+                           generation="v5e")
+    # constructing with job identity announces the ground-truth source
+    assert xprof.job_compile_seconds("t", "rec") == 0.0
+    ev = ledger.record("train_step", 4.25, shape_class="seq512_bfloat16",
+                       fingerprint="abcd" * 4)
+    assert ev.seconds == 4.25 and ev.end == 500.0 and ev.start == 495.75
+    assert xprof.job_compile_seconds("t", "rec") == 4.25
+    assert xprof.job_compile_totals("t", "rec")["count"] == 1
+
+    h = DEFAULT_REGISTRY.histogram("kftpu_compile_seconds")
+    labels = dict(module="train_step", shape_class="seq512_bfloat16",
+                  generation="v5e", namespace="t", job="rec")
+    assert h.get(**labels) == 1
+    assert h.sum(**labels) == pytest.approx(4.25)
+
+    tid, root = tpujob_trace_ids("t", "rec", "u1")
+    spans = [s for s in collector.spans()
+             if s.name == "compile/train_step"]
+    assert len(spans) == 1
+    sp = spans[0]
+    assert sp.trace_id == tid and sp.parent_id == root
+    assert sp.span_id == compile_span_id(tid, 2, "train_step", 0)
+    assert sp.duration == pytest.approx(4.25)
+    assert sp.attrs["fingerprint"] == "abcd" * 4
+
+    # same module again: the seq advances, so the span id forks while
+    # a replay of the SAME compile would re-derive the same id
+    ledger.record("train_step", 1.0)
+    spans = [s for s in collector.spans()
+             if s.name == "compile/train_step"]
+    assert spans[1].span_id == compile_span_id(tid, 2, "train_step", 1)
+    assert spans[1].span_id != sp.span_id
+
+    assert ledger.total_seconds() == pytest.approx(5.25)
+    s = ledger.summary()
+    assert s["count"] == 2 and s["seconds"] == pytest.approx(5.25)
+    assert s["by_module"]["train_step"] == pytest.approx(5.25)
+
+
+def test_ledger_event_capacity_bounded():
+    ledger = CompileLedger(capacity=4)
+    for i in range(10):
+        ledger.record(f"m{i}", 0.1)
+    assert len(ledger.events) == 4
+    assert ledger.events[-1].module == "m9"
+
+
+# -- jax.monitoring subscription ----------------------------------------------
+
+
+def test_fake_monitoring_event_records_once():
+    """The satellite pin: a synthetic duration event walks the whole
+    path — metric, span, goodput attribution source — and the
+    jaxpr/MLIR sibling events are filtered out."""
+    from jax import monitoring
+
+    clock = SetClock(100.0)
+    collector = SpanCollector()
+    ledger = CompileLedger(namespace="t", job="fake-ev", uid="u",
+                           clock=clock, tracer=Tracer(collector,
+                                                      clock=clock))
+    assert ledger.install() is True
+    assert ledger.install() is False  # idempotent per ledger
+    try:
+        before = len(ledger.events)
+        monitoring.record_event_duration_secs(
+            "/jax/core/compile/backend_compile_duration", 2.5)
+        # the two sibling events of the same compilation: must NOT count
+        monitoring.record_event_duration_secs(
+            "/jax/core/compile/jaxpr_trace_duration", 2.5)
+        monitoring.record_event_duration_secs(
+            "/jax/core/compile/jaxpr_to_mlir_module_duration", 2.5)
+        assert len(ledger.events) - before == 1
+        assert ledger.events[-1].seconds == 2.5
+        assert xprof.job_compile_seconds("t", "fake-ev") == 2.5
+        assert any(s.name.startswith("compile/")
+                   for s in collector.spans())
+    finally:
+        assert ledger.uninstall() is True
+    assert ledger.uninstall() is False
+    monitoring.record_event_duration_secs(
+        "/jax/core/compile/backend_compile_duration", 9.9)
+    assert xprof.job_compile_seconds("t", "fake-ev") == 2.5  # torn down
+
+
+def test_real_jit_compile_lands_in_ledger():
+    ledger = CompileLedger(namespace="t", job="real-jit")
+    x = jnp.arange(16, dtype=jnp.float32)  # eager compiles done first
+    with ledger:
+        before = len(ledger.events)
+        jax.jit(lambda v: (v * 3.0 - 1.0).sum())(x).block_until_ready()
+        assert len(ledger.events) - before == 1
+    assert ledger.events[-1].seconds >= 0.0
+    assert ledger.events[-1].generation == "cpu"
+
+
+def test_second_ledger_install_evicts_marked_listener():
+    """The re-import guard: installing a new marked listener sweeps
+    any marked listener already registered (the orphan a module
+    reload leaves), so one compilation can never bill twice."""
+    from jax import monitoring
+
+    a = CompileLedger(namespace="t", job="dup-a")
+    b = CompileLedger(namespace="t", job="dup-b")
+    assert a.install() and b.install()
+    try:
+        monitoring.record_event_duration_secs(
+            "/jax/core/compile/backend_compile_duration", 1.0)
+        # only the newest listener (b) recorded; a's was evicted
+        assert xprof.job_compile_seconds("t", "dup-a") == 0.0
+        assert xprof.job_compile_seconds("t", "dup-b") == 1.0
+    finally:
+        b.uninstall()
+        a.uninstall()
+
+
+# -- timed_compile: fingerprint + memory_analysis budget ----------------------
+
+
+def test_timed_compile_budget_per_fingerprint():
+    clock = SetClock(10.0)
+    ledger = CompileLedger(namespace="t", job="aot", clock=clock)
+    y = jnp.ones((16, 16), dtype=jnp.float32)
+    compiled = ledger.timed_compile(jax.jit(lambda v: v @ v), y,
+                                    module="mm")
+    ev = ledger.events[-1]
+    assert ev.module == "mm" and ev.shape_class == "seq128_float32"
+    assert len(ev.fingerprint) == 16
+    b = xprof.budget_for(ev.fingerprint)
+    assert b is not None and b["module"] == "mm"
+    assert b["bytes"]["argument"] >= y.nbytes
+    assert b["bytes"]["output"] >= y.nbytes
+    assert ev.fingerprint in xprof.budgets()
+    g = DEFAULT_REGISTRY.gauge("kftpu_hbm_budget_bytes")
+    assert g.get(kind="argument", module="mm",
+                 shape_class="seq128_float32",
+                 generation="cpu") >= y.nbytes
+    assert compiled(y).shape == (16, 16)
+    # no AOT surface: passthrough, nothing recorded
+    n = len(ledger.events)
+    assert ledger.timed_compile(len, y) is len
+    assert len(ledger.events) == n
+
+
+def test_memory_budget_declines_gracefully():
+    class Broken:
+        def memory_analysis(self):
+            raise RuntimeError("backend says no")
+
+    class NoneBudget:
+        def memory_analysis(self):
+            return None
+
+    assert memory_budget(Broken()) == {}
+    assert memory_budget(NoneBudget()) == {}
+    assert xprof.budget_for("not-a-fingerprint") is None
+
+
+# -- HBM sampler --------------------------------------------------------------
+
+
+def test_hbm_sampler_injected_source():
+    mem = {"bytes_in_use": 10 * GiB, "peak_bytes_in_use": 11 * GiB,
+           "bytes_limit": 16 * GiB}
+    s = HbmSampler(namespace="t", job="hbm", worker=1,
+                   source=lambda: dict(mem))
+    out = s.sample()
+    assert out == {"in_use": float(10 * GiB), "peak": float(11 * GiB),
+                   "limit": float(16 * GiB)}
+    g = DEFAULT_REGISTRY.gauge("kftpu_hbm_bytes")
+    ident = dict(namespace="t", job="hbm", worker="1")
+    assert g.get(kind="in_use", **ident) == float(10 * GiB)
+    assert g.get(kind="limit", **ident) == float(16 * GiB)
+    u = DEFAULT_REGISTRY.gauge("kftpu_hbm_utilization")
+    assert u.get(**ident) == pytest.approx(10 / 16)
+    assert s.beacon_fields() == {"inUseBytes": 10 * GiB,
+                                 "peakBytes": 11 * GiB,
+                                 "limitBytes": 16 * GiB}
+    # peak is max-seen: a drop below the old peak keeps the watermark
+    mem["bytes_in_use"] = 6 * GiB
+    mem["peak_bytes_in_use"] = 6 * GiB  # allocator reset its peak
+    out = s.sample()
+    assert out["peak"] == float(11 * GiB)
+
+
+def test_hbm_sampler_cpu_degrades_silently():
+    # tier-1 runs JAX_PLATFORMS=cpu: the real device returns None
+    s = HbmSampler(namespace="t", job="cpu")
+    assert s.sample() is None
+    assert s.beacon_fields() == {}
+    # a raising source is also silent (never fails a step)
+    s = HbmSampler(source=lambda: (_ for _ in ()).throw(OSError("x")))
+    assert s.sample() is None
+
+
+def test_step_telemetry_carries_hbm_beacon():
+    mem = {"bytes_in_use": 3 * GiB, "peak_bytes_in_use": 4 * GiB,
+           "bytes_limit": 16 * GiB}
+    clock = SetClock(0.0)
+
+    def step_clock():
+        clock.now += 0.5
+        return clock.now
+
+    sampler = HbmSampler(namespace="t", job="beam", worker=0,
+                         source=lambda: dict(mem))
+    telem = StepTelemetry(job="beam", namespace="t", worker=0,
+                          clock=step_clock, use_cost_analysis=False,
+                          hbm_sampler=sampler)
+    step = telem.wrap(lambda s: s + 1)
+    for i in range(3):
+        step(i)
+    b = telem.beacon()
+    assert b["hbm"] == {"inUseBytes": 3 * GiB, "peakBytes": 4 * GiB,
+                        "limitBytes": 16 * GiB}
+    # no sampler: the key is still present (same-shape contract)
+    bare = StepTelemetry(job="bare", use_cost_analysis=False)
+    assert bare.beacon()["hbm"] == {}
+
+
+def test_hbm_view_gang_max():
+    beacons = {
+        0: {"step": 5, "hbm": {"inUseBytes": 10, "peakBytes": 12,
+                               "limitBytes": 100}},
+        1: {"step": 5, "hbm": {"inUseBytes": 40, "peakBytes": 41,
+                               "limitBytes": 100}},
+        2: {"step": 5, "hbm": {}},  # CPU worker: no block
+    }
+    v = _hbm_view(beacons)
+    assert v == {"inUseBytes": 40, "peakBytes": 41, "limitBytes": 100,
+                 "workersReporting": 2}
+    assert telemetry_view(beacons, straggler_k=10)["hbm"] == v
+    assert _hbm_view({}) == {"inUseBytes": 0, "peakBytes": 0,
+                             "limitBytes": 0, "workersReporting": 0}
+
+
+# -- goodput ground-truth carve -----------------------------------------------
+
+
+def _sig(now, secs=None, **kw):
+    kw.setdefault("has_pods", True)
+    return gp.GoodputSignals(now=now, compile_seconds=secs, **kw)
+
+
+def test_goodput_carve_startup_exact():
+    g = gp.fold(None, _sig(0.0, secs=0.0))
+    g = gp.fold(g, _sig(60.0, secs=7.5))
+    assert g["seconds"]["startup_compile"] == 7.5  # exactly
+    assert g["seconds"]["unattributed"] == pytest.approx(52.5)
+    assert "recompile" not in g["seconds"]
+    # stable across later windows with no new compiles
+    g = gp.fold(g, _sig(120.0, secs=7.5))
+    assert g["seconds"]["startup_compile"] == 7.5
+
+
+def test_goodput_carve_recompile_after_steps():
+    g = gp.fold(None, _sig(0.0, secs=0.0))
+    g = gp.fold(g, _sig(60.0, secs=5.0))  # startup
+    g = gp.fold(g, _sig(120.0, secs=5.0, last_step=50))  # productive
+    g = gp.fold(g, _sig(180.0, secs=6.5, last_step=80))
+    assert g["seconds"]["startup_compile"] == 5.0
+    assert g["seconds"]["recompile"] == pytest.approx(1.5)
+
+
+def test_goodput_measured_suppresses_inference():
+    """A growing beacon recompile counter is IGNORED when the
+    ground-truth source exists — attributing both would double-bill."""
+    g = gp.fold(None, _sig(0.0, secs=0.0, last_step=10))
+    g = gp.fold(g, _sig(60.0, secs=0.0, last_step=20, recompiles=5))
+    assert "recompile" not in g["seconds"]
+    assert g["seconds"]["productive_step"] == pytest.approx(60.0)
+
+    # without the source, the inference path stands (unchanged)
+    g = gp.fold(None, gp.GoodputSignals(now=0.0, has_pods=True,
+                                        last_step=10))
+    g = gp.fold(g, gp.GoodputSignals(now=60.0, has_pods=True,
+                                     last_step=20, recompiles=5))
+    assert g["seconds"]["recompile"] == pytest.approx(60.0)
+
+
+def test_goodput_carve_counter_reset_rebaselines():
+    g = gp.fold(None, _sig(0.0, secs=0.0))
+    g = gp.fold(g, _sig(60.0, secs=9.0))
+    # re-ganged workers reset their ledger: observed drops to 2.0 —
+    # rebaseline, attribute nothing negative, then deltas resume
+    g = gp.fold(g, _sig(120.0, secs=2.0))
+    assert g["seconds"]["startup_compile"] == 9.0
+    g = gp.fold(g, _sig(180.0, secs=3.5))
+    assert g["seconds"]["startup_compile"] == pytest.approx(10.5)
+
+
+def test_goodput_source_appearing_midlife_baselines():
+    """A CR whose markers predate the ledger (or an operator upgrade):
+    the first measured observation must not bill the job's whole
+    compile history into one window."""
+    g = gp.fold(None, gp.GoodputSignals(now=0.0, has_pods=True))
+    g = gp.fold(g, gp.GoodputSignals(now=30.0, has_pods=True))
+    del g["markers"]["compileSeconds"]  # pre-PR CR shape
+    g = gp.fold(g, _sig(60.0, secs=100.0))
+    assert g["seconds"].get("recompile", 0.0) == 0.0
+    # inferred startup_compile from the measured-less windows only
+    assert g["seconds"].get("startup_compile", 0.0) <= 60.0
+    # from the baseline on, deltas attribute normally
+    g = gp.fold(g, _sig(90.0, secs=104.0))
+    assert g["markers"]["compileSeconds"] == pytest.approx(104.0)
+
+
+def test_goodput_carve_spills_past_window():
+    """A compile longer than the reconcile window carves the whole
+    window now and the remainder in the next (marker advances only by
+    what was attributed)."""
+    g = gp.fold(None, _sig(0.0, secs=0.0))
+    g = gp.fold(g, _sig(10.0, secs=25.0))
+    assert g["seconds"]["startup_compile"] == pytest.approx(10.0)
+    g = gp.fold(g, _sig(30.0, secs=25.0))
+    assert g["seconds"]["startup_compile"] == pytest.approx(25.0)
+    assert math.isclose(sum(g["seconds"].values()), 30.0, abs_tol=1e-9)
+
+
+# -- the end-to-end acceptance pin --------------------------------------------
+
+
+def test_compile_event_to_query_goodput_and_headroom_fsm():
+    """One fake clock end to end: a compile event reads back through
+    the tsdb + /api/metrics/query, the goodput ledger's
+    startup_compile matches the event-sourced seconds EXACTLY, and an
+    injected HBM climb walks hbm-headroom Pending -> Firing ->
+    Resolved with exactly one Event per transition."""
+    ns, job = "pin", "e2e"
+    clock = SetClock(1000.0)
+    collector = SpanCollector()
+    tracer = Tracer(collector, clock=clock)
+    client = FakeKubeClient()
+    store = TimeSeriesStore(clock=clock)
+    rule = next(r for r in default_rules() if r.name == "hbm-headroom")
+    mgr = AlertManager(store, [rule], client=client, namespace=ns,
+                       clock=clock, tracer=tracer)
+    transitions = []
+
+    def tick(dt=10.0):
+        clock.now += dt
+        store.sample_registry(DEFAULT_REGISTRY)
+        for st in mgr.evaluate():
+            transitions.append((st.rule.name, st.state))
+
+    ledger = CompileLedger(namespace=ns, job=job, uid="u-pin",
+                           clock=clock, tracer=tracer)
+    g = gp.fold(None, _sig(clock.now,
+                           secs=xprof.job_compile_seconds(ns, job)))
+    ledger.record("train_step", 4.5, shape_class="seq512_bfloat16")
+    ledger.record("train_step", 3.0, shape_class="seq512_bfloat16")
+    clock.now += 60.0
+    g = gp.fold(g, _sig(clock.now,
+                        secs=xprof.job_compile_seconds(ns, job)))
+    assert g["seconds"]["startup_compile"] == 7.5  # exactly
+
+    store.sample_registry(DEFAULT_REGISTRY)
+    api = DashboardApi(client, authorize=lambda *a: True, tsdb=store,
+                       collector=collector)
+    code, body = api.handle(
+        "GET",
+        "/api/metrics/query?metric=kftpu_compile_seconds_sum"
+        f"&label=namespace:{ns}&label=job:{job}", None)
+    assert code == 200 and body["result"]
+    assert sum(r["value"] for r in body["result"]) == 7.5
+
+    mem = {"bytes_in_use": 10 * GiB, "peak_bytes_in_use": 10 * GiB,
+           "bytes_limit": 16 * GiB}
+    sampler = HbmSampler(namespace=ns, job=job, worker=0,
+                         source=lambda: dict(mem))
+    for _ in range(3):
+        sampler.sample()
+        tick()
+    assert transitions == []  # 62%: headroom fine
+    mem["bytes_in_use"] = int(15.5 * GiB)  # ~97%
+    for _ in range(15):
+        sampler.sample()
+        tick()
+    mem["bytes_in_use"] = 8 * GiB
+    for _ in range(15):
+        sampler.sample()
+        tick()
+    names = [s for (r, s) in transitions if r == "hbm-headroom"]
+    assert names == [PENDING, FIRING, RESOLVED]
+    events = [e for e in client.list("v1", "Event", ns)
+              if e["reason"].startswith("Alert")]
+    assert sorted(e["reason"] for e in events) \
+        == ["AlertFiring", "AlertPending", "AlertResolved"]
+
+    # the measured attribution never drifted while the alert walked
+    g = gp.fold(g, _sig(clock.now,
+                        secs=xprof.job_compile_seconds(ns, job)))
+    assert g["seconds"]["startup_compile"] == 7.5
