@@ -17,9 +17,15 @@ Layout:
 - :mod:`baseline`  — committed grandfather file for pre-existing debt
 - :mod:`runner`    — orchestration: walk → check → suppress → diff
 - :mod:`cfg`       — per-function statement-level control-flow graphs
+                     (built once per function via ``cfg_for``, shared
+                     by both dataflow planes)
 - :mod:`callgraph` — class-scoped ``self._foo()`` call resolution
 - :mod:`locksets`  — must-hold lock-set dataflow + guard inference
-- :mod:`checkers`  — the shipped rules TPU001–TPU013
+- :mod:`tracetaint` — may-taint traced-value dataflow + jit-site
+                     inventory (the compile-plane rules' core)
+- :mod:`compileaudit` — static jit-site inventory × recorded
+                     ``kftpu_compile_seconds`` events join
+- :mod:`checkers`  — the shipped rules TPU001–TPU018
 
 Rule catalog (details in ``docs/ANALYSIS.md``):
 
@@ -38,6 +44,14 @@ TPU010      unguarded writes to lock-guarded shared state
 TPU011      blocking I/O / foreign callbacks under a held lock
 TPU012      re-entrant acquisition of a non-reentrant Lock
 TPU013      kftpu_* metric help/label-key contract drift
+TPU014      Python control flow on a traced value in a jit region
+TPU015      recompile hazards: jit-in-loop, per-call callables,
+            non-hashable/traced/unbucketed static arguments
+TPU016      donated argument read after the jitted call
+TPU017      implicit host sync (.item()/float()/np.asarray/...)
+            in step loops and decode admit paths
+TPU018      jax.jit sites in serving/train/elastic bypassing
+            CompileLedger.timed_compile
 ==========  ==================================================
 """
 

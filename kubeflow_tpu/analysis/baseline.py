@@ -17,12 +17,29 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Dict, Iterable, List, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from kubeflow_tpu.analysis.findings import Finding, normalize_path
 
 BASELINE_VERSION = 1
 DEFAULT_BASELINE = "tpulint_baseline.json"
+
+
+class BaselineRuleGap(ValueError):
+    """The baseline predates one or more active rules: its gate
+    semantics for them are undefined (every finding would read as
+    'new'), so the run refuses with the fix spelled out instead of
+    failing cryptically."""
+
+    def __init__(self, path: str, missing: Sequence[str]) -> None:
+        rules = ", ".join(sorted(missing))
+        super().__init__(
+            f"rule(s) {rules} unknown in baseline {path} (the baseline "
+            "predates them) — triage their findings, then rerun "
+            "scripts/run_tpulint.py --baseline-update to record the "
+            "covered rule set")
+        self.path = path
+        self.missing = tuple(sorted(missing))
 
 
 def fingerprint_counts(
@@ -39,7 +56,8 @@ def fingerprint_counts(
     return out
 
 
-def load(path: str) -> Dict[str, dict]:
+def load_payload(path: str) -> Dict[str, object]:
+    """The whole baseline payload ({} when the file does not exist)."""
     if not os.path.exists(path):
         return {}
     with open(path, encoding="utf-8") as f:
@@ -48,10 +66,31 @@ def load(path: str) -> Dict[str, dict]:
         raise ValueError(
             f"baseline {path} has version {data.get('version')!r}, "
             f"expected {BASELINE_VERSION}")
-    return data.get("findings", {})
+    return data
 
 
-def save(path: str, findings: Iterable[Tuple[Finding, str]]) -> None:
+def load(path: str) -> Dict[str, dict]:
+    return load_payload(path).get("findings", {})  # type: ignore[return-value]
+
+
+def check_rule_coverage(path: str, payload: Dict[str, object],
+                        active: Iterable[str]) -> None:
+    """Raise :class:`BaselineRuleGap` when ``active`` rules are absent
+    from the payload's recorded ``rules`` list. Baselines written
+    before the coverage contract (no ``rules`` key) are exempt — they
+    cannot distinguish 'rule predates me' from 'rule was clean'."""
+    if not payload:
+        return
+    covered = payload.get("rules")
+    if not isinstance(covered, list):
+        return
+    missing = set(active) - set(covered)
+    if missing:
+        raise BaselineRuleGap(path, sorted(missing))
+
+
+def save(path: str, findings: Iterable[Tuple[Finding, str]],
+         rules: Optional[Sequence[str]] = None) -> None:
     # deterministic, review-friendly order: by path, then rule, then
     # occurrence key (the fingerprint) — a refresh after fixing one
     # file touches that file's block only, never reshuffles the rest
@@ -59,12 +98,17 @@ def save(path: str, findings: Iterable[Tuple[Finding, str]]) -> None:
     ordered = dict(sorted(
         counts.items(),
         key=lambda kv: (kv[1]["path"], kv[1]["rule"], kv[0])))
-    payload = {
+    payload: Dict[str, object] = {
         "version": BASELINE_VERSION,
         "comment": "tpulint grandfathered findings; regenerate with "
                    "scripts/run_tpulint.py --baseline-update",
         "findings": ordered,
     }
+    if rules is not None:
+        # the covered-rule record: a future run whose active rules
+        # exceed this list fails with BaselineRuleGap instead of
+        # reporting every pre-existing finding of the new rule as new
+        payload["rules"] = sorted(rules)
     with open(path, "w", encoding="utf-8") as f:
         json.dump(payload, f, indent=1, sort_keys=False)
         f.write("\n")

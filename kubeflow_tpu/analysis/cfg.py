@@ -201,3 +201,21 @@ class _Builder:
 def build_cfg(fn: FunctionNode) -> Cfg:
     """Build the statement-level CFG for one function body."""
     return _Builder(fn).cfg
+
+
+def cfg_for(module, fn: FunctionNode) -> Cfg:
+    """The CFG for ``fn``, built once per (module, function) and
+    memoized on the ModuleInfo — the lock-set analysis (TPU010–012)
+    and the trace-taint analysis (TPU014–017) walk the same graphs,
+    so the second dataflow plane must not double the CFG build cost.
+    Keyed by AST-node identity: fixture tests that re-parse a module
+    get fresh graphs because they get fresh nodes."""
+    cache = getattr(module, "_cfg_cache", None)
+    if cache is None:
+        cache = {}
+        module._cfg_cache = cache
+    got = cache.get(id(fn))
+    if got is None:
+        got = build_cfg(fn)
+        cache[id(fn)] = got
+    return got

@@ -2,16 +2,21 @@
 
 from kubeflow_tpu.analysis.checkers import (  # noqa: F401
     host_call_in_jit,
+    host_sync,
     lock_blocking,
     lock_reentrant,
     lock_unguarded_state,
     mesh_axes,
     metric_contract,
     raw_clock,
+    recompile_hazard,
     spec_legality,
     tile_legality,
+    trace_control_flow,
     unbound_collective,
     unbounded_retry,
+    unledgered_compile,
+    use_after_donate,
     version_gate,
     wiring,
 )

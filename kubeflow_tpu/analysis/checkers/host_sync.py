@@ -1,0 +1,170 @@
+"""TPU017 — implicit device→host sync inside a hot path.
+
+``.item()``, ``float()``/``int()``, ``np.asarray``, ``.tolist()`` and
+``.block_until_ready()`` on a device value block the Python thread
+until the device catches up. Once per job that is instrumentation;
+inside a training-step loop or the decode engine's admission path it
+serializes host and device per iteration — the dispatch-stall badput
+the goodput ledger bills but cannot locate.
+
+Hot regions (call-graph-scoped, like TPU012's deadlock reachability):
+
+- the body of any loop that drives a jitted callable (a train/decode
+  step loop), in any function;
+- ``_admit*`` methods of a class owning jitted callables (the
+  ``DecodeEngine`` admission path), plus every same-class method
+  transitively reachable from a hot seed over direct call edges.
+
+Only *tainted* values (per :mod:`tracetaint`: results of jitted
+calls / ``jnp`` ops) flag — ``float(self.threshold)`` in the same
+loop is host arithmetic and stays silent. Syncs before or after the
+loop (e.g. materializing final tokens once) are not hot and never
+flag. A deliberate sync — the one transfer point where results
+surface per design — gets an inline pragma with its justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from kubeflow_tpu.analysis import astutil
+from kubeflow_tpu.analysis import callgraph as cg
+from kubeflow_tpu.analysis import tracetaint
+from kubeflow_tpu.analysis.findings import Finding
+from kubeflow_tpu.analysis.registry import Checker, register_checker
+from kubeflow_tpu.analysis.walker import ModuleInfo
+
+SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+SYNC_CALLS = {"float", "int", "bool", "np.asarray", "np.array",
+              "numpy.asarray", "numpy.array", "jax.device_get"}
+HOT_METHOD_PREFIX = "_admit"
+
+
+def _sync_target(node: ast.Call) -> Optional[Tuple[str, ast.AST]]:
+    """(sync op name, the expression being synced) or None."""
+    func = node.func
+    if isinstance(func, ast.Attribute) and func.attr in SYNC_METHODS:
+        return (f".{func.attr}()", func.value)
+    name = astutil.call_name(node) or ""
+    if name in SYNC_CALLS and node.args:
+        return (f"{name}()", node.args[0])
+    return None
+
+
+def _calls_jitted(root: ast.AST, mt) -> bool:
+    for node in tracetaint.iter_exprs(root):
+        if isinstance(node, ast.Call):
+            name = tracetaint._bindable_name(node.func)
+            if name and name in mt.jitted_names:
+                return True
+    return False
+
+
+def _hot_loops(fn, mt) -> List[ast.AST]:
+    """Loops in ``fn`` (nested defs excluded) whose body drives a
+    jitted callable — the step-loop signature."""
+    out = []
+    for node in tracetaint.iter_exprs(fn):
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)) \
+                and _calls_jitted(node, mt):
+            out.append(node)
+    return out
+
+
+@register_checker
+class HostSyncChecker(Checker):
+    rule = "TPU017"
+    name = "host-sync-in-hot-path"
+    severity = "warning"
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        mt = tracetaint.taint_analysis(module)
+        if not mt.jitted_names:
+            return
+        seen: Set[int] = set()
+        # `a, b = np.asarray(a), np.asarray(b)` is one surfacing
+        # point, not two findings — collapse identical (line, op).
+        emitted: Set[Tuple[int, str]] = set()
+
+        # class dimension: _admit* seeds + call-graph closure, on
+        # classes that own jitted callables (self._step = jax.jit(...))
+        hot_methods: Dict[int, str] = {}  # id(fn) → reason
+        for cls in cg.classes_in(module.tree):
+            owns = any(b.startswith("self.") and b in mt.jitted_names
+                       for site in mt.sites for b in site.bound
+                       if self._inside(module, site.node, cls))
+            if not owns:
+                continue
+            graph = cg.class_graph(cls)
+            seeds = {m for m in graph.methods
+                     if m.startswith(HOT_METHOD_PREFIX)}
+            # methods invoked from inside a hot loop of the same class
+            for name, fn in graph.methods.items():
+                for loop in _hot_loops(fn, mt):
+                    for node in tracetaint.iter_exprs(loop):
+                        if isinstance(node, ast.Call):
+                            attr = tracetaint._self_attr(node.func)
+                            if attr in graph.methods:
+                                seeds.add(attr)
+            reach = set(seeds)
+            frontier = list(seeds)
+            while frontier:
+                m = frontier.pop()
+                for callee in graph.direct_calls.get(m, ()):
+                    if callee not in reach:
+                        reach.add(callee)
+                        frontier.append(callee)
+            for m in reach:
+                fn = graph.methods.get(m)
+                if fn is not None:
+                    hot_methods[id(fn)] = (
+                        "decode admit path"
+                        if m.startswith(HOT_METHOD_PREFIX)
+                        else "reachable from an admit/step-loop seed")
+
+        for fn in astutil.functions(module.tree):
+            ft = None
+            regions: List[Tuple[ast.AST, str]] = []
+            if id(fn) in hot_methods:
+                regions.append((fn, hot_methods[id(fn)]))
+            else:
+                for loop in _hot_loops(fn, mt):
+                    regions.append(
+                        (loop, f"loop driving a jitted callable "
+                               f"(line {loop.lineno})"))
+            for root, reason in regions:
+                for node in tracetaint.iter_exprs(root):
+                    if not isinstance(node, ast.Call) \
+                            or id(node) in seen:
+                        continue
+                    hit = _sync_target(node)
+                    if hit is None:
+                        continue
+                    if ft is None:
+                        ft = mt.taint_of(fn)
+                    if not ft.expr_tainted(hit[1]):
+                        continue
+                    seen.add(id(node))
+                    if (node.lineno, hit[0]) in emitted:
+                        continue
+                    emitted.add((node.lineno, hit[0]))
+                    yield self.finding(
+                        module, node,
+                        f"implicit host sync {hit[0]} on a device "
+                        f"value in a hot path ({reason}): the host "
+                        "blocks until the device drains",
+                        hint="keep the value device-side, batch the "
+                             "transfer outside the loop, or mark the "
+                             "deliberate surfacing point with a "
+                             "justified pragma")
+
+    @staticmethod
+    def _inside(module: ModuleInfo, node: ast.AST,
+                cls: ast.ClassDef) -> bool:
+        cur = module.parents.get(node)
+        while cur is not None:
+            if cur is cls:
+                return True
+            cur = module.parents.get(cur)
+        return False

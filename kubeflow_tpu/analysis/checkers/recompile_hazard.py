@@ -1,0 +1,183 @@
+"""TPU015 — jit usage patterns that defeat the compile cache.
+
+Four statically-visible recompile hazards, all of which the compile
+ledger (PR 18) can only bill *after* the chip stalls:
+
+- ``jax.jit(...)`` constructed inside a loop: a fresh jit wrapper per
+  iteration means a fresh compile-cache entry per iteration;
+- ``jax.jit`` wrapping a callable that is itself rebuilt per call —
+  a ``lambda`` or ``functools.partial`` inside a function body: the
+  cache keys on callable identity, so every call of the enclosing
+  function compiles again (module-level lambdas/partials are built
+  once and stay silent);
+- a non-hashable literal (list/dict/set) or a *traced* value flowing
+  into a ``static_argnums``/``static_argnames`` position at a call
+  site of a jitted callable: non-hashables raise, traced statics
+  either raise or recompile per value;
+- an unbucketed shape-bearing value (``len(...)``/``.shape``-derived
+  with no routing through the ``ops/autotune`` ``*bucket`` shape-class
+  vocabulary) into a static position: one compile per distinct length
+  instead of one per bucket — the exact storm
+  ``--compile-audit`` attributes from ledger events.
+
+Only call sites whose static spec resolved to literals are examined
+(:mod:`tracetaint` leaves ``static_argnums=<expr>`` as None), so an
+unresolvable spec stays silent per the conservatism contract.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional, Set
+
+from kubeflow_tpu.analysis import astutil, tracetaint
+from kubeflow_tpu.analysis.findings import Finding
+from kubeflow_tpu.analysis.registry import Checker, register_checker
+from kubeflow_tpu.analysis.walker import ModuleInfo
+
+
+def _derives_shape(scope: Optional[ast.AST], node: ast.AST,
+                   depth: int = 2) -> bool:
+    """Does ``node`` derive from ``len()``/``.shape`` with no
+    ``*bucket`` sanitizer on the way? One level of single-assignment
+    name resolution, bounded."""
+    bucketed = False
+    shapey = False
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            name = astutil.call_name(sub) or ""
+            if name.split(".")[-1].endswith("bucket"):
+                bucketed = True
+            elif name == "len":
+                shapey = True
+        elif isinstance(sub, ast.Attribute) and sub.attr == "shape":
+            shapey = True
+    if bucketed:
+        return False
+    if shapey:
+        return True
+    if depth > 0 and isinstance(node, ast.Name) and scope is not None:
+        values = list(astutil.assignments_to(scope, node.id))
+        if len(values) == 1:
+            return _derives_shape(scope, values[0], depth - 1)
+    return False
+
+
+_MEMO_DECORATORS = {"lru_cache", "cache"}
+
+
+def _memoized_factory(module: ModuleInfo, node: ast.AST) -> bool:
+    """Is ``node`` inside a function decorated with
+    ``functools.lru_cache``/``functools.cache``? A memoized factory
+    returning ``jax.jit(partial(...))`` builds one wrapper per key —
+    the sanctioned per-config compile-cache idiom, not a hazard."""
+    fn = module.enclosing_function(node)
+    while fn is not None:
+        for dec in fn.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            name = astutil.dotted_name(target) or ""
+            if name.split(".")[-1] in _MEMO_DECORATORS:
+                return True
+        fn = module.enclosing_function(fn)
+    return False
+
+
+@register_checker
+class RecompileHazardChecker(Checker):
+    rule = "TPU015"
+    name = "recompile-hazard"
+    severity = "error"
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        mt = tracetaint.taint_analysis(module)
+        yield from self._construction_hazards(module, mt)
+        yield from self._static_position_hazards(module, mt)
+
+    # -- jit construction --------------------------------------------------
+
+    def _construction_hazards(self, module, mt) -> Iterable[Finding]:
+        for site in mt.sites:
+            if site.kind != "call":
+                continue
+            if site.in_loop:
+                yield self.finding(
+                    module, site.node,
+                    "jax.jit constructed inside a loop: every iteration "
+                    "makes a fresh wrapper and a fresh compile-cache "
+                    "entry",
+                    hint="hoist the jit out of the loop and call the "
+                         "one wrapper per iteration")
+            elif site.fresh_callee and site.enclosing is not None \
+                    and not site.immediate \
+                    and not _memoized_factory(module, site.node):
+                yield self.finding(
+                    module, site.node,
+                    f"jax.jit wraps a {site.wrapped!r} built per call "
+                    f"of {site.enclosing!r}; the compile cache keys on "
+                    "callable identity, so each call compiles again",
+                    hint="define the callable once at module scope (or "
+                         "close over the varying values inside one "
+                         "def) and jit that single object")
+
+    # -- static positions at call sites ------------------------------------
+
+    def _static_position_hazards(self, module, mt) -> Iterable[Finding]:
+        if not mt.jitted_names:
+            return
+        seen: Set[int] = set()
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = tracetaint._bindable_name(node.func)
+            site = mt.site_for_name(name) if name else None
+            if site is None:
+                continue
+            fn = module.enclosing_function(node)
+            ft = mt.taint_of(fn) if fn is not None else None
+            for i in site.static_argnums or ():
+                if 0 <= i < len(node.args):
+                    yield from self._check_static(
+                        module, node, node.args[i], f"static_argnums {i}",
+                        name, fn, ft, seen)
+            for aname in site.static_argnames or ():
+                for kw in node.keywords:
+                    if kw.arg == aname:
+                        yield from self._check_static(
+                            module, node, kw.value,
+                            f"static_argnames {aname!r}", name, fn, ft,
+                            seen)
+
+    def _check_static(self, module, call, arg, pos, callee, fn, ft,
+                      seen: Set[int]) -> Iterable[Finding]:
+        if id(arg) in seen:
+            return
+        if isinstance(arg, (ast.List, ast.Dict, ast.Set)):
+            seen.add(id(arg))
+            yield self.finding(
+                module, call,
+                f"non-hashable literal in {pos} of jitted "
+                f"{callee!r}: static arguments are cache keys and "
+                "must hash",
+                hint="pass a tuple (or a frozen dataclass) instead")
+            return
+        if ft is not None and ft.expr_tainted(arg):
+            seen.add(id(arg))
+            yield self.finding(
+                module, call,
+                f"traced value in {pos} of jitted {callee!r}: a "
+                "tracer cannot be a cache key — this raises, or "
+                "recompiles per value once materialized",
+                hint="pass the value as a regular (traced) argument, "
+                     "or materialize + bucket it on the host first")
+            return
+        if _derives_shape(fn, arg):
+            seen.add(id(arg))
+            yield self.finding(
+                module, call,
+                f"unbucketed shape-bearing value in {pos} of jitted "
+                f"{callee!r}: one compile per distinct length instead "
+                "of one per shape class",
+                hint="route the value through the ops/autotune bucket "
+                     "vocabulary (seq_bucket/pow2_bucket) so compiles "
+                     "land on the ledger's shape-class grid",
+                severity="warning")

@@ -1,0 +1,66 @@
+"""TPU014 — Python control flow on a traced value inside a jit region.
+
+``if``/``while``/``assert`` on a tracer either raises a
+concretization error at trace time (the lucky case) or — when the
+value sneaks through as a weakly-typed Python bool via shapes that
+happen to be concrete — silently splits the program into per-branch
+compilations: the recompile-storm signature the compile ledger sees
+as the same module fingerprinting differently per step.
+
+The taint core (:mod:`tracetaint`) decides "traced here": parameters
+of jit/pjit/Pallas contexts, ``jnp``/``lax`` results, nested scan/cond
+bodies, and module-local helpers called with tainted arguments.
+Shape/dtype reads, ``len()``, ``is``/``is not`` tests, and
+``isinstance`` are host-decidable and never flagged — branch-on-shape
+is the idiom, not the bug. The canonical fixes are ``jax.lax.cond`` /
+``jax.lax.while_loop`` / ``jnp.where``, or marking the argument
+static at the jit boundary.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from kubeflow_tpu.analysis import cfg as cfg_mod
+from kubeflow_tpu.analysis import tracetaint
+from kubeflow_tpu.analysis.findings import Finding
+from kubeflow_tpu.analysis.registry import Checker, register_checker
+from kubeflow_tpu.analysis.walker import ModuleInfo
+
+_KINDS = {ast.If: "if", ast.While: "while", ast.Assert: "assert"}
+
+
+@register_checker
+class TraceControlFlowChecker(Checker):
+    rule = "TPU014"
+    name = "traced-control-flow"
+    severity = "error"
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        mt = tracetaint.taint_analysis(module)
+        for fn, ctx_name in mt.traced_functions():
+            ft = mt.taint_of(fn)
+            for cn in ft.cfg.nodes:
+                stmt = cn.node
+                if cn.kind != cfg_mod.STMT or stmt is None:
+                    continue
+                kind = _KINDS.get(type(stmt))
+                if kind is None:
+                    continue
+                env = ft.taint_in.get(cn.nid)
+                if env is None:
+                    continue  # unreachable statement
+                if not ft._expr(stmt.test, env):
+                    continue
+                yield self.finding(
+                    module, stmt,
+                    f"Python `{kind}` on a traced value inside jit "
+                    f"context {ctx_name!r}; this concretizes a tracer "
+                    "(error) or forks one compilation per branch "
+                    "(recompile storm)",
+                    hint="use jax.lax.cond / jax.lax.while_loop / "
+                         "jnp.where, hoist the decision to the host, "
+                         "or mark the argument static at the jit "
+                         "boundary (shape/dtype reads are static and "
+                         "never flagged)")

@@ -1,0 +1,64 @@
+"""TPU018 — compile entry points invisible to the CompileLedger.
+
+PR 18 made ``CompileLedger.timed_compile`` the instrumented AOT entry
+point: it fingerprints the HLO, records the ``memory_analysis``
+budget, and lands the compile on the ``kftpu_compile_seconds`` series
+the goodput ledger and the planned fleet compile cache key on. A bare
+``jax.jit``/``pjit`` site in the serving/train/elastic planes is a
+compile those consumers can never attribute or warm — the startup
+badput the ROADMAP item exists to kill.
+
+A site is **sanctioned** when a name it is bound to (``step``,
+``self._step``, aliases through plain assignment, or the decorated
+function's own name) appears as the first argument of a
+``*.timed_compile(...)`` call anywhere in the same module — i.e. the
+module offers a ledger-routed path to that executable. Everything
+else needs either that wiring or an inline pragma explaining why the
+compile is deliberately listener-only (the process-wide
+``CompileLedger.install`` subscription still bills it, but without
+an AOT fingerprint or memory budget).
+
+Scope is deliberately the hot planes only — ``serving/``, ``train/``,
+``elastic/``. Kernels, benches, and examples jit freely.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from kubeflow_tpu.analysis import tracetaint
+from kubeflow_tpu.analysis.findings import Finding
+from kubeflow_tpu.analysis.registry import Checker, register_checker
+from kubeflow_tpu.analysis.walker import ModuleInfo
+
+SCOPES = ("kubeflow_tpu/serving/", "kubeflow_tpu/train/",
+          "kubeflow_tpu/elastic/")
+
+
+@register_checker
+class UnledgeredCompileChecker(Checker):
+    rule = "TPU018"
+    name = "unledgered-compile"
+    severity = "warning"
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        if not module.rel.startswith(SCOPES):
+            return
+        mt = tracetaint.taint_analysis(module)
+        for site in mt.sites:
+            names = set(site.bound) | ({site.wrapped} if site.wrapped
+                                       else set())
+            if names & mt.sanctioned:
+                continue
+            label = site.wrapped or "/".join(sorted(site.bound)) \
+                or "<anonymous>"
+            yield self.finding(
+                module, site.node,
+                f"jit site {label!r} bypasses "
+                "CompileLedger.timed_compile: the compile has no HLO "
+                "fingerprint or memory budget on the ledger, so the "
+                "fleet compile cache and AOT warm pools cannot key it",
+                hint="expose a ledger-routed path (pass the jitted "
+                     "callable to CompileLedger.timed_compile with "
+                     "example args/ShapeDtypeStructs), or pragma the "
+                     "site with the reason it stays listener-only")

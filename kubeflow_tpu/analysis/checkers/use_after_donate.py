@@ -1,0 +1,167 @@
+"""TPU016 — a donated argument read after the jitted call.
+
+``donate_argnums`` hands the argument's buffer to XLA: after the
+call returns, the Python name still points at an array whose storage
+may have been aliased into the outputs. Reading it "works" on CPU,
+returns garbage-or-raises on TPU, and the failure is shape-dependent
+— the worst kind of production surprise. The correct idiom rebinds
+the name from the call's result (``state = step(state, batch)``),
+which this rule recognizes as safe by construction.
+
+Scope (all conservatism, per the analysis-plane contract):
+
+- only call sites whose callee resolves to a jit site with a
+  *literal* ``donate_argnums`` (an unresolvable spec like
+  ``(0,) if donate else ()`` stays silent);
+- only donated arguments that are a bare name or ``self.attr`` —
+  expressions have no identity to track;
+- intraprocedural: a forward CFG walk from the call marks every path
+  until the name is rebound; any read (including the call statement
+  itself re-executing in a loop without a rebind) is the finding.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+from kubeflow_tpu.analysis import cfg as cfg_mod
+from kubeflow_tpu.analysis import tracetaint
+from kubeflow_tpu.analysis.findings import Finding
+from kubeflow_tpu.analysis.registry import Checker, register_checker
+from kubeflow_tpu.analysis.walker import ModuleInfo
+
+
+def _binds(target: ast.AST, name: str) -> bool:
+    if isinstance(target, (ast.Tuple, ast.List)):
+        return any(_binds(el, name) for el in target.elts)
+    if isinstance(target, ast.Starred):
+        return _binds(target.value, name)
+    return tracetaint._bindable_name(target) == name
+
+
+def _stmt_rebinds(cn: cfg_mod.CfgNode, name: str) -> bool:
+    stmt = cn.node
+    if stmt is None:
+        return False
+    if cn.kind == cfg_mod.WITH_ENTER:
+        return any(item.optional_vars is not None
+                   and _binds(item.optional_vars, name)
+                   for item in stmt.items)
+    if isinstance(stmt, ast.Assign):
+        return any(_binds(t, name) for t in stmt.targets)
+    if isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        return _binds(stmt.target, name)
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return _binds(stmt.target, name)
+    return False
+
+
+def _reads_in(cn: cfg_mod.CfgNode, name: str) -> Optional[ast.AST]:
+    """A Load of ``name`` among the expressions evaluated *at* this
+    node (branch headers evaluate only their test; Store targets do
+    not count — a pure rebind is the safe idiom)."""
+    stmt = cn.node
+    if stmt is None or cn.kind == cfg_mod.WITH_EXIT:
+        return None
+    if cn.kind == cfg_mod.WITH_ENTER:
+        exprs: List[ast.AST] = [i.context_expr for i in stmt.items]
+    elif isinstance(stmt, (ast.If, ast.While)):
+        exprs = [stmt.test]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        exprs = [stmt.iter]
+    elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef, ast.Try)):
+        return None
+    elif hasattr(ast, "Match") and isinstance(stmt, ast.Match):
+        exprs = [stmt.subject]
+    else:
+        exprs = [stmt]
+    for root in exprs:
+        for node in tracetaint.iter_exprs(root):
+            if isinstance(node, ast.Name) and node.id == name \
+                    and isinstance(node.ctx, ast.Load):
+                return node
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and tracetaint._bindable_name(node) == name:
+                return node
+            # a Store INTO the donated buffer (x[i] = ...) is a use too
+            if isinstance(node, ast.Subscript) \
+                    and isinstance(node.ctx, (ast.Store, ast.Del)) \
+                    and tracetaint._bindable_name(node.value) == name:
+                return node
+    return None
+
+
+@register_checker
+class UseAfterDonateChecker(Checker):
+    rule = "TPU016"
+    name = "use-after-donate"
+    severity = "error"
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        mt = tracetaint.taint_analysis(module)
+        if not mt.jitted_names:
+            return
+        reported: Set[Tuple[int, str]] = set()
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = tracetaint._bindable_name(node.func)
+            site = mt.site_for_name(callee) if callee else None
+            if site is None or not site.donate_argnums:
+                continue
+            fn = module.enclosing_function(node)
+            if fn is None:
+                continue
+            ft = mt.taint_of(fn)
+            stmt = ft.enclosing_stmt(node)
+            if stmt is None:
+                continue
+            start = ft.cfg.stmt_node.get(stmt)
+            if start is None:
+                continue
+            for i in site.donate_argnums:
+                if not (0 <= i < len(node.args)):
+                    continue
+                donated = tracetaint._bindable_name(node.args[i])
+                if donated is None:
+                    continue
+                if _stmt_rebinds(start, donated):
+                    continue  # state = step(state, ...): the idiom
+                read = self._first_read_after(ft.cfg, start, donated)
+                if read is None:
+                    continue
+                key = (node.lineno, donated)
+                if key in reported:
+                    continue
+                reported.add(key)
+                yield self.finding(
+                    module, read[0],
+                    f"{donated!r} read after being donated to "
+                    f"{callee!r} (donate_argnums={i}, call at line "
+                    f"{node.lineno}): the buffer may already be "
+                    "aliased into the call's outputs",
+                    hint="rebind the name from the call's result "
+                         "(x = f(x, ...)) before any further use, or "
+                         "drop the donation")
+
+    def _first_read_after(self, graph: cfg_mod.Cfg,
+                          start: cfg_mod.CfgNode, name: str,
+                          ) -> Optional[Tuple[ast.AST, ast.AST]]:
+        seen: Set[int] = set()
+        stack = list(start.succs)
+        while stack:
+            nid = stack.pop()
+            if nid in seen:
+                continue
+            seen.add(nid)
+            cn = graph.nodes[nid]
+            read = _reads_in(cn, name)
+            if read is not None:
+                return (cn.node, read)
+            if _stmt_rebinds(cn, name):
+                continue  # rebound: paths beyond here are clean
+            stack.extend(cn.succs)
+        return None

@@ -1,0 +1,217 @@
+"""Compile audit: static jit-site inventory × recorded compile events.
+
+The trace-taint plane (:mod:`tracetaint`) knows every ``jax.jit`` /
+``pjit`` site in the source; the CompileLedger (PR 18,
+``kubeflow_tpu/obs/xprof.py``) records every compilation that actually
+happened as ``kftpu_compile_seconds{module,shape_class,generation}``
+events. Joining the two converts the ledger from a measurement into an
+enforcement mechanism: a jit site is expected to compile **once per
+(shape class, backend generation)** — the shape-class grid is exactly
+the ``ops/autotune`` bucket vocabulary the engine and the bench suite
+key their program inventories on. A site whose runtime compile count
+exceeds that expectation is a *recompile storm with a source location
+attached* — the dynamic twin of TPU015, which can only flag the storms
+that are statically visible.
+
+Artifact formats accepted (all JSON):
+
+- ``CompileLedger.events_payload()``: ``{"compile_events": [...]}``;
+- a generic dump: ``{"events": [...]}`` or a top-level list of event
+  objects (each needs ``module``; ``shape_class``/``generation``/
+  ``seconds`` default);
+- a bench artifact whose ``compile`` block is
+  ``CompileLedger.summary()``: per-module *totals* only (one synthetic
+  event per module) — enough to attribute compile seconds to sites,
+  too coarse to count a storm; use ``events_payload()`` for gating.
+
+Matching events to sites is name-based and conservative: the event's
+``module`` field (XLA emits ``jit_<fn>``/``pjit_<fn>``; ``timed_compile``
+callers pass dotted labels like ``train.step``) is normalized and
+compared against each site's wrapped-function name and bound names.
+An event that matches no site is reported as *unmatched* — visible,
+never gating (the process may legitimately compile library code the
+lint scope never parsed).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from kubeflow_tpu.analysis import tracetaint
+from kubeflow_tpu.analysis.walker import ModuleInfo
+
+DEFAULT_MAX_PER_SHAPE = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class SiteRef:
+    """One static jit site, addressable from a report line."""
+
+    path: str
+    line: int
+    label: str            # wrapped name or bound-name join
+    names: Tuple[str, ...]  # every name the site answers to
+
+
+@dataclasses.dataclass(frozen=True)
+class Storm:
+    module: str           # the event's module label, as recorded
+    shape_class: str
+    generation: str
+    count: int
+    expected: int
+    seconds: float
+    site: Optional[SiteRef]   # None: storm in code the scan never saw
+
+
+@dataclasses.dataclass
+class AuditReport:
+    events: int
+    sites: int
+    storms: List[Storm]
+    unmatched: List[Tuple[str, int]]   # (module label, event count)
+
+    def format(self) -> str:
+        lines = [
+            f"compile-audit: {self.events} event(s), {self.sites} static "
+            f"jit site(s), {len(self.storms)} storm(s)"]
+        for s in self.storms:
+            lines.append(
+                f"  STORM {s.module!r} shape_class={s.shape_class!r} "
+                f"generation={s.generation!r}: {s.count} compiles "
+                f"(expected <= {s.expected}, {s.seconds:.3f}s total)")
+            if s.site is not None:
+                lines.append(
+                    f"    -> {s.site.path}:{s.site.line} jit site "
+                    f"{s.site.label!r}")
+            else:
+                lines.append(
+                    "    -> no static jit site matched (compiled outside "
+                    "the lint scope?)")
+        for module, n in self.unmatched:
+            lines.append(
+                f"  note: {n} event(s) for {module!r} matched no static "
+                "jit site (not gating)")
+        return "\n".join(lines)
+
+
+def load_events(data: Any) -> List[Dict[str, Any]]:
+    """Normalize any accepted artifact shape into a list of event
+    dicts with ``module``/``shape_class``/``generation``/``seconds``."""
+    if isinstance(data, dict):
+        if "compile_events" in data:
+            raw = data["compile_events"]
+        elif "events" in data:
+            raw = data["events"]
+        elif isinstance(data.get("compile"), dict):
+            # bench-artifact summary: synthesize per-module aggregates
+            block = data["compile"]
+            gen = str(block.get("generation", "unknown"))
+            raw = [{"module": m, "seconds": s, "shape_class": "unknown",
+                    "generation": gen}
+                   for m, s in (block.get("by_module") or {}).items()]
+        else:
+            raise ValueError(
+                "unrecognized compile-audit artifact: expected "
+                "'compile_events', 'events', a top-level list, or a "
+                "bench artifact with a 'compile' block")
+    elif isinstance(data, list):
+        raw = data
+    else:
+        raise ValueError(
+            f"unrecognized compile-audit artifact of type "
+            f"{type(data).__name__}")
+    out: List[Dict[str, Any]] = []
+    for ev in raw:
+        if not isinstance(ev, dict) or "module" not in ev:
+            continue
+        out.append({
+            "module": str(ev["module"]),
+            "shape_class": str(ev.get("shape_class") or "unknown"),
+            "generation": str(ev.get("generation") or "unknown"),
+            "seconds": float(ev.get("seconds") or 0.0),
+        })
+    return out
+
+
+def load_events_file(path: str) -> List[Dict[str, Any]]:
+    with open(path, encoding="utf-8") as f:
+        return load_events(json.load(f))
+
+
+def _candidates(label: str) -> List[str]:
+    """Names an event's module label could answer to: the raw label,
+    XLA's ``jit_``/``pjit_`` prefix stripped, and the last dotted
+    component of a ``train.step``-style ledger label."""
+    names = [label]
+    for prefix in ("jit_", "pjit_"):
+        if label.startswith(prefix):
+            names.append(label[len(prefix):])
+    if "." in label:
+        names.append(label.rsplit(".", 1)[1])
+    return names
+
+
+def site_inventory(modules: Iterable[ModuleInfo]) -> List[SiteRef]:
+    """Every jit site the trace-taint plane found, with the name set
+    an event label is matched against (wrapped name, bound names, and
+    bound names with a ``self.`` prefix stripped)."""
+    out: List[SiteRef] = []
+    for module in modules:
+        mt = tracetaint.taint_analysis(module)
+        for site in mt.sites:
+            names = set(site.bound)
+            names |= {n.split(".", 1)[1] for n in site.bound
+                      if n.startswith("self.")}
+            if site.wrapped and not site.wrapped.startswith("<"):
+                names.add(site.wrapped)
+            # label by the name call sites (and event labels) use: the
+            # bound name when there is one, else the wrapped function
+            label = ("/".join(sorted(site.bound))
+                     or (site.wrapped
+                         if site.wrapped
+                         and not site.wrapped.startswith("<")
+                         else "")
+                     or "<anonymous>")
+            out.append(SiteRef(path=module.rel, line=site.node.lineno,
+                               label=label,
+                               names=tuple(sorted(names))))
+    return out
+
+
+def audit(events: Sequence[Dict[str, Any]], sites: Sequence[SiteRef],
+          *, max_per_shape: int = DEFAULT_MAX_PER_SHAPE) -> AuditReport:
+    """Group events by (module, shape_class, generation); every group
+    whose count exceeds ``max_per_shape`` is a storm, attributed to
+    the static site whose name set matches the module label."""
+    by_name: Dict[str, SiteRef] = {}
+    for site in sites:
+        for n in site.names:
+            # first site wins per name; ambiguity keeps the first in
+            # walk order — the report carries path:line either way
+            by_name.setdefault(n, site)
+
+    groups: Dict[Tuple[str, str, str], List[Dict[str, Any]]] = {}
+    for ev in events:
+        key = (ev["module"], ev["shape_class"], ev["generation"])
+        groups.setdefault(key, []).append(ev)
+
+    storms: List[Storm] = []
+    unmatched: Dict[str, int] = {}
+    for (module, sc, gen), evs in sorted(groups.items()):
+        site = next(
+            (by_name[c] for c in _candidates(module) if c in by_name),
+            None)
+        if site is None:
+            unmatched[module] = unmatched.get(module, 0) + len(evs)
+        if len(evs) > max_per_shape:
+            storms.append(Storm(
+                module=module, shape_class=sc, generation=gen,
+                count=len(evs), expected=max_per_shape,
+                seconds=sum(e["seconds"] for e in evs), site=site))
+    storms.sort(key=lambda s: (-s.count, s.module, s.shape_class))
+    return AuditReport(events=len(events), sites=len(sites),
+                       storms=storms,
+                       unmatched=sorted(unmatched.items()))

@@ -334,7 +334,8 @@ class ClassLockAnalysis:
             for name in stale:
                 fn = self.graph.methods[name]
                 full = analyze_method(
-                    fn, self.locks, convention[name] | ctxs[name])
+                    fn, self.locks, convention[name] | ctxs[name],
+                    graph=cfg_mod.cfg_for(self.module, fn))
                 proven_entry = ctxs[name] if multi \
                     else convention[name] | ctxs[name]
                 self.methods[name] = full

@@ -17,7 +17,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from kubeflow_tpu.analysis import baseline as baseline_mod
 from kubeflow_tpu.analysis.findings import Finding
-from kubeflow_tpu.analysis.registry import create_checkers
+from kubeflow_tpu.analysis.registry import all_checkers, create_checkers
 from kubeflow_tpu.analysis.walker import ModuleInfo, walk_paths
 
 DEFAULT_PATHS = ("kubeflow_tpu",)
@@ -118,16 +118,29 @@ def lint_modules(modules: Sequence[ModuleInfo],
 def run_lint(paths: Optional[Sequence[str]] = None,
              root: Optional[str] = None,
              rules: Optional[Sequence[str]] = None,
-             baseline_path: Optional[str] = None) -> LintReport:
+             baseline_path: Optional[str] = None,
+             allow_unknown_rules: bool = False) -> LintReport:
     """Lint ``paths`` (default: the kubeflow_tpu package) against the
-    committed baseline. ``baseline_path=''`` disables baselining."""
+    committed baseline. ``baseline_path=''`` disables baselining.
+
+    Raises :class:`baseline.BaselineRuleGap` when the baseline records
+    a covered-rule set and an active rule is absent from it — the
+    baseline predates the rule, so its findings cannot be gated.
+    ``allow_unknown_rules=True`` skips that check (the
+    ``--baseline-update`` path, which exists to close the gap)."""
     root = root or repo_root()
     modules = list(walk_paths(paths or DEFAULT_PATHS, root))
     kept, suppressed = lint_modules(modules, rules)
 
     if baseline_path is None:
         baseline_path = os.path.join(root, baseline_mod.DEFAULT_BASELINE)
-    base = baseline_mod.load(baseline_path) if baseline_path else {}
+    payload = baseline_mod.load_payload(baseline_path) \
+        if baseline_path else {}
+    if baseline_path and not allow_unknown_rules:
+        active = ([r.upper() for r in rules] if rules
+                  else list(all_checkers()))
+        baseline_mod.check_rule_coverage(baseline_path, payload, active)
+    base = payload.get("findings", {}) if payload else {}
     new = baseline_mod.new_findings(kept, base)
     return LintReport(findings=kept, new=new, suppressed=suppressed,
                       files=len(modules))
@@ -137,5 +150,5 @@ def update_baseline(report: LintReport, root: Optional[str] = None,
                     baseline_path: Optional[str] = None) -> str:
     root = root or repo_root()
     path = baseline_path or os.path.join(root, baseline_mod.DEFAULT_BASELINE)
-    baseline_mod.save(path, report.findings)
+    baseline_mod.save(path, report.findings, rules=sorted(all_checkers()))
     return path

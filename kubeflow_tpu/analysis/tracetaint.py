@@ -1,0 +1,740 @@
+"""Trace-taint dataflow: which values are traced (or live on device).
+
+The second dataflow plane over the :mod:`cfg`/:mod:`callgraph`
+infrastructure. :mod:`locksets` answers "which locks are held here";
+this module answers "is this expression a *traced* value here" — the
+property under every recompile storm, donation bug, and hidden host
+sync that PR 18's compile ledger can only measure after a chip paid
+for it.
+
+A value is **tainted** when it may be a JAX tracer or a device array:
+
+- parameters of a jit/pjit/Pallas context function (minus the params
+  named by ``static_argnums``/``static_argnames`` — those are Python
+  values by contract);
+- results of ``jnp.*`` / ``jax.lax.*`` / ``jax.random.*`` / ``pl.*``
+  calls, and of calling any name bound to a jitted callable;
+- anything data-derived from a tainted value: assignments, container
+  literals, subscripts, arithmetic, method calls on a tainted
+  receiver, iteration.
+
+Taint is a **may** analysis (union joins over the CFG, strong updates
+on simple ``name``/``self.attr`` rebinds) — the dual of the lock
+plane's must-analysis, because here the dangerous direction is "this
+might be traced". What keeps it conservative toward *silence* is the
+sanitizer set: ``.shape``/``.dtype``/``.ndim``/``.size`` reads,
+``len()``, ``is``/``is not`` comparisons, ``isinstance``, and the
+host-materializing calls (``int()``/``float()``, ``.item()``,
+``.tolist()``, ``np.asarray``, ``jax.device_get``, the
+``*bucket`` shape-class vocabulary of ``ops/autotune``) all produce
+host values, so branch-on-shape and bucketed-padding idioms never
+taint. Per the analysis plane's contract, a fact that cannot be
+proven stays un-flagged.
+
+Interprocedural scope is module-local and bounded (like the lock
+plane's ``_PROPAGATION_ROUNDS``): a module-level function called from
+a traced context with a tainted argument becomes a traced context
+itself, with exactly those parameters tainted; nested ``def``s inside
+a traced context are traced with *all* parameters tainted (they are
+``scan``/``cond`` bodies by construction). Cross-module calls are
+invisible — a documented false-negative, never a false positive.
+
+The module also builds the **jit-site inventory** every compile-plane
+rule shares: each ``jax.jit``/``pjit`` call or decoration with its
+bound names (``step``, ``self._step``, aliases through plain
+assignment), resolved ``static_argnums``/``static_argnames``/
+``donate_argnums`` (literal-or-None, per :mod:`astutil`'s
+conservatism), loop nesting, and whether the wrapped callable is
+fresh per call (lambda / ``functools.partial``). The same inventory
+is what ``scripts/run_tpulint.py --compile-audit`` joins against the
+recorded ``kftpu_compile_seconds`` events.
+
+Everything is memoized per :class:`ModuleInfo` via
+:func:`taint_analysis`; CFGs come from :func:`cfg.cfg_for`, shared
+with the lock plane, so the five consuming checkers (TPU014–TPU018)
+add one analysis pass per file, not five.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import (Dict, FrozenSet, Iterator, List, Optional, Sequence,
+                    Set, Tuple, Union)
+
+from kubeflow_tpu.analysis import astutil
+from kubeflow_tpu.analysis import cfg as cfg_mod
+from kubeflow_tpu.analysis.walker import ModuleInfo
+
+FunctionNode = Union[ast.FunctionDef, ast.AsyncFunctionDef]
+
+JIT_NAMES = {"jit", "jax.jit", "pjit", "pjit.pjit",
+             "jax.experimental.pjit.pjit"}
+PALLAS_CALL_SUFFIX = "pallas_call"
+
+_PROPAGATION_ROUNDS = 3   # traced-helper call-site fixpoint bound
+
+# dotted-call prefixes whose results are traced/device values
+TRACED_PREFIXES = ("jnp.", "jax.numpy.", "jax.lax.", "lax.",
+                   "jax.random.", "jax.nn.", "jax.scipy.", "jax.ops.",
+                   "pl.", "pltpu.")
+TRACED_EXACT = {"jax.device_put", "jax.eval_shape"}
+
+# attribute reads that are static under tracing — branch-on-shape is
+# the idiomatic fix for TPU014, so it must never taint
+UNTAINT_ATTRS = {"shape", "dtype", "ndim", "size", "itemsize", "nbytes",
+                 "sharding", "aval", "weak_type"}
+
+# calls whose result is a host value (several of them are exactly the
+# sync points TPU017 flags — the *result* is host-side either way)
+SANITIZER_CALLS = {"int", "float", "bool", "str", "len", "isinstance",
+                   "hash", "repr", "range", "np.asarray", "np.array",
+                   "numpy.asarray", "numpy.array", "jax.device_get",
+                   "np.float32", "np.int32", "np.float64", "np.int64"}
+# method calls whose result is a host value; block_until_ready is a
+# sync but returns the (device) array itself, so it stays tainted
+SANITIZER_METHODS = {"item", "tolist"}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _bindable_name(node: ast.AST) -> Optional[str]:
+    """A name a callable can be reached by later: bare ``x`` or
+    ``self.x`` (as "self.x"). Anything else has no stable handle."""
+    if isinstance(node, ast.Name):
+        return node.id
+    attr = _self_attr(node)
+    if attr is not None:
+        return "self." + attr
+    return None
+
+
+def iter_exprs(root: ast.AST) -> Iterator[ast.AST]:
+    """Walk ``root`` without descending into nested defs/lambdas —
+    their bodies are separate (traced) contexts with their own taint."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            stack.append(child)
+
+
+def _int_tuple(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    """``0`` / ``(0, 1)`` / ``[0]`` → a tuple of ints; None when any
+    element is not a literal int (conditional expressions, names)."""
+    v = astutil.const_int(node)
+    if v is not None:
+        return (v,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            v = astutil.const_int(el)
+            if v is None:
+                return None
+            out.append(v)
+        return tuple(out)
+    return None
+
+
+def _str_tuple(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    v = astutil.const_str(node)
+    if v is not None:
+        return (v,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            v = astutil.const_str(el)
+            if v is None:
+                return None
+            out.append(v)
+        return tuple(out)
+    return None
+
+
+@dataclasses.dataclass
+class JitSite:
+    """One ``jax.jit``/``pjit`` entry point: a call site or a
+    decorated function."""
+
+    node: ast.AST                 # the Call, or the decorated def
+    lineno: int
+    kind: str                     # "call" | "decorator"
+    wrapped: str                  # wrapped callable's name ("<lambda>")
+    bound: Set[str]               # names it is reachable by (+aliases)
+    enclosing: Optional[str]      # enclosing function name, if any
+    in_loop: bool                 # constructed inside a loop body
+    immediate: bool               # jax.jit(f, ...)(args) — used once
+    fresh_callee: bool            # wraps a lambda / functools.partial
+    static_argnums: Optional[Tuple[int, ...]]
+    static_argnames: Optional[Tuple[str, ...]]
+    donate_argnums: Optional[Tuple[int, ...]]
+
+
+class FunctionTaint:
+    """May-taint states for one function, one entry assumption."""
+
+    def __init__(self, mt: "ModuleTaint", fn: FunctionNode,
+                 entry: FrozenSet[str]) -> None:
+        self.mt = mt
+        self.fn = fn
+        self.entry = entry
+        self.cfg = cfg_mod.cfg_for(mt.module, fn)
+        self.taint_in: Dict[int, Optional[FrozenSet[str]]] = {
+            n.nid: None for n in self.cfg.nodes}
+        self._run()
+
+    # -- fixpoint ----------------------------------------------------------
+
+    def _run(self) -> None:
+        self.taint_in[self.cfg.entry.nid] = self.entry
+        worklist = [self.cfg.entry.nid]
+        while worklist:
+            nid = worklist.pop()
+            state = self.taint_in[nid]
+            if state is None:
+                continue
+            out = self._transfer(self.cfg.nodes[nid], state)
+            for s in self.cfg.nodes[nid].succs:
+                cur = self.taint_in[s]
+                new = out if cur is None else (cur | out)
+                if cur is None or new != cur:
+                    self.taint_in[s] = frozenset(new)
+                    worklist.append(s)
+
+    def _transfer(self, cn: cfg_mod.CfgNode,
+                  env: FrozenSet[str]) -> FrozenSet[str]:
+        stmt = cn.node
+        if stmt is None or cn.kind == cfg_mod.WITH_EXIT:
+            return env
+        out = set(env)
+        if cn.kind == cfg_mod.WITH_ENTER:
+            for item in stmt.items:
+                if item.optional_vars is not None:
+                    t = self._expr(item.context_expr, env)
+                    self._assign(out, item.optional_vars, t, env)
+            return frozenset(out)
+        if isinstance(stmt, ast.Assign):
+            t = self._expr(stmt.value, env)
+            for tgt in stmt.targets:
+                self._assign(out, tgt, t, env, value=stmt.value)
+            return frozenset(out)
+        if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            t = self._expr(stmt.value, env)
+            self._assign(out, stmt.target, t, env)
+            return frozenset(out)
+        if isinstance(stmt, ast.AugAssign):
+            # target op= value reads the old target; taint only grows
+            if self._expr(stmt.value, env):
+                name = _bindable_name(stmt.target)
+                if name is not None:
+                    out.add(name)
+            return frozenset(out)
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            t = self._expr(stmt.iter, env)
+            self._assign(out, stmt.target, t, env)
+            return frozenset(out)
+        if isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                name = _bindable_name(tgt)
+                if name is not None:
+                    out.discard(name)
+            return frozenset(out)
+        return env
+
+    def _assign(self, out: Set[str], target: ast.AST, tainted: bool,
+                env: FrozenSet[str],
+                value: Optional[ast.AST] = None) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            elts = None
+            if isinstance(value, (ast.Tuple, ast.List)) \
+                    and len(value.elts) == len(target.elts):
+                elts = value.elts
+            for i, el in enumerate(target.elts):
+                if elts is not None:
+                    self._assign(out, el, self._expr(elts[i], env), env,
+                                 value=elts[i])
+                else:
+                    self._assign(out, el, tainted, env)
+            return
+        if isinstance(target, ast.Starred):
+            self._assign(out, target.value, tainted, env)
+            return
+        name = _bindable_name(target)
+        if name is not None:
+            # strong update: a simple rebind replaces the old value
+            if tainted:
+                out.add(name)
+            else:
+                out.discard(name)
+            return
+        if isinstance(target, ast.Subscript):
+            # x[i] = tainted → x may now hold a tainted element (weak)
+            base = _bindable_name(target.value)
+            if base is not None and tainted:
+                out.add(base)
+
+    # -- expression taint --------------------------------------------------
+
+    def _expr(self, node: ast.AST, env: FrozenSet[str]) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in env
+        if isinstance(node, ast.Attribute):
+            if node.attr in UNTAINT_ATTRS:
+                return False
+            name = _bindable_name(node)
+            if name is not None:
+                return name in env
+            return self._expr(node.value, env)
+        if isinstance(node, (ast.Constant, ast.Lambda, ast.JoinedStr,
+                             ast.FormattedValue)):
+            return False
+        if isinstance(node, ast.Call):
+            return self._call(node, env)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False  # identity tests are host-decidable
+            return (self._expr(node.left, env)
+                    or any(self._expr(c, env) for c in node.comparators))
+        if isinstance(node, ast.BoolOp):
+            return any(self._expr(v, env) for v in node.values)
+        if isinstance(node, ast.BinOp):
+            return self._expr(node.left, env) \
+                or self._expr(node.right, env)
+        if isinstance(node, ast.UnaryOp):
+            return self._expr(node.operand, env)
+        if isinstance(node, ast.IfExp):
+            return self._expr(node.body, env) \
+                or self._expr(node.orelse, env)
+        if isinstance(node, ast.Subscript):
+            return self._expr(node.value, env)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self._expr(e, env) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(self._expr(v, env)
+                       for v in node.values if v is not None)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            return any(self._expr(g.iter, env) for g in node.generators)
+        if isinstance(node, ast.Starred):
+            return self._expr(node.value, env)
+        if isinstance(node, ast.Await):
+            return self._expr(node.value, env)
+        if isinstance(node, ast.NamedExpr):
+            return self._expr(node.value, env)
+        if isinstance(node, ast.Slice):
+            return any(self._expr(p, env) for p in
+                       (node.lower, node.upper, node.step)
+                       if p is not None)
+        return False
+
+    def _call(self, node: ast.Call, env: FrozenSet[str]) -> bool:
+        func = node.func
+        if isinstance(func, ast.Attribute) \
+                and func.attr in SANITIZER_METHODS:
+            return False
+        name = astutil.call_name(node) or ""
+        if name:
+            if name in SANITIZER_CALLS:
+                return False
+            last = name.split(".")[-1]
+            if last.endswith("bucket"):
+                # the ops/autotune shape-class vocabulary (seq_bucket,
+                # pow2_bucket): its whole point is a host-side int
+                return False
+            if name in TRACED_EXACT \
+                    or any(name.startswith(p) for p in TRACED_PREFIXES):
+                return True
+            if name in self.mt.jitted_names:
+                return True
+        # a method call on a tainted receiver returns a tainted value
+        # (x.astype(...), x.sum(), cache.at[i].set(...))
+        if isinstance(func, ast.Attribute) \
+                and self._expr(func.value, env):
+            return True
+        if isinstance(func, ast.Name) and func.id in env:
+            return True  # calling a value that is itself traced-ish
+        return (any(self._expr(a, env) for a in node.args)
+                or any(self._expr(kw.value, env)
+                       for kw in node.keywords if kw.value is not None))
+
+    # -- queries -----------------------------------------------------------
+
+    def enclosing_stmt(self, node: ast.AST) -> Optional[ast.AST]:
+        """Walk up to the CFG statement of this function that evaluates
+        ``node``; None when the node sits in a nested def (that def
+        has its own FunctionTaint)."""
+        cur: Optional[ast.AST] = node
+        while cur is not None and cur is not self.fn:
+            if cur in self.cfg.stmt_node:
+                if isinstance(cur, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)) \
+                        and cur is not node:
+                    return None
+                return cur
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return None
+            cur = self.mt.module.parents.get(cur)
+        return None
+
+    def env_at(self, stmt: ast.AST) -> Optional[FrozenSet[str]]:
+        cn = self.cfg.stmt_node.get(stmt)
+        if cn is None:
+            return None
+        return self.taint_in.get(cn.nid)
+
+    def expr_tainted(self, node: ast.AST) -> bool:
+        """Is ``node`` (an expression somewhere in this function) a
+        traced/device value where it is evaluated? False whenever the
+        statement cannot be located or is unreachable — silence over
+        guessing."""
+        stmt = self.enclosing_stmt(node)
+        if stmt is None:
+            return False
+        env = self.env_at(stmt)
+        if env is None:
+            return False
+        return self._expr(node, env)
+
+
+class ModuleTaint:
+    """Jit-site inventory + traced contexts + per-function taint for
+    one module."""
+
+    def __init__(self, module: ModuleInfo) -> None:
+        self.module = module
+        self.sites: List[JitSite] = []
+        # names bound to jitted callables ("step", "self._step", ...)
+        self.jitted_names: Set[str] = set()
+        # names routed through a *.timed_compile(...) first argument —
+        # compiles the CompileLedger can see (TPU018's sanction set)
+        self.sanctioned: Set[str] = set()
+        # traced functions: id(fn) → (fn, display name)
+        self.contexts: Dict[int, Tuple[FunctionNode, str]] = {}
+        # id(fn) → tainted entry param names
+        self._entry: Dict[int, Set[str]] = {}
+        self._taints: Dict[int, FunctionTaint] = {}
+        self._defs: Dict[str, List[FunctionNode]] = {}
+        if not self._worth_analyzing():
+            return
+        for fn in astutil.functions(module.tree):
+            self._defs.setdefault(fn.name, []).append(fn)
+        self._collect_sites()
+        self._collect_aliases()
+        self._collect_sanctioned()
+        self._seed_contexts()
+        self._propagate()
+
+    def _worth_analyzing(self) -> bool:
+        # cheap textual gate: no jax in the file ⇒ no jit sites, no
+        # traced values, nothing for five checkers to do
+        src = self.module.source
+        return "jax" in src or "jnp" in src
+
+    # -- site inventory ----------------------------------------------------
+
+    def _site_kwargs(self, call: ast.Call) -> Tuple[
+            Optional[Tuple[int, ...]], Optional[Tuple[str, ...]],
+            Optional[Tuple[int, ...]], bool]:
+        """(static_argnums, static_argnames, donate_argnums, present)
+        — each resolved to a literal tuple or None for "present but
+        unresolvable". ``present`` flags per-kwarg existence via the
+        sentinel: absent kwargs resolve to ()."""
+        sn: Optional[Tuple[int, ...]] = ()
+        sa: Optional[Tuple[str, ...]] = ()
+        dn: Optional[Tuple[int, ...]] = ()
+        for kw in call.keywords:
+            if kw.arg == "static_argnums":
+                sn = _int_tuple(kw.value)
+            elif kw.arg == "static_argnames":
+                sa = _str_tuple(kw.value)
+            elif kw.arg in ("donate_argnums", "donate_argnames"):
+                dn = _int_tuple(kw.value) \
+                    if kw.arg == "donate_argnums" else None
+        return sn, sa, dn, True
+
+    def _ancestry(self, node: ast.AST) -> Tuple[Optional[str], bool]:
+        """(enclosing function name, inside-a-loop?) — the loop check
+        stops at the first enclosing def (a jit built inside a nested
+        function inside a loop runs on that function's schedule, which
+        this module-local analysis cannot see)."""
+        in_loop = False
+        cur = self.module.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+                in_loop = True
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur.name, in_loop
+            cur = self.module.parents.get(cur)
+        return None, in_loop
+
+    def _collect_sites(self) -> None:
+        # decorator form
+        for fn in astutil.functions(self.module.tree):
+            decs = set(astutil.decorator_names(fn))
+            if not (decs & JIT_NAMES):
+                continue
+            sn: Optional[Tuple[int, ...]] = ()
+            sa: Optional[Tuple[str, ...]] = ()
+            dn: Optional[Tuple[int, ...]] = ()
+            for dec in fn.decorator_list:
+                if isinstance(dec, ast.Call):
+                    sn, sa, dn, _ = self._site_kwargs(dec)
+            enclosing, in_loop = self._ancestry(fn)
+            self.sites.append(JitSite(
+                node=fn, lineno=fn.lineno, kind="decorator",
+                wrapped=fn.name, bound={fn.name}, enclosing=enclosing,
+                in_loop=in_loop, immediate=False, fresh_callee=False,
+                static_argnums=sn, static_argnames=sa,
+                donate_argnums=dn))
+        # call form
+        for node in ast.walk(self.module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if (astutil.call_name(node) or "") not in JIT_NAMES:
+                continue
+            wrapped, fresh = "", False
+            if node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Lambda):
+                    wrapped, fresh = "<lambda>", True
+                elif isinstance(arg, ast.Call):
+                    inner = astutil.call_name(arg) or ""
+                    if inner in ("functools.partial", "partial"):
+                        fresh = True
+                        if arg.args:
+                            wrapped = astutil.dotted_name(arg.args[0]) \
+                                or "<partial>"
+                        else:
+                            wrapped = "<partial>"
+                    else:
+                        wrapped = inner or "<call>"
+                else:
+                    wrapped = astutil.dotted_name(arg) or ""
+            sn, sa, dn, _ = self._site_kwargs(node)
+            bound: Set[str] = set()
+            immediate = False
+            parent = self.module.parents.get(node)
+            if isinstance(parent, ast.Call) and parent.func is node:
+                immediate = True
+            elif isinstance(parent, ast.Assign):
+                for tgt in parent.targets:
+                    name = _bindable_name(tgt)
+                    if name is not None:
+                        bound.add(name)
+            elif isinstance(parent, ast.AnnAssign):
+                name = _bindable_name(parent.target)
+                if name is not None:
+                    bound.add(name)
+            enclosing, in_loop = self._ancestry(node)
+            self.sites.append(JitSite(
+                node=node, lineno=node.lineno, kind="call",
+                wrapped=wrapped, bound=bound, enclosing=enclosing,
+                in_loop=in_loop, immediate=immediate,
+                fresh_callee=fresh, static_argnums=sn,
+                static_argnames=sa, donate_argnums=dn))
+        for site in self.sites:
+            self.jitted_names |= site.bound
+
+    def _collect_aliases(self) -> None:
+        """``self._prefill = _prefill_and_sample`` style re-bindings of
+        a jitted callable: the alias is jitted too (and inherits the
+        site's donate/static contract for the call-site rules)."""
+        by_name: Dict[str, JitSite] = {}
+        for site in self.sites:
+            for b in site.bound:
+                by_name.setdefault(b, site)
+        for _ in range(2):  # alias-of-alias, one extra hop
+            changed = False
+            for node in ast.walk(self.module.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                src = _bindable_name(node.value)
+                if src is None or src not in by_name:
+                    continue
+                site = by_name[src]
+                for tgt in node.targets:
+                    name = _bindable_name(tgt)
+                    if name is not None and name not in site.bound:
+                        site.bound.add(name)
+                        self.jitted_names.add(name)
+                        by_name.setdefault(name, site)
+                        changed = True
+            if not changed:
+                break
+
+    def _collect_sanctioned(self) -> None:
+        for node in ast.walk(self.module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute) \
+                    or func.attr != "timed_compile" or not node.args:
+                continue
+            name = _bindable_name(node.args[0]) \
+                or astutil.dotted_name(node.args[0])
+            if name:
+                self.sanctioned.add(name)
+
+    def site_for_name(self, name: str) -> Optional[JitSite]:
+        """The unique site a bound name resolves to; None when the name
+        is unknown or ambiguous (two sites claim it)."""
+        found = None
+        for site in self.sites:
+            if name in site.bound:
+                if found is not None and found is not site:
+                    return None
+                found = site
+        return found
+
+    # -- traced-context discovery ------------------------------------------
+
+    def _params_of(self, fn: FunctionNode) -> List[str]:
+        a = fn.args
+        names = [p.arg for p in a.posonlyargs + a.args]
+        return [n for n in names if n != "self"]
+
+    def _context_entry(self, fn: FunctionNode, site: Optional[JitSite],
+                       ) -> Set[str]:
+        params = self._params_of(fn)
+        static: Set[str] = set()
+        if site is not None:
+            for i in site.static_argnums or ():
+                if 0 <= i < len(params):
+                    static.add(params[i])
+            static |= set(site.static_argnames or ())
+        kwonly = [p.arg for p in fn.args.kwonlyargs]
+        return (set(params) | set(kwonly)) - static
+
+    def _add_context(self, fn: FunctionNode, name: str,
+                     entry: Set[str]) -> bool:
+        key = id(fn)
+        if key not in self.contexts:
+            self.contexts[key] = (fn, name)
+            self._entry[key] = set(entry)
+            return True
+        if not entry <= self._entry[key]:
+            self._entry[key] |= entry
+            self._taints.pop(key, None)
+            return True
+        return False
+
+    def _seed_contexts(self) -> None:
+        # decorated + call-form jit targets
+        for site in self.sites:
+            if site.kind == "decorator":
+                self._add_context(site.node, site.wrapped,
+                                  self._context_entry(site.node, site))
+            elif site.wrapped and "." not in site.wrapped:
+                for fn in self._defs.get(site.wrapped, ()):
+                    self._add_context(fn, fn.name,
+                                      self._context_entry(fn, site))
+        # Pallas kernel bodies: first arg of pl.pallas_call (optionally
+        # through functools.partial) — every Ref param is traced
+        for node in ast.walk(self.module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = astutil.call_name(node) or ""
+            if name.split(".")[-1] != PALLAS_CALL_SUFFIX or not node.args:
+                continue
+            arg = node.args[0]
+            target = ""
+            if isinstance(arg, ast.Name):
+                target = arg.id
+            elif isinstance(arg, ast.Call):
+                inner = astutil.call_name(arg) or ""
+                if inner in ("functools.partial", "partial") and arg.args \
+                        and isinstance(arg.args[0], ast.Name):
+                    target = arg.args[0].id
+            for fn in self._defs.get(target, ()):
+                self._add_context(fn, fn.name,
+                                  set(self._params_of(fn)))
+        # nested defs inside any traced context are traced bodies
+        # (scan/cond/while_loop callees): every parameter is a tracer
+        frontier = [fn for fn, _ in list(self.contexts.values())]
+        while frontier:
+            ctx = frontier.pop()
+            for node in ast.walk(ctx):
+                if node is ctx or not isinstance(
+                        node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if id(node) not in self.contexts:
+                    self._add_context(
+                        node, node.name,
+                        set(self._params_of(node))
+                        | {p.arg for p in node.args.kwonlyargs})
+                    frontier.append(node)
+
+    def _propagate(self) -> None:
+        """Module-level helpers called from traced code with tainted
+        arguments become traced contexts themselves (those params
+        tainted) — bounded rounds, module-local."""
+        for _ in range(_PROPAGATION_ROUNDS):
+            changed = False
+            for fn, name in list(self.contexts.values()):
+                ft = self.taint_of(fn)
+                for node in iter_exprs(fn):
+                    if not isinstance(node, ast.Call) \
+                            or not isinstance(node.func, ast.Name):
+                        continue
+                    targets = self._defs.get(node.func.id, ())
+                    if not targets:
+                        continue
+                    tainted_args = [
+                        i for i, a in enumerate(node.args)
+                        if ft.expr_tainted(a)]
+                    tainted_kw = {
+                        kw.arg for kw in node.keywords
+                        if kw.arg and ft.expr_tainted(kw.value)}
+                    if not tainted_args and not tainted_kw:
+                        continue
+                    for callee in targets:
+                        params = self._params_of(callee)
+                        entry = {params[i] for i in tainted_args
+                                 if i < len(params)} | tainted_kw
+                        if entry and self._add_context(
+                                callee, callee.name, entry):
+                            changed = True
+            if not changed:
+                break
+
+    # -- per-function taint ------------------------------------------------
+
+    def taint_of(self, fn: FunctionNode) -> FunctionTaint:
+        """The FunctionTaint for ``fn`` — traced entry params when it
+        is a traced context, empty entry otherwise (host code still
+        taints through calls to jitted names)."""
+        key = id(fn)
+        got = self._taints.get(key)
+        if got is None:
+            entry = frozenset(self._entry.get(key, ()))
+            got = FunctionTaint(self, fn, entry)
+            self._taints[key] = got
+        return got
+
+    def traced_functions(self) -> List[Tuple[FunctionNode, str]]:
+        return list(self.contexts.values())
+
+    def is_traced(self, fn: FunctionNode) -> bool:
+        return id(fn) in self.contexts
+
+
+def taint_analysis(module: ModuleInfo) -> ModuleTaint:
+    """The module's trace-taint analysis, computed once and memoized —
+    TPU014–TPU018 share one pass per file, exactly like the lock
+    plane's :func:`locksets.lock_analysis`."""
+    cached = getattr(module, "_trace_taint", None)
+    if cached is None:
+        cached = ModuleTaint(module)
+        module._trace_taint = cached
+    return cached

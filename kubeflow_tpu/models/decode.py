@@ -495,7 +495,9 @@ def speculative_generate(config: TransformerConfig, params,
     while min(len(e) for e in emitted) < max_new_tokens:
         t_cache, d_cache, out, m, pending, n = spec_round(
             params, draft_params, t_cache, d_cache, pending)
-        out, m, n = np.asarray(out), np.asarray(m), np.asarray(n)
+        # the per-round surfacing point BY DESIGN: acceptance counts
+        # decide on the host whether another speculative round runs
+        out, m, n = np.asarray(out), np.asarray(m), np.asarray(n)  # tpulint: disable=TPU017
         rounds += 1
         accepted_total += int(n.sum())
         for b in range(B):

@@ -478,6 +478,14 @@ class CompileLedger:
             out["generation"] = evs[-1].generation
         return out
 
+    def events_payload(self) -> Dict[str, Any]:
+        """The ``--compile-audit`` artifact shape: every ledgered
+        event, JSON-serializable, keyed for the static jit-site join
+        (``kubeflow_tpu/analysis/compileaudit.py``)."""
+        with self._lock:
+            evs = list(self.events)
+        return {"compile_events": [dataclasses.asdict(e) for e in evs]}
+
     # -- jax.monitoring subscription ---------------------------------------
 
     def install(self) -> bool:

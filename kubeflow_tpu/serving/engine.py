@@ -46,6 +46,12 @@ batch path adds batch-bucket shapes to the inventory
 (``KFTPU_ADMIT_BATCH=0`` pins admission back to the row path's one
 program per prompt bucket if that matters more than burst TTFT).
 """
+# tpulint: disable-file=TPU018 — the engine's per-bucket program
+# inventory compiles lazily on first dispatch and is billed by the
+# process-wide CompileLedger monitoring listener; routing these sites
+# through timed_compile would AOT-compile via .lower().compile(),
+# which does NOT populate jax's jit dispatch cache, so every program
+# would compile twice. `precompile=True` is the engine's warm path.
 
 from __future__ import annotations
 
@@ -1025,7 +1031,9 @@ class DecodeEngine:
                         jnp.int32(req.seed), jnp.int32(0))
             self._cache = self._insert(self._cache, row_cache,
                                        jnp.int32(slot))
-        self._finalize_admission(req, slot, int(tok))
+        # the prefill-sampled first token must surface NOW — emitting it
+        # is what makes TTFT one prefill + one step
+        self._finalize_admission(req, slot, int(tok))  # tpulint: disable=TPU017
 
     def _finalize_admission(self, req: _Request, slot: int,
                             first: int) -> None:
@@ -1664,7 +1672,7 @@ class DecodeEngine:
             # device-side prefill failure must surface while self._cache
             # is still intact, so _admit's row-path fallback retries
             # against a live engine instead of a consumed cache
-            toks = np.asarray(toks)
+            toks = np.asarray(toks)  # tpulint: disable=TPU017 — deliberate barrier, see above
             p1 = self.clock()
             try:
                 self._cache = self._insert_rows(

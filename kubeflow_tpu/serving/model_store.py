@@ -346,8 +346,11 @@ def load_version(base_path: str, version: int,
     if sharded_load:
         params = shard_lm_params(params, mesh)
 
+    # one program per loaded model, compiled lazily on the first
+    # request and billed by the CompileLedger listener; there is no
+    # example input at load time to AOT-compile against
     @jax.jit
-    def predict(x: jnp.ndarray) -> jnp.ndarray:
+    def predict(x: jnp.ndarray) -> jnp.ndarray:  # tpulint: disable=TPU018
         return apply_fn(model, params, x)
 
     generate = None
@@ -364,9 +367,12 @@ def load_version(base_path: str, version: int,
         # every temperature/top_k/top_p shares one compiled sampling
         # program (a client sweeping them must not mint unbounded XLA
         # cache entries); the unfiltered path stays sort-free
+        # same listener-only contract as predict: shapes arrive with
+        # requests, so the bounded sampling-program inventory compiles
+        # lazily per (max_new, greedy, filtered) key
         @functools.partial(jax.jit,
                            static_argnames=("max_new", "greedy", "filtered"))
-        def generate(prompt, true_len, max_new, temperature, rng_seed, *,
+        def generate(prompt, true_len, max_new, temperature, rng_seed, *,  # tpulint: disable=TPU018
                      greedy, top_k=0, top_p=1.0, filtered=False):
             return _generate(
                 model.config, params, prompt,

@@ -122,8 +122,11 @@ def distill_draft(target_config: TransformerConfig, target_params: Any,
     # embed the full frozen target as HLO constants — catastrophic at
     # real model sizes (a 167M-param target is a ~334 MB program body;
     # remote-compile transports reject it outright)
+    # one ad-hoc distillation program per make_draft call, closed over
+    # this tx/draft pair — billed by the CompileLedger listener; there
+    # is no long-lived runner to hang an AOT handle on
     @jax.jit
-    def step(dparams, opt_state, tokens, tparams):
+    def step(dparams, opt_state, tokens, tparams):  # tpulint: disable=TPU018
         t_logits = target.apply({"params": tparams}, tokens)
         t_probs = jax.nn.softmax(t_logits.astype(jnp.float32), axis=-1)
         t_logp = jax.nn.log_softmax(t_logits.astype(jnp.float32), -1)
@@ -143,7 +146,9 @@ def distill_draft(target_config: TransformerConfig, target_params: Any,
         return optax.apply_updates(dparams, updates), opt_state, loss
 
     rng = np.random.default_rng(seed)
-    first_loss: Optional[float] = None
+    # first-step loss stays a device value until after the loop: a
+    # float() inside would stall the host on step 1's dispatch queue
+    first_loss: Optional[jnp.ndarray] = None
     loss = jnp.float32(0.0)
     for _ in range(steps):
         rows = rng.integers(0, n, size=(batch,))
@@ -151,9 +156,11 @@ def distill_draft(target_config: TransformerConfig, target_params: Any,
             draft_params, opt_state, jnp.asarray(corpus[rows]),
             target_params)
         if first_loss is None:
-            first_loss = float(loss)
-    return draft_params, {"first_loss": round(float(first_loss or 0), 4),
-                          "last_loss": round(float(loss), 4)}
+            first_loss = loss
+    return draft_params, {
+        "first_loss": round(float(first_loss) if first_loss is not None
+                            else 0.0, 4),
+        "last_loss": round(float(loss), 4)}
 
 
 def make_draft(config: TransformerConfig, params: Any, *,

@@ -109,7 +109,9 @@ def create_sharded_state(
     """
     abstract = jax.eval_shape(init_fn, rng)
     shardings = state_shardings(abstract, mesh, rules, pipelined=pipelined)
-    state = jax.jit(init_fn, out_shardings=shardings)(rng)
+    # one-time init compile, consumed immediately — billed by the
+    # CompileLedger listener; an AOT fingerprint buys nothing here
+    state = jax.jit(init_fn, out_shardings=shardings)(rng)  # tpulint: disable=TPU018
     return state, shardings
 
 
@@ -224,7 +226,22 @@ def make_lm_train_step(
             return jitted(state, tokens)
 
     jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
-    run.jitted = jitted  # AOT handle (bench roofline / HLO inspection)
+    return _ledgered(run, jitted, mesh)
+
+
+def _ledgered(run, jitted, mesh):
+    """Expose a step runner's AOT surfaces: ``run.jitted`` (bench
+    roofline / HLO inspection) and ``run.aot_compile(ledger, *args)``,
+    which lands the step's compile on a ``CompileLedger`` — HLO
+    fingerprint, memory budget, and the ``kftpu_compile_seconds``
+    series — before the step loop starts, so startup compile cost is
+    attributed instead of billed as badput."""
+    def aot_compile(ledger, *example_args, module: str = "train.step"):
+        with mesh_context(mesh):
+            return ledger.timed_compile(jitted, *example_args,
+                                        module=module)
+    run.jitted = jitted
+    run.aot_compile = aot_compile
     return run
 
 
@@ -271,8 +288,7 @@ def make_mlm_train_step(
             return jitted(state, tokens, labels, weights)
 
     jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
-    run.jitted = jitted  # AOT handle (bench roofline / HLO inspection)
-    return run
+    return _ledgered(run, jitted, mesh)
 
 
 def make_pipelined_lm_train_step(
@@ -316,8 +332,7 @@ def make_pipelined_lm_train_step(
         with mesh_context(mesh):
             return jitted(state, tokens)
 
-    run.jitted = jitted  # AOT handle (bench roofline / HLO inspection)
-    return run
+    return _ledgered(run, jitted, mesh)
 
 
 def make_image_train_step(
@@ -364,5 +379,4 @@ def make_image_train_step(
         with mesh_context(mesh):
             return jitted(state, images, labels)
 
-    run.jitted = jitted  # AOT handle (bench roofline / HLO inspection)
-    return run
+    return _ledgered(run, jitted, mesh)

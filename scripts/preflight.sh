@@ -5,15 +5,22 @@
 #   scripts/preflight.sh --ref HEAD~1   # blob check over a commit range
 #
 # Checks:
-#   1. tpulint (scripts/run_tpulint.py): rules TPU001-TPU013 over
+#   1. tpulint (scripts/run_tpulint.py): rules TPU001-TPU018 over
 #      kubeflow_tpu/ — the AST rules, the SPMD shardlint plane
-#      (TPU006-TPU009), and the lock-discipline dataflow plane:
-#      TPU010 unguarded-shared-state, TPU011 blocking-under-lock,
-#      TPU012 re-entrant lock acquisition, TPU013 metric-contract —
-#      gated on tpulint_baseline.json (docs/ANALYSIS.md). Writes the
-#      SARIF artifact to traces/tpulint.sarif on every run; a failing
-#      run prints the per-rule new-vs-baseline diff table and the
-#      measured wall time (the <= +25%/4-rules budget is read here)
+#      (TPU006-TPU009), the lock-discipline dataflow plane
+#      (TPU010-TPU012), TPU013 metric-contract, and the trace-taint
+#      compile plane: TPU014 traced-control-flow, TPU015
+#      recompile-hazard, TPU016 use-after-donate, TPU017
+#      host-sync-in-hot-path, TPU018 unledgered-compile — gated on
+#      tpulint_baseline.json (docs/ANALYSIS.md). Writes the SARIF
+#      artifact to traces/tpulint.sarif on every run; --budget-check
+#      ASSERTS the full 18-rule wall stays within +25% of the
+#      TPU001-TPU013 reference pass and emits the measured delta into
+#      the SARIF run properties (budget_delta_pct)
+#   1b. compile audit (optional): when a ledger artifact exists at
+#      traces/compile_events.json (CompileLedger.events_payload()
+#      dump), join it against the static jit-site inventory and fail
+#      on recompile storms; silently skipped when absent
 #   2. binary-blob guard (scripts/check_binary_blobs.py): no large
 #      binaries staged for commit (PERF.md trace-artifact policy)
 #   3. obs smoke test (tests/test_obs.py): traceparent round-trip, span
@@ -84,7 +91,16 @@ cd "$(dirname "$0")/.."
 rc=0
 
 echo "== preflight: tpulint =="
-python scripts/run_tpulint.py --sarif-out traces/tpulint.sarif || rc=1
+python scripts/run_tpulint.py --budget-check \
+    --sarif-out traces/tpulint.sarif || rc=1
+
+if [ -f traces/compile_events.json ]; then
+    echo "== preflight: compile audit =="
+    python scripts/run_tpulint.py \
+        --compile-audit traces/compile_events.json || rc=1
+else
+    echo "== preflight: compile audit (skipped: no traces/compile_events.json) =="
+fi
 
 echo "== preflight: binary blobs =="
 python scripts/check_binary_blobs.py "$@" || rc=1

@@ -12,6 +12,8 @@ Usage:
     python scripts/run_tpulint.py --format json         # machine output
     python scripts/run_tpulint.py --format sarif        # CI PR annotations
     python scripts/run_tpulint.py --sarif-out traces/tpulint.sarif
+    python scripts/run_tpulint.py --budget-check        # +25% wall gate
+    python scripts/run_tpulint.py --compile-audit traces/compile_events.json
 
 Pre-existing findings live in ``tpulint_baseline.json`` (committed);
 only findings beyond the baseline fail the run. After fixing debt, run
@@ -37,11 +39,20 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+from kubeflow_tpu.analysis import baseline as baseline_mod  # noqa: E402
+from kubeflow_tpu.analysis import compileaudit  # noqa: E402
 from kubeflow_tpu.analysis import runner  # noqa: E402
 from kubeflow_tpu.analysis.registry import all_checkers  # noqa: E402
+from kubeflow_tpu.analysis.walker import walk_paths  # noqa: E402
+
+# the rule set the +25% wall-time budget is measured against: every
+# rule that existed before the trace-taint plane (PR 14's budget,
+# re-anchored as the catalog grows)
+REFERENCE_RULES = tuple(f"TPU{i:03d}" for i in range(1, 14))
+BUDGET_PCT = 25.0
 
 
-def sarif_payload(report) -> dict:
+def sarif_payload(report, properties=None) -> dict:
     """SARIF 2.1.0 for the *new* (gating) findings — the shape CI
     uploaders expect for inline PR-line annotations. Baselined debt is
     deliberately absent: annotating grandfathered lines on every PR
@@ -68,22 +79,25 @@ def sarif_payload(report) -> dict:
                 },
             }],
         })
+    run = {
+        "tool": {"driver": {
+            "name": "tpulint",
+            "informationUri": "docs/ANALYSIS.md",
+            "rules": rules,
+        }},
+        # SRCROOT is deliberately left undefined (no
+        # originalUriBaseIds): per SARIF §3.14.14 the consumer —
+        # the CI uploader, which knows the checkout root — resolves
+        # it; baking in a wrong absolute root would break PR-line
+        # annotation placement on every machine but this one
+        "results": results,
+    }
+    if properties:
+        run["properties"] = properties
     return {
         "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
         "version": "2.1.0",
-        "runs": [{
-            "tool": {"driver": {
-                "name": "tpulint",
-                "informationUri": "docs/ANALYSIS.md",
-                "rules": rules,
-            }},
-            # SRCROOT is deliberately left undefined (no
-            # originalUriBaseIds): per SARIF §3.14.14 the consumer —
-            # the CI uploader, which knows the checkout root — resolves
-            # it; baking in a wrong absolute root would break PR-line
-            # annotation placement on every machine but this one
-            "results": results,
-        }],
+        "runs": [run],
     }
 
 
@@ -134,7 +148,27 @@ def main(argv=None) -> int:
     ap.add_argument("--sarif-out", default=None, metavar="PATH",
                     help="additionally write the SARIF artifact to "
                          "PATH regardless of --format (CI artifact)")
+    ap.add_argument("--budget-check", action="store_true",
+                    help="also time a reference pass (rules "
+                         f"{REFERENCE_RULES[0]}-{REFERENCE_RULES[-1]}) "
+                         f"and fail if the full run exceeds it by more "
+                         f"than {BUDGET_PCT:.0f}%% (delta lands in the "
+                         "SARIF run properties)")
+    ap.add_argument("--compile-audit", default=None, metavar="ARTIFACT",
+                    help="audit mode: join the static jit-site "
+                         "inventory against a recorded compile-event "
+                         "artifact (CompileLedger.events_payload() "
+                         "dump or bench artifact) and exit 1 on "
+                         "recompile storms; skips the lint gate")
+    ap.add_argument("--audit-max-per-shape", type=int, default=None,
+                    metavar="N",
+                    help="compiles allowed per (module, shape_class, "
+                         "generation) before a group is a storm "
+                         f"(default {compileaudit.DEFAULT_MAX_PER_SHAPE})")
     args = ap.parse_args(argv)
+
+    if args.compile_audit is not None:
+        return run_compile_audit(args)
 
     rules = ([r.strip().upper() for r in args.rules.split(",") if r.strip()]
              if args.rules else None)
@@ -166,8 +200,13 @@ def main(argv=None) -> int:
             return 0
 
     t0 = time.monotonic()
-    report = runner.run_lint(paths=paths, rules=rules,
-                             baseline_path=args.baseline)
+    try:
+        report = runner.run_lint(paths=paths, rules=rules,
+                                 baseline_path=args.baseline,
+                                 allow_unknown_rules=args.baseline_update)
+    except baseline_mod.BaselineRuleGap as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     wall = time.monotonic() - t0
 
     if args.baseline_update:
@@ -177,15 +216,34 @@ def main(argv=None) -> int:
               f"{len(report.findings)} finding(s) → {path}")
         return 0
 
+    properties = {"wall_s": round(wall, 3),
+                  "rules_active": len(all_checkers()) if rules is None
+                  else len(rules)}
+    budget_fail = False
+    if args.budget_check:
+        t1 = time.monotonic()
+        runner.run_lint(paths=paths, rules=list(REFERENCE_RULES),
+                        baseline_path="")
+        ref_wall = time.monotonic() - t1
+        delta_pct = ((wall - ref_wall) / ref_wall * 100.0
+                     if ref_wall > 0 else 0.0)
+        budget_fail = delta_pct > BUDGET_PCT
+        properties.update({
+            "reference_rules": f"{REFERENCE_RULES[0]}-{REFERENCE_RULES[-1]}",
+            "reference_wall_s": round(ref_wall, 3),
+            "budget_delta_pct": round(delta_pct, 1),
+            "budget_limit_pct": BUDGET_PCT,
+        })
+
     if args.sarif_out:
         parent = os.path.dirname(os.path.abspath(args.sarif_out))
         os.makedirs(parent, exist_ok=True)
         with open(args.sarif_out, "w", encoding="utf-8") as f:
-            json.dump(sarif_payload(report), f, indent=1)
+            json.dump(sarif_payload(report, properties), f, indent=1)
             f.write("\n")
 
     if args.format == "sarif":
-        print(json.dumps(sarif_payload(report), indent=1))
+        print(json.dumps(sarif_payload(report, properties), indent=1))
     elif args.format == "json":
         print(json.dumps({
             "files": report.files,
@@ -204,11 +262,43 @@ def main(argv=None) -> int:
         print(report.rule_table())
         print(f"tpulint: wall {wall:.2f}s (single shared parse per "
               f"file across all checkers)")
+        if args.budget_check:
+            print(f"tpulint: budget {properties['budget_delta_pct']:+.1f}% "
+                  f"vs reference {properties['reference_rules']} "
+                  f"({properties['reference_wall_s']:.2f}s), limit "
+                  f"+{BUDGET_PCT:.0f}%"
+                  + (" — OVER BUDGET" if budget_fail else ""))
         if args.sarif_out:
             print(f"tpulint: sarif artifact → {args.sarif_out}")
         if report.new:
             print(report.diff_table())
-    return 1 if report.new else 0
+    if budget_fail and args.format != "text":
+        print(f"tpulint: wall-time budget exceeded "
+              f"(+{properties['budget_delta_pct']:.1f}% > "
+              f"+{BUDGET_PCT:.0f}%)", file=sys.stderr)
+    return 1 if (report.new or budget_fail) else 0
+
+
+def run_compile_audit(args) -> int:
+    """``--compile-audit``: static jit-site inventory × recorded
+    compile events. Exit 0 clean, 1 on storms, 2 on a bad artifact."""
+    try:
+        events = compileaudit.load_events_file(args.compile_audit)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: --compile-audit {args.compile_audit}: {e}",
+              file=sys.stderr)
+        return 2
+    paths = args.paths or None
+    modules = walk_paths(paths or runner.DEFAULT_PATHS,
+                         runner.repo_root())
+    sites = compileaudit.site_inventory(modules)
+    report = compileaudit.audit(
+        events, sites,
+        max_per_shape=(args.audit_max_per_shape
+                       if args.audit_max_per_shape is not None
+                       else compileaudit.DEFAULT_MAX_PER_SHAPE))
+    print(report.format())
+    return 1 if report.storms else 0
 
 
 if __name__ == "__main__":

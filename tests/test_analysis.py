@@ -46,8 +46,8 @@ def check(checker, *modules):
 
 # -- registry / framework ---------------------------------------------------
 
-def test_registry_has_all_thirteen_rules():
-    assert set(all_checkers()) == {f"TPU{i:03d}" for i in range(1, 14)}
+def test_registry_has_all_eighteen_rules():
+    assert set(all_checkers()) == {f"TPU{i:03d}" for i in range(1, 19)}
 
 
 def test_create_checkers_rejects_unknown_rule():

@@ -728,6 +728,47 @@ RULE_FIXTURES = {
         from kubeflow_tpu.utils import DEFAULT_REGISTRY
         _e = DEFAULT_REGISTRY.counter("kftpu_p_total", "drifted")
     """)],
+    "TPU014": [("kubeflow_tpu/fx.py", """
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def step(x):
+            if jnp.mean(x) > 0:
+                x = -x
+            return x
+    """)],
+    "TPU015": [("kubeflow_tpu/fx.py", """
+        import jax
+        def train(xs):
+            out = []
+            for x in xs:
+                f = jax.jit(lambda v: v * 2)
+                out.append(f(x))
+            return out
+    """)],
+    "TPU016": [("kubeflow_tpu/fx.py", """
+        import jax
+        def update(p):
+            return p
+        step = jax.jit(update, donate_argnums=(0,))
+        def train(state):
+            out = step(state)
+            return out, state
+    """)],
+    "TPU017": [("kubeflow_tpu/fx.py", """
+        import jax
+        class Engine:
+            def __init__(self, fn):
+                self._step = jax.jit(fn)
+            def _admit(self, row):
+                return float(self._step(row))
+    """)],
+    "TPU018": [("kubeflow_tpu/serving/fx.py", """
+        import jax
+        def build(fn):
+            step = jax.jit(fn)
+            return step
+    """)],
 }
 
 

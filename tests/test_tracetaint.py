@@ -1,0 +1,294 @@
+"""Trace-taint dataflow plane (TPU014–TPU018) and the compile-audit join.
+
+The fixture corpus in tests/tracetaint_fixtures/ gives every rule one
+minimal true positive and one near-miss true negative (the fixed idiom
+that must stay silent — hoisted wrappers, rebind-after-donate, bucketed
+statics, host-arithmetic lookalikes). On top of the corpus: taint-core
+unit tests (sources, sanitizers, strong updates, the shared ``cfg_for``
+build), the baseline rule-coverage contract, and an end-to-end
+``--compile-audit`` join that attributes a synthetic recompile storm
+from a canned ledger dump to its static jit site.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from kubeflow_tpu.analysis import baseline as baseline_mod
+from kubeflow_tpu.analysis import cfg as cfg_mod
+from kubeflow_tpu.analysis import compileaudit, runner, tracetaint
+from kubeflow_tpu.analysis.runner import lint_modules
+from kubeflow_tpu.analysis.walker import ModuleInfo
+from kubeflow_tpu.obs.xprof import CompileLedger, Tracer
+
+REPO = runner.repo_root()
+FIXTURES = os.path.join(REPO, "tests", "tracetaint_fixtures")
+
+# TPU018 scopes on serving/train/elastic rels, so its fixtures parse
+# as if they lived in the serving plane; the rest are path-agnostic
+FIXTURE_RELS = {
+    "tpu018_pos": "kubeflow_tpu/serving/tpu018_pos.py",
+    "tpu018_neg": "kubeflow_tpu/serving/tpu018_neg.py",
+}
+
+RULES = ("TPU014", "TPU015", "TPU016", "TPU017", "TPU018")
+
+
+def fixture(name):
+    with open(os.path.join(FIXTURES, name + ".py"), encoding="utf-8") as f:
+        src = f.read()
+    rel = FIXTURE_RELS.get(name, f"kubeflow_tpu/models/{name}.py")
+    return ModuleInfo.from_source(rel, src)
+
+
+def mod(src, rel="kubeflow_tpu/fixture.py"):
+    return ModuleInfo.from_source(rel, textwrap.dedent(src))
+
+
+def findings(module, rules):
+    out, _ = lint_modules([module], rules=list(rules))
+    return [f for f, _ in out]
+
+
+# -- fixture corpus: one positive + one near-miss negative per rule ----------
+
+
+@pytest.mark.parametrize("rule", RULES)
+def test_positive_fixture_fires(rule):
+    got = findings(fixture(f"{rule.lower()}_pos"), [rule])
+    assert got and all(f.rule == rule for f in got), rule
+
+
+@pytest.mark.parametrize("rule", RULES)
+def test_near_miss_fixture_stays_silent(rule):
+    assert findings(fixture(f"{rule.lower()}_neg"), [rule]) == [], rule
+
+
+def test_fixture_negatives_are_near_misses_not_empty():
+    # the negatives must actually exercise the rule's machinery: each
+    # one still contains a jit site / sync call the checker walks past
+    for rule in RULES:
+        m = fixture(f"{rule.lower()}_neg")
+        assert "jit" in m.source or "float(" in m.source, rule
+
+
+# -- taint core --------------------------------------------------------------
+
+
+def _taint(src):
+    m = mod(src)
+    return m, tracetaint.taint_analysis(m)
+
+
+def test_jit_params_and_jnp_results_are_tainted():
+    m, mt = _taint("""
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def step(x):
+            y = jnp.exp(x)
+            z = y + 1
+            return z
+    """)
+    fn = m.tree.body[2]
+    ft = mt.taint_of(fn)
+    ret = fn.body[-1]
+    env = ft.env_at(ret)
+    assert env is not None
+    assert "x" in env and "y" in env and "z" in env
+
+
+def test_sanitizers_strip_taint():
+    m, mt = _taint("""
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def step(x):
+            n = x.shape[0]
+            k = int(n)
+            return x
+    """)
+    fn = m.tree.body[2]
+    ft = mt.taint_of(fn)
+    env = ft.env_at(fn.body[-1])
+    assert "n" not in env and "k" not in env
+
+
+def test_strong_update_untaints_a_rebind():
+    m, mt = _taint("""
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def step(x):
+            y = jnp.exp(x)
+            y = 3
+            return y
+    """)
+    fn = m.tree.body[2]
+    ft = mt.taint_of(fn)
+    assert "y" not in ft.env_at(fn.body[-1])
+
+
+def test_jit_site_inventory_resolves_literal_specs():
+    _, mt = _taint("""
+        import jax
+        def f(a, b):
+            return a
+        g = jax.jit(f, static_argnums=(1,), donate_argnums=(0,))
+        h = jax.jit(f, static_argnums=n_static)
+    """)
+    by_bound = {b: s for s in mt.sites for b in s.bound}
+    assert by_bound["g"].static_argnums == (1,)
+    assert by_bound["g"].donate_argnums == (0,)
+    # unresolvable spec stays None (prove-it-or-silence)
+    assert by_bound["h"].static_argnums is None
+
+
+def test_cfg_for_is_memoized_per_function():
+    m = mod("""
+        def f(x):
+            return x
+    """)
+    fn = m.tree.body[0]
+    assert cfg_mod.cfg_for(m, fn) is cfg_mod.cfg_for(m, fn)
+
+
+def test_taint_analysis_is_memoized_per_module():
+    m = mod("""
+        import jax
+        @jax.jit
+        def f(x):
+            return x
+    """)
+    assert tracetaint.taint_analysis(m) is tracetaint.taint_analysis(m)
+
+
+# -- baseline rule-coverage contract -----------------------------------------
+
+
+def test_baseline_predating_a_rule_fails_with_clear_message(tmp_path):
+    m = fixture("tpu015_pos")
+    pairs, _ = lint_modules([m], rules=["TPU015"])
+    path = str(tmp_path / "base.json")
+    baseline_mod.save(path, pairs, rules=["TPU001"])
+    payload = baseline_mod.load_payload(path)
+    with pytest.raises(baseline_mod.BaselineRuleGap) as ei:
+        baseline_mod.check_rule_coverage(path, payload, ["TPU015"])
+    msg = str(ei.value)
+    assert "TPU015" in msg and "--baseline-update" in msg
+
+
+def test_legacy_baseline_without_rules_key_is_exempt(tmp_path):
+    m = fixture("tpu015_pos")
+    pairs, _ = lint_modules([m], rules=["TPU015"])
+    path = str(tmp_path / "base.json")
+    baseline_mod.save(path, pairs)  # no rules recorded
+    baseline_mod.check_rule_coverage(
+        path, baseline_mod.load_payload(path), ["TPU015"])
+
+
+def test_baseline_update_records_the_covered_rule_set(tmp_path):
+    m = fixture("tpu015_pos")
+    pairs, _ = lint_modules([m], rules=["TPU015"])
+    path = str(tmp_path / "base.json")
+    baseline_mod.save(path, pairs, rules=["TPU014", "TPU015"])
+    data = json.load(open(path))
+    assert data["rules"] == ["TPU014", "TPU015"]
+
+
+# -- compile-audit join ------------------------------------------------------
+
+
+def _storm_events(module="jit_train_step", n=5):
+    return [{"module": module, "seconds": 2.0, "shape_class": "B8xS128",
+             "generation": "tpu-v4"} for _ in range(n)]
+
+
+def test_audit_attributes_storm_to_static_site():
+    m = mod("""
+        import jax
+        def loss(s, b):
+            return s
+        train_step = jax.jit(loss, donate_argnums=(0,))
+    """, rel="kubeflow_tpu/train/fx.py")
+    sites = compileaudit.site_inventory([m])
+    report = compileaudit.audit(_storm_events(), sites)
+    assert len(report.storms) == 1
+    storm = report.storms[0]
+    assert storm.count == 5 and storm.site is not None
+    assert storm.site.path == "kubeflow_tpu/train/fx.py"
+    assert storm.site.label == "train_step"
+    assert "STORM" in report.format()
+
+
+def test_audit_one_compile_per_shape_class_is_clean():
+    m = mod("""
+        import jax
+        def loss(s):
+            return s
+        train_step = jax.jit(loss)
+    """, rel="kubeflow_tpu/train/fx.py")
+    sites = compileaudit.site_inventory([m])
+    events = [
+        {"module": "jit_train_step", "seconds": 1.0,
+         "shape_class": sc, "generation": "tpu-v4"}
+        for sc in ("B8xS128", "B8xS256", "B8xS512")]
+    report = compileaudit.audit(events, sites)
+    assert report.storms == []
+
+
+def test_audit_unmatched_events_reported_but_not_gating():
+    report = compileaudit.audit(
+        _storm_events(module="jit__threefry_split", n=1), [])
+    assert report.storms == [] and report.unmatched == [
+        ("jit__threefry_split", 1)]
+
+
+def test_ledger_events_payload_round_trips_through_loader():
+    t = [0.0]
+
+    def clock():
+        t[0] += 1.0
+        return t[0]
+
+    ledger = CompileLedger(clock=clock, tracer=Tracer(clock=clock),
+                           generation="tpu-v4")
+    for _ in range(3):
+        ledger.record("train_step", 2.5, shape_class="B8xS128")
+    payload = ledger.events_payload()
+    events = compileaudit.load_events(json.loads(json.dumps(payload)))
+    assert len(events) == 3
+    assert events[0]["module"] == "train_step"
+    assert events[0]["shape_class"] == "B8xS128"
+    assert events[0]["generation"] == "tpu-v4"
+
+
+def test_compile_audit_cli_end_to_end(tmp_path):
+    """The acceptance-criterion path: a canned ledger dump with a
+    synthetic recompile storm, fed to ``--compile-audit``, names a jit
+    call site and exits 1."""
+    artifact = tmp_path / "compile_events.json"
+    artifact.write_text(json.dumps(
+        {"compile_events": _storm_events(module="jit_step", n=6)}))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "run_tpulint.py"),
+         "--compile-audit", str(artifact)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "STORM" in proc.stdout
+    assert ".py:" in proc.stdout  # a source location is attached
+
+
+def test_compile_audit_cli_rejects_bad_artifact(tmp_path):
+    artifact = tmp_path / "bad.json"
+    artifact.write_text('{"nothing": true}')
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "run_tpulint.py"),
+         "--compile-audit", str(artifact)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 2
+    assert "unrecognized" in proc.stderr

@@ -1,0 +1,16 @@
+"""TPU014 near miss: host-decidable control flow inside jit stays
+silent — identity tests, static shape reads, and the `jnp.where`
+fix idiom are all trace-safe."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x, mask=None):
+    if mask is None:  # identity test: decided at trace time
+        mask = jnp.ones_like(x)
+    if x.shape[0] > 128:  # .shape is static under trace
+        scale = 0.5
+    else:
+        scale = 1.0
+    return jnp.where(mask > 0, x * scale, 0.0)  # traced select

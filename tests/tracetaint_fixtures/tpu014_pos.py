@@ -1,0 +1,12 @@
+"""TPU014 true positive: Python `if` on a traced value inside a jit
+region — concretizes the tracer (error) or forces per-branch retrace."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x, lr):
+    m = jnp.mean(x)
+    if m > 0:  # traced bool reaches Python control flow
+        x = x - lr * m
+    return x

@@ -1,0 +1,11 @@
+"""TPU015 true positive: `jax.jit` constructed inside the step loop —
+a fresh wrapper (and a fresh compile-cache entry) every iteration."""
+import jax
+
+
+def train(xs):
+    out = []
+    for x in xs:
+        f = jax.jit(lambda v: v * 2)  # new callable identity per pass
+        out.append(f(x))
+    return out

@@ -1,0 +1,16 @@
+"""TPU016 near miss: the rebind idiom — the donated name is reassigned
+from the call's own result, so no stale buffer is ever readable."""
+import jax
+
+
+def update(params):
+    return params
+
+
+step = jax.jit(update, donate_argnums=(0,))
+
+
+def train(state, n):
+    for _ in range(n):
+        state = step(state)  # safe by construction
+    return state

@@ -1,0 +1,15 @@
+"""TPU016 true positive: a donated argument read after the jitted
+call — the buffer may already be aliased into the outputs."""
+import jax
+
+
+def update(params):
+    return params
+
+
+step = jax.jit(update, donate_argnums=(0,))
+
+
+def train(state):
+    out = step(state)
+    return out, state["step"]  # state's buffer was donated above

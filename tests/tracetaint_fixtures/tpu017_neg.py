@@ -1,0 +1,16 @@
+"""TPU017 near miss: host arithmetic in the admit path and a sync in
+a method no hot seed reaches — both stay silent."""
+import jax
+
+
+class Engine:
+    def __init__(self, fn, threshold):
+        self._step = jax.jit(fn)
+        self.threshold = threshold
+
+    def _admit(self, row):
+        budget = float(self.threshold)  # host value, not a device sync
+        return self._step(row), budget
+
+    def report(self, tok):
+        return float(tok)  # cold path: not admit, not in a step loop

@@ -1,0 +1,12 @@
+"""TPU017 true positive: device→host sync inside the admission path
+of a class that owns a jitted callable."""
+import jax
+
+
+class Engine:
+    def __init__(self, fn):
+        self._step = jax.jit(fn)
+
+    def _admit(self, row):
+        tok = self._step(row)
+        return float(tok)  # blocks the host per admission

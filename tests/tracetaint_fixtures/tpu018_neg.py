@@ -1,0 +1,12 @@
+"""TPU018 near miss: the jitted callable is handed to
+``CompileLedger.timed_compile``, so the site is ledger-sanctioned.
+
+(The test parses this file with a ``kubeflow_tpu/serving/`` rel, the
+rule's scope.)"""
+import jax
+
+
+def build(fn, ledger, example):
+    step = jax.jit(fn)
+    ledger.timed_compile(step, example, module="serving.step")
+    return step

@@ -1,0 +1,11 @@
+"""TPU018 true positive: a bare jit site in the serving plane with no
+ledger-routed path — the compile is invisible to the CompileLedger.
+
+(The test parses this file with a ``kubeflow_tpu/serving/`` rel, the
+rule's scope.)"""
+import jax
+
+
+def build(fn):
+    step = jax.jit(fn)
+    return step
