@@ -694,6 +694,22 @@ def engine_drain(eng) -> None:
         eng.run_once(timeout=0.01)
 
 
+def ledger_burst_ttft_ms(ledger, wave) -> Optional[float]:
+    """Burst TTFT off the request ledger — production's definition
+    (docs/OBSERVABILITY.md "Request lifecycle"), replacing the bench's
+    old hand-rolled first-wave stamp: wall from the burst's first
+    submit until EVERY wave member held its first token (each record's
+    submit + ttft). None (JSON null) when a wave member never produced
+    one — total run time masquerading as TTFT would poison any A/B
+    read of this number."""
+    ttfts = [ledger.ttft_ms(r.rid) for r in wave]
+    if not wave or any(f is None for f in ttfts):
+        return None
+    first_all = (max(r.t_submit + f / 1e3 for r, f in zip(wave, ttfts))
+                 - min(r.t_submit for r in wave))
+    return round(first_all * 1e3, 1)
+
+
 def engine_throughput(config, params, prompts, *, slots: int,
                       steps_per_sync: int, new_tokens: int,
                       sampler_bound: Optional[int], sampled: bool,
@@ -701,20 +717,28 @@ def engine_throughput(config, params, prompts, *, slots: int,
                       sampler_impl: Optional[str] = None,
                       paged: bool = False,
                       paged_attention_impl: Optional[str] = None,
+                      request_ledger=None,
                       name: str = "bench"):
     """tokens/sec through a fresh engine (params shared in HBM).
-    Returns (tok/s/chip, engine steps, burst TTFT ms, batch prefills)."""
+    Returns (tok/s/chip, engine steps, burst TTFT ms, batch prefills).
+    ``request_ledger`` (a fresh one per run by default, so bench bursts
+    never mix into the process ledger) also hands the caller the
+    per-request phase breakdown via its ``bench_block()``."""
     import jax
 
+    from kubeflow_tpu.obs import requests as reqobs
     from kubeflow_tpu.serving.engine import DecodeEngine
 
     n_chips = jax.device_count()
+    if request_ledger is None:
+        request_ledger = reqobs.RequestLedger()
     eng = DecodeEngine(config, params, slots=slots,
                        steps_per_sync=steps_per_sync,
                        sampler_bound=sampler_bound,
                        sampler_impl=sampler_impl, paged=paged,
                        paged_attention_impl=paged_attention_impl,
-                       autostart=False, name=name)
+                       autostart=False, name=name,
+                       request_ledger=request_ledger)
 
     # warm the compiled programs: the row prefill, insert, step —
     # and every batch-prefill bucket burst admission can hit (a
@@ -749,20 +773,13 @@ def engine_throughput(config, params, prompts, *, slots: int,
     else:
         # burst TTFT: admit the first wave explicitly (one _admit pass
         # fills every free slot, and each request's first token is
-        # emitted during its prefill sample) and stamp BEFORE any
-        # decode step runs — the number batched admission improves
+        # emitted during its prefill sample) — the number batched
+        # admission improves
         eng._admit(0.01)
-    first_all = (time.perf_counter() - t0
-                 if all(r._seen or r.out.qsize() for r in wave)
-                 else None)
     engine_drain(eng)
     total = sum(len(r.result()) for r in reqs)
     dt = time.perf_counter() - t0
-    # None (JSON null) when the stamp was invalid (a wave member
-    # unadmitted/errored) — total run time masquerading as TTFT
-    # would poison any A/B read of this number
-    ttft = (round(first_all * 1e3, 1) if first_all is not None
-            else None)
+    ttft = ledger_burst_ttft_ms(eng.rledger, wave)
     return (round(total / dt / n_chips, 1),
             eng.steps_total - steps0, ttft,
             eng.batch_prefills - bp0)
@@ -848,13 +865,15 @@ def bench_decode_engine(concurrency: int = 48, slots: int = 32,
     def run_engine(sampler_bound: Optional[int], sampled: bool,
                    sampler_impl: Optional[str] = None,
                    paged: bool = False,
-                   paged_attention_impl: Optional[str] = None):
+                   paged_attention_impl: Optional[str] = None,
+                   request_ledger=None):
         return engine_throughput(
             config, params, prompts, slots=slots,
             steps_per_sync=steps_per_sync, new_tokens=new_tokens,
             sampler_bound=sampler_bound, sampled=sampled,
             sample_kw=sample_kw, sampler_impl=sampler_impl, paged=paged,
-            paged_attention_impl=paged_attention_impl)
+            paged_attention_impl=paged_attention_impl,
+            request_ledger=request_ledger)
 
     # sampler modes at the same effective batch: greedy rides the
     # argmax fast-path step; "sampled" pays the per-row sampler. The
@@ -862,8 +881,14 @@ def bench_decode_engine(concurrency: int = 48, slots: int = 32,
     # sampling at slots=32); the fused Pallas kernel
     # (ops/sampling.py) is the exact path that must close that gap.
     bound = int(os.environ.get("KFTPU_SAMPLER_BOUND", "64"))
+    # the headline greedy run keeps its request ledger: the artifact's
+    # "requests" block is its per-phase breakdown (docs/OBSERVABILITY.md
+    # "Request lifecycle")
+    from kubeflow_tpu.obs import requests as reqobs
+
+    req_ledger = reqobs.RequestLedger()
     greedy_tps, engine_steps, ttft_ms, batch_prefills = run_engine(
-        bound, sampled=False)
+        bound, sampled=False, request_ledger=req_ledger)
     sampled_bounded_tps, _, _, _ = run_engine(bound, sampled=True)
     sampled_exact_tps, _, _, _ = run_engine(
         0, sampled=True, sampler_impl="exact_sort")
@@ -932,6 +957,7 @@ def bench_decode_engine(concurrency: int = 48, slots: int = 32,
             if paged_gather_tps else None),
         "tile_config": autotune.summarize_resolutions(paged_tile_rec),
         **prefix_counters,
+        "requests": req_ledger.bench_block(),
         "burst_first_tokens_ms": ttft_ms,
         "batch_prefills": batch_prefills,
         "sampler_bound": bound,

@@ -31,6 +31,14 @@ Route parity with the reference's Express server
   (``kubeflow_tpu/obs/goodput.py``; docs/OBSERVABILITY.md "Goodput"):
   every TpuJob's ``status.goodput`` ledger weighted by chips × seconds,
   per-state fractions + per-job rows
+- ``GET /api/metrics/requests``    — the fleet request-lifecycle rollup
+  (``kubeflow_tpu/obs/requests.py``; docs/OBSERVABILITY.md "Request
+  lifecycle"): per-model and fleet phase-seconds breakdowns, phase
+  fractions, TTFT percentiles, shed/breach counts
+- ``GET /api/models/<model>/requests`` — one model's request-phase
+  percentiles (TTFT/ITL/per-phase seconds) plus the single worst-TTFT
+  request's trace exemplar (resolves via ``GET /api/traces/<id>``,
+  mirroring the goodput worst-interval exemplar)
 - ``GET /api/metrics/query``       — the monitoring tier's query API
   over the in-process time-series store (``kubeflow_tpu/obs/tsdb.py``):
   instant and range evaluation of ``instant``/``rate``/``delta``/
@@ -217,7 +225,8 @@ class DashboardApi:
                  scheduler_queue=None,
                  tsdb=None,
                  alerts=None,
-                 edge=None) -> None:
+                 edge=None,
+                 request_ledger=None) -> None:
         from kubeflow_tpu.tenancy.authz import default_authorizer
 
         self.client = client
@@ -251,6 +260,13 @@ class DashboardApi:
         # anything with .status() (a fleet FleetEdge); None = the
         # registry's kftpu_edge_* / kftpu_multiplex_* series only
         self.edge = edge
+        # the serving request-lifecycle ledger for /api/metrics/requests
+        # and /api/models/<model>/requests — the process-default ledger
+        # unless a test or a multi-engine host wires its own
+        from kubeflow_tpu.obs import requests as reqobs
+
+        self.rledger = (request_ledger if request_ledger is not None
+                        else reqobs.DEFAULT_LEDGER)
 
     def _authz(self, user: str, ns: str, resource: str) -> None:
         if not self.authorize(user, "get", ns, resource):
@@ -287,6 +303,14 @@ class DashboardApi:
                 return 200, self.edge_view()
             if path == "/api/metrics/goodput":
                 return 200, self.goodput_view()
+            if path == "/api/metrics/requests":
+                return 200, self.requests_view()
+            if path.startswith("/api/models/"):
+                parts = path[len("/api/models/"):].split("/")
+                if len(parts) == 2 and parts[0] \
+                        and parts[1] == "requests":
+                    return self.model_requests(parts[0])
+                return 404, {"error": f"no route {path}"}
             if path == "/api/metrics/query":
                 return self.metrics_query(query)
             if path == "/api/alerts":
@@ -567,6 +591,53 @@ class DashboardApi:
             **gp.view(g),
             "worstBadput": exemplar,
         }
+
+    def requests_view(self) -> Dict[str, Any]:
+        """``GET /api/metrics/requests``: the fleet request-lifecycle
+        rollup off the ledger (docs/OBSERVABILITY.md "Request
+        lifecycle")."""
+        return self.rledger.rollup()
+
+    def model_requests(self, model: str) -> Tuple[int, Any]:
+        """One model's request-phase percentiles plus the single
+        worst-TTFT request's trace exemplar — the request record's id
+        IS its trace id, so ``GET /api/traces/<traceId>`` opens the
+        span tree that explains the tail (the goodput worst-interval
+        exemplar pattern at request granularity)."""
+        view = self.rledger.view(model)
+        if not view["count"]:
+            return 404, {"error": f"no finished requests for model "
+                                  f"{model!r}"}
+        worst = self.rledger.worst_ttft(model)
+        exemplar = None
+        if worst is not None:
+            exemplar = {
+                "traceId": worst.rid,
+                "ttftMs": (None if worst.ttft_ms is None
+                           else round(worst.ttft_ms, 3)),
+                "sloClass": worst.slo_class or "none",
+                "shed": worst.shed,
+                "breach": worst.breach,
+            }
+            # the span that explains the tail: the request-trace span
+            # overlapping [submit, first token] the most (full-wall
+            # fallback for requests that never produced one)
+            t_hi = (worst.t_first_token
+                    if worst.t_first_token is not None else worst.t_end)
+            best, best_key = None, None
+            for s in self.collector.spans():
+                if s.trace_id != worst.rid:
+                    continue
+                if s.start > t_hi or s.end < worst.t_start:
+                    continue
+                overlap = min(s.end, t_hi) - max(s.start, worst.t_start)
+                key = (overlap, s.end - s.start)
+                if best_key is None or key > best_key:
+                    best, best_key = s, key
+            if best is not None:
+                exemplar["spanId"] = best.span_id
+                exemplar["span"] = best.name
+        return 200, {**view, "worstTtft": exemplar}
 
     def metrics_query(self, query: str) -> Tuple[int, Any]:
         """The monitoring query API over the in-process tsdb

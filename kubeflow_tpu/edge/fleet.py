@@ -37,14 +37,17 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
+import math
 import os
 import threading
+import time
 from typing import Any, Callable, Dict, Iterator, List, Mapping, Optional, Tuple
 
 import numpy as np
 
 from kubeflow_tpu.edge.affinity import HashRing, affinity_key
 from kubeflow_tpu.obs import TRACER
+from kubeflow_tpu.obs import requests as reqobs
 from kubeflow_tpu.utils import DEFAULT_REGISTRY
 
 log = logging.getLogger(__name__)
@@ -373,20 +376,57 @@ class FleetEdge:
     def __init__(self, router: FleetRouter, gate: SloAdmissionGate, *,
                  dispatch: Callable[[str, Optional[str], FleetRequest], Any],
                  multiplex: Any = None,
-                 tracer=None, retry_after_s: int = 1) -> None:
+                 tracer=None, retry_after_s: int = 1,
+                 request_ledger: Optional["reqobs.RequestLedger"]
+                 = None) -> None:
         self.router = router
         self.gate = gate
         self.dispatch = dispatch
         self.multiplex = multiplex
         self.tracer = tracer if tracer is not None else TRACER
+        # floor/fallback for Retry-After: the live value comes from the
+        # scraped queue-drain window (note_drain), clamped [floor, 30]
         self.retry_after_s = int(retry_after_s)
+        self.rledger = (request_ledger if request_ledger is not None
+                        else reqobs.DEFAULT_LEDGER)
         self.served = 0
         self.shed: Dict[str, int] = {}
+        # (pending requests fleet-wide, drain rate in req/s) from the
+        # poller's last scrape window; None rate = no window yet
+        self._drain: Tuple[float, Optional[float]] = (0.0, None)
         # handle() runs on ThreadingHTTPServer worker threads: the
         # panel counters must not lose increments the (locked) registry
         # counters keep, or the two sources disagree under exactly the
         # bursts the panel explains
         self._count_lock = threading.Lock()
+
+    # -- backoff -----------------------------------------------------------
+
+    def note_drain(self, pending: float,
+                   drain_rate: Optional[float]) -> None:
+        """Record one scrape window's fleet queue state (total pending
+        requests + measured drain rate, req/s) — the inputs
+        :meth:`retry_after` prices a shed's backoff from."""
+        with self._count_lock:
+            self._drain = (float(pending),
+                           None if drain_rate is None
+                           else float(drain_rate))
+
+    def retry_after(self) -> int:
+        """Seconds a shed client should wait: the time the measured
+        drain rate needs to clear today's queue, clamped to
+        [retry_after_s, 30]. Before the first drain window (or with an
+        empty queue) the static floor answers; a non-draining fleet
+        with work pending answers the cap — "come back in 1 s" under a
+        wedged fleet just re-sheds the whole retry wave."""
+        floor = max(1, self.retry_after_s)
+        with self._count_lock:
+            pending, rate = self._drain
+        if rate is None:
+            return floor
+        if rate <= 0.0:
+            return 30 if pending > 0 else floor
+        return int(min(30, max(floor, math.ceil(pending / rate))))
 
     # -- request path ------------------------------------------------------
 
@@ -397,6 +437,15 @@ class FleetEdge:
         slo = self.gate.classify(request.headers)
         with self.tracer.span("edge.fleet.request",
                               attrs={"slo.class": slo}) as sp:
+            # the request's lifecycle record keys on its trace id — the
+            # same id the traceparent carries into the backend hop, so
+            # the in-process engine CONTINUES this record rather than
+            # opening a second one. Edge time before dispatch is
+            # `admission`; the hand-off window until the engine's own
+            # admission mark is `queue_wait`
+            rid = sp.trace_id
+            self.rledger.start(rid, t=sp.start, slo_class=slo,
+                               phase=reqobs.ADMISSION)
             ok, pressure = self.gate.admit(slo)
             if not ok:
                 with self._count_lock:
@@ -410,17 +459,21 @@ class FleetEdge:
                         "pressure": round(pressure, 4)}):
                     pass
                 sp.attrs["http.status"] = 503
+                self.rledger.mark(rid, reqobs.SHED, self.tracer.clock())
+                retry_s = self.retry_after()
+                self.rledger.finish(rid, self.tracer.clock())
                 return 503, {
                     "error": f"overloaded; class {slo!r} shed at "
                              f"pressure {pressure:.2f}",
                     "sloClass": slo,
-                    "retryAfterSeconds": self.retry_after_s,
+                    "retryAfterSeconds": retry_s,
                 }
             picked = self.router.pick(request.prompt, request.prefix_len)
             if picked is None:
                 sp.attrs["http.status"] = 503
+                self.rledger.finish(rid, self.tracer.clock())
                 return 503, {"error": "no replicas in the fleet",
-                             "retryAfterSeconds": self.retry_after_s}
+                             "retryAfterSeconds": self.retry_after()}
             replica, key, spilled = picked
             sp.attrs.update({"replica": replica,
                              "affinity": key is not None,
@@ -431,14 +484,18 @@ class FleetEdge:
             # pick() already acquired the in-flight unit (atomically
             # with the bound check); this block only releases it
             streaming = False
+            self.rledger.mark(rid, reqobs.QUEUE_WAIT,
+                              self.tracer.clock())
             try:
                 payload = self.dispatch(replica, target, request)
                 if _is_stream(payload):
                     streaming = True
                     sp.attrs["streamed"] = True
-                    payload = self._guard_stream(replica, payload)
+                    payload = self._guard_stream(replica, payload,
+                                                 rid=rid)
             except DispatchError as e:
                 sp.attrs["http.status"] = e.code
+                self.rledger.finish(rid, self.tracer.clock())
                 return e.code, e.payload
             finally:
                 if not streaming:
@@ -447,17 +504,32 @@ class FleetEdge:
                 self.served += 1
             _fleet_requests_c.inc(replica=replica)
             sp.attrs["http.status"] = 200
+            if not streaming:
+                # an in-process engine already finished the shared
+                # record at its last token (finish() is then a no-op);
+                # remote/simulated backends close here, at response
+                # time — either way the record never leaks live
+                self.rledger.finish(rid, self.tracer.clock())
             return 200, payload
 
-    def _guard_stream(self, replica: str, it: Iterator) -> Iterator:
+    def _guard_stream(self, replica: str, it: Iterator, *,
+                      rid: Optional[str] = None) -> Iterator:
         """Hold the replica's in-flight count for the stream's whole
         life; release exactly once however it ends — including a
         stream the caller DROPS without ever starting (a generator's
         ``finally`` never runs if no frame was entered, which would
         leak the in-flight count and spill the replica's affinity arc
         for the life of the process; the guard object releases on
-        exhaustion, error, close() and GC)."""
-        return _StreamGuard(self.router, replica, iter(it))
+        exhaustion, error, close() and GC). The release also closes the
+        request's lifecycle record when the backend didn't (an
+        in-process engine finishes it at last token; a remote or
+        simulated stream ends here)."""
+        on_release = None
+        if rid is not None:
+            def on_release(rid=rid):
+                self.rledger.finish(rid, self.tracer.clock())
+        return _StreamGuard(self.router, replica, iter(it),
+                            on_release=on_release)
 
     # -- membership + telemetry poll ---------------------------------------
 
@@ -522,16 +594,20 @@ class _StreamGuard:
     once, however the stream ends (see ``FleetEdge._guard_stream``)."""
 
     def __init__(self, router: FleetRouter, replica: str,
-                 it: Iterator) -> None:
+                 it: Iterator, *,
+                 on_release: Optional[Callable[[], None]] = None) -> None:
         self._router = router
         self._replica = replica
         self._it = it
+        self._on_release = on_release
         self._released = False
 
     def _release(self) -> None:
         if not self._released:
             self._released = True
             self._router.finish(self._replica)
+            if self._on_release is not None:
+                self._on_release()
 
     def __iter__(self) -> "_StreamGuard":
         return self
@@ -669,11 +745,13 @@ class BackendPoller:
 
     def __init__(self, edge: FleetEdge, *, interval_s: float = 2.0,
                  slots_hint: int = 0, metrics_path: str = "/metrics",
-                 timeout_s: float = 2.0, fetch=None) -> None:
+                 timeout_s: float = 2.0, fetch=None,
+                 clock: Optional[Callable[[], float]] = None) -> None:
         self.edge = edge
         self.interval_s = float(interval_s)
         self.slots_hint = int(slots_hint)
         self.metrics_path = metrics_path
+        self.clock = clock if clock is not None else time.monotonic
         if fetch is None:
             import urllib.request
 
@@ -684,23 +762,33 @@ class BackendPoller:
 
         self.fetch = fetch
         self._pool = None  # lazy ThreadPoolExecutor, reused per tick
-        # last (queue_wait_sum, queue_wait_count) per replica: the
-        # increase between scrapes is the in-window average wait — the
-        # engine_queue_wait_seconds signal the gate prices against its
-        # SLO (a single scrape only sees lifetime cumulative totals)
-        self._qw_last: Dict[str, Tuple[float, float]] = {}
+        # last (queue_wait_sum, queue_wait_count, scrape time) per
+        # replica: the increase between scrapes is the in-window
+        # average wait — the engine_queue_wait_seconds signal the gate
+        # prices against its SLO — and the count delta over wall time
+        # is the replica's drain rate (a single scrape only sees
+        # lifetime cumulative totals)
+        self._qw_last: Dict[str, Tuple[float, float, float]] = {}
 
-    def _queue_wait(self, name: str,
-                    snap: Mapping[str, float]) -> Optional[float]:
+    def _window(self, name: str, snap: Mapping[str, float]
+                ) -> Tuple[Optional[float], Optional[float]]:
+        """``(avg queue wait s, drain rate req/s)`` over the scrape
+        window, either None when this tick can't difference it (first
+        scrape or a counter reset; an IDLE window still reports drain
+        rate 0.0 — a queue that isn't moving is a real reading, the
+        one Retry-After must price at its cap)."""
         cur = (float(snap.get("queue_wait_sum", 0.0)),
-               float(snap.get("queue_wait_count", 0.0)))
+               float(snap.get("queue_wait_count", 0.0)),
+               self.clock())
         prev = self._qw_last.get(name)
         self._qw_last[name] = cur
-        if prev is None or cur[1] <= prev[1] or cur[0] < prev[0]:
-            # first scrape, idle window, or counter reset (engine
-            # restart): no windowed reading this tick
-            return None
-        return (cur[0] - prev[0]) / (cur[1] - prev[1])
+        if prev is None or cur[1] < prev[1] or cur[0] < prev[0]:
+            return None, None
+        dt = cur[2] - prev[2]
+        rate = (cur[1] - prev[1]) / dt if dt > 0 else None
+        if cur[1] <= prev[1]:
+            return None, rate
+        return (cur[0] - prev[0]) / (cur[1] - prev[1]), rate
 
     def _scrape_one(self, name: str, target: str):
         try:
@@ -739,13 +827,24 @@ class BackendPoller:
                 max_workers=16, thread_name_prefix="fleet-poll")
         results = list(self._pool.map(lambda kv: self._scrape_one(*kv),
                                       sorted(targets.items())))
+        pending_total = 0.0
+        drain_total: Optional[float] = None
         for name, snap in results:
             if snap is None:
                 self.edge.gate.forget(name)
                 self._qw_last.pop(name, None)
             else:
+                wait_s, rate = self._window(name, snap)
                 self.edge.gate.observe_snapshot(
-                    name, snap, queue_wait_s=self._queue_wait(name, snap))
+                    name, snap, queue_wait_s=wait_s)
+                pending_total += float(snap.get("pending", 0.0))
+                if rate is not None:
+                    drain_total = (rate if drain_total is None
+                                   else drain_total + rate)
+        # the fleet queue-drain window Retry-After is priced from:
+        # pending work across every reachable replica vs how fast the
+        # fleet admitted work this window
+        self.edge.note_drain(pending_total, drain_total)
         pressure = self.edge.gate.fleet_pressure()
         _pressure_g.set(round(pressure, 4))
         return pressure

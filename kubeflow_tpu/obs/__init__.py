@@ -66,6 +66,15 @@ from kubeflow_tpu.obs.xprof import (  # noqa: F401
     record_memory_budget,
     shape_class_of,
 )
+from kubeflow_tpu.obs.requests import (  # noqa: F401
+    DEFAULT_LEDGER,
+    PHASES as REQUEST_PHASES,
+    RequestLedger,
+    RequestRecord,
+    check_tiling as check_request_tiling,
+    fold_record as fold_request_record,
+    synthetic_rid,
+)
 from kubeflow_tpu.obs.steps import (  # noqa: F401
     FlightRecorder,
     StepRecord,

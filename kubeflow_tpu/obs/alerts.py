@@ -512,6 +512,16 @@ def default_rules() -> List[Rule]:
       unchanged; the window factors are scaled down from the 5xx
       ladder because a 10% budget caps the expressible burn ratio at
       10× (a 14.4× factor could never fire).
+    - **ttft-slo-burn-{interactive,standard,batch}** — the request
+      ledger's TTFT-breach ratio per SLO class
+      (``kftpu_request_ttft_breach_total`` over
+      ``kftpu_request_finished_total``, both labeled ``slo_class`` —
+      docs/OBSERVABILITY.md "Request lifecycle") burning that class's
+      latency budget. Objectives mirror the class's criticality:
+      interactive 98%, standard 90%, batch 70% of requests inside
+      their TTFT target — and each class's window factors are capped
+      by its budget (batch's 30% budget means a 6× factor could never
+      fire, so it burns at 3×/1.5×).
     """
     return [
         BurnRateRule(
@@ -585,4 +595,27 @@ def default_rules() -> List[Rule]:
             summary="fleet badput (non-productive chip-seconds from "
                     "the goodput ledger) is burning the 10% "
                     "efficiency budget"),
+        # one burn rule per SLO class: a batch backlog blowing its lax
+        # TTFT target must not page the interactive on-call, and an
+        # interactive breach must not hide inside a batch-dominated
+        # fleet ratio. (objective, factors) per class keep each ladder
+        # expressible within its budget (factor × budget < 1).
+        *(BurnRateRule(
+            name=f"ttft-slo-burn-{cls}",
+            numerator="kftpu_request_ttft_breach_total",
+            numerator_labels={"slo_class": cls},
+            denominator="kftpu_request_finished_total",
+            denominator_labels={"slo_class": cls},
+            objective=objective,
+            windows=(BurnWindow(3600.0, 300.0, fast),
+                     BurnWindow(6 * 3600.0, 1800.0, slow)),
+            for_s=60.0,
+            severity="critical" if cls == "interactive" else "warning",
+            summary=f"{cls!r}-class requests are missing their TTFT "
+                    f"target, burning the {100 * (1 - objective):.0f}% "
+                    "latency budget")
+          for cls, objective, fast, slow in (
+              ("interactive", 0.98, 6.0, 3.0),
+              ("standard", 0.90, 6.0, 3.0),
+              ("batch", 0.70, 3.0, 1.5))),
     ]

@@ -89,6 +89,7 @@ from kubeflow_tpu.obs import (
     current_context,
     profiler_annotator,
 )
+from kubeflow_tpu.obs import requests as reqobs
 from kubeflow_tpu.utils import DEFAULT_REGISTRY
 from kubeflow_tpu.utils.clock import Clock
 
@@ -201,6 +202,10 @@ class _Request:
     # queue-wait/admit/decode spans onto the submitting request's span
     ctx: Optional[SpanContext] = None
     t_submit: float = 0.0
+    # request-ledger key (docs/OBSERVABILITY.md "Request lifecycle"):
+    # the propagated trace id when one exists — so the edge's record
+    # and the engine's phases join — else a synthetic 32-hex id
+    rid: str = ""
     # queue-wait recorded once: a failed batch admission retries members
     # through the row path, which must not observe the wait twice
     _wait_noted: bool = False
@@ -291,6 +296,7 @@ class DecodeEngine:
                  autostart: bool = True, name: str = "",
                  clock: Optional[Clock] = None,
                  tracer: Optional[Tracer] = None,
+                 request_ledger: Optional["reqobs.RequestLedger"] = None,
                  hbm_sampler=None) -> None:
         self.config = config
         self.slots = slots
@@ -315,6 +321,12 @@ class DecodeEngine:
         # during a capture (docs/OBSERVABILITY.md)
         self.tracer = tracer if tracer is not None else Tracer(
             clock=self.clock, annotator=profiler_annotator())
+        # the request-lifecycle ledger (docs/OBSERVABILITY.md "Request
+        # lifecycle"): phase marks ride the clock reads this file
+        # already takes; the process-wide default joins edge-side
+        # phases for the same trace id
+        self.rledger = (request_ledger if request_ledger is not None
+                        else reqobs.DEFAULT_LEDGER)
         # lax.top_k-bounded sampler (models/decode.py:sample_logits
         # ``bound``): avoids the per-token full-vocab sort the exact
         # sampler pays at every sampled step — 0 selects the exact sort
@@ -831,8 +843,18 @@ class DecodeEngine:
         # the lock orders this against close()'s drain: a submit must
         # either land before the drain (and be failed by it) or see the
         # stop flag and raise — never sit in a queue nobody reads
+        # ledger key: join the propagated trace's record (the edge may
+        # already have started it) or open a fresh engine-only record.
+        # Started BEFORE the queue put — the engine thread may admit
+        # the request immediately, and its marks must find the record
+        req.rid = (req.ctx.trace_id if req.ctx is not None
+                   else reqobs.synthetic_rid())
+        self.rledger.start(req.rid, t=req.t_submit, model=self.name)
         with self._lock:
             if self._stop.is_set():
+                # the request is over (503 to the caller): close its
+                # record — whichever tier opened it
+                self.rledger.finish(req.rid, req.t_submit)
                 raise EngineClosed("decode engine closed")
             self._pending.put(req)
         _queue_depth.set(self._pending.qsize(), model=self.name)
@@ -866,9 +888,12 @@ class DecodeEngine:
                     active.append(self._pending.get_nowait())
                 except queue.Empty:
                     break
+        t_close = self.clock()
         for req in active:
             req.error = EngineClosed("decode engine closed")
             req.out.put(_END)
+            # the stream is over for its client: fold what we know
+            self.rledger.finish(req.rid, t_close)
 
     @property
     def closed(self) -> bool:
@@ -985,6 +1010,9 @@ class DecodeEngine:
         self.tracer.record("engine.queue_wait", start=req.t_submit,
                            end=now, parent=req.ctx,
                            attrs={"model": self.name})
+        # the ledger's queue phase closes on the same timestamp: slot
+        # placement / batch assembly time is admission from here on
+        self.rledger.mark(req.rid, reqobs.ADMISSION, now)
         return now
 
     def _admit_one(self, req: _Request, slot: int) -> None:
@@ -995,6 +1023,9 @@ class DecodeEngine:
                 "model": self.name, "slot": slot,
                 "prompt_tokens": int(S), "batched": False}), \
                 self._mesh_ctx():
+            # prefill phase opens here (prefix-row prep IS prefill
+            # work); admission was the gap since _note_queue_wait
+            self.rledger.mark(req.rid, reqobs.PREFILL, self.clock())
             if req.prefix_len:
                 N = req.prefix_len
                 pcache = self._prefix_cache_row(req.prompt[:N])
@@ -1035,14 +1066,24 @@ class DecodeEngine:
         # is what makes TTFT one prefill + one step
         self._finalize_admission(req, slot, int(tok))  # tpulint: disable=TPU017
 
-    def _finalize_admission(self, req: _Request, slot: int,
-                            first: int) -> None:
+    def _finalize_admission(self, req: _Request, slot: int, first: int,
+                            t: Optional[float] = None) -> None:
         """Emit the prefill-sampled first token and arm the slot's
         host-side step state — shared by the row and batch admission
-        paths so their slot initialization can never diverge."""
-        st = _Slot(req=req, t_decode0=self.clock())
-        self._emit(st, first)
-        if not self._finished(st, first):
+        paths so their slot initialization can never diverge. ``t`` is
+        the caller's already-read timestamp (the batch path stamps the
+        whole chunk once); the row path reads its own, as before."""
+        t = t if t is not None else self.clock()
+        st = _Slot(req=req, t_decode0=t)
+        # the TTFT span: one per request, edge-to-first-token visible
+        # in the trace tree the dashboard exemplar opens
+        self.tracer.record(
+            "engine.first_token", start=req.t_submit, end=t,
+            parent=req.ctx,
+            attrs={"model": self.name,
+                   "ttft_ms": round((t - req.t_submit) * 1000.0, 3)})
+        self._emit(st, first, t)
+        if not self._finished(st, first, t):
             with self._lock:
                 self._active[slot] = st
         self._tokens[slot] = first
@@ -1052,18 +1093,26 @@ class DecodeEngine:
         self._topk[slot] = req.top_k
         self._topp[slot] = req.top_p
 
-    def _emit(self, slot: _Slot, token: int) -> None:
+    def _emit(self, slot: _Slot, token: int, t: float) -> None:
+        """The per-token hot path. ``t`` is a timestamp the caller
+        ALREADY read (run_once stamps one step-end time for every token
+        of the sync batch — the moment the host actually saw them);
+        neither this method nor the ledger reads a clock here."""
         slot.produced += 1
         slot.emitted.append(token)
         self.tokens_total += 1
         _tokens_total.inc(model=self.name)
+        self.rledger.emit(slot.req.rid, t)
         slot.req.out.put(token)
 
-    def _finished(self, slot: _Slot, token: int) -> bool:
+    def _finished(self, slot: _Slot, token: int, t: float) -> bool:
         done = (slot.produced >= slot.req.max_new or
                 (slot.req.eos_id is not None and token == slot.req.eos_id))
         if done:
             slot.req.out.put(_END)
+            # last token: fold the request's record (histograms +
+            # flight ring) on the same already-read timestamp
+            self.rledger.finish(slot.req.rid, t)
         return done
 
     def run_once(self, timeout: float = 0.1) -> bool:
@@ -1125,6 +1174,11 @@ class DecodeEngine:
             if self._maybe_recover("decode step"):
                 return True
             raise
+        # ONE wall-clock read per sync batch, after the host transfer:
+        # the moment every token of this chunk became user-visible. The
+        # emit loop below stamps K×B tokens with it — per-token emit
+        # takes zero additional clock reads (the ledger contract)
+        t_step_end = self.clock()
         K = toks.shape[0]
         self.steps_total += K
         if all_greedy:
@@ -1137,14 +1191,14 @@ class DecodeEngine:
             # one span per shared step: the burst-interleave evidence
             # (chunk spans between step spans bound any decode stall)
             self.tracer.record(
-                "engine.step", start=t_step0, end=self.clock(),
+                "engine.step", start=t_step0, end=t_step_end,
                 attrs={"model": self.name, "rows": len(active), "k": K})
         retired: List[int] = []
         for i, slot in active:
             for t in range(K):
                 tok = int(toks[t, i])
-                self._emit(slot, tok)
-                if self._finished(slot, tok):
+                self._emit(slot, tok, t_step_end)
+                if self._finished(slot, tok, t_step_end):
                     # tokens past EOS/budget in this chunk are discarded
                     with self._lock:
                         self._active[i] = None
@@ -1154,7 +1208,7 @@ class DecodeEngine:
                     # the token count — the per-request cost record
                     self.tracer.record(
                         "engine.decode", start=slot.t_decode0,
-                        end=self.clock(), parent=slot.req.ctx,
+                        end=t_step_end, parent=slot.req.ctx,
                         attrs={"model": self.name,
                                "tokens": slot.produced})
                     break
@@ -1341,6 +1395,11 @@ class DecodeEngine:
         padded[0, :n] = job.tokens[job.next:job.next + n]
         final = job.next + n >= total
         t0 = self.clock()
+        if job.chunks == 0:
+            # first chunk: the record's prefill phase opens here (the
+            # span below evidences each chunk; the ledger's prefill
+            # interval runs from this mark to the first token)
+            self.rledger.mark(req.rid, reqobs.PREFILL, t0)
         with self._mesh_ctx():
             tok, self._cache = self._chunk(
                 self._params, self._cache, jnp.asarray(padded),
@@ -1356,6 +1415,7 @@ class DecodeEngine:
         job.chunks += 1
         self.prefill_chunks += 1
         _prefill_chunks_c.inc(model=self.name)
+        self.rledger.note_chunk(req.rid)
         self.tracer.record(
             "engine.prefill_chunk", start=t0, end=self.clock(),
             parent=req.ctx,
@@ -1384,7 +1444,16 @@ class DecodeEngine:
         st = _Slot(req=req, produced=job.produced0, t_decode0=now,
                    emitted=[int(t) for t in
                             job.tokens[req.prompt.size:]])
-        self._emit(st, job.last_tok)
+        if job.produced0 == 0:
+            # not on the recovery-replay path: a replayed stream's
+            # first token reached the client long ago
+            self.tracer.record(
+                "engine.first_token", start=req.t_submit, end=now,
+                parent=req.ctx,
+                attrs={"model": self.name,
+                       "ttft_ms": round((now - req.t_submit) * 1000.0,
+                                        3)})
+        self._emit(st, job.last_tok, now)
         self._tokens[slot] = job.last_tok
         self._seeds[slot] = req.seed
         self._stepidx[slot] = job.fold0 + 1
@@ -1392,7 +1461,7 @@ class DecodeEngine:
         self._topk[slot] = req.top_k
         self._topp[slot] = req.top_p
         self._pos_host[slot] = job.tokens.size
-        if self._finished(st, job.last_tok):
+        if self._finished(st, job.last_tok, now):
             self._retire_paged(slot)
         else:
             with self._lock:
@@ -1408,12 +1477,22 @@ class DecodeEngine:
             need = min(int(self._pos_host[i]) + K,
                        int(self._slot_budget[i]), Smax)
             if self._pool.ensure(i, need):
+                # page growth stalls THIS stream's decode: the arm call
+                # is a device round-trip the step waits behind. Clock
+                # reads happen only on growth (every ~page_size/K
+                # steps), never on the per-token emit path
+                t0 = self.clock()
                 with self._mesh_ctx():
                     self._cache = self._arm(
                         self._cache, jnp.int32(i),
                         jnp.int32(self._pos_host[i]),
                         jnp.asarray(self._pool.table_row(i)))
                 self._export_page_gauges()
+                with self._lock:
+                    st = self._active[i]
+                if st is not None:
+                    self.rledger.stall(st.req.rid, reqobs.KV_FAULT,
+                                       t0, self.clock())
 
     def _export_page_gauges(self) -> None:
         """One write site for the pool-occupancy gauges, so in_use /
@@ -1504,6 +1583,7 @@ class DecodeEngine:
                     req.error = EngineClosed(
                         "engine cache recovered; stream evicted — retry")
                     req.out.put(_END)
+                    self.rledger.finish(req.rid, self.clock())
         else:
             for i, req, tokens, produced, fold in replays:
                 self._replay_dense(i, req, tokens, produced, fold)
@@ -1545,16 +1625,17 @@ class DecodeEngine:
                 jnp.int32(fold))
             self._cache = self._insert(self._cache, row_cache,
                                        jnp.int32(slot))
-        st = _Slot(req=req, produced=produced, t_decode0=self.clock(),
+        t_now = self.clock()
+        st = _Slot(req=req, produced=produced, t_decode0=t_now,
                    emitted=[int(t) for t in tokens[req.prompt.size:]])
-        self._emit(st, int(tok))
+        self._emit(st, int(tok), t_now)
         self._tokens[slot] = int(tok)
         self._seeds[slot] = req.seed
         self._stepidx[slot] = fold + 1
         self._temps[slot] = req.temperature
         self._topk[slot] = req.top_k
         self._topp[slot] = req.top_p
-        if not self._finished(st, int(tok)):
+        if not self._finished(st, int(tok), t_now):
             with self._lock:
                 self._active[slot] = st
 
@@ -1620,6 +1701,7 @@ class DecodeEngine:
         except Exception as e:  # noqa: BLE001 — surface to the caller
             req.error = e
             req.out.put(_END)
+            self.rledger.finish(req.rid, self.clock())
 
     def _admit_batch(self, bucket: int, members: List[tuple]) -> None:
         """One shared prefill for same-bucket requests, then per-row
@@ -1661,6 +1743,10 @@ class DecodeEngine:
                    if self.tracer.annotator is not None
                    else contextlib.nullcontext())
             p0 = self.clock()
+            for req, _slot in members:
+                # the shared device call opens every member's prefill
+                # phase on the same already-read timestamp
+                self.rledger.mark(req.rid, reqobs.PREFILL, p0)
             with ann:
                 toks, bcache = self._prefill_batch(
                     self._params, jnp.asarray(prompts),
@@ -1682,10 +1768,12 @@ class DecodeEngine:
                 # the cache; fail the chunk retryably and escalate so
                 # the loop closes the engine (no row-path retry can
                 # succeed against a consumed cache)
+                t_fail = self.clock()
                 for req, _ in members:
                     req.error = EngineClosed(
                         "engine cache invalidated during admission")
                     req.out.put(_END)
+                    self.rledger.finish(req.rid, t_fail)
                 raise _CacheInvalidated(str(e)) from e
         self.batch_prefills += 1
         t1 = self.clock()
@@ -1701,7 +1789,7 @@ class DecodeEngine:
                 "engine.prefill", start=p0, end=p1, parent=adm,
                 attrs={"prompt_tokens": int(lens[i]), "bucket": bucket,
                        "batched": True, "batch": k})
-            self._finalize_admission(req, slot, int(toks[i]))
+            self._finalize_admission(req, slot, int(toks[i]), t1)
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -1734,7 +1822,9 @@ class DecodeEngine:
                             failed.append(self._pending.get_nowait())
                         except queue.Empty:
                             break
+                t_fail = self.clock()
                 for req in failed:
                     req.error = EngineClosed("decode engine step failed")
                     req.out.put(_END)
+                    self.rledger.finish(req.rid, t_fail)
                 return

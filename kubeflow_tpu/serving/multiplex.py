@@ -97,7 +97,8 @@ class ModelMultiplexer:
                  max_resident: int, pinned: Sequence[str] = (),
                  loader: Optional[Callable[[str], Any]] = None,
                  engine: Any = None, mesh: Any = None,
-                 clock: Optional[Callable[[], float]] = None) -> None:
+                 clock: Optional[Callable[[], float]] = None,
+                 request_ledger=None) -> None:
         if max_resident < 1:
             raise ValueError("max_resident must be >= 1")
         if len(set(pinned)) > max_resident:
@@ -113,6 +114,13 @@ class ModelMultiplexer:
         self.loader = loader
         self.engine = engine
         self.clock = clock if clock is not None else time.monotonic
+        # request-lifecycle ledger: a cold-start fault is the faulting
+        # request's weight_fault phase (carved from whatever base phase
+        # it overlaps), keyed by the caller's active trace
+        from kubeflow_tpu.obs import requests as _reqobs
+
+        self.rledger = (request_ledger if request_ledger is not None
+                        else _reqobs.DEFAULT_LEDGER)
         self._resident: Dict[str, _Resident] = {}
         self._loading: Dict[str, _Fault] = {}
         self._lock = threading.Lock()
@@ -167,8 +175,13 @@ class ModelMultiplexer:
                     break
             # follower: wait for the leader's outcome outside the lock
             # — read it off the shared fault object (a failed load
-            # fails the whole herd; a success loops to residency)
+            # fails the whole herd; a success loops to residency).
+            # The wait is THIS request's weight_fault stall too: every
+            # member of the herd pays the cold start, and each record
+            # shows its own share
+            tw0 = self.clock()
             fault.event.wait()
+            self._note_weight_fault(tw0, self.clock())
             if fault.error is not None:
                 raise fault.error
         t0 = self.clock()
@@ -181,6 +194,7 @@ class ModelMultiplexer:
             fault.event.set()
             raise
         cold_ms = (self.clock() - t0) * 1000.0
+        self._note_weight_fault(t0, t0 + cold_ms / 1000.0)
         with self._lock:
             self._tick += 1
             self._resident[name] = _Resident(
@@ -195,6 +209,19 @@ class ModelMultiplexer:
         log.info("multiplex: faulted %s in %.1f ms (%d resident)",
                  name, cold_ms, n_res)
         return handle
+
+    def _note_weight_fault(self, t0: float, t1: float) -> None:
+        """Attribute a cold-start window to the calling request's
+        lifecycle record (keyed by the thread's active trace; callers
+        outside any trace simply have no record to charge)."""
+        from kubeflow_tpu.obs import current_context
+
+        ctx = current_context()
+        if ctx is not None:
+            from kubeflow_tpu.obs import requests as _reqobs
+
+            self.rledger.stall(ctx.trace_id, _reqobs.WEIGHT_FAULT,
+                               t0, t1)
 
     def _evict_for_one_locked(self) -> None:
         """Make room for one incoming model (caller holds the lock).

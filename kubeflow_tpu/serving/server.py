@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubeflow_tpu.obs import TRACER, extract
+from kubeflow_tpu.obs import requests as reqobs
 from kubeflow_tpu.serving.engine import EngineClosed, pow2_bucket
 from kubeflow_tpu.serving.model_store import (
     LoadedModel,
@@ -47,6 +48,9 @@ _gen_requests = DEFAULT_REGISTRY.counter(
     "kftpu_serving_generate_requests_total", "generate requests")
 _gen_latency = DEFAULT_REGISTRY.gauge(
     "kftpu_serving_generate_last_latency_seconds", "last generate latency")
+# a streamed-generate yield suspended longer than this charges the
+# request ledger's stream_stall phase; below it is scheduling jitter
+STREAM_STALL_MIN_S = 0.05
 _spec_requests = DEFAULT_REGISTRY.counter(
     "kftpu_serving_speculative_requests_total",
     "generate requests served through a speculative draft pair")
@@ -425,6 +429,13 @@ def _run_generate_engine(engine, arr, row_lens, *, max_new, ctx,
 
     if stream:
         def steps():
+            # time suspended at each yield is the CLIENT not draining:
+            # the writer thread is parked in wfile.write/flush, so the
+            # gap charges the rows' lifecycle records as stream_stall
+            # (threshold-gated; sub-threshold scheduling jitter is not
+            # a stall). Same clock domain as the engine's ledger marks.
+            rledger = getattr(engine, "rledger", None)
+            clock = getattr(engine, "clock", time.monotonic)
             try:
                 iters = [r.stream() for r in reqs]
                 lasts = [0] * len(iters)
@@ -443,7 +454,14 @@ def _run_generate_engine(engine, arr, row_lens, *, max_new, ctx,
                         return
                     # finished rows repeat their final token (EOS) so
                     # the line stays a full (B,) row
+                    ty0 = clock()
                     yield [int(t) for t in lasts]
+                    ty1 = clock()
+                    if (rledger is not None
+                            and ty1 - ty0 >= STREAM_STALL_MIN_S):
+                        for r in reqs:
+                            rledger.stall(getattr(r, "rid", ""),
+                                          reqobs.STREAM_STALL, ty0, ty1)
             finally:
                 _gen_latency.set(time.perf_counter() - t0,
                                  model=model_name)

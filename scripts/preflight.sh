@@ -85,6 +85,16 @@
 #      summary, and an injected HBM climb walks hbm-headroom
 #      Pending -> Firing -> Resolved with one Event per transition
 #      (docs/OBSERVABILITY.md "Compile & memory")
+#  13. request-lifecycle smoke (scripts/request_smoke.py): a mixed
+#      burst rides edge->engine on CPU with traceparents; every
+#      record's phases tile [submit, end] exactly, each request is ONE
+#      trace tree (edge + engine spans under the inbound trace id),
+#      kftpu_request_ttft_ms reads back through the tsdb +
+#      /api/metrics/query, the worst-TTFT exemplar resolves through
+#      /api/traces/<id>, and ttft-slo-burn walks
+#      Pending -> Firing -> Resolved on an injected breach storm with
+#      one Event per transition (docs/OBSERVABILITY.md
+#      "Request lifecycle")
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -137,6 +147,9 @@ JAX_PLATFORMS=cpu python scripts/tile_sweep.py --validate || rc=1
 
 echo "== preflight: compile/HBM profile smoke =="
 JAX_PLATFORMS=cpu python scripts/profile_smoke.py || rc=1
+
+echo "== preflight: request lifecycle smoke =="
+JAX_PLATFORMS=cpu python scripts/request_smoke.py || rc=1
 
 if [ "$rc" -ne 0 ]; then
     echo "preflight: FAILED" >&2

@@ -1,0 +1,238 @@
+#!/usr/bin/env python
+"""Request-lifecycle smoke gate (scripts/preflight.sh stage 13).
+
+A mixed burst (interactive + standard, plus a forced batch shed) rides
+the real edge->engine path on CPU jax: traceparent-carrying requests
+enter ``FleetEdge.handle``, dispatch into an in-process
+``DecodeEngine``, and land in ONE shared ``RequestLedger``
+(docs/OBSERVABILITY.md "Request lifecycle"). Then checks:
+
+- every finished record's phase intervals tile ``[submit, end]``
+  exactly (``check_tiling``), with prefill + decode attribution;
+- each request is ONE trace tree: the edge and engine spans all carry
+  the inbound trace id, which is also the ledger record id;
+- ``kftpu_request_ttft_ms`` reads back through the tsdb and
+  ``GET /api/metrics/query``; ``GET /api/models/<m>/requests`` serves
+  the worst-TTFT exemplar whose traceId resolves through
+  ``GET /api/traces/<id>``;
+- the ``ttft-slo-burn-interactive`` burn-rate rule walks
+  ``Pending -> Firing -> Resolved`` on an injected breach storm with
+  exactly one k8s Event per transition.
+
+Exits nonzero on any violated invariant.
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from kubeflow_tpu.dashboard.server import DashboardApi  # noqa: E402
+from kubeflow_tpu.edge.fleet import (  # noqa: E402
+    FleetEdge,
+    FleetRequest,
+    FleetRouter,
+    SloAdmissionGate,
+)
+from kubeflow_tpu.k8s import FakeKubeClient  # noqa: E402
+from kubeflow_tpu.models import (  # noqa: E402
+    Transformer,
+    TransformerConfig,
+)
+from kubeflow_tpu.obs import extract, format_traceparent  # noqa: E402
+from kubeflow_tpu.obs import requests as reqobs  # noqa: E402
+from kubeflow_tpu.obs.alerts import (  # noqa: E402
+    FIRING,
+    PENDING,
+    RESOLVED,
+    AlertManager,
+    default_rules,
+)
+from kubeflow_tpu.obs.requests import (  # noqa: E402
+    RequestLedger,
+    check_tiling,
+)
+from kubeflow_tpu.obs.trace import (  # noqa: E402
+    SpanCollector,
+    SpanContext,
+    Tracer,
+)
+from kubeflow_tpu.obs.tsdb import TimeSeriesStore  # noqa: E402
+from kubeflow_tpu.serving.engine import DecodeEngine  # noqa: E402
+from kubeflow_tpu.utils import DEFAULT_REGISTRY  # noqa: E402
+
+
+class Clock:
+    def __init__(self, now=1000.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+
+def check(ok, what):
+    if not ok:
+        print(f"FAIL: {what}")
+        sys.exit(1)
+    print(f"ok: {what}")
+
+
+def main():
+    config = TransformerConfig(vocab_size=97, d_model=32, n_layers=2,
+                               n_heads=4, n_kv_heads=2, d_ff=64,
+                               max_seq_len=64, dtype=jnp.float32,
+                               remat=False)
+    params = Transformer(config).init(
+        jax.random.key(0), np.zeros((1, 8), np.int32))["params"]
+
+    collector = SpanCollector()
+    tracer = Tracer(collector)
+    led = RequestLedger()
+    eng = DecodeEngine(config, params, slots=2, autostart=False,
+                       name="chat", tracer=tracer, request_ledger=led)
+
+    def dispatch(replica, target, request):
+        r = eng.submit(list(request.prompt), max_new=4)
+        while eng.active_count or eng.pending_count:
+            eng.run_once(timeout=0.01)
+        return {"tokens": r.result()}
+
+    router = FleetRouter(page_size=4)
+    router.sync({"r0": "inproc"})
+    gate = SloAdmissionGate()
+    edge = FleetEdge(router, gate, dispatch=dispatch, tracer=tracer,
+                     request_ledger=led, retry_after_s=1)
+
+    # -- the mixed burst -----------------------------------------------------
+    rids = []
+    for i, cls in enumerate(["interactive", "standard", "interactive",
+                             "standard", "interactive"]):
+        inbound = SpanContext(f"{i + 1:02x}" * 16, f"{i + 1:02x}" * 8)
+        headers = {"traceparent": format_traceparent(inbound),
+                   "X-Kftpu-Slo-Class": cls}
+        with tracer.span("edge.http", remote=extract(headers)):
+            code, payload = edge.handle(FleetRequest(
+                prompt=np.arange(4 + i), headers=headers))
+        check(code == 200 and len(payload["tokens"]) == 4,
+              f"burst request {i} ({cls}) served 4 tokens")
+        rids.append(inbound.trace_id)
+
+    # a pressured gate sheds the batch straggler with a priced 503
+    gate.observe_snapshot("r0", {"pages_total": 10, "pages_free": 0,
+                                 "slots": 2, "pending": 4})
+    edge.note_drain(12, 0.5)
+    code, body = edge.handle(FleetRequest(
+        prompt=np.arange(4), headers={"X-Kftpu-Slo-Class": "batch"}))
+    check(code == 503 and body["retryAfterSeconds"] == 24,
+          "batch straggler shed with drain-priced Retry-After")
+
+    # -- phases tile, one trace tree per request -----------------------------
+    recs = {r.rid: r for r in led.records()}
+    check(len(recs) == 6 and led.live_count() == 0,
+          "6 finished records (5 served + 1 shed), none live")
+    for rec in recs.values():
+        check_tiling(rec)
+        check(abs(sum(rec.seconds.values()) - rec.wall_s) < 1e-9,
+              f"record {rec.rid[:8]} phases tile the wall clock")
+    for rid in rids:
+        rec = recs[rid]
+        for ph in (reqobs.ADMISSION, reqobs.QUEUE_WAIT, reqobs.PREFILL,
+                   reqobs.DECODE):
+            check(ph in rec.seconds, f"{rid[:8]} attributes {ph}")
+        check(rec.ttft_ms is not None and len(rec.itl_ms) == 3,
+              f"{rid[:8]} has TTFT + 3 inter-token gaps")
+        names = {s.name for s in collector.spans()
+                 if s.trace_id == rid}
+        for want in ("edge.http", "edge.fleet.request",
+                     "engine.queue_wait", "engine.prefill",
+                     "engine.first_token"):
+            check(want in names, f"{rid[:8]} trace tree has {want}")
+    shed_rec = next(r for r in recs.values() if r.shed)
+    check(shed_rec.slo_class == "batch" and shed_rec.breach,
+          "shed record is a batch-class TTFT breach")
+
+    # -- surfaced: histogram through the tsdb + dashboard routes -------------
+    clock = Clock()
+    client = FakeKubeClient()
+    store = TimeSeriesStore(clock=clock)
+    store.sample_registry(DEFAULT_REGISTRY)
+    api = DashboardApi(client, authorize=lambda *a: True, tsdb=store,
+                       collector=collector, request_ledger=led)
+    code, body = api.handle(
+        "GET",
+        "/api/metrics/query?metric=kftpu_request_ttft_ms_count"
+        "&label=model:chat&label=slo_class:interactive", None)
+    check(code == 200 and body["result"]
+          and body["result"][0]["value"] == 3.0,
+          "kftpu_request_ttft_ms reads back through /api/metrics/query")
+    code, view = api.handle("GET", "/api/models/chat/requests", None)
+    check(code == 200 and view["count"] == 5
+          and view["phaseSeconds"]["decode"]["count"] == 5,
+          "per-model request route serves phase percentiles")
+    tid = view["worstTtft"]["traceId"]
+    code, tree = api.handle("GET", f"/api/traces/{tid}", None)
+    check(code == 200 and tree["spans"],
+          "worst-TTFT exemplar resolves to the request trace")
+    code, body = api.handle("GET", "/api/metrics/requests", None)
+    check(code == 200 and body["fleet"]["count"] == 6
+          and body["fleet"]["shed"] == 1,
+          "fleet rollup counts served + shed")
+
+    # -- the ttft-slo-burn walk ----------------------------------------------
+    rule = next(r for r in default_rules()
+                if r.name == "ttft-slo-burn-interactive")
+    mgr = AlertManager(store, [rule], client=client, namespace="smoke",
+                       clock=clock, tracer=tracer)
+    transitions = []
+    seq = [0]
+
+    def finish(breach):
+        seq[0] += 1
+        rid = f"{seq[0]:032x}"
+        led.start(rid, t=clock.now, model="synthetic",
+                  slo_class="interactive")
+        if not breach:
+            led.emit(rid, clock.now + 0.1)   # 100 ms, under the 500 ms
+        led.finish(rid, clock.now + 1.0)     # no token at all -> breach
+
+    def tick(dt=30.0):
+        clock.now += dt
+        store.sample_registry(DEFAULT_REGISTRY)
+        for st in mgr.evaluate():
+            transitions.append((st.rule.name, st.state))
+
+    for _ in range(4):                       # clean baseline traffic
+        finish(breach=False)
+        tick()
+    for _ in range(8):                       # the breach storm
+        finish(breach=True)
+        finish(breach=True)
+        tick()
+    check(("ttft-slo-burn-interactive", PENDING) in transitions,
+          "burn rule went Pending on the breach storm")
+    check(("ttft-slo-burn-interactive", FIRING) in transitions,
+          "burn rule fired on the breach storm")
+    for _ in range(70):                      # recovery: clean stepping
+        for _ in range(5):
+            finish(breach=False)
+        tick()
+    check(("ttft-slo-burn-interactive", RESOLVED) in transitions,
+          "burn rule resolved when TTFT recovered")
+    names = [s for (r, s) in transitions
+             if r == "ttft-slo-burn-interactive"]
+    check(names == [PENDING, FIRING, RESOLVED],
+          "exactly Pending -> Firing -> Resolved, in order")
+    events = [e for e in client.list("v1", "Event", "smoke")
+              if e["reason"].startswith("Alert")]
+    check(sorted(e["reason"] for e in events)
+          == ["AlertFiring", "AlertPending", "AlertResolved"],
+          "exactly one Event per transition")
+
+    print("request smoke: PASS")
+
+
+if __name__ == "__main__":
+    main()

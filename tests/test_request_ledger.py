@@ -1,0 +1,497 @@
+"""Request-lifecycle ledger tests (docs/OBSERVABILITY.md "Request
+lifecycle"): exact fake-clock pins of TTFT/ITL/phase attribution, the
+tiling property under random interleavings, the zero-extra-clock-reads
+emit hot-path contract, edge→engine trace-context propagation into ONE
+trace tree + ONE ledger record, the drain-window Retry-After, the
+bench parity pin, and the dashboard request routes with the worst-TTFT
+trace exemplar."""
+
+import math
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.obs import requests as reqobs
+from kubeflow_tpu.obs.requests import (
+    ADMISSION,
+    DECODE,
+    KV_FAULT,
+    PHASES,
+    PREFILL,
+    QUEUE_WAIT,
+    SHED,
+    STREAM_STALL,
+    WEIGHT_FAULT,
+    RequestLedger,
+    check_tiling,
+    fold_record,
+    synthetic_rid,
+)
+
+RID = "ab" * 16
+
+
+# -- exact fake-clock pins ---------------------------------------------------
+
+
+def test_edge_joined_record_pins_exact_values():
+    """The end-to-end hand-computable pin: an edge-fronted request's
+    record — edge admission, hand-off queue_wait, engine admission,
+    prefill, decode with a kv_fault carve — folds to EXACT seconds,
+    TTFT and ITL on hand-picked timestamps."""
+    led = RequestLedger()
+    led.start(RID, t=0.0, slo_class="standard", phase=ADMISSION)  # edge
+    led.mark(RID, QUEUE_WAIT, 0.5)           # edge hands off to backend
+    led.start(RID, t=0.6, model="m")         # engine submit joins (model
+    #                                          back-fill only; t ignored)
+    led.mark(RID, ADMISSION, 1.0)            # engine _note_queue_wait
+    led.mark(RID, PREFILL, 1.5)              # slot placed, prefill runs
+    led.emit(RID, 2.0)                       # first token == decode mark
+    led.emit(RID, 2.5)
+    led.emit(RID, 3.0)
+    led.stall(RID, KV_FAULT, 2.2, 2.4)       # page growth mid-decode
+    rec = led.finish(RID, 3.0)
+    assert rec is not None
+    check_tiling(rec)
+    assert rec.model == "m" and rec.slo_class == "standard"
+    assert rec.ttft_ms == 2000.0
+    assert rec.itl_ms == [500.0, 500.0]
+    assert rec.tokens == 3
+    assert rec.seconds == {
+        ADMISSION: pytest.approx(1.0),       # 0.0-0.5 edge + 1.0-1.5 engine
+        QUEUE_WAIT: pytest.approx(0.5),      # 0.5-1.0 hand-off window
+        PREFILL: pytest.approx(0.5),         # 1.5-2.0
+        DECODE: pytest.approx(0.8),          # 2.0-3.0 minus the carve
+        KV_FAULT: pytest.approx(0.2),        # 2.2-2.4
+    }
+    assert rec.wall_s == pytest.approx(3.0)
+    # standard TTFT target is 2000 ms: exactly on target is NOT a breach
+    assert not rec.breach
+    # finished rid: every later mutator drops silently, finish is a no-op
+    led.emit(RID, 99.0)
+    assert led.finish(RID, 99.0) is None
+
+
+def test_shed_record_pins_admission_plus_shed():
+    led = RequestLedger()
+    rec = led.shed(RID, t_start=10.0, t_shed=10.25, t_end=10.3,
+                   slo_class="batch")
+    assert rec is not None
+    check_tiling(rec)
+    assert rec.shed and rec.breach and rec.ttft_ms is None
+    assert rec.seconds == {ADMISSION: pytest.approx(0.25),
+                           SHED: pytest.approx(0.05)}
+
+
+def test_stalls_clip_and_never_overlap():
+    """Stall windows outside the record's life clip away; overlapping
+    stalls resolve earlier-wins so the carve set stays disjoint (the
+    tiling precondition)."""
+    led = RequestLedger()
+    led.start(RID, t=0.0, phase=PREFILL)
+    led.emit(RID, 1.0)
+    led.stall(RID, WEIGHT_FAULT, -5.0, 0.5)   # clips to [0.0, 0.5]
+    led.stall(RID, KV_FAULT, 0.4, 0.8)        # loses [0.4, 0.5] overlap
+    led.stall(RID, STREAM_STALL, 1.5, 99.0)   # clips to [1.5, 2.0]
+    rec = led.finish(RID, 2.0)
+    check_tiling(rec)
+    assert rec.seconds == {
+        WEIGHT_FAULT: pytest.approx(0.5),
+        KV_FAULT: pytest.approx(0.3),
+        PREFILL: pytest.approx(0.2),          # 0.8-1.0 survives the carves
+        DECODE: pytest.approx(0.5),           # 1.0-1.5
+        STREAM_STALL: pytest.approx(0.5),
+    }
+
+
+# -- the tiling property under random interleavings --------------------------
+
+
+def test_property_random_interleavings_tile_exactly():
+    """For ANY random interleaving of starts/marks/stalls/emits across
+    concurrent requests, every folded record's intervals tile
+    [t_start, t_end] exactly: no gaps, no overlaps, seconds summing to
+    the wall clock — the goodput invariant at request granularity."""
+    rng = random.Random(20)
+    for round_i in range(30):
+        led = RequestLedger()
+        rids = [f"{round_i:02x}{i:02x}" * 8 for i in range(8)]
+        t0 = {rid: rng.uniform(0.0, 10.0) for rid in rids}
+        last = dict(t0)
+        for rid in rids:
+            led.start(rid, t=t0[rid], model="m",
+                      phase=rng.choice([QUEUE_WAIT, ADMISSION]))
+        ops = []
+        for rid in rids:
+            for _ in range(rng.randrange(0, 12)):
+                ops.append(rid)
+        rng.shuffle(ops)
+        for rid in ops:
+            kind = rng.randrange(4)
+            t = last[rid] + rng.uniform(-0.5, 2.0)  # may go backwards
+            if kind == 0:
+                led.mark(rid, rng.choice(
+                    [QUEUE_WAIT, ADMISSION, PREFILL, DECODE]), t)
+            elif kind == 1:
+                led.emit(rid, t)
+            elif kind == 2:
+                led.stall(rid, rng.choice(
+                    [KV_FAULT, WEIGHT_FAULT, STREAM_STALL]),
+                    t, t + rng.uniform(-0.2, 1.0))
+            else:
+                led.note_chunk(rid)
+            last[rid] = max(last[rid], t)
+        for rid in rids:
+            rec = led.finish(rid, last[rid] + rng.uniform(-1.0, 1.0))
+            assert rec is not None
+            check_tiling(rec)
+            assert set(rec.seconds) <= set(PHASES)
+            assert sum(rec.seconds.values()) == pytest.approx(
+                rec.wall_s, abs=1e-9)
+
+
+# -- the emit hot-path contract ----------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def lm():
+    from kubeflow_tpu.models import Transformer, TransformerConfig
+
+    config = TransformerConfig(vocab_size=97, d_model=32, n_layers=2,
+                               n_heads=4, n_kv_heads=2, d_ff=64,
+                               max_seq_len=64, dtype=jnp.float32,
+                               remat=False)
+    params = Transformer(config).init(
+        jax.random.key(0), np.zeros((1, 8), np.int32))["params"]
+    return config, params
+
+
+class _CountingClock:
+    def __init__(self):
+        self.reads = 0
+
+    def __call__(self) -> float:
+        self.reads += 1
+        return time.monotonic()
+
+
+def _steady_state_reads(config, params, steps_per_sync: int) -> int:
+    """Engine clock reads in ONE steady-state run_once (live decode,
+    no admission, no finish)."""
+    from kubeflow_tpu.serving.engine import DecodeEngine
+
+    clock = _CountingClock()
+    eng = DecodeEngine(config, params, slots=2,
+                       steps_per_sync=steps_per_sync, autostart=False,
+                       clock=clock, request_ledger=RequestLedger())
+    eng.submit([5, 11, 17], max_new=40)
+    eng.run_once(timeout=0.01)          # admit + first sync batch
+    before = clock.reads
+    eng.run_once(timeout=0.01)          # steady state: decode only
+    return clock.reads - before
+
+
+def test_emit_hot_path_adds_no_wall_clock_reads(lm):
+    """The acceptance property: ledger emits ride the ONE timestamp
+    run_once already reads per sync batch — clock reads per
+    steady-state run_once do not scale with tokens emitted
+    (steps_per_sync × batch), so the ledger added zero reads on the
+    emit path."""
+    config, params = lm
+    reads_small = _steady_state_reads(config, params, steps_per_sync=2)
+    reads_large = _steady_state_reads(config, params, steps_per_sync=8)
+    assert reads_small == reads_large, (
+        f"clock reads scale with emitted tokens: {reads_small} at "
+        f"steps_per_sync=2 vs {reads_large} at 8")
+    assert reads_large <= 6
+
+
+def test_engine_records_tile_and_export_histograms(lm):
+    """A real (wall-clock) engine run: every finished record tiles,
+    carries prefill+decode attribution and the ttft/itl observations
+    land in the kftpu_request_* histograms with {model, slo_class}."""
+    from kubeflow_tpu.serving.engine import DecodeEngine
+    from kubeflow_tpu.utils import DEFAULT_REGISTRY
+
+    config, params = lm
+    led = RequestLedger()
+    eng = DecodeEngine(config, params, slots=2, autostart=False,
+                       name="tiled", request_ledger=led)
+    reqs = [eng.submit([5, 11, 17 + i], max_new=6) for i in range(3)]
+    while eng.active_count or eng.pending_count:
+        eng.run_once(timeout=0.01)
+    for r in reqs:
+        assert len(r.result()) == 6
+    recs = led.records("tiled")
+    assert len(recs) == 3
+    for rec in recs:
+        check_tiling(rec)
+        assert rec.tokens == 6
+        assert rec.ttft_ms is not None and rec.ttft_ms > 0
+        assert len(rec.itl_ms) == 5
+        assert PREFILL in rec.seconds and DECODE in rec.seconds
+        assert rec.slo_class == ""      # no edge: exported as "none"
+    text = DEFAULT_REGISTRY.expose()
+    assert ('kftpu_request_ttft_ms_count{model="tiled",'
+            'slo_class="none"}') in text
+    assert 'kftpu_request_phase_seconds_count' in text
+
+
+# -- edge→engine propagation: one trace tree, one record ---------------------
+
+
+def test_edge_to_engine_one_trace_tree_one_record(lm):
+    """A request dispatched through FleetRouter with a traceparent
+    produces ONE trace tree — edge admission, engine queue-wait,
+    prefill, first-token spans all under the inbound trace id — and
+    ONE ledger record carrying both tiers' phases."""
+    from kubeflow_tpu.edge.fleet import (
+        FleetEdge,
+        FleetRequest,
+        FleetRouter,
+        SloAdmissionGate,
+    )
+    from kubeflow_tpu.obs import extract, format_traceparent
+    from kubeflow_tpu.obs.trace import SpanCollector, SpanContext, Tracer
+    from kubeflow_tpu.serving.engine import DecodeEngine
+
+    config, params = lm
+    col = SpanCollector()
+    tracer = Tracer(col)
+    led = RequestLedger()
+    eng = DecodeEngine(config, params, slots=2, autostart=False,
+                       name="m0", tracer=tracer, request_ledger=led)
+
+    def dispatch(replica, target, request):
+        r = eng.submit(list(request.prompt), max_new=4)
+        while eng.active_count or eng.pending_count:
+            eng.run_once(timeout=0.01)
+        return {"tokens": r.result()}
+
+    router = FleetRouter(page_size=4)
+    router.sync({"r0": "inproc"})
+    edge = FleetEdge(router, SloAdmissionGate(), dispatch=dispatch,
+                     tracer=tracer, request_ledger=led)
+    inbound = SpanContext("c0ffee" * 5 + "00", "beef" * 4)
+    headers = {"traceparent": format_traceparent(inbound),
+               "X-Kftpu-Slo-Class": "interactive"}
+    with tracer.span("edge.http", remote=extract(headers)):
+        code, payload = edge.handle(FleetRequest(
+            prompt=np.arange(4), headers=headers))
+    assert code == 200 and len(payload["tokens"]) == 4
+    spans = {s.name: s for s in col.spans()}
+    for name in ("edge.http", "edge.fleet.request", "engine.queue_wait",
+                 "engine.admit", "engine.prefill", "engine.first_token"):
+        assert name in spans, sorted(spans)
+        assert spans[name].trace_id == inbound.trace_id, name
+    # one record, keyed by the SAME trace id, phases from both tiers
+    recs = led.records("m0")
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec.rid == inbound.trace_id
+    assert rec.slo_class == "interactive"
+    check_tiling(rec)
+    for phase in (ADMISSION, QUEUE_WAIT, PREFILL, DECODE):
+        assert phase in rec.seconds, rec.seconds
+    assert led.live_count() == 0        # nothing leaked live
+
+
+# -- Retry-After from the scraped queue-drain window --------------------------
+
+
+def _expo(pending: float, qw_sum: float, qw_count: float) -> str:
+    return (f"kftpu_engine_slots 8\n"
+            f"kftpu_engine_kv_pages_free 64\n"
+            f"kftpu_engine_pending_requests {pending}\n"
+            f"engine_queue_wait_seconds_sum {qw_sum}\n"
+            f"engine_queue_wait_seconds_count {qw_count}\n")
+
+
+def test_retry_after_tracks_drain_window():
+    """The Retry-After pin: pending / measured drain rate, clamped to
+    [floor, 30]; the static retry_after_s only answers before the
+    first window or with an empty queue."""
+    from kubeflow_tpu.edge.fleet import (
+        BackendPoller,
+        FleetEdge,
+        FleetRouter,
+        SloAdmissionGate,
+    )
+
+    router = FleetRouter(page_size=4)
+    router.sync({"r0": "http://r0"})
+    edge = FleetEdge(router, SloAdmissionGate(),
+                     dispatch=lambda *a: {}, retry_after_s=1)
+    t = [100.0]
+    text = [""]
+    poller = BackendPoller(edge, fetch=lambda url: text[0],
+                           clock=lambda: t[0])
+    text[0] = _expo(12, 0.0, 100)
+    poller.poll_once()
+    assert edge.retry_after() == 1          # no window yet -> floor
+    t[0] += 10.0
+    text[0] = _expo(12, 5.0, 105)           # 5 admits / 10 s
+    poller.poll_once()
+    assert edge.retry_after() == math.ceil(12 / 0.5) == 24
+    t[0] += 10.0
+    text[0] = _expo(400, 10.0, 110)
+    poller.poll_once()
+    assert edge.retry_after() == 30         # cap
+    t[0] += 10.0
+    text[0] = _expo(12, 10.0, 110)          # idle window: zero drain
+    poller.poll_once()
+    assert edge.retry_after() == 30         # queued work, nothing moving
+    t[0] += 10.0
+    text[0] = _expo(0, 10.0, 110)
+    poller.poll_once()
+    assert edge.retry_after() == 1          # empty queue -> floor
+
+
+def test_shed_503_carries_drain_priced_retry_after():
+    from kubeflow_tpu.edge.fleet import (
+        FleetEdge,
+        FleetRequest,
+        FleetRouter,
+        SloAdmissionGate,
+    )
+
+    router = FleetRouter(page_size=4)
+    router.sync({"r0": "http://r0"})
+    gate = SloAdmissionGate()
+    gate.observe_snapshot("r0", {"slots": 1, "pending": 5})  # pressure 1
+    edge = FleetEdge(router, gate, dispatch=lambda *a: {},
+                     request_ledger=RequestLedger(), retry_after_s=1)
+    edge.note_drain(12, 0.5)
+    code, body = edge.handle(FleetRequest(
+        prompt=np.arange(4), headers={"X-Kftpu-Slo-Class": "batch"}))
+    assert code == 503
+    assert body["retryAfterSeconds"] == 24
+    # ...and the shed landed in the ledger as a finished shed record
+    recs = edge.rledger.records()
+    assert len(recs) == 1 and recs[0].shed
+    assert recs[0].slo_class == "batch"
+
+
+# -- bench parity pin --------------------------------------------------------
+
+
+class _FakeReq:
+    def __init__(self, rid: str, t_submit: float) -> None:
+        self.rid = rid
+        self.t_submit = t_submit
+
+
+def test_bench_ledger_ttft_matches_legacy_wave_computation():
+    """The satellite pin: the bench's ledger-based burst TTFT equals
+    the legacy first-wave stamp (wall from burst start until every
+    wave member's first token) on a fake-clock wave — ONE definition
+    shared by bench and production."""
+    from kubeflow_tpu.bench.suite import ledger_burst_ttft_ms
+
+    led = RequestLedger()
+    t0 = 50.0                      # burst start == first submit
+    wave, firsts = [], []
+    for i in range(4):
+        sub = t0 + 0.001 * i
+        first = sub + 0.1 + 0.05 * i
+        rid = f"{i:02x}" * 16
+        led.start(rid, t=sub, model="bench")
+        led.emit(rid, first)
+        led.finish(rid, first + 0.2)
+        wave.append(_FakeReq(rid, sub))
+        firsts.append(first)
+    legacy = round((max(firsts) - t0) * 1e3, 1)  # the deleted stamp
+    assert ledger_burst_ttft_ms(led, wave) == legacy
+    # a wave member with no first token poisons the number -> JSON null
+    led.start("f" * 32, t=t0)
+    led.finish("f" * 32, t0 + 1.0)
+    wave.append(_FakeReq("f" * 32, t0))
+    assert ledger_burst_ttft_ms(led, wave) is None
+
+
+# -- dashboard surfaces ------------------------------------------------------
+
+
+def test_dashboard_request_routes_and_worst_ttft_exemplar():
+    """GET /api/models/<model>/requests serves phase percentiles plus
+    the worst-TTFT request's exemplar, whose traceId resolves through
+    GET /api/traces/<id> to the request's real span tree; GET
+    /api/metrics/requests serves the fleet rollup."""
+    from kubeflow_tpu.dashboard.server import DashboardApi
+    from kubeflow_tpu.k8s import FakeKubeClient
+    from kubeflow_tpu.obs.trace import SpanCollector, Tracer
+
+    col = SpanCollector()
+    t = [0.0]
+    tracer = Tracer(col, clock=lambda: t[0])  # spans share the fake axis
+    led = RequestLedger()
+    rids = []
+    for i in range(3):
+        t[0] = float(i)
+        with tracer.span("edge.fleet.request") as sp:
+            rid = sp.trace_id
+            led.start(rid, t=float(i), model="m0",
+                      slo_class="standard", phase=ADMISSION)
+            led.mark(rid, PREFILL, i + 0.1)
+            led.emit(rid, i + 0.2 + 0.4 * i)   # worst TTFT: the last
+            led.finish(rid, i + 1.0)
+            t[0] = i + 1.0
+        rids.append(rid)
+    api = DashboardApi(FakeKubeClient(), collector=col,
+                       request_ledger=led)
+    code, view = api.handle("GET", "/api/models/m0/requests", None)
+    assert code == 200
+    assert view["count"] == 3
+    assert view["ttftMs"]["max"] == pytest.approx(1000.0)
+    assert set(view["phaseSeconds"]) == {ADMISSION, PREFILL, DECODE}
+    ex = view["worstTtft"]
+    assert ex["traceId"] == rids[-1]
+    assert ex["ttftMs"] == pytest.approx(1000.0)
+    assert ex["span"] == "edge.fleet.request"
+    code, tree = api.handle("GET", f"/api/traces/{ex['traceId']}", None)
+    assert code == 200
+    assert any(s["name"] == "edge.fleet.request"
+               for s in tree["spans"])
+    code, rollup = api.handle("GET", "/api/metrics/requests", None)
+    assert code == 200
+    assert rollup["fleet"]["count"] == 3
+    assert rollup["models"]["m0"]["count"] == 3
+    assert rollup["fleet"]["phaseFractions"]
+    code, _ = api.handle("GET", "/api/models/nosuch/requests", None)
+    assert code == 404
+
+
+def test_ttft_slo_burn_rules_in_default_pack():
+    """One burn rule per SLO class over the ledger's breach/finished
+    counters, each ladder expressible within its budget."""
+    from kubeflow_tpu.obs.alerts import BurnRateRule, default_rules
+
+    rules = {r.name: r for r in default_rules()}
+    for cls, objective in (("interactive", 0.98), ("standard", 0.90),
+                           ("batch", 0.70)):
+        rule = rules[f"ttft-slo-burn-{cls}"]
+        assert isinstance(rule, BurnRateRule)
+        assert rule.numerator == "kftpu_request_ttft_breach_total"
+        assert rule.denominator == "kftpu_request_finished_total"
+        assert rule.numerator_labels == {"slo_class": cls}
+        assert rule.denominator_labels == {"slo_class": cls}
+        assert rule.objective == objective
+        for w in rule.windows:
+            # the ladder must be able to fire: factor × budget < 1
+            assert w.factor * (1.0 - objective) < 1.0
+        assert rule.for_s > 0       # Pending must be visible
+
+
+def test_live_eviction_and_synthetic_rids():
+    led = RequestLedger(max_live=4)
+    for i in range(8):
+        led.start(f"{i:02x}" * 16, t=float(i))
+    assert led.live_count() == 4
+    assert led.dropped_live == 4
+    a, b = synthetic_rid(), synthetic_rid()
+    assert a != b and len(a) == 32
+    int(a, 16)                      # 32 hex chars, trace-id shaped
